@@ -1,0 +1,26 @@
+"""openr-tpu: a TPU-native link-state routing framework.
+
+A ground-up re-design of the capabilities of Open/R (reference:
+/root/reference, Facebook's link-state IGP) around JAX/XLA on TPU:
+
+- ``openr_tpu.types``     -- the typed message schema (reference: openr/if/*.thrift)
+- ``openr_tpu.graph``     -- host LinkState graph + device snapshot compiler
+                             (reference: openr/decision/LinkState.{h,cpp})
+- ``openr_tpu.ops``       -- batched all-sources SPF + route-selection kernels
+- ``openr_tpu.parallel``  -- device-mesh sharding of the source dimension
+- ``openr_tpu.decision``  -- SpfSolver / Decision module
+                             (reference: openr/decision/Decision.cpp)
+- ``openr_tpu.kvstore``   -- flooded, eventually-consistent LSDB
+                             (reference: openr/kvstore/KvStore.cpp)
+- ``openr_tpu.messaging`` -- typed replicated queues (reference: openr/messaging)
+- ``openr_tpu.spark``     -- neighbor discovery (reference: openr/spark)
+- ``openr_tpu.linkmonitor``, ``openr_tpu.fib``, ``openr_tpu.prefixmgr``,
+  ``openr_tpu.ctrl``, ``openr_tpu.cli`` -- the protocol/daemon shell.
+
+The compute hot path (all-sources shortest paths, ECMP next-hop derivation,
+best-route selection) runs as jitted JAX kernels over dense int32 metric
+arrays resident in HBM; the protocol machinery is host-side Python/C++ with
+the same module-per-thread, typed-queue dataflow as the reference daemon.
+"""
+
+__version__ = "0.1.0"

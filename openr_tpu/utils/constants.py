@@ -1,0 +1,38 @@
+"""Framework-wide constants (reference: openr/common/Constants.h)."""
+
+from __future__ import annotations
+
+# key markers in the flooded store (reference: Constants.h kAdjDbMarker /
+# kPrefixDbMarker)
+ADJ_DB_MARKER = "adj:"
+PREFIX_DB_MARKER = "prefix:"
+FIB_TIME_MARKER = "fibtime:"
+
+PREFIX_NAME_SEPARATOR = ":"
+
+DEFAULT_AREA = "0"
+
+# default ports (reference: Constants.h:254-263)
+CTRL_PORT = 2018
+KVSTORE_PORT = 60002
+FIB_AGENT_PORT = 60100
+SPARK_MCAST_PORT = 6666
+
+# debounce window for route rebuilds (reference: common/Flags.cpp:87-96)
+DECISION_DEBOUNCE_MIN_MS = 10
+DECISION_DEBOUNCE_MAX_MS = 250
+
+# KvStore timers (reference: Constants.h)
+KVSTORE_DB_SYNC_INTERVAL_S = 60
+TTL_DECREMENT_MS = 1  # floor applied when re-flooding TTLs
+
+# MPLS label ranges (reference: Constants.h kSrGlobalRange / kSrLocalRange)
+SR_GLOBAL_RANGE = (101, 49999)
+SR_LOCAL_RANGE = (50000, 59999)
+MPLS_LABEL_MIN = 16
+MPLS_LABEL_MAX = (1 << 20) - 1
+
+
+def is_mpls_label_valid(label: int) -> bool:
+    """reference: openr/common/Util.h isMplsLabelValid"""
+    return MPLS_LABEL_MIN <= label <= MPLS_LABEL_MAX

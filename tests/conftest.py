@@ -1,0 +1,16 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; all sharding tests run on
+8 virtual CPU devices (the standard JAX trick for testing pjit/shard_map
+topologies host-side). The driver separately dry-runs the multi-chip path
+via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

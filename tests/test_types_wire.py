@@ -1,0 +1,134 @@
+"""Schema + wire codec tests (reference test analogue: thrift round-trip
+guarantees the reference gets for free from fbthrift)."""
+
+import pytest
+
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    BinaryAddress,
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    NextHop,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+    UnicastRoute,
+    Value,
+)
+from openr_tpu.utils import wire
+
+
+def test_binary_address_roundtrip():
+    a = BinaryAddress.from_str("fe80::1", if_name="eth0")
+    assert a.to_str() == "fe80::1"
+    assert not a.is_v4
+    b = BinaryAddress.from_str("10.0.0.1")
+    assert b.is_v4 and b.to_str() == "10.0.0.1"
+
+
+def test_ip_prefix_parse():
+    p = IpPrefix.from_str("fd00::/64")
+    assert p.prefix_length == 64 and not p.is_v4
+    q = IpPrefix.from_str("10.1.2.0/24")
+    assert q.is_v4 and q.to_str() == "10.1.2.0/24"
+
+
+def test_prefix_metrics_comparison_order():
+    # (path_preference desc, source_preference desc, distance asc)
+    # reference: openr/common/Util.h:549 selectBestPrefixMetrics
+    better = PrefixMetrics(path_preference=2, source_preference=0, distance=9)
+    worse = PrefixMetrics(path_preference=1, source_preference=9, distance=0)
+    assert better.comparison_key() > worse.comparison_key()
+    near = PrefixMetrics(path_preference=1, source_preference=1, distance=1)
+    far = PrefixMetrics(path_preference=1, source_preference=1, distance=5)
+    assert near.comparison_key() > far.comparison_key()
+
+
+def test_unicast_route_canonical_nexthop_order():
+    nh1 = NextHop(address=BinaryAddress.from_str("fe80::2"), metric=10)
+    nh2 = NextHop(address=BinaryAddress.from_str("fe80::1"), metric=10)
+    r1 = UnicastRoute(dest=IpPrefix.from_str("fd00::/64"), next_hops=(nh1, nh2))
+    r2 = UnicastRoute(dest=IpPrefix.from_str("fd00::/64"), next_hops=(nh2, nh1))
+    assert r1 == r2
+    assert wire.dumps(r1) == wire.dumps(r2)
+
+
+@pytest.mark.parametrize(
+    "obj,cls",
+    [
+        (BinaryAddress.from_str("fd00::1"), BinaryAddress),
+        (IpPrefix.from_str("10.0.0.0/8"), IpPrefix),
+        (
+            Adjacency(
+                other_node_name="n2",
+                if_name="if_a",
+                metric=7,
+                next_hop_v6=BinaryAddress.from_str("fe80::2"),
+                adj_label=50001,
+                rtt=123,
+                other_if_name="if_b",
+            ),
+            Adjacency,
+        ),
+        (
+            MplsAction(action=MplsActionCode.PUSH, push_labels=(1, 2, 3)),
+            MplsAction,
+        ),
+        (
+            NextHop(
+                address=BinaryAddress.from_str("fe80::9", if_name="if9"),
+                metric=3,
+                area="0",
+                neighbor_node_name="n9",
+                mpls_action=MplsAction(action=MplsActionCode.SWAP, swap_label=5),
+            ),
+            NextHop,
+        ),
+        (Value(version=3, originator_id="node-1", value=b"xyz", ttl=500), Value),
+    ],
+)
+def test_wire_roundtrip(obj, cls):
+    data = wire.dumps(obj)
+    back = wire.loads(data, cls)
+    assert back == obj
+    assert wire.dumps(back) == data
+
+
+def test_wire_roundtrip_adj_db():
+    topo = topologies.grid(3)
+    for db in topo.adj_dbs.values():
+        data = wire.dumps(db)
+        assert wire.loads(data, AdjacencyDatabase) == db
+    for pdb in topo.prefix_dbs.values():
+        data = wire.dumps(pdb)
+        assert wire.loads(data, PrefixDatabase) == pdb
+
+
+def test_wire_determinism_dict_ordering():
+    v1 = wire.dumps({"b": 1, "a": 2})
+    v2 = wire.dumps(dict([("a", 2), ("b", 1)]))
+    assert v1 == v2
+
+
+def test_generate_hash_stable():
+    h1 = wire.generate_hash(1, "node-1", b"value")
+    h2 = wire.generate_hash(1, "node-1", b"value")
+    h3 = wire.generate_hash(2, "node-1", b"value")
+    assert h1 == h2 != h3
+    assert -(1 << 63) <= h1 < (1 << 63)
+
+
+def test_topology_generators_shapes():
+    g = topologies.grid(4)
+    assert g.num_nodes == 16
+    ft = topologies.fat_tree(pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3)
+    # 2 planes x 2 ssw + 2 pods x (2 fsw + 3 rsw)
+    assert ft.num_nodes == 2 * 2 + 2 * (2 + 3)
+    rm = topologies.random_mesh(30, degree=4, seed=7)
+    assert rm.num_nodes == 30
+    # deterministic
+    rm2 = topologies.random_mesh(30, degree=4, seed=7)
+    assert rm.adj_dbs == rm2.adj_dbs
