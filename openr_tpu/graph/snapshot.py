@@ -1,0 +1,144 @@
+"""LinkState -> device-array snapshot compiler.
+
+The TPU compute path never walks the host object graph. Instead, each
+topology version of a ``LinkState`` is *compiled* once into dense arrays:
+
+- node-name interning: sorted names -> dense ids (stable for a given node
+  set, so unchanged topologies reuse the resident snapshot)
+- ``metric[N, N]`` int32 directed min-metric matrix (INF where no up link;
+  min over parallel links per direction)
+- ``overloaded[N]`` node transit-exclusion mask
+- directed-link metadata (iface, addrs, labels) kept host-side for
+  next-hop materialization
+
+This replaces the reference's per-(source, useLinkMetric) SPF memo cache
+(reference: openr/decision/LinkState.cpp:794-803): the memo key here is
+``LinkState.topology_version`` and the cached artifact is the HBM-resident
+metric matrix, against which any batch of sources can be solved.
+
+Padding: N is padded up to the next multiple of 128 (TPU lane width) so
+recompilation only happens when the node count crosses a bucket boundary,
+not on every node join/leave.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from openr_tpu.graph.linkstate import Link, LinkState
+
+# Distance/metric infinity sentinel. Chosen so that INF + INF still fits
+# in int32 (no wraparound in the relaxation adds): 2**30 - 1, and
+# 2*(2**30 - 1) == 2**31 - 2 < 2**31 - 1.
+INF = np.int32((1 << 30) - 1)
+
+_PAD = 128
+
+
+def _padded(n: int) -> int:
+    return max(_PAD, ((n + _PAD - 1) // _PAD) * _PAD)
+
+
+@dataclass
+class DirectedLink:
+    """Host-side metadata for one direction of one up link; indexed
+    parallel to the snapshot's directed-link arrays."""
+
+    link: Link
+    src: str
+    dst: str
+    src_id: int
+    dst_id: int
+    metric: int
+
+
+@dataclass
+class GraphSnapshot:
+    area: str
+    version: int
+    node_names: List[str]  # index == dense node id
+    node_index: Dict[str, int]
+    n: int  # real node count
+    n_pad: int  # padded node count (metric matrix dimension)
+    metric: np.ndarray  # [n_pad, n_pad] int32, INF where no edge
+    hop: np.ndarray  # [n_pad, n_pad] int32, 1 where edge, INF elsewhere
+    overloaded: np.ndarray  # [n_pad] bool
+    directed_links: List[DirectedLink]
+    # per node id: indices into directed_links of links leaving that node
+    links_from: List[List[int]]
+
+    def id_of(self, node: str) -> Optional[int]:
+        return self.node_index.get(node)
+
+
+def compile_snapshot(ls: LinkState) -> GraphSnapshot:
+    """Compile the current LinkState topology into a GraphSnapshot."""
+    names = sorted(ls.get_adjacency_databases().keys())
+    index = {name: i for i, name in enumerate(names)}
+    n = len(names)
+    n_pad = _padded(n)
+
+    metric = np.full((n_pad, n_pad), INF, dtype=np.int32)
+    overloaded = np.zeros((n_pad,), dtype=bool)
+    directed: List[DirectedLink] = []
+    links_from: List[List[int]] = [[] for _ in range(n)]
+
+    for name in names:
+        i = index[name]
+        overloaded[i] = ls.is_node_overloaded(name)
+        for link in ls.ordered_links_from_node(name):
+            if not link.is_up():
+                continue
+            j = index[link.other_node(name)]
+            m = min(int(link.metric_from(name)), int(INF) - 1)
+            links_from[i].append(len(directed))
+            directed.append(
+                DirectedLink(
+                    link=link,
+                    src=name,
+                    dst=link.other_node(name),
+                    src_id=i,
+                    dst_id=j,
+                    metric=m,
+                )
+            )
+            if m < metric[i, j]:
+                metric[i, j] = m
+
+    hop = np.where(metric < INF, np.int32(1), INF).astype(np.int32)
+    return GraphSnapshot(
+        area=ls.area,
+        version=ls.topology_version,
+        node_names=names,
+        node_index=index,
+        n=n,
+        n_pad=n_pad,
+        metric=metric,
+        hop=hop,
+        overloaded=overloaded,
+        directed_links=directed,
+        links_from=links_from,
+    )
+
+
+class SnapshotCache:
+    """Versioned snapshot cache, one entry per area."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[str, GraphSnapshot] = {}
+
+    def get(self, ls: LinkState) -> GraphSnapshot:
+        snap = self._cache.get(ls.area)
+        if snap is None or snap.version != ls.topology_version:
+            snap = compile_snapshot(ls)
+            self._cache[ls.area] = snap
+        return snap
+
+    def invalidate(self, area: Optional[str] = None) -> None:
+        if area is None:
+            self._cache.clear()
+        else:
+            self._cache.pop(area, None)

@@ -1,0 +1,261 @@
+"""LinkState graph-engine tests (reference analogue:
+openr/decision/tests/LinkStateTest.cpp)."""
+
+import pytest
+
+from openr_tpu.graph.linkstate import HoldableValue, LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def load(topo):
+    ls = LinkState(area=topo.area)
+    for db in topo.adj_dbs.values():
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def adj(other, if_name, other_if, metric=1, overloaded=False, adj_label=0):
+    return Adjacency(
+        other_node_name=other,
+        if_name=if_name,
+        other_if_name=other_if,
+        metric=metric,
+        is_overloaded=overloaded,
+        adj_label=adj_label,
+    )
+
+
+def db(node, adjs, overloaded=False, node_label=0, area="0"):
+    return AdjacencyDatabase(
+        this_node_name=node,
+        adjacencies=tuple(adjs),
+        is_overloaded=overloaded,
+        node_label=node_label,
+        area=area,
+    )
+
+
+class TestBidirectionalLinks:
+    def test_unidirectional_adjacency_creates_no_link(self):
+        ls = LinkState()
+        change = ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba")])
+        )
+        assert not change.topology_changed
+        assert ls.num_links == 0
+
+    def test_bidirectional_adjacency_creates_link(self):
+        ls = LinkState()
+        ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba")]))
+        change = ls.update_adjacency_database(
+            db("b", [adj("a", "if_ba", "if_ab")])
+        )
+        assert change.topology_changed
+        assert ls.num_links == 1
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+
+    def test_mismatched_ifaces_no_link(self):
+        ls = LinkState()
+        ls.update_adjacency_database(db("a", [adj("b", "if_ab", "WRONG")]))
+        ls.update_adjacency_database(db("b", [adj("a", "if_ba", "if_ab")]))
+        assert ls.num_links == 0
+
+    def test_link_removal_on_adj_withdrawal(self):
+        ls = LinkState()
+        ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba")]))
+        ls.update_adjacency_database(db("b", [adj("a", "if_ba", "if_ab")]))
+        change = ls.update_adjacency_database(db("a", []))
+        assert change.topology_changed
+        assert ls.num_links == 0
+
+    def test_delete_adjacency_database(self):
+        ls = load(topologies.ring(4))
+        change = ls.delete_adjacency_database("node-0")
+        assert change.topology_changed
+        assert not ls.has_node("node-0")
+        # node-1..3 remain connected in a line
+        assert ls.get_metric_from_a_to_b("node-1", "node-3") == 2
+
+
+class TestMetricsAndOverloads:
+    def _pair(self, metric_ab=1, metric_ba=1):
+        ls = LinkState()
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=metric_ab)])
+        )
+        ls.update_adjacency_database(
+            db("b", [adj("a", "if_ba", "if_ab", metric=metric_ba)])
+        )
+        return ls
+
+    def test_asymmetric_metrics(self):
+        ls = self._pair(metric_ab=5, metric_ba=9)
+        assert ls.get_metric_from_a_to_b("a", "b") == 5
+        assert ls.get_metric_from_a_to_b("b", "a") == 9
+
+    def test_metric_change_invalidates_memo(self):
+        ls = self._pair()
+        v0 = ls.topology_version
+        change = ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=7)])
+        )
+        assert change.topology_changed
+        assert ls.topology_version > v0
+        assert ls.get_metric_from_a_to_b("a", "b") == 7
+
+    def test_link_overload_takes_link_down(self):
+        ls = self._pair()
+        change = ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", overloaded=True)])
+        )
+        assert change.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") is None
+
+    def test_node_overload_blocks_transit_only(self):
+        # line a - b - c with b overloaded: a can reach b but not c
+        ls = LinkState()
+        ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba")]))
+        ls.update_adjacency_database(
+            db(
+                "b",
+                [adj("a", "if_ba", "if_ab"), adj("c", "if_bc", "if_cb")],
+                overloaded=True,
+            )
+        )
+        ls.update_adjacency_database(db("c", [adj("b", "if_cb", "if_bc")]))
+        assert ls.is_node_overloaded("b")
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+        assert ls.get_metric_from_a_to_b("a", "c") is None
+        # b itself can still reach everything (source exemption)
+        assert ls.get_metric_from_a_to_b("b", "c") == 1
+
+    def test_no_change_is_no_change(self):
+        topo = topologies.grid(3)
+        ls = load(topo)
+        v0 = ls.topology_version
+        change = ls.update_adjacency_database(topo.adj_dbs["node-0"])
+        assert not change.topology_changed
+        assert ls.topology_version == v0
+
+
+class TestEcmpAndPaths:
+    def test_ecmp_next_hops_square(self):
+        # a-b-d and a-c-d equal cost: a's next hops toward d are {b, c}
+        ls = LinkState()
+        edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+        topo = topologies.build_topology("sq", edges)
+        for adj_db in topo.adj_dbs.values():
+            ls.update_adjacency_database(adj_db)
+        res = ls.get_spf_result("a")
+        assert res["d"].metric == 2
+        assert res["d"].next_hops == {"b", "c"}
+        assert res["b"].next_hops == {"b"}
+
+    def test_unequal_paths_single_next_hop(self):
+        edges = [("a", "b", 1), ("a", "c", 5), ("b", "d", 1), ("c", "d", 1)]
+        topo = topologies.build_topology("sq2", edges)
+        ls = load(topo)
+        res = ls.get_spf_result("a")
+        assert res["d"].metric == 2
+        assert res["d"].next_hops == {"b"}
+
+    def test_hop_count_mode(self):
+        edges = [("a", "b", 10), ("b", "c", 10), ("a", "c", 100)]
+        topo = topologies.build_topology("tri", edges)
+        ls = load(topo)
+        assert ls.get_metric_from_a_to_b("a", "c") == 20
+        assert ls.get_hops_from_a_to_b("a", "c") == 1
+        assert ls.get_max_hops_to_node("a") == 1
+
+    def test_kth_paths_edge_disjoint(self):
+        # square: two edge-disjoint paths a->d
+        edges = [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+        ls = load(topologies.build_topology("sq3", edges))
+        p1 = ls.get_kth_paths("a", "d", 1)
+        assert len(p1) == 2  # both equal-cost shortest paths traced
+        used = {l for p in p1 for l in p}
+        p2 = ls.get_kth_paths("a", "d", 2)
+        assert all(l not in used for p in p2 for l in p)
+        assert p2 == []  # square is exhausted after the two shortest
+
+    def test_kth_paths_second_shortest(self):
+        # triangle with a longer detour: k=1 direct, k=2 via c
+        edges = [("a", "b", 1), ("a", "c", 2), ("c", "b", 2)]
+        ls = load(topologies.build_topology("tri2", edges))
+        p1 = ls.get_kth_paths("a", "b", 1)
+        assert len(p1) == 1 and len(p1[0]) == 1
+        p2 = ls.get_kth_paths("a", "b", 2)
+        assert len(p2) == 1 and len(p2[0]) == 2
+
+    def test_path_a_in_path_b(self):
+        edges = [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+        ls = load(topologies.build_topology("line", edges))
+        res = ls.get_spf_result("a")
+        full = ls._trace_one_path("a", "d", res, set())
+        sub = full[1:3]
+        assert LinkState.path_a_in_path_b(sub, full)
+        assert not LinkState.path_a_in_path_b(full, sub)
+
+
+class TestHolds:
+    def test_holdable_value_basics(self):
+        hv = HoldableValue(10)
+        # degrading change (increase) held for hold_down ttl
+        assert not hv.update_value(20, 2, 3)  # no observable change yet
+        assert hv.value == 10 and hv.has_hold()
+        assert not hv.decrement_ttl()
+        assert not hv.decrement_ttl()
+        assert hv.decrement_ttl()  # third tick expires the hold
+        assert hv.value == 20 and not hv.has_hold()
+
+    def test_holdable_bool_false_hold(self):
+        # hold of value False must still count as a hold
+        hv = HoldableValue(False)
+        assert not hv.update_value(True, 5, 5)
+        assert hv.value is False and hv.has_hold()
+
+    def test_second_change_clears_hold(self):
+        hv = HoldableValue(10)
+        hv.update_value(20, 5, 5)
+        assert hv.has_hold()
+        # second change while held: applied immediately
+        assert hv.update_value(30, 5, 5)
+        assert hv.value == 30 and not hv.has_hold()
+
+    def test_same_value_noop(self):
+        hv = HoldableValue(10)
+        assert not hv.update_value(10, 5, 5)
+        assert not hv.has_hold()
+
+    def test_link_up_hold(self):
+        ls = LinkState()
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba")]), hold_up_ttl=2
+        )
+        change = ls.update_adjacency_database(
+            db("b", [adj("a", "if_ba", "if_ab")]), hold_up_ttl=2
+        )
+        # link held down: not yet a topology change
+        assert not change.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") is None
+        assert ls.has_holds()
+        assert not ls.decrement_holds().topology_changed
+        assert ls.decrement_holds().topology_changed  # hold expired
+        assert ls.get_metric_from_a_to_b("a", "b") == 1
+
+    def test_metric_hold_down(self):
+        ls = LinkState()
+        ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba", metric=5)]))
+        ls.update_adjacency_database(db("b", [adj("a", "if_ba", "if_ab")]))
+        # metric increase (degrading) held for hold_down ttl
+        change = ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=9)]),
+            hold_up_ttl=1,
+            hold_down_ttl=2,
+        )
+        assert not change.topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") == 5
+        ls.decrement_holds()
+        assert ls.decrement_holds().topology_changed
+        assert ls.get_metric_from_a_to_b("a", "b") == 9
