@@ -125,20 +125,22 @@ def compile_snapshot(ls: LinkState) -> GraphSnapshot:
 
 
 class SnapshotCache:
-    """Versioned snapshot cache, one entry per area."""
+    """Versioned snapshot cache keyed by LinkState *identity* (weakly held)
+    so distinct graphs never alias, plus topology_version for staleness."""
 
     def __init__(self) -> None:
-        self._cache: Dict[str, GraphSnapshot] = {}
+        import weakref
+
+        self._cache: "weakref.WeakKeyDictionary[LinkState, GraphSnapshot]" = (
+            weakref.WeakKeyDictionary()
+        )
 
     def get(self, ls: LinkState) -> GraphSnapshot:
-        snap = self._cache.get(ls.area)
+        snap = self._cache.get(ls)
         if snap is None or snap.version != ls.topology_version:
             snap = compile_snapshot(ls)
-            self._cache[ls.area] = snap
+            self._cache[ls] = snap
         return snap
 
-    def invalidate(self, area: Optional[str] = None) -> None:
-        if area is None:
-            self._cache.clear()
-        else:
-            self._cache.pop(area, None)
+    def invalidate(self) -> None:
+        self._cache.clear()

@@ -162,6 +162,7 @@ class UnicastRoute:
     next_hops: Tuple[NextHop, ...] = ()
     admin_distance: Optional[AdminDistance] = None
     prefix_type: Optional[PrefixType] = None
+    data: Optional[bytes] = None
     do_not_install: bool = False
 
     def __post_init__(self) -> None:

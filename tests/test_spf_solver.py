@@ -1,0 +1,485 @@
+"""SpfSolver route-computation tests.
+
+Scenario coverage mirrors the reference golden corpus
+(openr/decision/tests/DecisionTest.cpp, 51 cases): SP-ECMP, anycast,
+best-metrics selection, drained advertisers, min-nexthop, SR-MPLS label
+routes, KSP2 edge-disjoint multipath — all written fresh against our API.
+"""
+
+import pytest
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    IpPrefix,
+    MplsActionCode,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+)
+from openr_tpu.types.lsdb import PrefixForwardingAlgorithm, PrefixForwardingType
+
+
+def setup_network(topo, prefix_dbs=None):
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    prefix_state = PrefixState()
+    for db in (prefix_dbs or topo.prefix_dbs).values():
+        prefix_state.update_prefix_database(db)
+    return {topo.area: ls}, prefix_state
+
+
+def overload_node(topo, name):
+    from openr_tpu.types import AdjacencyDatabase
+
+    db = topo.adj_dbs[name]
+    topo.adj_dbs[name] = AdjacencyDatabase(
+        this_node_name=db.this_node_name,
+        is_overloaded=True,
+        adjacencies=db.adjacencies,
+        node_label=db.node_label,
+        area=db.area,
+    )
+
+
+def route_map(route_db):
+    return {e.prefix: e for e in (route_db.unicast_routes.values())}
+
+
+def nh_neighbors(entry):
+    return {nh.neighbor_node_name for nh in entry.nexthops}
+
+
+class TestSpEcmp:
+    def test_line_routes(self):
+        topo = topologies.build_topology(
+            "line", [("a", "b", 10), ("b", "c", 20)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        solver = SpfSolver("a")
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        routes = db.unicast_routes
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        # no route to own prefix
+        a_pfx = topo.prefix_dbs["a"].prefix_entries[0].prefix
+        assert a_pfx not in routes
+        rb, rc = routes[b_pfx], routes[c_pfx]
+        assert nh_neighbors(rb) == {"b"}
+        assert nh_neighbors(rc) == {"b"}
+        (nb,) = rb.nexthops
+        assert nb.metric == 10
+        assert nb.address.if_name == "if_a_b"
+        (nc,) = rc.nexthops
+        assert nc.metric == 30
+
+    def test_ecmp_two_paths(self):
+        topo = topologies.build_topology(
+            "sq", [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        solver = SpfSolver("a")
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        d_pfx = topo.prefix_dbs["d"].prefix_entries[0].prefix
+        rd = db.unicast_routes[d_pfx]
+        assert nh_neighbors(rd) == {"b", "c"}
+        assert all(nh.metric == 2 for nh in rd.nexthops)
+
+    def test_unequal_cost_single_path(self):
+        topo = topologies.build_topology(
+            "sq", [("a", "b", 1), ("a", "c", 9), ("b", "d", 1), ("c", "d", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        d_pfx = topo.prefix_dbs["d"].prefix_entries[0].prefix
+        assert nh_neighbors(db.unicast_routes[d_pfx]) == {"b"}
+
+    def test_anycast_closest_wins(self):
+        # b and d both advertise P; a is 1 hop from b, 2 from d
+        topo = topologies.build_topology(
+            "line", [("a", "b", 1), ("b", "c", 1), ("c", "d", 1)]
+        )
+        anycast = IpPrefix.from_str("fd00:a::/64")
+        pdbs = dict(topo.prefix_dbs)
+        for node in ("b", "d"):
+            pdbs[node] = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=pdbs[node].prefix_entries
+                + (PrefixEntry(prefix=anycast),),
+                area=topo.area,
+            )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        r = db.unicast_routes[anycast]
+        assert nh_neighbors(r) == {"b"}
+        (nh,) = r.nexthops
+        assert nh.metric == 1
+
+    def test_anycast_equidistant_ecmp(self):
+        topo = topologies.build_topology(
+            "sq", [("a", "b", 1), ("a", "c", 1)]
+        )
+        anycast = IpPrefix.from_str("fd00:a::/64")
+        pdbs = dict(topo.prefix_dbs)
+        for node in ("b", "c"):
+            pdbs[node] = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=pdbs[node].prefix_entries
+                + (PrefixEntry(prefix=anycast),),
+                area=topo.area,
+            )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"b", "c"}
+
+    def test_unreachable_advertiser_no_route(self):
+        topo = topologies.build_topology(
+            "disc", [("a", "b", 1), ("c", "d", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        assert c_pfx not in db.unicast_routes
+
+    def test_node_not_in_graph_returns_none(self):
+        topo = topologies.build_topology("pair", [("a", "b", 1)])
+        area_ls, prefix_state = setup_network(topo)
+        assert SpfSolver("zz").build_route_db("zz", area_ls, prefix_state) is None
+
+
+class TestBestRouteSelection:
+    def _anycast_with_metrics(self, metrics_by_node):
+        topo = topologies.build_topology(
+            "tri", [("a", "b", 1), ("a", "c", 1)]
+        )
+        anycast = IpPrefix.from_str("fd00:a::/64")
+        pdbs = dict(topo.prefix_dbs)
+        for node, metrics in metrics_by_node.items():
+            pdbs[node] = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=pdbs[node].prefix_entries
+                + (PrefixEntry(prefix=anycast, metrics=metrics),),
+                area=topo.area,
+            )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        return anycast, db
+
+    def test_higher_path_preference_wins(self):
+        anycast, db = self._anycast_with_metrics(
+            {
+                "b": PrefixMetrics(path_preference=100),
+                "c": PrefixMetrics(path_preference=50),
+            }
+        )
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"b"}
+
+    def test_source_preference_tiebreak(self):
+        anycast, db = self._anycast_with_metrics(
+            {
+                "b": PrefixMetrics(path_preference=100, source_preference=10),
+                "c": PrefixMetrics(path_preference=100, source_preference=90),
+            }
+        )
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"c"}
+
+    def test_lower_distance_tiebreak(self):
+        anycast, db = self._anycast_with_metrics(
+            {
+                "b": PrefixMetrics(path_preference=1, distance=4),
+                "c": PrefixMetrics(path_preference=1, distance=2),
+            }
+        )
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"c"}
+
+    def test_equal_metrics_multipath(self):
+        anycast, db = self._anycast_with_metrics(
+            {
+                "b": PrefixMetrics(path_preference=7),
+                "c": PrefixMetrics(path_preference=7),
+            }
+        )
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"b", "c"}
+
+    def test_negative_metrics_select_nothing(self):
+        # worse than the (0,0,0) initial best: no route (reference quirk)
+        anycast, db = self._anycast_with_metrics(
+            {
+                "b": PrefixMetrics(path_preference=0, distance=5),
+                "c": PrefixMetrics(path_preference=0, distance=9),
+            }
+        )
+        assert anycast not in db.unicast_routes
+
+
+class TestDrainedNodes:
+    def _topo_with_anycast(self):
+        topo = topologies.build_topology(
+            "tri", [("a", "b", 1), ("a", "c", 1)]
+        )
+        anycast = IpPrefix.from_str("fd00:a::/64")
+        pdbs = dict(topo.prefix_dbs)
+        for node in ("b", "c"):
+            pdbs[node] = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=pdbs[node].prefix_entries
+                + (PrefixEntry(prefix=anycast),),
+                area=topo.area,
+            )
+        return topo, anycast, pdbs
+
+    def test_drained_advertiser_filtered(self):
+        topo, anycast, pdbs = self._topo_with_anycast()
+        overload_node(topo, "b")
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"c"}
+
+    def test_all_drained_falls_back_unfiltered(self):
+        topo, anycast, pdbs = self._topo_with_anycast()
+        overload_node(topo, "b")
+        overload_node(topo, "c")
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"b", "c"}
+
+
+class TestRouteConstraints:
+    def test_min_nexthop_drops_route(self):
+        topo = topologies.build_topology(
+            "sq", [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)]
+        )
+        d_pfx = topo.prefix_dbs["d"].prefix_entries[0].prefix
+        pdbs = dict(topo.prefix_dbs)
+        pdbs["d"] = PrefixDatabase(
+            this_node_name="d",
+            prefix_entries=(PrefixEntry(prefix=d_pfx, min_nexthop=3),),
+            area=topo.area,
+        )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        # only 2 ECMP nexthops < 3 required: dropped
+        assert d_pfx not in db.unicast_routes
+
+        pdbs["d"] = PrefixDatabase(
+            this_node_name="d",
+            prefix_entries=(PrefixEntry(prefix=d_pfx, min_nexthop=2),),
+            area=topo.area,
+        )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        assert len(db.unicast_routes[d_pfx].nexthops) == 2
+
+    def test_v4_gated_by_flag(self):
+        topo = topologies.build_topology(
+            "pair", [("a", "b", 1)], v4_prefixes=True
+        )
+        area_ls, prefix_state = setup_network(topo)
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        assert b_pfx.is_v4
+        db = SpfSolver("a", enable_v4=False).build_route_db(
+            "a", area_ls, prefix_state
+        )
+        assert b_pfx not in db.unicast_routes
+        db = SpfSolver("a", enable_v4=True).build_route_db(
+            "a", area_ls, prefix_state
+        )
+        r = db.unicast_routes[b_pfx]
+        (nh,) = r.nexthops
+        assert len(nh.address.addr) == 4  # v4 nexthop for v4 prefix
+
+
+class TestMplsRoutes:
+    def test_node_label_routes(self):
+        topo = topologies.build_topology(
+            "line", [("a", "b", 1), ("b", "c", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        labels = {
+            n: topo.adj_dbs[n].node_label for n in ("a", "b", "c")
+        }
+        # own label: POP_AND_LOOKUP
+        own = db.mpls_routes[labels["a"]]
+        (nh,) = own.nexthops
+        assert nh.mpls_action.action == MplsActionCode.POP_AND_LOOKUP
+        # neighbor label: PHP (penultimate hop pop)
+        rb = db.mpls_routes[labels["b"]]
+        (nhb,) = rb.nexthops
+        assert nhb.mpls_action.action == MplsActionCode.PHP
+        assert nhb.neighbor_node_name == "b"
+        # remote label: SWAP via b
+        rc = db.mpls_routes[labels["c"]]
+        (nhc,) = rc.nexthops
+        assert nhc.mpls_action.action == MplsActionCode.SWAP
+        assert nhc.mpls_action.swap_label == labels["c"]
+        assert nhc.neighbor_node_name == "b"
+
+    def test_adjacency_label_routes(self):
+        topo = topologies.build_topology("pair", [("a", "b", 1)])
+        # add adjacency labels
+        from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+        def with_adj_label(db, label):
+            adjs = tuple(
+                Adjacency(
+                    other_node_name=adj.other_node_name,
+                    if_name=adj.if_name,
+                    metric=adj.metric,
+                    next_hop_v6=adj.next_hop_v6,
+                    next_hop_v4=adj.next_hop_v4,
+                    adj_label=label,
+                    is_overloaded=adj.is_overloaded,
+                    rtt=adj.rtt,
+                    timestamp=adj.timestamp,
+                    weight=adj.weight,
+                    other_if_name=adj.other_if_name,
+                )
+                for adj in db.adjacencies
+            )
+            return AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                is_overloaded=db.is_overloaded,
+                adjacencies=adjs,
+                node_label=db.node_label,
+                area=db.area,
+            )
+
+        topo.adj_dbs["a"] = with_adj_label(topo.adj_dbs["a"], 50001)
+        topo.adj_dbs["b"] = with_adj_label(topo.adj_dbs["b"], 50002)
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        r = db.mpls_routes[50001]
+        (nh,) = r.nexthops
+        assert nh.mpls_action.action == MplsActionCode.PHP
+        assert nh.neighbor_node_name == "b"
+
+    def test_sr_mpls_ip_to_mpls_push(self):
+        topo = topologies.build_topology(
+            "line",
+            [("a", "b", 1), ("b", "c", 1)],
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        (nh,) = db.unicast_routes[c_pfx].nexthops
+        assert nh.mpls_action.action == MplsActionCode.PUSH
+        assert nh.mpls_action.push_labels == (topo.adj_dbs["c"].node_label,)
+        # directly-connected destination: no label push
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        (nhb,) = db.unicast_routes[b_pfx].nexthops
+        assert nhb.mpls_action is None
+
+
+class TestKsp2:
+    def test_two_edge_disjoint_paths(self):
+        topo = topologies.build_topology(
+            "sq",
+            [("a", "b", 1), ("a", "c", 1), ("b", "d", 1), ("c", "d", 1)],
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        d_pfx = topo.prefix_dbs["d"].prefix_entries[0].prefix
+        r = db.unicast_routes[d_pfx]
+        assert nh_neighbors(r) == {"b", "c"}
+        for nh in r.nexthops:
+            assert nh.metric == 2
+            assert nh.mpls_action.action == MplsActionCode.PUSH
+            assert nh.mpls_action.push_labels == (
+                topo.adj_dbs["d"].node_label,
+            )
+
+    def test_second_path_longer(self):
+        # a-b direct (1) plus detour a-c-b (4): KSP2 uses both
+        topo = topologies.build_topology(
+            "tri",
+            [("a", "b", 1), ("a", "c", 2), ("c", "b", 2)],
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        r = db.unicast_routes[b_pfx]
+        by_neighbor = {nh.neighbor_node_name: nh for nh in r.nexthops}
+        assert set(by_neighbor) == {"b", "c"}
+        assert by_neighbor["b"].metric == 1
+        assert by_neighbor["b"].mpls_action is None  # direct: PHP'd away
+        assert by_neighbor["c"].metric == 4
+        assert by_neighbor["c"].mpls_action.push_labels == (
+            topo.adj_dbs["b"].node_label,
+        )
+
+    def test_ksp2_requires_sr_mpls(self):
+        topo = topologies.build_topology(
+            "sq",
+            [("a", "b", 1), ("b", "d", 1)],
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            forwarding_type=PrefixForwardingType.IP,
+        )
+        area_ls, prefix_state = setup_network(topo)
+        db = SpfSolver("a").build_route_db("a", area_ls, prefix_state)
+        d_pfx = topo.prefix_dbs["d"].prefix_entries[0].prefix
+        assert d_pfx not in db.unicast_routes
+
+
+class TestBackendParity:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_device_matches_host(self, seed):
+        topo = topologies.random_mesh(16, degree=3, seed=seed, max_metric=10)
+        if seed == 1:
+            overload_node(topo, "node-3")
+        area_ls, prefix_state = setup_network(topo)
+        my = "node-0"
+        db_dev = SpfSolver(my, backend="device").build_route_db(
+            my, area_ls, prefix_state
+        )
+        db_host = SpfSolver(my, backend="host").build_route_db(
+            my, area_ls, prefix_state
+        )
+        assert db_dev.to_route_db(my) == db_host.to_route_db(my)
+
+    def test_route_db_delta(self):
+        topo = topologies.build_topology(
+            "line", [("a", "b", 1), ("b", "c", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        solver = SpfSolver("a")
+        db1 = solver.build_route_db("a", area_ls, prefix_state)
+        # metric change b->c: only c's route updates
+        from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+        old = topo.adj_dbs["b"]
+        new_adjs = tuple(
+            Adjacency(
+                other_node_name=adj.other_node_name,
+                if_name=adj.if_name,
+                metric=50 if adj.other_node_name == "c" else adj.metric,
+                next_hop_v6=adj.next_hop_v6,
+                next_hop_v4=adj.next_hop_v4,
+                adj_label=adj.adj_label,
+                other_if_name=adj.other_if_name,
+            )
+            for adj in old.adjacencies
+        )
+        area_ls["0"].update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="b",
+                adjacencies=new_adjs,
+                node_label=old.node_label,
+                area=old.area,
+            )
+        )
+        db2 = solver.build_route_db("a", area_ls, prefix_state)
+        delta = db1.calculate_update(db2)
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        assert set(delta.unicast_routes_to_update) == {c_pfx}
+        assert not delta.unicast_routes_to_delete
+        (nh,) = delta.unicast_routes_to_update[c_pfx].nexthops
+        assert nh.metric == 51
