@@ -1,0 +1,112 @@
+"""Reconvergence benchmark: full all-sources SPF after a topology change.
+
+Scenario (mirrors the reference Decision benchmarks,
+openr/decision/tests/DecisionBenchmark.cpp: BM_DecisionFabric, and its
+<100 ms convergence design goal, openr/docs/Introduction/Overview.md:28):
+
+  A ~1000-node 3-tier fat-tree is resident as a compiled snapshot. One
+  adjacency metric changes (link churn). Measured latency = incremental
+  LinkState merge + snapshot recompile + device all-sources SPF (every
+  node's distance vector; the reference computes *one* source per SPF
+  call) + ECMP first-hop matrix for this node, result on host.
+
+Prints one JSON line:
+  {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": x}
+where vs_baseline is the speedup vs the reference's 100 ms convergence
+design goal (>1.0 means faster than the goal).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.graph.snapshot import compile_snapshot
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import spf as spf_ops
+    from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    topo = topologies.fat_tree_nodes(1000)
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+
+    churn_node = "fsw-0-0"
+    my_node = "rsw-0-0"
+
+    def churn(step: int) -> None:
+        """Bump one adjacency metric on churn_node (incremental update)."""
+        db = ls.get_adjacency_databases()[churn_node]
+        adjs = list(db.adjacencies)
+        a0 = adjs[0]
+        adjs[0] = Adjacency(
+            other_node_name=a0.other_node_name,
+            if_name=a0.if_name,
+            metric=2 + (step % 5),
+            next_hop_v6=a0.next_hop_v6,
+            next_hop_v4=a0.next_hop_v4,
+            adj_label=a0.adj_label,
+            is_overloaded=a0.is_overloaded,
+            rtt=a0.rtt,
+            timestamp=a0.timestamp,
+            weight=a0.weight,
+            other_if_name=a0.other_if_name,
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                is_overloaded=db.is_overloaded,
+                adjacencies=tuple(adjs),
+                node_label=db.node_label,
+                area=db.area,
+            )
+        )
+
+    def reconverge():
+        snap = compile_snapshot(ls)
+        sid = snap.node_index[my_node]
+        d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
+            jnp.asarray(snap.metric),
+            jnp.asarray(snap.hop),
+            jnp.asarray(snap.overloaded),
+            jnp.int32(sid),
+        )
+        jax.block_until_ready((d_src, d_all, fh))
+        return snap, d_all
+
+    # warm-up (jit compile + first snapshot)
+    snap, d_all = reconverge()
+    n = snap.n
+
+    samples = []
+    for step in range(10):
+        churn(step)
+        t0 = time.perf_counter()
+        reconverge()
+        samples.append((time.perf_counter() - t0) * 1000.0)
+
+    value = statistics.median(samples)
+    baseline_ms = 100.0  # reference convergence design goal
+    print(
+        json.dumps(
+            {
+                "metric": f"full_spf_reconvergence_ms_fattree_{n}",
+                "value": round(value, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / value, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

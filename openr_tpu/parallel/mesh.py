@@ -1,0 +1,119 @@
+"""Device-mesh sharding of the all-sources SPF.
+
+The scaling axis of this framework is the *source* dimension of the
+batched shortest-path computation: every device owns a contiguous block of
+source rows of the distance matrix while the (transit-masked) one-hop
+metric matrix is replicated. Relaxation steps are purely local; the only
+cross-device communication is a 1-bit "any row changed" OR (``psum``) per
+iteration to agree on the fixed point — so the kernel scales linearly
+across ICI with no distance-matrix traffic at all.
+
+This is the TPU-native analogue of the reference's scale story (per-source
+Dijkstra memoization + multi-area partitioning, reference:
+openr/decision/LinkState.cpp:794); instead of memoizing per source we
+recompute all sources in parallel from the HBM-resident snapshot.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from openr_tpu.ops.spf import INF, _mask_transit_rows, _minplus
+
+SOURCES_AXIS = "sources"
+
+
+def make_mesh(devices=None, axis_name: str = SOURCES_AXIS) -> Mesh:
+    """1-D mesh over all (or the given) devices, sharding the source axis."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def pad_for_mesh(n: int, mesh: Mesh, align: int = 128) -> int:
+    """Rows must divide evenly across mesh devices and stay lane-aligned
+    (128 on TPU; tests on virtual CPU meshes may pass a smaller align)."""
+    devs = mesh.devices.size
+    block = align * devs
+    return max(block, ((n + block - 1) // block) * block)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_all_sources(
+    w: jnp.ndarray, overloaded: jnp.ndarray, mesh: Mesh
+) -> jnp.ndarray:
+    """All-sources shortest-path distances [N, N], rows sharded over the
+    mesh. ``w`` must be padded so N % mesh.devices.size == 0.
+
+    Bellman-Ford over the replicated transit matrix; convergence agreed
+    via a psum'd change flag so every shard exits the while_loop together.
+    """
+    n = w.shape[0]
+    t = _mask_transit_rows(w, overloaded)
+
+    def shard_fn(w_blk: jnp.ndarray, t_full: jnp.ndarray) -> jnp.ndarray:
+        rows = w_blk.shape[0]
+        shard_idx = jax.lax.axis_index(SOURCES_AXIS)
+        row_ids = shard_idx * rows + jnp.arange(rows, dtype=jnp.int32)
+        # initial distances: this shard's source rows, diagonal zeroed
+        d0 = w_blk
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (rows, n), 1)
+        d0 = jnp.where(col_ids == row_ids[:, None], jnp.int32(0), d0)
+
+        def cond(state):
+            _, changed, it = state
+            return jnp.logical_and(changed > 0, it < n)
+
+        def body(state):
+            d, _, it = state
+            nxt = jnp.minimum(d, _minplus(d, t_full))
+            local_changed = jnp.any(nxt < d).astype(jnp.int32)
+            global_changed = jax.lax.psum(local_changed, SOURCES_AXIS)
+            return nxt, global_changed, it + 1
+
+        d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
+        return d
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SOURCES_AXIS, None), P(None, None)),
+        out_specs=P(SOURCES_AXIS, None),
+    )(w, t)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def sharded_reconvergence_step(
+    w: jnp.ndarray,
+    overloaded: jnp.ndarray,
+    dest_mask: jnp.ndarray,
+    mesh: Mesh,
+):
+    """One full sharded "reconvergence" step: all-sources SPF plus a
+    per-source nearest-advertiser reduction (the batched analogue of
+    best-route selection's min-metric destination filter,
+    reference: openr/decision/Decision.cpp:1099 getMinCostNodes).
+
+    dest_mask: [P, N] bool — advertisers per prefix group.
+    Returns (distances [N, N] row-sharded, best_metric [N, P]).
+    """
+    d = sharded_all_sources(w, overloaded, mesh)
+
+    def reduce_fn(d_blk: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+        # min over advertisers of each prefix: [rows, N] x [P, N] -> [rows, P]
+        masked = jnp.where(mask[None, :, :], d_blk[:, None, :], INF)
+        return jnp.min(masked, axis=2)
+
+    best = jax.shard_map(
+        reduce_fn,
+        mesh=mesh,
+        in_specs=(P(SOURCES_AXIS, None), P(None, None)),
+        out_specs=P(SOURCES_AXIS, None),
+    )(d, dest_mask)
+    return d, best

@@ -1,0 +1,104 @@
+"""Sharded SPF tests over the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.graph.snapshot import INF, compile_snapshot
+from openr_tpu.models import topologies
+from openr_tpu.ops import spf
+from openr_tpu.parallel import mesh as pmesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) == 8, "conftest should force 8 CPU devices"
+    return pmesh.make_mesh()
+
+
+def _snapshot(topo, n_pad):
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    snap = compile_snapshot(ls)
+    w = np.full((n_pad, n_pad), INF, dtype=np.int32)
+    w[: snap.n, : snap.n] = snap.metric[: snap.n, : snap.n]
+    ov = np.zeros((n_pad,), dtype=bool)
+    ov[: snap.n] = snap.overloaded[: snap.n]
+    return snap, w, ov
+
+
+def test_sharded_matches_single_device(mesh8):
+    topo = topologies.random_mesh(40, degree=4, seed=11, max_metric=12)
+    n_pad = pmesh.pad_for_mesh(40, mesh8, align=8)
+    snap, w, ov = _snapshot(topo, n_pad)
+    d_single = np.asarray(
+        spf.all_pairs_distances(jnp.asarray(w), jnp.asarray(ov))
+    )
+    d_sharded = np.asarray(
+        pmesh.sharded_all_sources(jnp.asarray(w), jnp.asarray(ov), mesh8)
+    )
+    np.testing.assert_array_equal(d_single, d_sharded)
+
+
+def test_sharded_with_overloads(mesh8):
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    n = topo.num_nodes
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        db = topo.adj_dbs[name]
+        if name == "fsw-0-0":
+            from openr_tpu.types import AdjacencyDatabase
+
+            db = AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                is_overloaded=True,
+                adjacencies=db.adjacencies,
+                node_label=db.node_label,
+                area=db.area,
+            )
+        ls.update_adjacency_database(db)
+    snap = compile_snapshot(ls)
+    n_pad = pmesh.pad_for_mesh(snap.n, mesh8, align=16)
+    w = np.full((n_pad, n_pad), INF, dtype=np.int32)
+    w[: snap.n, : snap.n] = snap.metric[: snap.n, : snap.n]
+    ov = np.zeros((n_pad,), dtype=bool)
+    ov[: snap.n] = snap.overloaded[: snap.n]
+    d_single = np.asarray(
+        spf.all_pairs_distances(jnp.asarray(w), jnp.asarray(ov))
+    )
+    d_sharded = np.asarray(
+        pmesh.sharded_all_sources(jnp.asarray(w), jnp.asarray(ov), mesh8)
+    )
+    np.testing.assert_array_equal(d_single, d_sharded)
+    # oracle spot check on a few sources
+    for src in ["rsw-0-0", "ssw-0-1", "fsw-0-0"]:
+        oracle = ls.run_spf(src)
+        sid = snap.node_index[src]
+        for dst, res in oracle.items():
+            assert d_sharded[sid, snap.node_index[dst]] == res.metric
+
+
+def test_reconvergence_step_shapes(mesh8):
+    topo = topologies.grid(5)
+    n_pad = pmesh.pad_for_mesh(25, mesh8, align=8)
+    snap, w, ov = _snapshot(topo, n_pad)
+    # two prefix groups: advertised by node-0, and by {node-3, node-21}
+    dest_mask = np.zeros((2, n_pad), dtype=bool)
+    dest_mask[0, snap.node_index["node-0"]] = True
+    dest_mask[1, snap.node_index["node-3"]] = True
+    dest_mask[1, snap.node_index["node-21"]] = True
+    d, best = pmesh.sharded_reconvergence_step(
+        jnp.asarray(w), jnp.asarray(ov), jnp.asarray(dest_mask), mesh8
+    )
+    d, best = np.asarray(d), np.asarray(best)
+    assert best.shape == (n_pad, 2)
+    i5 = snap.node_index["node-5"]
+    assert best[i5, 0] == d[i5, snap.node_index["node-0"]]
+    assert best[i5, 1] == min(
+        d[i5, snap.node_index["node-3"]], d[i5, snap.node_index["node-21"]]
+    )
