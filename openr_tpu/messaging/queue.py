@@ -1,0 +1,129 @@
+"""Typed in-process message bus: multi-writer fan-out queues.
+
+Behavioral parity with the reference ``openr/messaging/ReplicateQueue.h``
+and ``Queue.h``: a ``ReplicateQueue`` replicates every pushed message to
+every reader endpoint; readers block on ``get`` until a message arrives or
+the queue closes. This is the only inter-module communication mechanism in
+the daemon (modules share no mutable state — reference: Main.cpp:269-280
+wires 11 of these between the modules).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class QueueClosedError(Exception):
+    """Raised by get() once the queue is closed and drained."""
+
+
+class QueueTimeoutError(Exception):
+    """Raised by get(timeout=...) when no message arrives in time."""
+
+
+class RQueue(Generic[T]):
+    """Reader endpoint of a ReplicateQueue (reference: messaging/Queue.h)."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._items: Deque[T] = deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._closed = False
+
+    def _push(self, item: T) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._items.append(item)
+            self._cv.notify()
+
+    def _close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def get(self, timeout: Optional[float] = None) -> T:
+        """Block until a message is available. Raises QueueClosedError when
+        the queue is closed and fully drained; QueueTimeoutError on
+        timeout."""
+        with self._cv:
+            if not self._cv.wait_for(
+                lambda: self._items or self._closed, timeout=timeout
+            ):
+                raise QueueTimeoutError(self.name)
+            if self._items:
+                return self._items.popleft()
+            raise QueueClosedError(self.name)
+
+    def try_get(self) -> Optional[T]:
+        with self._cv:
+            if self._items:
+                return self._items.popleft()
+            if self._closed:
+                raise QueueClosedError(self.name)
+            return None
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed and not self._items
+
+
+class ReplicateQueue(Generic[T]):
+    """Multi-writer fan-out queue: every push is replicated to every
+    reader. reference: messaging/ReplicateQueue.h:22."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._readers: List[RQueue[T]] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._writes = 0
+
+    def get_reader(self, name: str = "") -> RQueue[T]:
+        with self._lock:
+            if self._closed:
+                raise QueueClosedError(self.name)
+            reader = RQueue(name or f"{self.name}::reader{len(self._readers)}")
+            self._readers.append(reader)
+            return reader
+
+    def push(self, item: T) -> bool:
+        with self._lock:
+            if self._closed:
+                return False
+            readers = list(self._readers)
+            self._writes += 1
+        for reader in readers:
+            reader._push(item)
+        return True
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            readers = list(self._readers)
+        for reader in readers:
+            reader._close()
+
+    def open(self) -> None:
+        with self._lock:
+            self._closed = False
+
+    @property
+    def num_readers(self) -> int:
+        with self._lock:
+            return len(self._readers)
+
+    @property
+    def num_writes(self) -> int:
+        with self._lock:
+            return self._writes
