@@ -1,0 +1,264 @@
+"""KvStore tests: merge semantics, flooding topologies, sync FSM, TTLs.
+
+Scenario coverage mirrors the reference suites
+(openr/kvstore/tests/KvStoreTest.cpp, KvStoreThriftTest.cpp,
+KvStoreClientInternalTest.cpp) — written fresh against our API.
+"""
+
+import time
+
+import pytest
+
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.store import (
+    KvStoreFilters,
+    compare_values,
+    merge_key_values,
+)
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+from openr_tpu.types import TTL_INFINITY, KvStorePeerState, Value
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+def val(version=1, originator="node-a", value=b"v", ttl=TTL_INFINITY, ttl_version=0):
+    return Value(
+        version=version,
+        originator_id=originator,
+        value=value,
+        ttl=ttl,
+        ttl_version=ttl_version,
+        hash=wire.generate_hash(version, originator, value),
+    )
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestMergeSemantics:
+    def test_new_key_accepted(self):
+        store = {}
+        updates = merge_key_values(store, {"k": val()})
+        assert set(updates) == {"k"}
+        assert store["k"].value == b"v"
+
+    def test_higher_version_wins(self):
+        store = {"k": val(version=2, value=b"old")}
+        updates = merge_key_values(store, {"k": val(version=3, value=b"new")})
+        assert set(updates) == {"k"}
+        assert store["k"].value == b"new"
+
+    def test_lower_version_rejected(self):
+        store = {"k": val(version=3, value=b"cur")}
+        updates = merge_key_values(store, {"k": val(version=2, value=b"old")})
+        assert not updates
+        assert store["k"].value == b"cur"
+
+    def test_same_version_higher_originator_wins(self):
+        store = {"k": val(originator="node-a", value=b"a")}
+        updates = merge_key_values(
+            store, {"k": val(originator="node-b", value=b"b")}
+        )
+        assert set(updates) == {"k"}
+        assert store["k"].originator_id == "node-b"
+
+    def test_same_version_same_originator_value_tiebreak(self):
+        store = {"k": val(value=b"aaa")}
+        updates = merge_key_values(store, {"k": val(value=b"bbb")})
+        assert set(updates) == {"k"}  # higher value wins deterministically
+        assert store["k"].value == b"bbb"
+        # and the lower value loses
+        updates = merge_key_values(store, {"k": val(value=b"aaa")})
+        assert not updates
+
+    def test_identical_value_no_update(self):
+        store = {"k": val()}
+        assert not merge_key_values(store, {"k": val()})
+
+    def test_ttl_only_update(self):
+        store = {"k": val(ttl=1000)}
+        ttl_update = Value(
+            version=1,
+            originator_id="node-a",
+            value=None,
+            ttl=5000,
+            ttl_version=1,
+        )
+        updates = merge_key_values(store, {"k": ttl_update})
+        assert set(updates) == {"k"}
+        assert store["k"].ttl == 5000
+        assert store["k"].ttl_version == 1
+        assert store["k"].value == b"v"  # value untouched
+
+    def test_invalid_ttl_rejected(self):
+        store = {}
+        assert not merge_key_values(store, {"k": val(ttl=0)})
+        assert not merge_key_values(store, {"k": val(ttl=-5)})
+
+    def test_filters_applied(self):
+        store = {}
+        filters = KvStoreFilters(key_prefixes=["adj:"])
+        updates = merge_key_values(
+            store, {"adj:n1": val(), "prefix:n1": val()}, filters
+        )
+        assert set(updates) == {"adj:n1"}
+
+    def test_compare_values_orderings(self):
+        assert compare_values(val(version=2), val(version=1)) == 1
+        assert compare_values(val(version=1), val(version=2)) == -1
+        assert (
+            compare_values(val(originator="b"), val(originator="a")) == 1
+        )
+        assert compare_values(val(), val()) == 0
+        v_no_hash = Value(version=1, originator_id="node-a", value=None)
+        assert compare_values(val(), v_no_hash) == -2
+        assert (
+            compare_values(
+                val(ttl_version=2), val(ttl_version=1)
+            )
+            == 1
+        )
+
+
+class TestFlooding:
+    def setup_method(self):
+        self.stores = []
+
+    def teardown_method(self):
+        for s in self.stores:
+            s.stop()
+
+    def mk(self, name, **kwargs):
+        s = KvStoreWrapper(name, **kwargs)
+        s.start()
+        self.stores.append(s)
+        return s
+
+    def test_two_stores_sync_and_flood(self):
+        a, b = self.mk("node-a"), self.mk("node-b")
+        a.set_key("pre-sync", b"from-a")
+        link_bidirectional(a, b)
+        # initial full sync carries pre-link keys
+        assert wait_until(lambda: b.get_key("pre-sync") is not None)
+        assert b.get_key("pre-sync").value == b"from-a"
+        # live flood after sync
+        b.set_key("live", b"from-b")
+        assert wait_until(lambda: a.get_key("live") is not None)
+        states = a.peer_states()
+        assert states["node-b"] == KvStorePeerState.INITIALIZED
+
+    def test_star_topology_flood(self):
+        hub = self.mk("hub")
+        leaves = [self.mk(f"leaf-{i}") for i in range(4)]
+        for leaf in leaves:
+            link_bidirectional(hub, leaf)
+        leaves[0].set_key("k0", b"x")
+        for s in [hub] + leaves:
+            assert wait_until(lambda s=s: s.get_key("k0") is not None), s.node_id
+
+    def test_ring_topology_flood(self):
+        ring = [self.mk(f"r{i}") for i in range(5)]
+        for i in range(5):
+            link_bidirectional(ring[i], ring[(i + 1) % 5])
+        ring[2].set_key("rk", b"ring")
+        for s in ring:
+            assert wait_until(lambda s=s: s.get_key("rk") is not None), s.node_id
+
+    def test_conflict_resolution_converges(self):
+        a, b = self.mk("node-a"), self.mk("node-b")
+        # both write the same key at the same version before linking
+        a.set_key("k", b"alpha", version=1)
+        b.set_key("k", b"beta", version=1)
+        link_bidirectional(a, b)
+        # (version, originator, value) ordering: same version+different
+        # originators -> higher originator ("node-b") wins everywhere
+        assert wait_until(
+            lambda: a.get_key("k") is not None
+            and a.get_key("k").originator_id == "node-b"
+        )
+        assert b.get_key("k").originator_id == "node-b"
+
+    def test_three_way_sync_pushes_back(self):
+        a, b = self.mk("node-a"), self.mk("node-b")
+        a.set_key("only-a", b"a")
+        b.set_key("only-b", b"b")
+        link_bidirectional(a, b)
+        assert wait_until(lambda: b.get_key("only-a") is not None)
+        assert wait_until(lambda: a.get_key("only-b") is not None)
+
+    def test_ttl_expiry(self):
+        a = self.mk("node-a")
+        a.set_key("mortal", b"x", ttl=150)
+        assert a.get_key("mortal") is not None
+        assert wait_until(lambda: a.get_key("mortal") is None, timeout=3.0)
+
+    def test_ttl_decrement_on_flood(self):
+        a, b = self.mk("node-a"), self.mk("node-b")
+        link_bidirectional(a, b)
+        assert wait_until(
+            lambda: a.peer_states()["node-b"] == KvStorePeerState.INITIALIZED
+        )
+        a.set_key("mortal", b"x", ttl=5000)
+        assert wait_until(lambda: b.get_key("mortal") is not None)
+        assert b.get_key("mortal").ttl < 5000
+
+
+class TestKvStoreClient:
+    def setup_method(self):
+        self.stores = []
+        self.evbs = []
+
+    def teardown_method(self):
+        for e in self.evbs:
+            e.stop()
+            e.join()
+        for s in self.stores:
+            s.stop()
+
+    def mk_client(self, name):
+        s = KvStoreWrapper(name)
+        s.start()
+        self.stores.append(s)
+        evb = OpenrEventBase(f"client-evb:{name}")
+        evb.run_in_thread()
+        self.evbs.append(evb)
+        client = KvStoreClient(evb, name, s.store, ttl_refresh_interval_s=0.1)
+        return s, client
+
+    def test_persist_and_get(self):
+        s, client = self.mk_client("node-a")
+        client.persist_key("0", "my-key", b"mine")
+        v = client.get_key("0", "my-key")
+        assert v is not None and v.value == b"mine" and v.version == 1
+
+    def test_persist_wins_back_ownership(self):
+        s, client = self.mk_client("node-a")
+        client.persist_key("0", "contested", b"mine")
+        # someone else overrides with a higher version
+        s.set_key("contested", b"theirs", version=5, originator="node-z")
+        assert wait_until(
+            lambda: (v := s.get_key("contested")) is not None
+            and v.originator_id == "node-a"
+            and v.version > 5
+        )
+
+    def test_ttl_refresh_keeps_key_alive(self):
+        s, client = self.mk_client("node-a")
+        client.persist_key("0", "heartbeat", b"alive", ttl=400)
+        time.sleep(1.5)  # several ttl periods
+        v = s.get_key("heartbeat")
+        assert v is not None and v.ttl_version > 0
+
+    def test_subscribe_key_callback(self):
+        s, client = self.mk_client("node-a")
+        hits = []
+        client.subscribe_key("0", "watched", lambda k, v: hits.append((k, v)))
+        s.set_key("watched", b"1")
+        assert wait_until(lambda: len(hits) >= 1)
+        assert hits[0][0] == "watched" and hits[0][1].value == b"1"
