@@ -1,0 +1,486 @@
+"""Decision module: LSDB stream -> debounced route computation -> deltas.
+
+Behavioral parity with the reference ``openr/decision/Decision.{h,cpp}``:
+
+- subscribes to the KvStore publication queue; dispatches ``adj:`` /
+  ``prefix:`` / ``fibtime:`` keys (processPublication, Decision.cpp:1722)
+- maintains one LinkState per area plus the global PrefixState; per-prefix
+  keys merge into a per-node synthetic PrefixDatabase
+  (updateNodePrefixDatabase, Decision.cpp:1668)
+- batches churn behind an AsyncDebounce (10..250 ms by default, matching
+  common/Flags.cpp:87-96) and tracks whether the batch needs a *full*
+  rebuild (any topology/node-label change, or local link-attribute
+  change) or an *incremental* per-prefix pass
+  (DecisionPendingUpdates, Decision.h:130; rebuildRoutes, Decision.cpp:1860)
+- publishes DecisionRouteUpdate deltas on the route-updates queue with the
+  batch's oldest perf-event chain attached
+- cold-start hold gates the first route publication (Decision.cpp:1403)
+- ordered-FIB hold decrement timer (Decision.cpp:1930 decrementOrderedFibHolds)
+
+The solver behind it runs the TPU kernels (see spf_solver.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import DecisionRouteDb, DecisionRouteUpdate
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.graph.linkstate import LinkState, LinkStateChange
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    IpPrefix,
+    PerfEvents,
+    Publication,
+    PrefixDatabase,
+    PrefixEntry,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import AsyncDebounce, OpenrEventBase
+
+
+class DecisionPendingUpdates:
+    """reference: openr/decision/Decision.h:130."""
+
+    def __init__(self, my_node_name: str):
+        self._my_node_name = my_node_name
+        self.count = 0
+        self.perf_events: Optional[PerfEvents] = None
+        self._needs_full_rebuild = False
+        self.updated_prefixes: Set[IpPrefix] = set()
+
+    def needs_full_rebuild(self) -> bool:
+        return self._needs_full_rebuild
+
+    def set_needs_full_rebuild(self) -> None:
+        self._needs_full_rebuild = True
+
+    def needs_route_update(self) -> bool:
+        return self._needs_full_rebuild or bool(self.updated_prefixes)
+
+    def apply_link_state_change(
+        self,
+        node_name: str,
+        change: LinkStateChange,
+        perf_events: Optional[PerfEvents] = None,
+    ) -> None:
+        self._needs_full_rebuild |= (
+            change.topology_changed
+            or change.node_label_changed
+            # link attributes (nexthop addr / adj label) only matter for
+            # our own links: they alter our programmed nexthops
+            or (
+                change.link_attributes_changed
+                and node_name == self._my_node_name
+            )
+        )
+        self._add_update(perf_events)
+
+    def apply_prefix_state_change(
+        self,
+        changed: Set[IpPrefix],
+        perf_events: Optional[PerfEvents] = None,
+    ) -> None:
+        self.updated_prefixes |= changed
+        self._add_update(perf_events)
+
+    def _add_update(self, perf_events: Optional[PerfEvents]) -> None:
+        self.count += 1
+        # keep the *oldest* event chain so convergence is measured from the
+        # earliest update in the debounced batch
+        if self.perf_events is None or (
+            perf_events is not None
+            and perf_events.events
+            and self.perf_events.events
+            and self.perf_events.events[0].unix_ts
+            > perf_events.events[0].unix_ts
+        ):
+            self.perf_events = (
+                PerfEvents(events=list(perf_events.events))
+                if perf_events is not None
+                else PerfEvents()
+            )
+            self.add_event("DECISION_RECEIVED")
+
+    def add_event(self, descr: str) -> None:
+        if self.perf_events is not None:
+            self.perf_events.add(self._my_node_name, descr)
+
+    def move_out_events(self) -> Optional[PerfEvents]:
+        events = self.perf_events
+        self.perf_events = None
+        return events
+
+    def reset(self) -> None:
+        self.count = 0
+        self.perf_events = None
+        self._needs_full_rebuild = False
+        self.updated_prefixes = set()
+
+
+class Decision:
+    def __init__(
+        self,
+        my_node_name: str,
+        kvstore_updates_queue: ReplicateQueue,
+        route_updates_queue: ReplicateQueue,
+        static_routes_queue: Optional[ReplicateQueue] = None,
+        debounce_min_s: float = 0.010,
+        debounce_max_s: float = 0.250,
+        cold_start_s: float = 0.0,
+        enable_v4: bool = False,
+        compute_lfa_paths: bool = False,
+        enable_ordered_fib: bool = False,
+        bgp_dry_run: bool = False,
+        enable_best_route_selection: bool = True,
+        solver_backend: str = "device",
+    ):
+        self.my_node_name = my_node_name
+        self.evb = OpenrEventBase(name=f"decision:{my_node_name}")
+        self.route_updates_queue = route_updates_queue
+        self.spf_solver = SpfSolver(
+            my_node_name,
+            enable_v4=enable_v4,
+            compute_lfa_paths=compute_lfa_paths,
+            enable_ordered_fib=enable_ordered_fib,
+            bgp_dry_run=bgp_dry_run,
+            enable_best_route_selection=enable_best_route_selection,
+            backend=solver_backend,
+        )
+        self.area_link_states: Dict[str, LinkState] = {}
+        self.prefix_state = PrefixState()
+        self.route_db = DecisionRouteDb()
+        self.pending = DecisionPendingUpdates(my_node_name)
+        self.fib_times: Dict[str, float] = {}
+        self.rib_policy = None  # set via set_rib_policy
+        self._enable_ordered_fib = enable_ordered_fib
+        # per-node view assembled from per-prefix keys
+        # (reference: perPrefixPrefixEntries_ / fullDbPrefixEntries_)
+        self._per_prefix_entries: Dict[
+            Tuple[str, str], Dict[IpPrefix, PrefixEntry]
+        ] = {}
+        self._full_db_entries: Dict[
+            Tuple[str, str], Dict[IpPrefix, PrefixEntry]
+        ] = {}
+        self.counters: Dict[str, int] = {
+            "decision.adj_db_update": 0,
+            "decision.prefix_db_update": 0,
+            "decision.route_build_runs": 0,
+            "decision.publications": 0,
+        }
+
+        self._rebuild_debounced = AsyncDebounce(
+            self.evb, debounce_min_s, debounce_max_s, self._on_debounce_fire
+        )
+        self._cold_start_until = (
+            time.monotonic() + cold_start_s if cold_start_s > 0 else 0.0
+        )
+        if cold_start_s > 0:
+            self.evb.schedule_timeout(cold_start_s, self._on_cold_start_done)
+
+        self.evb.add_queue_reader(
+            kvstore_updates_queue.get_reader(f"decision:{my_node_name}"),
+            self._on_publication,
+        )
+        if static_routes_queue is not None:
+            self.evb.add_queue_reader(
+                static_routes_queue.get_reader(f"decision:{my_node_name}"),
+                self._on_static_routes,
+            )
+        self._ordered_fib_timer = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.evb.run_in_thread()
+
+    def stop(self) -> None:
+        self.evb.stop()
+        self.evb.join()
+
+    # -- queue handlers (run on the module thread) ------------------------
+
+    def _on_publication(self, pub: Publication) -> None:
+        self.counters["decision.publications"] += 1
+        self.process_publication(pub)
+        if self.pending.needs_route_update():
+            self._rebuild_debounced()
+
+    def _on_static_routes(self, delta) -> None:
+        """Static MPLS routes pushed by the platform/plugin layer
+        (reference: Decision static routes fiber)."""
+        to_update = {
+            r.top_label: list(r.next_hops)
+            for r in getattr(delta, "mpls_routes_to_update", [])
+        }
+        to_delete = list(getattr(delta, "mpls_routes_to_delete", []))
+        self.spf_solver.update_static_mpls_routes(to_update, to_delete)
+        self.pending.set_needs_full_rebuild()
+        self._rebuild_debounced()
+
+    def process_publication(self, pub: Publication) -> None:
+        """reference: Decision.cpp:1722 processPublication."""
+        area = pub.area
+        link_state = self.area_link_states.get(area)
+        if link_state is None:
+            link_state = self.area_link_states[area] = LinkState(area)
+
+        for key, value in pub.key_vals.items():
+            if value.value is None:
+                continue  # ttl refresh only
+            node_name = keyutil.get_node_name_from_key(key)
+            try:
+                if keyutil.is_adj_key(key):
+                    adj_db = wire.loads(value.value, AdjacencyDatabase)
+                    assert adj_db.this_node_name == node_name
+                    if adj_db.area != area:
+                        adj_db = AdjacencyDatabase(
+                            this_node_name=adj_db.this_node_name,
+                            is_overloaded=adj_db.is_overloaded,
+                            adjacencies=adj_db.adjacencies,
+                            node_label=adj_db.node_label,
+                            area=area,
+                            perf_events=adj_db.perf_events,
+                        )
+                    hold_up, hold_down = self._ordered_fib_holds(
+                        link_state, node_name
+                    )
+                    self.counters["decision.adj_db_update"] += 1
+                    self.pending.apply_link_state_change(
+                        node_name,
+                        link_state.update_adjacency_database(
+                            adj_db, hold_up, hold_down
+                        ),
+                        adj_db.perf_events,
+                    )
+                    if (
+                        self._enable_ordered_fib
+                        and link_state.has_holds()
+                        and self._ordered_fib_timer is None
+                    ):
+                        self._schedule_ordered_fib_tick()
+                elif keyutil.is_prefix_key(key):
+                    prefix_db = wire.loads(value.value, PrefixDatabase)
+                    assert prefix_db.this_node_name == node_name
+                    node_db = self._update_node_prefix_db(
+                        key, prefix_db, area
+                    )
+                    if node_db is None:
+                        continue
+                    self.counters["decision.prefix_db_update"] += 1
+                    self.pending.apply_prefix_state_change(
+                        self.prefix_state.update_prefix_database(node_db),
+                        prefix_db.perf_events,
+                    )
+                elif keyutil.is_fib_time_key(key):
+                    try:
+                        self.fib_times[node_name] = float(
+                            value.value.decode()
+                        )
+                    except ValueError:
+                        pass
+            except Exception:  # noqa: BLE001 - bad LSDB values are skipped
+                continue
+
+        for key in pub.expired_keys:
+            node_name = keyutil.get_node_name_from_key(key)
+            if keyutil.is_adj_key(key):
+                self.pending.apply_link_state_change(
+                    node_name,
+                    link_state.delete_adjacency_database(node_name),
+                )
+            elif keyutil.is_prefix_key(key):
+                delete_db = PrefixDatabase(
+                    this_node_name=node_name, delete_prefix=True, area=area
+                )
+                node_db = self._update_node_prefix_db(key, delete_db, area)
+                if node_db is None:
+                    continue
+                self.pending.apply_prefix_state_change(
+                    self.prefix_state.update_prefix_database(node_db)
+                )
+
+    def _update_node_prefix_db(
+        self, key: str, prefix_db: PrefixDatabase, area: str
+    ) -> Optional[PrefixDatabase]:
+        """Merge a per-prefix or full-db advertisement into the node's
+        synthetic PrefixDatabase (reference: Decision.cpp:1668
+        updateNodePrefixDatabase)."""
+        node = prefix_db.this_node_name
+        slot = (node, area)
+        parsed = keyutil.parse_per_prefix_key(key)
+        if parsed is not None:
+            _, _, prefix = parsed
+            per = self._per_prefix_entries.setdefault(slot, {})
+            if prefix_db.delete_prefix:
+                per.pop(prefix, None)
+            else:
+                assert len(prefix_db.prefix_entries) == 1
+                entry = prefix_db.prefix_entries[0]
+                # ignore self-redistributed route reflection
+                if (
+                    node == self.my_node_name
+                    and entry.area_stack
+                    and entry.area_stack[-1] in self.area_link_states
+                ):
+                    return None
+                per[prefix] = entry
+        else:
+            if prefix_db.delete_prefix:
+                self._full_db_entries.pop(slot, None)
+            else:
+                self._full_db_entries[slot] = {
+                    e.prefix: e for e in prefix_db.prefix_entries
+                }
+
+        per = self._per_prefix_entries.get(slot, {})
+        full = self._full_db_entries.get(slot, {})
+        entries = list(per.values()) + [
+            e for p, e in full.items() if p not in per
+        ]
+        return PrefixDatabase(
+            this_node_name=node,
+            prefix_entries=tuple(entries),
+            area=area,
+            perf_events=prefix_db.perf_events,
+        )
+
+    # -- ordered fib holds ------------------------------------------------
+
+    def _ordered_fib_holds(
+        self, link_state: LinkState, node_name: str
+    ) -> Tuple[int, int]:
+        """Hold TTLs so farther routers program before nearer ones
+        (RFC 6976 style; reference: Decision.cpp:1745-1752)."""
+        if not self._enable_ordered_fib:
+            return (0, 0)
+        hops = link_state.get_hops_from_a_to_b(self.my_node_name, node_name)
+        if hops is None:
+            return (0, 0)
+        hold_up = hops
+        hold_down = max(0, link_state.get_max_hops_to_node(node_name) - hold_up)
+        return (hold_up, hold_down)
+
+    def _schedule_ordered_fib_tick(self) -> None:
+        max_fib_s = max(self.fib_times.values(), default=0.1) / 1000.0
+        self._ordered_fib_timer = self.evb.schedule_timeout(
+            max(0.05, max_fib_s), self._decrement_ordered_fib_holds
+        )
+
+    def _decrement_ordered_fib_holds(self) -> None:
+        """reference: Decision.cpp:1930 decrementOrderedFibHolds."""
+        self._ordered_fib_timer = None
+        still_has_holds = False
+        topo_changed = False
+        for link_state in self.area_link_states.values():
+            change = link_state.decrement_holds()
+            topo_changed |= change.topology_changed
+            still_has_holds |= link_state.has_holds()
+        if topo_changed:
+            self.pending.set_needs_full_rebuild()
+            self._rebuild_debounced()
+        if still_has_holds:
+            self._schedule_ordered_fib_tick()
+
+    # -- rebuild ----------------------------------------------------------
+
+    def _on_cold_start_done(self) -> None:
+        self._cold_start_until = 0.0
+        if self.pending.needs_route_update():
+            self.rebuild_routes("COLD_START_UPDATE")
+
+    def _on_debounce_fire(self) -> None:
+        self.rebuild_routes("DECISION_DEBOUNCE")
+
+    def rebuild_routes(self, event: str) -> None:
+        """reference: Decision.cpp:1860 rebuildRoutes."""
+        if self._cold_start_until and time.monotonic() < self._cold_start_until:
+            return
+        self.pending.add_event(event)
+        self.counters["decision.route_build_runs"] += 1
+
+        update = DecisionRouteUpdate()
+        if self.pending.needs_full_rebuild():
+            new_db = (
+                self.spf_solver.build_route_db(
+                    self.my_node_name, self.area_link_states, self.prefix_state
+                )
+                or DecisionRouteDb()
+            )
+            if self.rib_policy is not None:
+                self.rib_policy.apply_policy(new_db.unicast_routes)
+            update = self.route_db.calculate_update(new_db)
+        else:
+            for prefix in self.pending.updated_prefixes:
+                entry = self.spf_solver.create_route_for_prefix(
+                    self.my_node_name,
+                    self.area_link_states,
+                    self.prefix_state,
+                    prefix,
+                )
+                if entry is not None:
+                    update.unicast_routes_to_update[prefix] = entry
+                else:
+                    update.unicast_routes_to_delete.append(prefix)
+            if self.rib_policy is not None:
+                deleted = self.rib_policy.apply_policy(
+                    update.unicast_routes_to_update
+                )
+                update.unicast_routes_to_delete.extend(deleted)
+
+        self.route_db.update(update)
+        self.pending.add_event("ROUTE_UPDATE")
+        update.perf_events = self.pending.move_out_events()
+        self.pending.reset()
+        self.route_updates_queue.push(update)
+
+    # -- public (thread-safe) APIs ---------------------------------------
+
+    def get_decision_route_db(
+        self, node: Optional[str] = None
+    ) -> DecisionRouteDb:
+        """Compute (any-source!) routes on demand — first-class API, same
+        solver as the hot path (reference: Decision.cpp:1492)."""
+        node = node or self.my_node_name
+
+        def compute() -> DecisionRouteDb:
+            return (
+                self.spf_solver.build_route_db(
+                    node, self.area_link_states, self.prefix_state
+                )
+                or DecisionRouteDb()
+            )
+
+        return self.evb.call_and_wait(compute)
+
+    def get_adj_dbs(self) -> Dict[str, Dict[str, AdjacencyDatabase]]:
+        return self.evb.call_and_wait(
+            lambda: {
+                area: dict(ls.get_adjacency_databases())
+                for area, ls in self.area_link_states.items()
+            }
+        )
+
+    def get_received_route_count(self) -> int:
+        return self.evb.call_and_wait(
+            lambda: len(self.prefix_state.prefixes())
+        )
+
+    def set_rib_policy(self, policy) -> None:
+        self.evb.call_and_wait(lambda: setattr(self, "rib_policy", policy))
+        self.evb.run_in_event_base(
+            lambda: (
+                self.pending.set_needs_full_rebuild(),
+                self._rebuild_debounced(),
+            )
+        )
+
+    def get_rib_policy(self):
+        return self.evb.call_and_wait(lambda: self.rib_policy)
+
+    def get_counters(self) -> Dict[str, int]:
+        return self.evb.call_and_wait(lambda: dict(self.counters))

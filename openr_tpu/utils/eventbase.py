@@ -71,7 +71,15 @@ class OpenrEventBase:
                     cb = self._callbacks.get(timeout=timeout)
                 except _queue.Empty:
                     continue
-                cb()
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001
+                    # a module callback must never kill the module loop
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "%s: unhandled exception in event callback", self.name
+                    )
         finally:
             self._running.clear()
 
@@ -170,7 +178,14 @@ class OpenrEventBase:
                     return self._timers[0].deadline - now
                 handle = heapq.heappop(self._timers)
             if not handle.cancelled:
-                handle.fn()
+                try:
+                    handle.fn()
+                except Exception:  # noqa: BLE001
+                    import logging
+
+                    logging.getLogger(__name__).exception(
+                        "%s: unhandled exception in timer", self.name
+                    )
 
     # -- queue reader tasks (the "fibers") --------------------------------
 

@@ -1,0 +1,238 @@
+"""End-to-end Decision pipeline tests: KvStore -> Decision -> route deltas.
+
+The slice the reference exercises in
+openr/decision/tests/DecisionTest.cpp by pushing synthetic Publications
+into a real Decision and asserting on emitted DecisionRouteUpdates.
+"""
+
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.messaging.queue import QueueTimeoutError, ReplicateQueue
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    AdjacencyDatabase,
+    PrefixDatabase,
+    PrefixEntry,
+    IpPrefix,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+
+class DecisionHarness:
+    """KvStore + Decision wired through real queues."""
+
+    def __init__(self, my_node, solver_backend="device"):
+        self.store = KvStoreWrapper(f"store:{my_node}")
+        self.route_q = ReplicateQueue(name="routeUpdates")
+        self.route_reader = self.route_q.get_reader("test")
+        self.decision = Decision(
+            my_node,
+            kvstore_updates_queue=self.store.store.updates_queue,
+            route_updates_queue=self.route_q,
+            debounce_min_s=0.01,
+            debounce_max_s=0.05,
+            solver_backend=solver_backend,
+        )
+        self.store.start()
+        self.decision.start()
+        self._versions = {}
+
+    def stop(self):
+        self.decision.stop()
+        self.store.stop()
+
+    def publish_adj(self, adj_db: AdjacencyDatabase):
+        key = keyutil.adj_key(adj_db.this_node_name)
+        v = self._versions[key] = self._versions.get(key, 0) + 1
+        self.store.set_key(key, wire.dumps(adj_db), version=v,
+                           originator=adj_db.this_node_name)
+
+    def publish_prefixes(self, prefix_db: PrefixDatabase):
+        key = keyutil.prefix_db_key(prefix_db.this_node_name)
+        v = self._versions[key] = self._versions.get(key, 0) + 1
+        self.store.set_key(key, wire.dumps(prefix_db), version=v,
+                           originator=prefix_db.this_node_name)
+
+    def publish_topology(self, topo):
+        for db in topo.adj_dbs.values():
+            self.publish_adj(db)
+        for pdb in topo.prefix_dbs.values():
+            self.publish_prefixes(pdb)
+
+    def next_update(self, timeout=5.0):
+        return self.route_reader.get(timeout=timeout)
+
+    def drain_updates(self, timeout=0.3, first_timeout=10.0):
+        """Collect updates until the queue goes quiet. The first wait is
+        generous: the solver's first device compile happens lazily."""
+        updates = []
+        wait = first_timeout
+        while True:
+            try:
+                updates.append(self.route_reader.get(timeout=wait))
+                wait = timeout
+            except QueueTimeoutError:
+                return updates
+
+
+@pytest.fixture
+def harness():
+    h = DecisionHarness("a")
+    yield h
+    h.stop()
+
+
+def line_topology():
+    return topologies.build_topology("line", [("a", "b", 1), ("b", "c", 2)])
+
+
+class TestDecisionPipeline:
+    def test_initial_convergence(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        updates = harness.drain_updates()
+        assert updates
+        # after convergence the accumulated route db has routes to b and c
+        routes = harness.decision.get_decision_route_db()
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        assert b_pfx in routes.unicast_routes
+        assert c_pfx in routes.unicast_routes
+        # perf events ride the updates
+        assert any(u.perf_events is not None for u in updates)
+
+    def test_incremental_prefix_update(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        # now c advertises one more prefix: expect a delta with only it
+        extra = IpPrefix.from_str("fd00:100::/64")
+        pdb = topo.prefix_dbs["c"]
+        harness.publish_prefixes(
+            PrefixDatabase(
+                this_node_name="c",
+                prefix_entries=pdb.prefix_entries
+                + (PrefixEntry(prefix=extra),),
+                area=topo.area,
+            )
+        )
+        updates = harness.drain_updates()
+        touched = set()
+        for u in updates:
+            touched |= set(u.unicast_routes_to_update)
+            touched |= set(u.unicast_routes_to_delete)
+        assert extra in touched
+        # the unrelated route to b must not be touched by the delta
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        assert b_pfx not in touched
+
+    def test_adjacency_change_triggers_full_rebuild(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        # metric change on b->c: route to c's prefix changes metric
+        db = topo.adj_dbs["b"]
+        from openr_tpu.types import Adjacency
+
+        new_adjs = tuple(
+            Adjacency(
+                other_node_name=adj.other_node_name,
+                if_name=adj.if_name,
+                metric=40 if adj.other_node_name == "c" else adj.metric,
+                next_hop_v6=adj.next_hop_v6,
+                next_hop_v4=adj.next_hop_v4,
+                other_if_name=adj.other_if_name,
+                adj_label=adj.adj_label,
+            )
+            for adj in db.adjacencies
+        )
+        harness.publish_adj(
+            AdjacencyDatabase(
+                this_node_name="b",
+                adjacencies=new_adjs,
+                node_label=db.node_label,
+                area=db.area,
+            )
+        )
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        (nh,) = routes.unicast_routes[c_pfx].nexthops
+        assert nh.metric == 41
+
+    def test_node_down_deletes_routes(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        c_pfx = topo.prefix_dbs["c"].prefix_entries[0].prefix
+        # c's adjacency and prefix keys expire (ttl'd out)
+        harness.store.set_key(
+            keyutil.adj_key("c"), wire.dumps(AdjacencyDatabase(
+                this_node_name="c", area=topo.area)), version=99,
+            originator="c", ttl=120)
+        harness.store.set_key(
+            keyutil.prefix_db_key("c"),
+            wire.dumps(PrefixDatabase(this_node_name="c", area=topo.area)),
+            version=99, originator="c", ttl=120)
+        time.sleep(0.5)
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        assert c_pfx not in routes.unicast_routes
+
+    def test_any_source_route_computation(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        # compute routes from c's perspective (first-class API)
+        routes_c = harness.decision.get_decision_route_db("c")
+        a_pfx = topo.prefix_dbs["a"].prefix_entries[0].prefix
+        assert a_pfx in routes_c.unicast_routes
+        (nh,) = routes_c.unicast_routes[a_pfx].nexthops
+        assert nh.neighbor_node_name == "b"
+        assert nh.metric == 3
+
+    def test_per_prefix_keys(self, harness):
+        topo = line_topology()
+        for db in topo.adj_dbs.values():
+            harness.publish_adj(db)
+        # advertise b's loopback via a per-prefix key
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+        key = keyutil.per_prefix_key("b", topo.area, b_pfx)
+        pdb = PrefixDatabase(
+            this_node_name="b",
+            prefix_entries=(PrefixEntry(prefix=b_pfx),),
+            area=topo.area,
+        )
+        harness.store.set_key(key, wire.dumps(pdb), version=1, originator="b")
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        assert b_pfx in routes.unicast_routes
+
+    def test_debounce_coalesces_churn(self, harness):
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        runs_before = harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ]
+        # 10 rapid prefix updates
+        extra = IpPrefix.from_str("fd00:200::/64")
+        for i in range(10):
+            harness.publish_prefixes(
+                PrefixDatabase(
+                    this_node_name="c",
+                    prefix_entries=topo.prefix_dbs["c"].prefix_entries
+                    + (PrefixEntry(prefix=extra),)[: i % 2 + 1],
+                    area=topo.area,
+                )
+            )
+        harness.drain_updates()
+        runs_after = harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ]
+        assert runs_after - runs_before < 10  # debounced into fewer rebuilds
