@@ -1,0 +1,187 @@
+"""Fib module tests (reference analogue: openr/fib/tests/FibTest.cpp)."""
+
+import time
+
+import pytest
+
+from openr_tpu.decision.rib import (
+    DecisionRouteUpdate,
+    RibMplsEntry,
+    RibUnicastEntry,
+)
+from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.fib_service import MockFibAgent
+from openr_tpu.types import BinaryAddress, IpPrefix, NextHop
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def rib_entry(prefix_str, nh="fe80::1", metric=1):
+    return RibUnicastEntry(
+        prefix=IpPrefix.from_str(prefix_str),
+        nexthops={
+            NextHop(
+                address=BinaryAddress.from_str(nh, if_name="if0"),
+                metric=metric,
+            )
+        },
+    )
+
+
+@pytest.fixture
+def fib_setup():
+    agent = MockFibAgent()
+    route_q = ReplicateQueue(name="routes")
+    fib = Fib(
+        "node-a",
+        agent,
+        route_q,
+        keepalive_interval_s=0.1,
+        retry_min_s=0.02,
+        retry_max_s=0.2,
+    )
+    fib.start()
+    yield agent, route_q, fib
+    fib.stop()
+
+
+def push_update(route_q, entries=(), deletes=(), mpls=(), mpls_deletes=()):
+    update = DecisionRouteUpdate()
+    for e in entries:
+        update.unicast_routes_to_update[e.prefix] = e
+    update.unicast_routes_to_delete.extend(deletes)
+    update.mpls_routes_to_update.extend(mpls)
+    update.mpls_routes_to_delete.extend(mpls_deletes)
+    route_q.push(update)
+
+
+class TestFib:
+    def test_programs_routes(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(route_q, entries=[rib_entry("fd00::/64")])
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+        # first programming is a full sync (cold start)
+        assert agent.counters["sync_fib"] >= 1
+
+    def test_incremental_add_delete(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(route_q, entries=[rib_entry("fd00:1::/64")])
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+        push_update(
+            route_q,
+            entries=[rib_entry("fd00:2::/64")],
+            deletes=[IpPrefix.from_str("fd00:1::/64")],
+        )
+        assert wait_until(
+            lambda: [
+                r.dest.to_str()
+                for r in agent.get_route_table_by_client(OPENR_CLIENT_ID)
+            ]
+            == ["fd00:2::/64"]
+        )
+        assert agent.counters["delete_unicast"] == 1
+
+    def test_mpls_routes(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(
+            route_q,
+            mpls=[
+                RibMplsEntry(
+                    100101,
+                    {
+                        NextHop(
+                            address=BinaryAddress.from_str("fe80::2"),
+                            metric=1,
+                        )
+                    },
+                )
+            ],
+        )
+        assert wait_until(
+            lambda: len(agent.get_mpls_route_table_by_client(OPENR_CLIENT_ID))
+            == 1
+        )
+        push_update(route_q, mpls_deletes=[100101])
+        assert wait_until(
+            lambda: len(agent.get_mpls_route_table_by_client(OPENR_CLIENT_ID))
+            == 0
+        )
+
+    def test_retry_after_agent_failure(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(route_q, entries=[rib_entry("fd00:1::/64")])
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+        agent.set_fail(True)
+        push_update(route_q, entries=[rib_entry("fd00:2::/64")])
+        assert wait_until(
+            lambda: fib.get_counters()["fib.route_programming_failures"] >= 1
+        )
+        agent.set_fail(False)
+        # retry with backoff resyncs the full table
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 2
+        )
+
+    def test_agent_restart_triggers_resync(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(route_q, entries=[rib_entry("fd00:1::/64")])
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+        agent.restart()
+        # keepalive detects the restart and resyncs
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+
+    def test_do_not_install_not_programmed(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        entry = rib_entry("fd00:9::/64")
+        entry.do_not_install = True
+        push_update(route_q, entries=[entry, rib_entry("fd00:8::/64")])
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 1
+        )
+        # but it is tracked in Fib's own route db
+        db = fib.get_route_db()
+        assert len(db.unicast_routes) == 2
+
+    def test_longest_prefix_match(self, fib_setup):
+        agent, route_q, fib = fib_setup
+        push_update(
+            route_q,
+            entries=[rib_entry("fd00::/16"), rib_entry("fd00:1::/64")],
+        )
+        assert wait_until(lambda: len(fib.get_route_db().unicast_routes) == 2)
+        r = fib.longest_prefix_match("fd00:1::5")
+        assert r is not None and r.dest.to_str() == "fd00:1::/64"
+        r = fib.longest_prefix_match("fd00:2::5")
+        assert r is not None and r.dest.to_str() == "fd00::/16"
+
+    def test_dry_run_programs_nothing(self):
+        agent = MockFibAgent()
+        route_q = ReplicateQueue()
+        fib = Fib("node-a", agent, route_q, dry_run=True)
+        fib.start()
+        try:
+            push_update(route_q, entries=[rib_entry("fd00::/64")])
+            assert wait_until(
+                lambda: len(fib.get_route_db().unicast_routes) == 1
+            )
+            assert agent.get_route_table_by_client(OPENR_CLIENT_ID) == []
+        finally:
+            fib.stop()
