@@ -1,0 +1,212 @@
+"""Spark neighbor-discovery tests over the simulated multicast LAN
+(reference analogue: openr/spark/tests/SparkTest.cpp, 22 cases, using
+MockIoProvider)."""
+
+import time
+
+import pytest
+
+from openr_tpu.messaging.queue import QueueTimeoutError, ReplicateQueue
+from openr_tpu.spark.io_provider import MockIoProvider
+from openr_tpu.spark.spark import Spark, SparkNeighState
+from openr_tpu.types import BinaryAddress
+from openr_tpu.types.spark import SparkNeighborEventType
+
+
+FAST = dict(
+    hello_interval_s=0.05,
+    fast_hello_interval_s=0.03,
+    handshake_interval_s=0.03,
+    heartbeat_interval_s=0.05,
+    hold_time_s=0.4,
+    graceful_restart_time_s=1.0,
+)
+
+
+class SparkHarness:
+    def __init__(self):
+        self.io = MockIoProvider()
+        self.sparks = {}
+        self.readers = {}
+
+    def add_node(self, name, ifaces, area="0", **overrides):
+        q = ReplicateQueue(name=f"nbr:{name}")
+        self.readers[name] = q.get_reader("test")
+        kwargs = dict(FAST)
+        kwargs.update(overrides)
+        spark = Spark(
+            name,
+            self.io,
+            q,
+            area=area,
+            v6_addr=BinaryAddress.from_str(f"fe80::{len(self.sparks) + 1}"),
+            **kwargs,
+        )
+        spark.start()
+        for iface in ifaces:
+            spark.add_interface(iface)
+        self.sparks[name] = spark
+        return spark
+
+    def connect(self, if_a, if_b, latency_ms=1):
+        self.io.connect_pair(if_a, if_b, latency_ms)
+
+    def events(self, node, timeout=3.0):
+        out = []
+        while True:
+            try:
+                out.append(self.readers[node].get(timeout=timeout))
+                timeout = 0.2
+            except QueueTimeoutError:
+                return out
+
+    def wait_event(self, node, event_type, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                ev = self.readers[node].get(timeout=0.2)
+            except QueueTimeoutError:
+                continue
+            if ev.event_type == event_type:
+                return ev
+        raise AssertionError(f"{node}: no {event_type.name} within {timeout}s")
+
+    def stop(self):
+        for spark in self.sparks.values():
+            try:
+                spark.stop()
+            except Exception:
+                pass
+        self.io.stop()
+
+
+@pytest.fixture
+def lan():
+    h = SparkHarness()
+    yield h
+    h.stop()
+
+
+class TestDiscovery:
+    def test_two_nodes_establish(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        ev_a = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        ev_b = lan.wait_event("b", SparkNeighborEventType.NEIGHBOR_UP)
+        assert ev_a.neighbor.node_name == "b"
+        assert ev_a.neighbor.local_if_name == "if_a_b"
+        assert ev_a.neighbor.remote_if_name == "if_b_a"
+        assert ev_a.neighbor.area == "0"
+        assert ev_b.neighbor.node_name == "a"
+        states = lan.sparks["a"].get_neighbors()
+        assert states["if_a_b"]["b"] == SparkNeighState.ESTABLISHED
+
+    def test_area_mismatch_no_adjacency(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"], area="0")
+        lan.add_node("b", ["if_b_a"], area="1")
+        with pytest.raises(AssertionError):
+            lan.wait_event(
+                "a", SparkNeighborEventType.NEIGHBOR_UP, timeout=1.0
+            )
+
+    def test_three_node_lan(self, lan):
+        # one shared broadcast segment
+        for x, y in [("if_a", "if_b"), ("if_a", "if_c"), ("if_b", "if_c")]:
+            lan.connect(x, y)
+        lan.add_node("a", ["if_a"])
+        lan.add_node("b", ["if_b"])
+        lan.add_node("c", ["if_c"])
+        seen = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(seen) < 2:
+            try:
+                ev = lan.readers["a"].get(timeout=0.2)
+            except QueueTimeoutError:
+                continue
+            if ev.event_type == SparkNeighborEventType.NEIGHBOR_UP:
+                seen.add(ev.neighbor.node_name)
+        assert seen == {"b", "c"}
+
+    def test_rtt_measured(self, lan):
+        lan.connect("if_a_b", "if_b_a", latency_ms=5)
+        lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        ev = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        # one-way 5ms => rtt ~10ms
+        assert ev.neighbor.rtt_us > 5000
+
+
+class TestFailure:
+    def test_hold_expiry_on_partition(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.io.partition("if_b_a")
+        ev = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_DOWN)
+        assert ev.neighbor.node_name == "b"
+
+    def test_interface_removal_downs_neighbor(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.wait_event("b", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.sparks["a"].remove_interface("if_a_b")
+        ev = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_DOWN)
+        assert ev.neighbor.node_name == "b"
+        # b eventually times a out too
+        lan.wait_event("b", SparkNeighborEventType.NEIGHBOR_DOWN)
+
+    def test_reconnect_after_down(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.io.partition("if_b_a")
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_DOWN)
+        lan.io.heal("if_b_a")
+        ev = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        assert ev.neighbor.node_name == "b"
+
+
+class TestGracefulRestart:
+    def test_restarting_event_then_restored(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"])
+        b = lan.add_node("b", ["if_b_a"])
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.wait_event("b", SparkNeighborEventType.NEIGHBOR_UP)
+        # b announces graceful restart and goes away
+        b.stop(graceful_restart=True)
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_RESTARTING)
+        # a keeps the adjacency (no DOWN) while b is away within GR window;
+        # b comes back with the same name
+        new_b = Spark(
+            "b",
+            lan.io,
+            ReplicateQueue(name="nbr:b-new"),
+            area="0",
+            v6_addr=BinaryAddress.from_str("fe80::99"),
+            **FAST,
+        )
+        new_b.start()
+        new_b.add_interface("if_b_a")
+        lan.sparks["b-new"] = new_b
+        ev = lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_RESTARTED)
+        assert ev.neighbor.node_name == "b"
+
+    def test_gr_expiry_downs_neighbor(self, lan):
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"], graceful_restart_time_s=0.5)
+        b = lan.add_node("b", ["if_b_a"])
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP)
+        b.stop(graceful_restart=True)
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_RESTARTING)
+        # never comes back: GR window expires
+        ev = lan.wait_event(
+            "a", SparkNeighborEventType.NEIGHBOR_DOWN, timeout=8.0
+        )
+        assert ev.neighbor.node_name == "b"
