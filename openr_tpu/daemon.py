@@ -1,0 +1,174 @@
+"""OpenrNode: full-daemon assembly (the reference's Main.cpp + the test
+fixture OpenrWrapper, openr/tests/OpenrWrapper.h:38).
+
+Constructs the typed queues, wires the modules
+(KvStore <- LinkMonitor <- Spark; KvStore -> Decision -> Fib; PrefixManager
+-> KvStore) and starts them in dependency order with reverse-order
+teardown (reference: Main.cpp:269-280 queue wiring, :374-504 module
+startup order, :604-654 shutdown).
+
+Multiple OpenrNodes in one process over a MockIoProvider + in-process
+KvStore transports form a complete simulated network (the reference's
+OpenrSystemTest pattern).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.fib.fib import Fib
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.store import InProcessTransport, KvStore, PeerTransport
+from openr_tpu.linkmonitor.link_monitor import LinkMonitor
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.fib_service import FibService, MockFibAgent
+from openr_tpu.prefixmgr.prefix_manager import PrefixManager
+from openr_tpu.spark.io_provider import IoProvider
+from openr_tpu.spark.spark import Spark
+from openr_tpu.types import BinaryAddress, IpPrefix, PrefixEntry, PrefixType
+from openr_tpu.types.spark import SparkNeighbor
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+class OpenrNode:
+    """One complete openr-tpu daemon instance."""
+
+    def __init__(
+        self,
+        name: str,
+        io_provider: IoProvider,
+        node_registry: Optional[Dict[str, "OpenrNode"]] = None,
+        fib_agent: Optional[FibService] = None,
+        area: str = "0",
+        v6_addr: Optional[str] = None,
+        spark_config: Optional[dict] = None,
+        use_rtt_metric: bool = False,
+        config_store=None,
+        solver_backend: str = "device",
+        debounce_min_s: float = 0.01,
+        debounce_max_s: float = 0.05,
+    ):
+        self.name = name
+        self.area = area
+        self.registry = node_registry if node_registry is not None else {}
+        self.registry[name] = self
+
+        # -- queues (reference: Main.cpp:269-280) -------------------------
+        self.neighbor_updates = ReplicateQueue(name=f"{name}:neighborUpdates")
+        self.interface_updates = ReplicateQueue(name=f"{name}:interfaceUpdates")
+        self.route_updates = ReplicateQueue(name=f"{name}:routeUpdates")
+        self.fib_updates = ReplicateQueue(name=f"{name}:fibUpdates")
+        self.prefix_updates = ReplicateQueue(name=f"{name}:prefixUpdates")
+        self.static_routes = ReplicateQueue(name=f"{name}:staticRoutes")
+
+        # -- modules ------------------------------------------------------
+        self.kvstore = KvStore(node_id=name, areas=[area])
+        self.client_evb = OpenrEventBase(name=f"kvclient:{name}")
+        self.kvstore_client = KvStoreClient(
+            self.client_evb, name, self.kvstore
+        )
+        self.decision = Decision(
+            name,
+            kvstore_updates_queue=self.kvstore.updates_queue,
+            route_updates_queue=self.route_updates,
+            static_routes_queue=self.static_routes,
+            debounce_min_s=debounce_min_s,
+            debounce_max_s=debounce_max_s,
+            solver_backend=solver_backend,
+        )
+        self.fib_agent = fib_agent or MockFibAgent()
+        self.fib = Fib(
+            name,
+            self.fib_agent,
+            self.route_updates,
+            fib_updates_queue=self.fib_updates,
+            kvstore_client=self.kvstore_client,
+            area=area,
+        )
+        self.spark = Spark(
+            name,
+            io_provider,
+            self.neighbor_updates,
+            interface_updates_queue=self.interface_updates,
+            area=area,
+            v6_addr=BinaryAddress.from_str(v6_addr) if v6_addr else None,
+            **(spark_config or {}),
+        )
+        self.link_monitor = LinkMonitor(
+            name,
+            neighbor_updates_queue=self.neighbor_updates,
+            interface_updates_queue=self.interface_updates,
+            kvstore_client=self.kvstore_client,
+            kvstore=self.kvstore,
+            peer_transport_factory=self._peer_transport,
+            config_store=config_store,
+            area=area,
+            use_rtt_metric=use_rtt_metric,
+        )
+        self.prefix_manager = PrefixManager(
+            name,
+            self.kvstore_client,
+            prefix_updates_queue=self.prefix_updates,
+            areas=[area],
+        )
+        self._started = False
+
+    # -- peering ----------------------------------------------------------
+
+    def _peer_transport(self, nbr: SparkNeighbor) -> Optional[PeerTransport]:
+        """In-process transport resolution: look the neighbor up in the
+        shared registry (the analogue of dialing its thrift port from the
+        handshake's transport address)."""
+        other = self.registry.get(nbr.node_name)
+        if other is None:
+            return None
+        return InProcessTransport(other.kvstore)
+
+    # -- lifecycle (reference startup order, Main.cpp:374-504) ------------
+
+    def start(self) -> None:
+        assert not self._started
+        self.kvstore.start()
+        self.client_evb.run_in_thread()
+        self.prefix_manager.start()
+        self.spark.start()
+        self.link_monitor.start()
+        self.decision.start()
+        self.fib.start()
+        self._started = True
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        # reverse order teardown (reference: Main.cpp:604-654)
+        self.fib.stop()
+        self.decision.stop()
+        self.link_monitor.stop()
+        self.spark.stop()
+        self.prefix_manager.stop()
+        self.client_evb.stop()
+        self.client_evb.join()
+        self.kvstore.stop()
+        self._started = False
+
+    # -- convenience ------------------------------------------------------
+
+    def add_interface(self, if_name: str) -> None:
+        self.spark.add_interface(if_name)
+
+    def advertise_loopback(self, prefix_str: str, **entry_kwargs) -> IpPrefix:
+        prefix = IpPrefix.from_str(prefix_str)
+        self.prefix_manager.advertise_prefixes(
+            [
+                PrefixEntry(
+                    prefix=prefix,
+                    type=PrefixType.LOOPBACK,
+                    **entry_kwargs,
+                )
+            ]
+        )
+        return prefix
+
+    def get_fib_routes(self):
+        return self.fib.get_route_db()

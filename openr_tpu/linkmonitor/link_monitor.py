@@ -1,0 +1,405 @@
+"""LinkMonitor: neighbor events + kernel links -> adjacency advertisement.
+
+Behavioral parity with the reference ``openr/link-monitor/LinkMonitor.cpp``:
+
+- consumes Spark neighbor events: UP records an adjacency (metric from
+  config or RTT), starts KvStore peering with the neighbor, and
+  (re-)advertises our ``adj:<node>`` key (neighborUpEvent,
+  LinkMonitor.cpp:300; advertiseKvStorePeers :508;
+  advertiseAdjacencies :602)
+- consumes netlink link/address events into an interface database with
+  per-interface flap damping (ExponentialBackoff backing off rapidly
+  flapping links; LinkMonitor.h:201-206), republished to Spark
+  (processNetlinkEvent, LinkMonitor.cpp:914; syncInterfaces :854)
+- drain control: node overload, per-link overload, per-link metric
+  override — persisted via the config store so they survive restart
+- adjacency advertisement is throttled to coalesce bursts
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.netlink import (
+    NetlinkEvent,
+    NetlinkProtocolSocket,
+)
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+from openr_tpu.types.spark import (
+    InterfaceDatabase,
+    InterfaceInfo,
+    SparkNeighbor,
+    SparkNeighborEvent,
+    SparkNeighborEventType,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import (
+    AsyncThrottle,
+    ExponentialBackoff,
+    OpenrEventBase,
+)
+
+# persisted drain-state key in the config store
+# (reference: LinkMonitor persists thrift::LinkMonitorState)
+LINK_MONITOR_STATE_KEY = "link-monitor-config"
+
+
+@dataclass
+class _InterfaceEntry:
+    """Per-interface state with flap damping
+    (reference: link-monitor/InterfaceEntry)."""
+
+    info: InterfaceInfo
+    backoff: ExponentialBackoff
+    advertised_up: bool = False
+
+
+class LinkMonitor:
+    def __init__(
+        self,
+        my_node_name: str,
+        neighbor_updates_queue: ReplicateQueue,
+        interface_updates_queue: ReplicateQueue,
+        kvstore_client=None,
+        kvstore=None,
+        peer_transport_factory: Optional[
+            Callable[[SparkNeighbor], object]
+        ] = None,
+        netlink: Optional[NetlinkProtocolSocket] = None,
+        netlink_events_queue: Optional[ReplicateQueue] = None,
+        config_store=None,
+        area: str = "0",
+        node_label: int = 0,
+        use_rtt_metric: bool = False,
+        flap_initial_backoff_s: float = 0.05,
+        flap_max_backoff_s: float = 2.0,
+        advertise_throttle_s: float = 0.02,
+    ):
+        self.my_node_name = my_node_name
+        self.area = area
+        self.node_label = node_label
+        self.use_rtt_metric = use_rtt_metric
+        self.evb = OpenrEventBase(name=f"linkmonitor:{my_node_name}")
+        self._interface_updates = interface_updates_queue
+        self._kvstore_client = kvstore_client
+        self._kvstore = kvstore
+        self._peer_transport_factory = peer_transport_factory
+        self._netlink = netlink
+        self._config_store = config_store
+        self._flap_initial = flap_initial_backoff_s
+        self._flap_max = flap_max_backoff_s
+
+        # (if_name, neighbor) -> (SparkNeighbor, Adjacency)
+        self._adjacencies: Dict[Tuple[str, str], Tuple[SparkNeighbor, Adjacency]] = {}
+        self._interfaces: Dict[str, _InterfaceEntry] = {}
+        self._metric_overrides: Dict[Tuple[str, str], int] = {}
+        self._link_overloads: Set[str] = set()
+        self.is_overloaded = False
+        self.counters: Dict[str, int] = {
+            "link_monitor.neighbor_up": 0,
+            "link_monitor.neighbor_down": 0,
+            "link_monitor.advertise_adjacencies": 0,
+            "link_monitor.advertise_interfaces": 0,
+        }
+        self._load_persisted_state()
+
+        self._advertise_adj_throttled = AsyncThrottle(
+            self.evb, advertise_throttle_s, self._advertise_adjacencies
+        )
+        self._advertise_ifaces_throttled = AsyncThrottle(
+            self.evb, advertise_throttle_s, self._advertise_interfaces
+        )
+
+        self.evb.add_queue_reader(
+            neighbor_updates_queue.get_reader(f"lm:{my_node_name}"),
+            self._on_neighbor_event,
+        )
+        if netlink_events_queue is not None:
+            self.evb.add_queue_reader(
+                netlink_events_queue.get_reader(f"lm:{my_node_name}"),
+                self._on_netlink_event,
+            )
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.evb.run_in_thread()
+        if self._netlink is not None:
+            self.evb.run_in_event_base(self._sync_interfaces)
+
+    def stop(self) -> None:
+        self.evb.stop()
+        self.evb.join()
+
+    # -- persisted drain state -------------------------------------------
+
+    def _load_persisted_state(self) -> None:
+        if self._config_store is None:
+            return
+        state = self._config_store.load(LINK_MONITOR_STATE_KEY)
+        if state is None:
+            return
+        self.is_overloaded = bool(state.get("is_overloaded", False))
+        self._link_overloads = set(state.get("link_overloads", []))
+        self._metric_overrides = {
+            (i, n): m
+            for (i, n), m in (
+                (tuple(k.split("|", 1)), v)
+                for k, v in state.get("metric_overrides", {}).items()
+            )
+        }
+
+    def _persist_state(self) -> None:
+        if self._config_store is None:
+            return
+        self._config_store.store(
+            LINK_MONITOR_STATE_KEY,
+            {
+                "is_overloaded": self.is_overloaded,
+                "link_overloads": sorted(self._link_overloads),
+                "metric_overrides": {
+                    f"{i}|{n}": m
+                    for (i, n), m in self._metric_overrides.items()
+                },
+            },
+        )
+
+    # -- spark events -----------------------------------------------------
+
+    def _on_neighbor_event(self, event: SparkNeighborEvent) -> None:
+        et = event.event_type
+        if et == SparkNeighborEventType.NEIGHBOR_UP:
+            self._neighbor_up(event.neighbor)
+        elif et == SparkNeighborEventType.NEIGHBOR_RESTARTED:
+            self._neighbor_up(event.neighbor)
+        elif et == SparkNeighborEventType.NEIGHBOR_DOWN:
+            self._neighbor_down(event.neighbor)
+        elif et == SparkNeighborEventType.NEIGHBOR_RESTARTING:
+            # graceful restart: keep the adjacency, stop nothing
+            pass
+        elif et == SparkNeighborEventType.NEIGHBOR_RTT_CHANGE:
+            self._rtt_change(event.neighbor)
+
+    def _metric_for(self, nbr: SparkNeighbor) -> int:
+        key = (nbr.local_if_name, nbr.node_name)
+        if key in self._metric_overrides:
+            return self._metric_overrides[key]
+        if self.use_rtt_metric:
+            # reference: metric = max(1, rtt_us / 100)
+            return max(1, nbr.rtt_us // 100)
+        return 1
+
+    def _neighbor_up(self, nbr: SparkNeighbor) -> None:
+        """reference: LinkMonitor.cpp:300 neighborUpEvent."""
+        self.counters["link_monitor.neighbor_up"] += 1
+        adj = Adjacency(
+            other_node_name=nbr.node_name,
+            if_name=nbr.local_if_name,
+            other_if_name=nbr.remote_if_name,
+            metric=self._metric_for(nbr),
+            next_hop_v6=nbr.transport_address_v6,
+            next_hop_v4=nbr.transport_address_v4,
+            is_overloaded=nbr.local_if_name in self._link_overloads,
+            rtt=nbr.rtt_us,
+            timestamp=int(time.time()),
+        )
+        self._adjacencies[(nbr.local_if_name, nbr.node_name)] = (nbr, adj)
+        self._advertise_kvstore_peer(nbr)
+        self._advertise_adj_throttled()
+
+    def _neighbor_down(self, nbr: SparkNeighbor) -> None:
+        self.counters["link_monitor.neighbor_down"] += 1
+        self._adjacencies.pop((nbr.local_if_name, nbr.node_name), None)
+        if self._kvstore is not None and not any(
+            n.node_name == nbr.node_name
+            for (n, _) in self._adjacencies.values()
+        ):
+            try:
+                self._kvstore.del_peer(self.area, nbr.node_name)
+            except Exception:
+                pass
+        self._advertise_adj_throttled()
+
+    def _rtt_change(self, nbr: SparkNeighbor) -> None:
+        entry = self._adjacencies.get((nbr.local_if_name, nbr.node_name))
+        if entry is None:
+            return
+        if self.use_rtt_metric:
+            self._neighbor_up(nbr)  # recompute metric + readvertise
+        else:
+            # record new rtt without metric change
+            old_nbr, adj = entry
+            self._adjacencies[(nbr.local_if_name, nbr.node_name)] = (
+                nbr,
+                Adjacency(
+                    other_node_name=adj.other_node_name,
+                    if_name=adj.if_name,
+                    other_if_name=adj.other_if_name,
+                    metric=adj.metric,
+                    next_hop_v6=adj.next_hop_v6,
+                    next_hop_v4=adj.next_hop_v4,
+                    is_overloaded=adj.is_overloaded,
+                    rtt=nbr.rtt_us,
+                    timestamp=adj.timestamp,
+                ),
+            )
+
+    def _advertise_kvstore_peer(self, nbr: SparkNeighbor) -> None:
+        """Start KvStore flooding with the new neighbor
+        (reference: LinkMonitor.cpp:508 advertiseKvStorePeers)."""
+        if self._kvstore is None or self._peer_transport_factory is None:
+            return
+        try:
+            transport = self._peer_transport_factory(nbr)
+            if transport is not None:
+                self._kvstore.add_peer(self.area, nbr.node_name, transport)
+        except Exception:
+            pass
+
+    # -- adjacency advertisement -----------------------------------------
+
+    def _build_adj_db(self) -> AdjacencyDatabase:
+        adjacencies = []
+        for (if_name, node), (nbr, adj) in sorted(self._adjacencies.items()):
+            metric = self._metric_overrides.get((if_name, node), adj.metric)
+            adjacencies.append(
+                Adjacency(
+                    other_node_name=adj.other_node_name,
+                    if_name=adj.if_name,
+                    other_if_name=adj.other_if_name,
+                    metric=metric,
+                    next_hop_v6=adj.next_hop_v6,
+                    next_hop_v4=adj.next_hop_v4,
+                    adj_label=adj.adj_label,
+                    is_overloaded=if_name in self._link_overloads,
+                    rtt=adj.rtt,
+                    timestamp=adj.timestamp,
+                    weight=adj.weight,
+                )
+            )
+        return AdjacencyDatabase(
+            this_node_name=self.my_node_name,
+            is_overloaded=self.is_overloaded,
+            adjacencies=tuple(adjacencies),
+            node_label=self.node_label,
+            area=self.area,
+        )
+
+    def _advertise_adjacencies(self) -> None:
+        """reference: LinkMonitor.cpp:602 advertiseAdjacencies."""
+        if self._kvstore_client is None:
+            return
+        self.counters["link_monitor.advertise_adjacencies"] += 1
+        adj_db = self._build_adj_db()
+        self._kvstore_client.persist_key(
+            self.area,
+            keyutil.adj_key(self.my_node_name),
+            wire.dumps(adj_db),
+        )
+
+    # -- netlink interface tracking --------------------------------------
+
+    def _sync_interfaces(self) -> None:
+        """reference: LinkMonitor.cpp:854 syncInterfaces."""
+        for link in self._netlink.get_all_links():
+            self._apply_link_state(link.if_name, link.is_up, link.addresses)
+        self._advertise_ifaces_throttled()
+
+    def _on_netlink_event(self, event: NetlinkEvent) -> None:
+        """reference: LinkMonitor.cpp:914 processNetlinkEvent."""
+        if event.link is None:
+            return
+        self._apply_link_state(
+            event.link.if_name, event.link.is_up, event.link.addresses
+        )
+        self._advertise_ifaces_throttled()
+
+    def _apply_link_state(self, if_name, is_up, addresses) -> None:
+        entry = self._interfaces.get(if_name)
+        if entry is None:
+            entry = self._interfaces[if_name] = _InterfaceEntry(
+                info=InterfaceInfo(is_up=is_up, networks=tuple(addresses)),
+                backoff=ExponentialBackoff(self._flap_initial, self._flap_max),
+            )
+            return
+        was_up = entry.info.is_up
+        entry.info = InterfaceInfo(is_up=is_up, networks=tuple(addresses))
+        if is_up and not was_up:
+            # flap damping: a link coming back up is held for the current
+            # backoff window; rapid flapping doubles the window
+            entry.backoff.report_error()
+            delay = entry.backoff.get_time_remaining_until_retry()
+            if delay > 0:
+                self.evb.schedule_timeout(
+                    delay, self._advertise_ifaces_throttled
+                )
+
+    def _advertise_interfaces(self) -> None:
+        self.counters["link_monitor.advertise_interfaces"] += 1
+        interfaces: Dict[str, InterfaceInfo] = {}
+        for if_name, entry in self._interfaces.items():
+            is_up = entry.info.is_up
+            if is_up and not entry.backoff.can_try_now():
+                is_up = False  # still damped
+            interfaces[if_name] = InterfaceInfo(
+                is_up=is_up,
+                if_index=entry.info.if_index,
+                networks=entry.info.networks,
+            )
+        self._interface_updates.push(
+            InterfaceDatabase(
+                this_node_name=self.my_node_name, interfaces=interfaces
+            )
+        )
+
+    # -- drain / overload APIs (thread-safe) ------------------------------
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        def apply() -> None:
+            if self.is_overloaded != overloaded:
+                self.is_overloaded = overloaded
+                self._persist_state()
+                self._advertise_adj_throttled()
+
+        self.evb.call_and_wait(apply)
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        def apply() -> None:
+            if overloaded:
+                self._link_overloads.add(if_name)
+            else:
+                self._link_overloads.discard(if_name)
+            self._persist_state()
+            self._advertise_adj_throttled()
+
+        self.evb.call_and_wait(apply)
+
+    def set_link_metric(
+        self, if_name: str, neighbor: str, metric: Optional[int]
+    ) -> None:
+        def apply() -> None:
+            if metric is None:
+                self._metric_overrides.pop((if_name, neighbor), None)
+            else:
+                self._metric_overrides[(if_name, neighbor)] = metric
+            self._persist_state()
+            self._advertise_adj_throttled()
+
+        self.evb.call_and_wait(apply)
+
+    # -- introspection ----------------------------------------------------
+
+    def get_adjacencies(self) -> AdjacencyDatabase:
+        return self.evb.call_and_wait(self._build_adj_db)
+
+    def get_interfaces(self) -> Dict[str, InterfaceInfo]:
+        return self.evb.call_and_wait(
+            lambda: {n: e.info for n, e in self._interfaces.items()}
+        )
+
+    def get_counters(self) -> Dict[str, int]:
+        return self.evb.call_and_wait(lambda: dict(self.counters))

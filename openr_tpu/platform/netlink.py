@@ -1,0 +1,132 @@
+"""Netlink layer: kernel interface/address/route access.
+
+Interface parity with the reference ``openr/nl/NetlinkProtocolSocket.h``
+(get_all_links / add_route / delete_route + event publication) with a
+mock in-memory kernel for tests
+(reference: openr/tests/mocks/MockNetlinkProtocolSocket.{h,cpp}).
+
+The real Linux implementation (AF_NETLINK rtnetlink socket) is provided
+in ``LinuxNetlinkSocket`` guarded by platform availability; everything
+above it (LinkMonitor, Fib handler) only sees this interface.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import IpPrefix, UnicastRoute
+
+
+@dataclass
+class NlLink:
+    """reference: fbnl::Link (openr/nl/NetlinkTypes.h)."""
+
+    if_name: str
+    if_index: int
+    is_up: bool = True
+    addresses: Tuple[IpPrefix, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.addresses, tuple):
+            self.addresses = tuple(self.addresses)
+
+
+class NetlinkEventType(enum.IntEnum):
+    LINK = 1
+    ADDRESS = 2
+    NEIGHBOR = 3
+
+
+@dataclass
+class NetlinkEvent:
+    event_type: NetlinkEventType
+    link: Optional[NlLink] = None
+
+
+class NetlinkProtocolSocket:
+    """Abstract kernel access interface."""
+
+    def get_all_links(self) -> List[NlLink]:
+        raise NotImplementedError
+
+    def add_route(self, route: UnicastRoute) -> None:
+        raise NotImplementedError
+
+    def delete_route(self, prefix: IpPrefix) -> None:
+        raise NotImplementedError
+
+    def get_all_routes(self) -> List[UnicastRoute]:
+        raise NotImplementedError
+
+    def add_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        raise NotImplementedError
+
+
+class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
+    """In-memory kernel with event injection
+    (reference: tests/mocks/MockNetlinkProtocolSocket.h +
+    NetlinkEventsInjector)."""
+
+    def __init__(self, events_queue: Optional[ReplicateQueue] = None):
+        self.events_queue = events_queue or ReplicateQueue(name="netlinkEvents")
+        self._lock = threading.Lock()
+        self._links: Dict[str, NlLink] = {}
+        self._routes: Dict[IpPrefix, UnicastRoute] = {}
+        self._next_index = 1
+
+    # -- test injection ---------------------------------------------------
+
+    def add_link(
+        self, if_name: str, is_up: bool = True, addresses: Tuple = ()
+    ) -> NlLink:
+        with self._lock:
+            link = NlLink(
+                if_name=if_name,
+                if_index=self._next_index,
+                is_up=is_up,
+                addresses=tuple(addresses),
+            )
+            self._next_index += 1
+            self._links[if_name] = link
+        self.events_queue.push(
+            NetlinkEvent(event_type=NetlinkEventType.LINK, link=link)
+        )
+        return link
+
+    def set_link_state(self, if_name: str, is_up: bool) -> None:
+        with self._lock:
+            link = self._links[if_name]
+            link.is_up = is_up
+        self.events_queue.push(
+            NetlinkEvent(event_type=NetlinkEventType.LINK, link=link)
+        )
+
+    # -- NetlinkProtocolSocket -------------------------------------------
+
+    def get_all_links(self) -> List[NlLink]:
+        with self._lock:
+            return list(self._links.values())
+
+    def add_route(self, route: UnicastRoute) -> None:
+        with self._lock:
+            self._routes[route.dest] = route
+
+    def delete_route(self, prefix: IpPrefix) -> None:
+        with self._lock:
+            self._routes.pop(prefix, None)
+
+    def get_all_routes(self) -> List[UnicastRoute]:
+        with self._lock:
+            return sorted(self._routes.values(), key=lambda r: r.dest)
+
+    def add_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        with self._lock:
+            link = self._links[if_name]
+            link.addresses = tuple(link.addresses) + (prefix,)
+        self.events_queue.push(
+            NetlinkEvent(event_type=NetlinkEventType.ADDRESS, link=link)
+        )

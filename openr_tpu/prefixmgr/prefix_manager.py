@@ -1,0 +1,178 @@
+"""PrefixManager: owns the prefixes this node advertises into the LSDB.
+
+Behavioral parity with the reference ``openr/prefix-manager/PrefixManager``:
+- advertise/withdraw/sync per PrefixType (LOOPBACK, CONFIG, BGP, ...)
+  (reference: PrefixManager.h:72 advertisePrefixes)
+- serializes to per-prefix KvStore keys ``prefix:<node>:<area>:[<prefix>]``
+  via the KvStore client (persist + TTL refresh)
+- accepts requests through a queue (PrefixEvent) and via direct API
+- cross-area re-distribution of Decision's best routes is handled by the
+  Decision+PrefixManager pair in the reference; tracked as future work
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import IpPrefix, PrefixDatabase, PrefixEntry, PrefixType
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+class PrefixEventType(enum.IntEnum):
+    ADD_PREFIXES = 1
+    WITHDRAW_PREFIXES = 2
+    SYNC_PREFIXES_BY_TYPE = 3
+    WITHDRAW_PREFIXES_BY_TYPE = 4
+
+
+@dataclass
+class PrefixEvent:
+    event_type: PrefixEventType
+    type: Optional[PrefixType] = None
+    prefixes: List[PrefixEntry] = field(default_factory=list)
+
+
+class PrefixManager:
+    def __init__(
+        self,
+        my_node_name: str,
+        kvstore_client,
+        prefix_updates_queue: Optional[ReplicateQueue] = None,
+        areas: Optional[List[str]] = None,
+        per_prefix_keys: bool = True,
+    ):
+        self.my_node_name = my_node_name
+        self.evb = OpenrEventBase(name=f"prefixmgr:{my_node_name}")
+        self._client = kvstore_client
+        self._areas = areas or ["0"]
+        self._per_prefix_keys = per_prefix_keys
+        # (type, prefix) -> entry
+        self._prefixes: Dict[Tuple[PrefixType, IpPrefix], PrefixEntry] = {}
+        self._advertised_keys: Dict[str, str] = {}  # key -> area
+        if prefix_updates_queue is not None:
+            self.evb.add_queue_reader(
+                prefix_updates_queue.get_reader(f"pm:{my_node_name}"),
+                self._on_event,
+            )
+
+    def start(self) -> None:
+        self.evb.run_in_thread()
+
+    def stop(self) -> None:
+        self.evb.stop()
+        self.evb.join()
+
+    # -- queue interface --------------------------------------------------
+
+    def _on_event(self, event: PrefixEvent) -> None:
+        if event.event_type == PrefixEventType.ADD_PREFIXES:
+            self._advertise(event.prefixes)
+        elif event.event_type == PrefixEventType.WITHDRAW_PREFIXES:
+            self._withdraw([e.prefix for e in event.prefixes])
+        elif event.event_type == PrefixEventType.SYNC_PREFIXES_BY_TYPE:
+            assert event.type is not None
+            self._sync_by_type(event.type, event.prefixes)
+        elif event.event_type == PrefixEventType.WITHDRAW_PREFIXES_BY_TYPE:
+            assert event.type is not None
+            self._withdraw(
+                [
+                    p
+                    for (t, p) in list(self._prefixes)
+                    if t == event.type
+                ]
+            )
+
+    # -- public API (thread-safe) -----------------------------------------
+
+    def advertise_prefixes(self, entries: List[PrefixEntry]) -> None:
+        self.evb.call_and_wait(lambda: self._advertise(entries))
+
+    def withdraw_prefixes(self, prefixes: List[IpPrefix]) -> None:
+        self.evb.call_and_wait(lambda: self._withdraw(prefixes))
+
+    def sync_prefixes_by_type(
+        self, prefix_type: PrefixType, entries: List[PrefixEntry]
+    ) -> None:
+        self.evb.call_and_wait(lambda: self._sync_by_type(prefix_type, entries))
+
+    def get_prefixes(self) -> List[PrefixEntry]:
+        return self.evb.call_and_wait(
+            lambda: sorted(self._prefixes.values(), key=lambda e: e.prefix)
+        )
+
+    # -- internals --------------------------------------------------------
+
+    def _advertise(self, entries: List[PrefixEntry]) -> None:
+        """reference: PrefixManager.cpp advertisePrefixesImpl."""
+        for entry in entries:
+            self._prefixes[(entry.type, entry.prefix)] = entry
+        self._update_kvstore()
+
+    def _withdraw(self, prefixes: List[IpPrefix]) -> None:
+        for key in [k for k in self._prefixes if k[1] in set(prefixes)]:
+            del self._prefixes[key]
+        self._update_kvstore()
+
+    def _sync_by_type(
+        self, prefix_type: PrefixType, entries: List[PrefixEntry]
+    ) -> None:
+        for key in [k for k in self._prefixes if k[0] == prefix_type]:
+            del self._prefixes[key]
+        for entry in entries:
+            self._prefixes[(prefix_type, entry.prefix)] = entry
+        self._update_kvstore()
+
+    def _update_kvstore(self) -> None:
+        wanted: Dict[str, Tuple[str, bytes]] = {}
+        for area in self._areas:
+            if self._per_prefix_keys:
+                for (_, prefix), entry in self._prefixes.items():
+                    key = keyutil.per_prefix_key(
+                        self.my_node_name, area, prefix
+                    )
+                    db = PrefixDatabase(
+                        this_node_name=self.my_node_name,
+                        prefix_entries=(entry,),
+                        area=area,
+                    )
+                    wanted[key] = (area, wire.dumps(db))
+            else:
+                key = keyutil.prefix_db_key(self.my_node_name)
+                db = PrefixDatabase(
+                    this_node_name=self.my_node_name,
+                    prefix_entries=tuple(
+                        e
+                        for _, e in sorted(
+                            self._prefixes.items(),
+                            key=lambda kv: kv[0][1],
+                        )
+                    ),
+                    area=area,
+                )
+                wanted[key] = (area, wire.dumps(db))
+
+        # withdraw keys that are no longer advertised: flood the delete
+        # marker so other Decisions drop the entries
+        for key, area in list(self._advertised_keys.items()):
+            if key not in wanted:
+                parsed = keyutil.parse_per_prefix_key(key)
+                delete_db = PrefixDatabase(
+                    this_node_name=self.my_node_name,
+                    prefix_entries=(
+                        (PrefixEntry(prefix=parsed[2]),) if parsed else ()
+                    ),
+                    delete_prefix=True,
+                    area=area,
+                )
+                self._client.set_key(area, key, wire.dumps(delete_db))
+                self._client.unset_key(area, key)
+                del self._advertised_keys[key]
+
+        for key, (area, payload) in wanted.items():
+            self._client.persist_key(area, key, payload)
+            self._advertised_keys[key] = area
