@@ -192,6 +192,10 @@ class _Peer:
     backoff: ExponentialBackoff = field(
         default_factory=lambda: ExponentialBackoff(0.05, 5.0)
     )
+    # floods that arrived while this peer was mid-full-sync; flushed when
+    # it reaches INITIALIZED (otherwise an update racing the full sync
+    # would be lost until the next anti-entropy pass)
+    pending_flood: Dict[str, Value] = field(default_factory=dict)
 
 
 class KvStoreDb:
@@ -251,6 +255,9 @@ class KvStoreDb:
             return
         for peer in list(self.peers.values()):
             if peer.name == exclude:
+                continue
+            if peer.state == KvStorePeerState.SYNCING:
+                peer.pending_flood.update(flooded)
                 continue
             if peer.state != KvStorePeerState.INITIALIZED:
                 continue
@@ -514,6 +521,18 @@ class KvStoreDb:
         # 3rd leg: push back the keys we are better at
         if pub.tobe_updated_keys:
             self._finalize_full_sync(peer, pub.tobe_updated_keys)
+        # flush floods that raced the full sync
+        if peer.pending_flood:
+            pending, peer.pending_flood = peer.pending_flood, {}
+            params = KeySetParams(
+                key_vals=pending,
+                originator_id=self.node_id,
+                solicit_response=False,
+            )
+            self._async_peer_call(
+                peer,
+                lambda t=peer.transport: t.set_key_vals(self.area, params),
+            )
 
     def _finalize_full_sync(self, peer: _Peer, keys: List[str]) -> None:
         """reference: KvStore.cpp:2727 finalizeFullSync."""
