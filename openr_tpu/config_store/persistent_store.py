@@ -1,0 +1,91 @@
+"""PersistentStore: disk-backed store for state that must survive restart.
+
+Behavioral parity with the reference ``openr/config-store/PersistentStore``
+(PersistentStore.h:55): async batched writes with atomic on-disk commit
+(tmp + rename + fsync), typed object load/store over the wire codec.
+Used for drain/overload state, allocated prefixes and node labels
+(reference: Main.cpp:479-480, PrefixAllocator).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import AsyncThrottle, OpenrEventBase
+
+
+class PersistentStore:
+    def __init__(self, path: str, save_throttle_s: float = 0.1):
+        self._path = path
+        self._lock = threading.Lock()
+        self._data: Dict[str, bytes] = {}
+        self.num_writes = 0
+        self.num_saves = 0
+        self._load_from_disk()
+        self.evb = OpenrEventBase(name=f"config-store")
+        self._save_throttled = AsyncThrottle(
+            self.evb, save_throttle_s, self._save_to_disk
+        )
+        self.evb.run_in_thread()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def stop(self) -> None:
+        # flush pending writes synchronously before shutdown
+        self.evb.call_and_wait(self._save_to_disk)
+        self.evb.stop()
+        self.evb.join()
+
+    # -- public API -------------------------------------------------------
+
+    def store(self, key: str, obj: Any) -> None:
+        """Store any wire-encodable object (dataclass, dict, list, ...)."""
+        payload = wire.dumps(obj)
+        with self._lock:
+            self._data[key] = payload
+            self.num_writes += 1
+        self._save_throttled()
+
+    def load(self, key: str, cls: Any = None) -> Optional[Any]:
+        with self._lock:
+            payload = self._data.get(key)
+        if payload is None:
+            return None
+        return wire.loads(payload, cls if cls is not None else Any)
+
+    def erase(self, key: str) -> bool:
+        with self._lock:
+            existed = key in self._data
+            self._data.pop(key, None)
+        if existed:
+            self._save_throttled()
+        return existed
+
+    def keys(self):
+        with self._lock:
+            return sorted(self._data)
+
+    # -- disk I/O ---------------------------------------------------------
+
+    def _load_from_disk(self) -> None:
+        try:
+            with open(self._path, "rb") as f:
+                raw = f.read()
+            self._data = dict(wire.loads(raw, Dict[str, bytes]))
+        except (FileNotFoundError, ValueError, TypeError):
+            self._data = {}
+
+    def _save_to_disk(self) -> None:
+        with self._lock:
+            raw = wire.dumps(dict(self._data))
+            self.num_saves += 1
+        tmp = f"{self._path}.tmp"
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(raw)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)

@@ -1,0 +1,122 @@
+"""Monitor: structured event-log drain + system metrics.
+
+Behavioral parity with the reference ``openr/monitor/``:
+- ``LogSample`` structured JSON-style event records with common fields
+  merged in (monitor/LogSample.h)
+- a Monitor module draining the log-sample queue, retaining a bounded
+  history and forwarding to a pluggable backend (monitor/MonitorBase.h:32)
+- ``SystemMetrics``: process RSS / CPU sampling (monitor/SystemMetrics.h)
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+class LogSample:
+    """Structured event record (reference: monitor/LogSample.h)."""
+
+    def __init__(self, **values):
+        self._values: Dict[str, object] = dict(values)
+        self._values.setdefault("time", int(time.time()))
+
+    def add_string(self, key: str, value: str) -> "LogSample":
+        self._values[key] = value
+        return self
+
+    def add_int(self, key: str, value: int) -> "LogSample":
+        self._values[key] = int(value)
+        return self
+
+    def get(self, key: str):
+        return self._values.get(key)
+
+    def to_json(self) -> str:
+        return json.dumps(self._values, sort_keys=True)
+
+    @staticmethod
+    def from_json(raw: str) -> "LogSample":
+        return LogSample(**json.loads(raw))
+
+
+class SystemMetrics:
+    """reference: monitor/SystemMetrics.h — RSS/CPU snapshots."""
+
+    @staticmethod
+    def rss_bytes() -> int:
+        # ru_maxrss is KiB on Linux
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    @staticmethod
+    def cpu_seconds() -> float:
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return ru.ru_utime + ru.ru_stime
+
+
+class Monitor:
+    """Drains the log-sample queue; merges common fields; keeps a bounded
+    history; forwards to an optional backend callback.
+    reference: monitor/MonitorBase.h:32, Monitor.h:27."""
+
+    def __init__(
+        self,
+        node_name: str,
+        log_sample_queue: ReplicateQueue,
+        max_history: int = 1024,
+        backend: Optional[Callable[[LogSample], None]] = None,
+        common_fields: Optional[Dict[str, object]] = None,
+    ):
+        self.node_name = node_name
+        self.evb = OpenrEventBase(name=f"monitor:{node_name}")
+        self._history: Deque[LogSample] = deque(maxlen=max_history)
+        self._backend = backend
+        self._common = dict(common_fields or {})
+        self._common.setdefault("node_name", node_name)
+        self.num_processed = 0
+        self.evb.add_queue_reader(
+            log_sample_queue.get_reader(f"monitor:{node_name}"),
+            self._process_event_log,
+        )
+
+    def start(self) -> None:
+        self.evb.run_in_thread()
+
+    def stop(self) -> None:
+        self.evb.stop()
+        self.evb.join()
+
+    def _process_event_log(self, sample: LogSample) -> None:
+        """reference: Monitor::processEventLog."""
+        for key, value in self._common.items():
+            if sample.get(key) is None:
+                sample.add_string(key, value) if isinstance(
+                    value, str
+                ) else sample.add_int(key, value)
+        self._history.append(sample)
+        self.num_processed += 1
+        if self._backend is not None:
+            try:
+                self._backend(sample)
+            except Exception:
+                pass
+
+    def get_event_logs(self, limit: int = 100) -> List[LogSample]:
+        return self.evb.call_and_wait(
+            lambda: list(self._history)[-limit:]
+        )
+
+    def get_counters(self) -> Dict[str, object]:
+        return self.evb.call_and_wait(
+            lambda: {
+                "monitor.log_samples_processed": self.num_processed,
+                "process.rss_bytes": SystemMetrics.rss_bytes(),
+                "process.cpu_seconds": SystemMetrics.cpu_seconds(),
+            }
+        )

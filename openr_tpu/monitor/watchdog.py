@@ -1,0 +1,92 @@
+"""Watchdog: liveness + memory-limit enforcement for module event bases.
+
+Behavioral parity with the reference ``openr/watchdog/Watchdog.h:24-42``:
+every module's event base registers (addEvb); a periodic check verifies
+each loop has made progress recently and that process RSS is under the
+limit; violations invoke ``fire_crash`` (default: abort the process so a
+supervisor restarts it — overridable for tests).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from openr_tpu.monitor.monitor import SystemMetrics
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+class Watchdog:
+    def __init__(
+        self,
+        interval_s: float = 1.0,
+        thread_timeout_s: float = 30.0,
+        max_memory_bytes: Optional[int] = None,
+        crash_handler: Optional[Callable[[str], None]] = None,
+    ):
+        self.evb = OpenrEventBase(name="watchdog")
+        self._interval = interval_s
+        self._thread_timeout = thread_timeout_s
+        self._max_memory = max_memory_bytes
+        self._crash_handler = crash_handler or self._default_crash
+        self._monitored: List[Tuple[str, OpenrEventBase]] = []
+        self._timer = None
+        self.violations: List[str] = []
+
+    # -- registration -----------------------------------------------------
+
+    def add_evb(self, name: str, evb: OpenrEventBase) -> None:
+        """reference: Watchdog.h:32 addEvb."""
+        self._monitored.append((name, evb))
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.evb.run_in_thread()
+        self._timer = self.evb.schedule_periodic(
+            self._interval, self._check, jitter_first=True
+        )
+
+    def stop(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        self.evb.stop()
+        self.evb.join()
+
+    # -- checks -----------------------------------------------------------
+
+    def _check(self) -> None:
+        now = time.monotonic()
+        for name, evb in self._monitored:
+            if not evb.is_running:
+                continue
+            stalled_for = now - evb.last_loop_ts
+            if stalled_for > self._thread_timeout:
+                self._fire_crash(
+                    f"event base {name!r} stalled for {stalled_for:.1f}s"
+                )
+        if self.memory_limit_exceeded():
+            self._fire_crash(
+                f"memory limit exceeded: rss={SystemMetrics.rss_bytes()}"
+                f" > {self._max_memory}"
+            )
+
+    def memory_limit_exceeded(self) -> bool:
+        """reference: Watchdog.h:34 memoryLimitExceeded."""
+        return (
+            self._max_memory is not None
+            and SystemMetrics.rss_bytes() > self._max_memory
+        )
+
+    def _fire_crash(self, reason: str) -> None:
+        """reference: Watchdog.h:40-42 fireCrash."""
+        self.violations.append(reason)
+        self._crash_handler(reason)
+
+    @staticmethod
+    def _default_crash(reason: str) -> None:
+        import logging
+
+        logging.getLogger(__name__).critical("watchdog: %s — aborting", reason)
+        os.abort()

@@ -1,0 +1,162 @@
+"""PersistentStore / Monitor / Watchdog tests (reference analogues:
+config-store, monitor, watchdog test suites)."""
+
+import os
+import time
+
+import pytest
+
+from openr_tpu.config_store.persistent_store import PersistentStore
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.monitor.monitor import LogSample, Monitor, SystemMetrics
+from openr_tpu.monitor.watchdog import Watchdog
+from openr_tpu.types import Adjacency
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestPersistentStore:
+    def test_store_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("drain-state", {"is_overloaded": True})
+        assert store.load("drain-state") == {"is_overloaded": True}
+        store.stop()
+
+    def test_survives_restart(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("node-label", 42)
+        store.store(
+            "adj", Adjacency(other_node_name="x", if_name="if0")
+        )
+        store.stop()
+        # new instance loads from disk
+        store2 = PersistentStore(path)
+        assert store2.load("node-label") == 42
+        adj = store2.load("adj", Adjacency)
+        assert adj.other_node_name == "x"
+        store2.stop()
+
+    def test_erase(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path)
+        store.store("k", 1)
+        assert store.erase("k")
+        assert not store.erase("k")
+        assert store.load("k") is None
+        store.stop()
+        store2 = PersistentStore(path)
+        assert store2.load("k") is None
+        store2.stop()
+
+    def test_batched_saves(self, tmp_path):
+        path = str(tmp_path / "store.bin")
+        store = PersistentStore(path, save_throttle_s=0.2)
+        for i in range(50):
+            store.store(f"k{i}", i)
+        store.stop()
+        # 50 writes coalesced into very few disk saves
+        assert store.num_saves < 10
+        store2 = PersistentStore(path)
+        assert store2.load("k49") == 49
+        store2.stop()
+
+
+class TestMonitor:
+    def test_event_log_drain_and_common_fields(self):
+        q = ReplicateQueue(name="logs")
+        mon = Monitor("node-a", q, max_history=16)
+        mon.start()
+        try:
+            q.push(LogSample(event="NEIGHBOR_UP", neighbor="b"))
+            q.push(LogSample(event="ROUTE_UPDATE").add_int("routes", 7))
+            assert wait_until(lambda: mon.num_processed == 2)
+            logs = mon.get_event_logs()
+            assert logs[0].get("event") == "NEIGHBOR_UP"
+            assert logs[0].get("node_name") == "node-a"  # merged common field
+            assert logs[1].get("routes") == 7
+        finally:
+            mon.stop()
+
+    def test_bounded_history(self):
+        q = ReplicateQueue()
+        mon = Monitor("node-a", q, max_history=4)
+        mon.start()
+        try:
+            for i in range(10):
+                q.push(LogSample(event=f"e{i}"))
+            assert wait_until(lambda: mon.num_processed == 10)
+            logs = mon.get_event_logs()
+            assert len(logs) == 4
+            assert logs[-1].get("event") == "e9"
+        finally:
+            mon.stop()
+
+    def test_system_metrics(self):
+        assert SystemMetrics.rss_bytes() > 0
+        assert SystemMetrics.cpu_seconds() > 0
+
+
+class TestWatchdog:
+    def test_detects_stalled_evb(self):
+        crashes = []
+        wd = Watchdog(
+            interval_s=0.05,
+            thread_timeout_s=0.2,
+            crash_handler=crashes.append,
+        )
+        evb = OpenrEventBase("victim")
+        evb.run_in_thread()
+        wd.add_evb("victim", evb)
+        wd.start()
+        try:
+            # block the victim's loop
+            evb.run_in_event_base(lambda: time.sleep(1.0))
+            assert wait_until(lambda: crashes, timeout=2.0)
+            assert "victim" in crashes[0]
+        finally:
+            wd.stop()
+            evb.stop()
+            evb.join()
+
+    def test_healthy_evb_no_crash(self):
+        crashes = []
+        wd = Watchdog(
+            interval_s=0.05,
+            thread_timeout_s=0.5,
+            crash_handler=crashes.append,
+        )
+        evb = OpenrEventBase("healthy")
+        evb.run_in_thread()
+        wd.add_evb("healthy", evb)
+        wd.start()
+        try:
+            time.sleep(0.5)
+            assert crashes == []
+        finally:
+            wd.stop()
+            evb.stop()
+            evb.join()
+
+    def test_memory_limit(self):
+        crashes = []
+        wd = Watchdog(
+            interval_s=0.05,
+            max_memory_bytes=1,  # everything exceeds this
+            crash_handler=crashes.append,
+        )
+        wd.start()
+        try:
+            assert wait_until(lambda: crashes, timeout=2.0)
+            assert "memory" in crashes[0]
+        finally:
+            wd.stop()
