@@ -1,0 +1,461 @@
+"""breeze: the operator CLI.
+
+Command-group parity with the reference ``openr/py/openr/cli/breeze.py``
+(groups: config, decision, fib, kvstore, lm, monitor, openr, perf,
+prefixmgr, spark, tech-support; breeze.py:94-104). Talks to a running
+daemon's CtrlServer over TCP, or drives an in-process handler directly
+(used by tests and the simulator).
+
+Usage:  breeze [--host H] [--port P] <group> <command> [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from openr_tpu.cli.printing import caption, render_table
+
+
+class _InProcessClient:
+    """Adapter giving an OpenrCtrlHandler the CtrlClient interface."""
+
+    def __init__(self, handler):
+        self._handler = handler
+
+    def call(self, method: str, **kwargs) -> Any:
+        from openr_tpu.utils.jsonable import to_jsonable
+
+        return to_jsonable(getattr(self._handler, method)(**kwargs))
+
+    def close(self) -> None:
+        pass
+
+
+def _fmt_next_hop(nh: Dict) -> str:
+    out = str(nh.get("address", ""))
+    mpls = nh.get("mpls_action")
+    if mpls:
+        action = mpls.get("action")
+        if action == "PUSH":
+            out += f" mpls push {mpls.get('push_labels')}"
+        elif action == "SWAP":
+            out += f" mpls swap {mpls.get('swap_label')}"
+        else:
+            out += f" mpls {str(action).lower()}"
+    out += f" metric {nh.get('metric')}"
+    if nh.get("neighbor_node_name"):
+        out += f" via {nh['neighbor_node_name']}"
+    return out
+
+
+class Breeze:
+    def __init__(self, client, out=None):
+        self.client = client
+        self.out = out or sys.stdout
+
+    def _print(self, text: str) -> None:
+        print(text, file=self.out)
+
+    # -- decision ---------------------------------------------------------
+
+    def decision_routes(self, node: Optional[str] = None) -> None:
+        db = self.client.call("get_route_db_computed", node=node)
+        self._print(caption(f"Routes computed for {db.get('this_node_name')}"))
+        rows = []
+        for route in db.get("unicast_routes", []):
+            nhs = [_fmt_next_hop(nh) for nh in route.get("next_hops", [])]
+            rows.append((route.get("dest"), "\n".join(nhs) or "-"))
+        self._print(render_table(["Prefix", "NextHops"], rows))
+
+    def decision_adj(self) -> None:
+        dbs = self.client.call("get_decision_adjacency_dbs")
+        for area, nodes in sorted(dbs.items()):
+            self._print(caption(f"Area {area}"))
+            rows = []
+            for node, adj_db in sorted(nodes.items()):
+                for adj in adj_db.get("adjacencies", []):
+                    rows.append(
+                        (
+                            node,
+                            adj.get("other_node_name"),
+                            adj.get("if_name"),
+                            adj.get("metric"),
+                            adj.get("rtt"),
+                            "overloaded" if adj.get("is_overloaded") else "",
+                        )
+                    )
+            self._print(
+                render_table(
+                    ["Node", "Neighbor", "Iface", "Metric", "RTT(us)", ""],
+                    rows,
+                )
+            )
+
+    def decision_prefixes(self) -> None:
+        dbs = self.client.call("get_decision_prefix_dbs")
+        rows = []
+        for prefix, entries in sorted(dbs.items()):
+            for node_area, entry in sorted(entries.items()):
+                rows.append(
+                    (
+                        prefix,
+                        node_area,
+                        entry.get("type"),
+                        entry.get("forwarding_algorithm"),
+                    )
+                )
+        self._print(render_table(["Prefix", "Node|Area", "Type", "Algo"], rows))
+
+    # -- fib --------------------------------------------------------------
+
+    def fib_routes(self) -> None:
+        db = self.client.call("get_route_db")
+        self._print(caption(f"FIB routes on {db.get('this_node_name')}"))
+        rows = []
+        for route in db.get("unicast_routes", []):
+            nhs = [_fmt_next_hop(nh) for nh in route.get("next_hops", [])]
+            rows.append((route.get("dest"), "\n".join(nhs) or "-"))
+        self._print(render_table(["Prefix", "NextHops"], rows))
+        mpls_rows = [
+            (
+                r.get("top_label"),
+                "\n".join(
+                    _fmt_next_hop(nh) for nh in r.get("next_hops", [])
+                ),
+            )
+            for r in db.get("mpls_routes", [])
+        ]
+        if mpls_rows:
+            self._print(render_table(["Label", "NextHops"], mpls_rows))
+
+    def fib_counters(self) -> None:
+        counters = self.client.call("get_counters")
+        rows = [(k, v) for k, v in sorted(counters.items()) if "fib" in k]
+        self._print(render_table(["Counter", "Value"], rows))
+
+    # -- kvstore ----------------------------------------------------------
+
+    def kvstore_keys(self, prefix: str = "", area: str = "0") -> None:
+        key_vals = self.client.call(
+            "get_kvstore_keys_filtered", prefix=prefix, area=area
+        )
+        rows = []
+        for key, value in sorted(key_vals.items()):
+            rows.append(
+                (
+                    key,
+                    value.get("originator_id"),
+                    value.get("version"),
+                    value.get("ttl"),
+                    value.get("ttl_version"),
+                )
+            )
+        self._print(
+            render_table(
+                ["Key", "Originator", "Version", "TTL(ms)", "TTLv"], rows
+            )
+        )
+
+    def kvstore_peers(self, area: str = "0") -> None:
+        peers = self.client.call("get_kvstore_peers", area=area)
+        self._print(
+            render_table(["Peer", "State"], sorted(peers.items()))
+        )
+
+    def kvstore_areas(self) -> None:
+        areas = self.client.call("get_kvstore_areas")
+        self._print(render_table(["Area"], [(a,) for a in areas]))
+
+    # -- lm ---------------------------------------------------------------
+
+    def lm_links(self) -> None:
+        interfaces = self.client.call("get_interfaces")
+        rows = [
+            (
+                name,
+                "UP" if info.get("is_up") else "DOWN",
+                ", ".join(info.get("networks", [])),
+            )
+            for name, info in sorted(interfaces.items())
+        ]
+        self._print(render_table(["Interface", "State", "Addresses"], rows))
+
+    def lm_adj(self) -> None:
+        adj_db = self.client.call("get_link_monitor_adjacencies")
+        rows = [
+            (
+                adj.get("other_node_name"),
+                adj.get("if_name"),
+                adj.get("metric"),
+                adj.get("rtt"),
+            )
+            for adj in adj_db.get("adjacencies", [])
+        ]
+        overload = "OVERLOADED" if adj_db.get("is_overloaded") else "healthy"
+        self._print(caption(f"Node {adj_db.get('this_node_name')} ({overload})"))
+        self._print(
+            render_table(["Neighbor", "Iface", "Metric", "RTT(us)"], rows)
+        )
+
+    def lm_set_node_overload(self) -> None:
+        self.client.call("set_node_overload", overloaded=True)
+        self._print("node overload: SET")
+
+    def lm_unset_node_overload(self) -> None:
+        self.client.call("set_node_overload", overloaded=False)
+        self._print("node overload: UNSET")
+
+    def lm_set_link_overload(self, if_name: str) -> None:
+        self.client.call(
+            "set_link_overload", if_name=if_name, overloaded=True
+        )
+        self._print(f"link overload on {if_name}: SET")
+
+    def lm_unset_link_overload(self, if_name: str) -> None:
+        self.client.call(
+            "set_link_overload", if_name=if_name, overloaded=False
+        )
+        self._print(f"link overload on {if_name}: UNSET")
+
+    def lm_set_link_metric(self, if_name: str, neighbor: str, metric: int):
+        self.client.call(
+            "set_link_metric",
+            if_name=if_name,
+            neighbor=neighbor,
+            metric=metric,
+        )
+        self._print(f"metric override {if_name}->{neighbor} = {metric}")
+
+    def lm_unset_link_metric(self, if_name: str, neighbor: str) -> None:
+        self.client.call(
+            "set_link_metric", if_name=if_name, neighbor=neighbor, metric=None
+        )
+        self._print(f"metric override {if_name}->{neighbor} cleared")
+
+    # -- monitor ----------------------------------------------------------
+
+    def monitor_counters(self) -> None:
+        counters = self.client.call("get_counters")
+        self._print(
+            render_table(["Counter", "Value"], sorted(counters.items()))
+        )
+
+    def monitor_logs(self, limit: int = 20) -> None:
+        logs = self.client.call("get_event_logs", limit=limit)
+        for raw in logs:
+            self._print(raw if isinstance(raw, str) else json.dumps(raw))
+
+    # -- openr ------------------------------------------------------------
+
+    def openr_version(self) -> None:
+        import openr_tpu
+
+        self._print(f"openr-tpu {openr_tpu.__version__}")
+
+    def openr_config(self) -> None:
+        self._print(json.dumps(self.client.call("get_running_config"), indent=2))
+
+    # -- perf -------------------------------------------------------------
+
+    def perf_fib(self) -> None:
+        perf_db = self.client.call("get_perf_db")
+        for events in perf_db:
+            rows = []
+            prev_ts = None
+            for ev in events.get("events", []):
+                ts = ev.get("unix_ts")
+                delta = "" if prev_ts is None else f"+{ts - prev_ts}ms"
+                prev_ts = ts
+                rows.append((ev.get("node_name"), ev.get("event_descr"), ts, delta))
+            self._print(
+                render_table(["Node", "Event", "Unix-ts(ms)", "Delta"], rows)
+            )
+            self._print("")
+
+    # -- prefixmgr --------------------------------------------------------
+
+    def prefixmgr_view(self) -> None:
+        prefixes = self.client.call("get_prefixes")
+        rows = [
+            (
+                p.get("prefix"),
+                p.get("type"),
+                p.get("forwarding_type"),
+                p.get("forwarding_algorithm"),
+            )
+            for p in prefixes
+        ]
+        self._print(render_table(["Prefix", "Type", "Fwd", "Algo"], rows))
+
+    def prefixmgr_advertise(self, prefixes: List[str]) -> None:
+        self.client.call("advertise_prefixes", prefixes=prefixes)
+        self._print(f"advertised {len(prefixes)} prefixes")
+
+    def prefixmgr_withdraw(self, prefixes: List[str]) -> None:
+        self.client.call("withdraw_prefixes", prefixes=prefixes)
+        self._print(f"withdrew {len(prefixes)} prefixes")
+
+    # -- spark ------------------------------------------------------------
+
+    def spark_neighbors(self) -> None:
+        neighbors = self.client.call("get_spark_neighbors")
+        rows = []
+        for if_name, by_node in sorted(neighbors.items()):
+            for node, state in sorted(by_node.items()):
+                rows.append((if_name, node, state))
+        self._print(render_table(["Iface", "Neighbor", "State"], rows))
+
+    # -- tech-support -----------------------------------------------------
+
+    def tech_support(self) -> None:
+        self.openr_version()
+        self.monitor_counters()
+        self.kvstore_areas()
+        self.kvstore_keys()
+        self.decision_adj()
+        self.fib_routes()
+        self.lm_links()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="breeze")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=2018)
+    sub = parser.add_subparsers(dest="group", required=True)
+
+    def group(name):
+        g = sub.add_parser(name)
+        return g.add_subparsers(dest="command", required=True)
+
+    d = group("decision")
+    routes = d.add_parser("routes")
+    routes.add_argument("--node", default=None)
+    d.add_parser("adj")
+    d.add_parser("prefixes")
+
+    f = group("fib")
+    f.add_parser("routes")
+    f.add_parser("counters")
+
+    k = group("kvstore")
+    keys = k.add_parser("keys")
+    keys.add_argument("--prefix", default="")
+    keys.add_argument("--area", default="0")
+    peers = k.add_parser("peers")
+    peers.add_argument("--area", default="0")
+    k.add_parser("areas")
+
+    lm = group("lm")
+    lm.add_parser("links")
+    lm.add_parser("adj")
+    lm.add_parser("set-node-overload")
+    lm.add_parser("unset-node-overload")
+    p = lm.add_parser("set-link-overload")
+    p.add_argument("interface")
+    p = lm.add_parser("unset-link-overload")
+    p.add_argument("interface")
+    p = lm.add_parser("set-link-metric")
+    p.add_argument("interface")
+    p.add_argument("neighbor")
+    p.add_argument("metric", type=int)
+    p = lm.add_parser("unset-link-metric")
+    p.add_argument("interface")
+    p.add_argument("neighbor")
+
+    m = group("monitor")
+    m.add_parser("counters")
+    logs = m.add_parser("logs")
+    logs.add_argument("--limit", type=int, default=20)
+
+    o = group("openr")
+    o.add_parser("version")
+    o.add_parser("config")
+
+    perf = group("perf")
+    perf.add_parser("fib")
+
+    pm = group("prefixmgr")
+    pm.add_parser("view")
+    adv = pm.add_parser("advertise")
+    adv.add_argument("prefixes", nargs="+")
+    wd = pm.add_parser("withdraw")
+    wd.add_argument("prefixes", nargs="+")
+
+    s = group("spark")
+    s.add_parser("neighbors")
+
+    sub.add_parser("tech-support")
+    return parser
+
+
+def run(argv: List[str], client=None, out=None) -> int:
+    args = build_parser().parse_args(argv)
+    if client is None:
+        from openr_tpu.ctrl.server import CtrlClient
+
+        client = CtrlClient(args.host, args.port)
+    breeze = Breeze(client, out=out)
+    group = args.group.replace("-", "_")
+    command = getattr(args, "command", "").replace("-", "_") if hasattr(
+        args, "command"
+    ) else ""
+
+    dispatch: Dict[str, Callable[[], None]] = {
+        "decision.routes": lambda: breeze.decision_routes(args.node),
+        "decision.adj": breeze.decision_adj,
+        "decision.prefixes": breeze.decision_prefixes,
+        "fib.routes": breeze.fib_routes,
+        "fib.counters": breeze.fib_counters,
+        "kvstore.keys": lambda: breeze.kvstore_keys(args.prefix, args.area),
+        "kvstore.peers": lambda: breeze.kvstore_peers(args.area),
+        "kvstore.areas": breeze.kvstore_areas,
+        "lm.links": breeze.lm_links,
+        "lm.adj": breeze.lm_adj,
+        "lm.set_node_overload": breeze.lm_set_node_overload,
+        "lm.unset_node_overload": breeze.lm_unset_node_overload,
+        "lm.set_link_overload": lambda: breeze.lm_set_link_overload(
+            args.interface
+        ),
+        "lm.unset_link_overload": lambda: breeze.lm_unset_link_overload(
+            args.interface
+        ),
+        "lm.set_link_metric": lambda: breeze.lm_set_link_metric(
+            args.interface, args.neighbor, args.metric
+        ),
+        "lm.unset_link_metric": lambda: breeze.lm_unset_link_metric(
+            args.interface, args.neighbor
+        ),
+        "monitor.counters": breeze.monitor_counters,
+        "monitor.logs": lambda: breeze.monitor_logs(args.limit),
+        "openr.version": breeze.openr_version,
+        "openr.config": breeze.openr_config,
+        "perf.fib": breeze.perf_fib,
+        "prefixmgr.view": breeze.prefixmgr_view,
+        "prefixmgr.advertise": lambda: breeze.prefixmgr_advertise(
+            args.prefixes
+        ),
+        "prefixmgr.withdraw": lambda: breeze.prefixmgr_withdraw(
+            args.prefixes
+        ),
+        "spark.neighbors": breeze.spark_neighbors,
+        "tech_support.": breeze.tech_support,
+        "tech_support": breeze.tech_support,
+    }
+    key = f"{group}.{command}" if command else group
+    fn = dispatch.get(key)
+    if fn is None:
+        print(f"unknown command: {key}", file=sys.stderr)
+        return 1
+    fn()
+    return 0
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+"""OpenrCtrlHandler: the unified control/introspection API.
+
+Behavioral parity with the reference ``openr/ctrl-server/OpenrCtrlHandler``
+(the ~70-RPC ``OpenrCtrl`` thrift service, openr/if/OpenrCtrl.thrift:168):
+per-module getters/setters routed to the modules' thread-safe APIs, plus
+server-streaming subscriptions for KvStore publications and Fib deltas
+(reference: OpenrCtrlHandler.h:226-247) and KvStore adjacency long-poll
+(:250).
+
+This object is transport-neutral: used directly in-process, and exposed
+over TCP by ``openr_tpu.ctrl.server.CtrlServer`` (the thrift-server
+analogue) for the ``breeze`` CLI.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.messaging.queue import RQueue
+from openr_tpu.types import IpPrefix, KeyDumpParams, KeySetParams, Value
+from openr_tpu.types.lsdb import PrefixForwardingAlgorithm, PrefixForwardingType
+from openr_tpu.types import PrefixEntry, PrefixType
+from openr_tpu.utils import keys as keyutil
+
+
+class OpenrCtrlHandler:
+    def __init__(
+        self,
+        node_name: str,
+        kvstore=None,
+        decision=None,
+        fib=None,
+        link_monitor=None,
+        prefix_manager=None,
+        spark=None,
+        monitor=None,
+        config=None,
+    ):
+        self.node_name = node_name
+        self._kvstore = kvstore
+        self._decision = decision
+        self._fib = fib
+        self._link_monitor = link_monitor
+        self._prefix_manager = prefix_manager
+        self._spark = spark
+        self._monitor = monitor
+        self._config = config
+        self._start_time = int(time.time())
+
+    # -- fb303-style base -------------------------------------------------
+
+    def alive_since(self) -> int:
+        return self._start_time
+
+    def get_counters(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for module in (
+            self._kvstore,
+            self._decision,
+            self._fib,
+            self._link_monitor,
+            self._spark,
+            self._monitor,
+        ):
+            if module is None:
+                continue
+            getter = getattr(module, "get_counters", None) or getattr(
+                module, "counters", None
+            )
+            try:
+                counters = getter() if callable(getter) else getter
+                if counters:
+                    out.update(counters)
+            except Exception:
+                continue
+        return out
+
+    def get_running_config(self) -> Dict[str, Any]:
+        if self._config is None:
+            return {"node_name": self.node_name}
+        return self._config.to_dict()
+
+    # -- KvStore ----------------------------------------------------------
+
+    def get_kvstore_key_vals(
+        self, keys: List[str], area: str = "0"
+    ) -> Dict[str, Value]:
+        return self._kvstore.get_key_vals(area, keys)
+
+    def set_kvstore_key_vals(
+        self, key_vals: Dict[str, Value], area: str = "0"
+    ) -> None:
+        self._kvstore.set_key_vals(
+            area,
+            KeySetParams(key_vals=key_vals, originator_id=self.node_name),
+        )
+
+    def get_kvstore_keys_filtered(
+        self, prefix: str = "", area: str = "0"
+    ) -> Dict[str, Value]:
+        return self._kvstore.dump_with_filters(
+            area, KeyDumpParams(prefix=prefix)
+        ).key_vals
+
+    def get_kvstore_hash_filtered(
+        self, prefix: str = "", area: str = "0"
+    ) -> Dict[str, Value]:
+        return self._kvstore.dump_hashes(area, prefix).key_vals
+
+    def get_kvstore_peers(self, area: str = "0") -> Dict[str, str]:
+        return {
+            name: state.name
+            for name, state in self._kvstore.peer_states(area).items()
+        }
+
+    def get_kvstore_areas(self) -> List[str]:
+        return self._kvstore.areas()
+
+    def subscribe_kvstore_filtered(
+        self, prefix: str = "", area: str = "0"
+    ) -> RQueue:
+        """Server-streaming subscription (reference:
+        OpenrCtrlHandler.h:226 subscribeAndGetKvStoreFiltered). Returns a
+        reader delivering matching Publications; snapshot via
+        get_kvstore_keys_filtered first."""
+        return self._kvstore.updates_queue.get_reader(
+            f"ctrl-sub:{self.node_name}"
+        )
+
+    def long_poll_kvstore_adj(
+        self, area: str = "0", timeout_s: float = 10.0
+    ) -> bool:
+        """Block until any adj: key changes (reference:
+        OpenrCtrlHandler.h:250 longPollKvStoreAdj). Returns True if a
+        change was seen within the timeout."""
+        reader = self._kvstore.updates_queue.get_reader("ctrl-longpoll")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                pub = reader.get(timeout=remaining)
+            except Exception:
+                return False
+            if pub.area != area:
+                continue
+            if any(keyutil.is_adj_key(k) for k in pub.key_vals) or any(
+                keyutil.is_adj_key(k) for k in pub.expired_keys
+            ):
+                return True
+
+    # -- Decision ---------------------------------------------------------
+
+    def get_route_db_computed(self, node: Optional[str] = None):
+        return self._decision.get_decision_route_db(node).to_route_db(
+            node or self.node_name
+        )
+
+    def get_decision_adjacency_dbs(self):
+        return self._decision.get_adj_dbs()
+
+    def get_decision_prefix_dbs(self):
+        return self._decision.evb.call_and_wait(
+            lambda: dict(self._decision.prefix_state.prefixes())
+        )
+
+    # -- Fib --------------------------------------------------------------
+
+    def get_route_db(self):
+        return self._fib.get_route_db()
+
+    def get_unicast_routes(self, prefixes: Optional[List[str]] = None):
+        parsed = (
+            [IpPrefix.from_str(p) for p in prefixes] if prefixes else None
+        )
+        return self._fib.get_unicast_routes(parsed)
+
+    def longest_prefix_match(self, addr: str):
+        return self._fib.longest_prefix_match(addr)
+
+    def subscribe_fib(self) -> RQueue:
+        """reference: OpenrCtrlHandler.h:240 subscribeAndGetFib."""
+        return self._fib.fib_updates_queue.get_reader(
+            f"ctrl-fib-sub:{self.node_name}"
+        )
+
+    def get_perf_db(self):
+        """reference: if/OpenrCtrl.thrift:312 getPerfDb."""
+        return self._fib.evb.call_and_wait(lambda: list(self._fib.perf_db))
+
+    # -- LinkMonitor ------------------------------------------------------
+
+    def get_interfaces(self):
+        return self._link_monitor.get_interfaces()
+
+    def get_link_monitor_adjacencies(self):
+        return self._link_monitor.get_adjacencies()
+
+    def set_node_overload(self, overloaded: bool) -> None:
+        self._link_monitor.set_node_overload(overloaded)
+
+    def set_link_overload(self, if_name: str, overloaded: bool) -> None:
+        self._link_monitor.set_link_overload(if_name, overloaded)
+
+    def set_link_metric(
+        self, if_name: str, neighbor: str, metric: Optional[int]
+    ) -> None:
+        self._link_monitor.set_link_metric(if_name, neighbor, metric)
+
+    # -- PrefixManager ----------------------------------------------------
+
+    def get_prefixes(self):
+        return self._prefix_manager.get_prefixes()
+
+    def advertise_prefixes(
+        self,
+        prefixes: List[str],
+        prefix_type: str = "BREEZE",
+        forwarding_type: str = "IP",
+        forwarding_algorithm: str = "SP_ECMP",
+    ) -> None:
+        entries = [
+            PrefixEntry(
+                prefix=IpPrefix.from_str(p),
+                type=PrefixType[prefix_type],
+                forwarding_type=PrefixForwardingType[forwarding_type],
+                forwarding_algorithm=PrefixForwardingAlgorithm[
+                    forwarding_algorithm
+                ],
+            )
+            for p in prefixes
+        ]
+        self._prefix_manager.advertise_prefixes(entries)
+
+    def withdraw_prefixes(self, prefixes: List[str]) -> None:
+        self._prefix_manager.withdraw_prefixes(
+            [IpPrefix.from_str(p) for p in prefixes]
+        )
+
+    # -- Spark ------------------------------------------------------------
+
+    def get_spark_neighbors(self):
+        return {
+            if_name: {n: state.name for n, state in neighbors.items()}
+            for if_name, neighbors in self._spark.get_neighbors().items()
+        }
+
+    # -- Monitor ----------------------------------------------------------
+
+    def get_event_logs(self, limit: int = 100):
+        if self._monitor is None:
+            return []
+        return [s.to_json() for s in self._monitor.get_event_logs(limit)]

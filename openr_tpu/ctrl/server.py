@@ -1,0 +1,148 @@
+"""CtrlServer: TCP transport for the control API.
+
+The analogue of the reference's thrift ctrl server (port 2018,
+reference: Main.cpp:587-592): length-prefixed JSON frames
+``{"method": ..., "kwargs": {...}}`` -> ``{"ok": true, "result": ...}``.
+Results are projected through ``utils.jsonable``. Streaming subscriptions
+(``subscribe_kvstore_filtered`` / ``subscribe_fib``) hold the connection
+open and push one frame per event until the client disconnects.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+from openr_tpu.ctrl.handler import OpenrCtrlHandler
+from openr_tpu.messaging.queue import QueueClosedError, QueueTimeoutError
+from openr_tpu.utils.jsonable import to_jsonable
+
+_STREAM_METHODS = {"subscribe_kvstore_filtered", "subscribe_fib"}
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+    header = b""
+    while len(header) < 4:
+        chunk = sock.recv(4 - len(header))
+        if not chunk:
+            return None
+        header += chunk
+    (length,) = struct.unpack(">I", header)
+    payload = b""
+    while len(payload) < length:
+        chunk = sock.recv(length - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return json.loads(payload.decode("utf-8"))
+
+
+class CtrlServer:
+    def __init__(self, handler: OpenrCtrlHandler, host="127.0.0.1", port=0):
+        self.handler = handler
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        request = _recv_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if request is None:
+                        return
+                    outer._dispatch(self.request, request)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"ctrl-server:{self.port}",
+            daemon=True,
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, sock: socket.socket, request: Dict) -> None:
+        method_name = request.get("method", "")
+        kwargs = request.get("kwargs", {})
+        method = getattr(self.handler, method_name, None)
+        if method is None or method_name.startswith("_"):
+            _send_frame(sock, {"ok": False, "error": f"no method {method_name}"})
+            return
+        if method_name in _STREAM_METHODS:
+            self._stream(sock, method, kwargs)
+            return
+        try:
+            result = method(**kwargs)
+            _send_frame(sock, {"ok": True, "result": to_jsonable(result)})
+        except Exception as e:  # noqa: BLE001 - relayed to client
+            _send_frame(sock, {"ok": False, "error": repr(e)})
+
+    def _stream(self, sock: socket.socket, method, kwargs: Dict) -> None:
+        try:
+            reader = method(**kwargs)
+        except Exception as e:  # noqa: BLE001
+            _send_frame(sock, {"ok": False, "error": repr(e)})
+            return
+        _send_frame(sock, {"ok": True, "stream": True})
+        while True:
+            try:
+                item = reader.get(timeout=1.0)
+            except QueueTimeoutError:
+                continue
+            except QueueClosedError:
+                return
+            try:
+                _send_frame(sock, {"ok": True, "event": to_jsonable(item)})
+            except (ConnectionError, OSError):
+                return
+
+
+class CtrlClient:
+    """Client for CtrlServer (used by the breeze CLI remotely)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 2018):
+        self._sock = socket.create_connection((host, port), timeout=30)
+
+    def call(self, method: str, **kwargs) -> Any:
+        _send_frame(self._sock, {"method": method, "kwargs": kwargs})
+        response = _recv_frame(self._sock)
+        if response is None:
+            raise ConnectionError("server closed connection")
+        if not response.get("ok"):
+            raise RuntimeError(response.get("error", "unknown error"))
+        return response.get("result")
+
+    def stream(self, method: str, **kwargs):
+        """Generator over streamed events."""
+        _send_frame(self._sock, {"method": method, "kwargs": kwargs})
+        first = _recv_frame(self._sock)
+        if first is None or not first.get("ok"):
+            raise RuntimeError(first.get("error") if first else "closed")
+        while True:
+            frame = _recv_frame(self._sock)
+            if frame is None:
+                return
+            yield frame.get("event")
+
+    def close(self) -> None:
+        self._sock.close()

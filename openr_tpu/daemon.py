@@ -112,6 +112,18 @@ class OpenrNode:
             prefix_updates_queue=self.prefix_updates,
             areas=[area],
         )
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+
+        self.ctrl_handler = OpenrCtrlHandler(
+            name,
+            kvstore=self.kvstore,
+            decision=self.decision,
+            fib=self.fib,
+            link_monitor=self.link_monitor,
+            prefix_manager=self.prefix_manager,
+            spark=self.spark,
+        )
+        self.ctrl_server = None  # created on demand by start_ctrl_server
         self._started = False
 
     # -- peering ----------------------------------------------------------
@@ -138,10 +150,21 @@ class OpenrNode:
         self.fib.start()
         self._started = True
 
+    def start_ctrl_server(self, port: int = 0) -> int:
+        """Expose the ctrl API over TCP (reference: thrift ctrl server on
+        port 2018, Main.cpp:587). Returns the bound port."""
+        from openr_tpu.ctrl.server import CtrlServer
+
+        self.ctrl_server = CtrlServer(self.ctrl_handler, port=port)
+        self.ctrl_server.start()
+        return self.ctrl_server.port
+
     def stop(self) -> None:
         if not self._started:
             return
         # reverse order teardown (reference: Main.cpp:604-654)
+        if self.ctrl_server is not None:
+            self.ctrl_server.stop()
         self.fib.stop()
         self.decision.stop()
         self.link_monitor.stop()

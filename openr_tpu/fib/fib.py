@@ -75,6 +75,11 @@ class Fib:
             "fib.routes_programmed": 0,
             "fib.routes_deleted": 0,
         }
+        # bounded perf-event history served via getPerfDb
+        # (reference: Fib keeps a PerfDatabase, if/OpenrCtrl.thrift:312)
+        from collections import deque
+
+        self.perf_db = deque(maxlen=32)
         self.evb.add_queue_reader(
             route_updates_queue.get_reader(f"fib:{my_node_name}"),
             self._on_route_update,
@@ -104,6 +109,9 @@ class Fib:
     def _on_route_update(self, update: DecisionRouteUpdate) -> None:
         """reference: Fib.cpp:316 processRouteUpdates."""
         t0 = time.perf_counter()
+        if update.perf_events is not None:
+            update.perf_events.add(self.my_node_name, "FIB_ROUTE_DB_RECVD")
+            self.perf_db.append(update.perf_events)
         # apply to desired state
         for prefix in update.unicast_routes_to_delete:
             self.unicast_routes.pop(prefix, None)

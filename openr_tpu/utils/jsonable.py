@@ -1,0 +1,46 @@
+"""Lossless-enough JSON projection of schema objects for the ctrl/CLI
+surface (the reference serializes thrift structs; we project dataclasses)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any
+
+from openr_tpu.types import BinaryAddress, IpPrefix
+
+
+def to_jsonable(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__bytes__": bytes(obj).hex()}
+    if isinstance(obj, enum.Enum):
+        return obj.name
+    if isinstance(obj, BinaryAddress):
+        return obj.to_str() + (f"%{obj.if_name}" if obj.if_name else "")
+    if isinstance(obj, IpPrefix):
+        return obj.to_str()
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: to_jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, dict):
+        return {_key(k): to_jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        items = [to_jsonable(v) for v in obj]
+        if isinstance(obj, (set, frozenset)):
+            items.sort(key=repr)
+        return items
+    return repr(obj)
+
+
+def _key(k: Any) -> str:
+    if isinstance(k, str):
+        return k
+    if isinstance(k, (IpPrefix,)):
+        return k.to_str()
+    if isinstance(k, tuple):
+        return "|".join(_key(x) for x in k)
+    return str(k)

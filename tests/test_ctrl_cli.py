@@ -1,0 +1,204 @@
+"""Ctrl API + breeze CLI tests (reference analogues:
+openr/ctrl-server/tests/OpenrCtrlHandlerTest.cpp and the breeze CLI)."""
+
+import io
+import time
+
+import pytest
+
+from openr_tpu.cli.breeze import run as breeze_run
+from openr_tpu.ctrl.server import CtrlClient
+from openr_tpu.daemon import OpenrNode
+from openr_tpu.spark.io_provider import MockIoProvider
+
+
+SPARK_FAST = dict(
+    hello_interval_s=0.05,
+    fast_hello_interval_s=0.03,
+    handshake_interval_s=0.03,
+    heartbeat_interval_s=0.05,
+    hold_time_s=0.6,
+    graceful_restart_time_s=2.0,
+)
+
+
+def wait_until(pred, timeout=10.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+@pytest.fixture(scope="module")
+def network():
+    io_provider = MockIoProvider()
+    registry = {}
+    nodes = {}
+    for i, name in enumerate(["alpha", "beta"]):
+        nodes[name] = OpenrNode(
+            name,
+            io_provider,
+            node_registry=registry,
+            v6_addr=f"fe80::{i + 1}",
+            spark_config=SPARK_FAST,
+        )
+    for node in nodes.values():
+        node.start()
+    io_provider.connect_pair("if_alpha_beta", "if_beta_alpha")
+    nodes["alpha"].add_interface("if_alpha_beta")
+    nodes["beta"].add_interface("if_beta_alpha")
+    alpha_pfx = nodes["alpha"].advertise_loopback("fd00:a::1/128")
+    beta_pfx = nodes["beta"].advertise_loopback("fd00:b::1/128")
+
+    def converged():
+        db = nodes["alpha"].get_fib_routes()
+        return any(r.dest == beta_pfx for r in db.unicast_routes)
+
+    assert wait_until(converged)
+    port = nodes["alpha"].start_ctrl_server()
+    yield nodes, port
+    for node in nodes.values():
+        node.stop()
+    io_provider.stop()
+
+
+def breeze(port, *argv):
+    out = io.StringIO()
+    client = CtrlClient(port=port)
+    try:
+        rc = breeze_run(list(argv), client=client, out=out)
+    finally:
+        client.close()
+    assert rc == 0
+    return out.getvalue()
+
+
+class TestCtrlApi:
+    def test_counters_over_tcp(self, network):
+        nodes, port = network
+        client = CtrlClient(port=port)
+        try:
+            counters = client.call("get_counters")
+            assert counters.get("spark.neighbor_up", 0) >= 1
+            assert client.call("alive_since") > 0
+        finally:
+            client.close()
+
+    def test_kvstore_api(self, network):
+        nodes, port = network
+        client = CtrlClient(port=port)
+        try:
+            keys = client.call("get_kvstore_keys_filtered", prefix="adj:")
+            assert any(k == "adj:alpha" for k in keys)
+            assert any(k == "adj:beta" for k in keys)
+            peers = client.call("get_kvstore_peers")
+            assert peers.get("beta") == "INITIALIZED"
+        finally:
+            client.close()
+
+    def test_route_apis(self, network):
+        nodes, port = network
+        client = CtrlClient(port=port)
+        try:
+            fib_db = client.call("get_route_db")
+            assert any(
+                r["dest"] == "fd00:b::1/128"
+                for r in fib_db["unicast_routes"]
+            )
+            computed = client.call("get_route_db_computed", node="beta")
+            assert any(
+                r["dest"] == "fd00:a::1/128"
+                for r in computed["unicast_routes"]
+            )
+            match = client.call("longest_prefix_match", addr="fd00:b::1")
+            assert match["dest"] == "fd00:b::1/128"
+        finally:
+            client.close()
+
+    def test_fib_stream_subscription(self, network):
+        nodes, port = network
+        client = CtrlClient(port=port)
+        try:
+            stream = client.stream("subscribe_fib")
+            # trigger a route change
+            nodes["beta"].advertise_loopback("fd00:b::2/128")
+            event = next(stream)
+            assert event is not None
+        finally:
+            client.close()
+
+
+class TestBreezeCli:
+    def test_decision_routes(self, network):
+        nodes, port = network
+        out = breeze(port, "decision", "routes")
+        assert "fd00:b::1/128" in out
+
+    def test_decision_adj(self, network):
+        nodes, port = network
+        out = breeze(port, "decision", "adj")
+        assert "alpha" in out and "beta" in out
+
+    def test_fib_routes(self, network):
+        nodes, port = network
+        out = breeze(port, "fib", "routes")
+        assert "fd00:b::1/128" in out
+
+    def test_kvstore_keys(self, network):
+        nodes, port = network
+        out = breeze(port, "kvstore", "keys", "--prefix", "adj:")
+        assert "adj:alpha" in out
+
+    def test_kvstore_peers(self, network):
+        nodes, port = network
+        out = breeze(port, "kvstore", "peers")
+        assert "INITIALIZED" in out
+
+    def test_spark_neighbors(self, network):
+        nodes, port = network
+        out = breeze(port, "spark", "neighbors")
+        assert "ESTABLISHED" in out
+
+    def test_lm_adj_and_overload_cycle(self, network):
+        nodes, port = network
+        out = breeze(port, "lm", "adj")
+        assert "beta" in out
+        breeze(port, "lm", "set-node-overload")
+        adj_db = nodes["alpha"].link_monitor.get_adjacencies()
+        assert adj_db.is_overloaded
+        breeze(port, "lm", "unset-node-overload")
+        adj_db = nodes["alpha"].link_monitor.get_adjacencies()
+        assert not adj_db.is_overloaded
+
+    def test_prefixmgr_advertise_withdraw(self, network):
+        nodes, port = network
+        breeze(port, "prefixmgr", "advertise", "fd00:cafe::/64")
+        out = breeze(port, "prefixmgr", "view")
+        assert "fd00:cafe::/64" in out
+        # the new prefix propagates into beta's fib
+        from openr_tpu.types import IpPrefix
+
+        target = IpPrefix.from_str("fd00:cafe::/64")
+        assert wait_until(
+            lambda: any(
+                r.dest == target
+                for r in nodes["beta"].get_fib_routes().unicast_routes
+            )
+        )
+        breeze(port, "prefixmgr", "withdraw", "fd00:cafe::/64")
+        out = breeze(port, "prefixmgr", "view")
+        assert "fd00:cafe::/64" not in out
+
+    def test_monitor_counters_and_version(self, network):
+        nodes, port = network
+        out = breeze(port, "monitor", "counters")
+        assert "spark.hello_sent" in out
+        out = breeze(port, "openr", "version")
+        assert "openr-tpu" in out
+
+    def test_tech_support(self, network):
+        nodes, port = network
+        out = breeze(port, "tech-support")
+        assert "adj:alpha" in out and "openr-tpu" in out
