@@ -1,0 +1,145 @@
+"""openr-tpu daemon entry point.
+
+The analogue of the reference's ``openr/Main.cpp`` main(): parse config
+(JSON file via --config, or legacy flags), assemble the module graph,
+start the ctrl server and watchdog, run until SIGINT/SIGTERM, tear down
+in reverse order.
+
+Run:  python -m openr_tpu.main --config node.json
+      python -m openr_tpu.main --node-name fc001 --ifaces eth0,eth1
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from openr_tpu.config.config import OpenrConfig
+from openr_tpu.daemon import OpenrNode
+from openr_tpu.monitor.watchdog import Watchdog
+from openr_tpu.spark.io_provider import UdpIoProvider
+
+
+def parse_args(argv):
+    parser = argparse.ArgumentParser(prog="openr-tpu")
+    parser.add_argument("--config", help="JSON config file")
+    # legacy flag surface (reference: 99 gflags in common/Flags.cpp;
+    # the load-bearing subset)
+    parser.add_argument("--node-name", default=None)
+    parser.add_argument("--areas", default="0")
+    parser.add_argument("--ifaces", default="", help="comma separated")
+    parser.add_argument("--ctrl-port", type=int, default=2018)
+    parser.add_argument("--dryrun", action="store_true")
+    parser.add_argument("--enable-v4", action="store_true")
+    parser.add_argument("--use-rtt-metric", action="store_true")
+    parser.add_argument("--solver-backend", default="device",
+                        choices=["device", "host"])
+    parser.add_argument("--spark-port", type=int, default=6666)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    return parser.parse_args(argv)
+
+
+def build_config(args) -> OpenrConfig:
+    if args.config:
+        return OpenrConfig.from_file(args.config)
+    if not args.node_name:
+        raise SystemExit("either --config or --node-name is required")
+    from openr_tpu.config.config import AreaConfig, LinkMonitorConfig
+
+    return OpenrConfig(
+        node_name=args.node_name,
+        areas=[AreaConfig(area_id=a) for a in args.areas.split(",")],
+        openr_ctrl_port=args.ctrl_port,
+        dryrun=args.dryrun,
+        enable_v4=args.enable_v4,
+        link_monitor=LinkMonitorConfig(use_rtt_metric=args.use_rtt_metric),
+        solver_backend=args.solver_backend,
+    )
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    config = build_config(args)
+    log = logging.getLogger("openr_tpu.main")
+    log.info("starting openr-tpu node %s", config.node_name)
+
+    from openr_tpu.config_store.persistent_store import PersistentStore
+
+    config_store = PersistentStore(config.persistent_store_path)
+    io_provider = UdpIoProvider(port=args.spark_port)
+    area = config.areas[0].area_id
+    node = OpenrNode(
+        config.node_name,
+        io_provider,
+        fib_agent=None,  # MockFibAgent unless netlink handler enabled
+        area=area,
+        spark_config=dict(
+            hello_interval_s=config.spark.hello_time_s,
+            fast_hello_interval_s=config.spark.fastinit_hello_time_ms / 1000,
+            handshake_interval_s=config.spark.handshake_time_ms / 1000,
+            heartbeat_interval_s=config.spark.keepalive_time_s,
+            hold_time_s=config.spark.hold_time_s,
+            graceful_restart_time_s=config.spark.graceful_restart_time_s,
+        ),
+        use_rtt_metric=config.link_monitor.use_rtt_metric,
+        config_store=config_store,
+        solver_backend=config.solver_backend,
+        debounce_min_s=config.decision.debounce_min_ms / 1000,
+        debounce_max_s=config.decision.debounce_max_ms / 1000,
+    )
+    node.ctrl_handler._config = config
+
+    watchdog = None
+    if config.enable_watchdog:
+        watchdog = Watchdog(
+            interval_s=config.watchdog.interval_s,
+            thread_timeout_s=config.watchdog.thread_timeout_s,
+            max_memory_bytes=config.watchdog.max_memory_mb * 1024 * 1024,
+        )
+        for name, evb in (
+            ("kvstore", node.kvstore.evb),
+            ("decision", node.decision.evb),
+            ("fib", node.fib.evb),
+            ("spark", node.spark.evb),
+            ("linkmonitor", node.link_monitor.evb),
+            ("prefixmgr", node.prefix_manager.evb),
+        ):
+            watchdog.add_evb(name, evb)
+
+    node.start()
+    if watchdog is not None:
+        watchdog.start()
+    port = node.start_ctrl_server(port=config.openr_ctrl_port)
+    log.info("ctrl server listening on port %d", port)
+
+    for if_name in [i for i in args.ifaces.split(",") if i]:
+        node.add_interface(if_name)
+        log.info("tracking interface %s", if_name)
+
+    stop_event = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop_event.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    stop_event.wait()
+
+    if watchdog is not None:
+        watchdog.stop()
+    node.stop()
+    config_store.stop()
+    log.info("shutdown complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Config layer tests (reference analogue: openr/config/tests)."""
+
+import json
+
+import pytest
+
+from openr_tpu.config.config import (
+    AreaConfig,
+    ConfigError,
+    OpenrConfig,
+    SparkConfig,
+)
+from openr_tpu.types.lsdb import PrefixForwardingAlgorithm, PrefixForwardingType
+
+
+class TestValidation:
+    def test_minimal_valid(self):
+        cfg = OpenrConfig(node_name="node-1")
+        assert cfg.area_ids() == ["0"]
+
+    def test_node_name_required(self):
+        with pytest.raises(ConfigError):
+            OpenrConfig(node_name="")
+
+    def test_node_name_charset(self):
+        with pytest.raises(ConfigError):
+            OpenrConfig(node_name="bad name")
+        with pytest.raises(ConfigError):
+            OpenrConfig(node_name="bad:name")
+
+    def test_duplicate_areas_rejected(self):
+        with pytest.raises(ConfigError):
+            OpenrConfig(
+                node_name="n",
+                areas=[AreaConfig(area_id="a"), AreaConfig(area_id="a")],
+            )
+
+    def test_spark_hold_time_validation(self):
+        with pytest.raises(ConfigError):
+            OpenrConfig(
+                node_name="n",
+                spark=SparkConfig(keepalive_time_s=5.0, hold_time_s=10.0),
+            )
+
+    def test_ksp2_requires_sr_mpls(self):
+        with pytest.raises(ConfigError):
+            OpenrConfig(
+                node_name="n",
+                prefix_forwarding_algorithm=(
+                    PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                ),
+                prefix_forwarding_type=PrefixForwardingType.IP,
+            )
+        # valid combination passes
+        OpenrConfig(
+            node_name="n",
+            prefix_forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            prefix_forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+
+
+class TestSerialization:
+    def test_json_roundtrip(self, tmp_path):
+        cfg = OpenrConfig(
+            node_name="fc001",
+            areas=[
+                AreaConfig(
+                    area_id="spine",
+                    neighbor_regexes=["ssw.*"],
+                    include_interface_regexes=["eth.*"],
+                )
+            ],
+            enable_v4=True,
+            prefix_forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        path = tmp_path / "cfg.json"
+        path.write_text(json.dumps(cfg.to_dict()))
+        loaded = OpenrConfig.from_file(str(path))
+        assert loaded.node_name == "fc001"
+        assert loaded.enable_v4
+        assert loaded.areas[0].area_id == "spine"
+        assert loaded.prefix_forwarding_type == PrefixForwardingType.SR_MPLS
+
+    def test_area_matching(self):
+        cfg = OpenrConfig(
+            node_name="n",
+            areas=[
+                AreaConfig(area_id="spine", neighbor_regexes=["ssw-.*"]),
+                AreaConfig(area_id="pod", neighbor_regexes=["rsw-.*"]),
+            ],
+        )
+        assert cfg.area_for_neighbor("ssw-1-2") == "spine"
+        assert cfg.area_for_neighbor("rsw-0-1") == "pod"
+        assert cfg.area_for_neighbor("other") is None
+
+    def test_interface_matching(self):
+        area = AreaConfig(
+            include_interface_regexes=["eth[0-9]+"],
+            exclude_interface_regexes=["eth99"],
+        )
+        assert area.matches_interface("eth0")
+        assert not area.matches_interface("eth99")
+        assert not area.matches_interface("lo")
+
+
+def test_main_flag_config_builds():
+    from openr_tpu.main import build_config, parse_args
+
+    args = parse_args(
+        ["--node-name", "fc001", "--areas", "0,1", "--enable-v4"]
+    )
+    cfg = build_config(args)
+    assert cfg.node_name == "fc001"
+    assert cfg.area_ids() == ["0", "1"]
+    assert cfg.enable_v4
