@@ -1,0 +1,126 @@
+"""PrefixAllocator: plug-and-play per-node prefix assignment.
+
+Behavioral parity with the reference ``openr/allocators/PrefixAllocator``
+(PrefixAllocator.h:35): elects a unique sub-prefix index out of a seed
+prefix via RangeAllocator consensus over the KvStore, advertises the
+elected prefix through the PrefixManager, programs the address on the
+loopback via netlink, and persists the allocation so restarts re-claim
+the same index. Static mode assigns from a configured node->prefix map.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from openr_tpu.allocators.range_allocator import RangeAllocator
+from openr_tpu.types import BinaryAddress, IpPrefix, PrefixEntry, PrefixType
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+ALLOC_PREFIX_MARKER = "allocprefix:"  # reference: Constants kPrefixAllocMarker
+PERSIST_KEY = "prefix-allocator-index"
+
+
+def sub_prefix(seed: IpPrefix, alloc_len: int, index: int) -> IpPrefix:
+    """Carve the index-th /alloc_len prefix out of the seed prefix."""
+    assert alloc_len >= seed.prefix_length
+    addr_bits = len(seed.prefix_address.addr) * 8
+    base = int.from_bytes(seed.prefix_address.addr, "big")
+    offset = index << (addr_bits - alloc_len)
+    return IpPrefix(
+        prefix_address=BinaryAddress(
+            addr=(base | offset).to_bytes(addr_bits // 8, "big")
+        ),
+        prefix_length=alloc_len,
+    )
+
+
+class PrefixAllocator:
+    def __init__(
+        self,
+        my_node_name: str,
+        evb: OpenrEventBase,
+        kvstore_client,
+        prefix_manager,
+        seed_prefix: Optional[IpPrefix] = None,
+        alloc_prefix_len: int = 64,
+        static_prefixes: Optional[Dict[str, IpPrefix]] = None,
+        netlink=None,
+        loopback_if: str = "lo",
+        config_store=None,
+        area: str = "0",
+        on_allocated: Optional[Callable[[Optional[IpPrefix]], None]] = None,
+    ):
+        self._node = my_node_name
+        self._evb = evb
+        self._prefix_manager = prefix_manager
+        self._netlink = netlink
+        self._loopback_if = loopback_if
+        self._config_store = config_store
+        self._on_allocated = on_allocated
+        self.allocated_prefix: Optional[IpPrefix] = None
+        self._range_allocator: Optional[RangeAllocator] = None
+
+        if static_prefixes is not None:
+            # static mode: allocation comes straight from config
+            prefix = static_prefixes.get(my_node_name)
+            if prefix is not None:
+                self._evb.run_in_event_base(lambda: self._apply(prefix))
+            return
+
+        assert seed_prefix is not None
+        self._seed = seed_prefix
+        self._alloc_len = alloc_prefix_len
+        count = 1 << (alloc_prefix_len - seed_prefix.prefix_length)
+        init_index = None
+        if config_store is not None:
+            init_index = config_store.load(PERSIST_KEY)
+            if init_index is not None and not (0 <= init_index < count):
+                init_index = None
+        self._range_allocator = RangeAllocator(
+            evb,
+            kvstore_client,
+            my_node_name,
+            ALLOC_PREFIX_MARKER,
+            (0, count - 1),
+            self._on_index,
+            area=area,
+        )
+        self._range_allocator.start_allocator(init_value=init_index)
+
+    def stop(self) -> None:
+        if self._range_allocator is not None:
+            self._range_allocator.stop()
+
+    # -- internals --------------------------------------------------------
+
+    def _on_index(self, index: Optional[int]) -> None:
+        if index is None:
+            self._withdraw()
+            return
+        if self._config_store is not None:
+            self._config_store.store(PERSIST_KEY, index)
+        self._apply(sub_prefix(self._seed, self._alloc_len, index))
+
+    def _apply(self, prefix: IpPrefix) -> None:
+        self.allocated_prefix = prefix
+        self._prefix_manager.advertise_prefixes(
+            [
+                PrefixEntry(
+                    prefix=prefix, type=PrefixType.PREFIX_ALLOCATOR
+                )
+            ]
+        )
+        if self._netlink is not None:
+            try:
+                self._netlink.add_ifaddress(self._loopback_if, prefix)
+            except Exception:
+                pass
+        if self._on_allocated is not None:
+            self._on_allocated(prefix)
+
+    def _withdraw(self) -> None:
+        if self.allocated_prefix is not None:
+            self._prefix_manager.withdraw_prefixes([self.allocated_prefix])
+            self.allocated_prefix = None
+        if self._on_allocated is not None:
+            self._on_allocated(None)

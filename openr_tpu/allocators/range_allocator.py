@@ -1,0 +1,171 @@
+"""RangeAllocator: distributed value election over the KvStore.
+
+Behavioral parity with the reference ``openr/allocators/RangeAllocator``
+(RangeAllocator.h:29): a node claims a value in [start, end] by
+advertising ``<key_prefix><value> -> <node_name>``; the KvStore merge
+ordering (version, then originatorId) is the consensus arbiter — two
+same-version claims resolve deterministically to the higher node name,
+and the loser detects the loss and proposes a different value with
+backoff. Initial proposal is a deterministic hash of the node name so
+disjoint nodes usually avoid collisions outright.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from typing import Callable, Optional, Tuple
+
+from openr_tpu.types import Value
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+class RangeAllocator:
+    def __init__(
+        self,
+        evb: OpenrEventBase,
+        kvstore_client,
+        my_node_name: str,
+        key_prefix: str,
+        allocator_range: Tuple[int, int],
+        callback: Callable[[Optional[int]], None],
+        area: str = "0",
+        retry_interval_s: float = 0.05,
+        override_owner: bool = False,
+        rand_seed: Optional[int] = None,
+    ):
+        self._evb = evb
+        self._client = kvstore_client
+        self._node = my_node_name
+        self._key_prefix = key_prefix
+        self._start, self._end = allocator_range
+        assert self._end >= self._start
+        self._callback = callback
+        self._area = area
+        self._retry_interval = retry_interval_s
+        self._override_owner = override_owner
+        self._rng = random.Random(
+            rand_seed if rand_seed is not None else my_node_name
+        )
+        self._my_value: Optional[int] = None
+        self._allocated = False
+        self._stopped = False
+        self._client.subscribe_key_filter(self._on_publication)
+
+    # -- public -----------------------------------------------------------
+
+    def start_allocator(self, init_value: Optional[int] = None) -> None:
+        """reference: RangeAllocator.h:69 startAllocator."""
+        value = (
+            init_value
+            if init_value is not None
+            and self._start <= init_value <= self._end
+            else self._initial_proposal()
+        )
+        self._evb.run_immediately_or_in_event_base(
+            lambda: self._try_claim(value)
+        )
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def get_value(self) -> Optional[int]:
+        return self._my_value if self._allocated else None
+
+    def is_range_consumed(self) -> bool:
+        """reference: RangeAllocator.h:90 isRangeConsumed."""
+        owned = self._client.dump_all_with_prefix(self._area, self._key_prefix)
+        return len(owned) >= (self._end - self._start + 1)
+
+    # -- internals --------------------------------------------------------
+
+    def _key_for(self, value: int) -> str:
+        return f"{self._key_prefix}{value}"
+
+    def _initial_proposal(self) -> int:
+        size = self._end - self._start + 1
+        digest = int.from_bytes(
+            hashlib.sha256(self._node.encode()).digest()[:8], "big"
+        )
+        return self._start + digest % size
+
+    def _try_claim(self, value: int) -> None:
+        if self._stopped:
+            return
+        existing = self._client.get_key(self._area, self._key_for(value))
+        foreign = (
+            existing is not None
+            and existing.value is not None
+            and existing.value != self._node.encode()
+        )
+        if foreign and not self._override_owner:
+            self._try_next(value)
+            return
+        self._my_value = value
+        self._allocated = False
+        # claim at the SAME version as a foreign owner: the merge ordering
+        # breaks the tie by originator id, deterministically, on every
+        # store in the network. Fresh keys start at version 1.
+        version = existing.version if foreign else (
+            1 if existing is None else existing.version
+        )
+        self._client.set_key(
+            self._area,
+            self._key_for(value),
+            self._node.encode(),
+            version=version,
+        )
+        self._evb.schedule_timeout(
+            self._retry_interval, lambda: self._verify_claim(value)
+        )
+
+    def _verify_claim(self, value: int) -> None:
+        if self._stopped or self._my_value != value:
+            return
+        stored = self._client.get_key(self._area, self._key_for(value))
+        if (
+            stored is not None
+            and stored.value == self._node.encode()
+            and stored.originator_id == self._node
+        ):
+            if not self._allocated:
+                self._allocated = True
+                self._callback(value)
+        else:
+            self._my_value = None
+            self._try_next(value)
+
+    def _try_next(self, failed_value: int) -> None:
+        if self._stopped:
+            return
+        size = self._end - self._start + 1
+        step = 1 + self._rng.randrange(max(1, size // 8))
+        nxt = self._start + (failed_value - self._start + step) % size
+        self._evb.schedule_timeout(
+            self._retry_interval, lambda: self._try_claim(nxt)
+        )
+
+    def _on_publication(self, area: str, key: str, value: Optional[Value]):
+        if (
+            self._stopped
+            or area != self._area
+            or self._my_value is None
+            or key != self._key_for(self._my_value)
+        ):
+            return
+        if value is None or value.value is None:
+            # our claim expired: re-claim the same value
+            claimed = self._my_value
+            self._evb.run_immediately_or_in_event_base(
+                lambda: self._try_claim(claimed)
+            )
+            return
+        if value.value != self._node.encode():
+            # a higher-precedence claim took our value: move on
+            lost = self._my_value
+            self._my_value = None
+            was_allocated = self._allocated
+            self._allocated = False
+            if was_allocated:
+                self._callback(None)
+            self._try_next(lost)

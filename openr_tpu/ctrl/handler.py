@@ -161,6 +161,50 @@ class OpenrCtrlHandler:
     def get_decision_adjacency_dbs(self):
         return self._decision.get_adj_dbs()
 
+    def set_rib_policy(
+        self, statements: List[Dict], ttl_secs: float = 300.0
+    ) -> None:
+        """reference: OpenrCtrl.thrift setRibPolicy."""
+        from openr_tpu.decision.rib_policy import (
+            RibPolicy,
+            RibPolicyStatement,
+            RibRouteAction,
+            RibRouteActionWeight,
+        )
+
+        parsed = [
+            RibPolicyStatement(
+                name=s.get("name", ""),
+                prefixes=tuple(
+                    IpPrefix.from_str(p) for p in s.get("prefixes", [])
+                ),
+                action=RibRouteAction(
+                    set_weight=RibRouteActionWeight(
+                        default_weight=s.get("default_weight", 0),
+                        area_to_weight=s.get("area_to_weight", {}),
+                        neighbor_to_weight=s.get("neighbor_to_weight", {}),
+                    )
+                ),
+            )
+            for s in statements
+        ]
+        self._decision.set_rib_policy(RibPolicy(parsed, ttl_secs=ttl_secs))
+
+    def get_rib_policy(self):
+        policy = self._decision.get_rib_policy()
+        if policy is None:
+            return None
+        return {
+            "ttl_remaining_s": policy.get_ttl_remaining_s(),
+            "statements": [
+                {
+                    "name": s.name,
+                    "prefixes": [p.to_str() for p in s.prefixes],
+                }
+                for s in policy.statements
+            ],
+        }
+
     def get_decision_prefix_dbs(self):
         return self._decision.evb.call_and_wait(
             lambda: dict(self._decision.prefix_state.prefixes())

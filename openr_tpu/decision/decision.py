@@ -411,7 +411,7 @@ class Decision:
                 )
                 or DecisionRouteDb()
             )
-            if self.rib_policy is not None:
+            if self.rib_policy is not None and self.rib_policy.is_active():
                 self.rib_policy.apply_policy(new_db.unicast_routes)
             update = self.route_db.calculate_update(new_db)
         else:
@@ -426,11 +426,11 @@ class Decision:
                     update.unicast_routes_to_update[prefix] = entry
                 else:
                     update.unicast_routes_to_delete.append(prefix)
-            if self.rib_policy is not None:
-                deleted = self.rib_policy.apply_policy(
+            if self.rib_policy is not None and self.rib_policy.is_active():
+                change = self.rib_policy.apply_policy(
                     update.unicast_routes_to_update
                 )
-                update.unicast_routes_to_delete.extend(deleted)
+                update.unicast_routes_to_delete.extend(change.deleted_routes)
 
         self.route_db.update(update)
         self.pending.add_event("ROUTE_UPDATE")
@@ -471,13 +471,26 @@ class Decision:
         )
 
     def set_rib_policy(self, policy) -> None:
-        self.evb.call_and_wait(lambda: setattr(self, "rib_policy", policy))
-        self.evb.run_in_event_base(
-            lambda: (
-                self.pending.set_needs_full_rebuild(),
-                self._rebuild_debounced(),
-            )
-        )
+        """Install a TTL'd policy; a rebuild is scheduled at expiry so its
+        effects revert (reference: Decision.cpp:1600 setRibPolicy +
+        ribPolicyTimer_)."""
+
+        def install() -> None:
+            self.rib_policy = policy
+            self.pending.set_needs_full_rebuild()
+            self._rebuild_debounced()
+            if policy is not None:
+                self.evb.schedule_timeout(
+                    policy.get_ttl_remaining_s() + 0.001,
+                    self._on_rib_policy_expiry,
+                )
+
+        self.evb.call_and_wait(install)
+
+    def _on_rib_policy_expiry(self) -> None:
+        if self.rib_policy is not None and not self.rib_policy.is_active():
+            self.pending.set_needs_full_rebuild()
+            self._rebuild_debounced()
 
     def get_rib_policy(self):
         return self.evb.call_and_wait(lambda: self.rib_policy)

@@ -1,0 +1,289 @@
+"""RangeAllocator / PrefixAllocator / RibPolicy tests (reference
+analogues: openr/allocators/tests, openr/decision/tests/RibPolicyTest)."""
+
+import time
+
+import pytest
+
+from openr_tpu.allocators.prefix_allocator import (
+    PrefixAllocator,
+    sub_prefix,
+)
+from openr_tpu.allocators.range_allocator import RangeAllocator
+from openr_tpu.decision.rib import RibUnicastEntry
+from openr_tpu.decision.rib_policy import (
+    RibPolicy,
+    RibPolicyStatement,
+    RibRouteAction,
+    RibRouteActionWeight,
+)
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+from openr_tpu.types import BinaryAddress, IpPrefix, NextHop
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+def wait_until(pred, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class AllocatorNet:
+    """Full-mesh KvStore network with a client+evb per node."""
+
+    def __init__(self, names):
+        self.stores = {}
+        self.evbs = {}
+        self.clients = {}
+        for name in names:
+            w = KvStoreWrapper(name)
+            w.start()
+            self.stores[name] = w
+            evb = OpenrEventBase(f"alloc:{name}")
+            evb.run_in_thread()
+            self.evbs[name] = evb
+            self.clients[name] = KvStoreClient(evb, name, w.store)
+        names = list(names)
+        for i, a in enumerate(names):
+            for b in names[i + 1 :]:
+                link_bidirectional(self.stores[a], self.stores[b])
+
+    def stop(self):
+        for evb in self.evbs.values():
+            evb.stop()
+            evb.join()
+        for w in self.stores.values():
+            w.stop()
+
+
+class TestRangeAllocator:
+    def test_unique_values_across_nodes(self):
+        names = [f"node-{i}" for i in range(4)]
+        net = AllocatorNet(names)
+        try:
+            allocations = {}
+            allocators = {}
+            for name in names:
+                allocators[name] = RangeAllocator(
+                    net.evbs[name],
+                    net.clients[name],
+                    name,
+                    "alloc-test:",
+                    (0, 15),
+                    lambda v, name=name: allocations.__setitem__(name, v),
+                )
+                allocators[name].start_allocator()
+            assert wait_until(
+                lambda: len(allocations) == 4
+                and all(v is not None for v in allocations.values())
+            ), allocations
+            # all elected values are unique
+            assert len(set(allocations.values())) == 4
+            # stable over time (no thrash)
+            snapshot = dict(allocations)
+            time.sleep(0.5)
+            assert allocations == snapshot
+        finally:
+            net.stop()
+
+    def test_collision_resolution(self):
+        # force both nodes to propose the same initial value
+        names = ["node-a", "node-b"]
+        net = AllocatorNet(names)
+        try:
+            allocations = {}
+            for name in names:
+                RangeAllocator(
+                    net.evbs[name],
+                    net.clients[name],
+                    name,
+                    "collide:",
+                    (0, 7),
+                    lambda v, name=name: allocations.__setitem__(name, v),
+                ).start_allocator(init_value=3)
+            assert wait_until(
+                lambda: len(allocations) == 2
+                and None not in allocations.values()
+                and allocations["node-a"] != allocations["node-b"]
+            ), allocations
+            # exactly one of them keeps the contested value (which one
+            # depends on claim arrival order; ties break by originator)
+            assert 3 in allocations.values()
+        finally:
+            net.stop()
+
+
+class TestPrefixAllocator:
+    def test_sub_prefix_carving(self):
+        seed = IpPrefix.from_str("fd00::/48")
+        p0 = sub_prefix(seed, 64, 0)
+        p5 = sub_prefix(seed, 64, 5)
+        assert p0.to_str() == "fd00::/64"
+        assert p5.to_str() == "fd00:0:0:5::/64"
+
+    def test_unique_prefixes_elected(self):
+        names = ["node-a", "node-b", "node-c"]
+        net = AllocatorNet(names)
+
+        class FakePrefixManager:
+            def __init__(self):
+                self.advertised = []
+
+            def advertise_prefixes(self, entries):
+                self.advertised.extend(e.prefix for e in entries)
+
+            def withdraw_prefixes(self, prefixes):
+                for p in prefixes:
+                    self.advertised.remove(p)
+
+        try:
+            seed = IpPrefix.from_str("fd00::/60")
+            managers = {n: FakePrefixManager() for n in names}
+            allocators = []
+            for name in names:
+                allocators.append(
+                    PrefixAllocator(
+                        name,
+                        net.evbs[name],
+                        net.clients[name],
+                        managers[name],
+                        seed_prefix=seed,
+                        alloc_prefix_len=64,
+                    )
+                )
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None for a in allocators
+                )
+            )
+            prefixes = {a.allocated_prefix for a in allocators}
+            assert len(prefixes) == 3  # unique
+            for p in prefixes:
+                assert p.prefix_length == 64
+            for name in names:
+                assert len(managers[name].advertised) == 1
+        finally:
+            for a in allocators:
+                a.stop()
+            net.stop()
+
+    def test_static_mode(self):
+        evb = OpenrEventBase("static-alloc")
+        evb.run_in_thread()
+
+        class FakePrefixManager:
+            advertised = []
+
+            def advertise_prefixes(self, entries):
+                self.advertised.extend(e.prefix for e in entries)
+
+        try:
+            target = IpPrefix.from_str("fd00:9::/64")
+            alloc = PrefixAllocator(
+                "node-x",
+                evb,
+                None,
+                FakePrefixManager(),
+                static_prefixes={"node-x": target},
+            )
+            assert wait_until(lambda: alloc.allocated_prefix == target)
+        finally:
+            evb.stop()
+            evb.join()
+
+
+def _route(prefix_str, *nhs):
+    return RibUnicastEntry(
+        prefix=IpPrefix.from_str(prefix_str), nexthops=set(nhs)
+    )
+
+
+def _nh(addr, neighbor=None, area="0"):
+    return NextHop(
+        address=BinaryAddress.from_str(addr),
+        neighbor_node_name=neighbor,
+        area=area,
+    )
+
+
+class TestRibPolicy:
+    def test_weight_by_neighbor(self):
+        policy = RibPolicy(
+            [
+                RibPolicyStatement(
+                    name="s1",
+                    prefixes=(IpPrefix.from_str("fd00::/64"),),
+                    action=RibRouteAction(
+                        set_weight=RibRouteActionWeight(
+                            default_weight=1,
+                            neighbor_to_weight={"b": 10, "c": 0},
+                        )
+                    ),
+                )
+            ],
+            ttl_secs=60,
+        )
+        routes = {
+            IpPrefix.from_str("fd00::/64"): _route(
+                "fd00::/64",
+                _nh("fe80::1", "b"),
+                _nh("fe80::2", "c"),
+                _nh("fe80::3", "d"),
+            ),
+            IpPrefix.from_str("fd01::/64"): _route(
+                "fd01::/64", _nh("fe80::1", "b")
+            ),
+        }
+        change = policy.apply_policy(routes)
+        assert change.updated_routes == [IpPrefix.from_str("fd00::/64")]
+        transformed = routes[IpPrefix.from_str("fd00::/64")]
+        by_nbr = {nh.neighbor_node_name: nh for nh in transformed.nexthops}
+        assert set(by_nbr) == {"b", "d"}  # c dropped (weight 0)
+        assert by_nbr["b"].weight == 10
+        assert by_nbr["d"].weight == 1  # default
+        # unmatched route untouched
+        (nh,) = routes[IpPrefix.from_str("fd01::/64")].nexthops
+        assert nh.weight == 0
+
+    def test_all_nexthops_dropped_deletes_route(self):
+        prefix = IpPrefix.from_str("fd00::/64")
+        policy = RibPolicy(
+            [
+                RibPolicyStatement(
+                    prefixes=(prefix,),
+                    action=RibRouteAction(
+                        set_weight=RibRouteActionWeight(default_weight=0)
+                    ),
+                )
+            ],
+            ttl_secs=60,
+        )
+        routes = {prefix: _route("fd00::/64", _nh("fe80::1", "b"))}
+        change = policy.apply_policy(routes)
+        assert change.deleted_routes == [prefix]
+        assert prefix not in routes
+
+    def test_expired_policy_inert(self):
+        prefix = IpPrefix.from_str("fd00::/64")
+        policy = RibPolicy(
+            [
+                RibPolicyStatement(
+                    prefixes=(prefix,),
+                    action=RibRouteAction(
+                        set_weight=RibRouteActionWeight(default_weight=5)
+                    ),
+                )
+            ],
+            ttl_secs=0.05,
+        )
+        time.sleep(0.1)
+        assert not policy.is_active()
+        routes = {prefix: _route("fd00::/64", _nh("fe80::1", "b"))}
+        change = policy.apply_policy(routes)
+        assert not change.updated_routes
+        (nh,) = routes[prefix].nexthops
+        assert nh.weight == 0
