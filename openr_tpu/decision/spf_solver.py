@@ -413,7 +413,7 @@ class SpfSolver:
         if prefix.is_v4 and not self.enable_v4:
             return None
 
-        has_bgp = has_non_bgp = False
+        has_bgp = has_non_bgp = missing_mv = False
         has_self_prepend_label = True
         for node_area, entry in entries.items():
             is_bgp = entry.type == PrefixType.BGP
@@ -421,11 +421,16 @@ class SpfSolver:
             has_non_bgp |= not is_bgp
             if node_area[0] == my_node_name:
                 has_self_prepend_label &= entry.prepend_label is not None
-        if has_bgp and has_non_bgp and not self.enable_best_route_selection:
-            return None
+            if is_bgp and entry.mv is None:
+                missing_mv = True
+        if has_bgp:
+            if has_non_bgp and not self.enable_best_route_selection:
+                return None
+            if missing_mv:
+                return None  # a BGP advertiser without its metric vector
 
         best = self._select_best_routes(
-            my_node_name, entries, area_link_states
+            my_node_name, entries, has_bgp, area_link_states
         )
         if not best.success:
             return None
@@ -469,6 +474,7 @@ class SpfSolver:
         self,
         my_node_name: str,
         entries: PrefixEntries,
+        is_bgp: bool,
         area_link_states: AreaLinkStates,
     ) -> BestRouteSelectionResult:
         """reference: Decision.cpp:737 selectBestRoutes."""
@@ -480,10 +486,52 @@ class SpfSolver:
                     ret.all_node_areas, my_node_name
                 )
             ret.success = True
+        elif is_bgp:
+            return self._run_best_path_selection_bgp(
+                my_node_name, entries, area_link_states
+            )
         else:
             ret.all_node_areas = set(entries)
             ret.best_node_area = min(ret.all_node_areas)
             ret.success = True
+        return self._maybe_filter_drained_nodes(ret, area_link_states)
+
+    def _run_best_path_selection_bgp(
+        self,
+        my_node_name: str,
+        entries: PrefixEntries,
+        area_link_states: AreaLinkStates,
+    ) -> BestRouteSelectionResult:
+        """MetricVector-ordered BGP best-path selection.
+        reference: Decision.cpp:807 runBestPathSelectionBgp."""
+        from openr_tpu.decision.metric_vector import (
+            CompareResult,
+            compare_metric_vectors,
+        )
+
+        ret = BestRouteSelectionResult()
+        best_vector = None
+        for node_area in sorted(entries):
+            entry = entries[node_area]
+            result = (
+                CompareResult.WINNER
+                if best_vector is None
+                else compare_metric_vectors(entry.mv, best_vector)
+            )
+            if result in (CompareResult.TIE, CompareResult.ERROR):
+                return ret  # ambiguous ordering: no route (success=False)
+            if result == CompareResult.WINNER:
+                ret.all_node_areas.clear()
+            if result in (CompareResult.WINNER, CompareResult.TIE_WINNER):
+                best_vector = entry.mv
+                ret.best_node_area = node_area
+            if result in (
+                CompareResult.WINNER,
+                CompareResult.TIE_WINNER,
+                CompareResult.TIE_LOOSER,
+            ):
+                ret.all_node_areas.add(node_area)
+        ret.success = True
         return self._maybe_filter_drained_nodes(ret, area_link_states)
 
     def _maybe_filter_drained_nodes(

@@ -26,6 +26,45 @@ class PrefixForwardingAlgorithm(enum.IntEnum):
     KSP2_ED_ECMP = 1
 
 
+class CompareType(enum.IntEnum):
+    """How a metric entity present in only one vector compares.
+    reference: openr/if/Lsdb.thrift:165-173 CompareType."""
+
+    WIN_IF_PRESENT = 1
+    WIN_IF_NOT_PRESENT = 2
+    IGNORE_IF_NOT_PRESENT = 3
+
+
+@dataclass(frozen=True)
+class MetricEntity:
+    """reference: openr/if/Lsdb.thrift:175-195 MetricEntity."""
+
+    type: int
+    priority: int
+    op: CompareType = CompareType.WIN_IF_PRESENT
+    is_best_path_tie_breaker: bool = False
+    metric: Tuple[int, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.metric, tuple):
+            object.__setattr__(self, "metric", tuple(self.metric))
+
+
+@dataclass(frozen=True)
+class MetricVector:
+    """reference: openr/if/Lsdb.thrift:197-206 MetricVector."""
+
+    version: int = 1
+    metrics: Tuple[MetricEntity, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not isinstance(self.metrics, tuple):
+            object.__setattr__(self, "metrics", tuple(self.metrics))
+
+    def sorted_metrics(self):
+        return sorted(self.metrics, key=lambda e: -e.priority)
+
+
 @dataclass(frozen=True)
 class PerfEvent:
     """reference: openr/if/Lsdb.thrift:24-28"""
@@ -121,6 +160,7 @@ class PrefixEntry:
     )
     min_nexthop: Optional[int] = None
     prepend_label: Optional[int] = None
+    mv: Optional[MetricVector] = None  # deprecated BGP metric vector
     metrics: PrefixMetrics = field(default_factory=PrefixMetrics)
     tags: Tuple[str, ...] = ()
     area_stack: Tuple[str, ...] = ()

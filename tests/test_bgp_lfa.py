@@ -1,0 +1,179 @@
+"""BGP MetricVector selection and LFA path computation tests."""
+
+import pytest
+
+from openr_tpu.decision.metric_vector import (
+    CompareResult,
+    CompareType,
+    MetricEntity,
+    MetricVector,
+    compare_metric_vectors,
+)
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.models import topologies
+from openr_tpu.types import IpPrefix, PrefixDatabase, PrefixEntry, PrefixType
+
+from tests.test_spf_solver import nh_neighbors, setup_network
+
+
+def mv(*entities):
+    return MetricVector(metrics=tuple(entities))
+
+
+def ent(type_, priority, metric, tie=False, op=CompareType.WIN_IF_PRESENT):
+    return MetricEntity(
+        type=type_,
+        priority=priority,
+        op=op,
+        is_best_path_tie_breaker=tie,
+        metric=tuple(metric),
+    )
+
+
+class TestMetricVectorCompare:
+    def test_higher_metric_wins(self):
+        l = mv(ent(1, 100, [10]))
+        r = mv(ent(1, 100, [5]))
+        assert compare_metric_vectors(l, r) == CompareResult.WINNER
+        assert compare_metric_vectors(r, l) == CompareResult.LOOSER
+
+    def test_tie(self):
+        l = mv(ent(1, 100, [7]))
+        assert compare_metric_vectors(l, l) == CompareResult.TIE
+
+    def test_version_mismatch_error(self):
+        l = MetricVector(version=1, metrics=(ent(1, 100, [1]),))
+        r = MetricVector(version=2, metrics=(ent(1, 100, [1]),))
+        assert compare_metric_vectors(l, r) == CompareResult.ERROR
+
+    def test_priority_ordering_decides_first(self):
+        l = mv(ent(1, 200, [1]), ent(2, 100, [99]))
+        r = mv(ent(1, 200, [2]), ent(2, 100, [0]))
+        # higher-priority entity (type 1) decides: r wins
+        assert compare_metric_vectors(l, r) == CompareResult.LOOSER
+
+    def test_loner_win_if_present(self):
+        l = mv(ent(1, 200, [1]), ent(2, 100, [1]))
+        r = mv(ent(2, 100, [1]))
+        assert compare_metric_vectors(l, r) == CompareResult.WINNER
+
+    def test_loner_ignore_if_not_present(self):
+        l = mv(
+            ent(1, 200, [1], op=CompareType.IGNORE_IF_NOT_PRESENT),
+            ent(2, 100, [5]),
+        )
+        r = mv(ent(2, 100, [9]))
+        assert compare_metric_vectors(l, r) == CompareResult.LOOSER
+
+    def test_tie_breaker_only_decides_without_decisive(self):
+        l = mv(ent(1, 200, [5], tie=True), ent(2, 100, [1]))
+        r = mv(ent(1, 200, [1], tie=True), ent(2, 100, [9]))
+        # type 1 is a tie-breaker: TIE_WINNER provisionally; type 2 is
+        # decisive and r wins it -> overall LOOSER
+        assert compare_metric_vectors(l, r) == CompareResult.LOOSER
+        # without the decisive entity, the tie-breaker stands
+        l2 = mv(ent(1, 200, [5], tie=True))
+        r2 = mv(ent(1, 200, [1], tie=True))
+        assert compare_metric_vectors(l2, r2) == CompareResult.TIE_WINNER
+
+    def test_mismatched_lengths_error(self):
+        l = mv(ent(1, 100, [1, 2]))
+        r = mv(ent(1, 100, [1]))
+        assert compare_metric_vectors(l, r) == CompareResult.ERROR
+
+
+class TestBgpSelection:
+    def _network_with_bgp(self, mv_b, mv_c):
+        topo = topologies.build_topology(
+            "tri", [("a", "b", 1), ("a", "c", 1)]
+        )
+        anycast = IpPrefix.from_str("fd00:b9b::/64")
+        pdbs = dict(topo.prefix_dbs)
+        for node, vector in (("b", mv_b), ("c", mv_c)):
+            pdbs[node] = PrefixDatabase(
+                this_node_name=node,
+                prefix_entries=pdbs[node].prefix_entries
+                + (
+                    PrefixEntry(
+                        prefix=anycast, type=PrefixType.BGP, mv=vector
+                    ),
+                ),
+                area=topo.area,
+            )
+        area_ls, prefix_state = setup_network(topo, prefix_dbs=pdbs)
+        return anycast, area_ls, prefix_state
+
+    def test_bgp_winner_selected(self):
+        anycast, area_ls, prefix_state = self._network_with_bgp(
+            mv(ent(1, 100, [10])), mv(ent(1, 100, [20]))
+        )
+        solver = SpfSolver("a", enable_best_route_selection=False)
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        assert nh_neighbors(db.unicast_routes[anycast]) == {"c"}
+
+    def test_bgp_tie_winner_multipath(self):
+        anycast, area_ls, prefix_state = self._network_with_bgp(
+            mv(ent(1, 100, [5], tie=True)), mv(ent(1, 100, [5], tie=True))
+        )
+        solver = SpfSolver("a", enable_best_route_selection=False)
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        # full tie is ambiguous: no route (reference skips it)
+        assert anycast not in db.unicast_routes
+
+    def test_bgp_missing_mv_skipped(self):
+        anycast, area_ls, prefix_state = self._network_with_bgp(
+            mv(ent(1, 100, [10])), None
+        )
+        solver = SpfSolver("a", enable_best_route_selection=False)
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        assert anycast not in db.unicast_routes
+
+    def test_bgp_dry_run_marks_do_not_install(self):
+        anycast, area_ls, prefix_state = self._network_with_bgp(
+            mv(ent(1, 100, [10])), mv(ent(1, 100, [5]))
+        )
+        solver = SpfSolver(
+            "a", enable_best_route_selection=False, bgp_dry_run=True
+        )
+        db = solver.build_route_db("a", area_ls, prefix_state)
+        assert db.unicast_routes[anycast].do_not_install
+
+
+class TestLfa:
+    def test_lfa_adds_loop_free_alternates(self):
+        # triangle: a-b (1), a-c (1), b-c (1); route to b's prefix from a.
+        # primary: direct a->b. LFA candidate c: dist(c,b)=1 <
+        # dist(a,b)+dist(c,a)=2 -> c qualifies (RFC 5286 condition).
+        topo = topologies.build_topology(
+            "tri", [("a", "b", 1), ("a", "c", 1), ("b", "c", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        b_pfx = topo.prefix_dbs["b"].prefix_entries[0].prefix
+
+        no_lfa = SpfSolver("a", compute_lfa_paths=False).build_route_db(
+            "a", area_ls, prefix_state
+        )
+        assert nh_neighbors(no_lfa.unicast_routes[b_pfx]) == {"b"}
+
+        with_lfa = SpfSolver("a", compute_lfa_paths=True).build_route_db(
+            "a", area_ls, prefix_state
+        )
+        r = with_lfa.unicast_routes[b_pfx]
+        assert nh_neighbors(r) == {"b", "c"}
+        by_nbr = {nh.neighbor_node_name: nh for nh in r.nexthops}
+        assert by_nbr["b"].metric == 1  # shortest
+        assert by_nbr["c"].metric == 2  # alternate: a->c->b
+
+    def test_lfa_excludes_looping_neighbor(self):
+        # line a-b-dest plus stub a-c where c's only path to dest goes
+        # back through a: c must NOT be an LFA.
+        topo = topologies.build_topology(
+            "y", [("a", "b", 1), ("b", "dest", 1), ("a", "c", 1)]
+        )
+        area_ls, prefix_state = setup_network(topo)
+        dest_pfx = topo.prefix_dbs["dest"].prefix_entries[0].prefix
+        with_lfa = SpfSolver("a", compute_lfa_paths=True).build_route_db(
+            "a", area_ls, prefix_state
+        )
+        assert nh_neighbors(with_lfa.unicast_routes[dest_pfx]) == {"b"}
