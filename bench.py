@@ -30,10 +30,12 @@ def main() -> None:
     import jax.numpy as jnp
 
     from openr_tpu.graph.linkstate import LinkState
-    from openr_tpu.graph.snapshot import compile_snapshot
+    from openr_tpu.graph.snapshot import SnapshotCache
     from openr_tpu.models import topologies
     from openr_tpu.ops import spf as spf_ops
     from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    snapshots = SnapshotCache()
 
     topo = topologies.fat_tree_nodes(1000)
     ls = LinkState(area=topo.area)
@@ -72,13 +74,11 @@ def main() -> None:
         )
 
     def reconverge():
-        snap = compile_snapshot(ls)
+        snap = snapshots.get(ls)  # incremental patch on steady-state churn
         sid = snap.node_index[my_node]
+        metric_dev, hop_dev, overloaded_dev = snap.device_arrays()
         d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
-            jnp.asarray(snap.metric),
-            jnp.asarray(snap.hop),
-            jnp.asarray(snap.overloaded),
-            jnp.int32(sid),
+            metric_dev, hop_dev, overloaded_dev, jnp.int32(sid)
         )
         jax.block_until_ready((d_src, d_all, fh))
         return snap, d_all

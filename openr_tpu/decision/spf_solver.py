@@ -158,11 +158,9 @@ class SpfView:
             self._d_all = None
             self._fh = None
             return
+        metric_dev, hop_dev, overloaded_dev = self._snap.device_arrays()
         d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
-            jnp.asarray(self._snap.metric),
-            jnp.asarray(self._snap.hop),
-            jnp.asarray(self._snap.overloaded),
-            jnp.int32(sid),
+            metric_dev, hop_dev, overloaded_dev, jnp.int32(sid)
         )
         self._d_all = np.asarray(d_all)
         self._fh = np.asarray(fh)

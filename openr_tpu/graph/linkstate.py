@@ -333,6 +333,12 @@ class LinkState:
         # layer keys HBM-resident arrays off this (replaces the reference's
         # SPF memo invalidation for the device path)
         self.topology_version = 0
+        # journal of (version, affected nodes) per topology change so the
+        # snapshot layer can patch only touched rows instead of a full
+        # rebuild; bounded ring — evicted history forces a full recompile
+        from collections import deque
+
+        self.change_journal = deque(maxlen=4096)
 
     # -- introspection ----------------------------------------------------
 
@@ -373,10 +379,29 @@ class LinkState:
 
     # -- mutation ---------------------------------------------------------
 
-    def _invalidate(self) -> None:
+    def _invalidate(self, affected: Optional[Set[str]] = None) -> None:
         self._spf_cache.clear()
         self._kth_path_cache.clear()
         self.topology_version += 1
+        self.change_journal.append(
+            (self.topology_version, frozenset(affected or ()))
+        )
+
+    def affected_since(self, version: int) -> Optional[Set[str]]:
+        """Union of nodes touched by all changes after ``version``; None if
+        the journal can't prove coverage (forces a full recompile)."""
+        if version == self.topology_version:
+            return set()
+        if not self.change_journal or self.change_journal[0][0] > version + 1:
+            return None  # history evicted: coverage unknown
+        affected: Set[str] = set()
+        for v, nodes in self.change_journal:
+            if v <= version:
+                continue
+            if not nodes:
+                return None  # a change with unrecorded blast radius
+            affected |= nodes
+        return affected
 
     def _maybe_make_link(self, node: str, adj: Adjacency) -> Optional[Link]:
         """Create a Link only if the reverse adjacency is also advertised
@@ -459,6 +484,10 @@ class LinkState:
             prior_db is None and adj_db.node_label != 0
         ) or (prior_db is not None and prior_db.node_label != adj_db.node_label)
 
+        affected = {node}
+        affected.update(l.other_node(node) for l in old_links)
+        affected.update(l.other_node(node) for l in new_links)
+
         oi, ni = 0, 0
         while ni < len(new_links) or oi < len(old_links):
             if ni < len(new_links) and (
@@ -501,16 +530,20 @@ class LinkState:
             oi += 1
 
         if change.topology_changed:
-            self._invalidate()
+            self._invalidate(affected)
         return change
 
     def delete_adjacency_database(self, node: str) -> LinkStateChange:
         """reference: LinkState.cpp:722 deleteAdjacencyDatabase"""
         change = LinkStateChange()
         if node in self._adj_dbs:
+            affected = {node}
+            affected.update(
+                l.other_node(node) for l in self._link_map.get(node, ())
+            )
             self._remove_node(node)
             del self._adj_dbs[node]
-            self._invalidate()
+            self._invalidate(affected)
             change.topology_changed = True
         return change
 
@@ -518,12 +551,18 @@ class LinkState:
         """One ordered-FIB tick: age all holds; expiry is a topology change.
         reference: LinkState.cpp:501 decrementHolds."""
         change = LinkStateChange()
+        affected: Set[str] = set()
         for link in self._all_links:
-            change.topology_changed |= link.decrement_holds()
-        for hv in self._node_overloads.values():
-            change.topology_changed |= hv.decrement_ttl()
+            if link.decrement_holds():
+                change.topology_changed = True
+                affected.add(link.n1)
+                affected.add(link.n2)
+        for node, hv in self._node_overloads.items():
+            if hv.decrement_ttl():
+                change.topology_changed = True
+                affected.add(node)
         if change.topology_changed:
-            self._invalidate()
+            self._invalidate(affected)
         return change
 
     # -- shortest paths (host oracle / fallback) --------------------------

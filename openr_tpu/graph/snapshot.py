@@ -1,29 +1,35 @@
-"""LinkState -> device-array snapshot compiler.
+"""LinkState -> device-array snapshot compiler, with incremental patching.
 
-The TPU compute path never walks the host object graph. Instead, each
-topology version of a ``LinkState`` is *compiled* once into dense arrays:
+The TPU compute path never walks the host object graph. Each topology
+version of a ``LinkState`` is *compiled* into dense arrays:
 
 - node-name interning: sorted names -> dense ids (stable for a given node
   set, so unchanged topologies reuse the resident snapshot)
 - ``metric[N, N]`` int32 directed min-metric matrix (INF where no up link;
   min over parallel links per direction)
 - ``overloaded[N]`` node transit-exclusion mask
-- directed-link metadata (iface, addrs, labels) kept host-side for
-  next-hop materialization
+- per-source-node directed-link metadata for next-hop materialization
 
 This replaces the reference's per-(source, useLinkMetric) SPF memo cache
-(reference: openr/decision/LinkState.cpp:794-803): the memo key here is
+(reference: openr/decision/LinkState.cpp:794-803): the memo key is
 ``LinkState.topology_version`` and the cached artifact is the HBM-resident
-metric matrix, against which any batch of sources can be solved.
+metric matrix, against which any batch of sources is solved.
 
-Padding: N is padded up to the next multiple of 128 (TPU lane width) so
-recompilation only happens when the node count crosses a bucket boundary,
-not on every node join/leave.
+Incremental path: LinkState journals the affected nodes of every topology
+change. When the node set is unchanged, a new snapshot is produced by
+*patching* only the affected rows — and the device copy is updated with a
+row-scatter instead of re-uploading the whole matrix, so the steady-state
+churn cost is O(changed rows), not O(N^2). The hop-count matrix is derived
+from the metric matrix on device.
+
+Padding: N is padded to the next multiple of 128 (TPU lane width) so
+recompilation only happens when the node count crosses a bucket boundary.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import functools
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -36,6 +42,9 @@ from openr_tpu.graph.linkstate import Link, LinkState
 INF = np.int32((1 << 30) - 1)
 
 _PAD = 128
+# row-patch bucket sizes (jit specializes per bucket; ids are padded by
+# repeating the first row, which is an idempotent scatter)
+_PATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
 
 
 def _padded(n: int) -> int:
@@ -44,8 +53,7 @@ def _padded(n: int) -> int:
 
 @dataclass
 class DirectedLink:
-    """Host-side metadata for one direction of one up link; indexed
-    parallel to the snapshot's directed-link arrays."""
+    """Host-side metadata for one direction of one up link."""
 
     link: Link
     src: str
@@ -62,20 +70,121 @@ class GraphSnapshot:
     node_names: List[str]  # index == dense node id
     node_index: Dict[str, int]
     n: int  # real node count
-    n_pad: int  # padded node count (metric matrix dimension)
+    n_pad: int  # padded node count (matrix dimension)
     metric: np.ndarray  # [n_pad, n_pad] int32, INF where no edge
-    hop: np.ndarray  # [n_pad, n_pad] int32, 1 where edge, INF elsewhere
     overloaded: np.ndarray  # [n_pad] bool
-    directed_links: List[DirectedLink]
-    # per node id: indices into directed_links of links leaving that node
-    links_from: List[List[int]]
+    # per node id: directed links leaving that node
+    links_from: List[List[DirectedLink]]
+    _hop: Optional[np.ndarray] = None
+    _dev: Optional[tuple] = None
+    _parent: Optional["GraphSnapshot"] = None
+    _changed_rows: Optional[np.ndarray] = None
 
     def id_of(self, node: str) -> Optional[int]:
         return self.node_index.get(node)
 
+    @property
+    def hop(self) -> np.ndarray:
+        """Hop-count (unweighted) matrix, derived lazily."""
+        if self._hop is None:
+            self._hop = np.where(
+                self.metric < INF, np.int32(1), INF
+            ).astype(np.int32)
+        return self._hop
+
+    def device_arrays(self):
+        """(metric, hop, overloaded) as device arrays. Patched snapshots
+        update their parent's resident arrays with a row scatter."""
+        if self._dev is not None:
+            return self._dev
+        import jax.numpy as jnp
+
+        parent = self._parent
+        rows = self._changed_rows
+        if (
+            parent is not None
+            and parent._dev is not None
+            and rows is not None
+            and len(rows) <= _PATCH_BUCKETS[-1]
+        ):
+            p_metric, _, _ = parent._dev
+            bucket = next(b for b in _PATCH_BUCKETS if b >= max(1, len(rows)))
+            padded_rows = np.full(bucket, rows[0] if len(rows) else 0,
+                                  dtype=np.int32)
+            padded_rows[: len(rows)] = rows
+            metric_dev = _patch_rows(
+                p_metric,
+                jnp.asarray(padded_rows),
+                jnp.asarray(self.metric[padded_rows, :]),
+            )
+            overloaded_dev = jnp.asarray(self.overloaded)
+        else:
+            metric_dev = jnp.asarray(self.metric)
+            overloaded_dev = jnp.asarray(self.overloaded)
+        hop_dev = _derive_hop(metric_dev)
+        self._dev = (metric_dev, hop_dev, overloaded_dev)
+        # release the parent chain: resident arrays now belong to us
+        self._parent = None
+        return self._dev
+
+
+def _patch_rows(metric_dev, row_ids, row_vals):
+    import jax
+
+    @jax.jit
+    def patch(m, ids, vals):
+        return m.at[ids, :].set(vals)
+
+    return patch(metric_dev, row_ids, row_vals)
+
+
+@functools.lru_cache(maxsize=1)
+def _hop_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def derive(m):
+        return jnp.where(m < INF, jnp.int32(1), INF)
+
+    return derive
+
+
+def _derive_hop(metric_dev):
+    return _hop_fn()(metric_dev)
+
+
+def _build_node_row(
+    ls: LinkState,
+    name: str,
+    index: Dict[str, int],
+    metric: np.ndarray,
+) -> List[DirectedLink]:
+    """Fill row index[name] of the metric matrix and return the node's
+    directed-link metadata."""
+    i = index[name]
+    metric[i, :] = INF
+    out: List[DirectedLink] = []
+    for link in ls.ordered_links_from_node(name):
+        if not link.is_up():
+            continue
+        dst = link.other_node(name)
+        j = index.get(dst)
+        if j is None:
+            continue
+        m = min(int(link.metric_from(name)), int(INF) - 1)
+        out.append(
+            DirectedLink(
+                link=link, src=name, dst=dst, src_id=i, dst_id=j, metric=m
+            )
+        )
+        if m < metric[i, j]:
+            metric[i, j] = m
+    return out
+
 
 def compile_snapshot(ls: LinkState) -> GraphSnapshot:
-    """Compile the current LinkState topology into a GraphSnapshot."""
+    """Full compile of the current LinkState topology."""
     names = sorted(ls.get_adjacency_databases().keys())
     index = {name: i for i, name in enumerate(names)}
     n = len(names)
@@ -83,32 +192,13 @@ def compile_snapshot(ls: LinkState) -> GraphSnapshot:
 
     metric = np.full((n_pad, n_pad), INF, dtype=np.int32)
     overloaded = np.zeros((n_pad,), dtype=bool)
-    directed: List[DirectedLink] = []
-    links_from: List[List[int]] = [[] for _ in range(n)]
+    links_from: List[List[DirectedLink]] = [[] for _ in range(n)]
 
     for name in names:
         i = index[name]
         overloaded[i] = ls.is_node_overloaded(name)
-        for link in ls.ordered_links_from_node(name):
-            if not link.is_up():
-                continue
-            j = index[link.other_node(name)]
-            m = min(int(link.metric_from(name)), int(INF) - 1)
-            links_from[i].append(len(directed))
-            directed.append(
-                DirectedLink(
-                    link=link,
-                    src=name,
-                    dst=link.other_node(name),
-                    src_id=i,
-                    dst_id=j,
-                    metric=m,
-                )
-            )
-            if m < metric[i, j]:
-                metric[i, j] = m
+        links_from[i] = _build_node_row(ls, name, index, metric)
 
-    hop = np.where(metric < INF, np.int32(1), INF).astype(np.int32)
     return GraphSnapshot(
         area=ls.area,
         version=ls.topology_version,
@@ -117,16 +207,46 @@ def compile_snapshot(ls: LinkState) -> GraphSnapshot:
         n=n,
         n_pad=n_pad,
         metric=metric,
-        hop=hop,
         overloaded=overloaded,
-        directed_links=directed,
         links_from=links_from,
     )
 
 
+def patch_snapshot(
+    prev: GraphSnapshot, ls: LinkState, affected: List[str]
+) -> GraphSnapshot:
+    """Produce a new snapshot by re-deriving only the affected rows.
+    Caller guarantees the node set is unchanged."""
+    metric = prev.metric.copy()
+    overloaded = prev.overloaded.copy()
+    links_from = list(prev.links_from)
+    rows = []
+    for name in affected:
+        i = prev.node_index.get(name)
+        if i is None:
+            continue
+        rows.append(i)
+        overloaded[i] = ls.is_node_overloaded(name)
+        links_from[i] = _build_node_row(ls, name, prev.node_index, metric)
+    return GraphSnapshot(
+        area=ls.area,
+        version=ls.topology_version,
+        node_names=prev.node_names,
+        node_index=prev.node_index,
+        n=prev.n,
+        n_pad=prev.n_pad,
+        metric=metric,
+        overloaded=overloaded,
+        links_from=links_from,
+        _parent=prev,
+        _changed_rows=np.asarray(sorted(rows), dtype=np.int32),
+    )
+
+
 class SnapshotCache:
-    """Versioned snapshot cache keyed by LinkState *identity* (weakly held)
-    so distinct graphs never alias, plus topology_version for staleness."""
+    """Versioned snapshot cache keyed by LinkState *identity* (weakly
+    held); patches incrementally when the change journal covers the gap
+    and the node set is unchanged."""
 
     def __init__(self) -> None:
         import weakref
@@ -137,10 +257,27 @@ class SnapshotCache:
 
     def get(self, ls: LinkState) -> GraphSnapshot:
         snap = self._cache.get(ls)
-        if snap is None or snap.version != ls.topology_version:
-            snap = compile_snapshot(ls)
-            self._cache[ls] = snap
+        if snap is not None and snap.version == ls.topology_version:
+            return snap
+        snap = self._compile_or_patch(ls, snap)
+        self._cache[ls] = snap
         return snap
+
+    def _compile_or_patch(
+        self, ls: LinkState, prev: Optional[GraphSnapshot]
+    ) -> GraphSnapshot:
+        if prev is not None:
+            affected = ls.affected_since(prev.version)
+            if (
+                affected is not None
+                and len(affected) <= max(8, prev.n // 4)
+                and len(ls.get_adjacency_databases()) == prev.n
+                and all(name in prev.node_index for name in affected)
+            ):
+                # same node set guaranteed: count matches and every
+                # touched node is known
+                return patch_snapshot(prev, ls, sorted(affected))
+        return compile_snapshot(ls)
 
     def invalidate(self) -> None:
         self._cache.clear()

@@ -1,0 +1,151 @@
+"""Incremental snapshot patching: patched == freshly compiled, always."""
+
+import numpy as np
+import pytest
+
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.graph.snapshot import SnapshotCache, compile_snapshot
+from openr_tpu.models import topologies
+from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+
+def remetric(db, other, metric):
+    adjs = tuple(
+        Adjacency(
+            other_node_name=a.other_node_name,
+            if_name=a.if_name,
+            metric=metric if a.other_node_name == other else a.metric,
+            next_hop_v6=a.next_hop_v6,
+            next_hop_v4=a.next_hop_v4,
+            other_if_name=a.other_if_name,
+            adj_label=a.adj_label,
+        )
+        for a in db.adjacencies
+    )
+    return AdjacencyDatabase(
+        this_node_name=db.this_node_name,
+        is_overloaded=db.is_overloaded,
+        adjacencies=adjs,
+        node_label=db.node_label,
+        area=db.area,
+    )
+
+
+def assert_same(snap_a, snap_b):
+    assert snap_a.node_names == snap_b.node_names
+    np.testing.assert_array_equal(snap_a.metric, snap_b.metric)
+    np.testing.assert_array_equal(snap_a.overloaded, snap_b.overloaded)
+    for la, lb in zip(snap_a.links_from, snap_b.links_from):
+        assert [(d.src, d.dst, d.metric) for d in la] == [
+            (d.src, d.dst, d.metric) for d in lb
+        ]
+
+
+class TestIncrementalSnapshot:
+    def test_metric_churn_patches(self):
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        cache = SnapshotCache()
+        snap0 = cache.get(ls)
+        for step in range(8):
+            db = ls.get_adjacency_databases()["fsw-0-0"]
+            ls.update_adjacency_database(
+                remetric(db, db.adjacencies[step % len(db.adjacencies)].other_node_name, 2 + step)
+            )
+            patched = cache.get(ls)
+            assert patched.version == ls.topology_version
+            assert patched._parent is not None or patched is not snap0
+            assert_same(patched, compile_snapshot(ls))
+
+    def test_overload_patch(self):
+        topo = topologies.grid(4)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        cache = SnapshotCache()
+        cache.get(ls)
+        db = ls.get_adjacency_databases()["node-5"]
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-5",
+                is_overloaded=True,
+                adjacencies=db.adjacencies,
+                node_label=db.node_label,
+                area=db.area,
+            )
+        )
+        patched = cache.get(ls)
+        assert_same(patched, compile_snapshot(ls))
+        assert patched.overloaded[patched.node_index["node-5"]]
+
+    def test_link_removal_patches_both_rows(self):
+        topo = topologies.grid(3)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        cache = SnapshotCache()
+        cache.get(ls)
+        # withdraw all of node-4's adjacencies (its links vanish both ways)
+        db = ls.get_adjacency_databases()["node-4"]
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-4",
+                adjacencies=(),
+                node_label=db.node_label,
+                area=db.area,
+            )
+        )
+        patched = cache.get(ls)
+        assert_same(patched, compile_snapshot(ls))
+        i4 = patched.node_index["node-4"]
+        assert (patched.metric[i4, : patched.n] >= (1 << 30) - 1).all()
+        assert (patched.metric[: patched.n, i4] >= (1 << 30) - 1).all()
+
+    def test_node_join_forces_full_compile(self):
+        topo = topologies.grid(3)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        cache = SnapshotCache()
+        snap0 = cache.get(ls)
+        # brand-new node joins (changes the interning)
+        from tests.test_linkstate import adj, db as mk_db
+
+        ls.update_adjacency_database(
+            mk_db("zz-new", [adj("node-0", "if_z_0", "if_0_z")])
+        )
+        ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name="node-0",
+                adjacencies=ls.get_adjacency_databases()["node-0"].adjacencies
+                + (adj("zz-new", "if_0_z", "if_z_0"),),
+                node_label=topo.adj_dbs["node-0"].node_label,
+                area=topo.area,
+            )
+        )
+        snap1 = cache.get(ls)
+        assert snap1._parent is None  # full compile
+        assert "zz-new" in snap1.node_index
+        assert_same(snap1, compile_snapshot(ls))
+
+    def test_device_arrays_patch_matches_full_upload(self):
+        topo = topologies.grid(4)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        cache = SnapshotCache()
+        snap0 = cache.get(ls)
+        snap0.device_arrays()  # make resident
+        db = ls.get_adjacency_databases()["node-0"]
+        ls.update_adjacency_database(
+            remetric(db, db.adjacencies[0].other_node_name, 9)
+        )
+        patched = cache.get(ls)
+        m_dev, h_dev, ov_dev = patched.device_arrays()
+        np.testing.assert_array_equal(np.asarray(m_dev), patched.metric)
+        np.testing.assert_array_equal(np.asarray(h_dev), patched.hop)
+        np.testing.assert_array_equal(np.asarray(ov_dev), patched.overloaded)
