@@ -1,0 +1,77 @@
+"""KvStore cross-process peer transport over wire-RPC.
+
+The analogue of the reference's thrift ``KvStoreService`` peer channel
+(and the legacy fbzmq ROUTER socket it dual-stacks with; reference:
+KvStore.cpp:2940-2973): exposes ``getKvStoreKeyValsFiltered`` and
+``setKvStoreKeyVals`` for remote stores, so daemons on different hosts
+flood and full-sync over TCP (default port 60002,
+reference: Constants.h:257).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from openr_tpu.kvstore.store import KvStore, PeerTransport
+from openr_tpu.types import KeyDumpParams, KeySetParams, Publication
+from openr_tpu.utils.rpc import RpcClient, RpcServer
+
+KVSTORE_RPC_PORT = 60002
+
+
+class KvStorePeerServer:
+    """Expose a KvStore to remote peers."""
+
+    def __init__(self, kvstore: KvStore, host: str = "::", port: int = 0):
+        self._kvstore = kvstore
+        # bind on IPv4 loopback-compatible any-host for portability
+        self._server = RpcServer(host=host if host != "::" else "0.0.0.0",
+                                 port=port)
+        self._server.register(
+            "getKvStoreKeyValsFiltered",
+            self._get_filtered,
+            arg_types=[str, KeyDumpParams],
+            result_type=Publication,
+        )
+        self._server.register(
+            "setKvStoreKeyVals",
+            self._set_key_vals,
+            arg_types=[str, KeySetParams],
+            result_type=type(None),
+        )
+        self.port = self._server.port
+
+    def _get_filtered(self, area: str, params: KeyDumpParams) -> Publication:
+        return self._kvstore.dump_with_filters(area, params)
+
+    def _set_key_vals(self, area: str, params: KeySetParams) -> None:
+        self._kvstore.set_key_vals(
+            area, params, sender_id=params.originator_id
+        )
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+class TcpPeerTransport(PeerTransport):
+    """Dial a remote KvStorePeerServer (the thrift peer-client analogue,
+    reference: KvStore.cpp:1400 requestThriftPeerSync client path)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._client = RpcClient(host, port, timeout_s=timeout_s)
+
+    def get_key_vals_filtered(
+        self, area: str, params: KeyDumpParams
+    ) -> Publication:
+        return self._client.call(
+            "getKvStoreKeyValsFiltered", [area, params], Publication
+        )
+
+    def set_key_vals(self, area: str, params: KeySetParams) -> None:
+        self._client.call("setKvStoreKeyVals", [area, params], type(None))
+
+    def close(self) -> None:
+        self._client.close()

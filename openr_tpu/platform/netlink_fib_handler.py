@@ -1,0 +1,169 @@
+"""NetlinkFibHandler: the platform agent programming kernel routes.
+
+Behavioral parity with the reference ``openr/platform/NetlinkFibHandler``
+(implements thrift FibService against rtnetlink; started standalone via
+LinuxPlatformMain.cpp or in-process, reference: Main.cpp:343-361): keeps
+per-client route tables, programs them through a NetlinkProtocolSocket
+(mock in-memory kernel or real rtnetlink), and reports liveness.
+
+``FibAgentServer`` / ``TcpFibAgent`` expose/consume it over wire-RPC
+(default port 60100, reference: Constants.h:260) so Fib can talk to an
+out-of-process agent exactly like the reference's thrift boundary.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from openr_tpu.platform.fib_service import FibService
+from openr_tpu.platform.netlink import NetlinkProtocolSocket
+from openr_tpu.types import IpPrefix, MplsRoute, UnicastRoute
+from openr_tpu.utils.rpc import RpcClient, RpcServer
+
+FIB_AGENT_RPC_PORT = 60100
+
+
+class NetlinkFibHandler(FibService):
+    def __init__(self, netlink: NetlinkProtocolSocket):
+        self._nl = netlink
+        self._unicast: Dict[int, Dict[IpPrefix, UnicastRoute]] = {}
+        self._mpls: Dict[int, Dict[int, MplsRoute]] = {}
+        self._alive_since = int(time.time() * 1000)
+
+    # -- FibService -------------------------------------------------------
+
+    def add_unicast_routes(self, client_id, routes) -> None:
+        table = self._unicast.setdefault(client_id, {})
+        for route in routes:
+            self._nl.add_route(route)
+            table[route.dest] = route
+
+    def delete_unicast_routes(self, client_id, prefixes) -> None:
+        table = self._unicast.setdefault(client_id, {})
+        for prefix in prefixes:
+            self._nl.delete_route(prefix)
+            table.pop(prefix, None)
+
+    def add_mpls_routes(self, client_id, routes) -> None:
+        table = self._mpls.setdefault(client_id, {})
+        for route in routes:
+            table[route.top_label] = route
+
+    def delete_mpls_routes(self, client_id, labels) -> None:
+        table = self._mpls.setdefault(client_id, {})
+        for label in labels:
+            table.pop(label, None)
+
+    def sync_fib(self, client_id, routes) -> None:
+        """Full-state reconciliation: program adds/changes, remove strays
+        (reference: NetlinkFibHandler syncFib semantics)."""
+        desired = {r.dest: r for r in routes}
+        current = self._unicast.get(client_id, {})
+        for prefix in list(current):
+            if prefix not in desired:
+                self._nl.delete_route(prefix)
+        for route in desired.values():
+            self._nl.add_route(route)
+        self._unicast[client_id] = desired
+
+    def sync_mpls_fib(self, client_id, routes) -> None:
+        self._mpls[client_id] = {r.top_label: r for r in routes}
+
+    def get_route_table_by_client(self, client_id) -> List[UnicastRoute]:
+        return sorted(
+            self._unicast.get(client_id, {}).values(), key=lambda r: r.dest
+        )
+
+    def get_mpls_route_table_by_client(self, client_id) -> List[MplsRoute]:
+        return sorted(
+            self._mpls.get(client_id, {}).values(),
+            key=lambda r: r.top_label,
+        )
+
+    def alive_since(self) -> int:
+        return self._alive_since
+
+
+class FibAgentServer:
+    """Serve any FibService over wire-RPC (the standalone platform agent,
+    reference: LinuxPlatformMain.cpp)."""
+
+    def __init__(
+        self, handler: FibService, host: str = "0.0.0.0", port: int = 0
+    ):
+        self.handler = handler
+        self._server = RpcServer(host=host, port=port)
+        r = self._server.register
+        r("addUnicastRoutes", handler.add_unicast_routes,
+          [int, List[UnicastRoute]], type(None))
+        r("deleteUnicastRoutes", handler.delete_unicast_routes,
+          [int, List[IpPrefix]], type(None))
+        r("addMplsRoutes", handler.add_mpls_routes,
+          [int, List[MplsRoute]], type(None))
+        r("deleteMplsRoutes", handler.delete_mpls_routes,
+          [int, List[int]], type(None))
+        r("syncFib", handler.sync_fib, [int, List[UnicastRoute]], type(None))
+        r("syncMplsFib", handler.sync_mpls_fib,
+          [int, List[MplsRoute]], type(None))
+        r("getRouteTableByClient", handler.get_route_table_by_client,
+          [int], List[UnicastRoute])
+        r("getMplsRouteTableByClient",
+          handler.get_mpls_route_table_by_client, [int], List[MplsRoute])
+        r("aliveSince", handler.alive_since, [], int)
+        self.port = self._server.port
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+class TcpFibAgent(FibService):
+    """FibService client over wire-RPC (what Fib uses when the agent runs
+    out-of-process; reference: Fib.h:72 createFibClient)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._client = RpcClient(host, port, timeout_s=timeout_s)
+
+    def add_unicast_routes(self, client_id, routes) -> None:
+        self._client.call(
+            "addUnicastRoutes", [client_id, list(routes)], type(None)
+        )
+
+    def delete_unicast_routes(self, client_id, prefixes) -> None:
+        self._client.call(
+            "deleteUnicastRoutes", [client_id, list(prefixes)], type(None)
+        )
+
+    def add_mpls_routes(self, client_id, routes) -> None:
+        self._client.call(
+            "addMplsRoutes", [client_id, list(routes)], type(None)
+        )
+
+    def delete_mpls_routes(self, client_id, labels) -> None:
+        self._client.call(
+            "deleteMplsRoutes", [client_id, list(labels)], type(None)
+        )
+
+    def sync_fib(self, client_id, routes) -> None:
+        self._client.call("syncFib", [client_id, list(routes)], type(None))
+
+    def sync_mpls_fib(self, client_id, routes) -> None:
+        self._client.call(
+            "syncMplsFib", [client_id, list(routes)], type(None)
+        )
+
+    def get_route_table_by_client(self, client_id) -> List[UnicastRoute]:
+        return self._client.call(
+            "getRouteTableByClient", [client_id], List[UnicastRoute]
+        )
+
+    def get_mpls_route_table_by_client(self, client_id) -> List[MplsRoute]:
+        return self._client.call(
+            "getMplsRouteTableByClient", [client_id], List[MplsRoute]
+        )
+
+    def alive_since(self) -> int:
+        return self._client.call("aliveSince", [], int)

@@ -1,0 +1,175 @@
+"""Framed wire-codec RPC: the cross-process transport substrate.
+
+Plays the role fbthrift RPC plays in the reference (KvStoreService peer
+sync, FibService platform agent): a length-framed TCP protocol whose
+blobs are encoded with the canonical wire codec, so schema objects
+(Value, Publication, UnicastRoute, ...) travel losslessly between
+processes.
+
+Frame layout:
+  u32 total_len | u8 nblobs | ( u32 blob_len | blob_bytes ) * nblobs
+
+Request blobs:  [method_name_utf8, wire(arg0), wire(arg1), ...]
+Response blobs: [status_utf8 ("ok" | "err:<repr>"), wire(result)]
+
+Servers register methods with their argument/result schemas; decoding is
+schema-directed on both sides.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from openr_tpu.utils import wire
+
+
+def _pack_frame(blobs: Sequence[bytes]) -> bytes:
+    body = bytes([len(blobs)]) + b"".join(
+        struct.pack(">I", len(b)) + b for b in blobs
+    )
+    return struct.pack(">I", len(body)) + body
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Optional[List[bytes]]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (total,) = struct.unpack(">I", header)
+    body = _recv_exact(sock, total)
+    if body is None:
+        return None
+    nblobs = body[0]
+    blobs: List[bytes] = []
+    pos = 1
+    for _ in range(nblobs):
+        (blen,) = struct.unpack(">I", body[pos : pos + 4])
+        pos += 4
+        blobs.append(body[pos : pos + blen])
+        pos += blen
+    return blobs
+
+
+class RpcServer:
+    """Threaded TCP server dispatching registered wire-RPC methods."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._methods: Dict[str, Tuple[Callable, List[Any], Any]] = {}
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        blobs = _recv_frame(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if blobs is None:
+                        return
+                    outer._dispatch(self.request, blobs)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"rpc-server:{self.port}",
+            daemon=True,
+        )
+
+    def register(
+        self,
+        name: str,
+        fn: Callable,
+        arg_types: List[Any],
+        result_type: Any = None,
+    ) -> None:
+        self._methods[name] = (fn, arg_types, result_type)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def _dispatch(self, sock: socket.socket, blobs: List[bytes]) -> None:
+        try:
+            name = blobs[0].decode("utf-8")
+            entry = self._methods.get(name)
+            if entry is None:
+                raise KeyError(f"no rpc method {name!r}")
+            fn, arg_types, _ = entry
+            args = [
+                wire.loads(blob, tp)
+                for blob, tp in zip(blobs[1:], arg_types)
+            ]
+            result = fn(*args)
+            response = [b"ok", wire.dumps(result)]
+        except Exception as e:  # noqa: BLE001 - relayed to the caller
+            response = [f"err:{e!r}".encode("utf-8"), wire.dumps(None)]
+        try:
+            sock.sendall(_pack_frame(response))
+        except (ConnectionError, OSError):
+            pass
+
+
+class RpcClient:
+    """Blocking wire-RPC client with per-call mutex (one in-flight call
+    per connection, like a thrift channel)."""
+
+    def __init__(
+        self, host: str, port: int, timeout_s: float = 10.0
+    ):
+        self._addr = (host, port)
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout
+            )
+        return self._sock
+
+    def call(self, name: str, args: Sequence[Any], result_type: Any = None):
+        blobs = [name.encode("utf-8")] + [wire.dumps(a) for a in args]
+        with self._lock:
+            try:
+                sock = self._connect()
+                sock.sendall(_pack_frame(blobs))
+                response = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self.close()
+                raise
+            if response is None:
+                self.close()
+                raise ConnectionError("rpc: server closed connection")
+        status = response[0].decode("utf-8")
+        if status != "ok":
+            raise RuntimeError(f"rpc {name}: {status[4:]}")
+        return wire.loads(response[1], result_type)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

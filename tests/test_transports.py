@@ -1,0 +1,135 @@
+"""Cross-process transport tests: KvStore peers over TCP, Fib agent over
+TCP backed by the (mock) netlink kernel."""
+
+import time
+
+import pytest
+
+from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+from openr_tpu.kvstore.transport import KvStorePeerServer, TcpPeerTransport
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.platform.netlink_fib_handler import (
+    FibAgentServer,
+    NetlinkFibHandler,
+    TcpFibAgent,
+)
+from openr_tpu.types import BinaryAddress, IpPrefix, KvStorePeerState, NextHop
+
+
+def wait_until(pred, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestKvStoreTcp:
+    def test_two_stores_over_tcp(self):
+        a, b = KvStoreWrapper("node-a"), KvStoreWrapper("node-b")
+        a.start()
+        b.start()
+        server_a = KvStorePeerServer(a.store, host="127.0.0.1")
+        server_b = KvStorePeerServer(b.store, host="127.0.0.1")
+        server_a.start()
+        server_b.start()
+        try:
+            a.set_key("pre", b"from-a")
+            # real TCP peering both ways
+            a.store.add_peer(
+                "0", "node-b", TcpPeerTransport("127.0.0.1", server_b.port)
+            )
+            b.store.add_peer(
+                "0", "node-a", TcpPeerTransport("127.0.0.1", server_a.port)
+            )
+            assert wait_until(lambda: b.get_key("pre") is not None)
+            assert b.get_key("pre").value == b"from-a"
+            # live flood over TCP
+            b.set_key("live", b"from-b")
+            assert wait_until(lambda: a.get_key("live") is not None)
+            assert (
+                a.peer_states()["node-b"] == KvStorePeerState.INITIALIZED
+            )
+        finally:
+            server_a.stop()
+            server_b.stop()
+            a.stop()
+            b.stop()
+
+    def test_tcp_peer_failure_backoff(self):
+        a = KvStoreWrapper("node-a")
+        a.start()
+        try:
+            # peer nobody is listening on
+            a.store.add_peer(
+                "0",
+                "ghost",
+                TcpPeerTransport("127.0.0.1", 1, timeout_s=0.2),
+            )
+            time.sleep(0.5)
+            assert a.peer_states()["ghost"] == KvStorePeerState.IDLE
+        finally:
+            a.stop()
+
+
+class TestFibAgentTcp:
+    def test_fib_programs_through_tcp_agent(self):
+        kernel = MockNetlinkProtocolSocket()
+        handler = NetlinkFibHandler(kernel)
+        server = FibAgentServer(handler, host="127.0.0.1")
+        server.start()
+        agent = TcpFibAgent("127.0.0.1", server.port)
+        route_q = ReplicateQueue()
+        fib = Fib("node-a", agent, route_q, keepalive_interval_s=0.2)
+        fib.start()
+        try:
+            update = DecisionRouteUpdate()
+            prefix = IpPrefix.from_str("fd00:77::/64")
+            update.unicast_routes_to_update[prefix] = RibUnicastEntry(
+                prefix=prefix,
+                nexthops={
+                    NextHop(
+                        address=BinaryAddress.from_str(
+                            "fe80::9", if_name="eth0"
+                        ),
+                        metric=4,
+                    )
+                },
+            )
+            route_q.push(update)
+            # route lands in the (mock) kernel through the TCP boundary
+            assert wait_until(
+                lambda: any(
+                    r.dest == prefix for r in kernel.get_all_routes()
+                )
+            )
+            # and the agent's table reflects it with full fidelity
+            (programmed,) = agent.get_route_table_by_client(OPENR_CLIENT_ID)
+            assert programmed.dest == prefix
+            (nh,) = programmed.next_hops
+            assert nh.address.if_name == "eth0"
+            assert nh.metric == 4
+        finally:
+            fib.stop()
+            server.stop()
+            kernel.events_queue.close()
+
+    def test_sync_fib_reconciles_strays(self):
+        kernel = MockNetlinkProtocolSocket()
+        handler = NetlinkFibHandler(kernel)
+        p1 = IpPrefix.from_str("fd00:1::/64")
+        p2 = IpPrefix.from_str("fd00:2::/64")
+        from openr_tpu.types import UnicastRoute
+
+        handler.add_unicast_routes(
+            OPENR_CLIENT_ID, [UnicastRoute(dest=p1), UnicastRoute(dest=p2)]
+        )
+        assert len(kernel.get_all_routes()) == 2
+        # sync with only p2: p1 must be withdrawn from the kernel
+        handler.sync_fib(OPENR_CLIENT_ID, [UnicastRoute(dest=p2)])
+        routes = kernel.get_all_routes()
+        assert [r.dest for r in routes] == [p2]
