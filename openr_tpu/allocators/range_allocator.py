@@ -161,7 +161,12 @@ class RangeAllocator:
             )
             return
         if value.value != self._node.encode():
-            # a higher-precedence claim took our value: move on
+            # a higher-precedence claim may have taken our value — but the
+            # publication can be stale (an interleaved losing claim that
+            # merged momentarily before ours). Confirm against the store.
+            stored = self._client.get_key(self._area, key)
+            if stored is not None and stored.value == self._node.encode():
+                return  # stale: we still own it
             lost = self._my_value
             self._my_value = None
             was_allocated = self._allocated
