@@ -1,0 +1,186 @@
+// spfcore: native all-sources shortest-path engine.
+//
+// The host-side (non-accelerator) compute core of openr-tpu: the role the
+// C++ SpfSolver/LinkState Dijkstra plays in the reference
+// (openr/decision/LinkState.cpp:809 runSpf), generalized to batched
+// sources. Used by the "native" solver backend and as the CPU baseline
+// the TPU kernels are benchmarked against.
+//
+// Semantics matched to the reference (and to openr_tpu.ops.spf):
+//  - directed min-metric CSR graph
+//  - overloaded nodes do not transit (source-exempt)
+//  - distances saturate at INF = 2^30 - 1
+//  - ECMP first-hop reconstruction is algebraic:
+//      v is a first hop of s toward j iff
+//        metric(s,v) + dist(v,j) == dist(s,j)      (v not overloaded)
+//        or v == j and metric(s,v) == dist(s,j)
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread spfcore.cpp -o libspfcore.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kInf = (1 << 30) - 1;
+
+struct Csr {
+  int32_t n;
+  std::vector<int32_t> offsets;  // n + 1
+  std::vector<int32_t> dsts;
+  std::vector<int32_t> weights;
+  const uint8_t* overloaded;
+};
+
+// Dijkstra from one source with overloaded-transit exclusion.
+// out: distance row of length n (pre-filled with kInf by caller).
+void dijkstra_one(const Csr& g, int32_t src, int32_t* out) {
+  using Item = std::pair<int64_t, int32_t>;  // (dist, node)
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> heap;
+  out[src] = 0;
+  heap.emplace(0, src);
+  while (!heap.empty()) {
+    auto [d, u] = heap.top();
+    heap.pop();
+    if (d > out[u]) {
+      continue;  // stale entry
+    }
+    if (g.overloaded[u] && u != src) {
+      continue;  // reachable, but never extends paths
+    }
+    for (int32_t e = g.offsets[u]; e < g.offsets[u + 1]; ++e) {
+      int32_t v = g.dsts[e];
+      int64_t nd = d + g.weights[e];
+      if (nd < out[v]) {
+        out[v] = static_cast<int32_t>(std::min<int64_t>(nd, kInf));
+        heap.emplace(nd, v);
+      }
+    }
+  }
+}
+
+void run_block(const Csr& g, const int32_t* sources, int32_t count,
+               int32_t* out) {
+  for (int32_t i = 0; i < count; ++i) {
+    int32_t* row = out + static_cast<int64_t>(i) * g.n;
+    std::fill(row, row + g.n, kInf);
+    dijkstra_one(g, sources[i], row);
+  }
+}
+
+Csr build_csr(int32_t n, int32_t n_edges, const int32_t* srcs,
+              const int32_t* dsts, const int32_t* weights,
+              const uint8_t* overloaded) {
+  Csr g;
+  g.n = n;
+  g.overloaded = overloaded;
+  g.offsets.assign(n + 1, 0);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    ++g.offsets[srcs[e] + 1];
+  }
+  for (int32_t i = 0; i < n; ++i) {
+    g.offsets[i + 1] += g.offsets[i];
+  }
+  g.dsts.resize(n_edges);
+  g.weights.resize(n_edges);
+  std::vector<int32_t> cursor(g.offsets.begin(), g.offsets.end() - 1);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    int32_t pos = cursor[srcs[e]]++;
+    g.dsts[pos] = dsts[e];
+    g.weights[pos] = weights[e];
+  }
+  return g;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Batched shortest paths from `n_sources` sources over a directed edge
+// list. out_dist must hold n_sources * n int32.
+void spf_from_sources(int32_t n, int32_t n_edges, const int32_t* edge_src,
+                      const int32_t* edge_dst, const int32_t* edge_weight,
+                      const uint8_t* overloaded, const int32_t* sources,
+                      int32_t n_sources, int32_t n_threads,
+                      int32_t* out_dist) {
+  Csr g = build_csr(n, n_edges, edge_src, edge_dst, edge_weight, overloaded);
+  if (n_threads <= 1 || n_sources <= 1) {
+    run_block(g, sources, n_sources, out_dist);
+    return;
+  }
+  int32_t threads = std::min<int32_t>(n_threads, n_sources);
+  std::vector<std::thread> pool;
+  int32_t per = (n_sources + threads - 1) / threads;
+  for (int32_t t = 0; t < threads; ++t) {
+    int32_t begin = t * per;
+    int32_t count = std::min(per, n_sources - begin);
+    if (count <= 0) {
+      break;
+    }
+    pool.emplace_back([&g, sources, begin, count, out_dist]() {
+      run_block(g, sources + begin,
+                count, out_dist + static_cast<int64_t>(begin) * g.n);
+    });
+  }
+  for (auto& th : pool) {
+    th.join();
+  }
+}
+
+// All-sources convenience: sources = 0..n-1.
+void spf_all_pairs(int32_t n, int32_t n_edges, const int32_t* edge_src,
+                   const int32_t* edge_dst, const int32_t* edge_weight,
+                   const uint8_t* overloaded, int32_t n_threads,
+                   int32_t* out_dist) {
+  std::vector<int32_t> sources(n);
+  for (int32_t i = 0; i < n; ++i) {
+    sources[i] = i;
+  }
+  spf_from_sources(n, n_edges, edge_src, edge_dst, edge_weight, overloaded,
+                   sources.data(), n, n_threads, out_dist);
+}
+
+// ECMP first-hop matrix for one source: out_mask[v * n + j] = 1 iff
+// neighbor v of `src` lies on an equal-cost shortest path to j.
+// dist_src: row of distances from src (length n); dist_all: n*n matrix
+// whose row v holds distances from v.
+void spf_first_hops(int32_t n, int32_t n_edges, const int32_t* edge_src,
+                    const int32_t* edge_dst, const int32_t* edge_weight,
+                    const uint8_t* overloaded, int32_t src,
+                    const int32_t* dist_src, const int32_t* dist_all,
+                    uint8_t* out_mask) {
+  std::memset(out_mask, 0, static_cast<size_t>(n) * n);
+  // min metric per neighbor of src
+  std::vector<int32_t> min_metric(n, kInf);
+  for (int32_t e = 0; e < n_edges; ++e) {
+    if (edge_src[e] == src) {
+      min_metric[edge_dst[e]] =
+          std::min(min_metric[edge_dst[e]], edge_weight[e]);
+    }
+  }
+  for (int32_t v = 0; v < n; ++v) {
+    if (min_metric[v] >= kInf || v == src) {
+      continue;
+    }
+    uint8_t* row = out_mask + static_cast<int64_t>(v) * n;
+    const int32_t* dv = dist_all + static_cast<int64_t>(v) * n;
+    if (!overloaded[v]) {
+      for (int32_t j = 0; j < n; ++j) {
+        if (dist_src[j] < kInf &&
+            min_metric[v] + static_cast<int64_t>(dv[j]) == dist_src[j]) {
+          row[j] = 1;
+        }
+      }
+    }
+    // directly-connected case (valid even for overloaded v)
+    if (min_metric[v] == dist_src[v]) {
+      row[v] = 1;
+    }
+  }
+}
+
+}  // extern "C"

@@ -138,9 +138,16 @@ class SpfView:
     def __init__(self, ls: LinkState, root: str, backend: str):
         self._ls = ls
         self._root = root
+        if backend == "native":
+            from openr_tpu.graph import native_spf
+
+            if not native_spf.is_available():
+                backend = "host"  # toolchain missing: degrade gracefully
         self._backend = backend
         if backend == "device":
             self._init_device()
+        elif backend == "native":
+            self._init_native()
         else:
             self._init_host()
 
@@ -165,6 +172,24 @@ class SpfView:
         self._d_all = np.asarray(d_all)
         self._fh = np.asarray(fh)
 
+    # -- native backend ---------------------------------------------------
+
+    def _init_native(self) -> None:
+        """Multithreaded C++ Dijkstra core (native/spfcore.cpp)."""
+        from openr_tpu.graph import native_spf
+
+        self._snap = _SNAPSHOTS.get(self._ls)
+        sid = self._snap.id_of(self._root)
+        self._sid = sid
+        if sid is None:
+            self._d_all = None
+            self._fh = None
+            return
+        self._d_all = native_spf.all_pairs_distances(self._snap)
+        self._fh = native_spf.first_hop_matrix(
+            self._snap, sid, self._d_all[sid], self._d_all
+        ).astype(bool)
+
     # -- host backend -----------------------------------------------------
 
     def _init_host(self) -> None:
@@ -173,7 +198,7 @@ class SpfView:
     # -- queries ----------------------------------------------------------
 
     def is_reachable(self, dst: str) -> bool:
-        if self._backend == "device":
+        if self._backend in ("device", "native"):
             if self._sid is None:
                 return dst == self._root
             did = self._snap.id_of(dst)
@@ -181,7 +206,7 @@ class SpfView:
         return dst in self._spf
 
     def metric_to(self, dst: str) -> Optional[Metric]:
-        if self._backend == "device":
+        if self._backend in ("device", "native"):
             if self._sid is None:
                 return 0 if dst == self._root else None
             did = self._snap.id_of(dst)
@@ -192,7 +217,7 @@ class SpfView:
         return res.metric if res is not None else None
 
     def next_hops_toward(self, dst: str) -> Set[str]:
-        if self._backend == "device":
+        if self._backend in ("device", "native"):
             if self._sid is None:
                 return set()
             did = self._snap.id_of(dst)
@@ -211,7 +236,7 @@ class SpfView:
         """Distance from an arbitrary node a to b (LFA computations)."""
         if a == b:
             return 0
-        if self._backend == "device":
+        if self._backend in ("device", "native"):
             if self._d_all is None:
                 return None
             aid, bid = self._snap.id_of(a), self._snap.id_of(b)

@@ -1,0 +1,152 @@
+"""ctypes bindings for the native SPF core (native/spfcore.cpp).
+
+Compiles the shared library on first use (g++ available in the target
+image); all callers gracefully fall back to the Python/JAX paths when the
+toolchain or library is unavailable (``is_available()``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "native", "spfcore.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libspfcore.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O3",
+                "-std=c++17",
+                "-shared",
+                "-fPIC",
+                "-pthread",
+                _SRC,
+                "-o",
+                _LIB,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not os.path.exists(_LIB) or os.path.getmtime(
+            _LIB
+        ) < os.path.getmtime(_SRC):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.spf_from_sources.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, u8p,
+            i32p, ctypes.c_int32, ctypes.c_int32, i32p,
+        ]
+        lib.spf_all_pairs.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, u8p,
+            ctypes.c_int32, i32p,
+        ]
+        lib.spf_first_hops.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, u8p,
+            ctypes.c_int32, i32p, i32p, u8p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    return _load() is not None
+
+
+def _as_i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _as_u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _edge_arrays(snap) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    srcs, dsts, weights = [], [], []
+    for links in snap.links_from:
+        for dl in links:
+            srcs.append(dl.src_id)
+            dsts.append(dl.dst_id)
+            weights.append(dl.metric)
+    return (
+        np.asarray(srcs, dtype=np.int32),
+        np.asarray(dsts, dtype=np.int32),
+        np.asarray(weights, dtype=np.int32),
+    )
+
+
+def all_pairs_distances(snap, n_threads: int = 0) -> Optional[np.ndarray]:
+    """All-sources distances over a GraphSnapshot via the native core.
+    Returns None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = snap.n
+    srcs, dsts, weights = _edge_arrays(snap)
+    overloaded = np.ascontiguousarray(
+        snap.overloaded[:n].astype(np.uint8)
+    )
+    out = np.empty((n, n), dtype=np.int32)
+    if n_threads <= 0:
+        n_threads = min(16, os.cpu_count() or 1)
+    lib.spf_all_pairs(
+        n, len(srcs), _as_i32p(srcs), _as_i32p(dsts), _as_i32p(weights),
+        _as_u8p(overloaded), n_threads, _as_i32p(out),
+    )
+    return out
+
+
+def first_hop_matrix(
+    snap, src_id: int, dist_src: np.ndarray, dist_all: np.ndarray
+) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    n = snap.n
+    srcs, dsts, weights = _edge_arrays(snap)
+    overloaded = np.ascontiguousarray(snap.overloaded[:n].astype(np.uint8))
+    dist_src = np.ascontiguousarray(dist_src[:n].astype(np.int32))
+    dist_all = np.ascontiguousarray(dist_all[:n, :n].astype(np.int32))
+    out = np.zeros((n, n), dtype=np.uint8)
+    lib.spf_first_hops(
+        n, len(srcs), _as_i32p(srcs), _as_i32p(dsts), _as_i32p(weights),
+        _as_u8p(overloaded), src_id, _as_i32p(dist_src), _as_i32p(dist_all),
+        _as_u8p(out),
+    )
+    return out

@@ -175,3 +175,62 @@ class TestSourceBatch:
         # padding rows: self-distance 0, everything else unreachable
         assert (d[snap.n :, : snap.n] >= INF).all()
         assert (d[: snap.n, snap.n :] >= INF).all()
+
+
+class TestNativeBackend:
+    def test_native_matches_oracle(self):
+        from openr_tpu.graph import native_spf
+
+        if not native_spf.is_available():
+            pytest.skip("native toolchain unavailable")
+        for seed in range(3):
+            topo = topologies.random_mesh(22, degree=4, seed=seed, max_metric=15)
+            over = {"node-2", "node-7"} if seed == 1 else set()
+            ls = load(topo, overloaded_nodes=over)
+            snap = compile_snapshot(ls)
+            d = native_spf.all_pairs_distances(snap)
+            for src in snap.node_names:
+                sid = snap.node_index[src]
+                oracle = ls.run_spf(src)
+                for dst in snap.node_names:
+                    did = snap.node_index[dst]
+                    expected = (
+                        oracle[dst].metric if dst in oracle else INF
+                    )
+                    assert d[sid, did] == expected, (src, dst)
+                fh = native_spf.first_hop_matrix(snap, sid, d[sid], d)
+                for dst in snap.node_names:
+                    if dst == src:
+                        continue
+                    did = snap.node_index[dst]
+                    got = {
+                        snap.node_names[v]
+                        for v in np.nonzero(fh[:, did])[0]
+                    }
+                    want = (
+                        oracle[dst].next_hops if dst in oracle else set()
+                    )
+                    assert got == want, (src, dst, got, want)
+
+    def test_native_solver_backend_matches_device(self):
+        from openr_tpu.graph import native_spf
+
+        if not native_spf.is_available():
+            pytest.skip("native toolchain unavailable")
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import SpfSolver
+
+        topo = topologies.random_mesh(18, degree=4, seed=3, max_metric=9)
+        ls = load(topo)
+        prefix_state = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            prefix_state.update_prefix_database(pdb)
+        area_ls = {topo.area: ls}
+        my = "node-0"
+        db_native = SpfSolver(my, backend="native").build_route_db(
+            my, area_ls, prefix_state
+        )
+        db_device = SpfSolver(my, backend="device").build_route_db(
+            my, area_ls, prefix_state
+        )
+        assert db_native.to_route_db(my) == db_device.to_route_db(my)
