@@ -1,0 +1,87 @@
+"""KvStoreAgent: embed application state in the routing KvStore.
+
+Example-parity with the reference ``examples/KvStoreAgent.cpp``: an
+application running next to the daemon persists its own keys (with TTL
+refresh handled by the client) and subscribes to keys published by the
+same application on other nodes.
+
+Run me standalone for a self-contained two-node demo:
+    python examples/kvstore_agent.py
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.types import Value
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+APP_PREFIX = "app-demo:"
+
+
+class KvStoreAgent:
+    """reference: examples/KvStoreAgent.cpp (kvStoreClient_->persistKey +
+    subscribeKeyFilter on the app's key namespace)."""
+
+    def __init__(self, node_name: str, kvstore, area: str = "0"):
+        self.node_name = node_name
+        self.area = area
+        self.evb = OpenrEventBase(name=f"agent:{node_name}")
+        self.client = KvStoreClient(self.evb, node_name, kvstore)
+        self.peers_seen: Dict[str, bytes] = {}
+        self.client.subscribe_key_filter(self._on_key)
+        self.evb.run_in_thread()
+
+    def advertise(self, payload: bytes, ttl_ms: int = 5000) -> None:
+        """Own our per-node app key; the client keeps it alive."""
+        self.client.persist_key(
+            self.area, f"{APP_PREFIX}{self.node_name}", payload, ttl=ttl_ms
+        )
+
+    def _on_key(self, area: str, key: str, value: Optional[Value]) -> None:
+        if not key.startswith(APP_PREFIX):
+            return
+        peer = key[len(APP_PREFIX):]
+        if value is None:
+            self.peers_seen.pop(peer, None)
+        elif value.value is not None:
+            self.peers_seen[peer] = value.value
+
+    def stop(self) -> None:
+        self.client.stop()
+        self.evb.stop()
+        self.evb.join()
+
+
+def main() -> None:
+    from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+
+    a, b = KvStoreWrapper("node-a"), KvStoreWrapper("node-b")
+    a.start()
+    b.start()
+    link_bidirectional(a, b)
+    agent_a = KvStoreAgent("node-a", a.store)
+    agent_b = KvStoreAgent("node-b", b.store)
+    agent_a.advertise(b"hello from a")
+    agent_b.advertise(b"hello from b")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if (
+            agent_a.peers_seen.get("node-b") == b"hello from b"
+            and agent_b.peers_seen.get("node-a") == b"hello from a"
+        ):
+            print("both agents see each other's app keys:")
+            print("  node-a sees:", agent_a.peers_seen)
+            print("  node-b sees:", agent_b.peers_seen)
+            break
+        time.sleep(0.05)
+    agent_a.stop()
+    agent_b.stop()
+    a.stop()
+    b.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""KvStorePoller: bulk-read LSDBs from many nodes' ctrl endpoints.
+
+Example-parity with the reference ``examples/KvStorePoller.cpp``: connect
+to a set of (host, port) ctrl endpoints and dump adjacency/prefix
+databases from each.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from openr_tpu.ctrl.server import CtrlClient
+
+
+class KvStorePoller:
+    def __init__(self, endpoints: List[Tuple[str, int]]):
+        self._endpoints = endpoints
+
+    def get_adjacency_databases(self) -> Dict[str, dict]:
+        """reference: KvStorePoller::getAdjacencyDatabases."""
+        return self._poll("adj:")
+
+    def get_prefix_databases(self) -> Dict[str, dict]:
+        """reference: KvStorePoller::getPrefixDatabases."""
+        return self._poll("prefix:")
+
+    def _poll(self, prefix: str) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for host, port in self._endpoints:
+            try:
+                client = CtrlClient(host, port)
+            except OSError:
+                continue
+            try:
+                out[f"{host}:{port}"] = client.call(
+                    "get_kvstore_keys_filtered", prefix=prefix
+                )
+            finally:
+                client.close()
+        return out
+
+
+def main() -> None:
+    import sys
+
+    endpoints = []
+    for arg in sys.argv[1:]:
+        host, _, port = arg.rpartition(":")
+        endpoints.append((host or "127.0.0.1", int(port)))
+    if not endpoints:
+        print("usage: kvstore_poller.py host:port [host:port ...]")
+        return
+    poller = KvStorePoller(endpoints)
+    for endpoint, keys in poller.get_adjacency_databases().items():
+        print(f"{endpoint}: {len(keys)} adjacency keys")
+        for key in sorted(keys):
+            print(f"  {key}")
+
+
+if __name__ == "__main__":
+    main()

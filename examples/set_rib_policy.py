@@ -1,0 +1,49 @@
+"""SetRibPolicyExample: push a weight-steering policy into Decision.
+
+Example-parity with the reference ``examples/SetRibPolicyExample.cpp``:
+connect to a node's ctrl endpoint and install a TTL'd RibPolicy that
+re-weights next-hops for a prefix (e.g. drain one neighbor softly).
+
+usage: set_rib_policy.py [host:]port PREFIX NEIGHBOR=WEIGHT ...
+"""
+
+from __future__ import annotations
+
+import sys
+
+from openr_tpu.ctrl.server import CtrlClient
+
+
+def main() -> None:
+    if len(sys.argv) < 4:
+        print(__doc__)
+        return
+    target, prefix = sys.argv[1], sys.argv[2]
+    host, _, port = target.rpartition(":")
+    weights = {}
+    for spec in sys.argv[3:]:
+        neighbor, _, weight = spec.partition("=")
+        weights[neighbor] = int(weight)
+
+    client = CtrlClient(host or "127.0.0.1", int(port))
+    try:
+        client.call(
+            "set_rib_policy",
+            statements=[
+                {
+                    "name": "example-steering",
+                    "prefixes": [prefix],
+                    "default_weight": 1,
+                    "neighbor_to_weight": weights,
+                }
+            ],
+            ttl_secs=300,
+        )
+        print(f"policy installed for {prefix}: {weights} (ttl 300s)")
+        print("current policy:", client.call("get_rib_policy"))
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""KvStoreSnooper: live-tail the KvStore publication stream of a running
+daemon (reference: openr/kvstore/tools/KvStoreSnooper.cpp).
+
+usage: kvstore_snooper.py [host:]port [--prefix adj:]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from openr_tpu.ctrl.server import CtrlClient
+
+
+def main() -> None:
+    args = sys.argv[1:]
+    if not args:
+        print(__doc__)
+        return
+    target = args[0]
+    prefix = ""
+    if "--prefix" in args:
+        prefix = args[args.index("--prefix") + 1]
+    host, _, port = target.rpartition(":")
+    client = CtrlClient(host or "127.0.0.1", int(port))
+    try:
+        # snapshot first, then live events
+        snapshot = client.call("get_kvstore_keys_filtered", prefix=prefix)
+        print(f"--- snapshot: {len(snapshot)} keys ---")
+        for key, value in sorted(snapshot.items()):
+            print(
+                f"{key}  v={value.get('version')} "
+                f"orig={value.get('originator_id')} ttl={value.get('ttl')}"
+            )
+        print("--- live stream (ctrl-c to stop) ---")
+        for event in client.stream("subscribe_kvstore_filtered"):
+            if event is None:
+                continue
+            for key, value in sorted(event.get("key_vals", {}).items()):
+                if prefix and not key.startswith(prefix):
+                    continue
+                print(
+                    f"UPDATE {key}  v={value.get('version')} "
+                    f"orig={value.get('originator_id')}"
+                )
+            for key in event.get("expired_keys", []):
+                if prefix and not key.startswith(prefix):
+                    continue
+                print(f"EXPIRED {key}")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    main()
