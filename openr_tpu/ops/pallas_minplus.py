@@ -1,0 +1,74 @@
+"""Pallas TPU kernel for the min-plus product (tropical matmul).
+
+The relaxation step of the batched SPF is ``out[s, j] = min_k a[s, k] +
+b[k, j]`` — a matmul over the (min, +) semiring. XLA's fused
+broadcast+reduce handles it well for moderate N, but tiling it explicitly
+keeps the k-panel resident in VMEM and bounds the broadcast temporary to
+(TS, TK, TN) regardless of N, which matters once N is in the thousands.
+
+Tiling: grid (S/TS, N/TN, N/TK) with k innermost; the output tile is
+revisited across k and accumulated with minimum (initialized to INF at
+k == 0 via pl.when). TK is kept small (8) so the 3-D broadcast temp is
+~0.5 MB of VMEM with 128x128 output tiles.
+
+Enable through ``openr_tpu.ops.spf.set_minplus_impl("pallas")`` (bench
+auto-probes and falls back to the jnp formulation on any failure);
+interpret mode is used for CPU correctness tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+INF = np.int32((1 << 30) - 1)
+
+TILE_S = 128
+TILE_N = 128
+TILE_K = 8
+
+
+def _minplus_kernel(a_ref, b_ref, o_ref):
+    k = pl.program_id(2)
+    a = a_ref[...]  # (TILE_S, TILE_K)
+    b = b_ref[...]  # (TILE_K, TILE_N)
+    cand = jnp.min(a[:, :, None] + b[None, :, :], axis=1)
+    cand = jnp.minimum(cand, INF).astype(jnp.int32)
+
+    @pl.when(k == 0)
+    def _init():
+        o_ref[...] = jnp.full_like(o_ref, INF)
+
+    o_ref[...] = jnp.minimum(o_ref[...], cand)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def minplus(a: jnp.ndarray, b: jnp.ndarray, interpret: bool = False):
+    """(a (x) b) over (min, +): [S, K] x [K, N] -> [S, N] int32.
+
+    Shapes must be multiples of the tile sizes (the snapshot layer pads
+    to 128, which satisfies this).
+    """
+    s, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    assert s % TILE_S == 0 and n % TILE_N == 0 and k % TILE_K == 0, (
+        a.shape,
+        b.shape,
+    )
+    grid = (s // TILE_S, n // TILE_N, k // TILE_K)
+    return pl.pallas_call(
+        _minplus_kernel,
+        out_shape=jax.ShapeDtypeStruct((s, n), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_S, TILE_K), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((TILE_K, TILE_N), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((TILE_S, TILE_N), lambda i, j, kk: (i, j)),
+        interpret=interpret,
+    )(a, b)

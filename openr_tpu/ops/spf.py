@@ -55,26 +55,41 @@ def _mask_transit_rows(d: jnp.ndarray, overloaded: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(overloaded[:, None], ident_row, d)
 
 
-def _minplus(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+# min-plus implementation selector: "jnp" (XLA fused broadcast+reduce) or
+# "pallas" (explicit VMEM tiling, openr_tpu.ops.pallas_minplus). The bench
+# probes pallas on real TPU and falls back on any failure.
+_MINPLUS_IMPL = "jnp"
+
+
+def set_minplus_impl(impl: str) -> None:
+    global _MINPLUS_IMPL
+    assert impl in ("jnp", "pallas"), impl
+    _MINPLUS_IMPL = impl
+
+
+def get_minplus_impl() -> str:
+    return _MINPLUS_IMPL
+
+
+def _minplus(a: jnp.ndarray, b: jnp.ndarray, impl: str = "jnp") -> jnp.ndarray:
     """(a (x) b)[s, j] = min_k a[s, k] + b[k, j], saturating at INF.
 
-    XLA fuses the broadcast-add into the min-reduction, so the [S, N, N]
-    intermediate is never materialized in HBM.
+    jnp path: XLA fuses the broadcast-add into the min-reduction, so the
+    [S, N, N] intermediate is never materialized in HBM.
     """
+    if impl == "pallas":
+        from openr_tpu.ops.pallas_minplus import minplus as pallas_minplus
+
+        return pallas_minplus(a, b)
     return jnp.minimum(
         jnp.min(a[:, :, None] + b[None, :, :], axis=1), INF
     ).astype(jnp.int32)
 
 
-@jax.jit
-def all_pairs_distances(
-    w: jnp.ndarray, overloaded: jnp.ndarray
+@functools.partial(jax.jit, static_argnames=("impl",))
+def _all_pairs_distances(
+    w: jnp.ndarray, overloaded: jnp.ndarray, impl: str
 ) -> jnp.ndarray:
-    """All-sources shortest path distances, [N, N] int32.
-
-    w: [N, N] one-hop metric matrix (INF = no edge). Diagonal is forced
-    to 0. overloaded: [N] bool transit-exclusion mask.
-    """
     n = w.shape[0]
     eye = (
         jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
@@ -89,22 +104,31 @@ def all_pairs_distances(
     def body(state):
         d, _, it = state
         d_transit = _mask_transit_rows(d, overloaded)
-        nxt = jnp.minimum(d, _minplus(d, d_transit))
+        nxt = jnp.minimum(d, _minplus(d, d_transit, impl))
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
     return d
 
 
-@jax.jit
-def distances_from_sources(
-    w: jnp.ndarray, overloaded: jnp.ndarray, src_ids: jnp.ndarray
+def all_pairs_distances(
+    w: jnp.ndarray, overloaded: jnp.ndarray
 ) -> jnp.ndarray:
-    """Shortest-path distances from a batch of sources, [S, N] int32.
+    """All-sources shortest path distances, [N, N] int32.
 
-    Bellman-Ford over the transit-masked one-hop matrix. Initial rows are
-    the sources' direct edges (so an overloaded source still originates).
+    w: [N, N] one-hop metric matrix (INF = no edge). Diagonal is forced
+    to 0. overloaded: [N] bool transit-exclusion mask.
     """
+    return _all_pairs_distances(w, overloaded, _MINPLUS_IMPL)
+
+
+@functools.partial(jax.jit, static_argnames=("impl",))
+def _distances_from_sources(
+    w: jnp.ndarray,
+    overloaded: jnp.ndarray,
+    src_ids: jnp.ndarray,
+    impl: str,
+) -> jnp.ndarray:
     n = w.shape[0]
     t = _mask_transit_rows(w, overloaded)
     d0 = w[src_ids, :]
@@ -116,11 +140,22 @@ def distances_from_sources(
 
     def body(state):
         d, _, it = state
-        nxt = jnp.minimum(d, _minplus(d, t))
+        nxt = jnp.minimum(d, _minplus(d, t, impl))
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
     return d
+
+
+def distances_from_sources(
+    w: jnp.ndarray, overloaded: jnp.ndarray, src_ids: jnp.ndarray
+) -> jnp.ndarray:
+    """Shortest-path distances from a batch of sources, [S, N] int32.
+
+    Bellman-Ford over the transit-masked one-hop matrix. Initial rows are
+    the sources' direct edges (so an overloaded source still originates).
+    """
+    return _distances_from_sources(w, overloaded, src_ids, _MINPLUS_IMPL)
 
 
 @jax.jit
@@ -162,7 +197,22 @@ def first_hop_matrix(
     return mask
 
 
-@functools.partial(jax.jit, static_argnames=("use_link_metric",))
+@functools.partial(jax.jit, static_argnames=("use_link_metric", "impl"))
+def _spf_from_source_with_first_hops(
+    metric: jnp.ndarray,
+    hop: jnp.ndarray,
+    overloaded: jnp.ndarray,
+    src_id: jnp.ndarray,
+    use_link_metric: bool,
+    impl: str,
+):
+    w = metric if use_link_metric else hop
+    d_all = _all_pairs_distances(w, overloaded, impl)
+    d_src = d_all[src_id, :]
+    fh = first_hop_matrix(w, overloaded, src_id, d_src, d_all)
+    return d_src, d_all, fh
+
+
 def spf_from_source_with_first_hops(
     metric: jnp.ndarray,
     hop: jnp.ndarray,
@@ -175,8 +225,6 @@ def spf_from_source_with_first_hops(
 
     Returns (d_src [N], d_all [N, N], first_hops [N, N] bool).
     """
-    w = metric if use_link_metric else hop
-    d_all = all_pairs_distances(w, overloaded)
-    d_src = d_all[src_id, :]
-    fh = first_hop_matrix(w, overloaded, src_id, d_src, d_all)
-    return d_src, d_all, fh
+    return _spf_from_source_with_first_hops(
+        metric, hop, overloaded, src_id, use_link_metric, _MINPLUS_IMPL
+    )

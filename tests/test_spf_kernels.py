@@ -234,3 +234,40 @@ class TestNativeBackend:
             my, area_ls, prefix_state
         )
         assert db_native.to_route_db(my) == db_device.to_route_db(my)
+
+
+class TestPallasMinplus:
+    def test_interpret_matches_jnp(self):
+        from openr_tpu.ops.pallas_minplus import minplus
+
+        rng = np.random.default_rng(0)
+        s, k, n = 128, 128, 256
+        a = rng.integers(0, 100, size=(s, k)).astype(np.int32)
+        b = rng.integers(0, 100, size=(k, n)).astype(np.int32)
+        # sprinkle INF (missing edges)
+        a[rng.random((s, k)) < 0.3] = INF
+        b[rng.random((k, n)) < 0.3] = INF
+        got = np.asarray(minplus(jnp.asarray(a), jnp.asarray(b), interpret=True))
+        want = np.minimum(
+            np.min(
+                a[:, :, None].astype(np.int64) + b[None, :, :], axis=1
+            ),
+            int(INF),
+        ).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_impl_switch_consistency(self):
+        from openr_tpu.ops import spf as spf_ops
+
+        topo = topologies.grid(4)
+        ls = load(topo)
+        snap = compile_snapshot(ls)
+        w = jnp.asarray(snap.metric)
+        ov = jnp.asarray(snap.overloaded)
+        d_jnp = np.asarray(spf_ops.all_pairs_distances(w, ov))
+        assert spf_ops.get_minplus_impl() == "jnp"
+        # pallas path on CPU runs via interpret-incapable lowering; only
+        # assert the dispatch plumbing stays consistent
+        spf_ops.set_minplus_impl("jnp")
+        d_again = np.asarray(spf_ops.all_pairs_distances(w, ov))
+        np.testing.assert_array_equal(d_jnp, d_again)
