@@ -26,7 +26,6 @@ import numpy as np
 
 
 def main() -> None:
-    import jax
     import jax.numpy as jnp
 
     from openr_tpu.graph.linkstate import LinkState
@@ -80,30 +79,40 @@ def main() -> None:
         d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
             metric_dev, hop_dev, overloaded_dev, jnp.int32(sid)
         )
-        jax.block_until_ready((d_src, d_all, fh))
-        return snap, d_all
+        # Honest completion signal: read this node's distance vector back
+        # to the host (what route selection consumes). On relay-backed
+        # platforms a bare block_until_ready can ack before the device
+        # round trip; a data-dependent readback cannot. This is one
+        # device->host sync per reconvergence.
+        d_src_host = np.asarray(d_src)
+        return snap, d_all, d_src_host
 
-    # warm-up (jit compile + first snapshot). Probe the pallas min-plus
-    # kernel first; fall back to the fused-jnp formulation on any failure.
+    # warm-up (jit compile + first snapshot; the readback inside
+    # reconverge also arms true-sync mode on relay-backed platforms, so
+    # every timed sample below measures a genuine device round trip).
+    # Probe the pallas min-plus kernel first; fall back to the fused-jnp
+    # formulation on any failure.
     try:
         spf_ops.set_minplus_impl("pallas")
-        snap, d_all = reconverge()
+        snap, d_all, _ = reconverge()
     except Exception:
         spf_ops.set_minplus_impl("jnp")
-        snap, d_all = reconverge()
+        snap, d_all, _ = reconverge()
     # whichever implementation survived, compare a reference row against
     # the jnp path once to guard against silent miscompiles
     if spf_ops.get_minplus_impl() == "pallas":
-        import numpy as _np
-
-        probe_impl = spf_ops.get_minplus_impl()
         spf_ops.set_minplus_impl("jnp")
-        _, d_check = reconverge()
-        spf_ops.set_minplus_impl(probe_impl)
-        if not _np.array_equal(_np.asarray(d_all), _np.asarray(d_check)):
+        _, d_check, _ = reconverge()
+        spf_ops.set_minplus_impl("pallas")
+        if not np.array_equal(np.asarray(d_all), np.asarray(d_check)):
             spf_ops.set_minplus_impl("jnp")
-        snap, d_all = reconverge()
+        snap, d_all, _ = reconverge()
     n = snap.n
+
+    # one churn+reconverge outside the timed loop: the first patched
+    # snapshot compiles the row-scatter program (one-time cost)
+    churn(99)
+    reconverge()
 
     samples = []
     for step in range(10):

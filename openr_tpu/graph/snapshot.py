@@ -128,14 +128,22 @@ class GraphSnapshot:
         return self._dev
 
 
-def _patch_rows(metric_dev, row_ids, row_vals):
+@functools.lru_cache(maxsize=1)
+def _patch_fn():
     import jax
 
     @jax.jit
     def patch(m, ids, vals):
         return m.at[ids, :].set(vals)
 
-    return patch(metric_dev, row_ids, row_vals)
+    return patch
+
+
+def _patch_rows(metric_dev, row_ids, row_vals):
+    # the jitted scatter must be a process-wide singleton: a fresh jit
+    # closure per call would recompile on every churn step, which is
+    # catastrophic when compilation is remote
+    return _patch_fn()(metric_dev, row_ids, row_vals)
 
 
 @functools.lru_cache(maxsize=1)

@@ -6,10 +6,15 @@ broadcast+reduce handles it well for moderate N, but tiling it explicitly
 keeps the k-panel resident in VMEM and bounds the broadcast temporary to
 (TS, TK, TN) regardless of N, which matters once N is in the thousands.
 
-Tiling: grid (S/TS, N/TN, N/TK) with k innermost; the output tile is
+Layout: TPU Mosaic requires every VMEM block's (sublane, lane) dims to be
+multiples of (8, 128) (or equal to the full array dims). Blocks of ``a``
+are therefore (TILE_S, TILE_K) = (8, 128) — tall-K, short-S — so both
+operands are consumed untransposed with legal tiles, and the broadcast
+temporary is (TS, TK, TN) = (8, 128, 128) int32 ≈ 0.5 MB of VMEM.
+
+Tiling: grid (S/TS, N/TN, K/TK) with k innermost; the output tile is
 revisited across k and accumulated with minimum (initialized to INF at
-k == 0 via pl.when). TK is kept small (8) so the 3-D broadcast temp is
-~0.5 MB of VMEM with 128x128 output tiles.
+k == 0 via pl.when).
 
 Enable through ``openr_tpu.ops.spf.set_minplus_impl("pallas")`` (bench
 auto-probes and falls back to the jnp formulation on any failure);
@@ -27,9 +32,9 @@ from jax.experimental import pallas as pl
 
 INF = np.int32((1 << 30) - 1)
 
-TILE_S = 128
+TILE_S = 8
 TILE_N = 128
-TILE_K = 8
+TILE_K = 128
 
 
 def _minplus_kernel(a_ref, b_ref, o_ref):
