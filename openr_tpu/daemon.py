@@ -41,6 +41,8 @@ class OpenrNode:
         node_registry: Optional[Dict[str, "OpenrNode"]] = None,
         fib_agent: Optional[FibService] = None,
         area: str = "0",
+        areas: Optional[List[str]] = None,
+        interface_areas: Optional[Dict[str, str]] = None,
         v6_addr: Optional[str] = None,
         spark_config: Optional[dict] = None,
         use_rtt_metric: bool = False,
@@ -51,6 +53,24 @@ class OpenrNode:
     ):
         self.name = name
         self.area = area
+        # border routers participate in several areas; interface_areas maps
+        # each interface to its area (default: the node's default area)
+        self.areas = list(areas) if areas else [area]
+        bad_areas = set((interface_areas or {}).values()) - set(self.areas)
+        if bad_areas:
+            # an adjacency in an unconfigured area would form at the Spark
+            # level but never enter any LSDB — a silent blackhole
+            raise ValueError(
+                f"interface_areas references areas {sorted(bad_areas)} "
+                f"not in this node's areas {self.areas}"
+            )
+        if area not in self.areas:
+            # unlisted interfaces fall back to the default area; it must
+            # be one this node actually participates in
+            raise ValueError(
+                f"default area {area!r} not in this node's areas "
+                f"{self.areas}"
+            )
         self.registry = node_registry if node_registry is not None else {}
         self.registry[name] = self
 
@@ -63,7 +83,7 @@ class OpenrNode:
         self.static_routes = ReplicateQueue(name=f"{name}:staticRoutes")
 
         # -- modules ------------------------------------------------------
-        self.kvstore = KvStore(node_id=name, areas=[area])
+        self.kvstore = KvStore(node_id=name, areas=self.areas)
         self.client_evb = OpenrEventBase(name=f"kvclient:{name}")
         self.kvstore_client = KvStoreClient(
             self.client_evb, name, self.kvstore
@@ -92,6 +112,7 @@ class OpenrNode:
             self.neighbor_updates,
             interface_updates_queue=self.interface_updates,
             area=area,
+            interface_areas=interface_areas,
             v6_addr=BinaryAddress.from_str(v6_addr) if v6_addr else None,
             **(spark_config or {}),
         )
@@ -104,13 +125,18 @@ class OpenrNode:
             peer_transport_factory=self._peer_transport,
             config_store=config_store,
             area=area,
+            areas=self.areas,
             use_rtt_metric=use_rtt_metric,
         )
         self.prefix_manager = PrefixManager(
             name,
             self.kvstore_client,
             prefix_updates_queue=self.prefix_updates,
-            areas=[area],
+            # border nodes re-originate Decision's best routes across areas
+            decision_route_updates_queue=(
+                self.route_updates if len(self.areas) > 1 else None
+            ),
+            areas=self.areas,
         )
         from openr_tpu.ctrl.handler import OpenrCtrlHandler
 

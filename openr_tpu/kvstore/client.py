@@ -80,6 +80,17 @@ class KvStoreClient:
         delete in the flooded store)."""
         self._persisted.pop((area, key), None)
 
+    def clear_key(
+        self, area: str, key: str, value: bytes, ttl: int = TTL_INFINITY
+    ) -> None:
+        """Stop owning the key and flood one final tombstone value
+        (reference: KvStoreClientInternal::clearKey). Ownership must be
+        dropped *before* the tombstone floods, or the ownership
+        enforcement in _process_publication would see a foreign value on
+        a persisted key and resurrect the old one."""
+        self._persisted.pop((area, key), None)
+        self.set_key(area, key, value, ttl=ttl)
+
     def set_key(
         self,
         area: str,

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.netlink import (
@@ -73,6 +73,7 @@ class LinkMonitor:
         netlink_events_queue: Optional[ReplicateQueue] = None,
         config_store=None,
         area: str = "0",
+        areas: Optional[List[str]] = None,
         node_label: int = 0,
         use_rtt_metric: bool = False,
         flap_initial_backoff_s: float = 0.05,
@@ -81,6 +82,10 @@ class LinkMonitor:
     ):
         self.my_node_name = my_node_name
         self.area = area
+        # all areas this node participates in (border routers list several);
+        # each gets its own adj:<node> advertisement holding only that
+        # area's adjacencies
+        self.areas = list(areas) if areas else [area]
         self.node_label = node_label
         self.use_rtt_metric = use_rtt_metric
         self.evb = OpenrEventBase(name=f"linkmonitor:{my_node_name}")
@@ -213,13 +218,14 @@ class LinkMonitor:
 
     def _neighbor_down(self, nbr: SparkNeighbor) -> None:
         self.counters["link_monitor.neighbor_down"] += 1
+        area = nbr.area or self.area
         self._adjacencies.pop((nbr.local_if_name, nbr.node_name), None)
         if self._kvstore is not None and not any(
-            n.node_name == nbr.node_name
+            n.node_name == nbr.node_name and (n.area or self.area) == area
             for (n, _) in self._adjacencies.values()
         ):
             try:
-                self._kvstore.del_peer(self.area, nbr.node_name)
+                self._kvstore.del_peer(area, nbr.node_name)
             except Exception:
                 pass
         self._advertise_adj_throttled()
@@ -256,15 +262,20 @@ class LinkMonitor:
         try:
             transport = self._peer_transport_factory(nbr)
             if transport is not None:
-                self._kvstore.add_peer(self.area, nbr.node_name, transport)
+                self._kvstore.add_peer(
+                    nbr.area or self.area, nbr.node_name, transport
+                )
         except Exception:
             pass
 
     # -- adjacency advertisement -----------------------------------------
 
-    def _build_adj_db(self) -> AdjacencyDatabase:
+    def _build_adj_db(self, area: Optional[str] = None) -> AdjacencyDatabase:
+        """Adjacencies for one area (or all, area=None for introspection)."""
         adjacencies = []
         for (if_name, node), (nbr, adj) in sorted(self._adjacencies.items()):
+            if area is not None and (nbr.area or self.area) != area:
+                continue
             metric = self._metric_overrides.get((if_name, node), adj.metric)
             adjacencies.append(
                 Adjacency(
@@ -286,20 +297,22 @@ class LinkMonitor:
             is_overloaded=self.is_overloaded,
             adjacencies=tuple(adjacencies),
             node_label=self.node_label,
-            area=self.area,
+            area=area if area is not None else self.area,
         )
 
     def _advertise_adjacencies(self) -> None:
-        """reference: LinkMonitor.cpp:602 advertiseAdjacencies."""
+        """reference: LinkMonitor.cpp:602 advertiseAdjacencies (one
+        adj:<node> advertisement per configured area)."""
         if self._kvstore_client is None:
             return
         self.counters["link_monitor.advertise_adjacencies"] += 1
-        adj_db = self._build_adj_db()
-        self._kvstore_client.persist_key(
-            self.area,
-            keyutil.adj_key(self.my_node_name),
-            wire.dumps(adj_db),
-        )
+        for area in self.areas:
+            adj_db = self._build_adj_db(area)
+            self._kvstore_client.persist_key(
+                area,
+                keyutil.adj_key(self.my_node_name),
+                wire.dumps(adj_db),
+            )
 
     # -- netlink interface tracking --------------------------------------
 

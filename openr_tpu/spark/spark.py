@@ -104,6 +104,7 @@ class Spark:
         neighbor_updates_queue: ReplicateQueue,
         interface_updates_queue: Optional[ReplicateQueue] = None,
         area: str = "0",
+        interface_areas: Optional[Dict[str, str]] = None,
         hello_interval_s: float = 0.5,
         fast_hello_interval_s: float = 0.05,
         handshake_interval_s: float = 0.05,
@@ -116,6 +117,10 @@ class Spark:
     ):
         self.my_node_name = my_node_name
         self.area = area
+        # border routers place interfaces in different areas (reference:
+        # per-area interface regexes in OpenrConfig AreaConfig); unlisted
+        # interfaces fall back to the default area
+        self._interface_areas = dict(interface_areas or {})
         self.evb = OpenrEventBase(name=f"spark:{my_node_name}")
         self._io = io_provider
         self._neighbor_updates = neighbor_updates_queue
@@ -160,6 +165,9 @@ class Spark:
             self._io.detach(if_name)
 
     # -- interface management --------------------------------------------
+
+    def area_for_interface(self, if_name: str) -> str:
+        return self._interface_areas.get(if_name, self.area)
 
     def add_interface(self, if_name: str) -> None:
         self.evb.call_and_wait(lambda: self._add_interface(if_name))
@@ -252,7 +260,7 @@ class Spark:
             transport_address_v6=self._v6,
             transport_address_v4=self._v4,
             openr_ctrl_port=self._ctrl_port,
-            area=self.area,
+            area=self.area_for_interface(if_name),
             neighbor_node_name=to_neighbor,
         )
         self._io.send(if_name, wire.dumps(SparkPacket(handshake=msg)))
@@ -363,7 +371,7 @@ class Spark:
         ):
             return
         neighbor = self._get_or_create(if_name, msg.node_name)
-        if msg.area != self.area:
+        if msg.area != self.area_for_interface(if_name):
             return  # area mismatch: no adjacency
         neighbor.remote_if = msg.if_name
         neighbor.area = msg.area

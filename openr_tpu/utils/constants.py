@@ -25,6 +25,18 @@ DECISION_DEBOUNCE_MAX_MS = 250
 # KvStore timers (reference: Constants.h)
 KVSTORE_DB_SYNC_INTERVAL_S = 60
 TTL_DECREMENT_MS = 1  # floor applied when re-flooding TTLs
+# finite TTL for withdraw tombstones so delete markers age out of every
+# store instead of accumulating (reference: clearKey floods with the
+# key's finite TTL, Constants.h kKvStoreDbTtl)
+KVSTORE_TOMBSTONE_TTL_MS = 300_000
+
+# default best-route-selection metrics assigned at prefix origination.
+# Non-zero so a re-originated copy (distance+1) still clears the
+# zero-metric selection sentinel yet always loses to the original
+# (reference: Constants.h:244-245 kDefaultPathPreference /
+# kDefaultSourcePreference, applied in buildOriginatedPrefixDb)
+DEFAULT_PATH_PREFERENCE = 1000
+DEFAULT_SOURCE_PREFERENCE = 200
 
 # MPLS label ranges (reference: Constants.h kSrGlobalRange / kSrLocalRange)
 SR_GLOBAL_RANGE = (101, 49999)
