@@ -1,14 +1,17 @@
-"""Reconvergence benchmark: full all-sources SPF after a topology change.
+"""Reconvergence benchmark: route-rebuild SPF after a topology change.
 
 Scenario (mirrors the reference Decision benchmarks,
 openr/decision/tests/DecisionBenchmark.cpp: BM_DecisionFabric, and its
 <100 ms convergence design goal, openr/docs/Introduction/Overview.md:28):
 
-  A ~1000-node 3-tier fat-tree is resident as a compiled snapshot. One
-  adjacency metric changes (link churn). Measured latency = incremental
-  LinkState merge + snapshot recompile + device all-sources SPF (every
-  node's distance vector; the reference computes *one* source per SPF
-  call) + ECMP first-hop matrix for this node, result on host.
+  A ~1000-node 3-tier fat-tree is resident as a compiled snapshot on the
+  device. One adjacency metric changes (link churn). Measured latency =
+  incremental LinkState merge + ONE fused device dispatch (scatter the
+  changed metric rows into the resident matrix + batched SPF from this
+  node and every neighbor — exactly the rows a route rebuild consumes for
+  best-path selection, ECMP first hops, and LFA; reference
+  Decision.cpp:1124 getNextHopsWithMetric, :1192) + distance/first-hop
+  readback to the host.
 
 Prints one JSON line:
   {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": x}
@@ -24,12 +27,14 @@ import time
 
 import numpy as np
 
+_PATCH_BUCKET = 8
+
 
 def main() -> None:
     import jax.numpy as jnp
 
     from openr_tpu.graph.linkstate import LinkState
-    from openr_tpu.graph.snapshot import SnapshotCache
+    from openr_tpu.graph.snapshot import INF, SnapshotCache
     from openr_tpu.models import topologies
     from openr_tpu.ops import spf as spf_ops
     from openr_tpu.types import Adjacency, AdjacencyDatabase
@@ -72,45 +77,87 @@ def main() -> None:
             )
         )
 
-    def reconverge():
-        snap = snapshots.get(ls)  # incremental patch on steady-state churn
-        sid = snap.node_index[my_node]
-        metric_dev, hop_dev, overloaded_dev = snap.device_arrays()
-        d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
-            metric_dev, hop_dev, overloaded_dev, jnp.int32(sid)
-        )
-        # Honest completion signal: read this node's distance vector back
-        # to the host (what route selection consumes). On relay-backed
-        # platforms a bare block_until_ready can ack before the device
-        # round trip; a data-dependent readback cannot. This is one
-        # device->host sync per reconvergence.
-        d_src_host = np.asarray(d_src)
-        return snap, d_all, d_src_host
+    # resident device state, owned by the bench loop
+    snap0 = snapshots.get(ls)
+    sid = snap0.node_index[my_node]
+    batch, srcs_dev = spf_ops.source_batch(snap0, sid)
+    bucket = srcs_dev.shape[0]
+    state = {"metric_dev": jnp.asarray(snap0.metric)}
+    noop_ids = np.asarray([sid] * _PATCH_BUCKET, dtype=np.int32)
 
-    # warm-up (jit compile + first snapshot; the readback inside
-    # reconverge also arms true-sync mode on relay-backed platforms, so
-    # every timed sample below measures a genuine device round trip).
-    # Probe the pallas min-plus kernel first; fall back to the fused-jnp
-    # formulation on any failure.
+    def reconverge():
+        snap = snapshots.get(ls)
+        plan = snap.patch_plan()
+        if plan is None:
+            # full (re)compile: upload the whole matrix
+            state["metric_dev"] = jnp.asarray(snap.metric)
+            ids = noop_ids
+        else:
+            rows, _ = plan
+            bkt = _PATCH_BUCKET
+            while bkt < len(rows):
+                bkt *= 2
+            ids = np.full(bkt, rows[0], dtype=np.int32)
+            ids[: len(rows)] = rows
+        vals = snap.metric[ids, :]
+        # one fused dispatch: scatter + batched SPF + first hops. The
+        # overloaded mask rides along on every step (patch_plan covers
+        # metric rows only; this is an O(N) async upload).
+        m2, packed = spf_ops.reconverge_step(
+            state["metric_dev"],
+            jnp.asarray(ids),
+            jnp.asarray(vals),
+            jnp.asarray(snap.overloaded),
+            srcs_dev,
+        )
+        state["metric_dev"] = m2
+        # Honest completion signal: read back the packed distance +
+        # first-hop rows route selection consumes. On relay-backed
+        # platforms a bare block_until_ready can ack before the device
+        # round trip; a data-dependent readback cannot. One device->host
+        # sync per reconvergence.
+        packed_host = np.asarray(packed)
+        d_host = packed_host[:bucket]
+        fh_host = packed_host[bucket:].astype(bool)
+        return d_host, fh_host
+
+    def oracle_gate(d_host, fh_host) -> bool:
+        """Device distances + ECMP first hops vs the host Dijkstra oracle
+        (reference runSpf semantics), exact."""
+        oracle = ls.run_spf(my_node)
+        names = snap0.node_names
+        for dst, res in oracle.items():
+            did = snap0.node_index[dst]
+            if d_host[0, did] != res.metric:
+                return False
+            if dst != my_node:
+                got_nh = {
+                    names[batch[i]]
+                    for i in np.nonzero(fh_host[: len(batch), did])[0]
+                }
+                if got_nh != res.next_hops:
+                    return False
+        for dst in set(names) - set(oracle):
+            if d_host[0, snap0.node_index[dst]] < INF:
+                return False
+        return True
+
+    # warm-up (jit compile + first snapshot). Probe the pallas min-plus
+    # kernel; fall back to the fused-jnp formulation on any failure —
+    # including a silent miscompile caught by the oracle gate.
     try:
         spf_ops.set_minplus_impl("pallas")
-        snap, d_all, _ = reconverge()
+        d_host, fh_host = reconverge()
+        if not oracle_gate(d_host, fh_host):
+            raise RuntimeError("pallas min-plus failed the oracle gate")
     except Exception:
         spf_ops.set_minplus_impl("jnp")
-        snap, d_all, _ = reconverge()
-    # whichever implementation survived, compare a reference row against
-    # the jnp path once to guard against silent miscompiles
-    if spf_ops.get_minplus_impl() == "pallas":
-        spf_ops.set_minplus_impl("jnp")
-        _, d_check, _ = reconverge()
-        spf_ops.set_minplus_impl("pallas")
-        if not np.array_equal(np.asarray(d_all), np.asarray(d_check)):
-            spf_ops.set_minplus_impl("jnp")
-        snap, d_all, _ = reconverge()
-    n = snap.n
+        snapshots.invalidate()  # rebuild resident state from scratch
+        d_host, fh_host = reconverge()
+        assert oracle_gate(d_host, fh_host), "device SPF failed oracle gate"
 
     # one churn+reconverge outside the timed loop: the first patched
-    # snapshot compiles the row-scatter program (one-time cost)
+    # snapshot compiles the fused scatter+SPF program (one-time cost)
     churn(99)
     reconverge()
 
@@ -126,7 +173,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": f"full_spf_reconvergence_ms_fattree_{n}",
+                "metric": f"spf_reconvergence_ms_fattree_{snap0.n}",
                 "value": round(value, 3),
                 "unit": "ms",
                 "vs_baseline": round(baseline_ms / value, 3),

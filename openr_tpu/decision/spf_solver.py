@@ -154,23 +154,30 @@ class SpfView:
     # -- device backend ---------------------------------------------------
 
     def _init_device(self) -> None:
-        import jax.numpy as jnp
-
+        """Batched {source} + neighbors SPF: the only rows a route rebuild
+        consumes (source distances for best-path selection, neighbor rows
+        for ECMP first hops and LFA — reference: Decision.cpp:1124, :1192).
+        Readback is O(B x N), not O(N^2)."""
         from openr_tpu.ops import spf as spf_ops
 
         self._snap: GraphSnapshot = _SNAPSHOTS.get(self._ls)
         sid = self._snap.id_of(self._root)
         self._sid = sid
+        self._d_all = None
+        self._fh = None
         if sid is None:
-            self._d_all = None
-            self._fh = None
             return
-        metric_dev, hop_dev, overloaded_dev = self._snap.device_arrays()
-        d_src, d_all, fh = spf_ops.spf_from_source_with_first_hops(
-            metric_dev, hop_dev, overloaded_dev, jnp.int32(sid)
+        srcs, srcs_dev = spf_ops.source_batch(self._snap, sid)
+        dev = self._snap.device_arrays()
+        packed = spf_ops.spf_view_batch_packed(
+            dev.metric, dev.overloaded, srcs_dev
         )
-        self._d_all = np.asarray(d_all)
-        self._fh = np.asarray(fh)
+        packed_host = np.asarray(packed)  # one device->host transfer
+        bucket = srcs_dev.shape[0]
+        self._d = packed_host[:bucket]
+        self._fh_batch = packed_host[bucket:].astype(bool)
+        self._batch_srcs = srcs  # row i of _d is distances from srcs[i]
+        self._row_of = {nid: i for i, nid in enumerate(srcs)}
 
     # -- native backend ---------------------------------------------------
 
@@ -198,7 +205,12 @@ class SpfView:
     # -- queries ----------------------------------------------------------
 
     def is_reachable(self, dst: str) -> bool:
-        if self._backend in ("device", "native"):
+        if self._backend == "device":
+            if self._sid is None:
+                return dst == self._root
+            did = self._snap.id_of(dst)
+            return did is not None and self._d[0, did] < INF
+        if self._backend == "native":
             if self._sid is None:
                 return dst == self._root
             did = self._snap.id_of(dst)
@@ -206,7 +218,14 @@ class SpfView:
         return dst in self._spf
 
     def metric_to(self, dst: str) -> Optional[Metric]:
-        if self._backend in ("device", "native"):
+        if self._backend == "device":
+            if self._sid is None:
+                return 0 if dst == self._root else None
+            did = self._snap.id_of(dst)
+            if did is None or self._d[0, did] >= INF:
+                return None
+            return int(self._d[0, did])
+        if self._backend == "native":
             if self._sid is None:
                 return 0 if dst == self._root else None
             did = self._snap.id_of(dst)
@@ -217,7 +236,18 @@ class SpfView:
         return res.metric if res is not None else None
 
     def next_hops_toward(self, dst: str) -> Set[str]:
-        if self._backend in ("device", "native"):
+        if self._backend == "device":
+            if self._sid is None:
+                return set()
+            did = self._snap.id_of(dst)
+            if did is None:
+                return set()
+            col = self._fh_batch[: len(self._batch_srcs), did]
+            return {
+                self._snap.node_names[self._batch_srcs[i]]
+                for i in np.nonzero(col)[0]
+            }
+        if self._backend == "native":
             if self._sid is None:
                 return set()
             did = self._snap.id_of(dst)
@@ -233,10 +263,26 @@ class SpfView:
         return set(res.next_hops) if res is not None else set()
 
     def metric_between(self, a: str, b: str) -> Optional[Metric]:
-        """Distance from an arbitrary node a to b (LFA computations)."""
+        """Distance from node a to b, where a is the root or one of its
+        neighbors (all LFA needs — reference: Decision.cpp:1192)."""
         if a == b:
             return 0
-        if self._backend in ("device", "native"):
+        if self._backend == "device":
+            if self._sid is None:
+                return None
+            aid, bid = self._snap.id_of(a), self._snap.id_of(b)
+            if aid is None or bid is None:
+                return None
+            row = self._row_of.get(aid)
+            if row is None:
+                # not in the batch (a is neither root nor neighbor):
+                # fall back to the host oracle, correctness over speed
+                res = self._ls.get_spf_result(a)
+                return res[b].metric if b in res else None
+            if self._d[row, bid] >= INF:
+                return None
+            return int(self._d[row, bid])
+        if self._backend == "native":
             if self._d_all is None:
                 return None
             aid, bid = self._snap.id_of(a), self._snap.id_of(b)

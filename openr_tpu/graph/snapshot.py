@@ -63,6 +63,28 @@ class DirectedLink:
     metric: int
 
 
+class _DeviceArrays:
+    """Resident device arrays for one snapshot. Unpacks like the
+    (metric, hop, overloaded) tuple it replaced, but the hop matrix is
+    derived on first access instead of eagerly per patch."""
+
+    __slots__ = ("metric", "overloaded", "_hop")
+
+    def __init__(self, metric, overloaded):
+        self.metric = metric
+        self.overloaded = overloaded
+        self._hop = None
+
+    @property
+    def hop(self):
+        if self._hop is None:
+            self._hop = _derive_hop(self.metric)
+        return self._hop
+
+    def __iter__(self):
+        return iter((self.metric, self.hop, self.overloaded))
+
+
 @dataclass
 class GraphSnapshot:
     area: str
@@ -92,9 +114,28 @@ class GraphSnapshot:
             ).astype(np.int32)
         return self._hop
 
+    def patch_plan(self) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(changed_row_ids, changed_row_values) when this snapshot is an
+        unrealized patch of a parent whose device copy the caller owns.
+        Callers driving their own resident device matrix (the fused
+        ``ops.spf.reconverge_step``) apply this instead of re-uploading;
+        returns None for a full compile. Detaches the parent chain.
+
+        Covers METRIC rows only: the caller must refresh its overloaded
+        mask from ``self.overloaded`` on every step (an O(N) upload) —
+        overload flips arrive through the same patch journal but are not
+        part of the row scatter."""
+        if self._parent is None or self._changed_rows is None:
+            return None
+        rows = self._changed_rows
+        self._parent = None
+        return rows, self.metric[rows, :]
+
     def device_arrays(self):
         """(metric, hop, overloaded) as device arrays. Patched snapshots
-        update their parent's resident arrays with a row scatter."""
+        update their parent's resident arrays with a row scatter. The hop
+        (unweighted) matrix is derived lazily on first access — most
+        consumers (route rebuilds) never touch it."""
         if self._dev is not None:
             return self._dev
         import jax.numpy as jnp
@@ -107,7 +148,7 @@ class GraphSnapshot:
             and rows is not None
             and len(rows) <= _PATCH_BUCKETS[-1]
         ):
-            p_metric, _, _ = parent._dev
+            p_metric = parent._dev.metric
             bucket = next(b for b in _PATCH_BUCKETS if b >= max(1, len(rows)))
             padded_rows = np.full(bucket, rows[0] if len(rows) else 0,
                                   dtype=np.int32)
@@ -121,8 +162,7 @@ class GraphSnapshot:
         else:
             metric_dev = jnp.asarray(self.metric)
             overloaded_dev = jnp.asarray(self.overloaded)
-        hop_dev = _derive_hop(metric_dev)
-        self._dev = (metric_dev, hop_dev, overloaded_dev)
+        self._dev = _DeviceArrays(metric_dev, overloaded_dev)
         # release the parent chain: resident arrays now belong to us
         self._parent = None
         return self._dev

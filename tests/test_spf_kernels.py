@@ -177,6 +177,102 @@ class TestSourceBatch:
         assert (d[: snap.n, snap.n :] >= INF).all()
 
 
+class TestSpfViewBatch:
+    """The fused daemon hot-path kernel: batched {src} + neighbors SPF
+    with first-hop rows, vs the Dijkstra oracle."""
+
+    @staticmethod
+    def batch_for(snap, src):
+        sid = snap.node_index[src]
+        real_srcs, srcs_dev = spf.source_batch(snap, sid)
+        return sid, real_srcs[1:], srcs_dev
+
+    def assert_view_parity(self, ls, use_link_metric=True):
+        snap = compile_snapshot(ls)
+        w = jnp.asarray(snap.metric)
+        ov = jnp.asarray(snap.overloaded)
+        for src in snap.node_names:
+            sid, nbrs, srcs = self.batch_for(snap, src)
+            d, fh = spf.spf_view_batch(w, ov, srcs, use_link_metric)
+            d, fh = np.asarray(d), np.asarray(fh)
+            oracle = ls.run_spf(src, use_link_metric)
+            # row 0 = source distances; rows 1..len(nbrs) = neighbor rows
+            for dst in snap.node_names:
+                did = snap.node_index[dst]
+                want = oracle[dst].metric if dst in oracle else None
+                got = int(d[0, did])
+                assert (got >= INF) == (want is None)
+                if want is not None:
+                    assert got == want, (src, dst)
+                kernel_nh = {
+                    snap.node_names[int(srcs[i])]
+                    for i in np.nonzero(fh[:, did])[0]
+                }
+                want_nh = (
+                    oracle[dst].next_hops
+                    if dst in oracle and dst != src
+                    else set()
+                )
+                assert kernel_nh == want_nh, (src, dst, kernel_nh, want_nh)
+            # neighbor rows match their own oracle runs
+            for i, nid in enumerate(nbrs):
+                nbr_oracle = ls.run_spf(snap.node_names[nid], use_link_metric)
+                for dst in snap.node_names:
+                    did = snap.node_index[dst]
+                    want = (
+                        nbr_oracle[dst].metric if dst in nbr_oracle else None
+                    )
+                    got = int(d[1 + i, did])
+                    assert (got >= INF) == (want is None)
+                    if want is not None:
+                        assert got == want
+
+    def test_grid(self):
+        self.assert_view_parity(load(topologies.grid(4)))
+
+    def test_random_weighted(self):
+        for seed in range(3):
+            topo = topologies.random_mesh(20, degree=4, seed=seed, max_metric=20)
+            self.assert_view_parity(load(topo))
+
+    def test_overloaded_nodes(self):
+        topo = topologies.random_mesh(16, degree=4, seed=2, max_metric=9)
+        self.assert_view_parity(load(topo, overloaded_nodes={"node-1", "node-5"}))
+
+    def test_hop_count_mode(self):
+        topo = topologies.random_mesh(14, degree=3, seed=7, max_metric=40)
+        self.assert_view_parity(load(topo), use_link_metric=False)
+
+    def test_reconverge_step_fused_patch(self):
+        """Patch-then-solve in one dispatch == recompile-then-solve."""
+        topo = topologies.random_mesh(16, degree=4, seed=4, max_metric=9)
+        ls = load(topo)
+        snap = compile_snapshot(ls)
+        metric_dev = jnp.asarray(snap.metric)
+        ov = jnp.asarray(snap.overloaded)
+        sid, nbrs, srcs = self.batch_for(snap, "node-0")
+
+        # mutate one row on the host, patch it on device
+        new_metric = snap.metric.copy()
+        victim = snap.node_index["node-3"]
+        row = new_metric[victim].copy()
+        edges = np.nonzero(row < INF)[0]
+        row[edges[0]] = row[edges[0]] + 7
+        new_metric[victim] = row
+        patch_ids = jnp.asarray(np.asarray([victim], dtype=np.int32))
+        patch_vals = jnp.asarray(row[None, :])
+
+        m2, packed = spf.reconverge_step(
+            metric_dev, patch_ids, patch_vals, ov, srcs
+        )
+        b = srcs.shape[0]
+        d2, fh2 = np.asarray(packed[:b]), np.asarray(packed[b:]).astype(bool)
+        d_ref, fh_ref = spf.spf_view_batch(jnp.asarray(new_metric), ov, srcs)
+        np.testing.assert_array_equal(np.asarray(m2), new_metric)
+        np.testing.assert_array_equal(d2, np.asarray(d_ref))
+        np.testing.assert_array_equal(fh2, np.asarray(fh_ref))
+
+
 class TestNativeBackend:
     def test_native_matches_oracle(self):
         from openr_tpu.graph import native_spf
