@@ -27,14 +27,13 @@ import time
 
 import numpy as np
 
-_PATCH_BUCKET = 8
 
 
 def main() -> None:
     import jax.numpy as jnp
 
     from openr_tpu.graph.linkstate import LinkState
-    from openr_tpu.graph.snapshot import INF, SnapshotCache
+    from openr_tpu.graph.snapshot import INF, SnapshotCache, pad_patch_rows
     from openr_tpu.models import topologies
     from openr_tpu.ops import spf as spf_ops
     from openr_tpu.types import Adjacency, AdjacencyDatabase
@@ -83,22 +82,16 @@ def main() -> None:
     batch, srcs_dev = spf_ops.source_batch(snap0, sid)
     bucket = srcs_dev.shape[0]
     state = {"metric_dev": jnp.asarray(snap0.metric)}
-    noop_ids = np.asarray([sid] * _PATCH_BUCKET, dtype=np.int32)
+    noop_ids = np.asarray([sid] * 8, dtype=np.int32)
 
     def reconverge():
         snap = snapshots.get(ls)
         plan = snap.patch_plan()
-        if plan is None:
-            # full (re)compile: upload the whole matrix
+        ids = pad_patch_rows(plan[0]) if plan is not None else None
+        if ids is None:
+            # full (re)compile or oversized change: upload the whole matrix
             state["metric_dev"] = jnp.asarray(snap.metric)
             ids = noop_ids
-        else:
-            rows, _ = plan
-            bkt = _PATCH_BUCKET
-            while bkt < len(rows):
-                bkt *= 2
-            ids = np.full(bkt, rows[0], dtype=np.int32)
-            ids[: len(rows)] = rows
         vals = snap.metric[ids, :]
         # one fused dispatch: scatter + batched SPF + first hops. The
         # overloaded mask rides along on every step (patch_plan covers

@@ -51,6 +51,20 @@ def _padded(n: int) -> int:
     return max(_PAD, ((n + _PAD - 1) // _PAD) * _PAD)
 
 
+def pad_patch_rows(rows: np.ndarray) -> Optional[np.ndarray]:
+    """Pad changed-row ids up to the shared bucket sizes (jit programs
+    specialize per bucket; padding repeats the first row, an idempotent
+    scatter). Returns None when the change exceeds the largest bucket —
+    callers should fall back to a full matrix upload instead of compiling
+    ever-larger scatter programs."""
+    if len(rows) > _PATCH_BUCKETS[-1]:
+        return None
+    bucket = next(b for b in _PATCH_BUCKETS if b >= max(1, len(rows)))
+    ids = np.full(bucket, rows[0] if len(rows) else 0, dtype=np.int32)
+    ids[: len(rows)] = rows
+    return ids
+
+
 @dataclass
 class DirectedLink:
     """Host-side metadata for one direction of one up link."""
@@ -142,17 +156,13 @@ class GraphSnapshot:
 
         parent = self._parent
         rows = self._changed_rows
+        padded_rows = pad_patch_rows(rows) if rows is not None else None
         if (
             parent is not None
             and parent._dev is not None
-            and rows is not None
-            and len(rows) <= _PATCH_BUCKETS[-1]
+            and padded_rows is not None
         ):
             p_metric = parent._dev.metric
-            bucket = next(b for b in _PATCH_BUCKETS if b >= max(1, len(rows)))
-            padded_rows = np.full(bucket, rows[0] if len(rows) else 0,
-                                  dtype=np.int32)
-            padded_rows[: len(rows)] = rows
             metric_dev = _patch_rows(
                 p_metric,
                 jnp.asarray(padded_rows),

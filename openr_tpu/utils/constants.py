@@ -41,10 +41,14 @@ DEFAULT_SOURCE_PREFERENCE = 200
 # MPLS label ranges (reference: Constants.h kSrGlobalRange / kSrLocalRange)
 SR_GLOBAL_RANGE = (101, 49999)
 SR_LOCAL_RANGE = (50000, 59999)
-MPLS_LABEL_MIN = 16
 MPLS_LABEL_MAX = (1 << 20) - 1
 
 
 def is_mpls_label_valid(label: int) -> bool:
-    """reference: openr/common/Util.h isMplsLabelValid"""
-    return MPLS_LABEL_MIN <= label <= MPLS_LABEL_MAX
+    """Label fits in 20 bits. The reference deliberately does NOT reject
+    the reserved 0-15 range (reference: openr/common/Util.h:284
+    isMplsLabelValid, '(mplsLabel & 0xfff00000) == 0'). Label 0 is
+    filtered by the MPLS label-route loops (buildRouteDb's 'topLabel == 0'
+    guards); the unicast PUSH path intentionally accepts it — the
+    reference pushes a 0 node label too (Decision.cpp:1287-1292)."""
+    return 0 <= label <= MPLS_LABEL_MAX

@@ -1,0 +1,557 @@
+"""Decision parity corpus, part 2: scenarios from the reference golden
+suite (openr/decision/tests/DecisionTest.cpp) not covered by
+test_spf_solver / test_decision_module / test_bgp_lfa / test_multiarea.
+
+All written fresh against our API; the reference citations mark which
+case each test mirrors.
+"""
+
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision, DecisionPendingUpdates
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.graph.linkstate import LinkState, LinkStateChange
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    IpPrefix,
+    MplsActionCode,
+    PerfEvents,
+    PrefixDatabase,
+    PrefixEntry,
+)
+from tests.test_decision_module import DecisionHarness, line_topology
+from tests.test_linkstate import adj, db
+
+
+def prefix_db(node, prefixes, area="0"):
+    return PrefixDatabase(
+        this_node_name=node,
+        prefix_entries=tuple(
+            PrefixEntry(prefix=IpPrefix.from_str(p)) for p in prefixes
+        ),
+        area=area,
+    )
+
+
+def network(adj_dbs, prefix_dbs, area="0"):
+    ls = LinkState(area=area)
+    for a in adj_dbs:
+        ls.update_adjacency_database(a)
+    ps = PrefixState()
+    for p in prefix_dbs:
+        ps.update_prefix_database(p)
+    return {area: ls}, ps
+
+
+class TestShortestPathEdgeCases:
+    """reference: DecisionTest.cpp:404-530 (ShortestPathTest group)."""
+
+    def test_unreachable_nodes(self):
+        # two nodes with no adjacencies at all: no routes, no labels
+        area_ls, ps = network(
+            [db("1", [], node_label=1), db("2", [], node_label=2)],
+            [prefix_db("1", ["fd00:1::/64"]), prefix_db("2", ["fd00:2::/64"])],
+        )
+        for node in ("1", "2"):
+            rdb = SpfSolver(node).build_route_db(node, area_ls, ps)
+            assert rdb is not None
+            assert len(rdb.unicast_routes) == 0
+            # own POP label still programmed
+            assert all(
+                next(iter(e.nexthops)).mpls_action.action
+                == MplsActionCode.POP_AND_LOOKUP
+                for e in rdb.mpls_routes.values()
+            )
+
+    def test_missing_neighbor_adjacency_db(self):
+        # R1 declares adj to R2, but R2's AdjDb was never received:
+        # the link is not bidirectional, R2 unreachable
+        area_ls, ps = network(
+            [db("1", [adj("2", "if_12", "if_21")])],
+            [prefix_db("1", ["fd00:1::/64"]), prefix_db("2", ["fd00:2::/64"])],
+        )
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        assert rdb is not None
+        assert len(rdb.unicast_routes) == 0
+
+    def test_empty_neighbor_adjacency_db(self):
+        # R2's AdjDb exists but lists no adjacency back to R1
+        area_ls, ps = network(
+            [db("1", [adj("2", "if_12", "if_21")]), db("2", [])],
+            [prefix_db("1", ["fd00:1::/64"]), prefix_db("2", ["fd00:2::/64"])],
+        )
+        for node in ("1", "2"):
+            rdb = SpfSolver(node).build_route_db(node, area_ls, ps)
+            assert rdb is not None
+            assert len(rdb.unicast_routes) == 0
+
+    def test_unknown_node_returns_none(self):
+        # empty link state: buildRouteDb has no graph for the node
+        area_ls, ps = network([], [])
+        assert SpfSolver("1").build_route_db("1", area_ls, ps) is None
+        assert SpfSolver("2").build_route_db("2", area_ls, ps) is None
+
+
+class TestAdjacencyUpdate:
+    """reference: DecisionTest.cpp:531 SpfSolver.AdjacencyUpdate —
+    change-flag classification drives full-rebuild decisions."""
+
+    def test_change_flag_sequence(self):
+        ls = LinkState(area="0")
+        db1 = db("1", [adj("2", "if_12", "if_21", metric=10)], node_label=1)
+        db2 = db("2", [adj("1", "if_21", "if_12", metric=10)], node_label=2)
+
+        c = ls.update_adjacency_database(db1)
+        assert not c.topology_changed
+        assert c.node_label_changed
+        c = ls.update_adjacency_database(db2)
+        assert c.topology_changed  # link came up (bidirectional now)
+        assert c.node_label_changed
+
+        # identical resend: nothing changed
+        c = ls.update_adjacency_database(db2)
+        assert c == LinkStateChange(False, False, False)
+
+        # nexthop address change: link attributes only, no topology change
+        db1_nh = db(
+            "1",
+            [
+                Adjacency(
+                    other_node_name="2",
+                    if_name="if_12",
+                    other_if_name="if_21",
+                    metric=10,
+                    next_hop_v6=b"\xfe\x80" + b"\x00" * 12 + b"\xb0\x0c",
+                )
+            ],
+            node_label=1,
+        )
+        c = ls.update_adjacency_database(db1_nh)
+        assert not c.topology_changed
+        assert c.link_attributes_changed
+
+        # adj label change: link attributes only
+        db1_lbl = db(
+            "1",
+            [
+                Adjacency(
+                    other_node_name="2",
+                    if_name="if_12",
+                    other_if_name="if_21",
+                    metric=10,
+                    next_hop_v6=b"\xfe\x80" + b"\x00" * 12 + b"\xb0\x0c",
+                    adj_label=111,
+                )
+            ],
+            node_label=1,
+        )
+        c = ls.update_adjacency_database(db1_lbl)
+        assert not c.topology_changed
+        assert c.link_attributes_changed
+
+        # node label change alone
+        db1_node_lbl = db(
+            "1",
+            [
+                Adjacency(
+                    other_node_name="2",
+                    if_name="if_12",
+                    other_if_name="if_21",
+                    metric=10,
+                    next_hop_v6=b"\xfe\x80" + b"\x00" * 12 + b"\xb0\x0c",
+                    adj_label=111,
+                )
+            ],
+            node_label=11,
+        )
+        c = ls.update_adjacency_database(db1_node_lbl)
+        assert not c.topology_changed
+        assert not c.link_attributes_changed
+        assert c.node_label_changed
+
+    def test_route_counts_both_perspectives(self):
+        # 1 unicast (peer prefix) + 3 mpls (own POP, peer node, adj) each
+        area_ls, ps = network(
+            [
+                db("1", [adj("2", "if_12", "if_21", adj_label=9001)],
+                   node_label=1),
+                db("2", [adj("1", "if_21", "if_12", adj_label=9002)],
+                   node_label=2),
+            ],
+            [prefix_db("1", ["fd00:1::/64"]), prefix_db("2", ["fd00:2::/64"])],
+        )
+        for node in ("1", "2"):
+            rdb = SpfSolver(node).build_route_db(node, area_ls, ps)
+            assert len(rdb.unicast_routes) == 1
+            assert len(rdb.mpls_routes) == 3
+
+
+class TestMplsOneSided:
+    """reference: DecisionTest.cpp:670 MplsRoutes.BasicTest — label
+    routes across a mix of one-sided and bidirectional links."""
+
+    def test_label_routes(self):
+        # 1 -> 2 one-sided (2 never declares 1); 2 <-> 3 bidirectional.
+        # Node 2 has no node label.
+        area_ls, ps = network(
+            [
+                db("1", [adj("2", "if_12", "if_21")], node_label=1),
+                db("2", [adj("3", "if_23", "if_32", adj_label=9023)],
+                   node_label=0),
+                db("3", [adj("2", "if_32", "if_23", adj_label=9032)],
+                   node_label=3),
+            ],
+            [],
+        )
+        total = 0
+        per_node = {}
+        for node in ("1", "2", "3"):
+            rdb = SpfSolver(node).build_route_db(node, area_ls, ps)
+            per_node[node] = rdb.mpls_routes
+            total += len(rdb.mpls_routes)
+        assert total == 5
+        # 1: own POP only (its link is not bidirectional)
+        assert set(per_node["1"]) == {1}
+        # 2: adj label + swap/php toward 3's node label
+        assert set(per_node["2"]) == {9023, 3}
+        # 3: own POP + adj label (2 has no node label to route toward)
+        assert set(per_node["3"]) == {3, 9032}
+
+
+class TestDuplicateNodeLabels:
+    """reference: DecisionTest.cpp:1946 DuplicateMplsRoutes — when two
+    nodes claim the same node label, the smaller node name wins."""
+
+    def test_smaller_name_wins(self):
+        area_ls, ps = network(
+            [
+                db("1", [adj("2", "if_12", "if_21")], node_label=7),
+                db(
+                    "2",
+                    [
+                        adj("1", "if_21", "if_12"),
+                        adj("3", "if_23", "if_32"),
+                    ],
+                    node_label=2,
+                ),
+                db("3", [adj("2", "if_32", "if_23")], node_label=7),
+            ],
+            [],
+        )
+        rdb = SpfSolver("2").build_route_db("2", area_ls, ps)
+        entry = rdb.mpls_routes[7]
+        # label 7 belongs to node "1" (smaller name), so 2's route for it
+        # points at 1, not 3
+        (nh,) = entry.nexthops
+        assert nh.neighbor_node_name == "1"
+
+
+class TestConnectivity:
+    """reference: DecisionTest.cpp:1214 GraphConnectedOrPartitioned."""
+
+    def test_partition_and_heal(self):
+        p1 = prefix_db("1", ["fd00:1::/64"])
+        p2 = prefix_db("2", ["fd00:2::/64"])
+        # partitioned: no adjacency between 1 and 2
+        area_ls, ps = network([db("1", []), db("2", [])], [p1, p2])
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        assert len(rdb.unicast_routes) == 0
+
+        # heal: both declare the adjacency
+        ls = area_ls["0"]
+        ls.update_adjacency_database(db("1", [adj("2", "if_12", "if_21")]))
+        change = ls.update_adjacency_database(
+            db("2", [adj("1", "if_21", "if_12")])
+        )
+        assert change.topology_changed
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        assert IpPrefix.from_str("fd00:2::/64") in rdb.unicast_routes
+
+
+class TestOverloadedLink:
+    """reference: DecisionTest.cpp:2936 OverloadLinkTest — an adjacency
+    marked overloaded (hard-drained link) carries no transit traffic."""
+
+    def test_overloaded_link_takes_detour(self):
+        # triangle: 1-2 direct (metric 1, but overloaded), 1-3-2 (cost 20)
+        area_ls, ps = network(
+            [
+                db(
+                    "1",
+                    [
+                        adj("2", "if_12", "if_21", metric=1, overloaded=True),
+                        adj("3", "if_13", "if_31", metric=10),
+                    ],
+                ),
+                db(
+                    "2",
+                    [
+                        adj("1", "if_21", "if_12", metric=1, overloaded=True),
+                        adj("3", "if_23", "if_32", metric=10),
+                    ],
+                ),
+                db(
+                    "3",
+                    [
+                        adj("1", "if_31", "if_13", metric=10),
+                        adj("2", "if_32", "if_23", metric=10),
+                    ],
+                ),
+            ],
+            [prefix_db("2", ["fd00:2::/64"])],
+        )
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        entry = rdb.unicast_routes[IpPrefix.from_str("fd00:2::/64")]
+        (nh,) = entry.nexthops
+        assert nh.neighbor_node_name == "3"
+        assert nh.metric == 20
+
+    def test_link_overload_one_direction_suffices(self):
+        # overload declared by only one endpoint still drains the link
+        # (reference: Link::isOverloaded is an OR of both directions)
+        area_ls, ps = network(
+            [
+                db("1", [adj("2", "if_12", "if_21", overloaded=True)]),
+                db("2", [adj("1", "if_21", "if_12")]),
+            ],
+            [prefix_db("2", ["fd00:2::/64"])],
+        )
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        assert len(rdb.unicast_routes) == 0
+
+
+class TestParallelAdjacencies:
+    """reference: DecisionTest.cpp:3374 ParallelAdjRing MultiPathTest —
+    ECMP across parallel links between the same node pair."""
+
+    def test_equal_cost_parallel_links_both_used(self):
+        area_ls, ps = network(
+            [
+                db(
+                    "1",
+                    [
+                        adj("2", "if1_12", "if1_21", metric=5),
+                        adj("2", "if2_12", "if2_21", metric=5),
+                    ],
+                ),
+                db(
+                    "2",
+                    [
+                        adj("1", "if1_21", "if1_12", metric=5),
+                        adj("1", "if2_21", "if2_12", metric=5),
+                    ],
+                ),
+            ],
+            [prefix_db("2", ["fd00:2::/64"])],
+        )
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        entry = rdb.unicast_routes[IpPrefix.from_str("fd00:2::/64")]
+        ifaces = {nh.address.if_name for nh in entry.nexthops}
+        assert ifaces == {"if1_12", "if2_12"}
+        assert all(nh.metric == 5 for nh in entry.nexthops)
+
+    def test_unequal_parallel_links_min_only(self):
+        area_ls, ps = network(
+            [
+                db(
+                    "1",
+                    [
+                        adj("2", "if1_12", "if1_21", metric=5),
+                        adj("2", "if2_12", "if2_21", metric=9),
+                    ],
+                ),
+                db(
+                    "2",
+                    [
+                        adj("1", "if1_21", "if1_12", metric=5),
+                        adj("1", "if2_21", "if2_12", metric=9),
+                    ],
+                ),
+            ],
+            [prefix_db("2", ["fd00:2::/64"])],
+        )
+        rdb = SpfSolver("1").build_route_db("1", area_ls, ps)
+        entry = rdb.unicast_routes[IpPrefix.from_str("fd00:2::/64")]
+        (nh,) = entry.nexthops
+        assert nh.address.if_name == "if1_12"
+        assert nh.metric == 5
+
+
+class TestGridStress:
+    """reference: DecisionTest.cpp:4358 GridTopology.StressTest."""
+
+    def test_grid_100_full_routes(self):
+        topo = topologies.grid(10)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        ps = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            ps.update_prefix_database(pdb)
+        rdb = SpfSolver("node-0").build_route_db(
+            "node-0", {topo.area: ls}, ps
+        )
+        # a route to every other node's loopback
+        assert len(rdb.unicast_routes) == 99
+        # corner-to-corner distance in a 10x10 grid is 18 hops
+        far = topo.prefix_dbs["node-99"].prefix_entries[0].prefix
+        assert min(
+            nh.metric for nh in rdb.unicast_routes[far].nexthops
+        ) == 18
+
+
+class TestDecisionModuleBehaviors:
+    """reference: DecisionTest.cpp DecisionTestFixture cases."""
+
+    @pytest.fixture
+    def harness(self):
+        h = DecisionHarness("a")
+        yield h
+        h.stop()
+
+    def test_no_spf_on_irrelevant_publication(self, harness):
+        # reference: :5621 NoSpfOnIrrelevantPublication
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        runs = harness.decision.get_counters()["decision.route_build_runs"]
+        harness.store.set_key("unrelated:xyz", b"junk", version=1,
+                              originator="x")
+        time.sleep(0.3)
+        assert harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ] == runs
+
+    def test_no_spf_on_duplicate_publication(self, harness):
+        # reference: :5654 NoSpfOnDuplicatePublication — re-announcing
+        # identical LSDB content (bumped version, same value) is a no-op
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        runs = harness.decision.get_counters()["decision.route_build_runs"]
+        harness.publish_adj(topo.adj_dbs["b"])  # identical content
+        harness.publish_prefixes(topo.prefix_dbs["c"])
+        time.sleep(0.3)
+        assert harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ] == runs
+
+    def test_duplicate_prefixes_failover(self, harness):
+        # reference: :5854 DuplicatePrefixes — anycast advertised by two
+        # nodes; when one disappears, traffic shifts to the survivor
+        topo = line_topology()
+        harness.publish_topology(topo)
+        anycast = IpPrefix.from_str("fd00:aaaa::/64")
+        harness.publish_prefixes(
+            PrefixDatabase(
+                this_node_name="b",
+                prefix_entries=topo.prefix_dbs["b"].prefix_entries
+                + (PrefixEntry(prefix=anycast),),
+                area=topo.area,
+            )
+        )
+        harness.publish_prefixes(
+            PrefixDatabase(
+                this_node_name="c",
+                prefix_entries=topo.prefix_dbs["c"].prefix_entries
+                + (PrefixEntry(prefix=anycast),),
+                area=topo.area,
+            )
+        )
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        # b is closer (metric 1) than c (metric 3): b wins
+        assert {
+            nh.neighbor_node_name
+            for nh in routes.unicast_routes[anycast].nexthops
+        } == {"b"}
+
+        # b withdraws: failover to c
+        harness.publish_prefixes(topo.prefix_dbs["b"])
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        assert {
+            nh.neighbor_node_name
+            for nh in routes.unicast_routes[anycast].nexthops
+        } == {"b"}  # still via b: b is the first hop toward c
+        assert routes.unicast_routes[anycast].nexthops == {
+            nh
+            for nh in routes.unicast_routes[
+                topo.prefix_dbs["c"].prefix_entries[0].prefix
+            ].nexthops
+        }
+
+    def test_counters_gauges(self, harness):
+        # reference: :6252 Counters
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        counters = harness.decision.get_counters()
+        assert counters["decision.adj_db_update"] >= 3
+        assert counters["decision.prefix_db_update"] >= 3
+        assert counters["decision.route_build_runs"] >= 1
+        assert counters["decision.publications"] >= 1
+
+
+class TestDecisionPendingUpdates:
+    """reference: DecisionTest.cpp:6485-6545 DecisionPendingUpdates unit
+    group."""
+
+    def test_needs_full_rebuild_on_topology_change(self):
+        p = DecisionPendingUpdates("me")
+        assert not p.needs_full_rebuild()
+        assert not p.needs_route_update()
+        p.apply_link_state_change(
+            "other", LinkStateChange(topology_changed=True)
+        )
+        assert p.needs_full_rebuild()
+        assert p.needs_route_update()
+        p.reset()
+        assert not p.needs_full_rebuild()
+
+    def test_link_attributes_only_matter_for_self(self):
+        p = DecisionPendingUpdates("me")
+        p.apply_link_state_change(
+            "other", LinkStateChange(link_attributes_changed=True)
+        )
+        assert not p.needs_full_rebuild()
+        p.apply_link_state_change(
+            "me", LinkStateChange(link_attributes_changed=True)
+        )
+        assert p.needs_full_rebuild()
+
+    def test_updated_prefixes_accumulate_without_full_rebuild(self):
+        p = DecisionPendingUpdates("me")
+        pfx1 = IpPrefix.from_str("fd00:1::/64")
+        pfx2 = IpPrefix.from_str("fd00:2::/64")
+        p.apply_prefix_state_change({pfx1})
+        p.apply_prefix_state_change({pfx2})
+        assert not p.needs_full_rebuild()
+        assert p.needs_route_update()
+        assert p.updated_prefixes == {pfx1, pfx2}
+        p.reset()
+        assert p.updated_prefixes == set()
+
+    def test_perf_events_keep_oldest_chain(self):
+        p = DecisionPendingUpdates("me")
+        old = PerfEvents()
+        old.add("n1", "FIRST")
+        time.sleep(0.01)
+        new = PerfEvents()
+        new.add("n2", "SECOND")
+        p.apply_prefix_state_change(
+            {IpPrefix.from_str("fd00:1::/64")}, new
+        )
+        p.apply_prefix_state_change(
+            {IpPrefix.from_str("fd00:2::/64")}, old
+        )
+        events = p.move_out_events()
+        assert events is not None
+        names = [e.event_descr for e in events.events]
+        assert "FIRST" in names  # oldest chain won
+        assert p.move_out_events() is None
