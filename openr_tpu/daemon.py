@@ -174,6 +174,22 @@ class OpenrNode:
         self.link_monitor.start()
         self.decision.start()
         self.fib.start()
+        # plugin hook, after all modules are live (reference:
+        # Main.cpp:595-601 pluginStart with the queue endpoints)
+        from openr_tpu import plugin
+
+        if plugin.has_plugin():
+            plugin.plugin_start(
+                plugin.PluginArgs(
+                    prefix_updates_queue=self.prefix_updates,
+                    static_routes_queue=self.static_routes,
+                    route_updates_reader=self.route_updates.get_reader(
+                        f"plugin:{self.name}"
+                    ),
+                    config=getattr(self.ctrl_handler, "_config", None),
+                )
+            )
+            self._plugin_started = True
         self._started = True
 
     def start_ctrl_server(self, port: int = 0) -> int:
@@ -188,7 +204,13 @@ class OpenrNode:
     def stop(self) -> None:
         if not self._started:
             return
-        # reverse order teardown (reference: Main.cpp:604-654)
+        # reverse order teardown (reference: Main.cpp:604-654; pluginStop
+        # first, before the queues it reads from close)
+        if getattr(self, "_plugin_started", False):
+            from openr_tpu import plugin
+
+            plugin.plugin_stop()
+            self._plugin_started = False
         if self.ctrl_server is not None:
             self.ctrl_server.stop()
         self.fib.stop()

@@ -20,7 +20,7 @@ predecessor links (paths are short; the SPF runs behind them are memoized).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -126,6 +126,27 @@ def get_prefix_forwarding_type_and_algorithm(
         ):
             break
     return (ftype, falgo)
+
+
+# Alternate solver backends registered by plugins (the north-star
+# "drop-in SpfSolver implementation" hook; reference: the pluginStart
+# registration point, openr/plugin/Plugin.h:24-34). A factory takes
+# (link_state, root) and returns an object implementing the SpfView
+# query protocol: is_reachable / metric_to / next_hops_toward /
+# metric_between.
+_SPF_BACKENDS: Dict[str, "Callable[[LinkState, str], object]"] = {}
+
+
+def register_spf_backend(name: str, factory) -> None:
+    """Register a custom SPF view backend usable as
+    ``SpfSolver(..., backend=name)``. Built-in names ("device", "native",
+    "host") cannot be overridden."""
+    assert name not in ("device", "native", "host"), name
+    _SPF_BACKENDS[name] = factory
+
+
+def unregister_spf_backend(name: str) -> None:
+    _SPF_BACKENDS.pop(name, None)
 
 
 class SpfView:
@@ -346,7 +367,12 @@ class SpfSolver:
                 for k, v in self._views.items()
                 if not (k[0] == key[0] and k[1] != key[1])
             }
-            view = SpfView(ls, root, self.backend)
+            factory = _SPF_BACKENDS.get(self.backend)
+            view = (
+                factory(ls, root)
+                if factory is not None
+                else SpfView(ls, root, self.backend)
+            )
             self._views[key] = view
         return view
 

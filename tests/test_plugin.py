@@ -1,0 +1,138 @@
+"""Plugin hook tests (reference: openr/plugin/Plugin.h:24-34 pluginStart
+/ pluginStop, invoked from Main.cpp:595-601) and the alternate SPF
+backend registration point."""
+
+import time
+
+import pytest
+
+from openr_tpu import plugin
+from openr_tpu.daemon import OpenrNode
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import (
+    SpfSolver,
+    SpfView,
+    register_spf_backend,
+    unregister_spf_backend,
+)
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.spark.io_provider import MockIoProvider
+from openr_tpu.types import MplsRoute, NextHop, BinaryAddress
+from openr_tpu.types.fib import RouteDatabaseDelta
+
+
+@pytest.fixture(autouse=True)
+def clean_registration():
+    yield
+    plugin.unregister_plugin()
+
+
+def two_node_net():
+    io = MockIoProvider()
+    io.connect_pair("if_ab", "if_ba", 5)
+    registry = {}
+    nodes = [
+        OpenrNode(n, io, node_registry=registry, solver_backend="host",
+                  spark_config=dict(
+                      hello_interval_s=0.05,
+                      fast_hello_interval_s=0.02,
+                      handshake_interval_s=0.02,
+                      heartbeat_interval_s=0.05,
+                      hold_time_s=1.0,
+                  ))
+        for n in ("a", "b")
+    ]
+    return io, nodes
+
+
+class TestPluginHook:
+    def test_default_noop(self):
+        # nothing registered: plugin_start / plugin_stop are safe no-ops
+        assert not plugin.has_plugin()
+        plugin.plugin_stop()  # never started; still a no-op
+
+    def test_plugin_receives_args_and_injects_static_routes(self):
+        # the hook fires once per daemon instance (this test process runs
+        # two); a real deployment has one daemon per process, like the
+        # reference
+        received = []
+
+        def start(args: plugin.PluginArgs):
+            received.append(args)
+            # inject a static MPLS route the way a BGP speaker would
+            args.static_routes_queue.push(
+                RouteDatabaseDelta(
+                    this_node_name="a",
+                    mpls_routes_to_update=[
+                        MplsRoute(
+                            top_label=60001,
+                            next_hops=[
+                                NextHop(
+                                    address=BinaryAddress.from_str("fd00::99")
+                                )
+                            ],
+                        )
+                    ],
+                )
+            )
+
+        stopped = []
+        plugin.register_plugin(start, lambda: stopped.append(True))
+
+        io, nodes = two_node_net()
+        a, b = nodes
+        try:
+            for n in nodes:
+                n.start()
+            for n in nodes:
+                n.add_interface(f"if_{'ab' if n.name == 'a' else 'ba'}")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                routes = a.decision.get_decision_route_db()
+                if 60001 in routes.mpls_routes:
+                    break
+                time.sleep(0.1)
+            assert len(received) == 2
+            assert any(
+                args.static_routes_queue is a.static_routes
+                for args in received
+            )
+            routes = a.decision.get_decision_route_db()
+            assert 60001 in routes.mpls_routes
+        finally:
+            for n in nodes:
+                n.stop()
+            io.stop()
+        assert stopped == [True, True]  # once per daemon instance
+
+
+class TestSpfBackendRegistration:
+    def test_custom_backend_drop_in(self):
+        # a custom backend delegating to the host oracle must produce the
+        # exact same RouteDatabase as the built-in host backend
+        register_spf_backend(
+            "my-tpu-solver", lambda ls, root: SpfView(ls, root, "host")
+        )
+        try:
+            topo = topologies.random_mesh(12, degree=3, seed=1, max_metric=9)
+            ls = LinkState(area=topo.area)
+            for name in sorted(topo.adj_dbs):
+                ls.update_adjacency_database(topo.adj_dbs[name])
+            ps = PrefixState()
+            for pdb in topo.prefix_dbs.values():
+                ps.update_prefix_database(pdb)
+            area_ls = {topo.area: ls}
+            custom = SpfSolver("node-0", backend="my-tpu-solver").build_route_db(
+                "node-0", area_ls, ps
+            )
+            stock = SpfSolver("node-0", backend="host").build_route_db(
+                "node-0", area_ls, ps
+            )
+            assert custom.to_route_db("node-0") == stock.to_route_db("node-0")
+        finally:
+            unregister_spf_backend("my-tpu-solver")
+
+    def test_builtin_names_protected(self):
+        with pytest.raises(AssertionError):
+            register_spf_backend("device", lambda ls, root: None)
