@@ -1,0 +1,65 @@
+"""PersistentStore benchmark: write/load at 10-10k keys.
+
+Mirrors openr/config-store/tests/PersistentStoreBenchmark.cpp:161-174.
+
+Run:  python -m benchmarks.bench_config_store [--full]
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from openr_tpu.config_store.persistent_store import PersistentStore
+
+
+def bench(n):
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "store.bin")
+        store = PersistentStore(path, save_throttle_s=0.0)
+        try:
+            payload = {"drained": True, "seq": list(range(8))}
+            t0 = time.perf_counter()
+            for i in range(n):
+                store.store(f"key-{i}", payload)
+            write_ms = (time.perf_counter() - t0) * 1000
+        finally:
+            store.stop()
+
+        # cold load from disk
+        store2 = PersistentStore(path, save_throttle_s=0.0)
+        try:
+            t0 = time.perf_counter()
+            loaded = sum(
+                1 for i in range(n) if store2.load(f"key-{i}") is not None
+            )
+            load_ms = (time.perf_counter() - t0) * 1000
+            assert loaded == n
+        finally:
+            store2.stop()
+    print(
+        json.dumps(
+            {
+                "bench": f"config_store.{n}_keys",
+                "write_ms": round(write_ms, 2),
+                "load_ms": round(load_ms, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--full", action="store_true")
+    args = p.parse_args(argv)
+    for n in [10, 100, 1000] + ([10000] if args.full else []):
+        bench(n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""Decision pipeline benchmark: full route build + incremental churn.
+
+Mirrors the reference parameter grids
+(openr/decision/tests/DecisionBenchmark.cpp:12-29 — BM_DecisionGrid at
+10/100/1000[/10000] nodes SP_ECMP and 10/100 KSP2_ED_ECMP,
+BM_DecisionFabric at 344/1000 SP_ECMP; fixture generators
+openr/decision/tests/RoutingBenchmarkUtils.cpp:205 createGrid, :356
+createFabric). Each case measures (a) the cold full route build and
+(b) the incremental rebuild after one adjacency metric change, through
+the same SpfSolver the daemon uses.
+
+Run:  python -m benchmarks.bench_decision [--backend device|host|native]
+      [--full]   # adds the 10000-node grid / 5000-node fabric points
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import time
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types import (
+    Adjacency,
+    AdjacencyDatabase,
+    PrefixEntry,
+)
+from openr_tpu.types.lsdb import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def load(topo, forwarding=None):
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        if forwarding is not None:
+            ftype, falgo = forwarding
+            pdb = type(pdb)(
+                this_node_name=pdb.this_node_name,
+                prefix_entries=tuple(
+                    PrefixEntry(
+                        prefix=e.prefix,
+                        type=e.type,
+                        forwarding_type=ftype,
+                        forwarding_algorithm=falgo,
+                    )
+                    for e in pdb.prefix_entries
+                ),
+                area=pdb.area,
+            )
+        ps.update_prefix_database(pdb)
+    return ls, ps
+
+
+def churn_one_metric(ls, node, step):
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    a0 = adjs[0]
+    adjs[0] = Adjacency(
+        other_node_name=a0.other_node_name,
+        if_name=a0.if_name,
+        other_if_name=a0.other_if_name,
+        metric=2 + step % 5,
+        next_hop_v6=a0.next_hop_v6,
+        next_hop_v4=a0.next_hop_v4,
+        adj_label=a0.adj_label,
+    )
+    ls.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=db.this_node_name,
+            is_overloaded=db.is_overloaded,
+            adjacencies=tuple(adjs),
+            node_label=db.node_label,
+            area=db.area,
+        )
+    )
+
+
+def run_case(name, topo, my_node, churn_node, backend, forwarding=None,
+             iters=3):
+    ls, ps = load(topo, forwarding)
+    area_ls = {topo.area: ls}
+    solver = SpfSolver(my_node, backend=backend)
+
+    t0 = time.perf_counter()
+    rdb = solver.build_route_db(my_node, area_ls, ps)
+    cold_ms = (time.perf_counter() - t0) * 1000
+    n_routes = len(rdb.unicast_routes) if rdb else 0
+
+    samples = []
+    for it in range(iters):
+        churn_one_metric(ls, churn_node, it)
+        t0 = time.perf_counter()
+        solver.build_route_db(my_node, area_ls, ps)
+        samples.append((time.perf_counter() - t0) * 1000)
+    print(
+        json.dumps(
+            {
+                "bench": f"decision.{name}",
+                "backend": backend,
+                "nodes": len(topo.adj_dbs),
+                "unicast_routes": n_routes,
+                "cold_build_ms": round(cold_ms, 2),
+                "churn_rebuild_ms": round(statistics.median(samples), 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", default="device",
+                   choices=["device", "host", "native"])
+    p.add_argument("--full", action="store_true",
+                   help="include the largest (slow) parameter points")
+    args = p.parse_args(argv)
+
+    grid_sizes = [10, 100, 1000] + ([10000] if args.full else [])
+    for n in grid_sizes:
+        side = max(2, int(n ** 0.5))
+        topo = topologies.grid(side)
+        run_case(
+            f"grid_{side * side}_sp_ecmp", topo, "node-0", "node-1",
+            args.backend,
+        )
+
+    ksp2 = (PrefixForwardingType.SR_MPLS,
+            PrefixForwardingAlgorithm.KSP2_ED_ECMP)
+    for n in [10, 100]:
+        side = max(2, int(n ** 0.5))
+        topo = topologies.grid(side)
+        run_case(
+            f"grid_{side * side}_ksp2_ed_ecmp", topo, "node-0", "node-1",
+            args.backend, forwarding=ksp2,
+        )
+
+    fabric_sizes = [344, 1000] + ([5000] if args.full else [])
+    for n in fabric_sizes:
+        topo = topologies.fat_tree_nodes(n)
+        rsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("rsw"))
+        fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+        run_case(
+            f"fabric_{len(topo.adj_dbs)}_sp_ecmp", topo, rsw, fsw,
+            args.backend,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Fib route-programming benchmark: 10-9000 routes.
+
+Mirrors openr/fib/tests/FibBenchmark.cpp:286-289 — time from pushing a
+DecisionRouteUpdate to the routes being programmed in the (mock)
+platform agent, plus incremental single-route updates against a full
+table.
+
+Run:  python -m benchmarks.bench_fib [--full]
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.platform.fib_service import MockFibAgent
+from openr_tpu.types import (
+    BinaryAddress,
+    IpPrefix,
+    NextHop,
+    PrefixEntry,
+)
+
+
+def make_entry(i):
+    prefix = IpPrefix.from_str(f"fd00:{i >> 8:x}:{i & 0xff:x}::/64")
+    return RibUnicastEntry(
+        prefix=prefix,
+        nexthops={
+            NextHop(
+                address=BinaryAddress.from_str("fe80::1", if_name="eth0"),
+                metric=10,
+            )
+        },
+        best_prefix_entry=PrefixEntry(prefix=prefix),
+        best_area="0",
+    )
+
+
+def wait_for(predicate, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+def bench_program(n):
+    agent = MockFibAgent()
+    route_q = ReplicateQueue(name="bench:routeUpdates")
+    fib = Fib("bench-node", agent, route_q)
+    fib.start()
+    try:
+        update = DecisionRouteUpdate(
+            unicast_routes_to_update={
+                (e := make_entry(i)).prefix: e for i in range(n)
+            }
+        )
+        t0 = time.perf_counter()
+        route_q.push(update)
+        ok = wait_for(lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) >= n)
+        program_ms = (time.perf_counter() - t0) * 1000
+        assert ok, "routes never landed in the agent"
+
+        # incremental: one route against the full table
+        extra = make_entry(n + 1)
+        t0 = time.perf_counter()
+        route_q.push(
+            DecisionRouteUpdate(
+                unicast_routes_to_update={extra.prefix: extra}
+            )
+        )
+        ok = wait_for(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) >= n + 1
+        )
+        incr_ms = (time.perf_counter() - t0) * 1000
+        assert ok
+        print(
+            json.dumps(
+                {
+                    "bench": f"fib.program_{n}_routes",
+                    "program_ms": round(program_ms, 2),
+                    "incremental_1_route_ms": round(incr_ms, 2),
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        fib.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--full", action="store_true")
+    args = p.parse_args(argv)
+    sizes = [10, 100, 1000] + ([9000] if args.full else [])
+    for n in sizes:
+        bench_program(n)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,135 @@
+"""KvStore benchmark: merge / dump / flood at 10-10k keys.
+
+Mirrors openr/kvstore/tests/KvStoreBenchmark.cpp:294-312 (mergeKeyValues
+and dumpAll at 10/100/1000/10000 keys, flood propagation between peered
+stores).
+
+Run:  python -m benchmarks.bench_kvstore [--full]
+Prints one JSON line per case.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from openr_tpu.kvstore.store import merge_key_values
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+from openr_tpu.types.kvstore import Value
+
+
+def make_kvs(n, version=1):
+    return {
+        f"prefix:node-{i}": Value(
+            version=version,
+            originator_id=f"node-{i}",
+            value=(b"v" * 100) + str(i).encode(),
+            ttl=-1,
+            ttl_version=0,
+        )
+        for i in range(n)
+    }
+
+
+def bench_merge(n, iters=10):
+    base = make_kvs(n, version=1)
+    incoming = make_kvs(n, version=2)
+    samples = []
+    for _ in range(iters):
+        store = dict(base)
+        t0 = time.perf_counter()
+        merge_key_values(store, incoming)
+        samples.append((time.perf_counter() - t0) * 1000)
+    print(
+        json.dumps(
+            {
+                "bench": f"kvstore.merge_{n}_keys",
+                "merge_ms": round(min(samples), 3),
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_dump(n, iters=10):
+    store = KvStoreWrapper(f"dump-{n}")
+    store.start()
+    try:
+        for key, val in make_kvs(n).items():
+            store.set_key(key, val.value, version=1,
+                          originator=val.originator_id)
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            dumped = store.dump()
+            samples.append((time.perf_counter() - t0) * 1000)
+        assert len(dumped) == n
+        print(
+            json.dumps(
+                {
+                    "bench": f"kvstore.dump_{n}_keys",
+                    "dump_ms": round(min(samples), 3),
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        store.stop()
+
+
+def bench_flood(n):
+    """Time for n keys set on store A to appear on peered store B."""
+    a = KvStoreWrapper(f"flood-a-{n}")
+    b = KvStoreWrapper(f"flood-b-{n}")
+    a.start()
+    b.start()
+    try:
+        link_bidirectional(a, b)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            states = dict(a.peer_states())
+            if all(s == "INITIALIZED" for s in states.values()) and states:
+                break
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        for key, val in make_kvs(n).items():
+            a.set_key(key, val.value, version=1,
+                      originator=val.originator_id)
+        last_key = f"prefix:node-{n - 1}"
+        deadline = time.time() + max(30.0, n * 0.01)
+        while time.time() < deadline:
+            if b.get_key(last_key) is not None and len(b.dump()) >= n:
+                break
+            time.sleep(0.005)
+        flood_ms = (time.perf_counter() - t0) * 1000
+        assert len(b.dump()) >= n, "flood did not converge"
+        print(
+            json.dumps(
+                {
+                    "bench": f"kvstore.flood_{n}_keys",
+                    "flood_ms": round(flood_ms, 3),
+                }
+            ),
+            flush=True,
+        )
+    finally:
+        a.stop()
+        b.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--full", action="store_true")
+    args = p.parse_args(argv)
+    sizes = [10, 100, 1000] + ([10000] if args.full else [])
+    for n in sizes:
+        bench_merge(n)
+    for n in sizes:
+        bench_dump(n)
+    for n in sizes:
+        bench_flood(n)
+
+
+if __name__ == "__main__":
+    main()

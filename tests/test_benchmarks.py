@@ -1,0 +1,39 @@
+"""Smoke tests keeping the benchmark harnesses importable and runnable
+at tiny sizes (the reference keeps its benchmark fixtures compiling in
+CI the same way)."""
+
+import json
+
+from benchmarks import bench_config_store, bench_decision, bench_fib
+from benchmarks import bench_kvstore
+from openr_tpu.models import topologies
+
+
+class TestBenchmarkHarnesses:
+    def test_decision_case(self, capsys):
+        topo = topologies.grid(3)
+        bench_decision.run_case(
+            "smoke", topo, "node-0", "node-1", "host", iters=1
+        )
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["bench"] == "decision.smoke"
+        assert out["unicast_routes"] == 8
+        assert out["cold_build_ms"] > 0
+
+    def test_kvstore_merge_and_dump(self, capsys):
+        bench_kvstore.bench_merge(10, iters=2)
+        bench_kvstore.bench_dump(10, iters=2)
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 2
+        assert all(json.loads(l)["bench"].startswith("kvstore.") for l in lines)
+
+    def test_fib_program(self, capsys):
+        bench_fib.bench_program(10)
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["program_ms"] > 0
+        assert out["incremental_1_route_ms"] > 0
+
+    def test_config_store(self, capsys):
+        bench_config_store.bench(10)
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["write_ms"] > 0 and out["load_ms"] > 0
