@@ -37,3 +37,11 @@ class TestBenchmarkHarnesses:
         bench_config_store.bench(10)
         out = json.loads(capsys.readouterr().out.strip())
         assert out["write_ms"] > 0 and out["load_ms"] > 0
+
+    def test_scale(self, capsys):
+        from benchmarks import bench_scale
+
+        bench_scale.main(["--nodes", "100", "--block", "64"])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["oracle_spot_check"] == "passed"
+        assert out["edges"] > 0

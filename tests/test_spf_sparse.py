@@ -1,0 +1,133 @@
+"""Sparse edge-list SPF kernels: parity with the dense kernels, the host
+Dijkstra oracle, and the sharded mesh variant."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.graph.snapshot import INF, compile_snapshot
+from openr_tpu.models import topologies
+from openr_tpu.ops import spf, spf_sparse
+from openr_tpu.types import AdjacencyDatabase
+
+
+def load(topo, overloaded_nodes=()):
+    ls = LinkState(area=topo.area)
+    for name, db in sorted(topo.adj_dbs.items()):
+        if name in overloaded_nodes:
+            db = AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                is_overloaded=True,
+                adjacencies=db.adjacencies,
+                node_label=db.node_label,
+                area=db.area,
+            )
+        ls.update_adjacency_database(db)
+    return ls
+
+
+class TestSparseParity:
+    def assert_matches_oracle(self, ls, use_link_metric=True):
+        graph = spf_sparse.compile_sparse(ls, use_link_metric)
+        src_ids = np.arange(graph.n, dtype=np.int32)
+        d = np.asarray(
+            spf_sparse.sparse_distances_from_sources(graph, src_ids)
+        )
+        for src in graph.node_names:
+            sid = graph.node_index[src]
+            oracle = ls.run_spf(src, use_link_metric)
+            for dst in graph.node_names:
+                did = graph.node_index[dst]
+                want = oracle[dst].metric if dst in oracle else None
+                got = int(d[sid, did])
+                assert (got >= INF) == (want is None), (src, dst)
+                if want is not None:
+                    assert got == want, (src, dst, got, want)
+
+    def test_grid(self):
+        self.assert_matches_oracle(load(topologies.grid(4)))
+
+    def test_random_weighted(self):
+        for seed in range(3):
+            topo = topologies.random_mesh(
+                24, degree=4, seed=seed, max_metric=20
+            )
+            self.assert_matches_oracle(load(topo))
+
+    def test_overloaded_transit(self):
+        topo = topologies.random_mesh(20, degree=4, seed=5, max_metric=9)
+        self.assert_matches_oracle(
+            load(topo, overloaded_nodes={"node-2", "node-9"})
+        )
+
+    def test_overloaded_source_still_originates(self):
+        topo = topologies.grid(3)
+        ls = load(topo, overloaded_nodes={"node-0"})
+        graph = spf_sparse.compile_sparse(ls)
+        d = np.asarray(
+            spf_sparse.sparse_distances_from_sources(
+                graph, [graph.node_index["node-0"]]
+            )
+        )
+        for name in graph.node_names:
+            assert d[0, graph.node_index[name]] < INF
+
+    def test_hop_count_mode(self):
+        topo = topologies.random_mesh(16, degree=3, seed=7, max_metric=40)
+        self.assert_matches_oracle(load(topo), use_link_metric=False)
+
+    def test_matches_dense_kernel(self):
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        ls = load(topo, overloaded_nodes={"fsw-0-0"})
+        snap = compile_snapshot(ls)
+        graph = spf_sparse.compile_sparse(ls)
+        assert snap.node_names == list(graph.node_names)
+        src_ids = np.arange(graph.n, dtype=np.int32)
+        d_sparse = np.asarray(
+            spf_sparse.sparse_distances_from_sources(graph, src_ids)
+        )
+        d_dense = np.asarray(
+            spf.distances_from_sources(
+                jnp.asarray(snap.metric),
+                jnp.asarray(snap.overloaded),
+                jnp.asarray(src_ids),
+            )
+        )
+        np.testing.assert_array_equal(
+            d_sparse[:, : graph.n], d_dense[:, : graph.n]
+        )
+
+
+class TestShardedSparse:
+    @pytest.fixture(scope="class")
+    def mesh8(self):
+        from openr_tpu.parallel import mesh as pmesh
+
+        assert len(jax.devices()) == 8
+        return pmesh.make_mesh(axis_name=spf_sparse.SOURCES_AXIS)
+
+    def test_sharded_matches_unsharded(self, mesh8):
+        topo = topologies.random_mesh(48, degree=4, seed=3, max_metric=15)
+        ls = load(topo, overloaded_nodes={"node-5"})
+        # pad the node axis so rows divide across 8 devices
+        graph = spf_sparse.compile_sparse(ls, align=8)
+        d_sharded = np.asarray(
+            spf_sparse.sharded_sparse_all_sources(graph, mesh8)
+        )
+        d_local = np.asarray(
+            spf_sparse.sparse_distances_from_sources(
+                graph, np.arange(graph.n_pad, dtype=np.int32)
+            )
+        )
+        np.testing.assert_array_equal(d_sharded, d_local)
+
+    def test_padding_rows_inert(self, mesh8):
+        topo = topologies.grid(4)
+        ls = load(topo)
+        graph = spf_sparse.compile_sparse(ls, align=8)
+        d = np.asarray(spf_sparse.sharded_sparse_all_sources(graph, mesh8))
+        assert (d[graph.n :, : graph.n] >= INF).all()
