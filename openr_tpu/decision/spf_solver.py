@@ -149,11 +149,18 @@ def unregister_spf_backend(name: str) -> None:
     _SPF_BACKENDS.pop(name, None)
 
 
+# above this node count the device backend switches from the dense
+# snapshot (O(N^2) metric matrix) to the sparse edge-list kernel
+SPARSE_NODE_THRESHOLD = 4096
+
+
 class SpfView:
     """SPF results for one area as seen from one root node.
 
     Device backend: distances + ECMP first-hop matrix from the jitted
-    kernels over the area snapshot. Host backend: the Dijkstra oracle.
+    kernels over the area snapshot (dense for moderate N, sparse
+    edge-list past SPARSE_NODE_THRESHOLD). Host backend: the Dijkstra
+    oracle.
     """
 
     def __init__(self, ls: LinkState, root: str, backend: str):
@@ -166,7 +173,12 @@ class SpfView:
                 backend = "host"  # toolchain missing: degrade gracefully
         self._backend = backend
         if backend == "device":
-            self._init_device()
+            if (
+                len(ls.get_adjacency_databases()) > SPARSE_NODE_THRESHOLD
+            ):
+                self._init_device_sparse()
+            else:
+                self._init_device()
         elif backend == "native":
             self._init_native()
         else:
@@ -198,6 +210,59 @@ class SpfView:
         self._d = packed_host[:bucket]
         self._fh_batch = packed_host[bucket:].astype(bool)
         self._batch_srcs = srcs  # row i of _d is distances from srcs[i]
+        self._row_of = {nid: i for i, nid in enumerate(srcs)}
+
+    def _init_device_sparse(self) -> None:
+        """Large-area device backend: same batched {source} + neighbors
+        view, but over the sparse edge-list kernel — no dense N x N
+        matrix is ever built (openr_tpu.ops.spf_sparse). First hops are
+        derived host-side from the batch rows (O(B x N) numpy)."""
+        from openr_tpu.ops import spf_sparse
+
+        graph = _SPARSE_GRAPHS.get(self._ls)
+        self._snap = _SparseIndexAdapter(graph)
+        sid = self._snap.id_of(self._root)
+        self._sid = sid
+        self._d_all = None
+        self._fh = None
+        if sid is None:
+            return
+        # direct min-metric per neighbor (parallel links: min wins)
+        w_sv_by_id: Dict[int, int] = {}
+        overloaded_nbr: Dict[int, bool] = {}
+        for link in self._ls.links_from_node(self._root):
+            if not link.is_up():
+                continue
+            other = link.other_node(self._root)
+            oid = graph.node_index.get(other)
+            if oid is None:
+                continue
+            m = int(link.metric_from(self._root))
+            if oid not in w_sv_by_id or m < w_sv_by_id[oid]:
+                w_sv_by_id[oid] = m
+            overloaded_nbr[oid] = self._ls.is_node_overloaded(other)
+        nbrs = sorted(w_sv_by_id)
+        srcs = [sid] + nbrs
+        d = np.asarray(
+            spf_sparse.sparse_distances_from_sources(graph, srcs)
+        )
+        d_src = d[0]
+        reachable = d_src < INF
+        fh = np.zeros((len(srcs), graph.n_pad), dtype=bool)
+        for i, v in enumerate(nbrs):
+            w_sv = w_sv_by_id[v]
+            row = 1 + i
+            if not overloaded_nbr[v]:
+                total = np.minimum(
+                    w_sv + d[row].astype(np.int64), int(INF)
+                )
+                fh[row] = total == d_src
+            if w_sv == d_src[v]:
+                fh[row, v] = True
+            fh[row] &= reachable
+        self._d = d
+        self._fh_batch = fh
+        self._batch_srcs = srcs
         self._row_of = {nid: i for i, nid in enumerate(srcs)}
 
     # -- native backend ---------------------------------------------------
@@ -315,6 +380,45 @@ class SpfView:
 
 
 _SNAPSHOTS = SnapshotCache()
+
+
+class _SparseIndexAdapter:
+    """Gives the sparse device backend the same id_of/node_names surface
+    the dense GraphSnapshot provides to the query methods."""
+
+    __slots__ = ("node_names", "node_index", "n", "n_pad")
+
+    def __init__(self, graph):
+        self.node_names = list(graph.node_names)
+        self.node_index = graph.node_index
+        self.n = graph.n
+        self.n_pad = graph.n_pad
+
+    def id_of(self, node):
+        return self.node_index.get(node)
+
+
+class _SparseGraphCache:
+    """compile_sparse results keyed by LinkState identity + topology
+    version (the sparse analogue of SnapshotCache)."""
+
+    def __init__(self) -> None:
+        import weakref
+
+        self._cache = weakref.WeakKeyDictionary()
+
+    def get(self, ls: LinkState):
+        from openr_tpu.ops import spf_sparse
+
+        entry = self._cache.get(ls)
+        if entry is not None and entry[0] == ls.topology_version:
+            return entry[1]
+        graph = spf_sparse.compile_sparse(ls)
+        self._cache[ls] = (ls.topology_version, graph)
+        return graph
+
+
+_SPARSE_GRAPHS = _SparseGraphCache()
 
 
 class SpfSolver:

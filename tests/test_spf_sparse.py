@@ -102,6 +102,55 @@ class TestSparseParity:
         )
 
 
+class TestSparseSolverBackend:
+    def test_sparse_device_backend_matches_host(self, monkeypatch):
+        """Past SPARSE_NODE_THRESHOLD the device backend switches to the
+        edge-list kernel; the full RouteDatabase must stay identical."""
+        from openr_tpu.decision import spf_solver as ss
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import SpfSolver
+
+        monkeypatch.setattr(ss, "SPARSE_NODE_THRESHOLD", 4)
+        topo = topologies.random_mesh(18, degree=4, seed=2, max_metric=9)
+        ls = load(topo, overloaded_nodes={"node-3"})
+        ps = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            ps.update_prefix_database(pdb)
+        area_ls = {topo.area: ls}
+        sparse_db = SpfSolver("node-0", backend="device").build_route_db(
+            "node-0", area_ls, ps
+        )
+        host_db = SpfSolver("node-0", backend="host").build_route_db(
+            "node-0", area_ls, ps
+        )
+        assert sparse_db.to_route_db("node-0") == host_db.to_route_db(
+            "node-0"
+        )
+
+    def test_sparse_backend_with_lfa(self, monkeypatch):
+        from openr_tpu.decision import spf_solver as ss
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import SpfSolver
+
+        monkeypatch.setattr(ss, "SPARSE_NODE_THRESHOLD", 4)
+        topo = topologies.random_mesh(14, degree=3, seed=6, max_metric=7)
+        ls = load(topo)
+        ps = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            ps.update_prefix_database(pdb)
+        area_ls = {topo.area: ls}
+        kw = dict(compute_lfa_paths=True)
+        sparse_db = SpfSolver(
+            "node-0", backend="device", **kw
+        ).build_route_db("node-0", area_ls, ps)
+        host_db = SpfSolver("node-0", backend="host", **kw).build_route_db(
+            "node-0", area_ls, ps
+        )
+        assert sparse_db.to_route_db("node-0") == host_db.to_route_db(
+            "node-0"
+        )
+
+
 class TestShardedSparse:
     @pytest.fixture(scope="class")
     def mesh8(self):
