@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from openr_tpu.graph.snapshot import pad_patch_rows
 from openr_tpu.ops.spf import INF
 
 _EDGE_PAD = 128
@@ -192,6 +193,364 @@ def sparse_distances_from_sources(graph: SparseGraph, src_ids):
         jnp.asarray(graph.transit_w),
         graph.n_pad,
     )
+
+
+# -- ELL (fixed-slot) format: the incremental-churn shape ----------------
+#
+# The flat edge list above is dst-sorted, so patching one node's edges
+# after a topology change would reshuffle the whole list. The ELL layout
+# gives every node a fixed band of in-edge slots: row j holds
+# (src[j, k], w[j, k]) for every edge INTO j, and relaxation is a pure
+# gather + K-reduce —
+#
+#     d'[s, j] = min(d[s, j], min_k d[s, src[j, k]] + w[j, k])
+#
+# — no scatter/segment-min anywhere (TPU scatters serialize; gathers
+# vectorize).
+#
+# A single uniform band would be sized by the MAX degree, which is
+# catastrophic on degree-skewed graphs (a 10k fat-tree: rack switches
+# have 8 links, spine switches ~600 — a uniform band is ~98% padding and
+# relaxation work becomes O(N x K_max) instead of O(E)). Nodes are
+# therefore renumbered by (degree class, name) so that each power-of-two
+# degree class occupies a contiguous id range with its own right-sized
+# band ("sliced ELL"): total slots stay O(E) and the per-class
+# gather-reduce writes a contiguous output slice — still no scatter.
+#
+# A churn event touches only the affected nodes' band rows (a LinkState
+# link is bidirectional, so a node's in-edges are exactly its own links'
+# reverse directions and the journal's affected set covers them): an
+# O(rows x K_class) device scatter patch, the same resident-array
+# pattern as the dense reconverge_step. This is what makes "1k adj
+# events/s at 10k nodes" (BASELINE.json config 4) feasible: per event,
+# host work and transfer are O(degree), device work O(S x E).
+
+_ELL_SLOT_PAD = 8
+
+
+@dataclass(frozen=True)
+class EllBand:
+    """One degree class: nodes [start, start + rows) hold <= k in-edges."""
+
+    start: int
+    rows: int
+    k: int
+
+
+@dataclass(frozen=True)
+class EllGraph:
+    node_names: Tuple[str, ...]  # index == dense id (class-grouped order!)
+    node_index: Dict[str, int]
+    n: int
+    n_pad: int
+    bands: Tuple[EllBand, ...]  # static per-topology; jit specializes on it
+    src: Tuple[np.ndarray, ...]  # per band [rows, k] int32 (self-loop pad)
+    w: Tuple[np.ndarray, ...]  # per band [rows, k] int32 (INF pad)
+    overloaded: np.ndarray  # [n_pad] bool
+    # band index -> band-local changed row ids, set by ell_patch so
+    # EllState.reconverge scatters only those rows; None == full graph
+    changed: Optional[Dict[int, np.ndarray]] = None
+
+
+def _in_edges(ls, name, index) -> Dict[int, int]:
+    """origin id -> min reverse-direction metric (parallel links: min)."""
+    best: Dict[int, int] = {}
+    for link in ls.ordered_links_from_node(name):
+        if not link.is_up():
+            continue
+        other = link.other_node(name)
+        i = index.get(other)
+        if i is None:
+            continue
+        m = min(int(link.metric_from(other)), int(INF) - 1)
+        if i not in best or m < best[i]:
+            best[i] = m
+    return best
+
+
+def _fill_row(src_row, w_row, edges) -> None:
+    for slot, (i, m) in enumerate(sorted(edges.items())):
+        src_row[slot] = i
+        w_row[slot] = m
+
+
+def _band_of(graph: EllGraph, node_id: int) -> Tuple[int, EllBand]:
+    for bi, band in enumerate(graph.bands):
+        if band.start <= node_id < band.start + band.rows:
+            return bi, band
+    raise KeyError(node_id)
+
+
+def compile_ell(ls, align: int = _NODE_PAD) -> EllGraph:
+    """Sliced-ELL compilation from the LinkState: O(E) host work and
+    O(E) total slots, no dense matrix."""
+    raw_names = sorted(ls.get_adjacency_databases().keys())
+    raw_index = {name: i for i, name in enumerate(raw_names)}
+    degree = {
+        name: max(1, len(_in_edges(ls, name, raw_index)))
+        for name in raw_names
+    }
+    # class id = padded power-of-two >= degree; group by (class, name)
+    def class_k(d: int) -> int:
+        k = _ELL_SLOT_PAD
+        while k < d:
+            k *= 2
+        return k
+
+    names = tuple(
+        sorted(raw_names, key=lambda nm: (class_k(degree[nm]), nm))
+    )
+    index = {name: i for i, name in enumerate(names)}
+    n = len(names)
+    n_pad = _pad_up(n, align)
+
+    bands: List[EllBand] = []
+    srcs: List[np.ndarray] = []
+    ws: List[np.ndarray] = []
+    overloaded = np.zeros(n_pad, dtype=bool)
+    i = 0
+    while i < n:
+        k = class_k(degree[names[i]])
+        j = i
+        while j < n and class_k(degree[names[j]]) == k:
+            j += 1
+        rows = j - i
+        src_b = np.tile(
+            np.arange(i, j, dtype=np.int32)[:, None], (1, k)
+        )  # self-loop padding: inert with w=INF
+        w_b = np.full((rows, k), INF, dtype=np.int32)
+        for r, name in enumerate(names[i:j]):
+            _fill_row(src_b[r], w_b[r], _in_edges(ls, name, index))
+        bands.append(EllBand(start=i, rows=rows, k=k))
+        srcs.append(src_b)
+        ws.append(w_b)
+        i = j
+    for name in names:
+        overloaded[index[name]] = ls.is_node_overloaded(name)
+    return EllGraph(
+        node_names=names, node_index=index, n=n, n_pad=n_pad,
+        bands=tuple(bands), src=tuple(srcs), w=tuple(ws),
+        overloaded=overloaded,
+    )
+
+
+def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
+    """New EllGraph with only the affected nodes' band rows re-derived;
+    ``patched.changed`` maps band index -> band-local row ids. Returns
+    None when the node set changed or a row outgrew its class band
+    (callers fall back to a full compile, which may renumber)."""
+    names = tuple(sorted(ls.get_adjacency_databases().keys()))
+    if len(names) != graph.n or any(
+        nm not in graph.node_index for nm in names
+    ):
+        return None
+    src = list(graph.src)
+    w = list(graph.w)
+    overloaded = graph.overloaded.copy()
+    changed: Dict[int, List[int]] = {}
+    copied: set = set()
+    for name in affected:
+        i = graph.node_index.get(name)
+        if i is None:
+            return None
+        edges = _in_edges(ls, name, graph.node_index)
+        bi, band = _band_of(graph, i)
+        if len(edges) > band.k:
+            return None
+        if bi not in copied:
+            src[bi] = src[bi].copy()
+            w[bi] = w[bi].copy()
+            copied.add(bi)
+        r = i - band.start
+        src[bi][r] = np.full(band.k, i, dtype=np.int32)
+        w[bi][r] = INF
+        _fill_row(src[bi][r], w[bi][r], edges)
+        overloaded[i] = ls.is_node_overloaded(name)
+        changed.setdefault(bi, []).append(r)
+    return EllGraph(
+        node_names=graph.node_names, node_index=graph.node_index,
+        n=graph.n, n_pad=graph.n_pad, bands=graph.bands,
+        src=tuple(src), w=tuple(w), overloaded=overloaded,
+        changed={bi: np.asarray(sorted(rs), dtype=np.int32)
+                 for bi, rs in changed.items()},
+    )
+
+
+def direct_metrics(graph: EllGraph, src_id: int, node_ids) -> np.ndarray:
+    """Host-side direct min-metric src_id -> each node in node_ids (INF
+    when not adjacent), read from the in-edge bands."""
+    out = np.full(len(node_ids), INF, dtype=np.int32)
+    for x, j in enumerate(node_ids):
+        bi, band = _band_of(graph, int(j))
+        r = int(j) - band.start
+        hits = graph.src[bi][r] == src_id
+        if hits.any():
+            out[x] = graph.w[bi][r][hits].min()
+    return out
+
+
+def _ell_relax(d, bands, srcs_t, ws_t, overloaded):
+    """One masked relaxation over the class bands: [S, N] -> [S, N] as
+    pure gather + reduce per band, writing contiguous output slices.
+    Edges originating at overloaded nodes never extend paths."""
+    parts = []
+    pos = 0
+    for band, s_b, w_b in zip(bands, srcs_t, ws_t):
+        assert band.start == pos, (band, pos)
+        w_eff = jnp.where(overloaded[s_b], INF, w_b)  # [rows, k]
+        gathered = d[:, s_b]  # [S, rows, k]
+        relaxed = jnp.min(
+            jnp.minimum(gathered + w_eff[None, :, :], INF), axis=2
+        )
+        parts.append(
+            jnp.minimum(d[:, pos : pos + band.rows], relaxed.astype(jnp.int32))
+        )
+        pos += band.rows
+    parts.append(d[:, pos:])  # padding columns: unchanged
+    return jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n):
+    """Batched {src} + neighbors distances + packed first hops over the
+    sliced-ELL graph — the sparse mirror of ops.spf._spf_view_batch.
+    w_sv: [B] host-computed direct metric source -> batch node."""
+    b = srcs.shape[0]
+    unit = jnp.full((b, n), INF, dtype=jnp.int32)
+    unit = unit.at[jnp.arange(b), srcs].set(0)
+    # init rows: one UNMASKED relax (overloaded sources still originate)
+    no_overload = jnp.zeros_like(overloaded)
+    d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload)
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        d, _, it = state
+        nxt = _ell_relax(d, bands, srcs_t, ws_t, overloaded)
+        return nxt, jnp.any(nxt < d), it + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+
+    # first hops (same algebra as the dense kernel)
+    d_src = d[0]
+    is_neighbor = w_sv < INF
+    reachable = d_src < INF
+    total = jnp.minimum(w_sv[:, None] + d, INF)
+    transit_ok = (
+        is_neighbor[:, None]
+        & (~overloaded[srcs])[:, None]
+        & (total == d_src[None, :])
+    )
+    col_is_self = srcs[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (b, n), 1
+    )
+    direct_ok = col_is_self & (is_neighbor & (w_sv == d_src[srcs]))[:, None]
+    fh = (transit_ok | direct_ok) & reachable[None, :]
+    return jnp.concatenate([d, fh.astype(jnp.int32)], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
+                    overloaded, srcs, w_sv, bands, n):
+    new_src = tuple(
+        s.at[ids, :].set(ps)
+        for s, ids, ps in zip(srcs_t, patch_ids_t, patch_src_t)
+    )
+    new_w = tuple(
+        w.at[ids, :].set(pw)
+        for w, ids, pw in zip(ws_t, patch_ids_t, patch_w_t)
+    )
+    packed = _ell_view_batch(
+        new_src, new_w, overloaded, srcs, w_sv, bands, n
+    )
+    return new_src, new_w, packed
+
+
+def _batch_args(graph: EllGraph, srcs):
+    srcs = np.asarray(srcs, dtype=np.int32)
+    w_sv = direct_metrics(graph, int(srcs[0]), srcs)
+    # the source itself is never its own neighbor
+    w_sv[srcs == srcs[0]] = INF
+    return jnp.asarray(srcs), jnp.asarray(w_sv)
+
+
+def ell_view_batch_packed(graph: EllGraph, srcs):
+    """Distances + first hops [2B, N_pad] (packed, one transfer) for a
+    padded source batch over the sliced-ELL graph."""
+    srcs_dev, w_sv = _batch_args(graph, srcs)
+    return _ell_view_batch(
+        tuple(jnp.asarray(s) for s in graph.src),
+        tuple(jnp.asarray(w) for w in graph.w),
+        jnp.asarray(graph.overloaded),
+        srcs_dev, w_sv, graph.bands, graph.n_pad,
+    )
+
+
+def ell_source_batch(graph: EllGraph, ls, src_name: str):
+    """The hot-path source batch over an ELL graph: [src] + sorted
+    unique up-neighbor ids, padded by repeating src to a power-of-two
+    bucket (>= 8, capped at n_pad) — the ELL analogue of
+    ops.spf.source_batch, and the one place this layout is defined for
+    the sparse path."""
+    sid = graph.node_index[src_name]
+    nbrs = sorted(
+        {
+            graph.node_index[link.other_node(src_name)]
+            for link in ls.links_from_node(src_name)
+            if link.is_up() and link.other_node(src_name) in graph.node_index
+        }
+    )
+    srcs = [sid] + nbrs
+    bucket = 8
+    while bucket < len(srcs):
+        bucket *= 2
+    bucket = min(bucket, graph.n_pad)
+    return srcs + [sid] * (bucket - len(srcs))
+
+
+class EllState:
+    """Caller-owned resident device bands for the churn loop."""
+
+    def __init__(self, graph: EllGraph):
+        self.graph = graph
+        self.src = tuple(jnp.asarray(s) for s in graph.src)
+        self.w = tuple(jnp.asarray(w) for w in graph.w)
+
+    def reconverge(self, patched: EllGraph, srcs):
+        """Fused churn step: scatter the patched rows into the resident
+        bands, solve the batched view. O(rows x K_class) transfer."""
+        changed: Dict[int, np.ndarray] = patched.changed or {}
+        patch_ids, patch_src, patch_w = [], [], []
+        for bi, band in enumerate(patched.bands):
+            rows = changed.get(bi)
+            if rows is None or len(rows) == 0:
+                rows = np.zeros(1, dtype=np.int32)  # idempotent no-op
+            else:
+                padded = pad_patch_rows(rows)
+                rows = (
+                    padded
+                    if padded is not None
+                    else np.arange(band.rows, dtype=np.int32)
+                )
+            patch_ids.append(jnp.asarray(rows))
+            patch_src.append(jnp.asarray(patched.src[bi][rows]))
+            patch_w.append(jnp.asarray(patched.w[bi][rows]))
+        srcs_dev, w_sv = _batch_args(patched, srcs)
+        self.src, self.w, packed = _ell_reconverge(
+            self.src, self.w,
+            tuple(patch_ids), tuple(patch_src), tuple(patch_w),
+            jnp.asarray(patched.overloaded), srcs_dev, w_sv,
+            patched.bands, patched.n_pad,
+        )
+        self.graph = patched
+        return packed
+
+
+def ell_reconverge_step(state: EllState, patched: EllGraph, srcs):
+    """Convenience wrapper around EllState.reconverge."""
+    return state.reconverge(patched, srcs)
 
 
 SOURCES_AXIS = "sources"

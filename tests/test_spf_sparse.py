@@ -102,6 +102,116 @@ class TestSparseParity:
         )
 
 
+class TestEllFormat:
+    """ELL fixed-slot graph: oracle parity + incremental row patching."""
+
+    @staticmethod
+    def batch_for(graph, ls, src):
+        srcs = spf_sparse.ell_source_batch(graph, ls, src)
+        sid = srcs[0]
+        nbrs = [i for i in srcs[1:] if i != sid]
+        return sid, nbrs, srcs
+
+    def assert_view_parity(self, ls):
+        graph = spf_sparse.compile_ell(ls)
+        for src in graph.node_names:
+            sid, nbrs, srcs = self.batch_for(graph, ls, src)
+            packed = np.asarray(
+                spf_sparse.ell_view_batch_packed(graph, srcs)
+            )
+            b = len(srcs)
+            d, fh = packed[:b], packed[b:].astype(bool)
+            oracle = ls.run_spf(src)
+            for dst in graph.node_names:
+                did = graph.node_index[dst]
+                want = oracle[dst].metric if dst in oracle else None
+                got = int(d[0, did])
+                assert (got >= INF) == (want is None), (src, dst)
+                if want is not None:
+                    assert got == want, (src, dst)
+                got_nh = {
+                    graph.node_names[srcs[i]]
+                    for i in np.nonzero(fh[:, did])[0]
+                }
+                want_nh = (
+                    oracle[dst].next_hops
+                    if dst in oracle and dst != src
+                    else set()
+                )
+                assert got_nh == want_nh, (src, dst, got_nh, want_nh)
+
+    def test_grid(self):
+        self.assert_view_parity(load(topologies.grid(4)))
+
+    def test_random_weighted(self):
+        for seed in range(2):
+            topo = topologies.random_mesh(
+                18, degree=4, seed=seed, max_metric=12
+            )
+            self.assert_view_parity(load(topo))
+
+    def test_overloaded_nodes(self):
+        topo = topologies.random_mesh(16, degree=4, seed=3, max_metric=9)
+        self.assert_view_parity(
+            load(topo, overloaded_nodes={"node-1", "node-7"})
+        )
+
+    def test_patch_matches_full_recompile(self):
+        topo = topologies.random_mesh(20, degree=4, seed=5, max_metric=9)
+        ls = load(topo)
+        graph = spf_sparse.compile_ell(ls)
+
+        # churn one metric
+        from dataclasses import replace
+
+        db = ls.get_adjacency_databases()["node-4"]
+
+        adjs = list(db.adjacencies)
+        a0 = adjs[0]
+        adjs[0] = replace(a0, metric=a0.metric + 3)
+        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+        affected = {"node-4", a0.other_node_name}
+        patched = spf_sparse.ell_patch(graph, ls, sorted(affected))
+        full = spf_sparse.compile_ell(ls)
+        assert patched is not None
+        assert patched.bands == full.bands
+        for a, b in zip(patched.src, full.src):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(patched.w, full.w):
+            np.testing.assert_array_equal(a, b)
+
+    def test_fused_reconverge_matches_unfused(self):
+        topo = topologies.random_mesh(14, degree=3, seed=8, max_metric=7)
+        ls = load(topo)
+        graph = spf_sparse.compile_ell(ls)
+        sid, nbrs, srcs = self.batch_for(graph, ls, "node-0")
+        state = spf_sparse.EllState(graph)
+
+        # churn: bump one adjacency metric, patch incrementally
+        from dataclasses import replace
+
+        db = ls.get_adjacency_databases()["node-2"]
+
+        adjs = list(db.adjacencies)
+        a0 = adjs[0]
+        adjs[0] = replace(a0, metric=a0.metric + 5)
+        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+        patched = spf_sparse.ell_patch(
+            graph, ls, ["node-2", a0.other_node_name]
+        )
+        assert patched is not None
+        packed = np.asarray(state.reconverge(patched, srcs))
+        ref = np.asarray(
+            spf_sparse.ell_view_batch_packed(
+                spf_sparse.compile_ell(ls), srcs
+            )
+        )
+        np.testing.assert_array_equal(packed, ref)
+        # resident bands now equal the full recompile
+        for a, b in zip(state.src, spf_sparse.compile_ell(ls).src):
+            np.testing.assert_array_equal(np.asarray(a), b)
+
+
 class TestSparseSolverBackend:
     def test_sparse_device_backend_matches_host(self, monkeypatch):
         """Past SPARSE_NODE_THRESHOLD the device backend switches to the
