@@ -77,6 +77,15 @@ class MockIoProvider(IoProvider):
             self._connected[if_a].append((if_b, latency_ms))
             self._connected[if_b].append((if_a, latency_ms))
 
+    def connect_one_way(
+        self, if_from: str, if_to: str, latency_ms: int = 1
+    ) -> None:
+        """Unidirectional connectivity (the reference's ConnectedIfPairs
+        is directional too): packets flow if_from -> if_to only — a
+        broken-cable / asymmetric-filter scenario."""
+        with self._lock:
+            self._connected[if_from].append((if_to, latency_ms))
+
     def partition(self, if_name: str) -> None:
         """Drop all packets to/from if_name (link cut)."""
         with self._lock:

@@ -144,6 +144,7 @@ class Spark:
             "spark.heartbeat_sent": 0,
             "spark.neighbor_up": 0,
             "spark.neighbor_down": 0,
+            "spark.invalid_version": 0,
         }
         if interface_updates_queue is not None:
             self.evb.add_queue_reader(
@@ -291,6 +292,10 @@ class Spark:
 
     # -- receive path -----------------------------------------------------
 
+    # lowest protocol version we interoperate with (reference:
+    # Spark.cpp packet validation against kOpenrSupportedVersion)
+    LOWEST_SUPPORTED_VERSION = 1
+
     def _process_packet(self, if_name: str, data: bytes) -> None:
         """reference: Spark.cpp:1597 processPacket."""
         if if_name not in self._tracked:
@@ -298,6 +303,9 @@ class Spark:
         try:
             packet = wire.loads(data, SparkPacket)
         except Exception:
+            return
+        if packet.version < self.LOWEST_SUPPORTED_VERSION:
+            self.counters["spark.invalid_version"] += 1
             return
         if packet.hello is not None:
             self._process_hello(if_name, packet.hello)

@@ -1,0 +1,245 @@
+"""LinkMonitor tests (reference analogue:
+openr/link-monitor/tests/LinkMonitorTest.cpp, 15 cases): neighbor events
+to adjacency advertisements, drain state persistence, metric overrides,
+RTT metric, parallel adjacencies, and graceful-restart retention."""
+
+import time
+
+import pytest
+
+from openr_tpu.config_store.persistent_store import PersistentStore
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.store import KvStore
+from openr_tpu.linkmonitor.link_monitor import LinkMonitor
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.types import AdjacencyDatabase, BinaryAddress
+from openr_tpu.types.spark import (
+    SparkNeighbor,
+    SparkNeighborEvent,
+    SparkNeighborEventType,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+
+def neighbor(node, local_if, remote_if, area="0", rtt_us=0):
+    return SparkNeighbor(
+        node_name=node,
+        local_if_name=local_if,
+        remote_if_name=remote_if,
+        transport_address_v6=BinaryAddress.from_str("fe80::2"),
+        area=area,
+        rtt_us=rtt_us,
+    )
+
+
+class Harness:
+    def __init__(self, config_store=None, areas=None, **lm_kwargs):
+        self.kvstore = KvStore(node_id="lm-test", areas=areas or ["0"])
+        self.kvstore.start()
+        self.client_evb = OpenrEventBase(name="lm-test-client")
+        self.client_evb.run_in_thread()
+        self.client = KvStoreClient(self.client_evb, "node-a", self.kvstore)
+        self.neighbor_q = ReplicateQueue(name="lm:neighborUpdates")
+        self.interface_q = ReplicateQueue(name="lm:interfaceUpdates")
+        self.lm = LinkMonitor(
+            "node-a",
+            neighbor_updates_queue=self.neighbor_q,
+            interface_updates_queue=self.interface_q,
+            kvstore_client=self.client,
+            kvstore=self.kvstore,
+            config_store=config_store,
+            areas=areas,
+            **lm_kwargs,
+        )
+        self.lm.start()
+
+    def emit(self, event_type, nbr):
+        self.neighbor_q.push(SparkNeighborEvent(event_type, nbr))
+
+    def adj_db(self, area="0", timeout=5.0):
+        """The adj:node-a advertisement currently in the KvStore."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            key = keyutil.adj_key("node-a")
+            val = self.kvstore.get_key_vals(area, [key]).get(key)
+            if val is not None and val.value is not None:
+                return wire.loads(val.value, AdjacencyDatabase)
+            time.sleep(0.02)
+        return None
+
+    def wait_adj(self, pred, area="0", timeout=5.0):
+        deadline = time.monotonic() + timeout
+        db = None
+        while time.monotonic() < deadline:
+            db = self.adj_db(area=area, timeout=0.2)
+            if db is not None and pred(db):
+                return db
+            time.sleep(0.02)
+        raise AssertionError(f"adj db never matched; last: {db}")
+
+    def stop(self):
+        self.lm.stop()
+        self.client_evb.stop()
+        self.client_evb.join()
+        self.kvstore.stop()
+
+
+@pytest.fixture
+def harness():
+    h = Harness()
+    yield h
+    h.stop()
+
+
+class TestBasicOperation:
+    def test_neighbor_up_advertises_adjacency(self, harness):
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP, neighbor("b", "if_ab", "if_ba")
+        )
+        db = harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        (adj,) = db.adjacencies
+        assert adj.other_node_name == "b"
+        assert adj.if_name == "if_ab"
+        assert adj.other_if_name == "if_ba"
+        assert db.this_node_name == "node-a"
+
+    def test_neighbor_down_withdraws_adjacency(self, harness):
+        nbr = neighbor("b", "if_ab", "if_ba")
+        harness.emit(SparkNeighborEventType.NEIGHBOR_UP, nbr)
+        harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        harness.emit(SparkNeighborEventType.NEIGHBOR_DOWN, nbr)
+        harness.wait_adj(lambda d: len(d.adjacencies) == 0)
+
+    def test_parallel_adjacencies_same_node(self, harness):
+        # two interfaces to the same neighbor: both advertised
+        # (reference: LinkMonitorTest ParallelAdj)
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP,
+            neighbor("b", "if1_ab", "if1_ba"),
+        )
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP,
+            neighbor("b", "if2_ab", "if2_ba"),
+        )
+        db = harness.wait_adj(lambda d: len(d.adjacencies) == 2)
+        assert {a.if_name for a in db.adjacencies} == {"if1_ab", "if2_ab"}
+
+    def test_neighbor_restart_keeps_adjacency(self, harness):
+        # graceful restart must not withdraw the adjacency
+        # (reference: LinkMonitorTest NeighborRestart)
+        nbr = neighbor("b", "if_ab", "if_ba")
+        harness.emit(SparkNeighborEventType.NEIGHBOR_UP, nbr)
+        harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        harness.emit(SparkNeighborEventType.NEIGHBOR_RESTARTING, nbr)
+        time.sleep(0.3)
+        db = harness.adj_db()
+        assert db is not None and len(db.adjacencies) == 1
+        harness.emit(SparkNeighborEventType.NEIGHBOR_RESTARTED, nbr)
+        time.sleep(0.3)
+        db = harness.adj_db()
+        assert db is not None and len(db.adjacencies) == 1
+
+
+class TestOverloadAndMetrics:
+    def test_node_overload_bit(self, harness):
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP, neighbor("b", "if_ab", "if_ba")
+        )
+        harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        harness.lm.set_node_overload(True)
+        harness.wait_adj(lambda d: d.is_overloaded)
+        harness.lm.set_node_overload(False)
+        harness.wait_adj(lambda d: not d.is_overloaded)
+
+    def test_link_overload_marks_adjacency(self, harness):
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP, neighbor("b", "if_ab", "if_ba")
+        )
+        harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        harness.lm.set_link_overload("if_ab", True)
+        db = harness.wait_adj(lambda d: d.adjacencies[0].is_overloaded)
+        assert db.adjacencies[0].is_overloaded
+
+    def test_link_metric_override(self, harness):
+        harness.emit(
+            SparkNeighborEventType.NEIGHBOR_UP, neighbor("b", "if_ab", "if_ba")
+        )
+        harness.wait_adj(lambda d: len(d.adjacencies) == 1)
+        harness.lm.set_link_metric("if_ab", "b", 777)
+        harness.wait_adj(lambda d: d.adjacencies[0].metric == 777)
+        harness.lm.set_link_metric("if_ab", "b", None)
+        harness.wait_adj(lambda d: d.adjacencies[0].metric != 777)
+
+    def test_rtt_metric_mode(self):
+        # use_rtt_metric derives the metric from measured RTT
+        # (reference: LinkMonitor metric = rtt-based when enabled)
+        h = Harness(use_rtt_metric=True)
+        try:
+            h.emit(
+                SparkNeighborEventType.NEIGHBOR_UP,
+                neighbor("b", "if_ab", "if_ba", rtt_us=20000),
+            )
+            db = h.wait_adj(lambda d: len(d.adjacencies) == 1)
+            assert db.adjacencies[0].metric > 1  # scaled from 20ms RTT
+            assert db.adjacencies[0].rtt == 20000
+        finally:
+            h.stop()
+
+
+class TestDrainPersistence:
+    def test_drain_state_survives_restart(self, tmp_path):
+        # reference: LinkMonitorTest DrainState — overload set, process
+        # restarts, overload still set (PersistentStore-backed)
+        store = PersistentStore(str(tmp_path / "lm.bin"), save_throttle_s=0.0)
+        h = Harness(config_store=store)
+        try:
+            h.emit(
+                SparkNeighborEventType.NEIGHBOR_UP,
+                neighbor("b", "if_ab", "if_ba"),
+            )
+            h.wait_adj(lambda d: len(d.adjacencies) == 1)
+            h.lm.set_node_overload(True)
+            h.wait_adj(lambda d: d.is_overloaded)
+        finally:
+            h.stop()
+            store.stop()
+
+        store2 = PersistentStore(
+            str(tmp_path / "lm.bin"), save_throttle_s=0.0
+        )
+        h2 = Harness(config_store=store2)
+        try:
+            assert h2.lm.is_overloaded
+            h2.emit(
+                SparkNeighborEventType.NEIGHBOR_UP,
+                neighbor("b", "if_ab", "if_ba"),
+            )
+            db = h2.wait_adj(lambda d: len(d.adjacencies) == 1)
+            assert db.is_overloaded
+        finally:
+            h2.stop()
+            store2.stop()
+
+
+class TestMultiArea:
+    def test_adjacency_lands_in_interface_area(self):
+        # border router: each area's adj db holds only that area's links
+        # (reference: LinkMonitorTest AreaTest)
+        h = Harness(areas=["0", "1"])
+        try:
+            h.emit(
+                SparkNeighborEventType.NEIGHBOR_UP,
+                neighbor("b", "if_ab", "if_ba", area="0"),
+            )
+            h.emit(
+                SparkNeighborEventType.NEIGHBOR_UP,
+                neighbor("c", "if_ac", "if_ca", area="1"),
+            )
+            db0 = h.wait_adj(lambda d: len(d.adjacencies) == 1, area="0")
+            db1 = h.wait_adj(lambda d: len(d.adjacencies) == 1, area="1")
+            assert db0.adjacencies[0].other_node_name == "b"
+            assert db1.adjacencies[0].other_node_name == "c"
+        finally:
+            h.stop()

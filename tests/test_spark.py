@@ -210,3 +210,102 @@ class TestGracefulRestart:
             "a", SparkNeighborEventType.NEIGHBOR_DOWN, timeout=8.0
         )
         assert ev.neighbor.node_name == "b"
+
+
+class TestEdgeCases:
+    """Scenarios from the reference suite beyond basic discovery:
+    UnidirectionTest, LoopedHelloPktTest, VersionTest, FastInitTest,
+    HubAndSpokeTopology, LinkDownWithoutAdjFormed."""
+
+    def test_unidirectional_no_adjacency(self, lan):
+        # a's packets reach b, but not vice versa: b sees a's hellos
+        # without itself reflected (stays WARM), a hears nothing (IDLE).
+        # reference: SparkTest UnidirectionTest / IgnoreUnidirectionalPeer
+        lan.io.connect_one_way("if_a_b", "if_b_a")
+        a = lan.add_node("a", ["if_a_b"])
+        b = lan.add_node("b", ["if_b_a"])
+        time.sleep(1.0)
+        assert lan.events("a", timeout=0.2) == []
+        assert lan.events("b", timeout=0.2) == []
+        b_view = b.get_neighbors().get("if_b_a", {})
+        assert b_view.get("a") in (None, SparkNeighState.WARM)
+        assert a.get_neighbors().get("if_a_b", {}) == {}
+
+    def test_looped_hello_ignored(self, lan):
+        # an interface hearing its own multicast back never forms a
+        # self-adjacency. reference: SparkTest LoopedHelloPktTest
+        lan.io.connect_one_way("if_a_b", "if_a_b")
+        a = lan.add_node("a", ["if_a_b"])
+        time.sleep(0.5)
+        assert lan.events("a", timeout=0.2) == []
+        assert a.get_neighbors().get("if_a_b", {}) == {}
+
+    def test_old_version_rejected(self, lan):
+        # a packet below LOWEST_SUPPORTED_VERSION is dropped before any
+        # FSM processing. reference: SparkTest VersionTest
+        from openr_tpu.types.spark import SparkHelloMsg, SparkPacket
+        from openr_tpu.utils import wire
+
+        lan.connect("if_a_b", "if_b_a")
+        a = lan.add_node("a", ["if_a_b"])
+        pkt = SparkPacket(
+            version=0,
+            hello=SparkHelloMsg(
+                node_name="ancient", if_name="if_b_a", seq_num=1
+            ),
+        )
+        lan.io.send("if_b_a", wire.dumps(pkt))
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            if a.get_counters().get("spark.invalid_version", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert a.get_counters()["spark.invalid_version"] >= 1
+        assert a.get_neighbors().get("if_a_b", {}) == {}
+
+    def test_fast_init_quick_establishment(self, lan):
+        # fast hellos on interface-add: adjacency forms in a small
+        # multiple of the fast interval, far below the steady hello
+        # interval. reference: SparkTest FastInitTest
+        lan.connect("if_a_b", "if_b_a")
+        lan.add_node("a", ["if_a_b"], hello_interval_s=5.0)
+        t0 = time.monotonic()
+        lan.add_node("b", ["if_b_a"], hello_interval_s=5.0)
+        lan.wait_event("a", SparkNeighborEventType.NEIGHBOR_UP, timeout=3.0)
+        assert time.monotonic() - t0 < 3.0  # << the 5s hello interval
+
+    def test_hub_and_spoke(self, lan):
+        # hub with one interface per spoke; spokes never see each other.
+        # reference: SparkTest HubAndSpokeTopology
+        lan.connect("if_hub_1", "if_s1_hub")
+        lan.connect("if_hub_2", "if_s2_hub")
+        hub = lan.add_node("hub", ["if_hub_1", "if_hub_2"])
+        lan.add_node("s1", ["if_s1_hub"])
+        lan.add_node("s2", ["if_s2_hub"])
+        ups = set()
+        for _ in range(2):
+            ev = lan.wait_event("hub", SparkNeighborEventType.NEIGHBOR_UP)
+            ups.add((ev.neighbor.node_name, ev.neighbor.local_if_name))
+        assert ups == {("s1", "if_hub_1"), ("s2", "if_hub_2")}
+        lan.wait_event("s1", SparkNeighborEventType.NEIGHBOR_UP)
+        lan.wait_event("s2", SparkNeighborEventType.NEIGHBOR_UP)
+        assert "s2" not in lan.sparks["s1"].get_neighbors().get(
+            "if_s1_hub", {}
+        )
+        assert "s1" not in lan.sparks["s2"].get_neighbors().get(
+            "if_s2_hub", {}
+        )
+
+    def test_link_down_without_adj_formed_no_down_event(self, lan):
+        # removing a still-negotiating interface must not emit
+        # NEIGHBOR_DOWN. reference: SparkTest LinkDownWithoutAdjFormed
+        lan.io.connect_one_way("if_a_b", "if_b_a")  # b can never answer
+        a = lan.add_node("a", ["if_a_b"])
+        lan.add_node("b", ["if_b_a"])
+        time.sleep(0.3)
+        a.remove_interface("if_a_b")
+        time.sleep(0.3)
+        assert all(
+            ev.event_type != SparkNeighborEventType.NEIGHBOR_DOWN
+            for ev in lan.events("a", timeout=0.3)
+        )
