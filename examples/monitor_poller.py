@@ -1,0 +1,67 @@
+"""MonitorPoller: periodically scrape counters and event logs from a set
+of nodes' ctrl endpoints.
+
+Example-parity with the reference ``examples/ZmqMonitorPoller.cpp``
+(which subscribed to each node's monitor socket): the thrift-era
+equivalent polls ``get_counters`` / ``get_event_logs`` over the ctrl
+API, keeping a last-seen high-water mark per node so each poll emits
+only new log samples.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Tuple
+
+from openr_tpu.ctrl.server import CtrlClient
+
+
+class MonitorPoller:
+    def __init__(self, endpoints: List[Tuple[str, int]]):
+        self._endpoints = endpoints
+        self._seen: Dict[Tuple[str, int], int] = {}
+
+    def poll_counters(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for host, port in self._endpoints:
+            try:
+                out[f"{host}:{port}"] = CtrlClient(host, port).call(
+                    "get_counters"
+                )
+            except Exception:
+                continue  # node unreachable: skip this round
+        return out
+
+    def poll_new_logs(self) -> List[dict]:
+        """Event-log samples not seen in a previous poll."""
+        fresh: List[dict] = []
+        for ep in self._endpoints:
+            host, port = ep
+            try:
+                logs = CtrlClient(host, port).call(
+                    "get_event_logs", limit=1000
+                )
+            except Exception:
+                continue
+            start = self._seen.get(ep, 0)
+            for raw in logs[start:]:
+                fresh.append(raw if isinstance(raw, dict) else json.loads(raw))
+            self._seen[ep] = len(logs)
+        return fresh
+
+    def run(self, interval_s: float = 5.0) -> None:
+        while True:
+            for sample in self.poll_new_logs():
+                print(json.dumps(sample))
+            time.sleep(interval_s)
+
+
+if __name__ == "__main__":
+    import sys
+
+    eps = [
+        (h, int(p))
+        for h, _, p in (arg.partition(":") for arg in sys.argv[1:])
+    ] or [("127.0.0.1", 2018)]
+    MonitorPoller(eps).run()

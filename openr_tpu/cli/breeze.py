@@ -258,6 +258,42 @@ class Breeze:
     def openr_config(self) -> None:
         self._print(json.dumps(self.client.call("get_running_config"), indent=2))
 
+    # -- config -----------------------------------------------------------
+    # reference: py/openr/cli/clis/config.py (show / dryrun / compare)
+
+    def config_show(self) -> None:
+        self._print(
+            json.dumps(self.client.call("get_running_config"), indent=2)
+        )
+
+    def config_dryrun(self, path: str) -> None:
+        """Parse + validate a config file locally; no daemon needed."""
+        from openr_tpu.config.config import OpenrConfig
+
+        try:
+            cfg = OpenrConfig.from_file(path)
+        except Exception as exc:  # noqa: BLE001 - report, exit non-zero
+            self._print(f"INVALID: {exc}")
+            raise SystemExit(1)
+        self._print(f"OK: valid config for node {cfg.node_name!r}")
+
+    def config_compare(self, path: str) -> None:
+        """Diff a config file against the daemon's running config."""
+        from openr_tpu.config.config import OpenrConfig
+
+        running = self.client.call("get_running_config")
+        local = OpenrConfig.from_file(path).to_dict()
+        keys = sorted(set(running) | set(local))
+        rows = [
+            (k, json.dumps(running.get(k)), json.dumps(local.get(k)))
+            for k in keys
+            if running.get(k) != local.get(k)
+        ]
+        if not rows:
+            self._print("identical")
+        else:
+            self._print(render_table(["Field", "Running", "File"], rows))
+
     # -- perf -------------------------------------------------------------
 
     def perf_fib(self) -> None:
@@ -329,6 +365,13 @@ def build_parser() -> argparse.ArgumentParser:
     def group(name):
         g = sub.add_parser(name)
         return g.add_subparsers(dest="command", required=True)
+
+    c = group("config")
+    c.add_parser("show")
+    p = c.add_parser("dryrun")
+    p.add_argument("file")
+    p = c.add_parser("compare")
+    p.add_argument("file")
 
     d = group("decision")
     routes = d.add_parser("routes")
@@ -404,6 +447,9 @@ def run(argv: List[str], client=None, out=None) -> int:
     ) else ""
 
     dispatch: Dict[str, Callable[[], None]] = {
+        "config.show": breeze.config_show,
+        "config.dryrun": lambda: breeze.config_dryrun(args.file),
+        "config.compare": lambda: breeze.config_compare(args.file),
         "decision.routes": lambda: breeze.decision_routes(args.node),
         "decision.adj": breeze.decision_adj,
         "decision.prefixes": breeze.decision_prefixes,
