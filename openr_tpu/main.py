@@ -37,6 +37,17 @@ def parse_args(argv):
     parser.add_argument("--use-rtt-metric", action="store_true")
     parser.add_argument("--solver-backend", default="device",
                         choices=["device", "host"])
+    parser.add_argument(
+        "--enable-netlink-fib", action="store_true",
+        help="program routes into the kernel via an in-process "
+             "NetlinkFibHandler over rtnetlink (reference: "
+             "Main.cpp:343-361)",
+    )
+    parser.add_argument(
+        "--fib-agent-port", type=int, default=0,
+        help="connect to an out-of-process platform agent "
+             "(python -m openr_tpu.platform.agent) instead",
+    )
     parser.add_argument("--spark-port", type=int, default=6666)
     parser.add_argument("-v", "--verbose", action="count", default=0)
     return parser.parse_args(argv)
@@ -75,10 +86,39 @@ def main(argv=None) -> int:
     config_store = PersistentStore(config.persistent_store_path)
     io_provider = UdpIoProvider(port=args.spark_port)
     area = config.areas[0].area_id
+
+    if args.fib_agent_port and args.enable_netlink_fib:
+        raise SystemExit(
+            "--fib-agent-port and --enable-netlink-fib are mutually "
+            "exclusive: the agent owns the kernel boundary"
+        )
+    fib_agent = None  # MockFibAgent default
+    if args.fib_agent_port:
+        from openr_tpu.platform.netlink_fib_handler import TcpFibAgent
+
+        fib_agent = TcpFibAgent("127.0.0.1", args.fib_agent_port)
+        log.info("using platform agent on port %d", args.fib_agent_port)
+    elif args.enable_netlink_fib:
+        from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+        from openr_tpu.platform.netlink_linux import (
+            LinuxNetlinkProtocolSocket,
+        )
+
+        # an explicitly requested kernel FIB must not silently degrade
+        # to the in-memory mock
+        if not LinuxNetlinkProtocolSocket.is_available():
+            raise SystemExit(
+                "--enable-netlink-fib requires rtnetlink access "
+                "(CAP_NET_ADMIN); use --mock on the standalone agent "
+                "for simulation"
+            )
+        fib_agent = NetlinkFibHandler(LinuxNetlinkProtocolSocket())
+        log.info("in-process netlink FIB handler (rtnetlink)")
+
     node = OpenrNode(
         config.node_name,
         io_provider,
-        fib_agent=None,  # MockFibAgent unless netlink handler enabled
+        fib_agent=fib_agent,
         area=area,
         spark_config=dict(
             hello_interval_s=config.spark.hello_time_s,

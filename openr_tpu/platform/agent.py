@@ -1,0 +1,70 @@
+"""Standalone platform agent: serve FibService over TCP against the
+kernel (the reference's LinuxPlatformMain.cpp binary).
+
+Run:  python -m openr_tpu.platform.agent [--port 60100] [--mock]
+
+The daemon's Fib module connects with ``TcpFibAgent`` (reference: Fib
+dialing the platform agent on port 60100, Constants.h:260).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.platform.netlink_fib_handler import (
+    FIB_AGENT_RPC_PORT,
+    FibAgentServer,
+    NetlinkFibHandler,
+)
+
+
+def build_netlink(force_mock: bool = False):
+    if not force_mock:
+        try:
+            from openr_tpu.platform.netlink_linux import (
+                LinuxNetlinkProtocolSocket,
+            )
+
+            if LinuxNetlinkProtocolSocket.is_available():
+                return LinuxNetlinkProtocolSocket()
+        except OSError:
+            pass
+    return MockNetlinkProtocolSocket()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="openr-tpu-platform-agent")
+    parser.add_argument("--port", type=int, default=FIB_AGENT_RPC_PORT)
+    parser.add_argument(
+        "--mock", action="store_true",
+        help="in-memory kernel instead of rtnetlink",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("openr_tpu.platform.agent")
+
+    netlink = build_netlink(force_mock=args.mock)
+    handler = NetlinkFibHandler(netlink)
+    server = FibAgentServer(handler, port=args.port)
+    server.start()
+    log.info(
+        "platform agent (%s kernel) listening on port %d",
+        type(netlink).__name__,
+        server.port,
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

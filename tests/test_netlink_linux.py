@@ -1,0 +1,235 @@
+"""Real rtnetlink socket tests (reference analogue:
+openr/nl/tests/* and platform/tests/NetlinkFibHandlerTest.cpp — 'need a
+real kernel; run on Linux CI').
+
+Skipped when the process lacks NET_ADMIN (probed by trying to create a
+dummy link)."""
+
+import time
+
+import pytest
+
+from openr_tpu.messaging.queue import QueueTimeoutError, ReplicateQueue
+from openr_tpu.platform.netlink import NetlinkEventType
+from openr_tpu.platform.netlink_linux import (
+    LinuxNetlinkProtocolSocket,
+    NetlinkError,
+)
+from openr_tpu.types import BinaryAddress, IpPrefix, NextHop, UnicastRoute
+
+IFACE = "oprtest0"
+
+
+def _admin_socket():
+    """A socket that can create links, or None. Kernels differ in which
+    virtual link kinds are compiled in — try a few."""
+    if not LinuxNetlinkProtocolSocket.is_available():
+        return None
+    nl = LinuxNetlinkProtocolSocket()
+    try:
+        nl.delete_link(IFACE)  # clean leftovers from a dead run
+    except (NetlinkError, PermissionError, OSError):
+        nl.close()
+        return None
+    for kind in ("dummy", "ifb"):
+        try:
+            nl.create_link(IFACE, kind=kind)
+            return nl
+        except (NetlinkError, PermissionError, OSError):
+            continue
+    nl.close()
+    return None
+
+
+@pytest.fixture
+def nl():
+    sock = _admin_socket()
+    if sock is None:
+        pytest.skip("rtnetlink link creation unavailable (no NET_ADMIN)")
+    sock.set_link_up(IFACE)
+    yield sock
+    try:
+        for route in sock.get_all_routes():
+            sock.delete_route(route.dest)
+        sock.delete_link(IFACE)
+    finally:
+        sock.close()
+
+
+class TestLinuxNetlink:
+    def test_link_dump_sees_dummy(self, nl):
+        links = {l.if_name: l for l in nl.get_all_links()}
+        assert IFACE in links
+        assert links[IFACE].is_up
+        assert "lo" in links
+
+    def test_link_up_down(self, nl):
+        nl.set_link_up(IFACE, up=False)
+        links = {l.if_name: l for l in nl.get_all_links()}
+        assert not links[IFACE].is_up
+        nl.set_link_up(IFACE, up=True)
+        links = {l.if_name: l for l in nl.get_all_links()}
+        assert links[IFACE].is_up
+
+    def test_route_add_dump_delete(self, nl):
+        dest = IpPrefix.from_str("fd00:bead::/64")
+        route = UnicastRoute(
+            dest=dest,
+            next_hops=(
+                NextHop(address=BinaryAddress(addr=b"", if_name=IFACE)),
+            ),
+        )
+        nl.add_route(route)
+        dests = [r.dest for r in nl.get_all_routes()]
+        assert dest in dests
+        nl.delete_route(dest)
+        dests = [r.dest for r in nl.get_all_routes()]
+        assert dest not in dests
+
+    def test_route_dump_only_openr_protocol(self, nl):
+        # the dump filter only returns proto-99 (openr) routes: kernel-
+        # installed routes (proto boot/kernel, e.g. lo's local routes and
+        # eth0's connected route) never appear, while ours do
+        dest = IpPrefix.from_str("fd00:feed::/64")
+        nl.add_route(
+            UnicastRoute(
+                dest=dest,
+                next_hops=(
+                    NextHop(address=BinaryAddress(addr=b"", if_name=IFACE)),
+                ),
+            )
+        )
+        routes = nl.get_all_routes()
+        assert [r.dest for r in routes] == [dest]
+        nl.delete_route(dest)
+
+    def test_ecmp_multipath_route(self, nl):
+        # two gateways via the dummy link -> RTA_MULTIPATH group
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:77::1/64"))
+        dest = IpPrefix.from_str("fd00:beef::/64")
+        route = UnicastRoute(
+            dest=dest,
+            next_hops=(
+                NextHop(
+                    address=BinaryAddress.from_str(
+                        "fd00:77::2", if_name=IFACE
+                    )
+                ),
+                NextHop(
+                    address=BinaryAddress.from_str(
+                        "fd00:77::3", if_name=IFACE
+                    )
+                ),
+            ),
+        )
+        nl.add_route(route)
+        by_dest = {r.dest: r for r in nl.get_all_routes()}
+        assert dest in by_dest
+        got = by_dest[dest]
+        assert len(got.next_hops) == 2
+        gw = {nh.address.addr for nh in got.next_hops}
+        assert gw == {
+            BinaryAddress.from_str("fd00:77::2").addr,
+            BinaryAddress.from_str("fd00:77::3").addr,
+        }
+        nl.delete_route(dest)
+
+    def test_replace_route(self, nl):
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:88::1/64"))
+        dest = IpPrefix.from_str("fd00:cafe::/64")
+        for gw in ("fd00:88::2", "fd00:88::3"):
+            nl.add_route(
+                UnicastRoute(
+                    dest=dest,
+                    next_hops=(
+                        NextHop(
+                            address=BinaryAddress.from_str(
+                                gw, if_name=IFACE
+                            )
+                        ),
+                    ),
+                )
+            )
+        by_dest = {r.dest: r for r in nl.get_all_routes()}
+        (nh,) = by_dest[dest].next_hops
+        assert nh.address.addr == BinaryAddress.from_str("fd00:88::3").addr
+        nl.delete_route(dest)
+
+    def test_delete_missing_route_is_noop(self, nl):
+        nl.delete_route(IpPrefix.from_str("fd00:dead::/64"))  # no raise
+
+    def test_link_event_subscription(self, nl):
+        q = ReplicateQueue(name="nl-events")
+        reader = q.get_reader("test")
+        nl.events_queue = q
+        nl.start_events()
+        try:
+            time.sleep(0.1)
+            nl.set_link_up(IFACE, up=False)
+            deadline = time.monotonic() + 5
+            seen = False
+            while time.monotonic() < deadline:
+                try:
+                    ev = reader.get(timeout=0.5)
+                except QueueTimeoutError:
+                    continue
+                if (
+                    ev.event_type == NetlinkEventType.LINK
+                    and ev.link is not None
+                    and ev.link.if_name == IFACE
+                    and not ev.link.is_up
+                ):
+                    seen = True
+                    break
+            assert seen, "no link-down event received"
+        finally:
+            nl.stop_events()
+
+    def test_fib_handler_programs_kernel(self, nl):
+        """End to end: Fib module -> NetlinkFibHandler -> rtnetlink ->
+        kernel FIB (reference: platform/tests/NetlinkFibHandlerTest)."""
+        from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+        from openr_tpu.fib.fib import Fib
+        from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+        from openr_tpu.types import PrefixEntry
+
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:99::1/64"))
+        handler = NetlinkFibHandler(nl)
+        route_q = ReplicateQueue(name="nl-e2e:routeUpdates")
+        fib = Fib("nl-e2e", handler, route_q)
+        fib.start()
+        try:
+            dest = IpPrefix.from_str("fd00:facc::/64")
+            entry = RibUnicastEntry(
+                prefix=dest,
+                nexthops={
+                    NextHop(
+                        address=BinaryAddress.from_str(
+                            "fd00:99::2", if_name=IFACE
+                        ),
+                        metric=10,
+                    )
+                },
+                best_prefix_entry=PrefixEntry(prefix=dest),
+                best_area="0",
+            )
+            route_q.push(
+                DecisionRouteUpdate(unicast_routes_to_update={dest: entry})
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if dest in [r.dest for r in nl.get_all_routes()]:
+                    break
+                time.sleep(0.05)
+            assert dest in [r.dest for r in nl.get_all_routes()]
+            # withdraw
+            route_q.push(
+                DecisionRouteUpdate(unicast_routes_to_delete=[dest])
+            )
+            while time.monotonic() < deadline:
+                if dest not in [r.dest for r in nl.get_all_routes()]:
+                    break
+                time.sleep(0.05)
+            assert dest not in [r.dest for r in nl.get_all_routes()]
+        finally:
+            fib.stop()
