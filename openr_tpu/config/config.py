@@ -75,6 +75,7 @@ class KvStoreConfig:
     sync_interval_s: float = 60.0
     ttl_decrement_ms: int = 1
     enable_flood_optimization: bool = False
+    is_flood_root: bool = False
 
 
 @dataclass

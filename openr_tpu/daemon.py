@@ -50,6 +50,8 @@ class OpenrNode:
         solver_backend: str = "device",
         debounce_min_s: float = 0.01,
         debounce_max_s: float = 0.05,
+        enable_flood_optimization: bool = False,
+        is_flood_root: bool = False,
     ):
         self.name = name
         self.area = area
@@ -83,7 +85,12 @@ class OpenrNode:
         self.static_routes = ReplicateQueue(name=f"{name}:staticRoutes")
 
         # -- modules ------------------------------------------------------
-        self.kvstore = KvStore(node_id=name, areas=self.areas)
+        self.kvstore = KvStore(
+            node_id=name,
+            areas=self.areas,
+            enable_flood_optimization=enable_flood_optimization,
+            is_flood_root=is_flood_root,
+        )
         self.client_evb = OpenrEventBase(name=f"kvclient:{name}")
         self.kvstore_client = KvStoreClient(
             self.client_evb, name, self.kvstore

@@ -166,6 +166,19 @@ class PeerTransport:
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
         raise NotImplementedError
 
+    def send_dual_messages(self, area: str, sender_id: str, msgs) -> None:
+        """Deliver DUAL messages for the flood-topology computation
+        (reference: KvStoreService processKvStoreDualMessage)."""
+        raise NotImplementedError
+
+    def set_flood_topo_child(
+        self, area: str, root_id: str, child_id: str, is_set: bool
+    ) -> None:
+        """Register/unregister the sender as an SPT child of this store
+        for the given flood root (reference: KvStoreService
+        updateFloodTopologyChild / FloodTopoSetParams)."""
+        raise NotImplementedError
+
 
 class InProcessTransport(PeerTransport):
     """Directly call into another KvStore in the same process (used by
@@ -182,6 +195,14 @@ class InProcessTransport(PeerTransport):
 
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
         self._target.set_key_vals(area, params, sender_id=params.originator_id)
+
+    def send_dual_messages(self, area: str, sender_id: str, msgs) -> None:
+        self._target.process_dual_messages(area, sender_id, msgs)
+
+    def set_flood_topo_child(
+        self, area: str, root_id: str, child_id: str, is_set: bool
+    ) -> None:
+        self._target.set_flood_topo_child(area, root_id, child_id, is_set)
 
 
 @dataclass
@@ -210,6 +231,8 @@ class KvStoreDb:
         updates_queue: ReplicateQueue,
         executor: ThreadPoolExecutor,
         filters: Optional[KvStoreFilters] = None,
+        enable_flood_optimization: bool = False,
+        is_flood_root: bool = False,
     ):
         self.area = area
         self.node_id = node_id
@@ -219,6 +242,18 @@ class KvStoreDb:
         self._filters = filters
         self.key_vals: Dict[str, Value] = {}
         self.peers: Dict[str, _Peer] = {}
+        # DUAL-computed SPT flood topology (reference: KvStoreDb inherits
+        # DualNode; flood-optimization flag KvStore.cpp:2940-2973). Off by
+        # default, matching the reference.
+        self.dual = None
+        if enable_flood_optimization:
+            from openr_tpu.dual.dual import DualNode
+
+            self.dual = DualNode(
+                node_id,
+                is_root=is_flood_root,
+                nexthop_change_cb=self._on_dual_nexthop_change,
+            )
         # (expiry_monotonic, key, version, originator, ttl_version)
         self._ttl_heap: List[Tuple[float, str, int, str, int]] = []
         self._ttl_timer = None
@@ -228,6 +263,7 @@ class KvStoreDb:
             "kvstore.expired_keys": 0,
             "kvstore.full_sync_count": 0,
             "kvstore.flood_count": 0,
+            "kvstore.spt_floods": 0,
         }
 
     # -- merge + flood ----------------------------------------------------
@@ -249,17 +285,32 @@ class KvStoreDb:
 
     def _flood(self, updates: Dict[str, Value], exclude: Optional[str]) -> None:
         """Flood accepted updates to every INITIALIZED peer except the one
-        we learned them from."""
+        we learned them from. With flood optimization on and a converged
+        SPT, only the SPT links (parent + children of the elected flood
+        root) carry the flood (reference: KvStore.cpp:2957 floodPeers =
+        getFloodPeers(rootId))."""
         flooded = self._decrement_ttls(updates)
         if not flooded:
             return
+        spt_targets = None
+        if self.dual is not None:
+            root = self.dual.pick_flood_root()
+            if root is not None:
+                spt = self.dual.spt_peers(root)
+                if spt:
+                    spt_targets = spt
+                    self.counters["kvstore.spt_floods"] += 1
         for peer in list(self.peers.values()):
             if peer.name == exclude:
                 continue
             if peer.state == KvStorePeerState.SYNCING:
+                # a syncing peer accumulates floods regardless of SPT:
+                # its full sync raced this update and would miss it
                 peer.pending_flood.update(flooded)
                 continue
             if peer.state != KvStorePeerState.INITIALIZED:
+                continue
+            if spt_targets is not None and peer.name not in spt_targets:
                 continue
             self.counters["kvstore.flood_count"] += 1
             params = KeySetParams(
@@ -455,12 +506,24 @@ class KvStoreDb:
         if peer is None:
             self.peers[name] = _Peer(name=name, transport=transport)
         else:
+            if (
+                self.dual is not None
+                and peer.state == KvStorePeerState.INITIALIZED
+            ):
+                # re-peering demotes to IDLE: balance the earlier peer_up
+                self._send_dual(self.dual.peer_down(name))
             peer.transport = transport
             peer.state = KvStorePeerState.IDLE
         self._request_sync()
 
     def del_peer(self, name: str) -> None:
-        self.peers.pop(name, None)
+        peer = self.peers.pop(name, None)
+        if (
+            self.dual is not None
+            and peer is not None
+            and peer.state == KvStorePeerState.INITIALIZED
+        ):
+            self._send_dual(self.dual.peer_down(name))
 
     def peer_states(self) -> Dict[str, KvStorePeerState]:
         return {name: p.state for name, p in self.peers.items()}
@@ -513,6 +576,10 @@ class KvStoreDb:
             return
         peer.state = KvStorePeerState.INITIALIZED
         peer.backoff.report_success()
+        if self.dual is not None:
+            # (re-)announce the link to DUAL; a bounced peer is handled
+            # as down-then-up inside Dual.peer_up
+            self._send_dual(self.dual.peer_up(peer.name, cost=1))
         # merge what the peer had better; reflood to *other* peers
         self.set_key_vals(
             KeySetParams(key_vals=pub.key_vals, originator_id=peer_name),
@@ -551,6 +618,61 @@ class KvStoreDb:
             peer, lambda t=peer.transport: t.set_key_vals(self.area, params)
         )
 
+    def _on_dual_nexthop_change(
+        self, root_id: str, old_nh: Optional[str], new_nh: Optional[str]
+    ) -> None:
+        """Our SPT parent for root_id changed: tell the old parent to
+        drop us as a child and the new one to adopt us (reference:
+        KvStoreDb::processNexthopChange sending FLOOD_TOPO_SET)."""
+        for nh, is_set in ((old_nh, False), (new_nh, True)):
+            if nh is None or nh == self.node_id:
+                continue
+            peer = self.peers.get(nh)
+            if peer is None:
+                continue
+            self._async_peer_call(
+                peer,
+                lambda t=peer.transport, flag=is_set: t.set_flood_topo_child(
+                    self.area, root_id, self.node_id, flag
+                ),
+            )
+
+    def set_flood_topo_child(
+        self, root_id: str, child_id: str, is_set: bool
+    ) -> None:
+        """A peer (un)registered as our SPT child (reference:
+        KvStoreDb::processFloodTopoSet)."""
+        if self.dual is None:
+            return
+        dual = self.dual.get_dual(root_id)
+        if dual is None:
+            return
+        if is_set:
+            dual.add_child(child_id)
+        else:
+            dual.remove_child(child_id)
+
+    def process_dual_messages(self, sender: str, msgs) -> None:
+        """Incoming DUAL messages from a peer (reference:
+        processKvStoreDualMessage); replies/propagation go back out over
+        the peer transports."""
+        if self.dual is None:
+            return
+        for msg in msgs:
+            self._send_dual(self.dual.process_message(sender, msg))
+
+    def _send_dual(self, out_msgs) -> None:
+        for nbr, mlist in out_msgs.items():
+            peer = self.peers.get(nbr)
+            if peer is None or not mlist:
+                continue
+            self._async_peer_call(
+                peer,
+                lambda t=peer.transport, m=list(mlist): t.send_dual_messages(
+                    self.area, self.node_id, m
+                ),
+            )
+
     def _async_peer_call(self, peer: _Peer, call: Callable[[], None]) -> None:
         def run() -> None:
             try:
@@ -564,6 +686,11 @@ class KvStoreDb:
         peer = self.peers.get(peer_name)
         if peer is None:
             return
+        if (
+            self.dual is not None
+            and peer.state == KvStorePeerState.INITIALIZED
+        ):
+            self._send_dual(self.dual.peer_down(peer_name))
         peer.state = KvStorePeerState.IDLE
         peer.backoff.report_error()
         self._evb.schedule_timeout(
@@ -583,6 +710,8 @@ class KvStore:
         updates_queue: Optional[ReplicateQueue] = None,
         filters: Optional[KvStoreFilters] = None,
         sync_interval_s: float = 60.0,
+        enable_flood_optimization: bool = False,
+        is_flood_root: bool = False,
     ):
         self.node_id = node_id
         self.evb = OpenrEventBase(name=f"kvstore:{node_id}")
@@ -601,6 +730,8 @@ class KvStore:
                 self.updates_queue,
                 self._executor,
                 filters,
+                enable_flood_optimization=enable_flood_optimization,
+                is_flood_root=is_flood_root,
             )
         self._sync_interval = sync_interval_s
         self._sync_timer = None
@@ -673,6 +804,20 @@ class KvStore:
 
     def peer_states(self, area: str) -> Dict[str, KvStorePeerState]:
         return self.evb.call_and_wait(lambda: self._db(area).peer_states())
+
+    def process_dual_messages(self, area: str, sender: str, msgs) -> None:
+        self.evb.call_and_wait(
+            lambda: self._db(area).process_dual_messages(sender, msgs)
+        )
+
+    def set_flood_topo_child(
+        self, area: str, root_id: str, child_id: str, is_set: bool
+    ) -> None:
+        self.evb.call_and_wait(
+            lambda: self._db(area).set_flood_topo_child(
+                root_id, child_id, is_set
+            )
+        )
 
     def counters(self) -> Dict[str, int]:
         def collect():

@@ -12,6 +12,9 @@ from __future__ import annotations
 
 from typing import Optional
 
+from typing import List
+
+from openr_tpu.dual.dual import DualMessage
 from openr_tpu.kvstore.store import KvStore, PeerTransport
 from openr_tpu.types import KeyDumpParams, KeySetParams, Publication
 from openr_tpu.utils.rpc import RpcClient, RpcServer
@@ -39,6 +42,18 @@ class KvStorePeerServer:
             arg_types=[str, KeySetParams],
             result_type=type(None),
         )
+        self._server.register(
+            "processKvStoreDualMessage",
+            self._process_dual,
+            arg_types=[str, str, List[DualMessage]],
+            result_type=type(None),
+        )
+        self._server.register(
+            "updateFloodTopologyChild",
+            self._kvstore.set_flood_topo_child,
+            arg_types=[str, str, str, bool],
+            result_type=type(None),
+        )
         self.port = self._server.port
 
     def _get_filtered(self, area: str, params: KeyDumpParams) -> Publication:
@@ -48,6 +63,11 @@ class KvStorePeerServer:
         self._kvstore.set_key_vals(
             area, params, sender_id=params.originator_id
         )
+
+    def _process_dual(
+        self, area: str, sender: str, msgs: List[DualMessage]
+    ) -> None:
+        self._kvstore.process_dual_messages(area, sender, msgs)
 
     def start(self) -> None:
         self._server.start()
@@ -72,6 +92,22 @@ class TcpPeerTransport(PeerTransport):
 
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
         self._client.call("setKvStoreKeyVals", [area, params], type(None))
+
+    def send_dual_messages(self, area: str, sender_id: str, msgs) -> None:
+        self._client.call(
+            "processKvStoreDualMessage",
+            [area, sender_id, list(msgs)],
+            type(None),
+        )
+
+    def set_flood_topo_child(
+        self, area: str, root_id: str, child_id: str, is_set: bool
+    ) -> None:
+        self._client.call(
+            "updateFloodTopologyChild",
+            [area, root_id, child_id, is_set],
+            type(None),
+        )
 
     def close(self) -> None:
         self._client.close()

@@ -22,9 +22,20 @@ from openr_tpu.utils import wire
 
 
 class KvStoreWrapper:
-    def __init__(self, node_id: str, areas: Optional[List[str]] = None):
+    def __init__(
+        self,
+        node_id: str,
+        areas: Optional[List[str]] = None,
+        enable_flood_optimization: bool = False,
+        is_flood_root: bool = False,
+    ):
         self.node_id = node_id
-        self.store = KvStore(node_id=node_id, areas=areas)
+        self.store = KvStore(
+            node_id=node_id,
+            areas=areas,
+            enable_flood_optimization=enable_flood_optimization,
+            is_flood_root=is_flood_root,
+        )
         self._reader: RQueue = self.store.updates_queue.get_reader(
             f"wrapper:{node_id}"
         )

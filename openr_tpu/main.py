@@ -106,7 +106,7 @@ def main(argv=None) -> int:
 
         # an explicitly requested kernel FIB must not silently degrade
         # to the in-memory mock
-        if not LinuxNetlinkProtocolSocket.is_available():
+        if not LinuxNetlinkProtocolSocket.is_admin_available():
             raise SystemExit(
                 "--enable-netlink-fib requires rtnetlink access "
                 "(CAP_NET_ADMIN); use --mock on the standalone agent "
@@ -133,6 +133,8 @@ def main(argv=None) -> int:
         solver_backend=config.solver_backend,
         debounce_min_s=config.decision.debounce_min_ms / 1000,
         debounce_max_s=config.decision.debounce_max_ms / 1000,
+        enable_flood_optimization=config.kvstore.enable_flood_optimization,
+        is_flood_root=config.kvstore.is_flood_root,
     )
     node.ctrl_handler._config = config
 

@@ -30,9 +30,10 @@ def build_netlink(force_mock: bool = False):
                 LinuxNetlinkProtocolSocket,
             )
 
-            if LinuxNetlinkProtocolSocket.is_available():
+            # mutations need CAP_NET_ADMIN, not just a socket
+            if LinuxNetlinkProtocolSocket.is_admin_available():
                 return LinuxNetlinkProtocolSocket()
-        except OSError:
+        except (OSError, AttributeError):  # AttributeError: non-Linux
             pass
     return MockNetlinkProtocolSocket()
 

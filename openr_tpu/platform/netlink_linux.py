@@ -130,17 +130,41 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         self._event_thread: Optional[threading.Thread] = None
         self._event_sock: Optional[socket.socket] = None
         self._running = False
+        # name -> ifindex cache: interfaces change rarely; invalidated on
+        # any local link mutation and on subscribed link events
+        self._links_cache: Optional[Dict[str, int]] = None
 
     @staticmethod
     def is_available() -> bool:
+        """A netlink route socket can be opened (this alone needs no
+        privileges — reads work unprivileged)."""
         try:
             s = socket.socket(
                 socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
             )
             s.close()
             return True
-        except OSError:
+        except (OSError, AttributeError):  # AttributeError: non-Linux
             return False
+
+    @staticmethod
+    def has_net_admin() -> bool:
+        """Mutations (link/route changes) additionally need
+        CAP_NET_ADMIN: check the effective capability set."""
+        CAP_NET_ADMIN_BIT = 12
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("CapEff:"):
+                        cap_eff = int(line.split()[1], 16)
+                        return bool(cap_eff & (1 << CAP_NET_ADMIN_BIT))
+        except OSError:
+            pass
+        return False
+
+    @classmethod
+    def is_admin_available(cls) -> bool:
+        return cls.is_available() and cls.has_net_admin()
 
     def close(self) -> None:
         self.stop_events()
@@ -229,6 +253,7 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_EXCL,
             body,
         )
+        self._links_cache = None
 
     def create_dummy_link(self, if_name: str) -> None:
         self.create_link(if_name, kind="dummy")
@@ -248,6 +273,7 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             return
         body = _IFINFOMSG.pack(socket.AF_UNSPEC, 0, index, 0, 0)
         self._request(RTM_DELLINK, NLM_F_REQUEST | NLM_F_ACK, body)
+        self._links_cache = None
 
     # -- routes -----------------------------------------------------------
 
@@ -266,9 +292,13 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         ) + _attr(RTA_DST, route_dest.prefix_address.addr)
 
     def _link_table(self) -> Dict[str, int]:
-        """name -> ifindex, resolved with ONE link dump (route
-        programming must not issue a dump per nexthop)."""
-        return {l.if_name: l.if_index for l in self.get_all_links()}
+        """name -> ifindex, cached (bulk route programming must not
+        issue a link dump per route)."""
+        if self._links_cache is None:
+            self._links_cache = {
+                l.if_name: l.if_index for l in self.get_all_links()
+            }
+        return self._links_cache
 
     @staticmethod
     def _gateway_attr(nh: NextHop) -> bytes:
@@ -420,6 +450,7 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
                 payload = data[off + _NLMSGHDR.size : off + length]
                 off += _align4(length)
                 if mtype in (RTM_NEWLINK, RTM_DELLINK):
+                    self._links_cache = None
                     link = self._parse_link(payload)
                     self.events_queue.push(
                         NetlinkEvent(

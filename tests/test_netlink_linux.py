@@ -41,6 +41,12 @@ def _admin_socket():
     return None
 
 
+# every destination this suite programs lives under one distinctive ULA
+# block, and teardown deletes ONLY routes inside it — a co-resident real
+# daemon's proto-99 routes must never be touched
+TEST_BLOCK = "fd0a:7e57:"
+
+
 @pytest.fixture
 def nl():
     sock = _admin_socket()
@@ -50,7 +56,8 @@ def nl():
     yield sock
     try:
         for route in sock.get_all_routes():
-            sock.delete_route(route.dest)
+            if route.dest.to_str().startswith(TEST_BLOCK):
+                sock.delete_route(route.dest)
         sock.delete_link(IFACE)
     finally:
         sock.close()
@@ -72,7 +79,7 @@ class TestLinuxNetlink:
         assert links[IFACE].is_up
 
     def test_route_add_dump_delete(self, nl):
-        dest = IpPrefix.from_str("fd00:bead::/64")
+        dest = IpPrefix.from_str("fd0a:7e57:bead::/64")
         route = UnicastRoute(
             dest=dest,
             next_hops=(
@@ -90,7 +97,7 @@ class TestLinuxNetlink:
         # the dump filter only returns proto-99 (openr) routes: kernel-
         # installed routes (proto boot/kernel, e.g. lo's local routes and
         # eth0's connected route) never appear, while ours do
-        dest = IpPrefix.from_str("fd00:feed::/64")
+        dest = IpPrefix.from_str("fd0a:7e57:feed::/64")
         nl.add_route(
             UnicastRoute(
                 dest=dest,
@@ -105,19 +112,19 @@ class TestLinuxNetlink:
 
     def test_ecmp_multipath_route(self, nl):
         # two gateways via the dummy link -> RTA_MULTIPATH group
-        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:77::1/64"))
-        dest = IpPrefix.from_str("fd00:beef::/64")
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd0a:7e57:77::1/64"))
+        dest = IpPrefix.from_str("fd0a:7e57:beef::/64")
         route = UnicastRoute(
             dest=dest,
             next_hops=(
                 NextHop(
                     address=BinaryAddress.from_str(
-                        "fd00:77::2", if_name=IFACE
+                        "fd0a:7e57:77::2", if_name=IFACE
                     )
                 ),
                 NextHop(
                     address=BinaryAddress.from_str(
-                        "fd00:77::3", if_name=IFACE
+                        "fd0a:7e57:77::3", if_name=IFACE
                     )
                 ),
             ),
@@ -129,15 +136,15 @@ class TestLinuxNetlink:
         assert len(got.next_hops) == 2
         gw = {nh.address.addr for nh in got.next_hops}
         assert gw == {
-            BinaryAddress.from_str("fd00:77::2").addr,
-            BinaryAddress.from_str("fd00:77::3").addr,
+            BinaryAddress.from_str("fd0a:7e57:77::2").addr,
+            BinaryAddress.from_str("fd0a:7e57:77::3").addr,
         }
         nl.delete_route(dest)
 
     def test_replace_route(self, nl):
-        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:88::1/64"))
-        dest = IpPrefix.from_str("fd00:cafe::/64")
-        for gw in ("fd00:88::2", "fd00:88::3"):
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd0a:7e57:88::1/64"))
+        dest = IpPrefix.from_str("fd0a:7e57:cafe::/64")
+        for gw in ("fd0a:7e57:88::2", "fd0a:7e57:88::3"):
             nl.add_route(
                 UnicastRoute(
                     dest=dest,
@@ -152,11 +159,11 @@ class TestLinuxNetlink:
             )
         by_dest = {r.dest: r for r in nl.get_all_routes()}
         (nh,) = by_dest[dest].next_hops
-        assert nh.address.addr == BinaryAddress.from_str("fd00:88::3").addr
+        assert nh.address.addr == BinaryAddress.from_str("fd0a:7e57:88::3").addr
         nl.delete_route(dest)
 
     def test_delete_missing_route_is_noop(self, nl):
-        nl.delete_route(IpPrefix.from_str("fd00:dead::/64"))  # no raise
+        nl.delete_route(IpPrefix.from_str("fd0a:7e57:dead::/64"))  # no raise
 
     def test_link_event_subscription(self, nl):
         q = ReplicateQueue(name="nl-events")
@@ -193,19 +200,19 @@ class TestLinuxNetlink:
         from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
         from openr_tpu.types import PrefixEntry
 
-        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd00:99::1/64"))
+        nl.add_ifaddress(IFACE, IpPrefix.from_str("fd0a:7e57:99::1/64"))
         handler = NetlinkFibHandler(nl)
         route_q = ReplicateQueue(name="nl-e2e:routeUpdates")
         fib = Fib("nl-e2e", handler, route_q)
         fib.start()
         try:
-            dest = IpPrefix.from_str("fd00:facc::/64")
+            dest = IpPrefix.from_str("fd0a:7e57:facc::/64")
             entry = RibUnicastEntry(
                 prefix=dest,
                 nexthops={
                     NextHop(
                         address=BinaryAddress.from_str(
-                            "fd00:99::2", if_name=IFACE
+                            "fd0a:7e57:99::2", if_name=IFACE
                         ),
                         metric=10,
                     )
