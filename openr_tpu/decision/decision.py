@@ -366,9 +366,11 @@ class Decision:
         return (hold_up, hold_down)
 
     def _schedule_ordered_fib_tick(self) -> None:
-        max_fib_s = max(self.fib_times.values(), default=0.1) / 1000.0
+        """Tick period = the slowest FIB in the network (reference:
+        Decision.cpp:1943 getMaxFib, floor 1 ms)."""
+        max_fib_s = max(self.fib_times.values(), default=1.0) / 1000.0
         self._ordered_fib_timer = self.evb.schedule_timeout(
-            max(0.05, max_fib_s), self._decrement_ordered_fib_holds
+            max(0.001, max_fib_s), self._decrement_ordered_fib_holds
         )
 
     def _decrement_ordered_fib_holds(self) -> None:

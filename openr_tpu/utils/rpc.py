@@ -68,18 +68,26 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._methods: Dict[str, Tuple[Callable, List[Any], Any]] = {}
+        self._active: set = set()
+        self._active_lock = threading.Lock()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
-                while True:
-                    try:
-                        blobs = _recv_frame(self.request)
-                    except (ConnectionError, OSError):
-                        return
-                    if blobs is None:
-                        return
-                    outer._dispatch(self.request, blobs)
+                with outer._active_lock:
+                    outer._active.add(self.request)
+                try:
+                    while True:
+                        try:
+                            blobs = _recv_frame(self.request)
+                        except (ConnectionError, OSError):
+                            return
+                        if blobs is None:
+                            return
+                        outer._dispatch(self.request, blobs)
+                finally:
+                    with outer._active_lock:
+                        outer._active.discard(self.request)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -108,6 +116,20 @@ class RpcServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # a stopped server must stop serving: close established
+        # connections too, so peers detect the death instead of talking
+        # to a zombie handler thread
+        with self._active_lock:
+            for sock in list(self._active):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._active.clear()
 
     def _dispatch(self, sock: socket.socket, blobs: List[bytes]) -> None:
         try:
