@@ -159,6 +159,37 @@ class Breeze:
             )
         )
 
+    def kvstore_get_key(self, key: str, area: str = "0") -> None:
+        vals = self.client.call("get_kvstore_key_vals", keys=[key], area=area)
+        val = vals.get(key)
+        if val is None:
+            self._print(f"{key}: not found")
+            raise SystemExit(1)
+        raw = val.get("value")
+        if isinstance(raw, dict) and "__bytes__" in raw:
+            data = bytes.fromhex(raw["__bytes__"])
+            try:
+                val["value"] = data.decode("utf-8")
+            except UnicodeDecodeError:
+                val["value"] = raw["__bytes__"]  # keep hex for binary
+        self._print(json.dumps(val, indent=2))
+
+    def kvstore_set_key(
+        self, key: str, value: str, version: int = 0, area: str = "0"
+    ) -> None:
+        written = self.client.call(
+            "set_kvstore_key", key=key, value=value, version=version,
+            area=area,
+        )
+        self._print(f"set {key} at version {written}")
+
+    def kvstore_erase_key(self, key: str, area: str = "0") -> None:
+        ok = self.client.call("erase_kvstore_key", key=key, area=area)
+        if not ok:
+            self._print(f"{key}: not found")
+            raise SystemExit(1)
+        self._print(f"erasing {key} (ttl countdown)")
+
     def kvstore_peers(self, area: str = "0") -> None:
         peers = self.client.call("get_kvstore_peers", area=area)
         self._print(
@@ -384,6 +415,17 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_parser("counters")
 
     k = group("kvstore")
+    p = k.add_parser("get-key")
+    p.add_argument("key")
+    p.add_argument("--area", default="0")
+    p = k.add_parser("set-key")
+    p.add_argument("key")
+    p.add_argument("value")
+    p.add_argument("--version", type=int, default=0)
+    p.add_argument("--area", default="0")
+    p = k.add_parser("erase-key")
+    p.add_argument("key")
+    p.add_argument("--area", default="0")
     keys = k.add_parser("keys")
     keys.add_argument("--prefix", default="")
     keys.add_argument("--area", default="0")
@@ -456,6 +498,15 @@ def run(argv: List[str], client=None, out=None) -> int:
         "fib.routes": breeze.fib_routes,
         "fib.counters": breeze.fib_counters,
         "kvstore.keys": lambda: breeze.kvstore_keys(args.prefix, args.area),
+        "kvstore.get_key": lambda: breeze.kvstore_get_key(
+            args.key, args.area
+        ),
+        "kvstore.set_key": lambda: breeze.kvstore_set_key(
+            args.key, args.value, args.version, args.area
+        ),
+        "kvstore.erase_key": lambda: breeze.kvstore_erase_key(
+            args.key, args.area
+        ),
         "kvstore.peers": lambda: breeze.kvstore_peers(args.area),
         "kvstore.areas": breeze.kvstore_areas,
         "lm.links": breeze.lm_links,

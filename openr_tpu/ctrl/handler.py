@@ -18,7 +18,13 @@ import time
 from typing import Any, Dict, List, Optional
 
 from openr_tpu.messaging.queue import RQueue
-from openr_tpu.types import IpPrefix, KeyDumpParams, KeySetParams, Value
+from openr_tpu.types import (
+    TTL_INFINITY,
+    IpPrefix,
+    KeyDumpParams,
+    KeySetParams,
+    Value,
+)
 from openr_tpu.types.lsdb import PrefixForwardingAlgorithm, PrefixForwardingType
 from openr_tpu.types import PrefixEntry, PrefixType
 from openr_tpu.utils import keys as keyutil
@@ -95,6 +101,60 @@ class OpenrCtrlHandler:
             area,
             KeySetParams(key_vals=key_vals, originator_id=self.node_name),
         )
+
+    def set_kvstore_key(
+        self,
+        key: str,
+        value: str,
+        version: int = 0,
+        area: str = "0",
+        ttl: Optional[int] = None,
+    ) -> int:
+        """Operator-facing single-key set (breeze kvstore set-key):
+        version 0 auto-advances past the stored version. Returns the
+        version written."""
+        if version == 0:
+            cur = self._kvstore.get_key_vals(area, [key]).get(key)
+            version = (cur.version + 1) if cur is not None else 1
+        self._kvstore.set_key_vals(
+            area,
+            KeySetParams(
+                key_vals={
+                    key: Value(
+                        version=version,
+                        originator_id=self.node_name,
+                        value=value.encode("utf-8"),
+                        ttl=TTL_INFINITY if ttl is None else ttl,
+                    )
+                },
+                originator_id=self.node_name,
+            ),
+        )
+        return version
+
+    def erase_kvstore_key(self, key: str, area: str = "0") -> bool:
+        """Expire a key network-wide by re-advertising it with a bumped
+        ttl_version and a near-zero TTL (the reference's breeze kvstore
+        erase-key mechanism — TTL countdown then removes it everywhere)."""
+        cur = self._kvstore.get_key_vals(area, [key]).get(key)
+        if cur is None:
+            return False
+        self._kvstore.set_key_vals(
+            area,
+            KeySetParams(
+                key_vals={
+                    key: Value(
+                        version=cur.version,
+                        originator_id=cur.originator_id,
+                        value=cur.value,
+                        ttl=100,  # ms: floods, then dies everywhere
+                        ttl_version=cur.ttl_version + 1,
+                    )
+                },
+                originator_id=self.node_name,
+            ),
+        )
+        return True
 
     def get_kvstore_keys_filtered(
         self, prefix: str = "", area: str = "0"

@@ -498,4 +498,37 @@ class Decision:
         return self.evb.call_and_wait(lambda: self.rib_policy)
 
     def get_counters(self) -> Dict[str, int]:
-        return self.evb.call_and_wait(lambda: dict(self.counters))
+        return self.evb.call_and_wait(self._collect_counters)
+
+    def _collect_counters(self) -> Dict[str, int]:
+        """Event counters + global gauges (reference: Decision.cpp:1964
+        updateGlobalCounters)."""
+        out = dict(self.counters)
+        num_adjacencies = 0
+        num_partial = 0
+        nodes = set()
+        for ls in self.area_link_states.values():
+            num_adjacencies += ls.num_links
+            spf = ls.get_spf_result(self.my_node_name) if ls.has_node(
+                self.my_node_name
+            ) else {}
+            for name, adj_db in ls.get_adjacency_databases().items():
+                nodes.add(name)
+                num_links = len(ls.links_from_node(name))
+                # partial adjacency: declared but not bidirectional, only
+                # counted for reachable, non-isolated nodes
+                if name in spf and num_links != 0:
+                    num_partial += max(
+                        0, len(adj_db.adjacencies) - num_links
+                    )
+        conflicting = sum(
+            1
+            for entries in self.prefix_state.prefixes().values()
+            if PrefixState.has_conflicting_forwarding_info(entries)
+        )
+        out["decision.num_conflicting_prefixes"] = conflicting
+        out["decision.num_partial_adjacencies"] = num_partial
+        out["decision.num_complete_adjacencies"] = num_adjacencies
+        out["decision.num_nodes"] = max(len(nodes), 1)
+        out["decision.num_prefixes"] = len(self.prefix_state.prefixes())
+        return out

@@ -83,5 +83,18 @@ class PrefixState:
                 del self._node_to_prefixes[node_area]
         return True
 
+    @staticmethod
+    def has_conflicting_forwarding_info(entries: PrefixEntries) -> bool:
+        """Advertisers of one prefix disagree on forwarding type or
+        algorithm (reference: PrefixState::hasConflictingForwardingInfo)."""
+        seen = None
+        for entry in entries.values():
+            key = (entry.forwarding_type, entry.forwarding_algorithm)
+            if seen is None:
+                seen = key
+            elif key != seen:
+                return True
+        return False
+
     def get_node_host_loopbacks(self) -> Dict[NodeAndArea, Set[IpPrefix]]:
         return dict(self._node_to_prefixes)

@@ -243,3 +243,24 @@ class TestBreezeCli:
         assert all(
             isinstance(s, dict) for s in poller.poll_new_logs()
         )
+
+    def test_kvstore_set_get_erase_key(self, network):
+        # reference: breeze kvstore set-key / get-key / erase-key
+        nodes, port = network
+        out = breeze(port, "kvstore", "set-key", "test:op", "hello")
+        assert "version 1" in out
+        out = breeze(port, "kvstore", "get-key", "test:op")
+        assert "hello" in out or "aGVsbG8" in out  # raw or base64
+
+        # erase floods a near-zero ttl; the key dies on every store
+        out = breeze(port, "kvstore", "erase-key", "test:op")
+        assert "erasing" in out
+        import time as _time
+
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            vals = nodes["alpha"].kvstore.get_key_vals("0", ["test:op"])
+            if not vals:
+                break
+            _time.sleep(0.05)
+        assert not nodes["alpha"].kvstore.get_key_vals("0", ["test:op"])

@@ -487,7 +487,7 @@ class TestDecisionModuleBehaviors:
         }
 
     def test_counters_gauges(self, harness):
-        # reference: :6252 Counters
+        # reference: :6252 Counters + :1964 updateGlobalCounters
         topo = line_topology()
         harness.publish_topology(topo)
         harness.drain_updates()
@@ -496,6 +496,12 @@ class TestDecisionModuleBehaviors:
         assert counters["decision.prefix_db_update"] >= 3
         assert counters["decision.route_build_runs"] >= 1
         assert counters["decision.publications"] >= 1
+        # global gauges
+        assert counters["decision.num_nodes"] == 3
+        assert counters["decision.num_complete_adjacencies"] == 2
+        assert counters["decision.num_partial_adjacencies"] == 0
+        assert counters["decision.num_prefixes"] == 3
+        assert counters["decision.num_conflicting_prefixes"] == 0
 
 
 class TestDecisionPendingUpdates:
