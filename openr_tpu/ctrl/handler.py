@@ -52,12 +52,48 @@ class OpenrCtrlHandler:
         self._spark = spark
         self._monitor = monitor
         self._config = config
+        self._config_store = None  # wired by the daemon when present
         self._start_time = int(time.time())
 
     # -- fb303-style base -------------------------------------------------
 
     def alive_since(self) -> int:
         return self._start_time
+
+    def get_my_node_name(self) -> str:
+        """reference: OpenrCtrl.thrift getMyNodeName."""
+        return self.node_name
+
+    def dryrun_config(self, config_json: str) -> Dict[str, Any]:
+        """Validate a config document server-side (reference:
+        OpenrCtrl.thrift dryrunConfig)."""
+        import json as _json
+
+        from openr_tpu.config.config import ConfigError, OpenrConfig
+
+        try:
+            cfg = OpenrConfig.from_dict(_json.loads(config_json))
+            return {"valid": True, "node_name": cfg.node_name}
+        except (ConfigError, ValueError, KeyError, TypeError) as exc:
+            return {"valid": False, "error": str(exc)}
+
+    # -- config store (reference: getConfigKey / setConfigKey /
+    # eraseConfigKey over PersistentStore) --------------------------------
+
+    def get_config_key(self, key: str) -> Any:
+        if self._config_store is None:
+            return None
+        return self._config_store.load(key)
+
+    def set_config_key(self, key: str, value: Any) -> None:
+        if self._config_store is None:
+            raise RuntimeError("no persistent store configured")
+        self._config_store.store(key, value)
+
+    def erase_config_key(self, key: str) -> bool:
+        if self._config_store is None:
+            return False
+        return self._config_store.erase(key)
 
     def get_counters(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
@@ -315,6 +351,13 @@ class OpenrCtrlHandler:
 
     # -- PrefixManager ----------------------------------------------------
 
+    def set_interface_metric(self, if_name: str, metric: int) -> None:
+        """reference: OpenrCtrl.thrift setInterfaceMetric."""
+        self._link_monitor.set_interface_metric(if_name, metric)
+
+    def unset_interface_metric(self, if_name: str) -> None:
+        self._link_monitor.set_interface_metric(if_name, None)
+
     def get_prefixes(self):
         return self._prefix_manager.get_prefixes()
 
@@ -343,7 +386,60 @@ class OpenrCtrlHandler:
             [IpPrefix.from_str(p) for p in prefixes]
         )
 
+    def get_prefixes_by_type(self, prefix_type: str):
+        """reference: OpenrCtrl.thrift getPrefixesByType."""
+        want = PrefixType[prefix_type]
+        return [
+            e for e in self._prefix_manager.get_prefixes() if e.type == want
+        ]
+
+    def withdraw_prefixes_by_type(self, prefix_type: str) -> int:
+        """reference: OpenrCtrl.thrift withdrawPrefixesByType."""
+        victims = [e.prefix for e in self.get_prefixes_by_type(prefix_type)]
+        if victims:
+            self._prefix_manager.withdraw_prefixes(victims)
+        return len(victims)
+
+    def sync_prefixes_by_type(
+        self,
+        prefix_type: str,
+        prefixes: List[str],
+    ) -> None:
+        """reference: OpenrCtrl.thrift syncPrefixesByType — the given set
+        becomes the complete set for that type."""
+        ptype = PrefixType[prefix_type]
+        entries = [
+            PrefixEntry(prefix=IpPrefix.from_str(p), type=ptype)
+            for p in prefixes
+        ]
+        self._prefix_manager.sync_prefixes_by_type(ptype, entries)
+
+    def get_advertised_routes(self, prefix: str = ""):
+        """reference: OpenrCtrl.thrift getAdvertisedRoutes(Filtered)."""
+        out = self._prefix_manager.get_prefixes()
+        if prefix:
+            want = IpPrefix.from_str(prefix)
+            out = [e for e in out if e.prefix == want]
+        return out
+
+    def get_received_routes(self, prefix: str = ""):
+        """reference: OpenrCtrl.thrift getReceivedRoutes(Filtered) — the
+        per-prefix advertisements Decision has received, with their
+        advertising (node, area)s."""
+        dbs = self._decision.evb.call_and_wait(
+            lambda: dict(self._decision.prefix_state.prefixes())
+        )
+        if prefix:
+            want = IpPrefix.from_str(prefix)
+            dbs = {p: entries for p, entries in dbs.items() if p == want}
+        return dbs
+
     # -- Spark ------------------------------------------------------------
+
+    def flood_restarting_msg(self) -> None:
+        """reference: OpenrCtrl.thrift floodRestartingMsg — announce
+        graceful restart on every interface without stopping."""
+        self._spark.flood_restarting()
 
     def get_spark_neighbors(self):
         return {

@@ -156,6 +156,7 @@ class OpenrNode:
             prefix_manager=self.prefix_manager,
             spark=self.spark,
         )
+        self.ctrl_handler._config_store = config_store
         self.ctrl_server = None  # created on demand by start_ctrl_server
         self._started = False
 

@@ -102,6 +102,9 @@ class LinkMonitor:
         self._adjacencies: Dict[Tuple[str, str], Tuple[SparkNeighbor, Adjacency]] = {}
         self._interfaces: Dict[str, _InterfaceEntry] = {}
         self._metric_overrides: Dict[Tuple[str, str], int] = {}
+        # interface-wide override (reference: setInterfaceMetric) —
+        # the per-(iface, neighbor) override wins when both are set
+        self._iface_metric_overrides: Dict[str, int] = {}
         self._link_overloads: Set[str] = set()
         self.is_overloaded = False
         self.counters: Dict[str, int] = {
@@ -150,6 +153,9 @@ class LinkMonitor:
             return
         self.is_overloaded = bool(state.get("is_overloaded", False))
         self._link_overloads = set(state.get("link_overloads", []))
+        self._iface_metric_overrides = dict(
+            state.get("iface_metric_overrides", {})
+        )
         self._metric_overrides = {
             (i, n): m
             for (i, n), m in (
@@ -170,6 +176,9 @@ class LinkMonitor:
                     f"{i}|{n}": m
                     for (i, n), m in self._metric_overrides.items()
                 },
+                "iface_metric_overrides": dict(
+                    self._iface_metric_overrides
+                ),
             },
         )
 
@@ -276,7 +285,10 @@ class LinkMonitor:
         for (if_name, node), (nbr, adj) in sorted(self._adjacencies.items()):
             if area is not None and (nbr.area or self.area) != area:
                 continue
-            metric = self._metric_overrides.get((if_name, node), adj.metric)
+            metric = self._metric_overrides.get(
+                (if_name, node),
+                self._iface_metric_overrides.get(if_name, adj.metric),
+            )
             adjacencies.append(
                 Adjacency(
                     other_node_name=adj.other_node_name,
@@ -399,6 +411,23 @@ class LinkMonitor:
                 self._metric_overrides.pop((if_name, neighbor), None)
             else:
                 self._metric_overrides[(if_name, neighbor)] = metric
+            self._persist_state()
+            self._advertise_adj_throttled()
+
+        self.evb.call_and_wait(apply)
+
+    def set_interface_metric(
+        self, if_name: str, metric: Optional[int]
+    ) -> None:
+        """Interface-wide metric override for every adjacency on the
+        interface (reference: OpenrCtrl setInterfaceMetric /
+        unsetInterfaceMetric). None clears it."""
+
+        def apply() -> None:
+            if metric is None:
+                self._iface_metric_overrides.pop(if_name, None)
+            else:
+                self._iface_metric_overrides[if_name] = metric
             self._persist_state()
             self._advertise_adj_throttled()
 

@@ -285,6 +285,11 @@ class Spark:
         self._io.send(if_name, wire.dumps(SparkPacket(heartbeat=msg)))
         self.counters["spark.heartbeat_sent"] += 1
 
+    def flood_restarting(self) -> None:
+        """Announce graceful restart on every tracked interface without
+        stopping (reference: OpenrCtrl floodRestartingMsg)."""
+        self.evb.call_and_wait(self._flood_restarting)
+
     def _flood_restarting(self) -> None:
         """reference: Spark.h:92 floodRestartingMsg."""
         for if_name in self._tracked:
