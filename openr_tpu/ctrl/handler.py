@@ -30,6 +30,52 @@ from openr_tpu.types import PrefixEntry, PrefixType
 from openr_tpu.utils import keys as keyutil
 
 
+class _FilteredPublicationReader:
+    """Reader adapter dropping publications outside the subscription's
+    area / key-prefix and trimming the surviving ones to matching keys
+    (the reference KvStorePublisher's per-subscriber filter,
+    openr/kvstore/KvStorePublisher.h)."""
+
+    def __init__(self, reader, prefix: str, area: str):
+        self._reader = reader
+        self._prefix = prefix
+        self._area = area
+
+    def get(self, timeout: Optional[float] = None):
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            remaining = (
+                None if deadline is None else deadline - _time.monotonic()
+            )
+            pub = self._reader.get(timeout=remaining)
+            if pub.area != self._area:
+                continue
+            if not self._prefix:
+                return pub
+            key_vals = {
+                k: v
+                for k, v in pub.key_vals.items()
+                if k.startswith(self._prefix)
+            }
+            expired = [
+                k for k in pub.expired_keys if k.startswith(self._prefix)
+            ]
+            if not key_vals and not expired:
+                continue
+            return type(pub)(
+                key_vals=key_vals,
+                expired_keys=expired,
+                area=pub.area,
+            )
+
+    def close(self) -> None:
+        close = getattr(self._reader, "close", None)
+        if close is not None:
+            close()
+
+
 class OpenrCtrlHandler:
     def __init__(
         self,
@@ -215,14 +261,18 @@ class OpenrCtrlHandler:
 
     def subscribe_kvstore_filtered(
         self, prefix: str = "", area: str = "0"
-    ) -> RQueue:
+    ):
         """Server-streaming subscription (reference:
-        OpenrCtrlHandler.h:226 subscribeAndGetKvStoreFiltered). Returns a
-        reader delivering matching Publications; snapshot via
-        get_kvstore_keys_filtered first."""
-        return self._kvstore.updates_queue.get_reader(
+        OpenrCtrlHandler.h:226 subscribeAndGetKvStoreFiltered +
+        KvStorePublisher's filtered fan-out). Returns a reader delivering
+        only Publications touching the requested area/key-prefix;
+        snapshot via get_kvstore_keys_filtered first."""
+        reader = self._kvstore.updates_queue.get_reader(
             f"ctrl-sub:{self.node_name}"
         )
+        if not prefix and area == "0" and self._kvstore.areas() == ["0"]:
+            return reader
+        return _FilteredPublicationReader(reader, prefix, area)
 
     def long_poll_kvstore_adj(
         self, area: str = "0", timeout_s: float = 10.0

@@ -349,3 +349,28 @@ class TestBreezeCli:
             assert seen, "beta never saw NEIGHBOR_RESTARTING"
         finally:
             client.close()
+
+    def test_subscribe_kvstore_filtered(self, network):
+        """The filtered stream drops non-matching keys (reference:
+        KvStorePublisher per-subscriber filtering)."""
+        nodes, port = network
+        handler = nodes["alpha"].ctrl_handler
+        reader = handler.subscribe_kvstore_filtered(prefix="special:")
+        nodes["alpha"].kvstore.set_key_vals(
+            "0",
+            __import__(
+                "openr_tpu.types", fromlist=["KeySetParams"]
+            ).KeySetParams(
+                key_vals={
+                    "noise:1": __import__(
+                        "openr_tpu.types", fromlist=["Value"]
+                    ).Value(version=1, originator_id="alpha", value=b"n"),
+                    "special:1": __import__(
+                        "openr_tpu.types", fromlist=["Value"]
+                    ).Value(version=1, originator_id="alpha", value=b"s"),
+                },
+                originator_id="alpha",
+            ),
+        )
+        pub = reader.get(timeout=5.0)
+        assert set(pub.key_vals) == {"special:1"}
