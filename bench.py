@@ -13,24 +13,46 @@ openr/decision/tests/DecisionBenchmark.cpp: BM_DecisionFabric, and its
   Decision.cpp:1124 getNextHopsWithMetric, :1192) + distance/first-hop
   readback to the host.
 
-Prints one JSON line:
-  {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": x}
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": ms, "unit": "ms", "vs_baseline": x,
+   "device_only_ms": ms, "platform": "...", "error": null}
 where vs_baseline is the speedup vs the reference's 100 ms convergence
-design goal (>1.0 means faster than the goal).
+design goal (>1.0 means faster than the goal). `value` is end-to-end
+(dispatch + readback); `device_only_ms` isolates on-device compute by
+timing K data-dependent chained dispatches against one (the fixed
+relay/transport cost cancels in the difference).
+
+Resilience: the TPU is reached through a relay that has been observed to
+(a) fail backend init outright and (b) HANG indefinitely on the first
+device op or even on jax.devices(). The top-level process therefore never
+imports jax: it probes the backend in a subprocess with a hard timeout,
+runs the benchmark in a TPU child if the probe passes, and degrades to a
+CPU-pinned child otherwise — so a JSON line (with an "error"/"fallback"
+field when degraded) is emitted no matter what the relay does.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import subprocess
+import sys
 import time
+import traceback
 
-import numpy as np
+BASELINE_MS = 100.0  # reference convergence design goal
+# error-path fallback only; successful runs name the real node count
+METRIC_NAME = "spf_reconvergence_ms_fattree_1008"
+PROBE_TIMEOUT_S = 60
+TPU_CHILD_TIMEOUT_S = 270
+CPU_CHILD_TIMEOUT_S = 150
 
 
-
-def main() -> None:
+def _run() -> dict:
+    import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from openr_tpu.graph.linkstate import LinkState
     from openr_tpu.graph.snapshot import INF, SnapshotCache, pad_patch_rows
@@ -38,6 +60,7 @@ def main() -> None:
     from openr_tpu.ops import spf as spf_ops
     from openr_tpu.types import Adjacency, AdjacencyDatabase
 
+    platform = jax.devices()[0].platform
     snapshots = SnapshotCache()
 
     topo = topologies.fat_tree_nodes(1000)
@@ -160,16 +183,168 @@ def main() -> None:
         t0 = time.perf_counter()
         reconverge()
         samples.append((time.perf_counter() - t0) * 1000.0)
-
     value = statistics.median(samples)
-    baseline_ms = 100.0  # reference convergence design goal
+
+    # Device-only compute time. A single e2e sample is dominated by the
+    # relay transport (~fixed per readback); chain K data-dependent
+    # dispatches (metric feeds back into the next step) with ONE readback
+    # at the end, subtract the 1-dispatch+readback time, and the fixed
+    # transport cost cancels: per-dispatch device time =
+    # (T_K - T_1) / (K - 1). On host CPU there is no transport to cancel
+    # (dispatch time IS compute time) — skip the ~46 extra full SPF
+    # dispatches so a slow degraded host still finishes in budget.
+    device_only = None
+    if platform != "cpu":
+        ov_dev = jnp.asarray(snap0.overloaded)
+        ids_dev = jnp.asarray(noop_ids)
+        # slice the 8 noop rows on-device: reading back the whole N x N
+        # matrix just to re-upload 8 rows costs a full relay round trip
+        vals_dev = state["metric_dev"][ids_dev, :]
+
+        def time_chain(k: int) -> float:
+            m = state["metric_dev"]
+            t0 = time.perf_counter()
+            packed = None
+            for _ in range(k):
+                m, packed = spf_ops.reconverge_step(
+                    m, ids_dev, vals_dev, ov_dev, srcs_dev
+                )
+            np.asarray(packed)
+            return (time.perf_counter() - t0) * 1000.0
+
+        time_chain(1)  # warm any K=1 cache path
+        k = 8
+        t1 = statistics.median(time_chain(1) for _ in range(5))
+        tk = statistics.median(time_chain(k) for _ in range(5))
+        device_only = round(max(0.0, (tk - t1) / (k - 1)), 3)
+
+    return {
+        "metric": f"spf_reconvergence_ms_fattree_{snap0.n}",
+        "value": round(value, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / value, 3),
+        "device_only_ms": device_only,
+        "n_nodes": snap0.n,
+        "platform": platform,
+        "minplus_impl": spf_ops.get_minplus_impl(),
+        "error": None,
+    }
+
+
+def _child_main(mode: str) -> None:
+    """Run the benchmark in a child process and print its JSON line."""
+    out = {
+        "metric": METRIC_NAME,
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "error": None,
+    }
+    try:
+        if mode == "cpu":
+            from openr_tpu.testing import pin_host_cpu
+
+            pin_host_cpu()
+        out = _run()
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+        out["traceback_tail"] = traceback.format_exc().splitlines()[-4:]
+    print(json.dumps(out))
+
+
+def _spawn(mode: str, timeout_s: int):
+    """Run this file in child mode; return (parsed json | None, note)."""
+    env = dict(os.environ, OPENR_BENCH_CHILD=mode)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"{mode} child timed out after {timeout_s}s"
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    # a child that died before printing JSON (native abort, import error)
+    # leaves its only diagnostic on stderr — surface the tail
+    err_tail = " | ".join(
+        proc.stderr.decode(errors="replace").splitlines()[-3:]
+    )
+    return None, (
+        f"{mode} child rc={proc.returncode}, no JSON line"
+        + (f"; stderr: {err_tail}" if err_tail else "")
+    )
+
+
+def _probe_tpu() -> tuple[bool, str]:
+    """Check that the default (relay) backend initializes AND completes a
+    trivial device round trip, under a hard timeout. jax.devices() itself
+    has been observed to hang on the relay, hence the subprocess."""
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "d = jax.devices()[0]\n"
+        "x = jnp.ones((8, 8), jnp.float32)\n"
+        "assert float(np.asarray(x @ x).sum()) == 512.0\n"
+        "print('PLATFORM=' + d.platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung (> {PROBE_TIMEOUT_S}s)"
+    out = proc.stdout.decode(errors="replace")
+    for line in out.splitlines():
+        if line.startswith("PLATFORM="):
+            plat = line.split("=", 1)[1].strip()
+            if plat == "cpu":
+                return False, "default backend is cpu"
+            return True, plat
+    return False, f"backend probe failed rc={proc.returncode}"
+
+
+def main() -> None:
+    child = os.environ.get("OPENR_BENCH_CHILD")
+    if child:
+        _child_main(child)
+        return
+
+    notes = []
+    ok, info = _probe_tpu()
+    if ok:
+        result, note = _spawn("tpu", TPU_CHILD_TIMEOUT_S)
+        if result is not None and result.get("error") is None:
+            print(json.dumps(result))
+            return
+        notes.append(note or f"tpu child error: {result.get('error')}")
+    else:
+        notes.append(f"tpu unavailable: {info}")
+
+    # Degraded path: a number on the host CPU is better than no number.
+    result, note = _spawn("cpu", CPU_CHILD_TIMEOUT_S)
+    if result is not None:
+        result["fallback"] = "; ".join(notes)
+        print(json.dumps(result))
+        return
+    notes.append(note or "cpu child failed")
     print(
         json.dumps(
             {
-                "metric": f"spf_reconvergence_ms_fattree_{snap0.n}",
-                "value": round(value, 3),
+                "metric": METRIC_NAME,
+                "value": None,
                 "unit": "ms",
-                "vs_baseline": round(baseline_ms / value, 3),
+                "vs_baseline": None,
+                "error": "; ".join(n for n in notes if n),
             }
         )
     )

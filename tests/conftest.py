@@ -3,22 +3,16 @@
 Multi-chip TPU hardware is not available in CI; all sharding tests run on
 8 virtual CPU devices (the standard JAX trick for testing pjit/shard_map
 topologies host-side). The driver separately dry-runs the multi-chip path
-via __graft_entry__.dryrun_multichip.
+via __graft_entry__.dryrun_multichip. The pin itself (env knobs + config
+override defeating the ambient TPU-relay site hook) lives in
+openr_tpu.testing so bench.py and the driver entries share one copy.
 """
 
 import os
+import sys
 
-# Override (not setdefault): the ambient environment may point JAX at a
-# single tunneled TPU chip; tests must run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The ambient site config can pin jax_platforms to the tunneled TPU plugin
-# regardless of the env var; force it back to CPU explicitly.
-import jax  # noqa: E402
+from openr_tpu.testing import pin_host_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_host_cpu(8)
