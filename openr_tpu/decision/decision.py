@@ -27,7 +27,7 @@ from typing import Callable, Dict, Optional, Set, Tuple
 
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb, DecisionRouteUpdate
-from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.spf_solver import SpfSolver, get_spf_counters
 from openr_tpu.graph.linkstate import LinkState, LinkStateChange
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.types import (
@@ -531,4 +531,5 @@ class Decision:
         out["decision.num_complete_adjacencies"] = num_adjacencies
         out["decision.num_nodes"] = max(len(nodes), 1)
         out["decision.num_prefixes"] = len(self.prefix_state.prefixes())
+        out.update(get_spf_counters())
         return out

@@ -150,8 +150,26 @@ def unregister_spf_backend(name: str) -> None:
 
 
 # above this node count the device backend switches from the dense
-# snapshot (O(N^2) metric matrix) to the sparse edge-list kernel
+# snapshot (O(N^2) metric matrix) to the resident sliced-ELL kernel
 SPARSE_NODE_THRESHOLD = 4096
+
+# Solver observability (exported through Decision.get_counters).
+# Process-global by design, mirroring the reference's fb303 counter
+# singletons (fb303::fbData->addStatValue) — and the ELL resident cache
+# these count against is itself process-global device state. The host
+# fallback counter tracks SpfView.metric_between queries answered by a
+# full host Dijkstra because the queried source was outside the device
+# batch — at scale that is an O(N log N) cliff that must stay at zero on
+# the hot path (round-1 review: silent fallback).
+SPF_COUNTERS: Dict[str, int] = {
+    "decision.spf_host_fallback": 0,
+    "decision.ell_full_compiles": 0,
+    "decision.ell_patches": 0,
+}
+
+
+def get_spf_counters() -> Dict[str, int]:
+    return dict(SPF_COUNTERS)
 
 
 class SpfView:
@@ -213,57 +231,33 @@ class SpfView:
         self._row_of = {nid: i for i, nid in enumerate(srcs)}
 
     def _init_device_sparse(self) -> None:
-        """Large-area device backend: same batched {source} + neighbors
-        view, but over the sparse edge-list kernel — no dense N x N
-        matrix is ever built (openr_tpu.ops.spf_sparse). First hops are
-        derived host-side from the batch rows (O(B x N) numpy)."""
-        from openr_tpu.ops import spf_sparse
-
-        graph = _SPARSE_GRAPHS.get(self._ls)
-        self._snap = _SparseIndexAdapter(graph)
-        sid = self._snap.id_of(self._root)
-        self._sid = sid
+        """Large-area device backend over resident sliced-ELL bands: the
+        same batched {source} + neighbors view as the dense path (packed
+        distances + on-device ECMP first hops, one transfer), but no
+        dense N x N matrix is ever built — and the bands stay resident on
+        the device across rebuilds, so steady-state churn costs one fused
+        O(rows x K) scatter + solve dispatch (ops.spf_sparse ELL; the
+        incremental-rebuild analogue of reference Decision.cpp:1896-1917)."""
         self._d_all = None
         self._fh = None
-        if sid is None:
+        if self._root not in self._ls.get_adjacency_databases():
+            self._snap = None
+            self._sid = None
             return
-        # direct min-metric per neighbor (parallel links: min wins)
-        w_sv_by_id: Dict[int, int] = {}
-        overloaded_nbr: Dict[int, bool] = {}
-        for link in self._ls.links_from_node(self._root):
-            if not link.is_up():
-                continue
-            other = link.other_node(self._root)
-            oid = graph.node_index.get(other)
-            if oid is None:
-                continue
-            m = int(link.metric_from(self._root))
-            if oid not in w_sv_by_id or m < w_sv_by_id[oid]:
-                w_sv_by_id[oid] = m
-            overloaded_nbr[oid] = self._ls.is_node_overloaded(other)
-        nbrs = sorted(w_sv_by_id)
-        srcs = [sid] + nbrs
-        d = np.asarray(
-            spf_sparse.sparse_distances_from_sources(graph, srcs)
+        graph, srcs, packed = _ELL_RESIDENT.view_packed(
+            self._ls, self._root
         )
-        d_src = d[0]
-        reachable = d_src < INF
-        fh = np.zeros((len(srcs), graph.n_pad), dtype=bool)
-        for i, v in enumerate(nbrs):
-            w_sv = w_sv_by_id[v]
-            row = 1 + i
-            if not overloaded_nbr[v]:
-                total = np.minimum(
-                    w_sv + d[row].astype(np.int64), int(INF)
-                )
-                fh[row] = total == d_src
-            if w_sv == d_src[v]:
-                fh[row, v] = True
-            fh[row] &= reachable
-        self._d = d
-        self._fh_batch = fh
+        self._snap = _SparseIndexAdapter(graph)
+        self._sid = graph.node_index[self._root]
+        b = len(srcs)
+        self._d = packed[:b]
+        self._fh_batch = packed[b:].astype(bool)
         self._batch_srcs = srcs
-        self._row_of = {nid: i for i, nid in enumerate(srcs)}
+        # padding repeats the source id; keep the first (real) row
+        row_of: Dict[int, int] = {}
+        for i, nid in enumerate(srcs):
+            row_of.setdefault(nid, i)
+        self._row_of = row_of
 
     # -- native backend ---------------------------------------------------
 
@@ -362,7 +356,11 @@ class SpfView:
             row = self._row_of.get(aid)
             if row is None:
                 # not in the batch (a is neither root nor neighbor):
-                # fall back to the host oracle, correctness over speed
+                # fall back to the host oracle, correctness over speed.
+                # Counted: at scale this is an O(N log N) cliff that must
+                # stay at zero on the hot path (LFA only queries
+                # neighbors, which the batch always covers).
+                SPF_COUNTERS["decision.spf_host_fallback"] += 1
                 res = self._ls.get_spf_result(a)
                 return res[b].metric if b in res else None
             if self._d[row, bid] >= INF:
@@ -398,27 +396,62 @@ class _SparseIndexAdapter:
         return self.node_index.get(node)
 
 
-class _SparseGraphCache:
-    """compile_sparse results keyed by LinkState identity + topology
-    version (the sparse analogue of SnapshotCache)."""
+class _EllResidentCache:
+    """Device-resident sliced-ELL state per LinkState identity.
+
+    The bands live on the device across rebuilds (EllState). On a
+    topology change the LinkState journal's affected set drives
+    ``ell_patch`` and one fused scatter+solve dispatch
+    (``EllState.reconverge``); only a node-set change, a row outgrowing
+    its degree-class band, or a journal gap forces ``compile_ell`` from
+    scratch. This is the sparse analogue of the dense path's
+    SnapshotCache row-patching (reference incremental rebuild:
+    openr/decision/Decision.cpp:1896-1917)."""
 
     def __init__(self) -> None:
         import weakref
 
+        # ls -> (synced topology_version, EllState)
         self._cache = weakref.WeakKeyDictionary()
 
-    def get(self, ls: LinkState):
+    def view_packed(
+        self, ls: LinkState, root: str
+    ) -> Tuple[object, List[int], np.ndarray]:
+        """Sync the resident bands to ``ls`` and solve the batched
+        {root} + neighbors view. Returns (EllGraph, batch srcs, packed
+        [2B, n_pad] host array: B distance rows then B first-hop rows)."""
         from openr_tpu.ops import spf_sparse
 
         entry = self._cache.get(ls)
-        if entry is not None and entry[0] == ls.topology_version:
-            return entry[1]
-        graph = spf_sparse.compile_sparse(ls)
-        self._cache[ls] = (ls.topology_version, graph)
-        return graph
+        state = None
+        graph = None
+        if entry is not None:
+            version, state = entry
+            if version == ls.topology_version:
+                graph = state.graph
+            else:
+                affected = ls.affected_since(version)
+                patched = (
+                    spf_sparse.ell_patch(state.graph, ls, sorted(affected))
+                    if affected is not None
+                    else None
+                )
+                if patched is None:
+                    state = None  # fall through to full compile
+                else:
+                    graph = patched
+                    SPF_COUNTERS["decision.ell_patches"] += 1
+        if state is None:
+            graph = spf_sparse.compile_ell(ls)
+            state = spf_sparse.EllState(graph)
+            SPF_COUNTERS["decision.ell_full_compiles"] += 1
+        srcs = spf_sparse.ell_source_batch(graph, ls, root)
+        packed = np.asarray(state.reconverge(graph, srcs))
+        self._cache[ls] = (ls.topology_version, state)
+        return state.graph, srcs, packed
 
 
-_SPARSE_GRAPHS = _SparseGraphCache()
+_ELL_RESIDENT = _EllResidentCache()
 
 
 class SpfSolver:

@@ -2,7 +2,14 @@
 shadow and compare): the device and host backends process the same
 randomized mutation stream and must emit byte-identical RouteDatabases
 after every step. This is the acceptance gate the reference's
-DecisionTest corpus approximates with hand-picked cases."""
+DecisionTest corpus approximates with hand-picked cases.
+
+Streams cover grid, fat-tree fabric, and random-mesh topologies with
+metric churn, overload flips, prefix churn, link flaps, and node
+add/remove — the latter exercising the sliced-ELL resident path's
+full-recompile fallback while metric churn exercises its patch path
+(asserted via the decision.ell_* counters).
+"""
 
 import random
 from dataclasses import replace
@@ -10,9 +17,10 @@ from dataclasses import replace
 import pytest
 
 from openr_tpu.decision.prefix_state import PrefixState
-from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.decision.spf_solver import SPF_COUNTERS, SpfSolver
 from openr_tpu.graph.linkstate import LinkState
 from openr_tpu.models import topologies
+from openr_tpu.models.topologies import _mk_adj
 from openr_tpu.types import (
     AdjacencyDatabase,
     IpPrefix,
@@ -31,76 +39,222 @@ def build(topo):
     return ls, ps
 
 
-def mutate(rng, ls, ps, topo):
-    """One random churn event; returns a description for failure
-    messages."""
-    kind = rng.choice(
-        ["metric", "metric", "metric", "overload", "prefix", "drop_node"]
-    )
-    names = sorted(ls.get_adjacency_databases())
-    victim = rng.choice(names)
-    db = ls.get_adjacency_databases()[victim]
-    if kind == "metric" and db.adjacencies:
+class Churn:
+    """Randomized mutation stream over a live LinkState + PrefixState."""
+
+    def __init__(self, rng, ls, ps, topo, node_churn=False):
+        self.rng = rng
+        self.ls = ls
+        self.ps = ps
+        self.topo = topo
+        self.node_churn = node_churn
+        self.added = []  # nodes added by add_node, eligible for del_node
+        self.next_id = 1000
+
+    def step(self) -> str:
+        kinds = ["metric"] * 4 + ["overload", "prefix", "flap"]
+        if self.node_churn:
+            kinds += ["add_node"] if not self.added else ["add_node", "del_node"]
+        kind = self.rng.choice(kinds)
+        return getattr(self, kind)()
+
+    def _dbs(self):
+        return self.ls.get_adjacency_databases()
+
+    def _victim(self):
+        return self.rng.choice(sorted(self._dbs()))
+
+    def metric(self) -> str:
+        victim = self._victim()
+        db = self._dbs()[victim]
+        if not db.adjacencies:
+            return self.overload()
         adjs = list(db.adjacencies)
-        i = rng.randrange(len(adjs))
-        adjs[i] = replace(adjs[i], metric=rng.randint(1, 20))
-        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+        i = self.rng.randrange(len(adjs))
+        adjs[i] = replace(adjs[i], metric=self.rng.randint(1, 20))
+        self.ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
         return f"metric {victim}[{i}]"
-    if kind == "overload":
-        ls.update_adjacency_database(
+
+    def overload(self) -> str:
+        victim = self._victim()
+        db = self._dbs()[victim]
+        self.ls.update_adjacency_database(
             replace(db, is_overloaded=not db.is_overloaded)
         )
         return f"overload {victim} -> {not db.is_overloaded}"
-    if kind == "prefix":
-        extra = IpPrefix.from_str(f"fd00:{rng.randint(0, 0xffff):x}::/64")
-        ps.update_prefix_database(
+
+    def prefix(self) -> str:
+        victim = self._victim()
+        base = self.topo.prefix_dbs.get(victim)
+        entries = tuple(base.prefix_entries) if base is not None else ()
+        extra = IpPrefix.from_str(
+            f"fd00:{self.rng.randint(0, 0xffff):x}::/64"
+        )
+        self.ps.update_prefix_database(
             PrefixDatabase(
                 this_node_name=victim,
-                prefix_entries=tuple(topo.prefix_dbs[victim].prefix_entries)
-                + (PrefixEntry(prefix=extra),),
-                area=topo.area,
+                prefix_entries=entries + (PrefixEntry(prefix=extra),),
+                area=self.topo.area,
             )
         )
         return f"prefix {victim} += {extra}"
-    # drop_node: withdraw all adjacencies (node keeps its prefix db)
-    ls.update_adjacency_database(replace(db, adjacencies=()))
-    return f"drop {victim}"
+
+    def flap(self) -> str:
+        """Withdraw one adjacency (half-link down), or restore the node's
+        full original adjacency set."""
+        victim = self._victim()
+        db = self._dbs()[victim]
+        orig = self.topo.adj_dbs.get(victim)
+        if db.adjacencies:
+            adjs = list(db.adjacencies)
+            adjs.pop(self.rng.randrange(len(adjs)))
+            self.ls.update_adjacency_database(
+                replace(db, adjacencies=tuple(adjs))
+            )
+            return f"flap down {victim}"
+        if orig is not None:
+            self.ls.update_adjacency_database(orig)
+            return f"flap restore {victim}"
+        return self.overload()
+
+    def add_node(self) -> str:
+        """Join a brand-new node to two existing ones (bidirectional),
+        with its own loopback prefix — forces a node-set change."""
+        name = f"joined-{self.next_id}"
+        idx = self.next_id
+        self.next_id += 1
+        peers = sorted(self._dbs())
+        self.rng.shuffle(peers)
+        peers = peers[:2]
+        all_names = sorted(self._dbs())
+        adjs = []
+        for p in peers:
+            pdb = self._dbs()[p]
+            m = self.rng.randint(1, 9)
+            # peer indices are only used for synthetic next-hop byte
+            # derivation; sorted position keeps the stream reproducible
+            # under hash randomization
+            p_idx = all_names.index(p) % 251
+            adjs.append(_mk_adj(name, idx, p, p_idx, m))
+            self.ls.update_adjacency_database(
+                replace(
+                    pdb,
+                    adjacencies=tuple(pdb.adjacencies)
+                    + (_mk_adj(p, p_idx, name, idx, m),),
+                )
+            )
+        self.ls.update_adjacency_database(
+            AdjacencyDatabase(
+                this_node_name=name,
+                adjacencies=tuple(adjs),
+                node_label=idx,
+                area=self.topo.area,
+            )
+        )
+        self.ps.update_prefix_database(
+            PrefixDatabase(
+                this_node_name=name,
+                prefix_entries=(
+                    PrefixEntry(
+                        prefix=IpPrefix.from_str(f"fd01:{idx:x}::/64")
+                    ),
+                ),
+                area=self.topo.area,
+            )
+        )
+        self.added.append(name)
+        return f"add_node {name} <-> {peers}"
+
+    def del_node(self) -> str:
+        name = self.added.pop(self.rng.randrange(len(self.added)))
+        self.ls.delete_adjacency_database(name)
+        # neighbors drop their half of the links
+        for peer, pdb in list(self._dbs().items()):
+            kept = tuple(
+                a for a in pdb.adjacencies if a.other_node_name != name
+            )
+            if len(kept) != len(pdb.adjacencies):
+                self.ls.update_adjacency_database(
+                    replace(pdb, adjacencies=kept)
+                )
+        self.ps.update_prefix_database(
+            PrefixDatabase(
+                this_node_name=name, prefix_entries=(), area=self.topo.area
+            )
+        )
+        return f"del_node {name}"
+
+
+def run_shadow(topo, root, steps, seed, node_churn=False, lfa=False):
+    rng = random.Random(seed)
+    ls, ps = build(topo)
+    area_ls = {topo.area: ls}
+    device = SpfSolver(root, backend="device", compute_lfa_paths=lfa)
+    host = SpfSolver(root, backend="host", compute_lfa_paths=lfa)
+    churn = Churn(rng, ls, ps, topo, node_churn=node_churn)
+    for step in range(steps):
+        desc = churn.step()
+        d_db = device.build_route_db(root, area_ls, ps)
+        h_db = host.build_route_db(root, area_ls, ps)
+        d_out = d_db.to_route_db(root) if d_db else None
+        h_out = h_db.to_route_db(root) if h_db else None
+        assert d_out == h_out, f"step {step}: {desc}"
 
 
 class TestShadowParity:
     @pytest.mark.parametrize("seed", [0, 1, 2])
     def test_device_shadows_host_under_churn(self, seed):
-        rng = random.Random(seed)
         topo = topologies.random_mesh(
             16, degree=4, seed=seed + 100, max_metric=12
         )
-        ls, ps = build(topo)
-        area_ls = {topo.area: ls}
-        device = SpfSolver("node-0", backend="device")
-        host = SpfSolver("node-0", backend="host")
+        run_shadow(topo, "node-0", steps=12, seed=seed)
 
-        for step in range(12):
-            desc = mutate(rng, ls, ps, topo)
-            d_db = device.build_route_db("node-0", area_ls, ps)
-            h_db = host.build_route_db("node-0", area_ls, ps)
-            d_out = d_db.to_route_db("node-0") if d_db else None
-            h_out = h_db.to_route_db("node-0") if h_db else None
-            assert d_out == h_out, f"step {step}: {desc}"
+    def test_grid_long_stream_with_node_churn(self):
+        topo = topologies.grid(5)
+        run_shadow(
+            topo, topo.nodes()[0], steps=60, seed=11, node_churn=True
+        )
 
-    def test_sparse_device_shadows_host_under_churn(self, monkeypatch):
+    def test_fabric_stream(self):
+        topo = topologies.fat_tree_nodes(80)
+        run_shadow(topo, "rsw-0-0", steps=40, seed=23)
+
+
+class TestSparseShadowParity:
+    """Same gate over the sliced-ELL resident device path."""
+
+    @pytest.fixture(autouse=True)
+    def _force_sparse(self, monkeypatch):
         from openr_tpu.decision import spf_solver as ss
 
         monkeypatch.setattr(ss, "SPARSE_NODE_THRESHOLD", 4)
-        rng = random.Random(7)
+
+    def test_sparse_device_shadows_host_under_churn(self):
         topo = topologies.random_mesh(14, degree=3, seed=77, max_metric=9)
-        ls, ps = build(topo)
-        area_ls = {topo.area: ls}
-        sparse = SpfSolver("node-1", backend="device")
-        host = SpfSolver("node-1", backend="host")
-        for step in range(10):
-            desc = mutate(rng, ls, ps, topo)
-            s_db = sparse.build_route_db("node-1", area_ls, ps)
-            h_db = host.build_route_db("node-1", area_ls, ps)
-            s_out = s_db.to_route_db("node-1") if s_db else None
-            h_out = h_db.to_route_db("node-1") if h_db else None
-            assert s_out == h_out, f"step {step}: {desc}"
+        run_shadow(topo, "node-1", steps=10, seed=7)
+
+    def test_sparse_grid_long_stream_with_node_churn(self):
+        topo = topologies.grid(5)
+        run_shadow(
+            topo, topo.nodes()[0], steps=60, seed=31, node_churn=True
+        )
+
+    def test_sparse_fabric_stream_uses_patch_path(self):
+        """Metric/overload/prefix churn on a fixed node set must ride the
+        ELL patch path (resident bands), not full recompiles, and LFA's
+        metric_between queries must never fall back to host Dijkstra."""
+        topo = topologies.fat_tree_nodes(80)
+        before = dict(SPF_COUNTERS)
+        run_shadow(topo, "rsw-0-0", steps=40, seed=41, lfa=True)
+        patches = SPF_COUNTERS["decision.ell_patches"] - before[
+            "decision.ell_patches"
+        ]
+        compiles = SPF_COUNTERS["decision.ell_full_compiles"] - before[
+            "decision.ell_full_compiles"
+        ]
+        fallbacks = SPF_COUNTERS["decision.spf_host_fallback"] - before[
+            "decision.spf_host_fallback"
+        ]
+        assert patches >= 30, (patches, compiles)
+        assert compiles <= 3, (patches, compiles)
+        assert fallbacks == 0, fallbacks
