@@ -504,6 +504,328 @@ class TestDecisionModuleBehaviors:
         assert counters["decision.num_conflicting_prefixes"] == 0
 
 
+class TestDecisionFixtureMore:
+    """reference: DecisionTest.cpp DecisionTestFixture cases — round 2
+    additions (:4878 InitialRouteUpdate, :5353 ParallelLinks, :6166
+    PerPrefixKeyExpiry, :6361 ExceedMaxBackoff, :5073
+    SelfReditributePrefixPublication, :6048 DecisionSubReliability)."""
+
+    @pytest.fixture
+    def harness(self):
+        h = DecisionHarness("a")
+        yield h
+        h.stop()
+
+    def test_initial_route_update(self, harness):
+        # reference: :4878 — the first emitted delta carries the full
+        # initial RIB as updates, nothing as deletes
+        topo = line_topology()
+        harness.publish_topology(topo)
+        updates = harness.drain_updates()
+        assert updates
+        first = updates[0]
+        assert not first.unicast_routes_to_delete
+        got = set()
+        for u in updates:
+            got |= set(u.unicast_routes_to_update)
+        for node in ("b", "c"):
+            assert topo.prefix_dbs[node].prefix_entries[0].prefix in got
+
+    def test_parallel_links_decision(self, harness):
+        # reference: :5353 ParallelLinks — ECMP over equal parallel
+        # adjacencies; metric bump collapses to the cheaper link
+        from openr_tpu.types import Adjacency
+
+        def adj_db(metric2):
+            return AdjacencyDatabase(
+                this_node_name="a",
+                adjacencies=(
+                    Adjacency(
+                        other_node_name="b",
+                        if_name="if1_ab",
+                        other_if_name="if1_ba",
+                        metric=10,
+                    ),
+                    Adjacency(
+                        other_node_name="b",
+                        if_name="if2_ab",
+                        other_if_name="if2_ba",
+                        metric=metric2,
+                    ),
+                ),
+                area="0",
+            )
+
+        b_side = AdjacencyDatabase(
+            this_node_name="b",
+            adjacencies=(
+                Adjacency(
+                    other_node_name="a",
+                    if_name="if1_ba",
+                    other_if_name="if1_ab",
+                    metric=10,
+                ),
+                Adjacency(
+                    other_node_name="a",
+                    if_name="if2_ba",
+                    other_if_name="if2_ab",
+                    metric=10,
+                ),
+            ),
+            area="0",
+        )
+        harness.publish_adj(adj_db(10))
+        harness.publish_adj(b_side)
+        b_pfx = IpPrefix.from_str("fd00:b::/64")
+        harness.publish_prefixes(prefix_db("b", ["fd00:b::/64"]))
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        ifaces = {
+            nh.address.if_name
+            for nh in routes.unicast_routes[b_pfx].nexthops
+        }
+        assert ifaces == {"if1_ab", "if2_ab"}
+
+        # bump one link's metric: single next-hop remains
+        harness.publish_adj(adj_db(20))
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        ifaces = {
+            nh.address.if_name
+            for nh in routes.unicast_routes[b_pfx].nexthops
+        }
+        assert ifaces == {"if1_ab"}
+
+    def test_per_prefix_key_expiry(self, harness):
+        # reference: :6166 PerPrefixKeyExpiry — a TTL'd per-prefix key
+        # expires in KvStore and Decision withdraws the route
+        from openr_tpu.utils import keys as keyutil
+        from openr_tpu.utils import wire
+
+        topo = line_topology()
+        for adb in topo.adj_dbs.values():
+            harness.publish_adj(adb)
+        extra = IpPrefix.from_str("fd00:e0e::/64")
+        key = keyutil.per_prefix_key("b", topo.area, extra)
+        pdb = PrefixDatabase(
+            this_node_name="b",
+            prefix_entries=(PrefixEntry(prefix=extra),),
+            area=topo.area,
+        )
+        harness.store.set_key(
+            key, wire.dumps(pdb), version=1, originator="b", ttl=500
+        )
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        assert extra in routes.unicast_routes
+
+        # wait past the TTL: the key expires, the route is withdrawn
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            routes = harness.decision.get_decision_route_db()
+            if extra not in routes.unicast_routes:
+                break
+            time.sleep(0.1)
+        assert extra not in routes.unicast_routes
+
+    def test_exceed_max_backoff(self, harness):
+        # reference: :6361 ExceedMaxBackoff — a continuous update stream
+        # cannot starve route builds past the debounce ceiling
+        topo = line_topology()
+        harness.publish_topology(topo)
+        harness.drain_updates()
+        runs_before = harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ]
+        # stream updates for ~8x the max debounce (0.05s in the harness)
+        extra = IpPrefix.from_str("fd00:7e7::/64")
+        end = time.time() + 0.4
+        i = 0
+        while time.time() < end:
+            i += 1
+            harness.publish_prefixes(
+                PrefixDatabase(
+                    this_node_name="c",
+                    prefix_entries=topo.prefix_dbs["c"].prefix_entries
+                    + (PrefixEntry(prefix=extra),) * (i % 2),
+                    area=topo.area,
+                )
+            )
+            time.sleep(0.01)
+        harness.drain_updates()
+        runs_after = harness.decision.get_counters()[
+            "decision.route_build_runs"
+        ]
+        # at least one build happened DURING the stream (max-backoff fired),
+        # and far fewer builds than publications (min-backoff coalesced)
+        assert runs_after > runs_before
+        assert runs_after - runs_before < i
+
+    def test_self_advertised_anycast_no_local_route(self, harness):
+        # reference: :5073 flavor — a prefix we advertise ourselves is
+        # never programmed locally, even when others advertise it too
+        topo = line_topology()
+        harness.publish_topology(topo)
+        anycast = IpPrefix.from_str("fd00:5e1f::/64")
+        for node in ("a", "c"):
+            harness.publish_prefixes(
+                PrefixDatabase(
+                    this_node_name=node,
+                    prefix_entries=topo.prefix_dbs[node].prefix_entries
+                    + (PrefixEntry(prefix=anycast),),
+                    area=topo.area,
+                )
+            )
+        harness.drain_updates()
+        routes = harness.decision.get_decision_route_db()
+        assert anycast not in routes.unicast_routes
+
+    def test_decision_sub_reliability(self):
+        # reference: :6048 DecisionSubReliability — a burst of hundreds of
+        # publications is fully absorbed; the final RIB matches a clean
+        # solver run over the final state
+        import random
+
+        rng = random.Random(7)
+        topo = topologies.grid(4)
+        harness = DecisionHarness("node-0")
+        try:
+            self._run_sub_reliability(harness, rng, topo)
+        finally:
+            harness.stop()
+
+    def _run_sub_reliability(self, harness, rng, topo):
+        harness.publish_topology(topo)
+        nodes = sorted(topo.adj_dbs)
+        # churn: random metric changes across the grid
+        for step in range(200):
+            node = rng.choice(nodes)
+            adb = topo.adj_dbs[node]
+            adjs = tuple(
+                Adjacency(
+                    other_node_name=a.other_node_name,
+                    if_name=a.if_name,
+                    other_if_name=a.other_if_name,
+                    metric=rng.randint(1, 10),
+                    next_hop_v6=a.next_hop_v6,
+                    next_hop_v4=a.next_hop_v4,
+                    adj_label=a.adj_label,
+                )
+                for a in adb.adjacencies
+            )
+            topo.adj_dbs[node] = AdjacencyDatabase(
+                this_node_name=node,
+                adjacencies=adjs,
+                node_label=adb.node_label,
+                area=adb.area,
+            )
+            harness.publish_adj(topo.adj_dbs[node])
+        harness.drain_updates()
+
+        # clean-room reference: fresh LinkState + solver over final state
+        ls = LinkState(area=topo.area)
+        for n in nodes:
+            ls.update_adjacency_database(topo.adj_dbs[n])
+        ps = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            ps.update_prefix_database(pdb)
+        expected = SpfSolver("node-0").build_route_db(
+            "node-0", {topo.area: ls}, ps
+        )
+        got = harness.decision.get_decision_route_db()
+        assert got.unicast_routes == expected.unicast_routes
+
+
+class TestBgpIgpTieBreak:
+    """reference: DecisionTest.cpp:907 BGPRedistribution.IgpMetric — metric
+    vectors tie on a tie-breaker entity, so the IGP distance decides; link
+    drains and metric bumps shift the winner and it all heals."""
+
+    def test_igp_metric_walk(self):
+        from openr_tpu.decision.metric_vector import (
+            CompareType,
+            MetricEntity,
+            MetricVector,
+        )
+        from openr_tpu.types import PrefixType
+
+        def bgp_mv(tie_metric):
+            # 5 entities, identical except the lowest-priority tie-breaker
+            ents = [
+                MetricEntity(
+                    type=i,
+                    priority=i,
+                    op=CompareType.WIN_IF_PRESENT,
+                    is_best_path_tie_breaker=(i == 4),
+                    metric=(tie_metric if i == 4 else i,),
+                )
+                for i in range(5)
+            ]
+            return MetricVector(metrics=tuple(ents))
+
+        anycast = IpPrefix.from_str("fd00:b9c::/64")
+
+        def adj_db_1(m2=10, m3=10, drain2=False):
+            return db(
+                "1",
+                [
+                    adj("2", "if_12", "if_21", metric=m2,
+                        overloaded=drain2),
+                    adj("3", "if_13", "if_31", metric=m3),
+                ],
+            )
+
+        ls = LinkState(area="0")
+        ls.update_adjacency_database(adj_db_1())
+        ls.update_adjacency_database(db("2", [adj("1", "if_21", "if_12",
+                                                  metric=10)]))
+        ls.update_adjacency_database(db("3", [adj("1", "if_31", "if_13",
+                                                  metric=10)]))
+        ps = PrefixState()
+        for node, tie in (("2", 4), ("3", 100)):
+            ps.update_prefix_database(
+                PrefixDatabase(
+                    this_node_name=node,
+                    prefix_entries=(
+                        PrefixEntry(
+                            prefix=IpPrefix.from_str(f"fd00:{node}::/64")
+                        ),
+                        PrefixEntry(
+                            prefix=anycast,
+                            type=PrefixType.BGP,
+                            mv=bgp_mv(tie),
+                        ),
+                    ),
+                    area="0",
+                )
+            )
+        solver = SpfSolver("1", enable_best_route_selection=False)
+        area_ls = {"0": ls}
+
+        def anycast_hops():
+            rdb = solver.build_route_db("1", area_ls, ps)
+            entry = rdb.unicast_routes.get(anycast)
+            if entry is None:
+                return None
+            return {(nh.neighbor_node_name, nh.metric)
+                    for nh in entry.nexthops}
+
+        # step 1: equidistant tie-broken advertisers -> ECMP
+        assert anycast_hops() == {("2", 10), ("3", 10)}
+        # step 2: node 3 farther -> node 2 only
+        ls.update_adjacency_database(adj_db_1(m3=20))
+        assert anycast_hops() == {("2", 10)}
+        # step 3: drain the 1-2 link -> node 3 takes over
+        ls.update_adjacency_database(adj_db_1(m3=20, drain2=True))
+        assert anycast_hops() == {("3", 20)}
+        # step 4: bump drained link metric, still node 3
+        ls.update_adjacency_database(adj_db_1(m2=20, m3=20, drain2=True))
+        assert anycast_hops() == {("3", 20)}
+        # step 5: undrain -> equidistant ECMP again
+        ls.update_adjacency_database(adj_db_1(m2=20, m3=20))
+        assert anycast_hops() == {("2", 20), ("3", 20)}
+
+
 class TestDecisionPendingUpdates:
     """reference: DecisionTest.cpp:6485-6545 DecisionPendingUpdates unit
     group."""

@@ -1,0 +1,651 @@
+"""Decision parity corpus, part 3: the reference ring-topology golden
+cases (openr/decision/tests/DecisionTest.cpp — SimpleRingTopologyFixture
+:1814-3252, SimpleRingMeshTopologyFixture :1607, ParallelAdjRingTopology
+:3252-3893, ConnectivityTest :1279-1607, Decision.BestRouteSelection
+:1070, IpToMplsLabelPrepend :2129, AttachedNodesTest :2770).
+
+All scenarios re-written fresh against our API, parametrized over the
+host and device SPF backends so the batched TPU path is held to the
+same golden answers as the Dijkstra oracle.
+
+Reference ring:
+
+    1------2
+    |      |
+    3------4
+
+all links metric 10, node labels 1-4, adj labels 90xy (x->y).
+"""
+
+import pytest
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.types import (
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    NextHop,
+    PrefixDatabase,
+    PrefixEntry,
+    PrefixMetrics,
+)
+from openr_tpu.types.lsdb import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+from tests.test_linkstate import adj, db
+
+BACKENDS = ["host", "device"]
+
+RING_EDGES = [("1", "2"), ("1", "3"), ("2", "4"), ("3", "4")]
+MESH_EDGES = RING_EDGES + [("1", "4"), ("2", "3")]
+
+
+def addr(node):
+    return IpPrefix.from_str(f"fd00:{node}::/64")
+
+
+def _adj(a, b, metric=10, overloaded=False):
+    return adj(
+        b,
+        f"if_{a}{b}",
+        f"if_{b}{a}",
+        metric=metric,
+        overloaded=overloaded,
+        adj_label=9000 + 10 * int(a) + int(b),
+    )
+
+
+def make_adj_dbs(edges, metric=10):
+    nodes = sorted({n for e in edges for n in e})
+    adjs = {n: [] for n in nodes}
+    for a, b in edges:
+        adjs[a].append(_adj(a, b, metric))
+        adjs[b].append(_adj(b, a, metric))
+    return {
+        n: db(n, adjs[n], node_label=int(n)) for n in nodes
+    }
+
+
+def make_entry(node, ksp2=False, **kw):
+    if ksp2:
+        kw.setdefault("forwarding_type", PrefixForwardingType.SR_MPLS)
+        kw.setdefault(
+            "forwarding_algorithm", PrefixForwardingAlgorithm.KSP2_ED_ECMP
+        )
+    return PrefixEntry(prefix=addr(node), **kw)
+
+
+def make_network(adj_dbs, entries=None, ksp2=False):
+    """entries: {node: [PrefixEntry, ...]} override; default one loopback
+    per node."""
+    ls = LinkState(area="0")
+    for n in sorted(adj_dbs):
+        ls.update_adjacency_database(adj_dbs[n])
+    ps = PrefixState()
+    if entries is None:
+        entries = {n: [make_entry(n, ksp2=ksp2)] for n in adj_dbs}
+    for n, ents in entries.items():
+        ps.update_prefix_database(
+            PrefixDatabase(
+                this_node_name=n, prefix_entries=tuple(ents), area="0"
+            )
+        )
+    return {"0": ls}, ps
+
+
+def route_maps(backend, area_ls, ps, nodes):
+    """Per-node RouteDatabases, reference getRouteMap analogue."""
+    out = {}
+    for n in nodes:
+        out[n] = SpfSolver(n, backend=backend).build_route_db(
+            n, area_ls, ps
+        )
+    return out
+
+
+def nh_set(entry):
+    return {
+        (nh.neighbor_node_name, nh.metric, nh.mpls_action)
+        for nh in entry.nexthops
+    }
+
+
+PHP = MplsAction(action=MplsActionCode.PHP)
+
+
+def swap(label):
+    return MplsAction(action=MplsActionCode.SWAP, swap_label=label)
+
+
+def push(*labels):
+    """bottom-of-stack first, matching reference pushLabels order."""
+    return MplsAction(action=MplsActionCode.PUSH, push_labels=tuple(labels))
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestRingShortestPath:
+    """reference: DecisionTest.cpp:1814 SimpleRingTopology ShortestPathTest
+    + :1999 MultiPathTest."""
+
+    def test_route_counts(self, backend):
+        area_ls, ps = make_network(make_adj_dbs(RING_EDGES))
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # 3 unicast each (12 total); 4 node-label + 2 adj-label each
+        for n in "1234":
+            assert len(rm[n].unicast_routes) == 3
+            assert len(rm[n].mpls_routes) == 6
+        assert sum(len(rm[n].unicast_routes) for n in "1234") == 12
+
+    def test_ecmp_across_ring(self, backend):
+        area_ls, ps = make_network(make_adj_dbs(RING_EDGES))
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # diagonal: two equal-cost paths
+        assert nh_set(rm["1"].unicast_routes[addr("4")]) == {
+            ("2", 20, None),
+            ("3", 20, None),
+        }
+        assert nh_set(rm["4"].unicast_routes[addr("1")]) == {
+            ("2", 20, None),
+            ("3", 20, None),
+        }
+        # direct neighbors: single hop at metric 10
+        assert nh_set(rm["1"].unicast_routes[addr("2")]) == {("2", 10, None)}
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {("3", 10, None)}
+        assert nh_set(rm["2"].unicast_routes[addr("4")]) == {("4", 10, None)}
+
+    def test_node_label_swap_and_php(self, backend):
+        area_ls, ps = make_network(make_adj_dbs(RING_EDGES))
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # remote label: SWAP via both ECMP first hops
+        assert nh_set(rm["1"].mpls_routes[4]) == {
+            ("2", 20, swap(4)),
+            ("3", 20, swap(4)),
+        }
+        # neighbor label: PHP
+        assert nh_set(rm["1"].mpls_routes[2]) == {("2", 10, PHP)}
+        assert nh_set(rm["1"].mpls_routes[3]) == {("3", 10, PHP)}
+
+    def test_pop_and_adj_labels(self, backend):
+        area_ls, ps = make_network(make_adj_dbs(RING_EDGES))
+        rm = route_maps(backend, area_ls, ps, "1234")
+        for n in "1234":
+            (nh,) = rm[n].mpls_routes[int(n)].nexthops
+            assert nh.mpls_action.action == MplsActionCode.POP_AND_LOOKUP
+        # adjacency labels terminate on the adjacent node (PHP)
+        assert nh_set(rm["1"].mpls_routes[9012]) == {("2", 10, PHP)}
+        assert nh_set(rm["1"].mpls_routes[9013]) == {("3", 10, PHP)}
+        assert nh_set(rm["4"].mpls_routes[9042]) == {("2", 10, PHP)}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestRingOverloadNode:
+    """reference: DecisionTest.cpp:2821 SimpleRingTopology OverloadNodeTest
+    — overloaded nodes 2 and 3 carry no transit; 1 and 4 partition."""
+
+    def test_overload_nodes_2_3(self, backend):
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        for n in ("2", "3"):
+            adj_dbs[n] = db(
+                n,
+                list(adj_dbs[n].adjacencies),
+                overloaded=True,
+                node_label=int(n),
+            )
+        area_ls, ps = make_network(adj_dbs)
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # 1 and 4 can't traverse the drained nodes to reach each other
+        assert addr("4") not in rm["1"].unicast_routes
+        assert addr("1") not in rm["4"].unicast_routes
+        # ...but still reach the drained neighbors directly
+        assert nh_set(rm["1"].unicast_routes[addr("2")]) == {("2", 10, None)}
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {("3", 10, None)}
+        # drained nodes route OUT normally (overload only blocks transit)
+        assert nh_set(rm["2"].unicast_routes[addr("3")]) == {
+            ("1", 20, None),
+            ("4", 20, None),
+        }
+        assert nh_set(rm["2"].unicast_routes[addr("1")]) == {("1", 10, None)}
+        # reference counts: 2 + 3 + 3 + 2 = 10 unicast routes
+        assert sum(len(rm[n].unicast_routes) for n in "1234") == 10
+
+    def test_overload_line_middle(self, backend):
+        # reference: DecisionTest.cpp:1279 ConnectivityTest.OverloadNodeTest
+        # (line 1-2-3, node 2 overloaded)
+        adj_dbs = {
+            "1": db("1", [_adj("1", "2")], node_label=1),
+            "2": db(
+                "2",
+                [_adj("2", "1"), _adj("2", "3")],
+                overloaded=True,
+                node_label=2,
+            ),
+            "3": db("3", [_adj("3", "2")], node_label=3),
+        }
+        area_ls, ps = make_network(adj_dbs)
+        rm = route_maps(backend, area_ls, ps, "123")
+        assert addr("3") not in rm["1"].unicast_routes
+        assert addr("1") not in rm["3"].unicast_routes
+        assert nh_set(rm["1"].unicast_routes[addr("2")]) == {("2", 10, None)}
+        assert nh_set(rm["3"].unicast_routes[addr("2")]) == {("2", 10, None)}
+        assert len(rm["2"].unicast_routes) == 2
+        # 4 unicast total; adj-label routes stay up regardless of overload
+        assert sum(len(rm[n].unicast_routes) for n in "123") == 4
+        assert nh_set(rm["2"].mpls_routes[9021]) == {("1", 10, PHP)}
+        assert nh_set(rm["2"].mpls_routes[9023]) == {("3", 10, PHP)}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestRingOverloadLink:
+    """reference: DecisionTest.cpp:2936 OverloadLinkTest — drain link 3-1,
+    routes detour; un-drain, routes heal."""
+
+    def test_overload_link_detour_and_heal(self, backend):
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        # overload adj 3->1 only (one side suffices)
+        adj_dbs["3"] = db(
+            "3",
+            [_adj("3", "1", overloaded=True), _adj("3", "4")],
+            node_label=3,
+        )
+        area_ls, ps = make_network(adj_dbs)
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # node 3 detours via 4 for everything
+        assert nh_set(rm["3"].unicast_routes[addr("4")]) == {("4", 10, None)}
+        assert nh_set(rm["3"].unicast_routes[addr("2")]) == {("4", 20, None)}
+        assert nh_set(rm["3"].unicast_routes[addr("1")]) == {("4", 30, None)}
+        # node 1 reaches 3 the long way
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {("2", 30, None)}
+        # heal: restore the adjacency
+        restored = make_adj_dbs(RING_EDGES)
+        change = area_ls["0"].update_adjacency_database(restored["3"])
+        assert change.topology_changed
+        rm = route_maps(backend, area_ls, ps, "13")
+        assert nh_set(rm["3"].unicast_routes[addr("1")]) == {("1", 10, None)}
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {("3", 10, None)}
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestRingAttachedNodes:
+    """reference: DecisionTest.cpp:2770 AttachedNodesTest — default route
+    from attached nodes 1 and 4; attached nodes install no default."""
+
+    def test_default_route_from_attached(self, backend):
+        default = IpPrefix.from_str("::/0")
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        entries = {n: [make_entry(n)] for n in adj_dbs}
+        entries["1"].append(PrefixEntry(prefix=default))
+        entries["4"].append(PrefixEntry(prefix=default))
+        area_ls, ps = make_network(adj_dbs, entries=entries)
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # advertisers don't install the default themselves
+        assert default not in rm["1"].unicast_routes
+        assert default not in rm["4"].unicast_routes
+        # transit nodes ECMP toward both attached nodes
+        assert nh_set(rm["2"].unicast_routes[default]) == {
+            ("1", 10, None),
+            ("4", 10, None),
+        }
+        assert nh_set(rm["3"].unicast_routes[default]) == {
+            ("1", 10, None),
+            ("4", 10, None),
+        }
+        # reference count: 12 + 2 default = 14 unicast
+        assert sum(len(rm[n].unicast_routes) for n in "1234") == 14
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestRingKsp2:
+    """reference: DecisionTest.cpp:2290 SimpleRingTopology Ksp2EdEcmp."""
+
+    def test_ksp2_route_shapes(self, backend):
+        area_ls, ps = make_network(make_adj_dbs(RING_EDGES), ksp2=True)
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # neighbor: direct path plus the edge-disjoint detour around the
+        # ring (1->3->4->2, push bottom-up {2,4})
+        assert nh_set(rm["1"].unicast_routes[addr("2")]) == {
+            ("2", 10, None),
+            ("3", 30, push(2, 4)),
+        }
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {
+            ("3", 10, None),
+            ("2", 30, push(3, 4)),
+        }
+        # diagonal: both 2-hop paths, single push of dst label
+        assert nh_set(rm["1"].unicast_routes[addr("4")]) == {
+            ("2", 20, push(4)),
+            ("3", 20, push(4)),
+        }
+        # symmetric spot-checks from node 4
+        assert nh_set(rm["4"].unicast_routes[addr("1")]) == {
+            ("2", 20, push(1)),
+            ("3", 20, push(1)),
+        }
+        assert nh_set(rm["4"].unicast_routes[addr("2")]) == {
+            ("2", 10, None),
+            ("3", 30, push(2, 1)),
+        }
+        # node-label routes unaffected by KSP2 (still SWAP/PHP)
+        assert nh_set(rm["1"].mpls_routes[4]) == {
+            ("2", 20, swap(4)),
+            ("3", 20, swap(4)),
+        }
+
+    def test_ksp2_overload_corner(self, backend):
+        # reference: DecisionTest.cpp:2455-2476 traceEdgeDisjointPaths
+        # corner: node 3 overloaded AND link 1-2 overloaded => node 1 has
+        # no route to 2 or 4, only the direct route to 3
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        adj_dbs["1"] = db(
+            "1",
+            [_adj("1", "2", overloaded=True), _adj("1", "3")],
+            node_label=1,
+        )
+        adj_dbs["3"] = db(
+            "3",
+            list(adj_dbs["3"].adjacencies),
+            overloaded=True,
+            node_label=3,
+        )
+        area_ls, ps = make_network(adj_dbs, ksp2=True)
+        rm = route_maps(backend, area_ls, ps, "1")
+        assert addr("2") not in rm["1"].unicast_routes
+        assert addr("4") not in rm["1"].unicast_routes
+        assert nh_set(rm["1"].unicast_routes[addr("3")]) == {
+            ("3", 10, None)
+        }
+
+    def test_ksp2_mesh(self, backend):
+        # reference: DecisionTest.cpp:1607 SimpleRingMeshTopology Ksp2EdEcmp
+        area_ls, ps = make_network(make_adj_dbs(MESH_EDGES), ksp2=True)
+        rm = route_maps(backend, area_ls, ps, "1")
+        assert nh_set(rm["1"].unicast_routes[addr("4")]) == {
+            ("4", 10, None),
+            ("2", 20, push(4)),
+            ("3", 20, push(4)),
+        }
+        # overload node 3: its detour drops, the rest stay
+        adj_dbs = make_adj_dbs(MESH_EDGES)
+        adj_dbs["3"] = db(
+            "3",
+            list(adj_dbs["3"].adjacencies),
+            overloaded=True,
+            node_label=3,
+        )
+        change = area_ls["0"].update_adjacency_database(adj_dbs["3"])
+        assert change.topology_changed
+        rm = route_maps(backend, area_ls, ps, "1")
+        assert nh_set(rm["1"].unicast_routes[addr("4")]) == {
+            ("4", 10, None),
+            ("2", 20, push(4)),
+        }
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestIpToMplsLabelPrepend:
+    """reference: DecisionTest.cpp:2129 IpToMplsLabelPrepend — SP-ECMP
+    IP->MPLS routes with min-nexthop, prepend labels and static next-hops."""
+
+    PREPEND = 10001
+
+    def _network(self, entry1_kw, node4_advertises=False):
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        entries = {n: [make_entry(n)] for n in adj_dbs}
+        entries["1"] = [
+            make_entry(
+                "1",
+                forwarding_type=PrefixForwardingType.SR_MPLS,
+                **entry1_kw,
+            )
+        ]
+        if node4_advertises:
+            entries["4"].append(
+                PrefixEntry(
+                    prefix=addr("1"),
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                    prepend_label=self.PREPEND,
+                )
+            )
+        return make_network(adj_dbs, entries=entries)
+
+    def test_ip2mpls_push_toward_advertiser(self, backend):
+        area_ls, ps = self._network({})
+        rm = route_maps(backend, area_ls, ps, "1234")
+        assert addr("1") not in rm["1"].unicast_routes
+        # direct neighbors: plain IP hop, no push
+        assert nh_set(rm["2"].unicast_routes[addr("1")]) == {("1", 10, None)}
+        assert nh_set(rm["3"].unicast_routes[addr("1")]) == {("1", 10, None)}
+        # remote node 4: push node-1's label over both ECMP paths
+        assert nh_set(rm["4"].unicast_routes[addr("1")]) == {
+            ("2", 20, push(1)),
+            ("3", 20, push(1)),
+        }
+
+    def test_min_nexthop_requirement(self, backend):
+        area_ls, ps = self._network({"min_nexthop": 2})
+        rm = route_maps(backend, area_ls, ps, "1234")
+        # 2 and 3 have a single feasible next-hop: route dropped
+        assert addr("1") not in rm["2"].unicast_routes
+        assert addr("1") not in rm["3"].unicast_routes
+        # 4 meets the requirement with its 2-way ECMP
+        assert nh_set(rm["4"].unicast_routes[addr("1")]) == {
+            ("2", 20, push(1)),
+            ("3", 20, push(1)),
+        }
+
+    def test_prepend_label(self, backend):
+        area_ls, ps = self._network(
+            {"min_nexthop": 2, "prepend_label": self.PREPEND}
+        )
+        rm = route_maps(backend, area_ls, ps, "4")
+        # prepend goes to the bottom of the pushed stack
+        assert nh_set(rm["4"].unicast_routes[addr("1")]) == {
+            ("2", 20, push(self.PREPEND, 1)),
+            ("3", 20, push(self.PREPEND, 1)),
+        }
+
+    def test_prepend_with_static_nexthops(self, backend):
+        # anycast origination: nodes 1 and 4 both advertise addr1 with a
+        # prepend label; static MPLS next-hops for that label surface in
+        # the advertiser's own route
+        area_ls, ps = self._network(
+            {"prepend_label": self.PREPEND}, node4_advertises=True
+        )
+        solver = SpfSolver("1", backend=backend)
+        from openr_tpu.types import BinaryAddress
+
+        nh_a = NextHop(
+            address=BinaryAddress(addr=b"\x01" * 16), metric=0
+        )
+        nh_b = NextHop(
+            address=BinaryAddress(addr=b"\x02" * 16), metric=0
+        )
+        solver.update_static_mpls_routes(
+            {self.PREPEND: [nh_a, nh_b]}, []
+        )
+        rdb = solver.build_route_db("1", area_ls, ps)
+        entry = rdb.unicast_routes[addr("1")]
+        addrs = {nh.address.addr for nh in entry.nexthops}
+        # both static next-hops present alongside the SPF paths toward 4
+        assert b"\x01" * 16 in addrs
+        assert b"\x02" * 16 in addrs
+        spf_hops = {
+            (nh.neighbor_node_name, nh.metric, nh.mpls_action)
+            for nh in entry.nexthops
+            if nh.neighbor_node_name is not None
+        }
+        assert spf_hops == {
+            ("2", 20, push(self.PREPEND, 4)),
+            ("3", 20, push(self.PREPEND, 4)),
+        }
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestBestRouteSelectionSolver:
+    """reference: DecisionTest.cpp:1070 Decision.BestRouteSelection —
+    2 <-> 1 <-> 3, nodes 2 and 3 advertise the same prefix."""
+
+    TARGET = IpPrefix.from_str("fd00:aa::/64")
+
+    def _network(self, m2, m3, type2_mpls=False):
+        adj_dbs = {
+            "1": db("1", [_adj("1", "2"), _adj("1", "3")], node_label=1),
+            "2": db("2", [_adj("2", "1")], node_label=2),
+            "3": db("3", [_adj("3", "1")], node_label=3),
+        }
+        e2 = PrefixEntry(
+            prefix=self.TARGET,
+            metrics=m2,
+            forwarding_type=(
+                PrefixForwardingType.SR_MPLS
+                if type2_mpls
+                else PrefixForwardingType.IP
+            ),
+        )
+        e3 = PrefixEntry(prefix=self.TARGET, metrics=m3)
+        return make_network(
+            adj_dbs, entries={"2": [e2], "3": [e3]}
+        )
+
+    def test_equal_metrics_ecmp(self, backend):
+        m = PrefixMetrics(path_preference=200)
+        area_ls, ps = self._network(m, m)
+        solver = SpfSolver("1", backend=backend,
+                           enable_best_route_selection=True)
+        rdb = solver.build_route_db("1", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.TARGET]) == {
+            ("2", 10, None),
+            ("3", 10, None),
+        }
+        best = solver.best_routes_cache[self.TARGET]
+        assert {na[0] for na in best.all_node_areas} == {"2", "3"}
+        assert best.best_node_area[0] == "2"  # smaller name tie-break
+
+    def test_preferred_advertiser_wins(self, backend):
+        area_ls, ps = self._network(
+            PrefixMetrics(path_preference=200, source_preference=100),
+            PrefixMetrics(path_preference=200),
+        )
+        solver = SpfSolver("1", backend=backend,
+                           enable_best_route_selection=True)
+        rdb = solver.build_route_db("1", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.TARGET]) == {("2", 10, None)}
+        best = solver.best_routes_cache[self.TARGET]
+        assert {na[0] for na in best.all_node_areas} == {"2"}
+
+    def test_forwarding_type_from_best_entry(self, backend):
+        # node 2 preferred + SR_MPLS, node 3 IP: route from node 3 uses
+        # the winner's forwarding type (push node-2's label)
+        area_ls, ps = self._network(
+            PrefixMetrics(path_preference=200, source_preference=100),
+            PrefixMetrics(path_preference=200),
+            type2_mpls=True,
+        )
+        solver = SpfSolver("3", backend=backend,
+                           enable_best_route_selection=True)
+        rdb = solver.build_route_db("3", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.TARGET]) == {
+            ("1", 20, push(2))
+        }
+
+    def test_mixed_type_lcd_is_ip(self, backend):
+        # equal metrics, node 2 SR_MPLS + node 3 IP: lowest common
+        # denominator forwarding across best advertisers is plain IP
+        m = PrefixMetrics(path_preference=200)
+        area_ls, ps = self._network(m, m, type2_mpls=True)
+        solver = SpfSolver("1", backend=backend,
+                           enable_best_route_selection=True)
+        rdb = solver.build_route_db("1", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.TARGET]) == {
+            ("2", 10, None),
+            ("3", 10, None),
+        }
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestParallelAdjRing:
+    """reference: DecisionTest.cpp:3252 ParallelAdjRingTopology — ring with
+    parallel adjacencies between each pair."""
+
+    def _adj_dbs(self):
+        # ring 1-2, 1-3, 2-4, 3-4; the 1-2 pair has two parallel links,
+        # equal metric; the 2-4 pair has unequal parallel links
+        def padj(a, b, tag, metric):
+            return adj(
+                b,
+                f"if{tag}_{a}{b}",
+                f"if{tag}_{b}{a}",
+                metric=metric,
+                adj_label=9000 + 100 * int(tag) + 10 * int(a) + int(b),
+            )
+
+        return {
+            "1": db(
+                "1",
+                [
+                    padj("1", "2", "1", 10),
+                    padj("1", "2", "2", 10),
+                    _adj("1", "3"),
+                ],
+                node_label=1,
+            ),
+            "2": db(
+                "2",
+                [
+                    padj("2", "1", "1", 10),
+                    padj("2", "1", "2", 10),
+                    padj("2", "4", "1", 10),
+                    padj("2", "4", "2", 15),
+                ],
+                node_label=2,
+            ),
+            "3": db("3", [_adj("3", "1"), _adj("3", "4")], node_label=3),
+            "4": db(
+                "4",
+                [
+                    padj("4", "2", "1", 10),
+                    padj("4", "2", "2", 15),
+                    _adj("4", "3"),
+                ],
+                node_label=4,
+            ),
+        }
+
+    def test_equal_parallel_links_ecmp(self, backend):
+        area_ls, ps = make_network(self._adj_dbs())
+        rm = route_maps(backend, area_ls, ps, "1")
+        entry = rm["1"].unicast_routes[addr("2")]
+        ifaces = {nh.address.if_name for nh in entry.nexthops}
+        assert ifaces == {"if1_12", "if2_12"}
+        assert all(nh.metric == 10 for nh in entry.nexthops)
+
+    def test_unequal_parallel_links_min_only(self, backend):
+        area_ls, ps = make_network(self._adj_dbs())
+        rm = route_maps(backend, area_ls, ps, "2")
+        entry = rm["2"].unicast_routes[addr("4")]
+        ifaces = {nh.address.if_name for nh in entry.nexthops}
+        assert ifaces == {"if1_24"}
+
+    def test_multipath_through_parallel_ring(self, backend):
+        # 1 -> 4: via 3 costs 20; via 2 costs 20 over each equal parallel
+        # link => 3 total first hops
+        area_ls, ps = make_network(self._adj_dbs())
+        rm = route_maps(backend, area_ls, ps, "1")
+        entry = rm["1"].unicast_routes[addr("4")]
+        ifaces = {nh.address.if_name for nh in entry.nexthops}
+        assert ifaces == {"if1_12", "if2_12", "if_13"}
+        assert all(nh.metric == 20 for nh in entry.nexthops)
+
+    def test_node_label_over_parallel_links(self, backend):
+        area_ls, ps = make_network(self._adj_dbs())
+        rm = route_maps(backend, area_ls, ps, "1")
+        entry = rm["1"].mpls_routes[2]
+        assert {
+            (nh.address.if_name, nh.mpls_action.action)
+            for nh in entry.nexthops
+        } == {
+            ("if1_12", MplsActionCode.PHP),
+            ("if2_12", MplsActionCode.PHP),
+        }
