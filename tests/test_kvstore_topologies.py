@@ -1,0 +1,372 @@
+"""Multi-store KvStore topology tests over the real TCP peer transport:
+star, ring and full-mesh store networks, version-conflict convergence,
+partition/heal reconciliation and 10k-key TTL churn.
+
+reference: openr/kvstore/tests/KvStoreTest.cpp (StoreNetwork fixtures —
+BasicSync / PeerSyncApi star, RingFlooding, FullMesh, TtlVerification /
+TtlExpiry at 10k-key scale).
+"""
+
+import time
+
+import pytest
+
+from openr_tpu.kvstore.store import KeySetParams
+from openr_tpu.kvstore.transport import KvStorePeerServer, TcpPeerTransport
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.types import TTL_INFINITY, KvStorePeerState, Value
+
+AREA = "0"
+
+
+def wait_until(pred, timeout=12.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TcpStoreNet:
+    """N KvStores, each listening on a real TCP peer server; links are
+    installed per topology. The analogue of the reference KvStoreTestFixture
+    store network."""
+
+    def __init__(self, names):
+        self.names = list(names)
+        self.stores = {}
+        self.servers = {}
+        for n in self.names:
+            w = KvStoreWrapper(n)
+            w.start()
+            server = KvStorePeerServer(w.store, host="127.0.0.1")
+            server.start()
+            self.stores[n] = w
+            self.servers[n] = server
+
+    def connect(self, a, b):
+        self.stores[a].store.add_peer(
+            AREA, b, TcpPeerTransport("127.0.0.1", self.servers[b].port)
+        )
+        self.stores[b].store.add_peer(
+            AREA, a, TcpPeerTransport("127.0.0.1", self.servers[a].port)
+        )
+
+    def disconnect(self, a, b):
+        self.stores[a].store.del_peer(AREA, b)
+        self.stores[b].store.del_peer(AREA, a)
+
+    def stop(self):
+        for server in self.servers.values():
+            server.stop()
+        for w in self.stores.values():
+            w.stop()
+
+    # -- assertions -------------------------------------------------------
+
+    def converged_on(self, key, value=None):
+        def check():
+            for w in self.stores.values():
+                v = w.get_key(key)
+                if v is None:
+                    return False
+                if value is not None and v.value != value:
+                    return False
+            return True
+
+        return wait_until(check)
+
+    def all_peers_initialized(self):
+        def check():
+            for w in self.stores.values():
+                states = w.peer_states()
+                if not states:
+                    return False
+                if any(
+                    s != KvStorePeerState.INITIALIZED
+                    for s in states.values()
+                ):
+                    return False
+            return True
+
+        return wait_until(check)
+
+    def counters(self, name):
+        return self.stores[name].store._db(AREA).counters
+
+
+@pytest.fixture
+def star():
+    net = TcpStoreNet(["hub", "leaf-0", "leaf-1", "leaf-2", "leaf-3"])
+    for i in range(4):
+        net.connect("hub", f"leaf-{i}")
+    yield net
+    net.stop()
+
+
+@pytest.fixture
+def ring():
+    names = [f"r{i}" for i in range(6)]
+    net = TcpStoreNet(names)
+    for i in range(6):
+        net.connect(names[i], names[(i + 1) % 6])
+    yield net
+    net.stop()
+
+
+@pytest.fixture
+def mesh():
+    names = [f"m{i}" for i in range(4)]
+    net = TcpStoreNet(names)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            net.connect(names[i], names[j])
+    yield net
+    net.stop()
+
+
+class TestStarTopology:
+    def test_leaf_write_floods_everywhere(self, star):
+        assert star.all_peers_initialized()
+        star.stores["leaf-2"].set_key("k:leaf2", b"v2", originator="leaf-2")
+        assert star.converged_on("k:leaf2", b"v2")
+        # the hub relayed by flooding, not by another full sync: each
+        # leaf's copy arrived as a flood publication
+        assert star.counters("hub")["kvstore.flood_count"] >= 1
+
+    def test_pre_peering_keys_arrive_via_full_sync(self):
+        net = TcpStoreNet(["hub", "leaf-0"])
+        try:
+            # key exists BEFORE peering: only 3-way full sync can carry it
+            net.stores["hub"].set_key("old:k", b"old", originator="hub")
+            net.connect("hub", "leaf-0")
+            assert net.converged_on("old:k", b"old")
+            assert (
+                net.counters("leaf-0")["kvstore.full_sync_count"] >= 1
+            )
+        finally:
+            net.stop()
+
+    def test_concurrent_leaf_writes_all_converge(self, star):
+        assert star.all_peers_initialized()
+        for i in range(4):
+            star.stores[f"leaf-{i}"].set_key(
+                f"k:{i}", f"v{i}".encode(), originator=f"leaf-{i}"
+            )
+        for i in range(4):
+            assert star.converged_on(f"k:{i}", f"v{i}".encode())
+
+
+class TestRingTopology:
+    def test_flood_travels_around_ring(self, ring):
+        assert ring.all_peers_initialized()
+        ring.stores["r0"].set_key("ring:k", b"v", originator="r0")
+        assert ring.converged_on("ring:k", b"v")
+        # the farthest node (r3) saw it via transit floods
+        assert ring.counters("r3")["kvstore.updated_key_vals"] >= 1
+
+    def test_version_conflict_highest_wins(self, ring):
+        assert ring.all_peers_initialized()
+        # same key injected at opposite sides with different versions
+        ring.stores["r0"].set_key(
+            "dup:k", b"low", version=1, originator="r0"
+        )
+        ring.stores["r3"].set_key(
+            "dup:k", b"high", version=5, originator="r3"
+        )
+        assert ring.converged_on("dup:k", b"high")
+        for n in ring.names:
+            assert ring.stores[n].get_key("dup:k").version == 5
+
+    def test_same_version_originator_tiebreak(self, ring):
+        assert ring.all_peers_initialized()
+        # same version, different originators: larger originator id wins
+        # (reference: KvStore.cpp compareValues originatorId tie-break)
+        ring.stores["r1"].set_key(
+            "tie:k", b"from-r1", version=3, originator="r1"
+        )
+        ring.stores["r4"].set_key(
+            "tie:k", b"from-r4", version=3, originator="r4"
+        )
+        assert ring.converged_on("tie:k", b"from-r4")
+
+
+class TestFullMeshTopology:
+    def test_all_writers_converge(self, mesh):
+        assert mesh.all_peers_initialized()
+        for i, n in enumerate(mesh.names):
+            mesh.stores[n].set_key(
+                f"mesh:{n}", str(i).encode(), originator=n
+            )
+        for i, n in enumerate(mesh.names):
+            assert mesh.converged_on(f"mesh:{n}", str(i).encode())
+        # every store holds the identical key set
+        dumps = [
+            set(mesh.stores[n].dump().keys()) for n in mesh.names
+        ]
+        assert all(d == dumps[0] for d in dumps)
+
+    def test_redundant_floods_are_absorbed(self, mesh):
+        assert mesh.all_peers_initialized()
+        mesh.stores["m0"].set_key("mesh:dup", b"x", originator="m0")
+        assert mesh.converged_on("mesh:dup", b"x")
+        # in a full mesh each node hears the same update from multiple
+        # peers; the merge dedups — received >= updated
+        time.sleep(0.3)
+        c = mesh.counters("m2")
+        assert (
+            c["kvstore.received_key_vals"]
+            >= c["kvstore.updated_key_vals"]
+        )
+
+
+class TestPartitionHeal:
+    def test_ring_partition_diverges_then_heals(self, ring):
+        assert ring.all_peers_initialized()
+        ring.stores["r0"].set_key("pre", b"shared", originator="r0")
+        assert ring.converged_on("pre", b"shared")
+
+        # cut the ring into {r0,r1,r2} and {r3,r4,r5}
+        ring.disconnect("r2", "r3")
+        ring.disconnect("r5", "r0")
+        ring.stores["r0"].set_key("side:a", b"a", originator="r0")
+        ring.stores["r3"].set_key("side:b", b"b", originator="r3")
+
+        # each side only sees its own write
+        assert wait_until(
+            lambda: ring.stores["r2"].get_key("side:a") is not None
+        )
+        assert wait_until(
+            lambda: ring.stores["r5"].get_key("side:b") is not None
+        )
+        time.sleep(0.3)
+        assert ring.stores["r4"].get_key("side:a") is None
+        assert ring.stores["r1"].get_key("side:b") is None
+
+        # heal: reconnecting triggers full sync; both sides reconcile
+        ring.connect("r2", "r3")
+        ring.connect("r5", "r0")
+        assert ring.converged_on("side:a", b"a")
+        assert ring.converged_on("side:b", b"b")
+
+
+class TestTtlChurn:
+    """reference: KvStoreTest.cpp TtlVerification / large-scale churn."""
+
+    N_KEYS = 10_000
+
+    def _batch_set(self, wrapper, items, ttl=TTL_INFINITY):
+        # batched writes through the public thread-safe API, 1k per call
+        chunk = {}
+        for key, (val, version) in items.items():
+            chunk[key] = Value(
+                version=version,
+                originator_id=wrapper.node_id,
+                value=val,
+                ttl=ttl,
+                ttl_version=0,
+            )
+            if len(chunk) == 1000:
+                wrapper.store.set_key_vals(
+                    AREA,
+                    KeySetParams(
+                        key_vals=chunk, originator_id=wrapper.node_id
+                    ),
+                )
+                chunk = {}
+        if chunk:
+            wrapper.store.set_key_vals(
+                AREA,
+                KeySetParams(key_vals=chunk, originator_id=wrapper.node_id),
+            )
+
+    def test_10k_keys_flood_and_ttl_expiry(self):
+        net = TcpStoreNet(["big-a", "big-b"])
+        try:
+            net.connect("big-a", "big-b")
+            assert net.all_peers_initialized()
+            a = net.stores["big-a"]
+            # half the keys immortal, half on a short fuse
+            immortal = {
+                f"keep:{i:05d}": (b"v", 1)
+                for i in range(self.N_KEYS // 2)
+            }
+            doomed = {
+                f"drop:{i:05d}": (b"v", 1)
+                for i in range(self.N_KEYS // 2)
+            }
+            self._batch_set(a, immortal)
+            self._batch_set(a, doomed, ttl=1500)
+
+            b = net.stores["big-b"]
+            assert wait_until(
+                lambda: len(b.dump()) >= self.N_KEYS, timeout=30.0
+            )
+
+            # expiry: the doomed half disappears on BOTH stores
+            def doomed_gone():
+                da = sum(
+                    1 for k in a.dump() if k.startswith("drop:")
+                )
+                db_ = sum(
+                    1 for k in b.dump() if k.startswith("drop:")
+                )
+                return da == 0 and db_ == 0
+
+            assert wait_until(doomed_gone, timeout=30.0)
+            # the immortal half survives intact
+            assert (
+                sum(1 for k in a.dump() if k.startswith("keep:"))
+                == self.N_KEYS // 2
+            )
+            assert (
+                sum(1 for k in b.dump() if k.startswith("keep:"))
+                == self.N_KEYS // 2
+            )
+            assert (
+                net.counters("big-a")["kvstore.expired_keys"]
+                + net.counters("big-b")["kvstore.expired_keys"]
+                > 0
+            )
+        finally:
+            net.stop()
+
+    def test_ttl_refresh_keeps_key_alive(self):
+        net = TcpStoreNet(["ttl-a", "ttl-b"])
+        try:
+            net.connect("ttl-a", "ttl-b")
+            assert net.all_peers_initialized()
+            a, b = net.stores["ttl-a"], net.stores["ttl-b"]
+            a.set_key("hb", b"alive", version=1, originator="ttl-a",
+                      ttl=800)
+            assert wait_until(lambda: b.get_key("hb") is not None)
+            # refresh the TTL twice at ~half-life (bumped ttl_version)
+            for ttl_version in (1, 2):
+                time.sleep(0.4)
+                a.store.set_key_vals(
+                    AREA,
+                    KeySetParams(
+                        key_vals={
+                            "hb": Value(
+                                version=1,
+                                originator_id="ttl-a",
+                                value=b"alive",
+                                ttl=800,
+                                ttl_version=ttl_version,
+                            )
+                        },
+                        originator_id="ttl-a",
+                    ),
+                )
+            # well past the original fuse, still alive everywhere
+            assert a.get_key("hb") is not None
+            assert b.get_key("hb") is not None
+            # stop refreshing: it dies
+            assert wait_until(
+                lambda: a.get_key("hb") is None
+                and b.get_key("hb") is None,
+                timeout=5.0,
+            )
+        finally:
+            net.stop()
