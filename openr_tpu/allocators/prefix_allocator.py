@@ -1,23 +1,44 @@
 """PrefixAllocator: plug-and-play per-node prefix assignment.
 
 Behavioral parity with the reference ``openr/allocators/PrefixAllocator``
-(PrefixAllocator.h:35): elects a unique sub-prefix index out of a seed
-prefix via RangeAllocator consensus over the KvStore, advertises the
-elected prefix through the PrefixManager, programs the address on the
-loopback via netlink, and persists the allocation so restarts re-claim
-the same index. Static mode assigns from a configured node->prefix map.
+(PrefixAllocator.h:35, PrefixAllocator.cpp:90-260): three allocation
+modes —
+
+* **static** (``staticAllocation``): the node->prefix map comes from
+  config and/or the ``e2e-network-allocations`` KvStore key, updated
+  live;
+* **dynamic root** (``dynamicAllocationRootNode``): seed prefix + alloc
+  length come from config, a unique sub-prefix index is elected via
+  RangeAllocator consensus over the KvStore;
+* **dynamic leaf** (``dynamicAllocationLeafNode``): allocation params
+  are learned from the ``e2e-network-prefix`` KvStore key (value
+  ``"<seed-prefix>,<alloc-len>"``) and re-elections follow param
+  changes.
+
+The elected prefix is advertised through the PrefixManager, programmed
+on the loopback via netlink (old addresses are removed on change —
+reference applyMyPrefix/withdrawMyPrefix), and the elected index is
+persisted so restarts re-claim the same sub-prefix
+(reference loadPrefixIndexFromDisk/savePrefixIndexToDisk).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+import json
+from typing import Callable, Dict, Optional, Tuple
 
 from openr_tpu.allocators.range_allocator import RangeAllocator
 from openr_tpu.types import BinaryAddress, IpPrefix, PrefixEntry, PrefixType
 from openr_tpu.utils.eventbase import OpenrEventBase
 
 ALLOC_PREFIX_MARKER = "allocprefix:"  # reference: Constants kPrefixAllocMarker
+# reference: Constants.h:112 kSeedPrefixAllocParamKey
+SEED_ALLOC_PARAM_KEY = "e2e-network-prefix"
+# reference: Constants.h:117 kStaticPrefixAllocParamKey
+STATIC_ALLOC_KEY = "e2e-network-allocations"
 PERSIST_KEY = "prefix-allocator-index"
+
+AllocParams = Tuple[IpPrefix, int]  # (seed prefix, alloc prefix length)
 
 
 def sub_prefix(seed: IpPrefix, alloc_len: int, index: int) -> IpPrefix:
@@ -32,6 +53,20 @@ def sub_prefix(seed: IpPrefix, alloc_len: int, index: int) -> IpPrefix:
         ),
         prefix_length=alloc_len,
     )
+
+
+def parse_alloc_params(text: str) -> AllocParams:
+    """Parse ``"fc00:cafe::/56,64"`` (reference: PrefixAllocator.cpp
+    parseParamsStr)."""
+    seed_str, _, len_str = text.partition(",")
+    seed = IpPrefix.from_str(seed_str.strip())
+    alloc_len = int(len_str.strip())
+    if alloc_len < seed.prefix_length:
+        raise ValueError(
+            f"alloc length /{alloc_len} shorter than seed "
+            f"/{seed.prefix_length}"
+        )
+    return seed, alloc_len
 
 
 class PrefixAllocator:
@@ -52,44 +87,143 @@ class PrefixAllocator:
     ):
         self._node = my_node_name
         self._evb = evb
+        self._client = kvstore_client
         self._prefix_manager = prefix_manager
         self._netlink = netlink
         self._loopback_if = loopback_if
         self._config_store = config_store
+        self._area = area
         self._on_allocated = on_allocated
         self.allocated_prefix: Optional[IpPrefix] = None
+        self._programmed_prefix: Optional[IpPrefix] = None
+        self._alloc_params: Optional[AllocParams] = None
         self._range_allocator: Optional[RangeAllocator] = None
+        self._static_mode = static_prefixes is not None
+        self._stopped = False
 
-        if static_prefixes is not None:
-            # static mode: allocation comes straight from config
+        if self._static_mode:
+            # static mode: allocation from config, live-updatable via the
+            # e2e-network-allocations key (reference: staticAllocation)
             prefix = static_prefixes.get(my_node_name)
             if prefix is not None:
                 self._evb.run_in_event_base(lambda: self._apply(prefix))
+            if self._client is not None:
+                self._client.subscribe_key(
+                    area, STATIC_ALLOC_KEY, self._on_static_alloc_update
+                )
             return
 
-        assert seed_prefix is not None
-        self._seed = seed_prefix
-        self._alloc_len = alloc_prefix_len
-        count = 1 << (alloc_prefix_len - seed_prefix.prefix_length)
+        if seed_prefix is not None:
+            # dynamic root: params from config
+            self.update_alloc_params(seed_prefix, alloc_prefix_len)
+            return
+
+        # dynamic leaf: params learned from the KvStore
+        # (reference: dynamicAllocationLeafNode)
+        assert self._client is not None, "leaf mode needs a KvStore client"
+        self._client.subscribe_key(
+            area, SEED_ALLOC_PARAM_KEY, self._on_alloc_param_update
+        )
+        existing = self._client.get_key(area, SEED_ALLOC_PARAM_KEY)
+        if existing is not None and existing.value is not None:
+            self._on_alloc_param_update(SEED_ALLOC_PARAM_KEY, existing)
+
+    def stop(self) -> None:
+        self._stopped = True
+        if self._range_allocator is not None:
+            self._range_allocator.stop()
+
+    # -- public -----------------------------------------------------------
+
+    def get_alloc_params(self) -> Optional[AllocParams]:
+        return self._alloc_params
+
+    def update_alloc_params(
+        self,
+        seed_prefix: Optional[IpPrefix],
+        alloc_prefix_len: int = 64,
+    ) -> None:
+        """(Re)start allocation from new params; ``None`` seed withdraws
+        the current allocation. reference: PrefixAllocator.cpp
+        startAllocation — 'can be called again with new prefix or
+        std::nullopt'."""
+        new_params = (
+            None
+            if seed_prefix is None
+            else (seed_prefix, alloc_prefix_len)
+        )
+        if new_params == self._alloc_params and new_params is not None:
+            return
+        if self._range_allocator is not None:
+            self._range_allocator.stop()
+            self._range_allocator = None
+        self._evb.run_immediately_or_in_event_base(self._withdraw)
+        self._alloc_params = new_params
+        if new_params is None:
+            return
+
+        seed, alloc_len = new_params
+        count = 1 << (alloc_len - seed.prefix_length)
         init_index = None
-        if config_store is not None:
-            init_index = config_store.load(PERSIST_KEY)
-            if init_index is not None and not (0 <= init_index < count):
-                init_index = None
+        if self._config_store is not None:
+            persisted = self._config_store.load(PERSIST_KEY)
+            # resume only if the persisted index was elected under the
+            # SAME params (reference: loadPrefixIndexFromDisk)
+            if (
+                isinstance(persisted, (list, tuple))
+                and len(persisted) == 3
+                and persisted[0] == seed.to_str()
+                and persisted[1] == alloc_len
+                and 0 <= persisted[2] < count
+            ):
+                init_index = persisted[2]
         self._range_allocator = RangeAllocator(
-            evb,
-            kvstore_client,
-            my_node_name,
-            ALLOC_PREFIX_MARKER,
+            self._evb,
+            self._client,
+            self._node,
+            f"{ALLOC_PREFIX_MARKER}{seed.to_str()}/{alloc_len}:",
             (0, count - 1),
             self._on_index,
-            area=area,
+            area=self._area,
         )
         self._range_allocator.start_allocator(init_value=init_index)
 
-    def stop(self) -> None:
-        if self._range_allocator is not None:
-            self._range_allocator.stop()
+    # -- KvStore-driven updates ------------------------------------------
+
+    def _on_alloc_param_update(self, key, value) -> None:
+        """reference: PrefixAllocator.cpp processAllocParamUpdate."""
+        del key
+        if self._stopped or value is None or value.value is None:
+            return
+        try:
+            seed, alloc_len = parse_alloc_params(
+                value.value.decode("utf-8")
+            )
+        except (ValueError, UnicodeDecodeError):
+            return  # malformed params: keep the current allocation
+        self.update_alloc_params(seed, alloc_len)
+
+    def _on_static_alloc_update(self, key, value) -> None:
+        """reference: PrefixAllocator.cpp processStaticPrefixAllocUpdate.
+        Value: JSON ``{node_name: "prefix/len", ...}``."""
+        del key
+        if self._stopped or value is None or value.value is None:
+            return
+        try:
+            allocations = json.loads(value.value.decode("utf-8"))
+            mine = allocations.get(self._node)
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return
+        if mine is None:
+            self._evb.run_immediately_or_in_event_base(self._withdraw)
+            return
+        try:
+            prefix = IpPrefix.from_str(mine)
+        except ValueError:
+            return
+        self._evb.run_immediately_or_in_event_base(
+            lambda: self._apply(prefix)
+        )
 
     # -- internals --------------------------------------------------------
 
@@ -97,11 +231,18 @@ class PrefixAllocator:
         if index is None:
             self._withdraw()
             return
+        assert self._alloc_params is not None
+        seed, alloc_len = self._alloc_params
         if self._config_store is not None:
-            self._config_store.store(PERSIST_KEY, index)
-        self._apply(sub_prefix(self._seed, self._alloc_len, index))
+            self._config_store.store(
+                PERSIST_KEY, [seed.to_str(), alloc_len, index]
+            )
+        self._apply(sub_prefix(seed, alloc_len, index))
 
     def _apply(self, prefix: IpPrefix) -> None:
+        if prefix == self.allocated_prefix:
+            return
+        self._withdraw()
         self.allocated_prefix = prefix
         self._prefix_manager.advertise_prefixes(
             [
@@ -110,17 +251,37 @@ class PrefixAllocator:
                 )
             ]
         )
-        if self._netlink is not None:
-            try:
-                self._netlink.add_ifaddress(self._loopback_if, prefix)
-            except Exception:
-                pass
+        self._sync_loopback_address(prefix)
         if self._on_allocated is not None:
             self._on_allocated(prefix)
 
     def _withdraw(self) -> None:
-        if self.allocated_prefix is not None:
+        had = self.allocated_prefix is not None
+        if had:
             self._prefix_manager.withdraw_prefixes([self.allocated_prefix])
             self.allocated_prefix = None
-        if self._on_allocated is not None:
+        self._sync_loopback_address(None)
+        if had and self._on_allocated is not None:
             self._on_allocated(None)
+
+    def _sync_loopback_address(
+        self, prefix: Optional[IpPrefix]
+    ) -> None:
+        """Program the new prefix on the loopback and remove the stale
+        one (reference: applyMyPrefix/withdrawMyPrefix address sync)."""
+        if self._netlink is None or prefix == self._programmed_prefix:
+            return
+        if self._programmed_prefix is not None:
+            try:
+                self._netlink.del_ifaddress(
+                    self._loopback_if, self._programmed_prefix
+                )
+            except Exception:
+                pass
+        self._programmed_prefix = None
+        if prefix is not None:
+            try:
+                self._netlink.add_ifaddress(self._loopback_if, prefix)
+                self._programmed_prefix = prefix
+            except Exception:
+                pass

@@ -65,6 +65,9 @@ class NetlinkProtocolSocket:
     def add_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
         raise NotImplementedError
 
+    def del_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        raise NotImplementedError
+
 
 class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
     """In-memory kernel with event injection
@@ -127,6 +130,16 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
         with self._lock:
             link = self._links[if_name]
             link.addresses = tuple(link.addresses) + (prefix,)
+        self.events_queue.push(
+            NetlinkEvent(event_type=NetlinkEventType.ADDRESS, link=link)
+        )
+
+    def del_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        with self._lock:
+            link = self._links[if_name]
+            link.addresses = tuple(
+                a for a in link.addresses if a != prefix
+            )
         self.events_queue.push(
             NetlinkEvent(event_type=NetlinkEventType.ADDRESS, link=link)
         )

@@ -40,6 +40,7 @@ RTM_NEWLINK = 16
 RTM_DELLINK = 17
 RTM_GETLINK = 18
 RTM_NEWADDR = 20
+RTM_DELADDR = 21
 RTM_GETADDR = 22
 RTM_NEWROUTE = 24
 RTM_DELROUTE = 25
@@ -408,6 +409,18 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_EXCL,
             body,
         )
+
+    def del_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        index = self.link_index(if_name)
+        if index is None:
+            raise NetlinkError(19, f"no such link {if_name}")
+        family = socket.AF_INET if prefix.is_v4 else socket.AF_INET6
+        body = struct.pack(
+            "=BBBBi", family, prefix.prefix_length, 0, 0, index
+        )
+        IFA_LOCAL = 2
+        body += _attr(IFA_LOCAL, prefix.prefix_address.addr)
+        self._request(RTM_DELADDR, NLM_F_REQUEST | NLM_F_ACK, body)
 
     # -- link event subscription -----------------------------------------
 

@@ -196,6 +196,295 @@ class TestPrefixAllocator:
             evb.join()
 
 
+class RecordingPrefixManager:
+    """Advertise/withdraw recorder for allocator tests."""
+
+    def __init__(self):
+        self.advertised = []
+
+    def advertise_prefixes(self, entries):
+        self.advertised.extend(e.prefix for e in entries)
+
+    def withdraw_prefixes(self, prefixes):
+        for p in prefixes:
+            if p in self.advertised:
+                self.advertised.remove(p)
+
+
+class DictConfigStore:
+    def __init__(self):
+        self.data = {}
+
+    def store(self, key, obj):
+        self.data[key] = obj
+
+    def load(self, key, cls=None):
+        return self.data.get(key)
+
+
+class TestPrefixAllocatorDeep:
+    """reference: openr/allocators/tests/PrefixAllocatorTest.cpp —
+    contention storms, param updates, loopback address sync, persistence."""
+
+    def _spawn(self, net, name, **kw):
+        mgr = RecordingPrefixManager()
+        alloc = PrefixAllocator(
+            name,
+            net.evbs[name],
+            net.clients[name],
+            mgr,
+            **kw,
+        )
+        return alloc, mgr
+
+    def test_collision_storm_converges_unique(self):
+        # 8 nodes contending for exactly 8 slots: every claim collision
+        # must resolve and everyone ends up with a distinct sub-prefix
+        # (reference: PrefixAllocatorTest UniquePrefixes with
+        # numNodes == numPrefixes)
+        names = [f"storm-{i}" for i in range(8)]
+        net = AllocatorNet(names)
+        allocs = []
+        try:
+            seed = IpPrefix.from_str("fd00:5707::/61")  # 8 x /64 slots
+            for name in names:
+                a, _ = self._spawn(
+                    net, name, seed_prefix=seed, alloc_prefix_len=64
+                )
+                allocs.append(a)
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None for a in allocs
+                ),
+                timeout=20.0,
+            ), [a.allocated_prefix for a in allocs]
+            prefixes = {a.allocated_prefix for a in allocs}
+            assert len(prefixes) == 8  # fully consumed, all unique
+        finally:
+            for a in allocs:
+                a.stop()
+            net.stop()
+
+    def test_seed_change_reelects(self):
+        names = ["re-a", "re-b"]
+        net = AllocatorNet(names)
+        allocs, mgrs = [], []
+        try:
+            seed1 = IpPrefix.from_str("fd00:aaaa::/60")
+            for name in names:
+                a, m = self._spawn(
+                    net, name, seed_prefix=seed1, alloc_prefix_len=64
+                )
+                allocs.append(a)
+                mgrs.append(m)
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None for a in allocs
+                )
+            )
+            old = [a.allocated_prefix for a in allocs]
+            assert all(p.to_str().startswith("fd00:aaaa") for p in old)
+
+            # the seed prefix changes: everyone withdraws and re-elects
+            # under the new space (reference: startAllocation re-entry)
+            seed2 = IpPrefix.from_str("fd00:bbbb::/60")
+            for a in allocs:
+                a.update_alloc_params(seed2, 64)
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None
+                    and a.allocated_prefix.to_str().startswith("fd00:bbbb")
+                    for a in allocs
+                )
+            ), [a.allocated_prefix for a in allocs]
+            assert allocs[0].allocated_prefix != allocs[1].allocated_prefix
+            # managers carry exactly the new prefix, old ones withdrawn
+            for m, a in zip(mgrs, allocs):
+                assert m.advertised == [a.allocated_prefix]
+
+            # None seed: withdraw everything
+            allocs[0].update_alloc_params(None)
+            assert wait_until(
+                lambda: allocs[0].allocated_prefix is None
+            )
+            assert mgrs[0].advertised == []
+        finally:
+            for a in allocs:
+                a.stop()
+            net.stop()
+
+    def test_leaf_mode_learns_params_from_kvstore(self):
+        from openr_tpu.allocators.prefix_allocator import (
+            SEED_ALLOC_PARAM_KEY,
+        )
+
+        names = ["leaf-a", "leaf-b"]
+        net = AllocatorNet(names)
+        allocs = []
+        try:
+            # no seed configured: allocators idle until the param key
+            # appears (reference: dynamicAllocationLeafNode)
+            for name in names:
+                a, _ = self._spawn(net, name)
+                allocs.append(a)
+            time.sleep(0.3)
+            assert all(a.allocated_prefix is None for a in allocs)
+
+            net.stores["leaf-a"].set_key(
+                SEED_ALLOC_PARAM_KEY,
+                b"fd00:cafe::/56,64",
+                originator="ctrl",
+            )
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None
+                    and a.allocated_prefix.to_str().startswith("fd00:cafe")
+                    for a in allocs
+                )
+            ), [a.allocated_prefix for a in allocs]
+            assert all(
+                a.get_alloc_params()[1] == 64 for a in allocs
+            )
+
+            # param update: re-election follows the new seed
+            net.stores["leaf-b"].set_key(
+                SEED_ALLOC_PARAM_KEY,
+                b"fd00:beef::/56,64",
+                version=2,
+                originator="ctrl",
+            )
+            assert wait_until(
+                lambda: all(
+                    a.allocated_prefix is not None
+                    and a.allocated_prefix.to_str().startswith("fd00:beef")
+                    for a in allocs
+                )
+            ), [a.allocated_prefix for a in allocs]
+        finally:
+            for a in allocs:
+                a.stop()
+            net.stop()
+
+    def test_loopback_address_sync(self):
+        from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+
+        net = AllocatorNet(["lo-a"])
+        try:
+            nl = MockNetlinkProtocolSocket()
+            nl.add_link("lo", is_up=True)
+            seed1 = IpPrefix.from_str("fd00:1111::/60")
+            alloc, _ = self._spawn(
+                net,
+                "lo-a",
+                seed_prefix=seed1,
+                alloc_prefix_len=64,
+                netlink=nl,
+                loopback_if="lo",
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is not None)
+            first = alloc.allocated_prefix
+
+            def lo_addrs():
+                (link,) = nl.get_all_links()
+                return set(link.addresses)
+
+            assert wait_until(lambda: lo_addrs() == {first})
+
+            # re-election under a new seed replaces the address
+            alloc.update_alloc_params(
+                IpPrefix.from_str("fd00:2222::/60"), 64
+            )
+            assert wait_until(
+                lambda: alloc.allocated_prefix is not None
+                and alloc.allocated_prefix != first
+            )
+            second = alloc.allocated_prefix
+            assert wait_until(lambda: lo_addrs() == {second})
+
+            # withdraw removes the programmed address
+            alloc.update_alloc_params(None)
+            assert wait_until(lambda: lo_addrs() == set())
+            alloc.stop()
+        finally:
+            net.stop()
+
+    def test_static_allocations_from_kvstore(self):
+        from openr_tpu.allocators.prefix_allocator import STATIC_ALLOC_KEY
+
+        net = AllocatorNet(["st-a"])
+        try:
+            alloc, mgr = self._spawn(net, "st-a", static_prefixes={})
+            time.sleep(0.2)
+            assert alloc.allocated_prefix is None
+
+            # central allocation map appears in the KvStore
+            net.stores["st-a"].set_key(
+                STATIC_ALLOC_KEY,
+                b'{"st-a": "fd00:77::/64", "other": "fd00:78::/64"}',
+                originator="ctrl",
+            )
+            target = IpPrefix.from_str("fd00:77::/64")
+            assert wait_until(lambda: alloc.allocated_prefix == target)
+            assert mgr.advertised == [target]
+
+            # our entry disappears from the map: withdraw
+            net.stores["st-a"].set_key(
+                STATIC_ALLOC_KEY,
+                b'{"other": "fd00:78::/64"}',
+                version=2,
+                originator="ctrl",
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is None)
+            assert mgr.advertised == []
+            alloc.stop()
+        finally:
+            net.stop()
+
+    def test_persisted_index_reclaimed_across_restart(self):
+        net = AllocatorNet(["per-a"])
+        try:
+            store = DictConfigStore()
+            seed = IpPrefix.from_str("fd00:9999::/60")
+            alloc, _ = self._spawn(
+                net,
+                "per-a",
+                seed_prefix=seed,
+                alloc_prefix_len=64,
+                config_store=store,
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is not None)
+            first = alloc.allocated_prefix
+            alloc.stop()
+
+            # restart with the same config store: same prefix re-claimed
+            alloc2, _ = self._spawn(
+                net,
+                "per-a",
+                seed_prefix=seed,
+                alloc_prefix_len=64,
+                config_store=store,
+            )
+            assert wait_until(lambda: alloc2.allocated_prefix == first)
+
+            # a persisted index under DIFFERENT params is ignored
+            alloc2.stop()
+            seed2 = IpPrefix.from_str("fd00:8888::/62")
+            alloc3, _ = self._spawn(
+                net,
+                "per-a",
+                seed_prefix=seed2,
+                alloc_prefix_len=64,
+                config_store=store,
+            )
+            assert wait_until(
+                lambda: alloc3.allocated_prefix is not None
+                and alloc3.allocated_prefix.to_str().startswith("fd00:8888")
+            )
+            alloc3.stop()
+        finally:
+            net.stop()
+
+
 def _route(prefix_str, *nhs):
     return RibUnicastEntry(
         prefix=IpPrefix.from_str(prefix_str), nexthops=set(nhs)
