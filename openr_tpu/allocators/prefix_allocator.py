@@ -98,6 +98,7 @@ class PrefixAllocator:
         self._programmed_prefix: Optional[IpPrefix] = None
         self._alloc_params: Optional[AllocParams] = None
         self._range_allocator: Optional[RangeAllocator] = None
+        self._alloc_token: Optional[object] = None
         self._static_mode = static_prefixes is not None
         self._stopped = False
 
@@ -130,6 +131,7 @@ class PrefixAllocator:
 
     def stop(self) -> None:
         self._stopped = True
+        self._alloc_token = None
         if self._range_allocator is not None:
             self._range_allocator.stop()
 
@@ -157,6 +159,7 @@ class PrefixAllocator:
         if self._range_allocator is not None:
             self._range_allocator.stop()
             self._range_allocator = None
+        self._alloc_token = None
         self._evb.run_immediately_or_in_event_base(self._withdraw)
         self._alloc_params = new_params
         if new_params is None:
@@ -177,13 +180,18 @@ class PrefixAllocator:
                 and 0 <= persisted[2] < count
             ):
                 init_index = persisted[2]
+        # bind the params generation into the callback: a claim that
+        # resolves after the next update_alloc_params/stop must not
+        # apply a stale index against the new seed space
+        token = object()
+        self._alloc_token = token
         self._range_allocator = RangeAllocator(
             self._evb,
             self._client,
             self._node,
             f"{ALLOC_PREFIX_MARKER}{seed.to_str()}/{alloc_len}:",
             (0, count - 1),
-            self._on_index,
+            lambda index: self._on_index(index, token, new_params),
             area=self._area,
         )
         self._range_allocator.start_allocator(init_value=init_index)
@@ -227,12 +235,18 @@ class PrefixAllocator:
 
     # -- internals --------------------------------------------------------
 
-    def _on_index(self, index: Optional[int]) -> None:
+    def _on_index(
+        self,
+        index: Optional[int],
+        token: object,
+        params: AllocParams,
+    ) -> None:
+        if token is not self._alloc_token:
+            return  # stale allocator generation
         if index is None:
             self._withdraw()
             return
-        assert self._alloc_params is not None
-        seed, alloc_len = self._alloc_params
+        seed, alloc_len = params
         if self._config_store is not None:
             self._config_store.store(
                 PERSIST_KEY, [seed.to_str(), alloc_len, index]

@@ -19,6 +19,11 @@ from typing import Callable, Optional, Tuple
 from openr_tpu.types import Value
 from openr_tpu.utils.eventbase import OpenrEventBase
 
+# Claims are TTL'd so an abandoned allocator's key ages out of the
+# flooded store instead of living forever
+# (reference: Constants.h:195 kRangeAllocTtl = 5min).
+RANGE_ALLOC_TTL_MS = 300_000
+
 
 class RangeAllocator:
     def __init__(
@@ -50,6 +55,7 @@ class RangeAllocator:
         self._my_value: Optional[int] = None
         self._allocated = False
         self._stopped = False
+        self._refresh_timer = None
         self._client.subscribe_key_filter(self._on_publication)
 
     # -- public -----------------------------------------------------------
@@ -67,7 +73,18 @@ class RangeAllocator:
         )
 
     def stop(self) -> None:
+        """Stop claiming: unsubscribe and let the TTL'd claim age out
+        (reference: RangeAllocator-inl.h:75-86 stop —
+        unsubscribeKey + unsetKey)."""
         self._stopped = True
+        if self._refresh_timer is not None:
+            self._refresh_timer.cancel()
+            self._refresh_timer = None
+        unsubscribe = getattr(
+            self._client, "unsubscribe_key_filter", None
+        )
+        if unsubscribe is not None:
+            unsubscribe(self._on_publication)
 
     def get_value(self) -> Optional[int]:
         return self._my_value if self._allocated else None
@@ -114,6 +131,7 @@ class RangeAllocator:
             self._key_for(value),
             self._node.encode(),
             version=version,
+            ttl=RANGE_ALLOC_TTL_MS,
         )
         self._evb.schedule_timeout(
             self._retry_interval, lambda: self._verify_claim(value)
@@ -130,10 +148,32 @@ class RangeAllocator:
         ):
             if not self._allocated:
                 self._allocated = True
+                self._start_ttl_refresh()
                 self._callback(value)
         else:
             self._my_value = None
             self._try_next(value)
+
+    def _start_ttl_refresh(self) -> None:
+        """Keep our claim's TTL fresh while we own it. Deliberately NOT
+        client.persist_key: ownership enforcement would bump the version
+        to win the key back, overriding the same-version originator-id
+        consensus that makes the allocator converge. A ttl-only refresh
+        (bumped ttlVersion, value=None) preserves the merge ordering."""
+        if self._refresh_timer is not None:
+            return
+        interval = RANGE_ALLOC_TTL_MS / 1000.0 / 3.0
+        self._refresh_timer = self._evb.schedule_periodic(
+            interval, self._refresh_claim_ttl, jitter_first=True
+        )
+
+    def _refresh_claim_ttl(self) -> None:
+        if self._stopped or self._my_value is None or not self._allocated:
+            return
+        # not ours anymore -> no-op; the publication path handles the loss
+        self._client.refresh_ttl(
+            self._area, self._key_for(self._my_value), RANGE_ALLOC_TTL_MS
+        )
 
     def _try_next(self, failed_value: int) -> None:
         if self._stopped:
@@ -153,12 +193,17 @@ class RangeAllocator:
             or key != self._key_for(self._my_value)
         ):
             return
-        if value is None or value.value is None:
-            # our claim expired: re-claim the same value
+        if value is None:
+            # true expiry (pub.expired_keys): re-claim the same value
             claimed = self._my_value
             self._evb.run_immediately_or_in_event_base(
                 lambda: self._try_claim(claimed)
             )
+            return
+        if value.value is None:
+            # ttl-only refresh (ours or a peer's): carries no ownership
+            # information — NOT an expiry. Re-claiming here would churn
+            # the allocation every refresh interval.
             return
         if value.value != self._node.encode():
             # a higher-precedence claim may have taken our value — but the

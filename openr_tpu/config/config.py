@@ -55,6 +55,7 @@ class SparkConfig:
     keepalive_time_s: float = 2.0
     hold_time_s: float = 10.0
     graceful_restart_time_s: float = 30.0
+    mcast_port: int = 6666  # reference: Flags.cpp spark_mcast_port
 
     def validate(self) -> None:
         if self.hold_time_s < 3 * self.keepalive_time_s:
@@ -104,6 +105,45 @@ class WatchdogConfig:
 
 
 @dataclass
+class PrefixAllocationConfig:
+    """reference: OpenrConfig.thrift PrefixAllocationConfig +
+    Flags.cpp enable_prefix_alloc/seed_prefix/alloc_prefix_len/
+    static_prefix_alloc/set_loopback_address/loopback_iface."""
+
+    enabled: bool = False
+    # "" means dynamic leaf mode: params learned from the
+    # e2e-network-prefix KvStore key
+    seed_prefix: str = ""
+    alloc_prefix_len: int = 64
+    static_allocation: bool = False
+    set_loopback_addr: bool = False
+    loopback_iface: str = "lo"
+
+    def validate(self) -> None:
+        if not self.enabled or self.static_allocation:
+            return
+        if self.seed_prefix:
+            from openr_tpu.types import IpPrefix
+
+            try:
+                seed = IpPrefix.from_str(self.seed_prefix)
+            except Exception as e:
+                raise ConfigError(
+                    f"bad seed_prefix {self.seed_prefix!r}: {e}"
+                ) from e
+            if self.alloc_prefix_len < seed.prefix_length:
+                raise ConfigError(
+                    "alloc_prefix_len shorter than the seed prefix"
+                )
+            addr_bits = 8 * len(seed.prefix_address.addr)
+            if self.alloc_prefix_len > addr_bits:
+                raise ConfigError(
+                    f"alloc_prefix_len /{self.alloc_prefix_len} exceeds "
+                    f"the seed's {addr_bits}-bit address width"
+                )
+
+
+@dataclass
 class OpenrConfig:
     """reference: OpenrConfig.thrift OpenrConfig (314 lines)."""
 
@@ -124,11 +164,15 @@ class OpenrConfig:
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
         PrefixForwardingAlgorithm.SP_ECMP
     )
+    per_prefix_keys: bool = True
     spark: SparkConfig = field(default_factory=SparkConfig)
     kvstore: KvStoreConfig = field(default_factory=KvStoreConfig)
     decision: DecisionConfig = field(default_factory=DecisionConfig)
     link_monitor: LinkMonitorConfig = field(default_factory=LinkMonitorConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    prefix_alloc: PrefixAllocationConfig = field(
+        default_factory=PrefixAllocationConfig
+    )
     persistent_store_path: str = "/tmp/openr_tpu_persistent_store.bin"
     node_label: int = 0
     solver_backend: str = "device"
@@ -152,6 +196,7 @@ class OpenrConfig:
         if len(area_ids) != len(set(area_ids)):
             raise ConfigError("duplicate area ids")
         self.spark.validate()
+        self.prefix_alloc.validate()
         if self.decision.debounce_min_ms > self.decision.debounce_max_ms:
             raise ConfigError("decision debounce min > max")
         if (
@@ -177,6 +222,7 @@ class OpenrConfig:
             ("decision", DecisionConfig),
             ("link_monitor", LinkMonitorConfig),
             ("watchdog", WatchdogConfig),
+            ("prefix_alloc", PrefixAllocationConfig),
         ):
             if key in kwargs:
                 kwargs[key] = build(cls, kwargs[key])

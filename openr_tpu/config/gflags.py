@@ -1,0 +1,259 @@
+"""Legacy gflag translation shim.
+
+The reference daemon is configured by ~99 gflags (reference:
+openr/common/Flags.cpp) and migrates them onto the typed config via
+``GflagConfig::createConfigFromGflag`` (reference:
+openr/config/GflagConfig.h:38-120). This module is that shim for
+openr-tpu: it parses the gflags command-line dialect (``--name=value``,
+``--name value``, ``--name`` / ``--noname`` for bools) for the
+load-bearing subset of the reference flag surface and builds an
+:class:`~openr_tpu.config.config.OpenrConfig` from it, so an operator's
+existing reference invocation of those flags works against this daemon
+unchanged.
+
+Every flag in ``GFLAG_DEFS`` is translated into the config.
+Flags outside the subset (TLS, ZMQ ports, BGP peering internals) land
+in ``GflagResult.unknown`` and are logged rather than rejected — the
+reference tolerates unknown gflags the same way.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.config.config import ConfigError, OpenrConfig
+
+# name -> (type, default). The reference's defaults
+# (openr/common/Flags.cpp); only flags that map onto our config or
+# daemon surface are listed — everything else lands in `unknown`.
+GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
+    # identity / topology
+    "node_name": (str, ""),
+    "domain": (str, "openr"),
+    "areas": (str, ""),
+    "listen_addr": (str, "*"),
+    "openr_ctrl_port": (int, 2018),
+    "spark_mcast_port": (int, 6666),
+    # behavior toggles
+    "dryrun": (bool, False),
+    "enable_v4": (bool, False),
+    "enable_netlink_fib_handler": (bool, False),
+    "enable_ordered_fib_programming": (bool, False),
+    "enable_lfa": (bool, False),
+    "enable_bgp_route_programming": (bool, False),
+    "enable_watchdog": (bool, True),
+    "enable_flood_optimization": (bool, False),
+    "is_flood_root": (bool, False),
+    "prefix_fwd_type_mpls": (bool, False),
+    "prefix_algo_type_ksp2_ed_ecmp": (bool, False),
+    # interfaces
+    "iface_regex_include": (str, ""),
+    "iface_regex_exclude": (str, ""),
+    "loopback_iface": (str, "lo"),
+    # kvstore
+    "kvstore_key_ttl_ms": (int, 300_000),
+    "kvstore_sync_interval_s": (int, 60),
+    "kvstore_ttl_decrement_ms": (int, 1),
+    # decision
+    "decision_debounce_min_ms": (int, 10),
+    "decision_debounce_max_ms": (int, 250),
+    # link monitor
+    "link_flap_initial_backoff_ms": (int, 60_000),
+    "link_flap_max_backoff_ms": (int, 300_000),
+    "enable_rtt_metric": (bool, True),
+    # spark timers
+    "spark2_hello_time_s": (int, 20),
+    "spark2_hello_fastinit_time_ms": (int, 500),
+    "spark2_handshake_time_ms": (int, 500),
+    "spark2_heartbeat_time_s": (int, 2),
+    "spark2_heartbeat_hold_time_s": (int, 10),
+    # watchdog
+    "watchdog_interval_s": (int, 20),
+    "watchdog_threshold_s": (int, 300),
+    "memory_limit_mb": (int, 800),
+    # prefix allocation
+    "enable_prefix_alloc": (bool, False),
+    "seed_prefix": (str, ""),
+    "alloc_prefix_len": (int, 64),
+    "static_prefix_alloc": (bool, False),
+    "per_prefix_keys": (bool, True),
+    "set_loopback_address": (bool, False),
+    # storage
+    "config_store_filepath": (str, "/tmp/openr_tpu_persistent_store.bin"),
+    "config": (str, ""),
+}
+
+
+@dataclass
+class GflagResult:
+    """Parsed legacy flags plus what they translate to."""
+
+    flags: Dict[str, object]
+    unknown: Dict[str, str] = field(default_factory=dict)
+
+    def __getitem__(self, name: str):
+        return self.flags[name]
+
+
+def parse_gflags(argv: List[str]) -> GflagResult:
+    """Parse the gflags dialect: ``--name=value``, ``--name value``,
+    bools as ``--name`` / ``--name=true`` / ``--noname``."""
+    flags = {name: default for name, (_, default) in GFLAG_DEFS.items()}
+    unknown: Dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        i += 1
+        if not arg.startswith("--"):
+            unknown[arg] = ""
+            continue
+        body = arg[2:]
+        name, eq, inline = body.partition("=")
+        name = name.replace("-", "_")
+        negated = False
+        if name not in GFLAG_DEFS and name.startswith("no"):
+            stripped = name[2:]
+            if (
+                stripped in GFLAG_DEFS
+                and GFLAG_DEFS[stripped][0] is bool
+            ):
+                name, negated = stripped, True
+        if name not in GFLAG_DEFS:
+            unknown[name] = inline
+            continue
+        ftype, _ = GFLAG_DEFS[name]
+        if ftype is bool:
+            if negated:
+                flags[name] = False
+            elif eq:
+                flags[name] = inline.strip().lower() in (
+                    "true", "1", "yes", "y",
+                )
+            else:
+                flags[name] = True
+            continue
+        if eq:
+            raw = inline
+        elif i < len(argv) and not argv[i].startswith("--"):
+            raw = argv[i]
+            i += 1
+        else:
+            raise ConfigError(f"flag --{name} expects a value")
+        try:
+            flags[name] = ftype(raw)
+        except ValueError as e:
+            raise ConfigError(
+                f"flag --{name}: cannot parse {raw!r} as "
+                f"{ftype.__name__}"
+            ) from e
+    return GflagResult(flags=flags, unknown=unknown)
+
+
+def config_from_gflags(result: GflagResult) -> OpenrConfig:
+    """Build the typed config from parsed legacy flags (reference:
+    GflagConfig::createConfigFromGflag)."""
+    f = result.flags
+    areas = [a for a in str(f["areas"]).split(",") if a] or ["0"]
+    data = {
+        "node_name": f["node_name"],
+        "domain": f["domain"],
+        "areas": [{"area_id": a} for a in areas],
+        "listen_addr": (
+            "::" if f["listen_addr"] == "*" else f["listen_addr"]
+        ),
+        "openr_ctrl_port": f["openr_ctrl_port"],
+        "dryrun": f["dryrun"],
+        "enable_v4": f["enable_v4"],
+        "enable_netlink_fib_handler": f["enable_netlink_fib_handler"],
+        "enable_ordered_fib_programming": f[
+            "enable_ordered_fib_programming"
+        ],
+        "enable_lfa": f["enable_lfa"],
+        "enable_watchdog": f["enable_watchdog"],
+        "prefix_forwarding_type": (
+            "SR_MPLS" if f["prefix_fwd_type_mpls"] else "IP"
+        ),
+        "prefix_forwarding_algorithm": (
+            "KSP2_ED_ECMP"
+            if f["prefix_algo_type_ksp2_ed_ecmp"]
+            else "SP_ECMP"
+        ),
+        "per_prefix_keys": f["per_prefix_keys"],
+        "prefix_alloc": {
+            "enabled": f["enable_prefix_alloc"],
+            "seed_prefix": f["seed_prefix"],
+            "alloc_prefix_len": f["alloc_prefix_len"],
+            "static_allocation": f["static_prefix_alloc"],
+            "set_loopback_addr": f["set_loopback_address"],
+            "loopback_iface": f["loopback_iface"],
+        },
+        "kvstore": {
+            "key_ttl_ms": f["kvstore_key_ttl_ms"],
+            "sync_interval_s": float(f["kvstore_sync_interval_s"]),
+            "ttl_decrement_ms": f["kvstore_ttl_decrement_ms"],
+            "enable_flood_optimization": f["enable_flood_optimization"],
+            "is_flood_root": f["is_flood_root"],
+        },
+        "decision": {
+            "debounce_min_ms": f["decision_debounce_min_ms"],
+            "debounce_max_ms": f["decision_debounce_max_ms"],
+            "enable_bgp_route_programming": f[
+                "enable_bgp_route_programming"
+            ],
+        },
+        "link_monitor": {
+            "linkflap_initial_backoff_ms": f[
+                "link_flap_initial_backoff_ms"
+            ],
+            "linkflap_max_backoff_ms": f["link_flap_max_backoff_ms"],
+            "use_rtt_metric": f["enable_rtt_metric"],
+        },
+        "spark": {
+            "hello_time_s": float(f["spark2_hello_time_s"]),
+            "fastinit_hello_time_ms": f["spark2_hello_fastinit_time_ms"],
+            "handshake_time_ms": f["spark2_handshake_time_ms"],
+            "keepalive_time_s": float(f["spark2_heartbeat_time_s"]),
+            "hold_time_s": float(f["spark2_heartbeat_hold_time_s"]),
+            "mcast_port": f["spark_mcast_port"],
+        },
+        "watchdog": {
+            "interval_s": float(f["watchdog_interval_s"]),
+            "thread_timeout_s": float(f["watchdog_threshold_s"]),
+            "max_memory_mb": f["memory_limit_mb"],
+        },
+        "persistent_store_path": f["config_store_filepath"],
+    }
+    iface_includes = [
+        rx for rx in str(f["iface_regex_include"]).split(",") if rx
+    ]
+    iface_excludes = [
+        rx for rx in str(f["iface_regex_exclude"]).split(",") if rx
+    ]
+    # reference default is NO interfaces (empty regex): an empty include
+    # list here means "track nothing", not the AreaConfig match-all
+    for area in data["areas"]:
+        area["include_interface_regexes"] = iface_includes
+        if iface_excludes:
+            area["exclude_interface_regexes"] = iface_excludes
+    return OpenrConfig.from_dict(data)
+
+
+def load_config_from_argv(argv: List[str]) -> OpenrConfig:
+    """One-call path: parse legacy argv and build the config. When
+    ``--config`` names a file, the file is the sole config source and
+    every other flag is ignored — exactly the reference's behavior
+    (Main.cpp uses Config(FLAGS_config) and consults GflagConfig only
+    when no file is given). Flags outside the translated subset are
+    logged so a reference invocation that relies on them is visible."""
+    import logging
+
+    result = parse_gflags(argv)
+    if result.unknown:
+        logging.getLogger("openr_tpu.config.gflags").warning(
+            "ignoring untranslated legacy flags: %s",
+            ", ".join(sorted(result.unknown)),
+        )
+    if result.flags["config"]:
+        return OpenrConfig.from_file(str(result.flags["config"]))
+    return config_from_gflags(result)

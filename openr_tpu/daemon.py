@@ -49,9 +49,14 @@ class OpenrNode:
         config_store=None,
         solver_backend: str = "device",
         debounce_min_s: float = 0.01,
-        debounce_max_s: float = 0.05,
+        # reference default: 250ms ceiling (common/Flags.cpp
+        # decision_debounce_max_ms); tests pass a smaller value
+        debounce_max_s: float = 0.25,
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
+        per_prefix_keys: bool = True,
+        prefix_alloc=None,  # Optional[PrefixAllocationConfig]
+        netlink=None,  # address programming target for the allocator
     ):
         self.name = name
         self.area = area
@@ -144,7 +149,38 @@ class OpenrNode:
                 self.route_updates if len(self.areas) > 1 else None
             ),
             areas=self.areas,
+            per_prefix_keys=per_prefix_keys,
         )
+        # automatic prefix allocation (reference: Main.cpp PrefixAllocator
+        # construction gated on enable_prefix_alloc)
+        self.prefix_allocator = None
+        if prefix_alloc is not None and prefix_alloc.enabled:
+            from openr_tpu.allocators.prefix_allocator import PrefixAllocator
+            from openr_tpu.types import IpPrefix as _IpPrefix
+
+            seed = (
+                _IpPrefix.from_str(prefix_alloc.seed_prefix)
+                if prefix_alloc.seed_prefix
+                and not prefix_alloc.static_allocation
+                else None
+            )
+            self.prefix_allocator = PrefixAllocator(
+                name,
+                self.client_evb,
+                self.kvstore_client,
+                self.prefix_manager,
+                seed_prefix=seed,
+                alloc_prefix_len=prefix_alloc.alloc_prefix_len,
+                static_prefixes=(
+                    {} if prefix_alloc.static_allocation else None
+                ),
+                netlink=(
+                    netlink if prefix_alloc.set_loopback_addr else None
+                ),
+                loopback_if=prefix_alloc.loopback_iface,
+                config_store=config_store,
+                area=area,
+            )
         from openr_tpu.ctrl.handler import OpenrCtrlHandler
 
         self.ctrl_handler = OpenrCtrlHandler(
@@ -221,6 +257,8 @@ class OpenrNode:
             self._plugin_started = False
         if self.ctrl_server is not None:
             self.ctrl_server.stop()
+        if self.prefix_allocator is not None:
+            self.prefix_allocator.stop()
         self.fib.stop()
         self.decision.stop()
         self.link_monitor.stop()

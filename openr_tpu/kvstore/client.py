@@ -142,6 +142,12 @@ class KvStoreClient:
     def unsubscribe_key(self, area: str, key: str) -> None:
         self._key_callbacks.pop((area, key), None)
 
+    def unsubscribe_key_filter(self, callback) -> None:
+        try:
+            self._filter_callbacks.remove(callback)
+        except ValueError:
+            pass
+
     def subscribe_key_filter(
         self, callback: Callable[[str, str, Optional[Value]], None]
     ) -> None:
@@ -189,27 +195,36 @@ class KvStoreClient:
             area, key, persisted.value, value.version + 1, persisted.ttl
         )
 
+    def refresh_ttl(self, area: str, key: str, ttl: int) -> bool:
+        """One ttl-only refresh (same version, bumped ttlVersion, no
+        value) for a key we originated. Returns False if the key is
+        gone or no longer ours. Unlike persist_key this carries no
+        ownership enforcement — consensus users (RangeAllocator) rely
+        on the same-version merge ordering staying untouched."""
+        current = self.get_key(area, key)
+        if current is None or current.originator_id != self._node_id:
+            return False
+        self._kvstore.set_key_vals(
+            area,
+            KeySetParams(
+                key_vals={
+                    key: Value(
+                        version=current.version,
+                        originator_id=self._node_id,
+                        value=None,  # ttl-only refresh
+                        ttl=ttl,
+                        ttl_version=current.ttl_version + 1,
+                    )
+                },
+                originator_id=self._node_id,
+            ),
+        )
+        return True
+
     def _refresh_ttls(self) -> None:
         """Bump ttlVersion on persisted finite-TTL keys so they never
         expire while owned."""
         for persisted in list(self._persisted.values()):
             if persisted.ttl == TTL_INFINITY:
                 continue
-            current = self.get_key(persisted.area, persisted.key)
-            if current is None or current.originator_id != self._node_id:
-                continue
-            self._kvstore.set_key_vals(
-                persisted.area,
-                KeySetParams(
-                    key_vals={
-                        persisted.key: Value(
-                            version=current.version,
-                            originator_id=self._node_id,
-                            value=None,  # ttl-only refresh
-                            ttl=persisted.ttl,
-                            ttl_version=current.ttl_version + 1,
-                        )
-                    },
-                    originator_id=self._node_id,
-                ),
-            )
+            self.refresh_ttl(persisted.area, persisted.key, persisted.ttl)

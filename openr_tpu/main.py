@@ -23,7 +23,43 @@ from openr_tpu.monitor.watchdog import Watchdog
 from openr_tpu.spark.io_provider import UdpIoProvider
 
 
+def _is_legacy_invocation(argv) -> bool:
+    """A reference-style gflags invocation is detected by any
+    underscore-named flag from the translated gflag subset
+    (``--node_name=...``). The native argparse surface uses dashes, so
+    the two dialects never overlap on a single argument."""
+    from openr_tpu.config.gflags import GFLAG_DEFS
+
+    for arg in argv:
+        if not arg.startswith("--"):
+            continue
+        name = arg[2:].partition("=")[0]
+        if "_" not in name:
+            continue
+        if name in GFLAG_DEFS or (
+            name.startswith("no") and name[2:] in GFLAG_DEFS
+        ):
+            return True
+    return False
+
+
 def parse_args(argv):
+    parser = _build_parser()
+    if _is_legacy_invocation(argv):
+        # the WHOLE argv goes through the gflag shim: mixing it into
+        # argparse would silently strip flags the two surfaces share
+        # (--areas, --dryrun, --config). Parsing an empty argv gives the
+        # native defaults, so both paths share one attribute contract.
+        args = parser.parse_args([])
+        args.legacy_argv = list(argv)
+        return args
+    # strict parse: unknown/typo'd flags must fail fast
+    args = parser.parse_args(argv)
+    args.legacy_argv = None
+    return args
+
+
+def _build_parser():
     parser = argparse.ArgumentParser(prog="openr-tpu")
     parser.add_argument("--config", help="JSON config file")
     # legacy flag surface (reference: 99 gflags in common/Flags.cpp;
@@ -48,12 +84,21 @@ def parse_args(argv):
         help="connect to an out-of-process platform agent "
              "(python -m openr_tpu.platform.agent) instead",
     )
-    parser.add_argument("--spark-port", type=int, default=6666)
+    parser.add_argument(
+        "--spark-port", type=int, default=None,
+        help="UDP multicast port (default: config spark.mcast_port)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
-    return parser.parse_args(argv)
+    return parser
 
 
 def build_config(args) -> OpenrConfig:
+    if getattr(args, "legacy_argv", None) is not None:
+        # reference-style gflags invocation (--node_name=... etc.):
+        # translate through the shim (reference: config/GflagConfig.h)
+        from openr_tpu.config.gflags import load_config_from_argv
+
+        return load_config_from_argv(args.legacy_argv)
     if args.config:
         return OpenrConfig.from_file(args.config)
     if not args.node_name:
@@ -84,21 +129,26 @@ def main(argv=None) -> int:
     from openr_tpu.config_store.persistent_store import PersistentStore
 
     config_store = PersistentStore(config.persistent_store_path)
-    io_provider = UdpIoProvider(port=args.spark_port)
+    spark_port = args.spark_port or config.spark.mcast_port
+    io_provider = UdpIoProvider(port=spark_port)
     area = config.areas[0].area_id
 
-    if args.fib_agent_port and args.enable_netlink_fib:
+    fib_agent_port = args.fib_agent_port
+    enable_netlink_fib = (
+        args.enable_netlink_fib or config.enable_netlink_fib_handler
+    )
+    if fib_agent_port and enable_netlink_fib:
         raise SystemExit(
             "--fib-agent-port and --enable-netlink-fib are mutually "
             "exclusive: the agent owns the kernel boundary"
         )
     fib_agent = None  # MockFibAgent default
-    if args.fib_agent_port:
+    if fib_agent_port:
         from openr_tpu.platform.netlink_fib_handler import TcpFibAgent
 
-        fib_agent = TcpFibAgent("127.0.0.1", args.fib_agent_port)
-        log.info("using platform agent on port %d", args.fib_agent_port)
-    elif args.enable_netlink_fib:
+        fib_agent = TcpFibAgent("127.0.0.1", fib_agent_port)
+        log.info("using platform agent on port %d", fib_agent_port)
+    elif enable_netlink_fib:
         from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
         from openr_tpu.platform.netlink_linux import (
             LinuxNetlinkProtocolSocket,
@@ -115,11 +165,53 @@ def main(argv=None) -> int:
         fib_agent = NetlinkFibHandler(LinuxNetlinkProtocolSocket())
         log.info("in-process netlink FIB handler (rtnetlink)")
 
+    # loopback address programming for the prefix allocator needs its own
+    # netlink socket (the FIB handler owns route programming only)
+    alloc_netlink = None
+    if config.prefix_alloc.enabled and config.prefix_alloc.set_loopback_addr:
+        from openr_tpu.platform.netlink_linux import (
+            LinuxNetlinkProtocolSocket as _NlSock,
+        )
+
+        if _NlSock.is_admin_available():
+            alloc_netlink = _NlSock()
+        else:
+            log.warning(
+                "set_loopback_address requested but rtnetlink is not "
+                "available (needs CAP_NET_ADMIN): the elected prefix "
+                "will be advertised but NOT programmed on %s",
+                config.prefix_alloc.loopback_iface,
+            )
+
+    # resolve tracked interfaces (and their areas) up front
+    ifaces = [i for i in args.ifaces.split(",") if i]
+    if not ifaces and args.legacy_argv is not None:
+        # reference semantics: interfaces come from the system, filtered
+        # by the configured area regexes (iface_regex_include/exclude) —
+        # without this a gflags-started daemon would track nothing and
+        # never form an adjacency
+        import socket as _socket
+
+        ifaces = [
+            name
+            for _, name in _socket.if_nameindex()
+            if name != "lo"
+            and any(a.matches_interface(name) for a in config.areas)
+        ]
+    interface_areas = {}
+    for if_name in ifaces:
+        for a in config.areas:
+            if a.matches_interface(if_name):
+                interface_areas[if_name] = a.area_id
+                break
+
     node = OpenrNode(
         config.node_name,
         io_provider,
         fib_agent=fib_agent,
         area=area,
+        areas=config.area_ids(),
+        interface_areas=interface_areas or None,
         spark_config=dict(
             hello_interval_s=config.spark.hello_time_s,
             fast_hello_interval_s=config.spark.fastinit_hello_time_ms / 1000,
@@ -135,6 +227,9 @@ def main(argv=None) -> int:
         debounce_max_s=config.decision.debounce_max_ms / 1000,
         enable_flood_optimization=config.kvstore.enable_flood_optimization,
         is_flood_root=config.kvstore.is_flood_root,
+        per_prefix_keys=config.per_prefix_keys,
+        prefix_alloc=config.prefix_alloc,
+        netlink=alloc_netlink,
     )
     node.ctrl_handler._config = config
 
@@ -161,9 +256,13 @@ def main(argv=None) -> int:
     port = node.start_ctrl_server(port=config.openr_ctrl_port)
     log.info("ctrl server listening on port %d", port)
 
-    for if_name in [i for i in args.ifaces.split(",") if i]:
+    for if_name in ifaces:
         node.add_interface(if_name)
-        log.info("tracking interface %s", if_name)
+        log.info(
+            "tracking interface %s (area %s)",
+            if_name,
+            interface_areas.get(if_name, area),
+        )
 
     stop_event = threading.Event()
 

@@ -576,3 +576,187 @@ class TestRibPolicy:
         assert not change.updated_routes
         (nh,) = routes[prefix].nexthops
         assert nh.weight == 0
+
+
+class TestAllocatorLifecycleRegressions:
+    """Regressions from review: claims must be TTL'd (abandoned ones age
+    out), stop() must unsubscribe, stale allocator generations must not
+    apply, and a daemon-wired allocator advertises end to end."""
+
+    def test_claims_are_ttld(self):
+        from openr_tpu.allocators.range_allocator import RANGE_ALLOC_TTL_MS
+        from openr_tpu.types import TTL_INFINITY
+
+        net = AllocatorNet(["ttl-n"])
+        try:
+            got = []
+            ra = RangeAllocator(
+                net.evbs["ttl-n"],
+                net.clients["ttl-n"],
+                "ttl-n",
+                "ttlclaim:",
+                (0, 3),
+                got.append,
+            )
+            ra.start_allocator()
+            assert wait_until(lambda: got and got[-1] is not None)
+            stored = net.clients["ttl-n"].get_key(
+                "0", f"ttlclaim:{got[-1]}"
+            )
+            assert stored.ttl == RANGE_ALLOC_TTL_MS
+            assert stored.ttl != TTL_INFINITY
+            ra.stop()
+        finally:
+            net.stop()
+
+    def test_stop_unsubscribes_filter_callback(self):
+        net = AllocatorNet(["unsub-n"])
+        try:
+            client = net.clients["unsub-n"]
+            before = len(client._filter_callbacks)
+            ra = RangeAllocator(
+                net.evbs["unsub-n"],
+                client,
+                "unsub-n",
+                "unsub:",
+                (0, 3),
+                lambda v: None,
+            )
+            assert len(client._filter_callbacks) == before + 1
+            ra.stop()
+            assert len(client._filter_callbacks) == before
+        finally:
+            net.stop()
+
+    def test_reelection_does_not_leak_subscriptions(self):
+        net = AllocatorNet(["leak-n"])
+        try:
+            client = net.clients["leak-n"]
+            mgr = RecordingPrefixManager()
+            alloc = PrefixAllocator(
+                "leak-n",
+                net.evbs["leak-n"],
+                client,
+                mgr,
+                seed_prefix=IpPrefix.from_str("fd00:aa::/60"),
+                alloc_prefix_len=64,
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is not None)
+            baseline = len(client._filter_callbacks)
+            for i in range(5):
+                alloc.update_alloc_params(
+                    IpPrefix.from_str(f"fd00:b{i}::/60"), 64
+                )
+                assert wait_until(
+                    lambda: alloc.allocated_prefix is not None
+                    and alloc.allocated_prefix.to_str().startswith(
+                        f"fd00:b{i}"
+                    )
+                )
+            # one live subscription regardless of how many re-elections
+            assert len(client._filter_callbacks) == baseline
+            alloc.stop()
+        finally:
+            net.stop()
+
+    def test_stale_generation_callback_ignored(self):
+        net = AllocatorNet(["stale-n"])
+        try:
+            mgr = RecordingPrefixManager()
+            seed1 = IpPrefix.from_str("fd00:c1::/60")
+            alloc = PrefixAllocator(
+                "stale-n",
+                net.evbs["stale-n"],
+                net.clients["stale-n"],
+                mgr,
+                seed_prefix=seed1,
+                alloc_prefix_len=64,
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is not None)
+            stale_token = alloc._alloc_token
+            seed2 = IpPrefix.from_str("fd00:c2::/60")
+            alloc.update_alloc_params(seed2, 64)
+            assert wait_until(
+                lambda: alloc.allocated_prefix is not None
+                and alloc.allocated_prefix.to_str().startswith("fd00:c2")
+            )
+            # a claim from the OLD generation resolving late is a no-op
+            alloc._on_index(7, stale_token, (seed1, 64))
+            assert alloc.allocated_prefix.to_str().startswith("fd00:c2")
+            alloc.stop()
+        finally:
+            net.stop()
+
+    def test_daemon_wires_allocator(self):
+        from openr_tpu.config.config import PrefixAllocationConfig
+        from openr_tpu.daemon import OpenrNode
+        from openr_tpu.spark.io_provider import MockIoProvider
+        from openr_tpu.types import PrefixType
+
+        io = MockIoProvider()
+        node = OpenrNode(
+            "alloc-node",
+            io,
+            prefix_alloc=PrefixAllocationConfig(
+                enabled=True,
+                seed_prefix="fd00:da::/60",
+                alloc_prefix_len=64,
+            ),
+        )
+        node.start()
+        try:
+            assert node.prefix_allocator is not None
+            assert wait_until(
+                lambda: node.prefix_allocator.allocated_prefix is not None
+            )
+            # the allocation reached the PrefixManager and the KvStore
+            def advertised():
+                entries = node.prefix_manager.get_prefixes()
+                return any(
+                    e.type == PrefixType.PREFIX_ALLOCATOR
+                    for e in entries
+                )
+
+            assert wait_until(advertised)
+        finally:
+            node.stop()
+
+    def test_ttl_refresh_publication_is_not_expiry(self):
+        # a ttl-only refresh (Value with value=None) must NOT be treated
+        # as claim expiry — that would churn the allocation every
+        # refresh interval
+        from openr_tpu.types import Value
+
+        net = AllocatorNet(["rfr-n"])
+        try:
+            got = []
+            ra = RangeAllocator(
+                net.evbs["rfr-n"],
+                net.clients["rfr-n"],
+                "rfr-n",
+                "rfrclaim:",
+                (0, 3),
+                got.append,
+            )
+            ra.start_allocator()
+            assert wait_until(lambda: got and got[-1] is not None)
+            value = got[-1]
+            calls_before = len(got)
+
+            # deliver a ttl-only refresh publication for our claim key
+            ra._on_publication(
+                "0",
+                f"rfrclaim:{value}",
+                Value(version=1, originator_id="rfr-n", value=None,
+                      ttl=300_000, ttl_version=1),
+            )
+            time.sleep(0.3)
+            assert ra.get_value() == value  # still allocated, no churn
+            assert len(got) == calls_before  # callback not re-fired
+
+            # a true expiry (None) DOES re-claim
+            ra._on_publication("0", f"rfrclaim:{value}", None)
+            assert wait_until(lambda: ra.get_value() == value)
+            ra.stop()
+        finally:
+            net.stop()

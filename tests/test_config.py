@@ -113,3 +113,175 @@ def test_main_flag_config_builds():
     assert cfg.node_name == "fc001"
     assert cfg.area_ids() == ["0", "1"]
     assert cfg.enable_v4
+
+
+class TestGflagShim:
+    """reference: openr/config/GflagConfig.h createConfigFromGflag +
+    openr/common/Flags.cpp flag dialect."""
+
+    def test_parse_dialect(self):
+        from openr_tpu.config.gflags import parse_gflags
+
+        r = parse_gflags(
+            [
+                "--node_name=fc42",
+                "--openr_ctrl_port", "3018",
+                "--dryrun",
+                "--noenable_watchdog",
+                "--enable_v4=true",
+                "--enable_lfa=false",
+                "--tls_ticket_seed_path=/x",  # outside the subset
+            ]
+        )
+        assert r["node_name"] == "fc42"
+        assert r["openr_ctrl_port"] == 3018
+        assert r["dryrun"] is True
+        assert r["enable_watchdog"] is False
+        assert r["enable_v4"] is True
+        assert r["enable_lfa"] is False
+        assert "tls_ticket_seed_path" in r.unknown
+
+    def test_config_translation(self):
+        from openr_tpu.config.gflags import (
+            config_from_gflags,
+            parse_gflags,
+        )
+        from openr_tpu.types.lsdb import (
+            PrefixForwardingAlgorithm,
+            PrefixForwardingType,
+        )
+
+        cfg = config_from_gflags(
+            parse_gflags(
+                [
+                    "--node_name=fc42",
+                    "--areas=pod,spine",
+                    "--listen_addr=*",
+                    "--prefix_fwd_type_mpls",
+                    "--prefix_algo_type_ksp2_ed_ecmp",
+                    "--kvstore_key_ttl_ms=60000",
+                    "--decision_debounce_max_ms=500",
+                    "--link_flap_initial_backoff_ms=1000",
+                    "--spark2_heartbeat_hold_time_s=30",
+                    "--iface_regex_include=eth.*,po.*",
+                    "--memory_limit_mb=450",
+                ]
+            )
+        )
+        assert cfg.node_name == "fc42"
+        assert cfg.area_ids() == ["pod", "spine"]
+        assert cfg.listen_addr == "::"
+        assert cfg.prefix_forwarding_type == PrefixForwardingType.SR_MPLS
+        assert (
+            cfg.prefix_forwarding_algorithm
+            == PrefixForwardingAlgorithm.KSP2_ED_ECMP
+        )
+        assert cfg.kvstore.key_ttl_ms == 60000
+        assert cfg.decision.debounce_max_ms == 500
+        assert cfg.link_monitor.linkflap_initial_backoff_ms == 1000
+        assert cfg.spark.hold_time_s == 30.0
+        assert cfg.watchdog.max_memory_mb == 450
+        for area in cfg.areas:
+            assert area.matches_interface("eth0")
+            assert not area.matches_interface("lo")
+
+    def test_invalid_combo_rejected(self):
+        import pytest as _pytest
+
+        from openr_tpu.config.config import ConfigError
+        from openr_tpu.config.gflags import (
+            config_from_gflags,
+            parse_gflags,
+        )
+
+        # KSP2 without SR-MPLS is invalid in the typed config, exactly
+        # like a hand-written JSON config
+        with _pytest.raises(ConfigError):
+            config_from_gflags(
+                parse_gflags(
+                    ["--node_name=x", "--prefix_algo_type_ksp2_ed_ecmp"]
+                )
+            )
+
+    def test_config_file_wins(self, tmp_path):
+        import json as _json
+
+        from openr_tpu.config.gflags import load_config_from_argv
+
+        path = tmp_path / "node.json"
+        path.write_text(_json.dumps({"node_name": "from-file"}))
+        cfg = load_config_from_argv(
+            [f"--config={path}", "--node_name=from-flag"]
+        )
+        assert cfg.node_name == "from-file"
+
+    def test_main_accepts_legacy_argv(self):
+        from openr_tpu.main import build_config, parse_args
+
+        args = parse_args(
+            ["--node_name=fc9", "--areas=0", "--enable_v4"]
+        )
+        cfg = build_config(args)
+        assert cfg.node_name == "fc9"
+        assert cfg.enable_v4
+
+
+class TestGflagShimRegressions:
+    """Regressions from review: shared-spelling flags must reach the
+    shim; native typos must fail fast; every accepted flag translates."""
+
+    def test_shared_spelling_flags_reach_shim(self):
+        # --areas/--dryrun exist in BOTH dialects; a legacy invocation
+        # must not have them swallowed (and defaulted) by argparse
+        from openr_tpu.main import build_config, parse_args
+
+        args = parse_args(
+            ["--node_name=fc42", "--areas=pod,spine", "--dryrun"]
+        )
+        cfg = build_config(args)
+        assert cfg.node_name == "fc42"
+        assert cfg.area_ids() == ["pod", "spine"]
+        assert cfg.dryrun is True
+
+    def test_native_typo_fails_fast(self):
+        import pytest as _pytest
+
+        from openr_tpu.main import parse_args
+
+        with _pytest.raises(SystemExit):
+            parse_args(["--node-name", "fc1", "--enable-v4x"])
+
+    def test_prefix_alloc_flags_translate(self):
+        from openr_tpu.config.gflags import (
+            config_from_gflags,
+            parse_gflags,
+        )
+
+        cfg = config_from_gflags(
+            parse_gflags(
+                [
+                    "--node_name=fc1",
+                    "--enable_prefix_alloc",
+                    "--seed_prefix=fc00:cafe::/56",
+                    "--alloc_prefix_len=64",
+                    "--set_loopback_address",
+                    "--loopback_iface=lo1",
+                    "--spark_mcast_port=7777",
+                    "--per_prefix_keys=false",
+                ]
+            )
+        )
+        assert cfg.prefix_alloc.enabled
+        assert cfg.prefix_alloc.seed_prefix == "fc00:cafe::/56"
+        assert cfg.prefix_alloc.alloc_prefix_len == 64
+        assert cfg.prefix_alloc.set_loopback_addr
+        assert cfg.prefix_alloc.loopback_iface == "lo1"
+        assert cfg.spark.mcast_port == 7777
+        assert cfg.per_prefix_keys is False
+
+    def test_untranslated_flags_are_reported(self):
+        from openr_tpu.config.gflags import parse_gflags
+
+        r = parse_gflags(["--node_name=x", "--bgp_min_nexthop=2"])
+        # flags with no config mapping are NOT silently accepted
+        assert "bgp_min_nexthop" in r.unknown

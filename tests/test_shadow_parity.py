@@ -219,6 +219,15 @@ class TestShadowParity:
         topo = topologies.fat_tree_nodes(80)
         run_shadow(topo, "rsw-0-0", steps=40, seed=23)
 
+    def test_grid_200_step_stream(self):
+        """SURVEY §7.8 acceptance gate at depth: identical RouteDatabases
+        after EVERY step of a 200-step stream mixing metric churn,
+        overload flips, prefix churn, link flaps and node add/remove."""
+        topo = topologies.grid(5)
+        run_shadow(
+            topo, topo.nodes()[0], steps=200, seed=97, node_churn=True
+        )
+
 
 class TestSparseShadowParity:
     """Same gate over the sliced-ELL resident device path."""
