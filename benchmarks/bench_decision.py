@@ -136,7 +136,8 @@ def main(argv=None):
 
     ksp2 = (PrefixForwardingType.SR_MPLS,
             PrefixForwardingAlgorithm.KSP2_ED_ECMP)
-    for n in [10, 100]:
+    # 1000 exceeds the reference's KSP2 grid (10/100) — BASELINE config 2
+    for n in [10, 100, 1000]:
         side = max(2, int(n ** 0.5))
         topo = topologies.grid(side)
         run_case(

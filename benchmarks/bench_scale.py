@@ -96,6 +96,25 @@ def run_churn(args):
         t0 = time.perf_counter()
         reconverge(affected)
         samples.append((time.perf_counter() - t0) * 1000)
+    # Device-only per-dispatch time: chain K solves with ONE readback and
+    # subtract the 1-dispatch+readback time — the fixed transport cost
+    # (the ~69ms axon relay RTT) cancels (same approach as bench.py).
+    import jax
+
+    platform = jax.devices()[0].platform
+
+    def time_chain(k: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(k):
+            out = state.reconverge(state.graph, srcs)
+        np.asarray(out)
+        return (time.perf_counter() - t0) * 1000.0
+
+    time_chain(1)  # warm any K=1 cache path
+    t1 = statistics.median(time_chain(1) for _ in range(5))
+    tk = statistics.median(time_chain(8) for _ in range(5))
+    device_only = round(max(0.0, (tk - t1) / 7.0), 3)
     print(
         json.dumps(
             {
@@ -109,6 +128,8 @@ def run_churn(args):
                     ],
                     1,
                 ),
+                "device_only_ms": device_only,
+                "platform": platform,
                 "oracle_spot_check": "passed",
             }
         ),

@@ -77,6 +77,15 @@ class KvStoreConfig:
     ttl_decrement_ms: int = 1
     enable_flood_optimization: bool = False
     is_flood_root: bool = False
+    # reference: KvstoreFloodRate (0 = unlimited)
+    flood_msg_per_sec: int = 0
+    flood_msg_burst_size: int = 0
+
+    def flood_rate(self):
+        if self.flood_msg_per_sec > 0 and self.flood_msg_burst_size > 0:
+            return (float(self.flood_msg_per_sec),
+                    self.flood_msg_burst_size)
+        return None
 
 
 @dataclass
@@ -197,6 +206,15 @@ class OpenrConfig:
             raise ConfigError("duplicate area ids")
         self.spark.validate()
         self.prefix_alloc.validate()
+        if (self.kvstore.flood_msg_per_sec > 0) != (
+            self.kvstore.flood_msg_burst_size > 0
+        ):
+            raise ConfigError(
+                "kvstore flood rate limiting needs BOTH "
+                "flood_msg_per_sec and flood_msg_burst_size > 0 "
+                f"(got {self.kvstore.flood_msg_per_sec}/"
+                f"{self.kvstore.flood_msg_burst_size})"
+            )
         if self.decision.debounce_min_ms > self.decision.debounce_max_ms:
             raise ConfigError("decision debounce min > max")
         if (

@@ -55,6 +55,8 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "kvstore_key_ttl_ms": (int, 300_000),
     "kvstore_sync_interval_s": (int, 60),
     "kvstore_ttl_decrement_ms": (int, 1),
+    "kvstore_flood_msg_per_sec": (int, 0),
+    "kvstore_flood_msg_burst_size": (int, 0),
     # decision
     "decision_debounce_min_ms": (int, 10),
     "decision_debounce_max_ms": (int, 250),
@@ -194,6 +196,8 @@ def config_from_gflags(result: GflagResult) -> OpenrConfig:
             "ttl_decrement_ms": f["kvstore_ttl_decrement_ms"],
             "enable_flood_optimization": f["enable_flood_optimization"],
             "is_flood_root": f["is_flood_root"],
+            "flood_msg_per_sec": f["kvstore_flood_msg_per_sec"],
+            "flood_msg_burst_size": f["kvstore_flood_msg_burst_size"],
         },
         "decision": {
             "debounce_min_ms": f["decision_debounce_min_ms"],

@@ -54,6 +54,7 @@ class OpenrNode:
         debounce_max_s: float = 0.25,
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
+        flood_rate=None,  # Optional[(msgs_per_sec, burst)]
         per_prefix_keys: bool = True,
         prefix_alloc=None,  # Optional[PrefixAllocationConfig]
         netlink=None,  # address programming target for the allocator
@@ -95,6 +96,7 @@ class OpenrNode:
             areas=self.areas,
             enable_flood_optimization=enable_flood_optimization,
             is_flood_root=is_flood_root,
+            flood_rate=flood_rate,
         )
         self.client_evb = OpenrEventBase(name=f"kvclient:{name}")
         self.kvstore_client = KvStoreClient(

@@ -476,6 +476,11 @@ class SpfSolver:
         self.backend = backend
         self.static_mpls_routes: Dict[int, List[NextHop]] = {}
         self.best_routes_cache: Dict[IpPrefix, BestRouteSelectionResult] = {}
+        # root -> (d, fh_matrix, node_names, links_sig,
+        # {node: (label, entry)}) for the incremental node-label fast
+        # path; per-root so ctrl queries for other nodes don't thrash
+        # the hot path's slot
+        self._label_cache: Dict[str, tuple] = {}
         # per-(graph identity, topology_version, root) SPF view cache
         self._views: Dict[Tuple[int, int, str], SpfView] = {}
 
@@ -536,55 +541,9 @@ class SpfSolver:
                 route_db.add_unicast_route(entry)
 
         # MPLS routes for node (SR) labels
-        label_to_node: Dict[int, Tuple[str, RibMplsEntry]] = {}
-        for area, ls in sorted(area_link_states.items()):
-            for node, adj_db in sorted(ls.get_adjacency_databases().items()):
-                top_label = adj_db.node_label
-                if top_label == 0:
-                    continue
-                if not is_mpls_label_valid(top_label):
-                    continue
-                # label collision: deterministically keep the smaller name
-                # (reference: Decision.cpp:620-633)
-                existing = label_to_node.get(top_label)
-                if existing is not None and existing[0] < node:
-                    continue
-                if node == my_node_name:
-                    nh = make_next_hop(
-                        BinaryAddress.from_str("::"),
-                        None,
-                        0,
-                        MplsAction(action=MplsActionCode.POP_AND_LOOKUP),
-                        area,
-                        None,
-                    )
-                    label_to_node[top_label] = (
-                        node,
-                        RibMplsEntry(top_label, {nh}),
-                    )
-                    continue
-                metric_nhs = self._get_next_hops_with_metric(
-                    my_node_name, {(node, area)}, False, area_link_states
-                )
-                if not metric_nhs[1]:
-                    continue
-                label_to_node[top_label] = (
-                    node,
-                    RibMplsEntry(
-                        top_label,
-                        self._get_next_hops(
-                            my_node_name,
-                            {(node, area)},
-                            False,
-                            False,
-                            metric_nhs[0],
-                            metric_nhs[1],
-                            top_label,
-                            area_link_states,
-                            {},
-                        ),
-                    ),
-                )
+        label_to_node = self._build_node_label_routes(
+            my_node_name, area_link_states
+        )
         for _, (_, entry) in sorted(label_to_node.items()):
             route_db.add_mpls_route(entry)
 
@@ -617,6 +576,139 @@ class SpfSolver:
             route_db.add_mpls_route(RibMplsEntry(label, set(nhs)))
 
         return route_db
+
+    # -- node-label routes -------------------------------------------------
+
+    def _build_node_label_routes(
+        self,
+        my_node_name: str,
+        area_link_states: AreaLinkStates,
+    ) -> Dict[int, Tuple[str, "RibMplsEntry"]]:
+        """SR node-label routes for every labeled node
+        (reference: Decision.cpp:600-650 buildRouteDb label loop).
+
+        Incremental fast path (single-area device backend): the batched
+        view exposes the root's distance row and the first-hop matrix for
+        all destinations at once, so label routes whose distance AND
+        first-hop column are unchanged since the previous build are
+        reused instead of re-derived — under steady churn at 10k+ nodes
+        the per-event host cost drops from O(N) route constructions to
+        O(changed)."""
+        label_to_node: Dict[int, Tuple[str, RibMplsEntry]] = {}
+
+        reusable: Dict[str, Tuple[int, RibMplsEntry]] = {}
+        cache_probe = None
+        if len(area_link_states) == 1:
+            ((area, ls),) = area_link_states.items()
+            view = self._view(area, ls, my_node_name)
+            d = getattr(view, "_d", None)
+            fh = getattr(view, "_fh_batch", None)
+            if d is not None and fh is not None and view._snap is not None:
+                names = list(view._snap.node_names)
+                links_sig = tuple(
+                    (
+                        link.iface_from(my_node_name),
+                        link.metric_from(my_node_name),
+                        link.other_node(my_node_name),
+                        link.is_up(),
+                        link.nh_v6_from(my_node_name).addr,
+                    )
+                    for link in sorted(ls.links_from_node(my_node_name))
+                )
+                cache_probe = (d.copy(), fh.copy(), names, links_sig)
+                prev = self._label_cache.get(my_node_name)
+                if (
+                    prev is not None
+                    and prev[2] == names
+                    and prev[3] == links_sig
+                    and prev[0].shape == d.shape
+                    and prev[1].shape == fh.shape
+                ):
+                    # column-wise: a dst is dirty if ANY source row's
+                    # distance (root or neighbor — LFA reads neighbor
+                    # rows) or first-hop bit changed
+                    changed = np.flatnonzero(
+                        (prev[0] != d).any(axis=0)
+                        | (prev[1] != fh).any(axis=0)
+                    )
+                    changed_ids = set(int(i) for i in changed)
+                    # next-hop derivation subtracts the neighbor's own
+                    # distance (remaining = shortest - metric_to(nh)), so
+                    # a shifted neighbor row invalidates EVERY label route
+                    neighbor_ids = {
+                        int(i) for i in view._batch_srcs
+                    }
+                    if changed_ids.isdisjoint(neighbor_ids):
+                        reusable = {
+                            node: lab_entry
+                            for node, lab_entry in prev[4].items()
+                            if (
+                                view._snap.id_of(node) is not None
+                                and view._snap.id_of(node)
+                                not in changed_ids
+                            )
+                        }
+
+        built: Dict[str, Tuple[int, RibMplsEntry]] = {}
+        for area, ls in sorted(area_link_states.items()):
+            for node, adj_db in sorted(ls.get_adjacency_databases().items()):
+                top_label = adj_db.node_label
+                if top_label == 0:
+                    continue
+                if not is_mpls_label_valid(top_label):
+                    continue
+                # label collision: deterministically keep the smaller name
+                # (reference: Decision.cpp:620-633)
+                existing = label_to_node.get(top_label)
+                if existing is not None and existing[0] < node:
+                    continue
+                if node == my_node_name:
+                    nh = make_next_hop(
+                        BinaryAddress.from_str("::"),
+                        None,
+                        0,
+                        MplsAction(action=MplsActionCode.POP_AND_LOOKUP),
+                        area,
+                        None,
+                    )
+                    entry = RibMplsEntry(top_label, {nh})
+                    label_to_node[top_label] = (node, entry)
+                    built[node] = (top_label, entry)
+                    continue
+                cached = reusable.get(node)
+                if cached is not None and cached[0] == top_label:
+                    label_to_node[top_label] = (node, cached[1])
+                    built[node] = cached
+                    continue
+                metric_nhs = self._get_next_hops_with_metric(
+                    my_node_name, {(node, area)}, False, area_link_states
+                )
+                if not metric_nhs[1]:
+                    continue
+                entry = RibMplsEntry(
+                    top_label,
+                    self._get_next_hops(
+                        my_node_name,
+                        {(node, area)},
+                        False,
+                        False,
+                        metric_nhs[0],
+                        metric_nhs[1],
+                        top_label,
+                        area_link_states,
+                        {},
+                    ),
+                )
+                label_to_node[top_label] = (node, entry)
+                built[node] = (top_label, entry)
+
+        self._label_cache.pop(my_node_name, None)
+        if cache_probe is not None:
+            # re-insert at the end: eviction below is LRU-by-build
+            self._label_cache[my_node_name] = (*cache_probe, built)
+            while len(self._label_cache) > 8:  # bound ctrl-query growth
+                self._label_cache.pop(next(iter(self._label_cache)))
+        return label_to_node
 
     def create_route_for_prefix(
         self,

@@ -233,6 +233,7 @@ class KvStoreDb:
         filters: Optional[KvStoreFilters] = None,
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
+        flood_rate: Optional[Tuple[float, int]] = None,
     ):
         self.area = area
         self.node_id = node_id
@@ -242,6 +243,14 @@ class KvStoreDb:
         self._filters = filters
         self.key_vals: Dict[str, Value] = {}
         self.peers: Dict[str, _Peer] = {}
+        # flood rate limiting: token bucket + coalescing buffer
+        # (reference: KvStore.cpp:1129 floodLimiter_ BasicTokenBucket +
+        # bufferPublication/floodBufferedUpdates)
+        self._flood_rate = flood_rate
+        self._flood_tokens = float(flood_rate[1]) if flood_rate else 0.0
+        self._flood_refill_t = time.monotonic()
+        self._flood_buffer: Set[str] = set()
+        self._flood_timer = None
         # DUAL-computed SPT flood topology (reference: KvStoreDb inherits
         # DualNode; flood-optimization flag KvStore.cpp:2940-2973). Off by
         # default, matching the reference.
@@ -264,6 +273,7 @@ class KvStoreDb:
             "kvstore.full_sync_count": 0,
             "kvstore.flood_count": 0,
             "kvstore.spt_floods": 0,
+            "kvstore.rate_limit_suppress": 0,
         }
 
     # -- merge + flood ----------------------------------------------------
@@ -283,7 +293,80 @@ class KvStoreDb:
     def _publish(self, pub: Publication) -> None:
         self._updates_queue.push(pub)
 
+    # -- flood rate limiting ---------------------------------------------
+
+    def _flood_consume(self) -> bool:
+        """Take one token from the flood bucket (refilled at
+        flood_msg_per_sec up to the burst size)."""
+        rate, burst = self._flood_rate
+        now = time.monotonic()
+        self._flood_tokens = min(
+            float(burst),
+            self._flood_tokens + (now - self._flood_refill_t) * rate,
+        )
+        self._flood_refill_t = now
+        if self._flood_tokens >= 1.0:
+            self._flood_tokens -= 1.0
+            return True
+        return False
+
+    def _schedule_buffered_flood(self) -> None:
+        if self._flood_timer is not None:
+            return
+        # reference: Constants.h:189 kFloodPendingPublication = 100ms
+        self._flood_timer = self._evb.schedule_timeout(
+            0.1, self._flood_buffered
+        )
+
+    def _flood_buffered(self) -> None:
+        """Re-flood the coalesced buffer with the CURRENT stored values
+        (reference: floodBufferedUpdates — keys are merged, so a burst of
+        N updates to one key floods once)."""
+        self._flood_timer = None
+        if not self._flood_buffer:
+            return
+        if not self._flood_consume():
+            self._schedule_buffered_flood()
+            return
+        updates = {
+            key: self.key_vals[key]
+            for key in self._flood_buffer
+            if key in self.key_vals
+        }
+        self._flood_buffer.clear()
+        if updates:
+            self._flood_now(updates, exclude=None)
+
     def _flood(self, updates: Dict[str, Value], exclude: Optional[str]) -> None:
+        if self._flood_rate is not None:
+            if not self._flood_consume():
+                # suppressed: coalesce into the buffer, retry on a timer
+                self.counters["kvstore.rate_limit_suppress"] += 1
+                self._flood_buffer.update(updates)
+                self._schedule_buffered_flood()
+                return
+            if self._flood_buffer:
+                # token in hand and older keys pending: merge and flood
+                # the whole buffer at once so ordering is preserved
+                # (reference: floodPublication's buffer-merge path)
+                self._flood_buffer.update(updates)
+                updates = {
+                    key: self.key_vals[key]
+                    for key in self._flood_buffer
+                    if key in self.key_vals
+                }
+                self._flood_buffer.clear()
+                if self._flood_timer is not None:
+                    self._flood_timer.cancel()
+                    self._flood_timer = None
+                exclude = None  # forwarded batch: no single sender
+                if not updates:
+                    return
+        self._flood_now(updates, exclude)
+
+    def _flood_now(
+        self, updates: Dict[str, Value], exclude: Optional[str]
+    ) -> None:
         """Flood accepted updates to every INITIALIZED peer except the one
         we learned them from. With flood optimization on and a converged
         SPT, only the SPT links (parent + children of the elected flood
@@ -712,6 +795,7 @@ class KvStore:
         sync_interval_s: float = 60.0,
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
+        flood_rate: Optional[Tuple[float, int]] = None,
     ):
         self.node_id = node_id
         self.evb = OpenrEventBase(name=f"kvstore:{node_id}")
@@ -732,6 +816,7 @@ class KvStore:
                 filters,
                 enable_flood_optimization=enable_flood_optimization,
                 is_flood_root=is_flood_root,
+                flood_rate=flood_rate,
             )
         self._sync_interval = sync_interval_s
         self._sync_timer = None

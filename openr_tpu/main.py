@@ -227,6 +227,7 @@ def main(argv=None) -> int:
         debounce_max_s=config.decision.debounce_max_ms / 1000,
         enable_flood_optimization=config.kvstore.enable_flood_optimization,
         is_flood_root=config.kvstore.is_flood_root,
+        flood_rate=config.kvstore.flood_rate(),
         per_prefix_keys=config.per_prefix_keys,
         prefix_alloc=config.prefix_alloc,
         netlink=alloc_netlink,

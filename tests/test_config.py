@@ -285,3 +285,26 @@ class TestGflagShimRegressions:
         r = parse_gflags(["--node_name=x", "--bgp_min_nexthop=2"])
         # flags with no config mapping are NOT silently accepted
         assert "bgp_min_nexthop" in r.unknown
+
+
+def test_partial_flood_rate_rejected():
+    import pytest as _pytest
+
+    from openr_tpu.config.config import (
+        ConfigError,
+        KvStoreConfig,
+        OpenrConfig,
+    )
+
+    with _pytest.raises(ConfigError):
+        OpenrConfig(
+            node_name="n",
+            kvstore=KvStoreConfig(flood_msg_per_sec=100),
+        )
+    cfg = OpenrConfig(
+        node_name="n",
+        kvstore=KvStoreConfig(
+            flood_msg_per_sec=100, flood_msg_burst_size=50
+        ),
+    )
+    assert cfg.kvstore.flood_rate() == (100.0, 50)

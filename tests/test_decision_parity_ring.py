@@ -649,3 +649,165 @@ class TestParallelAdjRing:
             ("if1_12", MplsActionCode.PHP),
             ("if2_12", MplsActionCode.PHP),
         }
+
+
+class TestRingKsp2ForBgp:
+    """reference: DecisionTest.cpp:2478 Ksp2EdEcmpForBGP + :2662
+    Ksp2EdEcmpForBGP123 — BGP anycast over KSP2 tunnels with prepend
+    labels, metric-vector ties, and static MPLS resolution."""
+
+    BGP_PFX = IpPrefix.from_str("fd00:b9b::/64")
+    PREPEND = 60000
+
+    @staticmethod
+    def _mv(tie_metric, tie_breaker=False):
+        from openr_tpu.decision.metric_vector import (
+            CompareType,
+            MetricEntity,
+            MetricVector,
+        )
+
+        return MetricVector(
+            metrics=tuple(
+                MetricEntity(
+                    type=i,
+                    priority=i,
+                    op=CompareType.WIN_IF_PRESENT,
+                    is_best_path_tie_breaker=(
+                        tie_breaker if i == 4 else False
+                    ),
+                    metric=(tie_metric if i == 4 else i,),
+                )
+                for i in range(5)
+            )
+        )
+
+    def _network(self, mv1, mv2, min_nexthop=None):
+        from openr_tpu.types import PrefixType
+
+        adj_dbs = make_adj_dbs(RING_EDGES)
+        entries = {n: [make_entry(n, ksp2=True)] for n in adj_dbs}
+        entries["1"].append(
+            PrefixEntry(
+                prefix=self.BGP_PFX,
+                type=PrefixType.BGP,
+                mv=mv1,
+                prepend_label=self.PREPEND,
+                min_nexthop=min_nexthop,
+                forwarding_type=PrefixForwardingType.SR_MPLS,
+                forwarding_algorithm=(
+                    PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                ),
+            )
+        )
+        entries["2"].append(
+            PrefixEntry(
+                prefix=self.BGP_PFX,
+                type=PrefixType.BGP,
+                mv=mv2,
+                forwarding_type=PrefixForwardingType.SR_MPLS,
+                forwarding_algorithm=(
+                    PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                ),
+            )
+        )
+        return make_network(adj_dbs, entries=entries)
+
+    def _solver(self, node):
+        return SpfSolver(node, enable_best_route_selection=False)
+
+    def test_full_mv_tie_programs_nothing(self):
+        # identical metric vectors with NO tie-breaker: ambiguous, no route
+        area_ls, ps = self._network(self._mv(4), self._mv(4))
+        rdb = self._solver("3").build_route_db("3", area_ls, ps)
+        assert self.BGP_PFX not in rdb.unicast_routes
+
+    def test_winner_node1_with_prepend(self):
+        # node 2's last entity decremented: node 1 wins; node 3 programs
+        # the direct path plus the edge-disjoint detour, both carrying
+        # the winner's prepend label at the stack bottom
+        area_ls, ps = self._network(self._mv(4), self._mv(3))
+        rdb = self._solver("3").build_route_db("3", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.BGP_PFX]) == {
+            ("1", 10, push(self.PREPEND)),
+            ("4", 30, push(self.PREPEND, 1, 2)),
+        }
+
+    def test_winner_node2_no_prepend(self):
+        # node 2's last entity bumped: node 2 wins; no prepend label
+        area_ls, ps = self._network(self._mv(4), self._mv(6))
+        rdb = self._solver("3").build_route_db("3", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.BGP_PFX]) == {
+            ("1", 20, push(2)),
+            ("4", 20, push(2)),
+        }
+
+    def test_tie_breaker_selects_both_with_path_dedup(self):
+        # tie-breaker entities differ -> TIE_WINNER keeps both
+        # advertisers; the second-shortest path toward node 1 is dropped
+        # because it contains a first path (anycast de-spray, reference:
+        # pathAInPathB)
+        area_ls, ps = self._network(
+            self._mv(4, tie_breaker=True), self._mv(6, tie_breaker=True)
+        )
+        rdb = self._solver("3").build_route_db("3", area_ls, ps)
+        assert nh_set(rdb.unicast_routes[self.BGP_PFX]) == {
+            ("1", 10, push(self.PREPEND)),
+            ("1", 20, push(2)),
+            ("4", 20, push(2)),
+        }
+
+    def test_self_advertiser_with_static_resolution(self):
+        # node 1's own view: it advertises with a prepend label whose
+        # static MPLS route resolves to a raw next-hop; plus both paths
+        # toward co-advertiser node 2 (reference Ksp2EdEcmpForBGP tail)
+        from openr_tpu.types import BinaryAddress
+
+        area_ls, ps = self._network(
+            self._mv(5, tie_breaker=True), self._mv(6, tie_breaker=True)
+        )
+        solver = self._solver("1")
+        static_nh = NextHop(
+            address=BinaryAddress(addr=b"\x11" * 16), metric=0
+        )
+        solver.update_static_mpls_routes({self.PREPEND: [static_nh]}, [])
+        rdb = solver.build_route_db("1", area_ls, ps)
+        entry = rdb.unicast_routes[self.BGP_PFX]
+        raw = {
+            nh.address.addr
+            for nh in entry.nexthops
+            if nh.neighbor_node_name is None
+        }
+        assert raw == {b"\x11" * 16}
+        spf_hops = {
+            (nh.neighbor_node_name, nh.metric, nh.mpls_action)
+            for nh in entry.nexthops
+            if nh.neighbor_node_name is not None
+        }
+        assert spf_hops == {
+            ("2", 10, None),
+            ("3", 30, push(2, 4)),
+        }
+
+    def test_min_nexthop_counts_spf_paths_only(self):
+        # reference Ksp2EdEcmpForBGP123 tail: minNexthop=3 drops the
+        # route even though static resolution would add a third next-hop
+        # — the threshold is checked against SPF paths alone
+        from openr_tpu.types import BinaryAddress
+
+        area_ls, ps = self._network(
+            self._mv(5, tie_breaker=True),
+            self._mv(6, tie_breaker=True),
+            min_nexthop=3,
+        )
+        solver = self._solver("1")
+        solver.update_static_mpls_routes(
+            {
+                self.PREPEND: [
+                    NextHop(address=BinaryAddress(addr=b"\x11" * 16))
+                ]
+            },
+            [],
+        )
+        rdb = solver.build_route_db("1", area_ls, ps)
+        assert self.BGP_PFX not in rdb.unicast_routes

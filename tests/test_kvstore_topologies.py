@@ -370,3 +370,77 @@ class TestTtlChurn:
             )
         finally:
             net.stop()
+
+
+class TestFloodRateLimit:
+    """reference: KvStore.cpp:1129 floodLimiter_ token bucket +
+    bufferPublication/floodBufferedUpdates coalescing."""
+
+    def _pair(self, flood_rate):
+        from openr_tpu.kvstore.store import KvStore
+
+        a = KvStoreWrapper("rl-a")
+        # rate-limit only on the sender side
+        a.store.stop()
+        a.store = KvStore("rl-a", flood_rate=flood_rate)
+        b = KvStoreWrapper("rl-b")
+        a.start()
+        b.start()
+        return a, b
+
+    def test_burst_is_coalesced(self):
+        # burst=2, 5/sec: a burst of 30 rapid updates to the same key
+        # floods far fewer than 30 messages, and the LAST value wins
+        # everywhere (coalescing refloods current stored values)
+        a, b = self._pair(flood_rate=(5.0, 2))
+        try:
+            from openr_tpu.kvstore.wrapper import link_bidirectional
+
+            link_bidirectional(a, b)
+            assert wait_until(
+                lambda: all(
+                    s == KvStorePeerState.INITIALIZED
+                    for s in a.peer_states().values()
+                )
+            )
+            for i in range(30):
+                a.set_key("hot", f"v{i}".encode(), version=i + 1,
+                          originator="rl-a")
+            assert wait_until(
+                lambda: b.get_key("hot") is not None
+                and b.get_key("hot").value == b"v29",
+                timeout=10.0,
+            )
+            c = a.store._db(AREA).counters
+            assert c["kvstore.rate_limit_suppress"] > 0
+            # coalescing: peer-bound floods far below the update count
+            assert c["kvstore.flood_count"] < 30
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_unlimited_by_default(self):
+        a, b = self._pair(flood_rate=None)
+        try:
+            from openr_tpu.kvstore.wrapper import link_bidirectional
+
+            link_bidirectional(a, b)
+            assert wait_until(
+                lambda: all(
+                    s == KvStorePeerState.INITIALIZED
+                    for s in a.peer_states().values()
+                )
+            )
+            for i in range(10):
+                a.set_key(f"k{i}", b"v", originator="rl-a")
+            for i in range(10):
+                assert wait_until(
+                    lambda i=i: b.get_key(f"k{i}") is not None
+                )
+            assert (
+                a.store._db(AREA).counters["kvstore.rate_limit_suppress"]
+                == 0
+            )
+        finally:
+            a.stop()
+            b.stop()
