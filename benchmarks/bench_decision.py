@@ -155,6 +155,17 @@ def main(argv=None):
             args.backend,
         )
 
+    if args.full:
+        # fabric-scale KSP2: the device-batched masked-SPF prefetch's
+        # home turf (one dispatch replaces N per-destination Dijkstras)
+        topo = topologies.fat_tree_nodes(1000)
+        rsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("rsw"))
+        fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+        run_case(
+            f"fabric_{len(topo.adj_dbs)}_ksp2_ed_ecmp", topo, rsw, fsw,
+            args.backend, forwarding=ksp2,
+        )
+
 
 if __name__ == "__main__":
     main()

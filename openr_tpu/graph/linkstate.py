@@ -190,6 +190,15 @@ class Link:
     def metric_from(self, node: str) -> Metric:
         return (self._metric1 if self._dir(node) == 1 else self._metric2).value
 
+    def metric_and_other(self, node: str) -> Tuple[Metric, str]:
+        """Fused (metric_from, other_node) for path-walk hot loops
+        (KSP2 backtrace accumulates these per hop)."""
+        if node == self.n1:
+            return self._metric1.value, self.n2
+        if node == self.n2:
+            return self._metric2.value, self.n1
+        raise KeyError(node)
+
     def overload_from(self, node: str) -> bool:
         return (
             self._overload1 if self._dir(node) == 1 else self._overload2
@@ -296,18 +305,28 @@ class NodeSpfResult:
     (ECMP) node set, and predecessor links for path backtracing.
     reference: LinkState.h:203 NodeSpfResult."""
 
-    __slots__ = ("metric", "next_hops", "path_links")
+    __slots__ = ("metric", "next_hops", "path_links", "_links_sorted")
 
     def __init__(self, metric: Metric):
         self.metric = metric
         self.next_hops: Set[str] = set()
         # (link, prev_node) pairs: incoming shortest-path edges
         self.path_links: List[Tuple[Link, str]] = []
+        self._links_sorted = False
+
+    def sorted_path_links(self) -> List[Tuple[Link, str]]:
+        """Canonical-order predecessor links, sorted once per node (the
+        trace backtracks, so per-visit sorting would repeat the work)."""
+        if not self._links_sorted:
+            self.path_links.sort(key=lambda lp: lp[0].ordered_names)
+            self._links_sorted = True
+        return self.path_links
 
     def reset(self, metric: Metric) -> None:
         self.metric = metric
         self.next_hops = set()
         self.path_links = []
+        self._links_sorted = False
 
     def __repr__(self) -> str:
         return f"NodeSpfResult(m={self.metric}, nh={sorted(self.next_hops)})"
@@ -654,10 +673,16 @@ class LinkState:
         links_to_ignore: Set[Link],
     ) -> Optional[Path]:
         """Walk predecessor links dest -> src, consuming each link at most
-        once across calls (reference: LinkState.cpp:399 traceOnePath)."""
+        once across calls (reference: LinkState.cpp:399 traceOnePath).
+
+        Candidates are visited in canonical (sorted) link order — the
+        reference iterates an unordered container, so any fixed order is
+        spec-conformant, and a DETERMINISTIC one lets the device-assisted
+        KSP2 path (solver _prefetch_ksp2_paths) reproduce identical
+        traces from masked distance rows."""
         if src == dest:
             return []
-        for link, prev in result[dest].path_links:
+        for link, prev in result[dest].sorted_path_links():
             if link in links_to_ignore:
                 continue
             links_to_ignore.add(link)
@@ -666,6 +691,22 @@ class LinkState:
                 sub.append(link)
                 return sub
         return None
+
+    def prime_kth_paths(
+        self, src: str, dest: str, k: int, paths: List[Path]
+    ) -> None:
+        """Seed the kth-path cache with externally computed paths (the
+        solver's device-batched masked-SPF KSP2 prefetch); entries are
+        dropped with the cache on any topology change."""
+        self._kth_path_cache[(src, dest, k)] = paths
+
+    def parallel_pairs(self) -> Set[FrozenSet[str]]:
+        """Node pairs connected by more than one (parallel) link."""
+        counts: Dict[FrozenSet[str], int] = {}
+        for link in self.all_links():
+            pair = frozenset((link.n1, link.n2))
+            counts[pair] = counts.get(pair, 0) + 1
+        return {pair for pair, c in counts.items() if c > 1}
 
     def get_kth_paths(self, src: str, dest: str, k: int) -> List[Path]:
         """Edge-disjoint paths of rank k: SPF excluding all links used by

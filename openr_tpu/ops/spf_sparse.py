@@ -510,6 +510,114 @@ def ell_source_batch(graph: EllGraph, ls, src_name: str):
     return srcs + [sid] * (bucket - len(srcs))
 
 
+def _ell_relax_masked(d, bands, srcs_t, ws_t, masks_t, overloaded):
+    """One relaxation with a PER-BATCH edge mask: [B, N] -> [B, N].
+    masks_t[bi] is [B, rows, k] bool — True == edge excluded for that
+    batch element (the KSP2 edge-disjoint second-path graphs)."""
+    parts = []
+    pos = 0
+    for band, s_b, w_b, m_b in zip(bands, srcs_t, ws_t, masks_t):
+        assert band.start == pos, (band, pos)
+        w_eff = jnp.where(overloaded[s_b], INF, w_b)  # [rows, k]
+        w_batched = jnp.where(m_b, INF, w_eff[None, :, :])  # [B, rows, k]
+        gathered = d[:, s_b]  # [B, rows, k]
+        relaxed = jnp.min(
+            jnp.minimum(gathered + w_batched, INF), axis=2
+        )
+        parts.append(
+            jnp.minimum(d[:, pos : pos + band.rows], relaxed.astype(jnp.int32))
+        )
+        pos += band.rows
+    parts.append(d[:, pos:])
+    return jnp.concatenate(parts, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _ell_masked_source_batch(srcs_t, ws_t, masks_t, overloaded, src_id,
+                             bands, n):
+    """Single-source distances over B differently-masked graphs:
+    [B, N]. The device half of batched KSP2 second-path computation —
+    one dispatch replaces B host Dijkstras
+    (reference semantics: LinkState.cpp:763 getKthPaths' runSpf with
+    linksToIgnore, one per destination)."""
+    b = masks_t[0].shape[0]
+    unit = jnp.full((b, n), INF, dtype=jnp.int32)
+    unit = unit.at[:, src_id].set(0)
+    # init: unmasked-overload relax so an overloaded SOURCE still
+    # originates (mirrors _ell_view_batch)
+    no_overload = jnp.zeros_like(overloaded)
+    d0 = _ell_relax_masked(unit, bands, srcs_t, ws_t, masks_t, no_overload)
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        d, _, it = state
+        nxt = _ell_relax_masked(
+            d, bands, srcs_t, ws_t, masks_t, overloaded
+        )
+        return nxt, jnp.any(nxt < d), it + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    return d
+
+
+def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
+    """Per-band [B, rows, k] bool masks from per-batch-element link
+    sets. Returns (masks, ok_flags): ok_flags[b] is False when element
+    b's exclusions cannot be represented in the ELL — a link between a
+    node pair with PARALLEL links shares one collapsed min-metric slot,
+    so masking it would wrongly kill the surviving parallel link(s).
+    ``parallel_pairs``: set of frozenset({n1, n2}) pairs with more than
+    one link; the caller derives it from the LinkState."""
+    b = len(exclusion_sets)
+    parallel_pairs = parallel_pairs or set()
+    masks = [
+        np.zeros((b, band.rows, band.k), dtype=bool)
+        for band in graph.bands
+    ]
+    ok = np.ones(b, dtype=bool)
+    for x, links in enumerate(exclusion_sets):
+        for link in links:
+            if frozenset((link.n1, link.n2)) in parallel_pairs:
+                ok[x] = False
+                break
+            for head in (link.n1, link.n2):
+                tail = link.other_node(head)
+                hid = graph.node_index.get(head)
+                tid = graph.node_index.get(tail)
+                if hid is None or tid is None:
+                    ok[x] = False
+                    break
+                bi, band = _band_of(graph, hid)
+                r = hid - band.start
+                hits = np.flatnonzero(graph.src[bi][r] == tid)
+                if len(hits) == 0:
+                    # edge not in the ELL (e.g. link went down after
+                    # compile): nothing to mask
+                    continue
+                masks[bi][x, r, hits[0]] = True
+            if not ok[x]:
+                break
+    return masks, ok
+
+
+def ell_masked_distances(graph: EllGraph, src_id: int, masks):
+    """Run the batched masked solve; returns host [B, n_pad] int32."""
+    return np.asarray(
+        _ell_masked_source_batch(
+            tuple(jnp.asarray(s) for s in graph.src),
+            tuple(jnp.asarray(w) for w in graph.w),
+            tuple(jnp.asarray(m) for m in masks),
+            jnp.asarray(graph.overloaded),
+            src_id,
+            graph.bands,
+            graph.n_pad,
+        )
+    )
+
+
 class EllState:
     """Caller-owned resident device bands for the churn loop."""
 

@@ -290,3 +290,66 @@ class TestShardedSparse:
         graph = spf_sparse.compile_sparse(ls, align=8)
         d = np.asarray(spf_sparse.sharded_sparse_all_sources(graph, mesh8))
         assert (d[graph.n :, : graph.n] >= INF).all()
+
+
+class TestMaskedSourceBatch:
+    """ops.spf_sparse._ell_masked_source_batch: batched per-destination
+    masked SPF (the KSP2 second-path device kernel)."""
+
+    def test_masked_distances_match_host_dijkstra(self):
+        import random
+
+        from openr_tpu.graph.linkstate import LinkState
+        from openr_tpu.models import topologies
+        from openr_tpu.ops import spf_sparse
+        from openr_tpu.ops.spf import INF
+
+        topo = topologies.random_mesh(24, degree=3, seed=5, max_metric=9)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        graph = spf_sparse.compile_ell(ls)
+        src = "node-0"
+        sid = graph.node_index[src]
+
+        rng = random.Random(3)
+        all_links = sorted(ls.all_links())
+        exclusion_sets = [
+            set(rng.sample(all_links, k)) for k in (0, 1, 2, 3)
+        ]
+        masks, ok = spf_sparse.build_edge_masks(
+            graph, exclusion_sets, ls.parallel_pairs()
+        )
+        assert ok.all()  # no parallel links in this mesh
+        drows = spf_sparse.ell_masked_distances(graph, sid, masks)
+
+        for i, excl in enumerate(exclusion_sets):
+            want = ls.run_spf(src, True, excl)
+            for name, nid in graph.node_index.items():
+                got = int(drows[i][nid])
+                if name in want:
+                    assert got == want[name].metric, (i, name)
+                else:
+                    assert got >= INF, (i, name)
+
+    def test_parallel_link_exclusion_flagged(self):
+        from openr_tpu.graph.linkstate import LinkState
+        from openr_tpu.ops import spf_sparse
+        from tests.test_linkstate import adj, db
+
+        ls = LinkState(area="0")
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if1_ab", "if1_ba"),
+                     adj("b", "if2_ab", "if2_ba")])
+        )
+        ls.update_adjacency_database(
+            db("b", [adj("a", "if1_ba", "if1_ab"),
+                     adj("a", "if2_ba", "if2_ab")])
+        )
+        graph = spf_sparse.compile_ell(ls)
+        (link, _other) = sorted(ls.all_links())[:2]
+        masks, ok = spf_sparse.build_edge_masks(
+            graph, [{link}, set()], ls.parallel_pairs()
+        )
+        assert not ok[0]  # parallel pair: not representable
+        assert ok[1]
