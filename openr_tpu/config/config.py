@@ -169,6 +169,7 @@ class OpenrConfig:
     enable_kvstore_request_queue: bool = False
     enable_watchdog: bool = True
     enable_lfa: bool = False
+    enable_rib_policy: bool = True
     prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
         PrefixForwardingAlgorithm.SP_ECMP

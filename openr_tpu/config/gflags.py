@@ -42,6 +42,7 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "enable_ordered_fib_programming": (bool, False),
     "enable_lfa": (bool, False),
     "enable_bgp_route_programming": (bool, False),
+    "enable_rib_policy": (bool, False),  # reference default: disabled
     "enable_watchdog": (bool, True),
     "enable_flood_optimization": (bool, False),
     "is_flood_root": (bool, False),
@@ -172,6 +173,7 @@ def config_from_gflags(result: GflagResult) -> OpenrConfig:
             "enable_ordered_fib_programming"
         ],
         "enable_lfa": f["enable_lfa"],
+        "enable_rib_policy": f["enable_rib_policy"],
         "enable_watchdog": f["enable_watchdog"],
         "prefix_forwarding_type": (
             "SR_MPLS" if f["prefix_fwd_type_mpls"] else "IP"

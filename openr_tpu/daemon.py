@@ -48,6 +48,7 @@ class OpenrNode:
         use_rtt_metric: bool = False,
         config_store=None,
         solver_backend: str = "device",
+        enable_rib_policy: bool = True,
         debounce_min_s: float = 0.01,
         # reference default: 250ms ceiling (common/Flags.cpp
         # decision_debounce_max_ms); tests pass a smaller value
@@ -110,6 +111,7 @@ class OpenrNode:
             debounce_min_s=debounce_min_s,
             debounce_max_s=debounce_max_s,
             solver_backend=solver_backend,
+            enable_rib_policy=enable_rib_policy,
         )
         self.fib_agent = fib_agent or MockFibAgent()
         self.fib = Fib(

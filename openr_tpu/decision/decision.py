@@ -138,7 +138,9 @@ class Decision:
         bgp_dry_run: bool = False,
         enable_best_route_selection: bool = True,
         solver_backend: str = "device",
+        enable_rib_policy: bool = True,
     ):
+        self._enable_rib_policy = enable_rib_policy
         self.my_node_name = my_node_name
         self.evb = OpenrEventBase(name=f"decision:{my_node_name}")
         self.route_updates_queue = route_updates_queue
@@ -475,7 +477,13 @@ class Decision:
     def set_rib_policy(self, policy) -> None:
         """Install a TTL'd policy; a rebuild is scheduled at expiry so its
         effects revert (reference: Decision.cpp:1600 setRibPolicy +
-        ribPolicyTimer_)."""
+        ribPolicyTimer_). Inline validation mirrors the reference's
+        thrift::OpenrError cases: feature knob off (Decision.cpp:1593)
+        and an empty policy (DecisionTest RibPolicyError)."""
+        if not self._enable_rib_policy:
+            raise RuntimeError("rib policy feature is disabled by config")
+        if policy is not None and not policy.statements:
+            raise ValueError("rib policy must carry >= 1 statement")
 
         def install() -> None:
             self.rib_policy = policy
@@ -495,6 +503,8 @@ class Decision:
             self._rebuild_debounced()
 
     def get_rib_policy(self):
+        if not self._enable_rib_policy:
+            raise RuntimeError("rib policy feature is disabled by config")
         return self.evb.call_and_wait(lambda: self.rib_policy)
 
     def get_counters(self) -> Dict[str, int]:

@@ -195,23 +195,6 @@ def _ksp2_chunk(graph) -> int:
         chunk *= 2
     return chunk
 
-# LinkState -> (topology_version, EllGraph) for the KSP2 masked
-# batches; weakly keyed so dead LinkStates are evicted (an id()-keyed
-# dict could both leak and alias a recycled address to a stale graph)
-import weakref
-
-_KSP2_ELL: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-
-
-def _ksp2_ell_graph(ls: LinkState):
-    from openr_tpu.ops import spf_sparse
-
-    entry = _KSP2_ELL.get(ls)
-    if entry is not None and entry[0] == ls.topology_version:
-        return entry[1]
-    graph = spf_sparse.compile_ell(ls)
-    _KSP2_ELL[ls] = (ls.topology_version, graph)
-    return graph
 
 
 def get_spf_counters() -> Dict[str, int]:
@@ -460,6 +443,35 @@ class _EllResidentCache:
         # ls -> (synced topology_version, EllState)
         self._cache = weakref.WeakKeyDictionary()
 
+    def state_for(self, ls: LinkState):
+        """Sync the resident device bands to ``ls`` and return the
+        EllState — incremental ``ell_patch`` scatter when the journal
+        covers the change, full ``compile_ell`` otherwise. Shared by the
+        view solve and the KSP2 masked batches (one resident copy of the
+        graph, however many consumers)."""
+        from openr_tpu.ops import spf_sparse
+
+        entry = self._cache.get(ls)
+        if entry is not None:
+            version, state = entry
+            if version == ls.topology_version:
+                return state
+            affected = ls.affected_since(version)
+            patched = (
+                spf_sparse.ell_patch(state.graph, ls, sorted(affected))
+                if affected is not None
+                else None
+            )
+            if patched is not None:
+                state.apply_patch(patched)
+                SPF_COUNTERS["decision.ell_patches"] += 1
+                self._cache[ls] = (ls.topology_version, state)
+                return state
+        state = spf_sparse.EllState(spf_sparse.compile_ell(ls))
+        SPF_COUNTERS["decision.ell_full_compiles"] += 1
+        self._cache[ls] = (ls.topology_version, state)
+        return state
+
     def view_packed(
         self, ls: LinkState, root: str
     ) -> Tuple[object, List[int], np.ndarray]:
@@ -468,32 +480,9 @@ class _EllResidentCache:
         [2B, n_pad] host array: B distance rows then B first-hop rows)."""
         from openr_tpu.ops import spf_sparse
 
-        entry = self._cache.get(ls)
-        state = None
-        graph = None
-        if entry is not None:
-            version, state = entry
-            if version == ls.topology_version:
-                graph = state.graph
-            else:
-                affected = ls.affected_since(version)
-                patched = (
-                    spf_sparse.ell_patch(state.graph, ls, sorted(affected))
-                    if affected is not None
-                    else None
-                )
-                if patched is None:
-                    state = None  # fall through to full compile
-                else:
-                    graph = patched
-                    SPF_COUNTERS["decision.ell_patches"] += 1
-        if state is None:
-            graph = spf_sparse.compile_ell(ls)
-            state = spf_sparse.EllState(graph)
-            SPF_COUNTERS["decision.ell_full_compiles"] += 1
-        srcs = spf_sparse.ell_source_batch(graph, ls, root)
-        packed = np.asarray(state.reconverge(graph, srcs))
-        self._cache[ls] = (ls.topology_version, state)
+        state = self.state_for(ls)
+        srcs = spf_sparse.ell_source_batch(state.graph, ls, root)
+        packed = np.asarray(state.reconverge(state.graph, srcs))
         return state.graph, srcs, packed
 
 
@@ -1031,16 +1020,15 @@ class SpfSolver:
         dsts = sorted(dsts)
         if len(dsts) < KSP2_DEVICE_MIN_DSTS:
             return
-        hops = ls.get_spf_result(my_node_name, use_link_metric=False)
-        eccentricity = max(
-            (r.metric for r in hops.values()), default=0
-        )
-        if eccentricity > KSP2_DEVICE_MAX_HOPS:
+        if ls.get_max_hops_to_node(my_node_name) > KSP2_DEVICE_MAX_HOPS:
             return  # high-diameter graph: host Dijkstra wins
 
         from openr_tpu.ops import spf_sparse
 
-        graph = _ksp2_ell_graph(ls)
+        # the same resident device bands the sparse view solves on —
+        # incremental ell_patch sync, no band re-upload per dispatch
+        state = _ELL_RESIDENT.state_for(ls)
+        graph = state.graph
         sid = graph.node_index.get(my_node_name)
         if sid is None:
             return
@@ -1089,7 +1077,9 @@ class SpfSolver:
             masks, ok = spf_sparse.build_edge_masks(
                 graph, batch_excl + [set()] * pad, parallel
             )
-            drows = spf_sparse.ell_masked_distances(graph, sid, masks)
+            drows = spf_sparse.ell_masked_distances_resident(
+                state, sid, masks
+            )
             SPF_COUNTERS["decision.ksp2_device_batches"] += 1
             for i, dst in enumerate(batch_dsts):
                 if not ok[i]:

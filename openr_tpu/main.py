@@ -223,6 +223,7 @@ def main(argv=None) -> int:
         use_rtt_metric=config.link_monitor.use_rtt_metric,
         config_store=config_store,
         solver_backend=config.solver_backend,
+        enable_rib_policy=config.enable_rib_policy,
         debounce_min_s=config.decision.debounce_min_ms / 1000,
         debounce_max_s=config.decision.debounce_max_ms / 1000,
         enable_flood_optimization=config.kvstore.enable_flood_optimization,

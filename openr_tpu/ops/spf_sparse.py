@@ -618,6 +618,24 @@ def ell_masked_distances(graph: EllGraph, src_id: int, masks):
     )
 
 
+def ell_masked_distances_resident(
+    state: "EllState", src_id: int, masks
+):
+    """Masked solve over an EllState's device-RESIDENT bands — only the
+    masks cross host->device per dispatch."""
+    return np.asarray(
+        _ell_masked_source_batch(
+            state.src,
+            state.w,
+            tuple(jnp.asarray(m) for m in masks),
+            jnp.asarray(state.graph.overloaded),
+            src_id,
+            state.graph.bands,
+            state.graph.n_pad,
+        )
+    )
+
+
 class EllState:
     """Caller-owned resident device bands for the churn loop."""
 
@@ -625,6 +643,38 @@ class EllState:
         self.graph = graph
         self.src = tuple(jnp.asarray(s) for s in graph.src)
         self.w = tuple(jnp.asarray(w) for w in graph.w)
+
+    def apply_patch(self, patched: EllGraph) -> None:
+        """Scatter a patched graph's changed rows into the resident
+        bands WITHOUT solving (for consumers that only need synced
+        device bands, e.g. the KSP2 masked batches)."""
+        changed: Dict[int, np.ndarray] = patched.changed or {}
+        new_src, new_w = [], []
+        for bi, band in enumerate(patched.bands):
+            rows = changed.get(bi)
+            if rows is None or len(rows) == 0:
+                new_src.append(self.src[bi])
+                new_w.append(self.w[bi])
+                continue
+            rows = np.asarray(rows, dtype=np.int32)
+            padded = pad_patch_rows(rows)
+            if padded is None:
+                padded = np.arange(band.rows, dtype=np.int32)
+            # bucketed shapes: the eager .at[].set dispatch compiles one
+            # scatter per bucket, not one per distinct churn size
+            new_src.append(
+                self.src[bi].at[padded, :].set(patched.src[bi][padded])
+            )
+            new_w.append(
+                self.w[bi].at[padded, :].set(patched.w[bi][padded])
+            )
+        self.src = tuple(new_src)
+        self.w = tuple(new_w)
+        from dataclasses import replace as _replace
+
+        # rows are applied: clear the journal so a later reconverge
+        # doesn't scatter them again
+        self.graph = _replace(patched, changed=None)
 
     def reconverge(self, patched: EllGraph, srcs):
         """Fused churn step: scatter the patched rows into the resident

@@ -760,3 +760,53 @@ class TestAllocatorLifecycleRegressions:
             ra.stop()
         finally:
             net.stop()
+
+
+class TestRibPolicyErrors:
+    """reference: DecisionTest.cpp:5275 RibPolicyError + :5289
+    RibPolicyFeatureKnob."""
+
+    def _decision(self, enable_rib_policy):
+        from openr_tpu.decision.decision import Decision
+        from openr_tpu.messaging.queue import ReplicateQueue
+
+        return Decision(
+            "rp-node",
+            kvstore_updates_queue=ReplicateQueue(name="rp-kv"),
+            route_updates_queue=ReplicateQueue(name="rp-routes"),
+            enable_rib_policy=enable_rib_policy,
+        )
+
+    def test_empty_policy_rejected_inline(self):
+        d = self._decision(True)
+        d.start()
+        try:
+            with pytest.raises(ValueError):
+                d.set_rib_policy(RibPolicy([], ttl_secs=1))
+        finally:
+            d.stop()
+
+    def test_feature_knob_disables_set_and_get(self):
+        d = self._decision(False)
+        d.start()
+        try:
+            policy = RibPolicy(
+                [
+                    RibPolicyStatement(
+                        name="s",
+                        prefixes=(IpPrefix.from_str("fd00:2::/64"),),
+                        action=RibRouteAction(
+                            set_weight=RibRouteActionWeight(
+                                neighbor_to_weight={"2": 2}
+                            )
+                        ),
+                    )
+                ],
+                ttl_secs=1,
+            )
+            with pytest.raises(RuntimeError):
+                d.set_rib_policy(policy)
+            with pytest.raises(RuntimeError):
+                d.get_rib_policy()
+        finally:
+            d.stop()
