@@ -94,7 +94,8 @@ class DecisionConfig:
 
     debounce_min_ms: int = 10
     debounce_max_ms: int = 250
-    enable_bgp_route_programming: bool = False
+    # reference default: true (Flags.cpp:39)
+    enable_bgp_route_programming: bool = True
 
 
 @dataclass
@@ -169,7 +170,8 @@ class OpenrConfig:
     enable_kvstore_request_queue: bool = False
     enable_watchdog: bool = True
     enable_lfa: bool = False
-    enable_rib_policy: bool = True
+    # reference default: disabled (Flags.cpp enable_rib_policy)
+    enable_rib_policy: bool = False
     prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
         PrefixForwardingAlgorithm.SP_ECMP

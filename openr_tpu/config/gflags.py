@@ -41,7 +41,7 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "enable_netlink_fib_handler": (bool, False),
     "enable_ordered_fib_programming": (bool, False),
     "enable_lfa": (bool, False),
-    "enable_bgp_route_programming": (bool, False),
+    "enable_bgp_route_programming": (bool, True),
     "enable_rib_policy": (bool, False),  # reference default: disabled
     "enable_watchdog": (bool, True),
     "enable_flood_optimization": (bool, False),

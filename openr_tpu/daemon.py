@@ -48,7 +48,15 @@ class OpenrNode:
         use_rtt_metric: bool = False,
         config_store=None,
         solver_backend: str = "device",
+        # library-level default is permissive (matches Decision's ctor);
+        # the config-driven daemon passes the reference default (off)
         enable_rib_policy: bool = True,
+        enable_v4: bool = False,
+        enable_lfa: bool = False,
+        enable_ordered_fib: bool = False,
+        # reference default: true (Flags.cpp:39) — matches DecisionConfig
+        enable_bgp_route_programming: bool = True,
+        enable_best_route_selection: bool = True,
         debounce_min_s: float = 0.01,
         # reference default: 250ms ceiling (common/Flags.cpp
         # decision_debounce_max_ms); tests pass a smaller value
@@ -112,6 +120,14 @@ class OpenrNode:
             debounce_max_s=debounce_max_s,
             solver_backend=solver_backend,
             enable_rib_policy=enable_rib_policy,
+            enable_v4=enable_v4,
+            compute_lfa_paths=enable_lfa,
+            enable_ordered_fib=enable_ordered_fib,
+            # BGP routes are computed either way; programming them is
+            # gated (reference: enable_bgp_route_programming -> dryrun
+            # marks do_not_install)
+            bgp_dry_run=not enable_bgp_route_programming,
+            enable_best_route_selection=enable_best_route_selection,
         )
         self.fib_agent = fib_agent or MockFibAgent()
         self.fib = Fib(

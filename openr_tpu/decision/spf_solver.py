@@ -443,19 +443,20 @@ class _EllResidentCache:
         # ls -> (synced topology_version, EllState)
         self._cache = weakref.WeakKeyDictionary()
 
-    def state_for(self, ls: LinkState):
-        """Sync the resident device bands to ``ls`` and return the
-        EllState — incremental ``ell_patch`` scatter when the journal
-        covers the change, full ``compile_ell`` otherwise. Shared by the
-        view solve and the KSP2 masked batches (one resident copy of the
-        graph, however many consumers)."""
+    def _sync(self, ls: LinkState):
+        """Resolve the resident state for ``ls``: returns
+        ``(state, pending)`` where ``pending`` is a journaled patched
+        EllGraph whose rows are NOT yet applied to the resident bands
+        (None when the bands are current or were just fully compiled).
+        The cache version is committed by the caller once the pending
+        rows actually land (fused into a solve, or via apply_patch)."""
         from openr_tpu.ops import spf_sparse
 
         entry = self._cache.get(ls)
         if entry is not None:
             version, state = entry
             if version == ls.topology_version:
-                return state
+                return state, None
             affected = ls.affected_since(version)
             patched = (
                 spf_sparse.ell_patch(state.graph, ls, sorted(affected))
@@ -463,26 +464,38 @@ class _EllResidentCache:
                 else None
             )
             if patched is not None:
-                state.apply_patch(patched)
                 SPF_COUNTERS["decision.ell_patches"] += 1
-                self._cache[ls] = (ls.topology_version, state)
-                return state
+                return state, patched
         state = spf_sparse.EllState(spf_sparse.compile_ell(ls))
         SPF_COUNTERS["decision.ell_full_compiles"] += 1
         self._cache[ls] = (ls.topology_version, state)
+        return state, None
+
+    def state_for(self, ls: LinkState):
+        """Synced resident state for solve-free consumers (the KSP2
+        masked batches): pending rows are scattered WITHOUT a view
+        solve."""
+        state, pending = self._sync(ls)
+        if pending is not None:
+            state.apply_patch(pending)
+            self._cache[ls] = (ls.topology_version, state)
         return state
 
     def view_packed(
         self, ls: LinkState, root: str
     ) -> Tuple[object, List[int], np.ndarray]:
         """Sync the resident bands to ``ls`` and solve the batched
-        {root} + neighbors view. Returns (EllGraph, batch srcs, packed
-        [2B, n_pad] host array: B distance rows then B first-hop rows)."""
+        {root} + neighbors view — pending patch rows ride the FUSED
+        scatter+solve dispatch (EllState.reconverge). Returns (EllGraph,
+        batch srcs, packed [2B, n_pad] host array: B distance rows then
+        B first-hop rows)."""
         from openr_tpu.ops import spf_sparse
 
-        state = self.state_for(ls)
-        srcs = spf_sparse.ell_source_batch(state.graph, ls, root)
-        packed = np.asarray(state.reconverge(state.graph, srcs))
+        state, pending = self._sync(ls)
+        graph = pending if pending is not None else state.graph
+        srcs = spf_sparse.ell_source_batch(graph, ls, root)
+        packed = np.asarray(state.reconverge(graph, srcs))
+        self._cache[ls] = (ls.topology_version, state)
         return state.graph, srcs, packed
 
 

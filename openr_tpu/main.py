@@ -224,6 +224,13 @@ def main(argv=None) -> int:
         config_store=config_store,
         solver_backend=config.solver_backend,
         enable_rib_policy=config.enable_rib_policy,
+        enable_v4=config.enable_v4,
+        enable_lfa=config.enable_lfa,
+        enable_ordered_fib=config.enable_ordered_fib_programming,
+        enable_bgp_route_programming=(
+            config.decision.enable_bgp_route_programming
+        ),
+        enable_best_route_selection=config.enable_best_route_selection,
         debounce_min_s=config.decision.debounce_min_ms / 1000,
         debounce_max_s=config.decision.debounce_max_ms / 1000,
         enable_flood_optimization=config.kvstore.enable_flood_optimization,

@@ -308,3 +308,24 @@ def test_partial_flood_rate_rejected():
         ),
     )
     assert cfg.kvstore.flood_rate() == (100.0, 50)
+
+
+def test_daemon_wires_decision_feature_flags():
+    from openr_tpu.daemon import OpenrNode
+    from openr_tpu.spark.io_provider import MockIoProvider
+
+    node = OpenrNode(
+        "flags-node",
+        MockIoProvider(),
+        enable_v4=True,
+        enable_lfa=True,
+        enable_ordered_fib=True,
+        enable_bgp_route_programming=False,
+        enable_rib_policy=False,
+    )
+    solver = node.decision.spf_solver
+    assert solver.enable_v4
+    assert solver.compute_lfa_paths
+    assert solver.enable_ordered_fib
+    assert solver.bgp_dry_run  # programming disabled -> dry run
+    assert not node.decision._enable_rib_policy
