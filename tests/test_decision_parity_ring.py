@@ -1063,3 +1063,54 @@ class TestKsp2DevicePrefetch:
             "1", area_ls_h, ps_h
         )
         assert dev.to_route_db("1") == host.to_route_db("1")
+
+
+class TestGridShortestPath:
+    """reference: DecisionTest.cpp:4301 GridTopologyFixture
+    ShortestPathTest — Manhattan distances on unit-metric n x n grids."""
+
+    @staticmethod
+    def _grid_distance(a, b, n):
+        return abs(a % n - b % n) + abs(a // n - b // n)
+
+    @pytest.mark.parametrize("n", [2, 3, 5])
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_manhattan_distances(self, n, backend):
+        import random
+
+        from openr_tpu.models import topologies
+
+        topo = topologies.grid(n)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        ps = PrefixState()
+        for pdb in topo.prefix_dbs.values():
+            ps.update_prefix_database(pdb)
+        area_ls = {topo.area: ls}
+
+        def pfx(i):
+            return topo.prefix_dbs[f"node-{i}"].prefix_entries[0].prefix
+
+        rng = random.Random(n)
+        cases = [(0, n * n - 1), (n - 1, n * (n - 1))]
+        cases.append((0, rng.randrange(1, n * n)))
+        a = rng.randrange(n * n)
+        b = a
+        while b == a:
+            b = rng.randrange(n * n)
+        cases.append((a, b))
+        for src, dst in cases:
+            rdb = SpfSolver(
+                f"node-{src}", backend=backend
+            ).build_route_db(f"node-{src}", area_ls, ps)
+            entry = rdb.unicast_routes[pfx(dst)]
+            want = self._grid_distance(src, dst, n)
+            assert min(nh.metric for nh in entry.nexthops) == want, (
+                src, dst, n,
+            )
+        # reference count identity: per node, unicast routes == n^2 - 1
+        rdb = SpfSolver("node-0", backend=backend).build_route_db(
+            "node-0", area_ls, ps
+        )
+        assert len(rdb.unicast_routes) == n * n - 1
