@@ -55,6 +55,19 @@ def sub_prefix(seed: IpPrefix, alloc_len: int, index: int) -> IpPrefix:
     )
 
 
+def prefix_contains(outer: IpPrefix, inner: IpPrefix) -> bool:
+    """True when ``inner`` lies within ``outer``'s address space."""
+    if len(outer.prefix_address.addr) != len(inner.prefix_address.addr):
+        return False
+    if inner.prefix_length < outer.prefix_length:
+        return False
+    bits = outer.prefix_length
+    o = int.from_bytes(outer.prefix_address.addr, "big")
+    i = int.from_bytes(inner.prefix_address.addr, "big")
+    shift = 8 * len(outer.prefix_address.addr) - bits
+    return (o >> shift) == (i >> shift)
+
+
 def parse_alloc_params(text: str) -> AllocParams:
     """Parse ``"fc00:cafe::/56,64"`` (reference: PrefixAllocator.cpp
     parseParamsStr)."""
@@ -96,6 +109,9 @@ class PrefixAllocator:
         self._on_allocated = on_allocated
         self.allocated_prefix: Optional[IpPrefix] = None
         self._programmed_prefix: Optional[IpPrefix] = None
+        # every seed this allocator has worked under: the loopback sync
+        # treats addresses inside these spaces as ours to clean up
+        self._known_seeds: set = set()
         self._alloc_params: Optional[AllocParams] = None
         self._range_allocator: Optional[RangeAllocator] = None
         self._alloc_token: Optional[object] = None
@@ -166,6 +182,7 @@ class PrefixAllocator:
             return
 
         seed, alloc_len = new_params
+        self._known_seeds.add(seed)
         count = 1 << (alloc_len - seed.prefix_length)
         init_index = None
         if self._config_store is not None:
@@ -256,7 +273,9 @@ class PrefixAllocator:
     def _apply(self, prefix: IpPrefix) -> None:
         if prefix == self.allocated_prefix:
             return
-        self._withdraw()
+        # the loopback sweep happens once, in the sync below — not in
+        # the intermediate withdraw too
+        self._withdraw(sync_loopback=False)
         self.allocated_prefix = prefix
         self._prefix_manager.advertise_prefixes(
             [
@@ -269,31 +288,55 @@ class PrefixAllocator:
         if self._on_allocated is not None:
             self._on_allocated(prefix)
 
-    def _withdraw(self) -> None:
+    def _withdraw(self, sync_loopback: bool = True) -> None:
         had = self.allocated_prefix is not None
         if had:
             self._prefix_manager.withdraw_prefixes([self.allocated_prefix])
             self.allocated_prefix = None
-        self._sync_loopback_address(None)
+        if sync_loopback:
+            self._sync_loopback_address(None)
         if had and self._on_allocated is not None:
             self._on_allocated(None)
 
     def _sync_loopback_address(
         self, prefix: Optional[IpPrefix]
     ) -> None:
-        """Program the new prefix on the loopback and remove the stale
-        one (reference: applyMyPrefix/withdrawMyPrefix address sync)."""
+        """Program the new prefix on the loopback and remove stale ones
+        (reference: PrefixAllocator.cpp:780 syncIfaceAddrs — add the
+        desired set, delete everything else in scope). "In scope" here
+        means: the previously programmed address, plus any kernel
+        address that lies inside a seed prefix this allocator has been
+        configured with — so a restarted daemon cleans up a prior
+        incarnation's allocation without ever touching unrelated
+        addresses (::1, operator-configured loopbacks)."""
         if self._netlink is None or prefix == self._programmed_prefix:
             return
+        stale = set()
         if self._programmed_prefix is not None:
+            stale.add(self._programmed_prefix)
+        try:
+            existing = self._netlink.get_ifaddresses(self._loopback_if)
+        except Exception:
+            existing = []
+        for addr in existing:
+            for seed in self._known_seeds:
+                if prefix_contains(seed, addr) and addr != prefix:
+                    stale.add(addr)
+                    break
+        for addr in stale:
+            if addr == prefix:
+                continue
             try:
-                self._netlink.del_ifaddress(
-                    self._loopback_if, self._programmed_prefix
-                )
+                self._netlink.del_ifaddress(self._loopback_if, addr)
             except Exception:
                 pass
         self._programmed_prefix = None
         if prefix is not None:
+            if prefix in existing:
+                # already programmed (restart re-claiming the same
+                # index): adopt it — the Linux add would EEXIST
+                self._programmed_prefix = prefix
+                return
             try:
                 self._netlink.add_ifaddress(self._loopback_if, prefix)
                 self._programmed_prefix = prefix

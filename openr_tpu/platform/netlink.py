@@ -21,6 +21,10 @@ from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.types import IpPrefix, UnicastRoute
 
 
+class NetlinkError(OSError):
+    """Kernel (or mock) rejected a netlink operation; errno carried."""
+
+
 @dataclass
 class NlLink:
     """reference: fbnl::Link (openr/nl/NetlinkTypes.h)."""
@@ -66,6 +70,9 @@ class NetlinkProtocolSocket:
         raise NotImplementedError
 
     def del_ifaddress(self, if_name: str, prefix: IpPrefix) -> None:
+        raise NotImplementedError
+
+    def get_ifaddresses(self, if_name: str) -> List[IpPrefix]:
         raise NotImplementedError
 
 
@@ -143,3 +150,10 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
         self.events_queue.push(
             NetlinkEvent(event_type=NetlinkEventType.ADDRESS, link=link)
         )
+
+    def get_ifaddresses(self, if_name: str) -> List[IpPrefix]:
+        with self._lock:
+            link = self._links.get(if_name)
+            if link is None:
+                raise NetlinkError(19, f"no such link {if_name}")
+            return list(link.addresses)

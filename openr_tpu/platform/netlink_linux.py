@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.netlink import (
+    NetlinkError,
     NetlinkEvent,
     NetlinkEventType,
     NetlinkProtocolSocket,
@@ -110,10 +111,6 @@ def _parse_attrs(data: bytes) -> Dict[int, bytes]:
         out[attr_type] = data[off + _RTATTR.size : off + length]
         off += _align4(length)
     return out
-
-
-class NetlinkError(OSError):
-    pass
 
 
 class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
@@ -237,10 +234,10 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         )
 
     def link_index(self, if_name: str) -> Optional[int]:
-        for link in self.get_all_links():
-            if link.if_name == if_name:
-                return link.if_index
-        return None
+        # rides the cached link table (invalidated on local link
+        # mutations and subscribed link events) so the address APIs
+        # don't pay a full RTM_GETLINK dump per call
+        return self._link_table().get(if_name)
 
     def create_link(self, if_name: str, kind: str = "dummy") -> None:
         """RTM_NEWLINK with linkinfo kind (test/loopback use). Kernels
@@ -421,6 +418,37 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         IFA_LOCAL = 2
         body += _attr(IFA_LOCAL, prefix.prefix_address.addr)
         self._request(RTM_DELADDR, NLM_F_REQUEST | NLM_F_ACK, body)
+
+    def get_ifaddresses(self, if_name: str) -> List[IpPrefix]:
+        """RTM_GETADDR dump filtered to one interface (reference:
+        NetlinkProtocolSocket::getAllIfAddresses)."""
+        index = self.link_index(if_name)
+        if index is None:
+            raise NetlinkError(19, f"no such link {if_name}")
+        body = struct.pack("=BBBBi", socket.AF_UNSPEC, 0, 0, 0, 0)
+        IFA_ADDRESS, IFA_LOCAL = 1, 2
+        out: List[IpPrefix] = []
+        for mtype, payload in self._request(
+            RTM_GETADDR, NLM_F_REQUEST | NLM_F_DUMP, body
+        ):
+            if mtype != RTM_NEWADDR:
+                continue
+            _family, plen, _flags, _scope, ifindex = struct.unpack_from(
+                "=BBBBi", payload
+            )
+            if ifindex != index:
+                continue
+            attrs = _parse_attrs(payload[8:])
+            addr = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS)
+            if addr is None:
+                continue
+            out.append(
+                IpPrefix(
+                    prefix_address=BinaryAddress(addr=addr),
+                    prefix_length=plen,
+                )
+            )
+        return out
 
     # -- link event subscription -----------------------------------------
 

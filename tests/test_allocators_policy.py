@@ -810,3 +810,90 @@ class TestRibPolicyErrors:
                 d.get_rib_policy()
         finally:
             d.stop()
+
+
+class TestLoopbackAddressSyncDeep:
+    """reference: PrefixAllocator.cpp:780 syncIfaceAddrs — stale
+    in-seed addresses are cleaned up; unrelated addresses survive."""
+
+    def test_stale_in_seed_address_removed(self):
+        from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+        from openr_tpu.allocators.prefix_allocator import sub_prefix
+
+        net = AllocatorNet(["sync-a"])
+        try:
+            nl = MockNetlinkProtocolSocket()
+            seed = IpPrefix.from_str("fd00:3333::/60")
+            # a prior incarnation programmed slot 7; an operator address
+            # lives outside the seed
+            stale = sub_prefix(seed, 64, 7)
+            operator_addr = IpPrefix.from_str("fd00:beef::1/128")
+            nl.add_link("lo", is_up=True,
+                        addresses=(stale, operator_addr))
+            mgr = RecordingPrefixManager()
+            alloc = PrefixAllocator(
+                "sync-a",
+                net.evbs["sync-a"],
+                net.clients["sync-a"],
+                mgr,
+                seed_prefix=seed,
+                alloc_prefix_len=64,
+                netlink=nl,
+                loopback_if="lo",
+            )
+            assert wait_until(lambda: alloc.allocated_prefix is not None)
+            mine = alloc.allocated_prefix
+
+            def lo_addrs():
+                (link,) = nl.get_all_links()
+                return set(link.addresses)
+
+            # the stale in-seed address is gone, ours is present, and
+            # the unrelated operator address is untouched
+            assert wait_until(
+                lambda: lo_addrs() == {mine, operator_addr}
+            ), lo_addrs()
+            alloc.stop()
+        finally:
+            net.stop()
+
+    def test_restart_adopts_existing_address_and_can_remove_it(self):
+        # reference restart scenario: the kernel still holds the prior
+        # incarnation's address; re-claiming the same index must ADOPT
+        # it (the raw add would EEXIST) so a later withdraw removes it
+        from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+        from openr_tpu.allocators.prefix_allocator import sub_prefix
+
+        net = AllocatorNet(["adopt-a"])
+        try:
+            store = DictConfigStore()
+            seed = IpPrefix.from_str("fd00:4444::/60")
+            store.data["prefix-allocator-index"] = [seed.to_str(), 64, 5]
+            mine = sub_prefix(seed, 64, 5)
+            nl = MockNetlinkProtocolSocket()
+            nl.add_link("lo", is_up=True, addresses=(mine,))
+            mgr = RecordingPrefixManager()
+            alloc = PrefixAllocator(
+                "adopt-a",
+                net.evbs["adopt-a"],
+                net.clients["adopt-a"],
+                mgr,
+                seed_prefix=seed,
+                alloc_prefix_len=64,
+                netlink=nl,
+                loopback_if="lo",
+                config_store=store,
+            )
+            assert wait_until(lambda: alloc.allocated_prefix == mine)
+
+            def lo_addrs():
+                (link,) = nl.get_all_links()
+                return set(link.addresses)
+
+            assert wait_until(lambda: lo_addrs() == {mine})
+            # withdraw must remove the ADOPTED address
+            alloc.update_alloc_params(None)
+            assert wait_until(lambda: lo_addrs() == set()), lo_addrs()
+            alloc.stop()
+        finally:
+            net.stop()

@@ -240,3 +240,15 @@ class TestLinuxNetlink:
             assert dest not in [r.dest for r in nl.get_all_routes()]
         finally:
             fib.stop()
+
+
+class TestAddressDump:
+    def test_address_add_dump_delete(self, nl):
+        from openr_tpu.types import IpPrefix
+
+        target = IpPrefix.from_str("fd0a:7e57:addc::1/64")
+        nl.add_ifaddress(IFACE, target)
+        addrs = nl.get_ifaddresses(IFACE)
+        assert target in addrs, addrs
+        nl.del_ifaddress(IFACE, target)
+        assert target not in nl.get_ifaddresses(IFACE)
