@@ -1,0 +1,118 @@
+"""Automatic prefix allocation demo: four nodes elect unique /64s out
+of one seed prefix via RangeAllocator consensus over a shared KvStore
+mesh, program them on a (mock) loopback, and re-elect when the seed
+prefix changes — the openr-tpu analogue of the reference's
+enable_prefix_alloc deployment flow (openr/allocators/PrefixAllocator).
+
+Run:  python examples/prefix_alloc_demo.py
+"""
+
+from __future__ import annotations
+
+import time
+
+from openr_tpu.allocators.prefix_allocator import PrefixAllocator
+from openr_tpu.kvstore.client import KvStoreClient
+from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.types import IpPrefix
+from openr_tpu.utils.eventbase import OpenrEventBase
+
+NODES = [f"rack-{i}" for i in range(4)]
+
+
+class PrintingPrefixManager:
+    def __init__(self, node):
+        self.node = node
+
+    def advertise_prefixes(self, entries):
+        for e in entries:
+            print(f"  {self.node}: advertise {e.prefix.to_str()}")
+
+    def withdraw_prefixes(self, prefixes):
+        for p in prefixes:
+            print(f"  {self.node}: withdraw  {p.to_str()}")
+
+
+def main() -> None:
+    stores, evbs, allocs, netlinks = {}, {}, {}, {}
+    for n in NODES:
+        w = KvStoreWrapper(n)
+        w.start()
+        stores[n] = w
+        evb = OpenrEventBase(f"alloc:{n}")
+        evb.run_in_thread()
+        evbs[n] = evb
+    for i, a in enumerate(NODES):
+        for b in NODES[i + 1 :]:
+            link_bidirectional(stores[a], stores[b])
+
+    seed = IpPrefix.from_str("fc00:cafe::/62")  # exactly 4 slots: contention!
+    print(f"electing /64s from {seed.to_str()} ({len(NODES)} nodes, 4 slots)")
+    for n in NODES:
+        nl = MockNetlinkProtocolSocket()
+        nl.add_link("lo", is_up=True)
+        netlinks[n] = nl
+        allocs[n] = PrefixAllocator(
+            n,
+            evbs[n],
+            KvStoreClient(evbs[n], n, stores[n].store),
+            PrintingPrefixManager(n),
+            seed_prefix=seed,
+            alloc_prefix_len=64,
+            netlink=nl,
+            loopback_if="lo",
+        )
+
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        got = {n: a.allocated_prefix for n, a in allocs.items()}
+        if all(got.values()) and len(set(got.values())) == len(NODES):
+            break
+        time.sleep(0.05)
+
+    got = {n: a.allocated_prefix for n, a in allocs.items()}
+    if not all(got.values()):
+        raise SystemExit(
+            f"did not converge within deadline: {got}"
+        )
+    print("\nconverged allocations:")
+    for n in NODES:
+        (link,) = netlinks[n].get_all_links()
+        addrs = ", ".join(p.to_str() for p in link.addresses)
+        print(f"  {n}: {allocs[n].allocated_prefix.to_str()}  (lo: {addrs})")
+    assert len({a.allocated_prefix for a in allocs.values()}) == len(NODES)
+
+    print("\nseed change -> re-election under fc00:beef::/62")
+    for a in allocs.values():
+        a.update_alloc_params(IpPrefix.from_str("fc00:beef::/62"), 64)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        got = {n: a.allocated_prefix for n, a in allocs.items()}
+        if (
+            all(got.values())
+            and len(set(got.values())) == len(NODES)
+            and all(p.to_str().startswith("fc00:beef") for p in got.values())
+        ):
+            break
+        time.sleep(0.05)
+    got = {n: a.allocated_prefix for n, a in allocs.items()}
+    if not all(got.values()) or not all(
+        p.to_str().startswith("fc00:beef") for p in got.values()
+    ):
+        raise SystemExit(f"re-election did not converge: {got}")
+    for n in NODES:
+        print(f"  {n}: {allocs[n].allocated_prefix.to_str()}")
+
+    for a in allocs.values():
+        a.stop()
+    for evb in evbs.values():
+        evb.stop()
+        evb.join()
+    for w in stores.values():
+        w.stop()
+    print("\nok")
+
+
+if __name__ == "__main__":
+    main()
