@@ -45,3 +45,33 @@ class TestBenchmarkHarnesses:
         out = json.loads(capsys.readouterr().out.strip())
         assert out["oracle_spot_check"] == "passed"
         assert out["edges"] > 0
+
+    def test_decision_ksp2_case(self, capsys):
+        from openr_tpu.types.lsdb import (
+            PrefixForwardingAlgorithm,
+            PrefixForwardingType,
+        )
+
+        topo = topologies.grid(3)
+        bench_decision.run_case(
+            "smoke_ksp2", topo, "node-0", "node-1", "host",
+            forwarding=(
+                PrefixForwardingType.SR_MPLS,
+                PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            ),
+            iters=1,
+        )
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["unicast_routes"] == 8
+        assert out["churn_rebuild_ms"] > 0
+
+    def test_scale_churn(self, capsys):
+        from benchmarks import bench_scale
+
+        bench_scale.main(
+            ["--churn", "--nodes", "100", "--churn-events", "2"]
+        )
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["bench"].startswith("scale.ell_churn")
+        assert out["oracle_spot_check"] == "passed"
+        assert "device_only_ms" in out
