@@ -172,6 +172,10 @@ class OpenrConfig:
     enable_lfa: bool = False
     # reference default: disabled (Flags.cpp enable_rib_policy)
     enable_rib_policy: bool = False
+    # SR node-label election via per-area RangeAllocator when no static
+    # node_label is configured (reference: Flags.cpp
+    # enable_segment_routing + LinkMonitor.cpp:171)
+    enable_segment_routing: bool = False
     prefix_forwarding_type: PrefixForwardingType = PrefixForwardingType.IP
     prefix_forwarding_algorithm: PrefixForwardingAlgorithm = (
         PrefixForwardingAlgorithm.SP_ECMP

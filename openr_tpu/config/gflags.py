@@ -43,6 +43,7 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "enable_lfa": (bool, False),
     "enable_bgp_route_programming": (bool, True),
     "enable_rib_policy": (bool, False),  # reference default: disabled
+    "enable_segment_routing": (bool, False),
     "enable_watchdog": (bool, True),
     "enable_flood_optimization": (bool, False),
     "is_flood_root": (bool, False),
@@ -174,6 +175,7 @@ def config_from_gflags(result: GflagResult) -> OpenrConfig:
         ],
         "enable_lfa": f["enable_lfa"],
         "enable_rib_policy": f["enable_rib_policy"],
+        "enable_segment_routing": f["enable_segment_routing"],
         "enable_watchdog": f["enable_watchdog"],
         "prefix_forwarding_type": (
             "SR_MPLS" if f["prefix_fwd_type_mpls"] else "IP"

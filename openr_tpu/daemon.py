@@ -57,6 +57,8 @@ class OpenrNode:
         # reference default: true (Flags.cpp:39) — matches DecisionConfig
         enable_bgp_route_programming: bool = True,
         enable_best_route_selection: bool = True,
+        enable_segment_routing: bool = False,
+        node_label: int = 0,
         debounce_min_s: float = 0.01,
         # reference default: 250ms ceiling (common/Flags.cpp
         # decision_debounce_max_ms); tests pass a smaller value
@@ -158,6 +160,8 @@ class OpenrNode:
             config_store=config_store,
             area=area,
             areas=self.areas,
+            node_label=node_label,
+            enable_segment_routing=enable_segment_routing,
             use_rtt_metric=use_rtt_metric,
         )
         self.prefix_manager = PrefixManager(

@@ -53,6 +53,12 @@ class KvStoreClient:
             ttl_refresh_interval_s, self._refresh_ttls, jitter_first=True
         )
 
+    @property
+    def evb(self) -> OpenrEventBase:
+        """The event base publications are delivered on — consensus users
+        (RangeAllocator) must run their FSM on this same thread."""
+        return self._evb
+
     def stop(self) -> None:
         self._refresh_timer.cancel()
 

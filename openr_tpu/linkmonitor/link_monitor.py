@@ -35,6 +35,7 @@ from openr_tpu.types.spark import (
     SparkNeighborEvent,
     SparkNeighborEventType,
 )
+from openr_tpu.allocators.range_allocator import RangeAllocator
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
 from openr_tpu.utils.eventbase import (
@@ -46,6 +47,13 @@ from openr_tpu.utils.eventbase import (
 # persisted drain-state key in the config store
 # (reference: LinkMonitor persists thrift::LinkMonitorState)
 LINK_MONITOR_STATE_KEY = "link-monitor-config"
+
+# SR global label block node labels are elected from
+# (reference: Constants.h:59 kSrGlobalRange)
+SR_GLOBAL_RANGE = (101, 49999)
+# claim-key marker (reference: Constants.h:205 kNodeLabelRangePrefix)
+NODE_LABEL_MARKER = "nodeLabel:"
+NODE_LABELS_PERSIST_KEY = "link-monitor-node-labels"
 
 
 @dataclass
@@ -75,6 +83,7 @@ class LinkMonitor:
         area: str = "0",
         areas: Optional[List[str]] = None,
         node_label: int = 0,
+        enable_segment_routing: bool = False,
         use_rtt_metric: bool = False,
         flap_initial_backoff_s: float = 0.05,
         flap_max_backoff_s: float = 2.0,
@@ -118,6 +127,38 @@ class LinkMonitor:
         self._advertise_adj_throttled = AsyncThrottle(
             self.evb, advertise_throttle_s, self._advertise_adjacencies
         )
+
+        # SR node-label election: one RangeAllocator per area over the
+        # global SR block, consensus via the KvStore merge ordering
+        # (reference: LinkMonitor.cpp:171-205 — per-area
+        # RangeAllocator<int32_t> over kSrGlobalRange, elected label
+        # re-advertised and persisted). A non-zero static node_label
+        # short-circuits election, like the reference's static config.
+        self._node_labels: Dict[str, int] = {}
+        self._label_allocators: Dict[str, RangeAllocator] = {}
+        if (
+            enable_segment_routing
+            and node_label == 0
+            and kvstore_client is not None
+        ):
+            persisted: Dict[str, int] = {}
+            if config_store is not None:
+                persisted = config_store.load(NODE_LABELS_PERSIST_KEY) or {}
+            # the allocator FSM must live on the SAME event base the
+            # KvStore client delivers publications on
+            alloc_evb = kvstore_client.evb
+            for lm_area in self.areas:
+                alloc = RangeAllocator(
+                    alloc_evb,
+                    kvstore_client,
+                    my_node_name,
+                    NODE_LABEL_MARKER,
+                    SR_GLOBAL_RANGE,
+                    lambda label, a=lm_area: self._on_node_label(a, label),
+                    area=lm_area,
+                )
+                self._label_allocators[lm_area] = alloc
+                alloc.start_allocator(init_value=persisted.get(lm_area))
         self._advertise_ifaces_throttled = AsyncThrottle(
             self.evb, advertise_throttle_s, self._advertise_interfaces
         )
@@ -140,8 +181,33 @@ class LinkMonitor:
             self.evb.run_in_event_base(self._sync_interfaces)
 
     def stop(self) -> None:
+        for alloc in self._label_allocators.values():
+            alloc.stop()
         self.evb.stop()
         self.evb.join()
+
+    # -- SR node-label election ------------------------------------------
+
+    def _on_node_label(self, area: str, label: Optional[int]) -> None:
+        """Elected (or lost) a node label for one area: record, persist,
+        re-advertise (reference: LinkMonitor.cpp:180-186 callback).
+        Fires on the allocator's event base — marshal onto ours."""
+
+        def apply() -> None:
+            if label is None:
+                self._node_labels.pop(area, None)
+            else:
+                self._node_labels[area] = label
+            if self._config_store is not None:
+                self._config_store.store(
+                    NODE_LABELS_PERSIST_KEY, dict(self._node_labels)
+                )
+            self._advertise_adj_throttled()
+
+        self.evb.run_immediately_or_in_event_base(apply)
+
+    def node_label_for(self, area: str) -> int:
+        return self._node_labels.get(area, self.node_label)
 
     # -- persisted drain state -------------------------------------------
 
@@ -304,12 +370,13 @@ class LinkMonitor:
                     weight=adj.weight,
                 )
             )
+        resolved_area = area if area is not None else self.area
         return AdjacencyDatabase(
             this_node_name=self.my_node_name,
             is_overloaded=self.is_overloaded,
             adjacencies=tuple(adjacencies),
-            node_label=self.node_label,
-            area=area if area is not None else self.area,
+            node_label=self.node_label_for(resolved_area),
+            area=resolved_area,
         )
 
     def _advertise_adjacencies(self) -> None:

@@ -231,6 +231,8 @@ def main(argv=None) -> int:
             config.decision.enable_bgp_route_programming
         ),
         enable_best_route_selection=config.enable_best_route_selection,
+        enable_segment_routing=config.enable_segment_routing,
+        node_label=config.node_label,
         debounce_min_s=config.decision.debounce_min_ms / 1000,
         debounce_max_s=config.decision.debounce_max_ms / 1000,
         enable_flood_optimization=config.kvstore.enable_flood_optimization,

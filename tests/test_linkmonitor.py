@@ -243,3 +243,87 @@ class TestMultiArea:
             assert db1.adjacencies[0].other_node_name == "c"
         finally:
             h.stop()
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestNodeLabelElection:
+    """reference: LinkMonitor.cpp:171-205 — per-area SR node-label
+    election over kSrGlobalRange via RangeAllocator."""
+
+    def test_unique_labels_elected_and_advertised(self):
+        from openr_tpu.linkmonitor.link_monitor import SR_GLOBAL_RANGE
+
+        a = Harness(enable_segment_routing=True)
+        # second node sharing the same KvStore graph via TCP-less
+        # in-process peering is overkill here: share ONE store
+        b_evb = OpenrEventBase(name="lm-test-client-b")
+        b_evb.run_in_thread()
+        b_client = KvStoreClient(b_evb, "node-b", a.kvstore)
+        b_neighbor_q = ReplicateQueue(name="lmb:neighborUpdates")
+        b_interface_q = ReplicateQueue(name="lmb:interfaceUpdates")
+        b = LinkMonitor(
+            "node-b",
+            neighbor_updates_queue=b_neighbor_q,
+            interface_updates_queue=b_interface_q,
+            kvstore_client=b_client,
+            kvstore=a.kvstore,
+            enable_segment_routing=True,
+        )
+        b.start()
+        try:
+            assert wait_until(
+                lambda: a.lm.node_label_for("0") != 0
+                and b.node_label_for("0") != 0
+            )
+            la, lb = a.lm.node_label_for("0"), b.node_label_for("0")
+            assert la != lb
+            for label in (la, lb):
+                assert SR_GLOBAL_RANGE[0] <= label <= SR_GLOBAL_RANGE[1]
+            # the elected label rides the advertised AdjacencyDatabase
+            assert a.lm._build_adj_db("0").node_label == la
+        finally:
+            b.stop()
+            b_evb.stop()
+            b_evb.join()
+            a.stop()
+
+    def test_static_label_skips_election(self):
+        h = Harness(enable_segment_routing=True, node_label=777)
+        try:
+            time.sleep(0.3)
+            assert h.lm.node_label_for("0") == 777
+            assert not h.lm._label_allocators
+        finally:
+            h.stop()
+
+    def test_persisted_label_reclaimed(self):
+        class DictStore:
+            def __init__(self):
+                self.data = {}
+
+            def store(self, key, obj):
+                self.data[key] = obj
+
+            def load(self, key, cls=None):
+                return self.data.get(key)
+
+        store = DictStore()
+        h = Harness(enable_segment_routing=True, config_store=store)
+        try:
+            assert wait_until(lambda: h.lm.node_label_for("0") != 0)
+            first = h.lm.node_label_for("0")
+        finally:
+            h.stop()
+        h2 = Harness(enable_segment_routing=True, config_store=store)
+        try:
+            assert wait_until(lambda: h2.lm.node_label_for("0") == first)
+        finally:
+            h2.stop()
