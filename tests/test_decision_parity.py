@@ -883,3 +883,81 @@ class TestDecisionPendingUpdates:
         names = [e.event_descr for e in events.events]
         assert "FIRST" in names  # oldest chain won
         assert p.move_out_events() is None
+
+
+class TestMultiAreaBestPath:
+    """reference: DecisionTest.cpp:4930 MultiAreaBestPathCalculation —
+    node 1 and node 4 straddle areas A and B; routes resolve per area,
+    and a prefix reachable through both areas at equal cost ECMPs across
+    the area boundary."""
+
+    @pytest.mark.parametrize("backend", ["host", "device"])
+    def test_cross_area_ecmp(self, backend):
+        ls_a = LinkState(area="A")
+        ls_a.update_adjacency_database(
+            db("1", [adj("2", "if_12", "if_21", metric=10)], area="A")
+        )
+        ls_a.update_adjacency_database(
+            db(
+                "2",
+                [
+                    adj("1", "if_21", "if_12", metric=10),
+                    adj("4", "if_24", "if_42", metric=10),
+                ],
+                area="A",
+            )
+        )
+        ls_a.update_adjacency_database(
+            db("4", [adj("2", "if_42", "if_24", metric=10)], area="A")
+        )
+        ls_b = LinkState(area="B")
+        ls_b.update_adjacency_database(
+            db("1", [adj("3", "if_13", "if_31", metric=10)], area="B")
+        )
+        ls_b.update_adjacency_database(
+            db(
+                "3",
+                [
+                    adj("1", "if_31", "if_13", metric=10),
+                    adj("4", "if_34", "if_43", metric=10),
+                ],
+                area="B",
+            )
+        )
+        ls_b.update_adjacency_database(
+            db("4", [adj("3", "if_43", "if_34", metric=10)], area="B")
+        )
+        ps = PrefixState()
+        ps.update_prefix_database(prefix_db("1", ["fd00:1::/64"], area="A"))
+        ps.update_prefix_database(prefix_db("2", ["fd00:2::/64"], area="A"))
+        ps.update_prefix_database(prefix_db("3", ["fd00:3::/64"], area="B"))
+        ps.update_prefix_database(prefix_db("4", ["fd00:4::/64"], area="B"))
+        area_ls = {"A": ls_a, "B": ls_b}
+
+        def hops(node, pfx):
+            rdb = SpfSolver(node, backend=backend).build_route_db(
+                node, area_ls, ps
+            )
+            entry = rdb.unicast_routes.get(IpPrefix.from_str(pfx))
+            if entry is None:
+                return None
+            return {
+                (nh.neighbor_node_name, nh.metric, nh.area)
+                for nh in entry.nexthops
+            }
+
+        # node 1: addr2 via area A, addr3 via area B, addr4 (originated
+        # only into B) ECMP across BOTH areas at cost 20
+        assert hops("1", "fd00:2::/64") == {("2", 10, "A")}
+        assert hops("1", "fd00:3::/64") == {("3", 10, "B")}
+        assert hops("1", "fd00:4::/64") == {
+            ("2", 20, "A"),
+            ("3", 20, "B"),
+        }
+        # node 2 only participates in A: sees addr1 (and addr4 via the
+        # area-A path through 4's area-A membership)
+        assert hops("2", "fd00:1::/64") == {("1", 10, "A")}
+        assert hops("2", "fd00:3::/64") is None
+        # node 3 only in B
+        assert hops("3", "fd00:4::/64") == {("4", 10, "B")}
+        assert hops("3", "fd00:2::/64") is None
