@@ -94,6 +94,49 @@ class Breeze:
                 )
             )
 
+    def decision_rib_policy(self) -> None:
+        """reference: breeze decision rib-policy (show the installed
+        TTL'd policy)."""
+        policy = self.client.call("get_rib_policy")
+        if policy is None:
+            self._print("no rib policy installed")
+            return
+        self._print(
+            caption(
+                f"RibPolicy (ttl remaining: "
+                f"{policy.get('ttl_remaining_s', 0):.1f}s)"
+            )
+        )
+
+        def fmt_action(action):
+            w = (action or {}).get("set_weight")
+            if not w:
+                return "-"
+            parts = [f"default={w.get('default_weight', 0)}"]
+            parts += [
+                f"area {a}={v}"
+                for a, v in sorted(w.get("area_to_weight", {}).items())
+            ]
+            parts += [
+                f"nbr {n}={v}"
+                for n, v in sorted(
+                    w.get("neighbor_to_weight", {}).items()
+                )
+            ]
+            return ", ".join(parts)
+
+        rows = [
+            (
+                s.get("name", ""),
+                ", ".join(s.get("prefixes", [])),
+                fmt_action(s.get("action")),
+            )
+            for s in policy.get("statements", [])
+        ]
+        self._print(
+            render_table(["Statement", "Prefixes", "SetWeight"], rows)
+        )
+
     def decision_prefixes(self) -> None:
         dbs = self.client.call("get_decision_prefix_dbs")
         rows = []
@@ -409,6 +452,7 @@ def build_parser() -> argparse.ArgumentParser:
     routes.add_argument("--node", default=None)
     d.add_parser("adj")
     d.add_parser("prefixes")
+    d.add_parser("rib-policy")
 
     f = group("fib")
     f.add_parser("routes")
@@ -495,6 +539,7 @@ def run(argv: List[str], client=None, out=None) -> int:
         "decision.routes": lambda: breeze.decision_routes(args.node),
         "decision.adj": breeze.decision_adj,
         "decision.prefixes": breeze.decision_prefixes,
+        "decision.rib_policy": breeze.decision_rib_policy,
         "fib.routes": breeze.fib_routes,
         "fib.counters": breeze.fib_counters,
         "kvstore.keys": lambda: breeze.kvstore_keys(args.prefix, args.area),

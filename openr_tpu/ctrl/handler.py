@@ -340,12 +340,25 @@ class OpenrCtrlHandler:
         policy = self._decision.get_rib_policy()
         if policy is None:
             return None
+        def action_dict(action):
+            w = action.set_weight
+            if w is None:
+                return {}
+            return {
+                "set_weight": {
+                    "default_weight": w.default_weight,
+                    "area_to_weight": dict(w.area_to_weight),
+                    "neighbor_to_weight": dict(w.neighbor_to_weight),
+                }
+            }
+
         return {
             "ttl_remaining_s": policy.get_ttl_remaining_s(),
             "statements": [
                 {
                     "name": s.name,
                     "prefixes": [p.to_str() for p in s.prefixes],
+                    "action": action_dict(s.action),
                 }
                 for s in policy.statements
             ],

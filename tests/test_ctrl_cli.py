@@ -374,3 +374,40 @@ class TestBreezeCli:
         )
         pub = reader.get(timeout=5.0)
         assert set(pub.key_vals) == {"special:1"}
+
+
+class TestRibPolicyCli:
+    def test_breeze_decision_rib_policy(self, network):
+        from openr_tpu.decision.rib_policy import (
+            RibPolicy,
+            RibPolicyStatement,
+            RibRouteAction,
+            RibRouteActionWeight,
+        )
+        from openr_tpu.types import IpPrefix
+
+        nodes, port = network
+        node = nodes["alpha"]
+        out = breeze(port, "decision", "rib-policy")
+        assert "no rib policy installed" in out
+
+        node.decision.set_rib_policy(
+            RibPolicy(
+                [
+                    RibPolicyStatement(
+                        name="weight-b",
+                        prefixes=(IpPrefix.from_str("fd00:b::/64"),),
+                        action=RibRouteAction(
+                            set_weight=RibRouteActionWeight(
+                                neighbor_to_weight={"b": 3}
+                            )
+                        ),
+                    )
+                ],
+                ttl_secs=120,
+            )
+        )
+        out = breeze(port, "decision", "rib-policy")
+        assert "weight-b" in out
+        assert "fd00:b::/64" in out
+        assert "nbr b=3" in out  # the action must be visible
