@@ -24,6 +24,10 @@ from openr_tpu.utils.eventbase import OpenrEventBase
 # (reference: Constants.h:195 kRangeAllocTtl = 5min).
 RANGE_ALLOC_TTL_MS = 300_000
 
+# a released claim's tombstone ages out fast so the value frees up in
+# seconds, not kRangeAllocTtl
+RELEASE_TOMBSTONE_TTL_MS = 1_000
+
 
 class RangeAllocator:
     def __init__(
@@ -73,9 +77,13 @@ class RangeAllocator:
         )
 
     def stop(self) -> None:
-        """Stop claiming: unsubscribe and let the TTL'd claim age out
-        (reference: RangeAllocator-inl.h:75-86 stop —
-        unsubscribeKey + unsetKey)."""
+        """Stop claiming: unsubscribe and best-effort release the claim
+        so other nodes can re-elect the value immediately instead of
+        waiting out RANGE_ALLOC_TTL_MS (reference:
+        RangeAllocator-inl.h:75-86 stop — unsubscribeKey + unsetKey).
+        Release = flood a short-TTL empty tombstone at a bumped
+        version; _try_claim recognizes empty values as free. TTL expiry
+        remains the fallback if the tombstone is lost."""
         self._stopped = True
         if self._refresh_timer is not None:
             self._refresh_timer.cancel()
@@ -85,6 +93,43 @@ class RangeAllocator:
         )
         if unsubscribe is not None:
             unsubscribe(self._on_publication)
+        # release on the EVENT BASE thread: the claim FSM (_try_claim's
+        # get/set) runs there, so scheduling the release serializes it
+        # after any in-flight claim write — otherwise a claim landing
+        # just after a caller-thread release check would stay locked for
+        # the full TTL. _my_value is read inside the closure, on the evb,
+        # so an in-flight _try_claim's freshly-claimed value is seen.
+        self._evb.run_immediately_or_in_event_base(self._release_claim)
+
+    def _release_claim(self) -> None:
+        value = self._my_value  # evb thread: serialized after claim FSM
+        clear = getattr(self._client, "clear_key", None)
+        if value is None or clear is None:
+            return
+        try:
+            # only release a claim the LOCAL store says is ours — a
+            # peer may have just won the tie-break. A winning claim
+            # still in flight from another node can slip this check
+            # (eventually-consistent store); the cost is one bounded
+            # re-election flap on that node, traded against freeing
+            # the value ~300x faster than TTL ageout on every clean
+            # shutdown.
+            stored = self._client.get_key(
+                self._area, self._key_for(value)
+            )
+            if (
+                stored is not None
+                and stored.value == self._node.encode()
+                and stored.originator_id == self._node
+            ):
+                clear(
+                    self._area,
+                    self._key_for(value),
+                    b"",
+                    ttl=RELEASE_TOMBSTONE_TTL_MS,
+                )
+        except Exception:
+            pass  # best-effort; TTL expiry is the fallback
 
     def get_value(self) -> Optional[int]:
         return self._my_value if self._allocated else None
@@ -110,8 +155,14 @@ class RangeAllocator:
         if self._stopped:
             return
         existing = self._client.get_key(self._area, self._key_for(value))
+        # an empty value is a release tombstone (stop() above): the
+        # value is free — claim PAST the tombstone's version
+        tombstone = (
+            existing is not None and existing.value == b""
+        )
         foreign = (
             existing is not None
+            and not tombstone
             and existing.value is not None
             and existing.value != self._node.encode()
         )
@@ -122,9 +173,12 @@ class RangeAllocator:
         self._allocated = False
         # claim at the SAME version as a foreign owner: the merge ordering
         # breaks the tie by originator id, deterministically, on every
-        # store in the network. Fresh keys start at version 1.
+        # store in the network. Fresh keys start at version 1; a release
+        # tombstone is outbid at version+1.
         version = existing.version if foreign else (
-            1 if existing is None else existing.version
+            1 if existing is None
+            else existing.version + 1 if tombstone
+            else existing.version
         )
         self._client.set_key(
             self._area,
@@ -193,8 +247,11 @@ class RangeAllocator:
             or key != self._key_for(self._my_value)
         ):
             return
-        if value is None:
-            # true expiry (pub.expired_keys): re-claim the same value
+        if value is None or value.value == b"":
+            # true expiry (pub.expired_keys) or a peer's release
+            # tombstone: the value is FREE — re-claim the same value
+            # (moving to a different one would churn allocations, e.g.
+            # a network-wide SR label change, for no reason)
             claimed = self._my_value
             self._evb.run_immediately_or_in_event_base(
                 lambda: self._try_claim(claimed)

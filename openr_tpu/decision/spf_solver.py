@@ -186,8 +186,10 @@ KSP2_DEVICE_MASK_BUDGET = 32_000_000
 
 
 def _ksp2_chunk(graph) -> int:
+    # grow from 1 so the budget holds even when a single chunk of 32
+    # bool masks would already exceed it at extreme ELL slot counts
     slots = sum(band.rows * band.k for band in graph.bands)
-    chunk = 32
+    chunk = 1
     while (
         chunk < 1024
         and chunk * 2 * max(1, slots) <= KSP2_DEVICE_MASK_BUDGET

@@ -37,7 +37,7 @@ plus the O(E) edge list — well inside HBM.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _replace
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -670,8 +670,6 @@ class EllState:
             )
         self.src = tuple(new_src)
         self.w = tuple(new_w)
-        from dataclasses import replace as _replace
-
         # rows are applied: clear the journal so a later reconverge
         # doesn't scatter them again
         self.graph = _replace(patched, changed=None)
@@ -702,7 +700,9 @@ class EllState:
             jnp.asarray(patched.overloaded), srcs_dev, w_sv,
             patched.bands, patched.n_pad,
         )
-        self.graph = patched
+        # rows are applied: clear the journal (mirrors apply_patch) so a
+        # later same-version dispatch doesn't re-scatter stale rows
+        self.graph = _replace(patched, changed=None)
         return packed
 
 

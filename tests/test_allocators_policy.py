@@ -609,6 +609,51 @@ class TestAllocatorLifecycleRegressions:
         finally:
             net.stop()
 
+    def test_stop_releases_claim_for_immediate_reelection(self):
+        """stop() floods a short-TTL empty tombstone so another node can
+        re-elect the value right away instead of waiting out the 5-min
+        claim TTL (reference RangeAllocator-inl.h stop -> unsetKey)."""
+        from openr_tpu.allocators.range_allocator import (
+            RELEASE_TOMBSTONE_TTL_MS,
+        )
+
+        net = AllocatorNet(["rel-a", "rel-b"])
+        try:
+            got_a = []
+            ra = RangeAllocator(
+                net.evbs["rel-a"],
+                net.clients["rel-a"],
+                "rel-a",
+                "rel:",
+                (7, 7),  # single-value range: contention is guaranteed
+                got_a.append,
+            )
+            ra.start_allocator()
+            assert wait_until(lambda: got_a and got_a[-1] == 7)
+            ra.stop()
+            # the release is serialized onto the event base
+            assert wait_until(
+                lambda: (
+                    net.clients["rel-a"].get_key("0", "rel:7").value == b""
+                )
+            )
+            stored = net.clients["rel-a"].get_key("0", "rel:7")
+            assert stored.ttl == RELEASE_TOMBSTONE_TTL_MS
+            got_b = []
+            rb = RangeAllocator(
+                net.evbs["rel-b"],
+                net.clients["rel-b"],
+                "rel-b",
+                "rel:",
+                (7, 7),
+                got_b.append,
+            )
+            rb.start_allocator()
+            assert wait_until(lambda: got_b and got_b[-1] == 7)
+            rb.stop()
+        finally:
+            net.stop()
+
     def test_stop_unsubscribes_filter_callback(self):
         net = AllocatorNet(["unsub-n"])
         try:
