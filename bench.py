@@ -23,12 +23,22 @@ timing K data-dependent chained dispatches against one (the fixed
 relay/transport cost cancels in the difference).
 
 Resilience: the TPU is reached through a relay that has been observed to
-(a) fail backend init outright and (b) HANG indefinitely on the first
-device op or even on jax.devices(). The top-level process therefore never
-imports jax: it probes the backend in a subprocess with a hard timeout,
-runs the benchmark in a TPU child if the probe passes, and degrades to a
-CPU-pinned child otherwise — so a JSON line (with an "error"/"fallback"
-field when degraded) is emitted no matter what the relay does.
+(a) fail backend init outright, (b) HANG indefinitely on the first
+device op or even on jax.devices(), and (c) recover later the same day.
+The top-level process therefore never imports jax: it probes the backend
+in a subprocess under a hard timeout, RETRYING with escalating timeouts
+across the bench budget (the relay has recovered mid-round before); runs
+the benchmark in a TPU child if any probe passes; re-probes and retries
+once if the TPU child dies mid-run; and degrades to a CPU-pinned child
+otherwise — so a JSON line (with "probe_attempts" + "fallback" evidence
+when degraded) is emitted no matter what the relay does.
+
+Secondary legs folded into the same artifact:
+- "bench_10k_churn": the 10k-node resident-ELL churn reconvergence
+  (BASELINE.json config 4 axis), via benchmarks.bench_scale.churn_bench.
+- "minplus_ms": pallas-vs-jnp min-plus timing at the bench shape on real
+  TPU; the main loop runs whichever measured faster (the losing number
+  is kept in the artifact).
 """
 
 from __future__ import annotations
@@ -42,11 +52,21 @@ import time
 import traceback
 
 BASELINE_MS = 100.0  # reference convergence design goal
+NORTHSTAR_MS = 10.0  # this repo's own target (BASELINE.json)
 # error-path fallback only; successful runs name the real node count
 METRIC_NAME = "spf_reconvergence_ms_fattree_1008"
-PROBE_TIMEOUT_S = 60
+# escalating probe schedule, spread across the bench budget: the relay
+# has hung for >115s and recovered within the same round before
+PROBE_TIMEOUTS_S = (60, 90, 120, 120)
+PROBE_BUDGET_S = 320  # stop probing once this much wall time is spent
+RETRY_PROBE_TIMEOUT_S = 120
 TPU_CHILD_TIMEOUT_S = 270
+TPU_CHILD_10K_TIMEOUT_S = 540
 CPU_CHILD_TIMEOUT_S = 150
+CPU_CHILD_10K_TIMEOUT_S = 420
+# soft wall-clock budget: optional legs (TPU retry, 10k CPU leg) are
+# skipped once exceeded so a worst-case run still emits JSON promptly
+BENCH_SOFT_BUDGET_S = 900
 
 
 def _run() -> dict:
@@ -158,49 +178,30 @@ def _run() -> dict:
                 return False
         return True
 
-    # warm-up (jit compile + first snapshot). Probe the pallas min-plus
-    # kernel; fall back to the fused-jnp formulation on any failure —
-    # including a silent miscompile caught by the oracle gate.
-    try:
-        spf_ops.set_minplus_impl("pallas")
-        d_host, fh_host = reconverge()
-        if not oracle_gate(d_host, fh_host):
-            raise RuntimeError("pallas min-plus failed the oracle gate")
-    except Exception:
-        spf_ops.set_minplus_impl("jnp")
-        snapshots.invalidate()  # rebuild resident state from scratch
-        d_host, fh_host = reconverge()
-        assert oracle_gate(d_host, fh_host), "device SPF failed oracle gate"
+    # warm-up (jit compile + first snapshot) on the always-available jnp
+    # formulation, oracle-gated
+    spf_ops.set_minplus_impl("jnp")
+    d_host, fh_host = reconverge()
+    assert oracle_gate(d_host, fh_host), "device SPF failed oracle gate"
 
     # one churn+reconverge outside the timed loop: the first patched
     # snapshot compiles the fused scatter+SPF program (one-time cost)
     churn(99)
     reconverge()
 
-    samples = []
-    for step in range(10):
-        churn(step)
-        t0 = time.perf_counter()
-        reconverge()
-        samples.append((time.perf_counter() - t0) * 1000.0)
-    value = statistics.median(samples)
+    # Device-only compute time for the CURRENT min-plus impl. A single
+    # e2e sample is dominated by the relay transport (~fixed per
+    # readback); chain K data-dependent dispatches (metric feeds back
+    # into the next step) with ONE readback at the end, subtract the
+    # 1-dispatch+readback time, and the fixed transport cost cancels:
+    # per-dispatch device time = (T_K - T_1) / (K - 1).
+    ov_dev = jnp.asarray(snap0.overloaded)
+    ids_dev = jnp.asarray(noop_ids)
+    # slice the 8 noop rows on-device: reading back the whole N x N
+    # matrix just to re-upload 8 rows costs a full relay round trip
+    vals_dev = state["metric_dev"][ids_dev, :]
 
-    # Device-only compute time. A single e2e sample is dominated by the
-    # relay transport (~fixed per readback); chain K data-dependent
-    # dispatches (metric feeds back into the next step) with ONE readback
-    # at the end, subtract the 1-dispatch+readback time, and the fixed
-    # transport cost cancels: per-dispatch device time =
-    # (T_K - T_1) / (K - 1). On host CPU there is no transport to cancel
-    # (dispatch time IS compute time) — skip the ~46 extra full SPF
-    # dispatches so a slow degraded host still finishes in budget.
-    device_only = None
-    if platform != "cpu":
-        ov_dev = jnp.asarray(snap0.overloaded)
-        ids_dev = jnp.asarray(noop_ids)
-        # slice the 8 noop rows on-device: reading back the whole N x N
-        # matrix just to re-upload 8 rows costs a full relay round trip
-        vals_dev = state["metric_dev"][ids_dev, :]
-
+    def chain_device_only() -> float:
         def time_chain(k: int) -> float:
             m = state["metric_dev"]
             t0 = time.perf_counter()
@@ -213,20 +214,75 @@ def _run() -> dict:
             return (time.perf_counter() - t0) * 1000.0
 
         time_chain(1)  # warm any K=1 cache path
-        k = 8
         t1 = statistics.median(time_chain(1) for _ in range(5))
-        tk = statistics.median(time_chain(k) for _ in range(5))
-        device_only = round(max(0.0, (tk - t1) / (k - 1)), 3)
+        tk = statistics.median(time_chain(8) for _ in range(5))
+        return round(max(0.0, (tk - t1) / 7.0), 3)
+
+    # Min-plus impl CHOSEN BY MEASUREMENT on real TPU: time the jnp
+    # (XLA-fused) and pallas (hand-tiled VMEM) kernels at the bench
+    # shape, run the main loop on the winner, keep the loser's number in
+    # the artifact. On host CPU the pallas path only runs in interpret
+    # mode — stay on jnp and skip the ~90 extra full-SPF dispatches.
+    device_only = None
+    minplus_ms = None
+    if platform != "cpu":
+        minplus_ms = {"jnp": chain_device_only()}
+        try:
+            spf_ops.set_minplus_impl("pallas")
+            d_host, fh_host = reconverge()  # compile the pallas programs
+            if not oracle_gate(d_host, fh_host):
+                raise RuntimeError("pallas min-plus failed the oracle gate")
+            minplus_ms["pallas"] = chain_device_only()
+        except Exception as e:
+            minplus_ms["pallas"] = None
+            minplus_ms["pallas_error"] = f"{type(e).__name__}: {e}"
+            spf_ops.set_minplus_impl("jnp")
+            snapshots.invalidate()  # rebuild resident state from scratch
+            d_host, fh_host = reconverge()
+            assert oracle_gate(d_host, fh_host), "jnp re-gate failed"
+        if (
+            minplus_ms.get("pallas") is not None
+            and minplus_ms["pallas"] >= minplus_ms["jnp"]
+        ):
+            spf_ops.set_minplus_impl("jnp")
+        device_only = minplus_ms[spf_ops.get_minplus_impl()]
+
+    samples = []
+    for step in range(10):
+        churn(step)
+        t0 = time.perf_counter()
+        reconverge()
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    value = statistics.median(samples)
+
+    # optional second leg: 10k-node resident-ELL churn (the north-star
+    # scale axis, BASELINE.json config 4) folded into the same artifact
+    bench_10k = None
+    if os.environ.get("OPENR_BENCH_10K") == "1":
+        try:
+            from benchmarks.bench_scale import churn_bench
+
+            bench_10k = churn_bench(10000, 10)
+            v10k = max(bench_10k["median_ms"], 1e-9)
+            bench_10k["vs_baseline"] = round(BASELINE_MS / v10k, 3)
+            bench_10k["vs_northstar"] = round(NORTHSTAR_MS / v10k, 3)
+        except Exception as e:
+            bench_10k = {"error": f"{type(e).__name__}: {e}"}
 
     return {
         "metric": f"spf_reconvergence_ms_fattree_{snap0.n}",
         "value": round(value, 3),
         "unit": "ms",
+        # two ratios, deliberately both: vs the reference's 100 ms
+        # convergence goal AND vs this repo's own 10 ms north star
         "vs_baseline": round(BASELINE_MS / value, 3),
+        "vs_northstar": round(NORTHSTAR_MS / value, 3),
         "device_only_ms": device_only,
         "n_nodes": snap0.n,
         "platform": platform,
         "minplus_impl": spf_ops.get_minplus_impl(),
+        "minplus_ms": minplus_ms,
+        "bench_10k_churn": bench_10k,
         "error": None,
     }
 
@@ -238,6 +294,7 @@ def _child_main(mode: str) -> None:
         "value": None,
         "unit": "ms",
         "vs_baseline": None,
+        "vs_northstar": None,
         "error": None,
     }
     try:
@@ -252,9 +309,13 @@ def _child_main(mode: str) -> None:
     print(json.dumps(out))
 
 
-def _spawn(mode: str, timeout_s: int):
+def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
     """Run this file in child mode; return (parsed json | None, note)."""
     env = dict(os.environ, OPENR_BENCH_CHILD=mode)
+    if with_10k:
+        env["OPENR_BENCH_10K"] = "1"
+    else:
+        env.pop("OPENR_BENCH_10K", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -283,7 +344,7 @@ def _spawn(mode: str, timeout_s: int):
     )
 
 
-def _probe_tpu() -> tuple[bool, str]:
+def _probe_tpu(timeout_s: int) -> tuple[bool, str]:
     """Check that the default (relay) backend initializes AND completes a
     trivial device round trip, under a hard timeout. jax.devices() itself
     has been observed to hang on the relay, hence the subprocess."""
@@ -299,10 +360,10 @@ def _probe_tpu() -> tuple[bool, str]:
             [sys.executable, "-c", code],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
-            timeout=PROBE_TIMEOUT_S,
+            timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
-        return False, f"backend probe hung (> {PROBE_TIMEOUT_S}s)"
+        return False, f"backend probe hung (> {timeout_s}s)"
     out = proc.stdout.decode(errors="replace")
     for line in out.splitlines():
         if line.startswith("PLATFORM="):
@@ -319,34 +380,87 @@ def main() -> None:
         _child_main(child)
         return
 
-    notes = []
-    ok, info = _probe_tpu()
-    if ok:
-        result, note = _spawn("tpu", TPU_CHILD_TIMEOUT_S)
-        if result is not None and result.get("error") is None:
-            print(json.dumps(result))
-            return
-        notes.append(note or f"tpu child error: {result.get('error')}")
-    else:
-        notes.append(f"tpu unavailable: {info}")
+    t_start = time.monotonic()
 
-    # Degraded path: a number on the host CPU is better than no number.
-    result, note = _spawn("cpu", CPU_CHILD_TIMEOUT_S)
-    if result is not None:
-        result["fallback"] = "; ".join(notes)
-        print(json.dumps(result))
-        return
-    notes.append(note or "cpu child failed")
-    print(
-        json.dumps(
+    def elapsed() -> float:
+        return time.monotonic() - t_start
+
+    notes = []
+    attempts = []  # evidence trail: every probe, with timestamps
+
+    def probe(timeout_s: int) -> bool:
+        stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        ok, info = _probe_tpu(timeout_s)
+        attempts.append(
             {
-                "metric": METRIC_NAME,
-                "value": None,
-                "unit": "ms",
-                "vs_baseline": None,
-                "error": "; ".join(n for n in notes if n),
+                "utc": stamp,
+                "at_s": round(elapsed(), 1),
+                "timeout_s": timeout_s,
+                "ok": ok,
+                "info": info,
             }
         )
+        return ok
+
+    def emit(result: dict) -> None:
+        result["probe_attempts"] = attempts
+        print(json.dumps(result))
+
+    # escalating probe schedule: the relay has hung >115s and recovered
+    # within the same round before — one 60s attempt is not evidence
+    ok = False
+    for timeout_s in PROBE_TIMEOUTS_S:
+        ok = probe(timeout_s)
+        if ok or elapsed() > PROBE_BUDGET_S:
+            break
+
+    if ok:
+        result, note = _spawn(
+            "tpu", TPU_CHILD_10K_TIMEOUT_S, with_10k=True
+        )
+        if result is not None and result.get("error") is None:
+            emit(result)
+            return
+        notes.append(note or f"tpu child error: {result.get('error')}")
+        # the relay can die mid-run: re-probe once and retry WITHOUT the
+        # optional 10k leg before degrading to CPU
+        if elapsed() < BENCH_SOFT_BUDGET_S and probe(RETRY_PROBE_TIMEOUT_S):
+            result, note = _spawn("tpu", TPU_CHILD_TIMEOUT_S)
+            if result is not None and result.get("error") is None:
+                emit(result)
+                return
+            notes.append(note or f"tpu retry error: {result.get('error')}")
+    else:
+        notes.append(
+            f"tpu unavailable after {len(attempts)} probes"
+        )
+
+    # Degraded path: a number on the host CPU is better than no number.
+    with_10k = elapsed() < BENCH_SOFT_BUDGET_S
+    result, note = _spawn(
+        "cpu",
+        CPU_CHILD_10K_TIMEOUT_S if with_10k else CPU_CHILD_TIMEOUT_S,
+        with_10k=with_10k,
+    )
+    if result is None and with_10k:
+        # the 10k leg blowing the child timeout must not cost the
+        # headline number
+        notes.append(note or "cpu+10k child failed")
+        result, note = _spawn("cpu", CPU_CHILD_TIMEOUT_S)
+    if result is not None:
+        result["fallback"] = "; ".join(notes)
+        emit(result)
+        return
+    notes.append(note or "cpu child failed")
+    emit(
+        {
+            "metric": METRIC_NAME,
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": None,
+            "vs_northstar": None,
+            "error": "; ".join(n for n in notes if n),
+        }
     )
 
 

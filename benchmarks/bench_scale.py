@@ -28,20 +28,18 @@ from openr_tpu.models import topologies
 from openr_tpu.ops import spf_sparse
 
 
-def run_churn(args):
-    """Incremental reconvergence under link-flap churn at --nodes scale
+def churn_bench(nodes: int, churn_events: int) -> dict:
+    """Incremental reconvergence under link-flap churn at ``nodes`` scale
     (BASELINE.json config 4) over the resident ELL graph: per event the
     host patches O(degree) edge rows, one fused dispatch re-solves the
-    {src} + neighbors view, one readback returns it."""
+    {src} + neighbors view, one readback returns it. Returns the result
+    dict (shared by ``--churn`` here and the official ``bench.py``)."""
     import statistics
-
 
     from openr_tpu.ops import spf_sparse
     from dataclasses import replace
 
-    from openr_tpu.types import Adjacency
-
-    topo = topologies.fat_tree_nodes(args.nodes)
+    topo = topologies.fat_tree_nodes(nodes)
     ls = LinkState(area=topo.area)
     for name in sorted(topo.adj_dbs):
         ls.update_adjacency_database(topo.adj_dbs[name])
@@ -91,7 +89,7 @@ def run_churn(args):
 
     reconverge(churn(99))  # compile the patch-bucket program
     samples = []
-    for step in range(args.churn_events):
+    for step in range(churn_events):
         affected = churn(step)
         t0 = time.perf_counter()
         reconverge(affected)
@@ -115,96 +113,148 @@ def run_churn(args):
     t1 = statistics.median(time_chain(1) for _ in range(5))
     tk = statistics.median(time_chain(8) for _ in range(5))
     device_only = round(max(0.0, (tk - t1) / 7.0), 3)
-    print(
-        json.dumps(
-            {
-                "bench": f"scale.ell_churn_reconverge_{graph.n}_nodes",
-                "events": args.churn_events,
-                "median_ms": round(statistics.median(samples), 1),
-                # nearest-rank p90 (index 8 of 10, not the max)
-                "p90_ms": round(
-                    sorted(samples)[
-                        max(0, -(-len(samples) * 9 // 10) - 1)
-                    ],
-                    1,
-                ),
-                "device_only_ms": device_only,
-                "platform": platform,
-                "oracle_spot_check": "passed",
-            }
+    return {
+        "bench": f"scale.ell_churn_reconverge_{graph.n}_nodes",
+        "events": churn_events,
+        "median_ms": round(statistics.median(samples), 1),
+        # nearest-rank p90 (index 8 of 10, not the max)
+        "p90_ms": round(
+            sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
         ),
+        "device_only_ms": device_only,
+        "platform": platform,
+        "oracle_spot_check": "passed",
+    }
+
+
+def run_churn(args):
+    print(
+        json.dumps(churn_bench(args.nodes, args.churn_events)),
         flush=True,
     )
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--nodes", type=int, default=10000)
-    p.add_argument("--block", type=int, default=1024)
-    p.add_argument("--churn", action="store_true",
-                   help="run the incremental ELL churn scenario instead "
-                        "of all-sources")
-    p.add_argument("--churn-events", type=int, default=10)
-    p.add_argument("--oracle-checks", type=int, default=2,
-                   help="host-Dijkstra spot checks on sampled sources")
-    args = p.parse_args(argv)
-    if args.churn:
-        run_churn(args)
-        return
+def all_sources_bench(
+    nodes: int, block: int, kernel: str = "ell"
+) -> dict:
+    """All-sources SPF at ``nodes`` scale (BASELINE.json config 5 axis).
+    kernel="ell": sliced-ELL gather+reduce blocks (the TPU-fast path);
+    kernel="edges": the flat dst-sorted edge list + segment-min (kept
+    for comparison — segment-min lowers to serialized scatters on TPU).
+    Device-only per-block time is isolated by chaining K block solves
+    against one readback, same as bench.py (relay transport cancels)."""
+    import statistics
 
-    topo = topologies.fat_tree_nodes(args.nodes)
+    import jax
+
+    topo = topologies.fat_tree_nodes(nodes)
     ls = LinkState(area=topo.area)
     for name in sorted(topo.adj_dbs):
         ls.update_adjacency_database(topo.adj_dbs[name])
+    platform = jax.devices()[0].platform
 
     t0 = time.perf_counter()
-    graph = spf_sparse.compile_sparse(ls)
+    if kernel == "ell":
+        graph = spf_sparse.compile_ell(ls)
+        state = spf_sparse.EllState(graph)
+        edges = int(
+            sum((w < 2 ** 30 - 1).sum() for w in graph.w)
+        )
+
+        def solve_block(ids):
+            return spf_sparse.ell_distances_from_sources(
+                graph, ids, state=state
+            )
+
+    else:
+        graph = spf_sparse.compile_sparse(ls)
+        edges = int(np.sum(graph.full_w < 2 ** 30 - 1))
+
+        def solve_block(ids):
+            return spf_sparse.sparse_distances_from_sources(graph, ids)
+
     compile_ms = (time.perf_counter() - t0) * 1000
 
     n = graph.n_pad
-    block = args.block
     # warm-up one block (jit compile)
-    first = np.asarray(
-        spf_sparse.sparse_distances_from_sources(
-            graph, np.arange(block, dtype=np.int32)
-        )
-    )
+    np.asarray(solve_block(np.arange(block, dtype=np.int32)))
 
     t0 = time.perf_counter()
-    rows_done = 0
-    sample_rows = {}
+    sample_row0 = None
     for start in range(0, n, block):
-        ids = np.arange(start, start + block, dtype=np.int32)
-        d_blk = np.asarray(
-            spf_sparse.sparse_distances_from_sources(graph, ids)
-        )
+        ids = np.arange(start, start + block, dtype=np.int32) % n
+        d_blk = np.asarray(solve_block(ids))
         if start == 0:
-            sample_rows[0] = d_blk[0]
-        rows_done += block
+            sample_row0 = d_blk[0]
     all_sources_ms = (time.perf_counter() - t0) * 1000
+
+    # device-only per-block: chain K data-dependent solves, one readback
+    device_only_block_ms = None
+    if platform != "cpu":
+        ids0 = np.arange(block, dtype=np.int32)
+
+        def time_chain(k: int) -> float:
+            t0 = time.perf_counter()
+            d = None
+            for i in range(k):
+                # data dependence: seed block i from block i-1's result
+                ids = ids0 if d is None else (ids0 + d[0, 0] % n) % n
+                d = solve_block(ids)
+            np.asarray(d)
+            return (time.perf_counter() - t0) * 1000.0
+
+        time_chain(1)
+        t1 = statistics.median(time_chain(1) for _ in range(3))
+        tk = statistics.median(time_chain(4) for _ in range(3))
+        device_only_block_ms = round(max(0.0, (tk - t1) / 3.0), 3)
 
     # oracle spot checks: row 0 vs host Dijkstra
     oracle = ls.run_spf(graph.node_names[0])
     for dst in list(graph.node_names)[:: max(1, graph.n // 50)]:
         did = graph.node_index[dst]
         want = oracle[dst].metric if dst in oracle else None
-        got = int(sample_rows[0][did])
+        got = int(sample_row0[did])
         from openr_tpu.ops.spf import INF
 
         assert (got >= INF) == (want is None), dst
         if want is not None:
             assert got == want, (dst, got, want)
 
+    n_blocks = -(-n // block)
+    out = {
+        "bench": f"scale.{kernel}_all_sources_{graph.n}_nodes",
+        "kernel": kernel,
+        "edges": edges,
+        "edge_compile_ms": round(compile_ms, 1),
+        "all_sources_ms": round(all_sources_ms, 1),
+        "source_block": block,
+        "platform": platform,
+        "oracle_spot_check": "passed",
+    }
+    if device_only_block_ms is not None:
+        out["device_only_block_ms"] = device_only_block_ms
+        out["device_only_all_sources_ms"] = round(
+            device_only_block_ms * n_blocks, 1
+        )
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=10000)
+    p.add_argument("--block", type=int, default=1024)
+    p.add_argument("--kernel", choices=("ell", "edges"), default="ell")
+    p.add_argument("--churn", action="store_true",
+                   help="run the incremental ELL churn scenario instead "
+                        "of all-sources")
+    p.add_argument("--churn-events", type=int, default=10)
+    args = p.parse_args(argv)
+    if args.churn:
+        run_churn(args)
+        return
     print(
         json.dumps(
-            {
-                "bench": f"scale.sparse_all_sources_{graph.n}_nodes",
-                "edges": int(np.sum(graph.full_w < 2 ** 30 - 1)),
-                "edge_compile_ms": round(compile_ms, 1),
-                "all_sources_ms": round(all_sources_ms, 1),
-                "source_block": block,
-                "oracle_spot_check": "passed",
-            }
+            all_sources_bench(args.nodes, args.block, args.kernel)
         ),
         flush=True,
     )
