@@ -97,11 +97,13 @@ class PrefixAllocator:
         config_store=None,
         area: str = "0",
         on_allocated: Optional[Callable[[Optional[IpPrefix]], None]] = None,
+        log_sample_queue=None,
     ):
         self._node = my_node_name
         self._evb = evb
         self._client = kvstore_client
         self._prefix_manager = prefix_manager
+        self._log_sample_queue = log_sample_queue
         self._netlink = netlink
         self._loopback_if = loopback_if
         self._config_store = config_store
@@ -172,6 +174,21 @@ class PrefixAllocator:
         )
         if new_params == self._alloc_params and new_params is not None:
             return
+        if new_params != self._alloc_params:  # None -> None is a no-op
+            self._log_prefix_event(
+                "ALLOC_PARAMS_UPDATE",
+                old_params=(
+                    f"{self._alloc_params[0].to_str()},"
+                    f"{self._alloc_params[1]}"
+                    if self._alloc_params
+                    else ""
+                ),
+                new_params=(
+                    f"{seed_prefix.to_str()},{alloc_prefix_len}"
+                    if seed_prefix is not None
+                    else ""
+                ),
+            )
         if self._range_allocator is not None:
             self._range_allocator.stop()
             self._range_allocator = None
@@ -252,6 +269,19 @@ class PrefixAllocator:
 
     # -- internals --------------------------------------------------------
 
+    def _log_prefix_event(self, event: str, **fields) -> None:
+        """reference: PrefixAllocator.cpp logPrefixEvent —
+        PREFIX_ELECTED / PREFIX_UPDATED / PREFIX_LOST /
+        ALLOC_PARAMS_UPDATE samples toward the Monitor."""
+        from openr_tpu.monitor.monitor import push_log_sample
+
+        push_log_sample(
+            self._log_sample_queue,
+            node_name=self._node,
+            event=event,
+            **fields,
+        )
+
     def _on_index(
         self,
         index: Optional[int],
@@ -273,9 +303,16 @@ class PrefixAllocator:
     def _apply(self, prefix: IpPrefix) -> None:
         if prefix == self.allocated_prefix:
             return
+        old = self.allocated_prefix
+        self._log_prefix_event(
+            "PREFIX_UPDATED" if old else "PREFIX_ELECTED",
+            prefix=prefix.to_str(),
+            old_prefix=old.to_str() if old else "",
+        )
         # the loopback sweep happens once, in the sync below — not in
-        # the intermediate withdraw too
-        self._withdraw(sync_loopback=False)
+        # the intermediate withdraw too; the UPDATED sample above covers
+        # the old prefix, so the withdraw does not log a separate LOST
+        self._withdraw(sync_loopback=False, log=False)
         self.allocated_prefix = prefix
         self._prefix_manager.advertise_prefixes(
             [
@@ -288,9 +325,15 @@ class PrefixAllocator:
         if self._on_allocated is not None:
             self._on_allocated(prefix)
 
-    def _withdraw(self, sync_loopback: bool = True) -> None:
+    def _withdraw(
+        self, sync_loopback: bool = True, log: bool = True
+    ) -> None:
         had = self.allocated_prefix is not None
         if had:
+            if log:
+                self._log_prefix_event(
+                    "PREFIX_LOST", prefix=self.allocated_prefix.to_str()
+                )
             self._prefix_manager.withdraw_prefixes([self.allocated_prefix])
             self.allocated_prefix = None
         if sync_loopback:

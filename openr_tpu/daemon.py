@@ -100,14 +100,22 @@ class OpenrNode:
         self.fib_updates = ReplicateQueue(name=f"{name}:fibUpdates")
         self.prefix_updates = ReplicateQueue(name=f"{name}:prefixUpdates")
         self.static_routes = ReplicateQueue(name=f"{name}:staticRoutes")
+        # event-log samples from every module -> Monitor (reference:
+        # Main.cpp:280 logSampleQueue wired into KvStore, LinkMonitor,
+        # Fib, PrefixAllocator; Monitor drains the reader at :390)
+        self.log_sample_queue = ReplicateQueue(name=f"{name}:logSamples")
 
         # -- modules ------------------------------------------------------
+        from openr_tpu.monitor.monitor import Monitor
+
+        self.monitor = Monitor(name, self.log_sample_queue)
         self.kvstore = KvStore(
             node_id=name,
             areas=self.areas,
             enable_flood_optimization=enable_flood_optimization,
             is_flood_root=is_flood_root,
             flood_rate=flood_rate,
+            log_sample_queue=self.log_sample_queue,
         )
         self.client_evb = OpenrEventBase(name=f"kvclient:{name}")
         self.kvstore_client = KvStoreClient(
@@ -139,6 +147,7 @@ class OpenrNode:
             fib_updates_queue=self.fib_updates,
             kvstore_client=self.kvstore_client,
             area=area,
+            log_sample_queue=self.log_sample_queue,
         )
         self.spark = Spark(
             name,
@@ -163,6 +172,7 @@ class OpenrNode:
             node_label=node_label,
             enable_segment_routing=enable_segment_routing,
             use_rtt_metric=use_rtt_metric,
+            log_sample_queue=self.log_sample_queue,
         )
         self.prefix_manager = PrefixManager(
             name,
@@ -204,6 +214,7 @@ class OpenrNode:
                 loopback_if=prefix_alloc.loopback_iface,
                 config_store=config_store,
                 area=area,
+                log_sample_queue=self.log_sample_queue,
             )
         from openr_tpu.ctrl.handler import OpenrCtrlHandler
 
@@ -215,6 +226,7 @@ class OpenrNode:
             link_monitor=self.link_monitor,
             prefix_manager=self.prefix_manager,
             spark=self.spark,
+            monitor=self.monitor,
         )
         self.ctrl_handler._config_store = config_store
         self.ctrl_server = None  # created on demand by start_ctrl_server
@@ -235,6 +247,10 @@ class OpenrNode:
 
     def start(self) -> None:
         assert not self._started
+        # Monitor first: it only reads the log queue, and every other
+        # module may push from its first event on (reference startup
+        # order: Main.cpp:385 Monitor before KvStore)
+        self.monitor.start()
         self.kvstore.start()
         self.client_evb.run_in_thread()
         self.prefix_manager.start()
@@ -291,6 +307,10 @@ class OpenrNode:
         self.client_evb.stop()
         self.client_evb.join()
         self.kvstore.stop()
+        # last, so producers are already quiet; samples still queued at
+        # this instant are dropped (best-effort shutdown telemetry, like
+        # the reference's logSampleQueue.close() at Main.cpp:617)
+        self.monitor.stop()
         self._started = False
 
     # -- convenience ------------------------------------------------------

@@ -51,6 +51,7 @@ class Fib:
         keepalive_interval_s: float = 1.0,
         retry_min_s: float = 0.05,
         retry_max_s: float = 2.0,
+        log_sample_queue: Optional[ReplicateQueue] = None,
     ):
         self.my_node_name = my_node_name
         self.agent = agent
@@ -60,6 +61,7 @@ class Fib:
         )
         self._kvstore_client = kvstore_client
         self._area = area
+        self._log_sample_queue = log_sample_queue
         self.dry_run = dry_run
         # desired state (what Decision wants programmed)
         self.unicast_routes: Dict[IpPrefix, UnicastRoute] = {}
@@ -132,7 +134,26 @@ class Fib:
         # publish what we programmed (even in dry run: observers track
         # intended state)
         self.fib_updates_queue.push(update)
-        self._advertise_fib_time((time.perf_counter() - t0) * 1000.0)
+        duration_ms = (time.perf_counter() - t0) * 1000.0
+        if update.perf_events is not None and update.perf_events.events:
+            # reference: Fib.cpp:891 logPerfEvents -> ROUTE_CONVERGENCE;
+            # duration = first perf event (the triggering update entering
+            # the pipeline) to routes-programmed, NOT just Fib-local time
+            from openr_tpu.monitor.monitor import push_log_sample
+
+            events = update.perf_events.events
+            push_log_sample(
+                self._log_sample_queue,
+                node_name=self.my_node_name,
+                event="ROUTE_CONVERGENCE",
+                perf_events=[
+                    f"{e.node_name}.{e.event_descr}" for e in events
+                ],
+                duration_ms=max(
+                    0, int(time.time() * 1000) - events[0].unix_ts
+                ),
+            )
+        self._advertise_fib_time(duration_ms)
 
     def _program_delta(self, update: DecisionRouteUpdate) -> bool:
         if self.dry_run:

@@ -217,6 +217,8 @@ class _Peer:
     # it reaches INITIALIZED (otherwise an update racing the full sync
     # would be lost until the next anti-entropy pass)
     pending_flood: Dict[str, Value] = field(default_factory=dict)
+    # monotonic stamp of the in-flight full sync (event-log duration)
+    sync_started: Optional[float] = None
 
 
 class KvStoreDb:
@@ -234,6 +236,7 @@ class KvStoreDb:
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
         flood_rate: Optional[Tuple[float, int]] = None,
+        log_sample_queue: Optional[ReplicateQueue] = None,
     ):
         self.area = area
         self.node_id = node_id
@@ -241,6 +244,7 @@ class KvStoreDb:
         self._updates_queue = updates_queue
         self._executor = executor
         self._filters = filters
+        self._log_sample_queue = log_sample_queue
         self.key_vals: Dict[str, Value] = {}
         self.peers: Dict[str, _Peer] = {}
         # flood rate limiting: token bucket + coalescing buffer
@@ -275,6 +279,17 @@ class KvStoreDb:
             "kvstore.spt_floods": 0,
             "kvstore.rate_limit_suppress": 0,
         }
+
+    def _log_sample(self, **fields) -> None:
+        """reference: KvStore.cpp:3104 logSyncEvent / :3118 logKvEvent."""
+        from openr_tpu.monitor.monitor import push_log_sample
+
+        push_log_sample(
+            self._log_sample_queue,
+            node_name=self.node_id,
+            area=self.area,
+            **fields,
+        )
 
     # -- merge + flood ----------------------------------------------------
 
@@ -474,6 +489,8 @@ class KvStoreDb:
                 expired.append(key)
         if expired:
             self.counters["kvstore.expired_keys"] += len(expired)
+            for key in expired:
+                self._log_sample(event="KEY_EXPIRE", key=key)
             self._publish(Publication(expired_keys=expired, area=self.area))
         self._schedule_ttl_cleanup()
 
@@ -624,6 +641,7 @@ class KvStoreDb:
                 )
                 continue
             peer.state = KvStorePeerState.SYNCING
+            peer.sync_started = time.monotonic()
             self.counters["kvstore.full_sync_count"] += 1
             hashes = self.dump_hashes().key_vals
             params = KeyDumpParams(key_val_hashes=hashes)
@@ -659,6 +677,15 @@ class KvStoreDb:
             return
         peer.state = KvStorePeerState.INITIALIZED
         peer.backoff.report_success()
+        if peer.sync_started is not None:
+            self._log_sample(
+                event="KVSTORE_FULL_SYNC",
+                neighbor=peer_name,
+                duration_ms=int(
+                    (time.monotonic() - peer.sync_started) * 1000
+                ),
+            )
+            peer.sync_started = None
         if self.dual is not None:
             # (re-)announce the link to DUAL; a bounced peer is handled
             # as down-then-up inside Dual.peer_up
@@ -796,6 +823,7 @@ class KvStore:
         enable_flood_optimization: bool = False,
         is_flood_root: bool = False,
         flood_rate: Optional[Tuple[float, int]] = None,
+        log_sample_queue: Optional[ReplicateQueue] = None,
     ):
         self.node_id = node_id
         self.evb = OpenrEventBase(name=f"kvstore:{node_id}")
@@ -817,6 +845,7 @@ class KvStore:
                 enable_flood_optimization=enable_flood_optimization,
                 is_flood_root=is_flood_root,
                 flood_rate=flood_rate,
+                log_sample_queue=log_sample_queue,
             )
         self._sync_interval = sync_interval_s
         self._sync_timer = None

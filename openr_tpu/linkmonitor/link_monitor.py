@@ -88,6 +88,7 @@ class LinkMonitor:
         flap_initial_backoff_s: float = 0.05,
         flap_max_backoff_s: float = 2.0,
         advertise_throttle_s: float = 0.02,
+        log_sample_queue: Optional[ReplicateQueue] = None,
     ):
         self.my_node_name = my_node_name
         self.area = area
@@ -106,6 +107,7 @@ class LinkMonitor:
         self._config_store = config_store
         self._flap_initial = flap_initial_backoff_s
         self._flap_max = flap_max_backoff_s
+        self._log_sample_queue = log_sample_queue
 
         # (if_name, neighbor) -> (SparkNeighbor, Adjacency)
         self._adjacencies: Dict[Tuple[str, str], Tuple[SparkNeighbor, Adjacency]] = {}
@@ -248,10 +250,31 @@ class LinkMonitor:
             },
         )
 
+    def _log_sample(self, **fields) -> None:
+        """reference: LinkMonitor.cpp:1287 logNeighborEvent, :1303
+        logLinkEvent, :1326 logPeerEvent."""
+        from openr_tpu.monitor.monitor import push_log_sample
+
+        push_log_sample(
+            self._log_sample_queue, node_name=self.my_node_name, **fields
+        )
+
     # -- spark events -----------------------------------------------------
 
     def _on_neighbor_event(self, event: SparkNeighborEvent) -> None:
         et = event.event_type
+        nbr = event.neighbor
+        if et != SparkNeighborEventType.NEIGHBOR_RTT_CHANGE:
+            # transitions only — RTT jitter on a noisy fabric would
+            # evict the rare UP/DOWN events from the bounded history
+            self._log_sample(
+                event=et.name,
+                neighbor=nbr.node_name,
+                interface=nbr.local_if_name,
+                remote_interface=nbr.remote_if_name,
+                area=nbr.area or self.area,
+                rtt_us=nbr.rtt_us,
+            )
         if et == SparkNeighborEventType.NEIGHBOR_UP:
             self._neighbor_up(event.neighbor)
         elif et == SparkNeighborEventType.NEIGHBOR_RESTARTED:
@@ -301,6 +324,9 @@ class LinkMonitor:
         ):
             try:
                 self._kvstore.del_peer(area, nbr.node_name)
+                self._log_sample(
+                    event="DEL_PEER", peer_name=nbr.node_name, area=area
+                )
             except Exception:
                 pass
         self._advertise_adj_throttled()
@@ -339,6 +365,11 @@ class LinkMonitor:
             if transport is not None:
                 self._kvstore.add_peer(
                     nbr.area or self.area, nbr.node_name, transport
+                )
+                self._log_sample(
+                    event="ADD_PEER",
+                    peer_name=nbr.node_name,
+                    area=nbr.area or self.area,
                 )
         except Exception:
             pass
@@ -420,15 +451,23 @@ class LinkMonitor:
             return
         was_up = entry.info.is_up
         entry.info = InterfaceInfo(is_up=is_up, networks=tuple(addresses))
+        backoff_ms = 0
         if is_up and not was_up:
             # flap damping: a link coming back up is held for the current
             # backoff window; rapid flapping doubles the window
             entry.backoff.report_error()
             delay = entry.backoff.get_time_remaining_until_retry()
+            backoff_ms = int(delay * 1000)
             if delay > 0:
                 self.evb.schedule_timeout(
                     delay, self._advertise_ifaces_throttled
                 )
+        if was_up != is_up:  # reference logLinkEvent: transitions only
+            self._log_sample(
+                event=f"IFACE_{'UP' if is_up else 'DOWN'}",
+                interface=if_name,
+                backoff_ms=backoff_ms,
+            )
 
     def _advertise_interfaces(self) -> None:
         self.counters["link_monitor.advertise_interfaces"] += 1

@@ -258,6 +258,7 @@ def main(argv=None) -> int:
             ("spark", node.spark.evb),
             ("linkmonitor", node.link_monitor.evb),
             ("prefixmgr", node.prefix_manager.evb),
+            ("monitor", node.monitor.evb),
         ):
             watchdog.add_evb(name, evb)
 

@@ -46,6 +46,16 @@ class LogSample:
         return LogSample(**json.loads(raw))
 
 
+def push_log_sample(queue: Optional[ReplicateQueue], **fields) -> None:
+    """Push one structured event sample toward the Monitor; no-op when
+    the producing module runs without a wired log queue. The single
+    shared helper for every module's event-log site (reference pattern:
+    logSampleQueue_.push in KvStore.cpp:3104, LinkMonitor.cpp:1287,
+    Fib.cpp:891, PrefixAllocator.cpp logPrefixEvent)."""
+    if queue is not None:
+        queue.push(LogSample(**fields))
+
+
 class SystemMetrics:
     """reference: monitor/SystemMetrics.h — RSS/CPU snapshots."""
 

@@ -128,6 +128,47 @@ class TestSystem:
         net.io.partition("if_beta_alpha")
         assert wait_until(lambda: nh_names() == {"gamma"}), nh_names()
 
+    def test_monitor_event_logs_flow_on_neighbor_flap(self, net):
+        """The daemon-wired Monitor receives LogSamples end to end:
+        neighbor discovery pushes NEIGHBOR_UP + ADD_PEER from
+        LinkMonitor and KVSTORE_FULL_SYNC from KvStore; a partition
+        pushes NEIGHBOR_DOWN; route programming pushes ROUTE_CONVERGENCE
+        (reference wiring: Main.cpp:269-280 logSampleQueue ->
+        Monitor)."""
+        for i, name in enumerate(["alpha", "beta"]):
+            net.add_node(name, i)
+        net.start()
+        net.link("alpha", "beta")
+        beta_pfx = net.nodes["beta"].advertise_loopback("fd00:b::1/128")
+        assert wait_until(lambda: net.has_route("alpha", beta_pfx))
+
+        def events(node):
+            return [
+                s.get("event")
+                for s in net.nodes[node].monitor.get_event_logs(100)
+            ]
+
+        assert wait_until(lambda: "NEIGHBOR_UP" in events("alpha"))
+        assert wait_until(lambda: "ADD_PEER" in events("alpha"))
+        assert wait_until(
+            lambda: "KVSTORE_FULL_SYNC" in events("alpha")
+        )
+        # common fields merged in by the Monitor
+        up = next(
+            s
+            for s in net.nodes["alpha"].monitor.get_event_logs(100)
+            if s.get("event") == "NEIGHBOR_UP"
+        )
+        assert up.get("neighbor") == "beta"
+        assert up.get("node_name") == "alpha"
+        # flap: partition both directions so alpha sees the hold expire
+        net.io.partition("if_beta_alpha")
+        net.io.partition("if_alpha_beta")
+        assert wait_until(lambda: "NEIGHBOR_DOWN" in events("alpha"))
+        # the ctrl surface serves the same stream (breeze monitor logs)
+        logs = net.nodes["alpha"].ctrl_handler.get_event_logs(100)
+        assert any('"NEIGHBOR_DOWN"' in raw for raw in logs)
+
     def test_node_restart_recovers(self, net):
         for i, name in enumerate(["alpha", "beta"]):
             net.add_node(name, i)
