@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 from typing import Callable, Dict, Optional, Tuple
 
+from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.allocators.range_allocator import RangeAllocator
 from openr_tpu.types import BinaryAddress, IpPrefix, PrefixEntry, PrefixType
 from openr_tpu.utils.eventbase import OpenrEventBase
@@ -273,8 +274,6 @@ class PrefixAllocator:
         """reference: PrefixAllocator.cpp logPrefixEvent —
         PREFIX_ELECTED / PREFIX_UPDATED / PREFIX_LOST /
         ALLOC_PARAMS_UPDATE samples toward the Monitor."""
-        from openr_tpu.monitor.monitor import push_log_sample
-
         push_log_sample(
             self._log_sample_queue,
             node_name=self._node,

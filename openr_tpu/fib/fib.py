@@ -22,6 +22,7 @@ import ipaddress
 import time
 from typing import Dict, List, Optional
 
+from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.decision.rib import DecisionRouteUpdate
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.fib_service import FibService
@@ -135,12 +136,12 @@ class Fib:
         # intended state)
         self.fib_updates_queue.push(update)
         duration_ms = (time.perf_counter() - t0) * 1000.0
-        if update.perf_events is not None and update.perf_events.events:
+        if ok and update.perf_events is not None and update.perf_events.events:
             # reference: Fib.cpp:891 logPerfEvents -> ROUTE_CONVERGENCE;
             # duration = first perf event (the triggering update entering
-            # the pipeline) to routes-programmed, NOT just Fib-local time
-            from openr_tpu.monitor.monitor import push_log_sample
-
+            # the pipeline) to routes-programmed, NOT just Fib-local
+            # time. Only logged when programming SUCCEEDED — a failed
+            # attempt has not converged.
             events = update.perf_events.events
             push_log_sample(
                 self._log_sample_queue,

@@ -33,6 +33,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.types import (
     DEFAULT_AREA,
@@ -282,8 +283,6 @@ class KvStoreDb:
 
     def _log_sample(self, **fields) -> None:
         """reference: KvStore.cpp:3104 logSyncEvent / :3118 logKvEvent."""
-        from openr_tpu.monitor.monitor import push_log_sample
-
         push_log_sample(
             self._log_sample_queue,
             node_name=self.node_id,

@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.netlink import (
     NetlinkEvent,
@@ -111,6 +112,9 @@ class LinkMonitor:
 
         # (if_name, neighbor) -> (SparkNeighbor, Adjacency)
         self._adjacencies: Dict[Tuple[str, str], Tuple[SparkNeighbor, Adjacency]] = {}
+        # (area, node) KvStore peers currently advertised — ADD_PEER is
+        # logged only on a genuinely new peer, not each RTT re-advertise
+        self._advertised_peers: Set[Tuple[str, str]] = set()
         self._interfaces: Dict[str, _InterfaceEntry] = {}
         self._metric_overrides: Dict[Tuple[str, str], int] = {}
         # interface-wide override (reference: setInterfaceMetric) —
@@ -253,8 +257,6 @@ class LinkMonitor:
     def _log_sample(self, **fields) -> None:
         """reference: LinkMonitor.cpp:1287 logNeighborEvent, :1303
         logLinkEvent, :1326 logPeerEvent."""
-        from openr_tpu.monitor.monitor import push_log_sample
-
         push_log_sample(
             self._log_sample_queue, node_name=self.my_node_name, **fields
         )
@@ -324,6 +326,7 @@ class LinkMonitor:
         ):
             try:
                 self._kvstore.del_peer(area, nbr.node_name)
+                self._advertised_peers.discard((area, nbr.node_name))
                 self._log_sample(
                     event="DEL_PEER", peer_name=nbr.node_name, area=area
                 )
@@ -363,14 +366,15 @@ class LinkMonitor:
         try:
             transport = self._peer_transport_factory(nbr)
             if transport is not None:
-                self._kvstore.add_peer(
-                    nbr.area or self.area, nbr.node_name, transport
-                )
-                self._log_sample(
-                    event="ADD_PEER",
-                    peer_name=nbr.node_name,
-                    area=nbr.area or self.area,
-                )
+                area = nbr.area or self.area
+                self._kvstore.add_peer(area, nbr.node_name, transport)
+                if (area, nbr.node_name) not in self._advertised_peers:
+                    self._advertised_peers.add((area, nbr.node_name))
+                    self._log_sample(
+                        event="ADD_PEER",
+                        peer_name=nbr.node_name,
+                        area=area,
+                    )
         except Exception:
             pass
 

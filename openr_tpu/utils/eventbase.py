@@ -25,6 +25,11 @@ from typing import Callable, List, Optional, Tuple
 
 from openr_tpu.messaging.queue import QueueClosedError, RQueue
 
+# upper bound on the event loop's idle wait so last_loop_ts stays fresh
+# for the Watchdog even on a completely quiet event base; small enough
+# that it stays well under any plausible watchdog threshold
+_WATCHDOG_TICK_S = 0.1
+
 
 class TimerHandle:
     __slots__ = ("deadline", "seq", "fn", "cancelled")
@@ -67,6 +72,14 @@ class OpenrEventBase:
             while not self._stop_requested.is_set():
                 self.last_loop_ts = time.monotonic()
                 timeout = self._run_due_timers()
+                # bound the idle wait: an evb with no timers and no
+                # traffic (Monitor on a quiet network) would otherwise
+                # block forever in get(), its last_loop_ts would go
+                # stale, and the Watchdog would abort a HEALTHY daemon.
+                # Idle-blocked is healthy; a hung callback still never
+                # returns here and still trips the watchdog.
+                if timeout is None or timeout > _WATCHDOG_TICK_S:
+                    timeout = _WATCHDOG_TICK_S
                 try:
                     cb = self._callbacks.get(timeout=timeout)
                 except _queue.Empty:

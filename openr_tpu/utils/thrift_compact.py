@@ -1,0 +1,569 @@
+"""Thrift CompactProtocol struct codec for the KvStore wire surface.
+
+The reference's peer channel exchanges thrift structs serialized with
+``TCompactProtocol`` (reference IDL: openr/if/KvStore.thrift; service:
+openr/if/OpenrCtrl.thrift KvStoreService). ``openr_tpu.utils.wire`` is
+the framework's own self-describing codec; THIS module is the
+interop path — it produces and consumes the exact compact-protocol
+bytes a reference node emits, so an openr-tpu daemon can sit on the
+wire with stock Open/R peers.
+
+Implemented from the thrift compact protocol specification
+(thrift/doc/specs/thrift-compact-protocol.md):
+
+- unsigned LEB128 varints; zigzag(i16/i32/i64) for integer values
+- struct field header: ``(delta << 4) | type`` when the field-id delta
+  from the previous field is in [1, 15], else ``0x00 | type`` followed
+  by the zigzag-varint field id
+- BOOL is carried in the field-header type nibble (1=true, 2=false);
+  standalone bools (collection elements) are one byte 1/2
+- binary/string: varint byte-length + payload
+- list/set: ``(size << 4) | elem_type`` when size < 15, else
+  ``0xF0 | elem_type`` + varint size
+- map: empty maps are the single byte 0x00, otherwise varint size +
+  one byte ``(key_type << 4) | value_type``
+- nested structs recurse; every struct ends with STOP (0x00)
+
+Fields are written in IDL *declaration* order (the generated reference
+serializers emit in declaration order, which for these structs differs
+from field-id order — the IDL comments call the numbering out as
+deliberate); the decoder accepts any order, per the spec.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# compact-protocol wire types
+T_STOP = 0x00
+T_TRUE = 0x01
+T_FALSE = 0x02
+T_BYTE = 0x03
+T_I16 = 0x04
+T_I32 = 0x05
+T_I64 = 0x06
+T_DOUBLE = 0x07
+T_BINARY = 0x08  # also string
+T_LIST = 0x09
+T_SET = 0x0A
+T_MAP = 0x0B
+T_STRUCT = 0x0C
+
+# type descriptors: ("i64",) | ("i32",) | ("i16",) | ("byte",) |
+# ("bool",) | ("string",) | ("binary",) | ("list", elem) |
+# ("set", elem) | ("map", key, val) | ("struct", StructSchema)
+_WIRE_TYPE = {
+    "bool": T_TRUE,  # placeholder; bools resolve per-value in headers
+    "byte": T_BYTE,
+    "i16": T_I16,
+    "i32": T_I32,
+    "i64": T_I64,
+    "double": T_DOUBLE,
+    "string": T_BINARY,
+    "binary": T_BINARY,
+    "list": T_LIST,
+    "set": T_SET,
+    "map": T_MAP,
+    "struct": T_STRUCT,
+}
+
+
+@dataclass(frozen=True)
+class Field:
+    """One IDL field: id, type descriptor, python key. ``optional``
+    fields are skipped when the value is None; required fields with
+    value None raise."""
+
+    fid: int
+    ftype: Tuple
+    name: str
+    optional: bool = False
+
+
+@dataclass(frozen=True)
+class StructSchema:
+    name: str
+    fields: Tuple[Field, ...]  # IDL declaration order
+
+    def by_id(self) -> Dict[int, Field]:
+        return {f.fid: f for f in self.fields}
+
+
+class _Writer:
+    def __init__(self):
+        self.buf = bytearray()
+
+    def byte(self, b: int) -> None:
+        self.buf.append(b & 0xFF)
+
+    def varint(self, n: int) -> None:
+        assert n >= 0, n
+        while True:
+            if n < 0x80:
+                self.buf.append(n)
+                return
+            self.buf.append((n & 0x7F) | 0x80)
+            n >>= 7
+
+    def zigzag(self, n: int, bits: int) -> None:
+        mask = (1 << bits) - 1
+        self.varint(((n << 1) ^ (n >> (bits - 1))) & mask)
+
+    def binary(self, b: bytes) -> None:
+        self.varint(len(b))
+        self.buf.extend(b)
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def byte(self) -> int:
+        b = self.data[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self.byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint too long")
+
+    def zigzag(self, bits: int) -> int:
+        u = self.varint()
+        n = (u >> 1) ^ -(u & 1)
+        # normalize to signed range
+        if n >= 1 << (bits - 1):
+            n -= 1 << bits
+        return n
+
+    def binary(self) -> bytes:
+        n = self.varint()
+        out = self.data[self.pos : self.pos + n]
+        if len(out) != n:
+            raise ValueError("truncated binary")
+        self.pos += n
+        return bytes(out)
+
+
+def _wire_type_of(ftype: Tuple, value: Any) -> int:
+    if ftype[0] == "bool":
+        return T_TRUE if value else T_FALSE
+    return _WIRE_TYPE[ftype[0]]
+
+
+def _write_value(w: _Writer, ftype: Tuple, value: Any) -> None:
+    kind = ftype[0]
+    if kind == "bool":
+        w.byte(T_TRUE if value else T_FALSE)  # standalone (collection)
+    elif kind == "byte":
+        w.byte(value & 0xFF)
+    elif kind in ("i16", "i32", "i64"):
+        bits = {"i16": 16, "i32": 32, "i64": 64}[kind]
+        w.zigzag(int(value), bits)
+    elif kind == "string":
+        w.binary(value.encode("utf-8"))
+    elif kind == "binary":
+        w.binary(bytes(value))
+    elif kind in ("list", "set"):
+        elem = ftype[1]
+        items = sorted(value) if kind == "set" else list(value)
+        et = _WIRE_TYPE[elem[0]] if elem[0] != "bool" else T_TRUE
+        if len(items) < 15:
+            w.byte((len(items) << 4) | et)
+        else:
+            w.byte(0xF0 | et)
+            w.varint(len(items))
+        for item in items:
+            _write_value(w, elem, item)
+    elif kind == "map":
+        ktype, vtype = ftype[1], ftype[2]
+        if not value:
+            w.byte(0)
+            return
+        w.varint(len(value))
+        kt = _WIRE_TYPE[ktype[0]] if ktype[0] != "bool" else T_TRUE
+        vt = _WIRE_TYPE[vtype[0]] if vtype[0] != "bool" else T_TRUE
+        w.byte((kt << 4) | vt)
+        # deterministic output: sort keys (maps are unordered on the
+        # wire; reference emits hash-map order, any order decodes)
+        for k in sorted(value):
+            _write_value(w, ktype, k)
+            _write_value(w, vtype, value[k])
+    elif kind == "struct":
+        _write_struct(w, ftype[1], value)
+    else:
+        raise TypeError(f"unsupported type {kind}")
+
+
+def _write_struct(w: _Writer, schema: StructSchema, values: Dict) -> None:
+    last_fid = 0
+    for f in schema.fields:
+        value = values.get(f.name)
+        if value is None:
+            if f.optional:
+                continue
+            raise ValueError(f"{schema.name}.{f.name} is required")
+        wtype = _wire_type_of(f.ftype, value)
+        delta = f.fid - last_fid
+        if 0 < delta <= 15:
+            w.byte((delta << 4) | wtype)
+        else:
+            w.byte(wtype)
+            w.zigzag(f.fid, 16)
+        if f.ftype[0] != "bool":  # bool value rode in the header
+            _write_value(w, f.ftype, value)
+        last_fid = f.fid
+    w.byte(T_STOP)
+
+
+def _skip(r: _Reader, wtype: int) -> None:
+    if wtype in (T_TRUE, T_FALSE):
+        return
+    if wtype == T_BYTE:
+        r.byte()
+    elif wtype in (T_I16, T_I32, T_I64):
+        r.varint()
+    elif wtype == T_DOUBLE:
+        r.pos += 8
+    elif wtype == T_BINARY:
+        r.binary()
+    elif wtype in (T_LIST, T_SET):
+        head = r.byte()
+        size = head >> 4
+        et = head & 0x0F
+        if size == 15:
+            size = r.varint()
+        for _ in range(size):
+            _skip(r, et)
+    elif wtype == T_MAP:
+        size = r.varint()
+        if size:
+            head = r.byte()
+            for _ in range(size):
+                _skip(r, head >> 4)
+                _skip(r, head & 0x0F)
+    elif wtype == T_STRUCT:
+        while True:
+            b = r.byte()
+            if b == T_STOP:
+                return
+            wt = b & 0x0F
+            if (b >> 4) == 0:
+                r.zigzag(16)
+            _skip(r, wt)
+    else:
+        raise ValueError(f"cannot skip wire type {wtype}")
+
+
+def _read_value(r: _Reader, ftype: Tuple, wtype: int) -> Any:
+    kind = ftype[0]
+    if kind == "bool":
+        # field context: value is the header nibble; standalone: a byte
+        if wtype in (T_TRUE, T_FALSE):
+            return wtype == T_TRUE
+        return r.byte() == T_TRUE
+    if kind == "byte":
+        b = r.byte()
+        return b - 256 if b >= 128 else b
+    if kind in ("i16", "i32", "i64"):
+        return r.zigzag({"i16": 16, "i32": 32, "i64": 64}[kind])
+    if kind == "string":
+        return r.binary().decode("utf-8")
+    if kind == "binary":
+        return r.binary()
+    if kind in ("list", "set"):
+        head = r.byte()
+        size = head >> 4
+        if size == 15:
+            size = r.varint()
+        elem = ftype[1]
+        items = [
+            _read_value(r, elem, head & 0x0F) for _ in range(size)
+        ]
+        return set(items) if kind == "set" else items
+    if kind == "map":
+        size = r.varint()
+        out: Dict = {}
+        if size == 0:
+            return out
+        head = r.byte()
+        for _ in range(size):
+            k = _read_value(r, ftype[1], head >> 4)
+            v = _read_value(r, ftype[2], head & 0x0F)
+            out[k] = v
+        return out
+    if kind == "struct":
+        return _read_struct(r, ftype[1])
+    raise TypeError(f"unsupported type {kind}")
+
+
+def _read_struct(r: _Reader, schema: StructSchema) -> Dict:
+    fields = schema.by_id()
+    out: Dict = {}
+    last_fid = 0
+    while True:
+        head = r.byte()
+        if head == T_STOP:
+            return out
+        wtype = head & 0x0F
+        delta = head >> 4
+        fid = last_fid + delta if delta else r.zigzag(16)
+        last_fid = fid
+        f = fields.get(fid)
+        if f is None:
+            _skip(r, wtype)  # forward compatibility: unknown field
+            continue
+        out[f.name] = _read_value(r, f.ftype, wtype)
+
+
+def encode(schema: StructSchema, values: Dict) -> bytes:
+    """Serialize ``values`` (a plain dict keyed by field name) as one
+    compact-protocol struct."""
+    w = _Writer()
+    _write_struct(w, schema, values)
+    return bytes(w.buf)
+
+
+def decode(schema: StructSchema, data: bytes) -> Dict:
+    """Parse one compact-protocol struct into a dict keyed by field
+    name. Unknown fields are skipped (forward compatibility); absent
+    fields are absent from the dict (callers apply IDL defaults)."""
+    return _read_struct(_Reader(data), schema)
+
+
+# -- KvStore.thrift schemas (field ids + declaration order verbatim) -----
+
+# reference: openr/if/KvStore.thrift:21-41
+VALUE = StructSchema(
+    "Value",
+    (
+        Field(1, ("i64",), "version"),
+        Field(3, ("string",), "originatorId"),
+        Field(2, ("binary",), "value", optional=True),
+        Field(4, ("i64",), "ttl"),
+        Field(5, ("i64",), "ttlVersion"),
+        Field(6, ("i64",), "hash", optional=True),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:62-85
+KEY_SET_PARAMS = StructSchema(
+    "KeySetParams",
+    (
+        Field(2, ("map", ("string",), ("struct", VALUE)), "keyVals"),
+        Field(3, ("bool",), "solicitResponse"),
+        Field(5, ("list", ("string",)), "nodeIds", optional=True),
+        Field(6, ("string",), "floodRootId", optional=True),
+        Field(7, ("i64",), "timestamp_ms", optional=True),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:87-89
+KEY_GET_PARAMS = StructSchema(
+    "KeyGetParams", (Field(1, ("list", ("string",)), "keys"),)
+)
+
+# reference: openr/if/KvStore.thrift:91-115
+KEY_DUMP_PARAMS = StructSchema(
+    "KeyDumpParams",
+    (
+        Field(1, ("string",), "prefix"),
+        Field(3, ("set", ("string",)), "originatorIds"),
+        Field(6, ("bool",), "ignoreTtl"),
+        Field(7, ("bool",), "doNotPublishValue"),
+        Field(
+            2,
+            ("map", ("string",), ("struct", VALUE)),
+            "keyValHashes",
+            optional=True,
+        ),
+        Field(4, ("i32",), "oper", optional=True),
+        Field(5, ("list", ("string",)), "keys", optional=True),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:229-254
+PUBLICATION = StructSchema(
+    "Publication",
+    (
+        Field(2, ("map", ("string",), ("struct", VALUE)), "keyVals"),
+        Field(3, ("list", ("string",)), "expiredKeys"),
+        Field(4, ("list", ("string",)), "nodeIds", optional=True),
+        Field(5, ("list", ("string",)), "tobeUpdatedKeys", optional=True),
+        Field(6, ("string",), "floodRootId", optional=True),
+        Field(7, ("string",), "area"),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:205-219 (KvStoreRequest; the DUAL
+# and flood-topo arms are carried by the framework's own RPC surface)
+KV_STORE_REQUEST = StructSchema(
+    "KvStoreRequest",
+    (
+        Field(1, ("i32",), "cmd"),
+        Field(11, ("string",), "area"),
+        Field(
+            2, ("struct", KEY_SET_PARAMS), "keySetParams", optional=True
+        ),
+        Field(
+            3, ("struct", KEY_GET_PARAMS), "keyGetParams", optional=True
+        ),
+        Field(
+            6, ("struct", KEY_DUMP_PARAMS), "keyDumpParams", optional=True
+        ),
+    ),
+)
+
+# Command enum values (KvStore.thrift:47-52)
+CMD_KEY_SET = 1
+CMD_KEY_DUMP = 3
+
+
+# -- dataclass adapters --------------------------------------------------
+
+
+def _value_to_wire(v) -> Dict:
+    out = {
+        "version": v.version,
+        "originatorId": v.originator_id,
+        "ttl": v.ttl,
+        "ttlVersion": v.ttl_version,
+    }
+    if v.value is not None:
+        out["value"] = v.value
+    if v.hash is not None:
+        out["hash"] = v.hash
+    return out
+
+
+def _value_from_wire(d: Dict):
+    from openr_tpu.types import Value
+
+    return Value(
+        version=d.get("version", 0),
+        originator_id=d.get("originatorId", ""),
+        value=d.get("value"),
+        ttl=d.get("ttl", 0),
+        ttl_version=d.get("ttlVersion", 0),
+        hash=d.get("hash"),
+    )
+
+
+def encode_value(v) -> bytes:
+    return encode(VALUE, _value_to_wire(v))
+
+
+def decode_value(data: bytes):
+    return _value_from_wire(decode(VALUE, data))
+
+
+def encode_publication(pub) -> bytes:
+    out: Dict = {
+        "keyVals": {
+            k: _value_to_wire(v) for k, v in pub.key_vals.items()
+        },
+        "expiredKeys": list(pub.expired_keys),
+        "area": pub.area,
+    }
+    if pub.nodes is not None:
+        out["nodeIds"] = list(pub.nodes)
+    if pub.tobe_updated_keys is not None:
+        out["tobeUpdatedKeys"] = list(pub.tobe_updated_keys)
+    if pub.flood_root_id is not None:
+        out["floodRootId"] = pub.flood_root_id
+    return encode(PUBLICATION, out)
+
+
+def decode_publication(data: bytes):
+    from openr_tpu.types import Publication
+
+    d = decode(PUBLICATION, data)
+    return Publication(
+        key_vals={
+            k: _value_from_wire(v)
+            for k, v in d.get("keyVals", {}).items()
+        },
+        expired_keys=list(d.get("expiredKeys", [])),
+        nodes=d.get("nodeIds"),
+        tobe_updated_keys=d.get("tobeUpdatedKeys"),
+        flood_root_id=d.get("floodRootId"),
+        area=d.get("area", "0"),
+    )
+
+
+def encode_key_set_params(p) -> bytes:
+    """Our KeySetParams.originator_id rides the wire as the reference's
+    ``nodeIds`` traversal list (the reference appends each hop's node id
+    for loop suppression; the framework tracks only the sender)."""
+    out: Dict = {
+        "keyVals": {
+            k: _value_to_wire(v) for k, v in p.key_vals.items()
+        },
+        "solicitResponse": p.solicit_response,
+    }
+    if p.originator_id:
+        out["nodeIds"] = [p.originator_id]
+    if p.flood_root_id is not None:
+        out["floodRootId"] = p.flood_root_id
+    if p.timestamp_ms is not None:
+        out["timestamp_ms"] = p.timestamp_ms
+    return encode(KEY_SET_PARAMS, out)
+
+
+def decode_key_set_params(data: bytes):
+    from openr_tpu.types import KeySetParams
+
+    d = decode(KEY_SET_PARAMS, data)
+    node_ids = d.get("nodeIds") or []
+    return KeySetParams(
+        key_vals={
+            k: _value_from_wire(v)
+            for k, v in d.get("keyVals", {}).items()
+        },
+        solicit_response=d.get("solicitResponse", True),
+        originator_id=node_ids[-1] if node_ids else "",
+        flood_root_id=d.get("floodRootId"),
+        timestamp_ms=d.get("timestamp_ms"),
+    )
+
+
+def encode_key_dump_params(p) -> bytes:
+    out: Dict = {
+        "prefix": p.prefix,
+        "originatorIds": set(p.originator_ids),
+        "ignoreTtl": True,
+        "doNotPublishValue": False,
+    }
+    if p.key_val_hashes is not None:
+        out["keyValHashes"] = {
+            k: _value_to_wire(v) for k, v in p.key_val_hashes.items()
+        }
+    if p.keys is not None:
+        out["keys"] = list(p.keys)
+    return encode(KEY_DUMP_PARAMS, out)
+
+
+def decode_key_dump_params(data: bytes):
+    from openr_tpu.types import KeyDumpParams
+
+    d = decode(KEY_DUMP_PARAMS, data)
+    hashes = d.get("keyValHashes")
+    return KeyDumpParams(
+        prefix=d.get("prefix", ""),
+        originator_ids=set(d.get("originatorIds", ())),
+        keys=d.get("keys"),
+        key_val_hashes=(
+            {k: _value_from_wire(v) for k, v in hashes.items()}
+            if hashes is not None
+            else None
+        ),
+    )

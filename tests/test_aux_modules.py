@@ -128,6 +128,28 @@ class TestWatchdog:
             evb.stop()
             evb.join()
 
+    def test_quiet_evb_without_timers_stays_healthy(self):
+        """An evb with NO timers and NO traffic (the Monitor on a quiet
+        network) must not read as stalled: the run loop's idle wait is
+        bounded so last_loop_ts keeps refreshing."""
+        crashes = []
+        wd = Watchdog(
+            interval_s=0.05,
+            thread_timeout_s=0.3,
+            crash_handler=crashes.append,
+        )
+        evb = OpenrEventBase("quiet")  # no schedule_periodic anywhere
+        evb.run_in_thread()
+        wd.add_evb("quiet", evb)
+        wd.start()
+        try:
+            time.sleep(1.0)  # >> thread_timeout_s of pure idleness
+            assert crashes == []
+        finally:
+            wd.stop()
+            evb.stop()
+            evb.join()
+
     def test_healthy_evb_no_crash(self):
         crashes = []
         wd = Watchdog(
