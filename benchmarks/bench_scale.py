@@ -135,7 +135,8 @@ def run_churn(args):
 
 
 def all_sources_bench(
-    nodes: int, block: int, kernel: str = "ell"
+    nodes: int, block: int, kernel: str = "ell",
+    max_blocks: int = 0,
 ) -> dict:
     """All-sources SPF at ``nodes`` scale (BASELINE.json config 5 axis).
     kernel="ell": sliced-ELL gather+reduce blocks (the TPU-fast path);
@@ -160,8 +161,11 @@ def all_sources_bench(
         edges = int(
             sum((w < 2 ** 30 - 1).sum() for w in graph.w)
         )
+        import jax.numpy as jnp
 
         def solve_block(ids):
+            if not isinstance(ids, jax.Array):
+                ids = jnp.asarray(np.asarray(ids, dtype=np.int32))
             return spf_sparse.ell_distances_from_sources(
                 graph, ids, state=state
             )
@@ -179,16 +183,11 @@ def all_sources_bench(
     # warm-up one block (jit compile)
     np.asarray(solve_block(np.arange(block, dtype=np.int32)))
 
-    t0 = time.perf_counter()
-    sample_row0 = None
-    for start in range(0, n, block):
-        ids = np.arange(start, start + block, dtype=np.int32) % n
-        d_blk = np.asarray(solve_block(ids))
-        if start == 0:
-            sample_row0 = d_blk[0]
-    all_sources_ms = (time.perf_counter() - t0) * 1000
-
-    # device-only per-block: chain K data-dependent solves, one readback
+    # device-only per-block FIRST (chain K data-dependent solves, one
+    # readback — fixed transport cancels in the K-vs-1 difference): the
+    # full sweep below pushes the whole [N, N] product through the
+    # relay (~20 MB/s observed), and that backlog would otherwise
+    # inflate the chained timing by 2 orders of magnitude
     device_only_block_ms = None
     if platform != "cpu":
         ids0 = np.arange(block, dtype=np.int32)
@@ -200,13 +199,34 @@ def all_sources_bench(
                 # data dependence: seed block i from block i-1's result
                 ids = ids0 if d is None else (ids0 + d[0, 0] % n) % n
                 d = solve_block(ids)
-            np.asarray(d)
+            np.asarray(d[0, 0])
             return (time.perf_counter() - t0) * 1000.0
 
         time_chain(1)
-        t1 = statistics.median(time_chain(1) for _ in range(3))
-        tk = statistics.median(time_chain(4) for _ in range(3))
+        t1 = statistics.median(time_chain(1) for _ in range(5))
+        tk = statistics.median(time_chain(4) for _ in range(5))
         device_only_block_ms = round(max(0.0, (tk - t1) / 3.0), 3)
+
+    # e2e streaming sweep: solve + read back every block ([N, N] int32
+    # product on the host at the end — transfer-dominated on the relay)
+    import jax.numpy as jnp
+
+    id_blocks = [
+        jnp.asarray(np.arange(s, s + block, dtype=np.int32) % n)
+        for s in range(0, n, block)
+    ]
+    if max_blocks > 0:
+        # at 100k the full [N, N] readback is ~40 GB — measure a
+        # representative slice and extrapolate (device_only_* already
+        # covers the compute claim; the sweep is transfer-bound)
+        id_blocks = id_blocks[:max_blocks]
+    t0 = time.perf_counter()
+    sample_row0 = None
+    for i, ids in enumerate(id_blocks):
+        d_blk = np.asarray(solve_block(ids))
+        if i == 0:
+            sample_row0 = d_blk[0]
+    all_sources_ms = (time.perf_counter() - t0) * 1000
 
     # oracle spot checks: row 0 vs host Dijkstra
     oracle = ls.run_spf(graph.node_names[0])
@@ -228,6 +248,8 @@ def all_sources_bench(
         "edge_compile_ms": round(compile_ms, 1),
         "all_sources_ms": round(all_sources_ms, 1),
         "source_block": block,
+        "swept_blocks": len(id_blocks),
+        "total_blocks": n_blocks,
         "platform": platform,
         "oracle_spot_check": "passed",
     }
@@ -236,6 +258,18 @@ def all_sources_bench(
         out["device_only_all_sources_ms"] = round(
             device_only_block_ms * n_blocks, 1
         )
+        # the remainder of the e2e sweep is host<->device transfer: the
+        # [N, N] int32 product read back block-by-block (~20 MB/s
+        # through the axon relay; orders of magnitude faster on a
+        # directly-attached chip)
+        out["readback_mb"] = round(n * block * len(id_blocks) * 4 / 1e6, 1)
+        out["transfer_ms"] = round(
+            max(
+                0.0,
+                all_sources_ms - device_only_block_ms * len(id_blocks),
+            ),
+            1,
+        )
     return out
 
 
@@ -243,6 +277,9 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)
     p.add_argument("--block", type=int, default=1024)
+    p.add_argument("--max-blocks", type=int, default=0,
+                   help="sweep only the first K source blocks (0 = all); "
+                        "the 100k full-product readback is ~40 GB")
     p.add_argument("--kernel", choices=("ell", "edges"), default="ell")
     p.add_argument("--churn", action="store_true",
                    help="run the incremental ELL churn scenario instead "
@@ -254,7 +291,10 @@ def main(argv=None):
         return
     print(
         json.dumps(
-            all_sources_bench(args.nodes, args.block, args.kernel)
+            all_sources_bench(
+                args.nodes, args.block, args.kernel,
+                max_blocks=args.max_blocks,
+            )
         ),
         flush=True,
     )

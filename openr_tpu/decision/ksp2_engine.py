@@ -1,0 +1,725 @@
+"""Incremental KSP2_ED_ECMP engine: persist paths across churn, re-solve
+only affected destinations.
+
+The per-build cost of the original device prefetch
+(spf_solver._prefetch_ksp2_paths) is O(D) host work per churn event —
+first-path traces, mask building, masked-row tracing and route assembly
+for EVERY KSP2 destination — even though one adjacency change leaves
+almost every destination's paths untouched. At fabric scale that host
+work dominates the rebuild (reference convergence goal is <100 ms,
+openr/docs/Introduction/Overview.md:28; the per-destination semantics
+being preserved are LinkState.cpp:763 getKthPaths and Decision.cpp:908
+selectBestPathsKsp2).
+
+This engine caches, per destination: the traced first/second paths, the
+first-path link (exclusion) set, and the masked-SPF distance row. On a
+topology change it determines the exact set of destinations whose paths
+may differ — everything else is primed straight from the cache — using
+a sound distance-algebra test:
+
+  For a changed directed edge C = (u, v) with weight w, C lies on some
+  shortest path src -> dst iff
+
+      d(src, u) + w + d(v, dst) == d(src, dst)
+
+  If no changed edge lies on dst's shortest-path DAG under EITHER the
+  old or the new distances, the DAG restricted to dst's explored region
+  is unchanged, so the (canonically ordered) first-path trace output is
+  unchanged. The same test bounds the MASKED graph of the second-path
+  solve: masking only removes edges, so base distances lower-bound
+  masked distances, giving a conservative (never unsound) filter.
+
+  Soundness sketch for multiple simultaneous changes {C_i}: if a
+  distance d(x, y) differs between the old and new graphs, some C_i
+  lies on an old or new shortest x->y path (otherwise both old and new
+  optima would be achievable in the other graph). Applying this to the
+  endpoints of any DAG(dst) link whose membership flips places some
+  C_i on DAG_old(dst) or DAG_new(dst) — exactly what the test checks.
+
+The distances come from a device-resident all-pairs matrix over the
+sliced-ELL bands (ops/spf_sparse.py): at KSP2 scale (n_pad <= 4096, the
+engine's activation bound) a full all-sources solve is ONE source block
+(~1-2 ms on-device), so every churn event recomputes it, swaps it with
+the previous event's matrix (kept resident — no transfer), and reads
+back one fused packet: the SPF view batch (served to SpfView, saving
+its separate dispatch) plus old/new distance rows for the changed-edge
+endpoints. Steady-state churn that touches no cached path costs ONE
+device round trip and O(changed) host work.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+import numpy as np
+
+from openr_tpu.graph.linkstate import Link, LinkState
+from openr_tpu.ops.spf import INF
+
+# engine activation bounds: the resident all-pairs matrix is [n, n]
+ENGINE_MAX_NODES = 4096
+# churn larger than this falls back to a full (cold) rebuild
+ENGINE_MAX_CHANGED_PAIRS = 64
+ENGINE_MAX_ENDPOINTS = 32
+# if more than this fraction of destinations is affected, a cold
+# rebuild is cheaper than the incremental machinery
+ENGINE_FULL_REBUILD_FRACTION = 3  # affected * N > dsts  -> cold
+
+
+def _counters():
+    from openr_tpu.decision import spf_solver as _ss
+
+    return _ss.SPF_COUNTERS
+
+
+def trace_paths_from_row(
+    src: str,
+    dest: str,
+    index: Dict[str, int],
+    dlist,
+    excluded: Set[Link],
+    cands_of,
+    transit_blocked: Set[str],
+):
+    """Enumerate link-disjoint shortest paths src -> dest from a
+    distance row — byte-identical to LinkState._trace_one_path over the
+    same SPF (both walk predecessor links in canonical sorted order;
+    reference: LinkState.cpp:399 traceOnePath)."""
+    inf = int(INF)
+    did = index.get(dest)
+    if did is None or dlist[did] >= inf:
+        return []
+
+    visited: Set[Link] = set()
+    preds: Dict[str, list] = {}
+
+    def preds_of(v: str):
+        got = preds.get(v)
+        if got is None:
+            dv = dlist[index[v]]
+            got = preds[v] = [
+                (link, u)
+                for link, u, uid, w in cands_of(v)
+                if uid is not None
+                and link not in excluded
+                and (u == src or u not in transit_blocked)
+                and dlist[uid] < inf
+                and dlist[uid] + w == dv
+            ]
+        return got
+
+    def trace_one(v: str):
+        if v == src:
+            return []
+        for link, u in preds_of(v):
+            if link in visited:
+                continue
+            visited.add(link)
+            sub = trace_one(u)
+            if sub is not None:
+                sub.append(link)
+                return sub
+        return None
+
+    paths = []
+    path = trace_one(dest)
+    while path:
+        paths.append(path)
+        path = trace_one(dest)
+    return paths
+
+
+def make_cands_of(ls: LinkState, node_index: Dict[str, int]):
+    """Per-build candidate list factory shared by the trace calls: up
+    links of each node in canonical order with (origin, origin id,
+    metric) pre-resolved."""
+    in_cands: Dict[str, list] = {}
+
+    def cands_of(v: str):
+        got = in_cands.get(v)
+        if got is None:
+            got = in_cands[v] = [
+                (
+                    link,
+                    link.other_node(v),
+                    node_index.get(link.other_node(v)),
+                    link.metric_from(link.other_node(v)),
+                )
+                for link in ls.ordered_links_from_node(v)
+                if link.is_up()
+            ]
+        return got
+
+    return cands_of
+
+
+def _path_nodes(src: str, path: List[Link]) -> List[str]:
+    """Nodes visited after src along a traced path."""
+    out = []
+    cur = src
+    for link in path:
+        cur = link.other_node(cur)
+        out.append(cur)
+    return out
+
+
+def _pad_ids(ids: List[int], bucket_min: int = 8) -> np.ndarray:
+    """Pad an id list to a power-of-two bucket by repeating the first id
+    (inert for row gathers) so jit shapes stay bounded."""
+    bucket = bucket_min
+    while bucket < len(ids):
+        bucket *= 2
+    return np.asarray(
+        ids + [ids[0]] * (bucket - len(ids)), dtype=np.int32
+    )
+
+
+class Ksp2Engine:
+    """Per-(LinkState, root) incremental KSP2 state. Invalid until the
+    first successful cold build."""
+
+    def __init__(self, src_name: str) -> None:
+        self.src_name = src_name
+        self.valid = False
+        self.last_affected: Optional[Set[str]] = None
+
+    # -- public entry ------------------------------------------------------
+
+    def sync(self, ls: LinkState, dsts: List[str]) -> Optional[Set[str]]:
+        """Bring the cache to ls.topology_version, prime the LinkState
+        kth-path cache for every destination, and return the set of
+        destination names whose paths may have changed (for route
+        reuse). Returns None when the engine had to cold-rebuild (no
+        reuse this build) or cannot run (caller falls back)."""
+        self.last_affected = None
+        from openr_tpu.decision import spf_solver as _ss
+
+        state = _ss._ELL_RESIDENT.state_for(ls)
+        if (
+            not self.valid
+            or state is not getattr(self, "state", None)
+            or dsts != self.dsts
+            or self.sid != state.graph.node_index.get(self.src_name)
+        ):
+            self._cold_build(ls, state, dsts)
+            return None
+        if (
+            ls.topology_version == self.version
+            and ls.attributes_version == self.aversion
+        ):
+            # nothing changed since the last build; the kth-path cache
+            # was not invalidated, so priming is already in place
+            self.last_affected = set()
+            return set()
+        affected_nodes = ls.affected_since(self.version)
+        attr_nodes = ls.attr_affected_since(self.aversion)
+        if affected_nodes is None or attr_nodes is None:
+            self._cold_build(ls, state, dsts)
+            return None
+        affected_nodes = set(affected_nodes) | set(attr_nodes)
+        changed = self._diff_pairs(ls, affected_nodes)
+        if changed is None or len(changed) > ENGINE_MAX_CHANGED_PAIRS:
+            self._cold_build(ls, state, dsts)
+            return None
+        ov_flips, label_flips = self._diff_nodes(ls, affected_nodes)
+        if self.src_name in ov_flips:
+            # the root's own drain state gates route selection broadly
+            self._cold_build(ls, state, dsts)
+            return None
+        # an overload flip changes the EFFECTIVE weight (INF <-> w) of
+        # every edge out of the node even though raw metrics are
+        # untouched: inject those pairs so the membership tests run with
+        # eff() consulting the old vs new overload maps (node_users
+        # alone cannot recover destinations that should START routing
+        # through a just-undrained node)
+        for x in ov_flips:
+            for link in ls.links_from_node(x):
+                if not link.is_up():
+                    continue
+                pair = (x, link.other_node(x))
+                if pair not in changed:
+                    w = self.eff_w.get(
+                        pair, min(int(link.metric_from(x)), INF - 1)
+                    )
+                    sig = self.attr_sig.get(pair, ())
+                    changed[pair] = (w, w, sig, sig)
+        if len(changed) > ENGINE_MAX_CHANGED_PAIRS:
+            self._cold_build(ls, state, dsts)
+            return None
+
+        graph = state.graph
+        ep = sorted(
+            {graph.node_index[u] for (u, v), _ in changed.items()}
+            | {graph.node_index[v] for (u, v), _ in changed.items()}
+        )
+        if len(ep) > ENGINE_MAX_ENDPOINTS:
+            self._cold_build(ls, state, dsts)
+            return None
+        if not ep:
+            ep = [self.sid]
+
+        # one fused dispatch: all-pairs + view + old/new endpoint rows
+        from openr_tpu.ops import spf_sparse
+
+        view_srcs = spf_sparse.ell_source_batch(graph, ls, self.src_name)
+        srcs_dev, w_sv = spf_sparse._batch_args(graph, view_srcs)
+        ep_ids = _pad_ids(ep)
+        d_all_dev, packed = spf_sparse.ell_all_view_rows(
+            state, srcs_dev, w_sv, ep_ids, self.d_prev_dev
+        )
+        b = len(view_srcs)
+        p = len(ep_ids)
+        view_packed = packed[: 2 * b]
+        rows_new = {int(i): packed[2 * b + x] for x, i in enumerate(ep_ids)}
+        rows_old = {
+            int(i): packed[2 * b + p + x] for x, i in enumerate(ep_ids)
+        }
+        self._preload_view(ls, graph, view_srcs, view_packed)
+        d_new_src = view_packed[0].astype(np.int64)
+
+        affected = self._affected_dsts(
+            ls, graph, changed, d_new_src, rows_new, rows_old
+        )
+        for x in ov_flips | label_flips:
+            if x in self.dst_pos:
+                affected.add(x)
+            affected |= self.node_users.get(x, set())
+        affected &= set(self.dst_pos)
+
+        if len(affected) * ENGINE_FULL_REBUILD_FRACTION > len(dsts):
+            self._cold_build(ls, state, dsts)
+            return None
+
+        if affected:
+            ok = self._recompute(ls, state, sorted(affected), d_new_src)
+            if not ok:
+                self._cold_build(ls, state, dsts)
+                return None
+        self._prime_all(ls)
+
+        # commit snapshots
+        for pair, (_w_old, w_new, _sig_old, sig_new) in changed.items():
+            if w_new >= INF and sig_new is None:
+                self.eff_w.pop(pair, None)
+                self.attr_sig.pop(pair, None)
+                for end in pair:
+                    self.pairs_by_node.get(end, set()).discard(pair)
+            else:
+                self.eff_w[pair] = w_new
+                self.attr_sig[pair] = sig_new
+                for end in pair:
+                    self.pairs_by_node.setdefault(end, set()).add(pair)
+        for x in ov_flips:
+            self.ov[x] = ls.is_node_overloaded(x)
+        for x in label_flips:
+            db = ls.get_adjacency_databases().get(x)
+            self.node_label[x] = db.node_label if db else 0
+        if any(
+            w_old >= INF or w_new >= INF
+            for (w_old, w_new, _so, _sn) in changed.values()
+        ):
+            self.ecc_hops = ls.get_max_hops_to_node(self.src_name)
+        self.d_base = d_new_src.astype(np.int32)
+        self.d_prev_dev = d_all_dev
+        self.version = ls.topology_version
+        self.aversion = ls.attributes_version
+        _counters()["decision.ksp2_incremental_syncs"] += 1
+        _counters()["decision.ksp2_affected_dsts"] += len(affected)
+        self.last_affected = affected
+        return affected
+
+    # -- cold build --------------------------------------------------------
+
+    def _cold_build(self, ls: LinkState, state, dsts: List[str]) -> None:
+        from openr_tpu.decision import spf_solver as _ss
+        from openr_tpu.ops import spf_sparse
+        import jax.numpy as jnp
+
+        self.valid = False
+        graph = state.graph
+        self.state = state
+        self.dsts = list(dsts)
+        self.sid = graph.node_index.get(self.src_name)
+        if self.sid is None:
+            return
+        self.dst_pos = {d: i for i, d in enumerate(dsts)}
+        n = graph.n_pad
+
+        # fused dispatch seeds the resident all-pairs matrix AND serves
+        # the view; d_prev is a placeholder on the cold path
+        view_srcs = spf_sparse.ell_source_batch(graph, ls, self.src_name)
+        srcs_dev, w_sv = spf_sparse._batch_args(graph, view_srcs)
+        placeholder = getattr(self, "d_prev_dev", None)
+        if placeholder is None or placeholder.shape != (n, n):
+            placeholder = jnp.zeros((n, n), dtype=jnp.int32)
+        d_all_dev, packed = spf_sparse.ell_all_view_rows(
+            state, srcs_dev, w_sv, np.asarray([self.sid], np.int32),
+            placeholder,
+        )
+        b = len(view_srcs)
+        self._preload_view(ls, graph, view_srcs, packed[: 2 * b])
+        self.d_base = packed[0].astype(np.int32)
+        self.d_prev_dev = d_all_dev
+
+        # first paths traced from the device base row (identical to the
+        # host get_kth_paths(.., 1) trace — same canonical order)
+        cands_of = make_cands_of(ls, graph.node_index)
+        transit_blocked = {
+            name
+            for name in graph.node_names
+            if ls.is_node_overloaded(name) and name != self.src_name
+        }
+        dlist = self.d_base.tolist()
+        self.first_paths: Dict[str, List[List[Link]]] = {}
+        self.second_paths: Dict[str, List[List[Link]]] = {}
+        self.excl: Dict[str, Set[Link]] = {}
+        self.node_users: Dict[str, Set[str]] = {}
+        for dst in dsts:
+            paths = trace_paths_from_row(
+                self.src_name, dst, graph.node_index, dlist,
+                set(), cands_of, transit_blocked,
+            )
+            self.first_paths[dst] = paths
+            self.excl[dst] = {l for p in paths for l in p}
+
+        # masked rows for every destination, chunked like the original
+        # prefetch; second paths traced from them
+        self.dm = np.full((len(dsts), n), INF, dtype=np.int32)
+        self.host_dsts: Set[str] = set()
+        self._solve_masked_batches(
+            ls, state, dsts, cands_of, transit_blocked
+        )
+        self._prime_all(ls)
+
+        # graph-attribute snapshots for churn diffing
+        self.eff_w, self.attr_sig = {}, {}
+        for name in graph.node_names:
+            if name not in graph.node_index:
+                continue
+            for link in ls.links_from_node(name):
+                if not link.is_up():
+                    continue
+                other = link.other_node(name)
+                pair = (name, other)
+                w = min(int(link.metric_from(name)), INF - 1)
+                if pair not in self.eff_w or w < self.eff_w[pair]:
+                    self.eff_w[pair] = w
+                self.attr_sig[pair] = self._pair_sig(ls, name, other)
+        self.pairs_by_node = {}
+        for pair in self.eff_w:
+            self.pairs_by_node.setdefault(pair[0], set()).add(pair)
+            self.pairs_by_node.setdefault(pair[1], set()).add(pair)
+        self.ov = {
+            name: ls.is_node_overloaded(name)
+            for name in graph.node_names
+        }
+        self.node_label = {
+            name: db.node_label
+            for name, db in ls.get_adjacency_databases().items()
+        }
+        self.ecc_hops = ls.get_max_hops_to_node(self.src_name)
+        self.version = ls.topology_version
+        self.aversion = ls.attributes_version
+        self.valid = True
+        _counters()["decision.ksp2_cold_builds"] += 1
+
+    # -- diffing -----------------------------------------------------------
+
+    @staticmethod
+    def _pair_sig(ls: LinkState, a: str, b: str) -> Tuple:
+        """Materialization-relevant attributes of the (a, b) link
+        direction set: next-hop addresses, interfaces, adj labels, and
+        canonical link identity (identity changes can reorder the
+        deterministic trace's candidate list)."""
+        sig = []
+        for link in ls.ordered_links_from_node(a):
+            if not link.is_up() or link.other_node(a) != b:
+                continue
+            sig.append(
+                (
+                    link.iface_from(a),
+                    link.nh_v4_from(a).addr,
+                    link.nh_v6_from(a).addr,
+                    link.adj_label_from(a),
+                    link.metric_from(a),
+                )
+            )
+        return tuple(sig)
+
+    def _diff_pairs(
+        self, ls: LinkState, affected_nodes: Set[str]
+    ) -> Optional[Dict[Tuple[str, str], Tuple]]:
+        """Directed pairs incident to the affected nodes whose collapsed
+        min-metric or materialization attributes changed:
+        (u, v) -> (w_old, w_new, sig_old, sig_new). Returns None when a
+        parallel-link pair appears (the ELL collapse cannot mask one of
+        parallel links; the caller cold-rebuilds and the per-destination
+        host fallback machinery takes over)."""
+        changed: Dict[Tuple[str, str], Tuple] = {}
+        graph_index = self.state.graph.node_index
+        seen_pairs: Set[Tuple[str, str]] = set()
+        for x in affected_nodes:
+            if x not in graph_index:
+                return None  # node set changed
+            neighbors: Set[str] = set()
+            per_pair_links: Dict[str, int] = {}
+            for link in ls.links_from_node(x):
+                if not link.is_up():
+                    continue
+                other = link.other_node(x)
+                neighbors.add(other)
+                per_pair_links[other] = per_pair_links.get(other, 0) + 1
+            if any(c > 1 for c in per_pair_links.values()):
+                return None  # parallel links: engine does not model
+            # pairs that vanished entirely (link down/removed: neither
+            # direction survives in the current link set) — probed via
+            # the incident-pair index, NOT a scan of every pair (at 4k
+            # nodes that scan made each churn event O(affected x E))
+            for (u, v) in list(self.pairs_by_node.get(x, ())):
+                if (u, v) in seen_pairs:
+                    continue
+                other = v if u == x else u
+                if other not in neighbors:
+                    changed[(u, v)] = (
+                        self.eff_w.get((u, v), INF), INF, None, None,
+                    )
+                    seen_pairs.add((u, v))
+            for other in neighbors:
+                for pair in ((x, other), (other, x)):
+                    if pair in seen_pairs:
+                        continue
+                    seen_pairs.add(pair)
+                    a, bnode = pair
+                    w_new = INF
+                    for link in ls.links_from_node(a):
+                        if link.is_up() and link.other_node(a) == bnode:
+                            w_new = min(
+                                w_new,
+                                min(int(link.metric_from(a)), INF - 1),
+                            )
+                    sig_new = self._pair_sig(ls, a, bnode)
+                    w_old = self.eff_w.get(pair, INF)
+                    sig_old = self.attr_sig.get(pair, ())
+                    if w_old != w_new or sig_old != sig_new:
+                        changed[pair] = (w_old, w_new, sig_old, sig_new)
+        return changed
+
+    def _diff_nodes(
+        self, ls: LinkState, affected_nodes: Set[str]
+    ) -> Tuple[Set[str], Set[str]]:
+        ov_flips = {
+            x
+            for x in affected_nodes
+            if self.ov.get(x, False) != ls.is_node_overloaded(x)
+        }
+        dbs = ls.get_adjacency_databases()
+        label_flips = {
+            x
+            for x in affected_nodes
+            if self.node_label.get(x, 0)
+            != (dbs[x].node_label if x in dbs else 0)
+        }
+        return ov_flips, label_flips
+
+    # -- affected-set computation -----------------------------------------
+
+    def _affected_dsts(
+        self,
+        ls: LinkState,
+        graph,
+        changed: Dict[Tuple[str, str], Tuple],
+        d_new_src: np.ndarray,
+        rows_new: Dict[int, np.ndarray],
+        rows_old: Dict[int, np.ndarray],
+    ) -> Set[str]:
+        index = graph.node_index
+        dst_ids = np.asarray(
+            [index[d] for d in self.dsts], dtype=np.int64
+        )
+        d_old_src = self.d_base.astype(np.int64)
+        d_new = d_new_src  # already int64
+        inf = np.int64(INF)
+
+        aff = d_new[dst_ids] != d_old_src[dst_ids]
+
+        dm = self.dm.astype(np.int64, copy=False)
+        dm_total = dm[np.arange(len(self.dsts)), dst_ids]
+
+        def eff(w, origin, ov_map):
+            if w >= INF:
+                return inf
+            if ov_map.get(origin, False) and origin != self.src_name:
+                return inf
+            return np.int64(w)
+
+        ov_new = {
+            x: ls.is_node_overloaded(x) for x in graph.node_names
+        }
+        for (u, v), (w_old, w_new, _so, _sn) in changed.items():
+            uid, vid = index[u], index[v]
+            r_old_v = rows_old[vid].astype(np.int64, copy=False)
+            r_new_v = rows_new[vid].astype(np.int64, copy=False)
+            wo = eff(w_old, u, self.ov)
+            wn = eff(w_new, u, ov_new)
+            # first-path DAG membership, old and new graphs (exact)
+            if wo < inf:
+                lhs = d_old_src[uid] + wo + r_old_v[dst_ids]
+                valid = (
+                    (d_old_src[uid] < inf)
+                    & (r_old_v[dst_ids] < inf)
+                )
+                aff |= valid & (lhs == d_old_src[dst_ids])
+            if wn < inf:
+                lhs = d_new[uid] + wn + r_new_v[dst_ids]
+                valid = (d_new[uid] < inf) & (r_new_v[dst_ids] < inf)
+                aff |= valid & (lhs == d_new[dst_ids])
+            # masked-graph membership bound (conservative: base
+            # distances lower-bound masked distances). A destination
+            # with dm_total == INF is disconnected in its masked graph;
+            # metric-only churn cannot create connectivity, so those
+            # rows are only dirtied by a link APPEARING (w: INF ->
+            # finite) — without this guard the <= test against INF
+            # fires for every disconnected row and the engine
+            # degenerates to cold rebuilds.
+            reachable_m = dm_total < inf
+            if wo < inf:
+                lhs = dm[:, uid] + wo + r_old_v[dst_ids]
+                valid = (
+                    (dm[:, uid] < inf)
+                    & (r_old_v[dst_ids] < inf)
+                    & reachable_m
+                )
+                aff |= valid & (lhs <= dm_total)
+            if wn < inf:
+                lhs = d_new[uid] + wn + r_new_v[dst_ids]
+                valid = (
+                    (d_new[uid] < inf)
+                    & (r_new_v[dst_ids] < inf)
+                    & reachable_m
+                )
+                aff |= valid & (lhs <= dm_total)
+            if wo >= inf and wn < inf:
+                # edge usable where it was not (link appeared, or its
+                # origin was undrained — hence EFFECTIVE weights, not
+                # raw: overload flips are injected with equal raw w):
+                # disconnected masked rows may reconnect
+                aff |= ~reachable_m
+        out = {self.dsts[i] for i in np.flatnonzero(aff)}
+        # host-fallback destinations are recomputed lazily by LinkState;
+        # never claim them unchanged
+        out |= self.host_dsts
+        return out
+
+    # -- recompute ---------------------------------------------------------
+
+    def _recompute(
+        self, ls: LinkState, state, affected: List[str],
+        d_new_src: np.ndarray,
+    ) -> bool:
+        from openr_tpu.decision import spf_solver as _ss
+        from openr_tpu.ops import spf_sparse
+
+        graph = state.graph
+        cands_of = make_cands_of(ls, graph.node_index)
+        transit_blocked = {
+            name
+            for name in graph.node_names
+            if ls.is_node_overloaded(name) and name != self.src_name
+        }
+        dlist = d_new_src.astype(np.int32).tolist()
+        for dst in affected:
+            # drop stale reverse-index entries
+            for path in self.first_paths.get(dst, []) + self.second_paths.get(
+                dst, []
+            ):
+                for x in _path_nodes(self.src_name, path):
+                    users = self.node_users.get(x)
+                    if users is not None:
+                        users.discard(dst)
+            paths = trace_paths_from_row(
+                self.src_name, dst, graph.node_index, dlist,
+                set(), cands_of, transit_blocked,
+            )
+            self.first_paths[dst] = paths
+            self.excl[dst] = {l for p in paths for l in p}
+
+        if ls.parallel_pairs():
+            return False  # engine precondition broken: cold-rebuild
+        self.host_dsts -= set(affected)
+        self._solve_masked_batches(
+            ls, state, affected, cands_of, transit_blocked
+        )
+        return True
+
+    def _solve_masked_batches(
+        self, ls, state, dsts, cands_of, transit_blocked
+    ) -> None:
+        """Masked-SPF rows + second-path traces + dm/node_users updates
+        for a destination subset (shared by cold build and incremental
+        recompute; the two loops MUST stay identical — fallback
+        accounting drifting between them was a review finding)."""
+        from openr_tpu.decision import spf_solver as _ss
+        from openr_tpu.ops import spf_sparse
+
+        graph = state.graph
+        parallel = ls.parallel_pairs()
+        chunk = _ss._ksp2_chunk(graph)
+        for start in range(0, len(dsts), chunk):
+            batch = dsts[start : start + chunk]
+            # pad to a power-of-two bucket (capped at the chunk) so the
+            # masked kernel compiles a handful of shapes, not one per
+            # distinct affected-set size
+            bucket = 8
+            while bucket < len(batch):
+                bucket *= 2
+            bucket = min(bucket, chunk)
+            excl_sets = [self.excl[d] for d in batch]
+            pad = bucket - len(batch)
+            masks, ok = spf_sparse.build_edge_masks(
+                graph, excl_sets + [set()] * pad, parallel
+            )
+            drows = spf_sparse.ell_masked_distances_resident(
+                state, self.sid, masks
+            )
+            _counters()["decision.ksp2_device_batches"] += 1
+            for i, dst in enumerate(batch):
+                if not ok[i]:
+                    _counters()["decision.ksp2_host_fallbacks"] += 1
+                    self.host_dsts.add(dst)
+                    self.second_paths.pop(dst, None)
+                    self.dm[self.dst_pos[dst]] = INF
+                    continue
+                self.dm[self.dst_pos[dst]] = drows[i]
+                self.second_paths[dst] = trace_paths_from_row(
+                    self.src_name, dst, graph.node_index,
+                    drows[i].tolist(), self.excl[dst], cands_of,
+                    transit_blocked,
+                )
+        for dst in dsts:
+            if dst in self.host_dsts:
+                continue
+            for path in self.first_paths[dst] + self.second_paths.get(
+                dst, []
+            ):
+                for x in _path_nodes(self.src_name, path):
+                    self.node_users.setdefault(x, set()).add(dst)
+
+    # -- priming / view preload -------------------------------------------
+
+    def _prime_all(self, ls: LinkState) -> None:
+        for dst in self.dsts:
+            if dst in self.host_dsts:
+                continue  # LinkState computes these lazily (host SPF)
+            ls.prime_kth_paths(
+                self.src_name, dst, 1, self.first_paths[dst]
+            )
+            ls.prime_kth_paths(
+                self.src_name, dst, 2, self.second_paths.get(dst, [])
+            )
+
+    def _preload_view(self, ls, graph, view_srcs, view_packed) -> None:
+        from openr_tpu.decision import spf_solver as _ss
+
+        _ss._ELL_RESIDENT.preload_view(
+            ls, graph, list(view_srcs), np.asarray(view_packed)
+        )

@@ -20,6 +20,9 @@ class PrefixState:
         self._prefixes: Dict[IpPrefix, PrefixEntries] = {}
         # reverse index: (node, area) -> set of prefixes it advertises
         self._node_to_prefixes: Dict[NodeAndArea, Set[IpPrefix]] = {}
+        # bumped whenever any entry actually changes; route caches key
+        # their validity off this (solver per-prefix route reuse)
+        self.version = 0
 
     def prefixes(self) -> Dict[IpPrefix, PrefixEntries]:
         return self._prefixes
@@ -41,6 +44,8 @@ class PrefixState:
             for entry in db.prefix_entries:
                 if self._remove_entry(node_area, entry.prefix):
                     changed.add(entry.prefix)
+            if changed:
+                self.version += 1
             return changed
 
         new_prefixes = {e.prefix: e for e in db.prefix_entries}
@@ -58,6 +63,8 @@ class PrefixState:
                 entries[node_area] = entry
                 self._node_to_prefixes.setdefault(node_area, set()).add(prefix)
                 changed.add(prefix)
+        if changed:
+            self.version += 1
         return changed
 
     def delete_prefix_database(self, node: str, area: str) -> Set[IpPrefix]:
@@ -67,6 +74,8 @@ class PrefixState:
         for prefix in list(self._node_to_prefixes.get(node_area, ())):
             if self._remove_entry(node_area, prefix):
                 changed.add(prefix)
+        if changed:
+            self.version += 1
         return changed
 
     def _remove_entry(self, node_area: NodeAndArea, prefix: IpPrefix) -> bool:

@@ -167,6 +167,10 @@ SPF_COUNTERS: Dict[str, int] = {
     "decision.ell_patches": 0,
     "decision.ksp2_device_batches": 0,
     "decision.ksp2_host_fallbacks": 0,
+    "decision.ksp2_cold_builds": 0,
+    "decision.ksp2_incremental_syncs": 0,
+    "decision.ksp2_affected_dsts": 0,
+    "decision.ksp2_route_reuses": 0,
 }
 
 # KSP2 device prefetch: below this many KSP2 destinations the host path
@@ -444,6 +448,23 @@ class _EllResidentCache:
 
         # ls -> (synced topology_version, EllState)
         self._cache = weakref.WeakKeyDictionary()
+        # (version, root) -> (weakref(ls), graph, srcs, packed): a view
+        # the KSP2 engine already computed inside its fused dispatch
+        # this build — consumed (popped) by view_packed so SpfView does
+        # not pay a second device round trip. Single entry, consume-
+        # once, identity checked through the weakref: id() reuse after
+        # gc must never serve a dead graph's rows.
+        self._preloaded: Dict[tuple, tuple] = {}
+
+    def preload_view(self, ls, graph, srcs, packed) -> None:
+        import weakref
+
+        root = graph.node_names[srcs[0]]
+        self._preloaded = {
+            (ls.topology_version, root): (
+                weakref.ref(ls), graph, srcs, packed,
+            )
+        }
 
     def _sync(self, ls: LinkState):
         """Resolve the resident state for ``ls``: returns
@@ -493,6 +514,13 @@ class _EllResidentCache:
         B first-hop rows)."""
         from openr_tpu.ops import spf_sparse
 
+        preloaded = self._preloaded.pop(
+            (ls.topology_version, root), None
+        )
+        if preloaded is not None:
+            ls_ref, graph, srcs, packed = preloaded
+            if ls_ref() is ls:
+                return graph, srcs, packed
         state, pending = self._sync(ls)
         graph = pending if pending is not None else state.graph
         srcs = spf_sparse.ell_source_batch(graph, ls, root)
@@ -533,6 +561,20 @@ class SpfSolver:
         self._label_cache: Dict[str, tuple] = {}
         # per-(graph identity, topology_version, root) SPF view cache
         self._views: Dict[Tuple[int, int, str], SpfView] = {}
+        # incremental KSP2 engines keyed weakly by LinkState: a dead
+        # area graph must release its engine (resident [n, n] device
+        # matrix + path caches) instead of pinning it until eviction
+        import weakref
+
+        self._ksp2_engines = weakref.WeakKeyDictionary()
+        # per-prefix route reuse across churn (driven by the engine's
+        # affected set): prefix -> (RibUnicastEntry | None, best result)
+        self._route_cache: Dict[IpPrefix, tuple] = {}
+        self._route_cache_meta: Optional[tuple] = None
+        # nodes the engine's affected set actually covers (its KSP2
+        # destinations); reuse is only sound for prefixes whose
+        # advertisers all lie inside this set
+        self._ksp2_tracked: Set[str] = set()
 
     # -- static MPLS routes ----------------------------------------------
 
@@ -582,16 +624,71 @@ class SpfSolver:
 
         route_db = DecisionRouteDb()
         self.best_routes_cache.clear()
-        self._prefetch_ksp2_paths(
+        affected = self._prefetch_ksp2_paths(
             my_node_name, area_link_states, prefix_state
         )
 
+        # Per-prefix route reuse: when the incremental KSP2 engine
+        # reports exactly which destinations' paths changed, any prefix
+        # advertised only by untouched nodes produces a byte-identical
+        # route — reuse it instead of re-deriving (reference analogue:
+        # the per-prefix incremental rebuild, Decision.cpp:1896-1917).
+        # LFA additionally consumes neighbor-row distances the affected
+        # test does not model, so reuse is gated off with it.
+        meta = (
+            id(prefix_state),
+            prefix_state.version,
+            my_node_name,
+            tuple(
+                (a, id(ls)) for a, ls in sorted(area_link_states.items())
+            ),
+        )
+        reuse = (
+            affected
+            if (
+                affected is not None
+                and not self.compute_lfa_paths
+                and self._route_cache_meta == meta
+            )
+            else None
+        )
+        populate = affected is not None and not self.compute_lfa_paths
+        self._route_cache_meta = meta if populate else None
+        new_cache: Dict[IpPrefix, tuple] = {}
+
         for prefix in prefix_state.prefixes():
+            if reuse is not None and prefix in self._route_cache:
+                advertisers = {
+                    node
+                    for (node, _a) in prefix_state.entries_for(prefix)
+                }
+                # the engine's affected set only covers the KSP2
+                # destinations it tracks — an advertiser outside that
+                # set (e.g. an SP_ECMP-only node) can change without
+                # ever appearing in `reuse`, so its prefixes must be
+                # re-derived every build
+                if advertisers <= self._ksp2_tracked and advertisers.isdisjoint(
+                    reuse
+                ):
+                    entry, best = self._route_cache[prefix]
+                    if best is not None:
+                        self.best_routes_cache[prefix] = best
+                    if entry is not None:
+                        route_db.add_unicast_route(entry)
+                    new_cache[prefix] = (entry, best)
+                    SPF_COUNTERS["decision.ksp2_route_reuses"] += 1
+                    continue
             entry = self.create_route_for_prefix(
                 my_node_name, area_link_states, prefix_state, prefix
             )
             if entry is not None:
                 route_db.add_unicast_route(entry)
+            if populate:
+                new_cache[prefix] = (
+                    entry,
+                    self.best_routes_cache.get(prefix),
+                )
+        self._route_cache = new_cache
 
         # MPLS routes for node (SR) labels
         label_to_node = self._build_node_label_routes(
@@ -998,28 +1095,30 @@ class SpfSolver:
         my_node_name: str,
         area_link_states: AreaLinkStates,
         prefix_state: PrefixState,
-    ) -> None:
+    ) -> Optional[Set[str]]:
         """Batch the KSP2 second-path SPFs onto the device.
 
         Host semantics (LinkState.get_kth_paths, reference
         LinkState.cpp:763) run ONE Dijkstra per destination over the
         graph minus that destination's first-path links — O(N) SPFs per
-        rebuild, the quadratic cliff at fabric scale. Here every
-        destination's masked graph becomes one batch element of a single
-        fused device dispatch (ops.spf_sparse._ell_masked_source_batch);
-        second paths are then traced on the host from the returned
-        distance rows and primed into the kth-path cache, so
-        _select_best_paths_ksp2's per-prefix lookups all hit.
+        rebuild, the quadratic cliff at fabric scale.
+
+        Moderate N (<= ksp2_engine.ENGINE_MAX_NODES): the incremental
+        Ksp2Engine persists paths across churn and re-solves only the
+        destinations a change can affect; returns that affected set so
+        build_route_db can reuse the untouched routes (None = no reuse
+        this build). Larger N: the original per-build chunked masked
+        dispatch (every destination, every build).
 
         Destinations whose first paths contain parallel links fall back
         to the host path (the sliced-ELL collapses parallel links into
         one min-metric slot, so masking one of them is not
         representable)."""
         if self.backend != "device" or len(area_link_states) != 1:
-            return
+            return None
         ((area, ls),) = area_link_states.items()
         if not ls.has_node(my_node_name):
-            return
+            return None
         dsts = set()
         for prefix in prefix_state.prefixes():
             for (node, p_area), entry in prefix_state.entries_for(
@@ -1034,9 +1133,48 @@ class SpfSolver:
                     dsts.add(node)
         dsts = sorted(dsts)
         if len(dsts) < KSP2_DEVICE_MIN_DSTS:
-            return
+            return None
+
+        from openr_tpu.decision import ksp2_engine
+
+        if (
+            len(ls.get_adjacency_databases())
+            <= ksp2_engine.ENGINE_MAX_NODES
+        ):
+            engine = self._ksp2_engines.get(ls)
+            if engine is not None and engine.src_name != my_node_name:
+                # one engine per graph: keep the hot root's; other
+                # roots (ctrl queries) take the host path
+                return None
+            if engine is None:
+                if (
+                    ls.get_max_hops_to_node(my_node_name)
+                    > KSP2_DEVICE_MAX_HOPS
+                ):
+                    return None  # high diameter: host Dijkstra wins
+                engine = ksp2_engine.Ksp2Engine(my_node_name)
+                self._ksp2_engines[ls] = engine
+            affected = engine.sync(ls, dsts)
+            # the affected set only speaks for the tracked KSP2
+            # destinations (plus the root, whose drain flips force a
+            # cold build): route reuse checks advertisers against this
+            self._ksp2_tracked = set(dsts) | {my_node_name}
+            if engine.valid and engine.ecc_hops > KSP2_DEVICE_MAX_HOPS:
+                # diameter grew past the device win: paths for THIS
+                # build are already primed; drop the engine so later
+                # builds do the cheap host hop check (memoized per
+                # topology version) instead of cold-rebuilding each time
+                del self._ksp2_engines[ls]
+                return affected
+            if affected is None and engine.valid:
+                # cold build: no reuse this time, but the per-prefix
+                # cache built now is valid for the NEXT event — signal
+                # "engine ran" with the all-affected set
+                return set(dsts)
+            return affected
+
         if ls.get_max_hops_to_node(my_node_name) > KSP2_DEVICE_MAX_HOPS:
-            return  # high-diameter graph: host Dijkstra wins
+            return None  # high-diameter graph: host Dijkstra wins
 
         from openr_tpu.ops import spf_sparse
 

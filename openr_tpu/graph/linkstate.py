@@ -358,6 +358,15 @@ class LinkState:
         from collections import deque
 
         self.change_journal = deque(maxlen=4096)
+        # attribute changes (node labels, adj labels, next-hop addresses,
+        # interface identities) do NOT move distances, so they bump a
+        # separate version: SPF memos and device snapshots stay valid,
+        # while route-materialization caches (the incremental KSP2
+        # engine's) can still detect and re-derive affected routes
+        # (reference keeps the same split: LinkStateChange
+        # topologyChanged vs linkAttributesChanged)
+        self.attributes_version = 0
+        self.attr_journal = deque(maxlen=4096)
 
     # -- introspection ----------------------------------------------------
 
@@ -409,18 +418,36 @@ class LinkState:
     def affected_since(self, version: int) -> Optional[Set[str]]:
         """Union of nodes touched by all changes after ``version``; None if
         the journal can't prove coverage (forces a full recompile)."""
-        if version == self.topology_version:
+        return self._affected_since(
+            self.change_journal, self.topology_version, version
+        )
+
+    def attr_affected_since(self, version: int) -> Optional[Set[str]]:
+        """Like affected_since, over the attribute-change journal."""
+        return self._affected_since(
+            self.attr_journal, self.attributes_version, version
+        )
+
+    @staticmethod
+    def _affected_since(journal, current: int, version: int):
+        if version == current:
             return set()
-        if not self.change_journal or self.change_journal[0][0] > version + 1:
+        if not journal or journal[0][0] > version + 1:
             return None  # history evicted: coverage unknown
         affected: Set[str] = set()
-        for v, nodes in self.change_journal:
+        for v, nodes in journal:
             if v <= version:
                 continue
             if not nodes:
                 return None  # a change with unrecorded blast radius
             affected |= nodes
         return affected
+
+    def _note_attr_change(self, affected: Set[str]) -> None:
+        self.attributes_version += 1
+        self.attr_journal.append(
+            (self.attributes_version, frozenset(affected))
+        )
 
     def _maybe_make_link(self, node: str, adj: Adjacency) -> Optional[Link]:
         """Create a Link only if the reverse adjacency is also advertised
@@ -550,6 +577,8 @@ class LinkState:
 
         if change.topology_changed:
             self._invalidate(affected)
+        if change.link_attributes_changed or change.node_label_changed:
+            self._note_attr_change(affected)
         return change
 
     def delete_adjacency_database(self, node: str) -> LinkStateChange:

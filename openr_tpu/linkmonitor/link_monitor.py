@@ -324,9 +324,12 @@ class LinkMonitor:
             n.node_name == nbr.node_name and (n.area or self.area) == area
             for (n, _) in self._adjacencies.values()
         ):
+            # drop the advertisement record first: a del_peer failure
+            # must not suppress the ADD_PEER sample when the neighbor
+            # later re-establishes
+            self._advertised_peers.discard((area, nbr.node_name))
             try:
                 self._kvstore.del_peer(area, nbr.node_name)
-                self._advertised_peers.discard((area, nbr.node_name))
                 self._log_sample(
                     event="DEL_PEER", peer_name=nbr.node_name, area=area
                 )

@@ -440,8 +440,17 @@ def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n):
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    fh = _first_hops_from_rows(d, srcs, w_sv, overloaded, n)
+    return jnp.concatenate([d, fh.astype(jnp.int32)], axis=0)
 
-    # first hops (same algebra as the dense kernel)
+
+def _first_hops_from_rows(d, srcs, w_sv, overloaded, n):
+    """ECMP first-hop bits [B, N] from the batch's distance rows (same
+    algebra as the dense kernel): neighbor v forwards toward j iff
+    w(src,v) + d(v, j) == d(src, j), plus the direct-neighbor case.
+    Shared by _ell_view_batch and _ell_all_view_rows — the engine's
+    preloaded view must stay byte-identical to the fallback dispatch."""
+    b = srcs.shape[0]
     d_src = d[0]
     is_neighbor = w_sv < INF
     reachable = d_src < INF
@@ -455,8 +464,7 @@ def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n):
         jnp.int32, (b, n), 1
     )
     direct_ok = col_is_self & (is_neighbor & (w_sv == d_src[srcs]))[:, None]
-    fh = (transit_ok | direct_ok) & reachable[None, :]
-    return jnp.concatenate([d, fh.astype(jnp.int32)], axis=0)
+    return (transit_ok | direct_ok) & reachable[None, :]
 
 
 @functools.partial(jax.jit, static_argnames=("bands", "n"))
@@ -570,9 +578,13 @@ def ell_distances_from_sources(graph: EllGraph, src_ids,
     ws_t = state.w if state is not None else tuple(
         jnp.asarray(w) for w in graph.w
     )
+    ov = (
+        state.overloaded
+        if state is not None
+        else jnp.asarray(graph.overloaded)
+    )
     return _ell_from_sources(
-        srcs_t, ws_t,
-        jnp.asarray(graph.overloaded),
+        srcs_t, ws_t, ov,
         _as_device_ids(src_ids),
         graph.bands, graph.n_pad,
     )
@@ -586,12 +598,17 @@ def iter_ell_all_sources(graph: EllGraph, block: int = 2048):
     dispatch + one readback."""
     state = EllState(graph)
     n = graph.n_pad
+    # all block id vectors go up front in one async burst: uploading per
+    # block would serialize a relay round trip between blocks
+    id_blocks = []
     for start in range(0, n, block):
         ids = np.arange(start, min(start + block, n), dtype=np.int32)
         if len(ids) < block:  # keep one compiled shape
             ids = np.concatenate(
                 [ids, np.full(block - len(ids), ids[-1], np.int32)]
             )
+        id_blocks.append((start, jnp.asarray(ids)))
+    for start, ids in id_blocks:
         yield start, np.asarray(
             ell_distances_from_sources(graph, ids, state=state)
         )
@@ -726,7 +743,7 @@ def ell_masked_distances_resident(
             state.src,
             state.w,
             tuple(jnp.asarray(m) for m in masks),
-            jnp.asarray(state.graph.overloaded),
+            state.overloaded,
             src_id,
             state.graph.bands,
             state.graph.n_pad,
@@ -735,12 +752,23 @@ def ell_masked_distances_resident(
 
 
 class EllState:
-    """Caller-owned resident device bands for the churn loop."""
+    """Caller-owned resident device bands for the churn loop.
+
+    Everything a dispatch consumes lives on the device: the bands, and
+    the overloaded mask (re-uploaded only when it actually changes — on
+    relay-backed platforms every host->device transfer rides a ~70ms
+    round trip, so a per-dispatch ``jnp.asarray(overloaded)`` used to
+    dominate the measured block time ~70x over the compute)."""
 
     def __init__(self, graph: EllGraph):
         self.graph = graph
         self.src = tuple(jnp.asarray(s) for s in graph.src)
         self.w = tuple(jnp.asarray(w) for w in graph.w)
+        self.overloaded = jnp.asarray(graph.overloaded)
+
+    def _sync_overloaded(self, patched: EllGraph) -> None:
+        if not np.array_equal(self.graph.overloaded, patched.overloaded):
+            self.overloaded = jnp.asarray(patched.overloaded)
 
     def apply_patch(self, patched: EllGraph) -> None:
         """Scatter a patched graph's changed rows into the resident
@@ -768,6 +796,7 @@ class EllState:
             )
         self.src = tuple(new_src)
         self.w = tuple(new_w)
+        self._sync_overloaded(patched)
         # rows are applied: clear the journal so a later reconverge
         # doesn't scatter them again
         self.graph = _replace(patched, changed=None)
@@ -792,10 +821,11 @@ class EllState:
             patch_src.append(jnp.asarray(patched.src[bi][rows]))
             patch_w.append(jnp.asarray(patched.w[bi][rows]))
         srcs_dev, w_sv = _batch_args(patched, srcs)
+        self._sync_overloaded(patched)
         self.src, self.w, packed = _ell_reconverge(
             self.src, self.w,
             tuple(patch_ids), tuple(patch_src), tuple(patch_w),
-            jnp.asarray(patched.overloaded), srcs_dev, w_sv,
+            self.overloaded, srcs_dev, w_sv,
             patched.bands, patched.n_pad,
         )
         # rows are applied: clear the journal (mirrors apply_patch) so a
@@ -807,6 +837,64 @@ class EllState:
 def ell_reconverge_step(state: EllState, patched: EllGraph, srcs):
     """Convenience wrapper around EllState.reconverge."""
     return state.reconverge(patched, srcs)
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _ell_all_view_rows(
+    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+    bands, n,
+):
+    """One fused dispatch for the incremental-KSP2 churn step at
+    moderate N (n_pad <= ~4k, where a full all-sources block fits):
+
+      1. all-sources distances D [n, n] over the resident bands,
+      2. the batched {root} + neighbors view (distances + packed first
+         hops — same algebra as _ell_view_batch) DERIVED from D's rows
+         instead of a second fixed point,
+      3. row gathers from D (new) and ``d_prev`` (the previous build's
+         resident D) for the invalidation endpoints,
+
+    returning (D, packed) where packed = [view_d | view_fh | rows_new |
+    rows_old] — the caller reads back only ``packed`` (one transfer) and
+    keeps D resident for the next event. On relay-backed platforms each
+    extra readback costs a ~70ms round trip, so fusing the view and the
+    invalidation rows into the same transfer is what keeps a churn
+    rebuild near the single-round-trip floor."""
+    d_all = _ell_fixed_point(
+        srcs_t, ws_t, overloaded,
+        jnp.arange(n, dtype=jnp.int32), bands, n,
+    )
+
+    # view from D rows (shared first-hop algebra with _ell_view_batch)
+    d = d_all[view_srcs]  # [B, n]
+    fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
+
+    packed = jnp.concatenate(
+        [
+            d,
+            fh.astype(jnp.int32),
+            d_all[ep_ids],
+            d_prev[ep_ids],
+        ],
+        axis=0,
+    )
+    return d_all, packed
+
+
+def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev):
+    """Run the fused all-sources + view + invalidation-rows dispatch on
+    the resident bands. Returns (d_all_dev, packed_host)."""
+    d_all, packed = _ell_all_view_rows(
+        state.src, state.w, state.overloaded,
+        _as_device_ids(view_srcs),
+        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+            np.asarray(w_sv, dtype=np.int32)
+        ),
+        _as_device_ids(ep_ids),
+        d_prev,
+        state.graph.bands, state.graph.n_pad,
+    )
+    return d_all, np.asarray(packed)
 
 
 SOURCES_AXIS = "sources"

@@ -225,8 +225,14 @@ def _write_struct(w: _Writer, schema: StructSchema, values: Dict) -> None:
     w.byte(T_STOP)
 
 
-def _skip(r: _Reader, wtype: int) -> None:
+def _skip(r: _Reader, wtype: int, standalone: bool = False) -> None:
+    """``standalone`` distinguishes the two bool encodings: a FIELD
+    bool rides entirely in the field-header nibble (zero value bytes),
+    while a collection/map ELEMENT bool is one byte (01/02). Skipping
+    with the wrong context desyncs every subsequent byte."""
     if wtype in (T_TRUE, T_FALSE):
+        if standalone:
+            r.byte()
         return
     if wtype == T_BYTE:
         r.byte()
@@ -243,14 +249,14 @@ def _skip(r: _Reader, wtype: int) -> None:
         if size == 15:
             size = r.varint()
         for _ in range(size):
-            _skip(r, et)
+            _skip(r, et, standalone=True)
     elif wtype == T_MAP:
         size = r.varint()
         if size:
             head = r.byte()
             for _ in range(size):
-                _skip(r, head >> 4)
-                _skip(r, head & 0x0F)
+                _skip(r, head >> 4, standalone=True)
+                _skip(r, head & 0x0F, standalone=True)
     elif wtype == T_STRUCT:
         while True:
             b = r.byte()
@@ -264,13 +270,18 @@ def _skip(r: _Reader, wtype: int) -> None:
         raise ValueError(f"cannot skip wire type {wtype}")
 
 
-def _read_value(r: _Reader, ftype: Tuple, wtype: int) -> Any:
+def _read_value(
+    r: _Reader, ftype: Tuple, wtype: int, standalone: bool = False
+) -> Any:
     kind = ftype[0]
     if kind == "bool":
-        # field context: value is the header nibble; standalone: a byte
-        if wtype in (T_TRUE, T_FALSE):
-            return wtype == T_TRUE
-        return r.byte() == T_TRUE
+        # field context: the value IS the header nibble (zero bytes);
+        # collection/map element context (standalone): one byte 01/02.
+        # The elem-type nibble is T_TRUE in both cases, so the caller's
+        # context flag — not the wire type — must decide.
+        if standalone:
+            return r.byte() == T_TRUE
+        return wtype == T_TRUE
     if kind == "byte":
         b = r.byte()
         return b - 256 if b >= 128 else b
@@ -287,7 +298,8 @@ def _read_value(r: _Reader, ftype: Tuple, wtype: int) -> Any:
             size = r.varint()
         elem = ftype[1]
         items = [
-            _read_value(r, elem, head & 0x0F) for _ in range(size)
+            _read_value(r, elem, head & 0x0F, standalone=True)
+            for _ in range(size)
         ]
         return set(items) if kind == "set" else items
     if kind == "map":
@@ -297,8 +309,8 @@ def _read_value(r: _Reader, ftype: Tuple, wtype: int) -> Any:
             return out
         head = r.byte()
         for _ in range(size):
-            k = _read_value(r, ftype[1], head >> 4)
-            v = _read_value(r, ftype[2], head & 0x0F)
+            k = _read_value(r, ftype[1], head >> 4, standalone=True)
+            v = _read_value(r, ftype[2], head & 0x0F, standalone=True)
             out[k] = v
         return out
     if kind == "struct":

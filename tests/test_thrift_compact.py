@@ -178,6 +178,56 @@ class TestRoundTrip:
         assert back["keyDumpParams"]["ignoreTtl"] is True
 
 
+class TestBoolCollections:
+    """Collection-element bools are ONE byte each (01/02) while field
+    bools ride in the header nibble — decoding or skipping with the
+    wrong context desyncs the stream (code-review regression: the
+    decoder returned from the field branch without consuming element
+    bytes, so list<bool> corrupted every subsequent field)."""
+
+    SCHEMA = tc.StructSchema(
+        "BoolBag",
+        (
+            tc.Field(1, ("list", ("bool",)), "flags"),
+            tc.Field(2, ("string",), "tag", optional=True),
+        ),
+    )
+
+    def test_list_bool_golden_round_trip(self):
+        data = {"flags": [True, False, True], "tag": "x"}
+        golden = bytes(
+            [
+                0x19,  # field 1 delta 1, type list
+                0x31,  # size 3 << 4 | elem type TRUE(0x01)
+                0x01, 0x02, 0x01,  # one byte per element
+                0x18, 0x01, 0x78,  # field 2: string "x"
+                0x00,  # STOP
+            ]
+        )
+        enc = tc.encode(self.SCHEMA, data)
+        assert enc == golden
+        assert tc.decode(self.SCHEMA, golden) == data
+
+    def test_unknown_list_bool_field_skipped(self):
+        """Forward compat: a newer peer's list<bool> field must be
+        skipped byte-exactly."""
+        newer = tc.StructSchema(
+            "Newer",
+            (
+                tc.Field(1, ("list", ("bool",)), "flags"),
+                tc.Field(2, ("string",), "tag", optional=True),
+            ),
+        )
+        older = tc.StructSchema(
+            "Older",
+            (tc.Field(2, ("string",), "tag", optional=True),),
+        )
+        enc = tc.encode(
+            newer, {"flags": [True, True, False], "tag": "ok"}
+        )
+        assert tc.decode(older, enc) == {"tag": "ok"}
+
+
 class TestForwardCompat:
     def test_unknown_fields_skipped(self):
         """A newer peer's extra fields (any type, short and long form
