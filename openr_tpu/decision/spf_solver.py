@@ -1195,27 +1195,7 @@ class SpfSolver:
                 links.update(path)
             exclusion_sets.append(links)
 
-        # per-build candidate lists: up in-links of each node in canonical
-        # order with (origin, origin id, metric) pre-resolved — the trace
-        # backtracks heavily in ECMP-rich fabrics, so none of this may be
-        # recomputed per visit
-        in_cands: Dict[str, list] = {}
-
-        def cands_of(v: str):
-            got = in_cands.get(v)
-            if got is None:
-                got = in_cands[v] = [
-                    (
-                        link,
-                        link.other_node(v),
-                        graph.node_index.get(link.other_node(v)),
-                        link.metric_from(link.other_node(v)),
-                    )
-                    for link in ls.ordered_links_from_node(v)
-                    if link.is_up()
-                ]
-            return got
-
+        cands_of = ksp2_engine.make_cands_of(ls, graph.node_index)
         transit_blocked = {
             name
             for name in graph.node_names
@@ -1238,7 +1218,7 @@ class SpfSolver:
                 if not ok[i]:
                     SPF_COUNTERS["decision.ksp2_host_fallbacks"] += 1
                     continue  # host path computes it lazily
-                paths = self._trace_paths_from_row(
+                paths = ksp2_engine.trace_paths_from_row(
                     my_node_name,
                     dst,
                     graph.node_index,
@@ -1248,67 +1228,6 @@ class SpfSolver:
                     transit_blocked,
                 )
                 ls.prime_kth_paths(my_node_name, dst, 2, paths)
-
-    @staticmethod
-    def _trace_paths_from_row(
-        src: str,
-        dest: str,
-        index: Dict[str, int],
-        dlist,
-        excluded: Set[Link],
-        cands_of,
-        transit_blocked: Set[str],
-    ):
-        """Enumerate link-disjoint shortest paths src -> dest from a
-        masked-graph distance row — byte-identical to
-        LinkState._trace_one_path over the same masked SPF (both walk
-        predecessor links in canonical sorted order)."""
-        from openr_tpu.ops.spf import INF as SPF_INF
-
-        inf = int(SPF_INF)
-        did = index.get(dest)
-        if did is None or dlist[did] >= inf:
-            return []
-
-        visited: Set[Link] = set()
-        # per-destination predecessor memo: distance-equality filtering
-        # of the candidate list happens once per node, not per backtrack
-        preds: Dict[str, list] = {}
-
-        def preds_of(v: str):
-            got = preds.get(v)
-            if got is None:
-                dv = dlist[index[v]]
-                got = preds[v] = [
-                    (link, u)
-                    for link, u, uid, w in cands_of(v)
-                    if uid is not None
-                    and link not in excluded
-                    and (u == src or u not in transit_blocked)
-                    and dlist[uid] < inf
-                    and dlist[uid] + w == dv
-                ]
-            return got
-
-        def trace_one(v: str):
-            if v == src:
-                return []
-            for link, u in preds_of(v):
-                if link in visited:
-                    continue
-                visited.add(link)
-                sub = trace_one(u)
-                if sub is not None:
-                    sub.append(link)
-                    return sub
-            return None
-
-        paths = []
-        path = trace_one(dest)
-        while path:
-            paths.append(path)
-            path = trace_one(dest)
-        return paths
 
     def _select_best_paths_ksp2(
         self,

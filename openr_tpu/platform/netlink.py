@@ -39,20 +39,59 @@ class NlLink:
             self.addresses = tuple(self.addresses)
 
 
+@dataclass(frozen=True)
+class NlNeighbor:
+    """Kernel neighbor-table (ARP/NDP) entry.
+    reference: fbnl::Neighbor (openr/nl/NetlinkTypes.h:1-632)."""
+
+    if_index: int
+    destination: IpPrefix  # host address of the neighbor
+    link_address: bytes = b""  # MAC, empty when not yet resolved
+    state: int = 0  # NUD_* bitmask
+    is_reachable: bool = False
+
+
+# NUD_* neighbor states (linux/neighbour.h)
+NUD_INCOMPLETE = 0x01
+NUD_REACHABLE = 0x02
+NUD_STALE = 0x04
+NUD_DELAY = 0x08
+NUD_PROBE = 0x10
+NUD_FAILED = 0x20
+NUD_NOARP = 0x40
+NUD_PERMANENT = 0x80
+# states the reference treats as usable
+NUD_VALID = (
+    NUD_PERMANENT | NUD_NOARP | NUD_REACHABLE | NUD_PROBE
+    | NUD_STALE | NUD_DELAY
+)
+
+
 class NetlinkEventType(enum.IntEnum):
     LINK = 1
     ADDRESS = 2
     NEIGHBOR = 3
+    ROUTE = 4
 
 
 @dataclass
 class NetlinkEvent:
     event_type: NetlinkEventType
+    # set ONLY for LINK events — LinkMonitor treats a non-None link as
+    # an interface state change, so ADDRESS/ROUTE events must not
+    # fabricate one (their payload rides prefix/if_index)
     link: Optional[NlLink] = None
+    neighbor: Optional[NlNeighbor] = None
+    # ADDRESS: the touched prefix; ROUTE: the route's destination
+    prefix: Optional[IpPrefix] = None
+    if_index: int = 0
+    deleted: bool = False
 
 
 class NetlinkProtocolSocket:
-    """Abstract kernel access interface."""
+    """Abstract kernel access interface.
+    reference surface: openr/nl/NetlinkProtocolSocket.h:96-196 (routes,
+    MPLS label routes, links, addresses, neighbors, event fan-out)."""
 
     def get_all_links(self) -> List[NlLink]:
         raise NotImplementedError
@@ -75,6 +114,21 @@ class NetlinkProtocolSocket:
     def get_ifaddresses(self, if_name: str) -> List[IpPrefix]:
         raise NotImplementedError
 
+    def get_all_neighbors(self) -> List[NlNeighbor]:
+        raise NotImplementedError
+
+    def add_mpls_route(self, route) -> None:
+        """Program one MPLS label route (types.MplsRoute): top_label ->
+        next hops whose mpls_action is SWAP/PHP/POP_AND_LOOKUP.
+        reference: nl/NetlinkProtocolSocket.h:131 addRoute(label)."""
+        raise NotImplementedError
+
+    def delete_mpls_route(self, label: int) -> None:
+        raise NotImplementedError
+
+    def get_all_mpls_routes(self) -> List:
+        raise NotImplementedError
+
 
 class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
     """In-memory kernel with event injection
@@ -86,7 +140,49 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
         self._lock = threading.Lock()
         self._links: Dict[str, NlLink] = {}
         self._routes: Dict[IpPrefix, UnicastRoute] = {}
+        self._neighbors: Dict[Tuple[int, IpPrefix], NlNeighbor] = {}
+        self._mpls: Dict[int, object] = {}
         self._next_index = 1
+
+    # -- neighbor-table injection (reference:
+    # tests/mocks/NetlinkEventsInjector) --------------------------------
+
+    def set_neighbor(
+        self,
+        if_name: str,
+        destination: IpPrefix,
+        link_address: bytes = b"",
+        state: int = NUD_REACHABLE,
+    ) -> NlNeighbor:
+        with self._lock:
+            link = self._links[if_name]
+            nbr = NlNeighbor(
+                if_index=link.if_index,
+                destination=destination,
+                link_address=link_address,
+                state=state,
+                is_reachable=bool(state & NUD_VALID),
+            )
+            self._neighbors[(link.if_index, destination)] = nbr
+        self.events_queue.push(
+            NetlinkEvent(
+                event_type=NetlinkEventType.NEIGHBOR, neighbor=nbr
+            )
+        )
+        return nbr
+
+    def del_neighbor(self, if_name: str, destination: IpPrefix) -> None:
+        with self._lock:
+            link = self._links[if_name]
+            nbr = self._neighbors.pop((link.if_index, destination), None)
+        if nbr is not None:
+            self.events_queue.push(
+                NetlinkEvent(
+                    event_type=NetlinkEventType.NEIGHBOR,
+                    neighbor=nbr,
+                    deleted=True,
+                )
+            )
 
     # -- test injection ---------------------------------------------------
 
@@ -124,10 +220,23 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
     def add_route(self, route: UnicastRoute) -> None:
         with self._lock:
             self._routes[route.dest] = route
+        self.events_queue.push(
+            NetlinkEvent(
+                event_type=NetlinkEventType.ROUTE, prefix=route.dest
+            )
+        )
 
     def delete_route(self, prefix: IpPrefix) -> None:
         with self._lock:
-            self._routes.pop(prefix, None)
+            existed = self._routes.pop(prefix, None) is not None
+        if existed:
+            self.events_queue.push(
+                NetlinkEvent(
+                    event_type=NetlinkEventType.ROUTE,
+                    prefix=prefix,
+                    deleted=True,
+                )
+            )
 
     def get_all_routes(self) -> List[UnicastRoute]:
         with self._lock:
@@ -157,3 +266,24 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
             if link is None:
                 raise NetlinkError(19, f"no such link {if_name}")
             return list(link.addresses)
+
+    def get_all_neighbors(self) -> List[NlNeighbor]:
+        with self._lock:
+            return sorted(
+                self._neighbors.values(),
+                key=lambda n: (n.if_index, n.destination),
+            )
+
+    def add_mpls_route(self, route) -> None:
+        with self._lock:
+            self._mpls[route.top_label] = route
+
+    def delete_mpls_route(self, label: int) -> None:
+        with self._lock:
+            self._mpls.pop(label, None)
+
+    def get_all_mpls_routes(self) -> List:
+        with self._lock:
+            return sorted(
+                self._mpls.values(), key=lambda r: r.top_label
+            )

@@ -13,11 +13,12 @@ out-of-process agent exactly like the reference's thrift boundary.
 
 from __future__ import annotations
 
+import errno
 import time
 from typing import Dict, List, Optional
 
 from openr_tpu.platform.fib_service import FibService
-from openr_tpu.platform.netlink import NetlinkProtocolSocket
+from openr_tpu.platform.netlink import NetlinkError, NetlinkProtocolSocket
 from openr_tpu.types import IpPrefix, MplsRoute, UnicastRoute
 from openr_tpu.utils.rpc import RpcClient, RpcServer
 
@@ -45,14 +46,46 @@ class NetlinkFibHandler(FibService):
             self._nl.delete_route(prefix)
             table.pop(prefix, None)
 
+    # errnos that mean "this kernel cannot do MPLS at all" — only these
+    # degrade to table-only programming; anything else (EINVAL from a
+    # bad next hop, ENODEV from a vanished interface...) is a REAL
+    # programming failure and must propagate, not be recorded as success
+    _MPLS_UNSUPPORTED_ERRNOS = frozenset(
+        {
+            errno.EAFNOSUPPORT,
+            errno.EPFNOSUPPORT,
+            errno.EPROTONOSUPPORT,
+            errno.EOPNOTSUPP,
+            errno.ENOENT,  # /proc/sys/net/mpls absent: module not loaded
+        }
+    )
+
+    def _nl_mpls(self, op, *args) -> None:
+        """Program MPLS through netlink where the backing socket (and
+        kernel) support it; on kernels without MPLS modules the
+        per-client table alone is authoritative (reference:
+        NetlinkFibHandler MPLS programming, gated on mpls_router)."""
+        fn = getattr(self._nl, op, None)
+        if fn is None:
+            return
+        try:
+            fn(*args)
+        except NotImplementedError:
+            pass
+        except NetlinkError as exc:
+            if exc.errno not in self._MPLS_UNSUPPORTED_ERRNOS:
+                raise
+
     def add_mpls_routes(self, client_id, routes) -> None:
         table = self._mpls.setdefault(client_id, {})
         for route in routes:
+            self._nl_mpls("add_mpls_route", route)
             table[route.top_label] = route
 
     def delete_mpls_routes(self, client_id, labels) -> None:
         table = self._mpls.setdefault(client_id, {})
         for label in labels:
+            self._nl_mpls("delete_mpls_route", label)
             table.pop(label, None)
 
     def sync_fib(self, client_id, routes) -> None:
@@ -68,7 +101,14 @@ class NetlinkFibHandler(FibService):
         self._unicast[client_id] = desired
 
     def sync_mpls_fib(self, client_id, routes) -> None:
-        self._mpls[client_id] = {r.top_label: r for r in routes}
+        desired = {r.top_label: r for r in routes}
+        current = self._mpls.get(client_id, {})
+        for label in list(current):
+            if label not in desired:
+                self._nl_mpls("delete_mpls_route", label)
+        for route in desired.values():
+            self._nl_mpls("add_mpls_route", route)
+        self._mpls[client_id] = desired
 
     def get_route_table_by_client(self, client_id) -> List[UnicastRoute]:
         return sorted(

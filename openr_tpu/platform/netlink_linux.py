@@ -28,13 +28,23 @@ from typing import Dict, List, Optional, Tuple
 
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.netlink import (
+    NUD_VALID,
     NetlinkError,
     NetlinkEvent,
     NetlinkEventType,
     NetlinkProtocolSocket,
     NlLink,
+    NlNeighbor,
 )
-from openr_tpu.types import BinaryAddress, IpPrefix, NextHop, UnicastRoute
+from openr_tpu.types import (
+    BinaryAddress,
+    IpPrefix,
+    MplsAction,
+    MplsActionCode,
+    MplsRoute,
+    NextHop,
+    UnicastRoute,
+)
 
 # netlink message types
 RTM_NEWLINK = 16
@@ -46,6 +56,9 @@ RTM_GETADDR = 22
 RTM_NEWROUTE = 24
 RTM_DELROUTE = 25
 RTM_GETROUTE = 26
+RTM_NEWNEIGH = 28
+RTM_DELNEIGH = 29
+RTM_GETNEIGH = 30
 NLMSG_ERROR = 2
 NLMSG_DONE = 3
 
@@ -66,6 +79,15 @@ RTA_OIF = 4
 RTA_GATEWAY = 5
 RTA_PRIORITY = 6
 RTA_MULTIPATH = 9
+RTA_VIA = 18  # MPLS nexthop: rtvia { u16 family; u8 addr[] }
+RTA_NEWDST = 19  # MPLS swap: outgoing label stack
+
+# rtattr types (neighbor, linux/neighbour.h)
+NDA_DST = 1
+NDA_LLADDR = 2
+
+AF_MPLS = 28
+MPLS_LABEL_IMPLICIT_NULL = 3  # PHP: pop, forward by inner header
 
 # rtattr types (link)
 IFLA_IFNAME = 3
@@ -79,11 +101,27 @@ RT_SCOPE_UNIVERSE = 0
 RTN_UNICAST = 1
 OPENR_ROUTE_PROTO_ID = 99  # reference: Constants.h kAqRouteProtoId
 
+# rtnetlink multicast groups (linux/rtnetlink.h)
 RTMGRP_LINK = 0x1
+RTMGRP_NEIGH = 0x4
+RTMGRP_IPV4_IFADDR = 0x10
+RTMGRP_IPV4_ROUTE = 0x40
+RTMGRP_IPV6_IFADDR = 0x100
+RTMGRP_IPV6_ROUTE = 0x400
+RTMGRP_ALL = (
+    RTMGRP_LINK
+    | RTMGRP_NEIGH
+    | RTMGRP_IPV4_IFADDR
+    | RTMGRP_IPV4_ROUTE
+    | RTMGRP_IPV6_IFADDR
+    | RTMGRP_IPV6_ROUTE
+)
 
 _NLMSGHDR = struct.Struct("=IHHII")
 _RTMSG = struct.Struct("=BBBBBBBBI")
 _IFINFOMSG = struct.Struct("=BxHiII")
+_IFADDRMSG = struct.Struct("=BBBBi")
+_NDMSG = struct.Struct("=BxxxiHBB")
 _RTATTR = struct.Struct("=HH")
 _RTNEXTHOP = struct.Struct("=HBBi")
 
@@ -450,17 +488,209 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             )
         return out
 
-    # -- link event subscription -----------------------------------------
+    # -- neighbor table ---------------------------------------------------
 
-    def start_events(self) -> None:
-        """Join RTMGRP_LINK and publish NetlinkEvents (reference:
-        NetlinkProtocolSocket's event publication queue)."""
+    def get_all_neighbors(self) -> List[NlNeighbor]:
+        """RTM_GETNEIGH dump (reference:
+        NetlinkProtocolSocket::getAllNeighbors,
+        nl/NetlinkProtocolSocket.h:176)."""
+        body = _NDMSG.pack(socket.AF_UNSPEC, 0, 0, 0, 0)
+        out: List[NlNeighbor] = []
+        for mtype, payload in self._request(
+            RTM_GETNEIGH, NLM_F_REQUEST | NLM_F_DUMP, body
+        ):
+            if mtype != RTM_NEWNEIGH:
+                continue
+            nbr = self._parse_neighbor(payload)
+            if nbr is not None:
+                out.append(nbr)
+        return sorted(out, key=lambda n: (n.if_index, n.destination))
+
+    @staticmethod
+    def _parse_neighbor(payload: bytes) -> Optional[NlNeighbor]:
+        family, ifindex, state, _flags, _typ = _NDMSG.unpack_from(payload)
+        if family not in (socket.AF_INET, socket.AF_INET6):
+            return None
+        attrs = _parse_attrs(payload[_NDMSG.size :])
+        dst = attrs.get(NDA_DST)
+        if dst is None:
+            return None
+        plen = 32 if family == socket.AF_INET else 128
+        return NlNeighbor(
+            if_index=ifindex,
+            destination=IpPrefix(
+                prefix_address=BinaryAddress(addr=dst), prefix_length=plen
+            ),
+            link_address=attrs.get(NDA_LLADDR, b""),
+            state=state,
+            is_reachable=bool(state & NUD_VALID),
+        )
+
+    # -- MPLS label routes ------------------------------------------------
+
+    @staticmethod
+    def _mpls_label_bytes(label: int, bos: bool = True) -> bytes:
+        """One MPLS label stack entry: label(20) tc(3) s(1) ttl(8), BE."""
+        return struct.pack(
+            ">I", ((label & 0xFFFFF) << 12) | (0x100 if bos else 0)
+        )
+
+    def _mpls_nh_attrs(self, nh: NextHop, links: Dict[str, int]) -> bytes:
+        """RTA_VIA (+ RTA_NEWDST for SWAP) + RTA_OIF for one MPLS
+        next hop."""
+        attrs = b""
+        act = nh.mpls_action
+        if act is not None and act.action == MplsActionCode.SWAP:
+            attrs += _attr(
+                RTA_NEWDST, self._mpls_label_bytes(act.swap_label)
+            )
+        # PHP / POP_AND_LOOKUP: no NEWDST — the kernel pops
+        addr = nh.address.addr
+        if addr and set(addr) != {0}:
+            family = (
+                socket.AF_INET if len(addr) == 4 else socket.AF_INET6
+            )
+            attrs += _attr(
+                RTA_VIA, struct.pack("=H", family) + addr
+            )
+        index = links.get(nh.address.if_name or "")
+        if index is not None:
+            attrs += _attr(RTA_OIF, struct.pack("=i", index))
+        return attrs
+
+    def _mpls_body(self, label: int) -> bytes:
+        return _RTMSG.pack(
+            AF_MPLS,
+            20,  # dst_len: one 20-bit label
+            0,
+            0,
+            RT_TABLE_MAIN,
+            OPENR_ROUTE_PROTO_ID,
+            RT_SCOPE_UNIVERSE,
+            RTN_UNICAST,
+            0,
+        ) + _attr(RTA_DST, self._mpls_label_bytes(label))
+
+    def add_mpls_route(self, route: MplsRoute) -> None:
+        """RTM_NEWROUTE with family AF_MPLS (reference:
+        nl/NetlinkRoute label-route builders; requires the kernel
+        mpls_router module)."""
+        body = self._mpls_body(route.top_label)
+        nhs = list(route.next_hops)
+        links = self._link_table()
+        if len(nhs) == 1:
+            body += self._mpls_nh_attrs(nhs[0], links)
+        elif len(nhs) > 1:
+            group = b""
+            for nh in nhs:
+                nh_attrs = self._mpls_nh_attrs(nh, links)
+                rtnh_len = _RTNEXTHOP.size + len(nh_attrs)
+                group += (
+                    _RTNEXTHOP.pack(
+                        rtnh_len, 0, 0,
+                        links.get(nh.address.if_name or "", 0),
+                    )
+                    + nh_attrs
+                )
+            body += _attr(RTA_MULTIPATH, group)
+        self._request(
+            RTM_NEWROUTE,
+            NLM_F_REQUEST | NLM_F_ACK | NLM_F_CREATE | NLM_F_REPLACE,
+            body,
+        )
+
+    def delete_mpls_route(self, label: int) -> None:
+        try:
+            self._request(
+                RTM_DELROUTE,
+                NLM_F_REQUEST | NLM_F_ACK,
+                self._mpls_body(label),
+            )
+        except NetlinkError as exc:
+            if exc.errno != 3:  # ESRCH: already gone
+                raise
+
+    def get_all_mpls_routes(self) -> List[MplsRoute]:
+        body = _RTMSG.pack(AF_MPLS, 0, 0, 0, 0, 0, 0, 0, 0)
+        out: List[MplsRoute] = []
+        for mtype, payload in self._request(
+            RTM_GETROUTE, NLM_F_REQUEST | NLM_F_DUMP, body
+        ):
+            if mtype != RTM_NEWROUTE:
+                continue
+            route = self._parse_mpls_route(payload)
+            if route is not None:
+                out.append(route)
+        return sorted(out, key=lambda r: r.top_label)
+
+    def _parse_mpls_route(self, payload: bytes) -> Optional[MplsRoute]:
+        (
+            family, _dst_len, _sl, _tos, _table, proto, _scope, _rtype,
+            _flags,
+        ) = _RTMSG.unpack_from(payload)
+        if family != AF_MPLS or proto != OPENR_ROUTE_PROTO_ID:
+            return None
+        attrs = _parse_attrs(payload[_RTMSG.size :])
+        dst = attrs.get(RTA_DST)
+        if dst is None:
+            return None
+        label = struct.unpack(">I", dst)[0] >> 12
+
+        def parse_nh(nh_attrs: Dict[int, bytes]) -> NextHop:
+            addr = b""
+            via = nh_attrs.get(RTA_VIA)
+            if via is not None:
+                addr = via[2:]
+            newdst = nh_attrs.get(RTA_NEWDST)
+            if newdst is not None:
+                action = MplsAction(
+                    action=MplsActionCode.SWAP,
+                    swap_label=struct.unpack(">I", newdst[:4])[0] >> 12,
+                )
+            else:
+                action = MplsAction(action=MplsActionCode.PHP)
+            return NextHop(
+                address=BinaryAddress(addr=addr), mpls_action=action
+            )
+
+        nhs: List[NextHop] = []
+        if RTA_MULTIPATH in attrs:
+            data = attrs[RTA_MULTIPATH]
+            off = 0
+            while off + _RTNEXTHOP.size <= len(data):
+                rtnh_len, _f, _h, _idx = _RTNEXTHOP.unpack_from(data, off)
+                nhs.append(
+                    parse_nh(
+                        _parse_attrs(
+                            data[off + _RTNEXTHOP.size : off + rtnh_len]
+                        )
+                    )
+                )
+                off += _align4(rtnh_len)
+        else:
+            nhs.append(parse_nh(attrs))
+        return MplsRoute(top_label=label, next_hops=tuple(nhs))
+
+    @staticmethod
+    def mpls_supported() -> bool:
+        """The kernel has the MPLS forwarding module loaded."""
+        import os
+
+        return os.path.exists("/proc/sys/net/mpls")
+
+    # -- event subscription ------------------------------------------------
+
+    def start_events(self, groups: int = RTMGRP_ALL) -> None:
+        """Join the rtnetlink multicast groups (links, addresses,
+        routes, neighbors) and publish NetlinkEvents (reference:
+        NetlinkProtocolSocket's event publication queue; the reference
+        subscribes the same groups, nl/NetlinkProtocolSocket.cpp)."""
         if self.events_queue is None or self._event_thread is not None:
             return
         self._event_sock = socket.socket(
             socket.AF_NETLINK, socket.SOCK_RAW, socket.NETLINK_ROUTE
         )
-        self._event_sock.bind((0, RTMGRP_LINK))
+        self._event_sock.bind((0, groups))
         self._event_sock.settimeout(0.2)
         self._running = True
         self._event_thread = threading.Thread(
@@ -490,11 +720,54 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
                 length, mtype, _f, _s, _p = _NLMSGHDR.unpack_from(data, off)
                 payload = data[off + _NLMSGHDR.size : off + length]
                 off += _align4(length)
-                if mtype in (RTM_NEWLINK, RTM_DELLINK):
-                    self._links_cache = None
-                    link = self._parse_link(payload)
-                    self.events_queue.push(
-                        NetlinkEvent(
-                            event_type=NetlinkEventType.LINK, link=link
-                        )
-                    )
+                event = self._parse_event(mtype, payload)
+                if event is not None:
+                    self.events_queue.push(event)
+
+    def _parse_event(
+        self, mtype: int, payload: bytes
+    ) -> Optional[NetlinkEvent]:
+        if mtype in (RTM_NEWLINK, RTM_DELLINK):
+            self._links_cache = None
+            return NetlinkEvent(
+                event_type=NetlinkEventType.LINK,
+                link=self._parse_link(payload),
+                deleted=mtype == RTM_DELLINK,
+            )
+        if mtype in (RTM_NEWADDR, RTM_DELADDR):
+            family, plen, _fl, _sc, ifindex = _IFADDRMSG.unpack_from(
+                payload
+            )
+            attrs = _parse_attrs(payload[_IFADDRMSG.size :])
+            IFA_ADDRESS, IFA_LOCAL = 1, 2
+            addr = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS)
+            if addr is None:
+                return None
+            return NetlinkEvent(
+                event_type=NetlinkEventType.ADDRESS,
+                prefix=IpPrefix(
+                    prefix_address=BinaryAddress(addr=addr),
+                    prefix_length=plen,
+                ),
+                if_index=ifindex,
+                deleted=mtype == RTM_DELADDR,
+            )
+        if mtype in (RTM_NEWROUTE, RTM_DELROUTE):
+            route = self._parse_route(payload)
+            if route is None:
+                return None  # not an openr-owned unicast route
+            return NetlinkEvent(
+                event_type=NetlinkEventType.ROUTE,
+                prefix=route.dest,
+                deleted=mtype == RTM_DELROUTE,
+            )
+        if mtype in (RTM_NEWNEIGH, RTM_DELNEIGH):
+            nbr = self._parse_neighbor(payload)
+            if nbr is None:
+                return None
+            return NetlinkEvent(
+                event_type=NetlinkEventType.NEIGHBOR,
+                neighbor=nbr,
+                deleted=mtype == RTM_DELNEIGH,
+            )
+        return None

@@ -252,3 +252,146 @@ class TestAddressDump:
         assert target in addrs, addrs
         nl.del_ifaddress(IFACE, target)
         assert target not in nl.get_ifaddresses(IFACE)
+
+
+class TestNeighborDump:
+    def test_neighbor_dump_shape(self, nl):
+        """The kernel neighbor table parses without error; entries are
+        typed NlNeighbor with host-prefix destinations (reference:
+        NetlinkProtocolSocket::getAllNeighbors,
+        nl/NetlinkProtocolSocket.h:176)."""
+        neighbors = nl.get_all_neighbors()
+        for nbr in neighbors:
+            assert nbr.destination.prefix_length in (32, 128)
+            assert nbr.if_index > 0
+            assert isinstance(nbr.link_address, bytes)
+
+
+class TestEventSubscriptions:
+    def test_address_event_published(self, nl):
+        """RTMGRP_IPV*_IFADDR subscription: adding an address publishes
+        an ADDRESS NetlinkEvent (reference: the reference subscribes
+        the addr groups and fans out fbnl::IfAddress events)."""
+        from openr_tpu.platform.netlink_linux import (
+            LinuxNetlinkProtocolSocket,
+        )
+
+        queue = ReplicateQueue(name="nlev")
+        sub = LinuxNetlinkProtocolSocket(events_queue=queue)
+        reader = queue.get_reader()
+        sub.start_events()
+        try:
+            time.sleep(0.1)
+            target = IpPrefix.from_str("fd0a:7e57:ebd::1/64")
+            nl.add_ifaddress(IFACE, target)
+            deadline = time.time() + 5
+            seen = False
+            while time.time() < deadline:
+                try:
+                    ev = reader.get(timeout=0.5)
+                except QueueTimeoutError:
+                    continue
+                if (
+                    ev.event_type == NetlinkEventType.ADDRESS
+                    and ev.prefix is not None
+                    and ev.prefix.prefix_address
+                    == target.prefix_address
+                ):
+                    seen = True
+                    break
+            assert seen, "no ADDRESS event for the added address"
+            nl.del_ifaddress(IFACE, target)
+        finally:
+            sub.stop_events()
+            sub.close()
+
+    def test_route_event_published(self, nl):
+        """RTMGRP_IPV*_ROUTE subscription: programming an openr-proto
+        route publishes a ROUTE NetlinkEvent."""
+        from openr_tpu.platform.netlink_linux import (
+            LinuxNetlinkProtocolSocket,
+        )
+
+        queue = ReplicateQueue(name="nlev2")
+        sub = LinuxNetlinkProtocolSocket(events_queue=queue)
+        reader = queue.get_reader()
+        sub.start_events()
+        try:
+            time.sleep(0.1)
+            dest = IpPrefix.from_str("fd0a:7e57:ee00::/64")
+            nl.add_route(
+                UnicastRoute(
+                    dest=dest,
+                    next_hops=(
+                        NextHop(
+                            address=BinaryAddress(
+                                addr=b"", if_name=IFACE
+                            )
+                        ),
+                    ),
+                )
+            )
+            deadline = time.time() + 5
+            seen = False
+            while time.time() < deadline:
+                try:
+                    ev = reader.get(timeout=0.5)
+                except QueueTimeoutError:
+                    continue
+                if (
+                    ev.event_type == NetlinkEventType.ROUTE
+                    and ev.prefix == dest
+                ):
+                    seen = True
+                    break
+            assert seen, "no ROUTE event for the programmed route"
+            nl.delete_route(dest)
+        finally:
+            sub.stop_events()
+            sub.close()
+
+
+class TestMplsRoutes:
+    def test_mpls_add_dump_delete(self, nl):
+        """AF_MPLS label routes (reference:
+        nl/NetlinkProtocolSocket.h:131-196 label-route surface). Gated
+        on the kernel mpls_router module."""
+        from openr_tpu.platform.netlink_linux import (
+            LinuxNetlinkProtocolSocket,
+        )
+        from openr_tpu.types import MplsAction, MplsActionCode, MplsRoute
+
+        if not LinuxNetlinkProtocolSocket.mpls_supported():
+            pytest.skip("kernel lacks MPLS modules")
+        route = MplsRoute(
+            top_label=10021,
+            next_hops=(
+                NextHop(
+                    address=BinaryAddress(
+                        addr=socket_inet("fe80::1"), if_name=IFACE
+                    ),
+                    mpls_action=MplsAction(
+                        action=MplsActionCode.SWAP, swap_label=10022
+                    ),
+                ),
+            ),
+        )
+        nl.add_mpls_route(route)
+        try:
+            dumped = {
+                r.top_label: r for r in nl.get_all_mpls_routes()
+            }
+            assert 10021 in dumped
+            got = dumped[10021]
+            assert got.next_hops[0].mpls_action.swap_label == 10022
+        finally:
+            nl.delete_mpls_route(10021)
+        assert 10021 not in {
+            r.top_label for r in nl.get_all_mpls_routes()
+        }
+
+
+def socket_inet(addr: str) -> bytes:
+    import socket as _s
+
+    return _s.inet_pton(_s.AF_INET6, addr)

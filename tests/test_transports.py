@@ -228,3 +228,92 @@ class TestKvStoreTcpRecovery:
             server_b.stop()
             a.stop()
             b.stop()
+
+
+class TestMockNetlinkDepth:
+    """Mock-kernel coverage of the neighbor table, MPLS label routes,
+    and route events (the real-kernel twins live in
+    tests/test_netlink_linux.py, gated on NET_ADMIN / mpls modules;
+    reference surface: nl/NetlinkProtocolSocket.h:131-196,
+    fbnl::Neighbor in nl/NetlinkTypes.h)."""
+
+    def test_neighbor_injection_and_dump(self):
+        from openr_tpu.messaging.queue import ReplicateQueue
+        from openr_tpu.platform.netlink import (
+            MockNetlinkProtocolSocket,
+            NUD_FAILED,
+            NUD_REACHABLE,
+            NetlinkEventType,
+        )
+        from openr_tpu.types import IpPrefix
+
+        q = ReplicateQueue(name="nl")
+        reader = q.get_reader()
+        mock = MockNetlinkProtocolSocket(events_queue=q)
+        mock.add_link("eth0")
+        dst = IpPrefix.from_str("fe80::99/128")
+        mock.set_neighbor(
+            "eth0", dst, link_address=b"\x02\x00\x00\x00\x00\x01"
+        )
+        (nbr,) = mock.get_all_neighbors()
+        assert nbr.destination == dst and nbr.is_reachable
+        ev = reader.get(timeout=1)  # link event
+        assert ev.event_type == NetlinkEventType.LINK
+        ev = reader.get(timeout=1)
+        assert ev.event_type == NetlinkEventType.NEIGHBOR
+        assert ev.neighbor.is_reachable and not ev.deleted
+        # failed state is not reachable
+        mock.set_neighbor("eth0", dst, state=NUD_FAILED)
+        assert not mock.get_all_neighbors()[0].is_reachable
+        mock.del_neighbor("eth0", dst)
+        assert mock.get_all_neighbors() == []
+
+    def test_mpls_route_table(self):
+        from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+        from openr_tpu.platform.netlink_fib_handler import (
+            NetlinkFibHandler,
+        )
+        from openr_tpu.types import (
+            BinaryAddress,
+            MplsAction,
+            MplsActionCode,
+            MplsRoute,
+            NextHop,
+        )
+
+        mock = MockNetlinkProtocolSocket()
+        handler = NetlinkFibHandler(mock)
+        route = MplsRoute(
+            top_label=20001,
+            next_hops=(
+                NextHop(
+                    address=BinaryAddress(addr=b"\xfe" + b"\x00" * 15),
+                    mpls_action=MplsAction(action=MplsActionCode.PHP),
+                ),
+            ),
+        )
+        handler.add_mpls_routes(786, [route])
+        # programmed through the netlink layer, not only the table
+        assert mock.get_all_mpls_routes() == [route]
+        handler.sync_mpls_fib(786, [])
+        assert mock.get_all_mpls_routes() == []
+
+    def test_route_events_published(self):
+        from openr_tpu.messaging.queue import ReplicateQueue
+        from openr_tpu.platform.netlink import (
+            MockNetlinkProtocolSocket,
+            NetlinkEventType,
+        )
+        from openr_tpu.types import IpPrefix, UnicastRoute
+
+        q = ReplicateQueue(name="nl2")
+        reader = q.get_reader()
+        mock = MockNetlinkProtocolSocket(events_queue=q)
+        dest = IpPrefix.from_str("fd00:1::/64")
+        mock.add_route(UnicastRoute(dest=dest))
+        ev = reader.get(timeout=1)
+        assert ev.event_type == NetlinkEventType.ROUTE
+        assert ev.prefix == dest and not ev.deleted
+        mock.delete_route(dest)
+        ev = reader.get(timeout=1)
+        assert ev.deleted
