@@ -16,6 +16,7 @@ import re
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
+from openr_tpu.config.bgp_config import BgpConfig
 from openr_tpu.types.lsdb import PrefixForwardingAlgorithm, PrefixForwardingType
 
 
@@ -192,6 +193,9 @@ class OpenrConfig:
     persistent_store_path: str = "/tmp/openr_tpu_persistent_store.bin"
     node_label: int = 0
     solver_backend: str = "device"
+    # BGP peering section (reference: openr/if/BgpConfig.thrift, gating
+    # pluginStart at Main.cpp:595-601); None = BGP peering disabled
+    bgp_config: Optional["BgpConfig"] = None
 
     # -- construction -----------------------------------------------------
 
@@ -251,6 +255,10 @@ class OpenrConfig:
         ):
             if key in kwargs:
                 kwargs[key] = build(cls, kwargs[key])
+        if kwargs.get("bgp_config") is not None:
+            kwargs["bgp_config"] = BgpConfig.from_dict(
+                kwargs["bgp_config"]
+            )
         if "prefix_forwarding_type" in kwargs and isinstance(
             kwargs["prefix_forwarding_type"], str
         ):
@@ -279,6 +287,11 @@ class OpenrConfig:
         return out
 
     # -- feature-flag helpers (reference: Config.h accessors) -------------
+
+    def is_bgp_peering_enabled(self) -> bool:
+        """reference: Config::isBgpPeeringEnabled — gates pluginStart
+        (Main.cpp:595-601)."""
+        return self.bgp_config is not None
 
     def area_for_neighbor(self, node_name: str) -> Optional[str]:
         for area in self.areas:

@@ -263,6 +263,7 @@ class OpenrNode:
         from openr_tpu import plugin
 
         if plugin.has_plugin():
+            cfg = getattr(self.ctrl_handler, "_config", None)
             plugin.plugin_start(
                 plugin.PluginArgs(
                     prefix_updates_queue=self.prefix_updates,
@@ -270,7 +271,8 @@ class OpenrNode:
                     route_updates_reader=self.route_updates.get_reader(
                         f"plugin:{self.name}"
                     ),
-                    config=getattr(self.ctrl_handler, "_config", None),
+                    config=cfg,
+                    bgp_config=getattr(cfg, "bgp_config", None),
                 )
             )
             self._plugin_started = True

@@ -262,6 +262,20 @@ def main(argv=None) -> int:
         ):
             watchdog.add_evb(name, evb)
 
+    # reference: Main.cpp:595-601 invokes pluginStart when BGP peering
+    # is enabled — here the plugin hook is generic (daemon starts any
+    # registered plugin, handing it config.bgp_config), so the gate's
+    # counterpart is surfacing a peering section nobody will speak
+    if config.is_bgp_peering_enabled():
+        from openr_tpu import plugin
+
+        if not plugin.has_plugin():
+            log.warning(
+                "bgp_config present (%d peers) but no plugin is "
+                "registered to speak BGP — peering will not come up",
+                len(config.bgp_config.peers),
+            )
+
     node.start()
     if watchdog is not None:
         watchdog.start()

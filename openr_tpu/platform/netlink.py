@@ -147,6 +147,12 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
     # -- neighbor-table injection (reference:
     # tests/mocks/NetlinkEventsInjector) --------------------------------
 
+    def _link_or_raise(self, if_name: str) -> NlLink:
+        link = self._links.get(if_name)
+        if link is None:
+            raise NetlinkError(19, f"no such link {if_name}")
+        return link
+
     def set_neighbor(
         self,
         if_name: str,
@@ -155,7 +161,7 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
         state: int = NUD_REACHABLE,
     ) -> NlNeighbor:
         with self._lock:
-            link = self._links[if_name]
+            link = self._link_or_raise(if_name)
             nbr = NlNeighbor(
                 if_index=link.if_index,
                 destination=destination,
@@ -173,7 +179,7 @@ class MockNetlinkProtocolSocket(NetlinkProtocolSocket):
 
     def del_neighbor(self, if_name: str, destination: IpPrefix) -> None:
         with self._lock:
-            link = self._links[if_name]
+            link = self._link_or_raise(if_name)
             nbr = self._neighbors.pop((link.if_index, destination), None)
         if nbr is not None:
             self.events_queue.push(

@@ -86,6 +86,10 @@ RTA_NEWDST = 19  # MPLS swap: outgoing label stack
 NDA_DST = 1
 NDA_LLADDR = 2
 
+# rtattr types (address, linux/if_addr.h)
+IFA_ADDRESS = 1
+IFA_LOCAL = 2
+
 AF_MPLS = 28
 MPLS_LABEL_IMPLICIT_NULL = 3  # PHP: pop, forward by inner header
 
@@ -434,10 +438,7 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         if index is None:
             raise NetlinkError(19, f"no such link {if_name}")
         family = socket.AF_INET if prefix.is_v4 else socket.AF_INET6
-        body = struct.pack(
-            "=BBBBi", family, prefix.prefix_length, 0, 0, index
-        )
-        IFA_LOCAL = 2
+        body = _IFADDRMSG.pack(family, prefix.prefix_length, 0, 0, index)
         body += _attr(IFA_LOCAL, prefix.prefix_address.addr)
         self._request(
             RTM_NEWADDR,
@@ -450,10 +451,7 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         if index is None:
             raise NetlinkError(19, f"no such link {if_name}")
         family = socket.AF_INET if prefix.is_v4 else socket.AF_INET6
-        body = struct.pack(
-            "=BBBBi", family, prefix.prefix_length, 0, 0, index
-        )
-        IFA_LOCAL = 2
+        body = _IFADDRMSG.pack(family, prefix.prefix_length, 0, 0, index)
         body += _attr(IFA_LOCAL, prefix.prefix_address.addr)
         self._request(RTM_DELADDR, NLM_F_REQUEST | NLM_F_ACK, body)
 
@@ -463,20 +461,19 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
         index = self.link_index(if_name)
         if index is None:
             raise NetlinkError(19, f"no such link {if_name}")
-        body = struct.pack("=BBBBi", socket.AF_UNSPEC, 0, 0, 0, 0)
-        IFA_ADDRESS, IFA_LOCAL = 1, 2
+        body = _IFADDRMSG.pack(socket.AF_UNSPEC, 0, 0, 0, 0)
         out: List[IpPrefix] = []
         for mtype, payload in self._request(
             RTM_GETADDR, NLM_F_REQUEST | NLM_F_DUMP, body
         ):
             if mtype != RTM_NEWADDR:
                 continue
-            _family, plen, _flags, _scope, ifindex = struct.unpack_from(
-                "=BBBBi", payload
+            _family, plen, _flags, _scope, ifindex = _IFADDRMSG.unpack_from(
+                payload
             )
             if ifindex != index:
                 continue
-            attrs = _parse_attrs(payload[8:])
+            attrs = _parse_attrs(payload[_IFADDRMSG.size :])
             addr = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS)
             if addr is None:
                 continue
@@ -546,7 +543,8 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             )
         # PHP / POP_AND_LOOKUP: no NEWDST — the kernel pops
         addr = nh.address.addr
-        if addr and set(addr) != {0}:
+        has_via = bool(addr) and set(addr) != {0}
+        if has_via:
             family = (
                 socket.AF_INET if len(addr) == 4 else socket.AF_INET6
             )
@@ -554,6 +552,11 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
                 RTA_VIA, struct.pack("=H", family) + addr
             )
         index = links.get(nh.address.if_name or "")
+        if index is None and not has_via:
+            # POP_AND_LOOKUP (our own label): Linux encodes "pop and
+            # forward by inner header" as a label route out of loopback
+            # — without any nexthop attr the kernel rejects the route
+            index = links.get("lo")
         if index is not None:
             attrs += _attr(RTA_OIF, struct.pack("=i", index))
         return attrs
@@ -636,17 +639,32 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             return None
         label = struct.unpack(">I", dst)[0] >> 12
 
-        def parse_nh(nh_attrs: Dict[int, bytes]) -> NextHop:
+        lo_index = self._link_table().get("lo")
+
+        def parse_nh(
+            nh_attrs: Dict[int, bytes], rtnh_index: Optional[int] = None
+        ) -> NextHop:
             addr = b""
             via = nh_attrs.get(RTA_VIA)
             if via is not None:
                 addr = via[2:]
+            oif = nh_attrs.get(RTA_OIF)
+            index = (
+                struct.unpack("=i", oif)[0]
+                if oif is not None
+                else rtnh_index
+            )
             newdst = nh_attrs.get(RTA_NEWDST)
             if newdst is not None:
                 action = MplsAction(
                     action=MplsActionCode.SWAP,
                     swap_label=struct.unpack(">I", newdst[:4])[0] >> 12,
                 )
+            elif via is None and index is not None and index == lo_index:
+                # no via, out of loopback: the POP_AND_LOOKUP encoding
+                # (mirrors _mpls_nh_attrs) — reporting it as PHP would
+                # make desired-vs-dumped reconciliation mismatch forever
+                action = MplsAction(action=MplsActionCode.POP_AND_LOOKUP)
             else:
                 action = MplsAction(action=MplsActionCode.PHP)
             return NextHop(
@@ -658,12 +676,13 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             data = attrs[RTA_MULTIPATH]
             off = 0
             while off + _RTNEXTHOP.size <= len(data):
-                rtnh_len, _f, _h, _idx = _RTNEXTHOP.unpack_from(data, off)
+                rtnh_len, _f, _h, idx = _RTNEXTHOP.unpack_from(data, off)
                 nhs.append(
                     parse_nh(
                         _parse_attrs(
                             data[off + _RTNEXTHOP.size : off + rtnh_len]
-                        )
+                        ),
+                        rtnh_index=idx,
                     )
                 )
                 off += _align4(rtnh_len)
@@ -739,7 +758,6 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
                 payload
             )
             attrs = _parse_attrs(payload[_IFADDRMSG.size :])
-            IFA_ADDRESS, IFA_LOCAL = 1, 2
             addr = attrs.get(IFA_LOCAL) or attrs.get(IFA_ADDRESS)
             if addr is None:
                 return None

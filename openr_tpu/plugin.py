@@ -31,6 +31,11 @@ class PluginArgs:
     static_routes_queue: ReplicateQueue
     route_updates_reader: RQueue
     config: Any = None
+    # the resolved BGP peering section (config.bgp_config.BgpConfig) —
+    # what a BGP speaker plugin peers from; None when BGP peering is
+    # disabled (the reference only calls pluginStart when it is
+    # enabled, Main.cpp:595-601)
+    bgp_config: Any = None
     ssl_context: Any = None  # parity slot; TLS is handled by ctrl server
 
 

@@ -1,0 +1,224 @@
+"""BGP peering config schema (reference: openr/if/BgpConfig.thrift) —
+parsing, validation, and the peer-group overlay semantics
+("peer config overwrites peer group config", BgpConfig.thrift:201)."""
+
+import pytest
+
+from openr_tpu.config.bgp_config import (
+    AddPath,
+    AdvertiseLinkBandwidth,
+    BgpConfig,
+    BgpConfigError,
+    BgpPeer,
+    BgpPeerTimers,
+    PeerGroup,
+)
+from openr_tpu.config.config import ConfigError, OpenrConfig
+
+
+class TestParsing:
+    def test_full_round(self):
+        cfg = BgpConfig.from_dict(
+            {
+                "router_id": "10.0.0.1",
+                "local_as": 65001,
+                "hold_time": 90,
+                "peer_groups": [
+                    {
+                        "name": "spine",
+                        "remote_as": 65000,
+                        "next_hop_self": True,
+                        "bgp_peer_timers": {
+                            "hold_time_seconds": 90,
+                            "keep_alive_seconds": 30,
+                        },
+                        "add_path": "BOTH",
+                    }
+                ],
+                "peers": [
+                    {
+                        "peer_addr": "10.0.1.2",
+                        "peer_group_name": "spine",
+                    },
+                    {
+                        "peer_addr": "fc00::2",
+                        "remote_as": 65002,
+                        "advertise_link_bandwidth": "AGGREGATE",
+                        "pre_filter": {"max_routes": 500},
+                    },
+                ],
+            }
+        )
+        assert cfg.listen_port == 179  # thrift default
+        assert cfg.eor_time_s == 45
+        p0, p1 = cfg.resolved_peers()
+        # group overlay filled these in
+        assert p0.remote_as == 65000
+        assert p0.next_hop_self is True
+        assert p0.add_path is AddPath.BOTH
+        assert p0.bgp_peer_timers.keep_alive_seconds == 30
+        # explicit peer config untouched
+        assert p1.remote_as == 65002
+        assert (
+            p1.advertise_link_bandwidth
+            is AdvertiseLinkBandwidth.AGGREGATE
+        )
+        assert p1.pre_filter.max_routes == 500
+
+    def test_peer_value_beats_group(self):
+        cfg = BgpConfig(
+            router_id="1.1.1.1",
+            local_as=65001,
+            peer_groups=[
+                PeerGroup(name="g", remote_as=65000, local_as=64999)
+            ],
+            peers=[
+                BgpPeer(
+                    peer_addr="10.0.0.9",
+                    peer_group_name="g",
+                    local_as=65010,
+                )
+            ],
+        )
+        (peer,) = cfg.resolved_peers()
+        assert peer.local_as == 65010  # peer overwrites group
+        assert peer.remote_as == 65000  # inherited
+
+
+class TestValidation:
+    def test_router_id_required_and_ip(self):
+        with pytest.raises(BgpConfigError):
+            BgpConfig(local_as=1)
+        with pytest.raises(BgpConfigError):
+            BgpConfig(router_id="not-an-ip", local_as=1)
+
+    def test_peer_needs_remote_as(self):
+        with pytest.raises(BgpConfigError, match="remote_as"):
+            BgpConfig(
+                router_id="1.1.1.1",
+                local_as=65001,
+                peers=[BgpPeer(peer_addr="10.0.0.2")],
+            )
+
+    def test_unknown_peer_group(self):
+        with pytest.raises(BgpConfigError, match="unknown peer group"):
+            BgpConfig(
+                router_id="1.1.1.1",
+                local_as=65001,
+                peers=[
+                    BgpPeer(
+                        peer_addr="10.0.0.2",
+                        remote_as=1,
+                        peer_group_name="missing",
+                    )
+                ],
+            )
+
+    def test_prefix_peer_addr_requires_passive(self):
+        with pytest.raises(BgpConfigError, match="passive"):
+            BgpConfig(
+                router_id="1.1.1.1",
+                local_as=65001,
+                peers=[
+                    BgpPeer(peer_addr="10.0.0.0/24", remote_as=65002)
+                ],
+            )
+        # passive prefix listen range is allowed
+        BgpConfig(
+            router_id="1.1.1.1",
+            local_as=65001,
+            peers=[
+                BgpPeer(
+                    peer_addr="10.0.0.0/24",
+                    remote_as=65002,
+                    is_passive=True,
+                )
+            ],
+        )
+
+    def test_hold_keepalive_ratio(self):
+        with pytest.raises(BgpConfigError, match="3x"):
+            BgpPeerTimers(
+                hold_time_seconds=20, keep_alive_seconds=10
+            ).validate()
+
+    def test_duplicate_peers_rejected(self):
+        with pytest.raises(BgpConfigError, match="duplicate"):
+            BgpConfig(
+                router_id="1.1.1.1",
+                local_as=65001,
+                peers=[
+                    BgpPeer(peer_addr="10.0.0.2", remote_as=1),
+                    BgpPeer(peer_addr="10.0.0.2", remote_as=2),
+                ],
+            )
+
+
+class TestOpenrConfigIntegration:
+    def test_bgp_section_parsed_and_gates_flag(self):
+        cfg = OpenrConfig.from_dict(
+            {
+                "node_name": "n1",
+                "bgp_config": {
+                    "router_id": "10.0.0.1",
+                    "local_as": 65001,
+                    "peers": [
+                        {"peer_addr": "10.0.0.2", "remote_as": 65002}
+                    ],
+                },
+            }
+        )
+        assert cfg.is_bgp_peering_enabled()
+        assert cfg.bgp_config.peers[0].remote_as == 65002
+        assert not OpenrConfig.from_dict(
+            {"node_name": "n1"}
+        ).is_bgp_peering_enabled()
+
+    def test_invalid_bgp_section_fails_config_load(self):
+        with pytest.raises((BgpConfigError, ConfigError)):
+            OpenrConfig.from_dict(
+                {
+                    "node_name": "n1",
+                    "bgp_config": {"router_id": "", "local_as": 0},
+                }
+            )
+
+    def test_plugin_receives_bgp_config(self):
+        """The daemon hands the parsed BgpConfig to the plugin hook
+        (reference: pluginStart gated on BGP peering, Main.cpp:595-601)."""
+        from openr_tpu import plugin
+
+        got = {}
+
+        def start(args):
+            got["bgp"] = args.bgp_config
+
+        class FakeHandler:
+            pass
+
+        cfg = OpenrConfig.from_dict(
+            {
+                "node_name": "n1",
+                "bgp_config": {
+                    "router_id": "10.0.0.1",
+                    "local_as": 65001,
+                },
+            }
+        )
+        plugin.register_plugin(start)
+        try:
+            from openr_tpu.messaging.queue import ReplicateQueue
+
+            args = plugin.PluginArgs(
+                prefix_updates_queue=ReplicateQueue(name="p"),
+                static_routes_queue=ReplicateQueue(name="s"),
+                route_updates_reader=ReplicateQueue(
+                    name="r"
+                ).get_reader(),
+                config=cfg,
+                bgp_config=cfg.bgp_config,
+            )
+            plugin.plugin_start(args)
+            assert got["bgp"] is cfg.bgp_config
+        finally:
+            plugin.unregister_plugin()
