@@ -81,6 +81,14 @@ class KvStoreConfig:
     # reference: KvstoreFloodRate (0 = unlimited)
     flood_msg_per_sec: int = 0
     flood_msg_burst_size: int = 0
+    # cross-process peer sync: TCP port the peer server binds (0 =
+    # ephemeral; reference: Constants.h:257 kvstore port 60002) and
+    # the wire spoken on it — the framework's own RPC codec, or thrift
+    # framed CompactProtocol for interop with stock Open/R peers
+    # (reference dual-stack flag: enable_kvstore_thrift,
+    # KvStore.cpp:2940-2973)
+    peer_port: int = 60002
+    enable_kvstore_thrift: bool = False
 
     def flood_rate(self):
         if self.flood_msg_per_sec > 0 and self.flood_msg_burst_size > 0:
@@ -217,6 +225,19 @@ class OpenrConfig:
             raise ConfigError("duplicate area ids")
         self.spark.validate()
         self.prefix_alloc.validate()
+        if (
+            self.kvstore.enable_kvstore_thrift
+            and self.kvstore.enable_flood_optimization
+        ):
+            # the thrift peer channel covers sync/flood only; DUAL
+            # flood-topology messages ride the framework RPC channel —
+            # combining them would demote the peer on every DUAL send
+            # and loop full syncs forever
+            raise ConfigError(
+                "enable_kvstore_thrift and enable_flood_optimization "
+                "are mutually exclusive (DUAL messages are not part of "
+                "the thrift peer surface)"
+            )
         if (self.kvstore.flood_msg_per_sec > 0) != (
             self.kvstore.flood_msg_burst_size > 0
         ):

@@ -45,6 +45,10 @@ class OpenrNode:
         interface_areas: Optional[Dict[str, str]] = None,
         v6_addr: Optional[str] = None,
         spark_config: Optional[dict] = None,
+        # cross-process KvStore peering: dial a neighbor's advertised
+        # peer port (reference: thrift peer clients, KvStore.cpp:1400).
+        # None = in-process registry resolution (simulations/tests)
+        peer_transport_factory=None,
         use_rtt_metric: bool = False,
         config_store=None,
         solver_backend: str = "device",
@@ -165,7 +169,9 @@ class OpenrNode:
             interface_updates_queue=self.interface_updates,
             kvstore_client=self.kvstore_client,
             kvstore=self.kvstore,
-            peer_transport_factory=self._peer_transport,
+            peer_transport_factory=(
+                peer_transport_factory or self._peer_transport
+            ),
             config_store=config_store,
             area=area,
             areas=self.areas,

@@ -27,9 +27,10 @@ class KvStorePeerServer:
 
     def __init__(self, kvstore: KvStore, host: str = "::", port: int = 0):
         self._kvstore = kvstore
-        # bind on IPv4 loopback-compatible any-host for portability
-        self._server = RpcServer(host=host if host != "::" else "0.0.0.0",
-                                 port=port)
+        # "::" binds dual-stack v6 (RpcServer picks AF_INET6 for v6
+        # hosts) — neighbors dial fe80:: link-local transports, which a
+        # v4-only listener can never accept
+        self._server = RpcServer(host=host, port=port)
         self._server.register(
             "getKvStoreKeyValsFiltered",
             self._get_filtered,

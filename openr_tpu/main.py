@@ -205,10 +205,36 @@ def main(argv=None) -> int:
                 interface_areas[if_name] = a.area_id
                 break
 
+    # cross-process KvStore peering: neighbors advertise their peer
+    # port in Spark handshakes (Spark.thrift:97 kvStoreCmdPort) and we
+    # dial their link-local transport address. Wire selected by
+    # kvstore.enable_kvstore_thrift (framed CompactProtocol interop vs
+    # the framework RPC codec).
+    def peer_transport_factory(nbr):
+        if nbr.kvstore_peer_port <= 0:
+            return None
+        host = None
+        if nbr.transport_address_v6.addr:
+            host = nbr.transport_address_v6.to_str()
+            if host.startswith("fe80"):
+                host = f"{host}%{nbr.local_if_name}"
+        elif nbr.transport_address_v4.addr:
+            host = nbr.transport_address_v4.to_str()
+        if not host:
+            return None
+        if config.kvstore.enable_kvstore_thrift:
+            from openr_tpu.kvstore.thrift_peer import ThriftPeerTransport
+
+            return ThriftPeerTransport(host, nbr.kvstore_peer_port)
+        from openr_tpu.kvstore.transport import TcpPeerTransport
+
+        return TcpPeerTransport(host, nbr.kvstore_peer_port)
+
     node = OpenrNode(
         config.node_name,
         io_provider,
         fib_agent=fib_agent,
+        peer_transport_factory=peer_transport_factory,
         area=area,
         areas=config.area_ids(),
         interface_areas=interface_areas or None,
@@ -276,6 +302,31 @@ def main(argv=None) -> int:
                 len(config.bgp_config.peers),
             )
 
+    # KvStore peer server: what neighbors dial for full-sync and flood
+    # (reference: the thrift KvStoreService / legacy zmq ROUTER on port
+    # 60002, Constants.h:257). Bound before Spark starts so the
+    # handshake advertises a live port.
+    if config.kvstore.enable_kvstore_thrift:
+        from openr_tpu.kvstore.thrift_peer import KvStoreThriftPeerServer
+
+        peer_server = KvStoreThriftPeerServer(
+            node.kvstore, host="::", port=config.kvstore.peer_port
+        )
+    else:
+        from openr_tpu.kvstore.transport import KvStorePeerServer
+
+        peer_server = KvStorePeerServer(
+            node.kvstore, host="::", port=config.kvstore.peer_port
+        )
+    peer_server.start()
+    node.spark.set_kvstore_peer_port(peer_server.port)
+    log.info(
+        "kvstore peer server (%s wire) on port %d",
+        "thrift-compact" if config.kvstore.enable_kvstore_thrift
+        else "framework-rpc",
+        peer_server.port,
+    )
+
     node.start()
     if watchdog is not None:
         watchdog.start()
@@ -302,6 +353,7 @@ def main(argv=None) -> int:
 
     if watchdog is not None:
         watchdog.stop()
+    peer_server.stop()
     node.stop()
     config_store.stop()
     log.info("shutdown complete")

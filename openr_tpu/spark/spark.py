@@ -79,6 +79,7 @@ class _Neighbor:
     transport_v6: BinaryAddress = field(default_factory=BinaryAddress)
     transport_v4: BinaryAddress = field(default_factory=BinaryAddress)
     ctrl_port: int = 2018
+    kvstore_peer_port: int = 0
     hold_timer=None
     gr_timer=None
     rtt_detector: Optional[StepDetector] = None
@@ -91,6 +92,7 @@ class _Neighbor:
             transport_address_v6=self.transport_v6,
             transport_address_v4=self.transport_v4,
             openr_ctrl_port=self.ctrl_port,
+            kvstore_peer_port=self.kvstore_peer_port,
             area=self.area,
             rtt_us=self.rtt_us,
         )
@@ -112,6 +114,7 @@ class Spark:
         hold_time_s: float = 1.5,
         graceful_restart_time_s: float = 10.0,
         ctrl_port: int = 2018,
+        kvstore_peer_port: int = 0,
         v4_addr: Optional[BinaryAddress] = None,
         v6_addr: Optional[BinaryAddress] = None,
     ):
@@ -131,6 +134,9 @@ class Spark:
         self._hold_time_ms = int(hold_time_s * 1000)
         self._gr_time_ms = int(graceful_restart_time_s * 1000)
         self._ctrl_port = ctrl_port
+        # advertised to neighbors in handshakes so they can dial our
+        # KvStore peer server (reference: Spark.thrift:97 kvStoreCmdPort)
+        self._kvstore_peer_port = kvstore_peer_port
         self._v4 = v4_addr or BinaryAddress()
         self._v6 = v6_addr or BinaryAddress()
         # if_name -> {neighbor_node -> _Neighbor}
@@ -151,6 +157,12 @@ class Spark:
                 interface_updates_queue.get_reader(f"spark:{my_node_name}"),
                 self._on_interface_updates,
             )
+
+    def set_kvstore_peer_port(self, port: int) -> None:
+        """Set the advertised peer port once the KvStore peer server has
+        bound (an ephemeral bind resolves only after construction).
+        Must be called before start()."""
+        self._kvstore_peer_port = port
 
     # -- lifecycle --------------------------------------------------------
 
@@ -261,6 +273,7 @@ class Spark:
             transport_address_v6=self._v6,
             transport_address_v4=self._v4,
             openr_ctrl_port=self._ctrl_port,
+            kvstore_peer_port=self._kvstore_peer_port,
             area=self.area_for_interface(if_name),
             neighbor_node_name=to_neighbor,
         )
@@ -393,6 +406,7 @@ class Spark:
         neighbor.transport_v6 = msg.transport_address_v6
         neighbor.transport_v4 = msg.transport_address_v4
         neighbor.ctrl_port = msg.openr_ctrl_port
+        neighbor.kvstore_peer_port = msg.kvstore_peer_port
 
         if neighbor.state in (
             SparkNeighState.WARM,

@@ -56,6 +56,12 @@ class SparkHandshakeMsg:
     area: str = "0"
     # receiver targeting: when set, only this neighbor should process
     neighbor_node_name: Optional[str] = None
+    # the sender's KvStore peer-sync port (reference: Spark.thrift:97
+    # kvStoreCmdPort); 0 when cross-process peering is not exposed.
+    # TRAILING deliberately: the wire codec decodes positionally and
+    # only forward-compats unknown trailing fields, so a mixed-version
+    # neighborhood (old daemon, new handshake) still negotiates
+    kvstore_peer_port: int = 0
 
 
 @dataclass
@@ -100,6 +106,9 @@ class SparkNeighbor:
     openr_ctrl_port: int = 2018
     area: str = "0"
     rtt_us: int = 0
+    # reference: Spark.thrift:97 kvStoreCmdPort (trailing: see
+    # SparkHandshakeMsg)
+    kvstore_peer_port: int = 0
 
 
 @dataclass

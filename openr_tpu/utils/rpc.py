@@ -63,6 +63,15 @@ def _recv_frame(sock: socket.socket) -> Optional[List[bytes]]:
     return blobs
 
 
+def apply_bind_family(server_cls, host: str) -> None:
+    """Pick the socketserver address family from the bind host: a v6
+    host (incl. "::" dual-stack) needs AF_INET6 — link-local neighbor
+    transports can only dial a v6 listener. Shared by every TCP server
+    in the framework so v6-bind fixes happen in one place."""
+    if ":" in host:
+        server_cls.address_family = socket.AF_INET6
+
+
 class RpcServer:
     """Threaded TCP server dispatching registered wire-RPC methods."""
 
@@ -93,6 +102,7 @@ class RpcServer:
             allow_reuse_address = True
             daemon_threads = True
 
+        apply_bind_family(_Server, host)
         self._server = _Server((host, port), _Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(

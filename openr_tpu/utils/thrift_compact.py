@@ -478,7 +478,7 @@ def decode_value(data: bytes):
     return _value_from_wire(decode(VALUE, data))
 
 
-def encode_publication(pub) -> bytes:
+def _publication_to_wire(pub) -> Dict:
     out: Dict = {
         "keyVals": {
             k: _value_to_wire(v) for k, v in pub.key_vals.items()
@@ -492,13 +492,12 @@ def encode_publication(pub) -> bytes:
         out["tobeUpdatedKeys"] = list(pub.tobe_updated_keys)
     if pub.flood_root_id is not None:
         out["floodRootId"] = pub.flood_root_id
-    return encode(PUBLICATION, out)
+    return out
 
 
-def decode_publication(data: bytes):
+def _publication_from_wire(d: Dict):
     from openr_tpu.types import Publication
 
-    d = decode(PUBLICATION, data)
     return Publication(
         key_vals={
             k: _value_from_wire(v)
@@ -512,7 +511,15 @@ def decode_publication(data: bytes):
     )
 
 
-def encode_key_set_params(p) -> bytes:
+def encode_publication(pub) -> bytes:
+    return encode(PUBLICATION, _publication_to_wire(pub))
+
+
+def decode_publication(data: bytes):
+    return _publication_from_wire(decode(PUBLICATION, data))
+
+
+def _key_set_params_to_wire(p) -> Dict:
     """Our KeySetParams.originator_id rides the wire as the reference's
     ``nodeIds`` traversal list (the reference appends each hop's node id
     for loop suppression; the framework tracks only the sender)."""
@@ -528,13 +535,12 @@ def encode_key_set_params(p) -> bytes:
         out["floodRootId"] = p.flood_root_id
     if p.timestamp_ms is not None:
         out["timestamp_ms"] = p.timestamp_ms
-    return encode(KEY_SET_PARAMS, out)
+    return out
 
 
-def decode_key_set_params(data: bytes):
+def _key_set_params_from_wire(d: Dict):
     from openr_tpu.types import KeySetParams
 
-    d = decode(KEY_SET_PARAMS, data)
     node_ids = d.get("nodeIds") or []
     return KeySetParams(
         key_vals={
@@ -548,7 +554,15 @@ def decode_key_set_params(data: bytes):
     )
 
 
-def encode_key_dump_params(p) -> bytes:
+def encode_key_set_params(p) -> bytes:
+    return encode(KEY_SET_PARAMS, _key_set_params_to_wire(p))
+
+
+def decode_key_set_params(data: bytes):
+    return _key_set_params_from_wire(decode(KEY_SET_PARAMS, data))
+
+
+def _key_dump_params_to_wire(p) -> Dict:
     out: Dict = {
         "prefix": p.prefix,
         "originatorIds": set(p.originator_ids),
@@ -561,13 +575,12 @@ def encode_key_dump_params(p) -> bytes:
         }
     if p.keys is not None:
         out["keys"] = list(p.keys)
-    return encode(KEY_DUMP_PARAMS, out)
+    return out
 
 
-def decode_key_dump_params(data: bytes):
+def _key_dump_params_from_wire(d: Dict):
     from openr_tpu.types import KeyDumpParams
 
-    d = decode(KEY_DUMP_PARAMS, data)
     hashes = d.get("keyValHashes")
     return KeyDumpParams(
         prefix=d.get("prefix", ""),
@@ -579,3 +592,11 @@ def decode_key_dump_params(data: bytes):
             else None
         ),
     )
+
+
+def encode_key_dump_params(p) -> bytes:
+    return encode(KEY_DUMP_PARAMS, _key_dump_params_to_wire(p))
+
+
+def decode_key_dump_params(data: bytes):
+    return _key_dump_params_from_wire(decode(KEY_DUMP_PARAMS, data))

@@ -182,3 +182,69 @@ class TestSystem:
         assert a_keys == b_keys
         assert any(k.startswith("adj:alpha") for k in a_keys)
         assert any(k.startswith("prefix:beta") for k in a_keys)
+
+
+class TestThriftWirePeering:
+    """Full daemons whose KvStores peer over REAL TCP speaking the
+    thrift framed-CompactProtocol wire (the stock Open/R peer channel,
+    KvStore.thrift:256-276), with the peer port learned from the Spark
+    handshake (Spark.thrift:97 kvStoreCmdPort) — the cross-process
+    deployment path of openr_tpu.main."""
+
+    def test_route_propagation_over_thrift_tcp(self):
+        from openr_tpu.kvstore.thrift_peer import (
+            KvStoreThriftPeerServer,
+            ThriftPeerTransport,
+        )
+
+        io = MockIoProvider()
+        nodes = {}
+        servers = {}
+
+        def factory(nbr):
+            if nbr.kvstore_peer_port <= 0:
+                return None
+            return ThriftPeerTransport(
+                "127.0.0.1", nbr.kvstore_peer_port
+            )
+
+        for idx, name in enumerate(("tna", "tnb")):
+            node = OpenrNode(
+                name,
+                io,
+                node_registry={},  # isolated: force the TCP path
+                v6_addr=f"fe80::{idx + 1}",
+                spark_config=SPARK_FAST,
+                peer_transport_factory=factory,
+            )
+            server = KvStoreThriftPeerServer(
+                node.kvstore, host="127.0.0.1"
+            )
+            server.start()
+            node.spark.set_kvstore_peer_port(server.port)
+            nodes[name] = node
+            servers[name] = server
+
+        try:
+            for node in nodes.values():
+                node.start()
+            if_ab, if_ba = "if_tna_tnb", "if_tnb_tna"
+            io.connect_pair(if_ab, if_ba, 1)
+            nodes["tna"].add_interface(if_ab)
+            nodes["tnb"].add_interface(if_ba)
+            pfx = nodes["tna"].advertise_loopback("fd00:aaaa::1/128")
+
+            def has_route():
+                db = nodes["tnb"].get_fib_routes()
+                return any(r.dest == pfx for r in db.unicast_routes)
+
+            assert wait_until(has_route, timeout=15.0)
+            # and the adjacency DB flooded over the same wire
+            adj = nodes["tnb"].kvstore.get_key_vals("0", ["adj:tna"])
+            assert "adj:tna" in adj
+        finally:
+            for node in nodes.values():
+                node.stop()
+            for server in servers.values():
+                server.stop()
+            io.stop()
