@@ -1,0 +1,38 @@
+# openr-tpu build/test entry points (Layer 0).
+#
+# The native SPF core (native/spfcore.cpp) also builds lazily on first
+# use (openr_tpu/graph/native_spf.py); this makes the build explicit
+# for packaging/CI. Python deps (jax, numpy, pytest) come from the
+# environment — see pyproject.toml.
+
+CXX      ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
+NATIVE    = native/libspfcore.so
+
+.PHONY: all native test test-fast bench clean install
+
+all: native
+
+native: $(NATIVE)
+
+$(NATIVE): native/spfcore.cpp
+	$(CXX) $(CXXFLAGS) -shared $< -o $@
+
+install:
+	pip install -e .
+
+# full suite on the virtual 8-device CPU mesh (conftest pins CPU)
+test: native
+	python -m pytest tests/ -q
+
+test-fast: native
+	python -m pytest tests/ -q -x -m "not slow"
+
+# the official reconvergence benchmark (one JSON line; probes the real
+# accelerator with retries, degrades to CPU with evidence)
+bench: native
+	python bench.py
+
+clean:
+	rm -f $(NATIVE)
+	find . -name __pycache__ -type d -exec rm -rf {} +

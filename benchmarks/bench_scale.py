@@ -134,6 +134,82 @@ def run_churn(args):
     )
 
 
+def ksp2_churn_bench(nodes: int, churn_events: int) -> dict:
+    """Fabric KSP2_ED_ECMP churn rebuild through the full SpfSolver —
+    the incremental-KSP2-engine path (BASELINE.json config 2 axis;
+    reference semantics: Decision.cpp:908 selectBestPathsKsp2).
+    Shared by the scale harness and the official bench.py artifact."""
+    import statistics
+    from dataclasses import replace
+
+    import jax
+
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import (
+        SPF_COUNTERS,
+        SpfSolver,
+    )
+    from openr_tpu.types.lsdb import (
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+    )
+
+    topo = topologies.fat_tree_nodes(
+        nodes,
+        forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        forwarding_type=PrefixForwardingType.SR_MPLS,
+    )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    area_ls = {topo.area: ls}
+    rsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("rsw"))
+    fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+    solver = SpfSolver(rsw, backend="device")
+    t0 = time.perf_counter()
+    solver.build_route_db(rsw, area_ls, ps)
+    cold_ms = (time.perf_counter() - t0) * 1000
+
+    def churn(step):
+        db = ls.get_adjacency_databases()[fsw]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=2 + step % 5)
+        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+
+    # one full metric cycle warms every jit shape (engine cold build +
+    # each masked-batch bucket) before the timed window
+    for step in range(5):
+        churn(step)
+        solver.build_route_db(rsw, area_ls, ps)
+
+    before = dict(SPF_COUNTERS)
+    samples = []
+    for step in range(churn_events):
+        churn(step)
+        t0 = time.perf_counter()
+        solver.build_route_db(rsw, area_ls, ps)
+        samples.append((time.perf_counter() - t0) * 1000)
+    return {
+        "bench": f"scale.fabric_{ls.num_nodes}_ksp2_churn_rebuild",
+        "events": churn_events,
+        "median_ms": round(statistics.median(samples), 1),
+        "p90_ms": round(
+            sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
+        ),
+        "cold_build_ms": round(cold_ms, 1),
+        "platform": jax.devices()[0].platform,
+        "ksp2_host_fallbacks": SPF_COUNTERS[
+            "decision.ksp2_host_fallbacks"
+        ] - before["decision.ksp2_host_fallbacks"],
+        "incremental_syncs": SPF_COUNTERS[
+            "decision.ksp2_incremental_syncs"
+        ] - before["decision.ksp2_incremental_syncs"],
+    }
+
+
 def all_sources_bench(
     nodes: int, block: int, kernel: str = "ell",
     max_blocks: int = 0,

@@ -64,6 +64,9 @@ ENGINE_MAX_ENDPOINTS = 32
 # if more than this fraction of destinations is affected, a cold
 # rebuild is cheaper than the incremental machinery
 ENGINE_FULL_REBUILD_FRACTION = 3  # affected * N > dsts  -> cold
+# fast path: how many changed masked rows the fused dispatch reads back
+# inline; more than this forces one extra full-matrix readback
+ENGINE_ROW_BUDGET = 64
 
 
 def _counters():
@@ -259,14 +262,26 @@ class Ksp2Engine:
             ep = [self.sid]
 
         # one fused dispatch: all-pairs + view + old/new endpoint rows
+        # (+ on the fast path: speculative masked re-solve of every
+        # destination against the RESIDENT masks, row-diffed on device)
         from openr_tpu.ops import spf_sparse
 
         view_srcs = spf_sparse.ell_source_batch(graph, ls, self.src_name)
         srcs_dev, w_sv = spf_sparse._batch_args(graph, view_srcs)
         ep_ids = _pad_ids(ep)
-        d_all_dev, packed = spf_sparse.ell_all_view_rows(
-            state, srcs_dev, w_sv, ep_ids, self.d_prev_dev
-        )
+        use_fast = getattr(self, "masks_t", None) is not None
+        dm_new_dev = None
+        if use_fast:
+            (
+                d_all_dev, dm_new_dev, packed,
+            ) = spf_sparse.ell_all_view_rows_masked(
+                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
+                self.masks_t, self.dm_dev, self.sid, ENGINE_ROW_BUDGET,
+            )
+        else:
+            d_all_dev, packed = spf_sparse.ell_all_view_rows(
+                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev
+            )
         b = len(view_srcs)
         p = len(ep_ids)
         view_packed = packed[: 2 * b]
@@ -277,24 +292,74 @@ class Ksp2Engine:
         self._preload_view(ls, graph, view_srcs, view_packed)
         d_new_src = view_packed[0].astype(np.int64)
 
-        affected = self._affected_dsts(
+        aff1, aff2 = self._affected_dsts(
             ls, graph, changed, d_new_src, rows_new, rows_old
         )
+        dst_set = set(self.dst_pos)
+        aff1 &= dst_set
+        aff2 &= dst_set
+        # label/overload materialization extras: paths are unchanged
+        # (distance tests cover path changes) but the ROUTES built from
+        # them embed labels / drain state — invalidate route reuse only
+        route_extra: Set[str] = set()
         for x in ov_flips | label_flips:
             if x in self.dst_pos:
-                affected.add(x)
-            affected |= self.node_users.get(x, set())
-        affected &= set(self.dst_pos)
+                route_extra.add(x)
+            route_extra |= self.node_users.get(x, set())
+        route_extra &= dst_set
+        affected = aff1 | aff2 | route_extra | (self.host_dsts & dst_set)
 
         if len(affected) * ENGINE_FULL_REBUILD_FRACTION > len(dsts):
             self._cold_build(ls, state, dsts)
             return None
 
-        if affected:
-            ok = self._recompute(ls, state, sorted(affected), d_new_src)
+        if use_fast:
+            # parse the on-device row diff: meta row carries the top-K
+            # changed row ids and the total count
+            meta = packed[2 * b + 2 * p]
+            ids = meta[:ENGINE_ROW_BUDGET]
+            count = int(meta[ENGINE_ROW_BUDGET])
+            changed_rows = packed[2 * b + 2 * p + 1 :]
+            # adopt the speculative matrix now so dispatch-2 corrections
+            # scatter into the CURRENT resident state
+            self.dm_dev = dm_new_dev
+            row_map = {}
+            if count <= ENGINE_ROW_BUDGET:
+                for x, i in enumerate(ids):
+                    if int(i) >= 0:
+                        row_map[self.dsts[int(i)]] = changed_rows[x]
+            else:
+                # budget overflow: one extra readback of the full
+                # matrix (rare — means a large fraction of rows moved)
+                dm_full = np.asarray(dm_new_dev)
+                moved = np.flatnonzero((dm_full != self.dm).any(axis=1))
+                row_map = {self.dsts[int(i)]: dm_full[int(i)] for i in moved}
+            # host-fallback dsts: adopt moved speculative rows into the
+            # host mirror (keeps the overflow diff and future row
+            # budgets quiet) but never re-trace from them
+            for dst in self.host_dsts & set(row_map):
+                self.dm[self.dst_pos[dst]] = row_map[dst]
+            a_retrace = (
+                (aff2 | set(row_map)) - aff1 - self.host_dsts
+            ) & dst_set
+            ok = True
+            if aff1:
+                # first paths changed: masks are stale for these — the
+                # speculative rows are garbage by construction; re-solve
+                # with fresh masks (dispatch 2) and scatter corrections
+                ok = self._recompute(ls, state, sorted(aff1), d_new_src)
             if not ok:
                 self._cold_build(ls, state, dsts)
                 return None
+            if a_retrace:
+                self._retrace_only(ls, graph, sorted(a_retrace), row_map)
+        else:
+            recompute = sorted(aff1 | aff2)
+            if recompute:
+                ok = self._recompute(ls, state, recompute, d_new_src)
+                if not ok:
+                    self._cold_build(ls, state, dsts)
+                    return None
         self._prime_all(ls)
 
         # commit snapshots
@@ -386,10 +451,29 @@ class Ksp2Engine:
         # prefetch; second paths traced from them
         self.dm = np.full((len(dsts), n), INF, dtype=np.int32)
         self.host_dsts: Set[str] = set()
+        self.masks_t = None  # set below; must be None while the
+        self.dm_dev = None  # chunked solves run (no resident scatter)
         self._solve_masked_batches(
             ls, state, dsts, cands_of, transit_blocked
         )
         self._prime_all(ls)
+
+        # fast path (1 device round trip per metric-churn event): keep
+        # every destination's edge masks and masked rows RESIDENT so
+        # the next event's fused dispatch can speculatively re-solve
+        # and row-diff them on device. Gated on the same mask-memory
+        # budget as the chunked dispatch.
+        slots = sum(band.rows * band.k for band in graph.bands)
+        if (
+            len(dsts) * 2 * max(1, slots)
+            <= _ss.KSP2_DEVICE_MASK_BUDGET
+        ):
+            parallel = ls.parallel_pairs()
+            masks_all, _ok = spf_sparse.build_edge_masks(
+                graph, [self.excl[d] for d in dsts], parallel
+            )
+            self.masks_t = tuple(jnp.asarray(m) for m in masks_all)
+            self.dm_dev = jnp.asarray(self.dm)
 
         # graph-attribute snapshots for churn diffing
         self.eff_w, self.attr_sig = {}, {}
@@ -531,7 +615,11 @@ class Ksp2Engine:
         d_new_src: np.ndarray,
         rows_new: Dict[int, np.ndarray],
         rows_old: Dict[int, np.ndarray],
-    ) -> Set[str]:
+    ) -> Tuple[Set[str], Set[str]]:
+        """Returns (first-path affected, masked/second-path affected) —
+        split because the former invalidates the destination's MASKS
+        (forcing a fresh masked solve) while the latter only needs the
+        second paths re-derived."""
         index = graph.node_index
         dst_ids = np.asarray(
             [index[d] for d in self.dsts], dtype=np.int64
@@ -541,6 +629,7 @@ class Ksp2Engine:
         inf = np.int64(INF)
 
         aff = d_new[dst_ids] != d_old_src[dst_ids]
+        aff2_vec = np.zeros(len(self.dsts), dtype=bool)
 
         dm = self.dm.astype(np.int64, copy=False)
         dm_total = dm[np.arange(len(self.dsts)), dst_ids]
@@ -589,7 +678,7 @@ class Ksp2Engine:
                     & (r_old_v[dst_ids] < inf)
                     & reachable_m
                 )
-                aff |= valid & (lhs <= dm_total)
+                aff2_vec |= valid & (lhs <= dm_total)
             if wn < inf:
                 lhs = d_new[uid] + wn + r_new_v[dst_ids]
                 valid = (
@@ -597,20 +686,50 @@ class Ksp2Engine:
                     & (r_new_v[dst_ids] < inf)
                     & reachable_m
                 )
-                aff |= valid & (lhs <= dm_total)
+                aff2_vec |= valid & (lhs <= dm_total)
             if wo >= inf and wn < inf:
                 # edge usable where it was not (link appeared, or its
                 # origin was undrained — hence EFFECTIVE weights, not
                 # raw: overload flips are injected with equal raw w):
                 # disconnected masked rows may reconnect
-                aff |= ~reachable_m
-        out = {self.dsts[i] for i in np.flatnonzero(aff)}
-        # host-fallback destinations are recomputed lazily by LinkState;
-        # never claim them unchanged
-        out |= self.host_dsts
-        return out
+                aff2_vec |= ~reachable_m
+        aff1 = {self.dsts[i] for i in np.flatnonzero(aff)}
+        aff2 = {self.dsts[i] for i in np.flatnonzero(aff2_vec)}
+        return aff1, aff2
 
     # -- recompute ---------------------------------------------------------
+
+    def _retrace_only(
+        self, ls: LinkState, graph, dsts: List[str],
+        row_map: Dict[str, np.ndarray],
+    ) -> None:
+        """Fast-path update for destinations whose MASKS are unchanged:
+        adopt the speculative masked row (when it moved) and re-trace
+        second paths with the current weights. First paths and
+        exclusion sets stay as cached."""
+        cands_of = make_cands_of(ls, graph.node_index)
+        transit_blocked = {
+            name
+            for name in graph.node_names
+            if ls.is_node_overloaded(name) and name != self.src_name
+        }
+        for dst in dsts:
+            row = row_map.get(dst)
+            if row is not None:
+                self.dm[self.dst_pos[dst]] = row
+            for path in self.second_paths.get(dst, []):
+                for x in _path_nodes(self.src_name, path):
+                    users = self.node_users.get(x)
+                    if users is not None:
+                        users.discard(dst)
+            self.second_paths[dst] = trace_paths_from_row(
+                self.src_name, dst, graph.node_index,
+                self.dm[self.dst_pos[dst]].tolist(), self.excl[dst],
+                cands_of, transit_blocked,
+            )
+            for path in self.second_paths[dst]:
+                for x in _path_nodes(self.src_name, path):
+                    self.node_users.setdefault(x, set()).add(dst)
 
     def _recompute(
         self, ls: LinkState, state, affected: List[str],
@@ -682,12 +801,35 @@ class Ksp2Engine:
                 state, self.sid, masks
             )
             _counters()["decision.ksp2_device_batches"] += 1
+            if getattr(self, "masks_t", None) is not None:
+                # fast path: keep the RESIDENT masks and masked-row
+                # matrix in sync so the next event's speculative solve
+                # uses current exclusions
+                import jax.numpy as jnp
+
+                ids = jnp.asarray(
+                    np.asarray(
+                        [self.dst_pos[d] for d in batch], np.int32
+                    )
+                )
+                self.masks_t = tuple(
+                    m_res.at[ids].set(jnp.asarray(m_new[: len(batch)]))
+                    for m_res, m_new in zip(self.masks_t, masks)
+                )
+                self.dm_dev = self.dm_dev.at[ids].set(
+                    jnp.asarray(drows[: len(batch)])
+                )
             for i, dst in enumerate(batch):
                 if not ok[i]:
                     _counters()["decision.ksp2_host_fallbacks"] += 1
                     self.host_dsts.add(dst)
                     self.second_paths.pop(dst, None)
-                    self.dm[self.dst_pos[dst]] = INF
+                    # keep the (unrepresentable-mask) solve row anyway:
+                    # it is deterministic, so the fast path's on-device
+                    # row diff stays quiet for this destination instead
+                    # of burning a gather slot every event; host_dsts
+                    # membership keeps it out of every cache read
+                    self.dm[self.dst_pos[dst]] = drows[i]
                     continue
                 self.dm[self.dst_pos[dst]] = drows[i]
                 self.second_paths[dst] = trace_paths_from_row(

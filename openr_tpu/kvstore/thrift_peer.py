@@ -290,7 +290,16 @@ class ThriftPeerTransport(PeerTransport):
             },
             _GET_RESULT,
         )
-        return tc._publication_from_wire(result.get("success", {}))
+        if "success" not in result:
+            # a declared IDL exception arrives as a non-zero result
+            # field this schema doesn't model; fabricating an empty
+            # Publication would mark the peer synced with zero keys.
+            # Standard generated clients raise MISSING_RESULT here.
+            raise RuntimeError(
+                "getKvStoreKeyValsFilteredArea returned no result "
+                "(peer raised a declared exception)"
+            )
+        return tc._publication_from_wire(result["success"])
 
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
         self._call(

@@ -881,6 +881,103 @@ def _ell_all_view_rows(
     return d_all, packed
 
 
+@functools.partial(jax.jit, static_argnames=("bands", "n", "k_budget"))
+def _ell_all_view_rows_masked(
+    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+    masks_t, dm_old, src_id, bands, n, k_budget,
+):
+    """The 1-round-trip incremental-KSP2 dispatch: everything
+    _ell_all_view_rows computes PLUS a speculative masked re-solve of
+    every destination's second-path graph against the RESIDENT masks,
+    diffed on-device against the previous masked rows so the readback
+    carries only the rows that actually moved:
+
+      - dm_new [D, n]: single-source solve over D edge-masked graphs
+        (the KSP2 second-path product, ops semantics of
+        _ell_masked_source_batch)
+      - changed row ids (top k_budget, -1 padded) + their rows
+      - count of changed rows (callers fall back to a full dm readback
+        when it exceeds the budget)
+
+    Destinations whose masks are stale this event (first paths changed)
+    get garbage dm_new rows by construction — the engine re-solves
+    exactly those in a follow-up dispatch and scatters the corrections
+    into the resident matrix. For every other destination the
+    speculative row is exact, which is what turns the common
+    metric-churn event into ONE device round trip."""
+    d_all = _ell_fixed_point(
+        srcs_t, ws_t, overloaded,
+        jnp.arange(n, dtype=jnp.int32), bands, n,
+    )
+    d = d_all[view_srcs]
+    fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
+
+    # masked re-solve (mirrors _ell_masked_source_batch)
+    b = masks_t[0].shape[0]
+    unit = jnp.full((b, n), INF, dtype=jnp.int32)
+    unit = unit.at[:, src_id].set(0)
+    no_overload = jnp.zeros_like(overloaded)
+    dm0 = _ell_relax_masked(unit, bands, srcs_t, ws_t, masks_t, no_overload)
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        dmat, _, it = state
+        nxt = _ell_relax_masked(
+            dmat, bands, srcs_t, ws_t, masks_t, overloaded
+        )
+        return nxt, jnp.any(nxt < dmat), it + 1
+
+    dm_new, _, _ = jax.lax.while_loop(
+        cond, body, (dm0, jnp.bool_(True), 0)
+    )
+
+    row_changed = jnp.any(dm_new != dm_old, axis=1)  # [D]
+    changed_ids = jnp.nonzero(
+        row_changed, size=k_budget, fill_value=-1
+    )[0].astype(jnp.int32)
+    count = jnp.sum(row_changed.astype(jnp.int32))
+    # ids + count packed into one int32 row of width n (n > k_budget)
+    meta = jnp.full((n,), -1, dtype=jnp.int32)
+    meta = meta.at[:k_budget].set(changed_ids)
+    meta = meta.at[k_budget].set(count)
+    changed_rows = dm_new[jnp.clip(changed_ids, 0, b - 1)]  # [K, n]
+
+    packed = jnp.concatenate(
+        [
+            d,
+            fh.astype(jnp.int32),
+            d_all[ep_ids],
+            d_prev[ep_ids],
+            meta[None, :],
+            changed_rows,
+        ],
+        axis=0,
+    )
+    return d_all, dm_new, packed
+
+
+def ell_all_view_rows_masked(
+    state: EllState, view_srcs, w_sv, ep_ids, d_prev,
+    masks_t, dm_old, src_id: int, k_budget: int,
+):
+    """Run the fused 1-RTT dispatch on the resident bands. Returns
+    (d_all_dev, dm_new_dev, packed_host)."""
+    d_all, dm_new, packed = _ell_all_view_rows_masked(
+        state.src, state.w, state.overloaded,
+        _as_device_ids(view_srcs),
+        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+            np.asarray(w_sv, dtype=np.int32)
+        ),
+        _as_device_ids(ep_ids),
+        d_prev, masks_t, dm_old, src_id,
+        state.graph.bands, state.graph.n_pad, k_budget,
+    )
+    return d_all, dm_new, np.asarray(packed)
+
+
 def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev):
     """Run the fused all-sources + view + invalidation-rows dispatch on
     the resident bands. Returns (d_all_dev, packed_host)."""
