@@ -69,6 +69,25 @@ ENGINE_FULL_REBUILD_FRACTION = 3  # affected * N > dsts  -> cold
 ENGINE_ROW_BUDGET = 64
 
 
+def _fast_path_enabled() -> bool:
+    """The resident-mask speculative solve trades extra device compute
+    (a masked re-solve of EVERY destination per event — single-digit ms
+    on an accelerator) for one fewer host<->device round trip (~70-200ms
+    on a relay-backed chip). On the CPU backend round trips are free
+    and the speculation is pure overhead (measured 8x slower at
+    fabric-1008), so it only engages on real accelerators.
+    OPENR_KSP2_FAST=1/0 overrides (tests force it on under the CPU
+    mesh)."""
+    import os
+
+    override = os.environ.get("OPENR_KSP2_FAST")
+    if override is not None:
+        return override == "1"
+    import jax
+
+    return jax.devices()[0].platform != "cpu"
+
+
 def _counters():
     from openr_tpu.decision import spf_solver as _ss
 
@@ -465,7 +484,8 @@ class Ksp2Engine:
         # budget as the chunked dispatch.
         slots = sum(band.rows * band.k for band in graph.bands)
         if (
-            len(dsts) * 2 * max(1, slots)
+            _fast_path_enabled()
+            and len(dsts) * 2 * max(1, slots)
             <= _ss.KSP2_DEVICE_MASK_BUDGET
         ):
             parallel = ls.parallel_pairs()
@@ -480,15 +500,11 @@ class Ksp2Engine:
         for name in graph.node_names:
             if name not in graph.node_index:
                 continue
-            for link in ls.links_from_node(name):
-                if not link.is_up():
-                    continue
-                other = link.other_node(name)
-                pair = (name, other)
-                w = min(int(link.metric_from(name)), INF - 1)
-                if pair not in self.eff_w or w < self.eff_w[pair]:
-                    self.eff_w[pair] = w
-                self.attr_sig[pair] = self._pair_sig(ls, name, other)
+            sigs = self._node_sigs(ls, name)
+            weights = self._min_weights(sigs)
+            for other, sig in sigs.items():
+                self.eff_w[(name, other)] = weights[other]
+                self.attr_sig[(name, other)] = sig
         self.pairs_by_node = {}
         for pair in self.eff_w:
             self.pairs_by_node.setdefault(pair[0], set()).add(pair)
@@ -510,16 +526,18 @@ class Ksp2Engine:
     # -- diffing -----------------------------------------------------------
 
     @staticmethod
-    def _pair_sig(ls: LinkState, a: str, b: str) -> Tuple:
-        """Materialization-relevant attributes of the (a, b) link
-        direction set: next-hop addresses, interfaces, adj labels, and
-        canonical link identity (identity changes can reorder the
-        deterministic trace's candidate list)."""
-        sig = []
+    def _node_sigs(ls: LinkState, a: str) -> Dict[str, Tuple]:
+        """Materialization-relevant attributes of every (a, other) link
+        direction in ONE pass over a's ordered links: next-hop
+        addresses, interfaces, adj labels, and canonical link identity
+        (identity changes can reorder the deterministic trace's
+        candidate list). One pass matters: per-pair scans made diffing
+        a single churn event O(degree^2) on high-degree spines."""
+        sigs: Dict[str, List[Tuple]] = {}
         for link in ls.ordered_links_from_node(a):
-            if not link.is_up() or link.other_node(a) != b:
+            if not link.is_up():
                 continue
-            sig.append(
+            sigs.setdefault(link.other_node(a), []).append(
                 (
                     link.iface_from(a),
                     link.nh_v4_from(a).addr,
@@ -528,7 +546,17 @@ class Ksp2Engine:
                     link.metric_from(a),
                 )
             )
-        return tuple(sig)
+        return {other: tuple(s) for other, s in sigs.items()}
+
+    @staticmethod
+    def _min_weights(sigs: Dict[str, Tuple]) -> Dict[str, int]:
+        """Collapsed min-metric per neighbor, derived from the sig
+        tuples (metric is each sig's last element) — the ONE source of
+        the min(metric, INF-1) reduction."""
+        return {
+            other: min(min(int(s[-1]), INF - 1) for s in sig_list)
+            for other, sig_list in sigs.items()
+        }
 
     def _diff_pairs(
         self, ls: LinkState, affected_nodes: Set[str]
@@ -542,6 +570,16 @@ class Ksp2Engine:
         changed: Dict[Tuple[str, str], Tuple] = {}
         graph_index = self.state.graph.node_index
         seen_pairs: Set[Tuple[str, str]] = set()
+        # one links pass per origin node, not per pair
+        sig_cache: Dict[str, Dict[str, Tuple]] = {}
+        w_cache: Dict[str, Dict[str, int]] = {}
+
+        def node_view(a: str):
+            if a not in sig_cache:
+                sig_cache[a] = self._node_sigs(ls, a)
+                w_cache[a] = self._min_weights(sig_cache[a])
+            return sig_cache[a], w_cache[a]
+
         for x in affected_nodes:
             if x not in graph_index:
                 return None  # node set changed
@@ -574,14 +612,9 @@ class Ksp2Engine:
                         continue
                     seen_pairs.add(pair)
                     a, bnode = pair
-                    w_new = INF
-                    for link in ls.links_from_node(a):
-                        if link.is_up() and link.other_node(a) == bnode:
-                            w_new = min(
-                                w_new,
-                                min(int(link.metric_from(a)), INF - 1),
-                            )
-                    sig_new = self._pair_sig(ls, a, bnode)
+                    sigs_a, ws_a = node_view(a)
+                    w_new = ws_a.get(bnode, INF)
+                    sig_new = sigs_a.get(bnode, ())
                     w_old = self.eff_w.get(pair, INF)
                     sig_old = self.attr_sig.get(pair, ())
                     if w_old != w_new or sig_old != sig_new:

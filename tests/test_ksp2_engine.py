@@ -34,6 +34,10 @@ def _engine_everywhere(monkeypatch):
     from openr_tpu.decision import spf_solver as ss
 
     monkeypatch.setattr(ss, "KSP2_DEVICE_MIN_DSTS", 1)
+    # force the accelerator-only fast path on under the CPU test mesh
+    # (the slow 2-dispatch path keeps coverage via the parity-ring
+    # churn suite, which does not set the override)
+    monkeypatch.setenv("OPENR_KSP2_FAST", "1")
 
 
 def _ksp2_network(kind: str, n: int):
@@ -229,6 +233,50 @@ class TestEngineChurnParity:
             rsw,
             [lambda ls: _set_label(ls, fsws[0], 60000)],
         )
+
+    def test_fast_path_dispatch_economy(self):
+        """Steady-state metric churn with unchanged first paths must
+        not issue the follow-up masked dispatch: the speculative
+        resident-mask solve inside the fused dispatch covers it (the
+        1-round-trip property)."""
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        (ls,) = area_d.values()
+        rsw = next(
+            k for k in sorted(topo.adj_dbs) if k.startswith("rsw")
+        )
+        fsw = next(
+            k for k in sorted(topo.adj_dbs) if k.startswith("fsw")
+        )
+        dev = SpfSolver(rsw, backend="device")
+        dev.build_route_db(rsw, area_d, ps)
+        from openr_tpu.decision import spf_solver as ss
+
+        engine = next(iter(dev._ksp2_engines.values()))
+        assert engine.masks_t is not None  # fast path active
+        # warm one full metric cycle (covers cold/tie transitions)
+        for step in range(5):
+            _mutate_metric(ls, fsw, 0, 2 + step % 5)
+            dev.build_route_db(rsw, area_d, ps)
+        # steady state: metric cycles where the churned link stays off
+        # every first path (3 -> 4 -> 5: strictly worse than the
+        # metric-1 siblings) must cost zero masked dispatches
+        quiet = 0
+        for metric in (4, 5):
+            _mutate_metric(ls, fsw, 0, metric)
+            before = dict(SPF_COUNTERS)
+            dev.build_route_db(rsw, area_d, ps)
+            batches = (
+                SPF_COUNTERS["decision.ksp2_device_batches"]
+                - before["decision.ksp2_device_batches"]
+            )
+            syncs = (
+                SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+                - before["decision.ksp2_incremental_syncs"]
+            )
+            assert syncs == 1, "event did not run incrementally"
+            if batches == 0:
+                quiet += 1
+        assert quiet == 2, "fast path issued masked dispatches"
 
     def test_route_reuse_counts(self):
         """Steady-state no-op rebuild reuses every cached route."""
