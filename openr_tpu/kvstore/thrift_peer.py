@@ -71,6 +71,13 @@ _SET_ARGS = tc.StructSchema(
     ),
 )
 _SET_RESULT = tc.StructSchema("setKvStoreKeyVals_result", ())
+_GET_KEYS_ARGS = tc.StructSchema(
+    "getKvStoreKeyValsArea_args",
+    (
+        tc.Field(1, ("list", ("string",)), "filterKeys"),
+        tc.Field(2, ("string",), "area"),
+    ),
+)
 
 
 def encode_message(
@@ -183,9 +190,29 @@ class KvStoreThriftPeerServer:
         if mtype != TYPE_CALL:
             raise ValueError(f"unexpected message type {mtype}")
         body = frame[off:]
+        params = None
         if name == "getKvStoreKeyValsFilteredArea":
             args = tc.decode(_GET_ARGS, body)
             params = tc._key_dump_params_from_wire(args.get("filter", {}))
+        elif name == "getKvStoreKeyValsArea":
+            # plain keyed get (OpenrCtrl.thrift:364): modeled as a
+            # filtered dump restricted to exact keys. An EMPTY key list
+            # asks for nothing — dump_with_filters treats falsy keys as
+            # "no filter", which would ship the whole database instead
+            # (the in-process exact get returns {} here)
+            args = tc.decode(_GET_KEYS_ARGS, body)
+            keys = args.get("filterKeys", [])
+            if not keys:
+                return encode_message(
+                    name, TYPE_REPLY, seqid, _GET_RESULT,
+                    {
+                        "success": tc._publication_to_wire(
+                            Publication(area=args.get("area", ""))
+                        )
+                    },
+                )
+            params = KeyDumpParams(keys=keys)
+        if params is not None:
             pub = self._kvstore.dump_with_filters(
                 args.get("area", ""), params
             )
@@ -298,6 +325,21 @@ class ThriftPeerTransport(PeerTransport):
             raise RuntimeError(
                 "getKvStoreKeyValsFilteredArea returned no result "
                 "(peer raised a declared exception)"
+            )
+        return tc._publication_from_wire(result["success"])
+
+    def get_key_vals(self, area: str, keys) -> Publication:
+        """Plain keyed get (OpenrCtrl.thrift:364
+        getKvStoreKeyValsArea)."""
+        result = self._call(
+            "getKvStoreKeyValsArea",
+            _GET_KEYS_ARGS,
+            {"filterKeys": list(keys), "area": area},
+            _GET_RESULT,
+        )
+        if "success" not in result:
+            raise RuntimeError(
+                "getKvStoreKeyValsArea returned no result"
             )
         return tc._publication_from_wire(result["success"])
 

@@ -75,3 +75,17 @@ class TestBenchmarkHarnesses:
         assert out["bench"].startswith("scale.ell_churn")
         assert out["oracle_spot_check"] == "passed"
         assert "device_only_ms" in out
+
+
+class TestKsp2ChurnLeg:
+    def test_ksp2_churn_bench_smoke(self):
+        """The official bench's third leg (bench.py OPENR_BENCH_KSP2)
+        must run end to end: engine churn rebuilds with zero host
+        fallbacks on a parallel-link-free fabric."""
+        from benchmarks.bench_scale import ksp2_churn_bench
+
+        out = ksp2_churn_bench(120, 3)
+        assert out["events"] == 3
+        assert out["ksp2_host_fallbacks"] == 0
+        assert out["incremental_syncs"] == 3
+        assert out["median_ms"] > 0

@@ -89,6 +89,69 @@ class TestThriftPeerSync:
             a.stop()
             b.stop()
 
+    def test_star_topology_floods_over_thrift_wire(self):
+        """Hub + two leaves, all peering over the thrift wire: a key
+        set at one leaf floods through the hub to the other leaf
+        (reference: the multi-store topology suites of
+        kvstore/tests/KvStoreTest.cpp run over real transports)."""
+        names = ["hub", "leaf1", "leaf2"]
+        stores = {n: KvStoreWrapper(n) for n in names}
+        servers = {}
+        for n, w in stores.items():
+            w.start()
+            servers[n] = KvStoreThriftPeerServer(
+                w.store, host="127.0.0.1"
+            )
+            servers[n].start()
+
+        def peer(a, b):
+            stores[a].store.add_peer(
+                "0", b, ThriftPeerTransport("127.0.0.1", servers[b].port)
+            )
+
+        try:
+            for leaf in ("leaf1", "leaf2"):
+                peer("hub", leaf)
+                peer(leaf, "hub")
+            stores["leaf1"].set_key("k-star", b"v1")
+            assert wait_until(
+                lambda: stores["leaf2"].get_key("k-star") is not None
+            )
+            assert stores["leaf2"].get_key("k-star").value == b"v1"
+            # and TTL metadata survived both hops
+            assert stores["leaf2"].get_key("k-star").version == 1
+        finally:
+            for n in names:
+                servers[n].stop()
+                stores[n].stop()
+
+    def test_plain_keyed_get_over_wire(self):
+        """getKvStoreKeyValsArea (OpenrCtrl.thrift:364): exact-key get
+        — keys with regex metacharacters (prefix:fd00::/64) must match
+        literally, not as patterns."""
+        a = KvStoreWrapper("node-a")
+        a.start()
+        server = KvStoreThriftPeerServer(a.store, host="127.0.0.1")
+        server.start()
+        client = ThriftPeerTransport("127.0.0.1", server.port)
+        try:
+            a.set_key("prefix:fd00::/64", b"p1")
+            a.set_key("adj:node-a", b"a1")
+            pub = client.get_key_vals("0", ["prefix:fd00::/64"])
+            assert set(pub.key_vals) == {"prefix:fd00::/64"}
+            assert pub.key_vals["prefix:fd00::/64"].value == b"p1"
+            # missing keys come back absent, not as errors
+            pub = client.get_key_vals("0", ["nope"])
+            assert pub.key_vals == {}
+            # an EMPTY key list asks for nothing — never a full dump
+            # (matches the in-process exact get, store.py get_key_vals)
+            pub = client.get_key_vals("0", [])
+            assert pub.key_vals == {}
+        finally:
+            client.close()
+            server.stop()
+            a.stop()
+
     def test_unknown_method_returns_exception(self):
         import socket
         import struct
