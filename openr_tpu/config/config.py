@@ -57,8 +57,17 @@ class SparkConfig:
     hold_time_s: float = 10.0
     graceful_restart_time_s: float = 30.0
     mcast_port: int = 6666  # reference: Flags.cpp spark_mcast_port
+    # "native" (framework codec) or "thrift" (the reference's
+    # CompactProtocol SparkHelloPacket layout — interop with stock
+    # Open/R neighbors on the LAN); receive always accepts both
+    wire_format: str = "native"
 
     def validate(self) -> None:
+        if self.wire_format not in ("native", "thrift"):
+            raise ConfigError(
+                f"spark wire_format must be native|thrift, got "
+                f"{self.wire_format!r}"
+            )
         if self.hold_time_s < 3 * self.keepalive_time_s:
             raise ConfigError(
                 "spark hold_time must be >= 3x keepalive_time"

@@ -19,8 +19,9 @@ are a different outer layer; classic framed transport is the
 interop-stable one, and fbthrift servers accept it in compatibility
 mode.)
 
-Methods served (KvStore.thrift:256-276):
+Methods served (KvStore.thrift:256-276, OpenrCtrl.thrift:358-381):
 - ``getKvStoreKeyValsFilteredArea(1: KeyDumpParams filter, 2: string area)``
+- ``getKvStoreKeyValsArea(1: list<string> filterKeys, 2: string area)``
 - ``setKvStoreKeyVals(1: KeySetParams setParams, 2: string area)``
 """
 
@@ -305,43 +306,40 @@ class ThriftPeerTransport(PeerTransport):
 
     # -- PeerTransport -----------------------------------------------------
 
+    def _call_publication(self, name, schema, args: Dict) -> Publication:
+        """Call a Publication-returning method; a reply without the
+        success field means the peer raised a declared IDL exception
+        this schema does not model — fabricating an empty Publication
+        would mark the peer synced with zero keys, so raise instead
+        (standard generated clients raise MISSING_RESULT here)."""
+        result = self._call(name, schema, args, _GET_RESULT)
+        if "success" not in result:
+            raise RuntimeError(
+                f"{name} returned no result "
+                "(peer raised a declared exception)"
+            )
+        return tc._publication_from_wire(result["success"])
+
     def get_key_vals_filtered(
         self, area: str, params: KeyDumpParams
     ) -> Publication:
-        result = self._call(
+        return self._call_publication(
             "getKvStoreKeyValsFilteredArea",
             _GET_ARGS,
             {
                 "filter": tc._key_dump_params_to_wire(params),
                 "area": area,
             },
-            _GET_RESULT,
         )
-        if "success" not in result:
-            # a declared IDL exception arrives as a non-zero result
-            # field this schema doesn't model; fabricating an empty
-            # Publication would mark the peer synced with zero keys.
-            # Standard generated clients raise MISSING_RESULT here.
-            raise RuntimeError(
-                "getKvStoreKeyValsFilteredArea returned no result "
-                "(peer raised a declared exception)"
-            )
-        return tc._publication_from_wire(result["success"])
 
     def get_key_vals(self, area: str, keys) -> Publication:
         """Plain keyed get (OpenrCtrl.thrift:364
         getKvStoreKeyValsArea)."""
-        result = self._call(
+        return self._call_publication(
             "getKvStoreKeyValsArea",
             _GET_KEYS_ARGS,
             {"filterKeys": list(keys), "area": area},
-            _GET_RESULT,
         )
-        if "success" not in result:
-            raise RuntimeError(
-                "getKvStoreKeyValsArea returned no result"
-            )
-        return tc._publication_from_wire(result["success"])
 
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
         self._call(

@@ -245,6 +245,7 @@ def main(argv=None) -> int:
             heartbeat_interval_s=config.spark.keepalive_time_s,
             hold_time_s=config.spark.hold_time_s,
             graceful_restart_time_s=config.spark.graceful_restart_time_s,
+            wire_format=config.spark.wire_format,
         ),
         use_rtt_metric=config.link_monitor.use_rtt_metric,
         config_store=config_store,

@@ -43,6 +43,7 @@ from openr_tpu.types.spark import (
     SparkNeighborEventType,
     SparkPacket,
 )
+from openr_tpu.spark import thrift_wire
 from openr_tpu.utils import wire
 from openr_tpu.utils.eventbase import OpenrEventBase
 from openr_tpu.utils.stepdetector import StepDetector, StepDetectorConfig
@@ -117,6 +118,7 @@ class Spark:
         kvstore_peer_port: int = 0,
         v4_addr: Optional[BinaryAddress] = None,
         v6_addr: Optional[BinaryAddress] = None,
+        wire_format: str = "native",
     ):
         self.my_node_name = my_node_name
         self.area = area
@@ -137,6 +139,13 @@ class Spark:
         # advertised to neighbors in handshakes so they can dial our
         # KvStore peer server (reference: Spark.thrift:97 kvStoreCmdPort)
         self._kvstore_peer_port = kvstore_peer_port
+        # "native" = the framework codec; "thrift" = the reference's
+        # CompactProtocol SparkHelloPacket layout (spark/thrift_wire.py)
+        # so stock Open/R neighbors on the LAN can parse our packets.
+        # RECEIVE always accepts both (format sniffed by first byte) —
+        # the reference's own dual-stack migration pattern.
+        assert wire_format in ("native", "thrift"), wire_format
+        self._wire_format = wire_format
         self._v4 = v4_addr or BinaryAddress()
         self._v6 = v6_addr or BinaryAddress()
         # if_name -> {neighbor_node -> _Neighbor}
@@ -257,7 +266,7 @@ class Spark:
             restarting=restarting,
             sent_ts_us=_now_us(),
         )
-        self._io.send(if_name, wire.dumps(SparkPacket(hello=msg)))
+        self._io.send(if_name, self._encode(SparkPacket(hello=msg)))
         self.counters["spark.hello_sent"] += 1
 
     def _send_handshake(self, if_name: str, to_neighbor: str) -> None:
@@ -277,7 +286,7 @@ class Spark:
             area=self.area_for_interface(if_name),
             neighbor_node_name=to_neighbor,
         )
-        self._io.send(if_name, wire.dumps(SparkPacket(handshake=msg)))
+        self._io.send(if_name, self._encode(SparkPacket(handshake=msg)))
         self.counters["spark.handshake_sent"] += 1
 
     def _send_heartbeat(self, if_name: str) -> None:
@@ -295,7 +304,7 @@ class Spark:
             seq_num=self._seq,
             hold_time_ms=self._hold_time_ms,
         )
-        self._io.send(if_name, wire.dumps(SparkPacket(heartbeat=msg)))
+        self._io.send(if_name, self._encode(SparkPacket(heartbeat=msg)))
         self.counters["spark.heartbeat_sent"] += 1
 
     def flood_restarting(self) -> None:
@@ -314,12 +323,20 @@ class Spark:
     # Spark.cpp packet validation against kOpenrSupportedVersion)
     LOWEST_SUPPORTED_VERSION = 1
 
+    def _encode(self, pkt: SparkPacket) -> bytes:
+        if self._wire_format == "thrift":
+            return thrift_wire.encode_packet(pkt)
+        return wire.dumps(pkt)
+
     def _process_packet(self, if_name: str, data: bytes) -> None:
         """reference: Spark.cpp:1597 processPacket."""
         if if_name not in self._tracked:
             return
         try:
-            packet = wire.loads(data, SparkPacket)
+            if data and data[0] == thrift_wire.NATIVE_MARKER:
+                packet = wire.loads(data, SparkPacket)
+            else:
+                packet = thrift_wire.decode_packet(data)
         except Exception:
             return
         if packet.version < self.LOWEST_SUPPORTED_VERSION:
@@ -399,7 +416,11 @@ class Spark:
         neighbor = self._get_or_create(if_name, msg.node_name)
         if msg.area != self.area_for_interface(if_name):
             return  # area mismatch: no adjacency
-        neighbor.remote_if = msg.if_name
+        if msg.if_name:
+            # the thrift wire's handshake carries no interface name; the
+            # hello-learned remote_if stands (reference: the remote
+            # ifName only rides SparkHelloMsg)
+            neighbor.remote_if = msg.if_name
         neighbor.area = msg.area
         neighbor.hold_time_ms = msg.hold_time_ms
         neighbor.gr_time_ms = msg.graceful_restart_time_ms
