@@ -2,22 +2,12 @@
 
 The reference's modern peer path is a thrift ``KvStoreService``
 (openr/if/KvStore.thrift:256-276; dual-stacked with legacy fbzmq in
-KvStore.cpp:2940-2973). This module implements that service's wire
+KvStore.cpp:2940-2973). This module serves/dials that service's wire
 contract in the standard Apache-thrift encoding every thrift toolchain
-ships — TFramedTransport (4-byte big-endian length prefix) carrying
-TCompactProtocol messages — so a stock thrift client with the
-KvStore.thrift IDL can sync against this daemon, and this daemon's
-client can sync against any framed+compact KvStoreService server.
-
-Message envelope (TCompactProtocol::writeMessageBegin):
-
-    0x82 | (version=1 | type<<5) | varint(seqid) | varstring(name)
-
-followed by the args struct; replies carry a result struct whose
-success field is id 0. (fbthrift's default Rocket/THeader transports
-are a different outer layer; classic framed transport is the
-interop-stable one, and fbthrift servers accept it in compatibility
-mode.)
+ships (shared transport + message envelope: utils/thrift_rpc.py), so a
+stock thrift client with the KvStore.thrift IDL can sync against this
+daemon, and this daemon's client can sync against any framed+compact
+KvStoreService server.
 
 Methods served (KvStore.thrift:256-276, OpenrCtrl.thrift:358-381):
 - ``getKvStoreKeyValsFilteredArea(1: KeyDumpParams filter, 2: string area)``
@@ -27,30 +17,14 @@ Methods served (KvStore.thrift:256-276, OpenrCtrl.thrift:358-381):
 
 from __future__ import annotations
 
-import socket
-import socketserver
-import struct
-import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 from openr_tpu.kvstore.store import KvStore, PeerTransport
 from openr_tpu.types import KeyDumpParams, KeySetParams, Publication
 from openr_tpu.utils import thrift_compact as tc
-from openr_tpu.utils.rpc import apply_bind_family
-
-PROTOCOL_ID = 0x82
-VERSION = 1
-TYPE_CALL = 1
-TYPE_REPLY = 2
-TYPE_EXCEPTION = 3
-
-# TApplicationException (thrift builtin), compact-encoded
-_TAPP_EXC = tc.StructSchema(
-    "TApplicationException",
-    (
-        tc.Field(1, ("string",), "message", optional=True),
-        tc.Field(2, ("i32",), "type", optional=True),
-    ),
+from openr_tpu.utils.thrift_rpc import (
+    FramedCompactClient,
+    FramedCompactServer,
 )
 
 _GET_ARGS = tc.StructSchema(
@@ -81,178 +55,61 @@ _GET_KEYS_ARGS = tc.StructSchema(
 )
 
 
-def encode_message(
-    name: str, mtype: int, seqid: int, schema, values: Dict
-) -> bytes:
-    """One framed compact-protocol message (frame header excluded)."""
-    w = tc._Writer()
-    w.byte(PROTOCOL_ID)
-    w.byte((VERSION & 0x1F) | (mtype << 5))
-    w.varint(seqid)
-    w.binary(name.encode("utf-8"))
-    return bytes(w.buf) + tc.encode(schema, values)
-
-
-def decode_message_header(data: bytes) -> Tuple[str, int, int, int]:
-    """Returns (name, mtype, seqid, args_offset)."""
-    r = tc._Reader(data)
-    proto = r.byte()
-    if proto != PROTOCOL_ID:
-        raise ValueError(f"not a compact-protocol message: 0x{proto:02x}")
-    vt = r.byte()
-    if (vt & 0x1F) != VERSION:
-        raise ValueError(f"unsupported compact version {vt & 0x1F}")
-    mtype = (vt >> 5) & 0x07
-    seqid = r.varint()
-    name = r.binary().decode("utf-8")
-    return name, mtype, seqid, r.pos
-
-
-def _frame(payload: bytes) -> bytes:
-    return struct.pack(">I", len(payload)) + payload
-
-
-def _read_frame(sock: socket.socket) -> Optional[bytes]:
-    hdr = _read_exact(sock, 4)
-    if hdr is None:
-        return None
-    (length,) = struct.unpack(">I", hdr)
-    if length > 64 * 1024 * 1024:
-        raise ValueError(f"oversized frame {length}")
-    return _read_exact(sock, length)
-
-
-def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    # bytearray accumulation: += on bytes is quadratic, and full-sync
-    # publications can be tens of MB
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            return None
-        buf.extend(chunk)
-    return bytes(buf)
-
-
 class KvStoreThriftPeerServer:
     """Serve the KvStoreService peer surface over framed+compact TCP."""
 
     def __init__(self, kvstore: KvStore, host: str = "0.0.0.0",
                  port: int = 0):
-        outer = self
-
-        class Handler(socketserver.BaseRequestHandler):
-            def handle(self) -> None:
-                while True:
-                    try:
-                        frame = _read_frame(self.request)
-                    except (OSError, ValueError):
-                        return
-                    if frame is None:
-                        return
-                    try:
-                        reply = outer._dispatch(frame)
-                    except Exception as exc:
-                        # thrift-standard error path: reply with a
-                        # TApplicationException instead of slamming the
-                        # connection (a stock client expects a reply
-                        # frame, not a bare EOF)
-                        reply = outer._exception_reply(frame, exc)
-                        if reply is None:  # header itself unparseable
-                            return
-                    try:
-                        self.request.sendall(_frame(reply))
-                    except OSError:
-                        return
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        apply_bind_family(Server, host)
         self._kvstore = kvstore
-        self._server = Server((host, port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
+        self._server = FramedCompactServer(
+            {
+                "getKvStoreKeyValsFilteredArea": (
+                    _GET_ARGS, self._get_filtered,
+                ),
+                "getKvStoreKeyValsArea": (_GET_KEYS_ARGS, self._get_keys),
+                "setKvStoreKeyVals": (_SET_ARGS, self._set),
+            },
+            host=host,
+            port=port,
+        )
+        self.port = self._server.port
 
-    @staticmethod
-    def _exception_reply(frame: bytes, exc: Exception) -> Optional[bytes]:
-        try:
-            name, _mtype, seqid, _off = decode_message_header(frame)
-        except Exception:
-            return None
-        return encode_message(
-            name, TYPE_EXCEPTION, seqid, _TAPP_EXC,
-            {"message": f"{type(exc).__name__}: {exc}", "type": 6},
+    def _pub_reply(self, pub: Publication):
+        return _GET_RESULT, {"success": tc._publication_to_wire(pub)}
+
+    def _get_filtered(self, args: Dict):
+        params = tc._key_dump_params_from_wire(args.get("filter", {}))
+        return self._pub_reply(
+            self._kvstore.dump_with_filters(args.get("area", ""), params)
         )
 
-    def _dispatch(self, frame: bytes) -> bytes:
-        name, mtype, seqid, off = decode_message_header(frame)
-        if mtype != TYPE_CALL:
-            raise ValueError(f"unexpected message type {mtype}")
-        body = frame[off:]
-        params = None
-        if name == "getKvStoreKeyValsFilteredArea":
-            args = tc.decode(_GET_ARGS, body)
-            params = tc._key_dump_params_from_wire(args.get("filter", {}))
-        elif name == "getKvStoreKeyValsArea":
-            # plain keyed get (OpenrCtrl.thrift:364): modeled as a
-            # filtered dump restricted to exact keys. An EMPTY key list
-            # asks for nothing — dump_with_filters treats falsy keys as
-            # "no filter", which would ship the whole database instead
-            # (the in-process exact get returns {} here)
-            args = tc.decode(_GET_KEYS_ARGS, body)
-            keys = args.get("filterKeys", [])
-            if not keys:
-                return encode_message(
-                    name, TYPE_REPLY, seqid, _GET_RESULT,
-                    {
-                        "success": tc._publication_to_wire(
-                            Publication(area=args.get("area", ""))
-                        )
-                    },
-                )
-            params = KeyDumpParams(keys=keys)
-        if params is not None:
-            pub = self._kvstore.dump_with_filters(
-                args.get("area", ""), params
+    def _get_keys(self, args: Dict):
+        # plain keyed get (OpenrCtrl.thrift:364): a filtered dump
+        # restricted to exact keys. An EMPTY key list asks for nothing —
+        # dump_with_filters treats falsy keys as "no filter", which
+        # would ship the whole database instead (the in-process exact
+        # get returns {} here)
+        keys = args.get("filterKeys", [])
+        if not keys:
+            return self._pub_reply(Publication(area=args.get("area", "")))
+        return self._pub_reply(
+            self._kvstore.dump_with_filters(
+                args.get("area", ""), KeyDumpParams(keys=keys)
             )
-            return encode_message(
-                name, TYPE_REPLY, seqid, _GET_RESULT,
-                {"success": tc._publication_to_wire(pub)},
-            )
-        if name == "setKvStoreKeyVals":
-            args = tc.decode(_SET_ARGS, body)
-            params = tc._key_set_params_from_wire(
-                args.get("setParams", {})
-            )
-            self._kvstore.set_key_vals(
-                args.get("area", ""),
-                params,
-                sender_id=params.originator_id,
-            )
-            return encode_message(
-                name, TYPE_REPLY, seqid, _SET_RESULT, {}
-            )
-        return encode_message(
-            name, TYPE_EXCEPTION, seqid, _TAPP_EXC,
-            {"message": f"unknown method {name!r}", "type": 1},
         )
+
+    def _set(self, args: Dict):
+        params = tc._key_set_params_from_wire(args.get("setParams", {}))
+        self._kvstore.set_key_vals(
+            args.get("area", ""), params, sender_id=params.originator_id
+        )
+        return _SET_RESULT, {}
 
     def start(self) -> None:
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            name="kvstore-thrift-peer",
-            daemon=True,
-        )
-        self._thread.start()
+        self._server.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
+        self._server.stop()
 
 
 class ThriftPeerTransport(PeerTransport):
@@ -260,51 +117,7 @@ class ThriftPeerTransport(PeerTransport):
     server above, or any thrift server with the same IDL)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
-        self._addr = (host, port)
-        self._timeout_s = timeout_s
-        self._lock = threading.Lock()
-        self._sock: Optional[socket.socket] = None
-        self._seqid = 0
-
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            self._sock = socket.create_connection(
-                self._addr, timeout=self._timeout_s
-            )
-        return self._sock
-
-    def _call(self, name: str, args_schema, args: Dict,
-              result_schema) -> Dict:
-        with self._lock:
-            self._seqid += 1
-            seqid = self._seqid
-            payload = encode_message(
-                name, TYPE_CALL, seqid, args_schema, args
-            )
-            try:
-                sock = self._connect()
-                sock.sendall(_frame(payload))
-                frame = _read_frame(sock)
-            except OSError:
-                self.close()
-                raise
-            if frame is None:
-                self.close()
-                raise ConnectionError("peer closed mid-call")
-            rname, mtype, rseq, off = decode_message_header(frame)
-            if mtype == TYPE_EXCEPTION:
-                exc = tc.decode(_TAPP_EXC, frame[off:])
-                raise RuntimeError(
-                    f"peer exception: {exc.get('message')}"
-                )
-            if rname != name or rseq != seqid:
-                self.close()
-                raise ConnectionError(
-                    f"out-of-sync reply {rname}/{rseq}"
-                )
-            return tc.decode(result_schema, frame[off:])
-
-    # -- PeerTransport -----------------------------------------------------
+        self._client = FramedCompactClient(host, port, timeout_s)
 
     def _call_publication(self, name, schema, args: Dict) -> Publication:
         """Call a Publication-returning method; a reply without the
@@ -312,13 +125,15 @@ class ThriftPeerTransport(PeerTransport):
         this schema does not model — fabricating an empty Publication
         would mark the peer synced with zero keys, so raise instead
         (standard generated clients raise MISSING_RESULT here)."""
-        result = self._call(name, schema, args, _GET_RESULT)
+        result = self._client.call(name, schema, args, _GET_RESULT)
         if "success" not in result:
             raise RuntimeError(
                 f"{name} returned no result "
                 "(peer raised a declared exception)"
             )
         return tc._publication_from_wire(result["success"])
+
+    # -- PeerTransport -----------------------------------------------------
 
     def get_key_vals_filtered(
         self, area: str, params: KeyDumpParams
@@ -342,7 +157,7 @@ class ThriftPeerTransport(PeerTransport):
         )
 
     def set_key_vals(self, area: str, params: KeySetParams) -> None:
-        self._call(
+        self._client.call(
             "setKvStoreKeyVals",
             _SET_ARGS,
             {
@@ -365,8 +180,4 @@ class ThriftPeerTransport(PeerTransport):
         )
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        self._client.close()

@@ -85,6 +85,12 @@ def _build_parser():
              "(python -m openr_tpu.platform.agent) instead",
     )
     parser.add_argument(
+        "--fib-agent-thrift", action="store_true",
+        help="the platform agent speaks the reference FibService "
+             "thrift wire (e.g. an FBOSS-style switch agent, or "
+             "openr_tpu.platform.agent --thrift)",
+    )
+    parser.add_argument(
         "--spark-port", type=int, default=None,
         help="UDP multicast port (default: config spark.mcast_port)",
     )
@@ -142,12 +148,27 @@ def main(argv=None) -> int:
             "--fib-agent-port and --enable-netlink-fib are mutually "
             "exclusive: the agent owns the kernel boundary"
         )
+    if args.fib_agent_thrift and not fib_agent_port:
+        raise SystemExit(
+            "--fib-agent-thrift requires --fib-agent-port (otherwise "
+            "the no-op mock agent would silently swallow every route)"
+        )
     fib_agent = None  # MockFibAgent default
     if fib_agent_port:
-        from openr_tpu.platform.netlink_fib_handler import TcpFibAgent
+        if args.fib_agent_thrift:
+            from openr_tpu.platform.thrift_fib import ThriftFibAgent
 
-        fib_agent = TcpFibAgent("127.0.0.1", fib_agent_port)
-        log.info("using platform agent on port %d", fib_agent_port)
+            fib_agent = ThriftFibAgent("127.0.0.1", fib_agent_port)
+        else:
+            from openr_tpu.platform.netlink_fib_handler import TcpFibAgent
+
+            fib_agent = TcpFibAgent("127.0.0.1", fib_agent_port)
+        log.info(
+            "using platform agent on port %d (%s wire)",
+            fib_agent_port,
+            "thrift-compact" if args.fib_agent_thrift
+            else "framework-rpc",
+        )
     elif enable_netlink_fib:
         from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
         from openr_tpu.platform.netlink_linux import (
@@ -246,6 +267,7 @@ def main(argv=None) -> int:
             hold_time_s=config.spark.hold_time_s,
             graceful_restart_time_s=config.spark.graceful_restart_time_s,
             wire_format=config.spark.wire_format,
+            domain=config.domain,
         ),
         use_rtt_metric=config.link_monitor.use_rtt_metric,
         config_store=config_store,

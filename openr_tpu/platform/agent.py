@@ -45,17 +45,30 @@ def main(argv=None) -> int:
         "--mock", action="store_true",
         help="in-memory kernel instead of rtnetlink",
     )
+    parser.add_argument(
+        "--thrift", action="store_true",
+        help="serve the reference FibService thrift wire (framed "
+             "CompactProtocol, Platform.thrift:70) instead of the "
+             "framework RPC codec — a stock Open/R Fib can program "
+             "this agent",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("openr_tpu.platform.agent")
 
     netlink = build_netlink(force_mock=args.mock)
     handler = NetlinkFibHandler(netlink)
-    server = FibAgentServer(handler, port=args.port)
+    if args.thrift:
+        from openr_tpu.platform.thrift_fib import FibThriftServer
+
+        server = FibThriftServer(handler, port=args.port)
+    else:
+        server = FibAgentServer(handler, port=args.port)
     server.start()
     log.info(
-        "platform agent (%s kernel) listening on port %d",
+        "platform agent (%s kernel, %s wire) listening on port %d",
         type(netlink).__name__,
+        "thrift-compact" if args.thrift else "framework-rpc",
         server.port,
     )
 

@@ -1,0 +1,275 @@
+"""FibService platform boundary in the reference thrift wire format.
+
+The reference's Fib module programs routes into a platform agent over
+thrift ``FibService`` (openr/if/Platform.thrift:70-135; agent default
+port 60100, Constants.h:260). This module serves/dials that contract
+as framed CompactProtocol RPC (shared transport: utils/thrift_rpc.py;
+Network.thrift struct schemas: utils/thrift_compact.py), so this
+daemon's Fib can program a stock FibService agent (an FBOSS-style
+switch agent) and a stock Open/R's Fib can program THIS framework's
+netlink-backed handler.
+
+Methods (Platform.thrift:90-135, clientId is i16):
+- addUnicastRoutes / deleteUnicastRoutes / syncFib
+- addMplsRoutes / deleteMplsRoutes / syncMplsFib
+- getRouteTableByClient / getMplsRouteTableByClient
+- aliveSince (fb303 surface, i64 epoch ms)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from openr_tpu.platform.fib_service import FibService
+from openr_tpu.types import MplsRoute, UnicastRoute
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.thrift_rpc import (
+    FramedCompactClient,
+    FramedCompactServer,
+)
+
+_VOID = tc.StructSchema("void_result", ())
+
+
+def _args(name: str, second=None) -> tc.StructSchema:
+    fields = [tc.Field(1, ("i16",), "clientId")]
+    if second is not None:
+        fields.append(tc.Field(2, second, "payload"))
+    return tc.StructSchema(f"{name}_args", tuple(fields))
+
+
+_UNICAST_LIST = ("list", ("struct", tc.UNICAST_ROUTE))
+_MPLS_LIST = ("list", ("struct", tc.MPLS_ROUTE))
+_PREFIX_LIST = ("list", ("struct", tc.IP_PREFIX))
+
+_ADD_UNICAST = _args("addUnicastRoutes", _UNICAST_LIST)
+_DEL_UNICAST = _args("deleteUnicastRoutes", _PREFIX_LIST)
+_SYNC_FIB = _args("syncFib", _UNICAST_LIST)
+_ADD_MPLS = _args("addMplsRoutes", _MPLS_LIST)
+_DEL_MPLS = _args("deleteMplsRoutes", ("list", ("i32",)))
+_SYNC_MPLS = _args("syncMplsFib", _MPLS_LIST)
+_GET_UNICAST = _args("getRouteTableByClient")
+_GET_MPLS = _args("getMplsRouteTableByClient")
+_ALIVE_ARGS = tc.StructSchema("aliveSince_args", ())
+
+_UNICAST_RESULT = tc.StructSchema(
+    "unicast_result",
+    (tc.Field(0, _UNICAST_LIST, "success", optional=True),),
+)
+_MPLS_RESULT = tc.StructSchema(
+    "mpls_result", (tc.Field(0, _MPLS_LIST, "success", optional=True),)
+)
+_ALIVE_RESULT = tc.StructSchema(
+    "aliveSince_result",
+    (tc.Field(0, ("i64",), "success", optional=True),),
+)
+
+
+class FibThriftServer:
+    """Serve any FibService implementation (the netlink-backed
+    NetlinkFibHandler, or the mock agent) on the reference wire."""
+
+    def __init__(self, handler: FibService, host: str = "0.0.0.0",
+                 port: int = 0):
+        self._handler = handler
+        h = handler
+        self._server = FramedCompactServer(
+            {
+                "addUnicastRoutes": (
+                    _ADD_UNICAST,
+                    self._void(
+                        lambda a: h.add_unicast_routes(
+                            a.get("clientId", 0),
+                            [
+                                tc._unicast_route_from_wire(r)
+                                for r in a.get("payload", [])
+                            ],
+                        )
+                    ),
+                ),
+                "deleteUnicastRoutes": (
+                    _DEL_UNICAST,
+                    self._void(
+                        lambda a: h.delete_unicast_routes(
+                            a.get("clientId", 0),
+                            [
+                                tc._ip_prefix_from_wire(p)
+                                for p in a.get("payload", [])
+                            ],
+                        )
+                    ),
+                ),
+                "syncFib": (
+                    _SYNC_FIB,
+                    self._void(
+                        lambda a: h.sync_fib(
+                            a.get("clientId", 0),
+                            [
+                                tc._unicast_route_from_wire(r)
+                                for r in a.get("payload", [])
+                            ],
+                        )
+                    ),
+                ),
+                "addMplsRoutes": (
+                    _ADD_MPLS,
+                    self._void(
+                        lambda a: h.add_mpls_routes(
+                            a.get("clientId", 0),
+                            [
+                                tc._mpls_route_from_wire(r)
+                                for r in a.get("payload", [])
+                            ],
+                        )
+                    ),
+                ),
+                "deleteMplsRoutes": (
+                    _DEL_MPLS,
+                    self._void(
+                        lambda a: h.delete_mpls_routes(
+                            a.get("clientId", 0), a.get("payload", [])
+                        )
+                    ),
+                ),
+                "syncMplsFib": (
+                    _SYNC_MPLS,
+                    self._void(
+                        lambda a: h.sync_mpls_fib(
+                            a.get("clientId", 0),
+                            [
+                                tc._mpls_route_from_wire(r)
+                                for r in a.get("payload", [])
+                            ],
+                        )
+                    ),
+                ),
+                "getRouteTableByClient": (
+                    _GET_UNICAST, self._get_unicast,
+                ),
+                "getMplsRouteTableByClient": (
+                    _GET_MPLS, self._get_mpls,
+                ),
+                "aliveSince": (_ALIVE_ARGS, self._alive),
+            },
+            host=host,
+            port=port,
+        )
+        self.port = self._server.port
+
+    @staticmethod
+    def _void(fn):
+        def handler(args: Dict):
+            fn(args)
+            return _VOID, {}
+
+        return handler
+
+    def _get_unicast(self, args: Dict):
+        routes = self._handler.get_route_table_by_client(
+            args.get("clientId", 0)
+        )
+        return _UNICAST_RESULT, {
+            "success": [tc._unicast_route_to_wire(r) for r in routes]
+        }
+
+    def _get_mpls(self, args: Dict):
+        routes = self._handler.get_mpls_route_table_by_client(
+            args.get("clientId", 0)
+        )
+        return _MPLS_RESULT, {
+            "success": [tc._mpls_route_to_wire(r) for r in routes]
+        }
+
+    def _alive(self, args: Dict):
+        return _ALIVE_RESULT, {"success": self._handler.alive_since()}
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+
+
+class ThriftFibAgent(FibService):
+    """FibService client over the reference wire — what Fib uses when
+    the platform agent speaks thrift (reference: Fib.h:72
+    createFibClient)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._client = FramedCompactClient(host, port, timeout_s)
+
+    def _void_call(self, name, schema, client_id, payload=None) -> None:
+        args: Dict = {"clientId": client_id}
+        if payload is not None:
+            args["payload"] = payload
+        self._client.call(name, schema, args, _VOID)
+
+    def add_unicast_routes(self, client_id, routes) -> None:
+        self._void_call(
+            "addUnicastRoutes", _ADD_UNICAST, client_id,
+            [tc._unicast_route_to_wire(r) for r in routes],
+        )
+
+    def delete_unicast_routes(self, client_id, prefixes) -> None:
+        self._void_call(
+            "deleteUnicastRoutes", _DEL_UNICAST, client_id,
+            [tc._ip_prefix_to_wire(p) for p in prefixes],
+        )
+
+    def sync_fib(self, client_id, routes) -> None:
+        self._void_call(
+            "syncFib", _SYNC_FIB, client_id,
+            [tc._unicast_route_to_wire(r) for r in routes],
+        )
+
+    def add_mpls_routes(self, client_id, routes) -> None:
+        self._void_call(
+            "addMplsRoutes", _ADD_MPLS, client_id,
+            [tc._mpls_route_to_wire(r) for r in routes],
+        )
+
+    def delete_mpls_routes(self, client_id, labels) -> None:
+        self._void_call(
+            "deleteMplsRoutes", _DEL_MPLS, client_id, list(labels)
+        )
+
+    def sync_mpls_fib(self, client_id, routes) -> None:
+        self._void_call(
+            "syncMplsFib", _SYNC_MPLS, client_id,
+            [tc._mpls_route_to_wire(r) for r in routes],
+        )
+
+    def get_route_table_by_client(
+        self, client_id
+    ) -> List[UnicastRoute]:
+        result = self._client.call(
+            "getRouteTableByClient", _GET_UNICAST,
+            {"clientId": client_id}, _UNICAST_RESULT,
+        )
+        return [
+            tc._unicast_route_from_wire(r)
+            for r in result.get("success", [])
+        ]
+
+    def get_mpls_route_table_by_client(
+        self, client_id
+    ) -> List[MplsRoute]:
+        result = self._client.call(
+            "getMplsRouteTableByClient", _GET_MPLS,
+            {"clientId": client_id}, _MPLS_RESULT,
+        )
+        return [
+            tc._mpls_route_from_wire(r)
+            for r in result.get("success", [])
+        ]
+
+    def alive_since(self) -> int:
+        result = self._client.call(
+            "aliveSince", _ALIVE_ARGS, {}, _ALIVE_RESULT
+        )
+        if "success" not in result:
+            raise RuntimeError("aliveSince returned no result")
+        return result["success"]
+
+    def close(self) -> None:
+        self._client.close()

@@ -119,6 +119,7 @@ class Spark:
         v4_addr: Optional[BinaryAddress] = None,
         v6_addr: Optional[BinaryAddress] = None,
         wire_format: str = "native",
+        domain: str = "openr",
     ):
         self.my_node_name = my_node_name
         self.area = area
@@ -146,6 +147,9 @@ class Spark:
         # the reference's own dual-stack migration pattern.
         assert wire_format in ("native", "thrift"), wire_format
         self._wire_format = wire_format
+        # rides thrift-wire hellos as domainName: a stock Open/R
+        # neighbor drops hellos whose domain mismatches its own
+        self._domain = domain
         self._v4 = v4_addr or BinaryAddress()
         self._v6 = v6_addr or BinaryAddress()
         # if_name -> {neighbor_node -> _Neighbor}
@@ -325,7 +329,7 @@ class Spark:
 
     def _encode(self, pkt: SparkPacket) -> bytes:
         if self._wire_format == "thrift":
-            return thrift_wire.encode_packet(pkt)
+            return thrift_wire.encode_packet(pkt, domain=self._domain)
         return wire.dumps(pkt)
 
     def _process_packet(self, if_name: str, data: bytes) -> None:

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 from typing import Dict
 
-from openr_tpu.types import BinaryAddress
 from openr_tpu.types.spark import (
     ReflectedNeighborInfo,
     SparkHandshakeMsg,
@@ -41,15 +40,9 @@ from openr_tpu.types.spark import (
 )
 from openr_tpu.utils import thrift_compact as tc
 
-# reference: openr/if/Network.thrift BinaryAddress (1: binary addr,
-# 3: optional string ifName; field 2 `port` is deprecated/unused here)
-BINARY_ADDRESS = tc.StructSchema(
-    "BinaryAddress",
-    (
-        tc.Field(1, ("binary",), "addr"),
-        tc.Field(3, ("string",), "ifName", optional=True),
-    ),
-)
+# Network.thrift BinaryAddress schema + adapters are shared with the
+# FibService wire (utils/thrift_compact.py)
+BINARY_ADDRESS = tc.BINARY_ADDRESS
 
 REFLECTED_NEIGHBOR_INFO = tc.StructSchema(
     "ReflectedNeighborInfo",
@@ -137,17 +130,8 @@ OPENR_VERSION = 20200825
 OPENR_SUPPORTED_VERSION = 20200604
 
 
-def _addr_to_wire(a: BinaryAddress) -> Dict:
-    out: Dict = {"addr": a.addr}
-    if a.if_name is not None:
-        out["ifName"] = a.if_name
-    return out
-
-
-def _addr_from_wire(d: Dict) -> BinaryAddress:
-    return BinaryAddress(
-        addr=d.get("addr", b""), if_name=d.get("ifName")
-    )
+_addr_to_wire = tc._bin_addr_to_wire
+_addr_from_wire = tc._bin_addr_from_wire
 
 
 def encode_packet(pkt: SparkPacket, domain: str = "") -> bytes:
@@ -230,8 +214,8 @@ def decode_packet(data: bytes) -> SparkPacket:
         v = hello.get("version", OPENR_VERSION)
         # map the reference's date-coded version onto the framework's
         # internal numbering: anything at/above the reference floor is
-        # acceptable (internally version 1); a below-floor sender keeps
-        # its raw value so Spark's version check rejects it
+        # acceptable (internally version 1); a below-floor sender maps
+        # to 0 so Spark's version check rejects it
         pkt.version = 1 if v >= OPENR_SUPPORTED_VERSION or v == 1 else 0
     heartbeat = d.get("heartbeatMsg")
     if heartbeat is not None:

@@ -600,3 +600,209 @@ def encode_key_dump_params(p) -> bytes:
 
 def decode_key_dump_params(data: bytes):
     return _key_dump_params_from_wire(decode(KEY_DUMP_PARAMS, data))
+
+
+# -- Network.thrift schemas (shared by FibService and Spark wires) -------
+
+# reference: openr/if/Network.thrift:55-58
+BINARY_ADDRESS = StructSchema(
+    "BinaryAddress",
+    (
+        Field(1, ("binary",), "addr"),
+        Field(3, ("string",), "ifName", optional=True),
+    ),
+)
+
+# reference: openr/if/Network.thrift:60-63
+IP_PREFIX = StructSchema(
+    "IpPrefix",
+    (
+        Field(1, ("struct", BINARY_ADDRESS), "prefixAddress"),
+        Field(2, ("i16",), "prefixLength"),
+    ),
+)
+
+# reference: openr/if/Network.thrift:47-53
+MPLS_ACTION = StructSchema(
+    "MplsAction",
+    (
+        Field(1, ("i32",), "action"),
+        Field(2, ("i32",), "swapLabel", optional=True),
+        Field(3, ("list", ("i32",)), "pushLabels", optional=True),
+    ),
+)
+
+# reference: openr/if/Network.thrift:65-96 (metric is field 51,
+# area 53, neighborNodeName 54 — deliberately sparse ids)
+NEXT_HOP = StructSchema(
+    "NextHopThrift",
+    (
+        Field(1, ("struct", BINARY_ADDRESS), "address"),
+        Field(2, ("i32",), "weight"),
+        Field(3, ("struct", MPLS_ACTION), "mplsAction", optional=True),
+        Field(51, ("i32",), "metric"),
+        Field(53, ("string",), "area", optional=True),
+        Field(54, ("string",), "neighborNodeName", optional=True),
+    ),
+)
+
+# reference: openr/if/Network.thrift:121-135 (field 2 deprecated)
+UNICAST_ROUTE = StructSchema(
+    "UnicastRoute",
+    (
+        Field(1, ("struct", IP_PREFIX), "dest"),
+        Field(3, ("i32",), "adminDistance", optional=True),
+        Field(4, ("list", ("struct", NEXT_HOP)), "nextHops"),
+        Field(5, ("i32",), "prefixType", optional=True),
+        Field(6, ("binary",), "data", optional=True),
+        Field(7, ("bool",), "doNotInstall"),
+    ),
+)
+
+# reference: openr/if/Network.thrift:98-104
+MPLS_ROUTE = StructSchema(
+    "MplsRoute",
+    (
+        Field(1, ("i32",), "topLabel"),
+        Field(3, ("i32",), "adminDistance", optional=True),
+        Field(4, ("list", ("struct", NEXT_HOP)), "nextHops"),
+    ),
+)
+
+
+def _bin_addr_to_wire(a) -> Dict:
+    out: Dict = {"addr": a.addr}
+    if a.if_name is not None:
+        out["ifName"] = a.if_name
+    return out
+
+
+def _bin_addr_from_wire(d: Dict):
+    from openr_tpu.types import BinaryAddress
+
+    return BinaryAddress(addr=d.get("addr", b""), if_name=d.get("ifName"))
+
+
+def _ip_prefix_to_wire(p) -> Dict:
+    return {
+        "prefixAddress": _bin_addr_to_wire(p.prefix_address),
+        "prefixLength": p.prefix_length,
+    }
+
+
+def _ip_prefix_from_wire(d: Dict):
+    from openr_tpu.types import IpPrefix
+
+    return IpPrefix(
+        prefix_address=_bin_addr_from_wire(d.get("prefixAddress", {})),
+        prefix_length=d.get("prefixLength", 0),
+    )
+
+
+def _next_hop_to_wire(nh) -> Dict:
+    out: Dict = {
+        "address": _bin_addr_to_wire(nh.address),
+        "weight": nh.weight,
+        "metric": nh.metric,
+    }
+    if nh.area is not None:
+        out["area"] = nh.area
+    if nh.neighbor_node_name is not None:
+        out["neighborNodeName"] = nh.neighbor_node_name
+    if nh.mpls_action is not None:
+        act: Dict = {"action": int(nh.mpls_action.action)}
+        if nh.mpls_action.swap_label is not None:
+            act["swapLabel"] = nh.mpls_action.swap_label
+        if nh.mpls_action.push_labels is not None:
+            act["pushLabels"] = list(nh.mpls_action.push_labels)
+        out["mplsAction"] = act
+    return out
+
+
+def _next_hop_from_wire(d: Dict):
+    from openr_tpu.types import MplsAction, MplsActionCode, NextHop
+
+    action = None
+    act = d.get("mplsAction")
+    if act is not None:
+        action = MplsAction(
+            action=MplsActionCode(act.get("action", 0)),
+            swap_label=act.get("swapLabel"),
+            push_labels=(
+                tuple(act["pushLabels"])
+                if act.get("pushLabels") is not None
+                else None
+            ),
+        )
+    return NextHop(
+        address=_bin_addr_from_wire(d.get("address", {})),
+        weight=d.get("weight", 0),
+        mpls_action=action,
+        metric=d.get("metric", 0),
+        area=d.get("area"),
+        neighbor_node_name=d.get("neighborNodeName"),
+    )
+
+
+def _unicast_route_to_wire(r) -> Dict:
+    out: Dict = {
+        "dest": _ip_prefix_to_wire(r.dest),
+        "nextHops": [_next_hop_to_wire(nh) for nh in r.next_hops],
+        "doNotInstall": r.do_not_install,
+    }
+    if r.admin_distance is not None:
+        out["adminDistance"] = int(r.admin_distance)
+    if r.prefix_type is not None:
+        out["prefixType"] = int(r.prefix_type)
+    if r.data is not None:
+        out["data"] = r.data
+    return out
+
+
+def _unicast_route_from_wire(d: Dict):
+    from openr_tpu.types import AdminDistance, PrefixType, UnicastRoute
+
+    return UnicastRoute(
+        dest=_ip_prefix_from_wire(d.get("dest", {})),
+        next_hops=tuple(
+            _next_hop_from_wire(nh) for nh in d.get("nextHops", [])
+        ),
+        admin_distance=(
+            AdminDistance(d["adminDistance"])
+            if d.get("adminDistance") is not None
+            else None
+        ),
+        prefix_type=(
+            PrefixType(d["prefixType"])
+            if d.get("prefixType") is not None
+            else None
+        ),
+        data=d.get("data"),
+        do_not_install=d.get("doNotInstall", False),
+    )
+
+
+def _mpls_route_to_wire(r) -> Dict:
+    out: Dict = {
+        "topLabel": r.top_label,
+        "nextHops": [_next_hop_to_wire(nh) for nh in r.next_hops],
+    }
+    if r.admin_distance is not None:
+        out["adminDistance"] = int(r.admin_distance)
+    return out
+
+
+def _mpls_route_from_wire(d: Dict):
+    from openr_tpu.types import AdminDistance, MplsRoute
+
+    return MplsRoute(
+        top_label=d.get("topLabel", 0),
+        next_hops=tuple(
+            _next_hop_from_wire(nh) for nh in d.get("nextHops", [])
+        ),
+        admin_distance=(
+            AdminDistance(d["adminDistance"])
+            if d.get("adminDistance") is not None
+            else None
+        ),
+    )

@@ -1,0 +1,243 @@
+"""Shared framed-CompactProtocol RPC machinery.
+
+The transport every interop channel speaks: TFramedTransport (4-byte
+big-endian length prefix) carrying TCompactProtocol messages with the
+standard envelope
+
+    0x82 | (version=1 | type<<5) | varint(seqid) | varstring(name)
+
+followed by the args struct; replies carry a result struct whose
+success field is id 0, declared-exception-free errors ride a
+TApplicationException. Used by the KvStore peer channel
+(kvstore/thrift_peer.py) and the FibService platform channel
+(platform/thrift_fib.py); fbthrift's Rocket/THeader outer transports
+are a different layer — classic framed transport is the interop-stable
+one.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.rpc import apply_bind_family
+
+PROTOCOL_ID = 0x82
+VERSION = 1
+TYPE_CALL = 1
+TYPE_REPLY = 2
+TYPE_EXCEPTION = 3
+
+MAX_FRAME = 64 * 1024 * 1024
+
+# TApplicationException (thrift builtin), compact-encoded
+TAPP_EXC = tc.StructSchema(
+    "TApplicationException",
+    (
+        tc.Field(1, ("string",), "message", optional=True),
+        tc.Field(2, ("i32",), "type", optional=True),
+    ),
+)
+
+
+def encode_message(
+    name: str, mtype: int, seqid: int, schema, values: Dict
+) -> bytes:
+    """One compact-protocol message (frame header excluded)."""
+    w = tc._Writer()
+    w.byte(PROTOCOL_ID)
+    w.byte((VERSION & 0x1F) | (mtype << 5))
+    w.varint(seqid)
+    w.binary(name.encode("utf-8"))
+    return bytes(w.buf) + tc.encode(schema, values)
+
+
+def decode_message_header(data: bytes) -> Tuple[str, int, int, int]:
+    """Returns (name, mtype, seqid, args_offset)."""
+    r = tc._Reader(data)
+    proto = r.byte()
+    if proto != PROTOCOL_ID:
+        raise ValueError(f"not a compact-protocol message: 0x{proto:02x}")
+    vt = r.byte()
+    if (vt & 0x1F) != VERSION:
+        raise ValueError(f"unsupported compact version {vt & 0x1F}")
+    mtype = (vt >> 5) & 0x07
+    seqid = r.varint()
+    name = r.binary().decode("utf-8")
+    return name, mtype, seqid, r.pos
+
+
+def frame(payload: bytes) -> bytes:
+    return struct.pack(">I", len(payload)) + payload
+
+
+def read_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = read_exact(sock, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack(">I", hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"oversized frame {length}")
+    return read_exact(sock, length)
+
+
+def read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    # bytearray accumulation: += on bytes is quadratic, and full-sync
+    # payloads can be tens of MB
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+# method name -> (args_schema, handler(args_dict) ->
+#                 (result_schema, result_dict))
+MethodTable = Dict[str, Tuple[object, Callable[[Dict], Tuple[object, Dict]]]]
+
+
+class FramedCompactServer:
+    """Threaded TCP server dispatching a framed-compact method table.
+    Dispatch errors reply as TApplicationException rather than closing
+    the connection (a stock thrift client expects a reply frame, not a
+    bare EOF)."""
+
+    def __init__(
+        self, methods: MethodTable, host: str = "0.0.0.0", port: int = 0
+    ):
+        outer = self
+        self._methods = methods
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                while True:
+                    try:
+                        data = read_frame(self.request)
+                    except (OSError, ValueError):
+                        return
+                    if data is None:
+                        return
+                    try:
+                        reply = outer._dispatch(data)
+                    except Exception as exc:
+                        reply = outer._exception_reply(data, exc)
+                        if reply is None:  # header itself unparseable
+                            return
+                    try:
+                        self.request.sendall(frame(reply))
+                    except OSError:
+                        return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        apply_bind_family(Server, host)
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def _dispatch(self, data: bytes) -> bytes:
+        name, mtype, seqid, off = decode_message_header(data)
+        if mtype != TYPE_CALL:
+            raise ValueError(f"unexpected message type {mtype}")
+        entry = self._methods.get(name)
+        if entry is None:
+            return encode_message(
+                name, TYPE_EXCEPTION, seqid, TAPP_EXC,
+                {"message": f"unknown method {name!r}", "type": 1},
+            )
+        args_schema, handler = entry
+        result_schema, result = handler(tc.decode(args_schema, data[off:]))
+        return encode_message(
+            name, TYPE_REPLY, seqid, result_schema, result
+        )
+
+    @staticmethod
+    def _exception_reply(data: bytes, exc: Exception) -> Optional[bytes]:
+        try:
+            name, _mtype, seqid, _off = decode_message_header(data)
+        except Exception:
+            return None
+        return encode_message(
+            name, TYPE_EXCEPTION, seqid, TAPP_EXC,
+            {"message": f"{type(exc).__name__}: {exc}", "type": 6},
+        )
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="framed-compact-rpc",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+class FramedCompactClient:
+    """One-connection framed-compact caller (reconnects per call after
+    a transport error)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._addr = (host, port)
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._seqid = 0
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                self._addr, timeout=self._timeout_s
+            )
+        return self._sock
+
+    def call(self, name: str, args_schema, args: Dict,
+             result_schema) -> Dict:
+        with self._lock:
+            self._seqid += 1
+            seqid = self._seqid
+            payload = encode_message(
+                name, TYPE_CALL, seqid, args_schema, args
+            )
+            try:
+                sock = self._connect()
+                sock.sendall(frame(payload))
+                data = read_frame(sock)
+            except OSError:
+                self.close()
+                raise
+            if data is None:
+                self.close()
+                raise ConnectionError("peer closed mid-call")
+            rname, mtype, rseq, off = decode_message_header(data)
+            if mtype == TYPE_EXCEPTION:
+                exc = tc.decode(TAPP_EXC, data[off:])
+                raise RuntimeError(
+                    f"peer exception: {exc.get('message')}"
+                )
+            if rname != name or rseq != seqid:
+                self.close()
+                raise ConnectionError(
+                    f"out-of-sync reply {rname}/{rseq}"
+                )
+            return tc.decode(result_schema, data[off:])
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None

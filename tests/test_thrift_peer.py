@@ -8,8 +8,11 @@ import time
 
 from openr_tpu.kvstore.thrift_peer import (
     KvStoreThriftPeerServer,
-    TYPE_CALL,
     ThriftPeerTransport,
+)
+from openr_tpu.utils.thrift_rpc import (
+    TYPE_CALL,
+    TYPE_EXCEPTION,
     decode_message_header,
     encode_message,
 )
@@ -173,8 +176,6 @@ class TestThriftPeerSync:
                 while len(frame) < n:
                     frame += s.recv(n - len(frame))
             name, mtype, _seq, _off = decode_message_header(frame)
-            from openr_tpu.kvstore.thrift_peer import TYPE_EXCEPTION
-
             assert mtype == TYPE_EXCEPTION and name == "nope"
         finally:
             server.stop()
