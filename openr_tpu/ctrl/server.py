@@ -29,6 +29,14 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
+# frame sanity cap. Deliberately below 0x16030100 (a TLS ClientHello's
+# first bytes read as a ~369 MB length prefix): a TLS client probing a
+# plain server gets the connection closed IMMEDIATELY instead of the
+# server blocking on a payload that never comes — which is what makes
+# the clients' secure->plain fallback cost ~1ms, not a probe timeout.
+MAX_FRAME = 128 * 1024 * 1024
+
+
 def _recv_frame(sock: socket.socket) -> Optional[Dict]:
     header = b""
     while len(header) < 4:
@@ -37,6 +45,8 @@ def _recv_frame(sock: socket.socket) -> Optional[Dict]:
             return None
         header += chunk
     (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        return None  # garbage or a TLS handshake: hang up
     payload = b""
     while len(payload) < length:
         chunk = sock.recv(length - len(payload))
@@ -47,12 +57,26 @@ def _recv_frame(sock: socket.socket) -> Optional[Dict]:
 
 
 class CtrlServer:
-    def __init__(self, handler: OpenrCtrlHandler, host="127.0.0.1", port=0):
+    """``ssl_context``: serve the ctrl API over TLS (reference: the
+    thrift ctrl server's optional TLS; clients use the secure-then-
+    plain fallback factory, openr_client.py:27-140)."""
+
+    def __init__(self, handler: OpenrCtrlHandler, host="127.0.0.1",
+                 port=0, ssl_context=None):
         self.handler = handler
+        self._ssl_context = ssl_context
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                from openr_tpu.utils.rpc import wrap_server_connection
+
+                wrapped = wrap_server_connection(
+                    self.request, outer._ssl_context
+                )
+                if wrapped is None:
+                    return
+                self.request = wrapped
                 while True:
                     try:
                         request = _recv_frame(self.request)
@@ -118,10 +142,23 @@ class CtrlServer:
 
 
 class CtrlClient:
-    """Client for CtrlServer (used by the breeze CLI remotely)."""
+    """Client for CtrlServer (used by the breeze CLI remotely).
+
+    Connection behavior mirrors the reference client factory
+    (openr_client.py get_openr_ctrl_client): try a TLS handshake first
+    — accepting the daemon's self-signed onbox cert — and fall back to
+    plain text when the server does not speak TLS."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 2018):
-        self._sock = socket.create_connection((host, port), timeout=30)
+        from openr_tpu.utils.rpc import probe_tls
+
+        ctx = probe_tls(host, port, timeout_s=30)
+        sock = socket.create_connection((host, port), timeout=30)
+        self._sock = (
+            ctx.wrap_socket(sock, server_hostname=host)
+            if ctx is not None
+            else sock
+        )
 
     def call(self, method: str, **kwargs) -> Any:
         _send_frame(self._sock, {"method": method, "kwargs": kwargs})

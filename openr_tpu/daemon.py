@@ -284,12 +284,15 @@ class OpenrNode:
             self._plugin_started = True
         self._started = True
 
-    def start_ctrl_server(self, port: int = 0) -> int:
-        """Expose the ctrl API over TCP (reference: thrift ctrl server on
-        port 2018, Main.cpp:587). Returns the bound port."""
+    def start_ctrl_server(self, port: int = 0, ssl_context=None) -> int:
+        """Expose the ctrl API over TCP, optionally TLS (reference:
+        thrift ctrl server on port 2018 with optional TLS,
+        Main.cpp:587). Returns the bound port."""
         from openr_tpu.ctrl.server import CtrlServer
 
-        self.ctrl_server = CtrlServer(self.ctrl_handler, port=port)
+        self.ctrl_server = CtrlServer(
+            self.ctrl_handler, port=port, ssl_context=ssl_context
+        )
         self.ctrl_server.start()
         return self.ctrl_server.port
 

@@ -94,6 +94,16 @@ def _build_parser():
         "--spark-port", type=int, default=None,
         help="UDP multicast port (default: config spark.mcast_port)",
     )
+    parser.add_argument(
+        "--tls-cert", default=None,
+        help="serve the ctrl API over TLS with this PEM cert chain "
+             "(reference: the thrift ctrl server's optional TLS; the "
+             "breeze client auto-falls-back secure -> plain)",
+    )
+    parser.add_argument(
+        "--tls-key", default=None,
+        help="PEM private key for --tls-cert",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     return parser
 
@@ -153,6 +163,19 @@ def main(argv=None) -> int:
             "--fib-agent-thrift requires --fib-agent-port (otherwise "
             "the no-op mock agent would silently swallow every route)"
         )
+    # pure argument validation: a bad cert invocation must die BEFORE
+    # the daemon starts announcing itself, not flap neighbors after
+    ssl_context = None
+    if bool(args.tls_cert) != bool(args.tls_key):
+        raise SystemExit("--tls-cert and --tls-key go together")
+    if args.tls_cert:
+        import ssl
+
+        ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        try:
+            ssl_context.load_cert_chain(args.tls_cert, args.tls_key)
+        except (OSError, ssl.SSLError) as exc:
+            raise SystemExit(f"--tls-cert/--tls-key: {exc}")
     fib_agent = None  # MockFibAgent default
     if fib_agent_port:
         if args.fib_agent_thrift:
@@ -353,8 +376,14 @@ def main(argv=None) -> int:
     node.start()
     if watchdog is not None:
         watchdog.start()
-    port = node.start_ctrl_server(port=config.openr_ctrl_port)
-    log.info("ctrl server listening on port %d", port)
+    port = node.start_ctrl_server(
+        port=config.openr_ctrl_port, ssl_context=ssl_context
+    )
+    log.info(
+        "ctrl server listening on port %d%s",
+        port,
+        " (TLS)" if ssl_context is not None else "",
+    )
 
     for if_name in ifaces:
         node.add_interface(if_name)

@@ -15,7 +15,8 @@ Differences the adapters absorb:
   receiver knows its own rx interface; the REMOTE interface comes from
   the hello msg) — decode leaves ``if_name`` empty and the Spark FSM
   keeps the hello-learned value;
-- ``domainName`` has no framework equivalent and rides empty;
+- ``domainName`` carries the daemon's configured domain
+  (OpenrConfig.domain; a stock neighbor drops mismatches);
 - the framework's packet-level version maps to the hello msg's
   ``version`` field (the only place the reference carries one).
 

@@ -44,11 +44,20 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
+# frame sanity cap, deliberately below 0x16030100 (a TLS ClientHello
+# read as a length prefix): probing TLS against a plain server fails
+# instantly instead of hanging the server on a phantom payload — see
+# ctrl/server.py MAX_FRAME for the full story.
+MAX_FRAME = 128 * 1024 * 1024
+
+
 def _recv_frame(sock: socket.socket) -> Optional[List[bytes]]:
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (total,) = struct.unpack(">I", header)
+    if total > MAX_FRAME:
+        return None  # garbage or a TLS handshake: hang up
     body = _recv_exact(sock, total)
     if body is None:
         return None
@@ -63,6 +72,59 @@ def _recv_frame(sock: socket.socket) -> Optional[List[bytes]]:
     return blobs
 
 
+def wrap_server_connection(sock, ssl_context, handshake_timeout=5.0):
+    """Server-side TLS wrap with a BOUNDED handshake, for use on the
+    per-connection handler thread — never on the accept thread, where a
+    client that connects and sends nothing would block every subsequent
+    accept and wedge shutdown. Returns the wrapped socket, or None when
+    the handshake fails/times out (caller just returns)."""
+    if ssl_context is None:
+        return sock
+    import ssl
+
+    old = sock.gettimeout()
+    sock.settimeout(handshake_timeout)
+    try:
+        sock = ssl_context.wrap_socket(sock, server_side=True)
+    except (ssl.SSLError, OSError):
+        try:
+            sock.close()
+        except OSError:
+            pass
+        return None
+    sock.settimeout(old)
+    return sock
+
+
+def probe_tls(host: str, port: int, timeout_s: float = 10.0):
+    """Secure-then-plain detection (reference client factory,
+    openr_client.py:27-140): returns a permissive client SSLContext
+    (self-signed accepted — the reference's onbox mode) when the server
+    completes a TLS handshake, else None. The probe handshake is
+    bounded; a plain server hangs up instantly on the ClientHello (its
+    bytes exceed the frame cap), so the fallback costs ~1ms."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    try:
+        probe = socket.create_connection((host, port), timeout=timeout_s)
+        probe.settimeout(min(2.0, timeout_s))
+        try:
+            probe = ctx.wrap_socket(probe, server_hostname=host)
+            probe.close()
+            return ctx
+        except (ssl.SSLError, OSError):
+            try:
+                probe.close()
+            except OSError:
+                pass
+    except OSError:
+        pass  # connection-level failure: let the real client raise it
+    return None
+
+
 def apply_bind_family(server_cls, host: str) -> None:
     """Pick the socketserver address family from the bind host: a v6
     host (incl. "::" dual-stack) needs AF_INET6 — link-local neighbor
@@ -73,16 +135,30 @@ def apply_bind_family(server_cls, host: str) -> None:
 
 
 class RpcServer:
-    """Threaded TCP server dispatching registered wire-RPC methods."""
+    """Threaded TCP server dispatching registered wire-RPC methods.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``ssl_context``: serve TLS (reference: the ctrl thrift server's
+    optional TLS with the acceptable-peers list; the py client factory
+    tries secure then falls back to plain, openr_client.py:27-140).
+    Accepted sockets are wrapped server-side; a plain-text client
+    connecting to a TLS server fails its first frame and falls back."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
         self._methods: Dict[str, Tuple[Callable, List[Any], Any]] = {}
         self._active: set = set()
         self._active_lock = threading.Lock()
+        self._ssl_context = ssl_context
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                wrapped = wrap_server_connection(
+                    self.request, outer._ssl_context
+                )
+                if wrapped is None:
+                    return
+                self.request = wrapped
                 with outer._active_lock:
                     outer._active.add(self.request)
                 try:
@@ -167,18 +243,25 @@ class RpcClient:
     per connection, like a thrift channel)."""
 
     def __init__(
-        self, host: str, port: int, timeout_s: float = 10.0
+        self, host: str, port: int, timeout_s: float = 10.0,
+        ssl_context=None,
     ):
         self._addr = (host, port)
         self._timeout = timeout_s
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        self._ssl_context = ssl_context
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(
+            sock = socket.create_connection(
                 self._addr, timeout=self._timeout
             )
+            if self._ssl_context is not None:
+                sock = self._ssl_context.wrap_socket(
+                    sock, server_hostname=self._addr[0]
+                )
+            self._sock = sock
         return self._sock
 
     def call(self, name: str, args: Sequence[Any], result_type: Any = None):
@@ -205,3 +288,15 @@ class RpcClient:
                 self._sock.close()
             finally:
                 self._sock = None
+
+
+def connect_with_tls_fallback(
+    host: str, port: int, timeout_s: float = 10.0
+) -> RpcClient:
+    """The reference client factory's behavior (openr_client.py:
+    get_openr_ctrl_client tries a secure client, falls back to
+    plain-text for onbox use)."""
+    return RpcClient(
+        host, port, timeout_s,
+        ssl_context=probe_tls(host, port, timeout_s),
+    )

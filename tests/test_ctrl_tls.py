@@ -1,0 +1,96 @@
+"""Ctrl API over TLS with secure-then-plain client fallback
+(reference: the thrift ctrl server's optional TLS and the py client
+factory's secure->plain fallback, openr/py/openr/clients/
+openr_client.py:27-140). Gated on the openssl binary for self-signed
+cert generation."""
+
+import shutil
+import ssl
+import subprocess
+
+import pytest
+
+from openr_tpu.ctrl.server import CtrlClient, CtrlServer
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("openssl") is None, reason="openssl unavailable"
+)
+
+
+class _EchoHandler:
+    """Minimal handler shape: any public method is callable."""
+
+    def get_counters(self):
+        return {"ok": 1}
+
+
+@pytest.fixture
+def cert(tmp_path):
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048",
+            "-keyout", str(key), "-out", str(cert),
+            "-days", "1", "-nodes", "-subj", "/CN=localhost",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(cert), str(key)
+
+
+class TestCtrlTls:
+    def test_tls_server_plain_fallback_clients(self, cert):
+        cert_path, key_path = cert
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_path, key_path)
+        server = CtrlServer(_EchoHandler(), ssl_context=ctx)
+        server.start()
+        try:
+            # the fallback client lands on TLS (self-signed accepted,
+            # like the reference's onbox mode)
+            client = CtrlClient("127.0.0.1", server.port)
+            assert client.call("get_counters") == {"ok": 1}
+            assert isinstance(client._sock, ssl.SSLSocket)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_plain_server_still_served(self):
+        server = CtrlServer(_EchoHandler())
+        server.start()
+        try:
+            client = CtrlClient("127.0.0.1", server.port)
+            assert client.call("get_counters") == {"ok": 1}
+            assert not isinstance(client._sock, ssl.SSLSocket)
+            client.close()
+        finally:
+            server.stop()
+
+    def test_rpc_layer_tls_fallback_factory(self, cert):
+        from openr_tpu.utils.rpc import RpcServer, connect_with_tls_fallback
+
+        cert_path, key_path = cert
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_path, key_path)
+        server = RpcServer(ssl_context=ctx)
+        server.register("ping", lambda: "pong", [], str)
+        server.start()
+        try:
+            client = connect_with_tls_fallback("127.0.0.1", server.port)
+            assert client.call("ping", [], str) == "pong"
+            client.close()
+        finally:
+            server.stop()
+
+        # and against a plain server the same factory falls back
+        plain = RpcServer()
+        plain.register("ping", lambda: "pong", [], str)
+        plain.start()
+        try:
+            client = connect_with_tls_fallback("127.0.0.1", plain.port)
+            assert client.call("ping", [], str) == "pong"
+            client.close()
+        finally:
+            plain.stop()
