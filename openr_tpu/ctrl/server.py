@@ -29,12 +29,9 @@ def _send_frame(sock: socket.socket, obj: Any) -> None:
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
-# frame sanity cap. Deliberately below 0x16030100 (a TLS ClientHello's
-# first bytes read as a ~369 MB length prefix): a TLS client probing a
-# plain server gets the connection closed IMMEDIATELY instead of the
-# server blocking on a payload that never comes — which is what makes
-# the clients' secure->plain fallback cost ~1ms, not a probe timeout.
-MAX_FRAME = 128 * 1024 * 1024
+# the frame cap (and its stay-below-a-ClientHello invariant) lives in
+# ONE place: utils/rpc.py
+from openr_tpu.utils.rpc import MAX_FRAME
 
 
 def _recv_frame(sock: socket.socket) -> Optional[Dict]:

@@ -448,23 +448,31 @@ class _EllResidentCache:
 
         # ls -> (synced topology_version, EllState)
         self._cache = weakref.WeakKeyDictionary()
-        # (version, root) -> (weakref(ls), graph, srcs, packed): a view
-        # the KSP2 engine already computed inside its fused dispatch
-        # this build — consumed (popped) by view_packed so SpfView does
-        # not pay a second device round trip. Single entry, consume-
-        # once, identity checked through the weakref: id() reuse after
-        # gc must never serve a dead graph's rows.
-        self._preloaded: Dict[tuple, tuple] = {}
+        # views the KSP2 engines already computed inside their fused
+        # dispatches this build — consumed (popped) by view_packed so
+        # SpfView does not pay a second device round trip. Entries are
+        # (weakref(ls), version, root, graph, srcs, packed): identity
+        # goes through the weakref (id() reuse after gc must never
+        # serve a dead graph's rows), consume-once, bounded FIFO (one
+        # entry per area engine per build).
+        self._preloaded: List[tuple] = []
 
     def preload_view(self, ls, graph, srcs, packed) -> None:
         import weakref
 
         root = graph.node_names[srcs[0]]
-        self._preloaded = {
-            (ls.topology_version, root): (
-                weakref.ref(ls), graph, srcs, packed,
+        # dead-graph entries can never match; drop them so MB-scale
+        # packed rows don't stay pinned behind a dead LinkState
+        self._preloaded = [
+            e for e in self._preloaded if e[0]() is not None
+        ]
+        self._preloaded.append(
+            (
+                weakref.ref(ls), ls.topology_version, root,
+                graph, srcs, packed,
             )
-        }
+        )
+        del self._preloaded[:-8]  # bound growth on unconsumed entries
 
     def _sync(self, ls: LinkState):
         """Resolve the resident state for ``ls``: returns
@@ -514,12 +522,14 @@ class _EllResidentCache:
         B first-hop rows)."""
         from openr_tpu.ops import spf_sparse
 
-        preloaded = self._preloaded.pop(
-            (ls.topology_version, root), None
-        )
-        if preloaded is not None:
-            ls_ref, graph, srcs, packed = preloaded
-            if ls_ref() is ls:
+        for i, entry in enumerate(self._preloaded):
+            ls_ref, version, entry_root, graph, srcs, packed = entry
+            if (
+                ls_ref() is ls
+                and version == ls.topology_version
+                and entry_root == root
+            ):
+                del self._preloaded[i]
                 return graph, srcs, packed
         state, pending = self._sync(ls)
         graph = pending if pending is not None else state.graph
@@ -1113,13 +1123,18 @@ class SpfSolver:
         Destinations whose first paths contain parallel links fall back
         to the host path (the sliced-ELL collapses parallel links into
         one min-metric slot, so masking one of them is not
-        representable)."""
-        if self.backend != "device" or len(area_link_states) != 1:
+        representable).
+
+        Multi-area: one engine per area graph primes that area's paths.
+        Route reuse needs EVERY area signaled — KSP2 paths toward a
+        best advertiser are computed in every area's graph it appears
+        in (_select_best_paths_ksp2 loops all areas), so a single
+        unsignaled area's churn could silently change reused routes."""
+        if self.backend != "device":
             return None
-        ((area, ls),) = area_link_states.items()
-        if not ls.has_node(my_node_name):
-            return None
-        dsts = set()
+        area_dsts: Dict[str, Set[str]] = {
+            area: set() for area in area_link_states
+        }
         for prefix in prefix_state.prefixes():
             for (node, p_area), entry in prefix_state.entries_for(
                 prefix
@@ -1128,13 +1143,59 @@ class SpfSolver:
                     entry.forwarding_algorithm
                     == PrefixForwardingAlgorithm.KSP2_ED_ECMP
                     and node != my_node_name
-                    and p_area == area
+                    and p_area in area_dsts
                 ):
-                    dsts.add(node)
-        dsts = sorted(dsts)
-        if len(dsts) < KSP2_DEVICE_MIN_DSTS:
+                    area_dsts[p_area].add(node)
+        if not any(area_dsts.values()):
             return None
 
+        union_affected: Set[str] = set()
+        union_tracked: Set[str] = set()
+        all_signaled = True
+        ran_any = False
+        for area, ls in sorted(area_link_states.items()):
+            dsts = sorted(area_dsts[area])
+            if (
+                len(dsts) < KSP2_DEVICE_MIN_DSTS
+                or not ls.has_node(my_node_name)
+            ):
+                all_signaled = False  # area covered by the host path
+                continue
+            result = self._prefetch_ksp2_area(
+                area, ls, my_node_name, dsts
+            )
+            if result is None:
+                all_signaled = False
+                continue
+            ran_any = True
+            union_affected |= result
+            union_tracked |= set(dsts)
+        if not ran_any or not all_signaled:
+            return None
+        # a best advertiser's paths are computed in EVERY area graph it
+        # appears in: a node advertising in area a but merely PRESENT
+        # in area b is untracked by b's engine, so b-churn would never
+        # land it in the affected set — its routes must not be reused
+        self._ksp2_tracked = {
+            n
+            for n in union_tracked
+            if all(
+                (n in area_dsts[a]) or not a_ls.has_node(n)
+                for a, a_ls in area_link_states.items()
+            )
+        } | {my_node_name}
+        return union_affected
+
+    def _prefetch_ksp2_area(
+        self,
+        area: str,
+        ls: LinkState,
+        my_node_name: str,
+        dsts: List[str],
+    ) -> Optional[Set[str]]:
+        """Device-batch one area's KSP2 paths; returns the affected set
+        (cold build = all dsts) or None when the area's paths came from
+        the legacy per-build dispatch / host fallback (no reuse)."""
         from openr_tpu.decision import ksp2_engine
 
         if (
@@ -1155,10 +1216,6 @@ class SpfSolver:
                 engine = ksp2_engine.Ksp2Engine(my_node_name)
                 self._ksp2_engines[ls] = engine
             affected = engine.sync(ls, dsts)
-            # the affected set only speaks for the tracked KSP2
-            # destinations (plus the root, whose drain flips force a
-            # cold build): route reuse checks advertisers against this
-            self._ksp2_tracked = set(dsts) | {my_node_name}
             if engine.valid and engine.ecc_hops > KSP2_DEVICE_MAX_HOPS:
                 # diameter grew past the device win: paths for THIS
                 # build are already primed; drop the engine so later

@@ -110,7 +110,11 @@ def probe_tls(host: str, port: int, timeout_s: float = 10.0):
     ctx.verify_mode = ssl.CERT_NONE
     try:
         probe = socket.create_connection((host, port), timeout=timeout_s)
-        probe.settimeout(min(2.0, timeout_s))
+        # generous handshake bound: a PLAIN server hangs up on the
+        # ClientHello instantly (frame cap), so only genuinely slow TLS
+        # handshakes spend time here — misreading one as "plain" would
+        # downgrade to a connection the TLS server then rejects
+        probe.settimeout(min(5.0, timeout_s))
         try:
             probe = ctx.wrap_socket(probe, server_hostname=host)
             probe.close()

@@ -358,6 +358,118 @@ class TestEngineChurnParity:
         h = host.build_route_db("node-0", area_h, ps_h)
         assert d.to_route_db("node-0") == h.to_route_db("node-0")
 
+    def test_multi_area_ksp2_device_parity(self):
+        """Two areas, each KSP2-rich, a border root in both: the
+        per-area engines batch both graphs and stay byte-exact with the
+        host solver under churn in either area (previously multi-area
+        KSP2 was host-only)."""
+        from openr_tpu.types import PrefixDatabase
+
+        def build_world():
+            area_ls = {}
+            ps = PrefixState()
+            for area, kind, n in (("a", "grid", 4), ("b", "fabric", 120)):
+                topo = (
+                    topologies.grid(
+                        n,
+                        area=area,
+                        forwarding_algorithm=(
+                            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                        ),
+                        forwarding_type=PrefixForwardingType.SR_MPLS,
+                    )
+                    if kind == "grid"
+                    else topologies.fat_tree_nodes(
+                        n,
+                        area=area,
+                        forwarding_algorithm=(
+                            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                        ),
+                        forwarding_type=PrefixForwardingType.SR_MPLS,
+                    )
+                )
+                ls = LinkState(area=area)
+                for name in sorted(topo.adj_dbs):
+                    ls.update_adjacency_database(topo.adj_dbs[name])
+                area_ls[area] = ls
+                for pdb in topo.prefix_dbs.values():
+                    ps.update_prefix_database(pdb)
+            # border root: present in area a's grid as node-0 and in
+            # area b via an adjacency to a rack switch
+            rsw = sorted(
+                k
+                for k in area_ls["b"].get_adjacency_databases()
+                if k.startswith("rsw")
+            )[0]
+            from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+            def border_adj(other, metric=1):
+                return Adjacency(
+                    other_node_name=other,
+                    if_name=f"if_node-0_{other}",
+                    other_if_name=f"if_{other}_node-0",
+                    metric=metric,
+                )
+
+            area_ls["b"].update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="node-0",
+                    adjacencies=(border_adj(rsw),),
+                    node_label=9000,
+                    area="b",
+                )
+            )
+            bdb = area_ls["b"].get_adjacency_databases()[rsw]
+            area_ls["b"].update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=rsw,
+                    adjacencies=tuple(bdb.adjacencies)
+                    + (border_adj("node-0"),),
+                    node_label=bdb.node_label,
+                    area="b",
+                )
+            )
+            return area_ls, ps, rsw
+
+        area_d, ps, rsw = build_world()
+        area_h, ps_h, _ = build_world()
+        dev = SpfSolver("node-0", backend="device")
+        host = SpfSolver("node-0", backend="host")
+
+        def check(step):
+            d = dev.build_route_db("node-0", area_d, ps)
+            h = host.build_route_db("node-0", area_h, ps_h)
+            assert d.to_route_db("node-0") == h.to_route_db("node-0"), step
+
+        check("cold")
+        fsw = sorted(
+            k
+            for k in area_d["b"].get_adjacency_databases()
+            if k.startswith("fsw")
+        )[0]
+        before = dict(SPF_COUNTERS)
+        for step in range(3):  # churn area b
+            for ls in (area_d["b"], area_h["b"]):
+                _mutate_metric(ls, fsw, 0, 2 + step)
+            check(f"b-{step}")
+        for step in range(3):  # churn area a
+            for ls in (area_d["a"], area_h["a"]):
+                _mutate_metric(ls, "node-2", 0, 3 + step)
+            check(f"a-{step}")
+        # the multi-area engine path actually engaged: both area
+        # engines synced incrementally and untouched routes were reused
+        # (MIN_DSTS is 1 via the fixture, so both areas signal)
+        assert (
+            SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+            - before["decision.ksp2_incremental_syncs"]
+            >= 6
+        )
+        assert (
+            SPF_COUNTERS["decision.ksp2_route_reuses"]
+            - before["decision.ksp2_route_reuses"]
+            > 0
+        )
+
     def test_prefix_change_invalidates_route_cache(self):
         """A changed prefix advertisement must not serve stale routes."""
         topo, area_d, ps = _ksp2_network("fabric", 120)
