@@ -472,7 +472,12 @@ class _EllResidentCache:
                 graph, srcs, packed,
             )
         )
-        del self._preloaded[:-8]  # bound growth on unconsumed entries
+        # bound growth on unconsumed entries — but never below the
+        # area count: every area engine preloads BEFORE any view is
+        # consumed, so a fixed cap would evict the earliest areas'
+        # views each build and silently re-pay the round trip
+        cap = max(8, len(self._cache))
+        del self._preloaded[:-cap]
 
     def _sync(self, ls: LinkState):
         """Resolve the resident state for ``ls``: returns

@@ -44,10 +44,12 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return buf
 
 
-# frame sanity cap, deliberately below 0x16030100 (a TLS ClientHello
-# read as a length prefix): probing TLS against a plain server fails
-# instantly instead of hanging the server on a phantom payload — see
-# ctrl/server.py MAX_FRAME for the full story.
+# Frame sanity cap, deliberately below 0x16030100 (a TLS ClientHello's
+# first bytes read as a length prefix): a plain server hangs up on a
+# TLS probe IMMEDIATELY instead of blocking on a ~369MB phantom
+# payload, which is what makes every client's secure->plain fallback
+# cost ~1ms rather than a probe timeout. The single authoritative
+# definition — ctrl/server.py imports it.
 MAX_FRAME = 128 * 1024 * 1024
 
 

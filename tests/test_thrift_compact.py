@@ -267,3 +267,123 @@ class TestForwardCompat:
         )
         with pytest.raises((ValueError, IndexError)):
             tc.decode_value(data[:-3])
+
+
+class TestCodecFuzz:
+    """Randomized schema/value round trips: any structurally valid
+    (schema, value) pair must encode+decode to itself, and skipping an
+    unknown field of any shape must leave the stream in sync."""
+
+    def _random_type(self, rng, depth):
+        kinds = ["bool", "byte", "i16", "i32", "i64", "string", "binary"]
+        if depth < 2:
+            kinds += ["list", "set", "map", "struct"]
+        kind = rng.choice(kinds)
+        if kind in ("list", "set"):
+            # sets need hashable (scalar) elements
+            elem_depth = 2 if kind == "set" else depth + 1
+            return (kind, self._random_type(rng, elem_depth))
+        if kind == "map":
+            return (
+                "map",
+                self._random_type(rng, 2),  # scalar keys
+                self._random_type(rng, depth + 1),
+            )
+        if kind == "struct":
+            return ("struct", self._random_schema(rng, depth + 1))
+        return (kind,)
+
+    def _random_schema(self, rng, depth=0):
+        fields = []
+        fid = 0
+        for _ in range(rng.randint(1, 5)):
+            fid += rng.randint(1, 40)  # exercise both header forms
+            fields.append(
+                tc.Field(
+                    fid,
+                    self._random_type(rng, depth),
+                    f"f{fid}",
+                    optional=rng.random() < 0.3,
+                )
+            )
+        return tc.StructSchema(f"S{rng.randint(0, 9999)}", tuple(fields))
+
+    def _random_value(self, rng, ftype):
+        kind = ftype[0]
+        if kind == "bool":
+            return rng.random() < 0.5
+        if kind == "byte":
+            return rng.randint(-128, 127)
+        if kind in ("i16", "i32", "i64"):
+            bits = {"i16": 15, "i32": 31, "i64": 63}[kind]
+            return rng.randint(-(2 ** bits), 2 ** bits - 1)
+        if kind == "string":
+            return "".join(
+                rng.choice("abcdefg é中") for _ in range(rng.randint(0, 20))
+            )
+        if kind == "binary":
+            return bytes(
+                rng.randint(0, 255) for _ in range(rng.randint(0, 40))
+            )
+        if kind == "list":
+            return [
+                self._random_value(rng, ftype[1])
+                for _ in range(rng.randint(0, 17))
+            ]
+        if kind == "set":
+            return {
+                self._random_value(rng, ftype[1])
+                for _ in range(rng.randint(0, 17))
+            }
+        if kind == "map":
+            return {
+                self._random_value(rng, ftype[1]): self._random_value(
+                    rng, ftype[2]
+                )
+                for _ in range(rng.randint(0, 9))
+            }
+        if kind == "struct":
+            return self._struct_value(rng, ftype[1])
+        raise AssertionError(kind)
+
+    def _struct_value(self, rng, schema):
+        out = {}
+        for f in schema.fields:
+            if f.optional and rng.random() < 0.4:
+                continue
+            out[f.name] = self._random_value(rng, f.ftype)
+        return out
+
+    def test_round_trips(self):
+        import random
+
+        rng = random.Random(0xC0DEC)
+        for _ in range(200):
+            schema = self._random_schema(rng)
+            value = self._struct_value(rng, schema)
+            data = tc.encode(schema, value)
+            assert tc.decode(schema, data) == value
+
+    def test_unknown_fields_of_every_shape_skip_cleanly(self):
+        import random
+
+        rng = random.Random(0x5EED)
+        # decode with a schema that knows NONE of the fields except a
+        # trailing sentinel: every unknown field must be skipped
+        # byte-exactly for the sentinel to decode
+        for _ in range(100):
+            schema = self._random_schema(rng)
+            value = self._struct_value(rng, schema)
+            sentinel_id = max(f.fid for f in schema.fields) + 1
+            full = tc.StructSchema(
+                "full",
+                schema.fields
+                + (tc.Field(sentinel_id, ("i32",), "sentinel"),),
+            )
+            reduced = tc.StructSchema(
+                "reduced",
+                (tc.Field(sentinel_id, ("i32",), "sentinel"),),
+            )
+            value["sentinel"] = 777
+            data = tc.encode(full, value)
+            assert tc.decode(reduced, data) == {"sentinel": 777}
