@@ -24,15 +24,13 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 from openr_tpu.utils import thrift_compact as tc
-from openr_tpu.utils.rpc import apply_bind_family
+from openr_tpu.utils.rpc import MAX_FRAME, apply_bind_family
 
 PROTOCOL_ID = 0x82
 VERSION = 1
 TYPE_CALL = 1
 TYPE_REPLY = 2
 TYPE_EXCEPTION = 3
-
-MAX_FRAME = 64 * 1024 * 1024
 
 # TApplicationException (thrift builtin), compact-encoded
 TAPP_EXC = tc.StructSchema(
