@@ -647,35 +647,45 @@ def _ell_relax_masked(d, bands, srcs_t, ws_t, masks_t, overloaded):
     return jnp.concatenate(parts, axis=1)
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n"))
-def _ell_masked_source_batch(srcs_t, ws_t, masks_t, overloaded, src_id,
-                             bands, n):
+def _ell_masked_fixed_point(srcs_t, ws_t, masks_t, overloaded, src_id,
+                            bands, n, vote=None):
     """Single-source distances over B differently-masked graphs:
-    [B, N]. The device half of batched KSP2 second-path computation —
-    one dispatch replaces B host Dijkstras
+    [B, N] — the device half of batched KSP2 second-path computation
     (reference semantics: LinkState.cpp:763 getKthPaths' runSpf with
-    linksToIgnore, one per destination)."""
+    linksToIgnore, one per destination). Init is an unmasked-overload
+    relax so an overloaded SOURCE still originates (mirrors
+    _ell_view_batch). ``vote`` turns the local convergence bit into the
+    global stop condition (identity when None; a psum for the sharded
+    variant) — the SAME parameterization as _ell_fixed_point, and the
+    ONE home of this loop (three call sites share it)."""
     b = masks_t[0].shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[:, src_id].set(0)
-    # init: unmasked-overload relax so an overloaded SOURCE still
-    # originates (mirrors _ell_view_batch)
     no_overload = jnp.zeros_like(overloaded)
     d0 = _ell_relax_masked(unit, bands, srcs_t, ws_t, masks_t, no_overload)
 
     def cond(state):
         _, changed, it = state
-        return jnp.logical_and(changed, it < n)
+        return jnp.logical_and(changed > 0, it < n)
 
     def body(state):
         d, _, it = state
         nxt = _ell_relax_masked(
             d, bands, srcs_t, ws_t, masks_t, overloaded
         )
-        return nxt, jnp.any(nxt < d), it + 1
+        local = jnp.any(nxt < d).astype(jnp.int32)
+        return nxt, local if vote is None else vote(local), it + 1
 
-    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
     return d
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _ell_masked_source_batch(srcs_t, ws_t, masks_t, overloaded, src_id,
+                             bands, n):
+    return _ell_masked_fixed_point(
+        srcs_t, ws_t, masks_t, overloaded, src_id, bands, n
+    )
 
 
 def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
@@ -912,26 +922,9 @@ def _ell_all_view_rows_masked(
     d = d_all[view_srcs]
     fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
 
-    # masked re-solve (mirrors _ell_masked_source_batch)
     b = masks_t[0].shape[0]
-    unit = jnp.full((b, n), INF, dtype=jnp.int32)
-    unit = unit.at[:, src_id].set(0)
-    no_overload = jnp.zeros_like(overloaded)
-    dm0 = _ell_relax_masked(unit, bands, srcs_t, ws_t, masks_t, no_overload)
-
-    def cond(state):
-        _, changed, it = state
-        return jnp.logical_and(changed, it < n)
-
-    def body(state):
-        dmat, _, it = state
-        nxt = _ell_relax_masked(
-            dmat, bands, srcs_t, ws_t, masks_t, overloaded
-        )
-        return nxt, jnp.any(nxt < dmat), it + 1
-
-    dm_new, _, _ = jax.lax.while_loop(
-        cond, body, (dm0, jnp.bool_(True), 0)
+    dm_new = _ell_masked_fixed_point(
+        srcs_t, ws_t, masks_t, overloaded, src_id, bands, n
     )
 
     row_changed = jnp.any(dm_new != dm_old, axis=1)  # [D]
@@ -1067,6 +1060,61 @@ def _sharded_ell(src_ids, srcs_t, ws_t, overloaded, bands, n, mesh):
         in_specs=(P(SOURCES_AXIS), P(None), P(None), P(None)),
         out_specs=P(SOURCES_AXIS, None),
     )(src_ids, srcs_t, ws_t, overloaded)
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
+def _sharded_ell_masked(
+    srcs_t, ws_t, masks_t, overloaded, src_id, bands, n, mesh
+):
+    def shard_fn(*args):
+        masks_blk = args[: len(masks_t)]
+        srcs_r = args[len(masks_t) : 2 * len(masks_t)]
+        ws_r = args[2 * len(masks_t) : 3 * len(masks_t)]
+        ov_r = args[-1]
+        return _ell_masked_fixed_point(
+            srcs_r, ws_r, masks_blk, ov_r, src_id, bands, n,
+            vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+        )
+
+    nb = len(masks_t)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS, None, None)] * nb  # masks: batch-sharded
+            + [P(None, None)] * nb  # bands replicated
+            + [P(None, None)] * nb
+            + [P(None)]
+        ),
+        out_specs=P(SOURCES_AXIS, None),
+    )(*masks_t, *srcs_t, *ws_t, overloaded)
+
+
+def sharded_ell_masked_distances(
+    graph: EllGraph, src_id: int, masks, mesh: Mesh
+) -> np.ndarray:
+    """The KSP2 masked batch sharded over the mesh: each device owns a
+    block of DESTINATIONS (batch elements of the per-destination
+    edge-masked solve, reference semantics LinkState.cpp:763
+    getKthPaths); bands are replicated (O(E)), the only collective is
+    the 1-bit convergence psum. This is how the KSP2 second-path
+    product scales past one chip's mask-memory budget: B x slots bool
+    masks divide by the mesh size. The mesh size must divide the
+    batch size."""
+    b = masks[0].shape[0]
+    assert b % mesh.devices.size == 0, (b, mesh.devices.size)
+    return np.asarray(
+        _sharded_ell_masked(
+            tuple(jnp.asarray(s) for s in graph.src),
+            tuple(jnp.asarray(w) for w in graph.w),
+            tuple(jnp.asarray(m) for m in masks),
+            jnp.asarray(graph.overloaded),
+            src_id,
+            graph.bands,
+            graph.n_pad,
+            mesh,
+        )
+    )
 
 
 def sharded_ell_all_sources(graph: EllGraph, mesh: Mesh):
