@@ -470,6 +470,113 @@ class TestEngineChurnParity:
             > 0
         )
 
+    def test_fuzz_mixed_churn_random_mesh(self):
+        """Adversarial soundness net: a random weighted mesh under a
+        random stream of MIXED churn (metric changes, link drops and
+        restores, drain/undrain, label flips) must keep the
+        engine-backed device solver byte-exact with the host solver at
+        every step. Any unsound invalidation (a destination wrongly
+        kept cached) breaks parity here."""
+        import random
+
+        from openr_tpu.models import topologies
+
+        rng = random.Random(0xF00D)
+        topo = topologies.random_mesh(30, seed=7)
+        area_d = {topo.area: LinkState(area=topo.area)}
+        area_h = {topo.area: LinkState(area=topo.area)}
+        ps = PrefixState()
+        ps_h = PrefixState()
+        for name in sorted(topo.adj_dbs):
+            area_d[topo.area].update_adjacency_database(
+                topo.adj_dbs[name]
+            )
+            area_h[topo.area].update_adjacency_database(
+                topo.adj_dbs[name]
+            )
+        for pdb in topo.prefix_dbs.values():
+            pdb2 = replace(
+                pdb,
+                prefix_entries=tuple(
+                    replace(
+                        e,
+                        forwarding_type=PrefixForwardingType.SR_MPLS,
+                        forwarding_algorithm=(
+                            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                        ),
+                    )
+                    for e in pdb.prefix_entries
+                ),
+            )
+            ps.update_prefix_database(pdb2)
+            ps_h.update_prefix_database(pdb2)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        nodes = sorted(topo.adj_dbs)
+        root = nodes[0]
+        dev = SpfSolver(root, backend="device")
+        host = SpfSolver(root, backend="host")
+        dropped = {}
+
+        def mutate(step):
+            kind = rng.choice(
+                ["metric", "metric", "metric", "drop", "restore",
+                 "drain", "undrain", "label"]
+            )
+            victim = rng.choice(nodes[1:])
+            for ls in (ls_d, ls_h):
+                db = ls.get_adjacency_databases()[victim]
+                if kind == "metric" and db.adjacencies:
+                    # deterministic picks inside the twin loop: an rng
+                    # draw here would advance the stream differently
+                    # for each twin and desynchronize the graphs
+                    i = step % len(db.adjacencies)
+                    m = (step * 7 + i) % 90 + 1
+                    adjs = list(db.adjacencies)
+                    adjs[i] = replace(adjs[i], metric=m)
+                    ls.update_adjacency_database(
+                        replace(db, adjacencies=tuple(adjs))
+                    )
+                elif kind == "drop" and len(db.adjacencies) > 1:
+                    adjs = list(db.adjacencies)
+                    gone = adjs.pop(step % len(adjs))
+                    dropped[(id(ls), victim)] = gone
+                    ls.update_adjacency_database(
+                        replace(db, adjacencies=tuple(adjs))
+                    )
+                elif kind == "restore":
+                    gone = dropped.pop((id(ls), victim), None)
+                    if gone is not None:
+                        ls.update_adjacency_database(
+                            replace(
+                                db,
+                                adjacencies=tuple(
+                                    list(db.adjacencies) + [gone]
+                                ),
+                            )
+                        )
+                elif kind == "drain":
+                    ls.update_adjacency_database(
+                        replace(db, is_overloaded=True)
+                    )
+                elif kind == "undrain":
+                    ls.update_adjacency_database(
+                        replace(db, is_overloaded=False)
+                    )
+                elif kind == "label":
+                    ls.update_adjacency_database(
+                        replace(db, node_label=50000 + step)
+                    )
+
+        d = dev.build_route_db(root, area_d, ps)
+        h = host.build_route_db(root, area_h, ps_h)
+        assert d.to_route_db(root) == h.to_route_db(root), "cold"
+        for step in range(25):
+            mutate(step)
+            d = dev.build_route_db(root, area_d, ps)
+            h = host.build_route_db(root, area_h, ps_h)
+            assert d.to_route_db(root) == h.to_route_db(root), step
+
     def test_prefix_change_invalidates_route_cache(self):
         """A changed prefix advertisement must not serve stale routes."""
         topo, area_d, ps = _ksp2_network("fabric", 120)
