@@ -487,6 +487,33 @@ def main() -> None:
         result, note = _spawn("cpu", CPU_CHILD_TIMEOUT_S)
     if result is not None:
         result["fallback"] = "; ".join(notes)
+        # carry the most recent REAL-TPU capture of this same benchmark
+        # (self-recorded mid-round when the relay was healthy) so a
+        # relay outage does not erase the round's on-chip evidence from
+        # the official artifact
+        try:
+            with open(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_r03_midround.json",
+                )
+            ) as f:
+                preserved = json.load(f)
+            result["last_known_tpu"] = {
+                "captured_round": 3,
+                "note": preserved.get("note"),
+                "value": preserved["result"]["value"],
+                "device_only_ms": preserved["result"]["device_only_ms"],
+                "platform": preserved["result"]["platform"],
+                "minplus_ms": preserved["result"]["minplus_ms"],
+                "bench_10k_churn": preserved["result"][
+                    "bench_10k_churn"
+                ],
+            }
+        except (OSError, KeyError, TypeError, json.JSONDecodeError):
+            # best-effort enrichment must never break the emit
+            # guarantee (a malformed/absent preserved file included)
+            pass
         emit(result)
         return
     notes.append(note or "cpu child failed")

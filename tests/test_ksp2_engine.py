@@ -181,9 +181,7 @@ class TestEngineChurnParity:
         def metric(ls):
             _mutate_metric(ls, fsw, 0, 4)
 
-        self._stream(
-            "fabric", 120, rsw, [metric, down, metric2_noop := metric, up]
-        )
+        self._stream("fabric", 120, rsw, [metric, down, metric, up])
 
     def test_overload_flip_transit_node(self):
         """Draining a transit fsw must dirty every destination routed
@@ -477,7 +475,6 @@ class TestEngineChurnParity:
         engine-backed device solver byte-exact with the host solver at
         every step. Any unsound invalidation (a destination wrongly
         kept cached) breaks parity here."""
-        import random
 
         from openr_tpu.models import topologies
 
