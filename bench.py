@@ -490,17 +490,23 @@ def main() -> None:
         # carry the most recent REAL-TPU capture of this same benchmark
         # (self-recorded mid-round when the relay was healthy) so a
         # relay outage does not erase the round's on-chip evidence from
-        # the official artifact
+        # the official artifact. Newest BENCH_r*_midround.json wins —
+        # no per-round hand edit, and the round is read from the file.
         try:
-            with open(
-                os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)),
-                    "BENCH_r03_midround.json",
+            import glob
+
+            candidates = sorted(
+                glob.glob(
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_r*_midround.json",
+                    )
                 )
-            ) as f:
+            )
+            with open(candidates[-1]) as f:
                 preserved = json.load(f)
             result["last_known_tpu"] = {
-                "captured_round": 3,
+                "captured_artifact": os.path.basename(candidates[-1]),
                 "note": preserved.get("note"),
                 "value": preserved["result"]["value"],
                 "device_only_ms": preserved["result"]["device_only_ms"],
@@ -510,7 +516,8 @@ def main() -> None:
                     "bench_10k_churn"
                 ],
             }
-        except (OSError, KeyError, TypeError, json.JSONDecodeError):
+        except (OSError, KeyError, IndexError, TypeError,
+                json.JSONDecodeError):
             # best-effort enrichment must never break the emit
             # guarantee (a malformed/absent preserved file included)
             pass

@@ -1,0 +1,156 @@
+"""Thrift-wire interop demo: the reference's wire formats end to end.
+
+Three self-contained legs, all speaking the byte-exact formats a stock
+Open/R toolchain emits (framed TCompactProtocol; see
+openr_tpu/utils/thrift_compact.py and utils/thrift_rpc.py):
+
+1. two KvStores full-sync and live-flood over the thrift
+   ``KvStoreService`` peer channel (KvStore.thrift:256-276);
+2. a ``FibService`` client programs unicast + MPLS routes into a
+   thrift-served platform agent (Platform.thrift:70-135) backed by the
+   in-memory mock kernel;
+3. Spark packets round-trip through the reference ``SparkHelloPacket``
+   compact layout (Spark.thrift:113) with format sniffing against the
+   framework codec.
+
+Run:  python examples/thrift_interop_demo.py
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def kvstore_leg() -> None:
+    from openr_tpu.kvstore.thrift_peer import (
+        KvStoreThriftPeerServer,
+        ThriftPeerTransport,
+    )
+    from openr_tpu.kvstore.wrapper import KvStoreWrapper
+
+    a, b = KvStoreWrapper("node-a"), KvStoreWrapper("node-b")
+    a.start()
+    b.start()
+    server_a = KvStoreThriftPeerServer(a.store, host="127.0.0.1")
+    server_b = KvStoreThriftPeerServer(b.store, host="127.0.0.1")
+    server_a.start()
+    server_b.start()
+    try:
+        a.set_key("demo:greeting", b"hello-over-thrift")
+        a.store.add_peer(
+            "0", "node-b", ThriftPeerTransport("127.0.0.1", server_b.port)
+        )
+        b.store.add_peer(
+            "0", "node-a", ThriftPeerTransport("127.0.0.1", server_a.port)
+        )
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            v = b.get_key("demo:greeting")
+            if v is not None:
+                print(
+                    f"[kvstore] node-b learned demo:greeting = "
+                    f"{v.value!r} over the thrift wire"
+                )
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("sync never completed")
+    finally:
+        server_a.stop()
+        server_b.stop()
+        a.stop()
+        b.stop()
+
+
+def fib_leg() -> None:
+    from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+    from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+    from openr_tpu.platform.thrift_fib import (
+        FibThriftServer,
+        ThriftFibAgent,
+    )
+    from openr_tpu.types import (
+        BinaryAddress,
+        IpPrefix,
+        MplsAction,
+        MplsActionCode,
+        MplsRoute,
+        NextHop,
+        UnicastRoute,
+    )
+
+    kernel = MockNetlinkProtocolSocket()
+    server = FibThriftServer(
+        NetlinkFibHandler(kernel), host="127.0.0.1"
+    )
+    server.start()
+    client = ThriftFibAgent("127.0.0.1", server.port)
+    try:
+        client.add_unicast_routes(
+            786,
+            [
+                UnicastRoute(
+                    dest=IpPrefix.from_str("fd00:de00::/64"),
+                    next_hops=(
+                        NextHop(
+                            address=BinaryAddress.from_str(
+                                "fe80::1", if_name="eth0"
+                            ),
+                            metric=2,
+                        ),
+                    ),
+                )
+            ],
+        )
+        client.add_mpls_routes(
+            786,
+            [
+                MplsRoute(
+                    top_label=10042,
+                    next_hops=(
+                        NextHop(
+                            address=BinaryAddress.from_str("fe80::2"),
+                            mpls_action=MplsAction(
+                                action=MplsActionCode.SWAP,
+                                swap_label=10043,
+                            ),
+                        ),
+                    ),
+                )
+            ],
+        )
+        routes = client.get_route_table_by_client(786)
+        labels = client.get_mpls_route_table_by_client(786)
+        print(
+            f"[fib] agent programmed {len(routes)} unicast route(s) and "
+            f"{len(labels)} MPLS route(s); kernel table: "
+            f"{[r.dest.to_str() for r in kernel.get_all_routes()]}"
+        )
+    finally:
+        client.close()
+        server.stop()
+
+
+def spark_leg() -> None:
+    from openr_tpu.spark import thrift_wire
+    from openr_tpu.types.spark import SparkHeartbeatMsg, SparkPacket
+
+    pkt = SparkPacket(
+        heartbeat=SparkHeartbeatMsg(
+            node_name="demo-node", if_name="eth0", seq_num=42
+        )
+    )
+    data = thrift_wire.encode_packet(pkt)
+    back = thrift_wire.decode_packet(data)
+    print(
+        f"[spark] heartbeat encoded to {len(data)} compact bytes "
+        f"({data.hex(' ')}), decoded node={back.heartbeat.node_name!r} "
+        f"seq={back.heartbeat.seq_num}"
+    )
+
+
+if __name__ == "__main__":
+    kvstore_leg()
+    fib_leg()
+    spark_leg()
+    print("thrift interop demo: all legs ok")

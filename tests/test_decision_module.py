@@ -236,3 +236,59 @@ class TestDecisionPipeline:
             "decision.route_build_runs"
         ]
         assert runs_after - runs_before < 10  # debounced into fewer rebuilds
+
+
+class TestDecisionKsp2Engine:
+    def test_engine_active_through_daemon_path(self, monkeypatch):
+        """The incremental KSP2 engine operates through the Decision
+        module's publication-driven rebuild: churn events arriving as
+        KvStore publications run incremental syncs with route reuse,
+        not cold rebuilds (reference rebuild driver:
+        Decision.cpp:1860 rebuildRoutes)."""
+        from dataclasses import replace
+
+        from openr_tpu.decision import spf_solver as ss
+        from openr_tpu.decision.spf_solver import SPF_COUNTERS
+        from openr_tpu.types.lsdb import (
+            PrefixForwardingAlgorithm,
+            PrefixForwardingType,
+        )
+
+        monkeypatch.setattr(ss, "KSP2_DEVICE_MIN_DSTS", 1)
+        topo = topologies.fat_tree_nodes(
+            120,
+            forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        rsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("rsw"))
+        fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+        h = DecisionHarness(rsw)
+        try:
+            h.publish_topology(topo)
+            assert h.drain_updates(), "no initial routes"
+            adj_dbs = dict(topo.adj_dbs)
+
+            def churn(steps):
+                for step in range(steps):
+                    db = adj_dbs[fsw]
+                    adjs = list(db.adjacencies)
+                    adjs[0] = replace(adjs[0], metric=2 + step % 5)
+                    adj_dbs[fsw] = replace(db, adjacencies=tuple(adjs))
+                    h.publish_adj(adj_dbs[fsw])
+                    h.drain_updates(first_timeout=5.0)
+
+            churn(5)  # warm: cold build + tie transitions
+            before = dict(SPF_COUNTERS)
+            churn(3)
+            syncs = (
+                SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+                - before["decision.ksp2_incremental_syncs"]
+            )
+            reuses = (
+                SPF_COUNTERS["decision.ksp2_route_reuses"]
+                - before["decision.ksp2_route_reuses"]
+            )
+            assert syncs >= 3, "daemon-path rebuilds were not incremental"
+            assert reuses > 0, "no routes reused through the daemon path"
+        finally:
+            h.stop()
