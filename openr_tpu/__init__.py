@@ -23,4 +23,4 @@ arrays resident in HBM; the protocol machinery is host-side Python/C++ with
 the same module-per-thread, typed-queue dataflow as the reference daemon.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

@@ -180,3 +180,45 @@ class TestThriftPeerSync:
         finally:
             server.stop()
             a.stop()
+
+
+class TestThriftRingTopology:
+    def test_ring_of_four_converges(self):
+        """Four stores in a ring, every adjacency on the thrift wire:
+        keys originated anywhere converge everywhere (the multi-store
+        topology pattern of kvstore/tests/KvStoreTest.cpp over a real
+        transport)."""
+        names = ["r0", "r1", "r2", "r3"]
+        stores = {n: KvStoreWrapper(n) for n in names}
+        servers = {}
+        for n, w in stores.items():
+            w.start()
+            servers[n] = KvStoreThriftPeerServer(
+                w.store, host="127.0.0.1"
+            )
+            servers[n].start()
+        try:
+            for i, n in enumerate(names):
+                nxt = names[(i + 1) % len(names)]
+                stores[n].store.add_peer(
+                    "0",
+                    nxt,
+                    ThriftPeerTransport("127.0.0.1", servers[nxt].port),
+                )
+                stores[nxt].store.add_peer(
+                    "0",
+                    n,
+                    ThriftPeerTransport("127.0.0.1", servers[n].port),
+                )
+            for i, n in enumerate(names):
+                stores[n].set_key(f"ring:{n}", f"v{i}".encode())
+            for n in names:
+                for m in names:
+                    assert wait_until(
+                        lambda n=n, m=m: stores[n].get_key(f"ring:{m}")
+                        is not None
+                    ), f"{n} missing ring:{m}"
+        finally:
+            for n in names:
+                servers[n].stop()
+                stores[n].stop()
