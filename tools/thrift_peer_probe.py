@@ -1,0 +1,66 @@
+"""Probe a KvStore peer over the reference thrift wire.
+
+Operator tool for interop debugging: dials a framed-CompactProtocol
+``KvStoreService`` endpoint (this framework's peer server with
+``enable_kvstore_thrift``, or a stock Open/R daemon's peer port) and
+dumps keys — proving wire-level compatibility from the command line.
+
+Run:  python tools/thrift_peer_probe.py HOST PORT [--area 0]
+          [--prefix adj:] [--keys k1,k2] [--hashes-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from openr_tpu.kvstore.thrift_peer import ThriftPeerTransport
+from openr_tpu.types import KeyDumpParams
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="thrift-peer-probe")
+    p.add_argument("host")
+    p.add_argument("port", type=int)
+    p.add_argument("--area", default="0")
+    p.add_argument("--prefix", default="", help="key prefix filter")
+    p.add_argument(
+        "--keys", default="", help="comma-separated exact keys"
+    )
+    p.add_argument(
+        "--timeout", type=float, default=10.0, help="dial timeout (s)"
+    )
+    args = p.parse_args(argv)
+
+    client = ThriftPeerTransport(args.host, args.port, args.timeout)
+    try:
+        if args.keys:
+            pub = client.get_key_vals(
+                args.area, [k for k in args.keys.split(",") if k]
+            )
+        else:
+            pub = client.get_key_vals_filtered(
+                args.area, KeyDumpParams(prefix=args.prefix)
+            )
+    except (OSError, RuntimeError) as exc:
+        print(f"probe failed: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        client.close()
+
+    print(
+        f"area {pub.area!r}: {len(pub.key_vals)} key(s)"
+        + (f" matching prefix {args.prefix!r}" if args.prefix else "")
+    )
+    for key in sorted(pub.key_vals):
+        v = pub.key_vals[key]
+        size = len(v.value) if v.value is not None else 0
+        print(
+            f"  {key}  v{v.version} ttl={v.ttl} ttlv={v.ttl_version} "
+            f"orig={v.originator_id} {size}B"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
