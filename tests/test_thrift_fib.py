@@ -139,3 +139,50 @@ class TestFibModuleOverThriftWire:
             fib.stop()
             client.close()
             server.stop()
+
+
+class TestStandaloneAgentThriftFlag:
+    def test_agent_process_serves_thrift_wire(self, tmp_path):
+        """The standalone platform agent binary with --thrift serves
+        the reference FibService wire (the LinuxPlatformMain.cpp
+        deployment shape): spawn it, program + read back a route over
+        the thrift channel, shut it down."""
+        import os
+        import re
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "openr_tpu.platform.agent",
+                "--mock", "--thrift", "--port", "0",
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        try:
+            port = None
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                m = re.search(r"listening on port (\d+)", line or "")
+                if m:
+                    assert "thrift-compact" in line
+                    port = int(m.group(1))
+                    break
+            assert port, "agent never reported its port"
+            client = ThriftFibAgent("127.0.0.1", port)
+            try:
+                r = _route("fd00:a9e7::/64")
+                client.add_unicast_routes(786, [r])
+                assert client.get_route_table_by_client(786) == [r]
+                assert client.alive_since() > 0
+            finally:
+                client.close()
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=10)
