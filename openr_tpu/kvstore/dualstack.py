@@ -1,0 +1,117 @@
+"""Dual-stack KvStore peer server: both wires on ONE advertised port.
+
+The reference runs its legacy and thrift peer transports
+simultaneously during wire migrations (KvStore.cpp:2940-2973 branches
+per peer). Here both wire formats are framed ``[u32 length][payload]``
+and the first payload byte disambiguates them unambiguously:
+
+- thrift CompactProtocol messages begin with the protocol id ``0x82``;
+- the framework RPC payload begins with its blob count, a small
+  integer that can never be 0x82 (requests carry a method name plus
+  arguments — single-digit blob counts).
+
+One listener peeks the first frame's leading bytes and then runs the
+matching backend's request loop DIRECTLY on the accepted socket (no
+loopback splice, no extra copies): both backend servers expose
+``serve_connection`` for exactly this. A daemon advertises one
+kvStoreCmdPort (Spark handshake) and peers dial it with whichever wire
+they speak.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Optional
+
+from openr_tpu.kvstore.store import KvStore
+from openr_tpu.kvstore.thrift_peer import KvStoreThriftPeerServer
+from openr_tpu.kvstore.transport import KvStorePeerServer
+from openr_tpu.utils.rpc import apply_bind_family
+from openr_tpu.utils.thrift_rpc import PROTOCOL_ID
+
+_SNIFF_BYTES = 5  # u32 frame length + first payload byte
+_SNIFF_DEADLINE_S = 30.0
+
+
+def _peek_first_bytes(sock: socket.socket) -> Optional[bytes]:
+    """Wait until the first frame header + payload byte are buffered.
+    MSG_PEEK returns whatever has ARRIVED — clients that write the
+    frame header and payload in separate sends (several stock thrift
+    transports do) need more than one peek."""
+    deadline = time.monotonic() + _SNIFF_DEADLINE_S
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        sock.settimeout(remaining)
+        try:
+            head = sock.recv(_SNIFF_BYTES, socket.MSG_PEEK)
+        except OSError:
+            return None
+        if not head:
+            return None  # peer hung up
+        if len(head) >= _SNIFF_BYTES:
+            return head
+        # partial arrival: yield briefly rather than hot-spinning on
+        # MSG_PEEK (which does not consume and so returns immediately)
+        time.sleep(0.005)
+
+
+class DualStackPeerServer:
+    """One listening port serving both KvStore peer wires."""
+
+    def __init__(self, kvstore: KvStore, host: str = "0.0.0.0",
+                 port: int = 0):
+        # backends are used for their serve_connection dispatch loops;
+        # their own loopback ephemeral listeners also run (idle,
+        # unadvertised) because socketserver.shutdown() deadlocks on a
+        # server whose serve_forever never ran — starting them is the
+        # cheap way to keep stop() safe
+        self._rpc_backend = KvStorePeerServer(kvstore, host="127.0.0.1")
+        self._thrift_backend = KvStoreThriftPeerServer(
+            kvstore, host="127.0.0.1"
+        )
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                head = _peek_first_bytes(sock)
+                if head is None:
+                    return
+                sock.settimeout(None)
+                if head[4] == PROTOCOL_ID:
+                    outer._thrift_backend.serve_connection(sock)
+                else:
+                    outer._rpc_backend.serve_connection(sock)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        apply_bind_family(Server, host)
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._rpc_backend.start()
+        self._thrift_backend.start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="kvstore-dualstack",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self._thrift_backend.stop()
+        self._rpc_backend.stop()

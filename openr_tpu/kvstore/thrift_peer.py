@@ -105,6 +105,9 @@ class KvStoreThriftPeerServer:
         )
         return _SET_RESULT, {}
 
+    def serve_connection(self, sock) -> None:
+        self._server.serve_connection(sock)
+
     def start(self) -> None:
         self._server.start()
 

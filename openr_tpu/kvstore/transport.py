@@ -70,6 +70,9 @@ class KvStorePeerServer:
     ) -> None:
         self._kvstore.process_dual_messages(area, sender, msgs)
 
+    def serve_connection(self, sock) -> None:
+        self._server.serve_connection(sock)
+
     def start(self) -> None:
         self._server.start()
 

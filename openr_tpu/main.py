@@ -350,24 +350,22 @@ def main(argv=None) -> int:
 
     # KvStore peer server: what neighbors dial for full-sync and flood
     # (reference: the thrift KvStoreService / legacy zmq ROUTER on port
-    # 60002, Constants.h:257). Bound before Spark starts so the
+    # 60002, Constants.h:257). The SERVER always dual-stacks — both
+    # wires on the one advertised port, sniffed per connection — so
+    # mixed deployments mid-migration sync regardless of which wire
+    # each neighbor dials (the reference's dual-transport pattern,
+    # KvStore.cpp:2940-2973). enable_kvstore_thrift selects only the
+    # wire THIS daemon dials outward. Bound before Spark starts so the
     # handshake advertises a live port.
-    if config.kvstore.enable_kvstore_thrift:
-        from openr_tpu.kvstore.thrift_peer import KvStoreThriftPeerServer
+    from openr_tpu.kvstore.dualstack import DualStackPeerServer
 
-        peer_server = KvStoreThriftPeerServer(
-            node.kvstore, host="::", port=config.kvstore.peer_port
-        )
-    else:
-        from openr_tpu.kvstore.transport import KvStorePeerServer
-
-        peer_server = KvStorePeerServer(
-            node.kvstore, host="::", port=config.kvstore.peer_port
-        )
+    peer_server = DualStackPeerServer(
+        node.kvstore, host="::", port=config.kvstore.peer_port
+    )
     peer_server.start()
     node.spark.set_kvstore_peer_port(peer_server.port)
     log.info(
-        "kvstore peer server (%s wire) on port %d",
+        "kvstore peer server (dual-stack; dialing %s) on port %d",
         "thrift-compact" if config.kvstore.enable_kvstore_thrift
         else "framework-rpc",
         peer_server.port,

@@ -164,21 +164,7 @@ class RpcServer:
                 )
                 if wrapped is None:
                     return
-                self.request = wrapped
-                with outer._active_lock:
-                    outer._active.add(self.request)
-                try:
-                    while True:
-                        try:
-                            blobs = _recv_frame(self.request)
-                        except (ConnectionError, OSError):
-                            return
-                        if blobs is None:
-                            return
-                        outer._dispatch(self.request, blobs)
-                finally:
-                    with outer._active_lock:
-                        outer._active.discard(self.request)
+                outer.serve_connection(wrapped)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -192,6 +178,26 @@ class RpcServer:
             name=f"rpc-server:{self.port}",
             daemon=True,
         )
+
+    def serve_connection(self, sock) -> None:
+        """Run the request loop on an already-accepted socket — the
+        shared entry for the own listener's handler AND external
+        demultiplexers (the dual-stack peer server hands sniffed
+        connections here directly, no loopback splice)."""
+        with self._active_lock:
+            self._active.add(sock)
+        try:
+            while True:
+                try:
+                    blobs = _recv_frame(sock)
+                except (ConnectionError, OSError):
+                    return
+                if blobs is None:
+                    return
+                self._dispatch(sock, blobs)
+        finally:
+            with self._active_lock:
+                self._active.discard(sock)
 
     def register(
         self,

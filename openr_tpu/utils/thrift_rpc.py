@@ -114,23 +114,7 @@ class FramedCompactServer:
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
-                while True:
-                    try:
-                        data = read_frame(self.request)
-                    except (OSError, ValueError):
-                        return
-                    if data is None:
-                        return
-                    try:
-                        reply = outer._dispatch(data)
-                    except Exception as exc:
-                        reply = outer._exception_reply(data, exc)
-                        if reply is None:  # header itself unparseable
-                            return
-                    try:
-                        self.request.sendall(frame(reply))
-                    except OSError:
-                        return
+                outer.serve_connection(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -140,6 +124,27 @@ class FramedCompactServer:
         self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def serve_connection(self, sock) -> None:
+        """Run the request loop on an already-accepted socket (shared
+        by the own listener and external demultiplexers)."""
+        while True:
+            try:
+                data = read_frame(sock)
+            except (OSError, ValueError):
+                return
+            if data is None:
+                return
+            try:
+                reply = self._dispatch(data)
+            except Exception as exc:
+                reply = self._exception_reply(data, exc)
+                if reply is None:  # header itself unparseable
+                    return
+            try:
+                sock.sendall(frame(reply))
+            except OSError:
+                return
 
     def _dispatch(self, data: bytes) -> bytes:
         name, mtype, seqid, off = decode_message_header(data)

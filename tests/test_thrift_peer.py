@@ -222,3 +222,38 @@ class TestThriftRingTopology:
             for n in names:
                 servers[n].stop()
                 stores[n].stop()
+
+
+class TestDualStackPeerServer:
+    def test_both_wires_one_port(self):
+        """A mixed deployment mid-migration: one peer dials the
+        framework RPC wire, another dials the thrift wire — BOTH
+        against the same advertised port of a dual-stack server
+        (reference dual-transport pattern, KvStore.cpp:2940-2973)."""
+        from openr_tpu.kvstore.dualstack import DualStackPeerServer
+        from openr_tpu.kvstore.transport import TcpPeerTransport
+
+        hub = KvStoreWrapper("hub")
+        rpc_peer = KvStoreWrapper("rpc-peer")
+        thrift_peer = KvStoreWrapper("thrift-peer")
+        for w in (hub, rpc_peer, thrift_peer):
+            w.start()
+        server = DualStackPeerServer(hub.store, host="127.0.0.1")
+        server.start()
+        try:
+            hub.set_key("hub:k", b"v")
+            rpc_peer.store.add_peer(
+                "0", "hub", TcpPeerTransport("127.0.0.1", server.port)
+            )
+            thrift_peer.store.add_peer(
+                "0", "hub", ThriftPeerTransport("127.0.0.1", server.port)
+            )
+            for w in (rpc_peer, thrift_peer):
+                assert wait_until(
+                    lambda w=w: w.get_key("hub:k") is not None
+                ), w.store.node_id
+                assert w.get_key("hub:k").value == b"v"
+        finally:
+            server.stop()
+            for w in (hub, rpc_peer, thrift_peer):
+                w.stop()
