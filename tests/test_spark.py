@@ -431,3 +431,69 @@ class TestThriftWire:
         assert m.kvstore_peer_port == 60002
         assert m.transport_address_v6.to_str() == "fe80::1"
         assert m.neighbor_node_name == "beta"
+
+
+class TestThriftWireVersionFloor:
+    def test_below_floor_hello_rejected(self):
+        """A hello advertising a protocol version below the reference's
+        date-coded floor must be dropped by the version check (the
+        decode maps below-floor to 0 < LOWEST_SUPPORTED_VERSION)."""
+        from openr_tpu.spark import thrift_wire
+        from openr_tpu.utils import thrift_compact as tc
+
+        # craft a below-floor hello directly on the wire
+        raw = tc.encode(
+            thrift_wire.SPARK_HELLO_PACKET,
+            {
+                "helloMsg": {
+                    "domainName": "",
+                    "nodeName": "old-node",
+                    "ifName": "eth0",
+                    "seqNum": 1,
+                    "neighborInfos": {},
+                    "version": 20190101,  # below 20200604
+                    "solicitResponse": False,
+                    "restarting": False,
+                    "sentTsInUs": 0,
+                }
+            },
+        )
+        pkt = thrift_wire.decode_packet(raw)
+        assert pkt.version < Spark.LOWEST_SUPPORTED_VERSION
+
+        h = SparkHarness()
+        try:
+            spark = h.add_node("vf", ["if_vf"])
+            before = spark.counters["spark.invalid_version"]
+            # inject the raw packet as if received on the wire
+            spark.evb.call_and_wait(
+                lambda: spark._process_packet("if_vf", raw)
+            )
+            assert (
+                spark.counters["spark.invalid_version"] == before + 1
+            )
+        finally:
+            h.stop()
+
+    def test_at_floor_hello_accepted(self):
+        from openr_tpu.spark import thrift_wire
+
+        h = SparkHarness()
+        try:
+            spark = h.add_node("vf2", ["if_vf2"])
+            from openr_tpu.types.spark import SparkHelloMsg, SparkPacket
+
+            raw = thrift_wire.encode_packet(
+                SparkPacket(
+                    hello=SparkHelloMsg(
+                        node_name="peer", if_name="eth1", seq_num=1
+                    )
+                )
+            )
+            before = spark.counters["spark.hello_recv"]
+            spark.evb.call_and_wait(
+                lambda: spark._process_packet("if_vf2", raw)
+            )
+            assert spark.counters["spark.hello_recv"] == before + 1
+        finally:
+            h.stop()

@@ -248,3 +248,78 @@ class TestThriftWirePeering:
             for server in servers.values():
                 server.stop()
             io.stop()
+
+
+class TestMixedWireMigration:
+    """The wire-migration story end to end: one daemon dials the thrift
+    wire, the other dials the framework wire, BOTH serve dual-stack on
+    one advertised port (the main() deployment shape) — adjacency
+    forms over the mock LAN, KvStores sync over mismatched dials, and
+    routes converge."""
+
+    def test_mixed_dials_converge(self):
+        from openr_tpu.kvstore.dualstack import DualStackPeerServer
+        from openr_tpu.kvstore.thrift_peer import ThriftPeerTransport
+        from openr_tpu.kvstore.transport import TcpPeerTransport
+
+        io = MockIoProvider()
+        nodes = {}
+        servers = {}
+
+        def mk_factory(use_thrift):
+            def factory(nbr):
+                if nbr.kvstore_peer_port <= 0:
+                    return None
+                cls = (
+                    ThriftPeerTransport if use_thrift else TcpPeerTransport
+                )
+                return cls("127.0.0.1", nbr.kvstore_peer_port)
+
+            return factory
+
+        for idx, (name, use_thrift) in enumerate(
+            (("mwa", True), ("mwb", False))
+        ):
+            node = OpenrNode(
+                name,
+                io,
+                node_registry={},
+                v6_addr=f"fe80::{idx + 1}",
+                spark_config=SPARK_FAST,
+                peer_transport_factory=mk_factory(use_thrift),
+            )
+            server = DualStackPeerServer(node.kvstore, host="127.0.0.1")
+            server.start()
+            node.spark.set_kvstore_peer_port(server.port)
+            nodes[name] = node
+            servers[name] = server
+
+        try:
+            for node in nodes.values():
+                node.start()
+            io.connect_pair("if_mwa_mwb", "if_mwb_mwa", 1)
+            nodes["mwa"].add_interface("if_mwa_mwb")
+            nodes["mwb"].add_interface("if_mwb_mwa")
+            pfx = nodes["mwa"].advertise_loopback("fd00:ab::1/128")
+
+            def has_route():
+                db = nodes["mwb"].get_fib_routes()
+                return any(r.dest == pfx for r in db.unicast_routes)
+
+            assert wait_until(has_route, timeout=15.0)
+            # and the reverse direction (framework-dial side originates)
+            pfx_b = nodes["mwb"].advertise_loopback("fd00:ba::1/128")
+
+            def has_route_back():
+                db = nodes["mwa"].get_fib_routes()
+                return any(
+                    r.dest == pfx_b for r in db.unicast_routes
+                )
+
+            assert wait_until(has_route_back, timeout=15.0)
+        finally:
+            for node in nodes.values():
+                node.stop()
+            for server in servers.values():
+                server.stop()
+            io.stop()
