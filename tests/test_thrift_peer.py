@@ -257,3 +257,50 @@ class TestDualStackPeerServer:
             server.stop()
             for w in (hub, rpc_peer, thrift_peer):
                 w.stop()
+
+
+class TestDualStackConcurrency:
+    def test_mixed_wire_hammer(self):
+        """16 concurrent clients, half per wire, hammering the same
+        dual-stack port: every call lands on the right backend and no
+        connection wedges (smoke for the per-connection sniff +
+        serve_connection dispatch under contention)."""
+        import concurrent.futures
+
+        from openr_tpu.kvstore.dualstack import DualStackPeerServer
+        from openr_tpu.kvstore.transport import TcpPeerTransport
+        from openr_tpu.types import KeyDumpParams
+
+        hub = KvStoreWrapper("hammer-hub")
+        hub.start()
+        server = DualStackPeerServer(hub.store, host="127.0.0.1")
+        server.start()
+        try:
+            for i in range(20):
+                hub.set_key(f"hammer:{i:02d}", bytes([i]))
+
+            def worker(i):
+                cls = (
+                    ThriftPeerTransport if i % 2 else TcpPeerTransport
+                )
+                client = cls("127.0.0.1", server.port)
+                try:
+                    total = 0
+                    for _ in range(10):
+                        pub = client.get_key_vals_filtered(
+                            "0", KeyDumpParams(prefix="hammer:")
+                        )
+                        assert len(pub.key_vals) == 20
+                        total += len(pub.key_vals)
+                    return total
+                finally:
+                    close = getattr(client, "close", None)
+                    if close:
+                        close()
+
+            with concurrent.futures.ThreadPoolExecutor(16) as pool:
+                results = list(pool.map(worker, range(16)))
+            assert results == [200] * 16
+        finally:
+            server.stop()
+            hub.stop()
