@@ -28,6 +28,23 @@ def pin_host_cpu(n_devices: int | None = None) -> None:
     ``xla_force_host_platform_device_count`` flag so callers get the count
     they asked for.
     """
+    # Deactivate the relay plugin BEFORE jax initializes any backend:
+    # the ambient site hook registers a tunneled PJRT plugin whose
+    # INITIALIZATION (not registration) dials the relay and has been
+    # observed to hang indefinitely when the relay is down — even with
+    # JAX_PLATFORMS=cpu, backend discovery touched it. The plugin's
+    # boot code is env-driven, so dropping its knobs in this process
+    # (registration already happened at interpreter start) makes the
+    # deferred initialization a no-op and CPU pinning deterministic
+    # regardless of relay health. TPU-path callers never call this
+    # function, so the real device path is unaffected.
+    for knob in (
+        "PALLAS_AXON_POOL_IPS",
+        "PALLAS_AXON_REMOTE_COMPILE",
+        "AXON_POOL_SVC_OVERRIDE",
+        "AXON_LOOPBACK_RELAY",
+    ):
+        os.environ.pop(knob, None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     if n_devices is not None:
         flags = os.environ.get("XLA_FLAGS", "")
