@@ -28,6 +28,30 @@ from openr_tpu.models import topologies
 from openr_tpu.ops import spf_sparse
 
 
+def _chained_device_only_ms(step, readback, k: int = 4,
+                            reps: int = 5) -> float:
+    """Per-dispatch device time via K data-dependent chained dispatches
+    against ONE readback: the fixed transport cost (the ~70ms axon
+    relay RTT) cancels in (T_K - T_1) / (K - 1). ``step(prev)`` issues
+    the next dispatch (prev is None on the first); ``readback(result)``
+    forces one device->host sync. Shared by every bench in this module
+    — the methodology must stay identical across benches."""
+    import statistics
+
+    def time_chain(kk: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(kk):
+            out = step(out)
+        readback(out)
+        return (time.perf_counter() - t0) * 1000.0
+
+    time_chain(1)  # warm any K=1 cache path
+    t1 = statistics.median(time_chain(1) for _ in range(reps))
+    tk = statistics.median(time_chain(k) for _ in range(reps))
+    return round(max(0.0, (tk - t1) / (k - 1)), 3)
+
+
 def churn_bench(nodes: int, churn_events: int) -> dict:
     """Incremental reconvergence under link-flap churn at ``nodes`` scale
     (BASELINE.json config 4) over the resident ELL graph: per event the
@@ -94,25 +118,14 @@ def churn_bench(nodes: int, churn_events: int) -> dict:
         t0 = time.perf_counter()
         reconverge(affected)
         samples.append((time.perf_counter() - t0) * 1000)
-    # Device-only per-dispatch time: chain K solves with ONE readback and
-    # subtract the 1-dispatch+readback time — the fixed transport cost
-    # (the ~69ms axon relay RTT) cancels (same approach as bench.py).
     import jax
 
     platform = jax.devices()[0].platform
-
-    def time_chain(k: int) -> float:
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(k):
-            out = state.reconverge(state.graph, srcs)
-        np.asarray(out)
-        return (time.perf_counter() - t0) * 1000.0
-
-    time_chain(1)  # warm any K=1 cache path
-    t1 = statistics.median(time_chain(1) for _ in range(5))
-    tk = statistics.median(time_chain(8) for _ in range(5))
-    device_only = round(max(0.0, (tk - t1) / 7.0), 3)
+    device_only = _chained_device_only_ms(
+        lambda _prev: state.reconverge(state.graph, srcs),
+        np.asarray,
+        k=8,
+    )
     return {
         "bench": f"scale.ell_churn_reconverge_{graph.n}_nodes",
         "events": churn_events,
@@ -267,21 +280,13 @@ def all_sources_bench(
     device_only_block_ms = None
     if platform != "cpu":
         ids0 = np.arange(block, dtype=np.int32)
-
-        def time_chain(k: int) -> float:
-            t0 = time.perf_counter()
-            d = None
-            for i in range(k):
-                # data dependence: seed block i from block i-1's result
-                ids = ids0 if d is None else (ids0 + d[0, 0] % n) % n
-                d = solve_block(ids)
-            np.asarray(d[0, 0])
-            return (time.perf_counter() - t0) * 1000.0
-
-        time_chain(1)
-        t1 = statistics.median(time_chain(1) for _ in range(5))
-        tk = statistics.median(time_chain(4) for _ in range(5))
-        device_only_block_ms = round(max(0.0, (tk - t1) / 3.0), 3)
+        device_only_block_ms = _chained_device_only_ms(
+            # data dependence: seed block i from block i-1's result
+            lambda d: solve_block(
+                ids0 if d is None else (ids0 + d[0, 0] % n) % n
+            ),
+            lambda d: np.asarray(d[0, 0]),
+        )
 
     # e2e streaming sweep: solve + read back every block ([N, N] int32
     # product on the host at the end — transfer-dominated on the relay)
@@ -349,6 +354,128 @@ def all_sources_bench(
     return out
 
 
+def route_sweep_bench(
+    nodes: int, block: int, max_blocks: int = 0
+) -> dict:
+    """All-sources sweep with route selection CONSUMED ON-DEVICE
+    (ops.route_sweep): per destination block the device computes every
+    source's per-destination metric + ECMP next-hop mask, reads back
+    only digests + sampled route rows. This is the transfer-fixed
+    version of the config-5 axis — e2e tracks device compute instead of
+    the [N, N] readback (414 MB at 10k, 40 GB at 100k).
+
+    Oracle: sampled nodes' full route tables vs the host Dijkstra
+    (reference runSpf / getNextHopsWithMetric semantics)."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.ops import route_sweep
+    from openr_tpu.ops.spf import INF
+
+    topo = topologies.fat_tree_nodes(nodes)
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    platform = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    graph = route_sweep.compile_out_ell(ls)
+    # one sample per tier: a rack, a fabric and a spine switch see
+    # different band shapes and ECMP fanouts
+    samples = []
+    for prefix in ("rsw", "fsw", "ssw"):
+        nm = next(
+            (k for k in graph.node_names if k.startswith(prefix)), None
+        )
+        if nm is not None:
+            samples.append(nm)
+    sweeper = route_sweep.RouteSweeper(graph, samples)
+    compile_ms = (time.perf_counter() - t0) * 1000
+    edges = int(sum((w < INF).sum() for w in graph.w))
+
+    n = graph.n_pad
+    ids0 = np.arange(block, dtype=np.int32)
+    np.asarray(sweeper.solve_block(ids0))  # jit warm-up
+
+    # device-only per-block via K data-dependent chained dispatches
+    # against one readback (fixed relay transport cancels)
+    device_only_block_ms = None
+    if platform != "cpu":
+        ids0_dev = jnp.asarray(ids0)
+        device_only_block_ms = _chained_device_only_ms(
+            lambda p: sweeper.solve_block(
+                ids0_dev if p is None else (ids0 + p[0, 1] % n) % n
+            ),
+            lambda p: np.asarray(p[0, 0]),
+        )
+
+    # e2e sweep: every destination block solved AND route-selected on
+    # device; the host receives digests + sampled route rows only
+    n_sweep = min(n, max_blocks * block) if max_blocks > 0 else n
+    t0 = time.perf_counter()
+    if max_blocks > 0:
+        # partial sweep: first K blocks through the same path, id
+        # uploads up front in one async burst (same discipline as
+        # sweep(); a per-block upload would serialize a relay RTT)
+        blocks = [
+            jnp.asarray(
+                np.arange(start, start + block, dtype=np.int32) % n
+            )
+            for start in range(0, n_sweep, block)
+        ]
+        total = 0
+        for ids in blocks:
+            packed = np.asarray(sweeper.solve_block(ids))
+            total += int(packed[:, 1].sum())
+        result = None
+    else:
+        result = sweeper.sweep(block=block)
+    e2e_ms = (time.perf_counter() - t0) * 1000
+
+    out = {
+        "bench": f"scale.route_sweep_{graph.n}_nodes",
+        "kernel": "ell_route_sweep",
+        "edges": edges,
+        "edge_compile_ms": round(compile_ms, 1),
+        "e2e_ms": round(e2e_ms, 1),
+        "source_block": block,
+        "swept_blocks": -(-n_sweep // block),
+        "total_blocks": -(-n // block),
+        "samples": samples,
+        "platform": platform,
+        # readback per block: digest + nh_total + S metrics + S masks
+        "readback_kb": round(
+            n_sweep * (2 + len(samples) * (1 + sweeper.samp_v.shape[1] // 32))
+            * 4 / 1024, 1
+        ),
+    }
+    if device_only_block_ms is not None:
+        out["device_only_block_ms"] = device_only_block_ms
+        out["device_only_all_sources_ms"] = round(
+            device_only_block_ms * (-(-n // block)), 1
+        )
+    if result is not None:
+        # oracle gate: every sample node's complete route table
+        for nm in samples:
+            got = result.routes_from(nm)
+            oracle = ls.run_spf(nm)
+            for dst in list(graph.node_names)[:: max(1, graph.n // 50)]:
+                if dst == nm:
+                    continue
+                want = oracle.get(dst)
+                if want is None:
+                    assert dst not in got, (nm, dst)
+                    continue
+                g_metric, g_nhs = got[dst]
+                assert g_metric == want.metric, (nm, dst)
+                assert g_nhs == set(want.next_hops), (nm, dst)
+        out["oracle_spot_check"] = "passed"
+        out["route_rows_total"] = int(result.nh_totals[: graph.n].sum())
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)
@@ -361,9 +488,22 @@ def main(argv=None):
                    help="run the incremental ELL churn scenario instead "
                         "of all-sources")
     p.add_argument("--churn-events", type=int, default=10)
+    p.add_argument("--routes", action="store_true",
+                   help="all-sources sweep with on-device route "
+                        "selection (digest + sample readback only)")
     args = p.parse_args(argv)
     if args.churn:
         run_churn(args)
+        return
+    if args.routes:
+        print(
+            json.dumps(
+                route_sweep_bench(
+                    args.nodes, args.block, max_blocks=args.max_blocks
+                )
+            ),
+            flush=True,
+        )
         return
     print(
         json.dumps(

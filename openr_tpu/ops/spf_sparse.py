@@ -258,6 +258,10 @@ class EllGraph:
     # band index -> band-local changed row ids, set by ell_patch so
     # EllState.reconverge scatters only those rows; None == full graph
     changed: Optional[Dict[int, np.ndarray]] = None
+    # "in": row j holds edges INTO j (the forward-relax layout);
+    # "out": row j holds edges OUT of j (the reversed-graph layout the
+    # destination-major route sweep relaxes over)
+    direction: str = "in"
 
 
 def _in_edges(ls, name, index) -> Dict[int, int]:
@@ -276,6 +280,25 @@ def _in_edges(ls, name, index) -> Dict[int, int]:
     return best
 
 
+def _out_edges(ls, name, index) -> Dict[int, int]:
+    """dst id -> min forward-direction metric (parallel links: min).
+    Row ``name`` of an out-ELL graph holds (dst, w(name -> dst)) — the
+    in-edge bands of the REVERSED graph, which is what the
+    destination-major route sweep (ops.route_sweep) relaxes over."""
+    best: Dict[int, int] = {}
+    for link in ls.ordered_links_from_node(name):
+        if not link.is_up():
+            continue
+        other = link.other_node(name)
+        i = index.get(other)
+        if i is None:
+            continue
+        m = min(int(link.metric_from(name)), int(INF) - 1)
+        if i not in best or m < best[i]:
+            best[i] = m
+    return best
+
+
 def _fill_row(src_row, w_row, edges) -> None:
     for slot, (i, m) in enumerate(sorted(edges.items())):
         src_row[slot] = i
@@ -289,13 +312,17 @@ def _band_of(graph: EllGraph, node_id: int) -> Tuple[int, EllBand]:
     raise KeyError(node_id)
 
 
-def compile_ell(ls, align: int = _NODE_PAD) -> EllGraph:
+def compile_ell(ls, align: int = _NODE_PAD,
+                direction: str = "in") -> EllGraph:
     """Sliced-ELL compilation from the LinkState: O(E) host work and
-    O(E) total slots, no dense matrix."""
+    O(E) total slots, no dense matrix. ``direction="out"`` builds the
+    reversed-graph bands (row j = out-edges of j) consumed by
+    ops.route_sweep."""
+    edges_of = _in_edges if direction == "in" else _out_edges
     raw_names = sorted(ls.get_adjacency_databases().keys())
     raw_index = {name: i for i, name in enumerate(raw_names)}
     degree = {
-        name: max(1, len(_in_edges(ls, name, raw_index)))
+        name: max(1, len(edges_of(ls, name, raw_index)))
         for name in raw_names
     }
     # class id = padded power-of-two >= degree; group by (class, name)
@@ -328,7 +355,7 @@ def compile_ell(ls, align: int = _NODE_PAD) -> EllGraph:
         )  # self-loop padding: inert with w=INF
         w_b = np.full((rows, k), INF, dtype=np.int32)
         for r, name in enumerate(names[i:j]):
-            _fill_row(src_b[r], w_b[r], _in_edges(ls, name, index))
+            _fill_row(src_b[r], w_b[r], edges_of(ls, name, index))
         bands.append(EllBand(start=i, rows=rows, k=k))
         srcs.append(src_b)
         ws.append(w_b)
@@ -338,7 +365,7 @@ def compile_ell(ls, align: int = _NODE_PAD) -> EllGraph:
     return EllGraph(
         node_names=names, node_index=index, n=n, n_pad=n_pad,
         bands=tuple(bands), src=tuple(srcs), w=tuple(ws),
-        overloaded=overloaded,
+        overloaded=overloaded, direction=direction,
     )
 
 
@@ -352,6 +379,7 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         nm not in graph.node_index for nm in names
     ):
         return None
+    edges_of = _in_edges if graph.direction == "in" else _out_edges
     src = list(graph.src)
     w = list(graph.w)
     overloaded = graph.overloaded.copy()
@@ -361,7 +389,7 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         i = graph.node_index.get(name)
         if i is None:
             return None
-        edges = _in_edges(ls, name, graph.node_index)
+        edges = edges_of(ls, name, graph.node_index)
         bi, band = _band_of(graph, i)
         if len(edges) > band.k:
             return None
@@ -381,6 +409,7 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         src=tuple(src), w=tuple(w), overloaded=overloaded,
         changed={bi: np.asarray(sorted(rs), dtype=np.int32)
                  for bi, rs in changed.items()},
+        direction=graph.direction,
     )
 
 

@@ -1,0 +1,188 @@
+"""Healthy-relay watcher: capture on-chip bench evidence whenever the
+TPU relay is up.
+
+The axon relay serving the one real TPU chip has died mid-round in
+every round so far (see BENCH_r03.json relay_outage_note). The official
+end-of-round ``bench.py`` run can therefore degrade to a CPU fallback
+through no fault of the framework. This watcher closes the evidence
+gap: it probes the relay on a fixed cadence and, inside any healthy
+window, re-runs the OFFICIAL bench command and preserves the parsed
+result as ``BENCH_r{N}_midround.json`` — the exact artifact
+``bench.py`` embeds as ``last_known_tpu`` when it has to fall back.
+
+It also runs the scale benches (10k all-sources ELL + fabric-1008 KSP2
+churn) and appends them, timestamped, to ``SCALE_r{N}_captures.jsonl``
+so the freshest on-chip scale numbers survive an outage too.
+
+Run (backgrounded, from the repo root):
+    python tools/tpu_watcher.py --round 4 &
+
+Everything is subprocess-isolated under hard timeouts — the relay has
+hung jax.devices() itself before — so the watcher never wedges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_TIMEOUT_S = 90
+PROBE_PERIOD_S = 240
+# a capture is "fresh enough" for this long; afterwards a healthy probe
+# triggers a re-capture so the preserved artifact tracks the newest code
+CAPTURE_TTL_S = 45 * 60
+BENCH_TIMEOUT_S = 1500
+SCALE_TIMEOUT_S = 1800
+
+
+def log(msg: str) -> None:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    print(f"[{stamp}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np\n"
+        "d = jax.devices()[0]\n"
+        "x = jnp.ones((8, 8), jnp.float32)\n"
+        "assert float(np.asarray(x @ x).sum()) == 512.0\n"
+        "print('PLATFORM=' + d.platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    out = proc.stdout.decode(errors="replace")
+    return any(
+        line.startswith("PLATFORM=") and line.split("=", 1)[1] != "cpu"
+        for line in out.splitlines()
+    )
+
+
+def run_json(cmd: list[str], timeout_s: int):
+    """Run a bench command, return its last JSON line (or None)."""
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"timed out: {' '.join(cmd)}")
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    log(f"no JSON from: {' '.join(cmd)} rc={proc.returncode}")
+    return None
+
+
+def capture(round_no: int) -> bool:
+    """One full capture: official bench + scale legs. True on success."""
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    result = run_json([sys.executable, "bench.py"], BENCH_TIMEOUT_S)
+    ok = (
+        result is not None
+        and result.get("error") is None
+        and result.get("platform") == "tpu"
+    )
+    if ok:
+        out = {
+            "note": (
+                "Self-captured run of the official bench.py (identical "
+                "format/command) while the axon relay was healthy, "
+                f"{stamp}. Preserved by tools/tpu_watcher.py so a later "
+                "relay outage cannot erase the round's on-chip evidence: "
+                "bench.py embeds this file as last_known_tpu when it has "
+                "to fall back to CPU."
+            ),
+            "utc": stamp,
+            "result": result,
+        }
+        path = os.path.join(REPO, f"BENCH_r{round_no:02d}_midround.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f, indent=2)
+        os.replace(tmp, path)
+        log(f"captured {path} (value={result.get('value')}ms)")
+    else:
+        log(f"bench.py capture not usable: {result and result.get('platform')}")
+
+    # scale legs: freshest on-chip numbers for SCALE_r{N}.json
+    scale_path = os.path.join(
+        REPO, f"SCALE_r{round_no:02d}_captures.jsonl"
+    )
+    legs = [
+        (
+            "all_sources_10k",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--nodes", "10000", "--kernel", "ell"],
+        ),
+        (
+            "ksp2_churn_1008",
+            [sys.executable, "-c",
+             "import json; from benchmarks.bench_scale import "
+             "ksp2_churn_bench; print(json.dumps("
+             "ksp2_churn_bench(1000, 10)))"],
+        ),
+    ]
+    for name, cmd in legs:
+        r = run_json(cmd, SCALE_TIMEOUT_S)
+        if r is not None:
+            with open(scale_path, "a") as f:
+                f.write(json.dumps(
+                    {"leg": name, "utc": stamp, "result": r}
+                ) + "\n")
+            log(f"scale leg {name}: {r.get('platform')}")
+        if not probe():
+            log("relay lost mid-capture; stopping scale legs")
+            return ok
+    return ok
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, required=True)
+    p.add_argument("--once", action="store_true",
+                   help="single probe+capture attempt, then exit")
+    args = p.parse_args()
+    last_capture = 0.0
+    last_attempt = 0.0
+    retry_backoff_s = 15 * 60  # failed capture: don't hammer the relay
+    while True:
+        healthy = probe()
+        if healthy:
+            due = time.time() - last_capture > CAPTURE_TTL_S
+            cooled = time.time() - last_attempt > retry_backoff_s
+            if due and cooled:
+                log("relay healthy; capturing")
+                last_attempt = time.time()
+                if capture(args.round):
+                    last_capture = time.time()
+            else:
+                log("relay healthy; capture fresh or cooling down")
+        else:
+            log("relay down")
+        if args.once:
+            break
+        time.sleep(PROBE_PERIOD_S)
+
+
+if __name__ == "__main__":
+    main()
