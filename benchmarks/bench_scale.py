@@ -355,7 +355,8 @@ def all_sources_bench(
 
 
 def route_sweep_bench(
-    nodes: int, block: int, max_blocks: int = 0
+    nodes: int, block: int, max_blocks: int = 0,
+    backend: str = "ell",
 ) -> dict:
     """All-sources sweep with route selection CONSUMED ON-DEVICE
     (ops.route_sweep): per destination block the device computes every
@@ -381,7 +382,12 @@ def route_sweep_bench(
     platform = jax.devices()[0].platform
 
     t0 = time.perf_counter()
-    graph = route_sweep.compile_out_ell(ls)
+    if backend == "grouped":
+        from openr_tpu.ops import spf_grouped
+
+        graph = spf_grouped.compile_out_grouped(ls)
+    else:
+        graph = route_sweep.compile_out_ell(ls)
     # one sample per tier: a rack, a fabric and a spine switch see
     # different band shapes and ECMP fanouts
     samples = []
@@ -391,9 +397,16 @@ def route_sweep_bench(
         )
         if nm is not None:
             samples.append(nm)
-    sweeper = route_sweep.RouteSweeper(graph, samples)
+    if backend == "grouped":
+        sweeper = spf_grouped.GroupedRouteSweeper(graph, samples)
+        edges = int(sum(
+            (seg.w < INF).sum()
+            for band in graph.bands for seg in band.segments
+        ))
+    else:
+        sweeper = route_sweep.RouteSweeper(graph, samples)
+        edges = int(sum((w < INF).sum() for w in graph.w))
     compile_ms = (time.perf_counter() - t0) * 1000
-    edges = int(sum((w < INF).sum() for w in graph.w))
 
     n = graph.n_pad
     ids0 = np.arange(block, dtype=np.int32)
@@ -402,14 +415,56 @@ def route_sweep_bench(
     # device-only per-block via K data-dependent chained dispatches
     # against one readback (fixed relay transport cancels)
     device_only_block_ms = None
+    impl_ms = None
     if platform != "cpu":
         ids0_dev = jnp.asarray(ids0)
-        device_only_block_ms = _chained_device_only_ms(
-            lambda p: sweeper.solve_block(
-                ids0_dev if p is None else (ids0 + p[0, 1] % n) % n
-            ),
-            lambda p: np.asarray(p[0, 0]),
-        )
+
+        def chain_ms():
+            return _chained_device_only_ms(
+                lambda p: sweeper.solve_block(
+                    ids0_dev if p is None else (ids0 + p[0, 1] % n) % n
+                ),
+                lambda p: np.asarray(p[0, 0]),
+            )
+
+        if backend == "grouped":
+            # contraction impl CHOSEN BY MEASUREMENT on real hardware
+            # (same contract as the dense min-plus path): time jnp and
+            # pallas at the bench shapes, run the winner, keep both
+            # numbers in the artifact
+            from openr_tpu.ops import spf_grouped
+
+            impl_ms = {}
+            ref = None
+            for impl in ("jnp", "pallas"):
+                spf_grouped.set_grouped_impl(impl)
+                try:
+                    got = np.asarray(
+                        sweeper.solve_block(ids0_dev)
+                    )  # compile + parity gate vs the jnp product
+                    if ref is None:
+                        ref = got
+                    elif not np.array_equal(ref, got):
+                        raise RuntimeError("pallas/jnp divergence")
+                    impl_ms[impl] = chain_ms()
+                except Exception as e:  # pallas probe must not kill jnp
+                    impl_ms[impl] = None
+                    impl_ms[f"{impl}_error"] = (
+                        f"{type(e).__name__}: {e}"
+                    )
+            timed = [
+                (v, k) for k, v in impl_ms.items()
+                if isinstance(v, (int, float))
+            ]
+            if not timed:
+                raise RuntimeError(
+                    f"both contraction impls failed: {impl_ms}"
+                )
+            winner = min(timed)[1]
+            spf_grouped.set_grouped_impl(winner)
+            device_only_block_ms = impl_ms[winner]
+        else:
+            device_only_block_ms = chain_ms()
 
     # e2e sweep: every destination block solved AND route-selected on
     # device; the host receives digests + sampled route rows only
@@ -436,7 +491,7 @@ def route_sweep_bench(
 
     out = {
         "bench": f"scale.route_sweep_{graph.n}_nodes",
-        "kernel": "ell_route_sweep",
+        "kernel": f"{backend}_route_sweep",
         "edges": edges,
         "edge_compile_ms": round(compile_ms, 1),
         "e2e_ms": round(e2e_ms, 1),
@@ -456,6 +511,11 @@ def route_sweep_bench(
         out["device_only_all_sources_ms"] = round(
             device_only_block_ms * (-(-n // block)), 1
         )
+    if impl_ms is not None:
+        out["impl_ms"] = impl_ms
+        from openr_tpu.ops import spf_grouped
+
+        out["impl"] = spf_grouped.get_grouped_impl()
     if result is not None:
         # oracle gate: every sample node's complete route table
         for nm in samples:
@@ -491,6 +551,10 @@ def main(argv=None):
     p.add_argument("--routes", action="store_true",
                    help="all-sources sweep with on-device route "
                         "selection (digest + sample readback only)")
+    p.add_argument("--backend", choices=("ell", "grouped"),
+                   default="ell",
+                   help="route-sweep relaxation backend: per-edge ELL "
+                        "gather, or block-bipartite grouped (dense)")
     args = p.parse_args(argv)
     if args.churn:
         run_churn(args)
@@ -499,7 +563,8 @@ def main(argv=None):
         print(
             json.dumps(
                 route_sweep_bench(
-                    args.nodes, args.block, max_blocks=args.max_blocks
+                    args.nodes, args.block, max_blocks=args.max_blocks,
+                    backend=args.backend,
                 )
             ),
             flush=True,

@@ -154,26 +154,48 @@ def _nh_counts(dr, bands, v_t, w_t, overloaded, t_ids):
     return jnp.concatenate(parts, axis=1)
 
 
-def _digest_rows(dr, nh_count, n):
+def canonical_pos_weights(graph: EllGraph) -> np.ndarray:
+    """Per-column digest weights keyed by CANONICAL (name-rank) node
+    order, so two graphs over the same node set produce comparable
+    digests regardless of their internal band renumbering — the digest
+    is a cross-kernel/cross-layout equality witness. Padding columns
+    get weight 0 (their content is layout-specific)."""
+    n_pad = graph.n_pad
+    order = np.argsort(np.asarray(graph.node_names))
+    ranks = np.empty(len(order), dtype=np.uint32)
+    ranks[order] = np.arange(len(order), dtype=np.uint32)
+    pos = np.zeros(n_pad, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        pos[: len(ranks)] = (
+            ranks * _DIGEST_MULT_C + np.uint32(1)
+        ) * _DIGEST_POS_A ^ _DIGEST_POS_B
+    return pos
+
+
+def _digest_rows(dr, nh_count, pos_w):
     """Position-sensitive uint32 fold of (distance, nh count) per row.
     Pure int mixing — wraparound adds/multiplies are deterministic on
-    every backend, so the digest is a cross-kernel equality witness."""
-    pos_w = (
-        jnp.arange(n, dtype=jnp.uint32) * _DIGEST_MULT_C + jnp.uint32(1)
-    ) * _DIGEST_POS_A ^ _DIGEST_POS_B
+    every backend. ``pos_w`` carries the canonical column weights."""
     v = dr.astype(jnp.uint32) * _DIGEST_MULT_D + (
         nh_count.astype(jnp.uint32) + jnp.uint32(0x85EBCA6B)
     )
     return jnp.sum(v * pos_w[None, :], axis=1, dtype=jnp.uint32)
 
 
-def host_digest(d_rows: np.ndarray, nh_counts: np.ndarray) -> np.ndarray:
-    """NumPy replica of the device digest (oracle for tests)."""
+def host_digest(
+    d_rows: np.ndarray, nh_counts: np.ndarray,
+    pos_w: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """NumPy replica of the device digest (oracle for tests). When
+    ``pos_w`` is omitted the columns are assumed to already be in
+    canonical name-rank order."""
     n = d_rows.shape[1]
     with np.errstate(over="ignore"):
-        pos_w = (
-            np.arange(n, dtype=np.uint32) * _DIGEST_MULT_C + np.uint32(1)
-        ) * _DIGEST_POS_A ^ _DIGEST_POS_B
+        if pos_w is None:
+            pos_w = (
+                np.arange(n, dtype=np.uint32) * _DIGEST_MULT_C
+                + np.uint32(1)
+            ) * _DIGEST_POS_A ^ _DIGEST_POS_B
         v = d_rows.astype(np.uint32) * _DIGEST_MULT_D + (
             nh_counts.astype(np.uint32) + np.uint32(0x85EBCA6B)
         )
@@ -209,7 +231,7 @@ def _sample_stats(dr, samp_ids, samp_v, samp_w, overloaded, t_ids):
 
 
 def _route_block_body(v_t, w_t, overloaded, t_ids, samp_ids, samp_v,
-                      samp_w, bands, n, vote=None):
+                      samp_w, pos_w, bands, n, vote=None):
     """Fixed point + on-device route selection for one destination
     block, packed into a single int32 array [B, W] so the block costs
     exactly ONE device->host transfer:
@@ -222,7 +244,7 @@ def _route_block_body(v_t, w_t, overloaded, t_ids, samp_ids, samp_v,
     ``vote`` lifts the convergence bit for the sharded variant."""
     dr = _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=vote)
     nh_count = _nh_counts(dr, bands, v_t, w_t, overloaded, t_ids)
-    digest = _digest_rows(dr, nh_count, n)
+    digest = _digest_rows(dr, nh_count, pos_w)
     nh_total = jnp.sum(nh_count, axis=1, dtype=jnp.int32)
     d_s, packed_mask = _sample_stats(
         dr, samp_ids, samp_v, samp_w, overloaded, t_ids
@@ -243,9 +265,10 @@ def _route_block_body(v_t, w_t, overloaded, t_ids, samp_ids, samp_v,
 
 @functools.partial(jax.jit, static_argnames=("bands", "n"))
 def _route_block(v_t, w_t, overloaded, t_ids, samp_ids, samp_v, samp_w,
-                 bands, n):
+                 pos_w, bands, n):
     return _route_block_body(
-        v_t, w_t, overloaded, t_ids, samp_ids, samp_v, samp_w, bands, n
+        v_t, w_t, overloaded, t_ids, samp_ids, samp_v, samp_w, pos_w,
+        bands, n
     )
 
 
@@ -301,17 +324,13 @@ class RouteSweepResult:
         return out
 
 
-def _sample_bands(graph: EllGraph, sample_ids: Sequence[int]):
-    """Gather the sample nodes' out-edge rows into one [S, K] pair,
-    K padded to a multiple of 32 (the nh masks pack into uint32)."""
-    from openr_tpu.ops.spf_sparse import _band_of
-
-    rows = []
-    for sid in sample_ids:
-        bi, band = _band_of(graph, int(sid))
-        r = int(sid) - band.start
-        rows.append((graph.src[bi][r], graph.w[bi][r]))
-    k_max = max(len(v) for v, _ in rows)
+def pack_sample_rows(rows, sample_ids):
+    """Pack per-sample (neighbor ids, metrics) rows into one [S, K]
+    pair, K padded to a multiple of 32 (the nh masks pack into uint32
+    words; RouteSweepResult.routes_from decodes this exact layout).
+    Shared by every sweep backend so the packing contract has one
+    home."""
+    k_max = max(1, max(len(v) for v, _ in rows))
     k_pad = max(32, ((k_max + 31) // 32) * 32)
     s = len(rows)
     samp_v = np.zeros((s, k_pad), dtype=np.int32)
@@ -321,6 +340,21 @@ def _sample_bands(graph: EllGraph, sample_ids: Sequence[int]):
         samp_v[x, len(v):] = sample_ids[x]  # inert self-pad
         samp_w[x, : len(w)] = w
     return samp_v, samp_w
+
+
+def _sample_bands(graph: EllGraph, sample_ids: Sequence[int]):
+    """Sample nodes' out-edge rows from the ELL bands, packed."""
+    from openr_tpu.ops.spf_sparse import _band_of
+
+    rows = []
+    for sid in sample_ids:
+        bi, band = _band_of(graph, int(sid))
+        r = int(sid) - band.start
+        v_row = graph.src[bi][r]
+        w_row = graph.w[bi][r]
+        keep = w_row < INF
+        rows.append((v_row[keep], w_row[keep]))
+    return pack_sample_rows(rows, sample_ids)
 
 
 class RouteSweeper:
@@ -345,6 +379,7 @@ class RouteSweeper:
         self._samp_ids_dev = jnp.asarray(self.sample_ids)
         self._samp_v_dev = jnp.asarray(self.samp_v)
         self._samp_w_dev = jnp.asarray(self.samp_w)
+        self._pos_w_dev = jnp.asarray(canonical_pos_weights(graph))
 
     def solve_block(self, t_ids) -> jnp.ndarray:
         """One destination block -> packed [B, W] int32 (still on
@@ -353,6 +388,7 @@ class RouteSweeper:
             self.v_t, self.w_t, self.overloaded,
             _as_device_ids(t_ids),
             self._samp_ids_dev, self._samp_v_dev, self._samp_w_dev,
+            self._pos_w_dev,
             self.graph.bands, self.graph.n_pad,
         )
 
@@ -413,15 +449,16 @@ from openr_tpu.ops.spf_sparse import SOURCES_AXIS  # noqa: E402
 
 @functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
 def _sharded_route_blocks(
-    v_t, w_t, overloaded, t_ids, samp_ids, samp_v, samp_w, bands, n, mesh
+    v_t, w_t, overloaded, t_ids, samp_ids, samp_v, samp_w, pos_w, bands,
+    n, mesh
 ):
     def shard_fn(t_blk, *rest):
         nb = len(v_t)
         v_r = rest[:nb]
         w_r = rest[nb : 2 * nb]
-        ov_r, sid_r, sv_r, sw_r = rest[2 * nb :]
+        ov_r, sid_r, sv_r, sw_r, pw_r = rest[2 * nb :]
         return _route_block_body(
-            v_r, w_r, ov_r, t_blk, sid_r, sv_r, sw_r, bands, n,
+            v_r, w_r, ov_r, t_blk, sid_r, sv_r, sw_r, pw_r, bands, n,
             vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
         )
 
@@ -432,10 +469,10 @@ def _sharded_route_blocks(
         in_specs=tuple(
             [P(SOURCES_AXIS)]
             + [P(None, None)] * (2 * nb)
-            + [P(None), P(None), P(None, None), P(None, None)]
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
         ),
         out_specs=P(SOURCES_AXIS, None),
-    )(t_ids, *v_t, *w_t, overloaded, samp_ids, samp_v, samp_w)
+    )(t_ids, *v_t, *w_t, overloaded, samp_ids, samp_v, samp_w, pos_w)
 
 
 def sharded_route_sweep(
@@ -456,7 +493,7 @@ def sharded_route_sweep(
             sweeper.v_t, sweeper.w_t, sweeper.overloaded,
             jnp.asarray(np.arange(n, dtype=np.int32)),
             sweeper._samp_ids_dev, sweeper._samp_v_dev,
-            sweeper._samp_w_dev,
+            sweeper._samp_w_dev, sweeper._pos_w_dev,
             graph.bands, n, mesh,
         )
     )

@@ -1,0 +1,627 @@
+"""Block-bipartite grouped SPF kernels: gather-free relaxation on
+structured fabrics.
+
+The sliced-ELL kernels (ops.spf_sparse) spend their device time in the
+per-edge gather ``d[:, src[r, k]]`` — an irregular lane-gather that TPUs
+execute at a few elements per cycle, single-digit percent of the VPU
+roof (the round-3 measurement: 188 ms for a 1024x100k block over 800k
+edges).
+
+This module removes the big gather. Observation: in a multi-tier
+fabric, nodes overwhelmingly share in-neighbor SETS — every rack in a
+pod sees the same fabric switches, every plane-k fabric switch sees the
+same spines (reference fabric generator:
+/root/reference/openr/decision/tests/RoutingBenchmarkUtils.h:53-58).
+Nodes sharing a source set form a COMPLETE BIPARTITE BLOCK with their
+common sources, and relaxation over such a block is a small dense
+min-plus contraction:
+
+    c[b, g, r] = min_s ( d[b, src[g, s]] + w[g, s, r] )
+
+— one tiny gather per GROUP (not per node) to pull the [B, G, S] source
+table, then pure broadcast-add-min, which the VPU runs at full lane
+utilization. Per-edge work is identical (E x B adds); the irregular
+part shrinks by the group fanout (12-6000x on fat-trees).
+
+Compilation (host, O(E log E)): nodes are classed by degree (as in the
+sliced ELL), then each class band is structured by hashing every node's
+per-source-class neighbor signature:
+
+  - one source class, equal group sizes  -> grid [G, R], one segment;
+  - two source classes, both regular and their groupings form a full
+    G1 x G2 product -> grid [G1, G2], two segments (the second writes
+    transposed);
+  - anything else -> the band degrades to singleton groups (G = rows,
+    R = 1), which is exactly the ELL gather shape — unstructured graphs
+    pay what they paid before, never more.
+
+Node ids are renumbered (class, group, member) so every segment's
+output is a contiguous [B, G, R] reshape — no scatter anywhere.
+
+Both relaxation directions are provided: forward (in-edge bands,
+transit mask = edge ORIGIN overloaded — LinkState.cpp:809 runSpf with
+the :831-838 originate exception handled by an unmasked init relax) and
+reverse (out-edge bands for the destination-major route sweep, mask =
+``overloaded[v] & (v != t)`` — see ops.route_sweep).
+
+Equality with the ELL kernels is witnessed by the canonical route-sweep
+digest (route_sweep.canonical_pos_weights): same node set, same uint32
+per destination, bit-exactly, regardless of either layout's internal
+renumbering.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.ops.spf import INF
+from openr_tpu.ops.spf_sparse import (
+    _as_device_ids,
+    _in_edges,
+    _out_edges,
+    _pad_up,
+)
+
+# Relaxation contraction backend: "jnp" leaves the broadcast+min-reduce
+# to XLA's fuser; "pallas" runs ops.pallas_grouped.batched_minplus
+# (explicit VMEM tiling). Like the dense path (ops.spf minplus), the
+# bench probes both ON REAL HARDWARE and runs the winner.
+_GROUPED_IMPL = "jnp"
+
+
+def set_grouped_impl(impl: str) -> None:
+    global _GROUPED_IMPL
+    assert impl in ("jnp", "pallas"), impl
+    _GROUPED_IMPL = impl
+
+
+def get_grouped_impl() -> str:
+    return _GROUPED_IMPL
+
+
+def _contract(gath, w, impl):
+    """c[b, g, r] = min_s gath[b, g, s] + w[g, s, r] (INF-saturating).
+    The pallas path runs in interpret mode off-TPU so CPU tests cover
+    the same code path."""
+    if impl == "pallas":
+        from openr_tpu.ops import pallas_grouped
+
+        interpret = jax.devices()[0].platform == "cpu"
+        c = pallas_grouped.batched_minplus(
+            jnp.transpose(gath, (1, 0, 2)), w, interpret=interpret
+        )  # [G, B, R]
+        return jnp.transpose(c, (1, 0, 2))
+    return jnp.min(
+        jnp.minimum(gath[:, :, :, None] + w[None], INF), axis=2
+    )
+
+
+@dataclass(frozen=True)
+class Segment:
+    """One bipartite block family of a band: groups of ``R`` nodes
+    sharing ``S`` sources. ``axis=1``: group index is the grid's major
+    axis (contribution lands as [B, G1, G2] directly); ``axis=2``:
+    group index is the minor axis (contribution transposes in)."""
+
+    axis: int
+    src: np.ndarray  # [G, S] int32 source ids (pad: self-ids, w=INF)
+    w: np.ndarray  # [G, S, R] int32 edge metrics, INF padding
+
+
+@dataclass(frozen=True)
+class GridBand:
+    start: int  # first node id of the band
+    g1: int
+    g2: int  # band rows = g1 * g2; id = start + a * g2 + b
+    segments: Tuple[Segment, ...]
+
+
+@dataclass(frozen=True)
+class GroupedGraph:
+    node_names: Tuple[str, ...]  # index == node id (grid-grouped order)
+    node_index: Dict[str, int]
+    n: int
+    n_pad: int
+    bands: Tuple[GridBand, ...]
+    overloaded: np.ndarray  # [n_pad] bool
+    direction: str  # "in" (forward relax) | "out" (reverse relax)
+
+    def out_slots(self, node_id: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(neighbor ids, metrics) of this node's band row — for an
+        "out" graph these are the node's forward out-edges, the slot
+        list the route sweep's sample masks are defined over."""
+        for band in self.bands:
+            rows = band.g1 * band.g2
+            if not (band.start <= node_id < band.start + rows):
+                continue
+            local = node_id - band.start
+            a, b = divmod(local, band.g2)
+            vs: List[int] = []
+            ws: List[int] = []
+            for seg in band.segments:
+                g, r = (a, b) if seg.axis == 1 else (b, a)
+                for s in range(seg.src.shape[1]):
+                    if seg.w[g, s, r] < INF:
+                        vs.append(int(seg.src[g, s]))
+                        ws.append(int(seg.w[g, s, r]))
+            return np.asarray(vs, np.int32), np.asarray(ws, np.int32)
+        raise KeyError(node_id)
+
+
+def _signature_groups(rows: List[str], srcs_by_class, cls):
+    """Group band rows by their class-``cls`` source-set signature.
+    Returns (groups: list of lists of row names, regular: bool)."""
+    sig_map: Dict[Tuple[str, ...], List[str]] = {}
+    for nm in rows:
+        sig = tuple(sorted(srcs_by_class[nm].get(cls, {})))
+        sig_map.setdefault(sig, []).append(nm)
+    groups = [sorted(v) for v in sig_map.values()]
+    groups.sort(key=lambda g: g[0])
+    sizes = {len(g) for g in groups}
+    regular = len(sizes) == 1 and () not in sig_map
+    return groups, regular
+
+
+def compile_grouped(
+    ls, align: int = 128, direction: str = "in"
+) -> GroupedGraph:
+    """Structure-detecting compilation from the LinkState. O(E log E)
+    host work; no dense matrix anywhere."""
+    edges_of = _in_edges if direction == "in" else _out_edges
+    raw_names = sorted(ls.get_adjacency_databases().keys())
+    raw_index = {nm: i for i, nm in enumerate(raw_names)}
+    # per node: src name -> metric (direction-appropriate)
+    edges: Dict[str, Dict[str, int]] = {}
+    for nm in raw_names:
+        by_id = edges_of(ls, nm, raw_index)
+        edges[nm] = {raw_names[i]: w for i, w in by_id.items()}
+    # class = EXACT degree: finer than the ELL's pow2 classes, so that
+    # fabric tiers land in distinct bands even when their degrees share
+    # a pow2 bucket (a 3-tier fat-tree with degrees 2/3/6 must become
+    # three bands for the signature grouping to see the structure).
+    # Irregular graphs get at most O(distinct degrees) bands.
+    degree = {nm: max(1, len(edges[nm])) for nm in raw_names}
+    node_class = dict(degree)
+    # per node: src class -> {src name: metric}
+    srcs_by_class: Dict[str, Dict[int, Dict[str, int]]] = {}
+    for nm in raw_names:
+        per: Dict[int, Dict[str, int]] = {}
+        for src, w in edges[nm].items():
+            per.setdefault(node_class[src], {})[src] = w
+        srcs_by_class[nm] = per
+
+    # ---- band structuring ------------------------------------------------
+    classes = sorted({node_class[nm] for nm in raw_names})
+    band_plans = []  # (class_k, grid_names [G1][G2], seg plans)
+    for ck in classes:
+        rows = sorted(nm for nm in raw_names if node_class[nm] == ck)
+        src_classes = sorted(
+            {c for nm in rows for c in srcs_by_class[nm]}
+        )
+        plan = None
+        if len(src_classes) == 1:
+            groups, regular = _signature_groups(
+                rows, srcs_by_class, src_classes[0]
+            )
+            if regular:
+                grid = groups  # [G][R]
+                plan = (grid, [(src_classes[0], 1)])
+        elif len(src_classes) == 2:
+            c1, c2 = src_classes
+            gr1, reg1 = _signature_groups(rows, srcs_by_class, c1)
+            gr2, reg2 = _signature_groups(rows, srcs_by_class, c2)
+            if reg1 and reg2 and len(gr1) * len(gr2) == len(rows):
+                # product check: every (group1, group2) cell holds
+                # exactly one row
+                pos1 = {nm: i for i, g in enumerate(gr1) for nm in g}
+                pos2 = {nm: j for j, g in enumerate(gr2) for nm in g}
+                cells = {(pos1[nm], pos2[nm]) for nm in rows}
+                if len(cells) == len(rows):
+                    grid = [
+                        [None] * len(gr2) for _ in range(len(gr1))
+                    ]
+                    for nm in rows:
+                        grid[pos1[nm]][pos2[nm]] = nm
+                    plan = (grid, [(c1, 1), (c2, 2)])
+        if plan is None:
+            # unstructured: singleton groups, R=1 — the ELL shape
+            grid = [[nm] for nm in rows]
+            plan = (grid, None)
+        band_plans.append((ck, plan))
+
+    # ---- numbering: (class, grid-major) ---------------------------------
+    names: List[str] = []
+    for ck, (grid, _segs) in band_plans:
+        for row in grid:
+            names.extend(row)
+    names_t = tuple(names)
+    index = {nm: i for i, nm in enumerate(names_t)}
+    n = len(names_t)
+    n_pad = _pad_up(n, align)
+
+    # ---- materialize segments -------------------------------------------
+    bands: List[GridBand] = []
+    start = 0
+    for ck, (grid, seg_plan) in band_plans:
+        g1 = len(grid)
+        g2 = len(grid[0])
+        segments: List[Segment] = []
+        if seg_plan is None:
+            # one generic segment: per-node source table, R = 1
+            s_max = max(1, max(len(edges[r[0]]) for r in grid))
+            src = np.zeros((g1, s_max), dtype=np.int32)
+            w = np.full((g1, s_max, 1), INF, dtype=np.int32)
+            for g, row in enumerate(grid):
+                nm = row[0]
+                src[g, :] = index[nm]  # inert self-pad
+                for s, (sn, sw) in enumerate(
+                    sorted(edges[nm].items())
+                ):
+                    src[g, s] = index[sn]
+                    w[g, s, 0] = min(int(sw), int(INF) - 1)
+            segments.append(Segment(axis=1, src=src, w=w))
+        else:
+            for cls, axis in seg_plan:
+                if axis == 1:
+                    groups = grid  # member r at grid[g][r]
+                else:
+                    groups = [
+                        [grid[a][b] for a in range(g1)]
+                        for b in range(g2)
+                    ]
+                g_count = len(groups)
+                r_count = len(groups[0])
+                src_names = [
+                    sorted(srcs_by_class[groups[g][0]].get(cls, {}))
+                    for g in range(g_count)
+                ]
+                s_max = max(1, max(len(s) for s in src_names))
+                src = np.zeros((g_count, s_max), dtype=np.int32)
+                w = np.full(
+                    (g_count, s_max, r_count), INF, dtype=np.int32
+                )
+                for g in range(g_count):
+                    base = index[groups[g][0]]
+                    src[g, :] = base  # inert pad
+                    for s, sn in enumerate(src_names[g]):
+                        src[g, s] = index[sn]
+                        for r, nm in enumerate(groups[g]):
+                            w[g, s, r] = min(
+                                int(srcs_by_class[nm][cls][sn]),
+                                int(INF) - 1,
+                            )
+                segments.append(Segment(axis=axis, src=src, w=w))
+        bands.append(
+            GridBand(
+                start=start, g1=g1, g2=g2, segments=tuple(segments)
+            )
+        )
+        start += g1 * g2
+    assert start == n, (start, n)
+
+    overloaded = np.zeros(n_pad, dtype=bool)
+    for nm in names_t:
+        overloaded[index[nm]] = ls.is_node_overloaded(nm)
+    return GroupedGraph(
+        node_names=names_t,
+        node_index=index,
+        n=n,
+        n_pad=n_pad,
+        bands=tuple(bands),
+        overloaded=overloaded,
+        direction=direction,
+    )
+
+
+# ---- device tensors ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _BandMeta:
+    """Static (hashable) shape info for jit specialization."""
+
+    start: int
+    g1: int
+    g2: int
+    seg_axes: Tuple[int, ...]
+
+
+def band_meta(graph: GroupedGraph) -> Tuple[_BandMeta, ...]:
+    return tuple(
+        _BandMeta(
+            start=b.start,
+            g1=b.g1,
+            g2=b.g2,
+            seg_axes=tuple(s.axis for s in b.segments),
+        )
+        for b in graph.bands
+    )
+
+
+def device_tensors(graph: GroupedGraph):
+    """Flat tuples of per-segment (src, w) device arrays, in band/seg
+    order — the resident state a caller uploads once."""
+    srcs = []
+    ws = []
+    for band in graph.bands:
+        for seg in band.segments:
+            srcs.append(jnp.asarray(seg.src))
+            ws.append(jnp.asarray(seg.w))
+    return tuple(srcs), tuple(ws)
+
+
+def _grouped_relax(d, meta, srcs_t, ws_t, overloaded, t_ids,
+                   impl="jnp"):
+    """One relaxation [B, N] -> [B, N] over the grouped bands as dense
+    per-segment contractions. ``t_ids`` None => forward transit mask
+    (edge origin overloaded); else the reverse row-dependent mask
+    ``overloaded[v] & (v != t)``."""
+    parts = []
+    pos = 0
+    si = 0
+    for band in meta:
+        assert band.start == pos, (band, pos)
+        rows = band.g1 * band.g2
+        acc = d[:, pos : pos + rows]
+        for axis in band.seg_axes:
+            src = srcs_t[si]
+            w = ws_t[si]
+            si += 1
+            gath = d[:, src]  # [B, G, S] — the only gather, G-sized
+            if t_ids is None:
+                blocked = overloaded[src][None, :, :]
+            else:
+                blocked = overloaded[src][None, :, :] & (
+                    src[None, :, :] != t_ids[:, None, None]
+                )
+            gath = jnp.where(blocked, INF, gath)
+            c = _contract(gath, w, impl)  # [B, G, R]
+            if axis == 2:
+                c = jnp.transpose(c, (0, 2, 1))  # -> [B, G1, G2]
+            acc = jnp.minimum(acc, c.reshape(c.shape[0], rows))
+        parts.append(acc.astype(jnp.int32))
+        pos += rows
+    parts.append(d[:, pos:])  # padding columns
+    return jnp.concatenate(parts, axis=1)
+
+
+def _grouped_fixed_point(
+    meta, srcs_t, ws_t, overloaded, ids, n, reverse, vote=None,
+    impl="jnp",
+):
+    """Distance fixed point from unit init. ``reverse=False``: rows are
+    SOURCES (forward all-sources; init = one unmasked relax so an
+    overloaded source still originates). ``reverse=True``: rows are
+    DESTINATIONS (route-sweep orientation; the per-row mask needs no
+    init special case)."""
+    b = ids.shape[0]
+    unit = jnp.full((b, n), INF, dtype=jnp.int32)
+    unit = unit.at[jnp.arange(b), ids].set(0)
+    if reverse:
+        d0 = unit
+    else:
+        no_overload = jnp.zeros_like(overloaded)
+        d0 = _grouped_relax(
+            unit, meta, srcs_t, ws_t, no_overload, None, impl=impl
+        )
+
+    t_ids = ids if reverse else None
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed > 0, it < n)
+
+    def body(state):
+        d, _, it = state
+        nxt = _grouped_relax(
+            d, meta, srcs_t, ws_t, overloaded, t_ids, impl=impl
+        )
+        local = jnp.any(nxt < d).astype(jnp.int32)
+        return nxt, local if vote is None else vote(local), it + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
+    return d
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "n", "impl"))
+def _grouped_from_sources(srcs_t, ws_t, overloaded, ids, meta, n, impl):
+    return _grouped_fixed_point(
+        meta, srcs_t, ws_t, overloaded, ids, n, reverse=False, impl=impl
+    )
+
+
+class GroupedState:
+    """Caller-owned resident device tensors (upload once)."""
+
+    def __init__(self, graph: GroupedGraph):
+        self.graph = graph
+        self.meta = band_meta(graph)
+        self.src, self.w = device_tensors(graph)
+        self.overloaded = jnp.asarray(graph.overloaded)
+
+
+def grouped_distances_from_sources(
+    graph: GroupedGraph, src_ids, state: Optional[GroupedState] = None
+):
+    """Forward distances [S, N_pad] from a batch of sources — the
+    grouped mirror of spf_sparse.ell_distances_from_sources."""
+    st = state if state is not None else GroupedState(graph)
+    return _grouped_from_sources(
+        st.src, st.w, st.overloaded,
+        _as_device_ids(src_ids), st.meta, graph.n_pad, _GROUPED_IMPL,
+    )
+
+
+# ---- destination-major route sweep over grouped bands --------------------
+
+
+def _grouped_nh_counts(dr, meta, srcs_t, ws_t, overloaded, t_ids):
+    """Per-node ECMP next-hop slot counts [B, N] over the grouped
+    segments — the dense mirror of route_sweep._nh_counts (same
+    algebra: v is a next hop of s toward t iff
+    w(s, v) + DR[t, v] == DR[t, s], v not transit-blocked)."""
+    b = dr.shape[0]
+    parts = []
+    pos = 0
+    si = 0
+    for band in meta:
+        rows = band.g1 * band.g2
+        acc = jnp.zeros((b, rows), dtype=jnp.int32)
+        d_grid = dr[:, pos : pos + rows].reshape(b, band.g1, band.g2)
+        for axis in band.seg_axes:
+            src = srcs_t[si]
+            w = ws_t[si]
+            si += 1
+            d_g = d_grid if axis == 1 else jnp.transpose(
+                d_grid, (0, 2, 1)
+            )  # [B, G, R]
+            gath = dr[:, src]  # [B, G, S]
+            blocked = overloaded[src][None, :, :] & (
+                src[None, :, :] != t_ids[:, None, None]
+            )
+            total = jnp.minimum(
+                jnp.where(blocked, INF, gath)[:, :, :, None] + w[None],
+                INF,
+            )  # [B, G, S, R]
+            cond = (
+                (total == d_g[:, :, None, :])
+                & (d_g < INF)[:, :, None, :]
+                & (w < INF)[None]
+            )
+            c = jnp.sum(cond, axis=2, dtype=jnp.int32)  # [B, G, R]
+            if axis == 2:
+                c = jnp.transpose(c, (0, 2, 1))
+            acc = acc + c.reshape(b, rows)
+        parts.append(acc)
+        pos += rows
+    parts.append(jnp.zeros_like(dr[:, pos:]))
+    return jnp.concatenate(parts, axis=1)
+
+
+def _grouped_route_block_body(
+    srcs_t, ws_t, overloaded, t_ids, samp_ids, samp_v, samp_w, pos_w,
+    meta, n, vote=None, impl="jnp",
+):
+    """Grouped twin of route_sweep._route_block_body: same packed
+    layout, same digest algebra — only the relaxation backend differs,
+    so the canonical digest must agree bit-exactly with the ELL sweep."""
+    from openr_tpu.ops import route_sweep as rs
+
+    dr = _grouped_fixed_point(
+        meta, srcs_t, ws_t, overloaded, t_ids, n, reverse=True,
+        vote=vote, impl=impl,
+    )
+    nh_count = _grouped_nh_counts(
+        dr, meta, srcs_t, ws_t, overloaded, t_ids
+    )
+    digest = rs._digest_rows(dr, nh_count, pos_w)
+    nh_total = jnp.sum(nh_count, axis=1, dtype=jnp.int32)
+    d_s, packed_mask = rs._sample_stats(
+        dr, samp_ids, samp_v, samp_w, overloaded, t_ids
+    )
+    b = t_ids.shape[0]
+    return jnp.concatenate(
+        [
+            jax.lax.bitcast_convert_type(digest, jnp.int32)[:, None],
+            nh_total[:, None],
+            d_s,
+            jax.lax.bitcast_convert_type(
+                packed_mask, jnp.int32
+            ).reshape(b, -1),
+        ],
+        axis=1,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("meta", "n", "impl"))
+def _grouped_route_block(
+    srcs_t, ws_t, overloaded, t_ids, samp_ids, samp_v, samp_w, pos_w,
+    meta, n, impl,
+):
+    return _grouped_route_block_body(
+        srcs_t, ws_t, overloaded, t_ids, samp_ids, samp_v, samp_w,
+        pos_w, meta, n, impl=impl,
+    )
+
+
+class GroupedRouteSweeper:
+    """Destination-major route sweeper over the grouped (out-edge)
+    graph — the gather-free backend of ops.route_sweep.RouteSweeper,
+    producing the identical RouteSweepResult (canonical digests are
+    bit-comparable across the two backends)."""
+
+    def __init__(self, graph: GroupedGraph, sample_names: Sequence[str]):
+        from openr_tpu.ops import route_sweep as rs
+
+        assert graph.direction == "out", "route sweep needs out-edges"
+        self.graph = graph
+        self.meta = band_meta(graph)
+        self.v_t, self.w_t = device_tensors(graph)
+        self.overloaded = jnp.asarray(graph.overloaded)
+        self.sample_names = tuple(sample_names)
+        self.sample_ids = np.asarray(
+            [graph.node_index[nm] for nm in self.sample_names],
+            dtype=np.int32,
+        )
+        rows = [graph.out_slots(int(sid)) for sid in self.sample_ids]
+        self.samp_v, self.samp_w = rs.pack_sample_rows(
+            rows, self.sample_ids
+        )
+        self._samp_ids_dev = jnp.asarray(self.sample_ids)
+        self._samp_v_dev = jnp.asarray(self.samp_v)
+        self._samp_w_dev = jnp.asarray(self.samp_w)
+        self._pos_w_dev = jnp.asarray(rs.canonical_pos_weights(graph))
+
+    def solve_block(self, t_ids):
+        return _grouped_route_block(
+            self.v_t, self.w_t, self.overloaded,
+            _as_device_ids(t_ids),
+            self._samp_ids_dev, self._samp_v_dev, self._samp_w_dev,
+            self._pos_w_dev, self.meta, self.graph.n_pad,
+            _GROUPED_IMPL,
+        )
+
+    # the block loop and result assembly are layout-independent —
+    # reuse RouteSweeper's implementation verbatim
+    from openr_tpu.ops.route_sweep import RouteSweeper as _RS
+
+    sweep = _RS.sweep
+    del _RS
+
+
+def compile_out_grouped(ls, align: int = 128) -> GroupedGraph:
+    """Out-edge grouped graph for the destination-major route sweep."""
+    return compile_grouped(ls, align=align, direction="out")
+
+
+def structure_report(graph: GroupedGraph) -> dict:
+    """How much of the edge volume the structure detection captured:
+    per band (g1, g2, segments, slots) + the total gather shrink
+    factor vs per-node ELL slots."""
+    bands = []
+    grouped_slots = 0
+    row_slots = 0
+    for band in graph.bands:
+        rows = band.g1 * band.g2
+        seg_info = []
+        for seg in band.segments:
+            g, s, r = seg.w.shape
+            seg_info.append({"axis": seg.axis, "g": g, "s": s, "r": r})
+            grouped_slots += g * s
+            row_slots += g * s * r
+        bands.append(
+            {"rows": rows, "g1": band.g1, "g2": band.g2,
+             "segments": seg_info}
+        )
+    return {
+        "bands": bands,
+        "gather_slots": grouped_slots,
+        "ell_equivalent_slots": row_slots,
+        "gather_shrink": round(row_slots / max(1, grouped_slots), 1),
+    }

@@ -129,7 +129,10 @@ class TestDigest:
                 d_rows[t, s] = res.metric
                 if s != t:
                     nh_counts[t, s] = len(res.next_hops)
-        want = route_sweep.host_digest(d_rows, nh_counts)
+        want = route_sweep.host_digest(
+            d_rows, nh_counts,
+            pos_w=route_sweep.canonical_pos_weights(g),
+        )
         np.testing.assert_array_equal(result.digests[:n], want)
 
     def test_digest_deterministic_across_runs(self):

@@ -130,9 +130,14 @@ def capture(round_no: int) -> bool:
     )
     legs = [
         (
-            "all_sources_10k",
+            "route_sweep_10k_grouped",
             [sys.executable, "-m", "benchmarks.bench_scale",
-             "--nodes", "10000", "--kernel", "ell"],
+             "--routes", "--nodes", "10000", "--backend", "grouped"],
+        ),
+        (
+            "route_sweep_10k_ell",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes", "--nodes", "10000", "--backend", "ell"],
         ),
         (
             "ksp2_churn_1008",
@@ -140,6 +145,19 @@ def capture(round_no: int) -> bool:
              "import json; from benchmarks.bench_scale import "
              "ksp2_churn_bench; print(json.dumps("
              "ksp2_churn_bench(1000, 10)))"],
+        ),
+        (
+            "all_sources_10k",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--nodes", "10000", "--kernel", "ell"],
+        ),
+        (
+            # the 100k north-star axis: FULL 98-block sweep with
+            # on-device route consumption (no 40 GB readback), grouped
+            # backend with on-chip impl probing
+            "route_sweep_100k_grouped",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes", "--nodes", "100000", "--backend", "grouped"],
         ),
     ]
     for name, cmd in legs:
