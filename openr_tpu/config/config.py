@@ -234,19 +234,6 @@ class OpenrConfig:
             raise ConfigError("duplicate area ids")
         self.spark.validate()
         self.prefix_alloc.validate()
-        if (
-            self.kvstore.enable_kvstore_thrift
-            and self.kvstore.enable_flood_optimization
-        ):
-            # the thrift peer channel covers sync/flood only; DUAL
-            # flood-topology messages ride the framework RPC channel —
-            # combining them would demote the peer on every DUAL send
-            # and loop full syncs forever
-            raise ConfigError(
-                "enable_kvstore_thrift and enable_flood_optimization "
-                "are mutually exclusive (DUAL messages are not part of "
-                "the thrift peer surface)"
-            )
         if (self.kvstore.flood_msg_per_sec > 0) != (
             self.kvstore.flood_msg_burst_size > 0
         ):

@@ -56,32 +56,69 @@ def _recv_frame(sock: socket.socket) -> Optional[Dict]:
 class CtrlServer:
     """``ssl_context``: serve the ctrl API over TLS (reference: the
     thrift ctrl server's optional TLS; clients use the secure-then-
-    plain fallback factory, openr_client.py:27-140)."""
+    plain fallback factory, openr_client.py:27-140).
+
+    The port is DUAL-STACKED by byte-sniffing the first bytes of every
+    connection (same trick as kvstore/dualstack.py, mirroring the
+    reference's wire-migration listeners KvStore.cpp:2940-2973):
+
+    - ``0x16`` first          -> TLS ClientHello: handshake, then sniff
+      the DECRYPTED stream the same way (thrift or JSON over TLS);
+    - ``0x82`` at offset 4    -> framed thrift CompactProtocol: the
+      stock-toolchain OpenrCtrl service (ctrl/thrift_ctrl.py,
+      reference if/OpenrCtrl.thrift:168-577);
+    - ``0x0F 0xFF`` at 4      -> THeader-wrapped thrift (the fbthrift
+      client default; utils/theader.py);
+    - anything else           -> plain framework JSON frames.
+
+    When TLS is configured, EVERY wire must arrive inside it — a
+    plaintext thrift dial is rejected exactly like a plaintext JSON
+    dial (no sniff path may bypass the operator's TLS requirement).
+    """
 
     def __init__(self, handler: OpenrCtrlHandler, host="127.0.0.1",
                  port=0, ssl_context=None):
+        from openr_tpu.ctrl.thrift_ctrl import ThriftCtrlServer
+
         self.handler = handler
         self._ssl_context = ssl_context
+        # thrift backend used for its serve_connection loop only; its
+        # own loopback listener runs idle + unadvertised so stop() is
+        # safe (socketserver.shutdown deadlocks when serve_forever
+        # never ran)
+        self._thrift_backend = ThriftCtrlServer(
+            handler, listen=False
+        )
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
-                from openr_tpu.utils.rpc import wrap_server_connection
-
-                wrapped = wrap_server_connection(
-                    self.request, outer._ssl_context
+                from openr_tpu.utils.rpc import (
+                    peek_first_bytes,
+                    wrap_server_connection,
                 )
-                if wrapped is None:
+
+                head = peek_first_bytes(self.request, 6)
+                if head is None:
                     return
-                self.request = wrapped
-                while True:
-                    try:
-                        request = _recv_frame(self.request)
-                    except (ConnectionError, OSError):
+                self.request.settimeout(None)
+                if head[0] == 0x16:
+                    # TLS: handshake first, then classify the DECRYPTED
+                    # stream (SSL sockets cannot MSG_PEEK — read the
+                    # first frame and replay it to the chosen backend)
+                    wrapped = wrap_server_connection(
+                        self.request, outer._ssl_context
+                    )
+                    if wrapped is None:
                         return
-                    if request is None:
-                        return
-                    outer._dispatch(self.request, request)
+                    outer._serve_classified_tls(wrapped)
+                    return
+                if outer._ssl_context is not None:
+                    return  # TLS required: reject every plaintext wire
+                if _is_thrift_head(head):
+                    outer._thrift_backend.serve_connection(self.request)
+                    return
+                outer._serve_json(self.request)
 
         class _Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -101,6 +138,31 @@ class CtrlServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+    def _serve_json(self, sock) -> None:
+        while True:
+            try:
+                request = _recv_frame(sock)
+            except (ConnectionError, OSError):
+                return
+            if request is None:
+                return
+            self._dispatch(sock, request)
+
+    def _serve_classified_tls(self, tls_sock) -> None:
+        """Read the first frame head off the TLS stream, classify it,
+        and hand a replaying socket to the matching backend."""
+        try:
+            head = _read_exact_sock(tls_sock, 6)
+        except (ConnectionError, OSError):
+            return
+        if head is None:
+            return
+        replay = _ReplaySocket(tls_sock, head)
+        if _is_thrift_head(head):
+            self._thrift_backend.serve_connection(replay)
+            return
+        self._serve_json(replay)
 
     def _dispatch(self, sock: socket.socket, request: Dict) -> None:
         method_name = request.get("method", "")
@@ -136,6 +198,49 @@ class CtrlServer:
                 _send_frame(sock, {"ok": True, "event": to_jsonable(item)})
             except (ConnectionError, OSError):
                 return
+
+
+def _is_thrift_head(head: bytes) -> bool:
+    """First 6 bytes of a connection: 4-byte frame length, then either
+    the compact-protocol id 0x82 or the THeader magic 0x0FFF."""
+    from openr_tpu.utils.thrift_rpc import PROTOCOL_ID
+
+    return head[4] == PROTOCOL_ID or head[4:6] == b"\x0f\xff"
+
+
+def _read_exact_sock(sock, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class _ReplaySocket:
+    """Socket adapter that serves pre-read bytes before delegating —
+    the TLS demux consumed the classification head from the decrypted
+    stream and the backend's frame reader must still see it."""
+
+    def __init__(self, sock, head: bytes):
+        self._sock = sock
+        self._head = head
+
+    def recv(self, n: int) -> bytes:
+        if self._head:
+            out, self._head = self._head[:n], self._head[n:]
+            return out
+        return self._sock.recv(n)
+
+    def sendall(self, data: bytes) -> None:
+        self._sock.sendall(data)
+
+    def settimeout(self, t) -> None:
+        self._sock.settimeout(t)
+
+    def close(self) -> None:
+        self._sock.close()
 
 
 class CtrlClient:

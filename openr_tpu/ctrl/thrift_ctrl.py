@@ -1,0 +1,404 @@
+"""OpenrCtrl on the thrift wire: the operator surface a STOCK Open/R
+toolchain speaks.
+
+The framework's own ctrl codec (ctrl/server.py, JSON frames) remains
+the native surface; THIS module exposes the high-traffic subset of the
+reference thrift service (`/root/reference/openr/if/OpenrCtrl.thrift:
+168-577`, handler `ctrl-server/OpenrCtrlHandler.h:24`) as framed
+CompactProtocol — the same interop wire the KvStore peer channel and
+FibService already speak (utils/thrift_rpc.py). A stock breeze or
+external automation dialing the ctrl port with classic framed transport
+round-trips these RPCs against an openr-tpu node.
+
+Implemented subset (the VERDICT-ranked operator surface): KvStore
+get/dump/hash/set + peers + long-poll, routes computed/installed
+(unicast + MPLS), adjacency/prefix dbs, counters/aliveSince, node and
+interface drain, interface metric overrides, version/config/identity,
+event logs. Streaming subscriptions stay on the framework wire (the
+reference serves those over fbthrift Rocket streams, out of scope for
+classic framed transport).
+
+Thrift service conventions: per-method args struct (ids from the IDL),
+result struct with ``success`` at field 0 and declared ``OpenrError``
+exceptions at field 1; undeclared failures become
+TApplicationException (utils/thrift_rpc.py handles the envelope).
+
+Dual-stacking on the ctrl port is byte-sniffed in ctrl/server.py: a
+compact-protocol message leads with 0x82 after the 4-byte frame
+length, a TLS ClientHello leads with 0x16, the framework JSON codec
+with ``{`` — all three wire shapes share one advertised port.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.thrift_rpc import (
+    FramedCompactClient,
+    FramedCompactServer,
+    MethodTable,
+)
+
+OPENR_VERSION = 20200825  # reference: common/Constants.h:274
+OPENR_LOWEST_SUPPORTED_VERSION = 20200604  # Constants.h:277
+
+_VOID = object()  # sentinel: method returns nothing
+
+
+def _result_schema(name: str, ret, throws: bool):
+    fields = []
+    if ret is not _VOID:
+        fields.append(tc.Field(0, ret, "success", optional=True))
+    if throws:
+        fields.append(
+            tc.Field(
+                1, ("struct", tc.OPENR_ERROR), "error", optional=True
+            )
+        )
+    return tc.StructSchema(f"{name}_result", tuple(fields))
+
+
+class _Method:
+    def __init__(self, name, arg_fields, ret, fn, throws=False):
+        self.name = name
+        self.args_schema = tc.StructSchema(
+            f"{name}_args", tuple(arg_fields)
+        )
+        self.result_schema = _result_schema(name, ret, throws)
+        self.ret = ret
+        self.fn = fn
+        self.throws = throws
+
+    def handle(self, args: Dict) -> Tuple[object, Dict]:
+        try:
+            value = self.fn(args)
+        except Exception as exc:
+            if self.throws:
+                return self.result_schema, {
+                    "error": {"message": f"{type(exc).__name__}: {exc}"}
+                }
+            raise
+        if self.ret is _VOID:
+            return self.result_schema, {}
+        return self.result_schema, {"success": value}
+
+
+def _pub_to_wire(key_vals, area: str) -> Dict:
+    return {
+        "keyVals": {
+            k: tc._value_to_wire(v) for k, v in key_vals.items()
+        },
+        "expiredKeys": [],
+        "area": area,
+    }
+
+
+def _node_of(key) -> str:
+    """PrefixState entry keys are node names or (node, area) pairs."""
+    return key[0] if isinstance(key, tuple) else key
+
+
+def build_method_table(handler) -> MethodTable:
+    """Method table for utils.thrift_rpc.FramedCompactServer wrapping
+    an OpenrCtrlHandler."""
+    F = tc.Field
+
+    def kv_publication(args, dump=False, hashes=False):
+        area = args.get("area", "0")
+        if hashes:
+            prefix = (args.get("filter") or {}).get("prefix", "")
+            kvs = handler.get_kvstore_hash_filtered(
+                prefix=prefix, area=area
+            )
+        elif dump:
+            params = tc._key_dump_params_from_wire(
+                args.get("filter") or {}
+            )
+            pub = handler._kvstore.dump_with_filters(area, params)
+            kvs = pub.key_vals
+        else:
+            kvs = handler.get_kvstore_key_vals(
+                list(args.get("filterKeys", [])), area=area
+            )
+        return _pub_to_wire(kvs, area)
+
+    def set_key_vals(args):
+        params = tc._key_set_params_from_wire(
+            args.get("setParams") or {}
+        )
+        handler._kvstore.set_key_vals(args.get("area", "0"), params)
+
+    def peers_map(args):
+        area = args.get("area", "0")
+        return {
+            name: {"peerAddr": "", "cmdUrl": "", "ctrlPort": 0}
+            for name in handler.get_kvstore_peers(area=area)
+        }
+
+    def route_db(args=None, node=None):
+        db = (
+            handler.get_route_db()
+            if node is None
+            else handler.get_route_db_computed(node or None)
+        )
+        return tc.route_db_to_wire(db)
+
+    def unicast_routes(args, filtered=False):
+        prefixes = (
+            list(args.get("prefixes", [])) if filtered else None
+        )
+        routes = handler.get_unicast_routes(prefixes or None)
+        return [tc._unicast_route_to_wire(r) for r in routes]
+
+    def mpls_routes(args, filtered=False):
+        labels = set(args.get("labels", [])) if filtered else None
+        routes = handler.get_route_db().mpls_routes
+        return [
+            tc._mpls_route_to_wire(r)
+            for r in routes
+            if not labels or r.top_label in labels
+        ]
+
+    def flat_adj_dbs() -> Dict[str, Any]:
+        # handler returns {area: {node: AdjacencyDatabase}}; the thrift
+        # AdjDbs is a per-node map (first area wins on collision, like
+        # the reference's single-area legacy view)
+        out: Dict[str, Any] = {}
+        for _area, dbs in sorted(
+            handler.get_decision_adjacency_dbs().items()
+        ):
+            for name, db in dbs.items():
+                out.setdefault(name, db)
+        return out
+
+    def adj_dbs(args):
+        return {
+            name: tc.adjacency_db_to_wire(db)
+            for name, db in flat_adj_dbs().items()
+        }
+
+    def all_adj_dbs(args):
+        return [
+            tc.adjacency_db_to_wire(db)
+            for _, db in sorted(flat_adj_dbs().items())
+        ]
+
+    def prefix_dbs(args):
+        from openr_tpu.types import PrefixDatabase
+
+        by_node: Dict[str, List] = {}
+        for _prefix, entries in handler.get_decision_prefix_dbs().items():
+            for key, entry in entries.items():
+                by_node.setdefault(_node_of(key), []).append(entry)
+        return {
+            node: tc.prefix_db_to_wire(
+                PrefixDatabase(
+                    this_node_name=node,
+                    prefix_entries=tuple(entries),
+                )
+            )
+            for node, entries in by_node.items()
+        }
+
+    def counters(args):
+        return {
+            k: int(v)
+            for k, v in handler.get_counters().items()
+            if isinstance(v, (int, float, bool))
+        }
+
+    def long_poll_adj(args):
+        # reference semantics (OpenrCtrlHandler.h:250): the client's
+        # snapshot is COMPARED first — any adj: key newer than (or
+        # absent from) the snapshot answers true immediately; only a
+        # matching snapshot blocks for the next change
+        snapshot = args.get("snapshot") or {}
+        current = handler.get_kvstore_keys_filtered(prefix="adj:")
+        for key, val in current.items():
+            snap = snapshot.get(key)
+            if snap is None or snap.get("version", 0) < val.version:
+                return True
+        return bool(handler.long_poll_kvstore_adj())
+
+    methods = [
+        _Method("getMyNodeName", (), ("string",),
+                lambda a: handler.get_my_node_name()),
+        _Method("getOpenrVersion", (),
+                ("struct", tc.OPENR_VERSIONS),
+                lambda a: {
+                    "version": OPENR_VERSION,
+                    "lowestSupportedVersion":
+                        OPENR_LOWEST_SUPPORTED_VERSION,
+                }, throws=True),
+        _Method("aliveSince", (), ("i64",),
+                lambda a: handler.alive_since()),
+        _Method("getCounters", (), ("map", ("string",), ("i64",)),
+                counters),
+        _Method("getRunningConfig", (), ("string",),
+                lambda a: json.dumps(handler.get_running_config())),
+        _Method("dryrunConfig", (F(1, ("string",), "file"),),
+                ("string",),
+                lambda a: json.dumps(
+                    handler.dryrun_config(a.get("file", "{}"))
+                ), throws=True),
+        # -- KvStore ------------------------------------------------------
+        _Method("getKvStoreKeyVals",
+                (F(1, ("list", ("string",)), "filterKeys"),),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a), throws=True),
+        _Method("getKvStoreKeyValsArea",
+                (F(1, ("list", ("string",)), "filterKeys"),
+                 F(2, ("string",), "area")),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a), throws=True),
+        _Method("getKvStoreKeyValsFiltered",
+                (F(1, ("struct", tc.KEY_DUMP_PARAMS), "filter"),),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a, dump=True), throws=True),
+        _Method("getKvStoreKeyValsFilteredArea",
+                (F(1, ("struct", tc.KEY_DUMP_PARAMS), "filter"),
+                 F(2, ("string",), "area")),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a, dump=True), throws=True),
+        _Method("getKvStoreHashFiltered",
+                (F(1, ("struct", tc.KEY_DUMP_PARAMS), "filter"),),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a, hashes=True), throws=True),
+        _Method("getKvStoreHashFilteredArea",
+                (F(1, ("struct", tc.KEY_DUMP_PARAMS), "filter"),
+                 F(2, ("string",), "area")),
+                ("struct", tc.PUBLICATION),
+                lambda a: kv_publication(a, hashes=True), throws=True),
+        _Method("setKvStoreKeyVals",
+                (F(1, ("struct", tc.KEY_SET_PARAMS), "setParams"),
+                 F(2, ("string",), "area")),
+                _VOID, set_key_vals, throws=True),
+        _Method("longPollKvStoreAdj",
+                (F(1, ("map", ("string",), ("struct", tc.VALUE)),
+                   "snapshot"),),
+                ("bool",),
+                long_poll_adj,
+                throws=True),
+        _Method("getKvStorePeers", (),
+                ("map", ("string",), ("struct", tc.PEER_SPEC)),
+                peers_map, throws=True),
+        _Method("getKvStorePeersArea", (F(1, ("string",), "area"),),
+                ("map", ("string",), ("struct", tc.PEER_SPEC)),
+                peers_map, throws=True),
+        # -- routes -------------------------------------------------------
+        _Method("getRouteDb", (), ("struct", tc.ROUTE_DATABASE),
+                lambda a: route_db(), throws=True),
+        _Method("getRouteDbComputed", (F(1, ("string",), "nodeName"),),
+                ("struct", tc.ROUTE_DATABASE),
+                lambda a: route_db(node=a.get("nodeName", "")),
+                throws=True),
+        _Method("getUnicastRoutes", (),
+                ("list", ("struct", tc.UNICAST_ROUTE)),
+                lambda a: unicast_routes(a), throws=True),
+        _Method("getUnicastRoutesFiltered",
+                (F(1, ("list", ("string",)), "prefixes"),),
+                ("list", ("struct", tc.UNICAST_ROUTE)),
+                lambda a: unicast_routes(a, filtered=True),
+                throws=True),
+        _Method("getMplsRoutes", (),
+                ("list", ("struct", tc.MPLS_ROUTE)),
+                lambda a: mpls_routes(a), throws=True),
+        _Method("getMplsRoutesFiltered",
+                (F(1, ("list", ("i32",)), "labels"),),
+                ("list", ("struct", tc.MPLS_ROUTE)),
+                lambda a: mpls_routes(a, filtered=True), throws=True),
+        # -- decision -----------------------------------------------------
+        _Method("getDecisionAdjacencyDbs", (),
+                ("map", ("string",), ("struct", tc.ADJACENCY_DATABASE)),
+                adj_dbs, throws=True),
+        _Method("getAllDecisionAdjacencyDbs", (),
+                ("list", ("struct", tc.ADJACENCY_DATABASE)),
+                all_adj_dbs, throws=True),
+        _Method("getDecisionPrefixDbs", (),
+                ("map", ("string",), ("struct", tc.PREFIX_DATABASE)),
+                prefix_dbs, throws=True),
+        # -- drain / link overrides --------------------------------------
+        _Method("setNodeOverload", (), _VOID,
+                lambda a: handler.set_node_overload(True), throws=True),
+        _Method("unsetNodeOverload", (), _VOID,
+                lambda a: handler.set_node_overload(False),
+                throws=True),
+        _Method("setInterfaceOverload",
+                (F(1, ("string",), "interfaceName"),), _VOID,
+                lambda a: handler.set_link_overload(
+                    a.get("interfaceName", ""), True
+                ), throws=True),
+        _Method("unsetInterfaceOverload",
+                (F(1, ("string",), "interfaceName"),), _VOID,
+                lambda a: handler.set_link_overload(
+                    a.get("interfaceName", ""), False
+                ), throws=True),
+        _Method("setInterfaceMetric",
+                (F(1, ("string",), "interfaceName"),
+                 F(2, ("i32",), "overrideMetric")), _VOID,
+                lambda a: handler.set_interface_metric(
+                    a.get("interfaceName", ""),
+                    a.get("overrideMetric", 0),
+                ), throws=True),
+        _Method("unsetInterfaceMetric",
+                (F(1, ("string",), "interfaceName"),), _VOID,
+                lambda a: handler.unset_interface_metric(
+                    a.get("interfaceName", "")
+                ), throws=True),
+        # -- misc ---------------------------------------------------------
+        _Method("floodRestartingMsg", (), _VOID,
+                lambda a: handler.flood_restarting_msg(), throws=True),
+        _Method("getEventLogs", (), ("list", ("string",)),
+                lambda a: list(handler.get_event_logs()), throws=True),
+    ]
+    return {
+        m.name: (m.args_schema, m.handle) for m in methods
+    }, {m.name: m for m in methods}
+
+
+class ThriftCtrlServer(FramedCompactServer):
+    """Framed-compact OpenrCtrl server. Normally not run on its own
+    port: ctrl/server.py byte-sniffs the shared ctrl port and hands
+    compact-protocol connections to ``serve_connection``."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0,
+                 listen: bool = True):
+        table, self.methods = build_method_table(handler)
+        super().__init__(table, host=host, port=port, listen=listen)
+
+
+class ThriftCtrlClient:
+    """Typed client for the thrift ctrl surface — the repo's own codec
+    standing in for a stock thrift client (byte-identical wire). Used
+    by tests and tools/thrift_ctrl_probe.py."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self._client = FramedCompactClient(host, port, timeout_s)
+        # method schemas are handler-independent: build against a dummy
+        _, self._methods = build_method_table(_SchemaOnly())
+
+    def call(self, name: str, **args) -> Any:
+        m = self._methods[name]
+        result = self._client.call(
+            name, m.args_schema, args, m.result_schema
+        )
+        if result.get("error") is not None:
+            raise RuntimeError(
+                f"OpenrError: {result['error'].get('message')}"
+            )
+        if m.ret is _VOID:
+            return None
+        return result.get("success")
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class _SchemaOnly:
+    """Attribute sink so build_method_table can run clientside (the
+    lambdas close over the handler but are never invoked)."""
+
+    def __getattr__(self, name):  # pragma: no cover - schema only
+        return None

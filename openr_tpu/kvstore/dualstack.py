@@ -2,13 +2,17 @@
 
 The reference runs its legacy and thrift peer transports
 simultaneously during wire migrations (KvStore.cpp:2940-2973 branches
-per peer). Here both wire formats are framed ``[u32 length][payload]``
-and the first payload byte disambiguates them unambiguously:
+per peer). Here all wire formats are framed ``[u32 length][payload]``
+and the leading payload bytes disambiguate them unambiguously:
 
 - thrift CompactProtocol messages begin with the protocol id ``0x82``;
+- THeader-wrapped thrift (the fbthrift client default) begins with the
+  TWO-byte magic ``0x0FFF`` (both bytes are checked: 0x0F alone would
+  collide with a 15-argument framework RPC one day, but 0x0F followed
+  by 0xFF cannot be a framework frame — the second byte there is the
+  top byte of a u32 blob length bounded far below 0xFF000000);
 - the framework RPC payload begins with its blob count, a small
-  integer that can never be 0x82 (requests carry a method name plus
-  arguments — single-digit blob counts).
+  integer that can never be 0x82.
 
 One listener peeks the first frame's leading bytes and then runs the
 matching backend's request loop DIRECTLY on the accepted socket (no
@@ -23,41 +27,19 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
-import time
 from typing import Optional
 
 from openr_tpu.kvstore.store import KvStore
 from openr_tpu.kvstore.thrift_peer import KvStoreThriftPeerServer
 from openr_tpu.kvstore.transport import KvStorePeerServer
-from openr_tpu.utils.rpc import apply_bind_family
+from openr_tpu.utils.rpc import apply_bind_family, peek_first_bytes
 from openr_tpu.utils.thrift_rpc import PROTOCOL_ID
 
-_SNIFF_BYTES = 5  # u32 frame length + first payload byte
-_SNIFF_DEADLINE_S = 30.0
+_SNIFF_BYTES = 6  # u32 frame length + two payload bytes
 
 
 def _peek_first_bytes(sock: socket.socket) -> Optional[bytes]:
-    """Wait until the first frame header + payload byte are buffered.
-    MSG_PEEK returns whatever has ARRIVED — clients that write the
-    frame header and payload in separate sends (several stock thrift
-    transports do) need more than one peek."""
-    deadline = time.monotonic() + _SNIFF_DEADLINE_S
-    while True:
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            return None
-        sock.settimeout(remaining)
-        try:
-            head = sock.recv(_SNIFF_BYTES, socket.MSG_PEEK)
-        except OSError:
-            return None
-        if not head:
-            return None  # peer hung up
-        if len(head) >= _SNIFF_BYTES:
-            return head
-        # partial arrival: yield briefly rather than hot-spinning on
-        # MSG_PEEK (which does not consume and so returns immediately)
-        time.sleep(0.005)
+    return peek_first_bytes(sock, _SNIFF_BYTES)
 
 
 class DualStackPeerServer:
@@ -65,14 +47,11 @@ class DualStackPeerServer:
 
     def __init__(self, kvstore: KvStore, host: str = "0.0.0.0",
                  port: int = 0):
-        # backends are used for their serve_connection dispatch loops;
-        # their own loopback ephemeral listeners also run (idle,
-        # unadvertised) because socketserver.shutdown() deadlocks on a
-        # server whose serve_forever never ran — starting them is the
-        # cheap way to keep stop() safe
-        self._rpc_backend = KvStorePeerServer(kvstore, host="127.0.0.1")
+        # backends are pure dispatchers: no sockets of their own, the
+        # demux below owns the one advertised port
+        self._rpc_backend = KvStorePeerServer(kvstore, listen=False)
         self._thrift_backend = KvStoreThriftPeerServer(
-            kvstore, host="127.0.0.1"
+            kvstore, listen=False
         )
         outer = self
 
@@ -83,7 +62,10 @@ class DualStackPeerServer:
                 if head is None:
                     return
                 sock.settimeout(None)
-                if head[4] == PROTOCOL_ID:
+                if head[4] == PROTOCOL_ID or head[4:6] == b"\x0f\xff":
+                    # bare framed compact (0x82) or a THeader-wrapped
+                    # dial (0x0FFF magic) — both land on the thrift
+                    # backend, which mirrors the request's wrapping
                     outer._thrift_backend.serve_connection(sock)
                 else:
                     outer._rpc_backend.serve_connection(sock)

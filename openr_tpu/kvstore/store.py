@@ -747,11 +747,17 @@ class KvStoreDb:
             )
 
     def set_flood_topo_child(
-        self, root_id: str, child_id: str, is_set: bool
+        self, root_id: str, child_id: str, is_set: bool,
+        all_roots: bool = False,
     ) -> None:
         """A peer (un)registered as our SPT child (reference:
-        KvStoreDb::processFloodTopoSet)."""
+        KvStoreDb::processFloodTopoSet; ``all_roots`` applies the
+        change to every root, FloodTopoSetParams.allRoots)."""
         if self.dual is None:
+            return
+        if all_roots:
+            for rid in list(self.dual.duals):
+                self.set_flood_topo_child(rid, child_id, is_set)
             return
         dual = self.dual.get_dual(root_id)
         if dual is None:
@@ -924,11 +930,12 @@ class KvStore:
         )
 
     def set_flood_topo_child(
-        self, area: str, root_id: str, child_id: str, is_set: bool
+        self, area: str, root_id: str, child_id: str, is_set: bool,
+        all_roots: bool = False,
     ) -> None:
         self.evb.call_and_wait(
             lambda: self._db(area).set_flood_topo_child(
-                root_id, child_id, is_set
+                root_id, child_id, is_set, all_roots=all_roots
             )
         )
 

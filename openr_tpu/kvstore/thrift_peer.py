@@ -9,10 +9,14 @@ stock thrift client with the KvStore.thrift IDL can sync against this
 daemon, and this daemon's client can sync against any framed+compact
 KvStoreService server.
 
-Methods served (KvStore.thrift:256-276, OpenrCtrl.thrift:358-381):
+Methods served (KvStore.thrift:256-276, OpenrCtrl.thrift:358-427):
 - ``getKvStoreKeyValsFilteredArea(1: KeyDumpParams filter, 2: string area)``
 - ``getKvStoreKeyValsArea(1: list<string> filterKeys, 2: string area)``
 - ``setKvStoreKeyVals(1: KeySetParams setParams, 2: string area)``
+- ``processKvStoreDualMessage(1: DualMessages, 2: string area)`` — the
+  flood-optimization channel (reference carries DUAL on the same peer
+  wire, KvStore.thrift:47-52 Command.DUAL / OpenrCtrl.thrift:416)
+- ``updateFloodTopologyChild(1: FloodTopoSetParams, 2: string area)``
 """
 
 from __future__ import annotations
@@ -53,13 +57,31 @@ _GET_KEYS_ARGS = tc.StructSchema(
         tc.Field(2, ("string",), "area"),
     ),
 )
+_DUAL_ARGS = tc.StructSchema(
+    "processKvStoreDualMessage_args",
+    (
+        tc.Field(1, ("struct", tc.DUAL_MESSAGES), "messages"),
+        tc.Field(2, ("string",), "area"),
+    ),
+)
+_DUAL_RESULT = tc.StructSchema("processKvStoreDualMessage_result", ())
+_FLOOD_TOPO_ARGS = tc.StructSchema(
+    "updateFloodTopologyChild_args",
+    (
+        tc.Field(1, ("struct", tc.FLOOD_TOPO_SET_PARAMS), "params"),
+        tc.Field(2, ("string",), "area"),
+    ),
+)
+_FLOOD_TOPO_RESULT = tc.StructSchema(
+    "updateFloodTopologyChild_result", ()
+)
 
 
 class KvStoreThriftPeerServer:
     """Serve the KvStoreService peer surface over framed+compact TCP."""
 
     def __init__(self, kvstore: KvStore, host: str = "0.0.0.0",
-                 port: int = 0):
+                 port: int = 0, listen: bool = True):
         self._kvstore = kvstore
         self._server = FramedCompactServer(
             {
@@ -68,9 +90,14 @@ class KvStoreThriftPeerServer:
                 ),
                 "getKvStoreKeyValsArea": (_GET_KEYS_ARGS, self._get_keys),
                 "setKvStoreKeyVals": (_SET_ARGS, self._set),
+                "processKvStoreDualMessage": (_DUAL_ARGS, self._dual),
+                "updateFloodTopologyChild": (
+                    _FLOOD_TOPO_ARGS, self._flood_topo,
+                ),
             },
             host=host,
             port=port,
+            listen=listen,
         )
         self.port = self._server.port
 
@@ -104,6 +131,26 @@ class KvStoreThriftPeerServer:
             args.get("area", ""), params, sender_id=params.originator_id
         )
         return _SET_RESULT, {}
+
+    def _dual(self, args: Dict):
+        src_id, msgs = tc.dual_messages_from_wire(
+            args.get("messages", {})
+        )
+        self._kvstore.process_dual_messages(
+            args.get("area", ""), src_id, msgs
+        )
+        return _DUAL_RESULT, {}
+
+    def _flood_topo(self, args: Dict):
+        params = args.get("params", {})
+        self._kvstore.set_flood_topo_child(
+            args.get("area", ""),
+            params.get("rootId", ""),
+            params.get("srcId", ""),
+            params.get("setChild", False),
+            all_roots=params.get("allRoots", False),
+        )
+        return _FLOOD_TOPO_RESULT, {}
 
     def serve_connection(self, sock) -> None:
         self._server.serve_connection(sock)
@@ -171,15 +218,33 @@ class ThriftPeerTransport(PeerTransport):
         )
 
     def send_dual_messages(self, area, sender_id, msgs) -> None:
-        raise NotImplementedError(
-            "DUAL flood-optimization rides the framework RPC channel "
-            "(kvstore.transport); the thrift peer channel covers the "
-            "sync/flood surface"
+        """DUAL messages on the SAME peer channel, as the reference
+        does (Command.DUAL, KvStore.thrift:47-52; service method
+        OpenrCtrl.thrift:416 processKvStoreDualMessage)."""
+        self._client.call(
+            "processKvStoreDualMessage",
+            _DUAL_ARGS,
+            {
+                "messages": tc.dual_messages_to_wire(sender_id, msgs),
+                "area": area,
+            },
+            _DUAL_RESULT,
         )
 
     def set_flood_topo_child(self, area, root_id, child, is_child) -> None:
-        raise NotImplementedError(
-            "flood-topo updates ride the framework RPC channel"
+        """reference: OpenrCtrl.thrift:424 updateFloodTopologyChild."""
+        self._client.call(
+            "updateFloodTopologyChild",
+            _FLOOD_TOPO_ARGS,
+            {
+                "params": {
+                    "rootId": root_id,
+                    "srcId": child,
+                    "setChild": is_child,
+                },
+                "area": area,
+            },
+            _FLOOD_TOPO_RESULT,
         )
 
     def close(self) -> None:

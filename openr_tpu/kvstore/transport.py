@@ -25,12 +25,13 @@ KVSTORE_RPC_PORT = 60002
 class KvStorePeerServer:
     """Expose a KvStore to remote peers."""
 
-    def __init__(self, kvstore: KvStore, host: str = "::", port: int = 0):
+    def __init__(self, kvstore: KvStore, host: str = "::", port: int = 0,
+                 listen: bool = True):
         self._kvstore = kvstore
         # "::" binds dual-stack v6 (RpcServer picks AF_INET6 for v6
         # hosts) — neighbors dial fe80:: link-local transports, which a
         # v4-only listener can never accept
-        self._server = RpcServer(host=host, port=port)
+        self._server = RpcServer(host=host, port=port, listen=listen)
         self._server.register(
             "getKvStoreKeyValsFiltered",
             self._get_filtered,

@@ -150,12 +150,19 @@ class RpcServer:
     connecting to a TLS server fails its first frame and falls back."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 ssl_context=None):
+                 ssl_context=None, listen: bool = True):
         self._methods: Dict[str, Tuple[Callable, List[Any], Any]] = {}
         self._active: set = set()
         self._active_lock = threading.Lock()
         self._ssl_context = ssl_context
         outer = self
+        if not listen:
+            # pure dispatcher for byte-sniffing demultiplexers: no
+            # socket is bound, start()/stop() are no-ops
+            self._server = None
+            self._thread = None
+            self.port = 0
+            return
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
@@ -209,9 +216,12 @@ class RpcServer:
         self._methods[name] = (fn, arg_types, result_type)
 
     def start(self) -> None:
-        self._thread.start()
+        if self._thread is not None:
+            self._thread.start()
 
     def stop(self) -> None:
+        if self._server is None:
+            return
         self._server.shutdown()
         self._server.server_close()
         # a stopped server must stop serving: close established
@@ -312,3 +322,31 @@ def connect_with_tls_fallback(
         host, port, timeout_s,
         ssl_context=probe_tls(host, port, timeout_s),
     )
+
+
+def peek_first_bytes(sock, n: int, deadline_s: float = 30.0):
+    """Wait until the first ``n`` bytes of a connection are buffered
+    and return them WITHOUT consuming (MSG_PEEK). Clients that write a
+    frame header and payload in separate sends (several stock thrift
+    transports do) need more than one peek. Returns None on timeout or
+    hang-up. Shared by every dual-stack byte-sniffing listener
+    (kvstore/dualstack.py, ctrl/server.py)."""
+    import time as _time
+
+    deadline = _time.monotonic() + deadline_s
+    while True:
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            return None
+        sock.settimeout(remaining)
+        try:
+            head = sock.recv(n, socket.MSG_PEEK)
+        except OSError:
+            return None
+        if not head:
+            return None  # peer hung up
+        if len(head) >= n:
+            return head
+        # partial arrival: yield briefly rather than hot-spinning on
+        # MSG_PEEK (which does not consume and so returns immediately)
+        _time.sleep(0.005)

@@ -1,0 +1,147 @@
+"""fbthrift THeader framing: unwrap/wrap for the dual-stack listeners.
+
+Stock fbthrift clients default to the Header transport (the reference's
+peer/ctrl channels are fbthrift clients — e.g. the KvStore thrift peer
+sync, kvstore/KvStore.cpp:1400 requestThriftPeerSync). A Header frame
+is NOT a bare framed thrift message: after the 4-byte frame length the
+payload leads with the 0x0FFF magic, so the byte-sniffing listeners
+would previously misclassify a Header-wrapped dial. This module parses
+exactly the fbthrift HeaderFormat (fbthrift THeader.h / the public
+THeader framing spec):
+
+    u32  LENGTH        (excluded from itself)
+    u16  MAGIC 0x0FFF
+    u16  FLAGS
+    u32  SEQUENCE NUMBER
+    u16  HEADER SIZE   (in 4-byte words, counting from after this u16)
+    varint PROTOCOL ID (0 = binary, 2 = compact)
+    varint NUM TRANSFORMS, then varint transform ids
+    info headers (INFO_KEYVALUE = 1: varint count, then varstring
+    key/value pairs), zero-padded to the declared header size
+    PAYLOAD            (the thrift message in the declared protocol)
+
+Only the untransformed compact-protocol payload is supported — the
+transports this repo speaks everywhere else. Unsupported protocol ids
+or transforms raise (the caller hangs up; a stock client surfaces a
+transport error rather than silence).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional, Tuple
+
+MAGIC = 0x0FFF
+PROTO_BINARY = 0
+PROTO_COMPACT = 2
+INFO_KEYVALUE = 1
+INFO_PKEYVALUE = 2
+
+
+def looks_like_theader(frame_payload: bytes) -> bool:
+    """True when a framed payload leads with the THeader magic."""
+    return (
+        len(frame_payload) >= 2
+        and struct.unpack(">H", frame_payload[:2])[0] == MAGIC
+    )
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _read_varstring(data: bytes, pos: int) -> Tuple[bytes, int]:
+    n, pos = _read_varint(data, pos)
+    return data[pos : pos + n], pos + n
+
+
+def unwrap(frame_payload: bytes) -> Tuple[bytes, int, Dict[str, str]]:
+    """THeader frame payload -> (thrift compact message, seqid, info
+    key/values). Raises ValueError on ANY malformed frame (truncation
+    included) — callers catch one exception type and hang up."""
+    try:
+        return _unwrap(frame_payload)
+    except (IndexError, struct.error) as exc:
+        raise ValueError(f"truncated THeader frame: {exc}") from exc
+
+
+def _unwrap(frame_payload: bytes) -> Tuple[bytes, int, Dict[str, str]]:
+    if not looks_like_theader(frame_payload):
+        raise ValueError("not a THeader frame")
+    flags, seqid, header_words = struct.unpack(
+        ">HIH", frame_payload[2:10]
+    )
+    del flags  # no flag semantics for plain request/response
+    header_end = 10 + header_words * 4
+    if header_end > len(frame_payload):
+        raise ValueError("THeader header overruns frame")
+    pos = 10
+    proto, pos = _read_varint(frame_payload, pos)
+    if proto != PROTO_COMPACT:
+        raise ValueError(
+            f"unsupported THeader protocol id {proto} (compact only)"
+        )
+    n_transforms, pos = _read_varint(frame_payload, pos)
+    if n_transforms:
+        raise ValueError(
+            f"unsupported THeader transforms ({n_transforms})"
+        )
+    info: Dict[str, str] = {}
+    while pos < header_end:
+        info_id, pos = _read_varint(frame_payload, pos)
+        if info_id == 0:  # zero padding
+            break
+        if info_id not in (INFO_KEYVALUE, INFO_PKEYVALUE):
+            raise ValueError(f"unknown THeader info id {info_id}")
+        count, pos = _read_varint(frame_payload, pos)
+        for _ in range(count):
+            k, pos = _read_varstring(frame_payload, pos)
+            v, pos = _read_varstring(frame_payload, pos)
+            info[k.decode("utf-8", "replace")] = v.decode(
+                "utf-8", "replace"
+            )
+    return frame_payload[header_end:], seqid, info
+
+
+def _write_varint(buf: bytearray, n: int) -> None:
+    while True:
+        if n < 0x80:
+            buf.append(n)
+            return
+        buf.append((n & 0x7F) | 0x80)
+        n >>= 7
+
+
+def wrap(message: bytes, seqid: int,
+         info: Optional[Dict[str, str]] = None) -> bytes:
+    """Compact thrift message -> THeader frame payload (the outer
+    4-byte frame length is the transport's job, utils/thrift_rpc
+    frame())."""
+    header = bytearray()
+    _write_varint(header, PROTO_COMPACT)
+    _write_varint(header, 0)  # no transforms
+    if info:
+        _write_varint(header, INFO_KEYVALUE)
+        _write_varint(header, len(info))
+        for k, v in info.items():
+            kb, vb = k.encode("utf-8"), v.encode("utf-8")
+            _write_varint(header, len(kb))
+            header.extend(kb)
+            _write_varint(header, len(vb))
+            header.extend(vb)
+    while len(header) % 4:
+        header.append(0)
+    return (
+        struct.pack(">HHIH", MAGIC, 0, seqid & 0xFFFFFFFF,
+                    len(header) // 4)
+        + bytes(header)
+        + message
+    )

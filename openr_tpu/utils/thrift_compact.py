@@ -806,3 +806,344 @@ def _mpls_route_from_wire(d: Dict):
             else None
         ),
     )
+
+
+# -- Lsdb.thrift schemas (the ctrl surface's adjacency/prefix dumps) -----
+
+# reference: openr/if/Lsdb.thrift Adjacency (ids 1,2,3,5,4,6,7,8,9,10,11
+# — declaration order has nextHopV4 at id 5 between 3 and 4)
+ADJACENCY = StructSchema(
+    "Adjacency",
+    (
+        Field(1, ("string",), "otherNodeName"),
+        Field(2, ("string",), "ifName"),
+        Field(3, ("struct", BINARY_ADDRESS), "nextHopV6"),
+        Field(5, ("struct", BINARY_ADDRESS), "nextHopV4"),
+        Field(4, ("i32",), "metric"),
+        Field(6, ("i32",), "adjLabel"),
+        Field(7, ("bool",), "isOverloaded"),
+        Field(8, ("i32",), "rtt"),
+        Field(9, ("i64",), "timestamp"),
+        Field(10, ("i64",), "weight"),
+        Field(11, ("string",), "otherIfName"),
+    ),
+)
+
+# reference: openr/if/Lsdb.thrift AdjacencyDatabase (perfEvents omitted)
+ADJACENCY_DATABASE = StructSchema(
+    "AdjacencyDatabase",
+    (
+        Field(1, ("string",), "thisNodeName"),
+        Field(2, ("bool",), "isOverloaded"),
+        Field(3, ("list", ("struct", ADJACENCY)), "adjacencies"),
+        Field(4, ("i32",), "nodeLabel"),
+        Field(6, ("string",), "area"),
+    ),
+)
+
+# reference: openr/if/Lsdb.thrift PrefixMetrics
+PREFIX_METRICS = StructSchema(
+    "PrefixMetrics",
+    (
+        Field(1, ("i32",), "version"),
+        Field(2, ("i32",), "path_preference"),
+        Field(3, ("i32",), "source_preference"),
+        Field(4, ("i32",), "distance"),
+    ),
+)
+
+# reference: openr/if/Lsdb.thrift PrefixEntry (declaration order
+# 1,2,3,4,7,5,6,8,9,10,11,12; deprecated mv/ephemeral omitted)
+PREFIX_ENTRY = StructSchema(
+    "PrefixEntry",
+    (
+        Field(1, ("struct", IP_PREFIX), "prefix"),
+        Field(2, ("i32",), "type"),
+        Field(3, ("binary",), "data", optional=True),
+        Field(4, ("i32",), "forwardingType"),
+        Field(7, ("i32",), "forwardingAlgorithm"),
+        Field(8, ("i64",), "minNexthop", optional=True),
+        Field(9, ("i32",), "prependLabel", optional=True),
+        Field(10, ("struct", PREFIX_METRICS), "metrics"),
+        Field(11, ("set", ("string",)), "tags"),
+        Field(12, ("list", ("string",)), "area_stack"),
+    ),
+)
+
+# reference: openr/if/Lsdb.thrift PrefixDatabase (numbering intentional:
+# 1,3,5,7; perfEvents omitted)
+PREFIX_DATABASE = StructSchema(
+    "PrefixDatabase",
+    (
+        Field(1, ("string",), "thisNodeName"),
+        Field(3, ("list", ("struct", PREFIX_ENTRY)), "prefixEntries"),
+        Field(5, ("bool",), "deletePrefix"),
+        Field(7, ("string",), "area"),
+    ),
+)
+
+# reference: openr/if/Fib.thrift RouteDatabase (perfEvents omitted)
+ROUTE_DATABASE = StructSchema(
+    "RouteDatabase",
+    (
+        Field(1, ("string",), "thisNodeName"),
+        Field(4, ("list", ("struct", UNICAST_ROUTE)), "unicastRoutes"),
+        Field(5, ("list", ("struct", MPLS_ROUTE)), "mplsRoutes"),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift PeerSpec
+PEER_SPEC = StructSchema(
+    "PeerSpec",
+    (
+        Field(1, ("string",), "peerAddr"),
+        Field(2, ("string",), "cmdUrl"),
+        Field(4, ("i32",), "ctrlPort"),
+    ),
+)
+
+# reference: openr/if/Spark.thrift OpenrVersions
+OPENR_VERSIONS = StructSchema(
+    "OpenrVersions",
+    (
+        Field(1, ("i32",), "version"),
+        Field(2, ("i32",), "lowestSupportedVersion"),
+    ),
+)
+
+# reference: openr/if/OpenrCtrl.thrift exception OpenrError
+OPENR_ERROR = StructSchema(
+    "OpenrError", (Field(1, ("string",), "message"),)
+)
+
+
+def _adjacency_to_wire(a) -> Dict:
+    return {
+        "otherNodeName": a.other_node_name,
+        "ifName": a.if_name,
+        "nextHopV6": _bin_addr_to_wire(a.next_hop_v6),
+        "nextHopV4": _bin_addr_to_wire(a.next_hop_v4),
+        "metric": int(a.metric),
+        "adjLabel": int(a.adj_label),
+        "isOverloaded": bool(a.is_overloaded),
+        "rtt": int(a.rtt),
+        "timestamp": int(a.timestamp),
+        "weight": int(a.weight),
+        "otherIfName": a.other_if_name,
+    }
+
+
+def _adjacency_from_wire(d: Dict):
+    from openr_tpu.types import Adjacency
+
+    return Adjacency(
+        other_node_name=d.get("otherNodeName", ""),
+        if_name=d.get("ifName", ""),
+        next_hop_v6=_bin_addr_from_wire(d.get("nextHopV6", {})),
+        next_hop_v4=_bin_addr_from_wire(d.get("nextHopV4", {})),
+        metric=d.get("metric", 1),
+        adj_label=d.get("adjLabel", 0),
+        is_overloaded=d.get("isOverloaded", False),
+        rtt=d.get("rtt", 0),
+        timestamp=d.get("timestamp", 0),
+        weight=d.get("weight", 1),
+        other_if_name=d.get("otherIfName", ""),
+    )
+
+
+def adjacency_db_to_wire(db) -> Dict:
+    return {
+        "thisNodeName": db.this_node_name,
+        "isOverloaded": bool(db.is_overloaded),
+        "adjacencies": [
+            _adjacency_to_wire(a) for a in db.adjacencies
+        ],
+        "nodeLabel": int(db.node_label),
+        "area": db.area,
+    }
+
+
+def adjacency_db_from_wire(d: Dict):
+    from openr_tpu.types import AdjacencyDatabase
+
+    return AdjacencyDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        is_overloaded=d.get("isOverloaded", False),
+        adjacencies=tuple(
+            _adjacency_from_wire(a) for a in d.get("adjacencies", [])
+        ),
+        node_label=d.get("nodeLabel", 0),
+        area=d.get("area", "0"),
+    )
+
+
+def _prefix_entry_to_wire(e) -> Dict:
+    out: Dict = {
+        "prefix": _ip_prefix_to_wire(e.prefix),
+        "type": int(e.type.value if hasattr(e.type, "value") else e.type),
+        "forwardingType": int(
+            e.forwarding_type.value
+            if hasattr(e.forwarding_type, "value")
+            else e.forwarding_type
+        ),
+        "forwardingAlgorithm": int(
+            e.forwarding_algorithm.value
+            if hasattr(e.forwarding_algorithm, "value")
+            else e.forwarding_algorithm
+        ),
+        "metrics": {
+            "version": e.metrics.version,
+            "path_preference": e.metrics.path_preference,
+            "source_preference": e.metrics.source_preference,
+            "distance": e.metrics.distance,
+        },
+        "tags": sorted(e.tags),
+        "area_stack": list(e.area_stack),
+    }
+    if e.data is not None:
+        out["data"] = e.data
+    if e.min_nexthop is not None:
+        out["minNexthop"] = int(e.min_nexthop)
+    if e.prepend_label is not None:
+        out["prependLabel"] = int(e.prepend_label)
+    return out
+
+
+def _prefix_entry_from_wire(d: Dict):
+    from openr_tpu.types import (
+        PrefixEntry,
+        PrefixForwardingAlgorithm,
+        PrefixForwardingType,
+        PrefixMetrics,
+        PrefixType,
+    )
+
+    m = d.get("metrics", {})
+    return PrefixEntry(
+        prefix=_ip_prefix_from_wire(d.get("prefix", {})),
+        type=PrefixType(d.get("type", PrefixType.DEFAULT.value)),
+        forwarding_type=PrefixForwardingType(d.get("forwardingType", 0)),
+        forwarding_algorithm=PrefixForwardingAlgorithm(
+            d.get("forwardingAlgorithm", 0)
+        ),
+        min_nexthop=d.get("minNexthop"),
+        prepend_label=d.get("prependLabel"),
+        metrics=PrefixMetrics(
+            version=m.get("version", 1),
+            path_preference=m.get("path_preference", 0),
+            source_preference=m.get("source_preference", 0),
+            distance=m.get("distance", 0),
+        ),
+        tags=tuple(sorted(d.get("tags", ()))),
+        area_stack=tuple(d.get("area_stack", ())),
+        data=d.get("data"),
+    )
+
+
+def prefix_db_to_wire(db) -> Dict:
+    return {
+        "thisNodeName": db.this_node_name,
+        "prefixEntries": [
+            _prefix_entry_to_wire(e) for e in db.prefix_entries
+        ],
+        "deletePrefix": bool(db.delete_prefix),
+        "area": db.area,
+    }
+
+
+def prefix_db_from_wire(d: Dict):
+    from openr_tpu.types import PrefixDatabase
+
+    return PrefixDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        prefix_entries=tuple(
+            _prefix_entry_from_wire(e) for e in d.get("prefixEntries", [])
+        ),
+        delete_prefix=d.get("deletePrefix", False),
+        area=d.get("area", "0"),
+    )
+
+
+def route_db_to_wire(db) -> Dict:
+    return {
+        "thisNodeName": db.this_node_name,
+        "unicastRoutes": [
+            _unicast_route_to_wire(r) for r in db.unicast_routes
+        ],
+        "mplsRoutes": [_mpls_route_to_wire(r) for r in db.mpls_routes],
+    }
+
+
+def route_db_from_wire(d: Dict):
+    from openr_tpu.types.fib import RouteDatabase
+
+    return RouteDatabase(
+        this_node_name=d.get("thisNodeName", ""),
+        unicast_routes=[
+            _unicast_route_from_wire(r)
+            for r in d.get("unicastRoutes", [])
+        ],
+        mpls_routes=[
+            _mpls_route_from_wire(r) for r in d.get("mplsRoutes", [])
+        ],
+    )
+
+
+# -- Dual.thrift schemas (flood-optimization over the peer wire) ---------
+
+# reference: openr/if/Dual.thrift:24-31
+DUAL_MESSAGE = StructSchema(
+    "DualMessage",
+    (
+        Field(1, ("string",), "dstId"),
+        Field(2, ("i64",), "distance"),
+        Field(3, ("i32",), "type"),
+    ),
+)
+
+# reference: openr/if/Dual.thrift:33-38
+DUAL_MESSAGES = StructSchema(
+    "DualMessages",
+    (
+        Field(1, ("string",), "srcId"),
+        Field(2, ("list", ("struct", DUAL_MESSAGE)), "messages"),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:155-165
+FLOOD_TOPO_SET_PARAMS = StructSchema(
+    "FloodTopoSetParams",
+    (
+        Field(1, ("string",), "rootId"),
+        Field(2, ("string",), "srcId"),
+        Field(3, ("bool",), "setChild"),
+        Field(4, ("bool",), "allRoots", optional=True),
+    ),
+)
+
+
+def dual_messages_to_wire(src_id: str, msgs) -> Dict:
+    return {
+        "srcId": src_id,
+        "messages": [
+            {
+                "dstId": m.dst_id,
+                "distance": int(m.distance),
+                "type": int(m.type),
+            }
+            for m in msgs
+        ],
+    }
+
+
+def dual_messages_from_wire(d: Dict):
+    from openr_tpu.dual.dual import DualMessage, DualMessageType
+
+    return d.get("srcId", ""), [
+        DualMessage(
+            dst_id=m.get("dstId", ""),
+            distance=m.get("distance", 0),
+            type=DualMessageType(m.get("type", 1)),
+        )
+        for m in d.get("messages", [])
+    ]

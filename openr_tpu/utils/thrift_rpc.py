@@ -104,13 +104,26 @@ class FramedCompactServer:
     """Threaded TCP server dispatching a framed-compact method table.
     Dispatch errors reply as TApplicationException rather than closing
     the connection (a stock thrift client expects a reply frame, not a
-    bare EOF)."""
+    bare EOF).
+
+    ``listen=False`` builds a pure DISPATCHER: no socket is ever bound
+    and start()/stop() are no-ops — for byte-sniffing demultiplexers
+    (kvstore/dualstack.py, ctrl/server.py) that accept on their own
+    port and hand classified connections to ``serve_connection``.
+    Without this, every demux would carry a hidden live loopback
+    listener just to reuse the request loop."""
 
     def __init__(
-        self, methods: MethodTable, host: str = "0.0.0.0", port: int = 0
+        self, methods: MethodTable, host: str = "0.0.0.0", port: int = 0,
+        listen: bool = True,
     ):
         outer = self
         self._methods = methods
+        self._thread: Optional[threading.Thread] = None
+        if not listen:
+            self._server = None
+            self.port = 0
+            return
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
@@ -123,11 +136,16 @@ class FramedCompactServer:
         apply_bind_family(Server, host)
         self._server = Server((host, port), Handler)
         self.port = self._server.server_address[1]
-        self._thread: Optional[threading.Thread] = None
 
     def serve_connection(self, sock) -> None:
         """Run the request loop on an already-accepted socket (shared
-        by the own listener and external demultiplexers)."""
+        by the own listener and external demultiplexers). Each frame
+        may be a bare framed-compact message OR a THeader-wrapped one
+        (the fbthrift default transport — a stock client's dial,
+        reference kvstore/KvStore.cpp:1400); replies mirror the
+        request's wrapping."""
+        from openr_tpu.utils import theader
+
         while True:
             try:
                 data = read_frame(sock)
@@ -135,12 +153,20 @@ class FramedCompactServer:
                 return
             if data is None:
                 return
+            wrapped_seqid = None
+            if theader.looks_like_theader(data):
+                try:
+                    data, wrapped_seqid, _info = theader.unwrap(data)
+                except ValueError:
+                    return  # unsupported protocol/transform: hang up
             try:
                 reply = self._dispatch(data)
             except Exception as exc:
                 reply = self._exception_reply(data, exc)
                 if reply is None:  # header itself unparseable
                     return
+            if wrapped_seqid is not None:
+                reply = theader.wrap(reply, wrapped_seqid)
             try:
                 sock.sendall(frame(reply))
             except OSError:
@@ -174,6 +200,8 @@ class FramedCompactServer:
         )
 
     def start(self) -> None:
+        if self._server is None:
+            return
         self._thread = threading.Thread(
             target=self._server.serve_forever,
             name="framed-compact-rpc",
@@ -182,6 +210,8 @@ class FramedCompactServer:
         self._thread.start()
 
     def stop(self) -> None:
+        if self._server is None:
+            return
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
@@ -191,14 +221,19 @@ class FramedCompactServer:
 
 class FramedCompactClient:
     """One-connection framed-compact caller (reconnects per call after
-    a transport error)."""
+    a transport error). ``theader=True`` wraps every call in the
+    fbthrift Header transport — the shape a STOCK fbthrift client puts
+    on the wire — and unwraps replies (tests use this to prove the
+    dual-stack listeners accept a Header-framed dial)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 theader: bool = False):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._seqid = 0
+        self._theader = theader
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -215,6 +250,10 @@ class FramedCompactClient:
             payload = encode_message(
                 name, TYPE_CALL, seqid, args_schema, args
             )
+            if self._theader:
+                from openr_tpu.utils import theader as th
+
+                payload = th.wrap(payload, seqid)
             try:
                 sock = self._connect()
                 sock.sendall(frame(payload))
@@ -225,6 +264,20 @@ class FramedCompactClient:
             if data is None:
                 self.close()
                 raise ConnectionError("peer closed mid-call")
+            if self._theader:
+                from openr_tpu.utils import theader as th
+
+                if not th.looks_like_theader(data):
+                    self.close()
+                    raise ConnectionError(
+                        "peer replied without THeader wrapping"
+                    )
+                data, rhdr_seq, _info = th.unwrap(data)
+                if rhdr_seq != seqid:
+                    self.close()
+                    raise ConnectionError(
+                        f"out-of-sync THeader reply {rhdr_seq}"
+                    )
             rname, mtype, rseq, off = decode_message_header(data)
             if mtype == TYPE_EXCEPTION:
                 exc = tc.decode(TAPP_EXC, data[off:])

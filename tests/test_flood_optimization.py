@@ -141,3 +141,134 @@ class TestFloodOptimization:
         finally:
             for n in ("b", "c"):
                 stores[n].stop()
+
+
+class TestFloodOptimizationThriftWire:
+    """DUAL over the thrift peer channel (reference: Command.DUAL on
+    the same peer wire, KvStore.thrift:47-52; service methods
+    OpenrCtrl.thrift:416 processKvStoreDualMessage / :424
+    updateFloodTopologyChild) — and over MIXED wires, the
+    mid-migration fleet the reference dual-stacks for
+    (KvStore.cpp:2940-2973)."""
+
+    @staticmethod
+    def thrift_net(names, edges, root, mixed=()):
+        """Line/star net where peer links ride the thrift wire, except
+        links whose BOTH ends are in ``mixed`` (those use the
+        framework in-process transport)."""
+        from openr_tpu.kvstore.thrift_peer import (
+            KvStoreThriftPeerServer,
+            ThriftPeerTransport,
+        )
+        from openr_tpu.kvstore.store import InProcessTransport
+
+        stores = {
+            n: KvStoreWrapper(
+                n, enable_flood_optimization=True,
+                is_flood_root=(n == root),
+            )
+            for n in names
+        }
+        servers = {}
+        for n, s in stores.items():
+            s.start()
+            servers[n] = KvStoreThriftPeerServer(
+                s.store, host="127.0.0.1"
+            )
+            servers[n].start()
+
+        def transport_to(a, b):
+            if a in mixed and b in mixed:
+                return InProcessTransport(stores[b].store)
+            return ThriftPeerTransport("127.0.0.1", servers[b].port)
+
+        for a, b in edges:
+            stores[a].store.add_peer("0", b, transport_to(a, b))
+            stores[b].store.add_peer("0", a, transport_to(b, a))
+        return stores, servers
+
+    @staticmethod
+    def stop_net(stores, servers):
+        for s in stores.values():
+            s.stop()
+        for srv in servers.values():
+            srv.stop()
+
+    def test_spt_forms_over_thrift_wire(self):
+        stores, servers = self.thrift_net(
+            ["a", "b", "c", "d"],
+            [("a", "b"), ("b", "c"), ("c", "d")],
+            root="a",
+        )
+        try:
+            wait_initialized(stores)
+            time.sleep(0.5)  # let DUAL converge over TCP
+            dual_b = stores["b"].store._dbs["0"].dual
+            root = dual_b.pick_flood_root()
+            assert root == "a"
+            assert dual_b.spt_peers(root) >= {"a", "c"}
+            stores["a"].set_key("adj:a", b"va", version=1, originator="a")
+            for n in ("b", "c", "d"):
+                assert wait_key(stores[n], "adj:a"), n
+        finally:
+            self.stop_net(stores, servers)
+
+    def test_spt_flood_counter_over_thrift_wire(self):
+        stores, servers = self.thrift_net(
+            ["a", "b", "c"],
+            [("a", "b"), ("b", "c"), ("a", "c")],
+            root="a",
+        )
+        try:
+            wait_initialized(stores)
+            time.sleep(0.5)
+            stores["a"].set_key(
+                "prefix:a", b"pa", version=1, originator="a"
+            )
+            assert wait_key(stores["b"], "prefix:a")
+            assert wait_key(stores["c"], "prefix:a")
+            assert (
+                stores["a"].store.counters()["kvstore.spt_floods"] >= 1
+            )
+        finally:
+            self.stop_net(stores, servers)
+
+    def test_mixed_wire_fleet_keeps_flood_optimization(self):
+        # a-b over the framework wire, b-c and c-d over thrift: the
+        # mid-migration fleet keeps ONE spanning tree across both wires
+        stores, servers = self.thrift_net(
+            ["a", "b", "c", "d"],
+            [("a", "b"), ("b", "c"), ("c", "d")],
+            root="a",
+            mixed={"a", "b"},
+        )
+        try:
+            wait_initialized(stores)
+            time.sleep(0.5)
+            dual_d = stores["d"].store._dbs["0"].dual
+            root = dual_d.pick_flood_root()
+            assert root == "a"
+            stores["d"].set_key("adj:d", b"vd", version=1, originator="d")
+            for n in ("a", "b", "c"):
+                assert wait_key(stores[n], "adj:d"), n
+            stores["a"].set_key("adj:a", b"va", version=1, originator="a")
+            for n in ("b", "c", "d"):
+                assert wait_key(stores[n], "adj:a"), n
+        finally:
+            self.stop_net(stores, servers)
+
+    def test_thrift_plus_flood_optimization_config_accepted(self):
+        from openr_tpu.config.config import OpenrConfig
+
+        cfg = OpenrConfig.from_dict(
+            {
+                "node_name": "x",
+                "areas": [{"area_id": "0"}],
+                "kvstore": {
+                    "enable_kvstore_thrift": True,
+                    "enable_flood_optimization": True,
+                },
+            }
+        )
+        assert cfg.kvstore.enable_kvstore_thrift
+        assert cfg.kvstore.enable_flood_optimization

@@ -1,0 +1,338 @@
+"""fbthrift THeader transport acceptance: the dual-stack listeners
+must serve a Header-wrapped dial (the stock fbthrift client default —
+reference peer channel, kvstore/KvStore.cpp:1400) alongside bare
+framed-compact and the framework codec, on the same advertised port."""
+
+import struct
+import threading
+import time
+
+import pytest
+
+from openr_tpu.kvstore.dualstack import DualStackPeerServer
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.utils import theader
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.thrift_rpc import FramedCompactClient
+
+
+class TestTHeaderFraming:
+    def test_wrap_layout(self):
+        msg = b"\x82\x21\x01\x04ping\x00"
+        frame = theader.wrap(msg, seqid=7)
+        magic, flags, seqid, words = struct.unpack(">HHIH", frame[:10])
+        assert magic == 0x0FFF
+        assert flags == 0
+        assert seqid == 7
+        # header: varint proto (compact=2), varint 0 transforms, padding
+        header = frame[10 : 10 + words * 4]
+        assert header[0] == theader.PROTO_COMPACT
+        assert header[1] == 0
+        assert all(b == 0 for b in header[2:])  # zero padding
+        assert frame[10 + words * 4 :] == msg
+
+    def test_unwrap_round_trip(self):
+        msg = b"\x82\x41\x05\x03abc\x00payload"
+        frame = theader.wrap(msg, seqid=99, info={"client": "test"})
+        out, seqid, info = theader.unwrap(frame)
+        assert out == msg
+        assert seqid == 99
+        assert info == {"client": "test"}
+
+    def test_unwrap_rejects_binary_protocol(self):
+        frame = bytearray(theader.wrap(b"x", seqid=1))
+        frame[10] = theader.PROTO_BINARY
+        with pytest.raises(ValueError, match="protocol"):
+            theader.unwrap(bytes(frame))
+
+    def test_unwrap_rejects_transforms(self):
+        # hand-build: proto=2, 1 transform (id 1 = zlib)
+        header = bytes([theader.PROTO_COMPACT, 1, 1, 0])
+        frame = struct.pack(">HHIH", 0x0FFF, 0, 1, 1) + header + b"x"
+        with pytest.raises(ValueError, match="transform"):
+            theader.unwrap(frame)
+
+    def test_not_theader(self):
+        assert not theader.looks_like_theader(b"\x82\x21")
+        assert theader.looks_like_theader(
+            theader.wrap(b"x", seqid=0)
+        )
+
+
+def wait_until(pred, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class TestTHeaderOnDualStackPort:
+    def test_theader_client_syncs_kvstore(self):
+        """A Header-wrapped dial on the kvStoreCmdPort: the sniffer
+        classifies it onto the thrift backend and the reply comes back
+        Header-wrapped."""
+        from openr_tpu.kvstore.thrift_peer import (
+            _GET_ARGS,
+            _GET_RESULT,
+        )
+
+        a = KvStoreWrapper("a")
+        a.start()
+        server = DualStackPeerServer(a.store, host="127.0.0.1")
+        server.start()
+        try:
+            a.set_key("adj:a", b"va", version=1)
+            client = FramedCompactClient(
+                "127.0.0.1", server.port, theader=True
+            )
+            result = client.call(
+                "getKvStoreKeyValsFilteredArea",
+                _GET_ARGS,
+                {"filter": {"prefix": "adj:", "originatorIds": [],
+                            "ignoreTtl": False,
+                            "doNotPublishValue": False},
+                 "area": "0"},
+                _GET_RESULT,
+            )
+            assert "adj:a" in result["success"]["keyVals"]
+            client.close()
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_three_wires_one_port(self):
+        """framed-compact, THeader and the framework RPC codec all
+        served concurrently on the one advertised peer port."""
+        from openr_tpu.kvstore.store import InProcessTransport
+        from openr_tpu.kvstore.thrift_peer import (
+            _GET_ARGS,
+            _GET_RESULT,
+            ThriftPeerTransport,
+        )
+        from openr_tpu.kvstore.transport import TcpPeerTransport
+
+        a = KvStoreWrapper("a")
+        a.start()
+        a.set_key("adj:a", b"va", version=1)
+        server = DualStackPeerServer(a.store, host="127.0.0.1")
+        server.start()
+        try:
+            # wire 1: bare framed compact
+            framed = ThriftPeerTransport("127.0.0.1", server.port)
+            pub = framed.get_key_vals("0", ["adj:a"])
+            assert "adj:a" in pub.key_vals
+            framed.close()
+            # wire 2: THeader-wrapped compact
+            th = FramedCompactClient(
+                "127.0.0.1", server.port, theader=True
+            )
+            result = th.call(
+                "getKvStoreKeyValsFilteredArea",
+                _GET_ARGS,
+                {"filter": {"prefix": "adj:", "originatorIds": [],
+                            "ignoreTtl": False,
+                            "doNotPublishValue": False},
+                 "area": "0"},
+                _GET_RESULT,
+            )
+            assert "adj:a" in result["success"]["keyVals"]
+            th.close()
+            # wire 3: framework RPC codec
+            rpc = TcpPeerTransport("127.0.0.1", server.port)
+            pub = rpc.get_key_vals_filtered("0", __import__(
+                "openr_tpu.types", fromlist=["KeyDumpParams"]
+            ).KeyDumpParams(prefix="adj:"))
+            assert "adj:a" in pub.key_vals
+            rpc.close()
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_theader_on_ctrl_port(self):
+        """The ctrl port's sniffer routes a THeader dial to the thrift
+        OpenrCtrl backend."""
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.server import CtrlServer
+        from openr_tpu.ctrl.thrift_ctrl import build_method_table
+
+        a = KvStoreWrapper("x-node")
+        a.start()
+        handler = OpenrCtrlHandler("x-node", kvstore=a.store)
+        server = CtrlServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            _, methods = build_method_table(handler)
+            m = methods["getMyNodeName"]
+            client = FramedCompactClient(
+                "127.0.0.1", server.port, theader=True
+            )
+            result = client.call(
+                "getMyNodeName", m.args_schema, {}, m.result_schema
+            )
+            assert result["success"] == "x-node"
+            client.close()
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_theader_mixed_frames_same_connection(self):
+        """The server mirrors wrapping PER FRAME: one connection may
+        alternate bare and Header-wrapped calls (a proxy funneling two
+        client kinds through one socket)."""
+        import socket as _socket
+
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.server import CtrlServer
+        from openr_tpu.ctrl.thrift_ctrl import build_method_table
+        from openr_tpu.utils.thrift_rpc import (
+            TYPE_CALL,
+            encode_message,
+            frame,
+            read_frame,
+        )
+
+        a = KvStoreWrapper("y-node")
+        a.start()
+        handler = OpenrCtrlHandler("y-node", kvstore=a.store)
+        server = CtrlServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            _, methods = build_method_table(handler)
+            m = methods["getMyNodeName"]
+            sock = _socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            # frame 1: THeader-wrapped (this classifies the connection)
+            msg = encode_message(
+                "getMyNodeName", TYPE_CALL, 1, m.args_schema, {}
+            )
+            sock.sendall(frame(theader.wrap(msg, seqid=1)))
+            reply = read_frame(sock)
+            assert theader.looks_like_theader(reply)
+            inner, seqid, _ = theader.unwrap(reply)
+            assert seqid == 1
+            assert b"y-node" in inner
+            # frame 2: bare framed compact on the SAME connection
+            msg2 = encode_message(
+                "getMyNodeName", TYPE_CALL, 2, m.args_schema, {}
+            )
+            sock.sendall(frame(msg2))
+            reply2 = read_frame(sock)
+            assert not theader.looks_like_theader(reply2)
+            assert b"y-node" in reply2
+            sock.close()
+        finally:
+            server.stop()
+            a.stop()
+
+
+class TestTlsGatedThrift:
+    """TLS on the ctrl port gates EVERY wire: thrift arrives inside the
+    TLS stream (classified post-handshake), plaintext thrift is
+    rejected — no sniff path bypasses the operator's TLS setting."""
+
+    @staticmethod
+    def _tls_ctx(tmp_path):
+        import ssl
+        import subprocess
+
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", str(key), "-out", str(cert),
+             "-days", "1", "-nodes", "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(str(cert), str(key))
+        return ctx
+
+    def test_thrift_over_tls_and_plaintext_rejected(self, tmp_path):
+        import socket as _socket
+        import ssl
+
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.server import CtrlServer
+        from openr_tpu.ctrl.thrift_ctrl import build_method_table
+        from openr_tpu.utils.thrift_rpc import (
+            TYPE_CALL,
+            decode_message_header,
+            encode_message,
+            frame,
+            read_frame,
+        )
+
+        a = KvStoreWrapper("tls-node")
+        a.start()
+        handler = OpenrCtrlHandler("tls-node", kvstore=a.store)
+        server = CtrlServer(
+            handler, host="127.0.0.1",
+            ssl_context=self._tls_ctx(tmp_path),
+        )
+        server.start()
+        try:
+            _, methods = build_method_table(handler)
+            m = methods["getMyNodeName"]
+            # thrift INSIDE TLS: works (classified after the handshake)
+            cctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            cctx.check_hostname = False
+            cctx.verify_mode = ssl.CERT_NONE
+            raw = _socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            tls = cctx.wrap_socket(raw, server_hostname="127.0.0.1")
+            msg = encode_message(
+                "getMyNodeName", TYPE_CALL, 1, m.args_schema, {}
+            )
+            tls.sendall(frame(msg))
+            reply = read_frame(tls)
+            assert reply is not None and b"tls-node" in reply
+            name, _, _, _ = decode_message_header(reply)
+            assert name == "getMyNodeName"
+            tls.close()
+            # PLAINTEXT thrift: rejected (connection closed, no reply)
+            plain = _socket.create_connection(
+                ("127.0.0.1", server.port), timeout=10
+            )
+            plain.sendall(frame(msg))
+            plain.settimeout(5)
+            assert plain.recv(4) == b""  # server hung up
+            plain.close()
+        finally:
+            server.stop()
+            a.stop()
+
+
+class TestFloodTopoAllRoots:
+    def test_all_roots_applies_child_to_every_root(self):
+        from openr_tpu.kvstore.wrapper import link_bidirectional
+
+        a = KvStoreWrapper(
+            "a", enable_flood_optimization=True, is_flood_root=True
+        )
+        b = KvStoreWrapper("b", enable_flood_optimization=True)
+        for s in (a, b):
+            s.start()
+        link_bidirectional(a, b)
+        try:
+            assert wait_until(
+                lambda: a.store._dbs["0"].dual is not None
+                and a.store._dbs["0"].dual.get_dual("a") is not None
+            )
+            # drop b as a child everywhere via allRoots (rootId ignored)
+            a.store.set_flood_topo_child(
+                "0", "ignored-root", "b", False, all_roots=True
+            )
+            dual = a.store._dbs["0"].dual.get_dual("a")
+            assert wait_until(lambda: "b" not in dual.children())
+            # and re-add via allRoots
+            a.store.set_flood_topo_child(
+                "0", "ignored-root", "b", True, all_roots=True
+            )
+            assert wait_until(lambda: "b" in dual.children())
+        finally:
+            a.stop()
+            b.stop()

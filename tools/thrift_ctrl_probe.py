@@ -1,0 +1,84 @@
+"""Probe an openr-tpu (or stock Open/R) ctrl port over the THRIFT
+wire — the stock-toolchain view of a node.
+
+Dials the ctrl port with framed CompactProtocol (byte-identical to a
+stock thrift client on classic framed transport) and prints the
+operator snapshot: identity/version, counters, KvStore dump summary,
+installed routes, adjacency and prefix databases, peers.
+
+    python tools/thrift_ctrl_probe.py --host 127.0.0.1 --port 2018
+    python tools/thrift_ctrl_probe.py --port 2018 --method getRouteDb
+
+With --method, calls exactly one RPC and prints its raw decoded
+result as JSON (bytes rendered as hex).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from openr_tpu.ctrl.thrift_ctrl import ThriftCtrlClient  # noqa: E402
+
+
+def _jsonable(obj):
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2018)
+    p.add_argument("--method", default=None,
+                   help="call one RPC and dump its decoded result")
+    p.add_argument("--args", default="{}",
+                   help="JSON kwargs for --method")
+    args = p.parse_args()
+
+    client = ThriftCtrlClient(args.host, args.port)
+    try:
+        if args.method:
+            result = client.call(
+                args.method, **json.loads(args.args)
+            )
+            print(json.dumps(_jsonable(result), indent=2, sort_keys=True))
+            return 0
+        node = client.call("getMyNodeName")
+        version = client.call("getOpenrVersion")
+        counters = client.call("getCounters")
+        pub = client.call(
+            "getKvStoreKeyValsFilteredArea",
+            filter={"prefix": "", "originatorIds": [],
+                    "ignoreTtl": False, "doNotPublishValue": True},
+            area="0",
+        )
+        routes = client.call("getRouteDb")
+        adj = client.call("getDecisionAdjacencyDbs")
+        prefixes = client.call("getDecisionPrefixDbs")
+        peers = client.call("getKvStorePeersArea", area="0")
+        print(f"node            {node}")
+        print(f"version         {version['version']} "
+              f"(lowest {version['lowestSupportedVersion']})")
+        print(f"counters        {len(counters)}")
+        print(f"kvstore keys    {len(pub['keyVals'])}")
+        print(f"unicast routes  {len(routes['unicastRoutes'])}")
+        print(f"mpls routes     {len(routes['mplsRoutes'])}")
+        print(f"adjacency dbs   {sorted(adj)}")
+        print(f"prefix dbs      {sorted(prefixes)}")
+        print(f"kvstore peers   {sorted(peers)}")
+        return 0
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
