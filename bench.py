@@ -281,6 +281,15 @@ def _run() -> dict:
                 v10k = max(bench_10k["median_ms"], 1e-9)
                 bench_10k["vs_baseline"] = round(BASELINE_MS / v10k, 3)
                 bench_10k["vs_northstar"] = round(NORTHSTAR_MS / v10k, 3)
+                # the north star is <10ms at 100k NODES on a v4-32
+                # MESH (BASELINE.json); this leg is 10k on one device.
+                # The explicit scale note keeps a CPU-fallback artifact
+                # from reading as "north star met" at the wrong scale.
+                bench_10k["northstar_scale_note"] = (
+                    "north-star target is 100k nodes / v4-32 mesh; "
+                    "this leg is 10k nodes on one "
+                    f"{bench_10k.get('platform', '?')} device"
+                )
             except Exception as e:
                 bench_10k = {"error": f"{type(e).__name__}: {e}"}
 
@@ -310,6 +319,10 @@ def _run() -> dict:
         # convergence goal AND vs this repo's own 10 ms north star
         "vs_baseline": round(BASELINE_MS / value, 3),
         "vs_northstar": round(NORTHSTAR_MS / value, 3),
+        "northstar_scale_note": (
+            "north-star target is 100k nodes / v4-32 mesh; this metric "
+            f"is {snap0.n} nodes on one {platform} device"
+        ),
         "device_only_ms": device_only,
         "n_nodes": snap0.n,
         "platform": platform,

@@ -147,11 +147,19 @@ def run_churn(args):
     )
 
 
-def ksp2_churn_bench(nodes: int, churn_events: int) -> dict:
+def ksp2_churn_bench(nodes: int, churn_events: int,
+                     ksp2_dst_count: int = 0) -> dict:
     """Fabric KSP2_ED_ECMP churn rebuild through the full SpfSolver —
     the incremental-KSP2-engine path (BASELINE.json config 2 axis;
     reference semantics: Decision.cpp:908 selectBestPathsKsp2).
-    Shared by the scale harness and the official bench.py artifact."""
+    Shared by the scale harness and the official bench.py artifact.
+
+    ``ksp2_dst_count`` > 0 marks only that many (evenly sampled)
+    prefixes as KSP2_ED_ECMP and leaves the rest SP_ECMP — the
+    realistic large-fabric shape (KSP2 is a per-prefix opt-in) and the
+    one that scales the ENGINE to 10k+ nodes: the all-pairs event
+    dispatch covers the whole graph while host path tracing stays
+    bounded by the KSP2 destination count."""
     import statistics
     from dataclasses import replace
 
@@ -167,15 +175,40 @@ def ksp2_churn_bench(nodes: int, churn_events: int) -> dict:
         PrefixForwardingType,
     )
 
+    all_ksp2 = ksp2_dst_count <= 0
     topo = topologies.fat_tree_nodes(
         nodes,
-        forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        forwarding_algorithm=(
+            PrefixForwardingAlgorithm.KSP2_ED_ECMP
+            if all_ksp2
+            else PrefixForwardingAlgorithm.SP_ECMP
+        ),
         forwarding_type=PrefixForwardingType.SR_MPLS,
     )
     ls = LinkState(area=topo.area)
     for name in sorted(topo.adj_dbs):
         ls.update_adjacency_database(topo.adj_dbs[name])
     ps = PrefixState()
+    if not all_ksp2:
+        names = sorted(topo.prefix_dbs)
+        stride = max(1, len(names) // ksp2_dst_count)
+        chosen = set(names[::stride][:ksp2_dst_count])
+        for name in names:
+            pdb = topo.prefix_dbs[name]
+            if name in chosen:
+                pdb = replace(
+                    pdb,
+                    prefix_entries=tuple(
+                        replace(
+                            e,
+                            forwarding_algorithm=(
+                                PrefixForwardingAlgorithm.KSP2_ED_ECMP
+                            ),
+                        )
+                        for e in pdb.prefix_entries
+                    ),
+                )
+            topo.prefix_dbs[name] = pdb
     for pdb in topo.prefix_dbs.values():
         ps.update_prefix_database(pdb)
     area_ls = {topo.area: ls}
@@ -207,6 +240,7 @@ def ksp2_churn_bench(nodes: int, churn_events: int) -> dict:
         samples.append((time.perf_counter() - t0) * 1000)
     return {
         "bench": f"scale.fabric_{ls.num_nodes}_ksp2_churn_rebuild",
+        "ksp2_dsts": ksp2_dst_count if not all_ksp2 else ls.num_nodes,
         "events": churn_events,
         "median_ms": round(statistics.median(samples), 1),
         "p90_ms": round(

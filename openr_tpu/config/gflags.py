@@ -47,6 +47,7 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "enable_watchdog": (bool, True),
     "enable_flood_optimization": (bool, False),
     "is_flood_root": (bool, False),
+    "enable_kvstore_thrift": (bool, False),
     "prefix_fwd_type_mpls": (bool, False),
     "prefix_algo_type_ksp2_ed_ecmp": (bool, False),
     # interfaces
@@ -198,6 +199,7 @@ def config_from_gflags(result: GflagResult) -> OpenrConfig:
             "key_ttl_ms": f["kvstore_key_ttl_ms"],
             "sync_interval_s": float(f["kvstore_sync_interval_s"]),
             "ttl_decrement_ms": f["kvstore_ttl_decrement_ms"],
+            "enable_kvstore_thrift": f["enable_kvstore_thrift"],
             "enable_flood_optimization": f["enable_flood_optimization"],
             "is_flood_root": f["is_flood_root"],
             "flood_msg_per_sec": f["kvstore_flood_msg_per_sec"],

@@ -56,8 +56,15 @@ import numpy as np
 from openr_tpu.graph.linkstate import Link, LinkState
 from openr_tpu.ops.spf import INF
 
-# engine activation bounds: the resident all-pairs matrix is [n, n]
-ENGINE_MAX_NODES = 4096
+# Engine activation bound: the event loop keeps TWO device-resident
+# [n_pad, n_pad] int32 matrices (current + previous all-pairs) — at the
+# 12k bound that is ~1.2 GB, comfortably inside a single chip's HBM,
+# and the per-event fused dispatch is one all-sources ELL solve. Past
+# this, the all-pairs residency must shard over a device mesh (the ELL
+# machinery already shards source rows — sharded_ell_all_sources); the
+# bound is where single-chip residency stops, not where the algorithm
+# does.
+ENGINE_MAX_NODES = 12288
 # churn larger than this falls back to a full (cold) rebuild
 ENGINE_MAX_CHANGED_PAIRS = 64
 ENGINE_MAX_ENDPOINTS = 32
@@ -488,9 +495,8 @@ class Ksp2Engine:
             and len(dsts) * 2 * max(1, slots)
             <= _ss.KSP2_DEVICE_MASK_BUDGET
         ):
-            parallel = ls.parallel_pairs()
             masks_all, _ok = spf_sparse.build_edge_masks(
-                graph, [self.excl[d] for d in dsts], parallel
+                graph, [self.excl[d] for d in dsts]
             )
             self.masks_t = tuple(jnp.asarray(m) for m in masks_all)
             self.dm_dev = jnp.asarray(self.dm)
@@ -563,10 +569,13 @@ class Ksp2Engine:
     ) -> Optional[Dict[Tuple[str, str], Tuple]]:
         """Directed pairs incident to the affected nodes whose collapsed
         min-metric or materialization attributes changed:
-        (u, v) -> (w_old, w_new, sig_old, sig_new). Returns None when a
-        parallel-link pair appears (the ELL collapse cannot mask one of
-        parallel links; the caller cold-rebuilds and the per-destination
-        host fallback machinery takes over)."""
+        (u, v) -> (w_old, w_new, sig_old, sig_new). Parallel links are
+        first-class: the pair model keeps MIN weights (exact for
+        first-path membership; a conservative lower bound for the
+        masked-graph membership test) while the per-link sigs catch
+        sibling-only changes, and the per-link ELL slots
+        (spf_sparse.compile_ell direction="in") make every member
+        individually maskable (reference: LinkState.h:82)."""
         changed: Dict[Tuple[str, str], Tuple] = {}
         graph_index = self.state.graph.node_index
         seen_pairs: Set[Tuple[str, str]] = set()
@@ -584,15 +593,10 @@ class Ksp2Engine:
             if x not in graph_index:
                 return None  # node set changed
             neighbors: Set[str] = set()
-            per_pair_links: Dict[str, int] = {}
             for link in ls.links_from_node(x):
                 if not link.is_up():
                     continue
-                other = link.other_node(x)
-                neighbors.add(other)
-                per_pair_links[other] = per_pair_links.get(other, 0) + 1
-            if any(c > 1 for c in per_pair_links.values()):
-                return None  # parallel links: engine does not model
+                neighbors.add(link.other_node(x))
             # pairs that vanished entirely (link down/removed: neither
             # direction survives in the current link set) — probed via
             # the incident-pair index, NOT a scan of every pair (at 4k
@@ -795,8 +799,6 @@ class Ksp2Engine:
             self.first_paths[dst] = paths
             self.excl[dst] = {l for p in paths for l in p}
 
-        if ls.parallel_pairs():
-            return False  # engine precondition broken: cold-rebuild
         self.host_dsts -= set(affected)
         self._solve_masked_batches(
             ls, state, affected, cands_of, transit_blocked
@@ -814,7 +816,6 @@ class Ksp2Engine:
         from openr_tpu.ops import spf_sparse
 
         graph = state.graph
-        parallel = ls.parallel_pairs()
         chunk = _ss._ksp2_chunk(graph)
         for start in range(0, len(dsts), chunk):
             batch = dsts[start : start + chunk]
@@ -828,7 +829,7 @@ class Ksp2Engine:
             excl_sets = [self.excl[d] for d in batch]
             pad = bucket - len(batch)
             masks, ok = spf_sparse.build_edge_masks(
-                graph, excl_sets + [set()] * pad, parallel
+                graph, excl_sets + [set()] * pad
             )
             drows = spf_sparse.ell_masked_distances_resident(
                 state, self.sid, masks

@@ -1125,10 +1125,11 @@ class SpfSolver:
         this build). Larger N: the original per-build chunked masked
         dispatch (every destination, every build).
 
-        Destinations whose first paths contain parallel links fall back
-        to the host path (the sliced-ELL collapses parallel links into
-        one min-metric slot, so masking one of them is not
-        representable).
+        Parallel links (LAGs) are first-class: the per-link ELL slots
+        (spf_sparse.compile_ell direction="in" + build_edge_masks via
+        graph.slot_of) mask individual group members, so no host
+        fallback and no engine cold-rebuild on LAG fabrics
+        (reference: LinkState.h:82 Link identity).
 
         Multi-area: one engine per area graph primes that area's paths.
         Route reuse needs EVERY area signaled — KSP2 paths toward a
@@ -1247,8 +1248,6 @@ class SpfSolver:
         sid = graph.node_index.get(my_node_name)
         if sid is None:
             return
-        parallel = ls.parallel_pairs()
-
         # first paths: host trace off the one memoized base SPF
         exclusion_sets = []
         for dst in dsts:
@@ -1270,7 +1269,7 @@ class SpfSolver:
             batch_excl = exclusion_sets[start : start + chunk]
             pad = chunk - len(batch_dsts)
             masks, ok = spf_sparse.build_edge_masks(
-                graph, batch_excl + [set()] * pad, parallel
+                graph, batch_excl + [set()] * pad
             )
             drows = spf_sparse.ell_masked_distances_resident(
                 state, sid, masks

@@ -42,8 +42,10 @@ class Topology:
         return sorted(self.adj_dbs)
 
 
-def _iface(a: str, b: str) -> str:
-    return f"if_{a}_{b}"
+def _iface(a: str, b: str, k: int = 0) -> str:
+    # k numbers parallel links (LAG members) between the same pair;
+    # k=0 keeps the historical single-link name
+    return f"if_{a}_{b}" if k == 0 else f"if_{a}_{b}_{k}"
 
 
 def _v6(node_idx: int, peer_idx: int) -> BinaryAddress:
@@ -65,11 +67,12 @@ def _mk_adj(
     metric: int,
     adj_label: int = 0,
     overloaded: bool = False,
+    link_idx: int = 0,
 ) -> Adjacency:
     return Adjacency(
         other_node_name=b,
-        if_name=_iface(a, b),
-        other_if_name=_iface(b, a),
+        if_name=_iface(a, b, link_idx),
+        other_if_name=_iface(b, a, link_idx),
         metric=metric,
         next_hop_v6=_v6(bi, ai),
         next_hop_v4=_v4(bi, ai),
@@ -101,9 +104,20 @@ def build_topology(
     names = sorted({n for e in edges for n in e[:2]})
     idx = {n: i for i, n in enumerate(names)}
     neighbors: Dict[str, List[Adjacency]] = {n: [] for n in names}
+    # duplicate (a, b) pairs are PARALLEL links (LAG members): each
+    # occurrence gets its own numbered interface pair so the LinkState
+    # models them as first-class Links (reference: LinkState.h:82)
+    pair_count: Dict[Tuple[str, str], int] = {}
     for a, b, metric in edges:
-        neighbors[a].append(_mk_adj(a, idx[a], b, idx[b], metric))
-        neighbors[b].append(_mk_adj(b, idx[b], a, idx[a], metric))
+        pair = (a, b) if a < b else (b, a)
+        k = pair_count.get(pair, 0)
+        pair_count[pair] = k + 1
+        neighbors[a].append(
+            _mk_adj(a, idx[a], b, idx[b], metric, link_idx=k)
+        )
+        neighbors[b].append(
+            _mk_adj(b, idx[b], a, idx[a], metric, link_idx=k)
+        )
 
     topo = Topology(name=name, area=area)
     for n in names:

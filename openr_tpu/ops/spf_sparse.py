@@ -262,6 +262,14 @@ class EllGraph:
     # "out": row j holds edges OUT of j (the reversed-graph layout the
     # destination-major route sweep relaxes over)
     direction: str = "in"
+    # per-link slot index for "in" graphs: (node id, link key) ->
+    # (band idx, band-local row, slot). What makes a single parallel
+    # link excludable in the masked KSP2 kernel.
+    slot_of: Optional[Dict[Tuple[int, Tuple], Tuple[int, int, int]]] = None
+    # node id -> its slot link-keys (the reverse index ell_patch uses
+    # to retire a node's old slot_of entries without scanning the
+    # whole O(E) dict on the churn hot path)
+    node_slot_keys: Optional[Dict[int, Tuple]] = None
 
 
 def _in_edges(ls, name, index) -> Dict[int, int]:
@@ -278,6 +286,34 @@ def _in_edges(ls, name, index) -> Dict[int, int]:
         if i not in best or m < best[i]:
             best[i] = m
     return best
+
+
+def link_key(link) -> Tuple:
+    """Canonical per-link identity — Link's own precomputed identity
+    tuple (the (node, iface) pair set, the same identity the reference
+    gives first-class Links, LinkState.h:82 orderedNames_). Parallel
+    links between one node pair differ in their iface pairs."""
+    return link.ordered_names
+
+
+def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
+    """PER-LINK in-edge slots of ``name``: [(origin id, metric, link
+    key)], sorted (origin id, key). Unlike _in_edges, parallel links
+    keep their own slots — the KSP2 edge-disjoint masks must be able
+    to exclude ONE member of a LAG without killing its siblings
+    (reference: LinkState.cpp:763 getKthPaths' linksToIgnore)."""
+    slots: List[Tuple[int, int, Tuple]] = []
+    for link in ls.ordered_links_from_node(name):
+        if not link.is_up():
+            continue
+        other = link.other_node(name)
+        i = index.get(other)
+        if i is None:
+            continue
+        m = min(int(link.metric_from(other)), int(INF) - 1)
+        slots.append((i, m, link_key(link)))
+    slots.sort(key=lambda t: (t[0], t[2]))
+    return slots
 
 
 def _out_edges(ls, name, index) -> Dict[int, int]:
@@ -317,14 +353,29 @@ def compile_ell(ls, align: int = _NODE_PAD,
     """Sliced-ELL compilation from the LinkState: O(E) host work and
     O(E) total slots, no dense matrix. ``direction="out"`` builds the
     reversed-graph bands (row j = out-edges of j) consumed by
-    ops.route_sweep."""
+    ops.route_sweep.
+
+    Direction "in" gives every LINK its own slot (parallel links are
+    NOT min-collapsed) and records a slot index, so build_edge_masks
+    can exclude one member of a parallel group — the KSP2 requirement.
+    Distances are unchanged (the relax min()s across slots). Direction
+    "out" keeps the collapsed per-neighbor layout: the route sweep's
+    next-hop counts are per-NEIGHBOR there, matching the grouped
+    backend's digest semantics."""
+    per_link = direction == "in"
     edges_of = _in_edges if direction == "in" else _out_edges
     raw_names = sorted(ls.get_adjacency_databases().keys())
     raw_index = {name: i for i, name in enumerate(raw_names)}
-    degree = {
-        name: max(1, len(edges_of(ls, name, raw_index)))
-        for name in raw_names
-    }
+    if per_link:
+        degree = {
+            name: max(1, len(_in_edge_slots(ls, name, raw_index)))
+            for name in raw_names
+        }
+    else:
+        degree = {
+            name: max(1, len(edges_of(ls, name, raw_index)))
+            for name in raw_names
+        }
     # class id = padded power-of-two >= degree; group by (class, name)
     def class_k(d: int) -> int:
         k = _ELL_SLOT_PAD
@@ -342,6 +393,8 @@ def compile_ell(ls, align: int = _NODE_PAD,
     bands: List[EllBand] = []
     srcs: List[np.ndarray] = []
     ws: List[np.ndarray] = []
+    slot_of: Dict[Tuple[int, Tuple], Tuple[int, int, int]] = {}
+    node_slot_keys: Dict[int, Tuple] = {}
     overloaded = np.zeros(n_pad, dtype=bool)
     i = 0
     while i < n:
@@ -355,7 +408,19 @@ def compile_ell(ls, align: int = _NODE_PAD,
         )  # self-loop padding: inert with w=INF
         w_b = np.full((rows, k), INF, dtype=np.int32)
         for r, name in enumerate(names[i:j]):
-            _fill_row(src_b[r], w_b[r], edges_of(ls, name, index))
+            if per_link:
+                nid = index[name]
+                keys = []
+                for slot, (sid, m, key) in enumerate(
+                    _in_edge_slots(ls, name, index)
+                ):
+                    src_b[r, slot] = sid
+                    w_b[r, slot] = m
+                    slot_of[(nid, key)] = (len(bands), r, slot)
+                    keys.append(key)
+                node_slot_keys[nid] = tuple(keys)
+            else:
+                _fill_row(src_b[r], w_b[r], edges_of(ls, name, index))
         bands.append(EllBand(start=i, rows=rows, k=k))
         srcs.append(src_b)
         ws.append(w_b)
@@ -366,6 +431,8 @@ def compile_ell(ls, align: int = _NODE_PAD,
         node_names=names, node_index=index, n=n, n_pad=n_pad,
         bands=tuple(bands), src=tuple(srcs), w=tuple(ws),
         overloaded=overloaded, direction=direction,
+        slot_of=slot_of if per_link else None,
+        node_slot_keys=node_slot_keys if per_link else None,
     )
 
 
@@ -379,19 +446,26 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         nm not in graph.node_index for nm in names
     ):
         return None
+    per_link = graph.slot_of is not None
     edges_of = _in_edges if graph.direction == "in" else _out_edges
     src = list(graph.src)
     w = list(graph.w)
     overloaded = graph.overloaded.copy()
+    slot_of = dict(graph.slot_of) if per_link else None
+    node_slot_keys = dict(graph.node_slot_keys) if per_link else None
     changed: Dict[int, List[int]] = {}
     copied: set = set()
     for name in affected:
         i = graph.node_index.get(name)
         if i is None:
             return None
-        edges = edges_of(ls, name, graph.node_index)
+        if per_link:
+            slots = _in_edge_slots(ls, name, graph.node_index)
+        else:
+            edges = edges_of(ls, name, graph.node_index)
         bi, band = _band_of(graph, i)
-        if len(edges) > band.k:
+        n_entries = len(slots) if per_link else len(edges)
+        if n_entries > band.k:
             return None
         if bi not in copied:
             src[bi] = src[bi].copy()
@@ -400,7 +474,21 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         r = i - band.start
         src[bi][r] = np.full(band.k, i, dtype=np.int32)
         w[bi][r] = INF
-        _fill_row(src[bi][r], w[bi][r], edges)
+        if per_link:
+            # retire this node's old slot entries via the reverse
+            # index (NOT a scan of the whole O(E) slot_of dict — this
+            # runs per affected node on the churn hot path)
+            for key in node_slot_keys.get(i, ()):
+                slot_of.pop((i, key), None)
+            keys = []
+            for slot, (sid, m, key) in enumerate(slots):
+                src[bi][r, slot] = sid
+                w[bi][r, slot] = m
+                slot_of[(i, key)] = (bi, r, slot)
+                keys.append(key)
+            node_slot_keys[i] = tuple(keys)
+        else:
+            _fill_row(src[bi][r], w[bi][r], edges)
         overloaded[i] = ls.is_node_overloaded(name)
         changed.setdefault(bi, []).append(r)
     return EllGraph(
@@ -410,6 +498,8 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         changed={bi: np.asarray(sorted(rs), dtype=np.int32)
                  for bi, rs in changed.items()},
         direction=graph.direction,
+        slot_of=slot_of,
+        node_slot_keys=node_slot_keys,
     )
 
 
@@ -719,12 +809,15 @@ def _ell_masked_source_batch(srcs_t, ws_t, masks_t, overloaded, src_id,
 
 def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
     """Per-band [B, rows, k] bool masks from per-batch-element link
-    sets. Returns (masks, ok_flags): ok_flags[b] is False when element
-    b's exclusions cannot be represented in the ELL — a link between a
-    node pair with PARALLEL links shares one collapsed min-metric slot,
-    so masking it would wrongly kill the surviving parallel link(s).
-    ``parallel_pairs``: set of frozenset({n1, n2}) pairs with more than
-    one link; the caller derives it from the LinkState."""
+    sets. On a per-link-slot graph (compile_ell direction="in") every
+    link — parallel group members included — maps to its OWN slot via
+    ``graph.slot_of``, so ok_flags[b] is False only when an exclusion
+    references a node outside the graph (reference semantics:
+    LinkState.cpp:763 getKthPaths' linksToIgnore treats each Link as
+    first-class, LinkState.h:82).
+
+    Collapsed graphs (no slot_of) keep the legacy behavior:
+    ``parallel_pairs`` elements are unrepresentable and flag ok=False."""
     b = len(exclusion_sets)
     parallel_pairs = parallel_pairs or set()
     masks = [
@@ -732,11 +825,15 @@ def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
         for band in graph.bands
     ]
     ok = np.ones(b, dtype=bool)
+    per_link = graph.slot_of is not None
     for x, links in enumerate(exclusion_sets):
         for link in links:
-            if frozenset((link.n1, link.n2)) in parallel_pairs:
+            if not per_link and (
+                frozenset((link.n1, link.n2)) in parallel_pairs
+            ):
                 ok[x] = False
                 break
+            key = link_key(link) if per_link else None
             for head in (link.n1, link.n2):
                 tail = link.other_node(head)
                 hid = graph.node_index.get(head)
@@ -744,12 +841,19 @@ def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
                 if hid is None or tid is None:
                     ok[x] = False
                     break
+                if per_link:
+                    hit = graph.slot_of.get((hid, key))
+                    if hit is None:
+                        # link not in the ELL (e.g. went down after
+                        # compile): nothing to mask
+                        continue
+                    bi, r, slot = hit
+                    masks[bi][x, r, slot] = True
+                    continue
                 bi, band = _band_of(graph, hid)
                 r = hid - band.start
                 hits = np.flatnonzero(graph.src[bi][r] == tid)
                 if len(hits) == 0:
-                    # edge not in the ELL (e.g. link went down after
-                    # compile): nothing to mask
                     continue
                 masks[bi][x, r, hits[0]] = True
             if not ok[x]:

@@ -1,0 +1,102 @@
+#!/bin/bash
+#
+# Env-file driven launcher for the openr-tpu daemon — the operational
+# entry point, mirroring the reference's scripts/run_openr.sh shape
+# (reference: /root/reference/openr/scripts/run_openr.sh): defaults
+# here, node-specific overrides in an env file (/etc/sysconfig/openr
+# by default), or a JSON config path as the first argument.
+#
+#   run_openr.sh                    # env-file driven (gflags surface)
+#   run_openr.sh /data/openr.json   # explicit JSON config
+#
+# NOTE: for correct drain-state persistence across reboots point
+# CONFIG_STORE_FILEPATH somewhere persistent (the reference's own
+# advice), e.g. /data/openr_config_store.bin.
+
+set -u
+
+# openr-tpu invocation: a python module, not a compiled binary
+OPENR="${OPENR:-python3 -m openr_tpu.main}"
+SYSCONFIG="${SYSCONFIG:-/etc/sysconfig/openr}"
+
+# Defaults (sorted) — override in ${SYSCONFIG}
+AREAS=""
+CONFIG=""
+CONFIG_STORE_FILEPATH="/tmp/openr_tpu_config_store.json"
+DOMAIN=openr
+DRYRUN=false
+ENABLE_FLOOD_OPTIMIZATION=false
+ENABLE_KVSTORE_THRIFT=false
+ENABLE_NETLINK_FIB_HANDLER=true
+ENABLE_PREFIX_ALLOC=false
+ENABLE_SEGMENT_ROUTING=false
+ENABLE_V4=false
+ENABLE_WATCHDOG=true
+IFACE_REGEX_EXCLUDE=""
+IFACE_REGEX_INCLUDE=""
+IS_FLOOD_ROOT=false
+KVSTORE_KEY_TTL_MS=300000
+KVSTORE_SYNC_INTERVAL_S=60
+NODE_NAME="${HOSTNAME:-}"
+OPENR_CTRL_PORT=2018
+PREFIX_FWD_ALGO_KSP2_ED_ECMP=0
+PREFIX_FWD_TYPE_MPLS=0
+SEED_PREFIX=""
+SPARK_HOLD_TIME_S=30
+
+# Node overrides
+if [ -f "${SYSCONFIG}" ]; then
+  # shellcheck disable=SC1090
+  . "${SYSCONFIG}"
+fi
+
+# Explicit JSON config wins over the env surface
+if [ -n "${1:-}" ]; then
+  CONFIG="$1"
+fi
+
+if [ -n "${CONFIG}" ]; then
+  echo "Starting openr-tpu with config: ${CONFIG}"
+  exec ${OPENR} --config "${CONFIG}"
+fi
+
+if [ -z "${NODE_NAME}" ] || [ "${NODE_NAME}" = "localhost" ]; then
+  echo "ERROR: No hostname found for the node, bailing out." >&2
+  exit 1
+fi
+
+ARGS="--node_name=${NODE_NAME}"
+ARGS="${ARGS} --domain=${DOMAIN}"
+ARGS="${ARGS} --config_store_filepath=${CONFIG_STORE_FILEPATH}"
+ARGS="${ARGS} --kvstore_key_ttl_ms=${KVSTORE_KEY_TTL_MS}"
+ARGS="${ARGS} --kvstore_sync_interval_s=${KVSTORE_SYNC_INTERVAL_S}"
+ARGS="${ARGS} --spark2_heartbeat_hold_time_s=${SPARK_HOLD_TIME_S}"
+ARGS="${ARGS} --openr_ctrl_port=${OPENR_CTRL_PORT}"
+[ -n "${AREAS}" ] && ARGS="${ARGS} --areas=${AREAS}"
+[ -n "${IFACE_REGEX_INCLUDE}" ] && \
+  ARGS="${ARGS} --iface_regex_include=${IFACE_REGEX_INCLUDE}"
+[ -n "${IFACE_REGEX_EXCLUDE}" ] && \
+  ARGS="${ARGS} --iface_regex_exclude=${IFACE_REGEX_EXCLUDE}"
+[ -n "${SEED_PREFIX}" ] && ARGS="${ARGS} --seed_prefix=${SEED_PREFIX}"
+[ "${DRYRUN}" = "true" ] && ARGS="${ARGS} --dryrun"
+[ "${ENABLE_V4}" = "true" ] && ARGS="${ARGS} --enable_v4"
+[ "${ENABLE_WATCHDOG}" = "true" ] && ARGS="${ARGS} --enable_watchdog"
+[ "${ENABLE_SEGMENT_ROUTING}" = "true" ] && \
+  ARGS="${ARGS} --enable_segment_routing"
+[ "${ENABLE_PREFIX_ALLOC}" = "true" ] && \
+  ARGS="${ARGS} --enable_prefix_alloc"
+[ "${ENABLE_FLOOD_OPTIMIZATION}" = "true" ] && \
+  ARGS="${ARGS} --enable_flood_optimization"
+[ "${IS_FLOOD_ROOT}" = "true" ] && ARGS="${ARGS} --is_flood_root"
+[ "${ENABLE_KVSTORE_THRIFT}" = "true" ] && \
+  ARGS="${ARGS} --enable_kvstore_thrift"
+[ "${ENABLE_NETLINK_FIB_HANDLER}" = "true" ] && \
+  ARGS="${ARGS} --enable_netlink_fib_handler"
+[ "${PREFIX_FWD_TYPE_MPLS}" != "0" ] && \
+  ARGS="${ARGS} --prefix_fwd_type_mpls"
+[ "${PREFIX_FWD_ALGO_KSP2_ED_ECMP}" != "0" ] && \
+  ARGS="${ARGS} --prefix_algo_type_ksp2_ed_ecmp"
+
+echo "Starting openr-tpu: ${OPENR} ${ARGS}"
+# shellcheck disable=SC2086
+exec ${OPENR} ${ARGS}

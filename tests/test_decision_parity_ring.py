@@ -1030,10 +1030,12 @@ class TestKsp2DevicePrefetch:
             h = host.build_route_db("node-0", area_ls_h, ps_h)
             assert d.to_route_db("node-0") == h.to_route_db("node-0"), step
 
-    def test_parallel_links_fall_back_to_host(self):
+    def test_parallel_links_stay_on_device(self):
         from openr_tpu.decision.spf_solver import SPF_COUNTERS
 
-        # ring with parallel 1-2 links; KSP2 prefixes everywhere
+        # ring with parallel 1-2 links; KSP2 prefixes everywhere.
+        # Parallel links are first-class ELL slots now: no destination
+        # falls back to the host path, and device == host routes.
         def padj(a, b, tag, metric=10):
             return adj(b, f"if{tag}_{a}{b}", f"if{tag}_{b}{a}",
                        metric=metric)
@@ -1055,7 +1057,7 @@ class TestKsp2DevicePrefetch:
             SPF_COUNTERS["decision.ksp2_host_fallbacks"]
             - before["decision.ksp2_host_fallbacks"]
         )
-        assert fallbacks >= 1  # node 2's first path uses a parallel link
+        assert fallbacks == 0, fallbacks
         area_ls_h, ps_h = make_network(
             {k: v for k, v in adj_dbs.items()}, ksp2=True
         )

@@ -421,27 +421,49 @@ class TestMaskedSourceBatch:
                 else:
                     assert got >= INF, (i, name)
 
-    def test_parallel_link_exclusion_flagged(self):
+    def test_parallel_link_exclusion_first_class(self):
+        """Masking ONE member of a parallel group must keep its
+        sibling usable (per-link slots; reference LinkState.h:82 Link
+        identity, LinkState.cpp:763 linksToIgnore)."""
+        import numpy as np
+
         from openr_tpu.graph.linkstate import LinkState
         from openr_tpu.ops import spf_sparse
+        from openr_tpu.ops.spf import INF
         from tests.test_linkstate import adj, db
 
         ls = LinkState(area="0")
         ls.update_adjacency_database(
-            db("a", [adj("b", "if1_ab", "if1_ba"),
-                     adj("b", "if2_ab", "if2_ba")])
+            db("a", [adj("b", "if1_ab", "if1_ba", metric=1),
+                     adj("b", "if2_ab", "if2_ba", metric=5)])
         )
         ls.update_adjacency_database(
-            db("b", [adj("a", "if1_ba", "if1_ab"),
-                     adj("a", "if2_ba", "if2_ab")])
+            db("b", [adj("a", "if1_ba", "if1_ab", metric=1),
+                     adj("a", "if2_ba", "if2_ab", metric=5)])
         )
         graph = spf_sparse.compile_ell(ls)
-        (link, _other) = sorted(ls.all_links())[:2]
+        assert graph.slot_of is not None
+        links = sorted(ls.all_links())
+        assert len(links) == 2  # the two LAG members
+        cheap = min(links, key=lambda l: l.metric_from("a"))
         masks, ok = spf_sparse.build_edge_masks(
-            graph, [{link}, set()], ls.parallel_pairs()
+            graph, [{cheap}, set()], ls.parallel_pairs()
         )
-        assert not ok[0]  # parallel pair: not representable
-        assert ok[1]
+        assert ok[0] and ok[1]  # both representable now
+        sid = graph.node_index["a"]
+        d = spf_sparse.ell_masked_distances(graph, sid, masks)
+        bid = graph.node_index["b"]
+        # cheap member (metric 1) excluded: the metric-5 sibling carries
+        assert int(d[0, bid]) == 5
+        # nothing excluded: the cheap member wins
+        assert int(d[1, bid]) == 1
+        # masking BOTH members disconnects the pair
+        masks2, ok2 = spf_sparse.build_edge_masks(
+            graph, [set(links)], ls.parallel_pairs()
+        )
+        assert ok2[0]
+        d2 = spf_sparse.ell_masked_distances(graph, sid, masks2)
+        assert int(d2[0, bid]) >= INF
 
 
 class TestShardedMaskedBatch:

@@ -152,6 +152,16 @@ def capture(round_no: int) -> bool:
              "--nodes", "10000", "--kernel", "ell"],
         ),
         (
+            # incremental KSP2 with the ENGINE ACTIVE at 10k nodes
+            # (VERDICT item 8): 256 KSP2 destinations on the 10k
+            # fat-tree, all-pairs event dispatch over the full graph
+            "ksp2_churn_10k_engine",
+            [sys.executable, "-c",
+             "import json; from benchmarks.bench_scale import "
+             "ksp2_churn_bench; print(json.dumps("
+             "ksp2_churn_bench(10000, 5, ksp2_dst_count=256)))"],
+        ),
+        (
             # the 100k north-star axis: FULL 98-block sweep with
             # on-device route consumption (no 40 GB readback), grouped
             # backend with on-chip impl probing
