@@ -61,12 +61,12 @@ PROBE_TIMEOUTS_S = (60, 90, 120, 120)
 PROBE_BUDGET_S = 320  # stop probing once this much wall time is spent
 RETRY_PROBE_TIMEOUT_S = 120
 TPU_CHILD_TIMEOUT_S = 270
-TPU_CHILD_10K_TIMEOUT_S = 600  # headline + 10k churn + ksp2 legs
+TPU_CHILD_10K_TIMEOUT_S = 750  # headline + 10k churn + ksp2 + routes legs
 CPU_CHILD_TIMEOUT_S = 150
-CPU_CHILD_10K_TIMEOUT_S = 480
+CPU_CHILD_10K_TIMEOUT_S = 620
 # soft wall-clock budget: optional legs (TPU retry, 10k CPU leg) are
 # skipped once exceeded so a worst-case run still emits JSON promptly
-BENCH_SOFT_BUDGET_S = 900
+BENCH_SOFT_BUDGET_S = 1000
 
 
 def _run() -> dict:
@@ -311,6 +311,28 @@ def _run() -> dict:
             except Exception as e:
                 bench_ksp2 = {"error": f"{type(e).__name__}: {e}"}
 
+    # fourth leg: the destination-major route sweep with ON-DEVICE
+    # route selection (config 5 axis, transfer-fixed): all-sources
+    # product consumed on device, digests + sampled route rows read
+    # back. Runs the grouped (block-bipartite) backend with on-chip
+    # jnp-vs-pallas impl probing; 1008 keeps the CPU fallback cheap
+    # while the per-block device time is the scale-relevant number.
+    bench_routes = None
+    if os.environ.get("OPENR_BENCH_ROUTES") == "1":
+        if leg_elapsed() > 420:
+            bench_routes = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import route_sweep_bench
+
+                bench_routes = route_sweep_bench(
+                    1000, 256, backend="grouped"
+                )
+            except Exception as e:
+                bench_routes = {"error": f"{type(e).__name__}: {e}"}
+
     return {
         "metric": f"spf_reconvergence_ms_fattree_{snap0.n}",
         "value": round(value, 3),
@@ -330,6 +352,7 @@ def _run() -> dict:
         "minplus_ms": minplus_ms,
         "bench_10k_churn": bench_10k,
         "bench_ksp2_churn": bench_ksp2,
+        "bench_route_sweep": bench_routes,
         "error": None,
     }
 
@@ -360,13 +383,15 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
     """Run this file in child mode; return (parsed json | None, note)."""
     env = dict(os.environ, OPENR_BENCH_CHILD=mode)
     if with_10k:
-        # the optional legs share a fate: both ride the larger child
-        # timeout and both are dropped together on the retry path
+        # the optional legs share a fate: all ride the larger child
+        # timeout and all are dropped together on the retry path
         env["OPENR_BENCH_10K"] = "1"
         env["OPENR_BENCH_KSP2"] = "1"
+        env["OPENR_BENCH_ROUTES"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
+        env.pop("OPENR_BENCH_ROUTES", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

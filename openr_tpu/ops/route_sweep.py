@@ -285,6 +285,35 @@ def _unpack_blocks(packed: np.ndarray, s: int, kw: int):
     )
 
 
+def assemble_result(sweeper, packed: np.ndarray) -> "RouteSweepResult":
+    """Build a RouteSweepResult from a full [n_pad, W] packed array —
+    the ONE assembly site shared by every one-dispatch sweep (the ELL
+    and grouped sharded variants)."""
+    s = len(sweeper.sample_ids)
+    kw = sweeper.samp_v.shape[1] // 32
+    dg, nt, sm, sk = _unpack_blocks(packed, s, kw)
+    return RouteSweepResult(
+        graph=sweeper.graph,
+        sample_names=sweeper.sample_names,
+        sample_ids=sweeper.sample_ids,
+        samp_v=sweeper.samp_v,
+        samp_w=sweeper.samp_w,
+        digests=dg,
+        nh_totals=nt,
+        sample_metrics=sm,
+        sample_masks=sk,
+    )
+
+
+def digests_by_name(result: "RouteSweepResult"):
+    """Name-keyed canonical digests — the cross-backend comparison
+    view (two layouts number nodes differently; names do not)."""
+    idx = result.graph.node_index
+    return {
+        nm: result.digests[idx[nm]] for nm in result.graph.node_names
+    }
+
+
 @dataclass
 class RouteSweepResult:
     """Host-side product of a full destination sweep."""
@@ -497,17 +526,4 @@ def sharded_route_sweep(
             graph.bands, n, mesh,
         )
     )
-    s = len(sweeper.sample_ids)
-    kw = sweeper.samp_v.shape[1] // 32
-    dg, nt, sm, sk = _unpack_blocks(packed, s, kw)
-    return RouteSweepResult(
-        graph=graph,
-        sample_names=sweeper.sample_names,
-        sample_ids=sweeper.sample_ids,
-        samp_v=sweeper.samp_v,
-        samp_w=sweeper.samp_w,
-        digests=dg,
-        nh_totals=nt,
-        sample_metrics=sm,
-        sample_masks=sk,
-    )
+    return assemble_result(sweeper, packed)

@@ -600,6 +600,66 @@ def compile_out_grouped(ls, align: int = 128) -> GroupedGraph:
     return compile_grouped(ls, align=align, direction="out")
 
 
+@functools.partial(
+    jax.jit, static_argnames=("meta", "n", "mesh", "impl")
+)
+def _sharded_grouped_route_blocks(
+    srcs_t, ws_t, overloaded, t_ids, samp_ids, samp_v, samp_w, pos_w,
+    meta, n, mesh, impl,
+):
+    from jax.sharding import PartitionSpec as P
+
+    from openr_tpu.ops.spf_sparse import SOURCES_AXIS
+
+    def shard_fn(t_blk, *rest):
+        ns = len(srcs_t)
+        s_r = rest[:ns]
+        w_r = rest[ns : 2 * ns]
+        ov_r, sid_r, sv_r, sw_r, pw_r = rest[2 * ns :]
+        return _grouped_route_block_body(
+            s_r, w_r, ov_r, t_blk, sid_r, sv_r, sw_r, pw_r, meta, n,
+            vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+            impl=impl,
+        )
+
+    ns = len(srcs_t)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS)]
+            + [P(None, None)] * ns  # src tables [G, S], replicated
+            + [P(None, None, None)] * ns  # w tensors [G, S, R]
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=P(SOURCES_AXIS, None),
+    )(t_ids, *srcs_t, *ws_t, overloaded, samp_ids, samp_v, samp_w,
+      pos_w)
+
+
+def sharded_grouped_route_sweep(graph: GroupedGraph, sample_names, mesh):
+    """The grouped route sweep in ONE sharded dispatch: destination
+    rows sharded over the mesh, segment tables replicated (O(E)), the
+    1-bit convergence psum the only collective — the grouped twin of
+    route_sweep.sharded_route_sweep, producing the identical
+    RouteSweepResult (canonical digests bit-comparable)."""
+    from openr_tpu.ops import route_sweep as rs
+
+    sweeper = GroupedRouteSweeper(graph, sample_names)
+    n = graph.n_pad
+    assert n % mesh.devices.size == 0, (n, mesh.devices.size)
+    packed = np.asarray(
+        _sharded_grouped_route_blocks(
+            sweeper.v_t, sweeper.w_t, sweeper.overloaded,
+            jnp.asarray(np.arange(n, dtype=np.int32)),
+            sweeper._samp_ids_dev, sweeper._samp_v_dev,
+            sweeper._samp_w_dev, sweeper._pos_w_dev,
+            sweeper.meta, n, mesh, _GROUPED_IMPL,
+        )
+    )
+    return rs.assemble_result(sweeper, packed)
+
+
 def structure_report(graph: GroupedGraph) -> dict:
     """How much of the edge volume the structure detection captured:
     per band (g1, g2, segments, slots) + the total gather shrink

@@ -297,7 +297,11 @@ class TestTtlChurn:
                 for i in range(self.N_KEYS // 2)
             }
             self._batch_set(a, immortal)
-            self._batch_set(a, doomed, ttl=1500)
+            # fuse long enough that a loaded CI host can flood all 10k
+            # keys to the peer BEFORE the doomed half expires (a 1.5s
+            # fuse raced the flood under full-suite load), short enough
+            # to expire well inside the 30s expiry wait below
+            self._batch_set(a, doomed, ttl=5000)
 
             b = net.stores["big-b"]
             assert wait_until(

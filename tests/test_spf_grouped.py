@@ -100,12 +100,13 @@ class TestGroupedForwardParity:
         assert_forward_parity(ls)
 
 
+def digest_by_name(result):
+    return route_sweep.digests_by_name(result)
+
+
 class TestGroupedRouteSweep:
     def digest_by_name(self, result):
-        idx = result.graph.node_index
-        return {
-            nm: result.digests[idx[nm]] for nm in result.graph.node_names
-        }
+        return digest_by_name(result)
 
     def test_digest_matches_ell_backend(self):
         """The cross-backend witness: grouped and ELL sweeps number
@@ -206,3 +207,35 @@ class TestGroupedRouteSweep:
             spf_grouped.compile_out_grouped(ls), [names[0]]
         ).sweep(block=16)
         assert self.digest_by_name(ell) == self.digest_by_name(grouped)
+
+
+class TestShardedGroupedSweep:
+    def test_sharded_matches_single_chip(self):
+        """One sharded grouped dispatch over the 8-device CPU mesh:
+        identical route product (bit-exact digests) as the single-chip
+        block sweep AND as the ELL backend."""
+        from openr_tpu.parallel import mesh as pmesh
+        from openr_tpu.ops import spf_grouped as sg
+
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+        )
+        ls = load(topo, overloaded_nodes={"fsw-0-0"})
+        graph = sg.compile_out_grouped(ls)
+        samples = [graph.node_names[0]]
+        single = sg.GroupedRouteSweeper(graph, samples).sweep(block=32)
+        mesh = pmesh.make_mesh()
+        assert graph.n_pad % mesh.devices.size == 0
+        sharded = sg.sharded_grouped_route_sweep(graph, samples, mesh)
+        np.testing.assert_array_equal(sharded.digests, single.digests)
+        np.testing.assert_array_equal(
+            sharded.sample_metrics, single.sample_metrics
+        )
+        np.testing.assert_array_equal(
+            sharded.sample_masks, single.sample_masks
+        )
+        # cross-backend: the ELL sweep's name-keyed digests agree
+        ell = route_sweep.RouteSweeper(
+            route_sweep.compile_out_ell(ls), samples
+        ).sweep(block=32)
+        assert digest_by_name(ell) == digest_by_name(sharded)
