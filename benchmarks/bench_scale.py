@@ -570,6 +570,83 @@ def route_sweep_bench(
     return out
 
 
+def route_engine_churn_bench(nodes: int, churn_events: int) -> dict:
+    """Incremental NETWORK-WIDE route reconvergence (ops.route_engine):
+    per churn event, ONE fused dispatch re-solves only the affected
+    destination rows of the resident route product and reads back
+    their digests + sample route rows — the route-server analogue of
+    the reference's incremental Decision rebuild, at all-destinations
+    scope. Parity gate: engine digests vs a from-scratch full sweep."""
+    import statistics
+    from dataclasses import replace
+
+    import jax
+
+    from openr_tpu.ops import route_engine, route_sweep
+
+    topo = topologies.fat_tree_nodes(nodes)
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    names = sorted(topo.adj_dbs)
+    rsw = next(k for k in names if k.startswith("rsw"))
+    fsw = next(k for k in names if k.startswith("fsw"))
+
+    t0 = time.perf_counter()
+    engine = route_engine.RouteSweepEngine(ls, [rsw])
+    cold_ms = (time.perf_counter() - t0) * 1000
+
+    def churn(step):
+        db = ls.get_adjacency_databases()[fsw]
+        adjs = list(db.adjacencies)
+        a0 = adjs[0]
+        adjs[0] = replace(a0, metric=2 + step % 5)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        return {fsw, a0.other_node_name}
+
+    # warm every bucket shape outside the timed window
+    for step in range(4):
+        engine.churn(ls, churn(step))
+
+    samples = []
+    affected_counts = []
+    for step in range(churn_events):
+        affected = churn(step)
+        t0 = time.perf_counter()
+        moved = engine.churn(ls, affected)
+        samples.append((time.perf_counter() - t0) * 1000)
+        affected_counts.append(len(moved) if moved is not None else -1)
+
+    # parity gate on the final state
+    full = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [rsw], block=1024)
+    )
+    assert route_sweep.digests_by_name(engine.result) == full
+
+    return {
+        "bench": f"scale.route_engine_churn_{engine.graph.n}_nodes",
+        "events": churn_events,
+        "median_ms": round(statistics.median(samples), 1),
+        "p90_ms": round(
+            sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
+        ),
+        "cold_build_ms": round(cold_ms, 1),
+        "affected_dsts_median": (
+            int(statistics.median(incr))
+            if (incr := [c for c in affected_counts if c >= 0])
+            else None
+        ),
+        "cold_rebuilds_in_window": sum(
+            1 for c in affected_counts if c < 0
+        ),
+        "incremental_events": engine.incremental_events,
+        "platform": jax.devices()[0].platform,
+        "oracle_spot_check": "passed",
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)
@@ -582,6 +659,9 @@ def main(argv=None):
                    help="run the incremental ELL churn scenario instead "
                         "of all-sources")
     p.add_argument("--churn-events", type=int, default=10)
+    p.add_argument("--routes-churn", action="store_true",
+                   help="incremental network-wide route reconvergence "
+                        "via the resident route engine")
     p.add_argument("--routes", action="store_true",
                    help="all-sources sweep with on-device route "
                         "selection (digest + sample readback only)")
@@ -592,6 +672,14 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.churn:
         run_churn(args)
+        return
+    if args.routes_churn:
+        print(
+            json.dumps(
+                route_engine_churn_bench(args.nodes, args.churn_events)
+            ),
+            flush=True,
+        )
         return
     if args.routes:
         print(

@@ -152,6 +152,15 @@ def capture(round_no: int) -> bool:
              "--nodes", "10000", "--kernel", "ell"],
         ),
         (
+            # incremental NETWORK-WIDE route reconvergence at 10k: the
+            # resident route engine re-solves only affected
+            # destination rows per event (route_engine.py)
+            "route_engine_churn_10k",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes-churn", "--nodes", "10000",
+             "--churn-events", "10"],
+        ),
+        (
             # incremental KSP2 with the ENGINE ACTIVE at 10k nodes
             # (VERDICT item 8): 256 KSP2 destinations on the 10k
             # fat-tree, all-pairs event dispatch over the full graph
