@@ -479,6 +479,12 @@ def route_sweep_bench(
                     if ref is None:
                         ref = got
                     elif not np.array_equal(ref, got):
+                        # parity failure is a CORRECTNESS signal, not an
+                        # ordinary probe error: record it distinctly so a
+                        # pallas/jnp divergence on real hardware is
+                        # front-and-center in the artifact rather than
+                        # buried in an _error string
+                        impl_ms["parity_failed"] = impl
                         raise RuntimeError("pallas/jnp divergence")
                     impl_ms[impl] = chain_ms()
                 except Exception as e:  # pallas probe must not kill jnp

@@ -82,10 +82,9 @@ class CtrlServer:
 
         self.handler = handler
         self._ssl_context = ssl_context
-        # thrift backend used for its serve_connection loop only; its
-        # own loopback listener runs idle + unadvertised so stop() is
-        # safe (socketserver.shutdown deadlocks when serve_forever
-        # never ran)
+        # thrift backend used for its serve_connection loop only;
+        # listen=False builds a pure dispatcher with no socket bound
+        # (start/stop are no-ops — see utils/thrift_rpc.py)
         self._thrift_backend = ThriftCtrlServer(
             handler, listen=False
         )
@@ -201,11 +200,9 @@ class CtrlServer:
 
 
 def _is_thrift_head(head: bytes) -> bool:
-    """First 6 bytes of a connection: 4-byte frame length, then either
-    the compact-protocol id 0x82 or the THeader magic 0x0FFF."""
-    from openr_tpu.utils.thrift_rpc import PROTOCOL_ID
+    from openr_tpu.utils.thrift_rpc import is_thrift_head
 
-    return head[4] == PROTOCOL_ID or head[4:6] == b"\x0f\xff"
+    return is_thrift_head(head)
 
 
 def _read_exact_sock(sock, n: int) -> Optional[bytes]:

@@ -132,9 +132,18 @@ def build_method_table(handler) -> MethodTable:
 
     def peers_map(args):
         area = args.get("area", "0")
+        # one eventbase round trip: peer_endpoints' keys ARE the peer
+        # names. In-process transports have no endpoint; stock tooling
+        # renders the empty PeerSpec as "no address known".
         return {
-            name: {"peerAddr": "", "cmdUrl": "", "ctrlPort": 0}
-            for name in handler.get_kvstore_peers(area=area)
+            name: {
+                "peerAddr": ep[0] if ep else "",
+                "cmdUrl": "",
+                "ctrlPort": ep[1] if ep else 0,
+            }
+            for name, ep in handler._kvstore.peer_endpoints(
+                area
+            ).items()
         }
 
     def route_db(args=None, node=None):

@@ -11,8 +11,15 @@ and the leading payload bytes disambiguate them unambiguously:
   collide with a 15-argument framework RPC one day, but 0x0F followed
   by 0xFF cannot be a framework frame — the second byte there is the
   top byte of a u32 blob length bounded far below 0xFF000000);
+- bare framed strict-BinaryProtocol thrift begins with the TWO-byte
+  version word ``0x8001`` (same two-byte argument: a framework frame
+  leading with blob count 0x80 would need 128 arguments, and its
+  second byte could not be 0x01 — the blob-length top byte);
 - the framework RPC payload begins with its blob count, a small
   integer that can never be 0x82.
+
+The shared predicate lives in ``utils.thrift_rpc.is_thrift_head`` —
+every demultiplexer (here and ctrl/server.py) classifies through it.
 
 One listener peeks the first frame's leading bytes and then runs the
 matching backend's request loop DIRECTLY on the accepted socket (no
@@ -33,7 +40,7 @@ from openr_tpu.kvstore.store import KvStore
 from openr_tpu.kvstore.thrift_peer import KvStoreThriftPeerServer
 from openr_tpu.kvstore.transport import KvStorePeerServer
 from openr_tpu.utils.rpc import apply_bind_family, peek_first_bytes
-from openr_tpu.utils.thrift_rpc import PROTOCOL_ID
+from openr_tpu.utils.thrift_rpc import is_thrift_head
 
 _SNIFF_BYTES = 6  # u32 frame length + two payload bytes
 
@@ -62,10 +69,10 @@ class DualStackPeerServer:
                 if head is None:
                     return
                 sock.settimeout(None)
-                if head[4] == PROTOCOL_ID or head[4:6] == b"\x0f\xff":
-                    # bare framed compact (0x82) or a THeader-wrapped
-                    # dial (0x0FFF magic) — both land on the thrift
-                    # backend, which mirrors the request's wrapping
+                if is_thrift_head(head):
+                    # any thrift wire — bare compact, THeader-wrapped,
+                    # bare binary — lands on the thrift backend, which
+                    # mirrors the request's wrapping and protocol
                     outer._thrift_backend.serve_connection(sock)
                 else:
                     outer._rpc_backend.serve_connection(sock)

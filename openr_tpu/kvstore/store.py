@@ -159,6 +159,12 @@ class PeerTransport:
     """RPC surface a store exposes to its peers (reference: the
     KvStoreService thrift interface / fbzmq ROUTER socket)."""
 
+    # (host, port) the transport dials, when it dials anywhere — the
+    # ctrl surface reports it as the thrift PeerSpec peerAddr/ctrlPort
+    # (reference: openr/if/KvStore.thrift PeerSpec). In-process
+    # transports have no endpoint.
+    endpoint: Optional[Tuple[str, int]] = None
+
     def get_key_vals_filtered(
         self, area: str, params: KeyDumpParams
     ) -> Publication:
@@ -627,6 +633,11 @@ class KvStoreDb:
     def peer_states(self) -> Dict[str, KvStorePeerState]:
         return {name: p.state for name, p in self.peers.items()}
 
+    def peer_endpoints(self) -> Dict[str, Optional[Tuple[str, int]]]:
+        return {
+            name: p.transport.endpoint for name, p in self.peers.items()
+        }
+
     def _request_sync(self) -> None:
         """Promote IDLE peers to SYNCING and kick the 3-way full sync
         (reference: KvStore.cpp:1400 requestThriftPeerSync)."""
@@ -923,6 +934,13 @@ class KvStore:
 
     def peer_states(self, area: str) -> Dict[str, KvStorePeerState]:
         return self.evb.call_and_wait(lambda: self._db(area).peer_states())
+
+    def peer_endpoints(
+        self, area: str
+    ) -> Dict[str, Optional[Tuple[str, int]]]:
+        return self.evb.call_and_wait(
+            lambda: self._db(area).peer_endpoints()
+        )
 
     def process_dual_messages(self, area: str, sender: str, msgs) -> None:
         self.evb.call_and_wait(

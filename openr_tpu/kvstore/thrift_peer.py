@@ -168,6 +168,7 @@ class ThriftPeerTransport(PeerTransport):
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
         self._client = FramedCompactClient(host, port, timeout_s)
+        self.endpoint = (host, port)
 
     def _call_publication(self, name, schema, args: Dict) -> Publication:
         """Call a Publication-returning method; a reply without the

@@ -87,6 +87,7 @@ class TcpPeerTransport(PeerTransport):
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0):
         self._client = RpcClient(host, port, timeout_s=timeout_s)
+        self.endpoint = (host, port)
 
     def get_key_vals_filtered(
         self, area: str, params: KeyDumpParams

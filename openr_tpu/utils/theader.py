@@ -20,10 +20,15 @@ THeader framing spec):
     key/value pairs), zero-padded to the declared header size
     PAYLOAD            (the thrift message in the declared protocol)
 
-Only the untransformed compact-protocol payload is supported — the
-transports this repo speaks everywhere else. Unsupported protocol ids
-or transforms raise (the caller hangs up; a stock client surfaces a
-transport error rather than silence).
+Untransformed compact-protocol (id 2) AND binary-protocol (id 0)
+payloads are supported — compact is the repo's native interop wire,
+binary is the fbthrift client default when no protocol is configured
+(utils/thrift_binary.py decodes it over the same schema tables).
+Unsupported protocol ids or transforms raise (the caller hangs up; a
+stock client surfaces a transport error rather than silence). All
+header-info parsing is bounded by the declared header size: a
+malformed frame whose varints/varstrings would cross into the payload
+raises instead of misparsing payload bytes as header info.
 """
 
 from __future__ import annotations
@@ -46,10 +51,17 @@ def looks_like_theader(frame_payload: bytes) -> bool:
     )
 
 
-def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+def _read_varint(data: bytes, pos: int, end: int) -> Tuple[int, int]:
+    """Bounded LEB128 read: never consumes bytes at/past ``end`` and
+    caps the shift (an endless 0x80 run raises instead of scanning to
+    the buffer's physical end)."""
     result = 0
     shift = 0
     while True:
+        if pos >= end:
+            raise ValueError("THeader varint crosses header boundary")
+        if shift > 32:
+            raise ValueError("THeader varint too long")
         b = data[pos]
         pos += 1
         result |= (b & 0x7F) << shift
@@ -58,22 +70,29 @@ def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
         shift += 7
 
 
-def _read_varstring(data: bytes, pos: int) -> Tuple[bytes, int]:
-    n, pos = _read_varint(data, pos)
+def _read_varstring(data: bytes, pos: int, end: int) -> Tuple[bytes, int]:
+    n, pos = _read_varint(data, pos, end)
+    if pos + n > end:
+        raise ValueError("THeader varstring crosses header boundary")
     return data[pos : pos + n], pos + n
 
 
-def unwrap(frame_payload: bytes) -> Tuple[bytes, int, Dict[str, str]]:
-    """THeader frame payload -> (thrift compact message, seqid, info
-    key/values). Raises ValueError on ANY malformed frame (truncation
-    included) — callers catch one exception type and hang up."""
+def unwrap(
+    frame_payload: bytes,
+) -> Tuple[bytes, int, Dict[str, str], int]:
+    """THeader frame payload -> (thrift message, seqid, info
+    key/values, protocol id). Raises ValueError on ANY malformed frame
+    (truncation included) — callers catch one exception type and hang
+    up."""
     try:
         return _unwrap(frame_payload)
     except (IndexError, struct.error) as exc:
         raise ValueError(f"truncated THeader frame: {exc}") from exc
 
 
-def _unwrap(frame_payload: bytes) -> Tuple[bytes, int, Dict[str, str]]:
+def _unwrap(
+    frame_payload: bytes,
+) -> Tuple[bytes, int, Dict[str, str], int]:
     if not looks_like_theader(frame_payload):
         raise ValueError("not a THeader frame")
     flags, seqid, header_words = struct.unpack(
@@ -84,31 +103,32 @@ def _unwrap(frame_payload: bytes) -> Tuple[bytes, int, Dict[str, str]]:
     if header_end > len(frame_payload):
         raise ValueError("THeader header overruns frame")
     pos = 10
-    proto, pos = _read_varint(frame_payload, pos)
-    if proto != PROTO_COMPACT:
+    proto, pos = _read_varint(frame_payload, pos, header_end)
+    if proto not in (PROTO_COMPACT, PROTO_BINARY):
         raise ValueError(
-            f"unsupported THeader protocol id {proto} (compact only)"
+            f"unsupported THeader protocol id {proto} "
+            "(compact/binary only)"
         )
-    n_transforms, pos = _read_varint(frame_payload, pos)
+    n_transforms, pos = _read_varint(frame_payload, pos, header_end)
     if n_transforms:
         raise ValueError(
             f"unsupported THeader transforms ({n_transforms})"
         )
     info: Dict[str, str] = {}
     while pos < header_end:
-        info_id, pos = _read_varint(frame_payload, pos)
+        info_id, pos = _read_varint(frame_payload, pos, header_end)
         if info_id == 0:  # zero padding
             break
         if info_id not in (INFO_KEYVALUE, INFO_PKEYVALUE):
             raise ValueError(f"unknown THeader info id {info_id}")
-        count, pos = _read_varint(frame_payload, pos)
+        count, pos = _read_varint(frame_payload, pos, header_end)
         for _ in range(count):
-            k, pos = _read_varstring(frame_payload, pos)
-            v, pos = _read_varstring(frame_payload, pos)
+            k, pos = _read_varstring(frame_payload, pos, header_end)
+            v, pos = _read_varstring(frame_payload, pos, header_end)
             info[k.decode("utf-8", "replace")] = v.decode(
                 "utf-8", "replace"
             )
-    return frame_payload[header_end:], seqid, info
+    return frame_payload[header_end:], seqid, info, proto
 
 
 def _write_varint(buf: bytearray, n: int) -> None:
@@ -121,12 +141,13 @@ def _write_varint(buf: bytearray, n: int) -> None:
 
 
 def wrap(message: bytes, seqid: int,
-         info: Optional[Dict[str, str]] = None) -> bytes:
-    """Compact thrift message -> THeader frame payload (the outer
-    4-byte frame length is the transport's job, utils/thrift_rpc
-    frame())."""
+         info: Optional[Dict[str, str]] = None,
+         proto: int = PROTO_COMPACT) -> bytes:
+    """Thrift message -> THeader frame payload declaring ``proto``
+    (the outer 4-byte frame length is the transport's job,
+    utils/thrift_rpc frame())."""
     header = bytearray()
-    _write_varint(header, PROTO_COMPACT)
+    _write_varint(header, proto)
     _write_varint(header, 0)  # no transforms
     if info:
         _write_varint(header, INFO_KEYVALUE)

@@ -69,6 +69,33 @@ def decode_message_header(data: bytes) -> Tuple[str, int, int, int]:
     return name, mtype, seqid, r.pos
 
 
+class _CompactCodec:
+    """Uniform codec facade (encode/decode structs + message envelope)
+    so dispatch code is protocol-agnostic; thrift_binary presents the
+    same four names natively."""
+
+    encode_message = staticmethod(encode_message)
+    decode_message_header = staticmethod(decode_message_header)
+    encode = staticmethod(tc.encode)
+    decode = staticmethod(tc.decode)
+
+
+def is_thrift_head(head: bytes) -> bool:
+    """Classify a connection's first 6 bytes (4-byte frame length +
+    two payload bytes) as one of the thrift wires: bare framed compact
+    (0x82), THeader (0x0FFF magic), or bare framed strict binary
+    (0x8001 version word). The single shared predicate for every
+    byte-sniffing demultiplexer — a new wire is added HERE, once."""
+    return (
+        len(head) >= 6
+        and (
+            head[4] == PROTOCOL_ID
+            or head[4:6] == b"\x0f\xff"
+            or head[4:6] == b"\x80\x01"
+        )
+    )
+
+
 def frame(payload: bytes) -> bytes:
     return struct.pack(">I", len(payload)) + payload
 
@@ -140,11 +167,12 @@ class FramedCompactServer:
     def serve_connection(self, sock) -> None:
         """Run the request loop on an already-accepted socket (shared
         by the own listener and external demultiplexers). Each frame
-        may be a bare framed-compact message OR a THeader-wrapped one
-        (the fbthrift default transport — a stock client's dial,
-        reference kvstore/KvStore.cpp:1400); replies mirror the
-        request's wrapping."""
-        from openr_tpu.utils import theader
+        may be a bare framed-compact message, a bare framed-binary
+        message, OR a THeader-wrapped one in either protocol (the
+        fbthrift default transport — a stock client's dial, reference
+        kvstore/KvStore.cpp:1400); replies mirror the request's
+        wrapping AND protocol."""
+        from openr_tpu.utils import theader, thrift_binary as tb
 
         while True:
             try:
@@ -154,47 +182,71 @@ class FramedCompactServer:
             if data is None:
                 return
             wrapped_seqid = None
+            proto = theader.PROTO_COMPACT
             if theader.looks_like_theader(data):
                 try:
-                    data, wrapped_seqid, _info = theader.unwrap(data)
+                    data, wrapped_seqid, _info, proto = theader.unwrap(
+                        data
+                    )
                 except ValueError:
                     return  # unsupported protocol/transform: hang up
+            elif tb.looks_like_binary(data):
+                proto = theader.PROTO_BINARY
             try:
-                reply = self._dispatch(data)
+                reply = self._dispatch(data, proto)
             except Exception as exc:
-                reply = self._exception_reply(data, exc)
+                reply = self._exception_reply(data, exc, proto)
                 if reply is None:  # header itself unparseable
                     return
             if wrapped_seqid is not None:
-                reply = theader.wrap(reply, wrapped_seqid)
+                reply = theader.wrap(reply, wrapped_seqid, proto=proto)
             try:
                 sock.sendall(frame(reply))
             except OSError:
                 return
 
-    def _dispatch(self, data: bytes) -> bytes:
-        name, mtype, seqid, off = decode_message_header(data)
+    @staticmethod
+    def _codec(proto: int):
+        """Message/struct codec for a THeader protocol id: compact
+        (the repo's native interop wire) or binary (fbthrift's
+        unconfigured-client default)."""
+        from openr_tpu.utils import theader
+
+        if proto == theader.PROTO_BINARY:
+            from openr_tpu.utils import thrift_binary
+
+            return thrift_binary
+        return _CompactCodec
+
+    def _dispatch(self, data: bytes, proto: int) -> bytes:
+        codec = self._codec(proto)
+        name, mtype, seqid, off = codec.decode_message_header(data)
         if mtype != TYPE_CALL:
             raise ValueError(f"unexpected message type {mtype}")
         entry = self._methods.get(name)
         if entry is None:
-            return encode_message(
+            return codec.encode_message(
                 name, TYPE_EXCEPTION, seqid, TAPP_EXC,
                 {"message": f"unknown method {name!r}", "type": 1},
             )
         args_schema, handler = entry
-        result_schema, result = handler(tc.decode(args_schema, data[off:]))
-        return encode_message(
+        result_schema, result = handler(
+            codec.decode(args_schema, data[off:])
+        )
+        return codec.encode_message(
             name, TYPE_REPLY, seqid, result_schema, result
         )
 
-    @staticmethod
-    def _exception_reply(data: bytes, exc: Exception) -> Optional[bytes]:
+    @classmethod
+    def _exception_reply(
+        cls, data: bytes, exc: Exception, proto: int
+    ) -> Optional[bytes]:
+        codec = cls._codec(proto)
         try:
-            name, _mtype, seqid, _off = decode_message_header(data)
+            name, _mtype, seqid, _off = codec.decode_message_header(data)
         except Exception:
             return None
-        return encode_message(
+        return codec.encode_message(
             name, TYPE_EXCEPTION, seqid, TAPP_EXC,
             {"message": f"{type(exc).__name__}: {exc}", "type": 6},
         )
@@ -220,20 +272,24 @@ class FramedCompactServer:
 
 
 class FramedCompactClient:
-    """One-connection framed-compact caller (reconnects per call after
+    """One-connection framed thrift caller (reconnects per call after
     a transport error). ``theader=True`` wraps every call in the
     fbthrift Header transport — the shape a STOCK fbthrift client puts
     on the wire — and unwraps replies (tests use this to prove the
-    dual-stack listeners accept a Header-framed dial)."""
+    dual-stack listeners accept a Header-framed dial).
+    ``binary=True`` encodes calls with TBinaryProtocol (the fbthrift
+    default when no protocol is configured) instead of compact —
+    combinable with ``theader`` to model every stock client shape."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0,
-                 theader: bool = False):
+                 theader: bool = False, binary: bool = False):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._seqid = 0
         self._theader = theader
+        self._binary = binary
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -244,16 +300,24 @@ class FramedCompactClient:
 
     def call(self, name: str, args_schema, args: Dict,
              result_schema) -> Dict:
+        if self._binary:
+            from openr_tpu.utils import thrift_binary as codec
+        else:
+            codec = _CompactCodec
         with self._lock:
             self._seqid += 1
             seqid = self._seqid
-            payload = encode_message(
+            payload = codec.encode_message(
                 name, TYPE_CALL, seqid, args_schema, args
             )
             if self._theader:
                 from openr_tpu.utils import theader as th
 
-                payload = th.wrap(payload, seqid)
+                payload = th.wrap(
+                    payload, seqid,
+                    proto=(th.PROTO_BINARY if self._binary
+                           else th.PROTO_COMPACT),
+                )
             try:
                 sock = self._connect()
                 sock.sendall(frame(payload))
@@ -272,15 +336,15 @@ class FramedCompactClient:
                     raise ConnectionError(
                         "peer replied without THeader wrapping"
                     )
-                data, rhdr_seq, _info = th.unwrap(data)
+                data, rhdr_seq, _info, _proto = th.unwrap(data)
                 if rhdr_seq != seqid:
                     self.close()
                     raise ConnectionError(
                         f"out-of-sync THeader reply {rhdr_seq}"
                     )
-            rname, mtype, rseq, off = decode_message_header(data)
+            rname, mtype, rseq, off = codec.decode_message_header(data)
             if mtype == TYPE_EXCEPTION:
-                exc = tc.decode(TAPP_EXC, data[off:])
+                exc = codec.decode(TAPP_EXC, data[off:])
                 raise RuntimeError(
                     f"peer exception: {exc.get('message')}"
                 )
@@ -289,7 +353,7 @@ class FramedCompactClient:
                 raise ConnectionError(
                     f"out-of-sync reply {rname}/{rseq}"
                 )
-            return tc.decode(result_schema, data[off:])
+            return codec.decode(result_schema, data[off:])
 
     def close(self) -> None:
         if self._sock is not None:

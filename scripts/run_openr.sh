@@ -50,6 +50,20 @@ if [ -f "${SYSCONFIG}" ]; then
   . "${SYSCONFIG}"
 fi
 
+# An empty boolean override in ${SYSCONFIG} (FLAG= — the sysconfig
+# idiom for "use the default") must fall back to the script default,
+# not become an explicit --flag= (which the gflags parser reads as
+# false).
+DRYRUN="${DRYRUN:-false}"
+ENABLE_V4="${ENABLE_V4:-false}"
+ENABLE_WATCHDOG="${ENABLE_WATCHDOG:-true}"
+ENABLE_SEGMENT_ROUTING="${ENABLE_SEGMENT_ROUTING:-false}"
+ENABLE_PREFIX_ALLOC="${ENABLE_PREFIX_ALLOC:-false}"
+ENABLE_FLOOD_OPTIMIZATION="${ENABLE_FLOOD_OPTIMIZATION:-false}"
+IS_FLOOD_ROOT="${IS_FLOOD_ROOT:-false}"
+ENABLE_KVSTORE_THRIFT="${ENABLE_KVSTORE_THRIFT:-false}"
+ENABLE_NETLINK_FIB_HANDLER="${ENABLE_NETLINK_FIB_HANDLER:-true}"
+
 # Explicit JSON config wins over the env surface
 if [ -n "${1:-}" ]; then
   CONFIG="$1"
@@ -78,20 +92,18 @@ ARGS="${ARGS} --openr_ctrl_port=${OPENR_CTRL_PORT}"
 [ -n "${IFACE_REGEX_EXCLUDE}" ] && \
   ARGS="${ARGS} --iface_regex_exclude=${IFACE_REGEX_EXCLUDE}"
 [ -n "${SEED_PREFIX}" ] && ARGS="${ARGS} --seed_prefix=${SEED_PREFIX}"
-[ "${DRYRUN}" = "true" ] && ARGS="${ARGS} --dryrun"
-[ "${ENABLE_V4}" = "true" ] && ARGS="${ARGS} --enable_v4"
-[ "${ENABLE_WATCHDOG}" = "true" ] && ARGS="${ARGS} --enable_watchdog"
-[ "${ENABLE_SEGMENT_ROUTING}" = "true" ] && \
-  ARGS="${ARGS} --enable_segment_routing"
-[ "${ENABLE_PREFIX_ALLOC}" = "true" ] && \
-  ARGS="${ARGS} --enable_prefix_alloc"
-[ "${ENABLE_FLOOD_OPTIMIZATION}" = "true" ] && \
-  ARGS="${ARGS} --enable_flood_optimization"
-[ "${IS_FLOOD_ROOT}" = "true" ] && ARGS="${ARGS} --is_flood_root"
-[ "${ENABLE_KVSTORE_THRIFT}" = "true" ] && \
-  ARGS="${ARGS} --enable_kvstore_thrift"
-[ "${ENABLE_NETLINK_FIB_HANDLER}" = "true" ] && \
-  ARGS="${ARGS} --enable_netlink_fib_handler"
+# Booleans are passed explicitly as --flag=true/false: several gflags
+# default to true (e.g. enable_watchdog), so only appending the positive
+# form would make FLAG=false a silent no-op.
+ARGS="${ARGS} --dryrun=${DRYRUN}"
+ARGS="${ARGS} --enable_v4=${ENABLE_V4}"
+ARGS="${ARGS} --enable_watchdog=${ENABLE_WATCHDOG}"
+ARGS="${ARGS} --enable_segment_routing=${ENABLE_SEGMENT_ROUTING}"
+ARGS="${ARGS} --enable_prefix_alloc=${ENABLE_PREFIX_ALLOC}"
+ARGS="${ARGS} --enable_flood_optimization=${ENABLE_FLOOD_OPTIMIZATION}"
+ARGS="${ARGS} --is_flood_root=${IS_FLOOD_ROOT}"
+ARGS="${ARGS} --enable_kvstore_thrift=${ENABLE_KVSTORE_THRIFT}"
+ARGS="${ARGS} --enable_netlink_fib_handler=${ENABLE_NETLINK_FIB_HANDLER}"
 [ "${PREFIX_FWD_TYPE_MPLS}" != "0" ] && \
   ARGS="${ARGS} --prefix_fwd_type_mpls"
 [ "${PREFIX_FWD_ALGO_KSP2_ED_ECMP}" != "0" ] && \

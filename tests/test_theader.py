@@ -34,16 +34,51 @@ class TestTHeaderFraming:
     def test_unwrap_round_trip(self):
         msg = b"\x82\x41\x05\x03abc\x00payload"
         frame = theader.wrap(msg, seqid=99, info={"client": "test"})
-        out, seqid, info = theader.unwrap(frame)
+        out, seqid, info, proto = theader.unwrap(frame)
         assert out == msg
         assert seqid == 99
         assert info == {"client": "test"}
+        assert proto == theader.PROTO_COMPACT
 
-    def test_unwrap_rejects_binary_protocol(self):
+    def test_unwrap_accepts_binary_protocol(self):
+        frame = theader.wrap(
+            b"\x80\x01\x00\x01x", seqid=1, proto=theader.PROTO_BINARY
+        )
+        out, seqid, _info, proto = theader.unwrap(frame)
+        assert out == b"\x80\x01\x00\x01x"
+        assert proto == theader.PROTO_BINARY
+
+    def test_unwrap_rejects_unknown_protocol(self):
         frame = bytearray(theader.wrap(b"x", seqid=1))
-        frame[10] = theader.PROTO_BINARY
+        frame[10] = 7  # neither binary (0) nor compact (2)
         with pytest.raises(ValueError, match="protocol"):
             theader.unwrap(bytes(frame))
+
+    def test_header_info_bounded_by_declared_size(self):
+        """Malformed info headers cannot read past the declared header
+        size into payload bytes: a varstring whose length crosses the
+        boundary raises instead of consuming payload."""
+        # header: proto=2, 0 transforms, INFO_KEYVALUE, count=1,
+        # keylen=200 (crosses into payload) — padded to 8 bytes
+        header = bytes([theader.PROTO_COMPACT, 0,
+                        theader.INFO_KEYVALUE, 1, 200, 0, 0, 0])
+        frame = (
+            struct.pack(">HHIH", 0x0FFF, 0, 1, len(header) // 4)
+            + header + b"P" * 300
+        )
+        with pytest.raises(ValueError, match="boundary"):
+            theader.unwrap(frame)
+
+    def test_endless_varint_rejected(self):
+        # a run of 0x80 continuation bytes never terminates the varint;
+        # the bounded reader raises at the header boundary
+        header = b"\x80" * 8
+        frame = (
+            struct.pack(">HHIH", 0x0FFF, 0, 1, len(header) // 4)
+            + header + b"x"
+        )
+        with pytest.raises(ValueError):
+            theader.unwrap(frame)
 
     def test_unwrap_rejects_transforms(self):
         # hand-build: proto=2, 1 transform (id 1 = zlib)
@@ -211,7 +246,7 @@ class TestTHeaderOnDualStackPort:
             sock.sendall(frame(theader.wrap(msg, seqid=1)))
             reply = read_frame(sock)
             assert theader.looks_like_theader(reply)
-            inner, seqid, _ = theader.unwrap(reply)
+            inner, seqid, _info, _proto = theader.unwrap(reply)
             assert seqid == 1
             assert b"y-node" in inner
             # frame 2: bare framed compact on the SAME connection
@@ -321,6 +356,13 @@ class TestFloodTopoAllRoots:
             assert wait_until(
                 lambda: a.store._dbs["0"].dual is not None
                 and a.store._dbs["0"].dual.get_dual("a") is not None
+            )
+            # wait for b's own child REGISTRATION first: unsetting
+            # before it lands would be undone when it arrives (the
+            # registration is protocol traffic, not test traffic)
+            assert wait_until(
+                lambda: "b"
+                in a.store._dbs["0"].dual.get_dual("a").children()
             )
             # drop b as a child everywhere via allRoots (rootId ignored)
             a.store.set_flood_topo_child(

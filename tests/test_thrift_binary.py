@@ -1,0 +1,186 @@
+"""TBinaryProtocol interop: a stock fbthrift client left on the
+DEFAULT binary protocol (THeader protocol id 0, or a bare framed
+strict-binary dial) must get service from every dual-stack listener,
+with replies mirrored in the same protocol. Reference: the peer
+channel negotiates protocol from client config
+(kvstore/KvStore.cpp:1400); binary is fbthrift's unconfigured
+default."""
+
+import pytest
+
+from openr_tpu.kvstore.dualstack import DualStackPeerServer
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.utils import thrift_binary as tb
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.thrift_rpc import FramedCompactClient
+
+
+NESTED = tc.StructSchema(
+    "Inner",
+    (
+        tc.Field(1, ("string",), "name"),
+        tc.Field(2, ("i64",), "count", optional=True),
+    ),
+)
+
+EVERY_TYPE = tc.StructSchema(
+    "EveryType",
+    (
+        tc.Field(1, ("bool",), "flag"),
+        tc.Field(2, ("byte",), "small"),
+        tc.Field(3, ("i16",), "mid"),
+        tc.Field(4, ("i32",), "word"),
+        tc.Field(5, ("i64",), "wide"),
+        tc.Field(6, ("double",), "ratio"),
+        tc.Field(7, ("string",), "label"),
+        tc.Field(8, ("binary",), "blob"),
+        tc.Field(9, ("list", ("i32",)), "nums"),
+        tc.Field(10, ("set", ("string",)), "tags"),
+        tc.Field(11, ("map", ("string",), ("i64",)), "counts"),
+        tc.Field(12, ("struct", NESTED), "inner"),
+        tc.Field(13, ("i32",), "absent", optional=True),
+    ),
+)
+
+SAMPLE = {
+    "flag": True,
+    "small": -5,
+    "mid": -30000,
+    "wide": 1 << 40,
+    "word": -123456,
+    "ratio": 2.5,
+    "label": "héllo",
+    "blob": b"\x00\x01\xff",
+    "nums": [1, -2, 3],
+    "tags": {"a", "b"},
+    "counts": {"x": 1, "y": -9},
+    "inner": {"name": "n", "count": 7},
+}
+
+
+class TestBinaryCodec:
+    def test_round_trip_every_type(self):
+        data = tb.encode(EVERY_TYPE, SAMPLE)
+        out = tb.decode(EVERY_TYPE, data)
+        assert out == SAMPLE
+
+    def test_unknown_field_skipped(self):
+        data = tb.encode(EVERY_TYPE, SAMPLE)
+        # decode against a schema that only knows field 7: everything
+        # else must be skipped cleanly (forward compatibility)
+        sparse = tc.StructSchema(
+            "Sparse", (tc.Field(7, ("string",), "label"),)
+        )
+        out = tb.decode(sparse, data)
+        assert out == {"label": "héllo"}
+
+    def test_message_envelope(self):
+        msg = tb.encode_message(
+            "doThing", 1, 42, NESTED, {"name": "z", "count": 1}
+        )
+        assert tb.looks_like_binary(msg)
+        name, mtype, seqid, off = tb.decode_message_header(msg)
+        assert (name, mtype, seqid) == ("doThing", 1, 42)
+        assert tb.decode(NESTED, msg[off:]) == {"name": "z", "count": 1}
+
+    def test_non_strict_rejected(self):
+        with pytest.raises(ValueError, match="strict"):
+            tb.decode_message_header(b"\x00\x00\x00\x07doThing")
+
+    def test_required_field_enforced(self):
+        with pytest.raises(ValueError, match="required"):
+            tb.encode(NESTED, {"count": 3})
+
+
+class TestBinaryWireOnDualStackPort:
+    """All four stock client shapes on ONE advertised peer port:
+    compact-over-header, binary-over-header, bare framed compact,
+    bare framed binary (plus the framework codec, covered elsewhere)."""
+
+    @staticmethod
+    def _get(client):
+        from openr_tpu.kvstore.thrift_peer import _GET_ARGS, _GET_RESULT
+
+        return client.call(
+            "getKvStoreKeyValsFilteredArea",
+            _GET_ARGS,
+            {"filter": {"prefix": "adj:", "originatorIds": [],
+                        "ignoreTtl": False,
+                        "doNotPublishValue": False},
+             "area": "0"},
+            _GET_RESULT,
+        )
+
+    @pytest.mark.parametrize(
+        "theader,binary",
+        [(True, True), (False, True), (True, False), (False, False)],
+        ids=["binary-over-header", "bare-binary",
+             "compact-over-header", "bare-compact"],
+    )
+    def test_every_stock_shape_served(self, theader, binary):
+        a = KvStoreWrapper("a")
+        a.start()
+        server = DualStackPeerServer(a.store, host="127.0.0.1")
+        server.start()
+        try:
+            a.set_key("adj:a", b"va", version=1)
+            client = FramedCompactClient(
+                "127.0.0.1", server.port,
+                theader=theader, binary=binary,
+            )
+            result = self._get(client)
+            assert "adj:a" in result["success"]["keyVals"]
+            client.close()
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_binary_on_ctrl_port(self):
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.server import CtrlServer
+        from openr_tpu.ctrl.thrift_ctrl import build_method_table
+
+        a = KvStoreWrapper("bin-node")
+        a.start()
+        handler = OpenrCtrlHandler("bin-node", kvstore=a.store)
+        server = CtrlServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            _, methods = build_method_table(handler)
+            m = methods["getMyNodeName"]
+            for theader in (True, False):
+                client = FramedCompactClient(
+                    "127.0.0.1", server.port,
+                    theader=theader, binary=True,
+                )
+                result = client.call(
+                    "getMyNodeName", m.args_schema, {}, m.result_schema
+                )
+                assert result["success"] == "bin-node"
+                client.close()
+        finally:
+            server.stop()
+            a.stop()
+
+    def test_binary_exception_reply(self):
+        """Dispatch errors reply as a binary-encoded
+        TApplicationException (not a compact one, not a hangup)."""
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.server import CtrlServer
+
+        a = KvStoreWrapper("exc-node")
+        a.start()
+        handler = OpenrCtrlHandler("exc-node", kvstore=a.store)
+        server = CtrlServer(handler, host="127.0.0.1")
+        server.start()
+        try:
+            client = FramedCompactClient(
+                "127.0.0.1", server.port, binary=True
+            )
+            empty = tc.StructSchema("Empty", ())
+            with pytest.raises(RuntimeError, match="unknown method"):
+                client.call("noSuchMethod", empty, {}, empty)
+            client.close()
+        finally:
+            server.stop()
+            a.stop()

@@ -33,6 +33,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE_TIMEOUT_S = 90
 PROBE_PERIOD_S = 240
+HEARTBEAT_PERIOD_S = 15 * 60
 # a capture is "fresh enough" for this long; afterwards a healthy probe
 # triggers a re-capture so the preserved artifact tracks the newest code
 CAPTURE_TTL_S = 45 * 60
@@ -46,6 +47,13 @@ def log(msg: str) -> None:
 
 
 def probe() -> bool:
+    healthy, _ = probe_detail()
+    return healthy
+
+
+def probe_detail() -> tuple[bool, str]:
+    """Probe the relay; return (healthy, detail) where detail names the
+    failure mode (timeout / nonzero exit / cpu-only) for the heartbeat."""
     code = (
         "import jax, jax.numpy as jnp, numpy as np\n"
         "d = jax.devices()[0]\n"
@@ -61,12 +69,43 @@ def probe() -> bool:
             timeout=PROBE_TIMEOUT_S,
         )
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"probe timeout after {PROBE_TIMEOUT_S}s"
     out = proc.stdout.decode(errors="replace")
-    return any(
-        line.startswith("PLATFORM=") and line.split("=", 1)[1] != "cpu"
-        for line in out.splitlines()
-    )
+    for line in out.splitlines():
+        if line.startswith("PLATFORM="):
+            platform = line.split("=", 1)[1]
+            if platform != "cpu":
+                return True, f"healthy ({platform})"
+            return False, "backend came up cpu-only"
+    return False, f"probe exited rc={proc.returncode} without a platform"
+
+
+def heartbeat(
+    round_no: int, healthy: bool, detail: str, state: dict
+) -> None:
+    """Append a probe heartbeat to SCALE_r{N}_captures.jsonl on a coarse
+    cadence so 'relay down all round' is itself a committed, driver-visible
+    artifact (not just prose), even when no capture ever lands."""
+    state["probes"] = state.get("probes", 0) + 1
+    if healthy:
+        state["healthy"] = state.get("healthy", 0) + 1
+    else:
+        state["last_failure"] = detail
+    now = time.time()
+    if now - state.get("last_write", 0.0) < HEARTBEAT_PERIOD_S:
+        return
+    state["last_write"] = now
+    path = os.path.join(REPO, f"SCALE_r{round_no:02d}_captures.jsonl")
+    rec = {
+        "heartbeat": True,
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "probes_total": state["probes"],
+        "probes_healthy": state.get("healthy", 0),
+        "last_failure": state.get("last_failure"),
+        "last_probe": detail,
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 def run_json(cmd: list[str], timeout_s: int):
@@ -202,8 +241,10 @@ def main() -> None:
     last_capture = 0.0
     last_attempt = 0.0
     retry_backoff_s = 15 * 60  # failed capture: don't hammer the relay
+    hb_state: dict = {}
     while True:
-        healthy = probe()
+        healthy, detail = probe_detail()
+        heartbeat(args.round, healthy, detail, hb_state)
         if healthy:
             due = time.time() - last_capture > CAPTURE_TTL_S
             cooled = time.time() - last_attempt > retry_backoff_s
@@ -215,7 +256,7 @@ def main() -> None:
             else:
                 log("relay healthy; capture fresh or cooling down")
         else:
-            log("relay down")
+            log(f"relay down: {detail}")
         if args.once:
             break
         time.sleep(PROBE_PERIOD_S)
