@@ -576,13 +576,21 @@ def route_sweep_bench(
     return out
 
 
-def route_engine_churn_bench(nodes: int, churn_events: int) -> dict:
+def route_engine_churn_bench(
+    nodes: int, churn_events: int, churn_kind: str = "metric",
+    sharded: bool = False,
+) -> dict:
     """Incremental NETWORK-WIDE route reconvergence (ops.route_engine):
     per churn event, ONE fused dispatch re-solves only the affected
     destination rows of the resident route product and reads back
     their digests + sample route rows — the route-server analogue of
     the reference's incremental Decision rebuild, at all-destinations
-    scope. Parity gate: engine digests vs a from-scratch full sweep."""
+    scope. Parity gate: engine digests vs a from-scratch full sweep.
+
+    ``churn_kind="metric"`` wiggles one adjacency's metric per event;
+    ``"link"`` alternates REMOVING and RESTORING a leaf adjacency —
+    real topology churn (LinkState.cpp:565-719 semantics), proving
+    structure events ride the same incremental dispatch."""
     import statistics
     from dataclasses import replace
 
@@ -598,11 +606,56 @@ def route_engine_churn_bench(nodes: int, churn_events: int) -> dict:
     rsw = next(k for k in names if k.startswith("rsw"))
     fsw = next(k for k in names if k.startswith("fsw"))
 
+    mesh = None
+    if sharded:
+        from openr_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices())
     t0 = time.perf_counter()
-    engine = route_engine.RouteSweepEngine(ls, [rsw])
+    engine = route_engine.RouteSweepEngine(ls, [rsw], mesh=mesh)
     cold_ms = (time.perf_counter() - t0) * 1000
 
+    # link-churn state: the adjacency pair currently removed
+    churn_rsw = next(
+        k for k in names if k.startswith("rsw") and k != rsw
+    )
+    pulled: dict = {}
+
+    def drop_link(u, v):
+        for x, y in ((u, v), (v, u)):
+            db = ls.get_adjacency_databases()[x]
+            keep, gone = [], []
+            for a in db.adjacencies:
+                (gone if a.other_node_name == y else keep).append(a)
+            pulled[(x, y)] = tuple(gone)
+            ls.update_adjacency_database(
+                replace(db, adjacencies=tuple(keep))
+            )
+
+    def restore_link(u, v):
+        for x, y in ((u, v), (v, u)):
+            db = ls.get_adjacency_databases()[x]
+            ls.update_adjacency_database(
+                replace(
+                    db,
+                    adjacencies=tuple(
+                        list(db.adjacencies) + list(pulled.pop((x, y)))
+                    ),
+                )
+            )
+
     def churn(step):
+        if churn_kind == "link":
+            peer = ls.get_adjacency_databases()[churn_rsw].adjacencies[
+                0
+            ].other_node_name if not pulled else next(
+                v for (u, v) in pulled if u == churn_rsw
+            )
+            if pulled:
+                restore_link(churn_rsw, peer)
+            else:
+                drop_link(churn_rsw, peer)
+            return {churn_rsw, peer}
         db = ls.get_adjacency_databases()[fsw]
         adjs = list(db.adjacencies)
         a0 = adjs[0]
@@ -633,6 +686,10 @@ def route_engine_churn_bench(nodes: int, churn_events: int) -> dict:
 
     return {
         "bench": f"scale.route_engine_churn_{engine.graph.n}_nodes",
+        "churn_kind": churn_kind,
+        "sharded_devices": (
+            mesh.devices.size if mesh is not None else 0
+        ),
         "events": churn_events,
         "median_ms": round(statistics.median(samples), 1),
         "p90_ms": round(
@@ -668,6 +725,16 @@ def main(argv=None):
     p.add_argument("--routes-churn", action="store_true",
                    help="incremental network-wide route reconvergence "
                         "via the resident route engine")
+    p.add_argument("--churn-kind", choices=("metric", "link"),
+                   default="metric",
+                   help="routes-churn event type: metric wiggle, or "
+                        "alternating link remove/restore (topology "
+                        "churn on the incremental path)")
+    p.add_argument("--sharded", action="store_true",
+                   help="routes-churn: shard the resident engine over "
+                        "all visible devices (the past-12k design; on "
+                        "one chip this measures the sharded dispatch "
+                        "overhead)")
     p.add_argument("--routes", action="store_true",
                    help="all-sources sweep with on-device route "
                         "selection (digest + sample readback only)")
@@ -682,7 +749,11 @@ def main(argv=None):
     if args.routes_churn:
         print(
             json.dumps(
-                route_engine_churn_bench(args.nodes, args.churn_events)
+                route_engine_churn_bench(
+                    args.nodes, args.churn_events,
+                    churn_kind=args.churn_kind,
+                    sharded=args.sharded,
+                )
             ),
             flush=True,
         )

@@ -942,6 +942,39 @@ class KvStore:
             lambda: self._db(area).peer_endpoints()
         )
 
+    def spt_infos(self, area: str) -> Dict:
+        """Flood-topology snapshot for the ctrl getSpanningTreeInfos
+        RPC (reference: KvStore.thrift SptInfos + KvStore.cpp
+        processFloodTopoGet): per-root passive/cost/parent/children,
+        the elected flood root, and the flooding peer set. Empty when
+        flood optimization is off."""
+
+        def snap() -> Dict:
+            db = self._db(area)
+            if db.dual is None:
+                return {"infos": {}, "flood_root_id": None,
+                        "flood_peers": set()}
+            from openr_tpu.dual.dual import DualState
+
+            infos = {}
+            for root, dual in db.dual.duals.items():
+                infos[root] = {
+                    "passive": dual.sm.state == DualState.PASSIVE,
+                    "cost": int(dual.distance),
+                    "parent": dual.nexthop,
+                    "children": dual.children(),
+                }
+            root = db.dual.pick_flood_root()
+            return {
+                "infos": infos,
+                "flood_root_id": root,
+                "flood_peers": (
+                    db.dual.spt_peers(root) if root is not None else set()
+                ),
+            }
+
+        return self.evb.call_and_wait(snap)
+
     def process_dual_messages(self, area: str, sender: str, msgs) -> None:
         self.evb.call_and_wait(
             lambda: self._db(area).process_dual_messages(sender, msgs)

@@ -556,5 +556,27 @@ class LinkMonitor:
             lambda: {n: e.info for n, e in self._interfaces.items()}
         )
 
+    def get_interface_details(self):
+        """One-snapshot dump for the ctrl getInterfaces RPC (reference:
+        LinkMonitor.thrift DumpLinksReply): node overload bit plus, per
+        interface, (InterfaceInfo, link overload, interface-wide metric
+        override or None). The per-(iface, neighbor) overrides ride
+        getLinkMonitorAdjacencies, as in the reference."""
+
+        def snap():
+            return (
+                self.is_overloaded,
+                {
+                    n: (
+                        e.info,
+                        n in self._link_overloads,
+                        self._iface_metric_overrides.get(n),
+                    )
+                    for n, e in self._interfaces.items()
+                },
+            )
+
+        return self.evb.call_and_wait(snap)
+
     def get_counters(self) -> Dict[str, int]:
         return self.evb.call_and_wait(lambda: dict(self.counters))

@@ -13,7 +13,12 @@ subset of rows from scratch is correct regardless of what changed.
 That sidesteps the monotonicity trap of in-place re-relaxation (weight
 increases cannot be fixed by further min-relaxation).
 
-Per churn event (metric/overload-only; topology changes rebuild):
+Per churn event — metric changes, overload flips, AND link add/remove
+between known nodes (the detection diffs the directed edge set, so a
+removed edge that was tight or an added edge that improves/ties marks
+the row; a row outgrowing its slot class widens its band in place,
+ell_patch(widen=True), preserving node ids and the resident DR). Only
+node add/remove — a renumbering event — cold-rebuilds:
 
 1. host: diff the changed directed edges {(u, v): w_old -> w_new} and
    overload flips (an O(degree) LinkState journal read),
@@ -37,10 +42,14 @@ Per churn event (metric/overload-only; topology changes rebuild):
    O(N^2); the caller sees which destinations moved and their fresh
    routes.
 
-Memory: DR stays device-resident at [n_pad, n_pad] int32 — the same
-single-chip residency envelope as the incremental KSP2 engine (~400 MB
-at 10k, 12k bound); past that the full sweep's block/mesh path is the
-fallback.
+Memory: DR stays device-resident at [n_pad, n_pad] int32 — whole on a
+single chip (~400 MB at 10k, 12k bound, the same envelope as the
+incremental KSP2 engine), or ROW-SHARDED over a device mesh
+(``mesh=`` at construction): each device owns n_pad/ndev destination
+rows, detection + re-solve run per shard (rows never interact; the
+only collective is the 1-bit convergence vote), and the bound scales
+with sqrt(ndev) — ~100k on a 64-way mesh. The sharded event costs two
+dispatches (band patch + detect/solve) instead of one.
 
 Reference semantics: the product matches SpfSolver::buildRouteDb /
 getNextHopsWithMetric (Decision.cpp:569-734, :1124) for every source
@@ -104,30 +113,25 @@ def _full_resident_sweep(v_t, w_t, overloaded, samp_ids, samp_v,
     return dr, digests, packed
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n", "k"))
-def _churn_step(
-    v_t, w_t, patch_ids_t, patch_v_t, patch_w_t,
-    dr, digests,
-    e_u, e_v, e_w_old, e_w_new,
-    overloaded_new,
-    samp_ids, samp_v, samp_w, pos_w,
-    bands, n, k,
-):
-    """The fused incremental dispatch. Returns (new band tensors, DR,
-    digests, packed [k+1, W]) where packed row 0 col 0 carries the
-    TRUE affected count (overflow detection) and rows 1..k the
-    affected destinations' route product prefixed by their ids."""
-    # a. affected rows against the RESIDENT (pre-patch) DR. Raw
-    # weights (not overload-effective) make the test conservative:
-    # coincidental tightness over-selects, never under-selects;
-    # overload flips arrive as INF transitions from the host.
-    dr_u = dr[:, e_u]  # [n, E]
+def _detect_rows(dr, e_u, e_v, e_w_old, e_w_new, k, row_start):
+    """Affected-row detection against a (shard of the) RESIDENT
+    pre-patch DR. Raw weights (not overload-effective) make the test
+    conservative: coincidental tightness over-selects, never
+    under-selects; overload flips arrive as INF transitions from the
+    host.
+
+    Old side: the edge was TIGHT (it may have carried a shortest path
+    or an ECMP tie that the change breaks). New side is NON-strict: an
+    edge landing exactly ON the current best creates new equal-cost
+    next hops — distances unchanged, ECMP masks (and digests) changed
+    (the undrain case).
+
+    Returns (count, local row ids [k], global destination ids [k]);
+    padding entries repeat the FIRST affected id so every duplicate
+    scatter index writes an identical fresh row — deterministic and
+    correct."""
+    dr_u = dr[:, e_u]  # [rows, E]
     dr_v = dr[:, e_v]
-    # old side: the edge was TIGHT (it may have carried a shortest
-    # path or an ECMP tie that the change breaks). New side must be
-    # NON-strict: an edge landing exactly ON the current best creates
-    # new equal-cost next hops — distances unchanged, ECMP masks (and
-    # digests) changed (the undrain case).
     tight_old = dr_u == jnp.minimum(e_w_old[None, :] + dr_v, INF)
     ties_or_improves_new = (
         jnp.minimum(e_w_new[None, :] + dr_v, INF) <= dr_u
@@ -135,49 +139,38 @@ def _churn_step(
     usable = (e_w_old[None, :] < INF) | (e_w_new[None, :] < INF)
     affected = jnp.any(
         (tight_old | ties_or_improves_new) & usable, axis=1
-    )  # [n]
+    )  # [rows]
     count = jnp.sum(affected.astype(jnp.int32))
-    ids = jnp.nonzero(affected, size=k, fill_value=0)[0].astype(
+    local = jnp.nonzero(affected, size=k, fill_value=0)[0].astype(
         jnp.int32
     )
-    # padding entries re-solve the FIRST affected id: every duplicate
-    # scatter index then writes an identical fresh row, so the
-    # duplicate-scatter result is deterministic and correct
     valid = jnp.arange(k) < count
-    ids = jnp.where(valid, ids, ids[0])
+    local = jnp.where(valid, local, local[0])
+    return count, local, local + row_start
 
-    # b. scatter patched band rows (same bucketed shape discipline as
-    # EllState.reconverge)
-    new_v = tuple(
-        s.at[pids, :].set(pv)
-        for s, pids, pv in zip(v_t, patch_ids_t, patch_v_t)
-    )
-    new_w = tuple(
-        w.at[pids, :].set(pw)
-        for w, pids, pw in zip(w_t, patch_ids_t, patch_w_t)
-    )
 
-    # c. re-init + fixed-point the affected rows (independent problems)
+def _resolve_and_pack(
+    bands, v_t, w_t, overloaded, ids, local_ids, count, dr, digests,
+    samp_ids, samp_v, samp_w, pos_w, n, k, vote=None,
+):
+    """Re-init + fixed-point the affected rows (independent problems),
+    extract their route product, scatter fresh rows/digests into the
+    resident (shard of) DR. When count == 0 every id repeats one row
+    and the write is that row's own fresh re-solve: a no-op by value.
+    Returns (dr, digests, packed [k+1, W]) where packed row 0 col 0
+    carries the TRUE affected count (overflow detection) and rows
+    1..k the affected destinations' product prefixed by their ids."""
     rows = rs._rev_fixed_point(
-        bands, new_v, new_w, overloaded_new, ids, n
+        bands, v_t, w_t, overloaded, ids, n, vote=vote
     )
-    # d. extraction for exactly those rows
-    nh_count = rs._nh_counts(
-        rows, bands, new_v, new_w, overloaded_new, ids
-    )
+    nh_count = rs._nh_counts(rows, bands, v_t, w_t, overloaded, ids)
     row_digests = rs._digest_rows(rows, nh_count, pos_w)
     nh_total = jnp.sum(nh_count, axis=1, dtype=jnp.int32)
     d_s, packed_mask = rs._sample_stats(
-        rows, samp_ids, samp_v, samp_w, overloaded_new, ids
+        rows, samp_ids, samp_v, samp_w, overloaded, ids
     )
-
-    # scatter fresh rows/digests into the resident state (duplicates
-    # all write identical values — see the padding note above). When
-    # count == 0 every id is 0 and the write is the row's own fresh
-    # re-solve: a no-op by value.
-    dr = dr.at[ids].set(rows)
-    digests = digests.at[ids].set(row_digests)
-
+    dr = dr.at[local_ids].set(rows)
+    digests = digests.at[local_ids].set(row_digests)
     body = jnp.concatenate(
         [
             ids[:, None],
@@ -195,7 +188,181 @@ def _churn_step(
     meta = jnp.zeros((1, body.shape[1]), dtype=jnp.int32)
     meta = meta.at[0, 0].set(count)
     packed = jnp.concatenate([meta, body], axis=0)
+    return dr, digests, packed
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "k"))
+def _churn_step(
+    v_t, w_t, patch_ids_t, patch_v_t, patch_w_t,
+    dr, digests,
+    e_u, e_v, e_w_old, e_w_new,
+    overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w,
+    bands, n, k,
+):
+    """The fused single-chip incremental dispatch: detection against
+    the resident DR, band-row patch scatter, affected-row re-solve and
+    extraction — one device round trip per churn event."""
+    count, local_ids, ids = _detect_rows(
+        dr, e_u, e_v, e_w_old, e_w_new, k, 0
+    )
+    # scatter patched band rows (same bucketed shape discipline as
+    # EllState.reconverge)
+    new_v = tuple(
+        s.at[pids, :].set(pv)
+        for s, pids, pv in zip(v_t, patch_ids_t, patch_v_t)
+    )
+    new_w = tuple(
+        w.at[pids, :].set(pw)
+        for w, pids, pw in zip(w_t, patch_ids_t, patch_w_t)
+    )
+    dr, digests, packed = _resolve_and_pack(
+        bands, new_v, new_w, overloaded_new, ids, local_ids, count,
+        dr, digests, samp_ids, samp_v, samp_w, pos_w, n, k,
+    )
     return new_v, new_w, dr, digests, packed
+
+
+# -- mesh-sharded dispatches ----------------------------------------------
+
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from openr_tpu.ops.spf_sparse import SOURCES_AXIS  # noqa: E402
+
+
+@jax.jit
+def _patch_bands(v_t, w_t, patch_ids_t, patch_v_t, patch_w_t):
+    """Scatter patched band rows into the (replicated) resident band
+    tensors — the sharded engine's band patch rides this one small
+    dispatch instead of being fused into the churn step (replicated
+    outputs from inside shard_map would need cross-shard replication
+    bookkeeping for no bandwidth win; the patch is O(degree))."""
+    new_v = tuple(
+        s.at[pids, :].set(pv)
+        for s, pids, pv in zip(v_t, patch_ids_t, patch_v_t)
+    )
+    new_w = tuple(
+        w.at[pids, :].set(pw)
+        for w, pids, pw in zip(w_t, patch_ids_t, patch_w_t)
+    )
+    return new_v, new_w
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
+def _sharded_full_resident(
+    v_t, w_t, overloaded, samp_ids, samp_v, samp_w, pos_w, bands, n,
+    mesh,
+):
+    """Sharded cold build: every device solves its block of destination
+    rows (the axis the single-chip engine holds whole); DR and digests
+    come back SHARDED over the mesh — the resident footprint per device
+    is n_pad^2/ndev, which is what breaks the single-chip 12k bound.
+    Only collective: the 1-bit convergence vote per iteration."""
+    nb = len(v_t)
+
+    def shard_fn(t_blk, *rest):
+        v_r = rest[:nb]
+        w_r = rest[nb : 2 * nb]
+        ov_r, sid_r, sv_r, sw_r, pw_r = rest[2 * nb :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        dr = rs._rev_fixed_point(
+            bands, v_r, w_r, ov_r, t_blk, n, vote=vote
+        )
+        nh_count = rs._nh_counts(dr, bands, v_r, w_r, ov_r, t_blk)
+        digests = rs._digest_rows(dr, nh_count, pw_r)
+        nh_total = jnp.sum(nh_count, axis=1, dtype=jnp.int32)
+        d_s, packed_mask = rs._sample_stats(
+            dr, sid_r, sv_r, sw_r, ov_r, t_blk
+        )
+        b = t_blk.shape[0]
+        packed = jnp.concatenate(
+            [
+                jax.lax.bitcast_convert_type(digests, jnp.int32)[
+                    :, None
+                ],
+                nh_total[:, None],
+                d_s,
+                jax.lax.bitcast_convert_type(
+                    packed_mask, jnp.int32
+                ).reshape(b, -1),
+            ],
+            axis=1,
+        )
+        return dr, digests, packed
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS)]
+            + [P(None, None)] * (2 * nb)
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+        ),
+    )(
+        jnp.arange(n, dtype=jnp.int32),
+        *v_t, *w_t, overloaded, samp_ids, samp_v, samp_w, pos_w,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "k", "mesh"))
+def _sharded_churn_step(
+    v_t, w_t, dr, digests,
+    e_u, e_v, e_w_old, e_w_new,
+    overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w,
+    bands, n, k, mesh,
+):
+    """The sharded incremental dispatch: detection runs PER SHARD
+    against its resident DR rows (destination rows never interact, so
+    each shard's affected set is exactly its own rows' detection), the
+    re-solve runs on each shard's affected rows with the convergence
+    vote lifted over the mesh, and the packed result comes back as
+    ndev stacked [k+1, W] segments (each shard's count in its meta
+    row). Band tensors arrive ALREADY PATCHED (_patch_bands)."""
+    nb = len(v_t)
+    rows_per = n // mesh.devices.size
+
+    def shard_fn(dr_s, dg_s, *rest):
+        v_r = rest[:nb]
+        w_r = rest[nb : 2 * nb]
+        (e_u_r, e_v_r, e_wo_r, e_wn_r, ov_r,
+         sid_r, sv_r, sw_r, pw_r) = rest[2 * nb :]
+        row_start = (
+            jax.lax.axis_index(SOURCES_AXIS) * rows_per
+        ).astype(jnp.int32)
+        count, local_ids, ids = _detect_rows(
+            dr_s, e_u_r, e_v_r, e_wo_r, e_wn_r, k, row_start
+        )
+        return _resolve_and_pack(
+            bands, v_r, w_r, ov_r, ids, local_ids, count, dr_s, dg_s,
+            sid_r, sv_r, sw_r, pw_r, n, k,
+            vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+        )
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS, None), P(SOURCES_AXIS)]
+            + [P(None, None)] * (2 * nb)
+            + [P(None)] * 4
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+        ),
+    )(
+        dr, digests, *v_t, *w_t,
+        e_u, e_v, e_w_old, e_w_new, overloaded_new,
+        samp_ids, samp_v, samp_w, pos_w,
+    )
 
 
 class RouteSweepEngine:
@@ -204,24 +371,42 @@ class RouteSweepEngine:
     cold_build(ls) -> RouteSweepResult (full product)
     churn(ls, affected_nodes) -> (affected destination names, their
     fresh per-sample route rows) or None when the event needs a cold
-    rebuild (topology/structure change or affected overflow).
+    rebuild (node add/remove, a sample node's slot-table reshape, or
+    affected-count overflow past the largest bucket). Link add/remove
+    and band widening stay on the incremental path.
     """
 
     def __init__(self, ls, sample_names: Sequence[str],
-                 align: int = 128):
+                 align: int = 128, mesh: Optional[Mesh] = None):
         self.sample_names = tuple(sample_names)
+        self.mesh = mesh
+        if mesh is not None:
+            # every shard must own an equal block of destination rows
+            align = align * mesh.devices.size
         self._align = align
         self._k_hint = _ROW_BUCKETS[0]
         self._build(ls)
+
+    def _max_nodes(self) -> int:
+        """Residency bound: the resident DR is [n_pad, n_pad] int32 —
+        whole on a single chip, row-sharded over a mesh (per-device
+        footprint n_pad^2/ndev), so the bound scales with sqrt(ndev):
+        12k single-chip, ~100k on a 64-way mesh."""
+        if self.mesh is None:
+            return ENGINE_MAX_NODES
+        import math
+
+        return int(ENGINE_MAX_NODES * math.sqrt(self.mesh.devices.size))
 
     # -- state -------------------------------------------------------------
 
     def _build(self, ls) -> None:
         graph = compile_ell(ls, align=self._align, direction="out")
-        if graph.n_pad > ENGINE_MAX_NODES:
+        if graph.n_pad > self._max_nodes():
             raise ValueError(
                 f"route engine residency bound: {graph.n_pad} > "
-                f"{ENGINE_MAX_NODES} (use the block/mesh sweep)"
+                f"{self._max_nodes()} (use the block/mesh sweep, or "
+                "a larger mesh)"
             )
         self.graph = graph
         self.sweeper = rs.RouteSweeper(graph, self.sample_names)
@@ -241,13 +426,22 @@ class RouteSweepEngine:
         self._ov_host = {
             nm: ls.is_node_overloaded(nm) for nm in graph.node_names
         }
-        dr, digests, packed = _full_resident_sweep(
-            self.sweeper.v_t, self.sweeper.w_t,
-            self.sweeper.overloaded,
-            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-            graph.bands, graph.n_pad,
-        )
+        if self.mesh is None:
+            dr, digests, packed = _full_resident_sweep(
+                self.sweeper.v_t, self.sweeper.w_t,
+                self.sweeper.overloaded,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                graph.bands, graph.n_pad,
+            )
+        else:
+            dr, digests, packed = _sharded_full_resident(
+                self.sweeper.v_t, self.sweeper.w_t,
+                self.sweeper.overloaded,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                graph.bands, graph.n_pad, self.mesh,
+            )
         self._dr = dr
         self._digests_dev = digests
         self.result = rs.assemble_result(
@@ -290,7 +484,9 @@ class RouteSweepEngine:
         are refreshed in place); falls back to a cold rebuild (and
         returns None) when incrementality does not apply."""
         graph = self.graph
-        patched = ell_patch(graph, ls, sorted(affected_nodes))
+        patched = ell_patch(
+            graph, ls, sorted(affected_nodes), widen=True
+        )
         if patched is None or not self._refresh_sample_bands(
             patched, affected_nodes
         ):
@@ -375,53 +571,102 @@ class RouteSweepEngine:
                 [e_wn, np.full(pad, INF, np.int32)]
             )
 
-        # band patch tensors (same discipline as EllState.reconverge)
+        # band patch tensors (same discipline as EllState.reconverge).
+        # A WIDENED band (a row outgrew its slot class and ell_patch
+        # grew k in place) changed tensor SHAPE: the resident band
+        # cannot be row-scattered into — upload it wholesale as the
+        # dispatch input and make its scatter a no-op. Node ids are
+        # unchanged, so the resident DR stays valid; the new band
+        # shapes cost one jit recompile of the churn step.
+        widened = patched.widened or frozenset()
+        in_v = list(self.sweeper.v_t)
+        in_w = list(self.sweeper.w_t)
         patch_ids, patch_v, patch_w = [], [], []
         changed_rows = patched.changed or {}
         for bi, band in enumerate(patched.bands):
-            rows_b = changed_rows.get(bi)
-            if rows_b is None or len(rows_b) == 0:
+            if bi in widened:
+                in_v[bi] = jnp.asarray(patched.src[bi])
+                in_w[bi] = jnp.asarray(patched.w[bi])
                 rows_b = np.zeros(1, dtype=np.int32)
             else:
-                padded = pad_patch_rows(
-                    np.asarray(rows_b, dtype=np.int32)
-                )
-                rows_b = (
-                    padded
-                    if padded is not None
-                    else np.arange(band.rows, dtype=np.int32)
-                )
+                rows_b = changed_rows.get(bi)
+                if rows_b is None or len(rows_b) == 0:
+                    rows_b = np.zeros(1, dtype=np.int32)
+                else:
+                    padded = pad_patch_rows(
+                        np.asarray(rows_b, dtype=np.int32)
+                    )
+                    rows_b = (
+                        padded
+                        if padded is not None
+                        else np.arange(band.rows, dtype=np.int32)
+                    )
             patch_ids.append(jnp.asarray(rows_b))
             patch_v.append(jnp.asarray(patched.src[bi][rows_b]))
             patch_w.append(jnp.asarray(patched.w[bi][rows_b]))
 
         ov_new = jnp.asarray(patched.overloaded)
+        e_u_d, e_v_d = jnp.asarray(e_u), jnp.asarray(e_v)
+        e_wo_d, e_wn_d = jnp.asarray(e_wo), jnp.asarray(e_wn)
         buckets = [b for b in _ROW_BUCKETS if b >= self._k_hint]
-        packed = None
+        # segments: per-shard [k+1, W] packed arrays (ONE for the
+        # single-chip engine), each leading with its own meta count —
+        # the bucket k bounds the PER-SHARD affected count
+        segments: List[np.ndarray] = []
+        counts: List[int] = []
+        patched_bands = None
         k = None
         for k in buckets:
-            new_v, new_w_t, dr, digests, packed_dev = _churn_step(
-                self.sweeper.v_t, self.sweeper.w_t,
-                tuple(patch_ids), tuple(patch_v), tuple(patch_w),
-                self._dr, self._digests_dev,
-                jnp.asarray(e_u), jnp.asarray(e_v),
-                jnp.asarray(e_wo), jnp.asarray(e_wn),
-                ov_new,
-                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                graph.bands, graph.n_pad, k,
-            )
-            packed = np.asarray(packed_dev)
-            count = int(packed[0, 0])
-            if count <= k:
+            if self.mesh is None:
+                new_v, new_w_t, dr, digests, packed_dev = _churn_step(
+                    tuple(in_v), tuple(in_w),
+                    tuple(patch_ids), tuple(patch_v), tuple(patch_w),
+                    self._dr, self._digests_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                    graph.bands, graph.n_pad, k,
+                )
+                packed = np.asarray(packed_dev)
+                segments = [packed]
+            else:
+                # band patch in its own small dispatch (see
+                # _patch_bands) — loop-invariant, dispatched once
+                if patched_bands is None:
+                    patched_bands = _patch_bands(
+                        tuple(in_v), tuple(in_w),
+                        tuple(patch_ids), tuple(patch_v),
+                        tuple(patch_w),
+                    )
+                new_v, new_w_t = patched_bands
+                dr, digests, packed_dev = _sharded_churn_step(
+                    new_v, new_w_t,
+                    self._dr, self._digests_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                    graph.bands, graph.n_pad, k, self.mesh,
+                )
+                packed = np.asarray(packed_dev)
+                seg_rows = k + 1
+                segments = [
+                    packed[d * seg_rows : (d + 1) * seg_rows]
+                    for d in range(self.mesh.devices.size)
+                ]
+            counts = [int(seg[0, 0]) for seg in segments]
+            if max(counts) <= k:
                 break
-        if count > k:
+        if max(counts) > k:
             # beyond every bucket: a full rebuild is the honest path
             self._build(ls)
             return None
         # hint tracks the typical event size (decays toward small)
         self._k_hint = max(
-            _ROW_BUCKETS[0], min(1024, 2 * count)
+            _ROW_BUCKETS[0], min(1024, 2 * max(counts))
         )
 
         # commit
@@ -444,20 +689,21 @@ class RouteSweepEngine:
         s = len(self.sweeper.sample_ids)
         kw = self.sweeper.samp_v.shape[1] // 32
         affected_names: List[str] = []
-        for x in range(min(count, k)):
-            row = packed[1 + x]
-            t = int(row[0])
-            if t >= self.graph.n:
-                continue
-            self.result.digests[t] = np.uint32(row[1])
-            self.result.nh_totals[t] = row[2]
-            self.result.sample_metrics[t] = row[3 : 3 + s]
-            self.result.sample_masks[t] = (
-                row[3 + s : 3 + s + s * kw]
-                .view(np.uint32)
-                .reshape(s, kw)
-            )
-            affected_names.append(self.graph.node_names[t])
+        for seg, count in zip(segments, counts):
+            for x in range(min(count, k)):
+                row = seg[1 + x]
+                t = int(row[0])
+                if t >= self.graph.n:
+                    continue
+                self.result.digests[t] = np.uint32(row[1])
+                self.result.nh_totals[t] = row[2]
+                self.result.sample_metrics[t] = row[3 : 3 + s]
+                self.result.sample_masks[t] = (
+                    row[3 + s : 3 + s + s * kw]
+                    .view(np.uint32)
+                    .reshape(s, kw)
+                )
+                affected_names.append(self.graph.node_names[t])
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
         self.incremental_events += 1

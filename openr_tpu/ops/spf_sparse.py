@@ -258,6 +258,11 @@ class EllGraph:
     # band index -> band-local changed row ids, set by ell_patch so
     # EllState.reconverge scatters only those rows; None == full graph
     changed: Optional[Dict[int, np.ndarray]] = None
+    # band indices whose k was grown in-place by ell_patch(widen=True)
+    # (a row outgrew its slot class): node ids are UNCHANGED, but the
+    # band's tensors have a new shape — consumers must re-upload those
+    # bands wholesale instead of row-scattering into resident tensors
+    widened: Optional[frozenset] = None
     # "in": row j holds edges INTO j (the forward-relax layout);
     # "out": row j holds edges OUT of j (the reversed-graph layout the
     # destination-major route sweep relaxes over)
@@ -436,11 +441,23 @@ def compile_ell(ls, align: int = _NODE_PAD,
     )
 
 
-def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
+def ell_patch(
+    graph: EllGraph, ls, affected, widen: bool = False
+) -> Optional[EllGraph]:
     """New EllGraph with only the affected nodes' band rows re-derived;
     ``patched.changed`` maps band index -> band-local row ids. Returns
-    None when the node set changed or a row outgrew its class band
-    (callers fall back to a full compile, which may renumber)."""
+    None when the node set changed, or — unless ``widen`` — when a row
+    outgrew its slot-class band (callers fall back to a full compile,
+    which may renumber).
+
+    ``widen=True`` grows an overflowing band's k in place instead
+    (slots double to the next power of two; node ids are UNCHANGED, so
+    resident per-node device state like the route engine's DR matrix
+    stays valid). Widened band indices are recorded in
+    ``patched.widened``: their tensors changed SHAPE, so a consumer
+    holding resident band tensors must re-upload those bands wholesale
+    (a row-scatter into the old shape cannot represent them) and
+    expects a one-time jit recompile (band shapes are static args)."""
     names = tuple(sorted(ls.get_adjacency_databases().keys()))
     if len(names) != graph.n or any(
         nm not in graph.node_index for nm in names
@@ -450,10 +467,12 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
     edges_of = _in_edges if graph.direction == "in" else _out_edges
     src = list(graph.src)
     w = list(graph.w)
+    bands = list(graph.bands)
     overloaded = graph.overloaded.copy()
     slot_of = dict(graph.slot_of) if per_link else None
     node_slot_keys = dict(graph.node_slot_keys) if per_link else None
     changed: Dict[int, List[int]] = {}
+    widened: set = set()
     copied: set = set()
     for name in affected:
         i = graph.node_index.get(name)
@@ -464,9 +483,33 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         else:
             edges = edges_of(ls, name, graph.node_index)
         bi, band = _band_of(graph, i)
+        band = bands[bi]  # may already have been widened this event
         n_entries = len(slots) if per_link else len(edges)
         if n_entries > band.k:
-            return None
+            if not widen:
+                return None
+            new_k = band.k
+            while new_k < n_entries:
+                new_k *= 2
+            grow = new_k - band.k
+            # self-loop src + INF w padding: inert in every relax
+            pad_src = np.tile(
+                np.arange(
+                    band.start, band.start + band.rows, dtype=np.int32
+                )[:, None],
+                (1, grow),
+            )
+            src[bi] = np.concatenate([src[bi], pad_src], axis=1)
+            w[bi] = np.concatenate(
+                [w[bi], np.full((band.rows, grow), INF, np.int32)],
+                axis=1,
+            )
+            bands[bi] = EllBand(
+                start=band.start, rows=band.rows, k=new_k
+            )
+            band = bands[bi]
+            widened.add(bi)
+            copied.add(bi)  # concatenate already made fresh arrays
         if bi not in copied:
             src[bi] = src[bi].copy()
             w[bi] = w[bi].copy()
@@ -493,13 +536,14 @@ def ell_patch(graph: EllGraph, ls, affected) -> Optional[EllGraph]:
         changed.setdefault(bi, []).append(r)
     return EllGraph(
         node_names=graph.node_names, node_index=graph.node_index,
-        n=graph.n, n_pad=graph.n_pad, bands=graph.bands,
+        n=graph.n, n_pad=graph.n_pad, bands=tuple(bands),
         src=tuple(src), w=tuple(w), overloaded=overloaded,
         changed={bi: np.asarray(sorted(rs), dtype=np.int32)
                  for bi, rs in changed.items()},
         direction=graph.direction,
         slot_of=slot_of,
         node_slot_keys=node_slot_keys,
+        widened=frozenset(widened) if widened else None,
     )
 
 

@@ -1147,3 +1147,372 @@ def dual_messages_from_wire(d: Dict):
         )
         for m in d.get("messages", [])
     ]
+
+
+# -- OpenrCtrl tail surface (perf, links, spark, spt, rib policy, ---------
+# -- advertised/received routes, build info, areas, config) ---------------
+
+# reference: openr/if/Lsdb.thrift:24-32
+PERF_EVENT = StructSchema(
+    "PerfEvent",
+    (
+        Field(1, ("string",), "nodeName"),
+        Field(2, ("string",), "eventDescr"),
+        Field(3, ("i64",), "unixTs"),
+    ),
+)
+
+PERF_EVENTS = StructSchema(
+    "PerfEvents",
+    (Field(1, ("list", ("struct", PERF_EVENT)), "events"),),
+)
+
+# reference: openr/if/Fib.thrift:36-39
+PERF_DATABASE = StructSchema(
+    "PerfDatabase",
+    (
+        Field(1, ("string",), "thisNodeName"),
+        Field(2, ("list", ("struct", PERF_EVENTS)), "eventInfo"),
+    ),
+)
+
+# reference: openr/if/Lsdb.thrift:47-52
+INTERFACE_INFO = StructSchema(
+    "InterfaceInfo",
+    (
+        Field(1, ("bool",), "isUp"),
+        Field(2, ("i64",), "ifIndex"),
+        Field(5, ("list", ("struct", IP_PREFIX)), "networks"),
+    ),
+)
+
+# reference: openr/if/LinkMonitor.thrift:18-23
+INTERFACE_DETAILS = StructSchema(
+    "InterfaceDetails",
+    (
+        Field(1, ("struct", INTERFACE_INFO), "info"),
+        Field(2, ("bool",), "isOverloaded"),
+        Field(3, ("i32",), "metricOverride", optional=True),
+        Field(4, ("i64",), "linkFlapBackOffMs", optional=True),
+    ),
+)
+
+# reference: openr/if/LinkMonitor.thrift:25-30 (numbering 1,3,6 is the
+# IDL's own)
+DUMP_LINKS_REPLY = StructSchema(
+    "DumpLinksReply",
+    (
+        Field(1, ("string",), "thisNodeName"),
+        Field(3, ("bool",), "isOverloaded"),
+        Field(6, ("map", ("string",), ("struct", INTERFACE_DETAILS)),
+              "interfaceDetails"),
+    ),
+)
+
+# reference: openr/if/LinkMonitor.thrift:67-85
+BUILD_INFO = StructSchema(
+    "BuildInfo",
+    (
+        Field(1, ("string",), "buildUser"),
+        Field(2, ("string",), "buildTime"),
+        Field(3, ("i64",), "buildTimeUnix"),
+        Field(4, ("string",), "buildHost"),
+        Field(5, ("string",), "buildPath"),
+        Field(6, ("string",), "buildRevision"),
+        Field(7, ("i64",), "buildRevisionCommitTimeUnix"),
+        Field(8, ("string",), "buildUpstreamRevision"),
+        Field(9, ("i64",), "buildUpstreamRevisionCommitTimeUnix"),
+        Field(10, ("string",), "buildPackageName"),
+        Field(11, ("string",), "buildPackageVersion"),
+        Field(12, ("string",), "buildPackageRelease"),
+        Field(13, ("string",), "buildPlatform"),
+        Field(14, ("string",), "buildRule"),
+        Field(15, ("string",), "buildType"),
+        Field(16, ("string",), "buildTool"),
+        Field(17, ("string",), "buildMode"),
+    ),
+)
+
+# reference: openr/if/Spark.thrift:141-171
+SPARK_NEIGHBOR = StructSchema(
+    "SparkNeighbor",
+    (
+        Field(1, ("string",), "nodeName"),
+        Field(2, ("string",), "state"),
+        Field(3, ("string",), "area"),
+        Field(4, ("struct", BINARY_ADDRESS), "transportAddressV6"),
+        Field(5, ("struct", BINARY_ADDRESS), "transportAddressV4"),
+        Field(6, ("i32",), "openrCtrlThriftPort"),
+        Field(7, ("i32",), "kvStoreCmdPort"),
+        Field(8, ("string",), "remoteIfName"),
+        Field(9, ("string",), "localIfName"),
+        Field(10, ("i64",), "rttUs"),
+        Field(11, ("i32",), "label"),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:201-204
+AREAS_CONFIG = StructSchema(
+    "AreasConfig",
+    (Field(1, ("set", ("string",)), "areas"),),
+)
+
+# reference: openr/if/KvStore.thrift:171-180
+SPT_INFO = StructSchema(
+    "SptInfo",
+    (
+        Field(1, ("bool",), "passive"),
+        Field(2, ("i64",), "cost"),
+        Field(3, ("string",), "parent", optional=True),
+        Field(4, ("set", ("string",)), "children"),
+    ),
+)
+
+# reference: openr/if/Dual.thrift:42-48
+DUAL_PER_NEIGHBOR_COUNTERS = StructSchema(
+    "DualPerNeighborCounters",
+    (
+        Field(1, ("i64",), "pktSent"),
+        Field(2, ("i64",), "pktRecv"),
+        Field(3, ("i64",), "msgSent"),
+        Field(4, ("i64",), "msgRecv"),
+    ),
+)
+
+# reference: openr/if/Dual.thrift:51-60
+DUAL_PER_ROOT_COUNTERS = StructSchema(
+    "DualPerRootCounters",
+    (
+        Field(1, ("i64",), "querySent"),
+        Field(2, ("i64",), "queryRecv"),
+        Field(3, ("i64",), "replySent"),
+        Field(4, ("i64",), "replyRecv"),
+        Field(5, ("i64",), "updateSent"),
+        Field(6, ("i64",), "updateRecv"),
+        Field(7, ("i64",), "totalSent"),
+        Field(8, ("i64",), "totalRecv"),
+    ),
+)
+
+# reference: openr/if/Dual.thrift:72-75
+DUAL_COUNTERS = StructSchema(
+    "DualCounters",
+    (
+        Field(1, ("map", ("string",),
+                 ("struct", DUAL_PER_NEIGHBOR_COUNTERS)),
+              "neighborCounters"),
+        Field(2, ("map", ("string",),
+                 ("map", ("string",),
+                  ("struct", DUAL_PER_ROOT_COUNTERS))),
+              "rootCounters"),
+    ),
+)
+
+# reference: openr/if/KvStore.thrift:188-197
+SPT_INFOS = StructSchema(
+    "SptInfos",
+    (
+        Field(1, ("map", ("string",), ("struct", SPT_INFO)), "infos"),
+        Field(2, ("struct", DUAL_COUNTERS), "counters"),
+        Field(3, ("string",), "floodRootId", optional=True),
+        Field(4, ("set", ("string",)), "floodPeers"),
+    ),
+)
+
+# reference: openr/if/OpenrCtrl.thrift:31-68
+NODE_AND_AREA = StructSchema(
+    "NodeAndArea",
+    (
+        Field(1, ("string",), "node"),
+        Field(2, ("string",), "area"),
+    ),
+)
+
+ADVERTISED_ROUTE = StructSchema(
+    "AdvertisedRoute",
+    (
+        Field(1, ("i32",), "key"),
+        Field(2, ("struct", PREFIX_ENTRY), "route"),
+    ),
+)
+
+ADVERTISED_ROUTE_DETAIL = StructSchema(
+    "AdvertisedRouteDetail",
+    (
+        Field(1, ("struct", IP_PREFIX), "prefix"),
+        Field(2, ("i32",), "bestKey"),
+        Field(3, ("list", ("i32",)), "bestKeys"),
+        Field(4, ("list", ("struct", ADVERTISED_ROUTE)), "routes"),
+    ),
+)
+
+ADVERTISED_ROUTE_FILTER = StructSchema(
+    "AdvertisedRouteFilter",
+    (
+        Field(1, ("list", ("struct", IP_PREFIX)), "prefixes",
+              optional=True),
+        Field(2, ("i32",), "prefixType", optional=True),
+    ),
+)
+
+RECEIVED_ROUTE = StructSchema(
+    "ReceivedRoute",
+    (
+        Field(1, ("struct", NODE_AND_AREA), "key"),
+        Field(2, ("struct", PREFIX_ENTRY), "route"),
+    ),
+)
+
+RECEIVED_ROUTE_DETAIL = StructSchema(
+    "ReceivedRouteDetail",
+    (
+        Field(1, ("struct", IP_PREFIX), "prefix"),
+        Field(2, ("struct", NODE_AND_AREA), "bestKey"),
+        Field(3, ("list", ("struct", NODE_AND_AREA)), "bestKeys"),
+        Field(4, ("list", ("struct", RECEIVED_ROUTE)), "routes"),
+    ),
+)
+
+RECEIVED_ROUTE_FILTER = StructSchema(
+    "ReceivedRouteFilter",
+    (
+        Field(1, ("list", ("struct", IP_PREFIX)), "prefixes",
+              optional=True),
+        Field(2, ("string",), "nodeName", optional=True),
+        Field(3, ("string",), "areaName", optional=True),
+    ),
+)
+
+# reference: openr/if/OpenrCtrl.thrift:84-162 (RibPolicy family)
+RIB_ROUTE_MATCHER = StructSchema(
+    "RibRouteMatcher",
+    (Field(1, ("list", ("struct", IP_PREFIX)), "prefixes",
+           optional=True),),
+)
+
+RIB_ROUTE_ACTION_WEIGHT = StructSchema(
+    "RibRouteActionWeight",
+    (
+        Field(2, ("i32",), "default_weight"),
+        Field(3, ("map", ("string",), ("i32",)), "area_to_weight"),
+        Field(4, ("map", ("string",), ("i32",)), "neighbor_to_weight"),
+    ),
+)
+
+RIB_ROUTE_ACTION = StructSchema(
+    "RibRouteAction",
+    (Field(1, ("struct", RIB_ROUTE_ACTION_WEIGHT), "set_weight",
+           optional=True),),
+)
+
+RIB_POLICY_STATEMENT = StructSchema(
+    "RibPolicyStatement",
+    (
+        Field(1, ("string",), "name"),
+        Field(2, ("struct", RIB_ROUTE_MATCHER), "matcher"),
+        Field(3, ("struct", RIB_ROUTE_ACTION), "action"),
+    ),
+)
+
+RIB_POLICY = StructSchema(
+    "RibPolicy",
+    (
+        Field(1, ("list", ("struct", RIB_POLICY_STATEMENT)),
+              "statements"),
+        Field(2, ("i32",), "ttl_secs"),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:176-180
+AREA_CONFIG = StructSchema(
+    "AreaConfig",
+    (
+        Field(1, ("string",), "area_id"),
+        Field(2, ("list", ("string",)), "interface_regexes"),
+        Field(3, ("list", ("string",)), "neighbor_regexes"),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:24-38
+KVSTORE_CONFIG = StructSchema(
+    "KvstoreConfig",
+    (
+        Field(1, ("i32",), "key_ttl_ms"),
+        Field(2, ("i32",), "sync_interval_s"),
+        Field(3, ("i32",), "ttl_decrement_ms"),
+        Field(8, ("bool",), "enable_flood_optimization",
+              optional=True),
+        Field(9, ("bool",), "is_flood_root", optional=True),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:40-47
+LINK_MONITOR_CONFIG = StructSchema(
+    "LinkMonitorConfig",
+    (
+        Field(1, ("i32",), "linkflap_initial_backoff_ms"),
+        Field(2, ("i32",), "linkflap_max_backoff_ms"),
+        Field(3, ("bool",), "use_rtt_metric"),
+        Field(4, ("list", ("string",)), "include_interface_regexes"),
+        Field(5, ("list", ("string",)), "exclude_interface_regexes"),
+        Field(6, ("list", ("string",)),
+              "redistribute_interface_regexes"),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:57-68
+SPARK_CONFIG = StructSchema(
+    "SparkConfig",
+    (
+        Field(1, ("i32",), "neighbor_discovery_port"),
+        Field(2, ("i32",), "hello_time_s"),
+        Field(3, ("i32",), "fastinit_hello_time_ms"),
+        Field(4, ("i32",), "keepalive_time_s"),
+        Field(5, ("i32",), "hold_time_s"),
+        Field(6, ("i32",), "graceful_restart_time_s"),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:70-74
+WATCHDOG_CONFIG = StructSchema(
+    "WatchdogConfig",
+    (
+        Field(1, ("i32",), "interval_s"),
+        Field(2, ("i32",), "thread_timeout_s"),
+        Field(3, ("i32",), "max_memory_mb"),
+    ),
+)
+
+# reference: openr/if/OpenrConfig.thrift:238-314. The field ids cover
+# the surface this framework models; ids absent here (BGP translation,
+# originated prefixes, eor, prefix allocation details) are simply not
+# emitted — a stock decoder applies IDL defaults, the same
+# forward-compatibility contract this codec's own decoder honours.
+OPENR_CONFIG = StructSchema(
+    "OpenrConfig",
+    (
+        Field(1, ("string",), "node_name"),
+        Field(2, ("string",), "domain"),
+        Field(3, ("list", ("struct", AREA_CONFIG)), "areas"),
+        Field(4, ("string",), "listen_addr"),
+        Field(5, ("i32",), "openr_ctrl_port"),
+        Field(6, ("bool",), "dryrun", optional=True),
+        Field(7, ("bool",), "enable_v4", optional=True),
+        Field(8, ("bool",), "enable_netlink_fib_handler",
+              optional=True),
+        Field(11, ("i32",), "prefix_forwarding_type"),
+        Field(12, ("i32",), "prefix_forwarding_algorithm"),
+        Field(13, ("bool",), "enable_segment_routing", optional=True),
+        Field(15, ("struct", KVSTORE_CONFIG), "kvstore_config"),
+        Field(16, ("struct", LINK_MONITOR_CONFIG),
+              "link_monitor_config"),
+        Field(17, ("struct", SPARK_CONFIG), "spark_config"),
+        Field(18, ("bool",), "enable_watchdog", optional=True),
+        Field(19, ("struct", WATCHDOG_CONFIG), "watchdog_config",
+              optional=True),
+        Field(22, ("bool",), "enable_ordered_fib_programming",
+              optional=True),
+        Field(24, ("bool",), "enable_rib_policy"),
+        Field(51, ("bool",), "enable_best_route_selection"),
+    ),
+)

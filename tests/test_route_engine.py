@@ -155,6 +155,60 @@ class TestRouteEngineParity:
         engine.churn(ls, {rsw, dropped.other_node_name})
         assert engine_digests(engine) == full_digests(ls), "up"
 
+    def test_link_add_overflows_band_widens_in_place(self):
+        """A node at EXACTLY its slot-class capacity gaining a new
+        adjacency must stay on the incremental path: ell_patch widens
+        the band in place (node ids unchanged, resident DR valid)
+        instead of falling back to a cold rebuild."""
+        from openr_tpu.types import Adjacency
+
+        # rsw degree == fsw_per_pod == 8 == the minimum slot class:
+        # zero slack, any added link overflows the band
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=8, rsw_per_pod=2
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        rsws = [n for n in engine.graph.node_names
+                if n.startswith("rsw")]
+        a, b = rsws[0], rsws[-1]
+        assert a.split("-")[1] != b.split("-")[1], "want cross-pod"
+        cold_before = engine.cold_builds
+        for u, v in ((a, b), (b, a)):
+            db = ls.get_adjacency_databases()[u]
+            link = Adjacency(
+                other_node_name=v, if_name=f"xpod-{u}", metric=3,
+                other_if_name=f"xpod-{v}",
+            )
+            ls.update_adjacency_database(
+                replace(
+                    db, adjacencies=tuple(list(db.adjacencies) + [link])
+                )
+            )
+        moved = engine.churn(ls, {a, b})
+        assert moved is not None, "widening must stay incremental"
+        assert engine.cold_builds == cold_before
+        assert engine_digests(engine) == full_digests(ls)
+        # follow-up metric churn on the widened band still works
+        affected = mutate_metric(ls, a, 0, 9)
+        assert engine.churn(ls, affected) is not None
+        assert engine_digests(engine) == full_digests(ls)
+        # and removing the link again takes the incremental path too
+        for u in (a, b):
+            db = ls.get_adjacency_databases()[u]
+            ls.update_adjacency_database(
+                replace(
+                    db,
+                    adjacencies=tuple(
+                        x for x in db.adjacencies
+                        if not x.if_name.startswith("xpod-")
+                    ),
+                )
+            )
+        assert engine.churn(ls, {a, b}) is not None
+        assert engine.cold_builds == cold_before
+        assert engine_digests(engine) == full_digests(ls)
+
     def test_bucket_retry_and_overflow(self):
         # a spine-adjacent change at a bigger fabric affects many rows:
         # exercises the bucket-retry ladder; a change touching every
@@ -189,6 +243,125 @@ class TestRouteEngineParity:
             )
             engine.churn(ls, affected)
             assert engine_digests(engine) == full_digests(ls), step
+
+
+class TestShardedEngine:
+    """Mesh-sharded resident engine: DR rows sharded over the devices
+    (per-device footprint n_pad^2/ndev — what breaks the single-chip
+    12k bound), detection and re-solve per shard, digest parity vs the
+    single-chip full sweep after every churn class."""
+
+    def _engine(self, ls, align=16):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        names = sorted(ls.get_adjacency_databases().keys())
+        mesh = make_mesh(jax.devices())
+        return route_engine.RouteSweepEngine(
+            ls, [names[0]], align=align, mesh=mesh
+        )
+
+    def test_cold_build_matches_full_sweep(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_metric_and_link_churn_parity(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        cold_before = engine.cold_builds
+        # metric churn
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        affected = mutate_metric(ls, rsw, 0, 7)
+        moved = engine.churn(ls, affected)
+        assert moved is not None
+        assert engine_digests(engine) == full_digests(ls), "metric"
+        # link remove + restore (topology churn on the sharded path)
+        db = ls.get_adjacency_databases()[rsw]
+        adjs = list(db.adjacencies)
+        dropped = adjs.pop(0)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "down"
+        db = ls.get_adjacency_databases()[rsw]
+        ls.update_adjacency_database(
+            replace(
+                db, adjacencies=tuple(list(db.adjacencies) + [dropped])
+            )
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == cold_before
+
+    def test_overload_flip_parity(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        fsw = next(n for n in engine.graph.node_names
+                   if n.startswith("fsw"))
+        assert engine.churn(ls, set_overload(ls, fsw, True)) is not None
+        assert engine_digests(engine) == full_digests(ls), "drain"
+        assert engine.churn(
+            ls, set_overload(ls, fsw, False)
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "undrain"
+
+    def test_matches_single_chip_engine(self):
+        """Same churn sequence through both engines: identical digests
+        and identical affected sets (names; detection is per shard but
+        the union must equal the single-chip set)."""
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls_a, ls_b = load(topo), load(topo)
+        names = sorted(ls_a.get_adjacency_databases().keys())
+        single = route_engine.RouteSweepEngine(ls_a, [names[0]])
+        sharded = self._engine(ls_b)
+        rsw = next(n for n in single.graph.node_names
+                   if n.startswith("rsw"))
+        for step, metric in enumerate((5, 9, 2)):
+            aff_a = mutate_metric(ls_a, rsw, 0, metric)
+            aff_b = mutate_metric(ls_b, rsw, 0, metric)
+            moved_a = single.churn(ls_a, aff_a)
+            moved_b = sharded.churn(ls_b, aff_b)
+            assert moved_a is not None and moved_b is not None
+            assert sorted(moved_a) == sorted(moved_b), step
+            assert engine_digests(single) == engine_digests(sharded)
+
+    def test_residency_bound_scales_with_mesh(self):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=2
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        mesh = make_mesh(jax.devices())
+        eng = route_engine.RouteSweepEngine(
+            ls, [names[0]], align=16, mesh=mesh
+        )
+        ndev = mesh.devices.size
+        assert eng._max_nodes() == int(
+            route_engine.ENGINE_MAX_NODES * ndev ** 0.5
+        )
 
 
 class TestSampleNodeChurn:

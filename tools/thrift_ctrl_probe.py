@@ -34,6 +34,59 @@ def _jsonable(obj):
     return obj
 
 
+def _adj_snapshot(client):
+    """Current adj: keys as the thrift KeyVals map shape the long poll
+    compares against."""
+    pub = client.call(
+        "getKvStoreKeyValsFilteredArea",
+        filter={"prefix": "adj:", "originatorIds": [],
+                "ignoreTtl": False, "doNotPublishValue": True},
+        area="0",
+    )
+    return pub["keyVals"]
+
+
+def _follow(client, count: int) -> int:
+    """Follow adjacency-set changes over the STOCK thrift wire: the
+    long-poll emulation of the reference's Rocket streaming
+    subscription (docs/PROTOCOL_GUIDE.md). longPollKvStoreAdj answers
+    true when the snapshot is stale or a change lands; the filtered
+    re-dump then carries the delta."""
+    snapshot = _adj_snapshot(client)
+    print(f"following adjacency changes ({len(snapshot)} adj keys)",
+          flush=True)
+    seen = 0
+    while count <= 0 or seen < count:
+        try:
+            changed = client.call(
+                "longPollKvStoreAdj",
+                snapshot={
+                    k: {"version": v.get("version", 0),
+                        "originatorId": v.get("originatorId", ""),
+                        "ttl": v.get("ttl", 0),
+                        "ttlVersion": v.get("ttlVersion", 0)}
+                    for k, v in snapshot.items()
+                },
+            )
+        except (ConnectionError, OSError):
+            # transport hiccup (the client reconnects per call):
+            # re-arm with the same snapshot rather than crashing out
+            # of a long-running follow
+            continue
+        if not changed:
+            continue  # poll timeout: re-arm with the same snapshot
+        fresh = _adj_snapshot(client)
+        delta = sorted(
+            k for k in set(fresh) | set(snapshot)
+            if fresh.get(k, {}).get("version")
+            != snapshot.get(k, {}).get("version")
+        )
+        print(f"adjacency change: {delta}", flush=True)
+        snapshot = fresh
+        seen += 1
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
@@ -42,6 +95,16 @@ def main() -> int:
                    help="call one RPC and dump its decoded result")
     p.add_argument("--args", default="{}",
                    help="JSON kwargs for --method")
+    p.add_argument("--full", action="store_true",
+                   help="dump the COMPLETE RPC surface: call every "
+                        "read-only RPC and print each result")
+    p.add_argument("--follow", action="store_true",
+                   help="follow adjacency changes over the STOCK wire "
+                        "via the long-poll emulation of the Rocket "
+                        "streaming subscription (longPollKvStoreAdj + "
+                        "filtered re-dump); one line per change")
+    p.add_argument("--follow-count", type=int, default=0,
+                   help="stop --follow after N changes (0 = forever)")
     args = p.parse_args()
 
     client = ThriftCtrlClient(args.host, args.port)
@@ -52,6 +115,50 @@ def main() -> int:
             )
             print(json.dumps(_jsonable(result), indent=2, sort_keys=True))
             return 0
+        if args.full:
+            # every read-only RPC with defaultable args — the full
+            # surface a stock toolchain can dump without mutating state
+            calls = [
+                ("getMyNodeName", {}), ("getOpenrVersion", {}),
+                ("aliveSince", {}), ("getCounters", {}),
+                ("getRunningConfig", {}),
+                ("getRunningConfigThrift", {}),
+                ("getAreasConfig", {}), ("getBuildInfo", {}),
+                ("getKvStoreKeyValsFilteredArea", {
+                    "filter": {"prefix": "", "originatorIds": [],
+                               "ignoreTtl": False,
+                               "doNotPublishValue": True},
+                    "area": "0"}),
+                ("getKvStorePeersArea", {"area": "0"}),
+                ("getSpanningTreeInfos", {"area": "0"}),
+                ("getRouteDb", {}), ("getUnicastRoutes", {}),
+                ("getMplsRoutes", {}), ("getPerfDb", {}),
+                ("getDecisionAdjacencyDbs", {}),
+                ("getAllDecisionAdjacencyDbs", {}),
+                ("getDecisionPrefixDbs", {}),
+                ("getPrefixes", {}), ("getAdvertisedRoutes", {}),
+                ("getReceivedRoutes", {}), ("getInterfaces", {}),
+                ("getLinkMonitorAdjacencies", {}),
+                ("getNeighbors", {}), ("getEventLogs", {}),
+                ("getRibPolicy", {}),
+            ]
+            failures = 0
+            for name, kwargs in calls:
+                try:
+                    result = client.call(name, **kwargs)
+                    print(f"== {name}")
+                    print(json.dumps(_jsonable(result), indent=2,
+                                     sort_keys=True))
+                except RuntimeError as exc:
+                    # declared OpenrError (e.g. rib policy unset) is a
+                    # valid wire answer, not a probe failure
+                    print(f"== {name}: OpenrError: {exc}")
+                except Exception as exc:
+                    failures += 1
+                    print(f"== {name}: FAILED: {exc}")
+            return 1 if failures else 0
+        if args.follow:
+            return _follow(client, args.follow_count)
         node = client.call("getMyNodeName")
         version = client.call("getOpenrVersion")
         counters = client.call("getCounters")

@@ -200,6 +200,15 @@ def capture(round_no: int) -> bool:
              "--churn-events", "10"],
         ),
         (
+            # TOPOLOGY churn on the incremental path: alternating link
+            # remove/restore events ride the same fused dispatch
+            # (band widening in ell_patch keeps node ids stable)
+            "route_engine_link_churn_10k",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes-churn", "--nodes", "10000",
+             "--churn-events", "10", "--churn-kind", "link"],
+        ),
+        (
             # incremental KSP2 with the ENGINE ACTIVE at 10k nodes
             # (VERDICT item 8): 256 KSP2 destinations on the 10k
             # fat-tree, all-pairs event dispatch over the full graph
