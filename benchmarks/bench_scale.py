@@ -578,7 +578,7 @@ def route_sweep_bench(
 
 def route_engine_churn_bench(
     nodes: int, churn_events: int, churn_kind: str = "metric",
-    sharded: bool = False,
+    sharded: bool = False, backend: str = "ell",
 ) -> dict:
     """Incremental NETWORK-WIDE route reconvergence (ops.route_engine):
     per churn event, ONE fused dispatch re-solves only the affected
@@ -611,8 +611,13 @@ def route_engine_churn_bench(
         from openr_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(jax.devices())
+    cls = (
+        route_engine.GroupedRouteSweepEngine
+        if backend == "grouped"
+        else route_engine.RouteSweepEngine
+    )
     t0 = time.perf_counter()
-    engine = route_engine.RouteSweepEngine(ls, [rsw], mesh=mesh)
+    engine = cls(ls, [rsw], mesh=mesh)
     cold_ms = (time.perf_counter() - t0) * 1000
 
     # link-churn state: the adjacency pair currently removed
@@ -687,6 +692,7 @@ def route_engine_churn_bench(
     return {
         "bench": f"scale.route_engine_churn_{engine.graph.n}_nodes",
         "churn_kind": churn_kind,
+        "engine_backend": backend,
         "sharded_devices": (
             mesh.devices.size if mesh is not None else 0
         ),
@@ -753,6 +759,7 @@ def main(argv=None):
                     args.nodes, args.churn_events,
                     churn_kind=args.churn_kind,
                     sharded=args.sharded,
+                    backend=args.backend,
                 )
             ),
             flush=True,

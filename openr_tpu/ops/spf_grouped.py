@@ -600,6 +600,120 @@ def compile_out_grouped(ls, align: int = 128) -> GroupedGraph:
     return compile_grouped(ls, align=align, direction="out")
 
 
+# ---- incremental weight patching -----------------------------------------
+
+
+def slot_table(graph: GroupedGraph) -> Dict[int, List[Tuple]]:
+    """node id -> [(segment flat index, g, s, r, src id)] for every
+    REAL edge slot of the node's band row, in device_tensors order.
+
+    Captured at compile time (a real slot has w < INF in the fresh
+    layout), so later in-place removals (slot INF'd by grouped_patch)
+    stay in the table and remain RESTORABLE — the inert self-pad slots
+    are never in it, which is what keeps a patch from double-counting
+    a pad whose id coincides with a real neighbor (nh counts sum over
+    slots; a duplicated edge would corrupt the digest)."""
+    out: Dict[int, List[Tuple]] = {}
+    si = 0
+    for band in graph.bands:
+        for seg in band.segments:
+            # vectorized over the dense [G, S, R] weight tensor: a
+            # python triple loop here costs seconds of host time per
+            # cold build at engine scale (millions of cells at 10k+)
+            gg, ss, rr = np.nonzero(seg.w < INF)
+            if seg.axis == 1:
+                nodes = band.start + gg * band.g2 + rr
+            else:
+                nodes = band.start + rr * band.g2 + gg
+            sids = seg.src[gg, ss]
+            for x in range(len(gg)):
+                out.setdefault(int(nodes[x]), []).append(
+                    (si, int(gg[x]), int(ss[x]), int(rr[x]),
+                     int(sids[x]))
+                )
+            si += 1
+    return out
+
+
+def grouped_patch(
+    graph: GroupedGraph, ls, affected, slots: Dict[int, List[Tuple]]
+):
+    """In-place weight patch for churn on an existing grouped layout:
+    returns (patched GroupedGraph, per-segment update lists
+    {seg flat idx: [(g, s, r, new_w)]}) or None when the event breaks
+    the layout's structure (unknown node, or an edge toward a neighbor
+    the node's slot signature does not carry — a NEW adjacency needs a
+    recompile; the signature grouping is what makes the segments
+    dense).
+
+    Metric changes and edge REMOVALS (slot set to INF — inert in every
+    relaxation) always patch in place: node ids are untouched, so a
+    resident DR keyed by them stays valid. A removed slot stays in the
+    slot table and is restored by a later patch when the edge returns.
+    The patched layout may no longer be what a fresh compile would
+    produce (a removal changes the node's degree class) — stale as a
+    CANONICAL layout, but exact as a relaxation structure."""
+    edges_of = _in_edges if graph.direction == "in" else _out_edges
+    names = tuple(sorted(ls.get_adjacency_databases().keys()))
+    if len(names) != graph.n or any(
+        nm not in graph.node_index for nm in names
+    ):
+        # node set changed — including a same-count SWAP (one node
+        # out, another in), which a bare length check would miss and
+        # silently serve routes for a topology that no longer exists
+        return None
+    updates: Dict[int, List[Tuple[int, int, int, int]]] = {}
+    overloaded = graph.overloaded.copy()
+    for nm in affected:
+        i = graph.node_index.get(nm)
+        if i is None:
+            return None
+        new_edges = edges_of(ls, nm, graph.node_index)
+        my_slots = slots.get(i, [])
+        slot_srcs = {sid for (_si, _g, _s, _r, sid) in my_slots}
+        if set(new_edges) - slot_srcs:
+            return None  # new neighbor: structure change
+        for (si, g, s, r, sid) in my_slots:
+            # real metrics arrive capped at INF-1 by edges_of (the
+            # same cap compile_grouped applies); INF is exclusively
+            # the removed/pad sentinel. The ONE update list feeds both
+            # the host copy below and the device scatter tensors, so
+            # the two representations cannot diverge.
+            updates.setdefault(si, []).append(
+                (g, s, r, int(new_edges.get(sid, INF)))
+            )
+        overloaded[i] = ls.is_node_overloaded(nm)
+    # copy-on-write the touched segments' host arrays
+    seg_list: List[Segment] = []
+    for band in graph.bands:
+        seg_list.extend(band.segments)
+    patched_segs = list(seg_list)
+    for si, ups in updates.items():
+        w = seg_list[si].w.copy()
+        for (g, s, r, wv) in ups:
+            w[g, s, r] = wv
+        patched_segs[si] = Segment(
+            axis=seg_list[si].axis, src=seg_list[si].src, w=w
+        )
+    bands: List[GridBand] = []
+    si = 0
+    for band in graph.bands:
+        k = len(band.segments)
+        bands.append(
+            GridBand(
+                start=band.start, g1=band.g1, g2=band.g2,
+                segments=tuple(patched_segs[si : si + k]),
+            )
+        )
+        si += k
+    patched = GroupedGraph(
+        node_names=graph.node_names, node_index=graph.node_index,
+        n=graph.n, n_pad=graph.n_pad, bands=tuple(bands),
+        overloaded=overloaded, direction=graph.direction,
+    )
+    return patched, updates
+
+
 @functools.partial(
     jax.jit, static_argnames=("meta", "n", "mesh", "impl")
 )

@@ -364,6 +364,190 @@ class TestShardedEngine:
         )
 
 
+class TestGroupedEngine:
+    """The incremental engine over the grouped (block-bipartite)
+    backend: same digest contract as the ELL engine — every churn
+    class must leave the resident product equal to a from-scratch
+    sweep, with structure-breaking events (new adjacency) falling back
+    to a cold rebuild."""
+
+    def _engine(self, ls, mesh=None):
+        names = sorted(ls.get_adjacency_databases().keys())
+        return route_engine.GroupedRouteSweepEngine(
+            ls, [names[0]], align=16 if mesh else 128, mesh=mesh
+        )
+
+    def test_cold_build_matches_full_sweep(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_metric_churn_parity(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        for metric in (7, 3, 11):
+            affected = mutate_metric(ls, rsw, 0, metric)
+            moved = engine.churn(ls, affected)
+            assert moved is not None
+            assert engine_digests(engine) == full_digests(ls), metric
+        assert engine.cold_builds == 1
+
+    def test_link_remove_restore_incremental(self):
+        """Edge removal INFs the slot in place; restoring the same
+        adjacency later re-fills it (the slot table keeps removed
+        slots) — both on the incremental path."""
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        db = ls.get_adjacency_databases()[rsw]
+        adjs = list(db.adjacencies)
+        dropped = adjs.pop(0)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "down"
+        db = ls.get_adjacency_databases()[rsw]
+        ls.update_adjacency_database(
+            replace(
+                db, adjacencies=tuple(list(db.adjacencies) + [dropped])
+            )
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == 1
+
+    def test_new_adjacency_cold_rebuilds(self):
+        """A brand-new neighbor is a structure event for the signature
+        grouping: the engine must fall back (and stay correct)."""
+        from openr_tpu.types import Adjacency
+
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=2
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        rsws = [n for n in engine.graph.node_names
+                if n.startswith("rsw")]
+        a, b = rsws[0], rsws[-1]
+        for u, v in ((a, b), (b, a)):
+            db = ls.get_adjacency_databases()[u]
+            link = Adjacency(
+                other_node_name=v, if_name=f"new-{u}", metric=2,
+                other_if_name=f"new-{v}",
+            )
+            ls.update_adjacency_database(
+                replace(
+                    db, adjacencies=tuple(list(db.adjacencies) + [link])
+                )
+            )
+        assert engine.churn(ls, {a, b}) is None
+        assert engine.cold_builds == 2
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_overload_flip_parity(self):
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        fsw = next(n for n in engine.graph.node_names
+                   if n.startswith("fsw"))
+        assert engine.churn(ls, set_overload(ls, fsw, True)) is not None
+        assert engine_digests(engine) == full_digests(ls), "drain"
+        assert engine.churn(
+            ls, set_overload(ls, fsw, False)
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "undrain"
+
+    def test_sharded_grouped_engine_parity(self):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        engine = self._engine(ls, mesh=make_mesh(jax.devices()))
+        assert engine_digests(engine) == full_digests(ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        affected = mutate_metric(ls, rsw, 0, 9)
+        assert engine.churn(ls, affected) is not None
+        assert engine_digests(engine) == full_digests(ls), "metric"
+        # link remove on the sharded grouped path
+        db = ls.get_adjacency_databases()[rsw]
+        adjs = list(db.adjacencies)
+        dropped = adjs.pop(0)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "down"
+        assert engine.cold_builds == 1
+
+    def test_random_churn_fuzz(self):
+        rng = np.random.default_rng(11)
+        topo = topologies.random_mesh(
+            30, degree=4, seed=5, max_metric=12
+        )
+        ls = load(topo)
+        engine = self._engine(ls)
+        names = list(engine.graph.node_names)
+        for step in range(12):
+            node = names[int(rng.integers(len(names)))]
+            db = ls.get_adjacency_databases()[node]
+            if not db.adjacencies:
+                continue
+            i = int(rng.integers(len(db.adjacencies)))
+            affected = mutate_metric(
+                ls, node, i, int(rng.integers(1, 15))
+            )
+            engine.churn(ls, affected)
+            assert engine_digests(engine) == full_digests(ls), step
+
+    def test_matches_ell_engine(self):
+        """Same churn sequence through the ELL and grouped engines:
+        identical canonical digests (name-keyed — the two layouts
+        number nodes differently)."""
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls_a, ls_b = load(topo), load(topo)
+        names = sorted(ls_a.get_adjacency_databases().keys())
+        ell = route_engine.RouteSweepEngine(ls_a, [names[0]])
+        grouped = self._engine(ls_b)
+        rsw = next(n for n in ell.graph.node_names
+                   if n.startswith("rsw"))
+        for metric in (5, 9, 2):
+            moved_a = ell.churn(ls_a, mutate_metric(ls_a, rsw, 0, metric))
+            moved_b = grouped.churn(
+                ls_b, mutate_metric(ls_b, rsw, 0, metric)
+            )
+            assert moved_a is not None and moved_b is not None
+            assert sorted(moved_a) == sorted(moved_b)
+            assert engine_digests(ell) == engine_digests(grouped)
+
+
 class TestSampleNodeChurn:
     def test_sample_node_metric_change_updates_masks(self):
         """Churning the SAMPLE node's own adjacency must refresh the

@@ -209,6 +209,14 @@ def capture(round_no: int) -> bool:
              "--churn-events", "10", "--churn-kind", "link"],
         ),
         (
+            # the grouped-backend incremental engine: the flagship
+            # gather-free relaxation with resident-DR churn
+            "route_engine_churn_10k_grouped",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes-churn", "--nodes", "10000",
+             "--churn-events", "10", "--backend", "grouped"],
+        ),
+        (
             # incremental KSP2 with the ENGINE ACTIVE at 10k nodes
             # (VERDICT item 8): 256 KSP2 destinations on the 10k
             # fat-tree, all-pairs event dispatch over the full graph
