@@ -333,6 +333,27 @@ def _run() -> dict:
             except Exception as e:
                 bench_routes = {"error": f"{type(e).__name__}: {e}"}
 
+    # fifth leg: the incremental route engine on the GROUPED backend —
+    # per churn event ONE fused dispatch re-solves only affected
+    # destination rows of the resident network-wide route product
+    bench_rchurn = None
+    if os.environ.get("OPENR_BENCH_ROUTES") == "1":
+        if leg_elapsed() > 480:
+            bench_rchurn = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import (
+                    route_engine_churn_bench,
+                )
+
+                bench_rchurn = route_engine_churn_bench(
+                    1000, 8, backend="grouped"
+                )
+            except Exception as e:
+                bench_rchurn = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -377,6 +398,7 @@ def _run() -> dict:
         "bench_10k_churn": bench_10k,
         "bench_ksp2_churn": bench_ksp2,
         "bench_route_sweep": bench_routes,
+        "bench_route_engine_churn": bench_rchurn,
         "error": None,
     }
 

@@ -254,6 +254,16 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         "incremental_syncs": SPF_COUNTERS[
             "decision.ksp2_incremental_syncs"
         ] - before["decision.ksp2_incremental_syncs"],
+        # device ROUND TRIPS per event: on a relay-backed chip each
+        # dispatch+readback pays the transport RTT, so this is the
+        # fixed-cost multiplier of the e2e median (the speculative
+        # 1-RTT fast path exists to drive it to 1)
+        "device_batches_per_event": round(
+            (SPF_COUNTERS["decision.ksp2_device_batches"]
+             - before["decision.ksp2_device_batches"])
+            / max(1, churn_events),
+            2,
+        ),
     }
 
 

@@ -32,6 +32,7 @@ deliberate); the decoder accepts any order, per the spec.
 
 from __future__ import annotations
 
+import struct as _struct
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -169,6 +170,13 @@ def _write_value(w: _Writer, ftype: Tuple, value: Any) -> None:
     elif kind in ("i16", "i32", "i64"):
         bits = {"i16": 16, "i32": 32, "i64": 64}[kind]
         w.zigzag(int(value), bits)
+    elif kind == "double":
+        # 8 bytes BIG-endian: fbthrift's CompactProtocol kept the
+        # pre-spec big-endian double encoding (a documented divergence
+        # from the Apache compact spec's little-endian), and THIS
+        # codec's contract is byte-exact fbthrift interop — the wire
+        # the reference's stack actually emits
+        w.buf.extend(_struct.pack(">d", float(value)))
     elif kind == "string":
         w.binary(value.encode("utf-8"))
     elif kind == "binary":
@@ -239,6 +247,8 @@ def _skip(r: _Reader, wtype: int, standalone: bool = False) -> None:
     elif wtype in (T_I16, T_I32, T_I64):
         r.varint()
     elif wtype == T_DOUBLE:
+        if r.pos + 8 > len(r.data):
+            raise ValueError("truncated double")
         r.pos += 8
     elif wtype == T_BINARY:
         r.binary()
@@ -287,6 +297,12 @@ def _read_value(
         return b - 256 if b >= 128 else b
     if kind in ("i16", "i32", "i64"):
         return r.zigzag({"i16": 16, "i32": 32, "i64": 64}[kind])
+    if kind == "double":
+        raw = r.data[r.pos : r.pos + 8]
+        if len(raw) != 8:
+            raise ValueError("truncated double")
+        r.pos += 8
+        return _struct.unpack(">d", raw)[0]
     if kind == "string":
         return r.binary().decode("utf-8")
     if kind == "binary":

@@ -92,6 +92,110 @@ class TestBinaryCodec:
             tb.encode(NESTED, {"count": 3})
 
 
+class TestCrossCodecEquivalence:
+    """The two protocols must agree on VALUES for every schema the
+    wire serves: decode(binary, encode_binary(x)) ==
+    decode(compact, encode_compact(x)) == x, for randomized values
+    over randomized schema shapes. A divergence here means one stock
+    client kind sees different data than the other."""
+
+    def _random_value(self, rng, ftype, depth=0):
+        kind = ftype[0]
+        if kind == "bool":
+            return bool(rng.integers(2))
+        if kind == "byte":
+            return int(rng.integers(-128, 128))
+        if kind == "i16":
+            return int(rng.integers(-(1 << 15), 1 << 15))
+        if kind == "i32":
+            return int(rng.integers(-(1 << 31), 1 << 31))
+        if kind == "i64":
+            return int(rng.integers(-(1 << 62), 1 << 62))
+        if kind == "double":
+            return float(rng.normal())
+        if kind == "string":
+            return "".join(
+                chr(rng.integers(32, 0x2FF))
+                for _ in range(rng.integers(0, 12))
+            )
+        if kind == "binary":
+            return bytes(rng.integers(0, 256, rng.integers(0, 16),
+                                      dtype="uint8"))
+        if kind == "list":
+            return [self._random_value(rng, ftype[1], depth + 1)
+                    for _ in range(rng.integers(0, 6))]
+        if kind == "set":
+            return {self._random_value(rng, ftype[1], depth + 1)
+                    for _ in range(rng.integers(0, 6))}
+        if kind == "map":
+            return {
+                self._random_value(rng, ftype[1], depth + 1):
+                self._random_value(rng, ftype[2], depth + 1)
+                for _ in range(rng.integers(0, 6))
+            }
+        if kind == "struct":
+            return {
+                f.name: self._random_value(rng, f.ftype, depth + 1)
+                for f in ftype[1].fields
+            }
+        raise AssertionError(kind)
+
+    def _random_schema(self, rng, depth=0):
+        scalars = [("bool",), ("byte",), ("i16",), ("i32",), ("i64",),
+                   ("double",), ("string",), ("binary",)]
+        kinds = list(scalars)
+        if depth < 2:
+            kinds += ["list", "set", "map", "struct"]
+        fields = []
+        fid = 0
+        for _ in range(int(rng.integers(1, 6))):
+            fid += int(rng.integers(1, 20))  # exercise id deltas
+            pick = kinds[int(rng.integers(len(kinds)))]
+            if pick == "list":
+                ft = ("list", scalars[int(rng.integers(len(scalars)))])
+            elif pick == "set":
+                # set elements must be hashable + orderable
+                ft = ("set", ("string",))
+            elif pick == "map":
+                ft = ("map", ("string",),
+                      scalars[int(rng.integers(len(scalars)))])
+            elif pick == "struct":
+                ft = ("struct", self._random_schema(rng, depth + 1))
+            else:
+                ft = pick
+            fields.append(tc.Field(fid, ft, f"f{fid}"))
+        return tc.StructSchema(f"Fuzz{depth}", tuple(fields))
+
+    def test_fuzz_both_codecs_agree(self):
+        import numpy as np
+
+        rng = np.random.default_rng(2026)
+        for case in range(40):
+            schema = self._random_schema(rng)
+            value = self._random_value(rng, ("struct", schema))
+            cb = tc.encode(schema, value)
+            bb = tb.encode(schema, value)
+            got_c = tc.decode(schema, cb)
+            got_b = tb.decode(schema, bb)
+            assert got_c == got_b == value, (case, schema.name)
+
+    def test_fuzz_unknown_field_skip_agrees(self):
+        """Both codecs skip unknown fields identically: decode with a
+        schema missing half the fields gives the same subset."""
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        for case in range(20):
+            schema = self._random_schema(rng)
+            value = self._random_value(rng, ("struct", schema))
+            sparse = tc.StructSchema(
+                "Sparse", tuple(schema.fields[::2])
+            )
+            want = {f.name: value[f.name] for f in sparse.fields}
+            assert tc.decode(sparse, tc.encode(schema, value)) == want
+            assert tb.decode(sparse, tb.encode(schema, value)) == want
+
+
 class TestBinaryWireOnDualStackPort:
     """All four stock client shapes on ONE advertised peer port:
     compact-over-header, binary-over-header, bare framed compact,
