@@ -116,25 +116,44 @@ def trace_paths_from_row(
     reference: LinkState.cpp:399 traceOnePath)."""
     inf = int(INF)
     did = index.get(dest)
-    if did is None or dlist[did] >= inf:
+    if did is None:
+        return []
+    # numpy rows index/compare element-wise MUCH slower than a plain
+    # list in the tight predecessor scans below (np.int32 arithmetic
+    # per candidate); one bulk tolist() pays for itself immediately
+    if isinstance(dlist, np.ndarray):
+        dlist = dlist.tolist()
+    if dlist[did] >= inf:
         return []
 
     visited: Set[Link] = set()
     preds: Dict[str, list] = {}
 
+    # first-path traces run with BOTH filter sets empty (nothing
+    # excluded yet): skip the two per-candidate membership tests there
+    # — this is the hottest loop of the per-event host work
+    plain = not excluded and not transit_blocked
+
     def preds_of(v: str):
         got = preds.get(v)
         if got is None:
             dv = dlist[index[v]]
-            got = preds[v] = [
-                (link, u)
-                for link, u, uid, w in cands_of(v)
-                if uid is not None
-                and link not in excluded
-                and (u == src or u not in transit_blocked)
-                and dlist[uid] < inf
-                and dlist[uid] + w == dv
-            ]
+            if plain:
+                got = preds[v] = [
+                    (link, u)
+                    for link, u, uid, w in cands_of(v)
+                    if uid is not None and dlist[uid] + w == dv
+                ]
+            else:
+                got = preds[v] = [
+                    (link, u)
+                    for link, u, uid, w in cands_of(v)
+                    if uid is not None
+                    and link not in excluded
+                    and (u == src or u not in transit_blocked)
+                    and dlist[uid] < inf
+                    and dlist[uid] + w == dv
+                ]
         return got
 
     def trace_one(v: str):

@@ -123,6 +123,7 @@ class Link:
         "nh_v6_2",
         "hold_up_ttl",
         "ordered_names",
+        "_hash",
     )
 
     def __init__(
@@ -152,11 +153,15 @@ class Link:
         self.ordered_names = tuple(
             sorted(((self.n1, self.if1), (self.n2, self.if2)))
         )
+        # identity hash, cached: links land in sets/dicts on the KSP2
+        # trace hot path (hundreds of thousands of hashes per churn
+        # event network-wide) and the tuple-of-tuples hash is not free
+        self._hash = hash(self.ordered_names)
 
     # -- identity ---------------------------------------------------------
 
     def __hash__(self) -> int:
-        return hash(self.ordered_names)
+        return self._hash
 
     def __eq__(self, other) -> bool:
         return (

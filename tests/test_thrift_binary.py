@@ -179,6 +179,28 @@ class TestCrossCodecEquivalence:
             got_b = tb.decode(schema, bb)
             assert got_c == got_b == value, (case, schema.name)
 
+    def test_compact_double_golden_bytes(self):
+        """Byte-level pin of the compact double encoding: fbthrift's
+        CompactProtocol writes doubles BIG-endian (its documented
+        divergence from the Apache compact spec) — a symmetric
+        encode/decode bug ('<d' both sides) would pass every
+        round-trip test while corrupting values on the real wire."""
+        schema = tc.StructSchema(
+            "D", (tc.Field(1, ("double",), "x"),)
+        )
+        got = tc.encode(schema, {"x": 1.0})
+        # field header: (delta 1 << 4) | T_DOUBLE(0x07); then IEEE754
+        # 1.0 big-endian; then STOP
+        assert got == bytes(
+            [0x17, 0x3F, 0xF0, 0, 0, 0, 0, 0, 0, 0x00]
+        )
+        assert tc.decode(schema, got) == {"x": 1.0}
+        # binary protocol: type byte 4, i16 field id, same BE payload
+        got_b = tb.encode(schema, {"x": 1.0})
+        assert got_b == bytes(
+            [4, 0, 1, 0x3F, 0xF0, 0, 0, 0, 0, 0, 0, 0x00]
+        )
+
     def test_fuzz_unknown_field_skip_agrees(self):
         """Both codecs skip unknown fields identically: decode with a
         schema missing half the fields gives the same subset."""
