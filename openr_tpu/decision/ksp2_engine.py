@@ -109,11 +109,19 @@ def trace_paths_from_row(
     excluded: Set[Link],
     cands_of,
     transit_blocked: Set[str],
+    preds_cache: Optional[Dict[str, list]] = None,
 ):
     """Enumerate link-disjoint shortest paths src -> dest from a
     distance row — byte-identical to LinkState._trace_one_path over the
     same SPF (both walk predecessor links in canonical sorted order;
-    reference: LinkState.cpp:399 traceOnePath)."""
+    reference: LinkState.cpp:399 traceOnePath).
+
+    ``preds_cache``: predecessor lists depend only on (dlist, excluded,
+    transit_blocked) — NOT on the destination — so a caller tracing
+    many destinations from the SAME row under the same filters (the
+    per-event first-path loops) passes one shared dict and each node's
+    predecessor list is computed once per event instead of once per
+    destination."""
     inf = int(INF)
     did = index.get(dest)
     if did is None:
@@ -127,7 +135,9 @@ def trace_paths_from_row(
         return []
 
     visited: Set[Link] = set()
-    preds: Dict[str, list] = {}
+    preds: Dict[str, list] = (
+        preds_cache if preds_cache is not None else {}
+    )
 
     # first-path traces run with BOTH filter sets empty (nothing
     # excluded yet): skip the two per-candidate membership tests there
@@ -484,10 +494,12 @@ class Ksp2Engine:
         self.second_paths: Dict[str, List[List[Link]]] = {}
         self.excl: Dict[str, Set[Link]] = {}
         self.node_users: Dict[str, Set[str]] = {}
+        shared_preds: Dict[str, list] = {}  # one row, many dsts
         for dst in dsts:
             paths = trace_paths_from_row(
                 self.src_name, dst, graph.node_index, dlist,
                 set(), cands_of, transit_blocked,
+                preds_cache=shared_preds,
             )
             self.first_paths[dst] = paths
             self.excl[dst] = {l for p in paths for l in p}
@@ -802,6 +814,7 @@ class Ksp2Engine:
             if ls.is_node_overloaded(name) and name != self.src_name
         }
         dlist = d_new_src.astype(np.int32).tolist()
+        shared_preds: Dict[str, list] = {}  # one row, many dsts
         for dst in affected:
             # drop stale reverse-index entries
             for path in self.first_paths.get(dst, []) + self.second_paths.get(
@@ -814,6 +827,7 @@ class Ksp2Engine:
             paths = trace_paths_from_row(
                 self.src_name, dst, graph.node_index, dlist,
                 set(), cands_of, transit_blocked,
+                preds_cache=shared_preds,
             )
             self.first_paths[dst] = paths
             self.excl[dst] = {l for p in paths for l in p}
