@@ -961,3 +961,171 @@ class TestMultiAreaBestPath:
         # node 3 only in B
         assert hops("3", "fd00:4::/64") == {("4", 10, "B")}
         assert hops("3", "fd00:2::/64") is None
+
+
+class TestCompatibilityNode:
+    """reference: DecisionTest.cpp:1377 ConnectivityTest.CompatibilityNodeTest
+    — nodes whose adjacencies carry a DIFFERENT (older) adjacency-label
+    numbering still form bidirectional links and route correctly,
+    including the ECMP case where an asymmetric metric makes the direct
+    and transit paths equal-cost."""
+
+    def test_old_label_space_routes(self):
+        ls = LinkState(area="0")
+        ps = PrefixState()
+        # "old" adjacencies: same links, different adj-label space
+        # (1000021-style labels vs 10000x) — labels are opaque to the
+        # topology; only (node, iface) pairs identify a link
+        ls.update_adjacency_database(db("2", [
+            adj("1", "2/1", "1/2", metric=10, adj_label=1000011),
+            adj("3", "2/3", "3/2", metric=10, adj_label=100003),
+        ], node_label=2))
+        ls.update_adjacency_database(db("3", [
+            adj("2", "3/2", "2/3", metric=10, adj_label=100002),
+            adj("1", "3/1", "1/3", metric=10, adj_label=1000012),
+        ], node_label=3))
+        ls.update_adjacency_database(db("1", [
+            adj("2", "1/2", "2/1", metric=10, adj_label=1000021),
+        ], node_label=1))
+        # node 1 re-announces with BOTH adjacencies, then bumps the
+        # metric toward 2 (adj12_old_2): exercises versioned updates
+        # on a mixed-label-space fabric
+        ls.update_adjacency_database(db("1", [
+            adj("2", "1/2", "2/1", metric=10, adj_label=1000021),
+            adj("3", "1/3", "3/1", metric=10, adj_label=1000031),
+        ], node_label=1))
+        ls.update_adjacency_database(db("1", [
+            adj("2", "1/2", "2/1", metric=20, adj_label=1000022),
+            adj("3", "1/3", "3/1", metric=10, adj_label=1000031),
+        ], node_label=1))
+        for n in ("1", "2", "3"):
+            ps.update_prefix_database(
+                prefix_db(n, [f"fd00:{n}::/64"])
+            )
+        area_ls = {"0": ls}
+
+        from tests.test_spf_solver import nh_neighbors
+
+        # node 1 -> addr2: direct (metric 20) ties the transit path
+        # via 3 (10 + 10) -> ECMP over both neighbors
+        rdb1 = SpfSolver("1").build_route_db("1", area_ls, ps)
+        e2 = rdb1.unicast_routes[IpPrefix.from_str("fd00:2::/64")]
+        assert nh_neighbors(e2) == {"2", "3"}
+        assert all(nh.metric == 20 for nh in e2.nexthops)
+        e3 = rdb1.unicast_routes[IpPrefix.from_str("fd00:3::/64")]
+        assert nh_neighbors(e3) == {"3"}
+        # node 2 routes to both others directly
+        rdb2 = SpfSolver("2").build_route_db("2", area_ls, ps)
+        assert nh_neighbors(
+            rdb2.unicast_routes[IpPrefix.from_str("fd00:1::/64")]
+        ) == {"1"}
+        assert nh_neighbors(
+            rdb2.unicast_routes[IpPrefix.from_str("fd00:3::/64")]
+        ) == {"3"}
+        # the reference's 21-route shape is 6 unicast + 9 node-label +
+        # 6 adj-label across the three perspectives; per perspective
+        # that is 2 unicast + (own POP + 2 peer node labels) + 2
+        # adj-labels — assert node 1's exact MPLS shape (labels here:
+        # adj labels 1000022/1000031 + node labels 1/2/3)
+        assert len(rdb1.unicast_routes) == 2
+        mpls1 = rdb1.mpls_routes
+        assert len(mpls1) == 5, sorted(mpls1)
+        pop = mpls1[1]  # own node label: POP_AND_LOOKUP
+        assert all(
+            nh.mpls_action.action == MplsActionCode.POP_AND_LOOKUP
+            for nh in pop.nexthops
+        )
+        # peer node label 3: direct neighbor -> PHP
+        assert all(
+            nh.mpls_action.action == MplsActionCode.PHP
+            for nh in mpls1[3].nexthops
+        )
+        # node label 2 ties direct (20) with transit via 3 (10+10):
+        # the direct leg PHPs, the transit leg SWAPs
+        acts = {
+            (nh.neighbor_node_name, nh.mpls_action.action)
+            for nh in mpls1[2].nexthops
+        }
+        assert acts == {
+            ("2", MplsActionCode.PHP), ("3", MplsActionCode.SWAP),
+        }
+        # the old-space adj labels program as-is
+        assert {1000022, 1000031} <= set(mpls1)
+
+
+class TestPrefixWithMixedTypeRoutes:
+    """reference: DecisionTest.cpp:6412
+    EnableBestRouteSelectionFixture.PrefixWithMixedTypeRoutes — one
+    prefix announced by node2 as BGP type and node3 as RIB type; best
+    route selection picks across the types by metrics (NOT by
+    announcing type), falling back to the full candidate set on ties."""
+
+    def test_mixed_bgp_rib_same_prefix(self):
+        from openr_tpu.types import PrefixType
+
+        ls = LinkState(area="0")
+        ps = PrefixState()
+        ls.update_adjacency_database(db("1", [
+            adj("2", "1/2", "2/1", metric=10),
+            adj("3", "1/3", "3/1", metric=10),
+        ], node_label=1))
+        ls.update_adjacency_database(db("2", [
+            adj("1", "2/1", "1/2", metric=10),
+        ], node_label=2))
+        ls.update_adjacency_database(db("3", [
+            adj("1", "3/1", "1/3", metric=10),
+        ], node_label=3))
+        shared = IpPrefix.from_str("fd00:10::/64")
+        from openr_tpu.types.lsdb import MetricVector
+
+        # the reference's BGP entry carries an EMPTY MetricVector (not
+        # absent — an absent MV on a BGP advertiser blocks the route)
+        ps.update_prefix_database(PrefixDatabase(
+            this_node_name="2",
+            prefix_entries=(
+                PrefixEntry(
+                    prefix=shared, type=PrefixType.BGP,
+                    mv=MetricVector(),
+                ),
+            ),
+            area="0",
+        ))
+        ps.update_prefix_database(PrefixDatabase(
+            this_node_name="3",
+            prefix_entries=(
+                PrefixEntry(prefix=shared, type=PrefixType.RIB),
+            ),
+            area="0",
+        ))
+        area_ls = {"0": ls}
+
+        from tests.test_spf_solver import nh_neighbors
+
+        # best-route-selection ON (the fixture's enabled leg): equal
+        # metrics on both announcements -> ECMP across the two
+        # announcing nodes regardless of their differing types
+        rdb = SpfSolver(
+            "1", enable_best_route_selection=True
+        ).build_route_db("1", area_ls, ps)
+        assert nh_neighbors(rdb.unicast_routes[shared]) == {"2", "3"}
+        # a higher path preference on the RIB announcement wins the
+        # selection outright (metrics dominate type)
+        from openr_tpu.types import PrefixMetrics
+
+        ps.update_prefix_database(PrefixDatabase(
+            this_node_name="3",
+            prefix_entries=(
+                PrefixEntry(
+                    prefix=shared, type=PrefixType.RIB,
+                    metrics=PrefixMetrics(
+                        version=1, path_preference=2000,
+                        source_preference=100, distance=0,
+                    ),
+                ),
+            ),
+            area="0",
+        ))
+        rdb = SpfSolver(
+            "1", enable_best_route_selection=True
+        ).build_route_db("1", area_ls, ps)
+        assert nh_neighbors(rdb.unicast_routes[shared]) == {"3"}
