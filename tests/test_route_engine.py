@@ -548,6 +548,22 @@ class TestGroupedEngine:
             assert engine_digests(ell) == engine_digests(grouped)
 
 
+class TestRouteServerDemo:
+    def test_demo_runs_both_backends(self, capsys):
+        """examples/route_server_demo.py end to end at small scale:
+        resident build, metric + link-down events, oracle parity."""
+        import sys
+
+        from examples import route_server_demo
+
+        for extra in ([], ["--grouped"]):
+            sys.argv = ["route_server_demo", "--nodes", "80"] + extra
+            assert route_server_demo.main() == 0
+            out = capsys.readouterr().out
+            assert "oracle parity" in out
+            assert "no cold rebuild: 1 build(s) total" in out
+
+
 class TestSampleNodeChurn:
     def test_sample_node_metric_change_updates_masks(self):
         """Churning the SAMPLE node's own adjacency must refresh the
