@@ -76,8 +76,11 @@ def main() -> int:
     t0 = time.perf_counter()
     moved = engine.churn(ls, {fsw, adjs[0].other_node_name})
     dt = (time.perf_counter() - t0) * 1000
-    print(f"metric event: {len(moved)} destinations refreshed in "
-          f"{dt:.1f} ms (every served table current)")
+    if moved is None:
+        print(f"metric event: cold rebuild in {dt:.1f} ms")
+    else:
+        print(f"metric event: {len(moved)} destinations refreshed in "
+              f"{dt:.1f} ms (every served table current)")
 
     # -- link failure ----------------------------------------------------
     db = ls.get_adjacency_databases()[fsw]
@@ -94,9 +97,12 @@ def main() -> int:
     t0 = time.perf_counter()
     moved = engine.churn(ls, {fsw, dropped.other_node_name})
     dt = (time.perf_counter() - t0) * 1000
-    print(f"link-down event: {len(moved)} destinations refreshed in "
-          f"{dt:.1f} ms (incremental — no cold rebuild: "
-          f"{engine.cold_builds} build(s) total)")
+    if moved is None:
+        print(f"link-down event: cold rebuild in {dt:.1f} ms")
+    else:
+        print(f"link-down event: {len(moved)} destinations refreshed "
+              f"in {dt:.1f} ms (incremental — no cold rebuild: "
+              f"{engine.cold_builds} build(s) total)")
 
     # -- oracle parity ---------------------------------------------------
     oracle = ls.run_spf(served[0])
@@ -107,6 +113,9 @@ def main() -> int:
         assert want is not None and metric == want.metric, dst
         assert nhs == set(want.next_hops), dst
         checked += 1
+    # completeness, not just subset parity: every reachable
+    # destination (oracle includes the source itself) must be served
+    assert checked == len(oracle) - 1, (checked, len(oracle))
     print(f"oracle parity: {checked} routes of {served[0]} exact "
           "(metrics + ECMP sets)")
     return 0

@@ -549,7 +549,7 @@ class TestGroupedEngine:
 
 
 class TestRouteServerDemo:
-    def test_demo_runs_both_backends(self, capsys):
+    def test_demo_runs_both_backends(self, capsys, monkeypatch):
         """examples/route_server_demo.py end to end at small scale:
         resident build, metric + link-down events, oracle parity."""
         import sys
@@ -557,7 +557,10 @@ class TestRouteServerDemo:
         from examples import route_server_demo
 
         for extra in ([], ["--grouped"]):
-            sys.argv = ["route_server_demo", "--nodes", "80"] + extra
+            monkeypatch.setattr(
+                sys, "argv",
+                ["route_server_demo", "--nodes", "80"] + extra,
+            )
             assert route_server_demo.main() == 0
             out = capsys.readouterr().out
             assert "oracle parity" in out
