@@ -243,6 +243,28 @@ class Breeze:
         areas = self.client.call("get_kvstore_areas")
         self._print(render_table(["Area"], [(a,) for a in areas]))
 
+    def kvstore_flood(self, area: str = "0") -> None:
+        """reference: breeze kvstore flood — the DUAL spanning-tree
+        snapshot (per-root state, elected flood root, flooding peers)."""
+        snap = self.client.call("get_spanning_tree_infos", area=area)
+        root = snap.get("flood_root_id")
+        self._print(f"flood root: {root if root is not None else '-'}")
+        peers = sorted(snap.get("flood_peers", ()))
+        self._print(f"flood peers: {', '.join(peers) if peers else '-'}")
+        rows = [
+            (
+                rid,
+                "PASSIVE" if info.get("passive") else "ACTIVE",
+                info.get("cost"),
+                info.get("parent") or "-",
+                ", ".join(sorted(info.get("children", ()))) or "-",
+            )
+            for rid, info in sorted(snap.get("infos", {}).items())
+        ]
+        self._print(render_table(
+            ["Root", "State", "Cost", "Parent", "Children"], rows
+        ))
+
     # -- lm ---------------------------------------------------------------
 
     def lm_links(self) -> None:
@@ -302,6 +324,17 @@ class Breeze:
             metric=metric,
         )
         self._print(f"metric override {if_name}->{neighbor} = {metric}")
+
+    def lm_set_interface_metric(self, if_name: str, metric: int):
+        """reference: breeze lm set-link-metric (interface-wide)."""
+        self.client.call(
+            "set_interface_metric", if_name=if_name, metric=metric
+        )
+        self._print(f"interface metric override {if_name} = {metric}")
+
+    def lm_unset_interface_metric(self, if_name: str):
+        self.client.call("unset_interface_metric", if_name=if_name)
+        self._print(f"interface metric override {if_name} cleared")
 
     def lm_unset_link_metric(self, if_name: str, neighbor: str) -> None:
         self.client.call(
@@ -368,6 +401,29 @@ class Breeze:
         else:
             self._print(render_table(["Field", "Running", "File"], rows))
 
+    def config_store_get(self, key: str) -> None:
+        """reference: OpenrCtrl getConfigKey over the PersistentStore."""
+        value = self.client.call("get_config_key", key=key)
+        if value is None:
+            self._print(f"{key}: not found")
+            raise SystemExit(1)
+        self._print(json.dumps(value))
+
+    def config_store_set(self, key: str, value: str) -> None:
+        try:
+            self.client.call("set_config_key", key=key, value=value)
+        except Exception as exc:  # e.g. no persistent store configured
+            self._print(f"error: {exc}")
+            raise SystemExit(1)
+        self._print(f"stored {key}")
+
+    def config_store_erase(self, key: str) -> None:
+        ok = self.client.call("erase_config_key", key=key)
+        if not ok:
+            self._print(f"{key}: not found")
+            raise SystemExit(1)
+        self._print(f"erased {key}")
+
     # -- perf -------------------------------------------------------------
 
     def perf_fib(self) -> None:
@@ -408,6 +464,35 @@ class Breeze:
         self.client.call("withdraw_prefixes", prefixes=prefixes)
         self._print(f"withdrew {len(prefixes)} prefixes")
 
+    def prefixmgr_sync(
+        self, prefix_type: str, prefixes: List[str]
+    ) -> None:
+        """reference: breeze prefixmgr sync — the given set becomes
+        the COMPLETE set for the type (empty withdraws everything)."""
+        self.client.call(
+            "sync_prefixes_by_type",
+            prefix_type=prefix_type, prefixes=prefixes,
+        )
+        self._print(
+            f"synced {len(prefixes)} prefixes for type {prefix_type}"
+        )
+
+    def prefixmgr_advertised_routes(self) -> None:
+        """reference: breeze prefixmgr advertised-routes."""
+        entries = self.client.call("get_advertised_routes")
+        rows = [
+            (
+                e.get("prefix"),
+                e.get("type"),
+                (e.get("metrics") or {}).get("path_preference"),
+                (e.get("metrics") or {}).get("source_preference"),
+            )
+            for e in entries
+        ]
+        self._print(render_table(
+            ["Prefix", "Type", "PathPref", "SrcPref"], rows
+        ))
+
     # -- spark ------------------------------------------------------------
 
     def spark_neighbors(self) -> None:
@@ -446,6 +531,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("file")
     p = c.add_parser("compare")
     p.add_argument("file")
+    p = c.add_parser("store-get")
+    p.add_argument("key")
+    p = c.add_parser("store-set")
+    p.add_argument("key")
+    p.add_argument("value")
+    p = c.add_parser("store-erase")
+    p.add_argument("key")
 
     d = group("decision")
     routes = d.add_parser("routes")
@@ -476,6 +568,8 @@ def build_parser() -> argparse.ArgumentParser:
     peers = k.add_parser("peers")
     peers.add_argument("--area", default="0")
     k.add_parser("areas")
+    flood = k.add_parser("flood")
+    flood.add_argument("--area", default="0")
 
     lm = group("lm")
     lm.add_parser("links")
@@ -493,6 +587,21 @@ def build_parser() -> argparse.ArgumentParser:
     p = lm.add_parser("unset-link-metric")
     p.add_argument("interface")
     p.add_argument("neighbor")
+    # reference naming: set-adj-metric is the per-adjacency override
+    # (what set-link-metric above already does here); set-interface-
+    # metric is the interface-wide override
+    p = lm.add_parser("set-adj-metric")
+    p.add_argument("interface")
+    p.add_argument("neighbor")
+    p.add_argument("metric", type=int)
+    p = lm.add_parser("unset-adj-metric")
+    p.add_argument("interface")
+    p.add_argument("neighbor")
+    p = lm.add_parser("set-interface-metric")
+    p.add_argument("interface")
+    p.add_argument("metric", type=int)
+    p = lm.add_parser("unset-interface-metric")
+    p.add_argument("interface")
 
     m = group("monitor")
     m.add_parser("counters")
@@ -512,6 +621,10 @@ def build_parser() -> argparse.ArgumentParser:
     adv.add_argument("prefixes", nargs="+")
     wd = pm.add_parser("withdraw")
     wd.add_argument("prefixes", nargs="+")
+    sync = pm.add_parser("sync")
+    sync.add_argument("--type", dest="prefix_type", default="BREEZE")
+    sync.add_argument("prefixes", nargs="*")
+    pm.add_parser("advertised-routes")
 
     s = group("spark")
     s.add_parser("neighbors")
@@ -536,6 +649,13 @@ def run(argv: List[str], client=None, out=None) -> int:
         "config.show": breeze.config_show,
         "config.dryrun": lambda: breeze.config_dryrun(args.file),
         "config.compare": lambda: breeze.config_compare(args.file),
+        "config.store_get": lambda: breeze.config_store_get(args.key),
+        "config.store_set": lambda: breeze.config_store_set(
+            args.key, args.value
+        ),
+        "config.store_erase": lambda: breeze.config_store_erase(
+            args.key
+        ),
         "decision.routes": lambda: breeze.decision_routes(args.node),
         "decision.adj": breeze.decision_adj,
         "decision.prefixes": breeze.decision_prefixes,
@@ -554,6 +674,7 @@ def run(argv: List[str], client=None, out=None) -> int:
         ),
         "kvstore.peers": lambda: breeze.kvstore_peers(args.area),
         "kvstore.areas": breeze.kvstore_areas,
+        "kvstore.flood": lambda: breeze.kvstore_flood(args.area),
         "lm.links": breeze.lm_links,
         "lm.adj": breeze.lm_adj,
         "lm.set_node_overload": breeze.lm_set_node_overload,
@@ -570,6 +691,20 @@ def run(argv: List[str], client=None, out=None) -> int:
         "lm.unset_link_metric": lambda: breeze.lm_unset_link_metric(
             args.interface, args.neighbor
         ),
+        "lm.set_adj_metric": lambda: breeze.lm_set_link_metric(
+            args.interface, args.neighbor, args.metric
+        ),
+        "lm.unset_adj_metric": lambda: breeze.lm_unset_link_metric(
+            args.interface, args.neighbor
+        ),
+        "lm.set_interface_metric": lambda: (
+            breeze.lm_set_interface_metric(
+                args.interface, args.metric
+            )
+        ),
+        "lm.unset_interface_metric": lambda: (
+            breeze.lm_unset_interface_metric(args.interface)
+        ),
         "monitor.counters": breeze.monitor_counters,
         "monitor.logs": lambda: breeze.monitor_logs(args.limit),
         "openr.version": breeze.openr_version,
@@ -582,6 +717,11 @@ def run(argv: List[str], client=None, out=None) -> int:
         "prefixmgr.withdraw": lambda: breeze.prefixmgr_withdraw(
             args.prefixes
         ),
+        "prefixmgr.sync": lambda: breeze.prefixmgr_sync(
+            args.prefix_type, args.prefixes
+        ),
+        "prefixmgr.advertised_routes":
+            breeze.prefixmgr_advertised_routes,
         "spark.neighbors": breeze.spark_neighbors,
         "tech_support.": breeze.tech_support,
         "tech_support": breeze.tech_support,

@@ -259,6 +259,12 @@ class OpenrCtrlHandler:
     def get_kvstore_areas(self) -> List[str]:
         return self._kvstore.areas()
 
+    def get_spanning_tree_infos(self, area: str = "0"):
+        """reference: OpenrCtrl.thrift getSpanningTreeInfos — the
+        flood-optimization SPT snapshot (per-root state + elected
+        flood root + flooding peers); empty when DUAL is off."""
+        return self._kvstore.spt_infos(area)
+
     def subscribe_kvstore_filtered(
         self, prefix: str = "", area: str = "0"
     ):

@@ -486,7 +486,7 @@ def build_method_table(handler) -> MethodTable:
         return out
 
     def spt_infos(args):
-        snap = handler._kvstore.spt_infos(args.get("area", "0"))
+        snap = handler.get_spanning_tree_infos(args.get("area", "0"))
         out: Dict[str, Any] = {
             "infos": {
                 root: {

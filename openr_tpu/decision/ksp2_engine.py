@@ -258,6 +258,13 @@ class Ksp2Engine:
             or state is not getattr(self, "state", None)
             or dsts != self.dsts
             or self.sid != state.graph.node_index.get(self.src_name)
+            # a widened band (ell_patch grew a slot class in place)
+            # changed the band tensor shapes the resident masks were
+            # built for: the masked fast path would shape-mismatch,
+            # so re-seed everything from the new shapes
+            or tuple(state.graph.bands) != getattr(
+                self, "band_shapes", None
+            )
         ):
             self._cold_build(ls, state, dsts)
             return None
@@ -459,6 +466,7 @@ class Ksp2Engine:
         graph = state.graph
         self.state = state
         self.dsts = list(dsts)
+        self.band_shapes = tuple(graph.bands)
         self.sid = graph.node_index.get(self.src_name)
         if self.sid is None:
             return

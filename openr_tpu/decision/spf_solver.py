@@ -436,12 +436,12 @@ class _EllResidentCache:
 
     The bands live on the device across rebuilds (EllState). On a
     topology change the LinkState journal's affected set drives
-    ``ell_patch`` and one fused scatter+solve dispatch
-    (``EllState.reconverge``); only a node-set change, a row outgrowing
-    its degree-class band, or a journal gap forces ``compile_ell`` from
-    scratch. This is the sparse analogue of the dense path's
-    SnapshotCache row-patching (reference incremental rebuild:
-    openr/decision/Decision.cpp:1896-1917)."""
+    ``ell_patch(widen=True)`` and one fused scatter+solve dispatch
+    (``EllState.reconverge``); a row outgrowing its slot class widens
+    its band in place (node ids stable), so only a node-set change or
+    a journal gap forces ``compile_ell`` from scratch. This is the
+    sparse analogue of the dense path's SnapshotCache row-patching
+    (reference incremental rebuild: openr/decision/Decision.cpp:1896-1917)."""
 
     def __init__(self) -> None:
         import weakref
@@ -495,7 +495,9 @@ class _EllResidentCache:
                 return state, None
             affected = ls.affected_since(version)
             patched = (
-                spf_sparse.ell_patch(state.graph, ls, sorted(affected))
+                spf_sparse.ell_patch(
+                    state.graph, ls, sorted(affected), widen=True
+                )
                 if affected is not None
                 else None
             )

@@ -512,44 +512,20 @@ class RouteSweepEngine:
         patched = ell_patch(self.graph, ls, affected_sorted, widen=True)
         if patched is None:
             return None
-        # band patch tensors (same discipline as EllState.reconverge).
-        # A WIDENED band (a row outgrew its slot class and ell_patch
-        # grew k in place) changed tensor SHAPE: the resident band
-        # cannot be row-scattered into — upload it wholesale as the
-        # dispatch input and make its scatter a no-op. Node ids are
-        # unchanged, so the resident DR stays valid; the new band
-        # shapes cost one jit recompile of the churn step.
-        widened = patched.widened or frozenset()
-        in_v = list(self.sweeper.v_t)
-        in_w = list(self.sweeper.w_t)
-        patch_ids, patch_v, patch_w = [], [], []
-        changed_rows = patched.changed or {}
-        for bi, band in enumerate(patched.bands):
-            if bi in widened:
-                in_v[bi] = jnp.asarray(patched.src[bi])
-                in_w[bi] = jnp.asarray(patched.w[bi])
-                rows_b = np.zeros(1, dtype=np.int32)
-            else:
-                rows_b = changed_rows.get(bi)
-                if rows_b is None or len(rows_b) == 0:
-                    rows_b = np.zeros(1, dtype=np.int32)
-                else:
-                    padded = pad_patch_rows(
-                        np.asarray(rows_b, dtype=np.int32)
-                    )
-                    rows_b = (
-                        padded
-                        if padded is not None
-                        else np.arange(band.rows, dtype=np.int32)
-                    )
-            patch_ids.append(jnp.asarray(rows_b))
-            patch_v.append(jnp.asarray(patched.src[bi][rows_b]))
-            patch_w.append(jnp.asarray(patched.w[bi][rows_b]))
+        # band patch tensors: the shared discipline (bucketed row
+        # scatter; a WIDENED band — tensor shape changed — re-uploads
+        # wholesale with a no-op scatter; node ids stay fixed so the
+        # resident DR stays valid, at the cost of one jit recompile)
+        from openr_tpu.ops.spf_sparse import band_patch_inputs
+
+        in_v, in_w, patch_ids, patch_v, patch_w = band_patch_inputs(
+            self.sweeper.v_t, self.sweeper.w_t, patched
+        )
         return {
             "patched": patched,
-            "in_v": tuple(in_v), "in_w": tuple(in_w),
-            "patch_ids": tuple(patch_ids),
-            "patch_v": tuple(patch_v), "patch_w": tuple(patch_w),
+            "in_v": in_v, "in_w": in_w,
+            "patch_ids": patch_ids,
+            "patch_v": patch_v, "patch_w": patch_w,
             "patched_bands": None,  # sharded path: lazily dispatched
         }
 

@@ -938,6 +938,47 @@ def ell_masked_distances_resident(
     )
 
 
+def band_patch_inputs(resident_src, resident_w, patched: EllGraph):
+    """The ONE implementation of the band patch discipline shared by
+    every resident-band consumer (EllState.apply_patch/.reconverge and
+    the route engine's churn prep): per band, either a bucketed
+    row-scatter (pad_patch_rows shapes, a zeros(1) no-op when nothing
+    changed) or — for a WIDENED band, whose tensor SHAPE changed — a
+    wholesale re-upload with a no-op scatter. Returns
+    (in_src, in_w, patch_ids, patch_src, patch_w) as tuples of device
+    arrays: dispatch inputs plus the scatter triples."""
+    changed: Dict[int, np.ndarray] = patched.changed or {}
+    widened = patched.widened or frozenset()
+    in_src = list(resident_src)
+    in_w = list(resident_w)
+    patch_ids, patch_src, patch_w = [], [], []
+    for bi, band in enumerate(patched.bands):
+        if bi in widened:
+            in_src[bi] = jnp.asarray(patched.src[bi])
+            in_w[bi] = jnp.asarray(patched.w[bi])
+            rows = np.zeros(1, dtype=np.int32)
+        else:
+            rows = changed.get(bi)
+            if rows is None or len(rows) == 0:
+                rows = np.zeros(1, dtype=np.int32)  # no-op scatter
+            else:
+                padded = pad_patch_rows(
+                    np.asarray(rows, dtype=np.int32)
+                )
+                rows = (
+                    padded
+                    if padded is not None
+                    else np.arange(band.rows, dtype=np.int32)
+                )
+        patch_ids.append(jnp.asarray(rows))
+        patch_src.append(jnp.asarray(patched.src[bi][rows]))
+        patch_w.append(jnp.asarray(patched.w[bi][rows]))
+    return (
+        tuple(in_src), tuple(in_w),
+        tuple(patch_ids), tuple(patch_src), tuple(patch_w),
+    )
+
+
 class EllState:
     """Caller-owned resident device bands for the churn loop.
 
@@ -960,29 +1001,24 @@ class EllState:
     def apply_patch(self, patched: EllGraph) -> None:
         """Scatter a patched graph's changed rows into the resident
         bands WITHOUT solving (for consumers that only need synced
-        device bands, e.g. the KSP2 masked batches)."""
-        changed: Dict[int, np.ndarray] = patched.changed or {}
-        new_src, new_w = [], []
-        for bi, band in enumerate(patched.bands):
-            rows = changed.get(bi)
-            if rows is None or len(rows) == 0:
-                new_src.append(self.src[bi])
-                new_w.append(self.w[bi])
-                continue
-            rows = np.asarray(rows, dtype=np.int32)
-            padded = pad_patch_rows(rows)
-            if padded is None:
-                padded = np.arange(band.rows, dtype=np.int32)
-            # bucketed shapes: the eager .at[].set dispatch compiles one
-            # scatter per bucket, not one per distinct churn size
-            new_src.append(
-                self.src[bi].at[padded, :].set(patched.src[bi][padded])
-            )
-            new_w.append(
-                self.w[bi].at[padded, :].set(patched.w[bi][padded])
-            )
-        self.src = tuple(new_src)
-        self.w = tuple(new_w)
+        device bands, e.g. the KSP2 masked batches). A WIDENED band
+        (ell_patch(widen=True) grew its k — a row outgrew its slot
+        class) changed tensor SHAPE and is re-uploaded wholesale; node
+        ids are unchanged, so every id-keyed resident consumer stays
+        valid."""
+        in_src, in_w, patch_ids, patch_src, patch_w = (
+            band_patch_inputs(self.src, self.w, patched)
+        )
+        # eager bucketed scatters (one compiled shape per bucket); the
+        # no-op rows rewrite identical values
+        self.src = tuple(
+            s.at[ids, :].set(vals)
+            for s, ids, vals in zip(in_src, patch_ids, patch_src)
+        )
+        self.w = tuple(
+            w.at[ids, :].set(vals)
+            for w, ids, vals in zip(in_w, patch_ids, patch_w)
+        )
         self._sync_overloaded(patched)
         # rows are applied: clear the journal so a later reconverge
         # doesn't scatter them again
@@ -990,28 +1026,17 @@ class EllState:
 
     def reconverge(self, patched: EllGraph, srcs):
         """Fused churn step: scatter the patched rows into the resident
-        bands, solve the batched view. O(rows x K_class) transfer."""
-        changed: Dict[int, np.ndarray] = patched.changed or {}
-        patch_ids, patch_src, patch_w = [], [], []
-        for bi, band in enumerate(patched.bands):
-            rows = changed.get(bi)
-            if rows is None or len(rows) == 0:
-                rows = np.zeros(1, dtype=np.int32)  # idempotent no-op
-            else:
-                padded = pad_patch_rows(rows)
-                rows = (
-                    padded
-                    if padded is not None
-                    else np.arange(band.rows, dtype=np.int32)
-                )
-            patch_ids.append(jnp.asarray(rows))
-            patch_src.append(jnp.asarray(patched.src[bi][rows]))
-            patch_w.append(jnp.asarray(patched.w[bi][rows]))
+        bands, solve the batched view. O(rows x K_class) transfer.
+        Widened bands (shape changed) are re-uploaded wholesale as the
+        dispatch inputs with a no-op scatter — same discipline as
+        apply_patch; the new band shapes cost one jit recompile."""
+        in_src, in_w, patch_ids, patch_src, patch_w = (
+            band_patch_inputs(self.src, self.w, patched)
+        )
         srcs_dev, w_sv = _batch_args(patched, srcs)
         self._sync_overloaded(patched)
         self.src, self.w, packed = _ell_reconverge(
-            self.src, self.w,
-            tuple(patch_ids), tuple(patch_src), tuple(patch_w),
+            in_src, in_w, patch_ids, patch_src, patch_w,
             self.overloaded, srcs_dev, w_sv,
             patched.bands, patched.n_pad,
         )

@@ -411,3 +411,98 @@ class TestRibPolicyCli:
         assert "weight-b" in out
         assert "fd00:b::/64" in out
         assert "nbr b=3" in out  # the action must be visible
+
+
+class TestBreezeRound5Tails:
+    """The subcommand tails matching the reference CLI surface:
+    kvstore flood (SPT snapshot), prefixmgr sync/advertised-routes,
+    adjacency/interface metric overrides, config store keys."""
+
+    def test_kvstore_flood_without_dual(self, network):
+        _, port = network
+        out = breeze(port, "kvstore", "flood")
+        assert "flood root: -" in out  # DUAL off in this fixture
+
+    def test_prefixmgr_sync_and_advertised_routes(self, network):
+        _, port = network
+        out = breeze(
+            port, "prefixmgr", "sync", "--type", "BREEZE",
+            "fd00:77::/64", "fd00:78::/64",
+        )
+        assert "synced 2" in out
+        out = breeze(port, "prefixmgr", "advertised-routes")
+        assert "fd00:77::/64" in out and "fd00:78::/64" in out
+        # empty sync withdraws the type's set
+        out = breeze(port, "prefixmgr", "sync", "--type", "BREEZE")
+        assert "synced 0" in out
+        out = breeze(port, "prefixmgr", "advertised-routes")
+        assert "fd00:77::/64" not in out
+
+    def test_adj_and_interface_metric_overrides(self, network):
+        nodes, port = network
+        breeze(port, "lm", "set-adj-metric",
+               "if_alpha_beta", "beta", "55")
+        try:
+            def overridden():
+                db = nodes["alpha"].link_monitor.get_adjacencies()
+                return any(
+                    a.metric == 55 and a.other_node_name == "beta"
+                    for a in db.adjacencies
+                )
+
+            assert wait_until(overridden)
+        finally:
+            breeze(port, "lm", "unset-adj-metric",
+                   "if_alpha_beta", "beta")
+        breeze(port, "lm", "set-interface-metric",
+               "if_alpha_beta", "66")
+        try:
+            def iface_overridden():
+                db = nodes["alpha"].link_monitor.get_adjacencies()
+                return any(
+                    a.metric == 66 and a.other_node_name == "beta"
+                    for a in db.adjacencies
+                )
+
+            assert wait_until(iface_overridden)
+        finally:
+            breeze(port, "lm", "unset-interface-metric",
+                   "if_alpha_beta")
+
+    def test_config_store_keys(self, network, tmp_path):
+        nodes, port = network
+        from openr_tpu.config_store.persistent_store import (
+            PersistentStore,
+        )
+
+        handler = nodes["alpha"].ctrl_handler
+        saved = handler._config_store
+        handler._config_store = PersistentStore(
+            str(tmp_path / "cli-store.bin")
+        )
+        try:
+            out = breeze(port, "config", "store-set", "probe:k", "v1")
+            assert "stored" in out
+            out = breeze(port, "config", "store-get", "probe:k")
+            assert "v1" in out
+            out = breeze(port, "config", "store-erase", "probe:k")
+            assert "erased" in out
+        finally:
+            handler._config_store = saved
+        # store-less daemon: a one-line error + exit 1, not a traceback
+        import io as _io
+
+        from openr_tpu.cli.breeze import run as _run
+        from openr_tpu.ctrl.server import CtrlClient as _Client
+
+        out = _io.StringIO()
+        client = _Client(port=port)
+        try:
+            import pytest as _pytest
+
+            with _pytest.raises(SystemExit):
+                _run(["config", "store-set", "k", "v"],
+                     client=client, out=out)
+        finally:
+            client.close()
+        assert "error:" in out.getvalue()

@@ -718,3 +718,92 @@ class TestEngineBeyondLegacyBound:
         result = ksp2_churn_bench(4200, 1, ksp2_dst_count=128)
         assert result["ksp2_host_fallbacks"] == 0
         assert result["incremental_syncs"] >= 1, result
+
+
+class TestBandWideningOnSolverPath:
+    """ell_patch(widen=True) on the Decision/KSP2 path: a node at
+    exactly its slot-class capacity gaining a NEW adjacency widens the
+    resident band in place (no full recompile of the graph), the
+    reconverge dispatch re-uploads the widened band wholesale, and the
+    KSP2 engine — whose resident masks were shaped for the old band —
+    re-seeds cleanly instead of shape-mismatching."""
+
+    def test_new_adjacency_widens_and_stays_correct(self):
+        from openr_tpu.types import Adjacency
+
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        _t2, area_h, ps_h = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        rsws = [k for k in sorted(topo.adj_dbs)
+                if k.startswith("rsw")]
+        a, b = rsws[0], rsws[-1]
+        root = rsws[1]
+        dev = SpfSolver(root, backend="device")
+        host = SpfSolver(root, backend="host")
+        d = dev.build_route_db(root, area_d, ps)
+        h = host.build_route_db(root, area_h, ps_h)
+        assert d.to_route_db(root) == h.to_route_db(root), "cold"
+
+        before = dict(SPF_COUNTERS)
+        from openr_tpu.decision import spf_solver as _ss
+
+        state = _ss._ELL_RESIDENT.state_for(ls_d)
+        bands_before = tuple(state.graph.bands)
+        # enough NEW adjacencies from `a` to overflow its slot class:
+        # per-link "in" graphs give every link its own slot, so +len
+        # targets pushes a's in-slot count past any pow2 bound below
+        targets = [r for r in rsws if r not in (a, root)][:9]
+        assert len(targets) >= 6
+
+        def add_links(ls):
+            for v in targets:
+                for u, w in ((a, v), (v, a)):
+                    db = ls.get_adjacency_databases()[u]
+                    link = Adjacency(
+                        other_node_name=w, if_name=f"xw-{u}-{w}",
+                        metric=2, other_if_name=f"xw-{w}-{u}",
+                    )
+                    ls.update_adjacency_database(
+                        replace(
+                            db,
+                            adjacencies=tuple(
+                                list(db.adjacencies) + [link]
+                            ),
+                        )
+                    )
+
+        add_links(ls_d)
+        add_links(ls_h)
+        d = dev.build_route_db(root, area_d, ps)
+        h = host.build_route_db(root, area_h, ps_h)
+        assert d.to_route_db(root) == h.to_route_db(root), "widened"
+        # the widening GENUINELY happened: some band's k grew in place
+        # while the band partition (starts/rows) stayed fixed
+        state = _ss._ELL_RESIDENT.state_for(ls_d)
+        bands_after = tuple(state.graph.bands)
+        assert [
+            (x.start, x.rows) for x in bands_after
+        ] == [(x.start, x.rows) for x in bands_before]
+        assert any(
+            x.k > y.k for x, y in zip(bands_after, bands_before)
+        ), (bands_before, bands_after)
+        # the resident bands took the PATCH path (widening), not a
+        # full recompile
+        assert (
+            SPF_COUNTERS["decision.ell_patches"]
+            > before["decision.ell_patches"]
+        )
+        assert (
+            SPF_COUNTERS["decision.ell_full_compiles"]
+            == before["decision.ell_full_compiles"]
+        )
+        # follow-up metric churn on the widened graph still works
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        for step in range(3):
+            _mutate_metric(ls_d, fsw, 0, 3 + step)
+            _mutate_metric(ls_h, fsw, 0, 3 + step)
+            d = dev.build_route_db(root, area_d, ps)
+            h = host.build_route_db(root, area_h, ps_h)
+            assert d.to_route_db(root) == h.to_route_db(root), step
