@@ -61,9 +61,10 @@ PROBE_TIMEOUTS_S = (60, 90, 120, 120)
 PROBE_BUDGET_S = 320  # stop probing once this much wall time is spent
 RETRY_PROBE_TIMEOUT_S = 120
 TPU_CHILD_TIMEOUT_S = 270
-TPU_CHILD_10K_TIMEOUT_S = 750  # headline + 10k churn + ksp2 + routes legs
+# headline + 10k churn + ksp2 + route sweep + route-engine churn legs
+TPU_CHILD_10K_TIMEOUT_S = 800
 CPU_CHILD_TIMEOUT_S = 150
-CPU_CHILD_10K_TIMEOUT_S = 620
+CPU_CHILD_10K_TIMEOUT_S = 680
 # soft wall-clock budget: optional legs (TPU retry, 10k CPU leg) are
 # skipped once exceeded so a worst-case run still emits JSON promptly
 BENCH_SOFT_BUDGET_S = 1000
