@@ -279,28 +279,60 @@ class TestDualStackConcurrency:
             for i in range(20):
                 hub.set_key(f"hammer:{i:02d}", bytes([i]))
 
+            from openr_tpu.kvstore.thrift_peer import (
+                _GET_ARGS,
+                _GET_RESULT,
+            )
+            from openr_tpu.utils.thrift_rpc import FramedCompactClient
+
             def worker(i):
-                cls = (
-                    ThriftPeerTransport if i % 2 else TcpPeerTransport
-                )
-                client = cls("127.0.0.1", server.port)
+                # rotate through EVERY stock client shape the port
+                # serves: framework RPC, bare compact, and the four
+                # theader x binary combinations
+                kind = i % 6
+                if kind == 0:
+                    client = TcpPeerTransport("127.0.0.1", server.port)
+                elif kind == 1:
+                    client = ThriftPeerTransport(
+                        "127.0.0.1", server.port
+                    )
+                else:
+                    client = FramedCompactClient(
+                        "127.0.0.1", server.port,
+                        theader=kind in (2, 3),
+                        binary=kind in (3, 4),
+                    )
                 try:
                     total = 0
                     for _ in range(10):
-                        pub = client.get_key_vals_filtered(
-                            "0", KeyDumpParams(prefix="hammer:")
-                        )
-                        assert len(pub.key_vals) == 20
-                        total += len(pub.key_vals)
+                        if isinstance(client, FramedCompactClient):
+                            result = client.call(
+                                "getKvStoreKeyValsFilteredArea",
+                                _GET_ARGS,
+                                {"filter": {
+                                    "prefix": "hammer:",
+                                    "originatorIds": [],
+                                    "ignoreTtl": False,
+                                    "doNotPublishValue": False,
+                                }, "area": "0"},
+                                _GET_RESULT,
+                            )
+                            kvs = result["success"]["keyVals"]
+                        else:
+                            kvs = client.get_key_vals_filtered(
+                                "0", KeyDumpParams(prefix="hammer:")
+                            ).key_vals
+                        assert len(kvs) == 20
+                        total += len(kvs)
                     return total
                 finally:
                     close = getattr(client, "close", None)
                     if close:
                         close()
 
-            with concurrent.futures.ThreadPoolExecutor(16) as pool:
-                results = list(pool.map(worker, range(16)))
-            assert results == [200] * 16
+            with concurrent.futures.ThreadPoolExecutor(18) as pool:
+                results = list(pool.map(worker, range(18)))
+            assert results == [200] * 18
         finally:
             server.stop()
             hub.stop()
