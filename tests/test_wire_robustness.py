@@ -1,0 +1,208 @@
+"""Wire robustness: garbage on any listener must never wedge it.
+
+The byte-sniffing dual-stack listeners accept frames from untrusted
+peers; a malformed frame may at worst produce a TApplicationException
+reply or a hangup for THAT connection — the listener must keep serving
+well-formed clients afterwards. Fuzzed over random bytes and
+truncations of valid frames."""
+
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from openr_tpu.kvstore.dualstack import DualStackPeerServer
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.utils import theader
+from openr_tpu.utils import thrift_binary as tb
+from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.thrift_rpc import FramedCompactClient
+
+
+class TestDecoderFuzz:
+    def test_theader_unwrap_contract(self):
+        """unwrap either succeeds or raises ValueError — never an
+        uncaught IndexError/struct.error (the dispatch loop catches
+        exactly ValueError to hang up cleanly)."""
+        rng = np.random.default_rng(99)
+        for _ in range(400):
+            n = int(rng.integers(0, 64))
+            blob = bytes(rng.integers(0, 256, n, dtype="uint8"))
+            # bias half the cases toward the magic so header parsing
+            # actually runs
+            if rng.integers(2):
+                blob = b"\x0f\xff" + blob
+            try:
+                theader.unwrap(blob)
+            except ValueError:
+                pass
+
+    def test_theader_truncations_of_valid_frame(self):
+        msg = b"\x82\x21\x01\x04ping\x00"
+        frame = theader.wrap(msg, seqid=9, info={"k": "v"})
+        for cut in range(len(frame)):
+            try:
+                theader.unwrap(frame[:cut])
+            except ValueError:
+                pass
+
+    def test_binary_message_header_contract(self):
+        rng = np.random.default_rng(7)
+        for _ in range(400):
+            n = int(rng.integers(0, 48))
+            blob = bytes(rng.integers(0, 256, n, dtype="uint8"))
+            if rng.integers(2):
+                blob = b"\x80\x01\x00\x01" + blob
+            try:
+                name, _mt, _sq, off = tb.decode_message_header(blob)
+                tb.decode(
+                    tc.StructSchema("Any", ()), blob[off:]
+                )
+            except (ValueError, UnicodeDecodeError):
+                pass
+
+
+class TestListenerSurvivesGarbage:
+    def test_garbage_then_valid_calls(self):
+        """Random garbage frames (and raw unframed noise) on the
+        dual-stack peer port, then a well-formed client of EVERY stock
+        shape: the listener must still answer all of them."""
+        from openr_tpu.kvstore.thrift_peer import (
+            _GET_ARGS,
+            _GET_RESULT,
+        )
+
+        hub = KvStoreWrapper("fuzz-hub")
+        hub.start()
+        server = DualStackPeerServer(hub.store, host="127.0.0.1")
+        server.start()
+        try:
+            hub.set_key("adj:x", b"v", version=1)
+            rng = np.random.default_rng(3)
+            for case in range(30):
+                sock = socket.create_connection(
+                    ("127.0.0.1", server.port), timeout=5
+                )
+                try:
+                    n = int(rng.integers(1, 200))
+                    payload = bytes(
+                        rng.integers(0, 256, n, dtype="uint8")
+                    )
+                    if case % 3 == 0:
+                        # framed garbage (sniffable length prefix)
+                        sock.sendall(
+                            struct.pack(">I", len(payload)) + payload
+                        )
+                    elif case % 3 == 1:
+                        # framed garbage dressed as thrift (0x82 lead)
+                        sock.sendall(
+                            struct.pack(">I", len(payload) + 1)
+                            + b"\x82" + payload
+                        )
+                    else:
+                        # raw unframed noise
+                        sock.sendall(payload)
+                    sock.settimeout(1)
+                    try:
+                        sock.recv(64)
+                    except (TimeoutError, OSError):
+                        pass
+                finally:
+                    sock.close()
+            # every stock client shape still gets service
+            for th, binary in (
+                (False, False), (True, False),
+                (False, True), (True, True),
+            ):
+                client = FramedCompactClient(
+                    "127.0.0.1", server.port,
+                    theader=th, binary=binary,
+                )
+                result = client.call(
+                    "getKvStoreKeyValsFilteredArea",
+                    _GET_ARGS,
+                    {"filter": {"prefix": "adj:",
+                                "originatorIds": [],
+                                "ignoreTtl": False,
+                                "doNotPublishValue": False},
+                     "area": "0"},
+                    _GET_RESULT,
+                )
+                assert "adj:x" in result["success"]["keyVals"]
+                client.close()
+        finally:
+            server.stop()
+            hub.stop()
+
+
+class TestNewSchemaGoldens:
+    """Hand-derived byte vectors for round-5 ctrl schemas — the wire
+    contract pinned independently of the codec (the same discipline as
+    the KvStore goldens in test_thrift_compact.py)."""
+
+    def test_rib_policy_golden(self):
+        value = {
+            "statements": [{
+                "name": "s1",
+                "matcher": {"prefixes": []},
+                "action": {"set_weight": {
+                    "default_weight": 1,
+                    "area_to_weight": {},
+                    "neighbor_to_weight": {"n": 3},
+                }},
+            }],
+            "ttl_secs": 60,
+        }
+        got = tc.encode(tc.RIB_POLICY, value)
+        golden = bytes([
+            0x19,        # field 1 (delta 1): list
+            0x1C,        # list header: size 1, elem struct
+            0x18, 0x02, 0x73, 0x31,   # stmt field 1 string "s1"
+            0x1C,        # stmt field 2 struct (matcher)
+            0x19, 0x0C,  # matcher field 1: empty STRUCT-elem list
+            0x00,        # matcher STOP
+            0x1C,        # stmt field 3 struct (action)
+            0x1C,        # action field 1 struct (set_weight)
+            0x25, 0x02,  # weight field 2 (delta 2): i32 zigzag(1)=2
+            0x1B, 0x00,  # field 3: empty map
+            0x1B,        # field 4: map, size...
+            0x01, 0x85,  # varint size 1, (string key << 4) | i32 val
+            0x01, 0x6E,  # key "n"
+            0x06,        # zigzag(3) = 6
+            0x00,        # weight STOP
+            0x00,        # action STOP
+            0x00,        # stmt STOP
+            0x15, 0x78,  # policy field 2 (delta 1): i32 zigzag(60)
+            0x00,        # policy STOP
+        ])
+        assert got == golden, got.hex(" ")
+        assert tc.decode(tc.RIB_POLICY, got) == value
+
+    def test_spt_infos_golden(self):
+        value = {
+            "infos": {"r": {
+                "passive": True, "cost": 2, "children": set(),
+            }},
+            "counters": {"neighborCounters": {},
+                         "rootCounters": {}},
+            "floodPeers": set(),
+        }
+        got = tc.encode(tc.SPT_INFOS, value)
+        golden = bytes([
+            0x1B, 0x01,  # field 1 (delta 1): map, size 1
+            0x8C,        # (string key << 4) | struct value
+            0x01, 0x72,  # key "r"
+            0x11,        # SptInfo field 1: BOOL TRUE in the header
+            0x16, 0x04,  # field 2 (delta 1): i64 zigzag(2) = 4
+            0x2A, 0x08,  # field 4 (delta 2): set, empty, elem binary
+            0x00,        # SptInfo STOP
+            0x1C,        # field 2 (delta 1): counters struct
+            0x1B, 0x00,  # neighborCounters: empty map
+            0x1B, 0x00,  # rootCounters: empty map
+            0x00,        # counters STOP
+            0x2A, 0x08,  # field 4 (delta 2): floodPeers empty set
+            0x00,        # STOP
+        ])
+        assert got == golden, got.hex(" ")
+        assert tc.decode(tc.SPT_INFOS, got) == value
