@@ -65,6 +65,39 @@ from openr_tpu.ops.spf import INF
 # bound is where single-chip residency stops, not where the algorithm
 # does.
 ENGINE_MAX_NODES = 12288
+
+# Optional device mesh for the engine's all-pairs residency: when set
+# (set_engine_mesh), the all-pairs fixed point and the masked batches
+# run SHARDED over the mesh — per-device footprint n^2/ndev — and the
+# activation bound scales with sqrt(ndev) (~100k on a 64-way mesh).
+# The speculative resident-masks fast path stays single-chip-only for
+# now: sharded mode runs the plain incremental dispatch.
+_ENGINE_MESH = None
+
+
+def set_engine_mesh(mesh) -> None:
+    """Install (or clear, with None) the mesh the KSP2 engines shard
+    their resident all-pairs state over. Takes effect on the next
+    engine cold build."""
+    global _ENGINE_MESH
+    _ENGINE_MESH = mesh
+
+
+def get_engine_mesh():
+    return _ENGINE_MESH
+
+
+def engine_max_nodes() -> int:
+    """The activation bound under the current mesh setting: the two
+    resident [n, n] matrices shard over rows, so the single-chip
+    residency bound scales with sqrt(ndev)."""
+    if _ENGINE_MESH is None:
+        return ENGINE_MAX_NODES
+    import math
+
+    return int(ENGINE_MAX_NODES * math.sqrt(_ENGINE_MESH.devices.size))
+
+
 # churn larger than this falls back to a full (cold) rebuild
 ENGINE_MAX_CHANGED_PAIRS = 64
 ENGINE_MAX_ENDPOINTS = 32
@@ -240,6 +273,13 @@ class Ksp2Engine:
         self.src_name = src_name
         self.valid = False
         self.last_affected: Optional[Set[str]] = None
+        # _mesh_knob: the module knob as of the last (re)build — the
+        # change-detection identity. _mesh: the mesh the resident
+        # arrays are ACTUALLY sharded over (None when the knob is off
+        # OR the graph's n_pad does not divide by the mesh size, in
+        # which case the single-chip dispatch runs instead).
+        self._mesh_knob = _ENGINE_MESH
+        self._mesh = None
 
     # -- public entry ------------------------------------------------------
 
@@ -265,6 +305,9 @@ class Ksp2Engine:
             or tuple(state.graph.bands) != getattr(
                 self, "band_shapes", None
             )
+            # the engine-mesh knob changed: resident arrays carry the
+            # old sharding — re-seed under the new one
+            or self._mesh_knob is not _ENGINE_MESH
         ):
             self._cold_build(ls, state, dsts)
             return None
@@ -333,7 +376,12 @@ class Ksp2Engine:
         ep_ids = _pad_ids(ep)
         use_fast = getattr(self, "masks_t", None) is not None
         dm_new_dev = None
-        if use_fast:
+        if self._mesh is not None:
+            d_all_dev, packed = spf_sparse.sharded_ell_all_view_rows(
+                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
+                self._mesh,
+            )
+        elif use_fast:
             (
                 d_all_dev, dm_new_dev, packed,
             ) = spf_sparse.ell_all_view_rows_masked(
@@ -460,6 +508,7 @@ class Ksp2Engine:
     def _cold_build(self, ls: LinkState, state, dsts: List[str]) -> None:
         from openr_tpu.decision import spf_solver as _ss
         from openr_tpu.ops import spf_sparse
+        import jax
         import jax.numpy as jnp
 
         self.valid = False
@@ -467,6 +516,13 @@ class Ksp2Engine:
         self.state = state
         self.dsts = list(dsts)
         self.band_shapes = tuple(graph.bands)
+        self._mesh_knob = _ENGINE_MESH
+        self._mesh = (
+            _ENGINE_MESH
+            if _ENGINE_MESH is not None
+            and graph.n_pad % _ENGINE_MESH.devices.size == 0
+            else None
+        )
         self.sid = graph.node_index.get(self.src_name)
         if self.sid is None:
             return
@@ -479,11 +535,34 @@ class Ksp2Engine:
         srcs_dev, w_sv = spf_sparse._batch_args(graph, view_srcs)
         placeholder = getattr(self, "d_prev_dev", None)
         if placeholder is None or placeholder.shape != (n, n):
-            placeholder = jnp.zeros((n, n), dtype=jnp.int32)
-        d_all_dev, packed = spf_sparse.ell_all_view_rows(
-            state, srcs_dev, w_sv, np.asarray([self.sid], np.int32),
-            placeholder,
-        )
+            if self._mesh is not None:
+                # allocate the placeholder ALREADY row-sharded: an
+                # unsharded [n, n] zeros would commit n^2 x 4 B to the
+                # default device — exactly the single-chip footprint
+                # the mesh mode exists to avoid
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                placeholder = jax.jit(
+                    lambda: jnp.zeros((n, n), dtype=jnp.int32),
+                    out_shardings=NamedSharding(
+                        self._mesh,
+                        PartitionSpec(spf_sparse.SOURCES_AXIS, None),
+                    ),
+                )()
+            else:
+                placeholder = jnp.zeros((n, n), dtype=jnp.int32)
+        if self._mesh is not None:
+            d_all_dev, packed = spf_sparse.sharded_ell_all_view_rows(
+                state, srcs_dev, w_sv,
+                np.asarray([self.sid], np.int32),
+                placeholder, self._mesh,
+            )
+        else:
+            d_all_dev, packed = spf_sparse.ell_all_view_rows(
+                state, srcs_dev, w_sv,
+                np.asarray([self.sid], np.int32),
+                placeholder,
+            )
         b = len(view_srcs)
         self._preload_view(ls, graph, view_srcs, packed[: 2 * b])
         self.d_base = packed[0].astype(np.int32)
@@ -531,6 +610,7 @@ class Ksp2Engine:
         slots = sum(band.rows * band.k for band in graph.bands)
         if (
             _fast_path_enabled()
+            and self._mesh is None  # speculative path: single-chip
             and len(dsts) * 2 * max(1, slots)
             <= _ss.KSP2_DEVICE_MASK_BUDGET
         ):
@@ -867,14 +947,24 @@ class Ksp2Engine:
             while bucket < len(batch):
                 bucket *= 2
             bucket = min(bucket, chunk)
+            if self._mesh is not None:
+                # sharded batches divide destinations over the mesh
+                ndev = self._mesh.devices.size
+                bucket = max(bucket, ndev)
+                bucket = ((bucket + ndev - 1) // ndev) * ndev
             excl_sets = [self.excl[d] for d in batch]
             pad = bucket - len(batch)
             masks, ok = spf_sparse.build_edge_masks(
                 graph, excl_sets + [set()] * pad
             )
-            drows = spf_sparse.ell_masked_distances_resident(
-                state, self.sid, masks
-            )
+            if self._mesh is not None:
+                drows = spf_sparse.sharded_ell_masked_distances_resident(
+                    state, self.sid, masks, self._mesh
+                )
+            else:
+                drows = spf_sparse.ell_masked_distances_resident(
+                    state, self.sid, masks
+                )
             _counters()["decision.ksp2_device_batches"] += 1
             if getattr(self, "masks_t", None) is not None:
                 # fast path: keep the RESIDENT masks and masked-row

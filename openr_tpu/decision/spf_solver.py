@@ -1208,7 +1208,7 @@ class SpfSolver:
 
         if (
             len(ls.get_adjacency_databases())
-            <= ksp2_engine.ENGINE_MAX_NODES
+            <= ksp2_engine.engine_max_nodes()  # mesh-scaled bound
         ):
             engine = self._ksp2_engines.get(ls)
             if engine is not None and engine.src_name != my_node_name:

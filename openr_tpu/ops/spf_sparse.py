@@ -1338,3 +1338,93 @@ def sharded_ell_all_sources(graph: EllGraph, mesh: Mesh):
         n,
         mesh,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
+def _sharded_ell_all_view_rows(
+    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+    bands, n, mesh,
+):
+    """Mesh-sharded twin of _ell_all_view_rows: the all-pairs fixed
+    point runs with source rows sharded over the mesh (1-bit psum
+    vote), and the view/endpoint row gathers run as global-view ops on
+    the sharded matrix (XLA inserts the row collectives). d_all comes
+    back SHARDED — the resident footprint per device is n^2/ndev,
+    which is what lifts the KSP2 engine past the single-chip bound."""
+    nb = len(srcs_t)
+
+    def shard_fn(ids_blk, *rest):
+        srcs_r = rest[:nb]
+        ws_r = rest[nb : 2 * nb]
+        ov_r = rest[-1]
+        return _ell_fixed_point(
+            srcs_r, ws_r, ov_r, ids_blk, bands, n,
+            vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+        )
+
+    d_all = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS)] + [P(None, None)] * (2 * nb) + [P(None)]
+        ),
+        out_specs=P(SOURCES_AXIS, None),
+    )(jnp.arange(n, dtype=jnp.int32), *srcs_t, *ws_t, overloaded)
+
+    d = d_all[view_srcs]
+    fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
+    packed = jnp.concatenate(
+        [
+            d,
+            fh.astype(jnp.int32),
+            d_all[ep_ids],
+            d_prev[ep_ids],
+        ],
+        axis=0,
+    )
+    return d_all, packed
+
+
+def sharded_ell_all_view_rows(
+    state: "EllState", view_srcs, w_sv, ep_ids, d_prev, mesh: Mesh
+):
+    """Run the sharded all-sources + view + invalidation-rows dispatch
+    on the resident bands. Returns (d_all_dev SHARDED, packed_host).
+    n_pad must divide by the mesh size (the engine gates on this and
+    falls back to the single-chip dispatch otherwise)."""
+    assert state.graph.n_pad % mesh.devices.size == 0, (
+        state.graph.n_pad, mesh.devices.size,
+    )
+    d_all, packed = _sharded_ell_all_view_rows(
+        state.src, state.w, state.overloaded,
+        _as_device_ids(view_srcs),
+        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+            np.asarray(w_sv, dtype=np.int32)
+        ),
+        _as_device_ids(ep_ids),
+        d_prev,
+        state.graph.bands, state.graph.n_pad, mesh,
+    )
+    return d_all, np.asarray(packed)
+
+
+def sharded_ell_masked_distances_resident(
+    state: "EllState", src_id: int, masks, mesh: Mesh
+):
+    """Mesh-sharded twin of ell_masked_distances_resident: the KSP2
+    masked batch over the RESIDENT bands with destinations sharded
+    (each device owns batch/ndev masked solves). The batch size must
+    divide by the mesh size (callers pad their pow2 buckets up).
+    Dispatches through the same jitted _sharded_ell_masked the
+    graph-argument wrapper uses — the resident tensors pass straight
+    through."""
+    b = masks[0].shape[0]
+    assert b % mesh.devices.size == 0, (b, mesh.devices.size)
+    return np.asarray(
+        _sharded_ell_masked(
+            state.src, state.w,
+            tuple(jnp.asarray(m) for m in masks),
+            state.overloaded, src_id,
+            state.graph.bands, state.graph.n_pad, mesh,
+        )
+    )

@@ -18,6 +18,7 @@ from dataclasses import replace
 
 import pytest
 
+from openr_tpu.decision import ksp2_engine
 from openr_tpu.decision.prefix_state import PrefixState
 from openr_tpu.decision.spf_solver import SPF_COUNTERS, SpfSolver
 from openr_tpu.graph.linkstate import LinkState
@@ -807,3 +808,121 @@ class TestBandWideningOnSolverPath:
             d = dev.build_route_db(root, area_d, ps)
             h = host.build_route_db(root, area_h, ps_h)
             assert d.to_route_db(root) == h.to_route_db(root), step
+
+
+class TestMeshShardedEngine:
+    """The engine's all-pairs residency sharded over the device mesh
+    (set_engine_mesh): per-device footprint n^2/ndev, activation bound
+    scaled by sqrt(ndev) — the path past the single-chip 12k ceiling.
+    Sharded mode runs the plain incremental dispatch (the speculative
+    resident-masks fast path stays single-chip)."""
+
+    @pytest.fixture()
+    def engine_mesh(self):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        ksp2_engine.set_engine_mesh(make_mesh(jax.devices()))
+        try:
+            yield ksp2_engine.get_engine_mesh()
+        finally:
+            ksp2_engine.set_engine_mesh(None)
+
+    def test_bound_scales_with_mesh(self, engine_mesh):
+        ndev = engine_mesh.devices.size
+        assert ksp2_engine.engine_max_nodes() == int(
+            ksp2_engine.ENGINE_MAX_NODES * ndev ** 0.5
+        )
+        ksp2_engine.set_engine_mesh(None)
+        assert (
+            ksp2_engine.engine_max_nodes()
+            == ksp2_engine.ENGINE_MAX_NODES
+        )
+
+    def test_sharded_churn_parity(self, engine_mesh):
+        """Twin graphs through the device (sharded engine) and host
+        solvers across metric churn: identical RouteDbs, incremental
+        syncs engaged, zero host fallbacks."""
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        _t2, area_h, ps_h = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        rsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("rsw"))
+        dev = SpfSolver(rsw, backend="device")
+        host = SpfSolver(rsw, backend="host")
+        before = dict(SPF_COUNTERS)
+        d = dev.build_route_db(rsw, area_d, ps)
+        h = host.build_route_db(rsw, area_h, ps_h)
+        assert d.to_route_db(rsw) == h.to_route_db(rsw), "cold"
+        for step in range(4):
+            _mutate_metric(ls_d, fsw, 0, 2 + step % 3)
+            _mutate_metric(ls_h, fsw, 0, 2 + step % 3)
+            d = dev.build_route_db(rsw, area_d, ps)
+            h = host.build_route_db(rsw, area_h, ps_h)
+            assert d.to_route_db(rsw) == h.to_route_db(rsw), step
+        assert (
+            SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+            > before["decision.ksp2_incremental_syncs"]
+        )
+        assert (
+            SPF_COUNTERS["decision.ksp2_host_fallbacks"]
+            == before["decision.ksp2_host_fallbacks"]
+        )
+
+    def test_activates_past_single_chip_bound(self, engine_mesh,
+                                              monkeypatch):
+        """With the single-chip bound shrunk below the graph size, the
+        mesh-scaled bound still activates the engine — the composition
+        that breaks the ceiling — and routes stay host-exact."""
+        monkeypatch.setattr(ksp2_engine, "ENGINE_MAX_NODES", 64)
+        assert ksp2_engine.engine_max_nodes() >= 120
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        _t2, area_h, ps_h = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        rsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("rsw"))
+        dev = SpfSolver(rsw, backend="device")
+        host = SpfSolver(rsw, backend="host")
+        before = dict(SPF_COUNTERS)
+        d = dev.build_route_db(rsw, area_d, ps)
+        h = host.build_route_db(rsw, area_h, ps_h)
+        assert d.to_route_db(rsw) == h.to_route_db(rsw), "cold"
+        # several small wiggles: a big first delta legitimately trips
+        # the most-destinations-affected cold-rebuild heuristic
+        for step in range(4):
+            _mutate_metric(ls_d, fsw, 0, 2 + step % 3)
+            _mutate_metric(ls_h, fsw, 0, 2 + step % 3)
+            d = dev.build_route_db(rsw, area_d, ps)
+            h = host.build_route_db(rsw, area_h, ps_h)
+            assert d.to_route_db(rsw) == h.to_route_db(rsw), step
+        assert (
+            SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+            > before["decision.ksp2_incremental_syncs"]
+        ), "engine must be ACTIVE past the single-chip bound"
+
+    def test_mesh_knob_change_reseeds(self, engine_mesh):
+        """Flipping the mesh knob mid-life cold-rebuilds instead of
+        mixing shardings in the resident state."""
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        rsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("rsw"))
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        dev = SpfSolver(rsw, backend="device")
+        dev.build_route_db(rsw, area_d, ps)
+        ksp2_engine.set_engine_mesh(None)  # knob change
+        _mutate_metric(ls_d, fsw, 0, 9)
+        before = dict(SPF_COUNTERS)
+        dev.build_route_db(rsw, area_d, ps)
+        assert (
+            SPF_COUNTERS["decision.ksp2_cold_builds"]
+            > before["decision.ksp2_cold_builds"]
+        )
