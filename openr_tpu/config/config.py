@@ -210,6 +210,12 @@ class OpenrConfig:
     persistent_store_path: str = "/tmp/openr_tpu_persistent_store.bin"
     node_label: int = 0
     solver_backend: str = "device"
+    # shard the KSP2 engine's resident all-pairs state over ALL local
+    # devices (ksp2_engine.set_engine_mesh at daemon start): the
+    # engine's 12k single-chip activation bound scales with
+    # sqrt(ndev). Off by default — a single-device mesh only adds
+    # dispatch overhead.
+    enable_solver_mesh: bool = False
     # BGP peering section (reference: openr/if/BgpConfig.thrift, gating
     # pluginStart at Main.cpp:595-601); None = BGP peering disabled
     bgp_config: Optional["BgpConfig"] = None

@@ -45,6 +45,7 @@ GFLAG_DEFS: Dict[str, Tuple[type, object]] = {
     "enable_rib_policy": (bool, False),  # reference default: disabled
     "enable_segment_routing": (bool, False),
     "enable_watchdog": (bool, True),
+    "enable_solver_mesh": (bool, False),
     "enable_flood_optimization": (bool, False),
     "is_flood_root": (bool, False),
     "enable_kvstore_thrift": (bool, False),
@@ -178,6 +179,7 @@ def config_from_gflags(result: GflagResult) -> OpenrConfig:
         "enable_rib_policy": f["enable_rib_policy"],
         "enable_segment_routing": f["enable_segment_routing"],
         "enable_watchdog": f["enable_watchdog"],
+        "enable_solver_mesh": f["enable_solver_mesh"],
         "prefix_forwarding_type": (
             "SR_MPLS" if f["prefix_fwd_type_mpls"] else "IP"
         ),

@@ -142,6 +142,21 @@ def main(argv=None) -> int:
     log = logging.getLogger("openr_tpu.main")
     log.info("starting openr-tpu node %s", config.node_name)
 
+    if config.enable_solver_mesh:
+        # process-global: every KSP2 engine this daemon builds shards
+        # its resident all-pairs state over the local device mesh
+        import jax
+
+        from openr_tpu.decision import ksp2_engine
+        from openr_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices())
+        ksp2_engine.set_engine_mesh(mesh)
+        log.info(
+            "solver mesh enabled: %d device(s), KSP2 engine bound %d",
+            mesh.devices.size, ksp2_engine.engine_max_nodes(),
+        )
+
     from openr_tpu.config_store.persistent_store import PersistentStore
 
     config_store = PersistentStore(config.persistent_store_path)

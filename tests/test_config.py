@@ -329,3 +329,62 @@ def test_daemon_wires_decision_feature_flags():
     assert solver.enable_ordered_fib
     assert solver.bgp_dry_run  # programming disabled -> dry run
     assert not node.decision._enable_rib_policy
+
+
+class TestSolverMeshKnob:
+    def test_gflag_maps_to_config(self):
+        from openr_tpu.config.gflags import (
+            config_from_gflags,
+            parse_gflags,
+        )
+
+        cfg = config_from_gflags(parse_gflags(
+            ["--node_name=x", "--enable_solver_mesh"]
+        ))
+        assert cfg.enable_solver_mesh is True
+        cfg = config_from_gflags(parse_gflags(["--node_name=x"]))
+        assert cfg.enable_solver_mesh is False
+
+    def test_main_installs_engine_mesh(self, monkeypatch):
+        """main() with enable_solver_mesh installs the process-global
+        engine mesh before the daemon builds (checked by intercepting
+        the daemon constructor — no full boot needed)."""
+        from openr_tpu import main as main_mod
+        from openr_tpu.decision import ksp2_engine
+
+        ksp2_engine.set_engine_mesh(None)
+        seen = {}
+
+        class _Stop(Exception):
+            pass
+
+        def fake_node(*a, **kw):
+            seen["mesh"] = ksp2_engine.get_engine_mesh()
+            raise _Stop
+
+        monkeypatch.setattr(main_mod, "OpenrNode", fake_node)
+
+        # intercept BEFORE main() builds the persistent store: the
+        # _Stop abort skips the normal shutdown path, so a real store
+        # would leak its event-base thread and touch the machine-wide
+        # default /tmp path
+        class _NoStore:
+            def __init__(self, *a, **kw):
+                pass
+
+            def stop(self):
+                pass
+
+        import openr_tpu.config_store.persistent_store as _ps
+
+        monkeypatch.setattr(_ps, "PersistentStore", _NoStore)
+        try:
+            with pytest.raises(_Stop):
+                main_mod.main([
+                    "--node-name", "mesh-node",
+                    "--enable_solver_mesh",
+                ])
+        finally:
+            ksp2_engine.set_engine_mesh(None)
+        assert seen["mesh"] is not None
+        assert seen["mesh"].devices.size >= 1
