@@ -28,6 +28,29 @@ from openr_tpu.models import topologies
 from openr_tpu.ops import spf_sparse
 
 
+def _relay_rtt_ms() -> float:
+    """Median of five MINIMAL dispatch+readback round trips — the fixed
+    per-readback transport cost. Recorded in churn artifacts so a
+    median measured through the axon relay tunnel decomposes into host
+    work + k RTTs; a colocated production host pays microseconds where
+    the tunnel pays tens of ms, so this field is what makes
+    tunnel-measured event medians comparable to CPU-measured ones."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v + 1)
+    x = jnp.zeros((8,), jnp.int32)
+    np.asarray(f(x))  # warm the compile
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append((time.perf_counter() - t0) * 1000)
+    return round(statistics.median(ts), 2)
+
+
 def _chained_device_only_ms(step, readback, k: int = 4,
                             reps: int = 5) -> float:
     """Per-dispatch device time via K data-dependent chained dispatches
@@ -264,6 +287,7 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
             / max(1, churn_events),
             2,
         ),
+        "relay_rtt_ms": _relay_rtt_ms(),
     }
 
 
@@ -721,6 +745,8 @@ def route_engine_churn_bench(
             1 for c in affected_counts if c < 0
         ),
         "incremental_events": engine.incremental_events,
+        "full_refreshes": engine.full_refreshes,
+        "relay_rtt_ms": _relay_rtt_ms(),
         "platform": jax.devices()[0].platform,
         "oracle_spot_check": "passed",
     }

@@ -7,15 +7,26 @@ Per segment the relaxation computes, for every bipartite group g:
 
 — G independent small min-plus matmuls. The jnp formulation leaves the
 [B, G, S, R] broadcast to XLA's fuser; this kernel tiles it explicitly
-so the (TB, TS, TR) temporary lives in VMEM and the weight panel is
-revisited from VMEM across the batch, exactly the discipline of the
-proven dense kernel (ops.pallas_minplus, measured 5.4x over jnp on
-chip at the 1k bench shape). Tile shapes follow the same legality
-rules: (sublane, lane) multiples of (8, 128), or a dim equal to the
-full array extent.
+so the broadcast temporary lives in VMEM.
 
-Grid: (G, B/TB, R/TR, S/TS), s innermost; the output tile is revisited
-across s and accumulated with minimum (INF-initialized at s == 0).
+Tiling is GROUP-BLOCKED, sized from on-chip measurement of the actual
+fat-tree segment shapes (e.g. 10k nodes: G=624, S=4, R=12 with
+B=1024): the first kernel generation iterated the grid per group with
+an 8-row batch tile, which at those shapes meant ~165k grid steps of a
+few hundred min-adds each — pure grid-step overhead (measured 227 ms
+vs 8 ms for jnp per 1024-source block). This generation processes TG
+whole groups x TB=128 batch rows per grid step, with TG chosen to
+bound the VMEM broadcast temporary, collapsing the same segment to a
+few hundred steps. The s dimension is chunked inside the kernel (8 at
+a time) so the temporary is (TG, TB, 8, TR) regardless of S; segments
+with S beyond the block cap revisit the output tile across an s grid
+dimension, accumulated with minimum (INF-initialized at s == 0),
+exactly the proven dense-kernel discipline (ops.pallas_minplus).
+
+Block legality (Mosaic): every block's last-two (sublane, lane) dims
+are either multiples of (8, 128) or equal to the full array extent;
+leading block dims are unconstrained. Padding rows/cols are inert
+(weights pad with INF; min ignores them).
 
 Like the dense kernel, selection is BY MEASUREMENT: the scale bench
 times both impls at the segment shapes and runs the winner
@@ -33,38 +44,66 @@ from jax.experimental import pallas as pl
 
 INF = np.int32((1 << 30) - 1)
 
-TILE_B = 8
-_SMALL = 512  # dims up to this stay un-tiled (full-extent blocks)
+_S_CAP = 512  # s block cap; beyond this the grid revisits over s
+_TEMP_BUDGET = 1 << 20  # int32 elements of per-step VMEM (blocks + temp)
 
 
-def _pick_tiles(s: int, r: int):
-    """(S_pad, TS, R_pad, TR) satisfying Mosaic block legality."""
-    if s <= _SMALL:
-        s_pad, ts = s, s
-    else:
-        s_pad = ((s + 127) // 128) * 128
-        ts = 128
-    if r <= _SMALL:
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _pick_tiles(g: int, b_pad: int, s: int, r: int):
+    """(TG, g_pad, TB, b_pad, s_pad, TS, r_pad, TR) under Mosaic
+    legality and the VMEM temp budget. Incoming b_pad is a multiple of
+    8; it is re-padded to a TB multiple."""
+    tb = 128 if b_pad >= 128 else b_pad
+    b_ok = _pad_to(b_pad, tb)
+    # lane dim: full extent is legal at any size; tiled needs 128-mult
+    if r <= _S_CAP:
         r_pad, tr = r, r
     else:
-        r_pad = ((r + 127) // 128) * 128
-        tr = 128
-    return s_pad, ts, r_pad, tr
+        r_pad, tr = _pad_to(r, 128), 128
+    # s is chunked by 8 inside the kernel -> 8-mult; block cap _S_CAP
+    if s <= _S_CAP:
+        s_pad = _pad_to(s, 8)
+        ts = s_pad
+    else:
+        s_pad, ts = _pad_to(s, 128), _S_CAP
+        while s_pad % ts:
+            ts //= 2
+    # groups per step: bound TOTAL per-step VMEM, counting the gath
+    # (TG,TB,TS) and weight (TG,TS,TR) input blocks and the output
+    # (TG,TB,TR) alongside the (TG,TB,8,TR) broadcast temporary —
+    # bounding the temp alone lets a large-S/small-R segment blow the
+    # ~16 MB budget through its input block
+    per_group = tb * ts + ts * tr + tb * tr + tb * 8 * tr
+    tg = max(1, _TEMP_BUDGET // per_group)
+    tg = min(tg, g)
+    g_pad = _pad_to(g, tg)
+    return tg, g_pad, tb, b_ok, s_pad, ts, r_pad, tr
 
 
 def _kernel(g_ref, w_ref, o_ref):
     s_idx = pl.program_id(3)
-    a = g_ref[0]  # (TB, TS)
-    b = w_ref[0]  # (TS, TR)
-    cand = jnp.minimum(
-        jnp.min(a[:, :, None] + b[None, :, :], axis=1), INF
-    ).astype(jnp.int32)
+    a = g_ref[...]  # (TG, TB, TS)
+    w = w_ref[...]  # (TG, TS, TR)
+    nchunk = a.shape[2] // 8
+
+    def body(i, acc):
+        ac = jax.lax.dynamic_slice_in_dim(a, i * 8, 8, axis=2)
+        wc = jax.lax.dynamic_slice_in_dim(w, i * 8, 8, axis=1)
+        cand = jnp.min(ac[:, :, :, None] + wc[:, None, :, :], axis=2)
+        return jnp.minimum(acc, cand)
+
+    acc0 = jnp.full(o_ref.shape, INF, jnp.int32)
+    acc = jax.lax.fori_loop(0, nchunk, body, acc0)
+    acc = jnp.minimum(acc, INF).astype(jnp.int32)
 
     @pl.when(s_idx == 0)
     def _init():
-        o_ref[0] = jnp.full_like(o_ref[0], INF)
+        o_ref[...] = jnp.full_like(o_ref[...], INF)
 
-    o_ref[0] = jnp.minimum(o_ref[0], cand)
+    o_ref[...] = jnp.minimum(o_ref[...], acc)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -72,40 +111,40 @@ def batched_minplus(
     gath: jnp.ndarray, w: jnp.ndarray, interpret: bool = False
 ) -> jnp.ndarray:
     """[G, B, S] (x) [G, S, R] -> [G, B, R] over (min, +), saturating
-    at INF. B must be a multiple of 8; S and R are padded here (INF
-    weights keep padding inert)."""
+    at INF. Inputs are padded here; INF weight padding keeps padded
+    s/r/g slots inert (gath pads with 0 — its padded g/b rows compute
+    garbage that the final slice discards)."""
     g, b, s = gath.shape
     g2, s2, r = w.shape
     assert g == g2 and s == s2, (gath.shape, w.shape)
-    b_pad = ((b + TILE_B - 1) // TILE_B) * TILE_B
-    if b_pad != b:
-        gath = jnp.pad(gath, ((0, 0), (0, b_pad - b), (0, 0)))
-    s_pad, ts, r_pad, tr = _pick_tiles(s, r)
-    if s_pad != s:
-        gath = jnp.pad(gath, ((0, 0), (0, 0), (0, s_pad - s)))
-        w = jnp.pad(
-            w, ((0, 0), (0, s_pad - s), (0, 0)), constant_values=INF
-        )
-    if r_pad != r:
-        w = jnp.pad(
-            w, ((0, 0), (0, 0), (0, r_pad - r)), constant_values=INF
-        )
-    grid = (g, b_pad // TILE_B, r_pad // tr, s_pad // ts)
+    b_pad = _pad_to(b, 8)
+    tg, g_pad, tb, b_pad, s_pad, ts, r_pad, tr = _pick_tiles(
+        g, b_pad, s, r
+    )
+    gath = jnp.pad(
+        gath, ((0, g_pad - g), (0, b_pad - b), (0, s_pad - s))
+    )
+    w = jnp.pad(
+        w,
+        ((0, g_pad - g), (0, s_pad - s), (0, r_pad - r)),
+        constant_values=INF,
+    )
+    grid = (g_pad // tg, b_pad // tb, r_pad // tr, s_pad // ts)
     out = pl.pallas_call(
         _kernel,
-        out_shape=jax.ShapeDtypeStruct((g, b_pad, r_pad), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((g_pad, b_pad, r_pad), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec(
-                (1, TILE_B, ts), lambda gg, i, rr, ss: (gg, i, ss)
+                (tg, tb, ts), lambda gg, i, rr, ss: (gg, i, ss)
             ),
             pl.BlockSpec(
-                (1, ts, tr), lambda gg, i, rr, ss: (gg, ss, rr)
+                (tg, ts, tr), lambda gg, i, rr, ss: (gg, ss, rr)
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, TILE_B, tr), lambda gg, i, rr, ss: (gg, i, rr)
+            (tg, tb, tr), lambda gg, i, rr, ss: (gg, i, rr)
         ),
         interpret=interpret,
     )(gath, w)
-    return out[:, :b, :r]
+    return out[:g, :b, :r]

@@ -80,8 +80,14 @@ ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
 # RETRIES at a larger one on overflow (the jit is functional — nothing
 # commits until the count fits, so a retry re-detects against the
-# untouched resident state); beyond the largest bucket the event cold-
-# rebuilds
+# untouched resident state); beyond the largest bucket the event takes
+# the FULL-WIDTH refresh — the patched resident layout is kept and
+# every row re-solves in one cold-build-shaped dispatch, skipping the
+# host layout recompile that makes a true cold build expensive (a
+# fat-tree link up/down event affects every destination row through
+# ECMP next-hop churn, so past 1024 nodes this is the common link-event
+# path — first measured on-chip at 10k, where bucket overflow used to
+# cold-rebuild 10/10 link events)
 _ROW_BUCKETS = (32, 128, 512, 1024)
 
 
@@ -387,9 +393,11 @@ class RouteSweepEngine:
     cold_build(ls) -> RouteSweepResult (full product)
     churn(ls, affected_nodes) -> (affected destination names, their
     fresh per-sample route rows) or None when the event needs a cold
-    rebuild (node add/remove, a sample node's slot-table reshape, or
-    affected-count overflow past the largest bucket). Link add/remove
-    and band widening stay on the incremental path.
+    rebuild (node add/remove or a sample node's slot-table reshape).
+    Link add/remove and band widening stay on the incremental path;
+    affected-count overflow past the largest bucket takes the
+    full-width refresh (patched layout kept, all rows re-solved in one
+    dispatch — no host recompile) and still reports affected names.
     """
 
     def __init__(self, ls, sample_names: Sequence[str],
@@ -479,6 +487,7 @@ class RouteSweepEngine:
         self.incremental_events = getattr(
             self, "incremental_events", 0
         )
+        self.full_refreshes = getattr(self, "full_refreshes", 0)
 
     def _refresh_sample_bands(self, patched, affected_nodes) -> bool:
         """A churn event that touched a SAMPLE node's own adjacencies
@@ -546,6 +555,10 @@ class RouteSweepEngine:
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
                 graph.bands, graph.n_pad, k,
             )
+            # the fused step already patched the bands on device: cache
+            # them so an overflow's _apply_patch_resident adopts these
+            # instead of re-dispatching _patch_bands
+            ctx["patched_bands"] = (new_v, new_w_t)
             segments = [np.asarray(packed_dev)]
         else:
             # band patch in its own small dispatch (see
@@ -587,6 +600,73 @@ class RouteSweepEngine:
         self._dr = dr
         self._digests_dev = digests
         self.graph = self.sweeper.graph = ctx["patched"]
+
+    def _apply_patch_resident(self, ctx, ov_new) -> None:
+        """Backend hook: adopt the event's band patch into the resident
+        sweeper tensors WITHOUT a row re-solve — the full-width refresh
+        applies this then runs the cold-build-shaped dispatch over the
+        patched tensors (a widened band changed the static band shapes,
+        so that dispatch recompiles once — the documented widening
+        cost — but the layout itself is never re-derived on host)."""
+        if ctx["patched_bands"] is None:
+            ctx["patched_bands"] = _patch_bands(
+                ctx["in_v"], ctx["in_w"],
+                ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
+            )
+        new_v, new_w_t = ctx["patched_bands"]
+        self.sweeper.v_t = new_v
+        self.sweeper.w_t = new_w_t
+        self.sweeper.overloaded = ov_new
+        self.graph = self.sweeper.graph = ctx["patched"]
+
+    def _commit_host_mirrors(self, ls, new_out, ov_flips) -> None:
+        """Fold one committed event's raw-weight diff and overload
+        flips into the O(E) host mirrors (shared by the bucketed and
+        full-width commit paths)."""
+        for u, seen in new_out.items():
+            old = self._w_out.get(u, {})
+            for v in set(old) - set(seen):
+                self._w_in.get(v, {}).pop(u, None)
+            self._w_out[u] = dict(seen)
+            for v, w in seen.items():
+                self._w_in.setdefault(v, {})[u] = w
+        for nm in ov_flips:
+            self._ov_host[nm] = ls.is_node_overloaded(nm)
+
+    def _full_refresh(self, ls, ctx, ov_new, new_out, ov_flips):
+        """Overflow path: the affected-row count exceeds every solve
+        bucket (a fat-tree link up/down affects EVERY destination row
+        through ECMP next-hop churn), so re-solving a subset saves
+        nothing — but the LAYOUT is still patchable. Keep the patched
+        resident tensors and run the full-width dispatch; the host
+        layout recompile (the dominant cold-build cost: seconds at 10k)
+        is skipped entirely. Returns the affected names by digest diff,
+        keeping the incremental contract observable."""
+        self._apply_patch_resident(ctx, ov_new)
+        old_digests = self.result.digests.copy()
+        dr, digests, packed = self._full_resident(self.graph)
+        self._dr = dr
+        self._digests_dev = digests
+        self.result = rs.assemble_result(
+            self.sweeper, np.asarray(packed)
+        )
+        self._commit_host_mirrors(ls, new_out, ov_flips)
+        self.version = ls.topology_version
+        self.aversion = ls.attributes_version
+        # counted apart from incremental_events: the three event
+        # classes (bucketed incremental / full-width refresh / cold
+        # rebuild) stay disjoint in artifacts
+        self.full_refreshes += 1
+        # remember that events are running wide: start the next probe
+        # at the top bucket (one dispatch) instead of re-climbing the
+        # ladder; small events decay the hint back down as usual
+        self._k_hint = _ROW_BUCKETS[-1]
+        names = self.graph.node_names
+        moved = np.flatnonzero(
+            self.result.digests[: len(names)]
+            != old_digests[: len(names)]
+        )
+        return sorted(names[int(t)] for t in moved)
 
     def churn(self, ls, affected_nodes: Set[str]):
         """Apply one churn event. Returns the list of affected
@@ -699,9 +779,11 @@ class RouteSweepEngine:
             if max(counts) <= k:
                 break
         if max(counts) > k:
-            # beyond every bucket: a full rebuild is the honest path
-            self._build(ls)
-            return None
+            # beyond every bucket: keep the patched layout, re-solve
+            # all rows in one full-width dispatch (no host recompile)
+            return self._full_refresh(
+                ls, ctx, ov_new, new_out, ov_flips
+            )
         # hint tracks the typical event size (decays toward small)
         self._k_hint = max(
             _ROW_BUCKETS[0], min(1024, 2 * max(counts))
@@ -709,15 +791,7 @@ class RouteSweepEngine:
 
         # commit
         self._commit_device(ctx, commit_state, ov_new)
-        for u, seen in new_out.items():
-            old = self._w_out.get(u, {})
-            for v in set(old) - set(seen):
-                self._w_in.get(v, {}).pop(u, None)
-            self._w_out[u] = dict(seen)
-            for v, w in seen.items():
-                self._w_in.setdefault(v, {})[u] = w
-        for nm in ov_flips:
-            self._ov_host[nm] = ls.is_node_overloaded(nm)
+        self._commit_host_mirrors(ls, new_out, ov_flips)
 
         s = len(self.sweeper.sample_ids)
         kw = self.sweeper.samp_v.shape[1] // 32
@@ -1037,6 +1111,9 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
                 self.sweeper.meta, graph.n_pad, k, impl,
             )
+            # cache the fused step's on-device segment patch for an
+            # overflow's _apply_patch_resident (mirrors the ELL path)
+            ctx["patched_segs"] = new_w
             segments = [np.asarray(packed_dev)]
         else:
             if ctx["patched_segs"] is None:
@@ -1063,4 +1140,18 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         self.sweeper.overloaded = ov_new
         self._dr = dr
         self._digests_dev = digests
+        self.graph = self.sweeper.graph = ctx["patched"]
+
+    def _apply_patch_resident(self, ctx, ov_new) -> None:
+        """Grouped full-width refresh patch: scatter the event's
+        segment-slot weight updates into the resident segment tensors
+        (segment SHAPES never change under grouped_patch, so the
+        full-width dispatch re-runs without recompiling)."""
+        if ctx["patched_segs"] is None:
+            upd_g, upd_s, upd_r, upd_w = ctx["upd"]
+            ctx["patched_segs"] = _patch_segments(
+                self.sweeper.w_t, upd_g, upd_s, upd_r, upd_w
+            )
+        self.sweeper.w_t = ctx["patched_segs"]
+        self.sweeper.overloaded = ov_new
         self.graph = self.sweeper.graph = ctx["patched"]

@@ -641,3 +641,124 @@ class TestSampleNodeChurn:
             assert (
                 engine.result.nh_totals[t_e] == full.nh_totals[t_f]
             ), nm
+
+
+class TestFullRefresh:
+    """Bucket-overflow events (a fat-tree link flap affects EVERY
+    destination row through ECMP next-hop churn past 1024 nodes) must
+    take the full-width refresh — patched resident layout, one
+    cold-build-shaped dispatch, NO host layout recompile — and still
+    report the affected names. Buckets are monkeypatched small so the
+    overflow path runs at test scale."""
+
+    def _shrink_buckets(self, monkeypatch):
+        monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
+
+    def _overflow_event(self, ls, engine):
+        """A spine metric change: affects far more rows than the
+        8-wide bucket ladder admits."""
+        ssw = next(
+            n for n in engine.graph.node_names if n.startswith("ssw")
+        )
+        return mutate_metric(ls, ssw, 0, 9)
+
+    def test_ell_overflow_takes_full_refresh(self, monkeypatch):
+        self._shrink_buckets(monkeypatch)
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        engine = route_engine.RouteSweepEngine(ls, [names[0]])
+        engine._k_hint = 8
+        affected = self._overflow_event(ls, engine)
+        moved = engine.churn(ls, affected)
+        assert moved is not None and len(moved) > 8
+        assert engine.full_refreshes == 1
+        assert engine.cold_builds == 1  # only the constructor's
+        assert engine_digests(engine) == full_digests(ls)
+        # moved must be exactly the digest-diff set: follow with a
+        # quiet metric event and assert the engine is still consistent
+        rsw = next(
+            n for n in engine.graph.node_names if n.startswith("rsw")
+        )
+        moved2 = engine.churn(ls, mutate_metric(ls, rsw, 0, 5))
+        assert moved2 is not None
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_link_flap_full_refresh_parity(self, monkeypatch):
+        """The measured 10k failure shape, miniaturized: alternating
+        link remove/restore rides the full-width refresh with digest
+        parity and zero cold rebuilds."""
+        self._shrink_buckets(monkeypatch)
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        engine = route_engine.RouteSweepEngine(ls, [names[0]])
+        engine._k_hint = 8
+        rsw = next(
+            n for n in engine.graph.node_names if n.startswith("rsw")
+        )
+        db = ls.get_adjacency_databases()[rsw]
+        adjs = list(db.adjacencies)
+        dropped = adjs.pop(0)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "down"
+        db = ls.get_adjacency_databases()[rsw]
+        ls.update_adjacency_database(
+            replace(
+                db, adjacencies=tuple(list(db.adjacencies) + [dropped])
+            )
+        )
+        assert engine.churn(
+            ls, {rsw, dropped.other_node_name}
+        ) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == 1
+
+    def test_grouped_overflow_takes_full_refresh(self, monkeypatch):
+        self._shrink_buckets(monkeypatch)
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        engine = route_engine.GroupedRouteSweepEngine(
+            ls, [names[0]]
+        )
+        engine._k_hint = 8
+        affected = self._overflow_event(ls, engine)
+        moved = engine.churn(ls, affected)
+        assert moved is not None and len(moved) > 8
+        assert engine.full_refreshes == 1
+        assert engine.cold_builds == 1
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_sharded_overflow_takes_full_refresh(self, monkeypatch):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        self._shrink_buckets(monkeypatch)
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        engine = route_engine.RouteSweepEngine(
+            ls, [names[0]], align=16, mesh=make_mesh(jax.devices())
+        )
+        engine._k_hint = 8
+        affected = self._overflow_event(ls, engine)
+        moved = engine.churn(ls, affected)
+        assert moved is not None and len(moved) > 8
+        assert engine.full_refreshes == 1
+        assert engine.cold_builds == 1
+        assert engine_digests(engine) == full_digests(ls)

@@ -367,3 +367,47 @@ class TestPallasMinplus:
         spf_ops.set_minplus_impl("jnp")
         d_again = np.asarray(spf_ops.all_pairs_distances(w, ov))
         np.testing.assert_array_equal(d_jnp, d_again)
+
+
+class TestPallasGroupedTiling:
+    """Shape-sweep parity for the group-blocked batched min-plus
+    (ops.pallas_grouped): every tiling regime — full-extent lanes,
+    tiled lanes (R > 512), s-grid revisit (S > 512), TG group padding,
+    non-multiple batch — must reproduce the jnp broadcast bit-exactly
+    (interpret mode on CPU; the scale bench A/Bs the same shapes
+    on-chip)."""
+
+    # (G, B, S, R) spanning the regimes; the first row is the measured
+    # 10k fat-tree band-0 segment shape that exposed the grid-step
+    # collapse of the first kernel generation
+    SHAPES = [
+        (624, 256, 4, 12),
+        (4, 64, 4, 624),     # lane-tiled R, tiny G (TG padding inert)
+        (4, 64, 624, 4),     # s-grid revisit path
+        (7, 40, 37, 130),    # nothing aligned
+        (1, 8, 1, 1),        # degenerate minima
+        (85, 136, 9, 513),   # TG boundary + b_pad re-pad + R just over cap
+    ]
+
+    def test_shape_sweep_matches_jnp(self):
+        from openr_tpu.ops.pallas_grouped import batched_minplus
+
+        rng = np.random.default_rng(7)
+        for g, b, s, r in self.SHAPES:
+            gath = rng.integers(0, 1000, size=(g, b, s)).astype(np.int32)
+            w = rng.integers(0, 1000, size=(g, s, r)).astype(np.int32)
+            gath[rng.random((g, b, s)) < 0.3] = INF
+            w[rng.random((g, s, r)) < 0.3] = INF
+            got = np.asarray(
+                batched_minplus(
+                    jnp.asarray(gath), jnp.asarray(w), interpret=True
+                )
+            )
+            want = np.minimum(
+                np.min(
+                    gath[:, :, :, None].astype(np.int64) + w[:, None, :, :],
+                    axis=2,
+                ),
+                int(INF),
+            ).astype(np.int32)
+            np.testing.assert_array_equal(got, want, err_msg=str((g, b, s, r)))

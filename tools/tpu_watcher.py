@@ -132,16 +132,70 @@ def run_json(cmd: list[str], timeout_s: int):
     return None
 
 
+def _leg_capture_times(scale_path: str) -> dict:
+    """leg name -> epoch seconds of its newest ON-CHIP capture record.
+    Drives per-leg freshness: an interrupted capture RESUMES at the
+    legs it never reached (the relay has died mid-capture and the
+    north-star 100k leg, ordered last, went unmeasured) instead of
+    re-running the whole suite from the top."""
+    import calendar
+
+    out: dict = {}
+    try:
+        with open(scale_path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                leg, utc = rec.get("leg"), rec.get("utc")
+                result = rec.get("result") or {}
+                if not leg or not utc:
+                    continue
+                if result.get("platform") != "tpu":
+                    continue
+                try:
+                    ts = calendar.timegm(
+                        time.strptime(utc, "%Y-%m-%dT%H:%M:%SZ")
+                    )
+                except ValueError:
+                    continue
+                out[leg] = max(out.get(leg, 0), ts)
+    except OSError:
+        pass
+    return out
+
+
 def capture(round_no: int) -> bool:
-    """One full capture: official bench + scale legs. True on success."""
+    """One capture pass: official bench + scale legs, each skipped
+    while its last on-chip record is fresh. Returns True only when
+    EVERYTHING is fresh at exit — an interrupted pass returns False so
+    the main loop retries on the backoff cadence instead of waiting
+    out the full capture TTL with legs missing."""
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    result = run_json([sys.executable, "bench.py"], BENCH_TIMEOUT_S)
-    ok = (
-        result is not None
-        and result.get("error") is None
-        and result.get("platform") == "tpu"
+    bench_path = os.path.join(
+        REPO, f"BENCH_r{round_no:02d}_midround.json"
     )
-    if ok:
+    bench_age = time.time() - (
+        os.path.getmtime(bench_path)
+        if os.path.exists(bench_path)
+        else 0
+    )
+    ok = False
+    if bench_age < CAPTURE_TTL_S:
+        log(f"bench.py: fresh ({int(bench_age)}s old), skipping")
+        ok = True
+        result = None
+    else:
+        result = run_json(
+            [sys.executable, "bench.py"], BENCH_TIMEOUT_S
+        )
+        ok = (
+            result is not None
+            and result.get("error") is None
+            and result.get("platform") == "tpu"
+        )
+    if ok and result is not None:
         out = {
             "note": (
                 "Self-captured run of the official bench.py (identical "
@@ -154,13 +208,12 @@ def capture(round_no: int) -> bool:
             "utc": stamp,
             "result": result,
         }
-        path = os.path.join(REPO, f"BENCH_r{round_no:02d}_midround.json")
-        tmp = path + ".tmp"
+        tmp = bench_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(out, f, indent=2)
-        os.replace(tmp, path)
-        log(f"captured {path} (value={result.get('value')}ms)")
-    else:
+        os.replace(tmp, bench_path)
+        log(f"captured {bench_path} (value={result.get('value')}ms)")
+    elif not ok:
         log(f"bench.py capture not usable: {result and result.get('platform')}")
 
     # scale legs: freshest on-chip numbers for SCALE_r{N}.json
@@ -235,18 +288,39 @@ def capture(round_no: int) -> bool:
              "--routes", "--nodes", "100000", "--backend", "grouped"],
         ),
     ]
+    # stalest-first: legs never captured on-chip (epoch 0) run before
+    # re-runs of fresh ones, and a still-fresh leg is skipped outright —
+    # a healthy window is spent where the evidence gaps are
+    cap_times = _leg_capture_times(scale_path)
+    legs.sort(key=lambda nc: cap_times.get(nc[0], 0))
     for name, cmd in legs:
+        age = time.time() - cap_times.get(name, 0)
+        if age < CAPTURE_TTL_S:
+            log(f"scale leg {name}: fresh ({int(age)}s old), skipping")
+            continue
         r = run_json(cmd, SCALE_TIMEOUT_S)
         if r is not None:
+            # stamp at APPEND time, not pass start: a cold pass can
+            # outlast CAPTURE_TTL_S, and pass-start stamps would parse
+            # as already-stale, defeating both the fresh-skip and the
+            # end-of-pass completeness check
+            leg_stamp = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
             with open(scale_path, "a") as f:
                 f.write(json.dumps(
-                    {"leg": name, "utc": stamp, "result": r}
+                    {"leg": name, "utc": leg_stamp, "result": r}
                 ) + "\n")
             log(f"scale leg {name}: {r.get('platform')}")
         if not probe():
             log("relay lost mid-capture; stopping scale legs")
-            return ok
-    return ok
+            return False
+    cap_times = _leg_capture_times(scale_path)
+    all_fresh = all(
+        time.time() - cap_times.get(name, 0) < CAPTURE_TTL_S
+        for name, _cmd in legs
+    )
+    return ok and all_fresh
 
 
 def main() -> None:
