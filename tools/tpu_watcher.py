@@ -270,6 +270,17 @@ def capture(round_no: int) -> bool:
              "--churn-events", "10", "--backend", "grouped"],
         ),
         (
+            # grouped LINK churn: removal patches a weight slot,
+            # restore rewrites the retired slot (restorable by
+            # construction) — with the full-width refresh this is the
+            # hardest event class that still avoids a host recompile
+            "route_engine_link_churn_10k_grouped",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--routes-churn", "--nodes", "10000",
+             "--churn-events", "10", "--churn-kind", "link",
+             "--backend", "grouped"],
+        ),
+        (
             # incremental KSP2 with the ENGINE ACTIVE at 10k nodes
             # (VERDICT item 8): 256 KSP2 destinations on the 10k
             # fat-tree, all-pairs event dispatch over the full graph
