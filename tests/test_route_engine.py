@@ -762,3 +762,74 @@ class TestFullRefresh:
         assert engine.full_refreshes == 1
         assert engine.cold_builds == 1
         assert engine_digests(engine) == full_digests(ls)
+
+    def test_mixed_event_fuzz_with_tiny_buckets(self, monkeypatch):
+        """State-machine soak: metric / link-down / link-up / overload
+        events interleave while an 8-wide ladder forces frequent
+        full-width refreshes between bucketed commits — every step must
+        hold digest parity, and the three event classes must account
+        for every event (no silent cold rebuilds)."""
+        self._shrink_buckets(monkeypatch)
+        rng = np.random.default_rng(11)
+        topo = topologies.fat_tree(
+            pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        engine = route_engine.RouteSweepEngine(ls, [names[0]])
+        engine._k_hint = 8
+        pulled = {}
+        applied = 0
+        for step in range(14):
+            kind = ("metric", "link", "overload")[step % 3]
+            node = names[int(rng.integers(len(names)))]
+            db = ls.get_adjacency_databases()[node]
+            if kind == "metric" and db.adjacencies:
+                affected = mutate_metric(
+                    ls, node, 0, int(rng.integers(1, 12))
+                )
+            elif kind == "link":
+                if node in pulled:
+                    # restore the previously dropped adjacency
+                    back = pulled.pop(node)
+                    db = ls.get_adjacency_databases()[node]
+                    ls.update_adjacency_database(replace(
+                        db,
+                        adjacencies=tuple(
+                            list(db.adjacencies) + [back]
+                        ),
+                    ))
+                    affected = {node, back.other_node_name}
+                elif len(db.adjacencies) > 1:
+                    adjs = list(db.adjacencies)
+                    back = adjs.pop(0)
+                    pulled[node] = back
+                    ls.update_adjacency_database(
+                        replace(db, adjacencies=tuple(adjs))
+                    )
+                    affected = {node, back.other_node_name}
+                else:
+                    continue
+            else:
+                affected = set_overload(
+                    ls, node, not ls.is_node_overloaded(node)
+                )
+            f0 = engine.full_refreshes
+            i0 = engine.incremental_events
+            moved = engine.churn(ls, affected)
+            assert moved is not None, (step, kind)
+            df = engine.full_refreshes - f0
+            di = engine.incremental_events - i0
+            # disjoint accounting per event: exactly one of the two
+            # non-cold paths fired, or neither did and the event was a
+            # detection no-op (empty moved, e.g. a random wiggle
+            # landing on the current metric)
+            assert engine.cold_builds == 1, (step, kind)
+            assert df + di <= 1, (step, kind)
+            assert df + di == 1 or moved == [], (step, kind)
+            applied += df + di
+            assert engine_digests(engine) == full_digests(ls), (
+                step, kind,
+            )
+        assert engine.full_refreshes > 0  # the ladder forced some
+        assert applied > 0
