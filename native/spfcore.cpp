@@ -183,4 +183,148 @@ void spf_first_hops(int32_t n, int32_t n_edges, const int32_t* edge_src,
   }
 }
 
+// Batched KSP2 path enumeration: link-disjoint shortest paths from one
+// source to many destinations, byte-identical in path content AND order
+// to the Python tracer (ksp2_engine.trace_paths_from_row, itself
+// mirroring the reference LinkState.cpp:399 traceOnePath): predecessor
+// candidates are walked in the caller's canonical order, a link is
+// marked visited the moment it is tried (monotone within one
+// destination's enumeration), and enumeration stops at the first
+// failed trace.
+//
+// Candidates per node v live in cand_off[v]..cand_off[v+1) of the
+// parallel arrays cand_link / cand_uid (origin node id, -1 when the
+// origin is unknown to the graph) / cand_w. rows: one row of n
+// distances shared by every destination when shared_row != 0
+// (predecessor lists are then also shared across destinations as long
+// as no exclusions exist), else [n_dsts, n] row-major. Excluded link
+// ids per destination: excl_off[d]..excl_off[d+1) of excl_ids.
+//
+// Output, per destination: n_paths, then per path: len, link ids in
+// src->dst order. Returns the total int32 count written, or -1 when
+// out_cap would be exceeded (caller grows the buffer and retries).
+int32_t ksp2_trace_batch(
+    int32_t n, int32_t n_links, const int32_t* cand_off,
+    const int32_t* cand_link, const int32_t* cand_uid,
+    const int32_t* cand_w, int32_t src, const uint8_t* transit_blocked,
+    int32_t n_dsts, const int32_t* dst_ids, const int32_t* rows,
+    int32_t shared_row, const int32_t* excl_off,
+    const int32_t* excl_ids, int32_t* out, int32_t out_cap) {
+  // epoch-stamped scratch: visited/excluded links, per-node pred lists
+  std::vector<int32_t> vis(n_links, -1);
+  std::vector<int32_t> exc(n_links, -1);
+  int32_t total_cands = cand_off[n];
+  std::vector<int32_t> pred_link(total_cands);
+  std::vector<int32_t> pred_uid(total_cands);
+  std::vector<int32_t> pred_cnt(n, 0);
+  std::vector<int32_t> pred_epoch(n, -1);
+  bool share_preds = shared_row && excl_off[n_dsts] == 0;
+
+  struct Frame {
+    int32_t v;
+    int32_t idx;      // next candidate offset within v's pred list
+    int32_t in_link;  // link taken from the previous frame into v
+  };
+  std::vector<Frame> frames;
+  std::vector<int32_t> path;
+
+  int64_t written = 0;
+  for (int32_t d = 0; d < n_dsts; ++d) {
+    if (written >= out_cap) {
+      return -1;
+    }
+    int64_t npaths_slot = written++;
+    out[npaths_slot] = 0;
+    int32_t dst = dst_ids[d];
+    const int32_t* row =
+        shared_row ? rows : rows + static_cast<int64_t>(d) * n;
+    if (dst < 0 || dst >= n || row[dst] >= kInf || dst == src) {
+      continue;  // unreachable or trivial: zero paths (matches Python)
+    }
+    // stamp this destination's exclusions
+    for (int32_t x = excl_off[d]; x < excl_off[d + 1]; ++x) {
+      exc[excl_ids[x]] = d;
+    }
+    // predecessor lists: shared across the batch only when every
+    // destination sees the same row and no exclusions exist;
+    // otherwise rebuilt lazily per destination (epoch d)
+    int32_t epoch = share_preds ? 0 : d;
+    auto ensure_preds = [&](int32_t v) {
+      if (pred_epoch[v] == epoch) {
+        return;
+      }
+      pred_epoch[v] = epoch;
+      int32_t cnt = 0;
+      int32_t dv = row[v];
+      for (int32_t c = cand_off[v]; c < cand_off[v + 1]; ++c) {
+        int32_t uid = cand_uid[c];
+        if (uid < 0) {
+          continue;
+        }
+        int32_t l = cand_link[c];
+        if (exc[l] == d) {
+          continue;
+        }
+        if (uid != src && transit_blocked[uid]) {
+          continue;
+        }
+        if (row[uid] >= kInf || row[uid] + cand_w[c] != dv) {
+          continue;
+        }
+        pred_link[cand_off[v] + cnt] = l;
+        pred_uid[cand_off[v] + cnt] = uid;
+        ++cnt;
+      }
+      pred_cnt[v] = cnt;
+    };
+    // enumerate link-disjoint paths until a trace fails
+    for (;;) {
+      frames.clear();
+      frames.push_back({dst, 0, -1});
+      bool found = false;
+      while (!frames.empty()) {
+        Frame& f = frames.back();
+        if (f.v == src) {
+          found = true;
+          break;
+        }
+        ensure_preds(f.v);
+        bool advanced = false;
+        while (f.idx < pred_cnt[f.v]) {
+          int32_t c = cand_off[f.v] + f.idx++;
+          int32_t l = pred_link[c];
+          if (vis[l] == d) {
+            continue;
+          }
+          vis[l] = d;  // visited stays set even if this branch dies
+          frames.push_back({pred_uid[c], 0, l});
+          advanced = true;
+          break;
+        }
+        if (!advanced) {
+          frames.pop_back();
+        }
+      }
+      if (!found) {
+        break;
+      }
+      // frames: dst, ..., src with in_link = step toward dst; the
+      // src->dst path is those links read back-to-front
+      path.clear();
+      for (size_t i = frames.size() - 1; i >= 1; --i) {
+        path.push_back(frames[i].in_link);
+      }
+      if (written + 1 + static_cast<int64_t>(path.size()) > out_cap) {
+        return -1;
+      }
+      out[written++] = static_cast<int32_t>(path.size());
+      for (int32_t l : path) {
+        out[written++] = l;
+      }
+      ++out[npaths_slot];
+    }
+  }
+  return static_cast<int32_t>(written);
+}
+
 }  // extern "C"

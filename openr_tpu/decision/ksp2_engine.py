@@ -244,6 +244,94 @@ def make_cands_of(ls: LinkState, node_index: Dict[str, int]):
     return cands_of
 
 
+class _TraceArrays:
+    """Int-encoded view of one build's candidate structure for the
+    native batch tracer (native/spfcore.cpp ksp2_trace_batch): a
+    candidate CSR in the same canonical order make_cands_of yields,
+    a link table for id<->object mapping, and the transit-blocked
+    bitmap. Built once per churn event and shared by every trace
+    site; the Python tracer remains the fallback and the semantic
+    reference."""
+
+    __slots__ = (
+        "off", "link", "uid", "w", "links", "lid_of", "blocked",
+        "n_pad",
+    )
+
+    def __init__(self, graph, cands_of, transit_blocked):
+        index = graph.node_index
+        names = graph.node_names
+        n_pad = graph.n_pad
+        off = np.zeros(n_pad + 1, np.int32)
+        link_l: List[int] = []
+        uid_l: List[int] = []
+        w_l: List[int] = []
+        links: List[Link] = []
+        # keyed by the Link VALUE (its hash is cached), not id(): the
+        # Python tracer excludes via `link not in excluded` — a link
+        # that flapped down and back up is a fresh-but-EQUAL object,
+        # and an identity key would silently drop its exclusion
+        lid_of: Dict[Link, int] = {}
+        for i, v in enumerate(names):
+            for lnk, _u, uuid, w in cands_of(v):
+                lid = lid_of.get(lnk)
+                if lid is None:
+                    lid = lid_of[lnk] = len(links)
+                    links.append(lnk)
+                link_l.append(lid)
+                uid_l.append(-1 if uuid is None else int(uuid))
+                w_l.append(int(w))
+            off[i + 1] = len(link_l)
+        off[len(names) + 1 :] = len(link_l)
+        self.off = off
+        self.link = np.asarray(link_l, np.int32)
+        self.uid = np.asarray(uid_l, np.int32)
+        self.w = np.asarray(w_l, np.int32)
+        self.links = links
+        self.lid_of = lid_of
+        blocked = np.zeros(n_pad, np.uint8)
+        for nm in transit_blocked:
+            bi = index.get(nm)
+            if bi is not None:
+                blocked[bi] = 1
+        self.blocked = blocked
+        self.n_pad = n_pad
+
+    def _excl_arrays(self, excls):
+        """Per-dst exclusion ranges; a link absent from the current
+        candidate table is down, so its exclusion is vacuous."""
+        ids: List[int] = []
+        off = np.zeros(len(excls) + 1, np.int32)
+        for i, excl in enumerate(excls):
+            for lnk in excl:
+                lid = self.lid_of.get(lnk)
+                if lid is not None:
+                    ids.append(lid)
+            off[i + 1] = len(ids)
+        return off, np.asarray(ids, np.int32)
+
+    def trace(self, src_id, dst_ids, rows, shared_row, excls):
+        """Batch-enumerate via the native core; None when it is
+        unavailable. Paths come back as Link-object lists, identical
+        in content and order to trace_paths_from_row."""
+        from openr_tpu.graph import native_spf
+
+        excl_off, excl_ids = self._excl_arrays(excls)
+        got = native_spf.trace_batch(
+            self.n_pad, len(self.links), self.off, self.link,
+            self.uid, self.w, src_id, self.blocked,
+            np.ascontiguousarray(dst_ids, np.int32),
+            np.ascontiguousarray(rows, np.int32),
+            shared_row, excl_off, excl_ids,
+        )
+        if got is None:
+            return None
+        links = self.links
+        return [
+            [[links[l] for l in p] for p in paths] for paths in got
+        ]
+
+
 def _path_nodes(src: str, path: List[Link]) -> List[str]:
     """Nodes visited after src along a traced path."""
     out = []
@@ -576,18 +664,15 @@ class Ksp2Engine:
             for name in graph.node_names
             if ls.is_node_overloaded(name) and name != self.src_name
         }
-        dlist = self.d_base.tolist()
         self.first_paths: Dict[str, List[List[Link]]] = {}
         self.second_paths: Dict[str, List[List[Link]]] = {}
         self.excl: Dict[str, Set[Link]] = {}
         self.node_users: Dict[str, Set[str]] = {}
-        shared_preds: Dict[str, list] = {}  # one row, many dsts
-        for dst in dsts:
-            paths = trace_paths_from_row(
-                self.src_name, dst, graph.node_index, dlist,
-                set(), cands_of, transit_blocked,
-                preds_cache=shared_preds,
-            )
+        traced = self._trace_many(
+            ls, graph, cands_of, transit_blocked, dsts, self.d_base,
+            True, [set()] * len(dsts),
+        )
+        for dst, paths in zip(dsts, traced):
             self.first_paths[dst] = paths
             self.excl[dst] = {l for p in paths for l in p}
 
@@ -878,12 +963,16 @@ class Ksp2Engine:
                     users = self.node_users.get(x)
                     if users is not None:
                         users.discard(dst)
-            self.second_paths[dst] = trace_paths_from_row(
-                self.src_name, dst, graph.node_index,
-                self.dm[self.dst_pos[dst]].tolist(), self.excl[dst],
-                cands_of, transit_blocked,
-            )
-            for path in self.second_paths[dst]:
+        traced = self._trace_many(
+            ls, graph, cands_of, transit_blocked, dsts,
+            np.ascontiguousarray(
+                self.dm[[self.dst_pos[d] for d in dsts]]
+            ),
+            False, [self.excl[d] for d in dsts],
+        )
+        for dst, paths in zip(dsts, traced):
+            self.second_paths[dst] = paths
+            for path in paths:
                 for x in _path_nodes(self.src_name, path):
                     self.node_users.setdefault(x, set()).add(dst)
 
@@ -901,8 +990,6 @@ class Ksp2Engine:
             for name in graph.node_names
             if ls.is_node_overloaded(name) and name != self.src_name
         }
-        dlist = d_new_src.astype(np.int32).tolist()
-        shared_preds: Dict[str, list] = {}  # one row, many dsts
         for dst in affected:
             # drop stale reverse-index entries
             for path in self.first_paths.get(dst, []) + self.second_paths.get(
@@ -912,11 +999,12 @@ class Ksp2Engine:
                     users = self.node_users.get(x)
                     if users is not None:
                         users.discard(dst)
-            paths = trace_paths_from_row(
-                self.src_name, dst, graph.node_index, dlist,
-                set(), cands_of, transit_blocked,
-                preds_cache=shared_preds,
-            )
+        traced = self._trace_many(
+            ls, graph, cands_of, transit_blocked, affected,
+            d_new_src.astype(np.int32), True,
+            [set()] * len(affected),
+        )
+        for dst, paths in zip(affected, traced):
             self.first_paths[dst] = paths
             self.excl[dst] = {l for p in paths for l in p}
 
@@ -984,6 +1072,7 @@ class Ksp2Engine:
                 self.dm_dev = self.dm_dev.at[ids].set(
                     jnp.asarray(drows[: len(batch)])
                 )
+            traceable: List[int] = []
             for i, dst in enumerate(batch):
                 if not ok[i]:
                     _counters()["decision.ksp2_host_fallbacks"] += 1
@@ -997,11 +1086,15 @@ class Ksp2Engine:
                     self.dm[self.dst_pos[dst]] = drows[i]
                     continue
                 self.dm[self.dst_pos[dst]] = drows[i]
-                self.second_paths[dst] = trace_paths_from_row(
-                    self.src_name, dst, graph.node_index,
-                    drows[i].tolist(), self.excl[dst], cands_of,
-                    transit_blocked,
-                )
+                traceable.append(i)
+            traced = self._trace_many(
+                ls, graph, cands_of, transit_blocked,
+                [batch[i] for i in traceable],
+                np.ascontiguousarray(np.asarray(drows)[traceable]),
+                False, [self.excl[batch[i]] for i in traceable],
+            )
+            for i, paths in zip(traceable, traced):
+                self.second_paths[batch[i]] = paths
         for dst in dsts:
             if dst in self.host_dsts:
                 continue
@@ -1010,6 +1103,64 @@ class Ksp2Engine:
             ):
                 for x in _path_nodes(self.src_name, path):
                     self.node_users.setdefault(x, set()).add(dst)
+
+    def _trace_arrays(self, ls, graph, cands_of, transit_blocked):
+        """Per-event cache of the native tracer's int-encoded candidate
+        structure. One build serves every trace site of the event (cold
+        build first paths, recompute, retrace, masked second paths);
+        None when the native core is unavailable (callers fall back to
+        the Python tracer)."""
+        from openr_tpu.graph import native_spf
+
+        if not native_spf.is_available():
+            return None
+        key = (ls.topology_version, ls.attributes_version)
+        cached = getattr(self, "_tarrays", None)
+        if (
+            cached is not None
+            and cached[0] == key
+            and cached[1] is graph
+        ):
+            return cached[2]
+        arrays = _TraceArrays(graph, cands_of, transit_blocked)
+        self._tarrays = (key, graph, arrays)
+        return arrays
+
+    def _trace_many(
+        self, ls, graph, cands_of, transit_blocked, dsts, rows,
+        shared_row, excls,
+    ) -> List[List[List[Link]]]:
+        """THE trace front-end for every per-event path enumeration:
+        native batch when the core is available, else the Python tracer
+        per destination — one site to keep the two byte-identical.
+        ``rows``: one [n_pad] row (shared_row) or [len(dsts), n_pad];
+        ``excls``: per-dst exclusion sets (empty for first paths)."""
+        arrays = self._trace_arrays(ls, graph, cands_of, transit_blocked)
+        if arrays is not None:
+            got = arrays.trace(
+                self.sid,
+                np.asarray(
+                    [graph.node_index[d] for d in dsts], np.int32
+                ),
+                rows, shared_row, excls,
+            )
+            if got is not None:
+                return got
+        shared_preds: Optional[Dict[str, list]] = (
+            {} if shared_row else None
+        )
+        row_list = rows.tolist() if shared_row else None
+        return [
+            trace_paths_from_row(
+                self.src_name, dst, graph.node_index,
+                row_list if shared_row else rows[i].tolist(),
+                excls[i], cands_of, transit_blocked,
+                preds_cache=(
+                    shared_preds if not excls[i] else None
+                ),
+            )
+            for i, dst in enumerate(dsts)
+        ]
 
     # -- priming / view preload -------------------------------------------
 

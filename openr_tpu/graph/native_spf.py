@@ -81,6 +81,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, u8p,
             ctypes.c_int32, i32p, i32p, u8p,
         ]
+        lib.ksp2_trace_batch.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, i32p, i32p, i32p, i32p,
+            ctypes.c_int32, u8p, ctypes.c_int32, i32p, i32p,
+            ctypes.c_int32, i32p, i32p, i32p, ctypes.c_int32,
+        ]
+        lib.ksp2_trace_batch.restype = ctypes.c_int32
         _lib = lib
         return _lib
 
@@ -150,3 +156,53 @@ def first_hop_matrix(
         _as_u8p(out),
     )
     return out
+
+
+def trace_batch(
+    n: int,
+    n_links: int,
+    cand_off: np.ndarray,
+    cand_link: np.ndarray,
+    cand_uid: np.ndarray,
+    cand_w: np.ndarray,
+    src: int,
+    transit_blocked: np.ndarray,
+    dst_ids: np.ndarray,
+    rows: np.ndarray,
+    shared_row: bool,
+    excl_off: np.ndarray,
+    excl_ids: np.ndarray,
+) -> Optional[list]:
+    """Batched KSP2 link-disjoint path enumeration via the native core
+    (spfcore.cpp ksp2_trace_batch) — byte-identical path content and
+    order to ksp2_engine.trace_paths_from_row. Returns a list (one per
+    destination) of lists of link-id paths, or None when the native
+    library is unavailable. The int32 output buffer grows on overflow."""
+    lib = _load()
+    if lib is None:
+        return None
+    n_dsts = len(dst_ids)
+    cap = max(4096, 2 * n_links + 64 * n_dsts)
+    while True:
+        out = np.empty(cap, dtype=np.int32)
+        wrote = lib.ksp2_trace_batch(
+            n, n_links, _as_i32p(cand_off), _as_i32p(cand_link),
+            _as_i32p(cand_uid), _as_i32p(cand_w), src,
+            _as_u8p(transit_blocked), n_dsts, _as_i32p(dst_ids),
+            _as_i32p(rows), 1 if shared_row else 0,
+            _as_i32p(excl_off), _as_i32p(excl_ids), _as_i32p(out), cap,
+        )
+        if wrote >= 0:
+            break
+        cap *= 4
+    result = []
+    pos = 0
+    for _ in range(n_dsts):
+        n_paths = int(out[pos]); pos += 1
+        paths = []
+        for _p in range(n_paths):
+            ln = int(out[pos]); pos += 1
+            paths.append(out[pos : pos + ln].tolist())
+            pos += ln
+        result.append(paths)
+    return result

@@ -926,3 +926,118 @@ class TestMeshShardedEngine:
             SPF_COUNTERS["decision.ksp2_cold_builds"]
             > before["decision.ksp2_cold_builds"]
         )
+
+
+class TestNativeTraceBatch:
+    """Differential gate for the native batch tracer (spfcore.cpp
+    ksp2_trace_batch): over randomized topologies with exclusions,
+    overloaded transit nodes and unreachable destinations, the native
+    paths must be BYTE-IDENTICAL (content and order) to the Python
+    tracer it replaces."""
+
+    def _graphs(self):
+        import numpy as np
+
+        from openr_tpu.decision import spf_solver as ss
+
+        for seed, kind in ((3, "mesh"), (5, "mesh"), (1, "fabric")):
+            if kind == "mesh":
+                topo = topologies.random_mesh(
+                    28, degree=4, seed=seed, max_metric=9
+                )
+            else:
+                topo = topologies.fat_tree(
+                    pods=2, ssw_per_plane=2, fsw_per_pod=2,
+                    rsw_per_pod=3,
+                )
+            ls = LinkState(area=topo.area)
+            for name in sorted(topo.adj_dbs):
+                ls.update_adjacency_database(topo.adj_dbs[name])
+            # drain one transit node so blocked filtering is exercised
+            names = sorted(topo.adj_dbs)
+            drained = names[len(names) // 2]
+            db = ls.get_adjacency_databases()[drained]
+            ls.update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=db.this_node_name,
+                    is_overloaded=True,
+                    adjacencies=db.adjacencies,
+                    node_label=db.node_label,
+                    area=db.area,
+                )
+            )
+            state = ss._ELL_RESIDENT.state_for(ls)
+            yield ls, state.graph, np.random.default_rng(seed)
+
+    def test_matches_python_tracer(self):
+        import numpy as np
+
+        from openr_tpu.graph import native_spf
+
+        if not native_spf.is_available():
+            pytest.skip("native core unavailable")
+        for ls, graph, rng in self._graphs():
+            names = list(graph.node_names)
+            src = names[0]
+            sid = graph.node_index[src]
+            cands_of = ksp2_engine.make_cands_of(ls, graph.node_index)
+            transit_blocked = {
+                nm for nm in names
+                if ls.is_node_overloaded(nm) and nm != src
+            }
+            arrays = ksp2_engine._TraceArrays(
+                graph, cands_of, transit_blocked
+            )
+            # a distance row from the HOST oracle
+            spf = ls.get_spf_result(src)
+            row = np.full(graph.n_pad, ksp2_engine.INF, np.int32)
+            for nm, res in spf.items():
+                row[graph.node_index[nm]] = res.metric
+            dsts = [nm for nm in names if nm != src]
+            # shared-row, no exclusions (first-path shape)
+            got = arrays.trace(
+                sid,
+                np.asarray(
+                    [graph.node_index[d] for d in dsts], np.int32
+                ),
+                row, True, [set()] * len(dsts),
+            )
+            want = [
+                ksp2_engine.trace_paths_from_row(
+                    src, d, graph.node_index, row.tolist(), set(),
+                    cands_of, transit_blocked,
+                )
+                for d in dsts
+            ]
+            assert got == want, "shared-row trace diverged"
+            # per-dst rows with first-path exclusions (second-path
+            # shape). Every destination gets a DISTINCT perturbed row
+            # (random entries bumped) so a row-indexing bug in the
+            # shared_row=0 stride arithmetic cannot hide behind
+            # identical rows; expectations re-derive from the same
+            # perturbed row through the Python tracer.
+            excls = [
+                {l for p in w for l in p} for w in want
+            ]
+            rows = np.tile(row, (len(dsts), 1))
+            for i in range(len(dsts)):
+                bump = rng.integers(0, graph.n_pad, size=3)
+                rows[i, bump] = np.minimum(
+                    rows[i, bump].astype(np.int64) + 1 + i,
+                    int(ksp2_engine.INF),
+                ).astype(np.int32)
+            got2 = arrays.trace(
+                sid,
+                np.asarray(
+                    [graph.node_index[d] for d in dsts], np.int32
+                ),
+                rows, False, excls,
+            )
+            want2 = [
+                ksp2_engine.trace_paths_from_row(
+                    src, d, graph.node_index, rows[i].tolist(), excl,
+                    cands_of, transit_blocked,
+                )
+                for i, (d, excl) in enumerate(zip(dsts, excls))
+            ]
+            assert got2 == want2, "per-dst excluded trace diverged"
