@@ -592,6 +592,11 @@ class SpfSolver:
         # destinations); reuse is only sound for prefixes whose
         # advertisers all lie inside this set
         self._ksp2_tracked: Set[str] = set()
+        # advertiser sets per prefix, cached per prefix_state VERSION:
+        # rebuilding them per prefix per event made the reuse loop
+        # itself the cost it was meant to avoid (~30us x n_prefixes of
+        # entries_for + set building per churn event)
+        self._advertisers_cache: Optional[tuple] = None
 
     # -- static MPLS routes ----------------------------------------------
 
@@ -673,12 +678,29 @@ class SpfSolver:
         self._route_cache_meta = meta if populate else None
         new_cache: Dict[IpPrefix, tuple] = {}
 
+        adv_map = None
+        if reuse is not None:
+            # built only when reuse can actually consult it: an
+            # LFA-enabled or engine-less solver never reads the map,
+            # and building it would re-impose the very per-event cost
+            # the cache exists to avoid
+            adv_key = (id(prefix_state), prefix_state.version)
+            if (
+                self._advertisers_cache is None
+                or self._advertisers_cache[0] != adv_key
+            ):
+                self._advertisers_cache = (adv_key, {
+                    p: {
+                        node
+                        for (node, _a) in prefix_state.entries_for(p)
+                    }
+                    for p in prefix_state.prefixes()
+                })
+            adv_map = self._advertisers_cache[1]
+
         for prefix in prefix_state.prefixes():
             if reuse is not None and prefix in self._route_cache:
-                advertisers = {
-                    node
-                    for (node, _a) in prefix_state.entries_for(prefix)
-                }
+                advertisers = adv_map[prefix]
                 # the engine's affected set only covers the KSP2
                 # destinations it tracks — an advertiser outside that
                 # set (e.g. an SP_ECMP-only node) can change without
