@@ -171,6 +171,7 @@ SPF_COUNTERS: Dict[str, int] = {
     "decision.ksp2_incremental_syncs": 0,
     "decision.ksp2_affected_dsts": 0,
     "decision.ksp2_route_reuses": 0,
+    "decision.sp_route_reuses": 0,
 }
 
 # KSP2 device prefetch: below this many KSP2 destinations the host path
@@ -201,6 +202,38 @@ def _ksp2_chunk(graph) -> int:
         chunk *= 2
     return chunk
 
+
+
+_LINKS_SIG_MEMO: Dict[tuple, tuple] = {}
+
+
+def _local_links_sig(ls: LinkState, node: str) -> tuple:
+    """Signature of every route input read off the root's own links
+    during next-hop materialization (Decision.cpp:1211): iface, metric,
+    peer, liveness, v6/v4 next-hop addresses. Shared by the node-label
+    and SP-reuse caches so their invalidation can't drift apart.
+
+    Memoized per (graph identity, topology version, attribute version,
+    node): every field below moves one of the two versions when it
+    changes, so both caches' per-build probes share one link walk."""
+    key = (id(ls), ls.topology_version, ls.attributes_version, node)
+    sig = _LINKS_SIG_MEMO.get(key)
+    if sig is None:
+        while len(_LINKS_SIG_MEMO) > 32:  # a few roots x live graphs
+            _LINKS_SIG_MEMO.pop(next(iter(_LINKS_SIG_MEMO)))
+        sig = tuple(
+            (
+                link.iface_from(node),
+                link.metric_from(node),
+                link.other_node(node),
+                link.is_up(),
+                link.nh_v6_from(node).addr,
+                link.nh_v4_from(node).addr,
+            )
+            for link in sorted(ls.links_from_node(node))
+        )
+        _LINKS_SIG_MEMO[key] = sig
+    return sig
 
 
 def get_spf_counters() -> Dict[str, int]:
@@ -419,13 +452,17 @@ class _SparseIndexAdapter:
     """Gives the sparse device backend the same id_of/node_names surface
     the dense GraphSnapshot provides to the query methods."""
 
-    __slots__ = ("node_names", "node_index", "n", "n_pad")
+    __slots__ = ("node_names", "node_index", "n", "n_pad", "overloaded")
 
     def __init__(self, graph):
-        self.node_names = list(graph.node_names)
+        # alias, don't copy: the sparse graph's name tuple is shared
+        # across patches, so identity survives churn (the labels cache
+        # keys on it)
+        self.node_names = graph.node_names
         self.node_index = graph.node_index
         self.n = graph.n
         self.n_pad = graph.n_pad
+        self.overloaded = graph.overloaded
 
     def id_of(self, node):
         return self.node_index.get(node)
@@ -597,6 +634,18 @@ class SpfSolver:
         # itself the cost it was meant to avoid (~30us x n_prefixes of
         # entries_for + set building per churn event)
         self._advertisers_cache: Optional[tuple] = None
+        # root -> previous build's route-determining signature for the
+        # SP reuse dirty test (_sp_dirty_nodes): batched distance +
+        # first-hop matrices, overload bits, node labels, local-link
+        # signature. Bounded like _label_cache.
+        self._sp_reuse: Dict[str, tuple] = {}
+        # node-label vector cache: labels only move on an attribute
+        # change, so the O(N) rebuild is skipped across metric churn
+        self._labels_cache: Optional[tuple] = None
+        # bumped on every static-MPLS mutation: _add_best_paths merges
+        # static next hops into self-advertised anycast routes, so the
+        # reuse meta must change when they do
+        self._static_routes_version = 0
 
     # -- static MPLS routes ----------------------------------------------
 
@@ -609,6 +658,7 @@ class SpfSolver:
             self.static_mpls_routes[label] = list(nhs)
         for label in routes_to_delete:
             self.static_mpls_routes.pop(label, None)
+        self._static_routes_version += 1
 
     # -- SPF views --------------------------------------------------------
 
@@ -632,6 +682,148 @@ class SpfSolver:
             self._views[key] = view
         return view
 
+    # -- SP route reuse dirty test ----------------------------------------
+
+    def _sp_dirty_nodes(
+        self,
+        my_node_name: str,
+        area_link_states: AreaLinkStates,
+    ) -> Tuple[bool, Optional[Set[str]]]:
+        """Per-destination change detection for SP_ECMP route reuse.
+
+        A non-KSP2 route from ``my_node_name`` toward advertiser ``a``
+        is a pure function of: (1) the prefix entries (version-gated by
+        the caller), (2) the batched view's distance and first-hop
+        COLUMNS for ``a`` (reachability, best metric, ECMP first hops —
+        reference: Decision.cpp:847/:1124), (3) the distance columns of
+        the first-hop NEIGHBORS themselves (remaining metric =
+        shortest - metric_to(nh), Decision.cpp:1211), (4) the
+        advertiser's overload bit (maybeFilterDrainedNodes,
+        Decision.cpp:783) and node label (SR PUSH materialization), and
+        (5) the local link signature (iface, metric, addresses).
+
+        Compares all of (2)-(5) against the previous build and returns
+        ``(stored, dirty)``: ``stored`` is True when a fresh signature
+        was recorded (detection will be available next build); ``dirty``
+        is the set of node names whose routes MAY have changed, or None
+        when no comparable previous signature exists (first build,
+        topology re-index, neighbor-set change, non-device backend,
+        multi-area).
+        """
+        if len(area_link_states) != 1:
+            return False, None
+        ((_area, ls),) = area_link_states.items()
+        view = self._view(_area, ls, my_node_name)
+        d = getattr(view, "_d", None)
+        fh = getattr(view, "_fh_batch", None)
+        snap = getattr(view, "_snap", None)
+        srcs = getattr(view, "_batch_srcs", None)
+        if d is None or fh is None or snap is None or srcs is None:
+            return False, None
+        b = len(srcs)
+        names = snap.node_names
+        n = len(names)
+        # the device matrices pad the column (destination) axis to the
+        # compiled shape; only the first n columns name real nodes.
+        # Without LFA only the ROOT's distance row is ever consumed
+        # (metric_to/is_reachable read d[0]; neighbor rows feed LFA,
+        # which gates reuse off entirely) — comparing just that row
+        # keeps remote churn that reroutes around the root invisible,
+        # as it should be.
+        d = d[0:1, :n]
+        fh = fh[:b, :n]
+        links_sig = _local_links_sig(ls, my_node_name)
+        # the cache value retains the names referent: identity (shared
+        # across snapshot patches on both backends) or content must
+        # match, so an id()-reuse after GC can never alias orderings
+        lc = self._labels_cache
+        if (
+            lc is not None
+            and lc[0] == (id(ls), ls.attributes_version)
+            and (lc[1] is names or list(lc[1]) == list(names))
+        ):
+            labels = lc[2]
+        else:
+            adj_dbs = ls.get_adjacency_databases()
+            labels = np.fromiter(
+                (
+                    adj_dbs[nm].node_label if nm in adj_dbs else -1
+                    for nm in names
+                ),
+                dtype=np.int64,
+                count=n,
+            )
+            self._labels_cache = (
+                (id(ls), ls.attributes_version),
+                names,
+                labels,
+            )
+        ov_arr = getattr(snap, "overloaded", None)
+        if ov_arr is not None:
+            # snapshots rebuild on every topology change (overload
+            # flips included), so their host mask is always current;
+            # copy — the sparse resident graph patches it in place
+            ov = np.array(ov_arr[:n], dtype=bool)
+        else:
+            ov = np.fromiter(
+                (ls.is_node_overloaded(nm) for nm in names),
+                dtype=bool,
+                count=n,
+            )
+        prev = self._sp_reuse.get(my_node_name)
+        dirty: Optional[Set[str]] = None
+        if (
+            prev is not None
+            and prev[4] == links_sig
+            and prev[0].shape == d.shape
+            and prev[1].shape == fh.shape
+            and list(prev[2]) == list(srcs)
+            and (
+                prev[3] is names or list(prev[3]) == list(names)
+            )
+        ):
+            col_changed = (
+                (prev[0] != d).any(axis=0)
+                | (prev[1] != fh).any(axis=0)
+                | (prev[5] != ov)
+                | (prev[6] != labels)
+            )
+            changed_rows = [
+                i
+                for i, nid in enumerate(srcs)
+                if col_changed[int(nid)]
+            ]
+            if changed_rows:
+                # a shifted neighbor column changes the remaining
+                # metric of every destination it first-hops for (old
+                # OR new first-hop sets — a hop can appear/vanish)
+                dep = (
+                    fh[changed_rows].any(axis=0)
+                    | prev[1][changed_rows].any(axis=0)
+                )
+                dirty_mask = col_changed | dep
+            else:
+                dirty_mask = col_changed
+            dirty = {
+                str(names[int(i)])
+                for i in np.flatnonzero(dirty_mask)
+            }
+        # re-insert at the end: eviction below is LRU-by-build, so
+        # ctrl queries for other roots can't evict the hot root's slot
+        self._sp_reuse.pop(my_node_name, None)
+        self._sp_reuse[my_node_name] = (
+            d.copy(),
+            fh.copy(),
+            tuple(int(s) for s in srcs),
+            names,
+            links_sig,
+            ov,
+            labels,
+        )
+        while len(self._sp_reuse) > 8:  # bound ctrl-query growth
+            self._sp_reuse.pop(next(iter(self._sp_reuse)))
+        return True, dirty
+
     # -- route computation ------------------------------------------------
 
     def build_route_db(
@@ -650,36 +842,50 @@ class SpfSolver:
             my_node_name, area_link_states, prefix_state
         )
 
-        # Per-prefix route reuse: when the incremental KSP2 engine
-        # reports exactly which destinations' paths changed, any prefix
-        # advertised only by untouched nodes produces a byte-identical
-        # route — reuse it instead of re-deriving (reference analogue:
-        # the per-prefix incremental rebuild, Decision.cpp:1896-1917).
-        # LFA additionally consumes neighbor-row distances the affected
-        # test does not model, so reuse is gated off with it.
+        # Per-prefix route reuse: any prefix whose advertisers provably
+        # produce a byte-identical route is served from the cache
+        # instead of re-derived (reference analogue: the per-prefix
+        # incremental rebuild, Decision.cpp:1896-1917).
         meta = (
             id(prefix_state),
             prefix_state.version,
             my_node_name,
+            self._static_routes_version,
             tuple(
                 (a, id(ls)) for a, ls in sorted(area_link_states.items())
             ),
         )
+        # two independent change detectors feed the reuse gate:
+        # - the KSP2 engine's affected set (covers its tracked
+        #   destinations' full path state, second paths included)
+        # - the SP dirty test (covers EVERY node's shortest-path route
+        #   inputs column-wise; sound only for non-KSP2 prefixes)
+        # LFA consumes neighbor-row distances the engine's affected
+        # test does not model, so reuse is gated off with it.
+        sp_stored, sp_dirty = (
+            self._sp_dirty_nodes(my_node_name, area_link_states)
+            if not self.compute_lfa_paths
+            else (False, None)
+        )
+        meta_ok = self._route_cache_meta == meta
         reuse = (
             affected
             if (
                 affected is not None
                 and not self.compute_lfa_paths
-                and self._route_cache_meta == meta
+                and meta_ok
             )
             else None
         )
-        populate = affected is not None and not self.compute_lfa_paths
+        reuse_sp = sp_dirty if meta_ok else None
+        populate = (
+            affected is not None or sp_stored
+        ) and not self.compute_lfa_paths
         self._route_cache_meta = meta if populate else None
         new_cache: Dict[IpPrefix, tuple] = {}
 
         adv_map = None
-        if reuse is not None:
+        if reuse is not None or reuse_sp is not None:
             # built only when reuse can actually consult it: an
             # LFA-enabled or engine-less solver never reads the map,
             # and building it would re-impose the very per-event cost
@@ -689,33 +895,50 @@ class SpfSolver:
                 self._advertisers_cache is None
                 or self._advertisers_cache[0] != adv_key
             ):
+                ksp2 = PrefixForwardingAlgorithm.KSP2_ED_ECMP
                 self._advertisers_cache = (adv_key, {
-                    p: {
-                        node
-                        for (node, _a) in prefix_state.entries_for(p)
-                    }
-                    for p in prefix_state.prefixes()
+                    p: (
+                        {node for (node, _a) in entries},
+                        any(
+                            e.forwarding_algorithm == ksp2
+                            for e in entries.values()
+                        ),
+                    )
+                    for p, entries in prefix_state.prefixes().items()
                 })
             adv_map = self._advertisers_cache[1]
 
         for prefix in prefix_state.prefixes():
-            if reuse is not None and prefix in self._route_cache:
-                advertisers = adv_map[prefix]
-                # the engine's affected set only covers the KSP2
-                # destinations it tracks — an advertiser outside that
-                # set (e.g. an SP_ECMP-only node) can change without
-                # ever appearing in `reuse`, so its prefixes must be
-                # re-derived every build
-                if advertisers <= self._ksp2_tracked and advertisers.isdisjoint(
-                    reuse
+            if adv_map is not None and prefix in self._route_cache:
+                advertisers, has_ksp2 = adv_map[prefix]
+                # a cached route is reusable when every input that
+                # could change it is provably unchanged:
+                # - non-KSP2 prefix + every advertiser clean under the
+                #   SP dirty test (column-wise vs the previous build)
+                # - OR every advertiser is tracked by the KSP2 engine
+                #   and outside its affected set. An advertiser covered
+                #   by neither detector forces a re-derive.
+                ok = (
+                    not has_ksp2
+                    and reuse_sp is not None
+                    and advertisers.isdisjoint(reuse_sp)
+                )
+                if ok:
+                    SPF_COUNTERS["decision.sp_route_reuses"] += 1
+                elif (
+                    reuse is not None
+                    and advertisers <= self._ksp2_tracked
+                    and advertisers.isdisjoint(reuse)
                 ):
+                    ok = True
+                    SPF_COUNTERS["decision.ksp2_route_reuses"] += 1
+                if ok:
                     entry, best = self._route_cache[prefix]
                     if best is not None:
                         self.best_routes_cache[prefix] = best
                     if entry is not None:
                         route_db.add_unicast_route(entry)
                     new_cache[prefix] = (entry, best)
-                    SPF_COUNTERS["decision.ksp2_route_reuses"] += 1
                     continue
             entry = self.create_route_for_prefix(
                 my_node_name, area_link_states, prefix_state, prefix
@@ -794,16 +1017,7 @@ class SpfSolver:
             fh = getattr(view, "_fh_batch", None)
             if d is not None and fh is not None and view._snap is not None:
                 names = list(view._snap.node_names)
-                links_sig = tuple(
-                    (
-                        link.iface_from(my_node_name),
-                        link.metric_from(my_node_name),
-                        link.other_node(my_node_name),
-                        link.is_up(),
-                        link.nh_v6_from(my_node_name).addr,
-                    )
-                    for link in sorted(ls.links_from_node(my_node_name))
-                )
+                links_sig = _local_links_sig(ls, my_node_name)
                 cache_probe = (d.copy(), fh.copy(), names, links_sig)
                 prev = self._label_cache.get(my_node_name)
                 if (

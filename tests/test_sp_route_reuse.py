@@ -1,0 +1,273 @@
+"""SP_ECMP per-prefix route reuse: byte-exact parity with the host
+solver under every churn class the column-wise dirty test models.
+
+The device solver caches per-prefix routes across builds and reuses a
+cached route only when the SP dirty test (spf_solver._sp_dirty_nodes)
+proves every advertiser's route inputs unchanged: distance + first-hop
+columns, first-hop neighbors' own columns, overload bits, node labels,
+and the local link signature. These tests drive the SAME mutation
+stream through a device solver (reuse on) and a fresh host solver and
+require identical RouteDatabases every step — an unsound dirty test (a
+changed input not modeled) shows up as a parity break.
+Reference semantics: Decision.cpp:1896-1917 (per-prefix incremental
+rebuild), Decision.cpp:847/:1124/:1211 (SP route derivation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SPF_COUNTERS, SpfSolver
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types.lsdb import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def _sp_network(kind: str, n: int,
+                ftype=PrefixForwardingType.SR_MPLS):
+    kwargs = dict(
+        forwarding_algorithm=PrefixForwardingAlgorithm.SP_ECMP,
+        forwarding_type=ftype,
+    )
+    topo = (
+        topologies.grid(n, **kwargs)
+        if kind == "grid"
+        else topologies.fat_tree_nodes(n, **kwargs)
+    )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    return topo, {topo.area: ls}, ps
+
+
+def _mutate_metric(ls, node, i, metric):
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+
+
+def _drop_adj(ls, node, i):
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    dropped = adjs.pop(i)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return dropped
+
+
+def _restore_adj(ls, node, adj):
+    db = ls.get_adjacency_databases()[node]
+    ls.update_adjacency_database(
+        replace(db, adjacencies=tuple(list(db.adjacencies) + [adj]))
+    )
+
+
+def _set_overload(ls, node, overloaded):
+    db = ls.get_adjacency_databases()[node]
+    ls.update_adjacency_database(
+        replace(db, is_overloaded=overloaded)
+    )
+
+
+def _set_node_label(ls, node, label):
+    db = ls.get_adjacency_databases()[node]
+    ls.update_adjacency_database(replace(db, node_label=label))
+
+
+class _Worlds:
+    """Device solver (reuse on) + host oracle over twin LinkStates."""
+
+    def __init__(self, kind: str, n: int,
+                 ftype=PrefixForwardingType.SR_MPLS):
+        topo, self.area_d, self.ps = _sp_network(kind, n, ftype)
+        _t, self.area_h, self.ps_h = _sp_network(kind, n, ftype)
+        (self.ls_d,) = self.area_d.values()
+        (self.ls_h,) = self.area_h.values()
+        names = sorted(topo.adj_dbs)
+        # fabrics: root at a leaf (RSW) so remote-churn tests mutate
+        # nodes that are genuinely remote from the root
+        self.root = next(
+            (k for k in names if k.startswith("rsw")), names[0]
+        )
+        self.topo = topo
+        self.dev = SpfSolver(self.root, backend="device")
+        self.host = SpfSolver(self.root, backend="host")
+
+    def step(self, mutate=None):
+        if mutate is not None:
+            mutate(self.ls_d)
+            mutate(self.ls_h)
+        d = self.dev.build_route_db(self.root, self.area_d, self.ps)
+        h = self.host.build_route_db(
+            self.root, self.area_h, self.ps_h
+        )
+        assert d.to_route_db(self.root) == h.to_route_db(self.root)
+
+    def reuses(self, mutate=None):
+        before = SPF_COUNTERS["decision.sp_route_reuses"]
+        self.step(mutate)
+        return SPF_COUNTERS["decision.sp_route_reuses"] - before
+
+
+class TestSpRouteReuse:
+    def test_noop_rebuild_reuses_everything(self):
+        w = _Worlds("fabric", 120)
+        w.step()
+        w.step()  # second build stores + populates
+        assert w.reuses() > 100  # steady state: nearly every prefix
+
+    def test_remote_metric_churn_parity(self):
+        w = _Worlds("fabric", 120)
+        fsw = next(
+            k for k in sorted(w.topo.adj_dbs) if k.startswith("fsw")
+        )
+        w.step()
+        w.step()
+        total = 0
+        for step in range(6):
+            total += w.reuses(
+                lambda ls: _mutate_metric(ls, fsw, 0, 2 + step % 5)
+            )
+        # remote churn must not disable reuse for untouched advertisers
+        assert total > 0
+
+    def test_overload_flip_not_reused_stale(self):
+        """Draining an advertiser changes its routes via
+        maybeFilterDrainedNodes even when distances are unchanged —
+        the ov vector must catch it (Decision.cpp:783)."""
+        w = _Worlds("fabric", 120)
+        rsws = [
+            k for k in sorted(w.topo.adj_dbs) if k.startswith("rsw")
+        ]
+        target = rsws[-1]
+        w.step()
+        w.step()
+        w.step(lambda ls: _set_overload(ls, target, True))
+        w.step(lambda ls: _set_overload(ls, target, False))
+
+    def test_node_label_change_not_reused_stale(self):
+        """An SR PUSH route embeds the advertiser's node label; a label
+        change with unchanged distances must invalidate it."""
+        w = _Worlds("fabric", 120)
+        rsws = [
+            k for k in sorted(w.topo.adj_dbs) if k.startswith("rsw")
+        ]
+        target = rsws[-1]
+        w.step()
+        w.step()
+        w.step(lambda ls: _set_node_label(ls, target, 60123))
+        w.step(lambda ls: _set_node_label(ls, target, 60124))
+
+    def test_local_link_churn_parity(self):
+        """Local link metric changes alter every next hop's
+        materialized weight — the links signature must invalidate."""
+        w = _Worlds("fabric", 120)
+        w.step()
+        w.step()
+        for m in (3, 4, 1):
+            w.step(
+                lambda ls, m=m: _mutate_metric(ls, w.root, 0, m)
+            )
+
+    def test_link_down_up_parity(self):
+        w = _Worlds("fabric", 120)
+        fsw = next(
+            k for k in sorted(w.topo.adj_dbs) if k.startswith("fsw")
+        )
+        w.step()
+        w.step()
+        slot = {}
+
+        def down(ls):
+            slot[id(ls)] = _drop_adj(ls, fsw, 0)
+
+        def up(ls):
+            _restore_adj(ls, fsw, slot[id(ls)])
+
+        w.step(down)
+        w.step(up)
+
+    def test_prefix_version_change_invalidates(self):
+        """A prefix DB update bumps the version meta: the whole cache
+        is rebuilt (no stale routes for changed entries)."""
+        w = _Worlds("grid", 5)
+        w.step()
+        w.step()
+        node = sorted(w.topo.prefix_dbs)[-1]
+        pdb = w.topo.prefix_dbs[node]
+        new_pdb = replace(
+            pdb,
+            prefix_entries=tuple(
+                replace(e, forwarding_type=PrefixForwardingType.IP)
+                for e in pdb.prefix_entries
+            ),
+        )
+        w.ps.update_prefix_database(new_pdb)
+        w.ps_h.update_prefix_database(new_pdb)
+        w.step()
+
+    def test_ip_forwarding_grid_parity(self):
+        w = _Worlds("grid", 6, ftype=PrefixForwardingType.IP)
+        w.step()
+        w.step()
+        assert w.reuses() > 20
+        for step in range(4):
+            w.step(
+                lambda ls, s=step: _mutate_metric(
+                    ls, "node-21", 0, 2 + s
+                )
+            )
+
+    def test_static_mpls_update_invalidates(self):
+        """_add_best_paths merges static MPLS next hops into
+        self-advertised anycast routes (prepend label); a static-route
+        update with unchanged graph + prefix state must not serve the
+        stale cached route (code-review regression)."""
+        from openr_tpu.types import BinaryAddress
+        from openr_tpu.decision.spf_solver import make_next_hop
+
+        w = _Worlds("grid", 5)
+        # make the root advertise an anycast prefix with a prepend
+        # label in both worlds
+        pdb = w.topo.prefix_dbs[w.root]
+        new_pdb = replace(
+            pdb,
+            prefix_entries=tuple(
+                replace(e, prepend_label=70001)
+                for e in pdb.prefix_entries
+            ),
+        )
+        w.ps.update_prefix_database(new_pdb)
+        w.ps_h.update_prefix_database(new_pdb)
+        w.step()
+        w.step()
+        nh = make_next_hop(
+            BinaryAddress.from_str("fe80::99"), None, 0, None
+        )
+        for solver in (w.dev, w.host):
+            solver.update_static_mpls_routes({70001: [nh]}, [])
+        w.step()
+        for solver in (w.dev, w.host):
+            solver.update_static_mpls_routes({}, [70001])
+        w.step()
+
+    def test_lfa_disables_sp_reuse(self):
+        """LFA-enabled solvers must never take the reuse path (the
+        dirty test is gated off: Decision.cpp:1192 LFA reads rows the
+        per-column contract does not promise to keep stable)."""
+        topo, area_d, ps = _sp_network("grid", 5)
+        root = sorted(topo.adj_dbs)[0]
+        dev = SpfSolver(root, backend="device",
+                        compute_lfa_paths=True)
+        dev.build_route_db(root, area_d, ps)
+        before = SPF_COUNTERS["decision.sp_route_reuses"]
+        dev.build_route_db(root, area_d, ps)
+        dev.build_route_db(root, area_d, ps)
+        assert SPF_COUNTERS["decision.sp_route_reuses"] == before
