@@ -52,6 +52,38 @@ def _pad_to(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _s_plan(s: int):
+    """(s_pad, TS): full-extent block up to the cap, 128-aligned
+    revisit grid past it. Shared by both tile planners."""
+    if s <= _S_CAP:
+        s_pad = _pad_to(s, 8)
+        return s_pad, s_pad
+    s_pad, ts = _pad_to(s, 128), _S_CAP
+    while s_pad % ts:
+        ts //= 2
+    return s_pad, ts
+
+
+def _group_plan(g: int, per_group: int):
+    """(TG, g_pad) under the shared VMEM element budget."""
+    tg = max(1, _TEMP_BUDGET // per_group)
+    tg = min(tg, g)
+    return tg, _pad_to(g, tg)
+
+
+def _accumulate(o_ref, acc, s_idx):
+    """INF-clamp + s-grid revisit discipline shared by both kernels:
+    the output tile is INF-initialized on the first s step and
+    min-accumulated on every revisit."""
+    acc = jnp.minimum(acc, INF).astype(jnp.int32)
+
+    @pl.when(s_idx == 0)
+    def _init():
+        o_ref[...] = jnp.full_like(o_ref[...], INF)
+
+    o_ref[...] = jnp.minimum(o_ref[...], acc)
+
+
 def _pick_tiles(g: int, b_pad: int, s: int, r: int):
     """(TG, g_pad, TB, b_pad, s_pad, TS, r_pad, TR) under Mosaic
     legality and the VMEM temp budget. Incoming b_pad is a multiple of
@@ -64,22 +96,23 @@ def _pick_tiles(g: int, b_pad: int, s: int, r: int):
     else:
         r_pad, tr = _pad_to(r, 128), 128
     # s is chunked by 8 inside the kernel -> 8-mult; block cap _S_CAP
-    if s <= _S_CAP:
-        s_pad = _pad_to(s, 8)
-        ts = s_pad
-    else:
-        s_pad, ts = _pad_to(s, 128), _S_CAP
-        while s_pad % ts:
-            ts //= 2
+    s_pad, ts = _s_plan(s)
     # groups per step: bound TOTAL per-step VMEM, counting the gath
     # (TG,TB,TS) and weight (TG,TS,TR) input blocks and the output
-    # (TG,TB,TR) alongside the (TG,TB,8,TR) broadcast temporary —
-    # bounding the temp alone lets a large-S/small-R segment blow the
-    # ~16 MB budget through its input block
-    per_group = tb * ts + ts * tr + tb * tr + tb * 8 * tr
-    tg = max(1, _TEMP_BUDGET // per_group)
-    tg = min(tg, g)
-    g_pad = _pad_to(g, tg)
+    # (TG,TB,TR) alongside the (TG,TB,8,TR) broadcast temporary.
+    # Count TILED sizes: VMEM lays the last-two dims out in (8, 128)
+    # tiles, so a tiny trailing dim still occupies full lanes — raw
+    # element counts under-estimated a TR=4 segment 32x and blew the
+    # 16 MB scoped-vmem limit on-chip (measured on v5e at 1008).
+    lanes_s = _pad_to(ts, 128)
+    lanes_r = _pad_to(tr, 128)
+    per_group = (
+        tb * lanes_s  # gath block (tb, ts)
+        + _pad_to(ts, 8) * lanes_r  # weight block (ts, tr)
+        + tb * lanes_r  # output block (tb, tr)
+        + tb * 8 * lanes_r  # broadcast temp (tb, 8, tr)
+    )
+    tg, g_pad = _group_plan(g, per_group)
     return tg, g_pad, tb, b_ok, s_pad, ts, r_pad, tr
 
 
@@ -89,21 +122,101 @@ def _kernel(g_ref, w_ref, o_ref):
     w = w_ref[...]  # (TG, TS, TR)
     nchunk = a.shape[2] // 8
 
-    def body(i, acc):
-        ac = jax.lax.dynamic_slice_in_dim(a, i * 8, 8, axis=2)
-        wc = jax.lax.dynamic_slice_in_dim(w, i * 8, 8, axis=1)
+    # static unroll: a fori_loop carrying dynamic_slice over register
+    # values does not lower on Mosaic (measured on v5e: KernelType.TC
+    # "Unimplemented primitive: dynamic_slice"); TS is static and
+    # 8-aligned, so static slices compile — nchunk is at most
+    # _S_CAP // 8 = 64 and 1-2 at the real fat-tree segment shapes
+    acc = jnp.full(o_ref.shape, INF, jnp.int32)
+    for i in range(nchunk):
+        ac = jax.lax.slice_in_dim(a, i * 8, (i + 1) * 8, axis=2)
+        wc = jax.lax.slice_in_dim(w, i * 8, (i + 1) * 8, axis=1)
         cand = jnp.min(ac[:, :, :, None] + wc[:, None, :, :], axis=2)
-        return jnp.minimum(acc, cand)
+        acc = jnp.minimum(acc, cand)
+    _accumulate(o_ref, acc, s_idx)
 
-    acc0 = jnp.full(o_ref.shape, INF, jnp.int32)
-    acc = jax.lax.fori_loop(0, nchunk, body, acc0)
-    acc = jnp.minimum(acc, INF).astype(jnp.int32)
 
-    @pl.when(s_idx == 0)
-    def _init():
-        o_ref[...] = jnp.full_like(o_ref[...], INF)
+def _pick_tiles_t(g: int, b_pad: int, s: int, r: int):
+    """Tile plan for the TRANSPOSED layout (lanes = batch): returns
+    (TG, g_pad, TB, b_pad, s_pad, TS, r_pad, TR). b rides the lane
+    axis (128-tiled), r rides sublanes (8-tiled) — so a small R costs
+    8 sublanes instead of 128 lanes, shrinking the broadcast temp 8x
+    at the real fat-tree segment shapes (R = 4..16)."""
+    tb = 128 if b_pad >= 128 else b_pad
+    b_ok = _pad_to(b_pad, tb)
+    # r rides SUBLANES here: 8-aligned, same cap/revisit shape as s
+    r_pad, tr = _s_plan(r)
+    s_pad, ts = _s_plan(s)
+    lanes_b = _pad_to(tb, 128)
+    per_group = (
+        _pad_to(ts, 8) * lanes_b  # gath block (ts, tb)
+        + _pad_to(ts, 8) * _pad_to(tr, 128)  # weight block (ts, tr)
+        + _pad_to(tr, 8) * lanes_b  # output block (tr, tb)
+        + 8 * _pad_to(tr, 8) * lanes_b  # broadcast temp (8, tr, tb)
+    )
+    tg, g_pad = _group_plan(g, per_group)
+    return tg, g_pad, tb, b_ok, s_pad, ts, r_pad, tr
 
-    o_ref[...] = jnp.minimum(o_ref[...], acc)
+
+def _kernel_t(g_ref, w_ref, o_ref):
+    s_idx = pl.program_id(3)
+    a = g_ref[...]  # (TG, TS, TB)
+    w = w_ref[...]  # (TG, TS, TR)
+    nchunk = a.shape[1] // 8
+
+    acc = jnp.full(o_ref.shape, INF, jnp.int32)  # (TG, TR, TB)
+    for i in range(nchunk):  # static unroll (see _kernel)
+        ac = jax.lax.slice_in_dim(a, i * 8, (i + 1) * 8, axis=1)
+        wc = jax.lax.slice_in_dim(w, i * 8, (i + 1) * 8, axis=1)
+        cand = jnp.min(
+            ac[:, :, None, :] + wc[:, :, :, None], axis=1
+        )  # (TG, TR, TB)
+        acc = jnp.minimum(acc, cand)
+    _accumulate(o_ref, acc, s_idx)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def batched_minplus_t(
+    gath_t: jnp.ndarray, w: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """[G, S, B] (x) [G, S, R] -> [G, R, B] over (min, +): the
+    lane-efficient layout for small R. Padding discipline matches
+    batched_minplus (weights pad INF; padded gath rows compute garbage
+    the caller's slice discards)."""
+    g, s, b = gath_t.shape
+    g2, s2, r = w.shape
+    assert g == g2 and s == s2, (gath_t.shape, w.shape)
+    b_pad = _pad_to(b, 8)
+    tg, g_pad, tb, b_pad, s_pad, ts, r_pad, tr = _pick_tiles_t(
+        g, b_pad, s, r
+    )
+    gath_t = jnp.pad(
+        gath_t, ((0, g_pad - g), (0, s_pad - s), (0, b_pad - b))
+    )
+    w = jnp.pad(
+        w,
+        ((0, g_pad - g), (0, s_pad - s), (0, r_pad - r)),
+        constant_values=INF,
+    )
+    grid = (g_pad // tg, b_pad // tb, r_pad // tr, s_pad // ts)
+    out = pl.pallas_call(
+        _kernel_t,
+        out_shape=jax.ShapeDtypeStruct((g_pad, r_pad, b_pad), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (tg, ts, tb), lambda gg, i, rr, ss: (gg, ss, i)
+            ),
+            pl.BlockSpec(
+                (tg, ts, tr), lambda gg, i, rr, ss: (gg, ss, rr)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tg, tr, tb), lambda gg, i, rr, ss: (gg, rr, i)
+        ),
+        interpret=interpret,
+    )(gath_t, w)
+    return out[:g, :r, :b]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

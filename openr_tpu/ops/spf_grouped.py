@@ -77,7 +77,7 @@ _GROUPED_IMPL = "jnp"
 
 def set_grouped_impl(impl: str) -> None:
     global _GROUPED_IMPL
-    assert impl in ("jnp", "pallas"), impl
+    assert impl in ("jnp", "pallas", "pallas_t"), impl
     _GROUPED_IMPL = impl
 
 
@@ -97,6 +97,14 @@ def _contract(gath, w, impl):
             jnp.transpose(gath, (1, 0, 2)), w, interpret=interpret
         )  # [G, B, R]
         return jnp.transpose(c, (1, 0, 2))
+    if impl == "pallas_t":
+        from openr_tpu.ops import pallas_grouped
+
+        interpret = jax.devices()[0].platform == "cpu"
+        c = pallas_grouped.batched_minplus_t(
+            jnp.transpose(gath, (1, 2, 0)), w, interpret=interpret
+        )  # [G, R, B] — lanes carry the batch, sublanes carry R
+        return jnp.transpose(c, (2, 0, 1))
     return jnp.min(
         jnp.minimum(gath[:, :, :, None] + w[None], INF), axis=2
     )

@@ -154,8 +154,9 @@ class TestGroupedRouteSweep:
                 assert metric == want.metric, (src, dst)
                 assert nhs == set(want.next_hops), (src, dst)
 
-    def test_pallas_impl_matches_jnp(self):
-        """The pallas batched min-plus contraction (interpret mode on
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_t"])
+    def test_pallas_impl_matches_jnp(self, impl):
+        """Both pallas batched min-plus contractions (interpret mode on
         CPU) must reproduce the jnp route product bit-exactly — the
         same choice-by-measurement contract as the dense kernel."""
         from openr_tpu.ops import spf_grouped as sg
@@ -168,7 +169,7 @@ class TestGroupedRouteSweep:
         graph = sg.compile_out_grouped(ls)
         sweeper = sg.GroupedRouteSweeper(graph, [names[0]])
         jnp_result = sweeper.sweep(block=16)
-        sg.set_grouped_impl("pallas")
+        sg.set_grouped_impl(impl)
         try:
             pallas_result = sweeper.sweep(block=16)
         finally:
@@ -183,14 +184,15 @@ class TestGroupedRouteSweep:
             jnp_result.sample_masks, pallas_result.sample_masks
         )
 
-    def test_pallas_forward_matches_oracle(self):
+    @pytest.mark.parametrize("impl", ["pallas", "pallas_t"])
+    def test_pallas_forward_matches_oracle(self, impl):
         from openr_tpu.ops import spf_grouped as sg
 
         topo = topologies.fat_tree(
             pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
         )
         ls = load(topo)
-        sg.set_grouped_impl("pallas")
+        sg.set_grouped_impl(impl)
         try:
             assert_forward_parity(ls)
         finally:

@@ -389,6 +389,15 @@ class TestPallasGroupedTiling:
         (85, 136, 9, 513),   # TG boundary + b_pad re-pad + R just over cap
     ]
 
+    def _want(self, gath, w):
+        return np.minimum(
+            np.min(
+                gath[:, :, :, None].astype(np.int64) + w[:, None, :, :],
+                axis=2,
+            ),
+            int(INF),
+        ).astype(np.int32)
+
     def test_shape_sweep_matches_jnp(self):
         from openr_tpu.ops.pallas_grouped import batched_minplus
 
@@ -403,11 +412,31 @@ class TestPallasGroupedTiling:
                     jnp.asarray(gath), jnp.asarray(w), interpret=True
                 )
             )
-            want = np.minimum(
-                np.min(
-                    gath[:, :, :, None].astype(np.int64) + w[:, None, :, :],
-                    axis=2,
-                ),
-                int(INF),
-            ).astype(np.int32)
-            np.testing.assert_array_equal(got, want, err_msg=str((g, b, s, r)))
+            np.testing.assert_array_equal(
+                got, self._want(gath, w), err_msg=str((g, b, s, r))
+            )
+
+    def test_shape_sweep_matches_jnp_transposed(self):
+        """Same regimes through batched_minplus_t — its _pick_tiles_t
+        branches (sublane-tiled R, s revisit, TG padding) are distinct
+        from batched_minplus's and must be swept independently."""
+        from openr_tpu.ops.pallas_grouped import batched_minplus_t
+
+        rng = np.random.default_rng(11)
+        for g, b, s, r in self.SHAPES:
+            gath = rng.integers(0, 1000, size=(g, b, s)).astype(np.int32)
+            w = rng.integers(0, 1000, size=(g, s, r)).astype(np.int32)
+            gath[rng.random((g, b, s)) < 0.3] = INF
+            w[rng.random((g, s, r)) < 0.3] = INF
+            got_t = np.asarray(
+                batched_minplus_t(
+                    jnp.asarray(np.transpose(gath, (0, 2, 1))),
+                    jnp.asarray(w),
+                    interpret=True,
+                )
+            )  # [G, R, B]
+            np.testing.assert_array_equal(
+                np.transpose(got_t, (0, 2, 1)),
+                self._want(gath, w),
+                err_msg=str((g, b, s, r)),
+            )
