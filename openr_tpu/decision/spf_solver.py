@@ -634,14 +634,17 @@ class SpfSolver:
         # itself the cost it was meant to avoid (~30us x n_prefixes of
         # entries_for + set building per churn event)
         self._advertisers_cache: Optional[tuple] = None
-        # root -> previous build's route-determining signature for the
-        # SP reuse dirty test (_sp_dirty_nodes): batched distance +
-        # first-hop matrices, overload bits, node labels, local-link
-        # signature. Bounded like _label_cache.
-        self._sp_reuse: Dict[str, tuple] = {}
-        # node-label vector cache: labels only move on an attribute
-        # change, so the O(N) rebuild is skipped across metric churn
-        self._labels_cache: Optional[tuple] = None
+        # root -> {area -> previous build's route-determining signature}
+        # for the SP reuse dirty test (_sp_dirty_nodes): batched
+        # distance + first-hop matrices, overload bits, node labels,
+        # local-link signature per area ("absent" + versions for areas
+        # the root is not in). Bounded like _label_cache.
+        self._sp_reuse: Dict[str, Dict[str, tuple]] = {}
+        # node-label vector cache per live graph: labels only move on
+        # an attribute change, so the O(N) rebuild is skipped across
+        # metric churn. Weakly keyed (like _ksp2_engines) so a dead
+        # area's slot can never alias a recycled id.
+        self._labels_cache = weakref.WeakKeyDictionary()
         # bumped on every static-MPLS mutation: _add_best_paths merges
         # static next hops into self-advertised anycast routes, so the
         # reuse meta must change when they do
@@ -707,19 +710,86 @@ class SpfSolver:
         was recorded (detection will be available next build); ``dirty``
         is the set of node names whose routes MAY have changed, or None
         when no comparable previous signature exists (first build,
-        topology re-index, neighbor-set change, non-device backend,
-        multi-area).
+        topology re-index, neighbor-set change, non-device backend).
+
+        Multi-area: cross-area best-path selection takes the min over
+        every area's view (Decision.cpp:1124 loops areas), so a node is
+        clean only if it is clean in EVERY area; per-area signatures are
+        compared independently and the dirty sets unioned. An area the
+        root is absent from contributes a constant "unreachable" to
+        route derivation — it is version-pinned instead of column-
+        compared, so the root appearing there (or any churn inside it)
+        disables reuse for that build.
         """
-        if len(area_link_states) != 1:
-            return False, None
-        ((_area, ls),) = area_link_states.items()
-        view = self._view(_area, ls, my_node_name)
-        d = getattr(view, "_d", None)
-        fh = getattr(view, "_fh_batch", None)
-        snap = getattr(view, "_snap", None)
-        srcs = getattr(view, "_batch_srcs", None)
-        if d is None or fh is None or snap is None or srcs is None:
-            return False, None
+        per_area = []
+        for area in sorted(area_link_states):
+            ls = area_link_states[area]
+            if not ls.has_node(my_node_name):
+                per_area.append((area, ls, None))
+                continue
+            view = self._view(area, ls, my_node_name)
+            d = getattr(view, "_d", None)
+            fh = getattr(view, "_fh_batch", None)
+            snap = getattr(view, "_snap", None)
+            srcs = getattr(view, "_batch_srcs", None)
+            if d is None or fh is None or snap is None or srcs is None:
+                return False, None
+            per_area.append((area, ls, (view, d, fh, snap, srcs)))
+        prev_all = self._sp_reuse.get(my_node_name)
+        if prev_all is not None and set(prev_all) != {
+            a for a, _ls, _v in per_area
+        }:
+            prev_all = None
+        fresh_all: Dict[str, tuple] = {}
+        dirty_all: Optional[Set[str]] = (
+            set() if prev_all is not None else None
+        )
+        for area, ls, viewdata in per_area:
+            if viewdata is None:
+                # root-absent area: pin its whole state
+                sig = (
+                    "absent",
+                    ls.topology_version,
+                    ls.attributes_version,
+                )
+                fresh_all[area] = sig
+                if dirty_all is not None and prev_all[area] != sig:
+                    dirty_all = None
+                continue
+            dirty = self._sp_dirty_one_area(
+                my_node_name,
+                ls,
+                viewdata,
+                None if prev_all is None else prev_all[area],
+                fresh_all,
+                area,
+            )
+            if dirty_all is not None:
+                dirty_all = (
+                    None if dirty is None else dirty_all | dirty
+                )
+        # re-insert at the end: eviction below is LRU-by-build, so
+        # ctrl queries for other roots can't evict the hot root's slot
+        self._sp_reuse.pop(my_node_name, None)
+        self._sp_reuse[my_node_name] = fresh_all
+        while len(self._sp_reuse) > 8:  # bound ctrl-query growth
+            self._sp_reuse.pop(next(iter(self._sp_reuse)))
+        return True, dirty_all
+
+    def _sp_dirty_one_area(
+        self,
+        my_node_name: str,
+        ls: LinkState,
+        viewdata: tuple,
+        prev: Optional[tuple],
+        fresh_all: Dict[str, tuple],
+        area: str,
+    ) -> Optional[Set[str]]:
+        """One area's signature build + comparison for _sp_dirty_nodes;
+        records the fresh signature into ``fresh_all[area]`` and
+        returns the area's dirty set (None = no comparable previous
+        signature)."""
+        _view, d, fh, snap, srcs = viewdata
         b = len(srcs)
         names = snap.node_names
         n = len(names)
@@ -736,10 +806,10 @@ class SpfSolver:
         # the cache value retains the names referent: identity (shared
         # across snapshot patches on both backends) or content must
         # match, so an id()-reuse after GC can never alias orderings
-        lc = self._labels_cache
+        lc = self._labels_cache.get(ls)
         if (
             lc is not None
-            and lc[0] == (id(ls), ls.attributes_version)
+            and lc[0] == ls.attributes_version
             and (lc[1] is names or list(lc[1]) == list(names))
         ):
             labels = lc[2]
@@ -753,8 +823,8 @@ class SpfSolver:
                 dtype=np.int64,
                 count=n,
             )
-            self._labels_cache = (
-                (id(ls), ls.attributes_version),
+            self._labels_cache[ls] = (
+                ls.attributes_version,
                 names,
                 labels,
             )
@@ -770,10 +840,10 @@ class SpfSolver:
                 dtype=bool,
                 count=n,
             )
-        prev = self._sp_reuse.get(my_node_name)
         dirty: Optional[Set[str]] = None
         if (
             prev is not None
+            and len(prev) == 7
             and prev[4] == links_sig
             and prev[0].shape == d.shape
             and prev[1].shape == fh.shape
@@ -808,10 +878,7 @@ class SpfSolver:
                 str(names[int(i)])
                 for i in np.flatnonzero(dirty_mask)
             }
-        # re-insert at the end: eviction below is LRU-by-build, so
-        # ctrl queries for other roots can't evict the hot root's slot
-        self._sp_reuse.pop(my_node_name, None)
-        self._sp_reuse[my_node_name] = (
+        fresh_all[area] = (
             d.copy(),
             fh.copy(),
             tuple(int(s) for s in srcs),
@@ -820,9 +887,7 @@ class SpfSolver:
             ov,
             labels,
         )
-        while len(self._sp_reuse) > 8:  # bound ctrl-query growth
-            self._sp_reuse.pop(next(iter(self._sp_reuse)))
-        return True, dirty
+        return dirty
 
     # -- route computation ------------------------------------------------
 

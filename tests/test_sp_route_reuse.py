@@ -258,6 +258,105 @@ class TestSpRouteReuse:
             solver.update_static_mpls_routes({}, [70001])
         w.step()
 
+    def test_multi_area_parity_and_reuse(self):
+        """Two areas with a border root: per-area dirty signatures
+        union, churn in either area invalidates only that area's dirty
+        columns, and untouched prefixes reuse (cross-area min
+        semantics: Decision.cpp:1124 loops areas)."""
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+        def build_world():
+            area_ls = {}
+            ps = PrefixState()
+            for area, kind, n in (
+                ("a", "grid", 4),
+                ("b", "fabric", 120),
+            ):
+                kwargs = dict(
+                    area=area,
+                    forwarding_algorithm=(
+                        PrefixForwardingAlgorithm.SP_ECMP
+                    ),
+                    forwarding_type=PrefixForwardingType.SR_MPLS,
+                )
+                topo = (
+                    topologies.grid(n, **kwargs)
+                    if kind == "grid"
+                    else topologies.fat_tree_nodes(n, **kwargs)
+                )
+                ls = LinkState(area=area)
+                for name in sorted(topo.adj_dbs):
+                    ls.update_adjacency_database(topo.adj_dbs[name])
+                area_ls[area] = ls
+                for pdb in topo.prefix_dbs.values():
+                    ps.update_prefix_database(pdb)
+            rsw = sorted(
+                k
+                for k in area_ls["b"].get_adjacency_databases()
+                if k.startswith("rsw")
+            )[0]
+
+            def border_adj(other, metric=1):
+                return Adjacency(
+                    other_node_name=other,
+                    if_name=f"if_node-0_{other}",
+                    other_if_name=f"if_{other}_node-0",
+                    metric=metric,
+                )
+
+            area_ls["b"].update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name="node-0",
+                    adjacencies=(border_adj(rsw),),
+                    node_label=9000,
+                    area="b",
+                )
+            )
+            bdb = area_ls["b"].get_adjacency_databases()[rsw]
+            area_ls["b"].update_adjacency_database(
+                AdjacencyDatabase(
+                    this_node_name=rsw,
+                    adjacencies=tuple(bdb.adjacencies)
+                    + (border_adj("node-0"),),
+                    node_label=bdb.node_label,
+                    area="b",
+                )
+            )
+            return area_ls, ps
+
+        area_d, ps = build_world()
+        area_h, ps_h = build_world()
+        dev = SpfSolver("node-0", backend="device")
+        host = SpfSolver("node-0", backend="host")
+
+        def check(step):
+            d = dev.build_route_db("node-0", area_d, ps)
+            h = host.build_route_db("node-0", area_h, ps_h)
+            assert d.to_route_db("node-0") == h.to_route_db(
+                "node-0"
+            ), step
+
+        check("cold")
+        check("warm")
+        fsw = sorted(
+            k
+            for k in area_d["b"].get_adjacency_databases()
+            if k.startswith("fsw")
+        )[0]
+        before = SPF_COUNTERS["decision.sp_route_reuses"]
+        for step in range(3):  # churn area b: area-a prefixes reuse
+            for ls in (area_d["b"], area_h["b"]):
+                _mutate_metric(ls, fsw, 0, 2 + step)
+            check(f"b-{step}")
+        for step in range(3):  # churn area a: area-b prefixes reuse
+            for ls in (area_d["a"], area_h["a"]):
+                _mutate_metric(ls, "node-2", 0, 3 + step)
+            check(f"a-{step}")
+        assert (
+            SPF_COUNTERS["decision.sp_route_reuses"] - before > 0
+        )
+
     def test_lfa_disables_sp_reuse(self):
         """LFA-enabled solvers must never take the reuse path (the
         dirty test is gated off: Decision.cpp:1192 LFA reads rows the
