@@ -73,6 +73,13 @@ BENCH_SOFT_BUDGET_S = 1000
 def _run() -> dict:
     child_t0 = time.monotonic()
 
+    # children only: the PARENT never imports jax (the relay-tunneled
+    # plugin can hang at discovery; all jax work runs in probed,
+    # timed-out subprocesses)
+    from openr_tpu.utils.compile_cache import enable as _enable_cache
+
+    _enable_cache()
+
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -24,8 +24,11 @@ import time
 import numpy as np
 
 from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.utils.compile_cache import enable as _enable_compile_cache
 from openr_tpu.models import topologies
 from openr_tpu.ops import spf_sparse
+
+_enable_compile_cache()
 
 
 def _relay_rtt_ms() -> float:

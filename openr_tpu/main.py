@@ -142,6 +142,12 @@ def main(argv=None) -> int:
     log = logging.getLogger("openr_tpu.main")
     log.info("starting openr-tpu node %s", config.node_name)
 
+    # persistent XLA compilation cache: daemon restarts skip straight
+    # past the remote-compile tunnel for every already-seen kernel
+    from openr_tpu.utils.compile_cache import enable as _enable_cache
+
+    _enable_cache()
+
     if config.enable_solver_mesh:
         # process-global: every KSP2 engine this daemon builds shards
         # its resident all-pairs state over the local device mesh

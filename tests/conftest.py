@@ -14,5 +14,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from openr_tpu.testing import pin_host_cpu  # noqa: E402
+from openr_tpu.utils.compile_cache import enable as _enable_compile_cache  # noqa: E402
 
 pin_host_cpu(8)
+_enable_compile_cache()

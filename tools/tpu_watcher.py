@@ -38,7 +38,7 @@ HEARTBEAT_PERIOD_S = 15 * 60
 # triggers a re-capture so the preserved artifact tracks the newest code
 CAPTURE_TTL_S = 45 * 60
 BENCH_TIMEOUT_S = 1500
-SCALE_TIMEOUT_S = 1800
+SCALE_TIMEOUT_S = 2700  # the 100k leg probes three contraction impls
 
 
 def log(msg: str) -> None:
