@@ -174,9 +174,10 @@ def run_churn(args):
 
 
 def ksp2_churn_bench(nodes: int, churn_events: int,
-                     ksp2_dst_count: int = 0) -> dict:
-    """Fabric KSP2_ED_ECMP churn rebuild through the full SpfSolver —
-    the incremental-KSP2-engine path (BASELINE.json config 2 axis;
+                     ksp2_dst_count: int = 0,
+                     sp_only: bool = False) -> dict:
+    """Fabric churn rebuild through the full SpfSolver — the
+    incremental-KSP2-engine path (BASELINE.json config 2 axis;
     reference semantics: Decision.cpp:908 selectBestPathsKsp2).
     Shared by the scale harness and the official bench.py artifact.
 
@@ -185,7 +186,14 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
     realistic large-fabric shape (KSP2 is a per-prefix opt-in) and the
     one that scales the ENGINE to 10k+ nodes: the all-pairs event
     dispatch covers the whole graph while host path tracing stays
-    bounded by the KSP2 destination count."""
+    bounded by the KSP2 destination count.
+
+    ``sp_only=True`` keeps every prefix SP_ECMP — the north-star
+    framing (BASELINE.json: full-SPF reconvergence of one node's
+    RouteDb at 100k): per event the device re-solves the
+    {source}+neighbors view in one fused dispatch and the SP route
+    reuse dirty test bounds the host rebuild to O(changed) prefixes;
+    no all-pairs state exists at all."""
     import statistics
     from dataclasses import replace
 
@@ -201,7 +209,11 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         PrefixForwardingType,
     )
 
-    all_ksp2 = ksp2_dst_count <= 0
+    if sp_only and ksp2_dst_count > 0:
+        raise ValueError(
+            "sp_only excludes ksp2_dst_count: pick one shape"
+        )
+    all_ksp2 = ksp2_dst_count <= 0 and not sp_only
     topo = topologies.fat_tree_nodes(
         nodes,
         forwarding_algorithm=(
@@ -215,7 +227,7 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
     for name in sorted(topo.adj_dbs):
         ls.update_adjacency_database(topo.adj_dbs[name])
     ps = PrefixState()
-    if not all_ksp2:
+    if ksp2_dst_count > 0:
         names = sorted(topo.prefix_dbs)
         stride = max(1, len(names) // ksp2_dst_count)
         chosen = set(names[::stride][:ksp2_dst_count])
@@ -265,8 +277,22 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         solver.build_route_db(rsw, area_ls, ps)
         samples.append((time.perf_counter() - t0) * 1000)
     return {
-        "bench": f"scale.fabric_{ls.num_nodes}_ksp2_churn_rebuild",
-        "ksp2_dsts": ksp2_dst_count if not all_ksp2 else ls.num_nodes,
+        "bench": (
+            f"scale.fabric_{ls.num_nodes}_sp_churn_rebuild"
+            if sp_only
+            else f"scale.fabric_{ls.num_nodes}_ksp2_churn_rebuild"
+        ),
+        "ksp2_dsts": (
+            0
+            if sp_only
+            else ksp2_dst_count if not all_ksp2 else ls.num_nodes
+        ),
+        "sp_route_reuses_per_event": round(
+            (SPF_COUNTERS["decision.sp_route_reuses"]
+             - before["decision.sp_route_reuses"])
+            / max(1, churn_events),
+            1,
+        ),
         "events": churn_events,
         "median_ms": round(statistics.median(samples), 1),
         "p90_ms": round(
@@ -789,6 +815,15 @@ def main(argv=None):
     p.add_argument("--routes", action="store_true",
                    help="all-sources sweep with on-device route "
                         "selection (digest + sample readback only)")
+    p.add_argument("--solver-churn", action="store_true",
+                   help="full SpfSolver churn rebuild of one node's "
+                        "RouteDb (the north-star framing)")
+    p.add_argument("--ksp2-dsts", type=int, default=0,
+                   help="solver-churn: mark this many prefixes "
+                        "KSP2_ED_ECMP (0 = every prefix KSP2)")
+    p.add_argument("--sp-only", action="store_true",
+                   help="solver-churn: keep every prefix SP_ECMP "
+                        "(no KSP2 engine state at all)")
     p.add_argument("--backend", choices=("ell", "grouped"),
                    default="ell",
                    help="route-sweep relaxation backend: per-edge ELL "
@@ -796,6 +831,18 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.churn:
         run_churn(args)
+        return
+    if args.solver_churn:
+        print(
+            json.dumps(
+                ksp2_churn_bench(
+                    args.nodes, args.churn_events,
+                    ksp2_dst_count=args.ksp2_dsts,
+                    sp_only=args.sp_only,
+                )
+            ),
+            flush=True,
+        )
         return
     if args.routes_churn:
         print(

@@ -89,3 +89,17 @@ class TestKsp2ChurnLeg:
         assert out["ksp2_host_fallbacks"] == 0
         assert out["incremental_syncs"] == 3
         assert out["median_ms"] > 0
+
+    def test_sp_only_churn_bench_smoke(self):
+        """The north-star-framing leg (full-SPF reconvergence of one
+        node's RouteDb, every prefix SP_ECMP): no KSP2 engine state at
+        all, host rebuild bounded by the SP route reuse dirty test."""
+        from benchmarks.bench_scale import ksp2_churn_bench
+
+        out = ksp2_churn_bench(120, 3, sp_only=True)
+        assert out["bench"].endswith("_sp_churn_rebuild")
+        assert out["ksp2_dsts"] == 0
+        assert out["events"] == 3
+        assert out["incremental_syncs"] == 0  # no engine in play
+        assert out["sp_route_reuses_per_event"] > 50
+        assert out["median_ms"] > 0

@@ -233,10 +233,9 @@ def capture(round_no: int) -> bool:
         ),
         (
             "ksp2_churn_1008",
-            [sys.executable, "-c",
-             "import json; from benchmarks.bench_scale import "
-             "ksp2_churn_bench; print(json.dumps("
-             "ksp2_churn_bench(1000, 10)))"],
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--solver-churn", "--nodes", "1000",
+             "--churn-events", "10"],
         ),
         (
             "all_sources_10k",
@@ -285,10 +284,9 @@ def capture(round_no: int) -> bool:
             # (VERDICT item 8): 256 KSP2 destinations on the 10k
             # fat-tree, all-pairs event dispatch over the full graph
             "ksp2_churn_10k_engine",
-            [sys.executable, "-c",
-             "import json; from benchmarks.bench_scale import "
-             "ksp2_churn_bench; print(json.dumps("
-             "ksp2_churn_bench(10000, 5, ksp2_dst_count=256)))"],
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--solver-churn", "--nodes", "10000",
+             "--churn-events", "5", "--ksp2-dsts", "256"],
         ),
         (
             # the 100k north-star axis: FULL 98-block sweep with
@@ -297,6 +295,16 @@ def capture(round_no: int) -> bool:
             "route_sweep_100k_grouped",
             [sys.executable, "-m", "benchmarks.bench_scale",
              "--routes", "--nodes", "100000", "--backend", "grouped"],
+        ),
+        (
+            # the north star AS DEFINED (BASELINE.json: full-SPF
+            # reconvergence of one node's RouteDb at 100k): full
+            # SpfSolver churn rebuild, all prefixes SP_ECMP, one fused
+            # view dispatch + SP-route-reuse-bounded host rebuild
+            "solver_churn_100k_sp",
+            [sys.executable, "-m", "benchmarks.bench_scale",
+             "--solver-churn", "--nodes", "100000",
+             "--churn-events", "5", "--sp-only"],
         ),
     ]
     # stalest-first: legs never captured on-chip (epoch 0) run before
