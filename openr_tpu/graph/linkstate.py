@@ -352,6 +352,8 @@ class LinkState:
         self._node_overloads: Dict[str, HoldableValue] = {}
         self._adj_dbs: Dict[str, AdjacencyDatabase] = {}
         self._spf_cache: Dict[Tuple[str, bool], SpfResult] = {}
+        # per-node canonical link order, valid for one topology version
+        self._ordered_links_memo: Dict[str, List[Link]] = {}
         self._kth_path_cache: Dict[Tuple[str, str, int], List[Path]] = {}
         # monotonically bumped on every topology change; the device snapshot
         # layer keys HBM-resident arrays off this (replaces the reference's
@@ -393,7 +395,16 @@ class LinkState:
         return self._link_map.get(node, set())
 
     def ordered_links_from_node(self, node: str) -> List[Link]:
-        return sorted(self._link_map.get(node, set()))
+        """Node's links in canonical order. Memoized per topology
+        version (link IDENTITY is immutable, so attribute churn never
+        reorders; membership changes invalidate via _invalidate) — the
+        churn hot path sorts the same high-degree node repeatedly
+        within one rebuild. Callers must not mutate the list."""
+        cached = self._ordered_links_memo.get(node)
+        if cached is None:
+            cached = sorted(self._link_map.get(node, set()))
+            self._ordered_links_memo[node] = cached
+        return cached
 
     def all_links(self) -> Set[Link]:
         return self._all_links
@@ -415,6 +426,7 @@ class LinkState:
     def _invalidate(self, affected: Optional[Set[str]] = None) -> None:
         self._spf_cache.clear()
         self._kth_path_cache.clear()
+        self._ordered_links_memo.clear()
         self.topology_version += 1
         self.change_journal.append(
             (self.topology_version, frozenset(affected or ()))
@@ -482,18 +494,30 @@ class LinkState:
         self._link_map.setdefault(link.n1, set()).add(link)
         self._link_map.setdefault(link.n2, set()).add(link)
         self._all_links.add(link)
+        # membership can change WITHOUT _invalidate (a held-down add or
+        # a removal of a down link leaves topology_changed False): the
+        # order memo must drop the endpoints here, not only on
+        # invalidation (code-review repro: a held A-C add followed by a
+        # metric update misread the stale memo as 'new link' and lost
+        # the update)
+        self._ordered_links_memo.pop(link.n1, None)
+        self._ordered_links_memo.pop(link.n2, None)
 
     def _remove_link(self, link: Link) -> None:
         self._link_map[link.n1].discard(link)
         self._link_map[link.n2].discard(link)
         self._all_links.discard(link)
+        self._ordered_links_memo.pop(link.n1, None)
+        self._ordered_links_memo.pop(link.n2, None)
 
     def _remove_node(self, node: str) -> None:
         for link in list(self._link_map.get(node, ())):
             other = link.other_node(node)
             self._link_map[other].discard(link)
             self._all_links.discard(link)
+            self._ordered_links_memo.pop(other, None)
         self._link_map.pop(node, None)
+        self._ordered_links_memo.pop(node, None)
         self._node_overloads.pop(node, None)
 
     def _update_node_overloaded(

@@ -267,14 +267,12 @@ class EllGraph:
     # "out": row j holds edges OUT of j (the reversed-graph layout the
     # destination-major route sweep relaxes over)
     direction: str = "in"
-    # per-link slot index for "in" graphs: (node id, link key) ->
-    # (band idx, band-local row, slot). What makes a single parallel
-    # link excludable in the masked KSP2 kernel.
-    slot_of: Optional[Dict[Tuple[int, Tuple], Tuple[int, int, int]]] = None
-    # node id -> its slot link-keys (the reverse index ell_patch uses
-    # to retire a node's old slot_of entries without scanning the
-    # whole O(E) dict on the churn hot path)
-    node_slot_keys: Optional[Dict[int, Tuple]] = None
+    # per-link slot index for "in" graphs, two-level: node id ->
+    # {link key -> (band idx, band-local row, slot)}. What makes a
+    # single parallel link excludable in the masked KSP2 kernel. The
+    # nesting keeps ell_patch's copy O(N) shallow (replace affected
+    # nodes' inner dicts) instead of O(E) deep per churn event.
+    slot_of: Optional[Dict[int, Dict[Tuple, Tuple[int, int, int]]]] = None
 
 
 def _in_edges(ls, name, index) -> Dict[int, int]:
@@ -291,6 +289,9 @@ def _in_edges(ls, name, index) -> Dict[int, int]:
         if i not in best or m < best[i]:
             best[i] = m
     return best
+
+
+_EMPTY_SLOTS: dict = {}
 
 
 def link_key(link) -> Tuple:
@@ -398,8 +399,7 @@ def compile_ell(ls, align: int = _NODE_PAD,
     bands: List[EllBand] = []
     srcs: List[np.ndarray] = []
     ws: List[np.ndarray] = []
-    slot_of: Dict[Tuple[int, Tuple], Tuple[int, int, int]] = {}
-    node_slot_keys: Dict[int, Tuple] = {}
+    slot_of: Dict[int, Dict[Tuple, Tuple[int, int, int]]] = {}
     overloaded = np.zeros(n_pad, dtype=bool)
     i = 0
     while i < n:
@@ -415,15 +415,14 @@ def compile_ell(ls, align: int = _NODE_PAD,
         for r, name in enumerate(names[i:j]):
             if per_link:
                 nid = index[name]
-                keys = []
+                nd: Dict[Tuple, Tuple[int, int, int]] = {}
                 for slot, (sid, m, key) in enumerate(
                     _in_edge_slots(ls, name, index)
                 ):
                     src_b[r, slot] = sid
                     w_b[r, slot] = m
-                    slot_of[(nid, key)] = (len(bands), r, slot)
-                    keys.append(key)
-                node_slot_keys[nid] = tuple(keys)
+                    nd[key] = (len(bands), r, slot)
+                slot_of[nid] = nd
             else:
                 _fill_row(src_b[r], w_b[r], edges_of(ls, name, index))
         bands.append(EllBand(start=i, rows=rows, k=k))
@@ -437,7 +436,6 @@ def compile_ell(ls, align: int = _NODE_PAD,
         bands=tuple(bands), src=tuple(srcs), w=tuple(ws),
         overloaded=overloaded, direction=direction,
         slot_of=slot_of if per_link else None,
-        node_slot_keys=node_slot_keys if per_link else None,
     )
 
 
@@ -458,10 +456,11 @@ def ell_patch(
     holding resident band tensors must re-upload those bands wholesale
     (a row-scatter into the old shape cannot represent them) and
     expects a one-time jit recompile (band shapes are static args)."""
-    names = tuple(sorted(ls.get_adjacency_databases().keys()))
-    if len(names) != graph.n or any(
-        nm not in graph.node_index for nm in names
-    ):
+    # node-set validation without sorting 100k names per event: a
+    # removal alone changes the count; an add (or rename = remove+add)
+    # puts the new name in ``affected``, where the per-name
+    # node_index lookup below rejects it
+    if len(ls.get_adjacency_databases()) != graph.n:
         return None
     per_link = graph.slot_of is not None
     edges_of = _in_edges if graph.direction == "in" else _out_edges
@@ -470,7 +469,6 @@ def ell_patch(
     bands = list(graph.bands)
     overloaded = graph.overloaded.copy()
     slot_of = dict(graph.slot_of) if per_link else None
-    node_slot_keys = dict(graph.node_slot_keys) if per_link else None
     changed: Dict[int, List[int]] = {}
     widened: set = set()
     copied: set = set()
@@ -518,18 +516,14 @@ def ell_patch(
         src[bi][r] = np.full(band.k, i, dtype=np.int32)
         w[bi][r] = INF
         if per_link:
-            # retire this node's old slot entries via the reverse
-            # index (NOT a scan of the whole O(E) slot_of dict — this
-            # runs per affected node on the churn hot path)
-            for key in node_slot_keys.get(i, ()):
-                slot_of.pop((i, key), None)
-            keys = []
+            # replace this node's inner slot dict wholesale (the outer
+            # copy above was shallow, so the old graph keeps its own)
+            nd: Dict[Tuple, Tuple[int, int, int]] = {}
             for slot, (sid, m, key) in enumerate(slots):
                 src[bi][r, slot] = sid
                 w[bi][r, slot] = m
-                slot_of[(i, key)] = (bi, r, slot)
-                keys.append(key)
-            node_slot_keys[i] = tuple(keys)
+                nd[key] = (bi, r, slot)
+            slot_of[i] = nd
         else:
             _fill_row(src[bi][r], w[bi][r], edges)
         overloaded[i] = ls.is_node_overloaded(name)
@@ -542,7 +536,6 @@ def ell_patch(
                  for bi, rs in changed.items()},
         direction=graph.direction,
         slot_of=slot_of,
-        node_slot_keys=node_slot_keys,
         widened=frozenset(widened) if widened else None,
     )
 
@@ -886,7 +879,7 @@ def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
                     ok[x] = False
                     break
                 if per_link:
-                    hit = graph.slot_of.get((hid, key))
+                    hit = graph.slot_of.get(hid, _EMPTY_SLOTS).get(key)
                     if hit is None:
                         # link not in the ELL (e.g. went down after
                         # compile): nothing to mask

@@ -244,6 +244,30 @@ class TestHolds:
         assert ls.decrement_holds().topology_changed  # hold expired
         assert ls.get_metric_from_a_to_b("a", "b") == 1
 
+    def test_metric_update_during_link_up_hold(self):
+        """A link added under a hold mutates membership WITHOUT an
+        invalidation; the ordered-links memo must still see it, or the
+        next merge misreads the link as brand new and silently drops
+        the metric update (code-review regression)."""
+        ls = LinkState()
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=10)]),
+            hold_up_ttl=3,
+        )
+        # warm the memo for "a" BEFORE the held link lands
+        assert ls.ordered_links_from_node("a") == []
+        ls.update_adjacency_database(
+            db("b", [adj("a", "if_ba", "if_ab")]), hold_up_ttl=3
+        )
+        # metric update while the link is still held down
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=99)]),
+            hold_up_ttl=3,
+        )
+        for _ in range(4):
+            ls.decrement_holds()
+        assert ls.get_metric_from_a_to_b("a", "b") == 99
+
     def test_metric_hold_down(self):
         ls = LinkState()
         ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba", metric=5)]))
