@@ -302,12 +302,25 @@ def link_key(link) -> Tuple:
     return link.ordered_names
 
 
+_IN_SLOTS_MEMO: Dict[tuple, list] = {}
+
+
 def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
     """PER-LINK in-edge slots of ``name``: [(origin id, metric, link
     key)], sorted (origin id, key). Unlike _in_edges, parallel links
     keep their own slots — the KSP2 edge-disjoint masks must be able
     to exclude ONE member of a LAG without killing its siblings
-    (reference: LinkState.cpp:763 getKthPaths' linksToIgnore)."""
+    (reference: LinkState.cpp:763 getKthPaths' linksToIgnore).
+
+    Memoized per (graph identity, topology version, node): every input
+    below (membership, liveness, metrics incl. holds) bumps the
+    topology version when it changes, and churn-path callers re-derive
+    the same high-degree node several times per event (padded patch
+    rows repeat names). Callers must not mutate the list."""
+    memo_key = (id(ls), ls.topology_version, name, id(index))
+    cached = _IN_SLOTS_MEMO.get(memo_key)
+    if cached is not None:
+        return cached
     slots: List[Tuple[int, int, Tuple]] = []
     for link in ls.ordered_links_from_node(name):
         if not link.is_up():
@@ -319,6 +332,9 @@ def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
         m = min(int(link.metric_from(other)), int(INF) - 1)
         slots.append((i, m, link_key(link)))
     slots.sort(key=lambda t: (t[0], t[2]))
+    while len(_IN_SLOTS_MEMO) > 256:
+        _IN_SLOTS_MEMO.pop(next(iter(_IN_SLOTS_MEMO)))
+    _IN_SLOTS_MEMO[memo_key] = slots
     return slots
 
 
