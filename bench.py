@@ -61,13 +61,14 @@ PROBE_TIMEOUTS_S = (60, 90, 120, 120)
 PROBE_BUDGET_S = 320  # stop probing once this much wall time is spent
 RETRY_PROBE_TIMEOUT_S = 120
 TPU_CHILD_TIMEOUT_S = 270
-# headline + 10k churn + ksp2 + route sweep + route-engine churn legs
-TPU_CHILD_10K_TIMEOUT_S = 800
+# headline + 10k churn + ksp2 + route sweep + route-engine churn +
+# sp-solver churn legs
+TPU_CHILD_10K_TIMEOUT_S = 1000
 CPU_CHILD_TIMEOUT_S = 150
-CPU_CHILD_10K_TIMEOUT_S = 680
+CPU_CHILD_10K_TIMEOUT_S = 900
 # soft wall-clock budget: optional legs (TPU retry, 10k CPU leg) are
 # skipped once exceeded so a worst-case run still emits JSON promptly
-BENCH_SOFT_BUDGET_S = 1000
+BENCH_SOFT_BUDGET_S = 1200
 
 
 def _run() -> dict:
@@ -273,6 +274,19 @@ def _run() -> dict:
     def leg_elapsed() -> float:
         return time.monotonic() - child_t0
 
+    def annotate_ratios(leg: dict) -> dict:
+        """Shared vs_baseline / vs_northstar / scale-note annotation
+        for per-leg dicts (the north-star note keeps a CPU-fallback
+        artifact from reading as 'north star met' at the wrong scale)."""
+        v = max(leg["median_ms"], 1e-9)
+        leg["vs_baseline"] = round(BASELINE_MS / v, 3)
+        leg["vs_northstar"] = round(NORTHSTAR_MS / v, 3)
+        leg["northstar_scale_note"] = (
+            "north-star target is 100k nodes / v4-32 mesh; this leg "
+            f"is 10k nodes on one {leg.get('platform', '?')} device"
+        )
+        return leg
+
     # second leg: 10k-node resident-ELL churn (the north-star scale
     # axis, BASELINE.json config 4) folded into the same artifact
     bench_10k = None
@@ -285,19 +299,7 @@ def _run() -> dict:
             try:
                 from benchmarks.bench_scale import churn_bench
 
-                bench_10k = churn_bench(10000, 10)
-                v10k = max(bench_10k["median_ms"], 1e-9)
-                bench_10k["vs_baseline"] = round(BASELINE_MS / v10k, 3)
-                bench_10k["vs_northstar"] = round(NORTHSTAR_MS / v10k, 3)
-                # the north star is <10ms at 100k NODES on a v4-32
-                # MESH (BASELINE.json); this leg is 10k on one device.
-                # The explicit scale note keeps a CPU-fallback artifact
-                # from reading as "north star met" at the wrong scale.
-                bench_10k["northstar_scale_note"] = (
-                    "north-star target is 100k nodes / v4-32 mesh; "
-                    "this leg is 10k nodes on one "
-                    f"{bench_10k.get('platform', '?')} device"
-                )
+                bench_10k = annotate_ratios(churn_bench(10000, 10))
             except Exception as e:
                 bench_10k = {"error": f"{type(e).__name__}: {e}"}
 
@@ -362,6 +364,28 @@ def _run() -> dict:
             except Exception as e:
                 bench_rchurn = {"error": f"{type(e).__name__}: {e}"}
 
+    # sixth leg: full-SPF RouteDb reconvergence at 10k with every
+    # prefix SP_ECMP — the north star AS DEFINED (BASELINE.json: one
+    # node's RouteDatabase, full solver) at the largest scale that
+    # fits the child budget; SP route reuse bounds the host rebuild
+    # to O(changed) prefixes (the 100k variant is the watcher's
+    # solver_churn_100k_sp leg)
+    bench_spsolver = None
+    if os.environ.get("OPENR_BENCH_ROUTES") == "1":
+        if leg_elapsed() > 540:
+            bench_spsolver = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import ksp2_churn_bench
+
+                bench_spsolver = annotate_ratios(
+                    ksp2_churn_bench(10000, 6, sp_only=True)
+                )
+            except Exception as e:
+                bench_spsolver = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -407,6 +431,7 @@ def _run() -> dict:
         "bench_ksp2_churn": bench_ksp2,
         "bench_route_sweep": bench_routes,
         "bench_route_engine_churn": bench_rchurn,
+        "bench_sp_solver_churn": bench_spsolver,
         "error": None,
     }
 
