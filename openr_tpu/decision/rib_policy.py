@@ -12,7 +12,7 @@ schedules a rebuild at expiry so effects revert.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Dict, List, Optional, Set, Tuple
 
 from openr_tpu.decision.rib import RibUnicastEntry
@@ -51,11 +51,17 @@ class RibPolicyStatement:
     def match(self, route: RibUnicastEntry) -> bool:
         return route.prefix in self._prefix_set
 
-    def apply_action(self, route: RibUnicastEntry) -> bool:
-        """Set next-hop weights; drop zero-weight next-hops.
+    def apply_action(
+        self, route: RibUnicastEntry
+    ) -> Optional[RibUnicastEntry]:
+        """Set next-hop weights; drop zero-weight next-hops. Returns a
+        TRANSFORMED COPY (None = no match): the input entry is shared
+        with the solver's route-reuse caches, and mutating it in place
+        would make the policy effect permanent — an expired policy
+        could never restore the dropped next-hops of a reused route.
         reference: RibPolicyStatement::applyAction."""
         if not self.match(route) or self.action.set_weight is None:
-            return False
+            return None
         weights = self.action.set_weight
         new_nexthops: Set[NextHop] = set()
         for nh in route.nexthops:
@@ -79,8 +85,7 @@ class RibPolicyStatement:
                     neighbor_node_name=nh.neighbor_node_name,
                 )
             )
-        route.nexthops = new_nexthops
-        return True
+        return replace(route, nexthops=new_nexthops)
 
 
 @dataclass
@@ -106,12 +111,14 @@ class RibPolicy:
     def match(self, route: RibUnicastEntry) -> bool:
         return any(s.match(route) for s in self.statements)
 
-    def apply_action(self, route: RibUnicastEntry) -> bool:
+    def apply_action(
+        self, route: RibUnicastEntry
+    ) -> Optional[RibUnicastEntry]:
         # first successful match/action terminates processing
         for statement in self.statements:
             if statement.match(route):
                 return statement.apply_action(route)
-        return False
+        return None
 
     def apply_policy(
         self, unicast_routes: Dict[IpPrefix, RibUnicastEntry]
@@ -122,11 +129,13 @@ class RibPolicy:
         if not self.is_active():
             return change
         for prefix, route in list(unicast_routes.items()):
-            if not self.apply_action(route):
+            new_route = self.apply_action(route)
+            if new_route is None:
                 continue
-            if not route.nexthops:
+            if not new_route.nexthops:
                 del unicast_routes[prefix]
                 change.deleted_routes.append(prefix)
             else:
+                unicast_routes[prefix] = new_route
                 change.updated_routes.append(prefix)
         return change

@@ -357,6 +357,51 @@ class TestSpRouteReuse:
             SPF_COUNTERS["decision.sp_route_reuses"] - before > 0
         )
 
+    def test_rib_policy_does_not_pollute_reuse_cache(self):
+        """Decision applies RibPolicy to the dict build_route_db
+        returned; the entries are shared with the solver's reuse
+        caches, so policy application must be NON-mutating — an
+        in-place transform would survive policy expiry on every reused
+        route (code-review regression)."""
+        from openr_tpu.decision.rib_policy import (
+            RibPolicy,
+            RibPolicyStatement,
+            RibRouteAction,
+            RibRouteActionWeight,
+        )
+
+        w = _Worlds("grid", 5)
+        db1 = w.dev.build_route_db(w.root, w.area_d, w.ps)
+        db2 = w.dev.build_route_db(w.root, w.area_d, w.ps)
+        prefix = next(iter(db2.unicast_routes))
+        before = {
+            nh.weight for nh in db2.unicast_routes[prefix].nexthops
+        }
+        policy = RibPolicy(
+            [
+                RibPolicyStatement(
+                    name="w9",
+                    prefixes=(prefix,),
+                    action=RibRouteAction(
+                        set_weight=RibRouteActionWeight(
+                            default_weight=9
+                        )
+                    ),
+                )
+            ],
+            ttl_secs=300,
+        )
+        policy.apply_policy(db2.unicast_routes)
+        assert {
+            nh.weight for nh in db2.unicast_routes[prefix].nexthops
+        } == {9}
+        # steady-state rebuild: the reused route must be the RAW one
+        db3 = w.dev.build_route_db(w.root, w.area_d, w.ps)
+        assert {
+            nh.weight for nh in db3.unicast_routes[prefix].nexthops
+        } == before
+        assert db3.unicast_routes == db1.unicast_routes
+
     def test_lfa_disables_sp_reuse(self):
         """LFA-enabled solvers must never take the reuse path (the
         dirty test is gated off: Decision.cpp:1192 LFA reads rows the
