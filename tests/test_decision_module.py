@@ -238,6 +238,82 @@ class TestDecisionPipeline:
         assert runs_after - runs_before < 10  # debounced into fewer rebuilds
 
 
+class TestDecisionSpReuse:
+    def test_sp_reuse_active_through_daemon_path(self):
+        """SP_ECMP per-prefix route reuse operates through the Decision
+        module's publication-driven full rebuilds: remote churn events
+        arriving as KvStore publications serve untouched prefixes from
+        the cache (spf_solver._sp_dirty_nodes), with the accumulated
+        route DB staying byte-identical to a fresh host solver."""
+        from dataclasses import replace
+
+        from openr_tpu.decision.prefix_state import PrefixState
+        from openr_tpu.decision.spf_solver import (
+            SPF_COUNTERS,
+            SpfSolver,
+        )
+        from openr_tpu.graph.linkstate import LinkState
+        from openr_tpu.types.lsdb import (
+            PrefixForwardingAlgorithm,
+            PrefixForwardingType,
+        )
+
+        topo = topologies.fat_tree_nodes(
+            120,
+            forwarding_algorithm=PrefixForwardingAlgorithm.SP_ECMP,
+            forwarding_type=PrefixForwardingType.SR_MPLS,
+        )
+        rsw = next(
+            k for k in sorted(topo.adj_dbs) if k.startswith("rsw")
+        )
+        fsw = next(
+            k for k in sorted(topo.adj_dbs) if k.startswith("fsw")
+        )
+        h = DecisionHarness(rsw)
+        try:
+            h.publish_topology(topo)
+            assert h.drain_updates(), "no initial routes"
+            adj_dbs = dict(topo.adj_dbs)
+
+            def churn(steps, base=0):
+                for step in range(steps):
+                    db = adj_dbs[fsw]
+                    adjs = list(db.adjacencies)
+                    adjs[0] = replace(
+                        adjs[0], metric=2 + (base + step) % 5
+                    )
+                    adj_dbs[fsw] = replace(
+                        db, adjacencies=tuple(adjs)
+                    )
+                    h.publish_adj(adj_dbs[fsw])
+                    h.drain_updates(first_timeout=5.0)
+
+            churn(2)  # warm: signature store + cache populate
+            before = SPF_COUNTERS["decision.sp_route_reuses"]
+            churn(3, base=2)
+            assert (
+                SPF_COUNTERS["decision.sp_route_reuses"] - before
+                > 100
+            ), "no SP route reuse through the daemon path"
+
+            # parity: accumulated daemon route DB vs a fresh host
+            # solver over the same final adjacency state
+            ls = LinkState(area=topo.area)
+            for name in sorted(adj_dbs):
+                ls.update_adjacency_database(adj_dbs[name])
+            ps = PrefixState()
+            for pdb in topo.prefix_dbs.values():
+                ps.update_prefix_database(pdb)
+            want = SpfSolver(rsw, backend="host").build_route_db(
+                rsw, {topo.area: ls}, ps
+            )
+            got = h.decision.get_decision_route_db()
+            assert got.unicast_routes == want.unicast_routes
+            assert got.mpls_routes == want.mpls_routes
+        finally:
+            h.stop()
+
+
 class TestDecisionKsp2Engine:
     def test_engine_active_through_daemon_path(self, monkeypatch):
         """The incremental KSP2 engine operates through the Decision
