@@ -402,6 +402,23 @@ class TestSpRouteReuse:
         } == before
         assert db3.unicast_routes == db1.unicast_routes
 
+    def test_soak_mixed_churn_parity(self):
+        """CI slice of tools/soak_sp_reuse: randomized interleaved
+        churn (metric, overload, label, link drop/restore, prefix
+        updates, static MPLS) with byte-exact device-vs-host parity at
+        every step. The full soak (60 seeds x 120 steps, 392k reuses)
+        ran clean during round 5."""
+        from tools.soak_sp_reuse import soak_one
+
+        for seed, kind, n in (
+            (0, "grid", 6),
+            (1, "fabric", 120),
+            (2, "mesh", 40),
+        ):
+            out = soak_one(seed, kind, n, 30)
+            assert out["parity"] == "ok", out
+            assert out["sp_route_reuses"] > 0
+
     def test_lfa_disables_sp_reuse(self):
         """LFA-enabled solvers must never take the reuse path (the
         dirty test is gated off: Decision.cpp:1192 LFA reads rows the

@@ -1,0 +1,204 @@
+"""Soak the SP route-reuse solver: long randomized mutation streams,
+device (reuse on) vs fresh host solver, byte-exact RouteDatabase parity
+at every step.
+
+Interleaves every churn class the dirty test models: remote/local
+metric wiggles, overload flips, node-label changes, link drop/restore,
+prefix forwarding-type updates, and static-MPLS mutations. Any unsound
+reuse (a changed input the signature misses) shows up as a parity
+break naming the seed and step.
+
+Run:  python -m tools.soak_sp_reuse [--seeds 8] [--steps 60]
+Prints one JSON line per seed; exits non-zero on the first break.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from dataclasses import replace
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import (
+    SPF_COUNTERS,
+    SpfSolver,
+    make_next_hop,
+)
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types import BinaryAddress
+from openr_tpu.types.lsdb import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def _build(kind: str, n: int, area: str = "0"):
+    kwargs = dict(
+        forwarding_algorithm=PrefixForwardingAlgorithm.SP_ECMP,
+        forwarding_type=PrefixForwardingType.SR_MPLS,
+        area=area,
+    )
+    if kind == "grid":
+        topo = topologies.grid(n, **kwargs)
+    elif kind == "fabric":
+        topo = topologies.fat_tree_nodes(n, **kwargs)
+    else:
+        # random_mesh prefixes default to SP_ECMP already
+        topo = topologies.random_mesh(
+            n, degree=4, seed=7, max_metric=9, area=area
+        )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    return topo, ls, ps
+
+
+def soak_one(seed: int, kind: str, n: int, steps: int) -> dict:
+    rng = random.Random(seed)
+    topo, ls_d, ps_d = _build(kind, n)
+    _t, ls_h, ps_h = _build(kind, n)
+    names = sorted(topo.adj_dbs)
+    root = next(
+        (k for k in names if k.startswith("rsw")), names[0]
+    )
+    dev = SpfSolver(root, backend="device")
+    host = SpfSolver(root, backend="host")
+    area_d = {topo.area: ls_d}
+    area_h = {topo.area: ls_h}
+    pulled: dict = {}
+
+    def mutate(ls, ps, step):
+        kind_w = rng.random()
+        node = rng.choice(names)
+        db = ls.get_adjacency_databases()[node]
+        if kind_w < 0.45 and db.adjacencies:
+            # metric wiggle
+            i = rng.randrange(len(db.adjacencies))
+            adjs = list(db.adjacencies)
+            adjs[i] = replace(
+                adjs[i], metric=1 + rng.randrange(9)
+            )
+            ls.update_adjacency_database(
+                replace(db, adjacencies=tuple(adjs))
+            )
+        elif kind_w < 0.6:
+            ls.update_adjacency_database(
+                replace(db, is_overloaded=not db.is_overloaded)
+            )
+        elif kind_w < 0.7:
+            ls.update_adjacency_database(
+                replace(db, node_label=50000 + rng.randrange(1000))
+            )
+        elif kind_w < 0.85 and db.adjacencies:
+            # link drop or restore (per-world stash keyed by step so
+            # both worlds do the identical thing)
+            key = (id(ls), node)
+            if key in pulled:
+                adj = pulled.pop(key)
+                db = ls.get_adjacency_databases()[node]
+                ls.update_adjacency_database(
+                    replace(
+                        db,
+                        adjacencies=tuple(
+                            list(db.adjacencies) + [adj]
+                        ),
+                    )
+                )
+            else:
+                i = rng.randrange(len(db.adjacencies))
+                adjs = list(db.adjacencies)
+                pulled[key] = adjs.pop(i)
+                ls.update_adjacency_database(
+                    replace(db, adjacencies=tuple(adjs))
+                )
+        elif kind_w < 0.95:
+            # prefix forwarding-type flip (version bump path)
+            pdb = topo.prefix_dbs[node]
+            new_ftype = rng.choice(
+                [PrefixForwardingType.IP,
+                 PrefixForwardingType.SR_MPLS]
+            )
+            ps.update_prefix_database(
+                replace(
+                    pdb,
+                    prefix_entries=tuple(
+                        replace(e, forwarding_type=new_ftype)
+                        for e in pdb.prefix_entries
+                    ),
+                )
+            )
+        else:
+            # static MPLS mutation
+            label = 70000 + rng.randrange(4)
+            if rng.random() < 0.5:
+                nh = make_next_hop(
+                    BinaryAddress.from_str(
+                        f"fe80::{rng.randrange(1, 99):x}"
+                    ),
+                    None,
+                    0,
+                    None,
+                )
+                return ("static", label, [nh])
+            return ("static-del", label, None)
+        return None
+
+    t0 = time.time()
+    reuses0 = SPF_COUNTERS["decision.sp_route_reuses"]
+    for step in range(steps):
+        rng_state = rng.getstate()
+        act_d = mutate(ls_d, ps_d, step)
+        rng.setstate(rng_state)
+        act_h = mutate(ls_h, ps_h, step)
+        assert (act_d is None) == (act_h is None)
+        if act_d is not None:
+            op, label, nhs = act_d
+            for solver in (dev, host):
+                if op == "static":
+                    solver.update_static_mpls_routes(
+                        {label: nhs}, []
+                    )
+                else:
+                    solver.update_static_mpls_routes({}, [label])
+        d = dev.build_route_db(root, area_d, ps_d)
+        hdb = host.build_route_db(root, area_h, ps_h)
+        if d.to_route_db(root) != hdb.to_route_db(root):
+            return {
+                "seed": seed, "kind": kind, "n": n,
+                "step": step, "parity": "BROKEN",
+            }
+    return {
+        "seed": seed, "kind": kind, "n": n, "steps": steps,
+        "parity": "ok",
+        "sp_route_reuses": SPF_COUNTERS["decision.sp_route_reuses"]
+        - reuses0,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", type=int, default=6)
+    p.add_argument("--steps", type=int, default=60)
+    args = p.parse_args()
+    worlds = [("grid", 6), ("fabric", 120), ("mesh", 40)]
+    rc = 0
+    for seed in range(args.seeds):
+        kind, n = worlds[seed % len(worlds)]
+        out = soak_one(seed, kind, n, args.steps)
+        print(json.dumps(out), flush=True)
+        if out.get("parity") != "ok":
+            rc = 1
+            break
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
