@@ -402,6 +402,45 @@ class TestSpRouteReuse:
         } == before
         assert db3.unicast_routes == db1.unicast_routes
 
+    def test_label_collision_churn_parity(self):
+        """Node-label collisions through the patched label-route map:
+        two nodes claim one label (smaller name wins,
+        Decision.cpp:620-633); churn then moves the label around —
+        winner relabeled (handover to the losing claimant), loser
+        relabeled, collision created and dissolved — and every step
+        must match the host solver byte-exactly (contested removals
+        take the full-loop fallback)."""
+        w = _Worlds("grid", 5)
+        nodes = sorted(w.topo.adj_dbs)
+        a, b, c = nodes[2], nodes[7], nodes[11]
+
+        def set_label(node, label):
+            def fn(ls):
+                _set_node_label(ls, node, label)
+
+            return fn
+
+        w.step()
+        w.step()
+        # create a collision: b takes a's label (a < b: a keeps it)
+        a_label = w.ls_d.get_adjacency_databases()[a].node_label
+        w.step(set_label(b, a_label))
+        w.step()  # steady state with the collision live
+        # winner churn: relabel a — the label must hand over to b
+        w.step(set_label(a, 61001))
+        w.step()
+        # loser churn while contested: c joins the collision
+        w.step(set_label(c, a_label))
+        w.step()
+        # dissolve: everyone unique again
+        w.step(set_label(b, 61002))
+        w.step(set_label(c, 61003))
+        w.step()
+        # and metric churn right after collision churn still reuses
+        assert w.reuses(
+            lambda ls: _mutate_metric(ls, nodes[-1], 0, 7)
+        ) >= 0
+
     def test_soak_mixed_churn_parity(self):
         """CI slice of tools/soak_sp_reuse: randomized interleaved
         churn (metric, overload, label, link drop/restore, prefix
