@@ -232,7 +232,7 @@ def _local_links_sig(ls: LinkState, node: str) -> tuple:
                 link.nh_v6_from(node).addr,
                 link.nh_v4_from(node).addr,
             )
-            for link in sorted(ls.links_from_node(node))
+            for link in ls.ordered_links_from_node(node)
         )
         _LINKS_SIG_MEMO[key] = sig
     return sig
@@ -1106,7 +1106,7 @@ class SpfSolver:
 
         # MPLS routes for adjacency labels
         for _, ls in sorted(area_link_states.items()):
-            for link in sorted(ls.links_from_node(my_node_name)):
+            for link in ls.ordered_links_from_node(my_node_name):
                 top_label = link.adj_label_from(my_node_name)
                 if top_label == 0:
                     continue
@@ -1992,7 +1992,7 @@ class SpfSolver:
 
             if self.compute_lfa_paths:
                 # RFC 5286 loop-free alternates
-                for link in sorted(ls.links_from_node(my_node_name)):
+                for link in ls.ordered_links_from_node(my_node_name):
                     if not link.is_up():
                         continue
                     neighbor = link.other_node(my_node_name)
@@ -2037,7 +2037,7 @@ class SpfSolver:
         assert next_hop_nodes
         next_hops: Set[NextHop] = set()
         for area, ls in sorted(area_link_states.items()):
-            for link in sorted(ls.links_from_node(my_node_name)):
+            for link in ls.ordered_links_from_node(my_node_name):
                 dst_iter = (
                     sorted(dst_node_areas) if per_destination else [("", "")]
                 )
