@@ -453,6 +453,7 @@ class TestSpRouteReuse:
             (0, "grid", 6),
             (1, "fabric", 120),
             (2, "mesh", 40),
+            (3, "multi", 120),
         ):
             out = soak_one(seed, kind, n, 30)
             assert out["parity"] == "ok", out

@@ -60,23 +60,86 @@ def _build(kind: str, n: int, area: str = "0"):
     return topo, ls, ps
 
 
+def _build_multi(n: int):
+    """Two areas with a border root present in both (the multi-area
+    dirty-signature path: per-area compare, unioned dirty sets)."""
+    from openr_tpu.types import Adjacency, AdjacencyDatabase
+
+    topo_a, ls_a, ps = _build("grid", 4, area="a")
+    topo_b, ls_b, _ps_b = _build("fabric", n, area="b")
+    for pdb in topo_b.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    rsw = sorted(
+        k
+        for k in ls_b.get_adjacency_databases()
+        if k.startswith("rsw")
+    )[0]
+
+    def border_adj(other):
+        return Adjacency(
+            other_node_name=other,
+            if_name=f"if_node-0_{other}",
+            other_if_name=f"if_{other}_node-0",
+            metric=1,
+        )
+
+    ls_b.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name="node-0",
+            adjacencies=(border_adj(rsw),),
+            node_label=9000,
+            area="b",
+        )
+    )
+    bdb = ls_b.get_adjacency_databases()[rsw]
+    ls_b.update_adjacency_database(
+        AdjacencyDatabase(
+            this_node_name=rsw,
+            adjacencies=tuple(bdb.adjacencies)
+            + (border_adj("node-0"),),
+            node_label=bdb.node_label,
+            area="b",
+        )
+    )
+    topos = {"a": topo_a, "b": topo_b}
+    return topos, {"a": ls_a, "b": ls_b}, ps
+
+
 def soak_one(seed: int, kind: str, n: int, steps: int) -> dict:
     rng = random.Random(seed)
-    topo, ls_d, ps_d = _build(kind, n)
-    _t, ls_h, ps_h = _build(kind, n)
-    names = sorted(topo.adj_dbs)
-    root = next(
-        (k for k in names if k.startswith("rsw")), names[0]
-    )
+    if kind == "multi":
+        topos_d, areas_d, ps_d = _build_multi(n)
+        topos_h, areas_h, ps_h = _build_multi(n)
+        root = "node-0"
+        area_d, area_h = areas_d, areas_h
+        names_by_area = {
+            a: sorted(t.adj_dbs) for a, t in topos_d.items()
+        }
+        topos = topos_d
+        names = names_by_area["b"]
+    else:
+        topo, ls_d, ps_d = _build(kind, n)
+        _t, ls_h, ps_h = _build(kind, n)
+        names = sorted(topo.adj_dbs)
+        root = next(
+            (k for k in names if k.startswith("rsw")), names[0]
+        )
+        area_d = {topo.area: ls_d}
+        area_h = {topo.area: ls_h}
+        names_by_area = None
+        topos = {topo.area: topo}
     dev = SpfSolver(root, backend="device")
     host = SpfSolver(root, backend="host")
-    area_d = {topo.area: ls_d}
-    area_h = {topo.area: ls_h}
     pulled: dict = {}
 
-    def mutate(ls, ps, step):
+    def mutate(areas, ps, step):
+        area = rng.choice(sorted(areas))
+        ls = areas[area]
+        pool = (
+            names_by_area[area] if names_by_area is not None else names
+        )
         kind_w = rng.random()
-        node = rng.choice(names)
+        node = rng.choice(pool)
         db = ls.get_adjacency_databases()[node]
         if kind_w < 0.45 and db.adjacencies:
             # metric wiggle
@@ -120,7 +183,7 @@ def soak_one(seed: int, kind: str, n: int, steps: int) -> dict:
                 )
         elif kind_w < 0.95:
             # prefix forwarding-type flip (version bump path)
-            pdb = topo.prefix_dbs[node]
+            pdb = topos[area].prefix_dbs[node]
             new_ftype = rng.choice(
                 [PrefixForwardingType.IP,
                  PrefixForwardingType.SR_MPLS]
@@ -154,9 +217,9 @@ def soak_one(seed: int, kind: str, n: int, steps: int) -> dict:
     reuses0 = SPF_COUNTERS["decision.sp_route_reuses"]
     for step in range(steps):
         rng_state = rng.getstate()
-        act_d = mutate(ls_d, ps_d, step)
+        act_d = mutate(area_d, ps_d, step)
         rng.setstate(rng_state)
-        act_h = mutate(ls_h, ps_h, step)
+        act_h = mutate(area_h, ps_h, step)
         assert (act_d is None) == (act_h is None)
         if act_d is not None:
             op, label, nhs = act_d
@@ -188,7 +251,7 @@ def main() -> int:
     p.add_argument("--seeds", type=int, default=6)
     p.add_argument("--steps", type=int, default=60)
     args = p.parse_args()
-    worlds = [("grid", 6), ("fabric", 120), ("mesh", 40)]
+    worlds = [("grid", 6), ("fabric", 120), ("mesh", 40), ("multi", 120)]
     rc = 0
     for seed in range(args.seeds):
         kind, n = worlds[seed % len(worlds)]
