@@ -469,6 +469,16 @@ class TestEngineChurnParity:
             > 0
         )
 
+    def test_soak_tool_slice(self):
+        """CI slice of tools/soak_ksp2: randomized mixed churn with
+        byte-exact device-vs-host parity, engine + fast path active."""
+        from tools.soak_ksp2 import soak_one
+
+        for seed, kind, n in ((0, "grid", 5), (1, "fabric", 120)):
+            out = soak_one(seed, kind, n, 20)
+            assert out["parity"] == "ok", out
+            assert out["incremental_syncs"] > 0
+
     def test_fuzz_mixed_churn_random_mesh(self):
         """Adversarial soundness net: a random weighted mesh under a
         random stream of MIXED churn (metric changes, link drops and

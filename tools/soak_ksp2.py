@@ -1,0 +1,157 @@
+"""Soak the incremental KSP2 engine: long randomized mutation streams,
+device (engine + fast path) vs fresh host solver, byte-exact
+RouteDatabase parity at every step.
+
+All prefixes are KSP2_ED_ECMP, so every event exercises the engine's
+invalidation algebra (first/second path membership tests, masked
+re-solve, speculative fast path) plus the label/overload
+materialization extras. Churn classes: metric wiggles, overload flips,
+node-label changes, link drop/restore.
+
+Run:  python -m tools.soak_ksp2 [--seeds 12] [--steps 40]
+Prints one JSON line per seed; exits non-zero on the first break.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from dataclasses import replace
+
+from openr_tpu.decision import spf_solver as _ss
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SPF_COUNTERS, SpfSolver
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.types.lsdb import (
+    PrefixForwardingAlgorithm,
+    PrefixForwardingType,
+)
+
+
+def _build(kind: str, n: int):
+    kwargs = dict(
+        forwarding_algorithm=PrefixForwardingAlgorithm.KSP2_ED_ECMP,
+        forwarding_type=PrefixForwardingType.SR_MPLS,
+    )
+    topo = (
+        topologies.grid(n, **kwargs)
+        if kind == "grid"
+        else topologies.fat_tree_nodes(n, **kwargs)
+    )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    return topo, ls, ps
+
+
+def soak_one(seed: int, kind: str, n: int, steps: int) -> dict:
+    rng = random.Random(seed)
+    topo, ls_d, ps_d = _build(kind, n)
+    _t, ls_h, ps_h = _build(kind, n)
+    names = sorted(topo.adj_dbs)
+    root = next(
+        (k for k in names if k.startswith("rsw")), names[0]
+    )
+    dev = SpfSolver(root, backend="device")
+    host = SpfSolver(root, backend="host")
+    pulled: dict = {}
+
+    def mutate(ls):
+        node = rng.choice(names)
+        db = ls.get_adjacency_databases()[node]
+        r = rng.random()
+        if r < 0.5 and db.adjacencies:
+            i = rng.randrange(len(db.adjacencies))
+            adjs = list(db.adjacencies)
+            adjs[i] = replace(adjs[i], metric=1 + rng.randrange(9))
+            ls.update_adjacency_database(
+                replace(db, adjacencies=tuple(adjs))
+            )
+        elif r < 0.7:
+            ls.update_adjacency_database(
+                replace(db, is_overloaded=not db.is_overloaded)
+            )
+        elif r < 0.85 and db.adjacencies:
+            key = (id(ls), node)
+            if key in pulled:
+                adj = pulled.pop(key)
+                db = ls.get_adjacency_databases()[node]
+                ls.update_adjacency_database(
+                    replace(
+                        db,
+                        adjacencies=tuple(
+                            list(db.adjacencies) + [adj]
+                        ),
+                    )
+                )
+            else:
+                i = rng.randrange(len(db.adjacencies))
+                adjs = list(db.adjacencies)
+                pulled[key] = adjs.pop(i)
+                ls.update_adjacency_database(
+                    replace(db, adjacencies=tuple(adjs))
+                )
+        else:
+            ls.update_adjacency_database(
+                replace(
+                    db, node_label=51000 + rng.randrange(500)
+                )
+            )
+
+    t0 = time.time()
+    syncs0 = SPF_COUNTERS["decision.ksp2_incremental_syncs"]
+    for step in range(steps):
+        st = rng.getstate()
+        mutate(ls_d)
+        rng.setstate(st)
+        mutate(ls_h)
+        d = dev.build_route_db(root, {topo.area: ls_d}, ps_d)
+        h = host.build_route_db(root, {topo.area: ls_h}, ps_h)
+        if d.to_route_db(root) != h.to_route_db(root):
+            return {
+                "seed": seed, "kind": kind, "n": n,
+                "step": step, "parity": "BROKEN",
+            }
+    return {
+        "seed": seed, "kind": kind, "n": n, "steps": steps,
+        "parity": "ok",
+        "incremental_syncs": SPF_COUNTERS[
+            "decision.ksp2_incremental_syncs"
+        ] - syncs0,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", type=int, default=12)
+    p.add_argument("--steps", type=int, default=40)
+    p.add_argument("--fast-path", action="store_true", default=True)
+    args = p.parse_args()
+    # engine active regardless of destination count; fast path on
+    # (covers the speculative resident-masks dispatch off-TPU too)
+    _ss.KSP2_DEVICE_MIN_DSTS = 1
+    import os
+
+    os.environ.setdefault("OPENR_KSP2_FAST", "1")
+    worlds = [("grid", 5), ("fabric", 120)]
+    rc = 0
+    for seed in range(args.seeds):
+        kind, n = worlds[seed % len(worlds)]
+        out = soak_one(seed, kind, n, args.steps)
+        print(json.dumps(out), flush=True)
+        if out.get("parity") != "ok":
+            rc = 1
+            break
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
