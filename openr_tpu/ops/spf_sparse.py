@@ -389,8 +389,19 @@ def compile_ell(ls, align: int = _NODE_PAD,
     raw_names = sorted(ls.get_adjacency_databases().keys())
     raw_index = {name: i for i, name in enumerate(raw_names)}
     if per_link:
+        # banding only needs the SLOT COUNT, which is independent of
+        # the id mapping — skip the full slot derivation (metric reads,
+        # link keys, sort) the fill pass below will do anyway
         degree = {
-            name: max(1, len(_in_edge_slots(ls, name, raw_index)))
+            name: max(
+                1,
+                sum(
+                    1
+                    for link in ls.ordered_links_from_node(name)
+                    if link.is_up()
+                    and link.other_node(name) in raw_index
+                ),
+            )
             for name in raw_names
         }
     else:
