@@ -209,6 +209,18 @@ class Link:
             self._overload1 if self._dir(node) == 1 else self._overload2
         ).value
 
+    def metric_raw_from(self, node: str) -> Metric:
+        """The ADVERTISED metric, ignoring any active hold — what merge
+        guards must compare against: a revert advertisement during a
+        hold would otherwise never reach the HoldableValue and the
+        held-away value would become visible at expiry."""
+        return (self._metric1 if self._dir(node) == 1 else self._metric2).raw
+
+    def overload_raw_from(self, node: str) -> bool:
+        return (
+            self._overload1 if self._dir(node) == 1 else self._overload2
+        ).raw
+
     def adj_label_from(self, node: str) -> int:
         return self.adj_label1 if self._dir(node) == 1 else self.adj_label2
 
@@ -559,9 +571,15 @@ class LinkState:
             prior_db is None and adj_db.node_label != 0
         ) or (prior_db is not None and prior_db.node_label != adj_db.node_label)
 
+        # blast radius: the node itself plus peers of links that
+        # ACTUALLY changed — not every peer. Journal consumers patch
+        # per-node device rows (snapshot / ELL bands), so a coarse set
+        # re-derived ~17 high-degree rows per single-adjacency metric
+        # wiggle at 100k where 2 suffice. Held changes are excluded
+        # here and journaled by decrement_holds at expiry, which
+        # already records the expired links' endpoints.
         affected = {node}
-        affected.update(l.other_node(node) for l in old_links)
-        affected.update(l.other_node(node) for l in new_links)
+        attr_affected = {node}
 
         oi, ni = 0, 0
         while ni < len(new_links) or oi < len(old_links):
@@ -571,6 +589,7 @@ class LinkState:
                 # new link coming up
                 new_links[ni].set_hold_up_ttl(hold_up_ttl)
                 change.topology_changed |= new_links[ni].is_up()
+                affected.add(new_links[ni].other_node(node))
                 self._add_link(new_links[ni])
                 ni += 1
                 continue
@@ -580,26 +599,39 @@ class LinkState:
                 # old link going away; if it was held or overloaded this is
                 # not a visible topology change
                 change.topology_changed |= old_links[oi].is_up()
+                affected.add(old_links[oi].other_node(node))
                 self._remove_link(old_links[oi])
                 oi += 1
                 continue
             new, old = new_links[ni], old_links[oi]
-            if new.metric_from(node) != old.metric_from(node):
-                change.topology_changed |= old.set_metric_from(
+            # compare against the RAW (advertised) value, not the
+            # observable one: during a hold those differ, and a revert
+            # advertisement must reach the HoldableValue (which drops
+            # the hold and applies fast) instead of silently letting
+            # the held-away value win at expiry (code-review repro)
+            if new.metric_from(node) != old.metric_raw_from(node):
+                if old.set_metric_from(
                     node, new.metric_from(node), hold_up_ttl, hold_down_ttl
-                )
-            if new.overload_from(node) != old.overload_from(node):
-                change.topology_changed |= old.set_overload_from(
+                ):
+                    change.topology_changed = True
+                    affected.add(old.other_node(node))
+            if new.overload_from(node) != old.overload_raw_from(node):
+                if old.set_overload_from(
                     node, new.overload_from(node), hold_up_ttl, hold_down_ttl
-                )
+                ):
+                    change.topology_changed = True
+                    affected.add(old.other_node(node))
             if new.adj_label_from(node) != old.adj_label_from(node):
                 change.link_attributes_changed = True
+                attr_affected.add(old.other_node(node))
                 old.set_adj_label_from(node, new.adj_label_from(node))
             if new.nh_v4_from(node) != old.nh_v4_from(node):
                 change.link_attributes_changed = True
+                attr_affected.add(old.other_node(node))
                 old.set_nh_v4_from(node, new.nh_v4_from(node))
             if new.nh_v6_from(node) != old.nh_v6_from(node):
                 change.link_attributes_changed = True
+                attr_affected.add(old.other_node(node))
                 old.set_nh_v6_from(node, new.nh_v6_from(node))
             ni += 1
             oi += 1
@@ -607,7 +639,7 @@ class LinkState:
         if change.topology_changed:
             self._invalidate(affected)
         if change.link_attributes_changed or change.node_label_changed:
-            self._note_attr_change(affected)
+            self._note_attr_change(attr_affected)
         return change
 
     def delete_adjacency_database(self, node: str) -> LinkStateChange:

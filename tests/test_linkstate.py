@@ -268,6 +268,38 @@ class TestHolds:
             ls.decrement_holds()
         assert ls.get_metric_from_a_to_b("a", "b") == 99
 
+    def test_held_metric_revert_converges_to_advertised(self):
+        """Metric change under hold, then a revert advertisement before
+        expiry: the link must converge to the ADVERTISED value, not the
+        held-away one (code-review repro: the merge guard compared the
+        new metric against the observable value, so the revert never
+        reached the HoldableValue and the stale raw value became
+        visible at expiry)."""
+        ls = LinkState()
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=10)])
+        )
+        ls.update_adjacency_database(
+            db("b", [adj("a", "if_ba", "if_ab")])
+        )
+        assert ls.get_metric_from_a_to_b("a", "b") == 10
+        # degrade under a hold: observable stays 10, raw goes 20
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=20)]),
+            hold_up_ttl=1,
+            hold_down_ttl=3,
+        )
+        assert ls.get_metric_from_a_to_b("a", "b") == 10
+        # revert BEFORE expiry: advertised value is 10 again
+        ls.update_adjacency_database(
+            db("a", [adj("b", "if_ab", "if_ba", metric=10)]),
+            hold_up_ttl=1,
+            hold_down_ttl=3,
+        )
+        for _ in range(4):
+            ls.decrement_holds()
+        assert ls.get_metric_from_a_to_b("a", "b") == 10
+
     def test_metric_hold_down(self):
         ls = LinkState()
         ls.update_adjacency_database(db("a", [adj("b", "if_ab", "if_ba", metric=5)]))
