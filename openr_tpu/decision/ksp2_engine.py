@@ -550,7 +550,21 @@ class Ksp2Engine:
                 self._cold_build(ls, state, dsts)
                 return None
             if a_retrace:
-                self._retrace_only(ls, graph, sorted(a_retrace), row_map)
+                unrealized = self._retrace_only(
+                    ls, graph, sorted(a_retrace), row_map
+                )
+                if unrealized:
+                    # masks drifted for these: full per-dst repair
+                    if not self._recompute(
+                        ls, state, sorted(unrealized), d_new_src
+                    ):
+                        self._cold_build(ls, state, dsts)
+                        return None
+            # a moved speculative row means the destination's second
+            # paths may have changed even when no membership test
+            # fired — its routes must not be served from the reuse
+            # cache (the soak's stale-route half of the same finding)
+            affected |= set(row_map) & dst_set
         else:
             recompute = sorted(aff1 | aff2)
             if recompute:
@@ -943,11 +957,21 @@ class Ksp2Engine:
     def _retrace_only(
         self, ls: LinkState, graph, dsts: List[str],
         row_map: Dict[str, np.ndarray],
-    ) -> None:
+    ) -> Set[str]:
         """Fast-path update for destinations whose MASKS are unchanged:
         adopt the speculative masked row (when it moved) and re-trace
         second paths with the current weights. First paths and
-        exclusion sets stay as cached."""
+        exclusion sets stay as cached.
+
+        Returns the destinations whose row could NOT be realized by a
+        trace (a finite masked total with no path walking to it): that
+        means the resident masks drifted from the destination's true
+        exclusion set, so the speculative row is bogus — the caller
+        must _recompute them from scratch (fresh first paths + masks).
+        The mixed-churn soak caught exactly this as a silently dropped
+        second path (seed 9013: stale masks yielded total 6 where the
+        true masked distance was 8, the trace found nothing, and the
+        destination was never invalidated)."""
         cands_of = make_cands_of(ls, graph.node_index)
         transit_blocked = {
             name
@@ -970,11 +994,22 @@ class Ksp2Engine:
             ),
             False, [self.excl[d] for d in dsts],
         )
+        unrealized: Set[str] = set()
         for dst, paths in zip(dsts, traced):
+            if not paths:
+                # empty trace: either the row is finite but unwalkable
+                # (masks drifted toward extra paths) or INF where the
+                # true masked graph has a path (masks drifted toward
+                # extra exclusions) — indistinguishable without fresh
+                # masks, and a genuinely second-path-less destination
+                # just re-confirms cheaply. Recompute all of them.
+                unrealized.add(dst)
+                continue
             self.second_paths[dst] = paths
             for path in paths:
                 for x in _path_nodes(self.src_name, path):
                     self.node_users.setdefault(x, set()).add(dst)
+        return unrealized
 
     def _recompute(
         self, ls: LinkState, state, affected: List[str],

@@ -204,7 +204,15 @@ def _ksp2_chunk(graph) -> int:
 
 
 
-_LINKS_SIG_MEMO: Dict[tuple, tuple] = {}
+import weakref as _weakref
+
+# weakly keyed by the LIVE LinkState: an id()-keyed memo can serve a
+# dead graph's signature when CPython recycles the address for a new
+# LinkState whose version counters pass through the same values — the
+# SP-reuse soak caught exactly that as a parity break across worlds
+_LINKS_SIG_MEMO: "_weakref.WeakKeyDictionary" = (
+    _weakref.WeakKeyDictionary()
+)
 
 _EMPTY_PREFIXES: frozenset = frozenset()
 
@@ -215,14 +223,18 @@ def _local_links_sig(ls: LinkState, node: str) -> tuple:
     peer, liveness, v6/v4 next-hop addresses. Shared by the node-label
     and SP-reuse caches so their invalidation can't drift apart.
 
-    Memoized per (graph identity, topology version, attribute version,
+    Memoized per live graph x (topology version, attribute version,
     node): every field below moves one of the two versions when it
     changes, so both caches' per-build probes share one link walk."""
-    key = (id(ls), ls.topology_version, ls.attributes_version, node)
-    sig = _LINKS_SIG_MEMO.get(key)
+    per_ls = _LINKS_SIG_MEMO.get(ls)
+    if per_ls is None:
+        per_ls = {}
+        _LINKS_SIG_MEMO[ls] = per_ls
+    key = (ls.topology_version, ls.attributes_version, node)
+    sig = per_ls.get(key)
     if sig is None:
-        while len(_LINKS_SIG_MEMO) > 32:  # a few roots x live graphs
-            _LINKS_SIG_MEMO.pop(next(iter(_LINKS_SIG_MEMO)))
+        while len(per_ls) > 32:  # a few roots x live versions
+            per_ls.pop(next(iter(per_ls)))
         sig = tuple(
             (
                 link.iface_from(node),
@@ -234,7 +246,7 @@ def _local_links_sig(ls: LinkState, node: str) -> tuple:
             )
             for link in ls.ordered_links_from_node(node)
         )
-        _LINKS_SIG_MEMO[key] = sig
+        per_ls[key] = sig
     return sig
 
 
@@ -483,10 +495,8 @@ class _EllResidentCache:
     (reference incremental rebuild: openr/decision/Decision.cpp:1896-1917)."""
 
     def __init__(self) -> None:
-        import weakref
-
         # ls -> (synced topology_version, EllState)
-        self._cache = weakref.WeakKeyDictionary()
+        self._cache = _weakref.WeakKeyDictionary()
         # views the KSP2 engines already computed inside their fused
         # dispatches this build — consumed (popped) by view_packed so
         # SpfView does not pay a second device round trip. Entries are
@@ -497,8 +507,6 @@ class _EllResidentCache:
         self._preloaded: List[tuple] = []
 
     def preload_view(self, ls, graph, srcs, packed) -> None:
-        import weakref
-
         root = graph.node_names[srcs[0]]
         # dead-graph entries can never match; drop them so MB-scale
         # packed rows don't stay pinned behind a dead LinkState
@@ -507,7 +515,7 @@ class _EllResidentCache:
         ]
         self._preloaded.append(
             (
-                weakref.ref(ls), ls.topology_version, root,
+                _weakref.ref(ls), ls.topology_version, root,
                 graph, srcs, packed,
             )
         )
@@ -615,14 +623,15 @@ class SpfSolver:
         # path; per-root so ctrl queries for other nodes don't thrash
         # the hot path's slot
         self._label_cache: Dict[str, tuple] = {}
-        # per-(graph identity, topology_version, root) SPF view cache
-        self._views: Dict[Tuple[int, int, str], SpfView] = {}
+        # per-graph SPF view cache: ls -> {(version, root): view}.
+        # STRONG object keys (no id-reuse aliasing), LRU-bounded: a
+        # weak dict can never collect here because each SpfView holds
+        # its graph (view._ls), so the value would pin its own key
+        self._views: Dict[LinkState, Dict] = {}
         # incremental KSP2 engines keyed weakly by LinkState: a dead
         # area graph must release its engine (resident [n, n] device
         # matrix + path caches) instead of pinning it until eviction
-        import weakref
-
-        self._ksp2_engines = weakref.WeakKeyDictionary()
+        self._ksp2_engines = _weakref.WeakKeyDictionary()
         # per-prefix route reuse across churn (driven by the engine's
         # affected set): prefix -> (RibUnicastEntry | None, best result)
         self._route_cache: Dict[IpPrefix, tuple] = {}
@@ -666,7 +675,7 @@ class SpfSolver:
         # an attribute change, so the O(N) rebuild is skipped across
         # metric churn. Weakly keyed (like _ksp2_engines) so a dead
         # area's slot can never alias a recycled id.
-        self._labels_cache = weakref.WeakKeyDictionary()
+        self._labels_cache = _weakref.WeakKeyDictionary()
         # bumped on every static-MPLS mutation: _add_best_paths merges
         # static next hops into self-advertised anycast routes, so the
         # reuse meta must change when they do
@@ -689,22 +698,26 @@ class SpfSolver:
 
     def _view(self, area: str, ls: LinkState, root: str) -> SpfView:
         del area  # identity of the LinkState object is the key
-        key = (id(ls), ls.topology_version, root)
-        view = self._views.get(key)
+        per_ls = self._views.get(ls)
+        if per_ls is None:
+            per_ls = {}
+            # LRU re-insert + bound: dead graphs must not accumulate
+            self._views[ls] = per_ls
+            while len(self._views) > 4:
+                self._views.pop(next(iter(self._views)))
+        key = (ls.topology_version, root)
+        view = per_ls.get(key)
         if view is None:
             # drop stale versions of this graph
-            self._views = {
-                k: v
-                for k, v in self._views.items()
-                if not (k[0] == key[0] and k[1] != key[1])
-            }
+            for k in [k for k in per_ls if k[0] != key[0]]:
+                del per_ls[k]
             factory = _SPF_BACKENDS.get(self.backend)
             view = (
                 factory(ls, root)
                 if factory is not None
                 else SpfView(ls, root, self.backend)
             )
-            self._views[key] = view
+            per_ls[key] = view
         return view
 
     # -- SP route reuse dirty test ----------------------------------------
@@ -936,13 +949,17 @@ class SpfSolver:
         # produce a byte-identical route is served from the cache
         # instead of re-derived (reference analogue: the per-prefix
         # incremental rebuild, Decision.cpp:1896-1917).
+        # object references, not id()s: a recycled id on a NEW
+        # graph/prefix-state whose version counters matched could alias
+        # (plain classes compare by identity; the single slot pins them
+        # only until the next build)
         meta = (
-            id(prefix_state),
+            prefix_state,
             prefix_state.version,
             my_node_name,
             self._static_routes_version,
             tuple(
-                (a, id(ls)) for a, ls in sorted(area_link_states.items())
+                (a, ls) for a, ls in sorted(area_link_states.items())
             ),
         )
         # two independent change detectors feed the reuse gate:
@@ -980,7 +997,7 @@ class SpfSolver:
             # LFA-enabled or engine-less solver never reads the map,
             # and building it would re-impose the very per-event cost
             # the cache exists to avoid
-            adv_key = (id(prefix_state), prefix_state.version)
+            adv_key = (prefix_state, prefix_state.version)
             if (
                 self._advertisers_cache is None
                 or self._advertisers_cache[0] != adv_key
@@ -1652,7 +1669,7 @@ class SpfSolver:
         # prefix-state version (at 100k SP-only fabrics it burned
         # ~0.4 s/event discovering an empty set every build)
         dsts_key = (
-            id(prefix_state),
+            prefix_state,
             prefix_state.version,
             my_node_name,
             tuple(sorted(area_link_states)),

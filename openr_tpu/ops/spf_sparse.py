@@ -302,7 +302,14 @@ def link_key(link) -> Tuple:
     return link.ordered_names
 
 
-_IN_SLOTS_MEMO: Dict[tuple, list] = {}
+import weakref as _weakref
+
+# weakly keyed by the LIVE LinkState (an id()-keyed memo can alias a
+# recycled address whose new graph passes through the same version —
+# the SP-reuse soak caught that as a cross-world parity break)
+_IN_SLOTS_MEMO: "_weakref.WeakKeyDictionary" = (
+    _weakref.WeakKeyDictionary()
+)
 
 
 def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
@@ -312,13 +319,18 @@ def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
     to exclude ONE member of a LAG without killing its siblings
     (reference: LinkState.cpp:763 getKthPaths' linksToIgnore).
 
-    Memoized per (graph identity, topology version, node): every input
-    below (membership, liveness, metrics incl. holds) bumps the
-    topology version when it changes, and churn-path callers re-derive
-    the same high-degree node several times per event (padded patch
-    rows repeat names). Callers must not mutate the list."""
-    memo_key = (id(ls), ls.topology_version, name, id(index))
-    cached = _IN_SLOTS_MEMO.get(memo_key)
+    Memoized per live graph x (topology version, node, id mapping):
+    every input below (membership, liveness, metrics incl. holds)
+    bumps the topology version when it changes, and churn-path callers
+    re-derive the same high-degree node several times per event
+    (padded patch rows repeat names). Callers must not mutate the
+    list."""
+    per_ls = _IN_SLOTS_MEMO.get(ls)
+    if per_ls is None:
+        per_ls = {}
+        _IN_SLOTS_MEMO[ls] = per_ls
+    memo_key = (ls.topology_version, name, id(index))
+    cached = per_ls.get(memo_key)
     if cached is not None:
         return cached
     slots: List[Tuple[int, int, Tuple]] = []
@@ -332,9 +344,9 @@ def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
         m = min(int(link.metric_from(other)), int(INF) - 1)
         slots.append((i, m, link_key(link)))
     slots.sort(key=lambda t: (t[0], t[2]))
-    while len(_IN_SLOTS_MEMO) > 256:
-        _IN_SLOTS_MEMO.pop(next(iter(_IN_SLOTS_MEMO)))
-    _IN_SLOTS_MEMO[memo_key] = slots
+    while len(per_ls) > 256:
+        per_ls.pop(next(iter(per_ls)))
+    per_ls[memo_key] = slots
     return slots
 
 

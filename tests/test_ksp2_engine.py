@@ -469,6 +469,20 @@ class TestEngineChurnParity:
             > 0
         )
 
+    def test_soak_seed_9013_stale_mask_regression(self):
+        """Soak-found regression: under compound churn (overload flips
+        + link drops), a destination's resident masks drifted, the
+        speculative masked row went bogus (total 6 vs true 8), the
+        re-trace silently dropped its second path, and the destination
+        never entered the affected set — stale reused routes diverged
+        from the host 12 steps later. The fix recomputes
+        unrealizable-row destinations and invalidates every
+        moved-row destination."""
+        from tools.soak_ksp2 import soak_one
+
+        out = soak_one(9013, "fabric", 120, 60)
+        assert out["parity"] == "ok", out
+
     def test_soak_tool_slice(self):
         """CI slice of tools/soak_ksp2: randomized mixed churn with
         byte-exact device-vs-host parity, engine + fast path active."""
