@@ -494,6 +494,39 @@ class Ksp2Engine:
             ls, graph, changed, d_new_src, rows_new, rows_old
         )
         dst_set = set(self.dst_pos)
+        # slot-map drift: a band patch that changes a node's in-edge
+        # SET re-packs that row's slot assignments, silently re-aiming
+        # every resident mask bit stored for those slots (soak repro
+        # seed 40018: a dropped link shifted two slots and a
+        # destination's masked solve excluded the wrong edges,
+        # yielding a metric-15 second path where the truth was 8).
+        # Metric-only patches keep the slot map stable. Destinations
+        # whose stored paths touch a re-slotted node join aff1 — the
+        # stale-mask bucket, re-solved with FRESH masks.
+        # only the fast path holds RESIDENT masks; the slow path
+        # rebuilds masks fresh from the current slot_of every event,
+        # so there is nothing to go stale there
+        if (
+            graph.slot_of is not None
+            and getattr(self, "masks_t", None) is not None
+        ):
+            for nm in affected_nodes:
+                nid = graph.node_index.get(nm)
+                if nid is None:
+                    continue
+                new_map = graph.slot_of.get(nid, {})
+                old_map = self._slot_maps.get(nid)
+                if old_map is not None and old_map != new_map:
+                    if nm == self.src_name:
+                        # every destination's mask holds its first-hop
+                        # bits in the ROOT's row (build_edge_masks
+                        # sets both endpoint rows), and node_users
+                        # never indexes the root — a re-slotted root
+                        # stales every mask
+                        aff1 |= set(self.dst_pos)
+                    else:
+                        aff1 |= self.node_users.get(nm, set())
+                self._slot_maps[nid] = new_map
         aff1 &= dst_set
         aff2 &= dst_set
         # label/overload materialization extras: paths are unchanged
@@ -618,6 +651,12 @@ class Ksp2Engine:
         self.state = state
         self.dsts = list(dsts)
         self.band_shapes = tuple(graph.bands)
+        # per-node slot-map snapshot for drift detection (see sync):
+        # inner dicts are immutable-in-practice (ell_patch replaces a
+        # node's map wholesale), so references compare by content later
+        self._slot_maps = (
+            dict(graph.slot_of) if graph.slot_of is not None else {}
+        )
         self._mesh_knob = _ENGINE_MESH
         self._mesh = (
             _ENGINE_MESH

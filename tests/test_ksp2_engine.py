@@ -483,6 +483,20 @@ class TestEngineChurnParity:
         out = soak_one(9013, "fabric", 120, 60)
         assert out["parity"] == "ok", out
 
+    def test_soak_seed_40018_slot_map_drift_regression(self):
+        """The root cause behind both soak breaks: a band patch that
+        changes a node's in-edge SET re-packs its slot assignments,
+        re-aiming every resident mask bit for that row — a dropped
+        link shifted two slots and the masked solve excluded the
+        wrong edges (metric-15 second path where the truth was 8).
+        The engine now snapshots per-node slot maps and sends
+        re-slotted nodes' path users through the fresh-mask aff1
+        bucket."""
+        from tools.soak_ksp2 import soak_one
+
+        out = soak_one(40018, "grid", 5, 60)
+        assert out["parity"] == "ok", out
+
     def test_soak_tool_slice(self):
         """CI slice of tools/soak_ksp2: randomized mixed churn with
         byte-exact device-vs-host parity, engine + fast path active."""
