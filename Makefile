@@ -5,11 +5,14 @@
 # for packaging/CI. Python deps (jax, numpy, pytest) come from the
 # environment — see pyproject.toml.
 
+# tier1 uses pipefail/PIPESTATUS (bash-only)
+SHELL    := /bin/bash
+
 CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast bench clean install
+.PHONY: all native test test-fast tier1 churn-smoke bench clean install
 
 all: native
 
@@ -27,6 +30,15 @@ test: native
 
 test-fast: native
 	python -m pytest tests/ -q -x -m "not slow"
+
+# the ROADMAP tier-1 gate, verbatim (CPU-pinned, bounded, dot-counted)
+tier1: native
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+
+# fast guard for the incremental churn path: fails if the device
+# pipeline regresses to zero incremental syncs / warm solves
+churn-smoke: native
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py -q -m "not slow"
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

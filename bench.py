@@ -465,8 +465,23 @@ def _run() -> dict:
         "bench_route_sweep": bench_routes,
         "bench_route_engine_churn": bench_rchurn,
         "bench_sp_solver_churn": bench_spsolver,
+        # merged solver + resident-band counters accumulated across
+        # every leg above — the churn-path health record (incremental
+        # syncs, warm/cold solve split, widen and prewarm events)
+        "spf_counters": _spf_counter_snapshot(),
         "error": None,
     }
+
+
+def _spf_counter_snapshot() -> dict:
+    try:
+        from openr_tpu.decision.spf_solver import get_spf_counters
+
+        return {
+            k: v for k, v in sorted(get_spf_counters().items()) if v
+        }
+    except Exception:
+        return {}
 
 
 def _child_main(mode: str) -> None:

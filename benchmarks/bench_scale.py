@@ -138,12 +138,25 @@ def churn_bench(nodes: int, churn_events: int) -> dict:
             assert int(d0[did]) == want, dst
 
     reconverge(churn(99))  # compile the patch-bucket program
+    c0 = dict(spf_sparse.ELL_COUNTERS)
     samples = []
     for step in range(churn_events):
         affected = churn(step)
         t0 = time.perf_counter()
         reconverge(affected)
         samples.append((time.perf_counter() - t0) * 1000)
+    c1 = dict(spf_sparse.ELL_COUNTERS)
+    # post-churn oracle gate: the WARM-started path must still match
+    # the host Dijkstra bit-for-bit after the whole mixed sequence
+    packed = reconverge(churn(churn_events))
+    oracle = ls.run_spf(my_node)
+    d_after = packed[: len(srcs)][0]
+    for dst in list(graph.node_names)[:: max(1, graph.n // 50)]:
+        did = graph.node_index[dst]
+        want = oracle[dst].metric if dst in oracle else None
+        assert (int(d_after[did]) >= INF) == (want is None), dst
+        if want is not None:
+            assert int(d_after[did]) == want, dst
     import jax
 
     platform = jax.devices()[0].platform
@@ -152,15 +165,22 @@ def churn_bench(nodes: int, churn_events: int) -> dict:
         np.asarray,
         k=8,
     )
+    median = round(statistics.median(samples), 1)
     return {
         "bench": f"scale.ell_churn_reconverge_{graph.n}_nodes",
         "events": churn_events,
-        "median_ms": round(statistics.median(samples), 1),
+        "median_ms": median,
         # nearest-rank p90 (index 8 of 10, not the max)
         "p90_ms": round(
             sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
         ),
         "device_only_ms": device_only,
+        "host_overhead_ms": round(max(0.0, median - device_only), 3),
+        "incremental_syncs": c1["ell_incremental_syncs"]
+        - c0["ell_incremental_syncs"],
+        "warm_solves": c1["ell_warm_solves"] - c0["ell_warm_solves"],
+        "cold_solves": c1["ell_cold_solves"] - c0["ell_cold_solves"],
+        "widen_events": c1["ell_widen_events"] - c0["ell_widen_events"],
         "platform": platform,
         "oracle_spot_check": "passed",
     }
@@ -269,13 +289,16 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         churn(step)
         solver.build_route_db(rsw, area_ls, ps)
 
-    before = dict(SPF_COUNTERS)
+    from openr_tpu.decision.spf_solver import get_spf_counters
+
+    before = get_spf_counters()
     samples = []
     for step in range(churn_events):
         churn(step)
         t0 = time.perf_counter()
         solver.build_route_db(rsw, area_ls, ps)
         samples.append((time.perf_counter() - t0) * 1000)
+    after = get_spf_counters()
     return {
         "bench": (
             f"scale.fabric_{ls.num_nodes}_sp_churn_rebuild"
@@ -303,9 +326,24 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         "ksp2_host_fallbacks": SPF_COUNTERS[
             "decision.ksp2_host_fallbacks"
         ] - before["decision.ksp2_host_fallbacks"],
-        "incremental_syncs": SPF_COUNTERS[
-            "decision.ksp2_incremental_syncs"
-        ] - before["decision.ksp2_incremental_syncs"],
+        # incremental device syncs per kind: the engine's fused
+        # all-pairs dispatch (KSP2 shapes), plus the resident ELL band
+        # deltas (the SpfView path) reported separately — they cover
+        # the SAME events, so summing would double-count
+        "incremental_syncs": after["decision.ksp2_incremental_syncs"]
+        - before["decision.ksp2_incremental_syncs"],
+        "ell_incremental_syncs": (
+            after.get("decision.ell_incremental_syncs", 0)
+            - before.get("decision.ell_incremental_syncs", 0)
+        ),
+        "warm_solves": after.get("decision.ell_warm_solves", 0)
+        - before.get("decision.ell_warm_solves", 0),
+        "warm_dispatches": after.get("decision.ksp2_warm_dispatches", 0)
+        - before.get("decision.ksp2_warm_dispatches", 0),
+        "ell_full_compiles": after["decision.ell_full_compiles"]
+        - before["decision.ell_full_compiles"],
+        "prewarms": after["decision.ell_prewarms"]
+        - before["decision.ell_prewarms"],
         # device ROUND TRIPS per event: on a relay-backed chip each
         # dispatch+readback pays the transport RTT, so this is the
         # fixed-cost multiplier of the e2e median (the speculative

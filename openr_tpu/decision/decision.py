@@ -210,6 +210,14 @@ class Decision:
         self.counters["decision.publications"] += 1
         self.process_publication(pub)
         if self.pending.needs_route_update():
+            # overlap the device-side delta application with the
+            # debounce window: the band scatter for this publication's
+            # topology delta is enqueued asynchronously NOW, so by the
+            # time the debounced rebuild dispatches its fused solve the
+            # resident bands are already patched (and the previous
+            # event's RouteDatabase delta emission ran concurrently
+            # with the scatter instead of ahead of it)
+            self.spf_solver.prewarm(self.area_link_states)
             self._rebuild_debounced()
 
     def _on_static_routes(self, delta) -> None:

@@ -464,6 +464,22 @@ class Ksp2Engine:
         ep_ids = _pad_ids(ep)
         use_fast = getattr(self, "masks_t", None) is not None
         dm_new_dev = None
+        # increase-edge delta for the warm-started fixed point: pairs
+        # whose collapsed min weight went UP since d_prev_dev's epoch.
+        # An overload flip changes effective weights without touching
+        # the raw metrics the tight test runs on — force a cold seed.
+        inc = None
+        if not ov_flips:
+            inc = [
+                (graph.node_index[u], graph.node_index[v], int(w_old))
+                for (u, v), (w_old, w_new, _so, _sn) in changed.items()
+                if w_new > w_old
+            ]
+            if self._mesh is None:
+                # the sharded dispatch does not thread the delta (its
+                # all-pairs solve stays cold) — counting it as warm
+                # would claim a seeding that never happened
+                _counters()["decision.ksp2_warm_dispatches"] += 1
         if self._mesh is not None:
             d_all_dev, packed = spf_sparse.sharded_ell_all_view_rows(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
@@ -475,11 +491,19 @@ class Ksp2Engine:
             ) = spf_sparse.ell_all_view_rows_masked(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
                 self.masks_t, self.dm_dev, self.sid, ENGINE_ROW_BUDGET,
+                inc=inc,
             )
         else:
             d_all_dev, packed = spf_sparse.ell_all_view_rows(
-                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev
+                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev, inc=inc
             )
+        # the single-chip dispatches DONATE d_prev_dev (and dm_dev on
+        # the fast path): adopt the outputs NOW, before any fallback
+        # below can hand the dead buffers to _cold_build (which reuses
+        # d_prev_dev as its placeholder)
+        self.d_prev_dev = d_all_dev
+        if dm_new_dev is not None:
+            self.dm_dev = dm_new_dev
         b = len(view_srcs)
         p = len(ep_ids)
         view_packed = packed[: 2 * b]
@@ -551,9 +575,9 @@ class Ksp2Engine:
             ids = meta[:ENGINE_ROW_BUDGET]
             count = int(meta[ENGINE_ROW_BUDGET])
             changed_rows = packed[2 * b + 2 * p + 1 :]
-            # adopt the speculative matrix now so dispatch-2 corrections
-            # scatter into the CURRENT resident state
-            self.dm_dev = dm_new_dev
+            # the speculative matrix was adopted right after the
+            # dispatch, so dispatch-2 corrections scatter into the
+            # CURRENT resident state
             row_map = {}
             if count <= ENGINE_ROW_BUDGET:
                 for x, i in enumerate(ids):
@@ -630,7 +654,6 @@ class Ksp2Engine:
         ):
             self.ecc_hops = ls.get_max_hops_to_node(self.src_name)
         self.d_base = d_new_src.astype(np.int32)
-        self.d_prev_dev = d_all_dev
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
         _counters()["decision.ksp2_incremental_syncs"] += 1
@@ -699,6 +722,11 @@ class Ksp2Engine:
                 placeholder, self._mesh,
             )
         else:
+            # the dispatch DONATES the placeholder (which may be the
+            # previous d_prev_dev): drop our reference first so a
+            # failed dispatch can't leave a dead buffer behind for the
+            # next cold build to reuse
+            self.d_prev_dev = None
             d_all_dev, packed = spf_sparse.ell_all_view_rows(
                 state, srcs_dev, w_sv,
                 np.asarray([self.sid], np.int32),

@@ -169,9 +169,11 @@ SPF_COUNTERS: Dict[str, int] = {
     "decision.ksp2_host_fallbacks": 0,
     "decision.ksp2_cold_builds": 0,
     "decision.ksp2_incremental_syncs": 0,
+    "decision.ksp2_warm_dispatches": 0,
     "decision.ksp2_affected_dsts": 0,
     "decision.ksp2_route_reuses": 0,
     "decision.sp_route_reuses": 0,
+    "decision.ell_prewarms": 0,
 }
 
 # KSP2 device prefetch: below this many KSP2 destinations the host path
@@ -251,7 +253,17 @@ def _local_links_sig(ls: LinkState, node: str) -> tuple:
 
 
 def get_spf_counters() -> Dict[str, int]:
-    return dict(SPF_COUNTERS)
+    out = dict(SPF_COUNTERS)
+    # fold in the ops-level resident-band counters under the same
+    # namespace (one merged view for Decision.get_counters and the
+    # churn smoke test)
+    try:
+        from openr_tpu.ops.spf_sparse import ELL_COUNTERS
+    except Exception:
+        return out
+    for k, v in ELL_COUNTERS.items():
+        out["decision." + k] = v
+    return out
 
 
 class SpfView:
@@ -696,15 +708,42 @@ class SpfSolver:
 
     # -- SPF views --------------------------------------------------------
 
+    def prewarm(self, area_link_states: AreaLinkStates) -> None:
+        """Publication-time overlap hook (called by the decision module
+        as publications land, BEFORE the debounced rebuild fires): push
+        pending topology deltas into the device-resident ELL bands now,
+        so the band scatter overlaps the debounce window and the
+        previous event's RouteDatabase delta emission instead of
+        sitting on the rebuild's critical path. Touches only graphs
+        that ALREADY have resident state (never compiles a new one) and
+        swallows failures — this is an overlap optimization, not a
+        correctness step: the rebuild re-syncs and no-ops when the
+        bands are already current."""
+        if self.backend != "device":
+            return
+        for ls in area_link_states.values():
+            try:
+                entry = _ELL_RESIDENT._cache.get(ls)
+                if entry is None or entry[0] == ls.topology_version:
+                    continue
+                _ELL_RESIDENT.state_for(ls)
+                SPF_COUNTERS["decision.ell_prewarms"] += 1
+            except Exception:
+                continue
+
     def _view(self, area: str, ls: LinkState, root: str) -> SpfView:
         del area  # identity of the LinkState object is the key
         per_ls = self._views.get(ls)
         if per_ls is None:
             per_ls = {}
-            # LRU re-insert + bound: dead graphs must not accumulate
-            self._views[ls] = per_ls
-            while len(self._views) > 4:
-                self._views.pop(next(iter(self._views)))
+        else:
+            # re-insert on hit: eviction is LRU, not FIFO — with 5+
+            # areas a FIFO bound evicts the hottest graph every build,
+            # which silently disables the SP dirty test
+            del self._views[ls]
+        self._views[ls] = per_ls
+        while len(self._views) > 4:
+            self._views.pop(next(iter(self._views)))
         key = (ls.topology_version, root)
         view = per_ls.get(key)
         if view is None:
@@ -1047,13 +1086,14 @@ class SpfSolver:
             route_db.unicast_routes = dict(self._route_entries_cache)
             self.best_routes_cache.update(self._route_best_cache)
             new_cache = dict(self._route_cache)
-            SPF_COUNTERS["decision.sp_route_reuses"] += len(
-                new_cache
-            ) - len(must)
             for p in must:
                 route_db.unicast_routes.pop(p, None)
                 self.best_routes_cache.pop(p, None)
                 new_cache.pop(p, None)
+            # count what actually survived the pops: `must` may name
+            # prefixes that were never cached, so set arithmetic
+            # (len(cache) - len(must)) under-counts
+            SPF_COUNTERS["decision.sp_route_reuses"] += len(new_cache)
             iter_prefixes = must
 
         for prefix in iter_prefixes:

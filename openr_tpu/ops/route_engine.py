@@ -168,6 +168,19 @@ def _detect_rows(dr, e_u, e_v, e_w_old, e_w_new, k, row_start):
     return count, local, local + row_start
 
 
+def _increase_rows(dr, e_u, e_v, e_w_old, e_w_new):
+    """Rows whose resident DR may UNDERESTIMATE the post-patch
+    distances: some edge whose weight went UP was tight under the old
+    row. Every other affected row keeps its old row as a sound warm
+    seed for the re-solve — same argument as spf_sparse._warm_seed,
+    destination-major (old rows are valid upper bounds under pure
+    decreases and equal-cost ties)."""
+    tight_old = dr[:, e_u] == jnp.minimum(
+        e_w_old[None, :] + dr[:, e_v], INF
+    )
+    return jnp.any(tight_old & (e_w_new > e_w_old)[None, :], axis=1)
+
+
 def _resolve_and_pack(
     solve_rows, nh_counts, overloaded, ids, local_ids, count, dr,
     digests, samp_ids, samp_v, samp_w, pos_w, n, k,
@@ -216,6 +229,11 @@ def _churn_step(
     count, local_ids, ids = _detect_rows(
         dr, e_u, e_v, e_w_old, e_w_new, k, 0
     )
+    # warm seed for the re-solve: pre-patch rows outside the
+    # increase-affected cone (XLA CSEs the shared dr gathers with
+    # _detect_rows); increase-affected rows restart from INF + anchor
+    inc_row = _increase_rows(dr, e_u, e_v, e_w_old, e_w_new)
+    warm0 = jnp.where(inc_row[local_ids][:, None], INF, dr[local_ids])
     # scatter patched band rows (same bucketed shape discipline as
     # EllState.reconverge)
     new_v = tuple(
@@ -228,7 +246,7 @@ def _churn_step(
     )
     dr, digests, packed = _resolve_and_pack(
         lambda t: rs._rev_fixed_point(
-            bands, new_v, new_w, overloaded_new, t, n
+            bands, new_v, new_w, overloaded_new, t, n, init=warm0
         ),
         lambda rows, t: rs._nh_counts(
             rows, bands, new_v, new_w, overloaded_new, t
@@ -242,6 +260,8 @@ def _churn_step(
 # -- mesh-sharded dispatches ----------------------------------------------
 
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+from openr_tpu.utils.jax_compat import shard_map
 
 from openr_tpu.ops.spf_sparse import SOURCES_AXIS  # noqa: E402
 
@@ -306,7 +326,7 @@ def _sharded_full_resident(
         )
         return dr, digests, packed
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
@@ -366,7 +386,7 @@ def _sharded_churn_step(
             sid_r, sv_r, sw_r, pw_r, n, k,
         )
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
@@ -879,7 +899,7 @@ def _sharded_grouped_full_resident(
         )
         return dr, digests, packed
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
@@ -983,7 +1003,7 @@ def _sharded_grouped_churn_step(
             sid_r, sv_r, sw_r, pw_r, n, k,
         )
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(

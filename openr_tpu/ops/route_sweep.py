@@ -108,13 +108,20 @@ def _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids):
     return jnp.concatenate(parts, axis=1)
 
 
-def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None):
+def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None,
+                     init=None):
     """DR rows [B, N] for destination batch ``t_ids`` from unit init.
     ``vote`` lifts the local convergence bit to a global one (psum) for
-    the sharded variant, mirroring spf_sparse._ell_fixed_point."""
+    the sharded variant, mirroring spf_sparse._ell_fixed_point.
+    ``init`` optionally warm-seeds rows with a pointwise upper bound on
+    the new fixed point (e.g. the pre-patch resident rows outside the
+    increase-affected cone); the unit anchor is min-ed in, and the
+    int32 min-relaxation's unique fixed point keeps the result
+    bit-identical to the cold solve."""
     b = t_ids.shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(b), t_ids].set(0)
+    d0 = unit if init is None else jnp.minimum(init, unit)
 
     def cond(state):
         _, changed, it = state
@@ -126,7 +133,7 @@ def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None):
         local = jnp.any(nxt < dr).astype(jnp.int32)
         return nxt, local if vote is None else vote(local), it + 1
 
-    dr, _, _ = jax.lax.while_loop(cond, body, (unit, jnp.int32(1), 0))
+    dr, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
     return dr
 
 
@@ -473,6 +480,8 @@ def all_sources_route_sweep(
 
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
+from openr_tpu.utils.jax_compat import shard_map
+
 from openr_tpu.ops.spf_sparse import SOURCES_AXIS  # noqa: E402
 
 
@@ -492,7 +501,7 @@ def _sharded_route_blocks(
         )
 
     nb = len(v_t)
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(

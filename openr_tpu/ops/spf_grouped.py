@@ -58,6 +58,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from openr_tpu.utils.jax_compat import shard_map
 import numpy as np
 
 from openr_tpu.ops.spf import INF
@@ -745,7 +747,7 @@ def _sharded_grouped_route_blocks(
         )
 
     ns = len(srcs_t)
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(

@@ -45,11 +45,25 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from openr_tpu.utils.jax_compat import shard_map
+
 from openr_tpu.graph.snapshot import pad_patch_rows
 from openr_tpu.ops.spf import INF
 
 _EDGE_PAD = 128
 _NODE_PAD = 128
+
+# Churn-path health counters for the resident-band machinery, surfaced
+# through decision.spf_solver.get_spf_counters() with a "decision."
+# prefix and asserted by the churn smoke test: a refactor that silently
+# knocks the hot path back to full recompiles shows up as
+# ell_incremental_syncs staying flat while ell_cold_solves climbs.
+ELL_COUNTERS: Dict[str, int] = {
+    "ell_incremental_syncs": 0,  # delta scatters into resident bands
+    "ell_warm_solves": 0,        # solves seeded from the previous d
+    "ell_cold_solves": 0,        # solves from the unit init
+    "ell_widen_events": 0,       # widen-on-overflow band re-uploads
+}
 
 
 def _pad_up(n: int, align: int) -> int:
@@ -319,20 +333,23 @@ def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
     to exclude ONE member of a LAG without killing its siblings
     (reference: LinkState.cpp:763 getKthPaths' linksToIgnore).
 
-    Memoized per live graph x (topology version, node, id mapping):
-    every input below (membership, liveness, metrics incl. holds)
-    bumps the topology version when it changes, and churn-path callers
-    re-derive the same high-degree node several times per event
-    (padded patch rows repeat names). Callers must not mutate the
-    list."""
+    Memoized per live graph x (topology version, node): every input
+    below (membership, liveness, metrics incl. holds) bumps the
+    topology version when it changes, and churn-path callers re-derive
+    the same high-degree node several times per event (padded patch
+    rows repeat names). The id mapping is validated by identity on the
+    cached entry rather than keyed by ``id(index)`` — a dict id can be
+    recycled across garbage-collected mappings within one topology
+    version, which would replay slots for the wrong numbering. Callers
+    must not mutate the list."""
     per_ls = _IN_SLOTS_MEMO.get(ls)
     if per_ls is None:
         per_ls = {}
         _IN_SLOTS_MEMO[ls] = per_ls
-    memo_key = (ls.topology_version, name, id(index))
+    memo_key = (ls.topology_version, name)
     cached = per_ls.get(memo_key)
-    if cached is not None:
-        return cached
+    if cached is not None and cached[0] is index:
+        return cached[1]
     slots: List[Tuple[int, int, Tuple]] = []
     for link in ls.ordered_links_from_node(name):
         if not link.is_up():
@@ -346,7 +363,7 @@ def _in_edge_slots(ls, name, index) -> List[Tuple[int, int, Tuple]]:
     slots.sort(key=lambda t: (t[0], t[2]))
     while len(per_ls) > 256:
         per_ls.pop(next(iter(per_ls)))
-    per_ls[memo_key] = slots
+    per_ls[memo_key] = (index, slots)
     return slots
 
 
@@ -579,6 +596,72 @@ def ell_patch(
     )
 
 
+def band_row_edge_delta(
+    old: EllGraph, patched: EllGraph
+) -> List[Tuple[int, int, int]]:
+    """Directed-edge weight INCREASES implied by a patch's changed
+    rows: [(tail id, head id, old collapsed weight)] for every
+    (tail, head) whose min-over-parallel-slots weight went UP (an edge
+    removal reads as old_w -> INF). Decreases are deliberately absent:
+    a min-relaxation warm start only needs the increase-affected cone
+    — decreased rows keep their previous distances as valid upper
+    bounds. O(changed rows x K_class) host work, no band scan."""
+    inc: List[Tuple[int, int, int]] = []
+    changed = patched.changed or {}
+    for bi, rows in changed.items():
+        band = patched.bands[bi]
+        for r in np.asarray(rows):
+            r = int(r)
+            head = band.start + r
+            old_w: Dict[int, int] = {}
+            for s, wv in zip(old.src[bi][r], old.w[bi][r]):
+                s = int(s)
+                wv = int(wv)
+                if s == head or wv >= INF:
+                    continue  # self-loop / INF padding slots
+                if wv < old_w.get(s, INF):
+                    old_w[s] = wv
+            new_w: Dict[int, int] = {}
+            for s, wv in zip(patched.src[bi][r], patched.w[bi][r]):
+                s = int(s)
+                wv = int(wv)
+                if s == head or wv >= INF:
+                    continue
+                if wv < new_w.get(s, INF):
+                    new_w[s] = wv
+            for s, wo in old_w.items():
+                if new_w.get(s, INF) > wo:
+                    inc.append((s, head, wo))
+    return inc
+
+
+# sentinel "increase" edge that flags EVERY row's seed for reset (the
+# tight test d[0] + 0 == d[0] holds unconditionally): encoding a full
+# cold restart as a 1-edge delta keeps the warm and cold paths on ONE
+# compiled executable instead of two
+_FORCE_RESET_EDGE = (0, 0, 0)
+
+
+def pad_increase_edges(
+    inc: List[Tuple[int, int, int]]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pack an increase-edge delta into pow-of-two bucketed arrays
+    (tails, heads, old weights). Padding entries carry w = INF, which
+    the tight test masks out, so every bucket size is one compiled
+    shape."""
+    bucket = 4
+    while bucket < len(inc):
+        bucket *= 2
+    tails = np.zeros(bucket, dtype=np.int32)
+    heads = np.zeros(bucket, dtype=np.int32)
+    ws = np.full(bucket, INF, dtype=np.int32)
+    for x, (t, h, w) in enumerate(inc):
+        tails[x] = t
+        heads[x] = h
+        ws[x] = w
+    return tails, heads, ws
+
+
 def direct_metrics(graph: EllGraph, src_id: int, node_ids) -> np.ndarray:
     """Host-side direct min-metric src_id -> each node in node_ids (INF
     when not adjacent), read from the in-edge bands."""
@@ -611,6 +694,45 @@ def _ell_relax(d, bands, srcs_t, ws_t, overloaded):
         pos += band.rows
     parts.append(d[:, pos:])  # padding columns: unchanged
     return jnp.concatenate(parts, axis=1)
+
+
+def _warm_seed(d_prev, inc_tail, inc_head, inc_w, d0):
+    """Seed the relaxation fixed point from the previous distance rows,
+    resetting only rows in the increase-affected cone.
+
+    Soundness: the masked min-relax closure of any seed S with
+    d* <= S <= d0 equals d* (monotone closure squeezed between the
+    fixed point and the cold init's closure). d0 >= d* always; a
+    previous row d_prev[s] >= d*_new[s] unless some increased edge lay
+    on an old shortest path from s — exactly when the edge was TIGHT
+    under the old distances: d_prev[s, head] == d_prev[s, tail] + w_old.
+    Tight rows restart from the cold init d0; everything else seeds
+    min(d_prev, d0) (the min keeps the unmasked-origination first-hop
+    floor that d_prev already carries and d0 re-derives). Raw (unmasked)
+    old weights make the test conservative under overload masks; mask
+    CHANGES must be forced to a full reset by the caller (the
+    _FORCE_RESET_EDGE sentinel). Bit-identical to a cold solve: int32
+    min-relaxation has a unique fixed point, no float reassociation."""
+    tight = (
+        jnp.minimum(d_prev[:, inc_tail] + inc_w[None, :], INF)
+        == d_prev[:, inc_head]
+    ) & (inc_w[None, :] < INF)
+    reset = jnp.any(tight, axis=1)
+    return jnp.where(reset[:, None], d0, jnp.minimum(d_prev, d0))
+
+
+def _device_direct_metrics(srcs_t, ws_t, srcs, bands):
+    """On-device direct min-metric srcs[0] -> each batch node (INF when
+    not adjacent, and for the source itself) — the resident-band mirror
+    of host direct_metrics + _batch_args, so the fused churn dispatch
+    needs no host band reads at all."""
+    src_id = srcs[0]
+    cols = []
+    for band, s_b, w_b in zip(bands, srcs_t, ws_t):
+        cols.append(jnp.min(jnp.where(s_b == src_id, w_b, INF), axis=1))
+    direct = jnp.concatenate(cols)  # [real rows]
+    w_sv = direct[srcs]
+    return jnp.where(srcs == src_id, INF, w_sv).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("bands", "n"))
@@ -662,9 +784,22 @@ def _first_hops_from_rows(d, srcs, w_sv, overloaded, n):
     return (transit_ok | direct_ok) & reachable[None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("bands", "n"),
+    # the previous bands and distance rows are dead after the call —
+    # donating them lets XLA scatter/relax in place instead of copying
+    # multi-hundred-MB band+distance blocks every churn event
+    donate_argnums=(0, 1, 9),
+)
 def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
-                    overloaded, srcs, w_sv, bands, n):
+                    inc_tail, inc_head, inc_w, overloaded, d_prev,
+                    srcs, bands, n):
+    """Fused churn executable: scatter the patched rows, derive the
+    direct metrics on device, warm-seed the fixed point from d_prev
+    (reset only the increase cone), pack distances + first hops.
+    Only the O(rows x K) patch + O(|delta|) increase edges cross
+    host->device; only the packed [2B, N] view crosses back."""
     new_src = tuple(
         s.at[ids, :].set(ps)
         for s, ids, ps in zip(srcs_t, patch_ids_t, patch_src_t)
@@ -673,10 +808,27 @@ def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
         w.at[ids, :].set(pw)
         for w, ids, pw in zip(ws_t, patch_ids_t, patch_w_t)
     )
-    packed = _ell_view_batch(
-        new_src, new_w, overloaded, srcs, w_sv, bands, n
-    )
-    return new_src, new_w, packed
+    w_sv = _device_direct_metrics(new_src, new_w, srcs, bands)
+    b = srcs.shape[0]
+    unit = jnp.full((b, n), INF, dtype=jnp.int32)
+    unit = unit.at[jnp.arange(b), srcs].set(0)
+    no_overload = jnp.zeros_like(overloaded)
+    d0 = _ell_relax(unit, bands, new_src, new_w, no_overload)
+    seed = _warm_seed(d_prev, inc_tail, inc_head, inc_w, d0)
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        d, _, it = state
+        nxt = _ell_relax(d, bands, new_src, new_w, overloaded)
+        return nxt, jnp.any(nxt < d), it + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (seed, jnp.bool_(True), 0))
+    fh = _first_hops_from_rows(d, srcs, w_sv, overloaded, n)
+    packed = jnp.concatenate([d, fh.astype(jnp.int32)], axis=0)
+    return new_src, new_w, packed, d
 
 
 def _batch_args(graph: EllGraph, srcs):
@@ -722,19 +874,25 @@ def ell_source_batch(graph: EllGraph, ls, src_name: str):
 
 
 def _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n,
-                     vote=None):
+                     vote=None, warm=None):
     """Shared ELL relaxation fixed-point: distances [S, N] from unit
     init. ``vote`` turns the local convergence bit into the global
     stop condition (identity when None; a psum over the mesh axis for
     the sharded variant — every device iterates until ALL shards
     converge; the relaxation is idempotent past the fixed point).
     Init rows are one UNMASKED relax so overloaded sources still
-    originate (reference: LinkState.cpp:831-838)."""
+    originate (reference: LinkState.cpp:831-838). ``warm`` is an
+    optional (d_prev, inc_tail, inc_head, inc_w) tuple: seed from the
+    previous distances via _warm_seed (bit-identical fixed point,
+    fewer iterations under churn)."""
     s = src_ids.shape[0]
     unit = jnp.full((s, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(s), src_ids].set(0)
     no_overload = jnp.zeros_like(overloaded)
     d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload)
+    if warm is not None:
+        d_prev, inc_tail, inc_head, inc_w = warm
+        d0 = _warm_seed(d_prev, inc_tail, inc_head, inc_w, d0)
 
     def cond(state):
         _, changed, it = state
@@ -1025,19 +1183,68 @@ class EllState:
         self.src = tuple(jnp.asarray(s) for s in graph.src)
         self.w = tuple(jnp.asarray(w) for w in graph.w)
         self.overloaded = jnp.asarray(graph.overloaded)
+        # warm-start state: the previous solve's distance rows plus the
+        # source batch they belong to, and at most ONE un-solved patch's
+        # increase-edge delta (pending_inc). Tight tests are only sound
+        # against the distance snapshot the old weights were read under,
+        # so a SECOND patch before a solve degrades to a forced reset
+        # instead of chaining stale tests.
+        self._d_dev = None
+        self._warm_key: Optional[Tuple[int, ...]] = None
+        self._pending_inc: List[Tuple[int, int, int]] = []
+        # True once ANY un-solved patch is journaled — tracked
+        # separately from _pending_inc because a pure-decrease patch
+        # journals an EMPTY increase delta yet still moves the weight
+        # snapshot (a later increase of an edge this patch decreased
+        # would test tightness against distances the old weight was
+        # never read under)
+        self._pending_patch = False
+        self._pending_force = False
 
-    def _sync_overloaded(self, patched: EllGraph) -> None:
-        if not np.array_equal(self.graph.overloaded, patched.overloaded):
+    def _sync_overloaded(self, patched: EllGraph) -> bool:
+        changed = not np.array_equal(
+            self.graph.overloaded, patched.overloaded
+        )
+        if changed:
             self.overloaded = jnp.asarray(patched.overloaded)
+        return changed
+
+    def _note_patch(self, patched: EllGraph, ov_changed: bool) -> None:
+        """Fold one patch's delta into the warm-start journal."""
+        if patched.changed:
+            ELL_COUNTERS["ell_incremental_syncs"] += 1
+        if patched.widened:
+            ELL_COUNTERS["ell_widen_events"] += len(patched.widened)
+        if self._d_dev is None:
+            return
+        if ov_changed:
+            # the tight test runs on RAW weights; it is not valid
+            # across an effective-weight (overload mask) change
+            self._pending_force = True
+            return
+        if not patched.changed:
+            return  # no-op sync: the journal is untouched
+        if self._pending_patch or self._pending_force:
+            # a second patch stacked on an un-solved one: the tight
+            # test is only sound against the distance snapshot the old
+            # weights were read under — fall back to a forced cold seed
+            self._pending_force = True
+        else:
+            self._pending_inc = band_row_edge_delta(self.graph, patched)
+            self._pending_patch = True
 
     def apply_patch(self, patched: EllGraph) -> None:
         """Scatter a patched graph's changed rows into the resident
         bands WITHOUT solving (for consumers that only need synced
-        device bands, e.g. the KSP2 masked batches). A WIDENED band
+        device bands, e.g. the KSP2 masked batches, and the decision
+        module's publication-time prewarm). A WIDENED band
         (ell_patch(widen=True) grew its k — a row outgrew its slot
         class) changed tensor SHAPE and is re-uploaded wholesale; node
         ids are unchanged, so every id-keyed resident consumer stays
-        valid."""
+        valid. The increase delta is journaled so a later reconverge
+        can still warm-start across the un-solved patch."""
+        ov_changed = self._sync_overloaded(patched)
+        self._note_patch(patched, ov_changed)
         in_src, in_w, patch_ids, patch_src, patch_w = (
             band_patch_inputs(self.src, self.w, patched)
         )
@@ -1051,29 +1258,54 @@ class EllState:
             w.at[ids, :].set(vals)
             for w, ids, vals in zip(in_w, patch_ids, patch_w)
         )
-        self._sync_overloaded(patched)
-        # rows are applied: clear the journal so a later reconverge
-        # doesn't scatter them again
         self.graph = _replace(patched, changed=None)
 
     def reconverge(self, patched: EllGraph, srcs):
         """Fused churn step: scatter the patched rows into the resident
-        bands, solve the batched view. O(rows x K_class) transfer.
-        Widened bands (shape changed) are re-uploaded wholesale as the
-        dispatch inputs with a no-op scatter — same discipline as
-        apply_patch; the new band shapes cost one jit recompile."""
+        bands, solve the batched view warm-started from the previous
+        solve's distances (bit-identical to cold — see _warm_seed),
+        O(rows x K_class + |delta|) transfer in, O(B x N) out. Widened
+        bands (shape changed) are re-uploaded wholesale as the dispatch
+        inputs with a no-op scatter — same discipline as apply_patch;
+        the new band shapes cost one jit recompile."""
+        ov_changed = self._sync_overloaded(patched)
+        self._note_patch(patched, ov_changed)
         in_src, in_w, patch_ids, patch_src, patch_w = (
             band_patch_inputs(self.src, self.w, patched)
         )
-        srcs_dev, w_sv = _batch_args(patched, srcs)
-        self._sync_overloaded(patched)
-        self.src, self.w, packed = _ell_reconverge(
+        srcs_key = tuple(int(s) for s in srcs)
+        b = len(srcs_key)
+        warm = (
+            self._d_dev is not None
+            and self._warm_key == srcs_key
+            and not self._pending_force
+        )
+        if warm:
+            inc = list(self._pending_inc)
+            d_prev = self._d_dev
+            ELL_COUNTERS["ell_warm_solves"] += 1
+        else:
+            inc = [_FORCE_RESET_EDGE]
+            d_prev = (
+                self._d_dev
+                if self._d_dev is not None
+                and self._d_dev.shape == (b, patched.n_pad)
+                else jnp.zeros((b, patched.n_pad), dtype=jnp.int32)
+            )
+            ELL_COUNTERS["ell_cold_solves"] += 1
+        inc_t, inc_h, inc_w = pad_increase_edges(inc)
+        srcs_dev = jnp.asarray(np.asarray(srcs, dtype=np.int32))
+        self.src, self.w, packed, d = _ell_reconverge(
             in_src, in_w, patch_ids, patch_src, patch_w,
-            self.overloaded, srcs_dev, w_sv,
+            jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w),
+            self.overloaded, d_prev, srcs_dev,
             patched.bands, patched.n_pad,
         )
-        # rows are applied: clear the journal (mirrors apply_patch) so a
-        # later same-version dispatch doesn't re-scatter stale rows
+        self._d_dev = d
+        self._warm_key = srcs_key
+        self._pending_inc = []
+        self._pending_patch = False
+        self._pending_force = False
         self.graph = _replace(patched, changed=None)
         return packed
 
@@ -1083,10 +1315,14 @@ def ell_reconverge_step(state: EllState, patched: EllGraph, srcs):
     return state.reconverge(patched, srcs)
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("bands", "n"),
+    donate_argnums=(6,),  # d_prev: dead after the call, relax in place
+)
 def _ell_all_view_rows(
     srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
-    bands, n,
+    inc_tail, inc_head, inc_w, bands, n,
 ):
     """One fused dispatch for the incremental-KSP2 churn step at
     moderate N (n_pad <= ~4k, where a full all-sources block fits):
@@ -1103,10 +1339,14 @@ def _ell_all_view_rows(
     keeps D resident for the next event. On relay-backed platforms each
     extra readback costs a ~70ms round trip, so fusing the view and the
     invalidation rows into the same transfer is what keeps a churn
-    rebuild near the single-round-trip floor."""
+    rebuild near the single-round-trip floor. The fixed point is
+    warm-seeded from ``d_prev`` with the increase-edge delta
+    (inc_tail/inc_head/inc_w — see _warm_seed; callers pass the
+    _FORCE_RESET_EDGE sentinel for cold semantics)."""
     d_all = _ell_fixed_point(
         srcs_t, ws_t, overloaded,
         jnp.arange(n, dtype=jnp.int32), bands, n,
+        warm=(d_prev, inc_tail, inc_head, inc_w),
     )
 
     # view from D rows (shared first-hop algebra with _ell_view_batch)
@@ -1125,10 +1365,15 @@ def _ell_all_view_rows(
     return d_all, packed
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n", "k_budget"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("bands", "n", "k_budget"),
+    donate_argnums=(6, 11),  # d_prev, dm_old: dead after the call
+)
 def _ell_all_view_rows_masked(
     srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
-    masks_t, dm_old, src_id, bands, n, k_budget,
+    inc_tail, inc_head, inc_w, masks_t, dm_old, src_id, bands, n,
+    k_budget,
 ):
     """The 1-round-trip incremental-KSP2 dispatch: everything
     _ell_all_view_rows computes PLUS a speculative masked re-solve of
@@ -1148,10 +1393,15 @@ def _ell_all_view_rows_masked(
     exactly those in a follow-up dispatch and scatters the corrections
     into the resident matrix. For every other destination the
     speculative row is exact, which is what turns the common
-    metric-churn event into ONE device round trip."""
+    metric-churn event into ONE device round trip. The all-sources
+    fixed point is warm-seeded from ``d_prev`` (cold when the caller
+    passes the _FORCE_RESET_EDGE sentinel); the masked second-path
+    solve stays cold — its masks change shape with the first paths, so
+    a previous dm row is not a sound upper bound."""
     d_all = _ell_fixed_point(
         srcs_t, ws_t, overloaded,
         jnp.arange(n, dtype=jnp.int32), bands, n,
+        warm=(d_prev, inc_tail, inc_head, inc_w),
     )
     d = d_all[view_srcs]
     fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
@@ -1186,12 +1436,26 @@ def _ell_all_view_rows_masked(
     return d_all, dm_new, packed
 
 
+def _inc_args(inc):
+    """Device increase-edge triple for the warm-seeded dispatches:
+    ``inc=None`` means cold semantics (the reset sentinel flags every
+    row); an (possibly empty) increase list warm-starts."""
+    inc_t, inc_h, inc_w = pad_increase_edges(
+        [_FORCE_RESET_EDGE] if inc is None else list(inc)
+    )
+    return jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w)
+
+
 def ell_all_view_rows_masked(
     state: EllState, view_srcs, w_sv, ep_ids, d_prev,
-    masks_t, dm_old, src_id: int, k_budget: int,
+    masks_t, dm_old, src_id: int, k_budget: int, inc=None,
 ):
     """Run the fused 1-RTT dispatch on the resident bands. Returns
-    (d_all_dev, dm_new_dev, packed_host)."""
+    (d_all_dev, dm_new_dev, packed_host). ``inc`` is the increase-edge
+    delta [(tail, head, old_w)] for warm seeding — None forces the
+    cold seed; d_prev and dm_old are DONATED (invalid after the
+    call)."""
+    inc_t, inc_h, inc_w = _inc_args(inc)
     d_all, dm_new, packed = _ell_all_view_rows_masked(
         state.src, state.w, state.overloaded,
         _as_device_ids(view_srcs),
@@ -1199,15 +1463,18 @@ def ell_all_view_rows_masked(
             np.asarray(w_sv, dtype=np.int32)
         ),
         _as_device_ids(ep_ids),
-        d_prev, masks_t, dm_old, src_id,
+        d_prev, inc_t, inc_h, inc_w, masks_t, dm_old, src_id,
         state.graph.bands, state.graph.n_pad, k_budget,
     )
     return d_all, dm_new, np.asarray(packed)
 
 
-def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev):
+def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev,
+                      inc=None):
     """Run the fused all-sources + view + invalidation-rows dispatch on
-    the resident bands. Returns (d_all_dev, packed_host)."""
+    the resident bands. Returns (d_all_dev, packed_host). ``inc`` as in
+    ell_all_view_rows_masked; d_prev is DONATED."""
+    inc_t, inc_h, inc_w = _inc_args(inc)
     d_all, packed = _ell_all_view_rows(
         state.src, state.w, state.overloaded,
         _as_device_ids(view_srcs),
@@ -1215,7 +1482,7 @@ def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev):
             np.asarray(w_sv, dtype=np.int32)
         ),
         _as_device_ids(ep_ids),
-        d_prev,
+        d_prev, inc_t, inc_h, inc_w,
         state.graph.bands, state.graph.n_pad,
     )
     return d_all, np.asarray(packed)
@@ -1247,7 +1514,7 @@ def _sharded_sparse(
         d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
         return d
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -1288,7 +1555,7 @@ def _sharded_ell(src_ids, srcs_t, ws_t, overloaded, bands, n, mesh):
             vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
         )
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(SOURCES_AXIS), P(None), P(None), P(None)),
@@ -1311,7 +1578,7 @@ def _sharded_ell_masked(
         )
 
     nb = len(masks_t)
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
@@ -1394,7 +1661,7 @@ def _sharded_ell_all_view_rows(
             vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
         )
 
-    d_all = jax.shard_map(
+    d_all = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(

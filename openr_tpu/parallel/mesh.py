@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from openr_tpu.utils.jax_compat import shard_map
+
 from openr_tpu.ops.spf import INF, _mask_transit_rows, _minplus
 
 SOURCES_AXIS = "sources"
@@ -80,7 +82,7 @@ def sharded_all_sources(
         d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.int32(1), 0))
         return d
 
-    return jax.shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(SOURCES_AXIS, None), P(None, None)),
@@ -110,7 +112,7 @@ def sharded_reconvergence_step(
         masked = jnp.where(mask[None, :, :], d_blk[:, None, :], INF)
         return jnp.min(masked, axis=2)
 
-    best = jax.shard_map(
+    best = shard_map(
         reduce_fn,
         mesh=mesh,
         in_specs=(P(SOURCES_AXIS, None), P(None, None)),

@@ -9,6 +9,12 @@ every process at one on-disk directory makes the second process skip
 straight to execution — measured through the axon relay: a cold 10.1 s
 toy compile replayed in 2.4 s. CPU test runs benefit the same way.
 
+The default location is the per-user cache (~/.cache/openr_tpu/jax, or
+$XDG_CACHE_HOME/openr_tpu/jax) so every checkout and bench worktree
+shares one warm cache; when the home directory is unwritable (hermetic
+CI sandboxes) it falls back to a repo-local .jax_cache. The cache grows
+without bound — see docs/RUNBOOK.md for the growth/pruning note.
+
 Opt-out: set OPENR_TPU_NO_COMPILE_CACHE=1 (e.g. to measure true
 cold-compile latency).
 """
@@ -17,10 +23,24 @@ from __future__ import annotations
 
 import os
 
-_DEFAULT_DIR = os.path.join(
+_REPO_FALLBACK_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
     ".jax_cache",
 )
+
+
+def default_dir() -> str:
+    """Per-user cache dir, falling back to the repo checkout when the
+    user cache root cannot be created."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    path = os.path.join(base, "openr_tpu", "jax")
+    try:
+        os.makedirs(path, exist_ok=True)
+        return path
+    except OSError:
+        return _REPO_FALLBACK_DIR
 
 
 def enable(cache_dir: str | None = None) -> bool:
@@ -35,7 +55,7 @@ def enable(cache_dir: str | None = None) -> bool:
     path = (
         cache_dir
         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or _DEFAULT_DIR
+        or default_dir()
     )
     try:
         os.makedirs(path, exist_ok=True)

@@ -1,0 +1,18 @@
+"""Version-portable access to jax APIs that moved between releases."""
+
+import functools
+
+import jax
+
+try:
+    shard_map = jax.shard_map  # promoted to the top level in newer jax
+except AttributeError:  # jax 0.4/0.5: still under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        # the experimental version has no replication rule for
+        # while_loop (every mesh kernel here runs one); the promoted
+        # API dropped that static check entirely
+        kwargs.setdefault("check_rep", False)
+        return _shard_map(*args, **kwargs)

@@ -1,0 +1,51 @@
+"""Fast churn-path smoke: the incremental device pipeline must actually
+engage. A refactor that silently demotes every churn event to a full
+recompile (or every solve to a cold seed) passes the parity suites while
+giving up the entire reconvergence speedup — this guard fails CI when
+the counters read zero. Runs under ``-m 'not slow'``; see also
+``make churn-smoke``."""
+
+from __future__ import annotations
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.spf_solver import SpfSolver, get_spf_counters
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from tests.test_sp_route_reuse import _mutate_metric
+
+
+def test_churn_engages_incremental_path(monkeypatch):
+    from openr_tpu.decision import spf_solver as ss
+
+    monkeypatch.setattr(ss, "SPARSE_NODE_THRESHOLD", 4)
+    topo = topologies.fat_tree(
+        pods=2, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=3
+    )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    ps = PrefixState()
+    for pdb in topo.prefix_dbs.values():
+        ps.update_prefix_database(pdb)
+    area_ls = {topo.area: ls}
+    root = sorted(topo.adj_dbs)[0]
+    solver = SpfSolver(root, backend="device")
+
+    solver.build_route_db(root, area_ls, ps)  # cold build
+    before = get_spf_counters()
+    fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+    for step in range(5):
+        _mutate_metric(ls, fsw, 0, 2 + step)
+        solver.build_route_db(root, area_ls, ps)
+    after = get_spf_counters()
+
+    def delta(key):
+        return after.get(key, 0) - before.get(key, 0)
+
+    # every pure-metric event must ride the patch path...
+    assert delta("decision.ell_patches") >= 5
+    assert delta("decision.ell_incremental_syncs") >= 5
+    # ...with zero full recompiles...
+    assert delta("decision.ell_full_compiles") == 0
+    # ...and the solves must warm-start, not silently reset
+    assert delta("decision.ell_warm_solves") >= 4
