@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 churn-smoke bench clean install
+.PHONY: all native test test-fast tier1 churn-smoke telemetry-smoke bench clean install
 
 all: native
 
@@ -39,6 +39,12 @@ tier1: native
 # pipeline regresses to zero incremental syncs / warm solves
 churn-smoke: native
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py -q -m "not slow"
+
+# observability gate: small churn scenario through the real pipeline;
+# fails if any registered histogram is empty, any trace span is left
+# unclosed, or fewer complete publication->FIB traces than events
+telemetry-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.telemetry_smoke
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

@@ -80,6 +80,12 @@ def _run() -> dict:
     from openr_tpu.utils.compile_cache import enable as _enable_cache
 
     _enable_cache()
+    # jit compile count/time listeners: a compile-cache regression in
+    # any leg shows up as jax.compile_count / jax.compile_ms in the
+    # artifact instead of a silent latency cliff
+    from openr_tpu.telemetry import jax_hooks as _jax_hooks
+
+    _jax_hooks.install()
 
     import jax
     import jax.numpy as jnp
@@ -393,6 +399,38 @@ def _run() -> dict:
             except Exception as e:
                 bench_spsolver = {"error": f"{type(e).__name__}: {e}"}
 
+    # seventh leg: convergence tracing through the REAL module pipeline
+    # (KvStore -> Decision -> Fib) with the telemetry spine on — the
+    # per-event publication->FIB latency distribution plus the trace
+    # artifact the north-star claim is audited against. Scale rides the
+    # same env gate as the 10k churn leg; the artifact lands next to
+    # this file so the watcher can collect it.
+    bench_traces = None
+    if os.environ.get("OPENR_BENCH_TRACES") == "1":
+        if leg_elapsed() > 420:
+            bench_traces = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import (
+                    convergence_trace_bench,
+                )
+
+                trace_nodes = int(
+                    os.environ.get("OPENR_BENCH_TRACE_NODES", "1000")
+                )
+                bench_traces = convergence_trace_bench(
+                    trace_nodes,
+                    6,
+                    trace_path=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "churn_traces.jsonl",
+                    ),
+                )
+            except Exception as e:
+                bench_traces = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -465,12 +503,32 @@ def _run() -> dict:
         "bench_route_sweep": bench_routes,
         "bench_route_engine_churn": bench_rchurn,
         "bench_sp_solver_churn": bench_spsolver,
+        "bench_convergence_trace": bench_traces,
+        # per-event convergence-latency distribution from the telemetry
+        # registry (convergence.e2e_ms feeds from every finished trace;
+        # the solver-leg histograms ride along) — the artifact's
+        # DeltaPath-style account next to the aggregate medians
+        "latency_histograms": _histogram_snapshot(),
         # merged solver + resident-band counters accumulated across
         # every leg above — the churn-path health record (incremental
         # syncs, warm/cold solve split, widen and prewarm events)
         "spf_counters": _spf_counter_snapshot(),
         "error": None,
     }
+
+
+def _histogram_snapshot() -> dict:
+    """Every non-empty registry histogram, expanded to percentiles."""
+    try:
+        from openr_tpu.telemetry import get_registry
+
+        out = {}
+        for h in get_registry().histograms().values():
+            if h.count:
+                out.update(h.stats())
+        return out
+    except Exception:
+        return {}
 
 
 def _spf_counter_snapshot() -> dict:
@@ -515,10 +573,12 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_10K"] = "1"
         env["OPENR_BENCH_KSP2"] = "1"
         env["OPENR_BENCH_ROUTES"] = "1"
+        env["OPENR_BENCH_TRACES"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
         env.pop("OPENR_BENCH_ROUTES", None)
+        env.pop("OPENR_BENCH_TRACES", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

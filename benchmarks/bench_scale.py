@@ -78,6 +78,30 @@ def _chained_device_only_ms(step, readback, k: int = 4,
     return round(max(0.0, (tk - t1) / (k - 1)), 3)
 
 
+def _latency_percentiles(samples) -> dict:
+    """Nearest-rank p50/p95/p99 for a per-event latency sample list —
+    the DeltaPath-style distribution account every churn leg reports
+    alongside its median (means hide the warm/cold split)."""
+    if not samples:
+        return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+    import math
+
+    ordered = sorted(samples)
+    n = len(ordered)
+
+    def rank(q: float) -> float:
+        # nearest-rank: ceil(q*n)-th smallest, 1-indexed
+        return round(
+            ordered[min(n - 1, max(0, math.ceil(q * n) - 1))], 3
+        )
+
+    return {
+        "p50_ms": rank(0.50),
+        "p95_ms": rank(0.95),
+        "p99_ms": rank(0.99),
+    }
+
+
 def churn_bench(nodes: int, churn_events: int) -> dict:
     """Incremental reconvergence under link-flap churn at ``nodes`` scale
     (BASELINE.json config 4) over the resident ELL graph: per event the
@@ -174,6 +198,7 @@ def churn_bench(nodes: int, churn_events: int) -> dict:
         "p90_ms": round(
             sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
         ),
+        **_latency_percentiles(samples),
         "device_only_ms": device_only,
         "host_overhead_ms": round(max(0.0, median - device_only), 3),
         "incremental_syncs": c1["ell_incremental_syncs"]
@@ -191,6 +216,184 @@ def run_churn(args):
         json.dumps(churn_bench(args.nodes, args.churn_events)),
         flush=True,
     )
+
+
+def convergence_trace_bench(
+    nodes: int,
+    churn_events: int = 6,
+    trace_path: str = "",
+    solver_backend: str = "device",
+) -> dict:
+    """Per-event convergence latency through the REAL module pipeline —
+    KvStore publication -> Decision debounce + solve -> Fib program —
+    with the telemetry tracer accounting every stage. Unlike the
+    solver-only churn legs this measures the daemon path the north-star
+    claim is actually about, and emits the trace artifact the claim can
+    be audited against (``trace_path``: JSONL, one trace per line,
+    loadable span-by-span; plus ``<trace_path>.chrome.json`` for
+    chrome://tracing / Perfetto)."""
+    import os
+    from dataclasses import replace
+
+    import jax
+
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.fib.fib import Fib
+    from openr_tpu.kvstore.wrapper import KvStoreWrapper
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.platform.fib_service import MockFibAgent
+    from openr_tpu.telemetry import get_registry, get_tracer
+    from openr_tpu.types import (
+        DEFAULT_AREA,
+        TTL_INFINITY,
+        KeySetParams,
+        Value,
+    )
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    topo = topologies.fat_tree_nodes(nodes)
+    rsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("rsw"))
+    fsw = next(k for k in sorted(topo.adj_dbs) if k.startswith("fsw"))
+
+    store = KvStoreWrapper(f"bench:{rsw}")
+    route_q = ReplicateQueue(name="routeUpdates")
+    decision = Decision(
+        rsw,
+        kvstore_updates_queue=store.store.updates_queue,
+        route_updates_queue=route_q,
+        debounce_min_s=0.01,
+        debounce_max_s=0.25,
+        solver_backend=solver_backend,
+    )
+    fib = Fib(rsw, MockFibAgent(), route_q, keepalive_interval_s=30.0)
+    tracer = get_tracer()
+    n_ring0 = len(tracer.traces())
+
+    versions: dict = {}
+
+    def publish(key: str, payload: bytes, originator: str) -> None:
+        v = versions[key] = versions.get(key, 0) + 1
+        store.set_key(key, payload, version=v, originator=originator)
+
+    def wait_until(pred, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.005)
+        return pred()
+
+    store.start()
+    decision.start()
+    fib.start()
+    try:
+        # BULK initial load: one set_key_vals publication for the whole
+        # topology so Decision sees ONE debounce window and does ONE
+        # full cold build — per-key publishing at 10k+ nodes streams for
+        # minutes, each debounce firing a partial-topology rebuild (and
+        # a fresh jit compile at that partial shape)
+        initial: dict = {}
+        for name in sorted(topo.adj_dbs):
+            key = keyutil.adj_key(name)
+            payload = wire.dumps(topo.adj_dbs[name])
+            versions[key] = 1
+            initial[key] = Value(
+                version=1,
+                originator_id=name,
+                value=payload,
+                ttl=TTL_INFINITY,
+                hash=wire.generate_hash(1, name, payload),
+            )
+        for name in sorted(topo.prefix_dbs):
+            key = keyutil.prefix_db_key(name)
+            payload = wire.dumps(topo.prefix_dbs[name])
+            versions[key] = 1
+            initial[key] = Value(
+                version=1,
+                originator_id=name,
+                value=payload,
+                ttl=TTL_INFINITY,
+                hash=wire.generate_hash(1, name, payload),
+            )
+        store.store.set_key_vals(
+            DEFAULT_AREA, KeySetParams(key_vals=initial)
+        )
+        # initial convergence (includes the solver's first compiles)
+        assert wait_until(
+            lambda: len(fib.get_route_db().unicast_routes) > 0, 1800.0
+        ), "initial convergence timed out"
+        # settle any still-debouncing startup publications
+        wait_until(lambda: False, 0.6)
+
+        n_before = len(tracer.traces())
+        for step in range(churn_events):
+            db = topo.adj_dbs[fsw]
+            adjs = list(db.adjacencies)
+            adjs[0] = replace(adjs[0], metric=2 + step % 5)
+            db = replace(db, adjacencies=tuple(adjs))
+            topo.adj_dbs[fsw] = db
+            want = len(tracer.traces())
+            publish(keyutil.adj_key(fsw), wire.dumps(db), fsw)
+            # one traced publication -> FIB cycle per event: wait for
+            # the trace to retire before the next churn so debounce
+            # merges never collapse the sample count
+            assert wait_until(
+                lambda: len(tracer.traces()) > want, 120.0
+            ), f"churn event {step} never completed a trace"
+    finally:
+        fib.stop()
+        decision.stop()
+        store.stop()
+
+    churn_traces = tracer.traces()[n_before:]
+    complete = [t for t in churn_traces if t.complete and t.well_formed()]
+    e2e = [t.e2e_ms for t in complete if t.e2e_ms is not None]
+
+    artifact = None
+    if trace_path:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(trace_path)), exist_ok=True
+        )
+        with open(trace_path, "w") as f:
+            f.write(
+                "\n".join(
+                    json.dumps(t.to_dict()) for t in churn_traces
+                )
+                + "\n"
+            )
+        with open(trace_path + ".chrome.json", "w") as f:
+            json.dump(tracer.chrome_trace(), f)
+        artifact = trace_path
+
+    span_ms = {}
+    for span_name in ("decision.debounce", "decision.rebuild", "fib.program"):
+        durs = [
+            s.dur_ms
+            for t in complete
+            for s in t.spans
+            if s.name == span_name and s.dur_ms is not None
+        ]
+        if durs:
+            span_ms[span_name] = _latency_percentiles(durs)
+
+    snap = get_registry().snapshot()
+    return {
+        "bench": f"scale.convergence_trace_{nodes}_nodes",
+        "events": churn_events,
+        "traces_complete": len(complete),
+        "traces_incomplete": len(churn_traces) - len(complete),
+        "unclosed_spans": snap.get("telemetry.traces_unclosed_spans", 0),
+        "median_ms": (
+            round(sorted(e2e)[len(e2e) // 2], 3) if e2e else None
+        ),
+        **_latency_percentiles(e2e),
+        "span_ms": span_ms,
+        "trace_artifact": artifact,
+        "platform": jax.devices()[0].platform,
+        "solver_backend": solver_backend,
+        "ring_total": len(tracer.traces()) - n_ring0,
+    }
 
 
 def ksp2_churn_bench(nodes: int, churn_events: int,
@@ -321,6 +524,7 @@ def ksp2_churn_bench(nodes: int, churn_events: int,
         "p90_ms": round(
             sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
         ),
+        **_latency_percentiles(samples),
         "cold_build_ms": round(cold_ms, 1),
         "platform": jax.devices()[0].platform,
         "ksp2_host_fallbacks": SPF_COUNTERS[
@@ -808,6 +1012,7 @@ def route_engine_churn_bench(
         "p90_ms": round(
             sorted(samples)[max(0, -(-len(samples) * 9 // 10) - 1)], 1
         ),
+        **_latency_percentiles(samples),
         "cold_build_ms": round(cold_ms, 1),
         "affected_dsts_median": (
             int(statistics.median(incr))
@@ -853,6 +1058,14 @@ def main(argv=None):
     p.add_argument("--routes", action="store_true",
                    help="all-sources sweep with on-device route "
                         "selection (digest + sample readback only)")
+    p.add_argument("--traces", action="store_true",
+                   help="convergence-trace leg: churn through the real "
+                        "KvStore->Decision->Fib pipeline with the "
+                        "telemetry tracer on, emitting a per-event "
+                        "trace artifact + latency percentiles")
+    p.add_argument("--trace-path", default="churn_traces.jsonl",
+                   help="traces leg: JSONL artifact path (a "
+                        ".chrome.json twin is written next to it)")
     p.add_argument("--solver-churn", action="store_true",
                    help="full SpfSolver churn rebuild of one node's "
                         "RouteDb (the north-star framing)")
@@ -869,6 +1082,17 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.churn:
         run_churn(args)
+        return
+    if args.traces:
+        print(
+            json.dumps(
+                convergence_trace_bench(
+                    args.nodes, args.churn_events,
+                    trace_path=args.trace_path,
+                )
+            ),
+            flush=True,
+        )
         return
     if args.solver_churn:
         print(

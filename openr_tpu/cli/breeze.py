@@ -355,6 +355,37 @@ class Breeze:
         for raw in logs:
             self._print(raw if isinstance(raw, str) else json.dumps(raw))
 
+    def monitor_traces(
+        self, limit: int = 20, fmt: str = "table"
+    ) -> None:
+        """Completed publication->FIB convergence traces. "table" for a
+        per-trace span summary; "jsonl"/"chrome" dump the raw artifact
+        (chrome loads in chrome://tracing or ui.perfetto.dev)."""
+        if fmt in ("jsonl", "chrome"):
+            out = self.client.call("get_traces", limit=limit, fmt=fmt)
+            self._print(
+                out if isinstance(out, str) else json.dumps(out)
+            )
+            return
+        traces = self.client.call("get_traces", limit=limit)
+        rows = []
+        for t in traces:
+            spans = " > ".join(
+                "  " * s["depth"] + f"{s['name']}={s['dur_ms']}ms"
+                for s in t["spans"]
+            )
+            rows.append(
+                (
+                    t["trace_id"],
+                    "ok" if t["complete"] else "INCOMPLETE",
+                    t["e2e_ms"],
+                    spans,
+                )
+            )
+        self._print(
+            render_table(["Trace", "State", "e2e_ms", "Spans"], rows)
+        )
+
     # -- openr ------------------------------------------------------------
 
     def openr_version(self) -> None:
@@ -607,6 +638,14 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_parser("counters")
     logs = m.add_parser("logs")
     logs.add_argument("--limit", type=int, default=20)
+    traces = m.add_parser("traces")
+    traces.add_argument("--limit", type=int, default=20)
+    traces.add_argument(
+        "--format",
+        dest="fmt",
+        choices=("table", "jsonl", "chrome"),
+        default="table",
+    )
 
     o = group("openr")
     o.add_parser("version")
@@ -707,6 +746,9 @@ def run(argv: List[str], client=None, out=None) -> int:
         ),
         "monitor.counters": breeze.monitor_counters,
         "monitor.logs": lambda: breeze.monitor_logs(args.limit),
+        "monitor.traces": lambda: breeze.monitor_traces(
+            args.limit, args.fmt
+        ),
         "openr.version": breeze.openr_version,
         "openr.config": breeze.openr_config,
         "perf.fib": breeze.perf_fib,

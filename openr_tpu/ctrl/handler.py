@@ -142,7 +142,14 @@ class OpenrCtrlHandler:
         return self._config_store.erase(key)
 
     def get_counters(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
+        # start from the process-wide telemetry registry snapshot (the
+        # store of record for SPF/ELL counters, latency histograms,
+        # trace health, and jax compile metrics), then fold in the
+        # module-local counter dicts — same order Monitor.get_counters
+        # uses, so `breeze monitor counters` and this API agree
+        from openr_tpu.telemetry import get_registry
+
+        out: Dict[str, Any] = dict(get_registry().snapshot())
         for module in (
             self._kvstore,
             self._decision,
@@ -398,6 +405,21 @@ class OpenrCtrlHandler:
     def get_perf_db(self):
         """reference: if/OpenrCtrl.thrift:312 getPerfDb."""
         return self._fib.evb.call_and_wait(lambda: list(self._fib.perf_db))
+
+    def get_traces(
+        self, limit: int = 20, fmt: str = "dict"
+    ) -> Any:
+        """Completed publication->FIB telemetry traces from the
+        process-wide ring (newest last). fmt: "dict" (list of trace
+        dicts), "jsonl", or "chrome" (one traceEvents document)."""
+        from openr_tpu.telemetry import get_tracer
+
+        tracer = get_tracer()
+        if fmt == "chrome":
+            return tracer.chrome_trace(limit)
+        if fmt == "jsonl":
+            return tracer.jsonl(limit)
+        return [t.to_dict() for t in tracer.traces(limit)]
 
     # -- LinkMonitor ------------------------------------------------------
 

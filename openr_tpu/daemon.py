@@ -253,6 +253,12 @@ class OpenrNode:
 
     def start(self) -> None:
         assert not self._started
+        # telemetry first: jit compile/dispatch listeners must be live
+        # before any module's first solver dispatch (idempotent, no-op
+        # without jax.monitoring)
+        from openr_tpu.telemetry import jax_hooks
+
+        jax_hooks.install()
         # Monitor first: it only reads the log queue, and every other
         # module may push from its first event on (reference startup
         # order: Main.cpp:385 Monitor before KvStore)

@@ -38,6 +38,7 @@ from openr_tpu.types import (
     PrefixDatabase,
     PrefixEntry,
 )
+from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
 from openr_tpu.utils.eventbase import AsyncDebounce, OpenrEventBase
@@ -52,6 +53,12 @@ class DecisionPendingUpdates:
         self.perf_events: Optional[PerfEvents] = None
         self._needs_full_rebuild = False
         self.updated_prefixes: Set[IpPrefix] = set()
+        # telemetry trace for the debounce window. The FIRST adopted
+        # trace wins (publications arrive in order, so first == oldest
+        # — the same convergence-from-earliest rule as perf_events);
+        # later traces in the window are counted as merged and dropped.
+        self.trace = None
+        self._debounce_span = None
 
     def needs_full_rebuild(self) -> bool:
         return self._needs_full_rebuild
@@ -115,11 +122,34 @@ class DecisionPendingUpdates:
         self.perf_events = None
         return events
 
+    def adopt_trace(self, trace) -> None:
+        if trace is None:
+            return
+        if self.trace is None:
+            self.trace = trace
+            self._debounce_span = trace.begin_span("decision.debounce")
+        else:
+            get_registry().counter_bump("telemetry.traces_merged")
+
+    def move_out_trace(self):
+        """End the debounce span and hand the trace to the rebuild."""
+        trace, span = self.trace, self._debounce_span
+        self.trace = None
+        self._debounce_span = None
+        if trace is not None and span is not None:
+            trace.end_span(span, merged_updates=self.count)
+            get_registry().observe(
+                "decision.debounce_ms", span.dur_ms or 0.0
+            )
+        return trace
+
     def reset(self) -> None:
         self.count = 0
         self.perf_events = None
         self._needs_full_rebuild = False
         self.updated_prefixes = set()
+        self.trace = None
+        self._debounce_span = None
 
 
 class Decision:
@@ -209,6 +239,12 @@ class Decision:
     def _on_publication(self, pub: Publication) -> None:
         self.counters["decision.publications"] += 1
         self.process_publication(pub)
+        if self.pending.needs_route_update():
+            self.pending.adopt_trace(pub.trace)
+        elif pub.trace is not None:
+            # publication with no route impact (e.g. fibtime keys):
+            # the trace dies here, visibly
+            get_registry().counter_bump("telemetry.traces_no_route_impact")
         if self.pending.needs_route_update():
             # overlap the device-side delta application with the
             # debounce window: the band scatter for this publication's
@@ -415,6 +451,20 @@ class Decision:
         self.pending.add_event(event)
         self.counters["decision.route_build_runs"] += 1
 
+        # close the debounce span, open the rebuild span, and activate
+        # the trace on this thread so deep call sites (the ELL
+        # reconverge in ops.spf_sparse) can nest their own spans
+        trace = self.pending.move_out_trace()
+        tracer = get_tracer()
+        rebuild_span = None
+        full = self.pending.needs_full_rebuild()
+        if trace is not None:
+            rebuild_span = trace.begin_span(
+                "decision.rebuild", full_rebuild=full
+            )
+            tracer.activate(trace)
+        t_rebuild0 = time.perf_counter()
+
         update = DecisionRouteUpdate()
         if self.pending.needs_full_rebuild():
             new_db = (
@@ -444,9 +494,22 @@ class Decision:
                 )
                 update.unicast_routes_to_delete.extend(change.deleted_routes)
 
+        get_registry().observe(
+            "decision.rebuild_ms",
+            (time.perf_counter() - t_rebuild0) * 1000.0,
+        )
+        if trace is not None:
+            tracer.deactivate()
+            trace.end_span(
+                rebuild_span,
+                routes_updated=len(update.unicast_routes_to_update),
+                routes_deleted=len(update.unicast_routes_to_delete),
+            )
+
         self.route_db.update(update)
         self.pending.add_event("ROUTE_UPDATE")
         update.perf_events = self.pending.move_out_events()
+        update.trace = trace
         self.pending.reset()
         self.route_updates_queue.push(update)
 

@@ -92,6 +92,9 @@ class DecisionRouteUpdate:
     mpls_routes_to_update: List[RibMplsEntry] = field(default_factory=list)
     mpls_routes_to_delete: List[int] = field(default_factory=list)
     perf_events: Optional[PerfEvents] = None
+    # in-process telemetry trace adopted from the triggering
+    # publication (oldest-chain rule, same as perf_events)
+    trace: Optional[object] = None
 
     def empty(self) -> bool:
         return not (

@@ -161,20 +161,29 @@ SPARSE_NODE_THRESHOLD = 4096
 # full host Dijkstra because the queried source was outside the device
 # batch — at scale that is an O(N log N) cliff that must stay at zero on
 # the hot path (round-1 review: silent fallback).
-SPF_COUNTERS: Dict[str, int] = {
-    "decision.spf_host_fallback": 0,
-    "decision.ell_full_compiles": 0,
-    "decision.ell_patches": 0,
-    "decision.ksp2_device_batches": 0,
-    "decision.ksp2_host_fallbacks": 0,
-    "decision.ksp2_cold_builds": 0,
-    "decision.ksp2_incremental_syncs": 0,
-    "decision.ksp2_warm_dispatches": 0,
-    "decision.ksp2_affected_dsts": 0,
-    "decision.ksp2_route_reuses": 0,
-    "decision.sp_route_reuses": 0,
-    "decision.ell_prewarms": 0,
-}
+# Since the telemetry spine landed this is a registry-backed shim: the
+# same `SPF_COUNTERS[k] += 1` / `dict(SPF_COUNTERS)` call sites, but
+# the store of record is openr_tpu.telemetry's process-wide Registry,
+# so OpenrCtrl.get_counters / breeze / bench artifacts see these names
+# without a per-module merge loop.
+from openr_tpu.telemetry import get_registry as _get_registry
+
+SPF_COUNTERS = _get_registry().counter_dict(
+    [
+        "decision.spf_host_fallback",
+        "decision.ell_full_compiles",
+        "decision.ell_patches",
+        "decision.ksp2_device_batches",
+        "decision.ksp2_host_fallbacks",
+        "decision.ksp2_cold_builds",
+        "decision.ksp2_incremental_syncs",
+        "decision.ksp2_warm_dispatches",
+        "decision.ksp2_affected_dsts",
+        "decision.ksp2_route_reuses",
+        "decision.sp_route_reuses",
+        "decision.ell_prewarms",
+    ]
+)
 
 # KSP2 device prefetch: below this many KSP2 destinations the host path
 # is cheaper than a device dispatch; batches are fixed-size so the

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 
 from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.decision.rib import DecisionRouteUpdate
+from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.messaging.queue import ReplicateQueue
 from openr_tpu.platform.fib_service import FibService
 from openr_tpu.types import (
@@ -112,6 +113,10 @@ class Fib:
     def _on_route_update(self, update: DecisionRouteUpdate) -> None:
         """reference: Fib.cpp:316 processRouteUpdates."""
         t0 = time.perf_counter()
+        trace = getattr(update, "trace", None)
+        program_span = (
+            trace.begin_span("fib.program") if trace is not None else None
+        )
         if update.perf_events is not None:
             update.perf_events.add(self.my_node_name, "FIB_ROUTE_DB_RECVD")
             self.perf_db.append(update.perf_events)
@@ -136,6 +141,13 @@ class Fib:
         # intended state)
         self.fib_updates_queue.push(update)
         duration_ms = (time.perf_counter() - t0) * 1000.0
+        get_registry().observe("fib.program_ms", duration_ms)
+        if trace is not None:
+            trace.end_span(program_span, ok=ok)
+            # end of the line: publication -> debounce -> rebuild ->
+            # program. finish() validates span closure/nesting and
+            # feeds convergence.e2e_ms.
+            get_tracer().finish(trace, ok=ok)
         if ok and update.perf_events is not None and update.perf_events.events:
             # reference: Fib.cpp:891 logPerfEvents -> ROUTE_CONVERGENCE;
             # duration = first perf event (the triggering update entering

@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.telemetry import get_tracer
 from openr_tpu.types import (
     DEFAULT_AREA,
     TTL_INFINITY,
@@ -307,7 +308,20 @@ class KvStoreDb:
         if not updates:
             return
         self._track_ttls(updates)
-        self._publish(Publication(key_vals=dict(updates), area=self.area))
+        # telemetry: every accepted merge births one trace; Decision
+        # adopts the oldest trace in a debounce window, Fib retires it
+        trace = get_tracer().start(
+            "kvstore.publish",
+            node=self.node_id,
+            area=self.area,
+            keys=sorted(updates)[:8],
+            n_keys=len(updates),
+        )
+        self._publish(
+            Publication(
+                key_vals=dict(updates), area=self.area, trace=trace
+            )
+        )
         self._flood(updates, exclude=sender_id)
 
     def _publish(self, pub: Publication) -> None:

@@ -28,7 +28,7 @@ from openr_tpu.platform.netlink import (
     NetlinkEvent,
     NetlinkProtocolSocket,
 )
-from openr_tpu.types import Adjacency, AdjacencyDatabase
+from openr_tpu.types import Adjacency, AdjacencyDatabase, PerfEvents
 from openr_tpu.types.spark import (
     InterfaceDatabase,
     InterfaceInfo,
@@ -425,6 +425,20 @@ class LinkMonitor:
         self.counters["link_monitor.advertise_adjacencies"] += 1
         for area in self.areas:
             adj_db = self._build_adj_db(area)
+            # originate the convergence perf chain here, so the e2e
+            # account starts at the adjacency change, not at Decision
+            # (reference: LinkMonitor.cpp:602 addPerfEvent
+            # ADJ_DB_UPDATED)
+            perf = PerfEvents()
+            perf.add(self.my_node_name, "ADJ_DB_UPDATED")
+            adj_db = AdjacencyDatabase(
+                this_node_name=adj_db.this_node_name,
+                is_overloaded=adj_db.is_overloaded,
+                adjacencies=adj_db.adjacencies,
+                node_label=adj_db.node_label,
+                area=adj_db.area,
+                perf_events=perf,
+            )
             self._kvstore_client.persist_key(
                 area,
                 keyutil.adj_key(self.my_node_name),

@@ -11,12 +11,14 @@ Behavioral parity with the reference ``openr/monitor/``:
 from __future__ import annotations
 
 import json
+import os
 import resource
 import time
 from collections import deque
 from typing import Callable, Deque, Dict, List, Optional
 
 from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.telemetry import get_registry
 from openr_tpu.utils.eventbase import OpenrEventBase
 
 
@@ -61,6 +63,18 @@ class SystemMetrics:
 
     @staticmethod
     def rss_bytes() -> int:
+        """CURRENT resident set size. ru_maxrss is the process's *peak*
+        RSS — reporting it as current hides every memory release, so on
+        Linux read /proc/self/statm (field 2, pages); the rusage peak
+        stays available as rss_peak_bytes and as the fallback here."""
+        try:
+            with open("/proc/self/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            return SystemMetrics.rss_peak_bytes()
+
+    @staticmethod
+    def rss_peak_bytes() -> int:
         # ru_maxrss is KiB on Linux
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
 
@@ -115,7 +129,9 @@ class Monitor:
             try:
                 self._backend(sample)
             except Exception:
-                pass
+                # a broken backend must not take the drain loop down,
+                # but the drop has to be countable (was a silent pass)
+                get_registry().counter_bump("monitor.backend_errors")
 
     def get_event_logs(self, limit: int = 100) -> List[LogSample]:
         return self.evb.call_and_wait(
@@ -123,10 +139,18 @@ class Monitor:
         )
 
     def get_counters(self) -> Dict[str, object]:
-        return self.evb.call_and_wait(
-            lambda: {
-                "monitor.log_samples_processed": self.num_processed,
-                "process.rss_bytes": SystemMetrics.rss_bytes(),
-                "process.cpu_seconds": SystemMetrics.cpu_seconds(),
-            }
-        )
+        def collect() -> Dict[str, object]:
+            # the process-wide registry snapshot (telemetry spine) +
+            # monitor-local and system gauges, one flat fb303 dict
+            out: Dict[str, object] = dict(get_registry().snapshot())
+            out.update(
+                {
+                    "monitor.log_samples_processed": self.num_processed,
+                    "process.rss_bytes": SystemMetrics.rss_bytes(),
+                    "process.rss_peak_bytes": SystemMetrics.rss_peak_bytes(),
+                    "process.cpu_seconds": SystemMetrics.cpu_seconds(),
+                }
+            )
+            return out
+
+        return self.evb.call_and_wait(collect)

@@ -37,6 +37,7 @@ plus the O(E) edge list — well inside HBM.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, replace as _replace
 from typing import Dict, List, Optional, Tuple
 
@@ -58,12 +59,22 @@ _NODE_PAD = 128
 # prefix and asserted by the churn smoke test: a refactor that silently
 # knocks the hot path back to full recompiles shows up as
 # ell_incremental_syncs staying flat while ell_cold_solves climbs.
-ELL_COUNTERS: Dict[str, int] = {
-    "ell_incremental_syncs": 0,  # delta scatters into resident bands
-    "ell_warm_solves": 0,        # solves seeded from the previous d
-    "ell_cold_solves": 0,        # solves from the unit init
-    "ell_widen_events": 0,       # widen-on-overflow band re-uploads
-}
+# Registry-backed shim since the telemetry spine: same bare keys and
+# `ELL_COUNTERS[k] += 1` idiom, stored in the process registry under
+# the exported "decision." names, so the registry snapshot and
+# get_spf_counters() agree by construction.
+from openr_tpu.telemetry import get_registry as _get_registry
+from openr_tpu.telemetry import get_tracer as _get_tracer
+
+ELL_COUNTERS = _get_registry().counter_dict(
+    [
+        "ell_incremental_syncs",  # delta scatters into resident bands
+        "ell_warm_solves",        # solves seeded from the previous d
+        "ell_cold_solves",        # solves from the unit init
+        "ell_widen_events",       # widen-on-overflow band re-uploads
+    ],
+    prefix="decision.",
+)
 
 
 def _pad_up(n: int, align: int) -> int:
@@ -1268,6 +1279,12 @@ class EllState:
         bands (shape changed) are re-uploaded wholesale as the dispatch
         inputs with a no-op scatter — same discipline as apply_patch;
         the new band shapes cost one jit recompile."""
+        # span on the enclosing module's active trace (no-op outside a
+        # traced churn event); attrs carry the warm/cold verdict plus
+        # the device-dispatch vs host-overhead split
+        _tracer = _get_tracer()
+        _span = _tracer.span_active("ops.ell_reconverge")
+        _t0 = time.perf_counter()
         ov_changed = self._sync_overloaded(patched)
         self._note_patch(patched, ov_changed)
         in_src, in_w, patch_ids, patch_src, patch_w = (
@@ -1295,18 +1312,33 @@ class EllState:
             ELL_COUNTERS["ell_cold_solves"] += 1
         inc_t, inc_h, inc_w = pad_increase_edges(inc)
         srcs_dev = jnp.asarray(np.asarray(srcs, dtype=np.int32))
+        _t_dispatch = time.perf_counter()
         self.src, self.w, packed, d = _ell_reconverge(
             in_src, in_w, patch_ids, patch_src, patch_w,
             jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w),
             self.overloaded, d_prev, srcs_dev,
             patched.bands, patched.n_pad,
         )
+        _t_end = time.perf_counter()
         self._d_dev = d
         self._warm_key = srcs_key
         self._pending_inc = []
         self._pending_patch = False
         self._pending_force = False
         self.graph = _replace(patched, changed=None)
+        _total_ms = (_t_end - _t0) * 1000.0
+        _dispatch_ms = (_t_end - _t_dispatch) * 1000.0
+        _reg = _get_registry()
+        _reg.observe("ops.ell.reconverge_ms", _total_ms)
+        _reg.observe(
+            "ops.ell.host_overhead_ms", _total_ms - _dispatch_ms
+        )
+        _tracer.end_span_active(
+            _span,
+            warm=warm,
+            dispatch_ms=round(_dispatch_ms, 4),
+            host_overhead_ms=round(_total_ms - _dispatch_ms, 4),
+        )
         return packed
 
 

@@ -1,0 +1,42 @@
+"""Process-wide observability spine: counters, gauges, latency
+histograms, and end-to-end trace spans.
+
+Three pieces, one export surface:
+
+- ``registry.py``: a thread-safe fb303-style metric registry. Modules
+  register dotted-name counters/gauges/histograms; ``snapshot()``
+  flattens everything (histograms expand to ``.p50/.p95/.p99/.max/
+  .avg/.count``) into the dict served by ``OpenrCtrl.get_counters``
+  and ``breeze monitor counters``.
+- ``trace.py``: structured spans over the PerfEvents chain. A trace is
+  born at KvStore publication, rides the Publication/RouteUpdate
+  objects through Decision and Fib, and lands in a bounded ring
+  exportable as Chrome-trace JSON or JSONL.
+- ``jax_hooks.py``: jax.monitoring listeners mapping jit compiles to
+  ``jax.compile_count`` / ``jax.compile_ms`` so compile-cache
+  regressions show up as counters, not silent latency cliffs.
+"""
+
+from openr_tpu.telemetry.registry import (  # noqa: F401
+    CounterDict,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from openr_tpu.telemetry.trace import (  # noqa: F401
+    Span,
+    Trace,
+    Tracer,
+    get_tracer,
+)
+
+__all__ = [
+    "CounterDict",
+    "Histogram",
+    "Registry",
+    "Span",
+    "Trace",
+    "Tracer",
+    "get_registry",
+    "get_tracer",
+]

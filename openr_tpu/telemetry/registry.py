@@ -1,0 +1,217 @@
+"""Thread-safe metric registry with fb303-style dotted names.
+
+One process-wide ``Registry`` (``get_registry()``) owns every counter,
+gauge, and histogram. Modules keep their historical idioms:
+
+- legacy module-global counter dicts (``SPF_COUNTERS``,
+  ``ELL_COUNTERS``) become ``CounterDict`` shims — same ``d[k] += 1``
+  / ``dict(d)`` / ``.items()`` call sites, but the backing store is
+  the registry, so ``OpenrCtrl.get_counters`` and bench artifacts see
+  them without per-module merge loops;
+- latency distributions are ``Histogram``s over a sliding window of
+  the most recent observations, exported as streaming percentiles
+  (``<name>.p50/.p95/.p99/.max/.avg/.count``) — per DeltaPath, means
+  hide the warm/cold split that the churn path must account for.
+
+Everything here must stay cheap on the hot path: a counter bump is a
+lock + dict add; a histogram observation is a lock + ring append.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Union
+
+from collections.abc import MutableMapping
+
+_PERCENTILES = ((".p50", 0.50), (".p95", 0.95), (".p99", 0.99))
+
+
+class Histogram:
+    """Streaming latency distribution over a sliding window.
+
+    Keeps the last ``window`` observations in a ring buffer plus
+    cumulative ``count``/``max`` over the histogram's whole life, so
+    the percentiles track recent behaviour while the count keeps
+    monotonic fb303 semantics.
+    """
+
+    __slots__ = ("name", "_ring", "_next", "_filled", "_count", "_max", "_sum")
+
+    def __init__(self, name: str, window: int = 1024) -> None:
+        self.name = name
+        self._ring: List[float] = [0.0] * window
+        self._next = 0
+        self._filled = 0
+        self._count = 0
+        self._max = 0.0
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self._ring[self._next] = value
+        self._next = (self._next + 1) % len(self._ring)
+        self._filled = min(self._filled + 1, len(self._ring))
+        self._count += 1
+        self._sum += value
+        if value > self._max:
+            self._max = value
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def stats(self) -> Dict[str, float]:
+        """Flattened ``<name>.p50/.p95/.p99/.max/.avg/.count`` dict."""
+        out: Dict[str, float] = {self.name + ".count": self._count}
+        if self._count == 0:
+            return out
+        window = sorted(self._ring[: self._filled])
+        n = len(window)
+        for suffix, q in _PERCENTILES:
+            # nearest-rank over the sliding window
+            idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+            out[self.name + suffix] = round(window[idx], 4)
+        out[self.name + ".max"] = round(self._max, 4)
+        out[self.name + ".avg"] = round(self._sum / self._count, 4)
+        return out
+
+
+class Registry:
+    """Process-wide metric store. All methods are thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._counters: Dict[str, Union[int, float]] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    # -- counters ---------------------------------------------------
+    def counter_bump(self, name: str, delta: Union[int, float] = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    def counter_set(self, name: str, value: Union[int, float]) -> None:
+        with self._lock:
+            self._counters[name] = value
+
+    def counter_get(self, name: str) -> Union[int, float]:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counter_dict(
+        self,
+        initial: Iterable[str] = (),
+        prefix: str = "",
+    ) -> "CounterDict":
+        """A dict-shaped shim over registry counters (see CounterDict)."""
+        d = CounterDict(self, prefix)
+        with self._lock:
+            for key in initial:
+                d.setdefault(key, 0)
+        return d
+
+    # -- gauges -----------------------------------------------------
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """Register a callable sampled at snapshot time. A gauge that
+        raises is dropped from that snapshot (never poisons export)."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    # -- histograms -------------------------------------------------
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, window)
+            return h
+
+    def observe(self, name: str, value: float) -> None:
+        self.histogram(name).observe(value)
+
+    def histograms(self) -> Dict[str, Histogram]:
+        with self._lock:
+            return dict(self._histograms)
+
+    # -- export -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Union[int, float]]:
+        """One flat fb303-style dict: counters, sampled gauges, and
+        expanded histogram stats."""
+        with self._lock:
+            out: Dict[str, Union[int, float]] = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = list(self._histograms.values())
+        for name, fn in gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                pass
+        for h in hists:
+            out.update(h.stats())
+        return out
+
+    def reset(self) -> None:
+        """Zero counters and drop histogram samples (tests only).
+        Registered names survive so snapshots keep a stable shape."""
+        with self._lock:
+            for name in self._counters:
+                self._counters[name] = 0
+            for name, h in list(self._histograms.items()):
+                self._histograms[name] = Histogram(name, len(h._ring))
+
+
+class CounterDict(MutableMapping):
+    """Compatibility shim: looks like the historical module-global
+    counter dict (``SPF_COUNTERS[k] += 1``, ``dict(SPF_COUNTERS)``,
+    ``.items()``), stores in the shared registry under
+    ``prefix + key``. Keys read before first write register at 0, so
+    ``before = COUNTERS[k]`` works for names no code path bumped yet.
+    """
+
+    __slots__ = ("_registry", "_prefix", "_keys")
+
+    def __init__(self, registry: Registry, prefix: str = "") -> None:
+        self._registry = registry
+        self._prefix = prefix
+        self._keys: Dict[str, None] = {}  # insertion-ordered key set
+
+    def __getitem__(self, key: str) -> Union[int, float]:
+        self.setdefault(key, 0)
+        return self._registry.counter_get(self._prefix + key)
+
+    def __setitem__(self, key: str, value: Union[int, float]) -> None:
+        self._keys[key] = None
+        self._registry.counter_set(self._prefix + key, value)
+
+    def __delitem__(self, key: str) -> None:
+        del self._keys[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(list(self._keys))
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._keys
+
+    def setdefault(self, key, default=0):
+        if key not in self._keys:
+            self._keys[key] = None
+            name = self._prefix + key
+            self._registry.counter_set(
+                name, self._registry.counter_get(name) or default
+            )
+        return self._registry.counter_get(self._prefix + key)
+
+
+_REGISTRY: Optional[Registry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def get_registry() -> Registry:
+    global _REGISTRY
+    if _REGISTRY is None:
+        with _REGISTRY_LOCK:
+            if _REGISTRY is None:
+                _REGISTRY = Registry()
+    return _REGISTRY

@@ -46,6 +46,9 @@ class Publication:
     tobe_updated_keys: Optional[List[str]] = None
     flood_root_id: Optional[str] = None
     area: str = DEFAULT_AREA
+    # in-process only (never serialized): the telemetry trace born at
+    # set_key_vals, carried to Decision for span accumulation
+    trace: Optional[object] = None
 
 
 @dataclass

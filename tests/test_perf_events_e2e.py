@@ -1,0 +1,193 @@
+"""End-to-end PerfEvents + trace propagation:
+KvStore -> Decision (debounced, oldest-chain merge) -> Fib.perf_db.
+
+Covers the convergence-accounting invariants the telemetry spine
+reports against:
+- an adjacency update's perf chain survives Decision's oldest-chain
+  merge (PendingUpdates._add_update) and lands in Fib.perf_db,
+- the surviving chain is the OLDEST of a debounced batch,
+- event timestamps are monotonically non-decreasing along the chain,
+- the telemetry trace born at kvstore publication is finished by Fib
+  with every span closed (publication -> debounce -> rebuild ->
+  program).
+"""
+
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.fib.fib import Fib
+from openr_tpu.kvstore.wrapper import KvStoreWrapper
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.models import topologies
+from openr_tpu.platform.fib_service import MockFibAgent
+from openr_tpu.telemetry import get_tracer
+from openr_tpu.types import AdjacencyDatabase, PerfEvent, PerfEvents
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+
+def wait_until(pred, timeout=10.0, step=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class PipelineHarness:
+    """KvStore -> Decision -> Fib wired through real queues (host
+    solver: these tests assert accounting, not kernels)."""
+
+    def __init__(self, my_node="a"):
+        self.store = KvStoreWrapper(f"store:{my_node}")
+        self.route_q = ReplicateQueue(name="routeUpdates")
+        self.decision = Decision(
+            my_node,
+            kvstore_updates_queue=self.store.store.updates_queue,
+            route_updates_queue=self.route_q,
+            debounce_min_s=0.05,
+            debounce_max_s=0.25,
+            solver_backend="host",
+        )
+        self.agent = MockFibAgent()
+        self.fib = Fib(
+            my_node,
+            self.agent,
+            self.route_q,
+            keepalive_interval_s=5.0,
+        )
+        self.store.start()
+        self.decision.start()
+        self.fib.start()
+        self._versions = {}
+
+    def stop(self):
+        self.fib.stop()
+        self.decision.stop()
+        self.store.stop()
+
+    def publish_adj(self, adj_db: AdjacencyDatabase):
+        key = keyutil.adj_key(adj_db.this_node_name)
+        v = self._versions[key] = self._versions.get(key, 0) + 1
+        self.store.set_key(
+            key,
+            wire.dumps(adj_db),
+            version=v,
+            originator=adj_db.this_node_name,
+        )
+
+    def publish_prefixes(self, prefix_db):
+        key = keyutil.prefix_db_key(prefix_db.this_node_name)
+        v = self._versions[key] = self._versions.get(key, 0) + 1
+        self.store.set_key(
+            key,
+            wire.dumps(prefix_db),
+            version=v,
+            originator=prefix_db.this_node_name,
+        )
+
+
+def line_topology():
+    return topologies.build_topology(
+        "line", [("a", "b", 1), ("b", "c", 2)]
+    )
+
+
+def with_perf(adj_db: AdjacencyDatabase, unix_ts: int) -> AdjacencyDatabase:
+    """Stamp an origination chain, as LinkMonitor does on advertise."""
+    return AdjacencyDatabase(
+        this_node_name=adj_db.this_node_name,
+        is_overloaded=adj_db.is_overloaded,
+        adjacencies=adj_db.adjacencies,
+        node_label=adj_db.node_label,
+        area=adj_db.area,
+        perf_events=PerfEvents(
+            events=[
+                PerfEvent(
+                    node_name=adj_db.this_node_name,
+                    event_descr="ADJ_DB_UPDATED",
+                    unix_ts=unix_ts,
+                )
+            ]
+        ),
+    )
+
+
+@pytest.fixture
+def harness():
+    h = PipelineHarness()
+    yield h
+    h.stop()
+
+
+class TestPerfEventsEndToEnd:
+    def test_adj_chain_reaches_fib_perf_db_monotone(self, harness):
+        topo = line_topology()
+        now_ms = int(time.time() * 1000)
+        for db in topo.adj_dbs.values():
+            harness.publish_adj(with_perf(db, now_ms))
+        for pdb in topo.prefix_dbs.values():
+            harness.publish_prefixes(pdb)
+
+        assert wait_until(lambda: len(harness.fib.perf_db) >= 1)
+        chain = harness.fib.perf_db[-1]
+        descrs = [e.event_descr for e in chain.events]
+        assert descrs[0] == "ADJ_DB_UPDATED"
+        assert "DECISION_RECEIVED" in descrs
+        assert "ROUTE_UPDATE" in descrs
+        assert descrs[-1] == "FIB_ROUTE_DB_RECVD"
+        stamps = [e.unix_ts for e in chain.events]
+        assert stamps == sorted(stamps), (
+            f"perf chain timestamps not monotone: {list(zip(descrs, stamps))}"
+        )
+
+    def test_oldest_chain_survives_debounce_merge(self, harness):
+        """Two adjacency updates in one debounce window: the NEWER
+        chain arrives first, the OLDER second — the merged batch must
+        report convergence from the oldest origination."""
+        topo = line_topology()
+        for pdb in topo.prefix_dbs.values():
+            harness.publish_prefixes(pdb)
+        now_ms = int(time.time() * 1000)
+        # newer chain first (ts = now), older chain second (ts = -2s)
+        harness.publish_adj(with_perf(topo.adj_dbs["a"], now_ms))
+        harness.publish_adj(
+            with_perf(topo.adj_dbs["b"], now_ms - 2000)
+        )
+        harness.publish_adj(with_perf(topo.adj_dbs["c"], now_ms))
+
+        assert wait_until(lambda: len(harness.fib.perf_db) >= 1)
+        chain = harness.fib.perf_db[-1]
+        assert chain.events[0].event_descr == "ADJ_DB_UPDATED"
+        assert chain.events[0].unix_ts == now_ms - 2000
+        assert chain.events[0].node_name == "b"
+
+    def test_trace_completes_publication_to_fib(self, harness):
+        tracer = get_tracer()
+        n_before = len(tracer.traces())
+        topo = line_topology()
+        for db in topo.adj_dbs.values():
+            harness.publish_adj(db)
+        for pdb in topo.prefix_dbs.values():
+            harness.publish_prefixes(pdb)
+
+        assert wait_until(lambda: len(tracer.traces()) > n_before)
+        new = tracer.traces()[n_before:]
+        done = [t for t in new if t.complete]
+        assert done, [t.to_dict() for t in new]
+        t = done[-1]
+        names = [s.name for s in t.spans]
+        assert names[0] == "kvstore.publish"
+        assert "decision.debounce" in names
+        assert "decision.rebuild" in names
+        assert names[-1] == "fib.program"
+        assert t.well_formed()
+        assert t.e2e_ms is not None and t.e2e_ms >= 0.0
+        # debounce ran: its span must be >= the configured minimum
+        debounce = next(
+            s for s in t.spans if s.name == "decision.debounce"
+        )
+        assert debounce.dur_ms >= 40.0  # 50ms debounce, clock slack

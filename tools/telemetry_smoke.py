@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Telemetry smoke gate (``make telemetry-smoke``).
+
+Runs a small churn scenario through the REAL module pipeline
+(KvStore -> Decision -> Fib) with the sparse threshold forced down so
+the resident-ELL solve path engages, then fails loudly if the
+observability spine regressed:
+
+- any registered histogram is EMPTY (an instrumentation point went
+  dead: the metric exists but nothing feeds it),
+- a REQUIRED histogram is missing entirely (the stage lost its timer),
+- any trace span was left unclosed or mis-nested,
+- fewer complete publication->FIB traces than churn events,
+- the jax compile hooks failed to install.
+
+Exit 0 on pass, 1 with a reason list on fail. Runs CPU-pinned — this
+gates instrumentation, not kernels.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/telemetry_smoke.py) in addition
+# to module mode (python -m tools.telemetry_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_HISTOGRAMS = (
+    "convergence.e2e_ms",
+    "decision.debounce_ms",
+    "decision.rebuild_ms",
+    "fib.program_ms",
+    "ops.ell.reconverge_ms",
+    "ops.ell.host_overhead_ms",
+)
+
+# trace-health counters that must stay at zero across the scenario
+ZERO_COUNTERS = (
+    "telemetry.traces_unclosed_spans",
+    "telemetry.traces_bad_nesting",
+)
+
+
+def main() -> int:
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.decision import spf_solver as ss
+
+    # engage the resident-ELL path at smoke scale (same trick as
+    # tests/test_churn_smoke.py) so the ops-level spans/histograms run
+    ss.SPARSE_NODE_THRESHOLD = 4
+
+    from benchmarks.bench_scale import convergence_trace_bench
+    from openr_tpu.telemetry import get_registry, get_tracer, jax_hooks
+
+    reg = get_registry()
+    before = {k: reg.counter_get(k) for k in ZERO_COUNTERS}
+    hooks_ok = jax_hooks.install()
+
+    result = convergence_trace_bench(
+        48,
+        churn_events=5,
+        trace_path="/tmp/openr_tpu_telemetry_smoke.jsonl",
+        solver_backend="device",
+    )
+
+    failures = []
+    if not hooks_ok:
+        failures.append("jax.monitoring hooks failed to install")
+    if result["traces_complete"] < 5:
+        failures.append(
+            f"only {result['traces_complete']}/5 complete traces"
+        )
+    if result["traces_incomplete"]:
+        failures.append(
+            f"{result['traces_incomplete']} incomplete traces"
+        )
+    for name in ZERO_COUNTERS:
+        delta = reg.counter_get(name) - before[name]
+        if delta:
+            failures.append(f"{name} moved by {delta}")
+
+    hists = reg.histograms()
+    for name in REQUIRED_HISTOGRAMS:
+        if name not in hists:
+            failures.append(f"required histogram missing: {name}")
+    for name, h in sorted(hists.items()):
+        if h.count == 0:
+            failures.append(f"registered histogram is empty: {name}")
+
+    # every span in the artifact closed (belt over the counters)
+    for t in get_tracer().traces():
+        for s in t.spans:
+            if not s.closed:
+                failures.append(
+                    f"trace {t.trace_id}: unclosed span {s.name}"
+                )
+
+    print(json.dumps({"bench": result, "failures": failures}, indent=1))
+    if failures:
+        print(f"TELEMETRY SMOKE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print("TELEMETRY SMOKE: PASS", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
