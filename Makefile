@@ -36,9 +36,11 @@ tier1: native
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
-# pipeline regresses to zero incremental syncs / warm solves
+# pipeline regresses to zero incremental syncs / warm solves, or if
+# metric churn starts reading the full packed product back per event
+# (delta-compacted readback contract, tests/test_route_engine_delta.py)
 churn-smoke: native
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py -q -m "not slow"
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py tests/test_route_engine_delta.py -q -m "not slow"
 
 # observability gate: small churn scenario through the real pipeline;
 # fails if any registered histogram is empty, any trace span is left

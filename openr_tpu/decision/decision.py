@@ -450,6 +450,18 @@ class Decision:
             return
         self.pending.add_event(event)
         self.counters["decision.route_build_runs"] += 1
+        if self.pending.count > 1:
+            # a debounce window folded several publications into THIS
+            # one rebuild: downstream, the device churn path pays one
+            # fused dispatch + one delta readback for the whole burst
+            # (EllState merges the stacked patch journals; the route
+            # engine takes the union affected set) — count the folds
+            # so burst coalescing is observable next to
+            # decision.route_build_runs
+            get_registry().counter_bump(
+                "decision.coalesced_publications",
+                self.pending.count - 1,
+            )
 
         # close the debounce span, open the rebuild span, and activate
         # the trace on this thread so deep call sites (the ELL

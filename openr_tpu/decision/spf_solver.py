@@ -727,7 +727,14 @@ class SpfSolver:
         that ALREADY have resident state (never compiles a new one) and
         swallows failures — this is an overlap optimization, not a
         correctness step: the rebuild re-syncs and no-ops when the
-        bands are already current."""
+        bands are already current.
+
+        Safe to call once per publication in a burst: the EllState
+        journal MERGES stacked patches (snapshot-keyed edge deltas, see
+        spf_sparse.EllState._note_patch), so N prewarmed publications
+        inside one debounce window still leave the debounced rebuild on
+        the warm-solve path — burst churn pays one fused dispatch, not
+        a forced cold seed."""
         if self.backend != "device":
             return
         for ls in area_link_states.values():

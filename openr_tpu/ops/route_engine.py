@@ -37,10 +37,20 @@ node add/remove — a renumbering event — cold-rebuilds:
    d. route extraction (nh counts, canonical digests, sample rows)
       for exactly those rows, scatter the fresh rows/digests into the
       resident state,
-3. readback: the affected rows' packed route product (digest +
-   nh_total + sample metrics/masks) + the affected count — O(K), not
+3. readback: DELTA-COMPACTED on device — the fresh product rows are
+   diffed bit-for-bit against the resident previous packed product and
+   prefix-sum-compacted, so only the rows that actually CHANGED cross
+   the device->host boundary (plus a 2-int meta row carrying the
+   affected and changed counts) — O(changed), not O(K) and never
    O(N^2); the caller sees which destinations moved and their fresh
-   routes.
+   routes,
+4. consume: the compacted readback stays an IN-FLIGHT device array.
+   The device state commits immediately and the host applies event k's
+   delta into the resident RouteSweepResult while event k+1's
+   patch+solve dispatches (the double-buffer overlap window —
+   ``churn(..., defer_consume=True)`` hands the caller the
+   PendingDelta handle explicitly; the default consumes synchronously
+   before returning, preserving the classic contract).
 
 Memory: DR stays device-resident at [n_pad, n_pad] int32 — whole on a
 single chip (~400 MB at 10k, 12k bound, the same envelope as the
@@ -61,6 +71,7 @@ on delta) at the network-wide scale.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
@@ -75,6 +86,7 @@ from openr_tpu.ops.spf_sparse import (
     ell_patch,
     pad_patch_rows,
 )
+from openr_tpu.telemetry import get_registry, get_tracer
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
@@ -183,15 +195,29 @@ def _increase_rows(dr, e_u, e_v, e_w_old, e_w_new):
 
 def _resolve_and_pack(
     solve_rows, nh_counts, overloaded, ids, local_ids, count, dr,
-    digests, samp_ids, samp_v, samp_w, pos_w, n, k,
+    digests, packed_res, samp_ids, samp_v, samp_w, pos_w, n, k,
 ):
     """Re-init + fixed-point the affected rows (independent problems),
-    extract their route product, scatter fresh rows/digests into the
-    resident (shard of) DR. When count == 0 every id repeats one row
-    and the write is that row's own fresh re-solve: a no-op by value.
-    Returns (dr, digests, packed [k+1, W]) where packed row 0 col 0
-    carries the TRUE affected count (overflow detection) and rows
-    1..k the affected destinations' product prefixed by their ids.
+    extract their route product, scatter fresh rows/digests/product
+    into the resident (shard of) DR. When count == 0 every id repeats
+    one row and the write is that row's own fresh re-solve: a no-op by
+    value. Returns (dr, digests, packed_res, out [k+1, 1+W]):
+
+      out row 0: [affected_count, changed_count, 0, ...] — the TRUE
+        affected count drives the overflow retry ladder; changed_count
+        bounds the readback,
+      out rows 1..changed_count: [dest id, product] for exactly the
+        affected rows whose packed product CHANGED bit-for-bit against
+        the resident previous product, prefix-sum-compacted in row
+        order. Rows past changed_count are zero.
+
+    The changed test compares FULL packed rows, not digests: a digest
+    can survive a sample-mask flip (equal-cost slot swap keeps the
+    distance and the fanout count while moving mask membership), so
+    compacting on digests alone would drop real route changes.
+    Detection padding repeats the first affected id; those duplicates
+    fall outside the ``arange(k) < count`` live window and never reach
+    the compaction, so compacted ids are unique.
 
     ``solve_rows(ids) -> [k, n]`` and ``nh_counts(rows, ids)`` are the
     relaxation-backend callables (ELL bands or grouped segments); the
@@ -207,17 +233,48 @@ def _resolve_and_pack(
     )
     dr = dr.at[local_ids].set(rows)
     digests = digests.at[local_ids].set(row_digests)
+    live = jnp.arange(k) < count
+    changed = live & jnp.any(product != packed_res[local_ids], axis=1)
+    ch_count = jnp.sum(changed.astype(jnp.int32))
+    packed_res = packed_res.at[local_ids].set(product)
     body = jnp.concatenate([ids[:, None], product], axis=1)
-    meta = jnp.zeros((1, body.shape[1]), dtype=jnp.int32)
-    meta = meta.at[0, 0].set(count)
-    packed = jnp.concatenate([meta, body], axis=0)
-    return dr, digests, packed
+    # prefix-sum compaction: changed rows scatter to 1..ch_count,
+    # unchanged rows to the dropped out-of-bounds slot
+    pos = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    dest = jnp.where(changed, pos + 1, k + 1)
+    out = jnp.zeros((k + 1, body.shape[1]), dtype=jnp.int32)
+    out = out.at[dest].set(body, mode="drop")
+    out = out.at[0, 0].set(count)
+    out = out.at[0, 1].set(ch_count)
+    return dr, digests, packed_res, out
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _compact_changed(new_packed, prev_packed, n):
+    """Full-width delta epilogue: diff the fresh [n_pad, W] packed
+    product bit-for-bit against the resident previous one and
+    prefix-sum-compact the changed rows to the front, each prefixed by
+    its destination id. Returns (changed_count, out [n_pad, 1+W]) —
+    the host reads the scalar, then slices out[:changed_count]: the
+    full-width refresh pays an O(changed) readback like the bucketed
+    path instead of hauling every row home. Padding destinations
+    (t >= n) re-solve identically every time and are masked out."""
+    npad = new_packed.shape[0]
+    ids = jnp.arange(npad, dtype=jnp.int32)
+    changed = (ids < n) & jnp.any(new_packed != prev_packed, axis=1)
+    ch_count = jnp.sum(changed.astype(jnp.int32))
+    pos = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    dest = jnp.where(changed, pos, npad)
+    body = jnp.concatenate([ids[:, None], new_packed], axis=1)
+    out = jnp.zeros((npad, body.shape[1]), dtype=jnp.int32)
+    out = out.at[dest].set(body, mode="drop")
+    return ch_count, out
 
 
 @functools.partial(jax.jit, static_argnames=("bands", "n", "k"))
 def _churn_step(
     v_t, w_t, patch_ids_t, patch_v_t, patch_w_t,
-    dr, digests,
+    dr, digests, packed_res,
     e_u, e_v, e_w_old, e_w_new,
     overloaded_new,
     samp_ids, samp_v, samp_w, pos_w,
@@ -225,7 +282,10 @@ def _churn_step(
 ):
     """The fused single-chip incremental dispatch: detection against
     the resident DR, band-row patch scatter, affected-row re-solve and
-    extraction — one device round trip per churn event."""
+    extraction — one device round trip per churn event. None of the
+    resident inputs (dr/digests/packed_res) are donated: the overflow
+    retry ladder re-dispatches at a larger bucket against the SAME
+    untouched resident arrays (the double-buffer hazard rule)."""
     count, local_ids, ids = _detect_rows(
         dr, e_u, e_v, e_w_old, e_w_new, k, 0
     )
@@ -244,7 +304,7 @@ def _churn_step(
         w.at[pids, :].set(pw)
         for w, pids, pw in zip(w_t, patch_ids_t, patch_w_t)
     )
-    dr, digests, packed = _resolve_and_pack(
+    dr, digests, packed_res, out = _resolve_and_pack(
         lambda t: rs._rev_fixed_point(
             bands, new_v, new_w, overloaded_new, t, n, init=warm0
         ),
@@ -252,9 +312,9 @@ def _churn_step(
             rows, bands, new_v, new_w, overloaded_new, t
         ),
         overloaded_new, ids, local_ids, count,
-        dr, digests, samp_ids, samp_v, samp_w, pos_w, n, k,
+        dr, digests, packed_res, samp_ids, samp_v, samp_w, pos_w, n, k,
     )
-    return new_v, new_w, dr, digests, packed
+    return new_v, new_w, dr, digests, packed_res, out
 
 
 # -- mesh-sharded dispatches ----------------------------------------------
@@ -347,7 +407,7 @@ def _sharded_full_resident(
 
 @functools.partial(jax.jit, static_argnames=("bands", "n", "k", "mesh"))
 def _sharded_churn_step(
-    v_t, w_t, dr, digests,
+    v_t, w_t, dr, digests, packed_res,
     e_u, e_v, e_w_old, e_w_new,
     overloaded_new,
     samp_ids, samp_v, samp_w, pos_w,
@@ -357,13 +417,16 @@ def _sharded_churn_step(
     against its resident DR rows (destination rows never interact, so
     each shard's affected set is exactly its own rows' detection), the
     re-solve runs on each shard's affected rows with the convergence
-    vote lifted over the mesh, and the packed result comes back as
-    ndev stacked [k+1, W] segments (each shard's count in its meta
-    row). Band tensors arrive ALREADY PATCHED (_patch_bands)."""
+    vote lifted over the mesh, and the delta-compacted readback comes
+    back as ndev stacked [k+1, 1+W] segments (each shard's
+    affected/changed counts in its meta row — the host reads each
+    shard's changed rows from its OWN addressable shard, see
+    _split_segments). Band tensors arrive ALREADY PATCHED
+    (_patch_bands)."""
     nb = len(v_t)
     rows_per = n // mesh.devices.size
 
-    def shard_fn(dr_s, dg_s, *rest):
+    def shard_fn(dr_s, dg_s, pk_s, *rest):
         v_r = rest[:nb]
         w_r = rest[nb : 2 * nb]
         (e_u_r, e_v_r, e_wo_r, e_wn_r, ov_r,
@@ -382,7 +445,7 @@ def _sharded_churn_step(
             lambda rows, t: rs._nh_counts(
                 rows, bands, v_r, w_r, ov_r, t
             ),
-            ov_r, ids, local_ids, count, dr_s, dg_s,
+            ov_r, ids, local_ids, count, dr_s, dg_s, pk_s,
             sid_r, sv_r, sw_r, pw_r, n, k,
         )
 
@@ -390,7 +453,8 @@ def _sharded_churn_step(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
-            [P(SOURCES_AXIS, None), P(SOURCES_AXIS)]
+            [P(SOURCES_AXIS, None), P(SOURCES_AXIS),
+             P(SOURCES_AXIS, None)]
             + [P(None, None)] * (2 * nb)
             + [P(None)] * 4
             + [P(None), P(None), P(None, None), P(None, None), P(None)]
@@ -399,26 +463,71 @@ def _sharded_churn_step(
             P(SOURCES_AXIS, None),
             P(SOURCES_AXIS),
             P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS, None),
         ),
     )(
-        dr, digests, *v_t, *w_t,
+        dr, digests, packed_res, *v_t, *w_t,
         e_u, e_v, e_w_old, e_w_new, overloaded_new,
         samp_ids, samp_v, samp_w, pos_w,
     )
+
+
+class PendingDelta:
+    """Handle to ONE churn event's in-flight delta-compacted readback.
+
+    The device state (bands, DR, digests, packed product) is already
+    committed when the handle exists; only the HOST mirror
+    (engine.result) lags until the delta is consumed. ``wait()``
+    consumes (via the engine, which owns ordering) and returns the
+    sorted moved destination names. The engine holds at most one
+    pending delta: the next churn event consumes it inside its own
+    dispatch window (the double-buffer overlap), so a pipelined caller
+    pays zero dedicated host time for the readback."""
+
+    __slots__ = (
+        "_engine", "segs", "counts", "ch_counts", "k",
+        "consumed", "names", "delta_rows", "readback_bytes",
+        "overlap_ms",
+    )
+
+    def __init__(self, engine, segs, counts, ch_counts, k):
+        self._engine = engine
+        self.segs = segs          # per-shard device [k+1, 1+W] arrays
+        self.counts = counts      # per-shard affected counts
+        self.ch_counts = ch_counts  # per-shard CHANGED counts
+        self.k = k
+        self.consumed = False
+        self.names: List[str] = []
+        self.delta_rows = 0
+        self.readback_bytes = 0
+        self.overlap_ms = 0.0
+
+    def wait(self) -> List[str]:
+        if not self.consumed:
+            self._engine.flush()
+        return self.names
 
 
 class RouteSweepEngine:
     """Resident incremental network-wide route product.
 
     cold_build(ls) -> RouteSweepResult (full product)
-    churn(ls, affected_nodes) -> (affected destination names, their
-    fresh per-sample route rows) or None when the event needs a cold
-    rebuild (node add/remove or a sample node's slot-table reshape).
-    Link add/remove and band widening stay on the incremental path;
-    affected-count overflow past the largest bucket takes the
-    full-width refresh (patched layout kept, all rows re-solved in one
-    dispatch — no host recompile) and still reports affected names.
-    """
+    churn(ls, affected_nodes) -> (moved destination names, their
+    fresh per-sample route rows refreshed in self.result) or None when
+    the event needs a cold rebuild (node add/remove or a sample node's
+    slot-table reshape). Link add/remove and band widening stay on the
+    incremental path; affected-count overflow past the largest bucket
+    takes the full-width refresh (patched layout kept, all rows
+    re-solved in one dispatch — no host recompile) and still reports
+    the moved names from the DEVICE product diff.
+
+    Every event class reads back only the delta: the rows whose packed
+    product changed bit-for-bit, compacted on device. With
+    ``defer_consume=True`` churn returns a PendingDelta instead of
+    names and the host-side apply overlaps the NEXT event's dispatch
+    (call ``flush()`` — or ``PendingDelta.wait()`` — to drain).
+    ``churn_coalesced`` folds a debounce window's worth of patches into
+    one fused dispatch + one readback."""
 
     def __init__(self, ls, sample_names: Sequence[str],
                  align: int = 128, mesh: Optional[Mesh] = None):
@@ -429,6 +538,10 @@ class RouteSweepEngine:
             align = align * mesh.devices.size
         self._align = align
         self._k_hint = _ROW_BUCKETS[0]
+        self._pending: Optional[PendingDelta] = None
+        self.last_delta_rows = 0
+        self.last_readback_bytes = 0
+        self.last_overlap_ms = 0.0
         self._build(ls)
 
     def _max_nodes(self) -> int:
@@ -470,6 +583,9 @@ class RouteSweepEngine:
         )
 
     def _build(self, ls) -> None:
+        # a cold rebuild replaces the whole result: drain any in-flight
+        # delta first so a caller-held PendingDelta handle resolves
+        self.flush()
         graph, sweeper = self._compile_backend(ls)
         if graph.n_pad > self._max_nodes():
             raise ValueError(
@@ -498,6 +614,9 @@ class RouteSweepEngine:
         dr, digests, packed = self._full_resident(graph)
         self._dr = dr
         self._digests_dev = digests
+        # the packed product stays RESIDENT: every later dispatch diffs
+        # its fresh rows against this to compact the readback
+        self._packed_dev = packed
         self.result = rs.assemble_result(
             self.sweeper, np.asarray(packed)
         )
@@ -508,6 +627,8 @@ class RouteSweepEngine:
             self, "incremental_events", 0
         )
         self.full_refreshes = getattr(self, "full_refreshes", 0)
+        self.coalesced_events = getattr(self, "coalesced_events", 0)
+        get_registry().counter_bump("route_engine.cold_builds")
 
     def _refresh_sample_bands(self, patched, affected_nodes) -> bool:
         """A churn event that touched a SAMPLE node's own adjacencies
@@ -560,14 +681,18 @@ class RouteSweepEngine:
 
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         """Backend hook: one detect+solve dispatch at bucket size k.
-        Returns (segments [[k+1, W] per shard], commit_state)."""
+        Returns (segments, commit_state) where segments are per-shard
+        IN-FLIGHT device arrays [k+1, 1+W] — nothing is copied to host
+        here; the caller reads the tiny meta row for the retry ladder
+        and the changed rows only at consume time."""
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         graph = ctx["patched"]
         if self.mesh is None:
-            new_v, new_w_t, dr, digests, packed_dev = _churn_step(
+            (new_v, new_w_t, dr, digests, packed_res,
+             packed_dev) = _churn_step(
                 ctx["in_v"], ctx["in_w"],
                 ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
-                self._dr, self._digests_dev,
+                self._dr, self._digests_dev, self._packed_dev,
                 e_u_d, e_v_d, e_wo_d, e_wn_d,
                 ov_new,
                 self.sweeper._samp_ids_dev,
@@ -579,7 +704,7 @@ class RouteSweepEngine:
             # them so an overflow's _apply_patch_resident adopts these
             # instead of re-dispatching _patch_bands
             ctx["patched_bands"] = (new_v, new_w_t)
-            segments = [np.asarray(packed_dev)]
+            segments = [packed_dev]
         else:
             # band patch in its own small dispatch (see
             # _patch_bands) — loop-invariant, dispatched once
@@ -589,9 +714,9 @@ class RouteSweepEngine:
                     ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
                 )
             new_v, new_w_t = ctx["patched_bands"]
-            dr, digests, packed_dev = _sharded_churn_step(
+            dr, digests, packed_res, packed_dev = _sharded_churn_step(
                 new_v, new_w_t,
-                self._dr, self._digests_dev,
+                self._dr, self._digests_dev, self._packed_dev,
                 e_u_d, e_v_d, e_wo_d, e_wn_d,
                 ov_new,
                 self.sweeper._samp_ids_dev,
@@ -599,26 +724,30 @@ class RouteSweepEngine:
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
                 graph.bands, graph.n_pad, k, self.mesh,
             )
-            segments = self._split_segments(np.asarray(packed_dev), k)
-        return segments, (new_v, new_w_t, dr, digests)
+            segments = self._split_segments(packed_dev, k)
+        return segments, (new_v, new_w_t, dr, digests, packed_res)
 
-    def _split_segments(self, packed: np.ndarray, k: int):
-        """Per-shard [k+1, W] segments of a sharded churn readback —
-        the one place that knows the stacked-segment layout."""
-        seg_rows = k + 1
-        return [
-            packed[d * seg_rows : (d + 1) * seg_rows]
-            for d in range(self.mesh.devices.size)
-        ]
+    def _split_segments(self, packed_dev, k: int):
+        """Per-shard [k+1, 1+W] segments of a sharded churn readback,
+        read from the array's ADDRESSABLE SHARDS (ordered by row
+        offset) — each shard's meta row and changed rows transfer from
+        the device that solved them; rows a shard didn't solve never
+        cross to host."""
+        shards = sorted(
+            packed_dev.addressable_shards,
+            key=lambda sh: sh.index[0].start or 0,
+        )
+        return [sh.data for sh in shards]
 
     def _commit_device(self, ctx, commit_state, ov_new) -> None:
         """Backend hook: adopt the dispatch's device state."""
-        new_v, new_w_t, dr, digests = commit_state
+        new_v, new_w_t, dr, digests, packed_res = commit_state
         self.sweeper.v_t = new_v
         self.sweeper.w_t = new_w_t
         self.sweeper.overloaded = ov_new
         self._dr = dr
         self._digests_dev = digests
+        self._packed_dev = packed_res
         self.graph = self.sweeper.graph = ctx["patched"]
 
     def _apply_patch_resident(self, ctx, ov_new) -> None:
@@ -660,16 +789,22 @@ class RouteSweepEngine:
         nothing — but the LAYOUT is still patchable. Keep the patched
         resident tensors and run the full-width dispatch; the host
         layout recompile (the dominant cold-build cost: seconds at 10k)
-        is skipped entirely. Returns the affected names by digest diff,
-        keeping the incremental contract observable."""
+        is skipped entirely.
+
+        The readback is delta-compacted ON DEVICE against the resident
+        previous packed product (_compact_changed): the host reads one
+        scalar + the changed rows, applies them in place
+        (assemble_result delta mode) and reports the moved names from
+        that same diff — no full-product transfer, no host digest
+        copy+diff, no RouteSweepResult re-assembly."""
         self._apply_patch_resident(ctx, ov_new)
-        old_digests = self.result.digests.copy()
         dr, digests, packed = self._full_resident(self.graph)
+        ch_count, comp = _compact_changed(
+            packed, self._packed_dev, self.graph.n
+        )
         self._dr = dr
         self._digests_dev = digests
-        self.result = rs.assemble_result(
-            self.sweeper, np.asarray(packed)
-        )
+        self._packed_dev = packed
         self._commit_host_mirrors(ls, new_out, ov_flips)
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
@@ -677,22 +812,114 @@ class RouteSweepEngine:
         # classes (bucketed incremental / full-width refresh / cold
         # rebuild) stay disjoint in artifacts
         self.full_refreshes += 1
+        get_registry().counter_bump("route_engine.full_refreshes")
         # remember that events are running wide: start the next probe
         # at the top bucket (one dispatch) instead of re-climbing the
         # ladder; small events decay the hint back down as usual
         self._k_hint = _ROW_BUCKETS[-1]
-        names = self.graph.node_names
-        moved = np.flatnonzero(
-            self.result.digests[: len(names)]
-            != old_digests[: len(names)]
-        )
-        return sorted(names[int(t)] for t in moved)
+        m = int(ch_count)
+        names: List[str] = []
+        if m:
+            names = self._apply_delta_rows(np.asarray(comp[:m]))
+        bytes_read = m * comp.shape[1] * 4 + 4  # rows + the scalar
+        self.last_delta_rows = m
+        self.last_readback_bytes = bytes_read
+        self.last_overlap_ms = 0.0
+        reg = get_registry()
+        reg.observe("ops.delta_rows", float(m))
+        reg.observe("ops.readback_bytes", float(bytes_read))
+        return sorted(names)
 
-    def churn(self, ls, affected_nodes: Set[str]):
+    def flush(self):
+        """Consume the in-flight delta, if any (host-side apply of the
+        pending event's changed rows into self.result). Returns the
+        consumed PendingDelta or None."""
+        return self._consume_pending(overlap=False)
+
+    def _apply_delta_rows(self, rows: np.ndarray) -> List[str]:
+        """Apply one compacted [m, 1+W] readback ([dest id, product]
+        per row) into the resident host result, returning the touched
+        destination names. O(m) — the host never walks all rows."""
+        rows = rows[rows[:, 0] < self.graph.n]
+        if not len(rows):
+            return []
+        rs.assemble_result(self.sweeper, rows, into=self.result)
+        names = self.graph.node_names
+        return [names[int(t)] for t in rows[:, 0]]
+
+    def _consume_pending(self, overlap: bool):
+        """Drain the pending delta: read each shard's changed rows
+        (O(changed) transfer) and apply them in place. When ``overlap``
+        is True this runs INSIDE the next event's dispatch window —
+        the host-side apply and the device solve proceed concurrently
+        (the double-buffer payoff, recorded as
+        ops.route_engine.overlap_ms)."""
+        p = self._pending
+        if p is None:
+            return None
+        self._pending = None
+        tracer = get_tracer()
+        span = tracer.span_active("ops.route_engine.delta_consume")
+        t0 = time.perf_counter()
+        names: List[str] = []
+        total_rows = 0
+        total_bytes = 0
+        for seg, m in zip(p.segs, p.ch_counts):
+            # meta row already crossed (retry ladder); count it
+            total_bytes += seg.shape[1] * 4
+            if m:
+                names.extend(
+                    self._apply_delta_rows(np.asarray(seg[1 : 1 + m]))
+                )
+                total_rows += m
+                total_bytes += m * seg.shape[1] * 4
+        ms = (time.perf_counter() - t0) * 1000.0
+        p.names = sorted(set(names))
+        p.consumed = True
+        p.delta_rows = total_rows
+        p.readback_bytes = total_bytes
+        p.overlap_ms = ms if overlap else 0.0
+        self.last_delta_rows = total_rows
+        self.last_readback_bytes = total_bytes
+        self.last_overlap_ms = p.overlap_ms
+        reg = get_registry()
+        reg.observe("ops.delta_rows", float(total_rows))
+        reg.observe("ops.readback_bytes", float(total_bytes))
+        if overlap:
+            reg.observe("ops.route_engine.overlap_ms", ms)
+        tracer.end_span_active(
+            span, overlap=overlap, delta_rows=total_rows,
+            readback_bytes=total_bytes,
+        )
+        return p
+
+    def churn_coalesced(self, ls, affected_sets, defer_consume=False):
+        """Fold N patches that landed inside one debounce window into
+        ONE fused dispatch + ONE compacted readback. Exactly
+        equivalent to N sequential churn() calls by construction: the
+        event diff compares the CURRENT LinkState against the resident
+        raw-weight mirrors, so the union affected set describes the
+        net effect and intermediate states are never observed."""
+        union: Set[str] = set()
+        for s in affected_sets:
+            union |= set(s)
+        if len(affected_sets) > 1:
+            self.coalesced_events += 1
+            get_registry().counter_bump(
+                "route_engine.coalesced_events"
+            )
+        return self.churn(ls, union, defer_consume=defer_consume)
+
+    def churn(self, ls, affected_nodes: Set[str],
+              defer_consume: bool = False):
         """Apply one churn event. Returns the list of affected
         destination NAMES (their digests/sample rows in self.result
         are refreshed in place); falls back to a cold rebuild (and
-        returns None) when incrementality does not apply."""
+        returns None) when incrementality does not apply. With
+        ``defer_consume=True`` the device state commits but the host
+        apply is left in flight: the return value is a PendingDelta
+        (consumed by the next churn inside its dispatch window, or by
+        flush()/wait()) — self.result is stale until then."""
         graph = self.graph
         ctx = self._prepare_patch(ls, sorted(affected_nodes))
         if ctx is None or not self._refresh_sample_bands(
@@ -753,6 +980,8 @@ class RouteSweepEngine:
             # attribute-only event: nothing route-affecting
             self.version = ls.topology_version
             self.aversion = ls.attributes_version
+            if not defer_consume:
+                self.flush()
             return []
 
         e_u = np.asarray([u for (u, _v) in changed], dtype=np.int32)
@@ -784,18 +1013,28 @@ class RouteSweepEngine:
         e_dev = (jnp.asarray(e_u), jnp.asarray(e_v),
                  jnp.asarray(e_wo), jnp.asarray(e_wn))
         buckets = [b for b in _ROW_BUCKETS if b >= self._k_hint]
-        # segments: per-shard [k+1, W] packed arrays (ONE for the
-        # single-chip engine), each leading with its own meta count —
-        # the bucket k bounds the PER-SHARD affected count
-        segments: List[np.ndarray] = []
+        # segments: per-shard IN-FLIGHT [k+1, 1+W] device arrays (ONE
+        # for the single-chip engine), each leading with its own meta
+        # row [affected, changed] — the bucket k bounds the PER-SHARD
+        # affected count; only the meta crosses during the ladder
+        segments: List = []
         counts: List[int] = []
+        ch_counts: List[int] = []
         commit_state = None
         k = None
+        overlapped = False
         for k in buckets:
             segments, commit_state = self._run_bucket(
                 ctx, k, e_dev, ov_new
             )
-            counts = [int(seg[0, 0]) for seg in segments]
+            if not overlapped:
+                # the overlap window: the PREVIOUS event's delta is
+                # consumed on host while this dispatch solves on device
+                self._consume_pending(overlap=True)
+                overlapped = True
+            metas = [np.asarray(seg[0, :2]) for seg in segments]
+            counts = [int(m[0]) for m in metas]
+            ch_counts = [int(m[1]) for m in metas]
             if max(counts) <= k:
                 break
         if max(counts) > k:
@@ -809,32 +1048,21 @@ class RouteSweepEngine:
             _ROW_BUCKETS[0], min(1024, 2 * max(counts))
         )
 
-        # commit
+        # commit the device state NOW; the host-side result apply rides
+        # the pending delta (consumed below, or deferred into the next
+        # event's dispatch window)
         self._commit_device(ctx, commit_state, ov_new)
         self._commit_host_mirrors(ls, new_out, ov_flips)
-
-        s = len(self.sweeper.sample_ids)
-        kw = self.sweeper.samp_v.shape[1] // 32
-        affected_names: List[str] = []
-        for seg, count in zip(segments, counts):
-            for x in range(min(count, k)):
-                row = seg[1 + x]
-                t = int(row[0])
-                if t >= self.graph.n:
-                    continue
-                self.result.digests[t] = np.uint32(row[1])
-                self.result.nh_totals[t] = row[2]
-                self.result.sample_metrics[t] = row[3 : 3 + s]
-                self.result.sample_masks[t] = (
-                    row[3 + s : 3 + s + s * kw]
-                    .view(np.uint32)
-                    .reshape(s, kw)
-                )
-                affected_names.append(self.graph.node_names[t])
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
         self.incremental_events += 1
-        return sorted(set(affected_names))
+        get_registry().counter_bump("route_engine.incremental_events")
+        pending = PendingDelta(self, segments, counts, ch_counts, k)
+        self._pending = pending
+        if defer_consume:
+            return pending
+        self._consume_pending(overlap=False)
+        return pending.names
 
 
 # -- grouped-backend engine ------------------------------------------------
@@ -936,7 +1164,7 @@ def _patch_segments(w_t, upd_g, upd_s, upd_r, upd_w):
 )
 def _grouped_churn_step(
     v_t, w_t, upd_g, upd_s, upd_r, upd_w,
-    dr, digests,
+    dr, digests, packed_res,
     e_u, e_v, e_w_old, e_w_new,
     overloaded_new,
     samp_ids, samp_v, samp_w, pos_w,
@@ -944,12 +1172,13 @@ def _grouped_churn_step(
 ):
     """Fused single-chip grouped churn dispatch: detection against the
     resident DR, segment-slot weight scatter, affected-row re-solve
-    through the grouped relaxation — one device round trip."""
+    through the grouped relaxation — one device round trip, with the
+    same delta-compacted readback as the ELL step."""
     count, local_ids, ids = _detect_rows(
         dr, e_u, e_v, e_w_old, e_w_new, k, 0
     )
     new_w = _patch_segments(w_t, upd_g, upd_s, upd_r, upd_w)
-    dr, digests, packed = _resolve_and_pack(
+    dr, digests, packed_res, out = _resolve_and_pack(
         lambda t: sg._grouped_fixed_point(
             meta, v_t, new_w, overloaded_new, t, n, reverse=True,
             impl=impl,
@@ -958,16 +1187,16 @@ def _grouped_churn_step(
             rows, meta, v_t, new_w, overloaded_new, t
         ),
         overloaded_new, ids, local_ids, count,
-        dr, digests, samp_ids, samp_v, samp_w, pos_w, n, k,
+        dr, digests, packed_res, samp_ids, samp_v, samp_w, pos_w, n, k,
     )
-    return new_w, dr, digests, packed
+    return new_w, dr, digests, packed_res, out
 
 
 @functools.partial(
     jax.jit, static_argnames=("meta", "n", "k", "mesh", "impl")
 )
 def _sharded_grouped_churn_step(
-    v_t, w_t, dr, digests,
+    v_t, w_t, dr, digests, packed_res,
     e_u, e_v, e_w_old, e_w_new,
     overloaded_new,
     samp_ids, samp_v, samp_w, pos_w,
@@ -975,11 +1204,12 @@ def _sharded_grouped_churn_step(
 ):
     """Sharded grouped churn: per-shard detection + re-solve over the
     row-sharded resident DR (segment tensors arrive ALREADY PATCHED by
-    _patch_segments, mirroring the ELL sharded path)."""
+    _patch_segments, mirroring the ELL sharded path), delta-compacted
+    per-shard readback."""
     nseg = len(v_t)
     rows_per = n // mesh.devices.size
 
-    def shard_fn(dr_s, dg_s, *rest):
+    def shard_fn(dr_s, dg_s, pk_s, *rest):
         v_r = rest[:nseg]
         w_r = rest[nseg : 2 * nseg]
         (e_u_r, e_v_r, e_wo_r, e_wn_r, ov_r,
@@ -999,7 +1229,7 @@ def _sharded_grouped_churn_step(
             lambda rows, t: sg._grouped_nh_counts(
                 rows, meta, v_r, w_r, ov_r, t
             ),
-            ov_r, ids, local_ids, count, dr_s, dg_s,
+            ov_r, ids, local_ids, count, dr_s, dg_s, pk_s,
             sid_r, sv_r, sw_r, pw_r, n, k,
         )
 
@@ -1007,7 +1237,8 @@ def _sharded_grouped_churn_step(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
-            [P(SOURCES_AXIS, None), P(SOURCES_AXIS)]
+            [P(SOURCES_AXIS, None), P(SOURCES_AXIS),
+             P(SOURCES_AXIS, None)]
             + [P(None, None)] * nseg
             + [P(None, None, None)] * nseg
             + [P(None)] * 4
@@ -1017,9 +1248,10 @@ def _sharded_grouped_churn_step(
             P(SOURCES_AXIS, None),
             P(SOURCES_AXIS),
             P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS, None),
         ),
     )(
-        dr, digests, *v_t, *w_t,
+        dr, digests, packed_res, *v_t, *w_t,
         e_u, e_v, e_w_old, e_w_new, overloaded_new,
         samp_ids, samp_v, samp_w, pos_w,
     )
@@ -1120,10 +1352,11 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         impl = sg.get_grouped_impl()
         upd_g, upd_s, upd_r, upd_w = ctx["upd"]
         if self.mesh is None:
-            new_w, dr, digests, packed_dev = _grouped_churn_step(
+            (new_w, dr, digests, packed_res,
+             packed_dev) = _grouped_churn_step(
                 self.sweeper.v_t, self.sweeper.w_t,
                 upd_g, upd_s, upd_r, upd_w,
-                self._dr, self._digests_dev,
+                self._dr, self._digests_dev, self._packed_dev,
                 e_u_d, e_v_d, e_wo_d, e_wn_d,
                 ov_new,
                 self.sweeper._samp_ids_dev,
@@ -1134,16 +1367,17 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             # cache the fused step's on-device segment patch for an
             # overflow's _apply_patch_resident (mirrors the ELL path)
             ctx["patched_segs"] = new_w
-            segments = [np.asarray(packed_dev)]
+            segments = [packed_dev]
         else:
             if ctx["patched_segs"] is None:
                 ctx["patched_segs"] = _patch_segments(
                     self.sweeper.w_t, upd_g, upd_s, upd_r, upd_w
                 )
             new_w = ctx["patched_segs"]
-            dr, digests, packed_dev = _sharded_grouped_churn_step(
+            (dr, digests, packed_res,
+             packed_dev) = _sharded_grouped_churn_step(
                 self.sweeper.v_t, new_w,
-                self._dr, self._digests_dev,
+                self._dr, self._digests_dev, self._packed_dev,
                 e_u_d, e_v_d, e_wo_d, e_wn_d,
                 ov_new,
                 self.sweeper._samp_ids_dev,
@@ -1151,15 +1385,16 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
                 self.sweeper.meta, graph.n_pad, k, self.mesh, impl,
             )
-            segments = self._split_segments(np.asarray(packed_dev), k)
-        return segments, (new_w, dr, digests)
+            segments = self._split_segments(packed_dev, k)
+        return segments, (new_w, dr, digests, packed_res)
 
     def _commit_device(self, ctx, commit_state, ov_new) -> None:
-        new_w, dr, digests = commit_state
+        new_w, dr, digests, packed_res = commit_state
         self.sweeper.w_t = new_w
         self.sweeper.overloaded = ov_new
         self._dr = dr
         self._digests_dev = digests
+        self._packed_dev = packed_res
         self.graph = self.sweeper.graph = ctx["patched"]
 
     def _apply_patch_resident(self, ctx, ov_new) -> None:

@@ -292,12 +292,32 @@ def _unpack_blocks(packed: np.ndarray, s: int, kw: int):
     )
 
 
-def assemble_result(sweeper, packed: np.ndarray) -> "RouteSweepResult":
+def assemble_result(
+    sweeper, packed: np.ndarray, into: "RouteSweepResult" = None
+) -> "RouteSweepResult":
     """Build a RouteSweepResult from a full [n_pad, W] packed array —
     the ONE assembly site shared by every one-dispatch sweep (the ELL
-    and grouped sharded variants)."""
+    and grouped sharded variants).
+
+    Delta mode (``into=``): ``packed`` is a COMPACTED [m, 1+W] delta —
+    each row a destination id followed by that row's fresh product —
+    and the decoded fields are scattered in place into the existing
+    result. O(m) host work instead of O(n_pad): this is how the
+    engine's delta-compacted readbacks land without re-assembling the
+    whole product (ids must be in-range; the engine filters padding
+    rows before calling)."""
     s = len(sweeper.sample_ids)
     kw = sweeper.samp_v.shape[1] // 32
+    if into is not None:
+        ids = packed[:, 0]
+        dg, nt, sm, sk = _unpack_blocks(
+            np.ascontiguousarray(packed[:, 1:]), s, kw
+        )
+        into.digests[ids] = dg
+        into.nh_totals[ids] = nt
+        into.sample_metrics[ids] = sm
+        into.sample_masks[ids] = sk
+        return into
     dg, nt, sm, sk = _unpack_blocks(packed, s, kw)
     return RouteSweepResult(
         graph=sweeper.graph,

@@ -72,6 +72,7 @@ ELL_COUNTERS = _get_registry().counter_dict(
         "ell_warm_solves",        # solves seeded from the previous d
         "ell_cold_solves",        # solves from the unit init
         "ell_widen_events",       # widen-on-overflow band re-uploads
+        "ell_patch_merges",       # stacked patches coalesced warm
     ],
     prefix="decision.",
 )
@@ -607,17 +608,19 @@ def ell_patch(
     )
 
 
-def band_row_edge_delta(
+def band_row_edge_changes(
     old: EllGraph, patched: EllGraph
-) -> List[Tuple[int, int, int]]:
-    """Directed-edge weight INCREASES implied by a patch's changed
-    rows: [(tail id, head id, old collapsed weight)] for every
-    (tail, head) whose min-over-parallel-slots weight went UP (an edge
-    removal reads as old_w -> INF). Decreases are deliberately absent:
-    a min-relaxation warm start only needs the increase-affected cone
-    — decreased rows keep their previous distances as valid upper
-    bounds. O(changed rows x K_class) host work, no band scan."""
-    inc: List[Tuple[int, int, int]] = []
+) -> List[Tuple[int, int, int, int]]:
+    """ALL directed-edge weight changes implied by a patch's changed
+    rows: [(tail id, head id, old collapsed weight, new collapsed
+    weight)] for every (tail, head) whose min-over-parallel-slots
+    weight moved (removal reads as old_w -> INF, addition as
+    INF -> new_w). O(changed rows x K_class) host work, no band scan.
+    The full (old, new) pair is what lets the warm-start journal MERGE
+    stacked patches: the first touch of an edge snapshots the weight
+    the resident distances were solved under, later touches only move
+    the current side."""
+    out: List[Tuple[int, int, int, int]] = []
     changed = patched.changed or {}
     for bi, rows in changed.items():
         band = patched.bands[bi]
@@ -641,9 +644,30 @@ def band_row_edge_delta(
                 if wv < new_w.get(s, INF):
                     new_w[s] = wv
             for s, wo in old_w.items():
-                if new_w.get(s, INF) > wo:
-                    inc.append((s, head, wo))
-    return inc
+                wn = new_w.get(s, INF)
+                if wn != wo:
+                    out.append((s, head, wo, wn))
+            for s, wn in new_w.items():
+                if s not in old_w:
+                    out.append((s, head, INF, wn))
+    return out
+
+
+def band_row_edge_delta(
+    old: EllGraph, patched: EllGraph
+) -> List[Tuple[int, int, int]]:
+    """Directed-edge weight INCREASES implied by a patch's changed
+    rows: [(tail id, head id, old collapsed weight)] for every
+    (tail, head) whose min-over-parallel-slots weight went UP (an edge
+    removal reads as old_w -> INF). Decreases are deliberately absent:
+    a min-relaxation warm start only needs the increase-affected cone
+    — decreased rows keep their previous distances as valid upper
+    bounds. Thin view over band_row_edge_changes."""
+    return [
+        (s, h, wo)
+        for s, h, wo, wn in band_row_edge_changes(old, patched)
+        if wn > wo
+    ]
 
 
 # sentinel "increase" edge that flags EVERY row's seed for reset (the
@@ -1195,21 +1219,21 @@ class EllState:
         self.w = tuple(jnp.asarray(w) for w in graph.w)
         self.overloaded = jnp.asarray(graph.overloaded)
         # warm-start state: the previous solve's distance rows plus the
-        # source batch they belong to, and at most ONE un-solved patch's
-        # increase-edge delta (pending_inc). Tight tests are only sound
-        # against the distance snapshot the old weights were read under,
-        # so a SECOND patch before a solve degrades to a forced reset
-        # instead of chaining stale tests.
+        # source batch they belong to, and a MERGEABLE journal of every
+        # un-solved patch's edge changes. Each journal entry keys
+        # (tail, head) -> (w_snapshot, w_current): the snapshot is the
+        # collapsed weight the RESIDENT DISTANCES were solved under
+        # (first touch wins — an edge changed twice inside one debounce
+        # window keeps its original snapshot), the current side tracks
+        # the latest patch. At solve time the increase delta is emitted
+        # against the snapshots, which is exactly what the tight test
+        # is sound against — so stacked patches coalesce into one warm
+        # solve instead of degrading to a forced cold seed.
         self._d_dev = None
         self._warm_key: Optional[Tuple[int, ...]] = None
-        self._pending_inc: List[Tuple[int, int, int]] = []
-        # True once ANY un-solved patch is journaled — tracked
-        # separately from _pending_inc because a pure-decrease patch
-        # journals an EMPTY increase delta yet still moves the weight
-        # snapshot (a later increase of an edge this patch decreased
-        # would test tightness against distances the old weight was
-        # never read under)
-        self._pending_patch = False
+        self._pending_edges: Dict[
+            Tuple[int, int], Tuple[int, int]
+        ] = {}
         self._pending_force = False
 
     def _sync_overloaded(self, patched: EllGraph) -> bool:
@@ -1221,7 +1245,14 @@ class EllState:
         return changed
 
     def _note_patch(self, patched: EllGraph, ov_changed: bool) -> None:
-        """Fold one patch's delta into the warm-start journal."""
+        """Fold one patch's delta into the warm-start journal. Stacked
+        patches MERGE: an edge already journaled keeps its weight
+        snapshot (taken from the last-solved graph) and only advances
+        its current side, so a burst of patches inside one debounce
+        window still emits a single sound increase delta at solve
+        time. Only an overload-mask flip forces the cold seed (the
+        tight test runs on raw weights and is not valid across an
+        effective-weight change)."""
         if patched.changed:
             ELL_COUNTERS["ell_incremental_syncs"] += 1
         if patched.widened:
@@ -1229,20 +1260,15 @@ class EllState:
         if self._d_dev is None:
             return
         if ov_changed:
-            # the tight test runs on RAW weights; it is not valid
-            # across an effective-weight (overload mask) change
             self._pending_force = True
             return
         if not patched.changed:
             return  # no-op sync: the journal is untouched
-        if self._pending_patch or self._pending_force:
-            # a second patch stacked on an un-solved one: the tight
-            # test is only sound against the distance snapshot the old
-            # weights were read under — fall back to a forced cold seed
-            self._pending_force = True
-        else:
-            self._pending_inc = band_row_edge_delta(self.graph, patched)
-            self._pending_patch = True
+        if self._pending_edges:
+            ELL_COUNTERS["ell_patch_merges"] += 1
+        for s, h, wo, wn in band_row_edge_changes(self.graph, patched):
+            snap, _cur = self._pending_edges.get((s, h), (wo, wo))
+            self._pending_edges[(s, h)] = (snap, wn)
 
     def apply_patch(self, patched: EllGraph) -> None:
         """Scatter a patched graph's changed rows into the resident
@@ -1298,7 +1324,15 @@ class EllState:
             and not self._pending_force
         )
         if warm:
-            inc = list(self._pending_inc)
+            # increases vs the SNAPSHOT weights the resident distances
+            # were solved under (edges that moved and came back to or
+            # below their snapshot need no reset: the old rows are
+            # still valid upper bounds)
+            inc = [
+                (s, h, snap)
+                for (s, h), (snap, cur) in self._pending_edges.items()
+                if cur > snap
+            ]
             d_prev = self._d_dev
             ELL_COUNTERS["ell_warm_solves"] += 1
         else:
@@ -1322,8 +1356,7 @@ class EllState:
         _t_end = time.perf_counter()
         self._d_dev = d
         self._warm_key = srcs_key
-        self._pending_inc = []
-        self._pending_patch = False
+        self._pending_edges = {}
         self._pending_force = False
         self.graph = _replace(patched, changed=None)
         _total_ms = (_t_end - _t0) * 1000.0

@@ -49,3 +49,60 @@ def test_churn_engages_incremental_path(monkeypatch):
     assert delta("decision.ell_full_compiles") == 0
     # ...and the solves must warm-start, not silently reset
     assert delta("decision.ell_warm_solves") >= 4
+
+
+def test_metric_churn_never_reads_full_product():
+    """Readback-regression guard for the resident route engine: pure
+    metric churn must stay on the bucketed incremental path with a
+    DELTA-compacted readback — bytes scaling with changed rows (exact
+    identity below), never with the full [n_pad, W] packed product. A
+    refactor that silently demotes metric events to the full-width
+    refresh (or reads the whole product back per event) fails here
+    while still passing the parity suites."""
+    from dataclasses import replace
+
+    from openr_tpu.ops import route_engine
+    from openr_tpu.telemetry import get_registry
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    names = sorted(topo.adj_dbs)
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    full_bytes = (
+        engine._packed_dev.shape[0] * engine._packed_dev.shape[1] * 4
+    )
+    snap0 = get_registry().snapshot()
+    fsw = next(n for n in engine.graph.node_names
+               if n.startswith("fsw"))
+    for step in range(5):
+        db = ls.get_adjacency_databases()[fsw]
+        adjs = list(db.adjacencies)
+        # alternate low/high so EVERY event moves routes (moved names
+        # are now the device-diffed truly-changed set — a monotone
+        # walk past the ECMP alternatives stops changing anything)
+        adjs[0] = replace(adjs[0], metric=(2, 9)[step % 2])
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        moved = engine.churn(ls, {fsw, adjs[0].other_node_name})
+        assert moved, step  # stayed incremental AND found movement
+        # per-event accounting identity: one meta row per shard
+        # segment plus exactly the changed rows, at readback row width
+        row_bytes = (engine._packed_dev.shape[1] + 1) * 4
+        assert engine.last_readback_bytes == (
+            engine.last_delta_rows + 1
+        ) * row_bytes, step
+        assert engine.last_delta_rows == len(moved), step
+        assert engine.last_readback_bytes < full_bytes, step
+    # metric churn NEVER takes the full-product path
+    assert engine.full_refreshes == 0
+    assert engine.cold_builds == 1
+    assert engine.incremental_events == 5
+    # and the readback histograms were fed (one sample per event)
+    snap1 = get_registry().snapshot()
+    for key in ("ops.readback_bytes.count", "ops.delta_rows.count"):
+        assert snap1.get(key, 0) - snap0.get(key, 0) >= 5, key

@@ -114,11 +114,16 @@ class TestEllStateWarmParity:
         assert c1["ell_warm_solves"] - c0["ell_warm_solves"] >= 4
         assert c1["ell_cold_solves"] - c0["ell_cold_solves"] >= 1
 
-    def test_stacked_patches_force_cold_but_match(self):
-        """Two patches landing before a solve: tight tests are only
-        sound against the distance snapshot the old weights were read
-        under, so the journal degrades to a forced reset — which must
-        still be bit-identical."""
+    def test_stacked_patches_merge_warm_and_match(self):
+        """Two patches landing before a solve MERGE in the journal:
+        each edge keeps the weight snapshot from the LAST-SOLVED graph
+        (first touch wins) while the current side advances, so the
+        increase delta emitted at solve time is sound against the
+        resident distances and the solve stays WARM — including the
+        adversarial order (decrease then increase of the same edge)
+        where chaining tight tests against the intermediate weight
+        would under-seed. Bit-identity against the cold oracle is the
+        proof; stacked patches used to force a cold seed here."""
         topo = topologies.random_mesh(14, degree=3, seed=9, max_metric=7)
         ls = load(topo)
         state = spf_sparse.EllState(spf_sparse.compile_ell(ls))
@@ -132,14 +137,16 @@ class TestEllStateWarmParity:
         assert p1 is not None
         state.apply_patch(p1)
 
-        # patch 2 stacked on the un-solved journal: adversarial order
-        # (decrease then increase of the same edge) where a chained
-        # tight test against the stale snapshot would under-seed
+        # patch 2 stacked on the un-solved journal: decrease then
+        # increase of the same edge — the merged entry must test
+        # tightness against the ORIGINAL snapshot, not patch 1's value
         c0 = dict(spf_sparse.ELL_COUNTERS)
         _mutate_metric(ls, "node-2", 0, 30)
         self._check(state, ls, {"node-2", o2})
         c1 = dict(spf_sparse.ELL_COUNTERS)
-        assert c1["ell_cold_solves"] > c0["ell_cold_solves"]
+        assert c1["ell_warm_solves"] > c0["ell_warm_solves"]
+        assert c1["ell_patch_merges"] > c0["ell_patch_merges"]
+        assert c1["ell_cold_solves"] == c0["ell_cold_solves"]
 
         # journal drained by the solve: next pure-metric event is warm
         c0 = c1
