@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 churn-smoke telemetry-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke bench clean install
 
 all: native
 
@@ -31,8 +31,18 @@ test: native
 test-fast: native
 	python -m pytest tests/ -q -x -m "not slow"
 
-# the ROADMAP tier-1 gate, verbatim (CPU-pinned, bounded, dot-counted)
-tier1: native
+# invariant linters (openr_tpu/analysis): donation-hazard,
+# host-sync-in-window, lock-order, span-discipline, retrace-risk.
+# Pure-ast pass, no jax import, a few seconds on the whole tree.
+# Exit 1 on any unsuppressed finding; suppressions need a reason
+# (see docs/RUNBOOK.md "Invariant lint triage").
+lint-analysis:
+	python -m openr_tpu.analysis
+
+# the ROADMAP tier-1 gate, verbatim (CPU-pinned, bounded, dot-counted);
+# the invariant linters run first — a finding fails the gate before
+# the test suite spends its budget
+tier1: native lint-analysis
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device

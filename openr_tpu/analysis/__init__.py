@@ -1,0 +1,37 @@
+"""Invariant lint engine: machine-checked hot-path rules.
+
+PRs 1-3 made the churn path fast by imposing invariants that lived only
+in prose (docs/ARCHITECTURE.md): residents are never donated into churn
+dispatches (the retry-ladder hazard), ``PendingDelta`` is drained before
+cold rebuilds, no host sync inside a solve window, every ``Tracer`` span
+closes on all paths, and the per-module threads acquire locks in a
+consistent order. This package turns each of those rules into a checker
+that runs on every PR (``make lint-analysis`` / tier-1's meta-test):
+
+- :mod:`openr_tpu.analysis.core` — the AST framework: per-file parse,
+  rule registry, ``# openr-lint: disable=<rule> -- reason`` suppression
+  syntax, and the two-phase (collect -> check -> finalize) driver that
+  lets rules see the whole tree before reporting.
+- :mod:`openr_tpu.analysis.annotations` — the runtime-inert marker API
+  (``@solve_window``, ``@resident_buffers``, ``@requires_drain``,
+  ``@donates``) the checkers key on. Importing it costs nothing on the
+  hot path; the markers double as reviewer-facing documentation.
+- :mod:`openr_tpu.analysis.rules` — the five checkers:
+  ``donation-hazard``, ``host-sync-in-window``, ``lock-order``,
+  ``span-discipline``, ``retrace-risk``.
+- :mod:`openr_tpu.analysis.lockdep` — the runtime lock-order tracker
+  (lockdep-style) that tests activate to catch dynamic inversions the
+  static graph over-approximates.
+
+This package deliberately imports neither jax nor numpy: the static
+pass must stay a sub-second pure-``ast`` walk.
+"""
+
+from openr_tpu.analysis.core import (  # noqa: F401
+    AnalysisContext,
+    Finding,
+    Report,
+    SourceFile,
+    run_analysis,
+)
+from openr_tpu.analysis.rules import ALL_RULES  # noqa: F401

@@ -1,0 +1,3 @@
+from openr_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,91 @@
+"""Runtime-inert annotation API the static checkers key on.
+
+The lint rules need ground truth that types alone cannot carry: which
+attributes are device-resident buffers, which functions run inside a
+solve window, which cold-rebuild paths must drain the pending delta
+first, and which plain-Python wrappers donate specific parameters into
+a jitted dispatch. These decorators record exactly that — as function /
+class attributes at runtime (free after import; nothing on the hot
+path reads them) and as names the AST pass recognizes syntactically.
+
+The decorators MUST stay dependency-free (no jax, no numpy): annotated
+modules import this at module load, including under ``make
+lint-analysis`` which never touches an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, TypeVar
+
+F = TypeVar("F", bound=Callable)
+C = TypeVar("C", bound=type)
+
+#: attribute names the markers are stored under (shared with the AST
+#: rules so both sides agree on one spelling)
+SOLVE_WINDOW_ATTR = "__openr_solve_window__"
+RESIDENT_ATTR = "__openr_resident_buffers__"
+REQUIRES_DRAIN_ATTR = "__openr_requires_drain__"
+DONATES_ATTR = "__openr_donates__"
+
+
+def solve_window(fn: F) -> F:
+    """Mark a function as solve-window code: it runs between a churn
+    dispatch and its commit, where any host synchronization
+    (``np.asarray`` on a device array, ``jax.device_get``,
+    ``.block_until_ready()``, ``float()`` on an Array) serializes the
+    device pipeline. The ``host-sync-in-window`` rule flags those call
+    forms in the function's direct body."""
+    try:
+        setattr(fn, SOLVE_WINDOW_ATTR, True)
+    except AttributeError:
+        # jit-wrapped callables may reject attributes; the static
+        # checker reads the decorator syntactically either way
+        pass
+    return fn
+
+
+def resident_buffers(*attr_names: str) -> Callable[[C], C]:
+    """Class decorator registering device-RESIDENT buffer attributes
+    (``_packed_dev``-style state that later dispatches re-read). The
+    ``donation-hazard`` rule flags any of these flowing into a donating
+    dispatch or being read after donation."""
+
+    def deco(cls: C) -> C:
+        merged = tuple(getattr(cls, RESIDENT_ATTR, ())) + attr_names
+        setattr(cls, RESIDENT_ATTR, merged)
+        return cls
+
+    return deco
+
+
+def requires_drain(drain_call: str) -> Callable[[F], F]:
+    """Mark a method that replaces resident state wholesale (a cold
+    rebuild): it must invoke ``drain_call`` (e.g. ``flush``) before any
+    write to a resident buffer, so a caller-held ``PendingDelta``
+    resolves instead of dangling over freed device state. Checked by
+    ``donation-hazard``."""
+
+    def deco(fn: F) -> F:
+        try:
+            setattr(fn, REQUIRES_DRAIN_ATTR, drain_call)
+        except AttributeError:
+            pass
+        return fn
+
+    return deco
+
+
+def donates(*param_names: str) -> Callable[[F], F]:
+    """Mark a plain-Python wrapper whose named parameters are forwarded
+    into a ``donate_argnums`` position of a jitted dispatch (the array
+    is invalid after the call). Lets the ``donation-hazard`` rule check
+    cross-module call sites without whole-program type inference."""
+
+    def deco(fn: F) -> F:
+        try:
+            setattr(fn, DONATES_ATTR, tuple(param_names))
+        except AttributeError:
+            pass
+        return fn
+
+    return deco

@@ -1,0 +1,115 @@
+"""``python -m openr_tpu.analysis`` — run the invariant linters.
+
+Exit status is the contract: 0 when every finding is suppressed (with a
+reason), 1 otherwise — so ``make lint-analysis`` and tier-1 can gate on
+it. ``--json`` additionally writes the machine-readable report (same
+payload ``tools/lint_report.py`` wraps for CI artifacts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from openr_tpu.analysis.core import run_analysis
+from openr_tpu.analysis.rules import ALL_RULES
+
+
+def _default_root() -> str:
+    # package lives at <root>/openr_tpu/analysis/cli.py
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m openr_tpu.analysis",
+        description="openr-tpu invariant linters "
+        "(donation-hazard, host-sync-in-window, lock-order, "
+        "span-discipline, retrace-risk)",
+    )
+    ap.add_argument(
+        "targets",
+        nargs="*",
+        default=["openr_tpu"],
+        help="files or directories relative to --root "
+        "(default: openr_tpu)",
+    )
+    ap.add_argument(
+        "--root",
+        default=_default_root(),
+        help="repository root (default: autodetected from the package)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE_ID",
+        help="run only this rule (repeatable)",
+    )
+    ap.add_argument(
+        "--json",
+        metavar="PATH",
+        help="write the machine-readable report here ('-' for stdout)",
+    )
+    ap.add_argument(
+        "--show-suppressed",
+        action="store_true",
+        help="also print suppressed findings with their reasons",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.id:22s} {cls.description}")
+        return 0
+
+    rules = None
+    if args.rules:
+        known = {cls.id: cls for cls in ALL_RULES}
+        unknown = [r for r in args.rules if r not in known]
+        if unknown:
+            print(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [known[r]() for r in args.rules]
+
+    report = run_analysis(args.root, targets=args.targets, rules=rules)
+
+    shown: List[str] = []
+    for f in report.findings:
+        if f.suppressed and not args.show_suppressed:
+            continue
+        shown.append(str(f))
+    for line in shown:
+        print(line)
+    n_sup = len(report.findings) - len(report.unsuppressed)
+    print(
+        f"lint-analysis: {report.files_scanned} files, "
+        f"{len(report.unsuppressed)} finding(s), "
+        f"{n_sup} suppressed, {report.duration_s * 1000:.0f} ms",
+        file=sys.stderr,
+    )
+
+    if args.json:
+        payload = json.dumps(report.to_dict(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

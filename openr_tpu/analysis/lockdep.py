@@ -1,0 +1,224 @@
+"""Runtime lock-order tracker (lockdep-style), the dynamic companion to
+the static ``lock-order`` rule.
+
+The static graph over-approximates: it cannot see locks handed through
+queues or callbacks registered at runtime. This shim closes that gap
+the way the kernel's lockdep does — it learns the *order* in which lock
+classes are taken and flags an inversion the first time the reversed
+order is observed on ANY thread, without needing the actual deadlock to
+strike:
+
+    thread 1: with a: with b: ...      # learns edge A -> B
+    thread 2: with b: with a: ...      # B -> A closes a cycle -> flag
+
+Usage (tests; production code never imports this on the hot path)::
+
+    dep = LockDepTracker()
+    a = TrackedLock("kvstore.store", tracker=dep)
+    b = TrackedLock("telemetry.registry", tracker=dep)
+    with a, b: ...
+    with b, a: ...          # -> LockOrderViolation recorded
+    dep.violations          # [LockOrderViolation(cycle=("A","B"), ...)]
+
+``TrackedLock`` wraps a real ``threading.Lock``/``RLock`` (or creates
+one), so the protected code still genuinely excludes. A module-level
+tracker (``get_tracker``/``reset_tracker``) lets a test fixture observe
+locks created in code under test. The tracker never deadlocks the
+program itself: detection is edge-graph reachability at acquire time,
+and violations are *recorded* (and optionally raised) rather than
+blocking.
+
+Keyed by lock *class* (the name string), not instance — two instances
+of the same class count as one node, matching the static rule's
+``ClassName._attr`` identity.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class _Witness:
+    """Where an edge was first observed."""
+
+    holder: str
+    acquired: str
+    thread: str
+
+
+@dataclass
+class LockOrderViolation:
+    """An acquisition that closed a cycle in the learned order graph."""
+
+    cycle: Tuple[str, ...]
+    witness: _Witness
+    prior: List[_Witness] = field(default_factory=list)
+
+    def __str__(self) -> str:
+        chain = " -> ".join(self.cycle + (self.cycle[0],))
+        return (
+            f"lock-order inversion {chain}: thread {self.witness.thread} "
+            f"acquired {self.witness.acquired} while holding "
+            f"{self.witness.holder}, but the reverse order was "
+            "previously observed"
+        )
+
+
+class LockOrderError(RuntimeError):
+    """Raised on inversion when the tracker is in raising mode."""
+
+
+class LockDepTracker:
+    """Learns held->acquired edges between lock classes and detects
+    cycles at acquire time."""
+
+    def __init__(self, raise_on_violation: bool = False) -> None:
+        self._mu = threading.Lock()
+        self._edges: Dict[Tuple[str, str], _Witness] = {}
+        self._tls = threading.local()
+        self.raise_on_violation = raise_on_violation
+        self.violations: List[LockOrderViolation] = []
+
+    # -- held-stack bookkeeping --------------------------------------
+
+    def _stack(self) -> List[Tuple[str, bool]]:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def on_acquire(self, name: str, reentrant: bool) -> None:
+        stack = self._stack()
+        violation: Optional[LockOrderViolation] = None
+        with self._mu:
+            for held, held_reentrant in stack:
+                if held == name:
+                    if reentrant and held_reentrant:
+                        continue  # RLock recursion is the design
+                    violation = LockOrderViolation(
+                        cycle=(name,),
+                        witness=_Witness(
+                            held, name, threading.current_thread().name
+                        ),
+                    )
+                    break
+                path = self._path(name, held)
+                if path is not None:
+                    cycle = (held,) + tuple(path)
+                    violation = LockOrderViolation(
+                        cycle=cycle,
+                        witness=_Witness(
+                            held, name, threading.current_thread().name
+                        ),
+                        prior=[
+                            self._edges[(a, b)]
+                            for a, b in zip(path, path[1:])
+                            if (a, b) in self._edges
+                        ],
+                    )
+                    break
+                self._edges.setdefault(
+                    (held, name),
+                    _Witness(held, name, threading.current_thread().name),
+                )
+            if violation is not None:
+                self.violations.append(violation)
+        stack.append((name, reentrant))
+        if violation is not None and self.raise_on_violation:
+            raise LockOrderError(str(violation))
+
+    def on_release(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i][0] == name:
+                del stack[i]
+                return
+
+    # -- graph reachability (caller holds self._mu) ------------------
+
+    def _path(self, src: str, dst: str) -> Optional[Tuple[str, ...]]:
+        """Edge path src -> ... -> dst in the learned graph, or None."""
+        if src == dst:
+            return (src,)
+        adj: Dict[str, List[str]] = {}
+        for a, b in self._edges:
+            adj.setdefault(a, []).append(b)
+        seen = {src}
+        frontier: List[Tuple[str, Tuple[str, ...]]] = [(src, (src,))]
+        while frontier:
+            node, path = frontier.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == dst:
+                    return path + (nxt,)
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append((nxt, path + (nxt,)))
+        return None
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self.violations.clear()
+
+
+class TrackedLock:
+    """A real lock with lockdep instrumentation. Drop-in for the
+    ``with``-statement and acquire/release protocols."""
+
+    def __init__(
+        self,
+        name: str,
+        lock: Optional[object] = None,
+        reentrant: bool = False,
+        tracker: Optional[LockDepTracker] = None,
+    ) -> None:
+        self.name = name
+        self.reentrant = reentrant
+        self._lock = lock if lock is not None else (
+            threading.RLock() if reentrant else threading.Lock()
+        )
+        self._tracker = tracker if tracker is not None else get_tracker()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # record BEFORE blocking: lockdep's whole point is to flag the
+        # inversion even when the deadlock doesn't strike this run
+        self._tracker.on_acquire(self.name, self.reentrant)
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            self._tracker.on_release(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        self._tracker.on_release(self.name)
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+_global_tracker: Optional[LockDepTracker] = None
+_global_mu = threading.Lock()
+
+
+def get_tracker() -> LockDepTracker:
+    global _global_tracker
+    with _global_mu:
+        if _global_tracker is None:
+            _global_tracker = LockDepTracker()
+        return _global_tracker
+
+
+def reset_tracker() -> LockDepTracker:
+    """Fresh module-level tracker (test fixtures call this)."""
+    global _global_tracker
+    with _global_mu:
+        _global_tracker = LockDepTracker()
+        return _global_tracker

@@ -1,0 +1,26 @@
+"""The five invariant checkers. Each module exports one Rule class;
+``ALL_RULES`` is the canonical registry consumed by
+``core.run_analysis`` and the CLI."""
+
+from openr_tpu.analysis.rules.donation import DonationHazardRule
+from openr_tpu.analysis.rules.hostsync import HostSyncInWindowRule
+from openr_tpu.analysis.rules.lockorder import LockOrderRule
+from openr_tpu.analysis.rules.retrace import RetraceRiskRule
+from openr_tpu.analysis.rules.spans import SpanDisciplineRule
+
+ALL_RULES = (
+    DonationHazardRule,
+    HostSyncInWindowRule,
+    LockOrderRule,
+    SpanDisciplineRule,
+    RetraceRiskRule,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "DonationHazardRule",
+    "HostSyncInWindowRule",
+    "LockOrderRule",
+    "SpanDisciplineRule",
+    "RetraceRiskRule",
+]

@@ -1,0 +1,364 @@
+"""``donation-hazard``: resident device buffers vs donating dispatches.
+
+The churn path's correctness hinges on a buffer-lifetime discipline
+that jax will not check for you:
+
+- a RESIDENT buffer (``@resident_buffers`` attribute, ``_packed_dev``
+  style) must never flow into a ``donate_argnums`` position of a jitted
+  dispatch that can re-run against the same inputs — the route engine's
+  overflow retry ladder re-dispatches at a larger bucket against the
+  SAME resident arrays, so a donated resident is freed memory on the
+  second rung (silent wrong routes or a crash, depending on backend);
+- a value donated into a dispatch must not be read afterwards in the
+  same function (donation invalidates the buffer);
+- a cold rebuild (``@requires_drain``) must drain the in-flight
+  ``PendingDelta`` before replacing resident buffers, or a caller-held
+  handle resolves against freed device state.
+
+Detection is name-based and alias-tainting: a local bound from a
+resident attribute carries the taint into call arguments. Donating
+callables are found two ways: jitted defs whose decorator carries
+``donate_argnums``/``donate_argnames``, and plain wrappers annotated
+``@donates("param", ...)`` (the cross-module escape hatch — wrappers
+forward into jitted donators the checker already understands).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    call_kwarg,
+    decorator_info,
+    dotted_name,
+    literal_or_none,
+)
+
+RULE_ID = "donation-hazard"
+
+#: attribute spellings that are resident by convention even without an
+#: explicit ``@resident_buffers`` registration (the ``_*_dev`` style
+#: plus the engines' resident distance matrix)
+_DEFAULT_RESIDENT = ("_dr",)
+
+
+def _is_resident_name(attr: str, registered: Set[str]) -> bool:
+    return (
+        attr in registered
+        or attr in _DEFAULT_RESIDENT
+        or (attr.startswith("_") and attr.endswith("_dev"))
+    )
+
+
+def _params(fn: ast.AST) -> List[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _branch_contexts(fn: ast.AST) -> Dict[int, Tuple[Tuple[int, bool], ...]]:
+    """line -> chain of (If-node id, branch) enclosing it, so the
+    read-after-donation check can skip pairs on mutually exclusive
+    paths (donation in the ``elif``, read in the ``else``)."""
+    ctx_of: Dict[int, Tuple[Tuple[int, bool], ...]] = {}
+
+    def mark(node: ast.AST, ctx: Tuple[Tuple[int, bool], ...]) -> None:
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        for ln in range(node.lineno, end + 1):
+            ctx_of.setdefault(ln, ctx)
+
+    def walk(stmts: List[ast.stmt], ctx: Tuple[Tuple[int, bool], ...]) -> None:
+        for s in stmts:
+            if isinstance(s, ast.If):
+                mark(s.test, ctx)
+                walk(s.body, ctx + ((id(s), True),))
+                walk(s.orelse, ctx + ((id(s), False),))
+            elif isinstance(s, ast.Try):
+                walk(s.body, ctx)
+                for h in s.handlers:
+                    walk(h.body, ctx)
+                walk(s.orelse, ctx)
+                walk(s.finalbody, ctx)
+            elif isinstance(
+                s, (ast.For, ast.AsyncFor, ast.While, ast.With, ast.AsyncWith)
+            ):
+                walk(s.body, ctx)
+                walk(getattr(s, "orelse", []) or [], ctx)
+            else:
+                mark(s, ctx)
+
+    walk(fn.body, ())
+    return ctx_of
+
+
+def _exclusive(
+    a: Tuple[Tuple[int, bool], ...], b: Tuple[Tuple[int, bool], ...]
+) -> bool:
+    """True when the two contexts sit in different branches of the same
+    If — they cannot execute on one path."""
+    da = dict(a)
+    return any(da.get(k, v) != v for k, v in b)
+
+
+class DonationHazardRule(Rule):
+    id = RULE_ID
+    description = (
+        "resident buffers must not be donated, donated values must not "
+        "be read back, and cold rebuilds must drain the pending delta"
+    )
+
+    # -- collect: donating callables + resident attrs ----------------
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        store = ctx.scratch(self.id)
+        donators: Dict[str, Dict[str, object]] = store.setdefault(
+            "donators", {}
+        )
+        resident: Set[str] = store.setdefault("resident", set())
+        drains: List[Tuple[SourceFile, ast.AST, str]] = store.setdefault(
+            "drains", []
+        )
+
+        for cls in sf.classes():
+            for dec in cls.decorator_list:
+                name, call = decorator_info(dec)
+                if name and name.split(".")[-1] == "resident_buffers" and call:
+                    for arg in call.args:
+                        val = literal_or_none(arg)
+                        if isinstance(val, str):
+                            resident.add(val)
+
+        for fn, _cls in sf.functions():
+            params = _params(fn)
+            donated: Set[str] = set()
+            for dec in fn.decorator_list:
+                name, call = decorator_info(dec)
+                if name is None:
+                    continue
+                leaf = name.split(".")[-1]
+                if leaf == "jit" and call is not None:
+                    nums = literal_or_none(call_kwarg(call, "donate_argnums"))
+                    if isinstance(nums, int):
+                        nums = (nums,)
+                    if isinstance(nums, (tuple, list)):
+                        for i in nums:
+                            if isinstance(i, int) and i < len(params):
+                                donated.add(params[i])
+                    names = literal_or_none(
+                        call_kwarg(call, "donate_argnames")
+                    )
+                    if isinstance(names, str):
+                        names = (names,)
+                    if isinstance(names, (tuple, list)):
+                        donated.update(n for n in names if isinstance(n, str))
+                elif leaf == "donates" and call is not None:
+                    for arg in call.args:
+                        val = literal_or_none(arg)
+                        if isinstance(val, str):
+                            donated.add(val)
+                elif leaf == "requires_drain" and call is not None:
+                    drain = literal_or_none(call.args[0]) if call.args else None
+                    if isinstance(drain, str):
+                        drains.append((sf, fn, drain))
+            if donated:
+                donators[fn.name] = {
+                    "params": params,
+                    "donated": donated,
+                    "path": sf.path,
+                }
+
+    # -- check: call sites + drain ordering --------------------------
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        store = ctx.scratch(self.id)
+        donators = store.get("donators", {})
+        resident: Set[str] = store.get("resident", set())
+        findings: List[Finding] = []
+
+        for fn, _cls in sf.functions():
+            findings.extend(
+                self._check_function(sf, fn, donators, resident)
+            )
+        for dsf, dfn, drain in store.get("drains", []):
+            if dsf is sf:
+                findings.extend(
+                    self._check_drain(sf, dfn, drain, resident)
+                )
+        return findings
+
+    def _check_function(
+        self,
+        sf: SourceFile,
+        fn: ast.AST,
+        donators: Dict[str, Dict[str, object]],
+        resident: Set[str],
+    ) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # taint: local names bound (anywhere in the function) from a
+        # resident attribute — conservative, no flow sensitivity
+        tainted: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Attribute
+            ):
+                if _is_resident_name(node.value.attr, resident):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            tainted[tgt.id] = node.value.attr
+
+        # donated expressions seen, keyed for read-after-donation:
+        # ("name", x) for locals, ("attr", a) for self/obj attributes
+        donated_sites: List[Tuple[Tuple[str, str], int]] = []
+
+        def resident_attr_in(expr: ast.expr) -> Optional[str]:
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Attribute) and _is_resident_name(
+                    sub.attr, resident
+                ):
+                    return sub.attr
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return f"{sub.id} (= self.{tainted[sub.id]})"
+            return None
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            info = donators.get(callee.split(".")[-1])
+            if info is None:
+                continue
+            params: List[str] = info["params"]  # type: ignore[assignment]
+            donated: Set[str] = info["donated"]  # type: ignore[assignment]
+            for i, arg in enumerate(node.args):
+                pname = params[i] if i < len(params) else None
+                if pname not in donated:
+                    continue
+                findings.extend(
+                    self._flag_donated_arg(
+                        sf, fn, node, arg, pname, callee,
+                        resident_attr_in, donated_sites,
+                    )
+                )
+            for kw in node.keywords:
+                if kw.arg in donated:
+                    findings.extend(
+                        self._flag_donated_arg(
+                            sf, fn, node, kw.value, kw.arg, callee,
+                            resident_attr_in, donated_sites,
+                        )
+                    )
+
+        # read-after-donation: any Load of a donated name/attr after
+        # the donating call line, with no intervening re-assignment
+        stores: Dict[Tuple[str, str], List[int]] = {}
+        loads: Dict[Tuple[str, str], List[int]] = {}
+        for node in ast.walk(fn):
+            key = None
+            if isinstance(node, ast.Name):
+                key = ("name", node.id)
+            elif isinstance(node, ast.Attribute):
+                key = ("attr", node.attr)
+            if key is None:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                stores.setdefault(key, []).append(node.lineno)
+            elif isinstance(node.ctx, ast.Load):
+                loads.setdefault(key, []).append(node.lineno)
+        branch_ctx = _branch_contexts(fn)
+        for key, call_line in donated_sites:
+            # call_line is the donating call's END line: loads that are
+            # lexically part of the (possibly multiline) call are the
+            # donation itself, not a read-after
+            # a store ON the call's end line is the idiomatic
+            # consume-and-rebind (`buf = consume(buf, x)`): it cuts off
+            # the read-after window just like a later rebind does
+            rebind = min(
+                (ln for ln in stores.get(key, []) if ln >= call_line),
+                default=None,
+            )
+            for ln in loads.get(key, []):
+                if ln > call_line and (rebind is None or ln < rebind):
+                    if _exclusive(
+                        branch_ctx.get(call_line, ()),
+                        branch_ctx.get(ln, ()),
+                    ):
+                        continue
+                    findings.append(
+                        Finding(
+                            self.id, sf.path, ln, 0,
+                            f"'{key[1]}' read after being donated at "
+                            f"line {call_line} (donation invalidates "
+                            "the buffer)",
+                        )
+                    )
+                    break
+        return findings
+
+    def _flag_donated_arg(
+        self, sf, fn, call, arg, pname, callee, resident_attr_in,
+        donated_sites,
+    ) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        hit = resident_attr_in(arg)
+        if hit is not None:
+            findings.append(
+                Finding(
+                    self.id, sf.path, call.lineno, call.col_offset,
+                    f"resident buffer {hit} flows into donated "
+                    f"parameter '{pname}' of {callee} — the dispatch "
+                    "frees it while the resident state still "
+                    "references it (retry-ladder hazard)",
+                )
+            )
+        end = getattr(call, "end_lineno", call.lineno) or call.lineno
+        if isinstance(arg, ast.Name):
+            donated_sites.append((("name", arg.id), end))
+        elif isinstance(arg, ast.Attribute):
+            donated_sites.append((("attr", arg.attr), end))
+        return findings
+
+    def _check_drain(
+        self, sf: SourceFile, fn: ast.AST, drain: str, resident: Set[str]
+    ) -> Iterable[Finding]:
+        drain_line = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee is not None and callee.split(".")[-1] == drain:
+                    drain_line = (
+                        node.lineno
+                        if drain_line is None
+                        else min(drain_line, node.lineno)
+                    )
+        first_write = None
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Store)
+                and _is_resident_name(node.attr, resident)
+            ):
+                first_write = (
+                    node.lineno
+                    if first_write is None
+                    else min(first_write, node.lineno)
+                )
+        if drain_line is None:
+            yield Finding(
+                self.id, sf.path, fn.lineno, fn.col_offset,
+                f"{fn.name} is @requires_drain('{drain}') but never "
+                f"calls {drain}() — a caller-held PendingDelta would "
+                "dangle over the replaced resident state",
+            )
+        elif first_write is not None and first_write < drain_line:
+            yield Finding(
+                self.id, sf.path, first_write, 0,
+                f"{fn.name} writes a resident buffer before calling "
+                f"{drain}() (line {drain_line}) — drain the pending "
+                "delta first",
+            )

@@ -1,0 +1,140 @@
+"""``host-sync-in-window``: no device->host sync inside a solve window.
+
+PR 1 closed the 10x host-overhead gap precisely by removing blocking
+readbacks from the churn path; PR 3 then made the one remaining
+readback double-buffered. This rule keeps it that way: inside any
+function annotated ``@solve_window`` — i.e. code that runs between a
+churn dispatch and its commit — the following forms are flagged:
+
+- ``np.asarray(...)`` / ``numpy.asarray(...)`` / ``np.array(...)`` on
+  anything (forces a transfer when handed a device array; a host-list
+  conversion is a legitimate suppression with a reason),
+- ``jax.device_get(...)`` / ``device_get(...)``,
+- ``<expr>.block_until_ready()``,
+- ``float(...)`` / ``int(...)`` / ``bool(...)`` applied to an
+  expression that mentions a device-resident name (``*_dev`` attrs,
+  ``_dr``) — scalar coercion of an Array is an implicit
+  ``device_get``,
+- ``.item()`` / ``.tolist()`` on such device-ish expressions.
+
+The rule is syntactic; only the annotated function's own body is
+scanned (nested defs get their own annotation if they need it), so a
+``@solve_window`` marker is a precise, reviewable claim.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    decorator_info,
+    dotted_name,
+)
+
+RULE_ID = "host-sync-in-window"
+
+_SYNC_CALLS = {
+    "np.asarray",
+    "numpy.asarray",
+    "np.array",
+    "numpy.array",
+    "jax.device_get",
+    "device_get",
+}
+_COERCIONS = {"float", "int", "bool"}
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+_DEVICE_HINTS = ("_dr",)
+
+
+def _mentions_device(expr: ast.expr) -> Optional[str]:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Name):
+            name = sub.id
+        if name is not None and (
+            name in _DEVICE_HINTS or name.endswith("_dev")
+        ):
+            return name
+    return None
+
+
+def _is_solve_window(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        name, _call = decorator_info(dec)
+        if name is not None and name.split(".")[-1] == "solve_window":
+            return True
+    return False
+
+
+def _own_body_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk the function body but do not descend into nested function
+    or class definitions — they make their own solve-window claim."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class HostSyncInWindowRule(Rule):
+    id = RULE_ID
+    description = (
+        "no blocking device->host transfer inside @solve_window code"
+    )
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn, _cls in sf.functions():
+            if not _is_solve_window(fn):
+                continue
+            for node in _own_body_walk(fn):
+                hit = self._classify(node)
+                if hit is not None:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            sf.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"{hit} inside @solve_window '{fn.name}' — "
+                            "blocking device->host sync serializes the "
+                            "solve pipeline; stage it through the "
+                            "deferred readback instead",
+                        )
+                    )
+        return findings
+
+    def _classify(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        callee = dotted_name(node.func)
+        if callee in _SYNC_CALLS:
+            return f"{callee}()"
+        if (
+            callee in _COERCIONS
+            and node.args
+            and _mentions_device(node.args[0]) is not None
+        ):
+            dev = _mentions_device(node.args[0])
+            return f"{callee}() scalar coercion of device value '{dev}'"
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth == "block_until_ready":
+                return ".block_until_ready()"
+            if meth in ("item", "tolist") and (
+                _mentions_device(node.func.value) is not None
+            ):
+                dev = _mentions_device(node.func.value)
+                return f".{meth}() on device value '{dev}'"
+        return None

@@ -1,0 +1,444 @@
+"""``lock-order``: static lock-acquisition graph, cycle = finding.
+
+The per-module threads (kvstore sync loop, messaging replicators,
+telemetry scrapers, decision debounce) each own locks; a deadlock needs
+only two of them acquired in opposite orders on two threads. This rule
+builds the whole-tree *may-acquire* graph and reports:
+
+- **cycles** in the acquired-while-holding edge relation (each edge
+  carries its first witness site, so the report names both halves of
+  the inversion), and
+- **self-edges on non-reentrant locks** — ``threading.Lock`` acquired
+  while already held on the same path (``RLock`` self-edges are the
+  reentrant design and allowed).
+
+Model (syntactic, conservative):
+
+- a *lock class* is ``self._x = threading.Lock() | RLock() |
+  Condition(...)`` anywhere in a class body; its identity is
+  ``ClassName._x`` (instance-insensitive, like kernel lockdep classes).
+  ``Condition(self._lock)`` aliases the underlying lock;
+  bare ``Condition()`` owns an internal RLock.
+- acquisitions are ``with <lockexpr>:`` regions and explicit
+  ``<lockexpr>.acquire()`` calls.
+- while a region holds lock A, any call whose *may-acquire* set
+  (transitive, fixpoint over the call graph) contains B adds edge
+  A -> B. Receivers resolve through: ``self`` methods, attribute types
+  recorded from constructor assignments (``self._q = RQueue(...)``),
+  parameter annotations, and return annotations
+  (``get_registry() -> Registry``).
+
+The runtime companion (:mod:`openr_tpu.analysis.lockdep`) catches the
+dynamic orders this over-approximation cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+)
+
+RULE_ID = "lock-order"
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+
+
+class _Model:
+    """Whole-tree facts accumulated during collect."""
+
+    def __init__(self) -> None:
+        # lock id ("Class._attr") -> "lock" | "rlock"
+        self.locks: Dict[str, str] = {}
+        # (class, attr) -> lock id (identity map + Condition aliases)
+        self.attr_lock: Dict[Tuple[str, str], str] = {}
+        # (class, attr) -> type name, from constructor-style assigns
+        self.attr_type: Dict[Tuple[str, str], str] = {}
+        # function leaf name -> return-annotation type name
+        self.returns: Dict[str, str] = {}
+        # (class | None, func name) -> (ast node, SourceFile)
+        self.methods: Dict[Tuple[Optional[str], str], Tuple[ast.AST, SourceFile]] = {}
+        self.class_names: Set[str] = set()
+
+
+def _ann_name(ann: Optional[ast.expr]) -> Optional[str]:
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1].split("[")[0]
+    name = dotted_name(ann)
+    return name.split(".")[-1] if name else None
+
+
+class LockOrderRule(Rule):
+    id = RULE_ID
+    description = (
+        "lock acquisition order must be acyclic across threads; "
+        "non-reentrant locks must not be re-acquired while held"
+    )
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        model: _Model = ctx.scratch(self.id).setdefault("model", _Model())
+        for cls in sf.classes():
+            model.class_names.add(cls.name)
+        for fn, cls in sf.functions():
+            key = (cls, fn.name)
+            # outermost definition wins; nested dupes are rare and
+            # conservative either way
+            model.methods.setdefault(key, (fn, sf))
+            rname = _ann_name(getattr(fn, "returns", None))
+            if rname is not None:
+                model.returns.setdefault(fn.name, rname)
+            if cls is None:
+                continue
+            for node in ast.walk(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                ):
+                    continue
+                attr = node.targets[0].attr
+                value = node.value
+                if not isinstance(value, ast.Call):
+                    continue
+                callee = dotted_name(value.func)
+                if callee is None:
+                    continue
+                leaf = callee.split(".")[-1]
+                if leaf in _LOCK_CTORS:
+                    if leaf == "Condition":
+                        # Condition(self._lock) aliases that lock;
+                        # Condition() owns an internal RLock
+                        if (
+                            value.args
+                            and isinstance(value.args[0], ast.Attribute)
+                            and isinstance(value.args[0].value, ast.Name)
+                            and value.args[0].value.id == "self"
+                        ):
+                            model.attr_lock[(cls, attr)] = (
+                                f"{cls}.{value.args[0].attr}"
+                            )
+                            continue
+                        lock_id = f"{cls}.{attr}"
+                        model.locks[lock_id] = "rlock"
+                        model.attr_lock[(cls, attr)] = lock_id
+                    else:
+                        lock_id = f"{cls}.{attr}"
+                        model.locks[lock_id] = _LOCK_CTORS[leaf]
+                        model.attr_lock[(cls, attr)] = lock_id
+                else:
+                    # constructor-style receiver typing
+                    model.attr_type.setdefault((cls, attr), leaf)
+
+    # -- finalize: resolve, fixpoint, walk, report -------------------
+
+    def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        model: Optional[_Model] = ctx.scratch(self.id).get("model")
+        if model is None:
+            return ()
+        # prune attr_type entries that aren't known classes (e.g.
+        # self._x = dict(...)), so resolution stays precise
+        model.attr_type = {
+            k: v
+            for k, v in model.attr_type.items()
+            if v in model.class_names
+        }
+        model.returns = {
+            k: v for k, v in model.returns.items() if v in model.class_names
+        }
+
+        direct: Dict[Tuple[Optional[str], str], Set[str]] = {}
+        calls: Dict[
+            Tuple[Optional[str], str], Set[Tuple[Optional[str], str]]
+        ] = {}
+        walkers: Dict[Tuple[Optional[str], str], "_MethodWalk"] = {}
+        for key, (fn, sf) in model.methods.items():
+            w = _MethodWalk(model, key[0], fn, sf)
+            w.run()
+            walkers[key] = w
+            direct[key] = set(w.acquired)
+            calls[key] = {c for c in w.called if c in model.methods}
+
+        # may-acquire fixpoint
+        may: Dict[Tuple[Optional[str], str], Set[str]] = {
+            k: set(v) for k, v in direct.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key in may:
+                for callee in calls.get(key, ()):
+                    before = len(may[key])
+                    may[key] |= may.get(callee, set())
+                    if len(may[key]) != before:
+                        changed = True
+
+        # edges: lock held -> lock acquired, with first witness
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self_edges: List[Tuple[str, str, int, str]] = []
+        for key, w in walkers.items():
+            for held, inner, line, desc in w.nested:
+                self._add_edge(
+                    model, edges, self_edges, held, inner,
+                    w.sf.path, line, desc,
+                )
+            for held, callee, line in w.calls_while_held:
+                for inner in may.get(callee, ()):
+                    self._add_edge(
+                        model, edges, self_edges, held, inner,
+                        w.sf.path, line,
+                        f"via call to {callee[0] or '<module>'}."
+                        f"{callee[1]}()",
+                    )
+
+        findings: List[Finding] = []
+        for lock_id, path, line, desc in self_edges:
+            findings.append(
+                Finding(
+                    self.id, path, line, 0,
+                    f"non-reentrant lock {lock_id} acquired while "
+                    f"already held ({desc}) — self-deadlock",
+                )
+            )
+        for cycle in _find_cycles({e for e in edges}):
+            # witness the cycle at its first edge's site
+            first = edges[(cycle[0], cycle[1])]
+            chain = " -> ".join(cycle + (cycle[0],))
+            detail = "; ".join(
+                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                for a, b in zip(cycle, cycle[1:] + (cycle[0],))
+            )
+            findings.append(
+                Finding(
+                    self.id, first[0], first[1], 0,
+                    f"lock-order cycle {chain} ({detail}) — two "
+                    "threads taking these in opposite order deadlock",
+                )
+            )
+        return findings
+
+    def _add_edge(self, model, edges, self_edges, held, inner, path, line, desc):
+        if held == inner:
+            if model.locks.get(held) == "lock":
+                self_edges.append((held, path, line, desc))
+            return
+        edges.setdefault((held, inner), (path, line, desc))
+
+
+class _MethodWalk:
+    """Single-method traversal tracking the with-held lock stack."""
+
+    def __init__(
+        self, model: _Model, cls: Optional[str], fn: ast.AST, sf: SourceFile
+    ) -> None:
+        self.model = model
+        self.cls = cls
+        self.fn = fn
+        self.sf = sf
+        self.acquired: Set[str] = set()
+        self.called: Set[Tuple[Optional[str], str]] = set()
+        # (held, inner, line, desc) for directly nested acquisitions
+        self.nested: List[Tuple[str, str, int, str]] = []
+        # (held, callee key, line) for calls made while holding
+        self.calls_while_held: List[Tuple[str, Tuple[Optional[str], str], int]] = []
+        # local var -> class name (from annotated params + typed calls)
+        self.var_type: Dict[str, str] = {}
+
+    def run(self) -> None:
+        args = self.fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            t = _ann_name(a.annotation)
+            if t is not None and t in self.model.class_names:
+                self.var_type[a.arg] = t
+        # one pre-pass for local typing: v = Ctor(...) / v = fn() with
+        # a return annotation / v = self._attr of known type
+        for node in ast.walk(self.fn):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            t = self._expr_type(node.value)
+            if t is not None:
+                self.var_type[node.targets[0].id] = t
+        self._walk_body(self.fn.body, [])
+
+    # -- resolution helpers ------------------------------------------
+
+    def _expr_type(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func)
+            if callee is not None:
+                leaf = callee.split(".")[-1]
+                if leaf in self.model.class_names:
+                    return leaf
+                if leaf in self.model.returns:
+                    return self.model.returns[leaf]
+        elif isinstance(expr, ast.Attribute):
+            owner = self._receiver_type(expr.value)
+            if owner is not None:
+                return self.model.attr_type.get((owner, expr.attr))
+        return None
+
+    def _receiver_type(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            if expr.id == "self":
+                return self.cls
+            return self.var_type.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            owner = self._receiver_type(expr.value)
+            if owner is not None:
+                return self.model.attr_type.get((owner, expr.attr))
+        if isinstance(expr, ast.Call):
+            return self._expr_type(expr)
+        return None
+
+    def _lock_id(self, expr: ast.expr) -> Optional[str]:
+        """Resolve an expression used as a context manager / acquire
+        receiver to a lock class id, or None."""
+        if isinstance(expr, ast.Attribute):
+            owner = self._receiver_type(expr.value)
+            if owner is not None:
+                return self.model.attr_lock.get((owner, expr.attr))
+        return None
+
+    def _callee_key(self, call: ast.Call) -> Optional[Tuple[Optional[str], str]]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            key = (None, func.id)
+            return key if key in self.model.methods else None
+        if isinstance(func, ast.Attribute):
+            owner = self._receiver_type(func.value)
+            if owner is not None and (owner, func.attr) in self.model.methods:
+                return (owner, func.attr)
+        return None
+
+    # -- traversal ----------------------------------------------------
+
+    def _walk_body(self, body: List[ast.stmt], held: List[str]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested defs analyzed as their own methods
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            entered: List[str] = []
+            for item in stmt.items:
+                lock = self._lock_id(item.context_expr)
+                if lock is not None:
+                    self.acquired.add(lock)
+                    for h in held + entered:
+                        self.nested.append(
+                            (h, lock, stmt.lineno, f"with {lock}")
+                        )
+                    entered.append(lock)
+                else:
+                    self._scan_expr(item.context_expr, held)
+            self._walk_body(stmt.body, held + entered)
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.stmt):
+                self._walk_stmt(node, held)
+            elif isinstance(node, ast.expr):
+                self._scan_expr(node, held)
+            elif isinstance(node, ast.ExceptHandler):
+                if node.type is not None:
+                    self._scan_expr(node.type, held)
+                self._walk_body(node.body, held)
+
+    def _scan_expr(self, expr: ast.expr, held: List[str]) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            # explicit .acquire()
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "acquire"
+            ):
+                lock = self._lock_id(node.func.value)
+                if lock is not None:
+                    self.acquired.add(lock)
+                    for h in held:
+                        self.nested.append(
+                            (h, lock, node.lineno, f"{lock}.acquire()")
+                        )
+                    continue
+            key = self._callee_key(node)
+            if key is not None:
+                self.called.add(key)
+                if held:
+                    for h in held:
+                        self.calls_while_held.append((h, key, node.lineno))
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[Tuple[str, ...]]:
+    """Minimal simple cycles via SCC then one cycle per SCC (enough to
+    surface the inversion; the witness detail names every edge)."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp: List[str] = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: List[Tuple[str, ...]] = []
+    for comp in sccs:
+        comp_set = set(comp)
+        # walk one cycle inside the SCC deterministically
+        start = min(comp)
+        path = [start]
+        seen = {start}
+        cur = start
+        while True:
+            nxt = min(
+                w for w in graph[cur] if w in comp_set
+            )
+            if nxt in seen:
+                cycles.append(tuple(path[path.index(nxt):]))
+                break
+            path.append(nxt)
+            seen.add(nxt)
+            cur = nxt
+    return cycles

@@ -1,0 +1,191 @@
+"""``retrace-risk``: static args to jitted dispatches that break or
+thrash the trace cache.
+
+``jax.jit`` keys its compilation cache on the *values* of static
+arguments. Two failure modes, both invisible until production:
+
+- an **unhashable** static value (list / dict / set / comprehension)
+  raises at trace time — but only on the first call of that code path,
+  which may be the overflow rung of the retry ladder rather than
+  anything a smoke test exercises;
+- a **call-varying** static value (fresh lambda, ``time.*()``,
+  ``id()``, RNG draws) is a new cache key every call — a silent
+  retrace storm that turns the microseconds-long churn step into a
+  milliseconds-long compile, exactly the regression PR 1 existed to
+  remove.
+
+The rule resolves jitted defs (``@jax.jit`` /
+``@functools.partial(jax.jit, static_argnums=...)``) to their static
+parameter names during collect, then classifies the expressions flowing
+into static positions at every call site. It also flags ``jax.jit(...)``
+wrapper construction inside a loop body — each iteration makes a fresh
+wrapper with an empty cache.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    call_kwarg,
+    decorator_info,
+    dotted_name,
+    literal_or_none,
+)
+
+RULE_ID = "retrace-risk"
+
+_UNHASHABLE = (
+    ast.List,
+    ast.Dict,
+    ast.Set,
+    ast.ListComp,
+    ast.DictComp,
+    ast.SetComp,
+    ast.GeneratorExp,
+)
+_CALL_VARYING = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.time_ns",
+    "id",
+    "object",
+    "random.random",
+    "random.randint",
+    "uuid.uuid4",
+}
+
+
+def _params(fn: ast.AST) -> List[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _classify(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, _UNHASHABLE):
+        return f"unhashable {type(expr).__name__.lower()} literal"
+    if isinstance(expr, ast.Lambda):
+        return "fresh lambda (new cache key every call)"
+    if isinstance(expr, ast.Call):
+        callee = dotted_name(expr.func)
+        if callee in _CALL_VARYING:
+            return f"call-varying value {callee}()"
+    if isinstance(expr, ast.Tuple):
+        for elt in expr.elts:
+            hit = _classify(elt)
+            if hit is not None:
+                return hit
+    return None
+
+
+class RetraceRiskRule(Rule):
+    id = RULE_ID
+    description = (
+        "static args to jitted functions must be hashable and stable "
+        "across calls; jit wrappers must not be built inside loops"
+    )
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        store = ctx.scratch(self.id)
+        jitted: Dict[str, Dict[str, object]] = store.setdefault("jitted", {})
+        for fn, _cls in sf.functions():
+            for dec in fn.decorator_list:
+                name, call = decorator_info(dec)
+                if name is None or name.split(".")[-1] != "jit":
+                    continue
+                params = _params(fn)
+                static: Set[str] = set()
+                if call is not None:
+                    nums = literal_or_none(
+                        call_kwarg(call, "static_argnums")
+                    )
+                    if isinstance(nums, int):
+                        nums = (nums,)
+                    if isinstance(nums, (tuple, list)):
+                        for i in nums:
+                            if isinstance(i, int) and i < len(params):
+                                static.add(params[i])
+                    names = literal_or_none(
+                        call_kwarg(call, "static_argnames")
+                    )
+                    if isinstance(names, str):
+                        names = (names,)
+                    if isinstance(names, (tuple, list)):
+                        static.update(
+                            n for n in names if isinstance(n, str)
+                        )
+                if static:
+                    jitted[fn.name] = {"params": params, "static": static}
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        jitted = ctx.scratch(self.id).get("jitted", {})
+        findings: List[Finding] = []
+        assert sf.tree is not None
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            info = jitted.get(callee.split(".")[-1])
+            if info is None:
+                continue
+            params: List[str] = info["params"]  # type: ignore[assignment]
+            static: Set[str] = info["static"]  # type: ignore[assignment]
+            for i, arg in enumerate(node.args):
+                pname = params[i] if i < len(params) else None
+                if pname in static:
+                    findings.extend(
+                        self._flag(sf, node, arg, pname, callee)
+                    )
+            for kw in node.keywords:
+                if kw.arg in static:
+                    findings.extend(
+                        self._flag(sf, node, kw.value, kw.arg, callee)
+                    )
+
+        # jit wrapper construction inside a loop body
+        for loop in ast.walk(sf.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name in ("jax.jit", "jit"):
+                        findings.append(
+                            Finding(
+                                self.id,
+                                sf.path,
+                                node.lineno,
+                                node.col_offset,
+                                "jax.jit wrapper constructed inside a "
+                                "loop — every iteration starts with an "
+                                "empty trace cache; hoist the wrapper "
+                                "out (or key a persistent cache on the "
+                                "static shape)",
+                            )
+                        )
+        return findings
+
+    def _flag(
+        self, sf: SourceFile, call: ast.Call, arg: ast.expr,
+        pname: str, callee: str,
+    ) -> Iterable[Finding]:
+        hit = _classify(arg)
+        if hit is not None:
+            yield Finding(
+                self.id,
+                sf.path,
+                call.lineno,
+                call.col_offset,
+                f"{hit} passed as static parameter '{pname}' of "
+                f"{callee} — static args are trace-cache keys and must "
+                "be hashable and call-stable",
+            )

@@ -1,0 +1,237 @@
+"""``span-discipline``: every span closes on all paths; metric names
+follow the fb303 dotted convention.
+
+``Tracer.finish`` already *counts* unclosed spans at runtime
+(``telemetry.traces_unclosed_spans``), but only for traces that reach
+``finish`` — a leaked span on an early-return path shows up as a
+mystery counter hours later. This rule pushes the check to lint time:
+
+- a span-opening call (``begin_span`` / ``span_active``) whose result
+  is discarded can never be closed — finding;
+- a span bound to a local must either be closed in the same function
+  (appear as an argument to ``end_span`` / ``end_span_active``) or
+  *transfer ownership* — be stored to an attribute (the debounce span
+  pattern in ``decision.py``), returned, or passed into another call;
+- a ``return`` between the open and the close leaks the span on that
+  path, unless the close sits in a ``finally`` whose ``try`` encloses
+  the return;
+- literal metric and span names (``counter_bump`` / ``counter_set`` /
+  ``observe`` / ``histogram`` / ``begin_span`` / ``span_active``) must
+  match the fb303 dotted convention ``component.sub.metric`` —
+  lowercase, digits, underscores, at least one dot. Dynamically built
+  names (``"jax.events." + suffix``) are skipped; they are covered by
+  the runtime registry, not lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+)
+
+RULE_ID = "span-discipline"
+
+_OPENERS = {"begin_span", "span_active"}
+_CLOSERS = {"end_span", "end_span_active"}
+_NAMED_CALLS = _OPENERS | {
+    "counter_bump",
+    "counter_set",
+    "observe",
+    "histogram",
+}
+_FB303_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _method_leaf(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _own_nodes(fn: ast.AST) -> Iterable[ast.AST]:
+    """Walk the function without descending into nested defs."""
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class SpanDisciplineRule(Rule):
+    id = RULE_ID
+    description = (
+        "spans must close (or transfer ownership) on all paths; "
+        "metric names must follow the fb303 dotted convention"
+    )
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_names(sf))
+        for fn, _cls in sf.functions():
+            findings.extend(self._check_spans(sf, fn))
+        return findings
+
+    # -- metric / span naming ----------------------------------------
+
+    def _check_names(self, sf: SourceFile) -> Iterable[Finding]:
+        assert sf.tree is not None
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _method_leaf(node)
+            if leaf not in _NAMED_CALLS or not node.args:
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant) and isinstance(arg.value, str)
+            ):
+                continue  # dynamically built names: runtime's problem
+            if not _FB303_RE.match(arg.value):
+                yield Finding(
+                    self.id,
+                    sf.path,
+                    node.lineno,
+                    node.col_offset,
+                    f"{leaf}() name '{arg.value}' violates the fb303 "
+                    "dotted convention (lowercase "
+                    "'component.sub.metric', at least one dot)",
+                )
+
+    # -- span open/close pairing -------------------------------------
+
+    def _check_spans(self, sf: SourceFile, fn: ast.AST) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # var -> line of the span-opening assignment
+        opens: Dict[str, int] = {}
+        discarded: List[Tuple[int, int, str]] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Expr) and self._opener_in(node.value):
+                leaf = self._opener_in(node.value)
+                discarded.append((node.lineno, node.col_offset, leaf))
+            elif isinstance(node, ast.Assign):
+                leaf = self._opener_in(node.value)
+                if leaf and len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name
+                ):
+                    opens[node.targets[0].id] = node.lineno
+
+        for line, col, leaf in discarded:
+            findings.append(
+                Finding(
+                    self.id, sf.path, line, col,
+                    f"{leaf}() result discarded — the span can never "
+                    "be closed (bind it and end_span it, or drop the "
+                    "span entirely)",
+                )
+            )
+        if not opens:
+            return findings
+
+        closed_at: Dict[str, int] = {}
+        escaped: Set[str] = set()
+        returns: List[int] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                leaf = _method_leaf(node)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name) and sub.id in opens:
+                            if leaf in _CLOSERS:
+                                closed_at[sub.id] = max(
+                                    closed_at.get(sub.id, 0), node.lineno
+                                )
+                            else:
+                                escaped.add(sub.id)
+            elif isinstance(node, ast.Return):
+                returns.append(node.lineno)
+                if node.value is not None:
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Name) and sub.id in opens:
+                            escaped.add(sub.id)
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                value = node.value
+                for tgt in targets:
+                    if isinstance(
+                        tgt, (ast.Attribute, ast.Subscript)
+                    ) and isinstance(value, ast.Name) and value.id in opens:
+                        escaped.add(value.id)
+
+        protected = self._finally_ranges(fn)
+        for var, open_line in sorted(opens.items(), key=lambda kv: kv[1]):
+            close = closed_at.get(var)
+            if close is None:
+                if var not in escaped:
+                    findings.append(
+                        Finding(
+                            self.id, sf.path, open_line, 0,
+                            f"span '{var}' opened here is never closed "
+                            "and never transfers ownership (no "
+                            "end_span*, attribute store, return, or "
+                            "call argument)",
+                        )
+                    )
+                continue
+            for rline in returns:
+                if open_line < rline < close and not any(
+                    t0 <= rline <= t1 and f0 <= close <= f1
+                    for (t0, t1, f0, f1) in protected
+                ):
+                    findings.append(
+                        Finding(
+                            self.id, sf.path, rline, 0,
+                            f"return leaks span '{var}' (opened line "
+                            f"{open_line}, closed line {close}) — close "
+                            "before returning or move the close into a "
+                            "finally",
+                        )
+                    )
+                    break
+        return findings
+
+    def _opener_in(self, expr: ast.expr) -> Optional[str]:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                leaf = _method_leaf(sub)
+                if leaf in _OPENERS:
+                    return leaf
+        return None
+
+    def _finally_ranges(
+        self, fn: ast.AST
+    ) -> List[Tuple[int, int, int, int]]:
+        """(try_start, try_end, finally_start, finally_end) line ranges
+        for every try/finally in the function — a return inside the try
+        is covered by a close inside the finally."""
+        out: List[Tuple[int, int, int, int]] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Try) and node.finalbody:
+                t0 = node.body[0].lineno
+                t1 = max(
+                    getattr(n, "end_lineno", n.lineno)
+                    for n in node.body + node.handlers + node.orelse
+                )
+                f0 = node.finalbody[0].lineno
+                f1 = max(
+                    getattr(n, "end_lineno", n.lineno)
+                    for n in node.finalbody
+                )
+                out.append((t0, t1, f0, f1))
+        return out

@@ -38,6 +38,7 @@ from openr_tpu.types import (
     PrefixDatabase,
     PrefixEntry,
 )
+from openr_tpu.analysis.annotations import solve_window
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
@@ -444,6 +445,7 @@ class Decision:
     def _on_debounce_fire(self) -> None:
         self.rebuild_routes("DECISION_DEBOUNCE")
 
+    @solve_window
     def rebuild_routes(self, event: str) -> None:
         """reference: Decision.cpp:1860 rebuildRoutes."""
         if self._cold_start_until and time.monotonic() < self._cold_start_until:

@@ -53,6 +53,7 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 import numpy as np
 
+from openr_tpu.analysis.annotations import resident_buffers
 from openr_tpu.graph.linkstate import Link, LinkState
 from openr_tpu.ops.spf import INF
 
@@ -353,6 +354,7 @@ def _pad_ids(ids: List[int], bucket_min: int = 8) -> np.ndarray:
     )
 
 
+@resident_buffers("d_prev_dev", "dm_dev", "masks_t")
 class Ksp2Engine:
     """Per-(LinkState, root) incremental KSP2 state. Invalid until the
     first successful cold build."""
@@ -486,14 +488,18 @@ class Ksp2Engine:
                 self._mesh,
             )
         elif use_fast:
-            (
-                d_all_dev, dm_new_dev, packed,
-            ) = spf_sparse.ell_all_view_rows_masked(
+            # openr-lint: disable=donation-hazard -- intentional: the
+            # dispatch consumes the previous epoch's resident
+            # d_prev_dev/dm_dev (dead after this call, no retry path)
+            # and both are rebound to the fresh outputs right below
+            d_all_dev, dm_new_dev, packed = spf_sparse.ell_all_view_rows_masked(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
                 self.masks_t, self.dm_dev, self.sid, ENGINE_ROW_BUDGET,
                 inc=inc,
             )
         else:
+            # openr-lint: disable=donation-hazard -- intentional: same
+            # consume-and-rebind discipline as the fast path above
             d_all_dev, packed = spf_sparse.ell_all_view_rows(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev, inc=inc
             )

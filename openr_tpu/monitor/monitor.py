@@ -71,6 +71,11 @@ class SystemMetrics:
             with open("/proc/self/statm") as f:
                 return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
         except (OSError, IndexError, ValueError):
+            # /proc/self/statm unreadable or malformed (non-Linux):
+            # count the fallback — peak-RSS-as-current hides memory
+            # releases, so a dashboard reading this metric should be
+            # able to see it is degraded
+            get_registry().counter_bump("monitor.statm_fallbacks")
             return SystemMetrics.rss_peak_bytes()
 
     @staticmethod

@@ -86,6 +86,11 @@ from openr_tpu.ops.spf_sparse import (
     ell_patch,
     pad_patch_rows,
 )
+from openr_tpu.analysis.annotations import (
+    requires_drain,
+    resident_buffers,
+    solve_window,
+)
 from openr_tpu.telemetry import get_registry, get_tracer
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
@@ -508,6 +513,7 @@ class PendingDelta:
         return self.names
 
 
+@resident_buffers("_dr", "_digests_dev", "_packed_dev")
 class RouteSweepEngine:
     """Resident incremental network-wide route product.
 
@@ -582,6 +588,7 @@ class RouteSweepEngine:
             graph.bands, graph.n_pad, self.mesh,
         )
 
+    @requires_drain("flush")
     def _build(self, ls) -> None:
         # a cold rebuild replaces the whole result: drain any in-flight
         # delta first so a caller-held PendingDelta handle resolves
@@ -679,6 +686,7 @@ class RouteSweepEngine:
             "patched_bands": None,  # sharded path: lazily dispatched
         }
 
+    @solve_window
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         """Backend hook: one detect+solve dispatch at bucket size k.
         Returns (segments, commit_state) where segments are per-shard
@@ -739,6 +747,7 @@ class RouteSweepEngine:
         )
         return [sh.data for sh in shards]
 
+    @solve_window
     def _commit_device(self, ctx, commit_state, ov_new) -> None:
         """Backend hook: adopt the dispatch's device state."""
         new_v, new_w_t, dr, digests, packed_res = commit_state
@@ -750,6 +759,7 @@ class RouteSweepEngine:
         self._packed_dev = packed_res
         self.graph = self.sweeper.graph = ctx["patched"]
 
+    @solve_window
     def _apply_patch_resident(self, ctx, ov_new) -> None:
         """Backend hook: adopt the event's band patch into the resident
         sweeper tensors WITHOUT a row re-solve — the full-width refresh
@@ -1346,6 +1356,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             "patched_segs": None,
         }
 
+    @solve_window
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         graph = ctx["patched"]
@@ -1388,6 +1399,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             segments = self._split_segments(packed_dev, k)
         return segments, (new_w, dr, digests, packed_res)
 
+    @solve_window
     def _commit_device(self, ctx, commit_state, ov_new) -> None:
         new_w, dr, digests, packed_res = commit_state
         self.sweeper.w_t = new_w
@@ -1397,6 +1409,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         self._packed_dev = packed_res
         self.graph = self.sweeper.graph = ctx["patched"]
 
+    @solve_window
     def _apply_patch_resident(self, ctx, ov_new) -> None:
         """Grouped full-width refresh patch: scatter the event's
         segment-slot weight updates into the resident segment tensors

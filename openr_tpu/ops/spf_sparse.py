@@ -63,6 +63,7 @@ _NODE_PAD = 128
 # `ELL_COUNTERS[k] += 1` idiom, stored in the process registry under
 # the exported "decision." names, so the registry snapshot and
 # get_spf_counters() agree by construction.
+from openr_tpu.analysis.annotations import donates, solve_window
 from openr_tpu.telemetry import get_registry as _get_registry
 from openr_tpu.telemetry import get_tracer as _get_tracer
 
@@ -1297,6 +1298,7 @@ class EllState:
         )
         self.graph = _replace(patched, changed=None)
 
+    @solve_window
     def reconverge(self, patched: EllGraph, srcs):
         """Fused churn step: scatter the patched rows into the resident
         bands, solve the batched view warm-started from the previous
@@ -1345,8 +1347,14 @@ class EllState:
             )
             ELL_COUNTERS["ell_cold_solves"] += 1
         inc_t, inc_h, inc_w = pad_increase_edges(inc)
+        # openr-lint: disable=host-sync-in-window -- srcs is a host
+        # list of sample ids, not a device array; no transfer happens
         srcs_dev = jnp.asarray(np.asarray(srcs, dtype=np.int32))
         _t_dispatch = time.perf_counter()
+        # openr-lint: disable=donation-hazard -- intentional: the warm
+        # path CONSUMES the previous resident distances (d_prev is dead
+        # after this dispatch) and self._d_dev is rebound to the fresh
+        # output below; no retry ladder re-reads the donated buffer
         self.src, self.w, packed, d = _ell_reconverge(
             in_src, in_w, patch_ids, patch_src, patch_w,
             jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w),
@@ -1511,6 +1519,7 @@ def _inc_args(inc):
     return jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w)
 
 
+@donates("d_prev", "dm_old")
 def ell_all_view_rows_masked(
     state: EllState, view_srcs, w_sv, ep_ids, d_prev,
     masks_t, dm_old, src_id: int, k_budget: int, inc=None,
@@ -1534,6 +1543,7 @@ def ell_all_view_rows_masked(
     return d_all, dm_new, np.asarray(packed)
 
 
+@donates("d_prev")
 def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev,
                       inc=None):
     """Run the fused all-sources + view + invalidation-rows dispatch on

@@ -16,6 +16,7 @@ import sys
 import threading
 
 from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.telemetry import get_registry
 from openr_tpu.platform.netlink_fib_handler import (
     FIB_AGENT_RPC_PORT,
     FibAgentServer,
@@ -34,7 +35,10 @@ def build_netlink(force_mock: bool = False):
             if LinuxNetlinkProtocolSocket.is_admin_available():
                 return LinuxNetlinkProtocolSocket()
         except (OSError, AttributeError):  # AttributeError: non-Linux
-            pass
+            # count the downgrade: a prod agent meant to program the
+            # kernel that silently fell back to the in-memory mock is
+            # invisible without this
+            get_registry().counter_bump("platform.netlink_probe_errors")
     return MockNetlinkProtocolSocket()
 
 

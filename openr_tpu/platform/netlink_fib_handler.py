@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 from openr_tpu.platform.fib_service import FibService
 from openr_tpu.platform.netlink import NetlinkError, NetlinkProtocolSocket
+from openr_tpu.telemetry import get_registry
 from openr_tpu.types import IpPrefix, MplsRoute, UnicastRoute
 from openr_tpu.utils.rpc import RpcClient, RpcServer
 
@@ -71,10 +72,14 @@ class NetlinkFibHandler(FibService):
         try:
             fn(*args)
         except NotImplementedError:
-            pass
+            # backend has no MPLS entry point at all
+            get_registry().counter_bump("platform.mpls_unsupported_ops")
         except NetlinkError as exc:
             if exc.errno not in self._MPLS_UNSUPPORTED_ERRNOS:
                 raise
+            # kernel without mpls_router: per-client table stays
+            # authoritative, but the skipped programming is counted
+            get_registry().counter_bump("platform.mpls_unsupported_ops")
 
     def add_mpls_routes(self, client_id, routes) -> None:
         table = self._mpls.setdefault(client_id, {})

@@ -27,6 +27,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.telemetry import get_registry
 from openr_tpu.platform.netlink import (
     NUD_VALID,
     NetlinkError,
@@ -199,7 +200,10 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
                         cap_eff = int(line.split()[1], 16)
                         return bool(cap_eff & (1 << CAP_NET_ADMIN_BIT))
         except OSError:
-            pass
+            # unreadable /proc/self/status: count it — an unexpected
+            # probe failure silently downgrading to mock is the kind of
+            # deployment surprise the counter surfaces
+            get_registry().counter_bump("platform.capability_probe_errors")
         return False
 
     @classmethod
@@ -733,6 +737,14 @@ class LinuxNetlinkProtocolSocket(NetlinkProtocolSocket):
             except socket.timeout:
                 continue
             except OSError:
+                # the socket died under us (close race at shutdown, or
+                # a kernel-side failure): the thread exits either way,
+                # but an unplanned exit must be visible next to
+                # monitor.backend_errors in the counter dump
+                if self._running:
+                    get_registry().counter_bump(
+                        "platform.netlink_event_errors"
+                    )
                 return
             off = 0
             while off + _NLMSGHDR.size <= len(data):

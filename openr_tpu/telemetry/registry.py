@@ -34,12 +34,21 @@ class Histogram:
     cumulative ``count``/``max`` over the histogram's whole life, so
     the percentiles track recent behaviour while the count keeps
     monotonic fb303 semantics.
+
+    Observations land from several module threads at once (decision
+    rebuild, fib program, monitor scrape) while snapshot() reads from
+    another — the per-histogram lock keeps ring/next/filled mutually
+    consistent. A plain Lock, never held while calling out.
     """
 
-    __slots__ = ("name", "_ring", "_next", "_filled", "_count", "_max", "_sum")
+    __slots__ = (
+        "name", "_lock", "_ring", "_next", "_filled", "_count", "_max",
+        "_sum",
+    )
 
     def __init__(self, name: str, window: int = 1024) -> None:
         self.name = name
+        self._lock = threading.Lock()
         self._ring: List[float] = [0.0] * window
         self._next = 0
         self._filled = 0
@@ -48,31 +57,37 @@ class Histogram:
         self._sum = 0.0
 
     def observe(self, value: float) -> None:
-        self._ring[self._next] = value
-        self._next = (self._next + 1) % len(self._ring)
-        self._filled = min(self._filled + 1, len(self._ring))
-        self._count += 1
-        self._sum += value
-        if value > self._max:
-            self._max = value
+        with self._lock:
+            self._ring[self._next] = value
+            self._next = (self._next + 1) % len(self._ring)
+            self._filled = min(self._filled + 1, len(self._ring))
+            self._count += 1
+            self._sum += value
+            if value > self._max:
+                self._max = value
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     def stats(self) -> Dict[str, float]:
         """Flattened ``<name>.p50/.p95/.p99/.max/.avg/.count`` dict."""
-        out: Dict[str, float] = {self.name + ".count": self._count}
-        if self._count == 0:
+        with self._lock:
+            count, filled = self._count, self._filled
+            ring = self._ring[:filled]
+            hmax, hsum = self._max, self._sum
+        out: Dict[str, float] = {self.name + ".count": count}
+        if count == 0:
             return out
-        window = sorted(self._ring[: self._filled])
+        window = sorted(ring)
         n = len(window)
         for suffix, q in _PERCENTILES:
             # nearest-rank over the sliding window
             idx = min(n - 1, max(0, int(round(q * (n - 1)))))
             out[self.name + suffix] = round(window[idx], 4)
-        out[self.name + ".max"] = round(self._max, 4)
-        out[self.name + ".avg"] = round(self._sum / self._count, 4)
+        out[self.name + ".max"] = round(hmax, 4)
+        out[self.name + ".avg"] = round(hsum / count, 4)
         return out
 
 

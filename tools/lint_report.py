@@ -1,0 +1,73 @@
+"""Machine-readable invariant-lint report for CI artifacts.
+
+``make lint-analysis`` gates on the exit code; this wrapper is the
+artifact side: it runs the same five checkers and writes the full JSON
+payload (every finding, including suppressed ones with their reasons)
+so a CI run keeps an auditable record of which invariant exceptions
+existed at that commit.
+
+Run:  python -m tools.lint_report [--out artifacts/lint_report.json]
+
+Exit code matches ``python -m openr_tpu.analysis``: 0 only when every
+finding is suppressed-with-a-reason.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from openr_tpu.analysis.core import run_analysis
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m tools.lint_report")
+    ap.add_argument(
+        "targets",
+        nargs="*",
+        default=["openr_tpu"],
+        help="files or directories relative to the repo root",
+    )
+    ap.add_argument(
+        "--root", default=_repo_root(), help="repository root override"
+    )
+    ap.add_argument(
+        "--out",
+        default=os.path.join("artifacts", "lint_report.json"),
+        help="report path ('-' for stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    report = run_analysis(args.root, targets=args.targets)
+    payload = json.dumps(report.to_dict(), indent=2, sort_keys=True)
+    if args.out == "-":
+        print(payload)
+    else:
+        out = args.out
+        if not os.path.isabs(out):
+            out = os.path.join(args.root, out)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+        print(out)
+
+    n_sup = len(report.findings) - len(report.unsuppressed)
+    print(
+        f"lint-report: {report.files_scanned} files, "
+        f"{len(report.unsuppressed)} finding(s), {n_sup} suppressed",
+        file=sys.stderr,
+    )
+    for f in report.unsuppressed:
+        print(str(f), file=sys.stderr)
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
