@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke bench clean install
 
 all: native
 
@@ -40,9 +40,10 @@ lint-analysis:
 	python -m openr_tpu.analysis
 
 # the ROADMAP tier-1 gate, verbatim (CPU-pinned, bounded, dot-counted);
-# the invariant linters run first — a finding fails the gate before
-# the test suite spends its budget
-tier1: native lint-analysis
+# the invariant linters and the chaos gate run first — a finding or a
+# degradation-contract regression fails the gate before the test suite
+# spends its budget
+tier1: native lint-analysis chaos-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -57,6 +58,14 @@ churn-smoke: native
 # unclosed, or fewer complete publication->FIB traces than events
 telemetry-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.telemetry_smoke
+
+# robustness gate: seeded fault storm through the supervised engine /
+# Decision / platform paths; fails if any supervisor fails to
+# self-heal, the post-storm product diverges from the fault-free
+# oracle, or the fault-coverage floor is missed. JSON artifact at
+# /tmp/openr_tpu_chaos_smoke.json (tools/chaos_report.py)
+chaos-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.chaos_report --smoke --out /tmp/openr_tpu_chaos_smoke.json
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

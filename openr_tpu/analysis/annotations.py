@@ -26,6 +26,7 @@ SOLVE_WINDOW_ATTR = "__openr_solve_window__"
 RESIDENT_ATTR = "__openr_resident_buffers__"
 REQUIRES_DRAIN_ATTR = "__openr_requires_drain__"
 DONATES_ATTR = "__openr_donates__"
+FAULT_BOUNDARY_ATTR = "__openr_fault_boundary__"
 
 
 def solve_window(fn: F) -> F:
@@ -73,6 +74,21 @@ def requires_drain(drain_call: str) -> Callable[[F], F]:
         return fn
 
     return deco
+
+
+def fault_boundary(fn: F) -> F:
+    """Mark a function as a degradation-ladder rung or fault-supervisor
+    catch site: it may be re-entered after a mid-flight failure, so the
+    buffers it touches must still be valid on the SECOND attempt. The
+    ``donation-hazard`` rule therefore flags *any* donation inside a
+    fault boundary (a deeper rung would re-dispatch against an already
+    invalidated buffer), and the ``span-discipline`` rule accepts its
+    close-in-except + re-raise shape as a protected exit path."""
+    try:
+        setattr(fn, FAULT_BOUNDARY_ATTR, True)
+    except AttributeError:
+        pass
+    return fn
 
 
 def donates(*param_names: str) -> Callable[[F], F]:

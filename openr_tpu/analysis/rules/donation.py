@@ -13,7 +13,13 @@ that jax will not check for you:
   same function (donation invalidates the buffer);
 - a cold rebuild (``@requires_drain``) must drain the in-flight
   ``PendingDelta`` before replacing resident buffers, or a caller-held
-  handle resolves against freed device state.
+  handle resolves against freed device state;
+- a ``@fault_boundary`` function (a degradation-ladder rung) must not
+  donate ANY argument, resident or not: when a rung fails the
+  supervisor walks on to the next rung against the same inputs, so a
+  buffer donated by a failed dispatch is freed memory for every deeper
+  rung. This holds by construction — the annotation marks the re-run
+  contract, no suppression needed for the safe (donation-free) shape.
 
 Detection is name-based and alias-tainting: a local bound from a
 resident attribute carries the taint into call arguments. Donating
@@ -58,6 +64,14 @@ def _is_resident_name(attr: str, registered: Set[str]) -> bool:
 def _params(fn: ast.AST) -> List[str]:
     args = fn.args
     return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _is_fault_boundary(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name, _call = decorator_info(dec)
+        if name and name.split(".")[-1] == "fault_boundary":
+            return True
+    return False
 
 
 def _branch_contexts(fn: ast.AST) -> Dict[int, Tuple[Tuple[int, bool], ...]]:
@@ -198,6 +212,7 @@ class DonationHazardRule(Rule):
         resident: Set[str],
     ) -> Iterable[Finding]:
         findings: List[Finding] = []
+        fault_boundary = _is_fault_boundary(fn)
         # taint: local names bound (anywhere in the function) from a
         # resident attribute — conservative, no flow sensitivity
         tainted: Dict[str, str] = {}
@@ -243,6 +258,7 @@ class DonationHazardRule(Rule):
                     self._flag_donated_arg(
                         sf, fn, node, arg, pname, callee,
                         resident_attr_in, donated_sites,
+                        fault_boundary,
                     )
                 )
             for kw in node.keywords:
@@ -251,6 +267,7 @@ class DonationHazardRule(Rule):
                         self._flag_donated_arg(
                             sf, fn, node, kw.value, kw.arg, callee,
                             resident_attr_in, donated_sites,
+                            fault_boundary,
                         )
                     )
 
@@ -302,7 +319,7 @@ class DonationHazardRule(Rule):
 
     def _flag_donated_arg(
         self, sf, fn, call, arg, pname, callee, resident_attr_in,
-        donated_sites,
+        donated_sites, fault_boundary=False,
     ) -> Iterable[Finding]:
         findings: List[Finding] = []
         hit = resident_attr_in(arg)
@@ -314,6 +331,16 @@ class DonationHazardRule(Rule):
                     f"parameter '{pname}' of {callee} — the dispatch "
                     "frees it while the resident state still "
                     "references it (retry-ladder hazard)",
+                )
+            )
+        elif fault_boundary:
+            findings.append(
+                Finding(
+                    self.id, sf.path, call.lineno, call.col_offset,
+                    f"@fault_boundary function {fn.name} donates "
+                    f"parameter '{pname}' into {callee} — if this rung "
+                    "fails, the supervisor re-runs deeper rungs against "
+                    "the same inputs, which the donation just freed",
                 )
             )
         end = getattr(call, "end_lineno", call.lineno) or call.lineno

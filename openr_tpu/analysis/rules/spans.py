@@ -14,7 +14,11 @@ mystery counter hours later. This rule pushes the check to lint time:
   pattern in ``decision.py``), returned, or passed into another call;
 - a ``return`` between the open and the close leaks the span on that
   path, unless the close sits in a ``finally`` whose ``try`` encloses
-  the return;
+  the return — or, in a ``@fault_boundary`` function (a degradation
+  ladder rung / fault-supervisor catch site), in an ``except`` handler
+  of that ``try``: the supervisor's contract is that failures re-raise
+  through the handler after stamping the span, so close-in-except is a
+  protected exit path there by construction, not via suppression;
 - literal metric and span names (``counter_bump`` / ``counter_set`` /
   ``observe`` / ``histogram`` / ``begin_span`` / ``span_active``) must
   match the fb303 dotted convention ``component.sub.metric`` —
@@ -34,7 +38,16 @@ from openr_tpu.analysis.core import (
     Finding,
     Rule,
     SourceFile,
+    decorator_info,
 )
+
+
+def _is_fault_boundary(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        name, _call = decorator_info(dec)
+        if name and name.split(".")[-1] == "fault_boundary":
+            return True
+    return False
 
 RULE_ID = "span-discipline"
 
@@ -174,7 +187,7 @@ class SpanDisciplineRule(Rule):
                     ) and isinstance(value, ast.Name) and value.id in opens:
                         escaped.add(value.id)
 
-        protected = self._finally_ranges(fn)
+        protected = self._protected_ranges(fn)
         for var, open_line in sorted(opens.items(), key=lambda kv: kv[1]):
             close = closed_at.get(var)
             if close is None:
@@ -214,24 +227,39 @@ class SpanDisciplineRule(Rule):
                     return leaf
         return None
 
-    def _finally_ranges(
+    def _protected_ranges(
         self, fn: ast.AST
     ) -> List[Tuple[int, int, int, int]]:
-        """(try_start, try_end, finally_start, finally_end) line ranges
-        for every try/finally in the function — a return inside the try
-        is covered by a close inside the finally."""
+        """(try_start, try_end, close_start, close_end) line ranges:
+        a return inside [try_start, try_end] is covered by a close
+        inside [close_start, close_end]. The close range is a
+        ``finally`` for any function; in a ``@fault_boundary``
+        function an ``except`` handler body also counts — the
+        supervisor's catch-and-re-raise shape closes the span on the
+        failure path there by contract."""
+        fault_boundary = _is_fault_boundary(fn)
         out: List[Tuple[int, int, int, int]] = []
         for node in _own_nodes(fn):
-            if isinstance(node, ast.Try) and node.finalbody:
-                t0 = node.body[0].lineno
-                t1 = max(
-                    getattr(n, "end_lineno", n.lineno)
-                    for n in node.body + node.handlers + node.orelse
-                )
+            if not isinstance(node, ast.Try):
+                continue
+            t0 = node.body[0].lineno
+            t1 = max(
+                getattr(n, "end_lineno", n.lineno)
+                for n in node.body + node.handlers + node.orelse
+            )
+            if node.finalbody:
                 f0 = node.finalbody[0].lineno
                 f1 = max(
                     getattr(n, "end_lineno", n.lineno)
                     for n in node.finalbody
                 )
                 out.append((t0, t1, f0, f1))
+            if fault_boundary:
+                for handler in node.handlers:
+                    h0 = handler.body[0].lineno
+                    h1 = max(
+                        getattr(n, "end_lineno", n.lineno)
+                        for n in handler.body
+                    )
+                    out.append((t0, t1, h0, h1))
         return out

@@ -38,7 +38,8 @@ from openr_tpu.types import (
     PrefixDatabase,
     PrefixEntry,
 )
-from openr_tpu.analysis.annotations import solve_window
+from openr_tpu.analysis.annotations import fault_boundary, solve_window
+from openr_tpu.faults.supervisor import DegradationSupervisor
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
@@ -184,6 +185,17 @@ class Decision:
             enable_best_route_selection=enable_best_route_selection,
             backend=solver_backend,
         )
+        # degradation ladder for the rebuild path: warm device solve →
+        # device-state reset + cold rebuild → non-device backend. The
+        # fallback backend is "native" when the configured backend is
+        # the device (SpfView itself degrades native → host when the
+        # toolchain is absent); for an already-host backend all rungs
+        # run the same solve, which is harmless.
+        self._primary_backend = solver_backend
+        self._fallback_backend = (
+            "native" if solver_backend == "device" else solver_backend
+        )
+        self.supervisor = DegradationSupervisor("decision")
         self.area_link_states: Dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
         self.route_db = DecisionRouteDb()
@@ -479,8 +491,91 @@ class Decision:
             tracer.activate(trace)
         t_rebuild0 = time.perf_counter()
 
+        # degradation ladder: warm solve with the configured backend →
+        # reset all device-derived state and rebuild cold → flip to the
+        # non-device backend. Every rung produces the same
+        # DecisionRouteDb (the parity suite proves it per rung), so the
+        # emitted delta is rung-independent. A LadderExhausted
+        # propagates to the event loop after the finally closes the
+        # trace span; pending is NOT reset on that path, so the next
+        # publication retriggers the rebuild.
+        update = None
+        try:
+            update = self.supervisor.run(
+                (
+                    (
+                        "warm",
+                        lambda: self._solve_update(
+                            full,
+                            reset=False,
+                            backend=self._primary_backend,
+                        ),
+                    ),
+                    (
+                        "cold",
+                        lambda: self._solve_update(
+                            True,
+                            reset=True,
+                            backend=self._primary_backend,
+                        ),
+                    ),
+                    (
+                        "host",
+                        lambda: self._solve_update(
+                            True,
+                            reset=True,
+                            backend=self._fallback_backend,
+                        ),
+                    ),
+                )
+            )
+        finally:
+            get_registry().observe(
+                "decision.rebuild_ms",
+                (time.perf_counter() - t_rebuild0) * 1000.0,
+            )
+            if trace is not None:
+                tracer.deactivate()
+                trace.end_span(
+                    rebuild_span,
+                    routes_updated=(
+                        len(update.unicast_routes_to_update)
+                        if update is not None
+                        else -1
+                    ),
+                    routes_deleted=(
+                        len(update.unicast_routes_to_delete)
+                        if update is not None
+                        else -1
+                    ),
+                )
+
+        self.route_db.update(update)
+        self.pending.add_event("ROUTE_UPDATE")
+        update.perf_events = self.pending.move_out_events()
+        update.trace = trace
+        self.pending.reset()
+        self.route_updates_queue.push(update)
+
+    @fault_boundary
+    def _solve_update(
+        self, full: bool, reset: bool, backend: str
+    ) -> DecisionRouteUpdate:
+        """One ladder rung: compute the DecisionRouteUpdate for this
+        rebuild. ``reset`` drops every device-derived cache first (so a
+        torn dispatch can't leak into the result); a backend flip does
+        the same implicitly. A reset or flip forces the full-rebuild
+        branch even for a per-prefix batch — the full route db is a
+        superset of the per-prefix entries and ``calculate_update``
+        diffs against the installed db, so the emitted delta is
+        identical."""
+        flipped = self.spf_solver.backend != backend
+        if reset:
+            self.spf_solver.reset_device_state()
+        if flipped:
+            self.spf_solver.set_backend(backend)
         update = DecisionRouteUpdate()
-        if self.pending.needs_full_rebuild():
+        if full or reset or flipped:
             new_db = (
                 self.spf_solver.build_route_db(
                     self.my_node_name, self.area_link_states, self.prefix_state
@@ -507,25 +602,7 @@ class Decision:
                     update.unicast_routes_to_update
                 )
                 update.unicast_routes_to_delete.extend(change.deleted_routes)
-
-        get_registry().observe(
-            "decision.rebuild_ms",
-            (time.perf_counter() - t_rebuild0) * 1000.0,
-        )
-        if trace is not None:
-            tracer.deactivate()
-            trace.end_span(
-                rebuild_span,
-                routes_updated=len(update.unicast_routes_to_update),
-                routes_deleted=len(update.unicast_routes_to_delete),
-            )
-
-        self.route_db.update(update)
-        self.pending.add_event("ROUTE_UPDATE")
-        update.perf_events = self.pending.move_out_events()
-        update.trace = trace
-        self.pending.reset()
-        self.route_updates_queue.push(update)
+        return update
 
     # -- public (thread-safe) APIs ---------------------------------------
 

@@ -26,6 +26,7 @@ import numpy as np
 
 from openr_tpu.decision.prefix_state import NodeAndArea, PrefixEntries, PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb, RibMplsEntry, RibUnicastEntry
+from openr_tpu.faults.injector import fault_point, register_fault_site
 from openr_tpu.graph.linkstate import Link, LinkState
 from openr_tpu.graph.snapshot import INF, GraphSnapshot, SnapshotCache
 from openr_tpu.types import (
@@ -182,8 +183,14 @@ SPF_COUNTERS = _get_registry().counter_dict(
         "decision.ksp2_route_reuses",
         "decision.sp_route_reuses",
         "decision.ell_prewarms",
+        "decision.device_state_resets",
+        "decision.backend_switches",
     ]
 )
+
+# the Decision degradation ladder's injection seam (a fresh device
+# view solve; see openr_tpu.faults)
+FAULT_SPF_SOLVE = register_fault_site("decision.spf_solve")
 
 # KSP2 device prefetch: below this many KSP2 destinations the host path
 # is cheaper than a device dispatch; batches are fixed-size so the
@@ -617,6 +624,18 @@ class _EllResidentCache:
 _ELL_RESIDENT = _EllResidentCache()
 
 
+def reset_device_caches() -> None:
+    """Drop every module-level device-derived cache (resident ELL
+    bands, preloaded views, compiled graph snapshots). The degradation
+    ladder's cold rung calls this when a device solve failed: the next
+    build recompiles and re-lands everything from the LinkState alone,
+    so a torn dispatch can never leave half-synced resident state
+    behind."""
+    _ELL_RESIDENT._cache = _weakref.WeakKeyDictionary()
+    _ELL_RESIDENT._preloaded = []
+    _SNAPSHOTS.invalidate()
+
+
 class SpfSolver:
     """reference: openr/decision/Decision.h:202 SpfSolver (pImpl)."""
 
@@ -715,6 +734,43 @@ class SpfSolver:
             self.static_mpls_routes.pop(label, None)
         self._static_routes_version += 1
 
+    # -- degradation-ladder hooks -----------------------------------------
+
+    def reset_device_state(self) -> None:
+        """Discard every solver cache derived from device solves (and
+        the module-level resident/compiled caches behind them). The
+        ladder's cold rung runs this before a full rebuild so the
+        rebuild recomputes everything from the LinkStates alone —
+        nothing cached across a failed or torn device dispatch can
+        leak into the recovered route database."""
+        self._views = {}
+        self._ksp2_engines = _weakref.WeakKeyDictionary()
+        self._labels_cache = _weakref.WeakKeyDictionary()
+        self._route_cache = {}
+        self._route_cache_meta = None
+        self._route_entries_cache = None
+        self._route_best_cache = None
+        self._advertisers_cache = None
+        self._ksp2_dsts_cache = None
+        self._ksp2_tracked = set()
+        self._sp_reuse = {}
+        self._sp_prev_seq = None
+        self._label_cache = {}
+        self._label_state = {}
+        reset_device_caches()
+        SPF_COUNTERS["decision.device_state_resets"] += 1
+
+    def set_backend(self, backend: str) -> None:
+        """Switch the solve backend. The view/route caches are not
+        backend-keyed, so a flip must drop them — otherwise a view
+        solved by the old backend would satisfy the new backend's
+        cache probe."""
+        if backend == self.backend:
+            return
+        self.backend = backend
+        self.reset_device_state()
+        SPF_COUNTERS["decision.backend_switches"] += 1
+
     # -- SPF views --------------------------------------------------------
 
     def prewarm(self, area_link_states: AreaLinkStates) -> None:
@@ -766,6 +822,11 @@ class SpfSolver:
             # drop stale versions of this graph
             for k in [k for k in per_ls if k[0] != key[0]]:
                 del per_ls[k]
+            if self.backend == "device":
+                # the degradation ladder's device seam: a cached view
+                # never fails (its rows already crossed), a fresh
+                # device solve can
+                fault_point(FAULT_SPF_SOLVE)
             factory = _SPF_BACKENDS.get(self.backend)
             view = (
                 factory(ls, root)

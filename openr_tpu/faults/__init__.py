@@ -1,0 +1,33 @@
+"""Fault-injection harness and graceful-degradation supervisor.
+
+``injector`` provides named, seedable injection points at the
+pipeline's real seams; ``supervisor`` owns the HEALTHY → DEGRADED →
+FALLBACK ladder walked by the route engine and Decision when those
+seams fail for real.
+"""
+
+from openr_tpu.faults.injector import (
+    FaultInjected,
+    FaultInjector,
+    FaultSchedule,
+    fault_point,
+    get_injector,
+    register_fault_site,
+)
+from openr_tpu.faults.supervisor import (
+    DegradationSupervisor,
+    HealthState,
+    LadderExhausted,
+)
+
+__all__ = [
+    "DegradationSupervisor",
+    "FaultInjected",
+    "FaultInjector",
+    "FaultSchedule",
+    "HealthState",
+    "LadderExhausted",
+    "fault_point",
+    "get_injector",
+    "register_fault_site",
+]

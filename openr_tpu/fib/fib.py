@@ -78,7 +78,16 @@ class Fib:
             "fib.sync_fib_calls": 0,
             "fib.routes_programmed": 0,
             "fib.routes_deleted": 0,
+            "fib.agent_restarts": 0,
+            "fib.unacked_reprogrammed": 0,
         }
+        # prefixes/labels a failed delta left in unknown agent state
+        # (the program call may have partially landed before the
+        # transport died). The recovery sync re-programs the FULL
+        # desired state, so these are re-acknowledged in bulk; the
+        # counter makes the re-program visible.
+        self._unacked_prefixes: set = set()
+        self._unacked_labels: set = set()
         # bounded perf-event history served via getPerfDb
         # (reference: Fib keeps a PerfDatabase, if/OpenrCtrl.thrift:312)
         from collections import deque
@@ -200,6 +209,14 @@ class Fib:
             return True
         except Exception:
             self.counters["fib.route_programming_failures"] += 1
+            # the delta's targets are now in unknown agent state until
+            # the recovery sync re-programs the full desired state
+            self._unacked_prefixes.update(update.unicast_routes_to_delete)
+            self._unacked_prefixes.update(update.unicast_routes_to_update)
+            self._unacked_labels.update(update.mpls_routes_to_delete)
+            self._unacked_labels.update(
+                e.label for e in update.mpls_routes_to_update
+            )
             return False
 
     def _is_do_not_install(self, prefix: IpPrefix) -> bool:
@@ -228,6 +245,13 @@ class Fib:
             self._synced_once = True
             self._dirty = False
             self._backoff.report_success()
+            unacked = len(self._unacked_prefixes) + len(self._unacked_labels)
+            if unacked:
+                # the full sync just re-asserted every desired route,
+                # covering everything a failed delta left unknown
+                self.counters["fib.unacked_reprogrammed"] += unacked
+                self._unacked_prefixes.clear()
+                self._unacked_labels.clear()
             return True
         except Exception:
             self.counters["fib.route_programming_failures"] += 1
@@ -262,6 +286,11 @@ class Fib:
             return
         if alive != self._agent_alive_since:
             self._agent_alive_since = alive
+            self.counters["fib.agent_restarts"] += 1
+            # the restarted agent lost its table: every desired route
+            # is effectively unacknowledged until the sync lands
+            self._unacked_prefixes.update(self.unicast_routes)
+            self._unacked_labels.update(self.mpls_routes)
             if not self._sync_route_db():
                 self._mark_dirty()
 

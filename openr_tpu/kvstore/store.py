@@ -33,9 +33,10 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from openr_tpu.faults.injector import fault_point, register_fault_site
 from openr_tpu.monitor.monitor import push_log_sample
 from openr_tpu.messaging.queue import ReplicateQueue
-from openr_tpu.telemetry import get_tracer
+from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.types import (
     DEFAULT_AREA,
     TTL_INFINITY,
@@ -51,6 +52,13 @@ from openr_tpu.utils.eventbase import ExponentialBackoff, OpenrEventBase
 # ttl decrement applied when re-flooding, so a key eventually dies even in
 # a flood loop (reference: Constants.h kTtlDecrement)
 TTL_DECREMENT_MS = 1
+
+# injection seams for the store's two peer-I/O paths: the 3-way full
+# sync request and the flood fan-out. Both fire on the executor thread
+# inside the existing try blocks, so an injected fault takes the same
+# backoff + re-sync recovery path as a real transport error.
+FAULT_KV_FULL_SYNC = register_fault_site("kvstore.full_sync")
+FAULT_KV_FLOOD = register_fault_site("kvstore.flood")
 
 
 @dataclass
@@ -286,6 +294,8 @@ class KvStoreDb:
             "kvstore.flood_count": 0,
             "kvstore.spt_floods": 0,
             "kvstore.rate_limit_suppress": 0,
+            "kvstore.full_sync_failures": 0,
+            "kvstore.flood_errors": 0,
         }
 
     def _log_sample(self, **fields) -> None:
@@ -435,9 +445,12 @@ class KvStoreDb:
                 originator_id=self.node_id,
                 solicit_response=False,
             )
-            self._async_peer_call(
-                peer, lambda t=peer.transport: t.set_key_vals(self.area, params)
-            )
+
+            def flood_one(t=peer.transport, p=params) -> None:
+                fault_point(FAULT_KV_FLOOD)
+                t.set_key_vals(self.area, p)
+
+            self._async_peer_call(peer, flood_one)
 
     def _decrement_ttls(self, updates: Dict[str, Value]) -> Dict[str, Value]:
         out: Dict[str, Value] = {}
@@ -672,6 +685,7 @@ class KvStoreDb:
 
             def do_sync(peer=peer, params=params) -> None:
                 try:
+                    fault_point(FAULT_KV_FULL_SYNC)
                     pub = peer.transport.get_key_vals_filtered(self.area, params)
                 except Exception:
                     self._evb.run_in_event_base(
@@ -685,6 +699,8 @@ class KvStoreDb:
             self._executor.submit(do_sync)
 
     def _sync_failed(self, peer_name: str) -> None:
+        self.counters["kvstore.full_sync_failures"] += 1
+        get_registry().counter_bump("kvstore.full_sync_failures")
         peer = self.peers.get(peer_name)
         if peer is None:
             return
@@ -823,6 +839,8 @@ class KvStoreDb:
         self._executor.submit(run)
 
     def _peer_io_failed(self, peer_name: str) -> None:
+        self.counters["kvstore.flood_errors"] += 1
+        get_registry().counter_bump("kvstore.flood_errors")
         peer = self.peers.get(peer_name)
         if peer is None:
             return

@@ -79,6 +79,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from openr_tpu.ops.spf import INF
+from openr_tpu.ops import host_sweep
 from openr_tpu.ops import route_sweep as rs
 from openr_tpu.ops.spf_sparse import (
     _out_edges,
@@ -87,11 +88,20 @@ from openr_tpu.ops.spf_sparse import (
     pad_patch_rows,
 )
 from openr_tpu.analysis.annotations import (
+    fault_boundary,
     requires_drain,
     resident_buffers,
     solve_window,
 )
+from openr_tpu.faults.injector import fault_point, register_fault_site
+from openr_tpu.faults.supervisor import DegradationSupervisor
 from openr_tpu.telemetry import get_registry, get_tracer
+
+# degradation-ladder injection sites (armable by name; see
+# openr_tpu.faults.injector)
+FAULT_DISPATCH = register_fault_site("route_engine.dispatch")
+FAULT_CONSUME = register_fault_site("route_engine.consume")
+FAULT_COLD_BUILD = register_fault_site("route_engine.cold_build")
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
@@ -477,6 +487,12 @@ def _sharded_churn_step(
     )
 
 
+class _DeviceStateInvalid(RuntimeError):
+    """The resident device state is stale (a host fallback bypassed
+    it): the warm rung refuses to run and the ladder walks to the cold
+    rebuild, which rederives everything."""
+
+
 class PendingDelta:
     """Handle to ONE churn event's in-flight delta-compacted readback.
 
@@ -548,6 +564,11 @@ class RouteSweepEngine:
         self.last_delta_rows = 0
         self.last_readback_bytes = 0
         self.last_overlap_ms = 0.0
+        # False between a failed/bypassed device path and the next
+        # successful cold build: gates the warm rung off stale residents
+        self._device_valid = False
+        self.host_fallbacks = 0
+        self.supervisor = DegradationSupervisor("route_engine")
         self._build(ls)
 
     def _max_nodes(self) -> int:
@@ -593,6 +614,10 @@ class RouteSweepEngine:
         # a cold rebuild replaces the whole result: drain any in-flight
         # delta first so a caller-held PendingDelta handle resolves
         self.flush()
+        # invalid until this build completes: a failure below leaves
+        # the engine torn (mirrors vs residents), and the gate forces
+        # every later event through another cold build or the host rung
+        self._device_valid = False
         graph, sweeper = self._compile_backend(ls)
         if graph.n_pad > self._max_nodes():
             raise ValueError(
@@ -618,6 +643,7 @@ class RouteSweepEngine:
         self._ov_host = {
             nm: ls.is_node_overloaded(nm) for nm in graph.node_names
         }
+        fault_point(FAULT_COLD_BUILD)
         dr, digests, packed = self._full_resident(graph)
         self._dr = dr
         self._digests_dev = digests
@@ -629,6 +655,7 @@ class RouteSweepEngine:
         )
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
+        self._device_valid = True
         self.cold_builds = getattr(self, "cold_builds", 0) + 1
         self.incremental_events = getattr(
             self, "incremental_events", 0
@@ -694,6 +721,7 @@ class RouteSweepEngine:
         here; the caller reads the tiny meta row for the retry ladder
         and the changed rows only at consume time."""
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        fault_point(FAULT_DISPATCH)
         graph = ctx["patched"]
         if self.mesh is None:
             (new_v, new_w_t, dr, digests, packed_res,
@@ -868,6 +896,10 @@ class RouteSweepEngine:
         if p is None:
             return None
         self._pending = None
+        # a consume failure drops this delta un-applied; every deeper
+        # ladder rung reassembles the whole result, so the staleness
+        # cannot outlive the walk
+        fault_point(FAULT_CONSUME)
         tracer = get_tracer()
         span = tracer.span_active("ops.route_engine.delta_consume")
         t0 = time.perf_counter()
@@ -922,14 +954,76 @@ class RouteSweepEngine:
 
     def churn(self, ls, affected_nodes: Set[str],
               defer_consume: bool = False):
-        """Apply one churn event. Returns the list of affected
-        destination NAMES (their digests/sample rows in self.result
-        are refreshed in place); falls back to a cold rebuild (and
-        returns None) when incrementality does not apply. With
-        ``defer_consume=True`` the device state commits but the host
-        apply is left in flight: the return value is a PendingDelta
-        (consumed by the next churn inside its dispatch window, or by
-        flush()/wait()) — self.result is stale until then."""
+        """Apply one churn event, SUPERVISED: the degradation ladder
+        walks warm incremental re-solve → drain + cold device rebuild
+        → host NumPy fallback, each rung producing a bit-identical
+        route product, until one succeeds (LadderExhausted if none
+        does). Returns the warm path's affected destination NAMES /
+        PendingDelta (``defer_consume=True``), or None from the deeper
+        rungs — the pre-existing cold-rebuild contract."""
+        return self.supervisor.run((
+            ("warm", lambda: self._churn_device(
+                ls, affected_nodes, defer_consume
+            )),
+            ("cold", lambda: self._cold_recover(ls)),
+            ("host", lambda: self._host_fallback(ls)),
+        ))
+
+    @fault_boundary
+    def _cold_recover(self, ls) -> None:
+        """Ladder rung 1: drain + cold device rebuild. Layout, host
+        mirrors, and residents are all rederived from the LinkState —
+        the cold-twin contract of the parity suite makes the result
+        bit-identical to the warm path's."""
+        self._build(ls)
+        return None
+
+    def _discard_pending(self) -> None:
+        """Drop the in-flight delta WITHOUT the host-side apply: the
+        host fallback replaces the whole result, so the pending rows
+        are subsumed. A caller-held PendingDelta resolves (empty)."""
+        p = self._pending
+        self._pending = None
+        if p is not None:
+            p.consumed = True
+            get_registry().counter_bump("route_engine.deltas_discarded")
+
+    @fault_boundary
+    @requires_drain("_discard_pending")
+    def _host_fallback(self, ls) -> None:
+        """Ladder rung 2: the device path is down — recompute the whole
+        packed product on the host (ops.host_sweep, bit-identical to a
+        cold device sweep by the replica contract) and mark the device
+        residents invalid so no later warm rung reads them. Self-heals
+        once the supervisor's breaker lets a cold rebuild through."""
+        self._discard_pending()
+        shim, packed = host_sweep.host_route_product(
+            ls, self.sample_names, align=self._align
+        )
+        self.result = rs.assemble_result(shim, packed)
+        self._device_valid = False
+        self.version = ls.topology_version
+        self.aversion = ls.attributes_version
+        self.host_fallbacks += 1
+        get_registry().counter_bump("route_engine.host_fallbacks")
+        return None
+
+    @fault_boundary
+    def _churn_device(self, ls, affected_nodes: Set[str],
+                      defer_consume: bool = False):
+        """Ladder rung 0 (warm): one incremental device event. Returns
+        the list of affected destination NAMES (their digests/sample
+        rows in self.result are refreshed in place); falls back to a
+        cold rebuild (and returns None) when incrementality does not
+        apply. With ``defer_consume=True`` the device state commits but
+        the host apply is left in flight: the return value is a
+        PendingDelta (consumed by the next churn inside its dispatch
+        window, or by flush()/wait()) — self.result is stale until
+        then."""
+        if not self._device_valid:
+            raise _DeviceStateInvalid(
+                "device residents stale (host fallback active)"
+            )
         graph = self.graph
         ctx = self._prepare_patch(ls, sorted(affected_nodes))
         if ctx is None or not self._refresh_sample_bands(
@@ -1359,6 +1453,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
     @solve_window
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        fault_point(FAULT_DISPATCH)
         graph = ctx["patched"]
         impl = sg.get_grouped_impl()
         upd_g, upd_s, upd_r, upd_w = ctx["upd"]

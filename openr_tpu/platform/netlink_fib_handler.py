@@ -17,6 +17,7 @@ import errno
 import time
 from typing import Dict, List, Optional
 
+from openr_tpu.faults.injector import fault_point, register_fault_site
 from openr_tpu.platform.fib_service import FibService
 from openr_tpu.platform.netlink import NetlinkError, NetlinkProtocolSocket
 from openr_tpu.telemetry import get_registry
@@ -24,6 +25,11 @@ from openr_tpu.types import IpPrefix, MplsRoute, UnicastRoute
 from openr_tpu.utils.rpc import RpcClient, RpcServer
 
 FIB_AGENT_RPC_PORT = 60100
+
+# injection seam for kernel programming: fires before the first netlink
+# write of a batch, so an injected fault leaves the per-client table
+# untouched (like an rtnetlink error on the first route)
+FAULT_NETLINK_PROGRAM = register_fault_site("platform.netlink_program")
 
 
 class NetlinkFibHandler(FibService):
@@ -36,12 +42,14 @@ class NetlinkFibHandler(FibService):
     # -- FibService -------------------------------------------------------
 
     def add_unicast_routes(self, client_id, routes) -> None:
+        fault_point(FAULT_NETLINK_PROGRAM)
         table = self._unicast.setdefault(client_id, {})
         for route in routes:
             self._nl.add_route(route)
             table[route.dest] = route
 
     def delete_unicast_routes(self, client_id, prefixes) -> None:
+        fault_point(FAULT_NETLINK_PROGRAM)
         table = self._unicast.setdefault(client_id, {})
         for prefix in prefixes:
             self._nl.delete_route(prefix)
@@ -96,6 +104,7 @@ class NetlinkFibHandler(FibService):
     def sync_fib(self, client_id, routes) -> None:
         """Full-state reconciliation: program adds/changes, remove strays
         (reference: NetlinkFibHandler syncFib semantics)."""
+        fault_point(FAULT_NETLINK_PROGRAM)
         desired = {r.dest: r for r in routes}
         current = self._unicast.get(client_id, {})
         for prefix in list(current):

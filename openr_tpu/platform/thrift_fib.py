@@ -18,15 +18,23 @@ Methods (Platform.thrift:90-135, clientId is i16):
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List
 
+from openr_tpu.faults.injector import fault_point, register_fault_site
 from openr_tpu.platform.fib_service import FibService
+from openr_tpu.telemetry import get_registry
 from openr_tpu.types import MplsRoute, UnicastRoute
 from openr_tpu.utils import thrift_compact as tc
+from openr_tpu.utils.eventbase import ExponentialBackoff
 from openr_tpu.utils.thrift_rpc import (
     FramedCompactClient,
     FramedCompactServer,
 )
+
+# injection seam for the programming transport: fires before the wire
+# call, exactly where a dead agent or a torn connection would surface
+FAULT_FIB_TRANSPORT = register_fault_site("fib.thrift_transport")
 
 _VOID = tc.StructSchema("void_result", ())
 
@@ -195,14 +203,49 @@ class ThriftFibAgent(FibService):
     the platform agent speaks thrift (reference: Fib.h:72
     createFibClient)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: float = 10.0,
+        retry_min_s: float = 0.05,
+        retry_max_s: float = 1.0,
+        max_attempts: int = 4,
+    ):
         self._client = FramedCompactClient(host, port, timeout_s)
+        # bounded retry-with-backoff around every wire call: the
+        # underlying client reconnects per call after a transport
+        # error, so each attempt is a fresh connection. max_attempts
+        # caps the loop — a dead agent costs at most max_attempts-1
+        # backoff sleeps, never an unbounded spin.
+        self._backoff = ExponentialBackoff(retry_min_s, retry_max_s)
+        self._max_attempts = max(1, max_attempts)
+
+    def _call(self, name, schema, args, result_schema) -> Dict:
+        last: Exception = RuntimeError("no attempts made")
+        for attempt in range(1, self._max_attempts + 1):
+            try:
+                fault_point(FAULT_FIB_TRANSPORT)
+                out = self._client.call(name, schema, args, result_schema)
+                self._backoff.report_success()
+                return out
+            except Exception as exc:  # transport or injected fault
+                last = exc
+                self._backoff.report_error()
+                if attempt == self._max_attempts:
+                    break
+                get_registry().counter_bump("fib.program_retries")
+                time.sleep(
+                    self._backoff.get_time_remaining_until_retry()
+                )
+        get_registry().counter_bump("fib.program_failures")
+        raise last
 
     def _void_call(self, name, schema, client_id, payload=None) -> None:
         args: Dict = {"clientId": client_id}
         if payload is not None:
             args["payload"] = payload
-        self._client.call(name, schema, args, _VOID)
+        self._call(name, schema, args, _VOID)
 
     def add_unicast_routes(self, client_id, routes) -> None:
         self._void_call(
@@ -242,7 +285,7 @@ class ThriftFibAgent(FibService):
     def get_route_table_by_client(
         self, client_id
     ) -> List[UnicastRoute]:
-        result = self._client.call(
+        result = self._call(
             "getRouteTableByClient", _GET_UNICAST,
             {"clientId": client_id}, _UNICAST_RESULT,
         )
@@ -254,7 +297,7 @@ class ThriftFibAgent(FibService):
     def get_mpls_route_table_by_client(
         self, client_id
     ) -> List[MplsRoute]:
-        result = self._client.call(
+        result = self._call(
             "getMplsRouteTableByClient", _GET_MPLS,
             {"clientId": client_id}, _MPLS_RESULT,
         )
@@ -264,7 +307,7 @@ class ThriftFibAgent(FibService):
         ]
 
     def alive_since(self) -> int:
-        result = self._client.call(
+        result = self._call(
             "aliveSince", _ALIVE_ARGS, {}, _ALIVE_RESULT
         )
         if "success" not in result:

@@ -1,0 +1,364 @@
+"""Chaos soak: one seeded, randomized fault schedule driven across
+every injection seam of the pipeline — device dispatch, delta consume,
+cold device rebuild, Decision SPF solve, the Fib thrift transport,
+netlink programming, and KvStore full-sync/flood — over 200+ churn
+events. The run is replayable bit-for-bit from the module seeds
+(``FaultSchedule.fail_with_probability`` draws from a private
+``random.Random(seed)`` stream and the event schedule from another).
+
+End-state obligations, per the degradation contract:
+
+- the route product after the storm is bit-identical to a fault-free
+  oracle (cold-twin engine + host digest sweep; fresh native-backend
+  Decision);
+- every supervisor self-heals back to HEALTHY once the faults stop;
+- no unbounded retry loops: each churn event is exactly one ladder
+  walk (<= 3 rung attempts), and each thrift call makes at most
+  ``max_attempts`` attempts;
+- at least 200 faults actually fired, across at least 5 distinct
+  injection sites (the coverage floor, proved from the
+  ``faults.injected.<site>`` counters).
+"""
+
+import random
+import time
+
+import pytest
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.faults import (
+    DegradationSupervisor,
+    FaultInjected,
+    FaultSchedule,
+    HealthState,
+    get_injector,
+)
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.models import topologies
+from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+from openr_tpu.platform.thrift_fib import FibThriftServer, ThriftFibAgent
+from openr_tpu.telemetry import get_registry
+
+from test_degradation_ladder import (
+    _assert_routes_match_oracle,
+    _bump_metric,
+    _dec_topo,
+    _make_decision,
+    _publish_adj,
+    _publish_all,
+    _route,
+    wait_until,
+)
+from test_route_engine_delta import (
+    assert_bit_identical,
+    engine_digests,
+    full_digests,
+    load,
+    make_engine,
+    mutate_metric,
+)
+
+SEED = 20260805  # every stream below derives from this; change = new run
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().reset()
+    yield
+    get_injector().reset()
+
+
+def _injected_snapshot():
+    prefix = "faults.injected."
+    return {
+        k[len(prefix):]: v
+        for k, v in get_registry().snapshot().items()
+        if k.startswith(prefix)
+    }
+
+
+# ---------------------------------------------------------------------------
+# legs
+# ---------------------------------------------------------------------------
+
+
+def _engine_leg(events):
+    """Seeded fault storm over the supervised route engine."""
+    ls = load(
+        topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+    )
+    engine = make_engine("ell", ls)
+    # near-zero breaker so the soak re-probes the faulty path on every
+    # event instead of riding out the storm on the host rung
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    rsws = [n for n in engine.graph.node_names if n.startswith("rsw")][:4]
+    inj = get_injector()
+    inj.arm(
+        "route_engine.dispatch",
+        FaultSchedule.fail_with_probability(0.5, seed=SEED + 1),
+    )
+    inj.arm(
+        "route_engine.consume",
+        FaultSchedule.fail_with_probability(0.4, seed=SEED + 2),
+    )
+    inj.arm(
+        "route_engine.cold_build",
+        FaultSchedule.fail_with_probability(0.5, seed=SEED + 3),
+    )
+    rng = random.Random(SEED + 4)
+    churns = 0
+    for _ in range(events):
+        node = rng.choice(rsws)
+        engine.churn(ls, mutate_metric(ls, node, 0, rng.randrange(1, 60)))
+        churns += 1
+        time.sleep(0.002)  # let the breaker elapse between events
+
+    for site in (
+        "route_engine.dispatch",
+        "route_engine.consume",
+        "route_engine.cold_build",
+    ):
+        inj.disarm(site)
+    # fault-free churns walk the ladder back to HEALTHY
+    for _ in range(12):
+        if engine.supervisor.state is HealthState.HEALTHY:
+            break
+        time.sleep(0.01)
+        node = rng.choice(rsws)
+        engine.churn(ls, mutate_metric(ls, node, 0, rng.randrange(1, 60)))
+        churns += 1
+    assert engine.supervisor.state is HealthState.HEALTHY
+    # bounded recovery: every churn event was exactly one ladder walk
+    assert engine.supervisor.walks == churns
+
+    # end-state bit-identity vs the fault-free oracles: a cold twin of
+    # the same engine class, and the host digest sweep
+    assert_bit_identical(engine, ls, "ell")
+    assert engine_digests(engine) == full_digests(ls)
+    return churns
+
+
+def _decision_leg(events):
+    """Seeded fault storm over the supervised Decision rebuild path."""
+    topo = _dec_topo()
+    d = _make_decision()
+    versions = {}
+    _publish_all(d, topo, versions)
+    d.rebuild_routes("SOAK")
+    d.supervisor = DegradationSupervisor(
+        "decision", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    get_injector().arm(
+        "decision.spf_solve",
+        FaultSchedule.fail_with_probability(0.6, seed=SEED + 5),
+    )
+    rng = random.Random(SEED + 6)
+    mutated = dict(topo.adj_dbs)
+    rebuilds = 0
+    for _ in range(events):
+        node = rng.choice(("b", "c"))
+        mutated[node] = _bump_metric(
+            mutated[node], rng.randrange(1, 40)
+        )
+        _publish_adj(d, mutated[node], versions)
+        d.rebuild_routes("SOAK")
+        rebuilds += 1
+        time.sleep(0.002)
+
+    get_injector().disarm("decision.spf_solve")
+    for _ in range(12):
+        if d.supervisor.state is HealthState.HEALTHY:
+            break
+        time.sleep(0.01)
+        node = rng.choice(("b", "c"))
+        mutated[node] = _bump_metric(mutated[node], rng.randrange(1, 40))
+        _publish_adj(d, mutated[node], versions)
+        d.rebuild_routes("SOAK")
+        rebuilds += 1
+    assert d.supervisor.state is HealthState.HEALTHY
+    assert d.spf_solver.backend == "device"
+    # the fast-breaker supervisor was swapped in after the initial
+    # rebuild: it saw exactly one bounded walk per soak event
+    assert d.supervisor.walks == rebuilds
+
+    _assert_routes_match_oracle(d, topo, mutated)
+    return rebuilds
+
+
+def _thrift_leg(events):
+    """Seeded faults on the Fib thrift transport; bounded retry absorbs
+    them, and the post-storm sync reconciles the table."""
+    mock = MockNetlinkProtocolSocket()
+    handler = NetlinkFibHandler(mock)
+    server = FibThriftServer(handler, host="127.0.0.1")
+    server.start()
+    client = ThriftFibAgent(
+        "127.0.0.1",
+        server.port,
+        retry_min_s=0.002,
+        retry_max_s=0.01,
+        max_attempts=4,
+    )
+    base_retries = get_registry().snapshot().get("fib.program_retries", 0)
+    try:
+        get_injector().arm(
+            "fib.thrift_transport",
+            FaultSchedule.fail_with_probability(0.5, seed=SEED + 7),
+        )
+        rng = random.Random(SEED + 8)
+        surfaced = 0
+        calls = 0
+        for i in range(events):
+            calls += 1
+            try:
+                if rng.random() < 0.7:
+                    client.add_unicast_routes(
+                        786, [_route(f"fd00:{i % 16:x}::/64")]
+                    )
+                else:
+                    client.delete_unicast_routes(
+                        786, [_route(f"fd00:{i % 16:x}::/64").dest]
+                    )
+            except FaultInjected:
+                # all max_attempts burned: the failure surfaces to the
+                # caller instead of looping forever
+                surfaced += 1
+        get_injector().disarm("fib.thrift_transport")
+        retries = (
+            get_registry().snapshot().get("fib.program_retries", 0)
+            - base_retries
+        )
+        assert retries <= (client._max_attempts - 1) * calls
+        # post-storm reconciliation: a clean full sync wins regardless
+        # of which calls surfaced failures mid-storm
+        desired = [_route("fd00:aa::/64"), _route("fd00:bb::/64")]
+        client.sync_fib(786, desired)
+        got = client.get_route_table_by_client(786)
+        assert [r.dest for r in got] == sorted(r.dest for r in desired)
+        return calls
+    finally:
+        client.close()
+        server.stop()
+
+
+def _netlink_leg(events):
+    """Seeded faults at the kernel-programming seam: a failed batch
+    leaves the table untouched, and the final sync reconciles."""
+    handler = NetlinkFibHandler(MockNetlinkProtocolSocket())
+    get_injector().arm(
+        "platform.netlink_program",
+        FaultSchedule.fail_with_probability(0.5, seed=SEED + 9),
+    )
+    rng = random.Random(SEED + 10)
+    calls = 0
+    for i in range(events):
+        calls += 1
+        try:
+            if rng.random() < 0.7:
+                handler.add_unicast_routes(
+                    786, [_route(f"fd01:{i % 8:x}::/64")]
+                )
+            else:
+                handler.delete_unicast_routes(
+                    786, [_route(f"fd01:{i % 8:x}::/64").dest]
+                )
+        except FaultInjected:
+            pass
+    get_injector().disarm("platform.netlink_program")
+    desired = [_route("fd01:aa::/64")]
+    handler.sync_fib(786, desired)
+    assert [r.dest for r in handler.get_route_table_by_client(786)] == [
+        desired[0].dest
+    ]
+    return calls
+
+
+def _kvstore_leg():
+    """Faults on peer full-sync and flood: backoff re-sync converges
+    both stores anyway."""
+    from openr_tpu.kvstore.store import KvStorePeerState
+    from openr_tpu.kvstore.wrapper import KvStoreWrapper, link_bidirectional
+
+    a = KvStoreWrapper("soak-a")
+    b = KvStoreWrapper("soak-b")
+    a.start()
+    b.start()
+    try:
+        from openr_tpu.kvstore.store import KvStorePeerState as PS
+
+        get_injector().arm("kvstore.full_sync", FaultSchedule.fail_n(2))
+        link_bidirectional(a, b)
+        events = 0
+        for i in range(5):
+            a.set_key(f"soak:key:{i}", b"payload-%d" % i)
+            events += 1
+            time.sleep(0.005)
+        # the full-sync faults are absorbed by the peer backoff FSM
+        get_injector().disarm("kvstore.full_sync")
+        assert wait_until(
+            lambda: all(
+                s is PS.INITIALIZED
+                for s in list(a.peer_states().values())
+                + list(b.peer_states().values())
+            )
+        )
+        # now the stores flood live updates: drop half of those too
+        get_injector().arm(
+            "kvstore.flood",
+            FaultSchedule.fail_with_probability(0.5, seed=SEED + 11),
+        )
+        for i in range(5, 15):
+            a.set_key(f"soak:key:{i}", b"payload-%d" % i)
+            events += 1
+            time.sleep(0.005)
+        get_injector().disarm("kvstore.flood")
+        # every key converges onto the peer despite the dropped floods
+        assert wait_until(
+            lambda: all(
+                b.get_key(f"soak:key:{i}") is not None for i in range(15)
+            ),
+            timeout=10.0,
+        )
+        assert wait_until(
+            lambda: all(
+                s is KvStorePeerState.INITIALIZED
+                for s in list(a.peer_states().values())
+                + list(b.peer_states().values())
+            )
+        )
+        return events
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_soak():
+    base = _injected_snapshot()
+
+    events = 0
+    events += _engine_leg(160)
+    events += _decision_leg(40)
+    events += _thrift_leg(40)
+    events += _netlink_leg(30)
+    events += _kvstore_leg()
+    assert events >= 200, events
+
+    injected = {
+        site: count - base.get(site, 0)
+        for site, count in _injected_snapshot().items()
+    }
+    injected = {s: c for s, c in injected.items() if c > 0}
+    total = sum(injected.values())
+    # the coverage floor: 200+ fired faults across 5+ distinct seams
+    assert total >= 200, injected
+    assert len(injected) >= 5, injected

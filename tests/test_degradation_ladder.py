@@ -1,0 +1,788 @@
+"""Per-rung degradation parity: every rung of the recovery ladder
+(warm incremental re-solve -> drain + cold device rebuild -> host
+fallback) produces a bit-identical route product, the
+HEALTHY -> DEGRADED -> FALLBACK state machine transitions exactly as
+specified, and the fault-injection seams (device dispatch, delta
+consume, cold build, SPF solve, KvStore sync/flood, Fib thrift
+transport, netlink programming) fire deterministically from their
+schedules. Also covers the Fib/thrift bounded retry-with-backoff and
+the re-program of unacknowledged routes after an agent restart."""
+
+import time
+from dataclasses import replace
+
+import pytest
+
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+from openr_tpu.faults import (
+    DegradationSupervisor,
+    FaultInjected,
+    FaultSchedule,
+    HealthState,
+    LadderExhausted,
+    fault_point,
+    get_injector,
+    register_fault_site,
+)
+from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.models import topologies
+from openr_tpu.platform.fib_service import MockFibAgent
+from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+from openr_tpu.platform.thrift_fib import FibThriftServer, ThriftFibAgent
+from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.types import (
+    BinaryAddress,
+    IpPrefix,
+    NextHop,
+    Publication,
+    UnicastRoute,
+    Value,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+from test_route_engine_delta import (
+    assert_bit_identical,
+    engine_digests,
+    full_digests,
+    load,
+    make_engine,
+    mutate_metric,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().reset()
+    yield
+    get_injector().reset()
+
+
+def counter(name):
+    return get_registry().snapshot().get(name, 0)
+
+
+def wait_until(pred, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# fault injector / schedule semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_fail_once_fires_exactly_once(self):
+        site = register_fault_site("test.fail_site")
+        base = counter(f"faults.injected.{site}")
+        get_injector().arm(site, FaultSchedule.fail_once())
+        with pytest.raises(FaultInjected) as ei:
+            fault_point(site)
+        assert ei.value.site == site
+        fault_point(site)  # schedule spent: crossing is clean
+        assert counter(f"faults.injected.{site}") == base + 1
+
+    def test_fail_n(self):
+        site = register_fault_site("test.fail_n_site")
+        get_injector().arm(site, FaultSchedule.fail_n(3))
+        for _ in range(3):
+            with pytest.raises(FaultInjected):
+                fault_point(site)
+        fault_point(site)
+
+    def test_probability_is_seed_deterministic(self):
+        s1 = FaultSchedule.fail_with_probability(0.3, seed=42)
+        s2 = FaultSchedule.fail_with_probability(0.3, seed=42)
+        seq1 = [s1.should_fire() for _ in range(200)]
+        seq2 = [s2.should_fire() for _ in range(200)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)
+        # a different seed draws a different stream
+        s3 = FaultSchedule.fail_with_probability(0.3, seed=43)
+        assert [s3.should_fire() for _ in range(200)] != seq1
+
+    def test_delay_sleeps_instead_of_raising(self):
+        site = register_fault_site("test.delay_site")
+        base = counter(f"faults.delayed.{site}")
+        get_injector().arm(site, FaultSchedule.delay(0.02, n=1))
+        t0 = time.perf_counter()
+        fault_point(site)  # no raise
+        assert time.perf_counter() - t0 >= 0.015
+        fault_point(site)  # budget spent
+        assert counter(f"faults.delayed.{site}") == base + 1
+
+    def test_disarm_and_reset(self):
+        site = register_fault_site("test.disarm_site")
+        inj = get_injector()
+        inj.arm(site, FaultSchedule.fail_n(100))
+        inj.disarm(site)
+        fault_point(site)
+        inj.arm(site, FaultSchedule.fail_n(100))
+        inj.reset()
+        assert not inj.any_armed
+        fault_point(site)
+        assert site in inj.list_sites()  # registration survives reset
+
+    def test_production_seams_are_registered(self):
+        # importing the pipeline modules declares their seams
+        import openr_tpu.decision.spf_solver  # noqa: F401
+        import openr_tpu.kvstore.store  # noqa: F401
+        import openr_tpu.ops.route_engine  # noqa: F401
+        import openr_tpu.platform.netlink_fib_handler  # noqa: F401
+        import openr_tpu.platform.thrift_fib  # noqa: F401
+
+        sites = set(get_injector().list_sites())
+        assert {
+            "route_engine.dispatch",
+            "route_engine.consume",
+            "route_engine.cold_build",
+            "decision.spf_solve",
+            "fib.thrift_transport",
+            "kvstore.full_sync",
+            "kvstore.flood",
+            "platform.netlink_program",
+        } <= sites
+
+
+# ---------------------------------------------------------------------------
+# supervisor state machine (unit)
+# ---------------------------------------------------------------------------
+
+
+def _boom():
+    raise RuntimeError("rung down")
+
+
+class TestDegradationSupervisor:
+    def test_warm_success_stays_healthy(self):
+        sup = DegradationSupervisor("tsup_warm")
+        out = sup.run(
+            (("warm", lambda: "w"), ("cold", _boom), ("host", _boom))
+        )
+        assert out == "w"
+        assert sup.state is HealthState.HEALTHY
+        assert sup.walks == 1
+
+    def test_middle_rung_degrades_then_self_heals(self):
+        sup = DegradationSupervisor("tsup_mid", backoff_min_s=0.01)
+        base_heal = counter("tsup_mid.self_heals")
+        out = sup.run(
+            (("warm", _boom), ("cold", lambda: "c"), ("host", _boom))
+        )
+        assert out == "c"
+        assert sup.state is HealthState.DEGRADED
+        assert counter("tsup_mid.rung_failures.warm") >= 1
+        # DEGRADED closes the breaker: the very next walk re-probes warm
+        out = sup.run(
+            (("warm", lambda: "w"), ("cold", _boom), ("host", _boom))
+        )
+        assert out == "w"
+        assert sup.state is HealthState.HEALTHY
+        assert counter("tsup_mid.self_heals") == base_heal + 1
+
+    def test_last_rung_opens_breaker_and_holds(self):
+        sup = DegradationSupervisor(
+            "tsup_hold", backoff_min_s=5.0, backoff_max_s=10.0
+        )
+        calls = []
+
+        def rung(name, fail=False):
+            def fn():
+                calls.append(name)
+                if fail:
+                    raise RuntimeError(name)
+                return name
+
+            return fn
+
+        out = sup.run(
+            (
+                ("warm", rung("warm", fail=True)),
+                ("cold", rung("cold", fail=True)),
+                ("host", rung("host")),
+            )
+        )
+        assert out == "host"
+        assert sup.state is HealthState.FALLBACK
+        # breaker open: the next walk jumps straight to the held rung
+        calls.clear()
+        out = sup.run(
+            (
+                ("warm", rung("warm")),
+                ("cold", rung("cold")),
+                ("host", rung("host")),
+            )
+        )
+        assert out == "host"
+        assert calls == ["host"]
+        assert sup.state is HealthState.FALLBACK
+
+    def test_probe_after_backoff_self_heals(self):
+        sup = DegradationSupervisor("tsup_probe", backoff_min_s=0.01)
+        sup.run((("warm", _boom), ("host", lambda: "h")))
+        assert sup.state is HealthState.FALLBACK
+        base = counter("tsup_probe.probes")
+        time.sleep(0.05)
+        calls = []
+        out = sup.run(
+            (
+                ("warm", lambda: calls.append("warm") or "w"),
+                ("host", lambda: "h"),
+            )
+        )
+        assert out == "w"
+        assert calls == ["warm"]
+        assert sup.state is HealthState.HEALTHY
+        assert counter("tsup_probe.probes") == base + 1
+
+    def test_exhaustion_is_bounded_and_raises(self):
+        sup = DegradationSupervisor(
+            "tsup_exh", backoff_min_s=5.0, backoff_max_s=10.0
+        )
+        calls = []
+
+        def failing(name):
+            def fn():
+                calls.append(name)
+                raise RuntimeError(name)
+
+            return fn
+
+        with pytest.raises(LadderExhausted) as ei:
+            sup.run(
+                (
+                    ("warm", failing("warm")),
+                    ("cold", failing("cold")),
+                    ("host", failing("host")),
+                )
+            )
+        # every rung ran AT MOST once: the walk is bounded by design
+        assert calls == ["warm", "cold", "host"]
+        assert [r for r, _ in ei.value.failures] == ["warm", "cold", "host"]
+        assert sup.state is HealthState.FALLBACK
+        # breaker open after exhaustion: next walk starts at the held
+        # (last) rung, not back at warm
+        calls.clear()
+        out = sup.run(
+            (
+                ("warm", failing("warm")),
+                ("cold", failing("cold")),
+                ("host", lambda: "h"),
+            )
+        )
+        assert out == "h"
+        assert calls == []
+
+    def test_ladder_span_stamped_into_active_trace(self):
+        sup = DegradationSupervisor("tsup_trace", backoff_min_s=0.01)
+        tracer = get_tracer()
+        trace = tracer.start("test.origin")
+        tracer.activate(trace)
+        try:
+            out = sup.run(
+                (("warm", _boom), ("cold", lambda: "c"), ("host", _boom))
+            )
+        finally:
+            tracer.deactivate()
+        assert out == "c"
+        spans = [s for s in trace.spans if s.name == "tsup_trace.ladder"]
+        assert len(spans) == 1 and spans[0].closed
+        assert spans[0].attrs["rung"] == "cold"
+        assert spans[0].attrs["health"] == "DEGRADED"
+        assert spans[0].attrs["rungs_tried"] == 2
+        tracer.finish(trace, ok=True)
+
+    def test_health_gauge_exported(self):
+        sup = DegradationSupervisor("tsup_gauge")
+        assert counter("tsup_gauge.health") == 0.0
+        sup.run((("warm", _boom), ("host", lambda: None)))
+        assert counter("tsup_gauge.health") == float(HealthState.FALLBACK)
+
+
+# ---------------------------------------------------------------------------
+# route engine: per-rung parity
+# ---------------------------------------------------------------------------
+
+
+def _engine_topo():
+    return topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+
+
+def _engine_setup():
+    ls = load(_engine_topo())
+    engine = make_engine("ell", ls)
+    rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+    return ls, engine, rsw
+
+
+class TestEngineLadder:
+    def test_warm_dispatch_fault_falls_to_cold(self):
+        ls, engine, rsw = _engine_setup()
+        base = counter("route_engine.rung_failures.warm")
+        get_injector().arm(
+            "route_engine.dispatch", FaultSchedule.fail_once()
+        )
+        out = engine.churn(ls, mutate_metric(ls, rsw, 0, 7))
+        assert out is None  # cold rung's contract
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert counter("route_engine.rung_failures.warm") == base + 1
+        assert counter("route_engine.health") == float(HealthState.DEGRADED)
+        assert_bit_identical(engine, ls, "ell")
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_consume_fault_falls_to_cold(self):
+        ls, engine, rsw = _engine_setup()
+        get_injector().arm("route_engine.consume", FaultSchedule.fail_once())
+        out = engine.churn(ls, mutate_metric(ls, rsw, 0, 9))
+        assert out is None
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert_bit_identical(engine, ls, "ell")
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_cold_fault_falls_to_host(self):
+        ls, engine, rsw = _engine_setup()
+        base = counter("route_engine.host_fallbacks")
+        get_injector().arm(
+            "route_engine.dispatch", FaultSchedule.fail_once()
+        )
+        get_injector().arm(
+            "route_engine.cold_build", FaultSchedule.fail_once()
+        )
+        out = engine.churn(ls, mutate_metric(ls, rsw, 0, 11))
+        assert out is None
+        assert engine.supervisor.state is HealthState.FALLBACK
+        assert engine._device_valid is False
+        assert engine.host_fallbacks == 1
+        assert counter("route_engine.host_fallbacks") == base + 1
+        assert counter("route_engine.health") == float(HealthState.FALLBACK)
+        # the host NumPy product vs a from-scratch cold DEVICE build:
+        # the replica contract, bit for bit, masks included
+        assert_bit_identical(engine, ls, "ell")
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_breaker_holds_then_probe_self_heals(self):
+        ls, engine, rsw = _engine_setup()
+        # a wider breaker window than the default so the hold assertion
+        # is not racing the walk's own wall-clock cost
+        engine.supervisor = DegradationSupervisor(
+            "route_engine", backoff_min_s=0.3, backoff_max_s=1.0
+        )
+        get_injector().arm(
+            "route_engine.dispatch", FaultSchedule.fail_once()
+        )
+        get_injector().arm(
+            "route_engine.cold_build", FaultSchedule.fail_once()
+        )
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 7))
+        assert engine.supervisor.state is HealthState.FALLBACK
+        get_injector().reset()
+
+        # breaker open: the next churn goes straight to the host rung
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 3))
+        assert engine.supervisor.state is HealthState.FALLBACK
+        assert engine.host_fallbacks == 2
+
+        # backoff elapses -> probe walk: warm sees invalid device
+        # residents, the cold rung rebuilds them -> DEGRADED
+        time.sleep(0.7)
+        base_heal = counter("route_engine.self_heals")
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 11))
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert engine._device_valid is True
+
+        # next walk re-probes warm and self-heals to HEALTHY
+        out = engine.churn(ls, mutate_metric(ls, rsw, 0, 5))
+        assert out is not None
+        assert engine.supervisor.state is HealthState.HEALTHY
+        assert counter("route_engine.self_heals") == base_heal + 1
+        assert_bit_identical(engine, ls, "ell")
+        assert engine_digests(engine) == full_digests(ls)
+
+
+# ---------------------------------------------------------------------------
+# decision: per-rung parity (synchronous publication driving)
+# ---------------------------------------------------------------------------
+
+
+def _make_decision(backend="device"):
+    return Decision(
+        "a",
+        kvstore_updates_queue=ReplicateQueue(name="kv"),
+        route_updates_queue=ReplicateQueue(name="routes"),
+        solver_backend=backend,
+    )
+
+
+def _dec_topo():
+    return topologies.build_topology(
+        "grid", [("a", "b", 1), ("b", "c", 2), ("a", "c", 5), ("c", "d", 1)]
+    )
+
+
+def _publish_all(d, topo, versions):
+    kv = {}
+    for db in topo.adj_dbs.values():
+        k = keyutil.adj_key(db.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=db.this_node_name,
+            value=wire.dumps(db),
+        )
+    for pdb in topo.prefix_dbs.values():
+        k = keyutil.prefix_db_key(pdb.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=pdb.this_node_name,
+            value=wire.dumps(pdb),
+        )
+    d.process_publication(Publication(key_vals=kv, area=topo.area))
+
+
+def _publish_adj(d, db, versions):
+    k = keyutil.adj_key(db.this_node_name)
+    versions[k] = versions.get(k, 0) + 1
+    d.process_publication(
+        Publication(
+            key_vals={
+                k: Value(
+                    version=versions[k],
+                    originator_id=db.this_node_name,
+                    value=wire.dumps(db),
+                )
+            },
+            area=db.area,
+        )
+    )
+
+
+def _bump_metric(db, metric):
+    adjs = list(db.adjacencies)
+    adjs[0] = replace(adjs[0], metric=metric)
+    return replace(db, adjacencies=tuple(adjs))
+
+
+def _oracle_routes(topo, adj_dbs):
+    """A fault-free native-backend Decision over the final topology."""
+    o = _make_decision(backend="native")
+    _publish_all(o, replace(topo, adj_dbs=adj_dbs), {})
+    o.rebuild_routes("ORACLE")
+    return dict(o.route_db.unicast_routes)
+
+
+def _assert_routes_match_oracle(d, topo, adj_dbs):
+    oracle = _oracle_routes(topo, adj_dbs)
+    assert set(d.route_db.unicast_routes) == set(oracle)
+    for prefix, entry in d.route_db.unicast_routes.items():
+        assert entry == oracle[prefix], prefix
+
+
+class TestDecisionLadder:
+    def _healthy_decision(self):
+        topo = _dec_topo()
+        d = _make_decision()
+        versions = {}
+        _publish_all(d, topo, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.HEALTHY
+        return topo, d, versions
+
+    def test_warm_fault_falls_to_cold(self):
+        topo, d, versions = self._healthy_decision()
+        db2 = _bump_metric(topo.adj_dbs["b"], 7)
+        get_injector().arm("decision.spf_solve", FaultSchedule.fail_once())
+        _publish_adj(d, db2, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.DEGRADED
+        assert d.spf_solver.backend == "device"
+        assert counter("decision.health") == float(HealthState.DEGRADED)
+        mutated = dict(topo.adj_dbs)
+        mutated["b"] = db2
+        _assert_routes_match_oracle(d, topo, mutated)
+
+    def test_cold_fault_falls_to_host_backend(self):
+        topo, d, versions = self._healthy_decision()
+        db2 = _bump_metric(topo.adj_dbs["b"], 9)
+        # enough charges to kill the warm rung and the cold rung's
+        # device re-solves; the host rung flips off the device backend
+        # and stops crossing the seam
+        get_injector().arm("decision.spf_solve", FaultSchedule.fail_n(5))
+        _publish_adj(d, db2, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.FALLBACK
+        assert d.spf_solver.backend != "device"
+        assert counter("decision.health") == float(HealthState.FALLBACK)
+        mutated = dict(topo.adj_dbs)
+        mutated["b"] = db2
+        _assert_routes_match_oracle(d, topo, mutated)
+
+    def test_breaker_holds_then_probe_self_heals(self):
+        topo, d, versions = self._healthy_decision()
+        d.supervisor = DegradationSupervisor(
+            "decision", backoff_min_s=0.25, backoff_max_s=1.0
+        )
+        db2 = _bump_metric(topo.adj_dbs["b"], 9)
+        get_injector().arm("decision.spf_solve", FaultSchedule.fail_n(5))
+        _publish_adj(d, db2, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.FALLBACK
+        get_injector().reset()
+
+        # breaker open: the rebuild stays on the host rung
+        db3 = _bump_metric(topo.adj_dbs["b"], 11)
+        _publish_adj(d, db3, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.FALLBACK
+        assert d.spf_solver.backend != "device"
+
+        # backoff elapses -> probe walk runs the warm device rung again
+        time.sleep(0.8)
+        db4 = _bump_metric(topo.adj_dbs["b"], 13)
+        _publish_adj(d, db4, versions)
+        d.rebuild_routes("TEST")
+        assert d.supervisor.state is HealthState.HEALTHY
+        assert d.spf_solver.backend == "device"
+        mutated = dict(topo.adj_dbs)
+        mutated["b"] = db4
+        _assert_routes_match_oracle(d, topo, mutated)
+
+    def test_ladder_span_in_rebuild_trace(self):
+        topo, d, versions = self._healthy_decision()
+        tracer = get_tracer()
+        trace = tracer.start("kvstore.publish")
+        db2 = _bump_metric(topo.adj_dbs["b"], 7)
+        get_injector().arm("decision.spf_solve", FaultSchedule.fail_once())
+        _publish_adj(d, db2, versions)
+        # the evb queue handler adopts the publication's trace; driving
+        # synchronously, hand it to the pending batch the same way
+        d.pending.adopt_trace(trace)
+        d.rebuild_routes("TEST")
+        names = [s.name for s in trace.spans]
+        assert "decision.rebuild" in names
+        ladder = [s for s in trace.spans if s.name == "decision.ladder"]
+        assert len(ladder) == 1 and ladder[0].closed
+        assert ladder[0].attrs["rung"] == "cold"
+        assert ladder[0].attrs["health"] == "DEGRADED"
+        tracer.finish(trace, ok=True)
+
+
+# ---------------------------------------------------------------------------
+# fib thrift transport: bounded retry with backoff
+# ---------------------------------------------------------------------------
+
+
+def _route(prefix, nh="fe80::9", metric=2):
+    return UnicastRoute(
+        dest=IpPrefix.from_str(prefix),
+        next_hops=(
+            NextHop(
+                address=BinaryAddress.from_str(nh, if_name="eth9"),
+                metric=metric,
+                area="0",
+                neighbor_node_name="peer-1",
+            ),
+        ),
+    )
+
+
+@pytest.fixture
+def thrift_agent():
+    mock = MockNetlinkProtocolSocket()
+    handler = NetlinkFibHandler(mock)
+    server = FibThriftServer(handler, host="127.0.0.1")
+    server.start()
+    client = ThriftFibAgent(
+        "127.0.0.1",
+        server.port,
+        retry_min_s=0.01,
+        retry_max_s=0.05,
+        max_attempts=3,
+    )
+    yield handler, client
+    client.close()
+    server.stop()
+
+
+class TestThriftRetry:
+    def test_transient_fault_retried(self, thrift_agent):
+        _handler, client = thrift_agent
+        base_retries = counter("fib.program_retries")
+        base_failures = counter("fib.program_failures")
+        get_injector().arm("fib.thrift_transport", FaultSchedule.fail_once())
+        client.add_unicast_routes(786, [_route("fd00:1::/64")])
+        assert [
+            r.dest.to_str() for r in client.get_route_table_by_client(786)
+        ] == ["fd00:1::/64"]
+        assert counter("fib.program_retries") >= base_retries + 1
+        assert counter("fib.program_failures") == base_failures
+
+    def test_persistent_fault_bounded(self, thrift_agent):
+        _handler, client = thrift_agent
+        base = counter("fib.program_failures")
+        # one charge per attempt: all three attempts burn, then the
+        # call surfaces the last cause instead of looping forever
+        get_injector().arm("fib.thrift_transport", FaultSchedule.fail_n(3))
+        with pytest.raises(FaultInjected):
+            client.add_unicast_routes(786, [_route("fd00:2::/64")])
+        assert counter("fib.program_failures") == base + 1
+        # the schedule is spent: the next call goes straight through
+        client.add_unicast_routes(786, [_route("fd00:2::/64")])
+        assert [
+            r.dest.to_str() for r in client.get_route_table_by_client(786)
+        ] == ["fd00:2::/64"]
+
+
+class TestNetlinkProgramFault:
+    def test_fault_leaves_table_untouched(self):
+        handler = NetlinkFibHandler(MockNetlinkProtocolSocket())
+        get_injector().arm(
+            "platform.netlink_program", FaultSchedule.fail_once()
+        )
+        with pytest.raises(FaultInjected):
+            handler.add_unicast_routes(786, [_route("fd00:1::/64")])
+        assert handler.get_route_table_by_client(786) == []
+        handler.add_unicast_routes(786, [_route("fd00:1::/64")])
+        assert len(handler.get_route_table_by_client(786)) == 1
+
+
+class TestFibUnackedReprogram:
+    def test_agent_restart_reprograms_unacked(self):
+        agent = MockFibAgent()
+        route_q = ReplicateQueue(name="routes")
+        fib = Fib(
+            "node-a",
+            agent,
+            route_q,
+            keepalive_interval_s=0.05,
+            retry_min_s=0.02,
+            retry_max_s=0.2,
+        )
+        fib.start()
+        try:
+            update = DecisionRouteUpdate()
+            entry = RibUnicastEntry(
+                prefix=IpPrefix.from_str("fd00::/64"),
+                nexthops={
+                    NextHop(
+                        address=BinaryAddress.from_str(
+                            "fe80::1", if_name="if0"
+                        ),
+                        metric=1,
+                    )
+                },
+            )
+            update.unicast_routes_to_update[entry.prefix] = entry
+            route_q.push(update)
+            assert wait_until(
+                lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID))
+                == 1
+            )
+            agent.restart()
+            # keepalive sees the aliveSince move: every installed route
+            # is treated as unacknowledged and re-programmed
+            assert wait_until(
+                lambda: fib.get_counters().get("fib.agent_restarts", 0) >= 1
+            )
+            assert wait_until(
+                lambda: fib.get_counters().get(
+                    "fib.unacked_reprogrammed", 0
+                )
+                >= 1
+            )
+            assert wait_until(
+                lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID))
+                == 1
+            )
+        finally:
+            fib.stop()
+
+
+# ---------------------------------------------------------------------------
+# kvstore: sync / flood failure counters and recovery
+# ---------------------------------------------------------------------------
+
+
+class TestKvStoreFaults:
+    def test_full_sync_failure_counted_and_recovered(self):
+        from openr_tpu.kvstore.store import KvStorePeerState
+        from openr_tpu.kvstore.wrapper import (
+            KvStoreWrapper,
+            link_bidirectional,
+        )
+
+        a = KvStoreWrapper("node-a")
+        b = KvStoreWrapper("node-b")
+        a.start()
+        b.start()
+        try:
+            a.set_key("k:a1", b"v1")
+            base = counter("kvstore.full_sync_failures")
+            get_injector().arm(
+                "kvstore.full_sync", FaultSchedule.fail_once()
+            )
+            link_bidirectional(a, b)
+            assert wait_until(
+                lambda: counter("kvstore.full_sync_failures") >= base + 1
+            )
+            assert wait_until(
+                lambda: a.store.counters()["kvstore.full_sync_failures"]
+                + b.store.counters()["kvstore.full_sync_failures"]
+                >= 1
+            )
+            # backoff retry converges both peers anyway
+            assert wait_until(
+                lambda: all(
+                    s is KvStorePeerState.INITIALIZED
+                    for s in list(a.peer_states().values())
+                    + list(b.peer_states().values())
+                )
+            )
+            assert wait_until(lambda: b.get_key("k:a1") is not None)
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_flood_error_counted_and_recovered(self):
+        from openr_tpu.kvstore.store import KvStorePeerState
+        from openr_tpu.kvstore.wrapper import (
+            KvStoreWrapper,
+            link_bidirectional,
+        )
+
+        a = KvStoreWrapper("node-a")
+        b = KvStoreWrapper("node-b")
+        a.start()
+        b.start()
+        try:
+            link_bidirectional(a, b)
+            assert wait_until(
+                lambda: all(
+                    s is KvStorePeerState.INITIALIZED
+                    for s in list(a.peer_states().values())
+                    + list(b.peer_states().values())
+                )
+            )
+            base = counter("kvstore.flood_errors")
+            get_injector().arm("kvstore.flood", FaultSchedule.fail_once())
+            a.set_key("k:a2", b"v2")
+            assert wait_until(
+                lambda: counter("kvstore.flood_errors") >= base + 1
+            )
+            assert a.store.counters()["kvstore.flood_errors"] >= 1
+            # the failed peer drops to IDLE and re-syncs: the update
+            # still arrives
+            assert wait_until(lambda: b.get_key("k:a2") is not None)
+        finally:
+            a.stop()
+            b.stop()

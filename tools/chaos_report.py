@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Chaos gate (``make chaos-smoke``) and report artifact.
+
+Drives a seeded, replayable fault storm across the pipeline's
+injection seams — device dispatch, delta consume, cold rebuild,
+Decision SPF solve, the Fib thrift transport, netlink programming —
+through the REAL supervised paths, then fails loudly if the
+graceful-degradation contract regressed:
+
+- any supervisor did not self-heal back to HEALTHY after the faults
+  stopped,
+- the post-storm route product is not bit-identical to a fault-free
+  cold twin (or the Decision RouteDatabase to a native-backend
+  oracle),
+- a ladder walk was unbounded (more walks than churn events),
+- the coverage floor was missed (too few faults fired, or fewer than
+  five distinct seams crossed).
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_chaos_report.json``) with the per-site fault counts,
+ladder counters, and final health gauges so a CI run leaves evidence.
+``--smoke`` shrinks the event budget for the tier-1 gate; the full
+soak lives in tests/test_chaos_soak.py. Exit 0 on pass, 1 with a
+reason list on fail. Runs CPU-pinned — this gates robustness
+machinery, not kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+from dataclasses import replace
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/chaos_report.py) in addition
+# to module mode (python -m tools.chaos_report)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+LADDER_COUNTERS = (
+    "ladder_walks",
+    "probes",
+    "fallbacks",
+    "degradations",
+    "self_heals",
+    "ladder_exhausted",
+    "health_transitions",
+)
+
+
+def _injected(reg):
+    prefix = "faults.injected."
+    return {
+        k[len(prefix):]: v
+        for k, v in reg.snapshot().items()
+        if k.startswith(prefix)
+    }
+
+
+def _engine_leg(seed, events, failures):
+    import numpy as np
+
+    from openr_tpu.faults import (
+        DegradationSupervisor,
+        FaultSchedule,
+        HealthState,
+        get_injector,
+    )
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import route_engine, route_sweep
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = LinkState(area=topo.area)
+    for _, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    rsws = [n for n in engine.graph.node_names if n.startswith("rsw")][:4]
+
+    inj = get_injector()
+    inj.arm(
+        "route_engine.dispatch",
+        FaultSchedule.fail_with_probability(0.5, seed=seed + 1),
+    )
+    inj.arm(
+        "route_engine.consume",
+        FaultSchedule.fail_with_probability(0.4, seed=seed + 2),
+    )
+    inj.arm(
+        "route_engine.cold_build",
+        FaultSchedule.fail_with_probability(0.5, seed=seed + 3),
+    )
+
+    def mutate(node, metric):
+        db = ls.get_adjacency_databases()[node]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+        return {node, adjs[0].other_node_name}
+
+    rng = random.Random(seed + 4)
+    churns = 0
+    for _ in range(events):
+        engine.churn(ls, mutate(rng.choice(rsws), rng.randrange(1, 60)))
+        churns += 1
+        time.sleep(0.002)
+    for site in (
+        "route_engine.dispatch",
+        "route_engine.consume",
+        "route_engine.cold_build",
+    ):
+        inj.disarm(site)
+    for _ in range(12):
+        if engine.supervisor.state is HealthState.HEALTHY:
+            break
+        time.sleep(0.01)
+        engine.churn(ls, mutate(rng.choice(rsws), rng.randrange(1, 60)))
+        churns += 1
+
+    if engine.supervisor.state is not HealthState.HEALTHY:
+        failures.append(
+            f"route_engine did not self-heal: {engine.supervisor.state.name}"
+        )
+    if engine.supervisor.walks != churns:
+        failures.append(
+            f"route_engine walks {engine.supervisor.walks} != churn "
+            f"events {churns} (unbounded recovery loop?)"
+        )
+
+    # bit-identity vs a fault-free cold twin of the same engine class
+    twin = route_engine.RouteSweepEngine(ls, [names[0]])
+    a, b = engine.result, twin.result
+    for field in ("digests", "nh_totals", "sample_metrics", "sample_masks"):
+        if not np.array_equal(getattr(a, field), getattr(b, field)):
+            failures.append(f"route product diverged from cold twin: {field}")
+    host = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+    if route_sweep.digests_by_name(engine.result) != host:
+        failures.append("route digests diverged from host sweep oracle")
+    return churns
+
+
+def _decision_leg(seed, events, failures):
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.faults import (
+        DegradationSupervisor,
+        FaultSchedule,
+        HealthState,
+        get_injector,
+    )
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.models import topologies
+    from openr_tpu.types import Publication, Value
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    topo = topologies.build_topology(
+        "grid", [("a", "b", 1), ("b", "c", 2), ("a", "c", 5), ("c", "d", 1)]
+    )
+    versions = {}
+
+    def make_decision(backend="device"):
+        return Decision(
+            "a",
+            kvstore_updates_queue=ReplicateQueue(name="kv"),
+            route_updates_queue=ReplicateQueue(name="routes"),
+            solver_backend=backend,
+        )
+
+    def publish_all(d, t, vers):
+        kv = {}
+        for db in t.adj_dbs.values():
+            k = keyutil.adj_key(db.this_node_name)
+            vers[k] = vers.get(k, 0) + 1
+            kv[k] = Value(
+                version=vers[k],
+                originator_id=db.this_node_name,
+                value=wire.dumps(db),
+            )
+        for pdb in t.prefix_dbs.values():
+            k = keyutil.prefix_db_key(pdb.this_node_name)
+            vers[k] = vers.get(k, 0) + 1
+            kv[k] = Value(
+                version=vers[k],
+                originator_id=pdb.this_node_name,
+                value=wire.dumps(pdb),
+            )
+        d.process_publication(Publication(key_vals=kv, area=t.area))
+
+    def publish_adj(d, db, vers):
+        k = keyutil.adj_key(db.this_node_name)
+        vers[k] = vers.get(k, 0) + 1
+        d.process_publication(
+            Publication(
+                key_vals={
+                    k: Value(
+                        version=vers[k],
+                        originator_id=db.this_node_name,
+                        value=wire.dumps(db),
+                    )
+                },
+                area=db.area,
+            )
+        )
+
+    def bump(db, metric):
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        return replace(db, adjacencies=tuple(adjs))
+
+    d = make_decision()
+    publish_all(d, topo, versions)
+    d.rebuild_routes("CHAOS")
+    d.supervisor = DegradationSupervisor(
+        "decision", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    get_injector().arm(
+        "decision.spf_solve",
+        FaultSchedule.fail_with_probability(0.6, seed=seed + 5),
+    )
+    rng = random.Random(seed + 6)
+    mutated = dict(topo.adj_dbs)
+    rebuilds = 0
+    for _ in range(events):
+        node = rng.choice(("b", "c"))
+        mutated[node] = bump(mutated[node], rng.randrange(1, 40))
+        publish_adj(d, mutated[node], versions)
+        d.rebuild_routes("CHAOS")
+        rebuilds += 1
+        time.sleep(0.002)
+    get_injector().disarm("decision.spf_solve")
+    for _ in range(12):
+        if d.supervisor.state is HealthState.HEALTHY:
+            break
+        time.sleep(0.01)
+        node = rng.choice(("b", "c"))
+        mutated[node] = bump(mutated[node], rng.randrange(1, 40))
+        publish_adj(d, mutated[node], versions)
+        d.rebuild_routes("CHAOS")
+        rebuilds += 1
+
+    if d.supervisor.state is not HealthState.HEALTHY:
+        failures.append(
+            f"decision did not self-heal: {d.supervisor.state.name}"
+        )
+    if d.spf_solver.backend != "device":
+        failures.append(
+            f"decision stuck on fallback backend {d.spf_solver.backend}"
+        )
+
+    oracle = make_decision(backend="native")
+    publish_all(oracle, replace(topo, adj_dbs=mutated), {})
+    oracle.rebuild_routes("ORACLE")
+    if dict(d.route_db.unicast_routes) != dict(
+        oracle.route_db.unicast_routes
+    ):
+        failures.append("decision RouteDatabase diverged from native oracle")
+    return rebuilds
+
+
+def _platform_leg(seed, events, failures):
+    from openr_tpu.faults import FaultInjected, FaultSchedule, get_injector
+    from openr_tpu.platform.netlink import MockNetlinkProtocolSocket
+    from openr_tpu.platform.netlink_fib_handler import NetlinkFibHandler
+    from openr_tpu.platform.thrift_fib import FibThriftServer, ThriftFibAgent
+    from openr_tpu.types import BinaryAddress, IpPrefix, NextHop, UnicastRoute
+
+    def route(prefix):
+        return UnicastRoute(
+            dest=IpPrefix.from_str(prefix),
+            next_hops=(
+                NextHop(
+                    address=BinaryAddress.from_str("fe80::9", if_name="eth9"),
+                    metric=2,
+                    area="0",
+                    neighbor_node_name="peer-1",
+                ),
+            ),
+        )
+
+    handler = NetlinkFibHandler(MockNetlinkProtocolSocket())
+    server = FibThriftServer(handler, host="127.0.0.1")
+    server.start()
+    client = ThriftFibAgent(
+        "127.0.0.1",
+        server.port,
+        retry_min_s=0.002,
+        retry_max_s=0.01,
+        max_attempts=4,
+    )
+    calls = 0
+    try:
+        get_injector().arm(
+            "fib.thrift_transport",
+            FaultSchedule.fail_with_probability(0.5, seed=seed + 7),
+        )
+        get_injector().arm(
+            "platform.netlink_program",
+            FaultSchedule.fail_with_probability(0.3, seed=seed + 8),
+        )
+        rng = random.Random(seed + 9)
+        for i in range(events):
+            calls += 1
+            try:
+                if rng.random() < 0.7:
+                    client.add_unicast_routes(
+                        786, [route(f"fd00:{i % 16:x}::/64")]
+                    )
+                else:
+                    client.delete_unicast_routes(
+                        786, [route(f"fd00:{i % 16:x}::/64").dest]
+                    )
+            except (FaultInjected, RuntimeError):
+                # bounded retry exhausted: surfaced, not looping. A
+                # client-side transport fault raises FaultInjected; a
+                # netlink fault on the server side comes back as a
+                # peer-exception RuntimeError through the thrift wire.
+                pass
+        get_injector().disarm("fib.thrift_transport")
+        get_injector().disarm("platform.netlink_program")
+        desired = [route("fd00:aa::/64"), route("fd00:bb::/64")]
+        client.sync_fib(786, desired)
+        got = [r.dest for r in client.get_route_table_by_client(786)]
+        if got != sorted(r.dest for r in desired):
+            failures.append("fib table did not reconcile after the storm")
+    finally:
+        client.close()
+        server.stop()
+    return calls
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=20260805)
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="small event budget for the tier-1 gate",
+    )
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_chaos_report.json"
+    )
+    args = parser.parse_args(argv)
+
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.faults import get_injector
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    get_injector().reset()
+    base = _injected(reg)
+
+    budgets = (
+        {"engine": 60, "decision": 20, "platform": 20, "floor": 50}
+        if args.smoke
+        else {"engine": 160, "decision": 40, "platform": 40, "floor": 200}
+    )
+
+    failures: list = []
+    t0 = time.perf_counter()
+    events = 0
+    events += _engine_leg(args.seed, budgets["engine"], failures)
+    events += _decision_leg(args.seed, budgets["decision"], failures)
+    events += _platform_leg(args.seed, budgets["platform"], failures)
+    elapsed = time.perf_counter() - t0
+
+    injected = {
+        site: count - base.get(site, 0)
+        for site, count in _injected(reg).items()
+    }
+    injected = {s: c for s, c in injected.items() if c > 0}
+    if sum(injected.values()) < budgets["floor"]:
+        failures.append(
+            f"coverage floor missed: {sum(injected.values())} faults "
+            f"< {budgets['floor']}"
+        )
+    if len(injected) < 5:
+        failures.append(
+            f"only {len(injected)} seams crossed: {sorted(injected)}"
+        )
+
+    snap = reg.snapshot()
+    report = {
+        "seed": args.seed,
+        "smoke": args.smoke,
+        "events": events,
+        "elapsed_s": round(elapsed, 3),
+        "faults_injected": dict(sorted(injected.items())),
+        "faults_total": sum(injected.values()),
+        "sites_registered": sorted(get_injector().list_sites()),
+        "health": {
+            name: snap.get(f"{name}.health")
+            for name in ("route_engine", "decision")
+        },
+        "ladder": {
+            name: {
+                c: snap.get(f"{name}.{c}", 0) for c in LADDER_COUNTERS
+            }
+            for name in ("route_engine", "decision")
+        },
+        "failures": failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if failures:
+        print(f"CHAOS GATE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print(f"CHAOS GATE: PASS (report: {args.out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
