@@ -49,9 +49,12 @@ tier1: native lint-analysis chaos-smoke
 # fast guard for the incremental churn path: fails if the device
 # pipeline regresses to zero incremental syncs / warm solves, or if
 # metric churn starts reading the full packed product back per event
-# (delta-compacted readback contract, tests/test_route_engine_delta.py)
+# (delta-compacted readback contract, tests/test_route_engine_delta.py).
+# The link-churn leg (tests/test_frontier_parity.py) adds the frontier
+# regression guard: a localized structural event silently taking the
+# full-width path while its frontier is below threshold fails here
 churn-smoke: native
-	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py tests/test_route_engine_delta.py -q -m "not slow"
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py tests/test_route_engine_delta.py tests/test_frontier_parity.py -q -m "not slow"
 
 # observability gate: small churn scenario through the real pipeline;
 # fails if any registered histogram is empty, any trace span is left

@@ -36,6 +36,10 @@ when degraded) is emitted no matter what the relay does.
 Secondary legs folded into the same artifact:
 - "bench_10k_churn": the 10k-node resident-ELL churn reconvergence
   (BASELINE.json config 4 axis), via benchmarks.bench_scale.churn_bench.
+- "bench_link_churn": paired metric-vs-link churn at 10k through the
+  resident route engine — link (structural) events overflow the bucket
+  ladder and ride the frontier re-solve; reports the link-vs-metric
+  median ratio (target: within ~2x) and the frontier-vs-full split.
 - "minplus_ms": pallas-vs-jnp min-plus timing at the bench shape on real
   TPU; the main loop runs whichever measured faster (the losing number
   is kept in the artifact).
@@ -316,6 +320,24 @@ def _run() -> dict:
             except Exception as e:
                 bench_10k = {"error": f"{type(e).__name__}: {e}"}
 
+    # link-churn leg: structural (link up/down) events at 10k through
+    # the frontier re-solve path, paired with a metric-churn control
+    # run on the same topology — the PR 6 perf target is the link
+    # median landing within ~2x of the metric median
+    bench_link = None
+    if os.environ.get("OPENR_BENCH_10K") == "1":
+        if leg_elapsed() > 330:
+            bench_link = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import link_churn_bench
+
+                bench_link = link_churn_bench(10000, 8)
+            except Exception as e:
+                bench_link = {"error": f"{type(e).__name__}: {e}"}
+
     # third leg: fabric-1008 KSP2 churn through the full SpfSolver —
     # the incremental KSP2 engine (BASELINE.json config 2)
     bench_ksp2 = None
@@ -499,6 +521,7 @@ def _run() -> dict:
         "minplus_impl": spf_ops.get_minplus_impl(),
         "minplus_ms": minplus_ms,
         "bench_10k_churn": bench_10k,
+        "bench_link_churn": bench_link,
         "bench_ksp2_churn": bench_ksp2,
         "bench_route_sweep": bench_routes,
         "bench_route_engine_churn": bench_rchurn,

@@ -992,11 +992,23 @@ def route_engine_churn_bench(
     # host readback stall
     samples = []
     records = []  # PendingDelta | (moved, bytes, rows, overlap_ms)
+    # per-event frontier probe stats (only events that hit the
+    # overflow policy contribute; engine.last_* is per-probe state)
+    frontier_rows, frontier_cells, frontier_jumps = [], [], []
     for step in range(churn_events):
         affected = churn(step)
+        probe0 = engine.frontier_resolves + engine.frontier_fallbacks
         t0 = time.perf_counter()
         out = engine.churn(ls, affected, defer_consume=True)
         samples.append((time.perf_counter() - t0) * 1000)
+        if (
+            engine.frontier_resolves + engine.frontier_fallbacks
+            > probe0
+            and engine.last_frontier_rows >= 0
+        ):
+            frontier_rows.append(engine.last_frontier_rows)
+            frontier_cells.append(engine.last_frontier_cells)
+            frontier_jumps.append(engine.last_frontier_jumps)
         if isinstance(out, route_engine.PendingDelta):
             records.append(out)
         elif out is not None and out != []:
@@ -1062,6 +1074,25 @@ def route_engine_churn_bench(
         ),
         "incremental_events": engine.incremental_events,
         "full_refreshes": engine.full_refreshes,
+        # structural-churn / frontier re-solve accounting: how many
+        # events were link-level (weight to/from INF), how many of the
+        # overflow events rode the frontier path vs fell back to the
+        # full-width refresh, and how big the cones were
+        "structural_events": engine.structural_events,
+        "frontier_resolves": engine.frontier_resolves,
+        "frontier_fallbacks": engine.frontier_fallbacks,
+        "frontier_rows_median": (
+            int(statistics.median(frontier_rows))
+            if frontier_rows else None
+        ),
+        "frontier_cells_median": (
+            round(statistics.median(frontier_cells), 1)
+            if frontier_cells else None
+        ),
+        "frontier_jumps_median": (
+            int(statistics.median(frontier_jumps))
+            if frontier_jumps else None
+        ),
         # delta-compacted readback accounting: bytes per event scale
         # with CHANGED rows, not the [n_pad, W] product width
         "readback_bytes_median": int(statistics.median(rb_bytes)),
@@ -1075,6 +1106,45 @@ def route_engine_churn_bench(
         "platform": jax.devices()[0].platform,
         "oracle_spot_check": "passed",
     }
+
+
+def link_churn_bench(
+    nodes: int, churn_events: int = 10,
+    sharded: bool = False, backend: str = "ell",
+) -> dict:
+    """Paired structural-vs-metric churn legs through the resident
+    route engine: the SAME topology and event count, once as metric
+    wiggles (the bucketed baseline) and once as alternating link
+    remove/restore (overflow events that ride the frontier re-solve).
+    Reports the link-vs-metric median ratio — the PR 6 target is the
+    link leg landing within ~2x of the metric leg — plus the
+    frontier-vs-full split and cone-size medians for the link leg."""
+    metric = route_engine_churn_bench(
+        nodes, churn_events, churn_kind="metric",
+        sharded=sharded, backend=backend,
+    )
+    link = route_engine_churn_bench(
+        nodes, churn_events, churn_kind="link",
+        sharded=sharded, backend=backend,
+    )
+    out = dict(link)
+    out["bench"] = link["bench"].replace(
+        "route_engine_churn", "link_churn"
+    )
+    out["metric_churn_median_ms"] = metric["median_ms"]
+    out["metric_churn_p90_ms"] = metric["p90_ms"]
+    out["link_vs_metric_ratio"] = round(
+        link["median_ms"] / max(metric["median_ms"], 1e-9), 3
+    )
+    overflowed = link["frontier_resolves"] + link["full_refreshes"]
+    out["frontier_fraction"] = (
+        round(link["frontier_resolves"] / overflowed, 3)
+        if overflowed else None
+    )
+    out["meets_2x_target"] = bool(
+        link["median_ms"] <= 2.0 * metric["median_ms"]
+    )
+    return out
 
 
 def main(argv=None):
@@ -1097,6 +1167,10 @@ def main(argv=None):
                    help="routes-churn event type: metric wiggle, or "
                         "alternating link remove/restore (topology "
                         "churn on the incremental path)")
+    p.add_argument("--link-churn", action="store_true",
+                   help="paired metric+link churn legs through the "
+                        "resident route engine: link-vs-metric median "
+                        "ratio, frontier-vs-full split, cone medians")
     p.add_argument("--sharded", action="store_true",
                    help="routes-churn: shard the resident engine over "
                         "all visible devices (the past-12k design; on "
@@ -1148,6 +1222,18 @@ def main(argv=None):
                     args.nodes, args.churn_events,
                     ksp2_dst_count=args.ksp2_dsts,
                     sp_only=args.sp_only,
+                )
+            ),
+            flush=True,
+        )
+        return
+    if args.link_churn:
+        print(
+            json.dumps(
+                link_churn_bench(
+                    args.nodes, args.churn_events,
+                    sharded=args.sharded,
+                    backend=args.backend,
                 )
             ),
             flush=True,

@@ -102,6 +102,7 @@ from openr_tpu.telemetry import get_registry, get_tracer
 FAULT_DISPATCH = register_fault_site("route_engine.dispatch")
 FAULT_CONSUME = register_fault_site("route_engine.consume")
 FAULT_COLD_BUILD = register_fault_site("route_engine.cold_build")
+FAULT_FRONTIER = register_fault_site("route_engine.frontier_resolve")
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
@@ -116,6 +117,17 @@ ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # path — first measured on-chip at 10k, where bucket overflow used to
 # cold-rebuild 10/10 link events)
 _ROW_BUCKETS = (32, 128, 512, 1024)
+# frontier cone-expansion jump cap (static per compiled shape): each
+# jump costs one relax-shaped pass, so past this the cone is deeper
+# than re-deriving it is worth — the bucketed seed degrades to the
+# whole-row reset and the overflow path to the full-width refresh,
+# both still bit-identical (the cap only ever coarsens the reset)
+_FRONTIER_MAX_JUMPS = 16
+# fraction of rows past which a converged frontier still falls back to
+# the full-width refresh (constructor-overridable): with most rows in
+# the cone the warm seed saves nothing over the cold-shaped dispatch
+# and the probe already paid its cost
+_DEFAULT_FRONTIER_THRESHOLD = 0.5
 
 
 def _pack_product(dr, nh_count, d_s, packed_mask, pos_w):
@@ -304,11 +316,25 @@ def _churn_step(
     count, local_ids, ids = _detect_rows(
         dr, e_u, e_v, e_w_old, e_w_new, k, 0
     )
-    # warm seed for the re-solve: pre-patch rows outside the
-    # increase-affected cone (XLA CSEs the shared dr gathers with
-    # _detect_rows); increase-affected rows restart from INF + anchor
+    # warm seed for the re-solve: pre-patch rows with the
+    # increase-affected CONE reset cell-granular (rs._cone_expand, the
+    # frontier kernel over the PRE-patch bands — XLA CSEs the shared
+    # dr gathers with _detect_rows). If the expansion hit the jump cap
+    # the cone is an under-approximation and the seed degrades to the
+    # pre-frontier whole-row reset; either way the re-solve stays
+    # bit-identical by the unique-fixed-point squeeze, the cone just
+    # leaves already-final cells converged from iteration zero.
+    sel = dr[local_ids]
+    cone, _rows, _cells, _jumps, cone_ok = rs._cone_expand(
+        sel, bands, v_t, w_t, e_u, e_v, e_w_old, e_w_new,
+        _FRONTIER_MAX_JUMPS,
+    )
     inc_row = _increase_rows(dr, e_u, e_v, e_w_old, e_w_new)
-    warm0 = jnp.where(inc_row[local_ids][:, None], INF, dr[local_ids])
+    warm0 = jnp.where(
+        cone_ok,
+        jnp.where(cone, INF, sel),
+        jnp.where(inc_row[local_ids][:, None], INF, sel),
+    )
     # scatter patched band rows (same bucketed shape discipline as
     # EllState.reconverge)
     new_v = tuple(
@@ -330,6 +356,68 @@ def _churn_step(
         dr, digests, packed_res, samp_ids, samp_v, samp_w, pos_w, n, k,
     )
     return new_v, new_w, dr, digests, packed_res, out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bands", "n", "max_jumps")
+)
+def _frontier_probe(
+    v_t, w_t, dr, e_u, e_v, e_w_old, e_w_new, cell_limit, bands, n,
+    max_jumps,
+):
+    """Frontier probe dispatch: expand the increase-affected cone over
+    the full resident DR and the PRE-patch bands (rs._cone_expand) and
+    return it ON DEVICE plus a 4-int meta [frontier_rows,
+    frontier_cells, jumps, converged]. The host reads only the meta to
+    make the frontier-vs-full-refresh policy call; the cone itself
+    stays resident as the follow-up _frontier_step's seed mask.
+    ``cell_limit`` is a device scalar (shape [1]) so threshold changes
+    never recompile; the expansion early-exits once the cone overflows
+    it (the fallback is already decided, no point finishing the
+    closure)."""
+    cone, rows, cells, jumps, ok = rs._cone_expand(
+        dr, bands, v_t, w_t, e_u, e_v, e_w_old, e_w_new, max_jumps,
+        cell_limit=cell_limit[0],
+    )
+    # float32 meta: the cell count already is (int32 overflows at
+    # 100k-node cone sizes), the rest are small ints cast losslessly
+    meta = jnp.stack(
+        [rows.astype(jnp.float32), cells,
+         jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+    )
+    return cone, meta
+
+
+@functools.partial(jax.jit, static_argnames=("bands", "n"))
+def _frontier_step(
+    v_t, w_t, cone, dr, overloaded, samp_ids, samp_v, samp_w, pos_w,
+    bands, n,
+):
+    """The frontier re-solve dispatch: full-width WARM fixed point over
+    the PATCHED bands, seeded from the resident DR with only the cone
+    cells reset to INF (+ the unit anchor inside _rev_fixed_point) —
+    the masked min-plus relaxation then converges in ~cone-radius
+    iterations instead of graph diameter, because every cell outside
+    the cone is already at its fixed point (structural increases) or a
+    sound upper bound (decreases / link up). Same extraction + packing
+    as the cold-shaped _full_resident_sweep, so the product is
+    bit-identical and the delta-compacted readback epilogue
+    (_compact_changed) applies unchanged. The residents are NOT
+    donated: a frontier failure falls back to _full_refresh against
+    the same untouched arrays (the retry-ladder hazard rule)."""
+    t_ids = jnp.arange(n, dtype=jnp.int32)
+    warm0 = jnp.where(cone, INF, dr)
+    dr2 = rs._rev_fixed_point(
+        bands, v_t, w_t, overloaded, t_ids, n, init=warm0
+    )
+    nh_count = rs._nh_counts(dr2, bands, v_t, w_t, overloaded, t_ids)
+    d_s, packed_mask = rs._sample_stats(
+        dr2, samp_ids, samp_v, samp_w, overloaded, t_ids
+    )
+    digests, packed = _pack_product(
+        dr2, nh_count, d_s, packed_mask, pos_w
+    )
+    return dr2, digests, packed
 
 
 # -- mesh-sharded dispatches ----------------------------------------------
@@ -487,6 +575,99 @@ def _sharded_churn_step(
     )
 
 
+@functools.partial(
+    jax.jit, static_argnames=("bands", "n", "max_jumps", "mesh")
+)
+def _sharded_frontier_probe(
+    v_t, w_t, dr, e_u, e_v, e_w_old, e_w_new, cell_limit, bands, n,
+    max_jumps, mesh,
+):
+    """Sharded frontier probe: each shard expands the cone over its own
+    resident DR rows (rows never interact), with the growth bit and the
+    frontier row/cell counts psum-voted so every shard runs the same
+    number of jumps. The meta row is device-invariant by construction
+    (voted counts + shared iteration counter) and comes back
+    replicated; the cone stays row-sharded for _sharded_frontier_step."""
+    nb = len(v_t)
+
+    def shard_fn(dr_s, *rest):
+        v_r = rest[:nb]
+        w_r = rest[nb : 2 * nb]
+        e_u_r, e_v_r, e_wo_r, e_wn_r, lim_r = rest[2 * nb :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        cone, rows, cells, jumps, ok = rs._cone_expand(
+            dr_s, bands, v_r, w_r, e_u_r, e_v_r, e_wo_r, e_wn_r,
+            max_jumps, vote=vote, cell_limit=lim_r[0],
+        )
+        meta = jnp.stack(
+            [rows.astype(jnp.float32), cells,
+             jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+        )
+        return cone, meta
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS, None)]
+            + [P(None, None)] * (2 * nb)
+            + [P(None)] * 5
+        ),
+        out_specs=(P(SOURCES_AXIS, None), P(None)),
+    )(dr, *v_t, *w_t, e_u, e_v, e_w_old, e_w_new, cell_limit)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bands", "n", "mesh")
+)
+def _sharded_frontier_step(
+    v_t, w_t, cone, dr, overloaded, samp_ids, samp_v, samp_w, pos_w,
+    bands, n, mesh,
+):
+    """Sharded frontier re-solve: the full-width warm dispatch over the
+    PATCHED (replicated) bands with each shard seeding its own DR rows
+    outside its cone shard — the convergence vote is the only
+    collective, exactly like the sharded cold build it replaces."""
+    nb = len(v_t)
+
+    def shard_fn(t_blk, cone_s, dr_s, *rest):
+        v_r = rest[:nb]
+        w_r = rest[nb : 2 * nb]
+        ov_r, sid_r, sv_r, sw_r, pw_r = rest[2 * nb :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        warm0 = jnp.where(cone_s, INF, dr_s)
+        dr2 = rs._rev_fixed_point(
+            bands, v_r, w_r, ov_r, t_blk, n, vote=vote, init=warm0
+        )
+        nh_count = rs._nh_counts(dr2, bands, v_r, w_r, ov_r, t_blk)
+        d_s, packed_mask = rs._sample_stats(
+            dr2, sid_r, sv_r, sw_r, ov_r, t_blk
+        )
+        digests, packed = _pack_product(
+            dr2, nh_count, d_s, packed_mask, pw_r
+        )
+        return dr2, digests, packed
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS), P(SOURCES_AXIS, None),
+             P(SOURCES_AXIS, None)]
+            + [P(None, None)] * (2 * nb)
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+        ),
+    )(
+        jnp.arange(n, dtype=jnp.int32), cone, dr, *v_t, *w_t,
+        overloaded, samp_ids, samp_v, samp_w, pos_w,
+    )
+
+
 class _DeviceStateInvalid(RuntimeError):
     """The resident device state is stale (a host fallback bypassed
     it): the warm rung refuses to run and the ladder walks to the cold
@@ -552,7 +733,8 @@ class RouteSweepEngine:
     one fused dispatch + one readback."""
 
     def __init__(self, ls, sample_names: Sequence[str],
-                 align: int = 128, mesh: Optional[Mesh] = None):
+                 align: int = 128, mesh: Optional[Mesh] = None,
+                 frontier_threshold: float = _DEFAULT_FRONTIER_THRESHOLD):
         self.sample_names = tuple(sample_names)
         self.mesh = mesh
         if mesh is not None:
@@ -564,6 +746,13 @@ class RouteSweepEngine:
         self.last_delta_rows = 0
         self.last_readback_bytes = 0
         self.last_overlap_ms = 0.0
+        # overflow policy knob: a converged frontier covering more than
+        # this fraction of the [n, n] route product still rides the
+        # full-width refresh
+        self.frontier_threshold = float(frontier_threshold)
+        self.last_frontier_rows = -1
+        self.last_frontier_jumps = -1
+        self.last_frontier_cells = -1.0
         # False between a failed/bypassed device path and the next
         # successful cold build: gates the warm rung off stale residents
         self._device_valid = False
@@ -662,6 +851,11 @@ class RouteSweepEngine:
         )
         self.full_refreshes = getattr(self, "full_refreshes", 0)
         self.coalesced_events = getattr(self, "coalesced_events", 0)
+        self.structural_events = getattr(self, "structural_events", 0)
+        self.frontier_resolves = getattr(self, "frontier_resolves", 0)
+        self.frontier_fallbacks = getattr(
+            self, "frontier_fallbacks", 0
+        )
         get_registry().counter_bump("route_engine.cold_builds")
 
     def _refresh_sample_bands(self, patched, affected_nodes) -> bool:
@@ -837,6 +1031,22 @@ class RouteSweepEngine:
         copy+diff, no RouteSweepResult re-assembly."""
         self._apply_patch_resident(ctx, ov_new)
         dr, digests, packed = self._full_resident(self.graph)
+        # counted apart from incremental_events: the four event
+        # classes (bucketed incremental / frontier re-solve /
+        # full-width refresh / cold rebuild) stay disjoint in
+        # artifacts
+        self.full_refreshes += 1
+        get_registry().counter_bump("route_engine.full_refreshes")
+        return self._commit_full_width(
+            ls, dr, digests, packed, new_out, ov_flips
+        )
+
+    def _commit_full_width(self, ls, dr, digests, packed, new_out,
+                           ov_flips):
+        """Shared commit tail of the full-width refresh and the
+        frontier re-solve: both produce a complete (dr, digests,
+        packed) product in one wide dispatch, compact the diff on
+        device, and apply only the changed rows on host."""
         ch_count, comp = _compact_changed(
             packed, self._packed_dev, self.graph.n
         )
@@ -846,11 +1056,6 @@ class RouteSweepEngine:
         self._commit_host_mirrors(ls, new_out, ov_flips)
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
-        # counted apart from incremental_events: the three event
-        # classes (bucketed incremental / full-width refresh / cold
-        # rebuild) stay disjoint in artifacts
-        self.full_refreshes += 1
-        get_registry().counter_bump("route_engine.full_refreshes")
         # remember that events are running wide: start the next probe
         # at the top bucket (one dispatch) instead of re-climbing the
         # ladder; small events decay the hint back down as usual
@@ -867,6 +1072,142 @@ class RouteSweepEngine:
         reg.observe("ops.delta_rows", float(m))
         reg.observe("ops.readback_bytes", float(bytes_read))
         return sorted(names)
+
+    @solve_window
+    def _dispatch_frontier_probe(self, ctx, e_dev, limit):
+        """Backend hook: dispatch the affected-cone probe
+        (rs._cone_expand) against the PRE-patch resident tensors.
+        Returns ``(cone, meta)`` — both in-flight device arrays, meta
+        being the float32 row ``[rows, cells, jumps, converged]`` —
+        or None when the backend has no frontier kernel (the caller
+        then rides the full-width refresh).
+
+        Ordering contract: this MUST run before _apply_patch_resident
+        commits the event's band patch — the cone is the
+        tight-closure under the OLD weights, so the resident
+        v_t/w_t/_dr it reads have to be the pre-event ones (they are:
+        bucketed dispatches are functional and nothing commits until
+        _commit_device)."""
+        e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        lim = jnp.asarray([limit], dtype=jnp.float32)
+        if self.mesh is None:
+            return _frontier_probe(
+                self.sweeper.v_t, self.sweeper.w_t, self._dr,
+                e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+                self.graph.bands, self.graph.n_pad,
+                _FRONTIER_MAX_JUMPS,
+            )
+        return _sharded_frontier_probe(
+            self.sweeper.v_t, self.sweeper.w_t, self._dr,
+            e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+            self.graph.bands, self.graph.n_pad,
+            _FRONTIER_MAX_JUMPS, self.mesh,
+        )
+
+    @solve_window
+    def _frontier_resident(self, cone):
+        """Backend hook: the masked full-width dispatch — every row
+        launches, but only cone cells re-relax from INF; all other
+        cells keep their resident distances, which stay valid upper
+        bounds (every cell whose old tight path crossed an increased
+        edge is in the cone), so the fixed point converges in
+        O(cone diameter) sweeps instead of O(graph diameter). Expects
+        the band patch ALREADY adopted (_apply_patch_resident ran)."""
+        if self.mesh is None:
+            return _frontier_step(
+                self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+                self.sweeper.overloaded,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                self.graph.bands, self.graph.n_pad,
+            )
+        return _sharded_frontier_step(
+            self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+            self.sweeper.overloaded,
+            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+            self.graph.bands, self.graph.n_pad, self.mesh,
+        )
+
+    def _overflow_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
+                          e_dev):
+        """Overflow policy: the affected-row count exceeded every
+        solve bucket. Probe the affected cone on device first; when
+        the cone converged under the row budget
+        (frontier_threshold * n), re-solve ONLY cone cells in one
+        masked full-width dispatch (_frontier_refresh) — otherwise
+        ride the existing _full_refresh. Either way the readback stays
+        delta-compacted (O(changed)).
+
+        A probe failure degrades WITHIN the warm rung: the full-width
+        refresh is this path's own fallback, so the supervisor ladder
+        (warm -> cold -> host) never sees a frontier error."""
+        reg = get_registry()
+        tracer = get_tracer()
+        span = tracer.span_active("ops.frontier_resolve")
+        rows = jumps = -1
+        path = "full_width"
+        try:
+            # budget in CELLS (re-solve work), not rows-with-any-cell:
+            # a single link down seeds one cell in nearly every
+            # destination row, so a row count saturates at n while the
+            # actual cone stays a sliver of the [n, n] product
+            limit = self.frontier_threshold * float(self.graph.n) ** 2
+            probe = None
+            try:
+                fault_point(FAULT_FRONTIER)
+                probe = self._dispatch_frontier_probe(
+                    ctx, e_dev, limit
+                )
+            except Exception:
+                # degrade, don't propagate: full-width gives the same
+                # bit-identical answer, just slower (counted so a
+                # frontier-fallback storm is visible in telemetry)
+                reg.counter_bump("route_engine.frontier_errors")
+            if probe is not None:
+                cone, meta = probe
+                meta = np.asarray(meta)  # 16-byte policy readback
+                rows, jumps = int(meta[0]), int(meta[2])
+                cells = float(meta[1])
+                converged = bool(meta[3])
+                self.last_frontier_rows = rows
+                self.last_frontier_jumps = jumps
+                self.last_frontier_cells = cells
+                reg.observe("ops.frontier_rows", float(rows))
+                reg.observe("ops.frontier_cells", cells)
+                reg.observe("ops.frontier_jumps", float(jumps))
+                if converged and cells <= limit:
+                    path = "frontier"
+                    return self._frontier_refresh(
+                        ls, ctx, ov_new, new_out, ov_flips, cone
+                    )
+            self.frontier_fallbacks += 1
+            reg.counter_bump("ops.frontier_fallbacks")
+            return self._full_refresh(
+                ls, ctx, ov_new, new_out, ov_flips
+            )
+        finally:
+            tracer.end_span_active(
+                span, path=path, frontier_rows=rows,
+                frontier_jumps=jumps,
+            )
+
+    def _frontier_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
+                          cone):
+        """Frontier path: adopt the band patch resident, then one
+        masked dispatch seeds cone cells at INF while every other cell
+        keeps its resident distance. Bit-identical to the cold solve
+        by the unique-fixed-point argument (int32 min-plus over the
+        patched weights has one fixed point, and any seed S with
+        d* <= S converges to it); commits through the same
+        delta-compacted tail as _full_refresh."""
+        self._apply_patch_resident(ctx, ov_new)
+        dr, digests, packed = self._frontier_resident(cone)
+        self.frontier_resolves += 1
+        get_registry().counter_bump("route_engine.frontier_resolves")
+        return self._commit_full_width(
+            ls, dr, digests, packed, new_out, ov_flips
+        )
 
     def flush(self):
         """Consume the in-flight delta, if any (host-side apply of the
@@ -1087,6 +1428,19 @@ class RouteSweepEngine:
             if not defer_consume:
                 self.flush()
             return []
+        # event classification: STRUCTURAL events (link up/down,
+        # drain flips) have an INF endpoint in some transition;
+        # metric churn never does. Counted apart so the frontier
+        # policy's coverage is auditable (a structural event that
+        # rides full-width below threshold is a regression — see
+        # tests/test_frontier_parity.py).
+        if any(
+            wo >= INF or wn >= INF for (wo, wn) in changed.values()
+        ):
+            self.structural_events += 1
+            get_registry().counter_bump(
+                "route_engine.structural_events"
+            )
 
         e_u = np.asarray([u for (u, _v) in changed], dtype=np.int32)
         e_v = np.asarray([v for (_u, v) in changed], dtype=np.int32)
@@ -1142,10 +1496,11 @@ class RouteSweepEngine:
             if max(counts) <= k:
                 break
         if max(counts) > k:
-            # beyond every bucket: keep the patched layout, re-solve
-            # all rows in one full-width dispatch (no host recompile)
-            return self._full_refresh(
-                ls, ctx, ov_new, new_out, ov_flips
+            # beyond every bucket: keep the patched layout and let the
+            # overflow policy pick frontier re-solve vs full-width
+            # refresh (no host recompile on either path)
+            return self._overflow_refresh(
+                ls, ctx, ov_new, new_out, ov_flips, e_dev
             )
         # hint tracks the typical event size (decays toward small)
         self._k_hint = max(
@@ -1518,3 +1873,12 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         self.sweeper.w_t = ctx["patched_segs"]
         self.sweeper.overloaded = ov_new
         self.graph = self.sweeper.graph = ctx["patched"]
+
+    @solve_window
+    def _dispatch_frontier_probe(self, ctx, e_dev, limit):
+        """No frontier kernel for the grouped backend yet: the cone
+        expansion walks per-band ELL slots, while this backend stores
+        block-bipartite segments. Returning None makes every grouped
+        overflow ride the full-width refresh (counted in
+        ops.frontier_fallbacks) — correctness is unaffected."""
+        return None

@@ -137,6 +137,100 @@ def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None,
     return dr
 
 
+def _cone_expand(sel_dr, bands, v_t, w_t, e_u, e_v, e_w_old, e_w_new,
+                 max_jumps, vote=None, cell_limit=None):
+    """Affected-cone mask for a weight-increase delta (the frontier
+    kernel). Given resident distance rows ``sel_dr`` [B, N] and the
+    PRE-patch bands, mark every cell whose tight shortest path crosses
+    an increased edge — exactly the cells whose distance may RISE, i.e.
+    the cells a warm seed must reset. Seed: cells u where an increased
+    edge (u -> v, w_old) was tight; expand by frontier jumps: cell j
+    joins when any tight band slot of j (old weights, old distances)
+    reaches a cone cell; a jump per `lax.while_loop` iteration until
+    the cone stops growing. Tightness is tested on RAW weights (no
+    overload mask): every realized tight step is raw-tight, so the
+    cone only over-approximates — extra resets stay bit-identical by
+    the unique-fixed-point squeeze. Cells already at INF can never
+    rise and are excluded (keeps unreachable regions from chaining
+    into the cone).
+
+    Returns ``(cone [B, N] bool, rows, cells, jumps, converged)``.
+    ``rows`` counts rows with a nonempty cone; ``cells`` the total
+    cone population — the re-solve work measure the overflow policy
+    thresholds on (a single link down puts ONE cell in nearly every
+    row, so a row count saturates while the cone stays tiny).
+    ``vote``/psum lifts both globally for the sharded variant, same
+    contract as _rev_fixed_point. ``converged`` is False when the
+    expansion was cut off by ``max_jumps`` or overflowed
+    ``cell_limit`` — the cone is then an UNDER-approximation and the
+    caller must fall back to a coarser reset (whole-row, or the
+    full-width refresh)."""
+    live = sel_dr < INF
+    inc_e = (e_w_new > e_w_old) & (e_w_old < INF)
+    seed_tight = (
+        (sel_dr[:, e_u]
+         == jnp.minimum(e_w_old[None, :] + sel_dr[:, e_v], INF))
+        & inc_e[None, :]
+        & live[:, e_u]
+    )  # [B, E]
+    cone0 = (
+        jnp.zeros(sel_dr.shape, dtype=jnp.int32)
+        .at[:, e_u].max(seed_tight.astype(jnp.int32))
+    ) > 0
+
+    def count(cone):
+        rows = jnp.sum(jnp.any(cone, axis=1), dtype=jnp.int32)
+        # float32: the population can reach B*N (1e10 at 100k nodes),
+        # past int32; a policy threshold tolerates float rounding
+        cells = jnp.sum(cone, dtype=jnp.float32)
+        if vote is None:
+            return rows, cells
+        return vote(rows), vote(cells)
+
+    def grow(cone):
+        parts = []
+        pos = 0
+        for band, v_b, w_b in zip(bands, v_t, w_t):
+            d_band = sel_dr[:, pos : pos + band.rows]  # [B, rows]
+            total = jnp.minimum(sel_dr[:, v_b] + w_b[None, :, :], INF)
+            tight = (
+                (total == d_band[:, :, None])
+                & (d_band < INF)[:, :, None]
+                & (w_b < INF)[None, :, :]
+            )  # [B, rows, k]
+            parts.append(jnp.any(tight & cone[:, v_b], axis=2))
+            pos += band.rows
+        parts.append(jnp.zeros_like(cone[:, pos:]))
+        return cone | jnp.concatenate(parts, axis=1)
+
+    def cond(state):
+        _, _, cells, it, grew = state
+        keep = jnp.logical_and(grew > 0, it < max_jumps)
+        if cell_limit is not None:
+            keep = jnp.logical_and(keep, cells <= cell_limit)
+        return keep
+
+    def body(state):
+        cone, _, _, it, _ = state
+        nxt = grow(cone)
+        grew_local = jnp.any(nxt & ~cone).astype(jnp.int32)
+        grew = grew_local if vote is None else vote(grew_local)
+        rows, cells = count(nxt)
+        return nxt, rows, cells, it + 1, grew
+
+    rows0, cells0 = count(cone0)
+    cone, rows, cells, jumps, grew = jax.lax.while_loop(
+        cond, body,
+        (cone0, rows0, cells0, jnp.int32(0),
+         (cells0 > 0).astype(jnp.int32)),
+    )
+    # rows/cells: int32 / float32; jumps int32; converged bool
+    converged = grew == 0
+    if cell_limit is not None:
+        converged = jnp.logical_and(converged, cells <= cell_limit)
+    return cone, rows, cells, jumps, converged
+
+
 def _nh_counts(dr, bands, v_t, w_t, overloaded, t_ids):
     """Per-node ECMP next-hop slot counts [B, N] — route selection for
     every source, evaluated against its own destination row."""

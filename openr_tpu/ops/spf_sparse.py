@@ -74,6 +74,7 @@ ELL_COUNTERS = _get_registry().counter_dict(
         "ell_cold_solves",        # solves from the unit init
         "ell_widen_events",       # widen-on-overflow band re-uploads
         "ell_patch_merges",       # stacked patches coalesced warm
+        "ell_structural_warm_solves",  # overload/link flips kept warm
     ],
     prefix="decision.",
 )
@@ -1230,12 +1231,21 @@ class EllState:
         # against the snapshots, which is exactly what the tight test
         # is sound against — so stacked patches coalesce into one warm
         # solve instead of degrading to a forced cold seed.
+        #
+        # STRUCTURAL events (overload-mask flips) stay warm too: the
+        # mask at the last solve is kept (_ov_solved), a flipped
+        # node's out-edges are journaled at their raw weights, and the
+        # solve-time emission compares EFFECTIVE weights (raw, or INF
+        # when the tail was/is masked) so a drain reads as an increase
+        # delta and an undrain as a plain decrease — no forced cold
+        # seed on either.
         self._d_dev = None
         self._warm_key: Optional[Tuple[int, ...]] = None
         self._pending_edges: Dict[
             Tuple[int, int], Tuple[int, int]
         ] = {}
-        self._pending_force = False
+        self._ov_solved = np.array(graph.overloaded, copy=True)
+        self._pending_structural = False
 
     def _sync_overloaded(self, patched: EllGraph) -> bool:
         changed = not np.array_equal(
@@ -1251,9 +1261,16 @@ class EllState:
         snapshot (taken from the last-solved graph) and only advances
         its current side, so a burst of patches inside one debounce
         window still emits a single sound increase delta at solve
-        time. Only an overload-mask flip forces the cold seed (the
-        tight test runs on raw weights and is not valid across an
-        effective-weight change)."""
+        time.
+
+        Overload-mask flips are journaled rather than forcing a cold
+        seed: every out-edge of a flipped node enters the journal at
+        its raw collapsed weight, and the emission in reconverge
+        applies the mask per side (see _emit_increases) — a drain
+        becomes an ordinary increase delta, an undrain a decrease.
+        Link up/down (a row removal/addition in the patch) already
+        reads as a w <-> INF transition through band_row_edge_changes,
+        so the same journal carries it."""
         if patched.changed:
             ELL_COUNTERS["ell_incremental_syncs"] += 1
         if patched.widened:
@@ -1261,15 +1278,60 @@ class EllState:
         if self._d_dev is None:
             return
         if ov_changed:
-            self._pending_force = True
-            return
+            # journal the flipped nodes' out-edges from the PRE-patch
+            # resident graph (self.graph — replaced only after the
+            # patch lands): the effective weight of every such edge
+            # moves with the mask even though its raw weight did not.
+            # O(E) host scan, vectorized; flips are rare events.
+            self._pending_structural = True
+            flipped = np.nonzero(
+                np.asarray(self.graph.overloaded)
+                != np.asarray(patched.overloaded)
+            )[0]
+            collapsed: Dict[Tuple[int, int], int] = {}
+            pos = 0
+            for src_b, w_b in zip(self.graph.src, self.graph.w):
+                src_h = np.asarray(src_b)
+                w_h = np.asarray(w_b)
+                hit = np.isin(src_h, flipped) & (w_h < INF)
+                for r, sl in zip(*np.nonzero(hit)):
+                    key = (int(src_h[r, sl]), pos + int(r))
+                    w = int(w_h[r, sl])
+                    if w < collapsed.get(key, INF):
+                        collapsed[key] = w
+                pos += src_h.shape[0]
+            for key, w in collapsed.items():
+                self._pending_edges.setdefault(key, (w, w))
         if not patched.changed:
-            return  # no-op sync: the journal is untouched
+            return  # mask-only / no-op sync: raw journal untouched
         if self._pending_edges:
             ELL_COUNTERS["ell_patch_merges"] += 1
+        structural = False
         for s, h, wo, wn in band_row_edge_changes(self.graph, patched):
             snap, _cur = self._pending_edges.get((s, h), (wo, wo))
             self._pending_edges[(s, h)] = (snap, wn)
+            structural = structural or wo >= INF or wn >= INF
+        if structural:
+            self._pending_structural = True
+
+    def _emit_increases(self, ov_now: np.ndarray):
+        """The journal's increase delta, EFFECTIVE-weight aware: an
+        entry is emitted when its raw weight rose (covers the
+        origination row — an overloaded source still uses its own
+        out-edges) or when its masked weight rose (covers transit
+        rows across a drain flip). The emitted weight is the raw
+        snapshot: every realized tight step in d_prev used the raw
+        value, so the tight test stays sound; rows reset through a
+        masked coincidence are merely extra work, never wrong."""
+        inc = []
+        for (s, h), (snap, cur) in self._pending_edges.items():
+            if snap >= INF:
+                continue  # edge unusable at solve time: can't tighten
+            snap_eff = INF if self._ov_solved[s] else snap
+            cur_eff = INF if ov_now[s] else cur
+            if cur > snap or cur_eff > snap_eff:
+                inc.append((s, h, snap))
+        return inc
 
     def apply_patch(self, patched: EllGraph) -> None:
         """Scatter a patched graph's changed rows into the resident
@@ -1323,20 +1385,21 @@ class EllState:
         warm = (
             self._d_dev is not None
             and self._warm_key == srcs_key
-            and not self._pending_force
         )
         if warm:
             # increases vs the SNAPSHOT weights the resident distances
             # were solved under (edges that moved and came back to or
             # below their snapshot need no reset: the old rows are
-            # still valid upper bounds)
-            inc = [
-                (s, h, snap)
-                for (s, h), (snap, cur) in self._pending_edges.items()
-                if cur > snap
-            ]
+            # still valid upper bounds); effective-weight aware, so
+            # drain flips and link removals ride the same warm seed
+            # openr-lint: disable=host-sync-in-window -- overloaded is
+            # a host ndarray on EllGraph; no device transfer happens
+            ov_now = np.asarray(patched.overloaded)
+            inc = self._emit_increases(ov_now)
             d_prev = self._d_dev
             ELL_COUNTERS["ell_warm_solves"] += 1
+            if self._pending_structural:
+                ELL_COUNTERS["ell_structural_warm_solves"] += 1
         else:
             inc = [_FORCE_RESET_EDGE]
             d_prev = (
@@ -1365,7 +1428,10 @@ class EllState:
         self._d_dev = d
         self._warm_key = srcs_key
         self._pending_edges = {}
-        self._pending_force = False
+        # openr-lint: disable=host-sync-in-window -- host ndarray copy
+        # (the overload mask the resident distances were solved under)
+        self._ov_solved = np.array(patched.overloaded, copy=True)
+        self._pending_structural = False
         self.graph = _replace(patched, changed=None)
         _total_ms = (_t_end - _t0) * 1000.0
         _dispatch_ms = (_t_end - _t_dispatch) * 1000.0

@@ -1,8 +1,9 @@
 """Chaos soak: one seeded, randomized fault schedule driven across
 every injection seam of the pipeline — device dispatch, delta consume,
-cold device rebuild, Decision SPF solve, the Fib thrift transport,
-netlink programming, and KvStore full-sync/flood — over 200+ churn
-events. The run is replayable bit-for-bit from the module seeds
+cold device rebuild, the frontier re-solve probe (structural link-flap
+events under a shrunken bucket ladder), Decision SPF solve, the Fib
+thrift transport, netlink programming, and KvStore full-sync/flood —
+over 200+ churn events. The run is replayable bit-for-bit from the module seeds
 (``FaultSchedule.fail_with_probability`` draws from a private
 ``random.Random(seed)`` stream and the event schedule from another).
 
@@ -58,6 +59,9 @@ from test_route_engine_delta import (
     make_engine,
     mutate_metric,
 )
+from test_sp_route_reuse import _drop_adj, _restore_adj
+
+from openr_tpu.ops import route_engine
 
 SEED = 20260805  # every stream below derives from this; change = new run
 
@@ -110,18 +114,60 @@ def _engine_leg(events):
         "route_engine.cold_build",
         FaultSchedule.fail_with_probability(0.5, seed=SEED + 3),
     )
+    inj.arm(
+        "route_engine.frontier_resolve",
+        FaultSchedule.fail_with_probability(0.5, seed=SEED + 7),
+    )
+    # shrink the bucket ladder so the storm also exercises the
+    # overflow policy: structural (link flap) events cross the
+    # frontier_resolve seam, and a probe fault must degrade WITHIN the
+    # warm rung (full-width fallback), never up the ladder
+    flap_rsw = [
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    ][-1]
+    pulled = []
+
+    def flap():
+        if pulled:
+            node, adj = pulled.pop()
+            _restore_adj(ls, node, adj)
+            _restore_adj(ls, adj.other_node_name, pulled.pop()[1])
+            return {node, adj.other_node_name}
+        peer = ls.get_adjacency_databases()[
+            flap_rsw
+        ].adjacencies[0].other_node_name
+        db = ls.get_adjacency_databases()[peer]
+        back = next(
+            i for i, a in enumerate(db.adjacencies)
+            if a.other_node_name == flap_rsw
+        )
+        pulled.append((peer, _drop_adj(ls, peer, back)))
+        pulled.append((flap_rsw, _drop_adj(ls, flap_rsw, 0)))
+        return {flap_rsw, peer}
+
+    buckets0 = route_engine._ROW_BUCKETS
+    route_engine._ROW_BUCKETS = (8,)
+    engine._k_hint = 8
     rng = random.Random(SEED + 4)
     churns = 0
-    for _ in range(events):
-        node = rng.choice(rsws)
-        engine.churn(ls, mutate_metric(ls, node, 0, rng.randrange(1, 60)))
-        churns += 1
-        time.sleep(0.002)  # let the breaker elapse between events
+    try:
+        for step in range(events):
+            affected = (
+                flap() if step % 2 else
+                mutate_metric(ls, rng.choice(rsws), 0,
+                              rng.randrange(1, 60))
+            )
+            engine.churn(ls, affected)
+            churns += 1
+            time.sleep(0.002)  # let the breaker elapse between events
+    finally:
+        route_engine._ROW_BUCKETS = buckets0
 
     for site in (
         "route_engine.dispatch",
         "route_engine.consume",
         "route_engine.cold_build",
+        "route_engine.frontier_resolve",
     ):
         inj.disarm(site)
     # fault-free churns walk the ladder back to HEALTHY

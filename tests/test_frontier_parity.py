@@ -1,0 +1,447 @@
+"""Frontier-based incremental re-solve for structural churn: link
+down/up/flap/partition events that overflow the bucket ladder must
+resolve through the device-resident frontier path (cone probe + masked
+full-width re-solve) bit-identical to a from-scratch cold oracle, fall
+back to the full-width refresh exactly when the policy says so
+(threshold boundary, jump cap, probe fault, grouped backend), keep the
+PendingDelta pipelining contract, and hold digest parity on the
+mesh-sharded engine.  The regression guard lives here too: a localized
+structural event must NOT silently ride the full-width path while its
+frontier is below threshold."""
+
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from openr_tpu.faults import FaultSchedule, get_injector
+from openr_tpu.models import topologies
+from openr_tpu.ops import route_engine, spf_sparse
+from openr_tpu.telemetry import get_registry
+from tests.test_route_engine_delta import (
+    assert_bit_identical,
+    engine_digests,
+    full_digests,
+    load,
+    make_engine,
+    mutate_metric,
+)
+from tests.test_sp_route_reuse import (
+    _drop_adj,
+    _mutate_metric,
+    _restore_adj,
+    _set_overload,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().reset()
+    yield
+    get_injector().reset()
+
+
+@pytest.fixture(autouse=True)
+def _tiny_buckets(monkeypatch):
+    """Force every event past the bucket ladder so the overflow policy
+    (frontier vs full-width) runs at test scale."""
+    monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
+
+
+def drop_link(ls, u, v):
+    """Remove the u<->v adjacency from BOTH endpoint databases (real
+    link-down semantics) and return the pulled adjacencies."""
+    pulled = {}
+    for x, y in ((u, v), (v, u)):
+        db = ls.get_adjacency_databases()[x]
+        keep, gone = [], []
+        for a in db.adjacencies:
+            (gone if a.other_node_name == y else keep).append(a)
+        pulled[(x, y)] = tuple(gone)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(keep))
+        )
+    return pulled
+
+
+def restore_link(ls, pulled):
+    for (x, _y), adjs in pulled.items():
+        db = ls.get_adjacency_databases()[x]
+        ls.update_adjacency_database(
+            replace(
+                db,
+                adjacencies=tuple(list(db.adjacencies) + list(adjs)),
+            )
+        )
+
+
+def fresh_engine(ls, kind="ell", **kw):
+    eng = make_engine(kind, ls)
+    eng._k_hint = 8
+    for k, v in kw.items():
+        setattr(eng, k, v)
+    return eng
+
+
+def leaf_link(ls):
+    """A rack uplink: the canonical LOCALIZED structural event."""
+    names = sorted(ls.get_adjacency_databases().keys())
+    rsw = next(n for n in names if n.startswith("rsw"))
+    peer = ls.get_adjacency_databases()[rsw].adjacencies[0].other_node_name
+    return rsw, peer
+
+
+TOPOS = {
+    "ring": lambda: topologies.ring(16),
+    "fat_tree": lambda: topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    ),
+    "random_mesh": lambda: topologies.random_mesh(
+        24, degree=3, seed=7, max_metric=9
+    ),
+}
+
+
+@pytest.mark.parametrize("topo_name", sorted(TOPOS))
+class TestFrontierEventParity:
+    """Link-down / link-up / flap / partition at ring, fat-tree and
+    random topologies: every overflow event must stay bit-identical to
+    the cold oracle regardless of which overflow rung it rode."""
+
+    def _any_link(self, ls):
+        names = sorted(ls.get_adjacency_databases().keys())
+        u = next(
+            n for n in names
+            if ls.get_adjacency_databases()[n].adjacencies
+        )
+        v = ls.get_adjacency_databases()[u].adjacencies[0].other_node_name
+        return u, v
+
+    def test_down_up_flap_bit_identical(self, topo_name):
+        ls = load(TOPOS[topo_name]())
+        engine = fresh_engine(ls)
+        u, v = self._any_link(ls)
+
+        pulled = drop_link(ls, u, v)  # link down
+        assert engine.churn(ls, {u, v}) is not None
+        assert engine_digests(engine) == full_digests(ls), "down"
+
+        restore_link(ls, pulled)  # link up
+        assert engine.churn(ls, {u, v}) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+
+        for _ in range(2):  # flap
+            pulled = drop_link(ls, u, v)
+            assert engine.churn(ls, {u, v}) is not None
+            restore_link(ls, pulled)
+            assert engine.churn(ls, {u, v}) is not None
+        assert engine_digests(engine) == full_digests(ls), "flap"
+
+        # structural events were classified as such, none demoted to a
+        # cold rebuild, and full host-result parity holds
+        assert engine.structural_events >= 6
+        assert engine.cold_builds == 1
+        assert_bit_identical(engine, ls, "ell")
+
+    def test_partition_and_heal_bit_identical(self, topo_name):
+        """Cut a node off entirely (every adjacency of one endpoint):
+        distances RISE TO INF — the cone must cover the newly
+        unreachable cells without chaining through already-INF ones —
+        then heal and re-check."""
+        ls = load(TOPOS[topo_name]())
+        engine = fresh_engine(ls)
+        names = sorted(ls.get_adjacency_databases().keys())
+        victim = next(
+            n for n in names
+            if len(ls.get_adjacency_databases()[n].adjacencies) >= 2
+        )
+        peers = {
+            a.other_node_name
+            for a in ls.get_adjacency_databases()[victim].adjacencies
+        }
+        pulls = [drop_link(ls, victim, p) for p in sorted(peers)]
+        assert engine.churn(ls, {victim} | peers) is not None
+        assert engine_digests(engine) == full_digests(ls), "partition"
+
+        for pulled in pulls:
+            restore_link(ls, pulled)
+        assert engine.churn(ls, {victim} | peers) is not None
+        assert engine_digests(engine) == full_digests(ls), "heal"
+        assert engine.cold_builds == 1
+        assert_bit_identical(engine, ls, "ell")
+
+
+class TestFrontierPolicy:
+    """The overflow policy itself: localized structural events ride
+    the frontier, the threshold boundary flips the decision, the
+    grouped backend (no frontier kernel) falls back, drain flips ride
+    the frontier as effective-weight increases."""
+
+    def _fat_tree(self):
+        return load(TOPOS["fat_tree"]())
+
+    def test_localized_link_down_takes_frontier(self):
+        """THE headline path: a rack uplink down at overflow scale
+        resolves via the frontier (not full-width), bit-identical."""
+        ls = self._fat_tree()
+        engine = fresh_engine(ls)
+        rsw, peer = leaf_link(ls)
+        drop_link(ls, rsw, peer)
+        moved = engine.churn(ls, {rsw, peer})
+        assert moved  # routes moved
+        assert engine.frontier_resolves == 1
+        assert engine.full_refreshes == 0
+        assert engine.frontier_fallbacks == 0
+        assert engine.structural_events == 1
+        # probe telemetry landed on the engine
+        assert engine.last_frontier_cells > 0
+        assert engine.last_frontier_jumps >= 0
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_regression_guard_no_silent_full_width(self):
+        """Regression guard (run by `make churn-smoke`): a structural
+        event whose frontier converges below threshold must NOT
+        silently take the full-width path. If this fires, the probe or
+        the policy regressed — full-width still gives right answers,
+        so only this counter check catches the perf loss."""
+        ls = self._fat_tree()
+        engine = fresh_engine(ls)
+        rsw, peer = leaf_link(ls)
+        pulled = drop_link(ls, rsw, peer)
+        engine.churn(ls, {rsw, peer})
+        restore_link(ls, pulled)
+        engine.churn(ls, {rsw, peer})
+        assert engine.structural_events == 2
+        assert engine.full_refreshes == 0, (
+            "structural event took full-width with a below-threshold "
+            "frontier (cells=%s of limit %s)"
+            % (
+                engine.last_frontier_cells,
+                engine.frontier_threshold * engine.graph.n ** 2,
+            )
+        )
+        assert engine.frontier_resolves == 2
+
+    def test_threshold_zero_falls_back_full_width(self):
+        reg = get_registry()
+        fb0 = reg.snapshot().get("ops.frontier_fallbacks", 0)
+        ls = self._fat_tree()
+        engine = fresh_engine(ls, frontier_threshold=0.0)
+        rsw, peer = leaf_link(ls)
+        drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 0
+        assert engine.full_refreshes == 1
+        assert engine.frontier_fallbacks == 1
+        fb1 = reg.snapshot().get("ops.frontier_fallbacks", 0)
+        assert fb1 > fb0
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_threshold_one_admits_wide_frontier(self):
+        """A spine event (wide cone) under threshold=1.0 still rides
+        the frontier — and stays bit-identical."""
+        ls = self._fat_tree()
+        engine = fresh_engine(ls, frontier_threshold=1.0)
+        ssw = next(
+            n for n in engine.graph.node_names if n.startswith("ssw")
+        )
+        assert engine.churn(ls, mutate_metric(ls, ssw, 0, 9)) is not None
+        assert engine.frontier_resolves == 1
+        assert engine.full_refreshes == 0
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_grouped_backend_falls_back(self):
+        """No frontier kernel over block-bipartite segments yet: the
+        grouped engine's probe hook returns None and every overflow
+        rides the full-width refresh, counted as a fallback."""
+        ls = self._fat_tree()
+        engine = fresh_engine(ls, kind="grouped")
+        rsw, peer = leaf_link(ls)
+        drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 0
+        assert engine.full_refreshes == 1
+        assert engine.frontier_fallbacks == 1
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_drain_flip_takes_frontier(self):
+        """An overload flip is structural churn too (effective-weight
+        increase of the node's in-edges): it must classify, ride the
+        frontier at overflow scale, and heal warm on undrain."""
+        from tests.test_route_engine import set_overload
+
+        ls = self._fat_tree()
+        engine = fresh_engine(ls)
+        fsw = next(
+            n for n in engine.graph.node_names if n.startswith("fsw")
+        )
+        assert engine.churn(ls, set_overload(ls, fsw, True)) is not None
+        assert engine.structural_events == 1
+        assert engine_digests(engine) == full_digests(ls), "drain"
+        assert engine.churn(ls, set_overload(ls, fsw, False)) is not None
+        assert engine_digests(engine) == full_digests(ls), "undrain"
+        assert engine.cold_builds == 1
+        assert engine.frontier_resolves + engine.full_refreshes == 2
+
+
+class TestFrontierPipelined:
+    """PendingDelta interaction: a deferred metric delta must be
+    consumed inside the overflow event's window, and a deferred delta
+    is never left dangling across the frontier commit."""
+
+    def test_defer_consume_across_frontier_event(self, monkeypatch):
+        ls = load(TOPOS["fat_tree"]())
+        engine = fresh_engine(ls)
+        rsw, peer = leaf_link(ls)
+        names = sorted(ls.get_adjacency_databases().keys())
+        other_rsw = next(
+            n for n in names if n.startswith("rsw") and n != rsw
+        )
+        # bucketed metric event, host apply deferred: widen the bucket
+        # so this event rides the bucketed path, then shrink it back so
+        # the link event overflows into the frontier
+        monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (128,))
+        engine._k_hint = 128
+        pending = engine.churn(
+            ls, mutate_metric(ls, other_rsw, 0, 7), defer_consume=True
+        )
+        monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
+        engine._k_hint = 8
+        assert isinstance(pending, route_engine.PendingDelta)
+        assert not pending.consumed
+        # the overflow (frontier) event drains it inside its window
+        drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert pending.consumed
+        assert engine.frontier_resolves == 1
+        assert engine_digests(engine) == full_digests(ls)
+        assert_bit_identical(engine, ls, "ell")
+
+
+class TestFrontierSharded:
+    """Mesh-sharded ELL engine: the psum-voted probe meta is
+    device-invariant and the row-sharded cone seeds the sharded
+    masked re-solve — digest parity against the cold oracle."""
+
+    def test_sharded_link_churn_digest_parity(self):
+        ls = load(TOPOS["fat_tree"]())
+        engine = fresh_engine(ls, kind="ell_sharded")
+        rsw, peer = leaf_link(ls)
+        pulled = drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 1
+        assert engine_digests(engine) == full_digests(ls), "down"
+        restore_link(ls, pulled)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == 1
+        assert_bit_identical(engine, ls, "ell_sharded")
+
+
+class TestFrontierFaults:
+    """The degradation contract: a frontier failure degrades WITHIN
+    the warm rung (frontier -> full-width), never up the ladder."""
+
+    def test_probe_fault_falls_back_full_width(self):
+        ls = load(TOPOS["fat_tree"]())
+        engine = fresh_engine(ls)
+        rsw, peer = leaf_link(ls)
+        get_injector().arm(
+            "route_engine.frontier_resolve", FaultSchedule.fail_once()
+        )
+        pulled = drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        # the fault ate the probe: full-width fallback, same answer
+        assert engine.frontier_resolves == 0
+        assert engine.full_refreshes == 1
+        assert engine.frontier_fallbacks == 1
+        assert engine.cold_builds == 1, "must not climb the ladder"
+        assert engine_digests(engine) == full_digests(ls), "faulted"
+        # injector drained: the next structural event is frontier again
+        restore_link(ls, pulled)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 1
+        assert engine_digests(engine) == full_digests(ls), "healed"
+
+
+class TestEllStructuralWarm:
+    """Decision layer: EllState keeps link removals AND overload flips
+    on the warm path through the effective-weight journal — the
+    structural churn classes PR 1/3 left cold-seeded."""
+
+    ROOT = "node-0"
+
+    def _check(self, state, ls, affected):
+        if affected:
+            patched = spf_sparse.ell_patch(
+                state.graph, ls, sorted(affected), widen=True
+            )
+            assert patched is not None
+        else:
+            patched = state.graph
+        srcs = spf_sparse.ell_source_batch(patched, ls, self.ROOT)
+        packed = np.asarray(state.reconverge(patched, srcs))
+        ref = np.asarray(
+            spf_sparse.ell_view_batch_packed(
+                spf_sparse.compile_ell(ls), srcs
+            )
+        )
+        np.testing.assert_array_equal(packed, ref)
+
+    def test_link_flap_and_drain_stay_warm(self):
+        topo = topologies.random_mesh(16, degree=3, seed=5, max_metric=9)
+        ls = load(topo)
+        state = spf_sparse.EllState(spf_sparse.compile_ell(ls))
+        self._check(state, ls, [])  # the one cold solve
+
+        c0 = dict(spf_sparse.ELL_COUNTERS)
+        other = ls.get_adjacency_databases()["node-3"].adjacencies[
+            0
+        ].other_node_name
+        dropped = _drop_adj(ls, "node-3", 0)  # link down: w -> INF
+        self._check(state, ls, {"node-3", other})
+        _restore_adj(ls, "node-3", dropped)  # link up: INF -> w
+        self._check(state, ls, {"node-3", other})
+        _set_overload(ls, "node-5", True)  # drain
+        self._check(state, ls, {"node-5"})
+        _set_overload(ls, "node-5", False)  # undrain
+        self._check(state, ls, {"node-5"})
+        c1 = dict(spf_sparse.ELL_COUNTERS)
+        assert c1["ell_warm_solves"] - c0["ell_warm_solves"] == 4
+        assert c1["ell_cold_solves"] == c0["ell_cold_solves"]
+        assert (
+            c1["ell_structural_warm_solves"]
+            - c0["ell_structural_warm_solves"]
+            >= 3
+        )
+
+    def test_stacked_flip_and_metric_patch_merge_warm(self):
+        """A drain flip and a metric increase stacked in one journal
+        (apply_patch then reconverge) must coalesce into one warm
+        solve — the flip's effective-weight entries and the metric
+        entry both emit against their solve-time snapshots."""
+        topo = topologies.random_mesh(16, degree=3, seed=8, max_metric=9)
+        ls = load(topo)
+        state = spf_sparse.EllState(spf_sparse.compile_ell(ls))
+        self._check(state, ls, [])
+
+        c0 = dict(spf_sparse.ELL_COUNTERS)
+        _set_overload(ls, "node-7", True)
+        p1 = spf_sparse.ell_patch(
+            state.graph, ls, ["node-7"], widen=True
+        )
+        assert p1 is not None
+        state.apply_patch(p1)  # flip journaled, no solve
+        other = ls.get_adjacency_databases()["node-2"].adjacencies[
+            0
+        ].other_node_name
+        _mutate_metric(ls, "node-2", 0, 21)
+        self._check(state, ls, {"node-2", other})
+        c1 = dict(spf_sparse.ELL_COUNTERS)
+        assert c1["ell_warm_solves"] - c0["ell_warm_solves"] == 1
+        assert c1["ell_cold_solves"] == c0["ell_cold_solves"]
+        assert (
+            c1["ell_structural_warm_solves"]
+            - c0["ell_structural_warm_solves"]
+            == 1
+        )

@@ -649,7 +649,9 @@ class TestFullRefresh:
     take the full-width refresh — patched resident layout, one
     cold-build-shaped dispatch, NO host layout recompile — and still
     report the affected names. Buckets are monkeypatched small so the
-    overflow path runs at test scale."""
+    overflow path runs at test scale; where a test targets the
+    full-width rung specifically, frontier_threshold=0.0 disables the
+    frontier fast path (owned by tests/test_frontier_parity.py)."""
 
     def _shrink_buckets(self, monkeypatch):
         monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
@@ -669,7 +671,9 @@ class TestFullRefresh:
         )
         ls = load(topo)
         names = sorted(ls.get_adjacency_databases().keys())
-        engine = route_engine.RouteSweepEngine(ls, [names[0]])
+        engine = route_engine.RouteSweepEngine(
+            ls, [names[0]], frontier_threshold=0.0
+        )
         engine._k_hint = 8
         affected = self._overflow_event(ls, engine)
         moved = engine.churn(ls, affected)
@@ -753,7 +757,8 @@ class TestFullRefresh:
         ls = load(topo)
         names = sorted(ls.get_adjacency_databases().keys())
         engine = route_engine.RouteSweepEngine(
-            ls, [names[0]], align=16, mesh=make_mesh(jax.devices())
+            ls, [names[0]], align=16, mesh=make_mesh(jax.devices()),
+            frontier_threshold=0.0,
         )
         engine._k_hint = 8
         affected = self._overflow_event(ls, engine)
@@ -816,20 +821,23 @@ class TestFullRefresh:
                 )
             f0 = engine.full_refreshes
             i0 = engine.incremental_events
+            r0 = engine.frontier_resolves
             moved = engine.churn(ls, affected)
             assert moved is not None, (step, kind)
             df = engine.full_refreshes - f0
             di = engine.incremental_events - i0
-            # disjoint accounting per event: exactly one of the two
-            # non-cold paths fired, or neither did and the event was a
+            dr = engine.frontier_resolves - r0
+            # disjoint accounting per event: exactly one of the three
+            # non-cold paths fired, or none did and the event was a
             # detection no-op (empty moved, e.g. a random wiggle
             # landing on the current metric)
             assert engine.cold_builds == 1, (step, kind)
-            assert df + di <= 1, (step, kind)
-            assert df + di == 1 or moved == [], (step, kind)
-            applied += df + di
+            assert df + di + dr <= 1, (step, kind)
+            assert df + di + dr == 1 or moved == [], (step, kind)
+            applied += df + di + dr
             assert engine_digests(engine) == full_digests(ls), (
                 step, kind,
             )
-        assert engine.full_refreshes > 0  # the ladder forced some
+        # the 8-wide ladder forced some events past the buckets
+        assert engine.full_refreshes + engine.frontier_resolves > 0
         assert applied > 0

@@ -100,6 +100,10 @@ def _engine_leg(seed, events, failures):
         "route_engine.cold_build",
         FaultSchedule.fail_with_probability(0.5, seed=seed + 3),
     )
+    inj.arm(
+        "route_engine.frontier_resolve",
+        FaultSchedule.fail_with_probability(0.5, seed=seed + 7),
+    )
 
     def mutate(node, metric):
         db = ls.get_adjacency_databases()[node]
@@ -108,16 +112,61 @@ def _engine_leg(seed, events, failures):
         ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
         return {node, adjs[0].other_node_name}
 
+    flap_rsw = [
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    ][-1]
+    pulled: list = []
+
+    def flap():
+        # alternating link remove/restore: structural churn that
+        # overflows the (shrunken) bucket ladder and crosses the
+        # frontier_resolve seam on every event
+        if pulled:
+            adjs = pulled.pop()
+            for x, gone in adjs:
+                db = ls.get_adjacency_databases()[x]
+                ls.update_adjacency_database(replace(
+                    db, adjacencies=tuple(list(db.adjacencies) + gone)
+                ))
+            return {flap_rsw, adjs[0][1][0].other_node_name}
+        peer = ls.get_adjacency_databases()[
+            flap_rsw
+        ].adjacencies[0].other_node_name
+        adjs = []
+        for x, y in ((flap_rsw, peer), (peer, flap_rsw)):
+            db = ls.get_adjacency_databases()[x]
+            keep = [a for a in db.adjacencies if a.other_node_name != y]
+            gone = [a for a in db.adjacencies if a.other_node_name == y]
+            adjs.append((x, gone))
+            ls.update_adjacency_database(
+                replace(db, adjacencies=tuple(keep))
+            )
+        pulled.append(adjs)
+        return {flap_rsw, peer}
+
+    # shrink the bucket ladder so every event overflows into the
+    # frontier-vs-full policy (where the frontier_resolve seam lives)
+    buckets0 = route_engine._ROW_BUCKETS
+    route_engine._ROW_BUCKETS = (8,)
+    engine._k_hint = 8
     rng = random.Random(seed + 4)
     churns = 0
-    for _ in range(events):
-        engine.churn(ls, mutate(rng.choice(rsws), rng.randrange(1, 60)))
-        churns += 1
-        time.sleep(0.002)
+    try:
+        for step in range(events):
+            affected = (
+                flap() if step % 2 else
+                mutate(rng.choice(rsws), rng.randrange(1, 60))
+            )
+            engine.churn(ls, affected)
+            churns += 1
+            time.sleep(0.002)
+    finally:
+        route_engine._ROW_BUCKETS = buckets0
     for site in (
         "route_engine.dispatch",
         "route_engine.consume",
         "route_engine.cold_build",
+        "route_engine.frontier_resolve",
     ):
         inj.disarm(site)
     for _ in range(12):
