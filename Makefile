@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke multichip-smoke bench clean install
 
 all: native
 
@@ -69,6 +69,19 @@ telemetry-smoke: native
 # /tmp/openr_tpu_chaos_smoke.json (tools/chaos_report.py)
 chaos-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.chaos_report --smoke --out /tmp/openr_tpu_chaos_smoke.json
+
+# sharded-dispatch gate on the virtual 8-device CPU mesh (conftest
+# pins the device count): pipelined==eager bit-identity across a
+# shard-boundary event, zero reshards / zero implicit transfers under
+# jax.transfer_guard across a 5-event churn run, and the KSP2
+# speculative fast path dispatching mesh-wide (typed fallback counter
+# when it can't). Same contracts a real multi-chip run must hold.
+multichip-smoke: native
+	env JAX_PLATFORMS=cpu OPENR_KSP2_FAST=1 python -m pytest \
+	  tests/test_route_engine_delta.py::TestMeshPipelining \
+	  tests/test_route_engine_delta.py::TestShardedNoReshard \
+	  tests/test_ksp2_engine.py::TestMeshShardedEngine \
+	  -q -m "not slow"
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

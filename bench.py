@@ -453,6 +453,26 @@ def _run() -> dict:
             except Exception as e:
                 bench_traces = {"error": f"{type(e).__name__}: {e}"}
 
+    # eighth leg: the resharding-free sharded dispatch contract —
+    # sharded-vs-single resident churn with the registry deltas that
+    # prove the sharded leg paid zero implicit XLA copies
+    # (ops.reshard_events == 0) plus the per-shard overlapped-readback
+    # account. On one chip the mesh is virtual and the ratio measures
+    # sharded dispatch overhead, not scale-out.
+    bench_shchurn = None
+    if os.environ.get("OPENR_BENCH_SHARDED") == "1":
+        if leg_elapsed() > 480:
+            bench_shchurn = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import sharded_churn_bench
+
+                bench_shchurn = sharded_churn_bench(1000, 8)
+            except Exception as e:
+                bench_shchurn = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -526,6 +546,7 @@ def _run() -> dict:
         "bench_route_sweep": bench_routes,
         "bench_route_engine_churn": bench_rchurn,
         "bench_sp_solver_churn": bench_spsolver,
+        "bench_sharded_churn": bench_shchurn,
         "bench_convergence_trace": bench_traces,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;

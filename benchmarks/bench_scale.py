@@ -1147,6 +1147,61 @@ def link_churn_bench(
     return out
 
 
+def sharded_churn_bench(
+    nodes: int, churn_events: int = 10, backend: str = "ell",
+) -> dict:
+    """Paired sharded-vs-single churn legs plus the resharding-free
+    contract accounting (issue 7): the SAME metric-churn scenario once
+    over all visible devices and once single-chip, with the registry
+    deltas that prove the sharded leg never paid an implicit XLA copy —
+    ``ops.reshard_events`` must stay 0 across the sharded run — and the
+    per-shard overlapped-readback volume (``ops.shard_readback_bytes``,
+    ``ops.shard_consume_overlap_ms``). On one real chip the 8-way
+    virtual mesh measures sharded dispatch overhead; on a real slice
+    the ratio is the scale-out win."""
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+
+    def contract():
+        return (
+            reg.counter_get("ops.reshard_events"),
+            reg.counter_get("ops.shard_readback_bytes"),
+        )
+
+    r0, b0 = contract()
+    sharded = route_engine_churn_bench(
+        nodes, churn_events, churn_kind="metric",
+        sharded=True, backend=backend,
+    )
+    r1, b1 = contract()
+    single = route_engine_churn_bench(
+        nodes, churn_events, churn_kind="metric",
+        sharded=False, backend=backend,
+    )
+
+    # lazily registered: only a mesh engine's deferred consume
+    # observes it, so a missing histogram means the sharded leg never
+    # overlapped a readback (that would be a bug worth seeing here)
+    hist = reg.histograms().get("ops.shard_consume_overlap_ms")
+    out = dict(sharded)
+    out["bench"] = sharded["bench"].replace(
+        "route_engine_churn", "sharded_churn"
+    )
+    out["reshard_events"] = r1 - r0
+    out["resharding_free"] = bool(r1 - r0 == 0)
+    out["shard_readback_bytes"] = b1 - b0
+    out["shard_consume_overlap_ms"] = (
+        hist.stats() if hist is not None else None
+    )
+    out["single_chip_median_ms"] = single["median_ms"]
+    out["single_chip_p90_ms"] = single["p90_ms"]
+    out["sharded_vs_single_ratio"] = round(
+        sharded["median_ms"] / max(single["median_ms"], 1e-9), 3
+    )
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)
@@ -1171,6 +1226,11 @@ def main(argv=None):
                    help="paired metric+link churn legs through the "
                         "resident route engine: link-vs-metric median "
                         "ratio, frontier-vs-full split, cone medians")
+    p.add_argument("--sharded-churn", action="store_true",
+                   help="paired sharded-vs-single metric-churn legs "
+                        "with the resharding-free contract deltas "
+                        "(ops.reshard_events, shard readback bytes, "
+                        "consume-overlap histogram)")
     p.add_argument("--sharded", action="store_true",
                    help="routes-churn: shard the resident engine over "
                         "all visible devices (the past-12k design; on "
@@ -1233,6 +1293,17 @@ def main(argv=None):
                 link_churn_bench(
                     args.nodes, args.churn_events,
                     sharded=args.sharded,
+                    backend=args.backend,
+                )
+            ),
+            flush=True,
+        )
+        return
+    if args.sharded_churn:
+        print(
+            json.dumps(
+                sharded_churn_bench(
+                    args.nodes, args.churn_events,
                     backend=args.backend,
                 )
             ),

@@ -1,4 +1,4 @@
-"""The five invariant checkers. Each module exports one Rule class;
+"""The six invariant checkers. Each module exports one Rule class;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
@@ -6,6 +6,7 @@ from openr_tpu.analysis.rules.donation import DonationHazardRule
 from openr_tpu.analysis.rules.hostsync import HostSyncInWindowRule
 from openr_tpu.analysis.rules.lockorder import LockOrderRule
 from openr_tpu.analysis.rules.retrace import RetraceRiskRule
+from openr_tpu.analysis.rules.sharding import ShardingSpecRule
 from openr_tpu.analysis.rules.spans import SpanDisciplineRule
 
 ALL_RULES = (
@@ -14,6 +15,7 @@ ALL_RULES = (
     LockOrderRule,
     SpanDisciplineRule,
     RetraceRiskRule,
+    ShardingSpecRule,
 )
 
 __all__ = [
@@ -23,4 +25,5 @@ __all__ = [
     "LockOrderRule",
     "SpanDisciplineRule",
     "RetraceRiskRule",
+    "ShardingSpecRule",
 ]

@@ -1,0 +1,189 @@
+"""``sharding-spec``: resident buffers only flow into placement-aware
+dispatches.
+
+The resharding-free contract (issue 7): every resident device buffer
+gets an explicit ``NamedSharding`` at build time, and every jitted
+dispatch that consumes one must DECLARE its placement — via
+``in_shardings``/``out_shardings`` on the jit, or by being a
+``shard_map`` dispatch (whose in/out_specs are the declaration), or by
+riding ``replicated_jit`` (which commits both sides replicated). A
+bare ``jax.jit`` consuming a resident leaves placement to XLA's
+sharding propagation: it usually guesses right today, and then a
+refactor moves one operand and every churn dispatch silently pays a
+reshard or replication copy — the storm ``ops.reshard_events`` exists
+to catch at runtime. This rule catches it at review time.
+
+Detection mirrors ``donation-hazard``'s conventions: resident names
+come from ``@resident_buffers`` registrations plus the ``_dr`` /
+``_*_dev`` spellings, with alias tainting through locals. A jitted
+callable "declares shardings" when:
+
+- its decorator call carries ``in_shardings`` or ``out_shardings``;
+- its body dispatches through ``shard_map`` (specs are per-operand
+  there);
+- it is a module-level ``name = jax.jit(fn, in_shardings=..., ...)``
+  binding with either kwarg.
+
+Only call sites inside ``openr_tpu/ops/`` and ``openr_tpu/decision/``
+are checked — that is where the resident churn path lives. Single-chip
+dispatch sites (no mesh, nothing to spec) carry audited suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    call_kwarg,
+    decorator_info,
+    dotted_name,
+    literal_or_none,
+)
+from openr_tpu.analysis.rules.donation import _is_resident_name
+
+RULE_ID = "sharding-spec"
+
+#: path fragments of the checked surface (the resident churn path)
+_CHECKED_DIRS = ("openr_tpu/ops/", "openr_tpu/decision/")
+
+_SHARDING_KWARGS = ("in_shardings", "out_shardings")
+
+
+def _declares_shardings(call: Optional[ast.Call]) -> bool:
+    if call is None:
+        return False
+    return any(call_kwarg(call, kw) is not None for kw in _SHARDING_KWARGS)
+
+
+def _body_uses_shard_map(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee is not None and callee.split(".")[-1] == "shard_map":
+                return True
+    return False
+
+
+class ShardingSpecRule(Rule):
+    id = RULE_ID
+    description = (
+        "jitted dispatches consuming resident buffers in ops/ and "
+        "decision/ must declare in_shardings/out_shardings (or be "
+        "shard_map / replicated_jit dispatches)"
+    )
+
+    # -- collect: jitted callables and whether they declare ----------
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        store = ctx.scratch(self.id)
+        jitted: Dict[str, bool] = store.setdefault("jitted", {})
+        resident: Set[str] = store.setdefault("resident", set())
+
+        for cls in sf.classes():
+            for dec in cls.decorator_list:
+                name, call = decorator_info(dec)
+                if name and name.split(".")[-1] == "resident_buffers" and call:
+                    for arg in call.args:
+                        val = literal_or_none(arg)
+                        if isinstance(val, str):
+                            resident.add(val)
+
+        for fn, _cls in sf.functions():
+            for dec in fn.decorator_list:
+                name, call = decorator_info(dec)
+                if name is None or name.split(".")[-1] != "jit":
+                    continue
+                jitted[fn.name] = (
+                    _declares_shardings(call) or _body_uses_shard_map(fn)
+                )
+
+        # module-level `name = jax.jit(fn, ...)` bindings
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            val = node.value
+            if not isinstance(val, ast.Call):
+                continue
+            callee = dotted_name(val.func)
+            if callee is None or callee.split(".")[-1] != "jit":
+                continue
+            declares = _declares_shardings(val)
+            if not declares and val.args:
+                inner = dotted_name(val.args[0])
+                if inner is not None:
+                    # jit(fn) over a shard_map-dispatching body counts
+                    for fn, _cls in sf.functions():
+                        if fn.name == inner.split(".")[-1]:
+                            declares = _body_uses_shard_map(fn)
+                            break
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    jitted[tgt.id] = declares
+
+    # -- check: resident args into non-declaring dispatches ----------
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        path = sf.path.replace("\\", "/")
+        if not any(frag in path for frag in _CHECKED_DIRS):
+            return []
+        store = ctx.scratch(self.id)
+        jitted: Dict[str, bool] = store.get("jitted", {})
+        resident: Set[str] = store.get("resident", set())
+        findings: List[Finding] = []
+
+        for fn, _cls in sf.functions():
+            tainted: Dict[str, str] = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Attribute
+                ):
+                    if _is_resident_name(node.value.attr, resident):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                tainted[tgt.id] = node.value.attr
+
+            def resident_in(expr: ast.expr) -> Optional[str]:
+                for sub in ast.walk(expr):
+                    if isinstance(sub, ast.Attribute) and _is_resident_name(
+                        sub.attr, resident
+                    ):
+                        return sub.attr
+                    if isinstance(sub, ast.Name) and sub.id in tainted:
+                        return f"{sub.id} (= self.{tainted[sub.id]})"
+                return None
+
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted_name(node.func)
+                if callee is None:
+                    continue
+                leaf = callee.split(".")[-1]
+                declares = jitted.get(leaf)
+                if declares is not False:
+                    # unknown callable or a declaring dispatch
+                    continue
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    hit = resident_in(arg)
+                    if hit is not None:
+                        findings.append(
+                            Finding(
+                                self.id, sf.path, node.lineno,
+                                node.col_offset,
+                                f"resident buffer {hit} flows into "
+                                f"{leaf}, a jitted dispatch with no "
+                                "in_shardings/out_shardings — XLA "
+                                "chooses the placement, and a reshard "
+                                "copy lands on the churn path the day "
+                                "propagation guesses differently",
+                            )
+                        )
+                        break
+        return findings

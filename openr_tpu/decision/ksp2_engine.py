@@ -71,8 +71,12 @@ ENGINE_MAX_NODES = 12288
 # (set_engine_mesh), the all-pairs fixed point and the masked batches
 # run SHARDED over the mesh — per-device footprint n^2/ndev — and the
 # activation bound scales with sqrt(ndev) (~100k on a 64-way mesh).
-# The speculative resident-masks fast path stays single-chip-only for
-# now: sharded mode runs the plain incremental dispatch.
+# The speculative resident-masks fast path runs mesh-wide too: the
+# destination batch pads to a mesh multiple and the mask stack / dm
+# residents stripe over the batch axis (ShardingPlan.batch3/rows).
+# When the fast path CANNOT engage on-mesh (mask budget, empty batch)
+# the drop is typed — decision.ksp2.spec_mesh_fallbacks plus a trace
+# stamp — never silent.
 _ENGINE_MESH = None
 
 
@@ -477,15 +481,29 @@ class Ksp2Engine:
                 for (u, v), (w_old, w_new, _so, _sn) in changed.items()
                 if w_new > w_old
             ]
-            if self._mesh is None:
-                # the sharded dispatch does not thread the delta (its
-                # all-pairs solve stays cold) — counting it as warm
-                # would claim a seeding that never happened
-                _counters()["decision.ksp2_warm_dispatches"] += 1
-        if self._mesh is not None:
+            # both the single-chip and the sharded dispatches thread
+            # the delta into the warm-seeded fixed point now
+            _counters()["decision.ksp2_warm_dispatches"] += 1
+        if self._mesh is not None and use_fast:
+            # mesh twin of the fused speculative dispatch; nothing is
+            # donated (residents keep their NamedSharding placement),
+            # the rebind below is a plain replace
+            d_all_dev, dm_new_dev, packed = (
+                spf_sparse.sharded_ell_all_view_rows_masked(
+                    state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
+                    self.masks_t, self.dm_dev, self.sid,
+                    ENGINE_ROW_BUDGET, len(self.dsts), self._mesh,
+                    inc=inc,
+                )
+            )
+        elif self._mesh is not None:
+            if _fast_path_enabled():
+                # fast path requested but no resident masks on-mesh
+                # (budget refusal at cold build): typed, not silent
+                self._note_mesh_fallback("no_resident_masks")
             d_all_dev, packed = spf_sparse.sharded_ell_all_view_rows(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
-                self._mesh,
+                self._mesh, inc=inc,
             )
         elif use_fast:
             # openr-lint: disable=donation-hazard -- intentional: the
@@ -506,7 +524,8 @@ class Ksp2Engine:
         # the single-chip dispatches DONATE d_prev_dev (and dm_dev on
         # the fast path): adopt the outputs NOW, before any fallback
         # below can hand the dead buffers to _cold_build (which reuses
-        # d_prev_dev as its placeholder)
+        # d_prev_dev as its placeholder). The sharded dispatches donate
+        # nothing, so for them this is a plain rebind.
         self.d_prev_dev = d_all_dev
         if dm_new_dev is not None:
             self.dm_dev = dm_new_dev
@@ -591,8 +610,13 @@ class Ksp2Engine:
                         row_map[self.dsts[int(i)]] = changed_rows[x]
             else:
                 # budget overflow: one extra readback of the full
-                # matrix (rare — means a large fraction of rows moved)
-                dm_full = np.asarray(dm_new_dev)
+                # matrix (rare — means a large fraction of rows moved);
+                # under the mesh the batch carries pad rows — drop them
+                import jax
+
+                dm_full = np.asarray(
+                    jax.device_get(dm_new_dev)
+                )[: len(self.dsts)]
                 moved = np.flatnonzero((dm_full != self.dm).any(axis=1))
                 row_map = {self.dsts[int(i)]: dm_full[int(i)] for i in moved}
             # host-fallback dsts: adopt moved speculative rows into the
@@ -668,6 +692,20 @@ class Ksp2Engine:
         return affected
 
     # -- cold build --------------------------------------------------------
+
+    def _note_mesh_fallback(self, reason: str) -> None:
+        """The speculative fast path could not run mesh-wide: bump the
+        typed counter AND stamp the active trace span — the drop
+        forfeits the warm-dispatch win exactly when sharding activates,
+        so it must never be silent (issue 7 satellite)."""
+        _counters()["decision.ksp2.spec_mesh_fallbacks"] += 1
+        from openr_tpu.telemetry import get_tracer
+
+        tracer = get_tracer()
+        span = tracer.span_active(
+            "decision.ksp2.spec_mesh_fallback", reason=reason
+        )
+        tracer.end_span_active(span, reason=reason)
 
     def _cold_build(self, ls: LinkState, state, dsts: List[str]) -> None:
         from openr_tpu.decision import spf_solver as _ss
@@ -780,17 +818,40 @@ class Ksp2Engine:
         # and row-diff them on device. Gated on the same mask-memory
         # budget as the chunked dispatch.
         slots = sum(band.rows * band.k for band in graph.bands)
+        ndev = self._mesh.devices.size if self._mesh is not None else 1
+        # under the mesh the destination batch pads to a device
+        # multiple so the mask stack / dm residents stripe evenly over
+        # the batch axis (ShardingPlan.batch3 / rows); the budget is
+        # charged for the PADDED batch — what the device actually holds
+        b_pad = -(-len(dsts) // ndev) * ndev
         if (
             _fast_path_enabled()
-            and self._mesh is None  # speculative path: single-chip
-            and len(dsts) * 2 * max(1, slots)
-            <= _ss.KSP2_DEVICE_MASK_BUDGET
+            and dsts
+            and b_pad * 2 * max(1, slots) <= _ss.KSP2_DEVICE_MASK_BUDGET
         ):
-            masks_all, _ok = spf_sparse.build_edge_masks(
-                graph, [self.excl[d] for d in dsts]
-            )
-            self.masks_t = tuple(jnp.asarray(m) for m in masks_all)
-            self.dm_dev = jnp.asarray(self.dm)
+            excl_sets = [self.excl[d] for d in dsts]
+            # pad rows carry empty exclusion sets: their (unmasked)
+            # speculative solves are diff-masked out by d_real in the
+            # sharded dispatch, so their churn never reads back
+            excl_sets += [set()] * (b_pad - len(dsts))
+            masks_all, _ok = spf_sparse.build_edge_masks(graph, excl_sets)
+            if self._mesh is not None:
+                from openr_tpu.parallel.mesh import ShardingPlan
+
+                plan = ShardingPlan(self._mesh)
+                self.masks_t = tuple(
+                    plan.place(m, plan.batch3) for m in masks_all
+                )
+                dm_pad = np.full((b_pad, n), INF, dtype=np.int32)
+                dm_pad[: len(dsts)] = self.dm
+                self.dm_dev = plan.place(dm_pad, plan.rows)
+            else:
+                self.masks_t = tuple(jnp.asarray(m) for m in masks_all)
+                self.dm_dev = jnp.asarray(self.dm)
+        elif _fast_path_enabled() and self._mesh is not None and dsts:
+            # speculative path requested but the padded mask stack
+            # exceeds the device budget: typed drop, never silent
+            self._note_mesh_fallback("mask_budget")
 
         # graph-attribute snapshots for churn diffing
         self.eff_w, self.attr_sig = {}, {}

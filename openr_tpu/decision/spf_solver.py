@@ -179,6 +179,10 @@ SPF_COUNTERS = _get_registry().counter_dict(
         "decision.ksp2_cold_builds",
         "decision.ksp2_incremental_syncs",
         "decision.ksp2_warm_dispatches",
+        # speculative fast path could not run mesh-wide (mask budget,
+        # empty batch, ...): typed so dashboards and the runbook can
+        # alert on silent single-chip drops under sharding
+        "decision.ksp2.spec_mesh_fallbacks",
         "decision.ksp2_affected_dsts",
         "decision.ksp2_route_reuses",
         "decision.sp_route_reuses",
@@ -270,6 +274,12 @@ def _local_links_sig(ls: LinkState, node: str) -> tuple:
 
 def get_spf_counters() -> Dict[str, int]:
     out = dict(SPF_COUNTERS)
+    # sharded-dispatch placement/readback counters: surfaced in the
+    # same snapshot so bench artifacts and the reshard-storm runbook
+    # recipe read one merged view (0 when no mesh ever activated)
+    _reg = _get_registry()
+    for _k in ("ops.reshard_events", "ops.shard_readback_bytes"):
+        out[_k] = _reg.counter_get(_k)
     # fold in the ops-level resident-band counters under the same
     # namespace (one merged view for Decision.get_counters and the
     # churn smoke test)

@@ -427,10 +427,12 @@ from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 from openr_tpu.utils.jax_compat import shard_map
 
 from openr_tpu.ops.spf_sparse import SOURCES_AXIS  # noqa: E402
+from openr_tpu.parallel.mesh import (  # noqa: E402
+    ShardingPlan, replicated_jit,
+)
 
 
-@jax.jit
-def _patch_bands(v_t, w_t, patch_ids_t, patch_v_t, patch_w_t):
+def _patch_bands_fn(v_t, w_t, patch_ids_t, patch_v_t, patch_w_t):
     """Scatter patched band rows into the (replicated) resident band
     tensors — the sharded engine's band patch rides this one small
     dispatch instead of being fused into the churn step (replicated
@@ -445,6 +447,33 @@ def _patch_bands(v_t, w_t, patch_ids_t, patch_v_t, patch_w_t):
         for w, pids, pw in zip(w_t, patch_ids_t, patch_w_t)
     )
     return new_v, new_w
+
+
+# single-chip dispatch of the band patch; the mesh engines instead ride
+# parallel.mesh.replicated_jit(_patch_bands_fn, mesh) so the patched
+# tensors come back COMMITTED replicated, matching the sharded churn
+# step's replicated in_specs — otherwise XLA re-replicates the bands on
+# every churn dispatch (the reshard storm the plan exists to prevent)
+_patch_bands = jax.jit(_patch_bands_fn)
+
+
+@functools.partial(jax.jit, static_argnames=("start", "size"))
+def _rows_slice(seg, start, size):
+    """Jitted static row slice of a device segment. Eager basic
+    indexing (``seg[1:1+m]``) uploads its start indices host->device
+    at every call — an IMPLICIT transfer the churn path's
+    transfer_guard contract forbids; under jit the indices are
+    compiled constants. One tiny executable per (shape, start, size),
+    cached — the same per-(shape, m) executable cache the eager slice
+    primitive was already paying for."""
+    return jax.lax.slice_in_dim(seg, start, start + size)
+
+
+@jax.jit
+def _seg_meta(seg):
+    """Jitted read of a segment's leading meta pair [affected,
+    changed] — same implicit-index-upload avoidance as _rows_slice."""
+    return jax.lax.slice(seg, (0, 0), (1, 2))[0]
 
 
 @functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
@@ -687,7 +716,7 @@ class PendingDelta:
     pays zero dedicated host time for the readback."""
 
     __slots__ = (
-        "_engine", "segs", "counts", "ch_counts", "k",
+        "_engine", "segs", "counts", "ch_counts", "k", "dslices",
         "consumed", "names", "delta_rows", "readback_bytes",
         "overlap_ms",
     )
@@ -703,6 +732,20 @@ class PendingDelta:
         self.delta_rows = 0
         self.readback_bytes = 0
         self.overlap_ms = 0.0
+        # kick EVERY shard's changed-rows transfer now: each device
+        # copies its own O(changed) slice to host concurrently while
+        # the next event dispatches, so consume time is an apply, not a
+        # serial per-device drain on the readback lane
+        self.dslices = []
+        for seg, m in zip(segs, ch_counts):
+            sl = None
+            if m:
+                if isinstance(seg, jax.Array):
+                    sl = _rows_slice(seg, 1, int(m))
+                    sl.copy_to_host_async()
+                else:  # host shim arrays
+                    sl = seg[1 : 1 + m]
+            self.dslices.append(sl)
 
     def wait(self) -> List[str]:
         if not self.consumed:
@@ -737,6 +780,10 @@ class RouteSweepEngine:
                  frontier_threshold: float = _DEFAULT_FRONTIER_THRESHOLD):
         self.sample_names = tuple(sample_names)
         self.mesh = mesh
+        # the build-time placement contract: under a mesh every
+        # resident gets an explicit NamedSharding (rows striped,
+        # bands/edges replicated) so churn dispatches never reshard
+        self.plan = ShardingPlan(mesh) if mesh is not None else None
         if mesh is not None:
             # every shard must own an equal block of destination rows
             align = align * mesh.devices.size
@@ -777,12 +824,16 @@ class RouteSweepEngine:
         """Backend hook: compile the layout + sweeper for a cold
         build."""
         graph = compile_ell(ls, align=self._align, direction="out")
-        return graph, rs.RouteSweeper(graph, self.sample_names)
+        return graph, rs.RouteSweeper(
+            graph, self.sample_names, plan=self.plan
+        )
 
     def _full_resident(self, graph):
         """Backend hook: the cold full-product dispatch (DR + digests
         resident, packed product back)."""
         if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip cold
+            # build (mesh is None): one device, no axis to spec
             return _full_resident_sweep(
                 self.sweeper.v_t, self.sweeper.w_t,
                 self.sweeper.overloaded,
@@ -839,8 +890,10 @@ class RouteSweepEngine:
         # the packed product stays RESIDENT: every later dispatch diffs
         # its fresh rows against this to compact the readback
         self._packed_dev = packed
+        # explicit gather (device_get): under a mesh np.asarray would
+        # be an implicit cross-device transfer the guard rejects
         self.result = rs.assemble_result(
-            self.sweeper, np.asarray(packed)
+            self.sweeper, jax.device_get(packed)
         )
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
@@ -874,10 +927,14 @@ class RouteSweepEngine:
         samp_v, samp_w = rs._sample_bands(patched, sweeper.sample_ids)
         if samp_v.shape != sweeper.samp_v.shape:
             return False
+        up = (
+            self.plan.replicate if self.plan is not None
+            else jnp.asarray
+        )
         sweeper.samp_v = self.result.samp_v = samp_v
         sweeper.samp_w = self.result.samp_w = samp_w
-        sweeper._samp_v_dev = jnp.asarray(samp_v)
-        sweeper._samp_w_dev = jnp.asarray(samp_w)
+        sweeper._samp_v_dev = up(samp_v)
+        sweeper._samp_w_dev = up(samp_w)
         return True
 
     # -- events ------------------------------------------------------------
@@ -899,6 +956,18 @@ class RouteSweepEngine:
         in_v, in_w, patch_ids, patch_v, patch_w = band_patch_inputs(
             self.sweeper.v_t, self.sweeper.w_t, patched
         )
+        if self.plan is not None:
+            # commit the fresh patch uploads (and any widened band
+            # re-upload) REPLICATED before the replicated_jit patch
+            # dispatch reads them: an uncommitted operand would make
+            # the dispatch replicate it itself — a device-to-device
+            # copy per event (and a transfer_guard violation)
+            up = self.plan.replicate
+            in_v = tuple(up(t) for t in in_v)
+            in_w = tuple(up(t) for t in in_w)
+            patch_ids = tuple(up(t) for t in patch_ids)
+            patch_v = tuple(up(t) for t in patch_v)
+            patch_w = tuple(up(t) for t in patch_w)
         return {
             "patched": patched,
             "in_v": in_v, "in_w": in_w,
@@ -919,6 +988,9 @@ class RouteSweepEngine:
         graph = ctx["patched"]
         if self.mesh is None:
             (new_v, new_w_t, dr, digests, packed_res,
+             # openr-lint: disable=sharding-spec -- single-chip churn
+             # dispatch (mesh is None): no mesh axis to spec; the mesh
+             # branch below rides _sharded_churn_step's shard_map specs
              packed_dev) = _churn_step(
                 ctx["in_v"], ctx["in_w"],
                 ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
@@ -936,13 +1008,11 @@ class RouteSweepEngine:
             ctx["patched_bands"] = (new_v, new_w_t)
             segments = [packed_dev]
         else:
+            self._ensure_residents()
             # band patch in its own small dispatch (see
-            # _patch_bands) — loop-invariant, dispatched once
+            # _patch_bands_fn) — loop-invariant, dispatched once
             if ctx["patched_bands"] is None:
-                ctx["patched_bands"] = _patch_bands(
-                    ctx["in_v"], ctx["in_w"],
-                    ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
-                )
+                ctx["patched_bands"] = self._dispatch_patch(ctx)
             new_v, new_w_t = ctx["patched_bands"]
             dr, digests, packed_res, packed_dev = _sharded_churn_step(
                 new_v, new_w_t,
@@ -956,6 +1026,37 @@ class RouteSweepEngine:
             )
             segments = self._split_segments(packed_dev, k)
         return segments, (new_v, new_w_t, dr, digests, packed_res)
+
+    def _ensure_residents(self) -> None:
+        """Churn-path placement tripwire (mesh engines): the resident
+        DR / digests / packed product must already sit at their
+        planned shardings — the sharded dispatches re-commit them via
+        out_specs, so any mismatch here means something moved them and
+        the next dispatch would pay an XLA reshard. Counted as
+        ops.reshard_events (and corrected) by ShardingPlan.ensure."""
+        plan = self.plan
+        self._dr = plan.ensure(self._dr, plan.rows, "_dr")
+        self._digests_dev = plan.ensure(
+            self._digests_dev, plan.vec, "_digests_dev"
+        )
+        self._packed_dev = plan.ensure(
+            self._packed_dev, plan.rows, "_packed_dev"
+        )
+
+    def _dispatch_patch(self, ctx):
+        """Backend hook: the standalone band-patch dispatch (mesh path;
+        the single-chip engine fuses the patch into the churn step).
+        Under a mesh the patch rides replicated_jit so its outputs are
+        COMMITTED replicated — matching the sharded churn step's
+        replicated in_specs, no broadcast copy at the consumer."""
+        fn = (
+            replicated_jit(_patch_bands_fn, self.mesh)
+            if self.mesh is not None else _patch_bands
+        )
+        return fn(
+            ctx["in_v"], ctx["in_w"],
+            ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
+        )
 
     def _split_segments(self, packed_dev, k: int):
         """Per-shard [k+1, 1+W] segments of a sharded churn readback,
@@ -990,10 +1091,7 @@ class RouteSweepEngine:
         so that dispatch recompiles once — the documented widening
         cost — but the layout itself is never re-derived on host)."""
         if ctx["patched_bands"] is None:
-            ctx["patched_bands"] = _patch_bands(
-                ctx["in_v"], ctx["in_w"],
-                ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
-            )
+            ctx["patched_bands"] = self._dispatch_patch(ctx)
         new_v, new_w_t = ctx["patched_bands"]
         self.sweeper.v_t = new_v
         self.sweeper.w_t = new_w_t
@@ -1047,6 +1145,9 @@ class RouteSweepEngine:
         frontier re-solve: both produce a complete (dr, digests,
         packed) product in one wide dispatch, compact the diff on
         device, and apply only the changed rows on host."""
+        # openr-lint: disable=sharding-spec -- elementwise diff of
+        # two committed operands: propagation keeps their (identical)
+        # placements; overflow rung, not the steady-state churn path
         ch_count, comp = _compact_changed(
             packed, self._packed_dev, self.graph.n
         )
@@ -1060,10 +1161,12 @@ class RouteSweepEngine:
         # at the top bucket (one dispatch) instead of re-climbing the
         # ladder; small events decay the hint back down as usual
         self._k_hint = _ROW_BUCKETS[-1]
-        m = int(ch_count)
+        m = int(jax.device_get(ch_count))
         names: List[str] = []
         if m:
-            names = self._apply_delta_rows(np.asarray(comp[:m]))
+            names = self._apply_delta_rows(
+                jax.device_get(_rows_slice(comp, 0, m))
+            )
         bytes_read = m * comp.shape[1] * 4 + 4  # rows + the scalar
         self.last_delta_rows = m
         self.last_readback_bytes = bytes_read
@@ -1090,7 +1193,11 @@ class RouteSweepEngine:
         _commit_device)."""
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         lim = jnp.asarray([limit], dtype=jnp.float32)
+        if self.plan is not None:
+            lim = self.plan.replicate(lim)
         if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip frontier
+            # probe (mesh is None): no mesh axis to spec
             return _frontier_probe(
                 self.sweeper.v_t, self.sweeper.w_t, self._dr,
                 e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
@@ -1114,6 +1221,8 @@ class RouteSweepEngine:
         O(cone diameter) sweeps instead of O(graph diameter). Expects
         the band patch ALREADY adopted (_apply_patch_resident ran)."""
         if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip frontier
+            # re-solve (mesh is None): no mesh axis to spec
             return _frontier_step(
                 self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
                 self.sweeper.overloaded,
@@ -1166,7 +1275,7 @@ class RouteSweepEngine:
                 reg.counter_bump("route_engine.frontier_errors")
             if probe is not None:
                 cone, meta = probe
-                meta = np.asarray(meta)  # 16-byte policy readback
+                meta = jax.device_get(meta)  # 16-byte policy readback
                 rows, jumps = int(meta[0]), int(meta[2])
                 cells = float(meta[1])
                 converged = bool(meta[3])
@@ -1243,19 +1352,33 @@ class RouteSweepEngine:
         fault_point(FAULT_CONSUME)
         tracer = get_tracer()
         span = tracer.span_active("ops.route_engine.delta_consume")
+        reg = get_registry()
+        sharded = self.mesh is not None
         t0 = time.perf_counter()
         names: List[str] = []
         total_rows = 0
         total_bytes = 0
-        for seg, m in zip(p.segs, p.ch_counts):
+        for seg, sl, m in zip(p.segs, p.dslices, p.ch_counts):
+            t_sh = time.perf_counter()
             # meta row already crossed (retry ladder); count it
-            total_bytes += seg.shape[1] * 4
+            shard_bytes = seg.shape[1] * 4
             if m:
-                names.extend(
-                    self._apply_delta_rows(np.asarray(seg[1 : 1 + m]))
-                )
+                # the per-shard copy was kicked async at PendingDelta
+                # creation: device_get here normally finds the host
+                # value already landed (explicit, guard-exempt)
+                names.extend(self._apply_delta_rows(jax.device_get(sl)))
                 total_rows += m
-                total_bytes += m * seg.shape[1] * 4
+                shard_bytes += m * seg.shape[1] * 4
+            total_bytes += shard_bytes
+            if sharded:
+                reg.counter_bump(
+                    "ops.shard_readback_bytes", shard_bytes
+                )
+                if overlap:
+                    reg.observe(
+                        "ops.shard_consume_overlap_ms",
+                        (time.perf_counter() - t_sh) * 1000.0,
+                    )
         ms = (time.perf_counter() - t0) * 1000.0
         p.names = sorted(set(names))
         p.consumed = True
@@ -1265,7 +1388,6 @@ class RouteSweepEngine:
         self.last_delta_rows = total_rows
         self.last_readback_bytes = total_bytes
         self.last_overlap_ms = p.overlap_ms
-        reg = get_registry()
         reg.observe("ops.delta_rows", float(total_rows))
         reg.observe("ops.readback_bytes", float(total_bytes))
         if overlap:
@@ -1467,9 +1589,13 @@ class RouteSweepEngine:
                 [e_wn, np.full(pad, INF, np.int32)]
             )
 
-        ov_new = jnp.asarray(patched.overloaded)
-        e_dev = (jnp.asarray(e_u), jnp.asarray(e_v),
-                 jnp.asarray(e_wo), jnp.asarray(e_wn))
+        # edge/overload uploads committed REPLICATED under a mesh (the
+        # sharded steps read them with P(None) in_specs; an unplaced
+        # upload would make XLA insert the broadcast on every dispatch)
+        up = self.plan.replicate if self.plan is not None \
+            else jnp.asarray
+        ov_new = up(patched.overloaded)
+        e_dev = (up(e_u), up(e_v), up(e_wo), up(e_wn))
         buckets = [b for b in _ROW_BUCKETS if b >= self._k_hint]
         # segments: per-shard IN-FLIGHT [k+1, 1+W] device arrays (ONE
         # for the single-chip engine), each leading with its own meta
@@ -1490,7 +1616,20 @@ class RouteSweepEngine:
                 # consumed on host while this dispatch solves on device
                 self._consume_pending(overlap=True)
                 overlapped = True
-            metas = [np.asarray(seg[0, :2]) for seg in segments]
+            # kick every shard's 8-byte meta copy before reading any:
+            # the transfers ride all devices' readback lanes
+            # concurrently instead of draining one shard at a time
+            meta_rows = [
+                _seg_meta(seg) if isinstance(seg, jax.Array)
+                else seg[0, :2]
+                for seg in segments
+            ]
+            for mrow in meta_rows:
+                try:
+                    mrow.copy_to_host_async()
+                except AttributeError:
+                    pass
+            metas = [jax.device_get(mrow) for mrow in meta_rows]
             counts = [int(m[0]) for m in metas]
             ch_counts = [int(m[1]) for m in metas]
             if max(counts) <= k:
@@ -1606,8 +1745,7 @@ def _sharded_grouped_full_resident(
     )
 
 
-@jax.jit
-def _patch_segments(w_t, upd_g, upd_s, upd_r, upd_w):
+def _patch_segments_fn(w_t, upd_g, upd_s, upd_r, upd_w):
     """Scatter per-segment weight updates into the (replicated)
     resident segment tensors — the grouped analogue of _patch_bands.
     Padding entries repeat a real update (duplicates write the same
@@ -1616,6 +1754,11 @@ def _patch_segments(w_t, upd_g, upd_s, upd_r, upd_w):
         w.at[g, s, r].set(v)
         for w, g, s, r, v in zip(w_t, upd_g, upd_s, upd_r, upd_w)
     )
+
+
+# single-chip dispatch; mesh engines ride replicated_jit (committed
+# replicated outputs — see _patch_bands)
+_patch_segments = jax.jit(_patch_segments_fn)
 
 
 @functools.partial(
@@ -1735,11 +1878,15 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
     def _compile_backend(self, ls):
         graph = sg.compile_out_grouped(ls, align=self._align)
         self._slots = sg.slot_table(graph)
-        return graph, sg.GroupedRouteSweeper(graph, self.sample_names)
+        return graph, sg.GroupedRouteSweeper(
+            graph, self.sample_names, plan=self.plan
+        )
 
     def _full_resident(self, graph):
         impl = sg.get_grouped_impl()
         if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip cold
+            # build (mesh is None): one device, no axis to spec
             return _grouped_full_resident(
                 self.sweeper.v_t, self.sweeper.w_t,
                 self.sweeper.overloaded,
@@ -1765,10 +1912,14 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         samp_v, samp_w = rs.pack_sample_rows(rows, sweeper.sample_ids)
         if samp_v.shape != sweeper.samp_v.shape:
             return False
+        up = (
+            self.plan.replicate if self.plan is not None
+            else jnp.asarray
+        )
         sweeper.samp_v = self.result.samp_v = samp_v
         sweeper.samp_w = self.result.samp_w = samp_w
-        sweeper._samp_v_dev = jnp.asarray(samp_v)
-        sweeper._samp_w_dev = jnp.asarray(samp_w)
+        sweeper._samp_v_dev = up(samp_v)
+        sweeper._samp_w_dev = up(samp_w)
         return True
 
     def _prepare_patch(self, ls, affected_sorted):
@@ -1784,6 +1935,10 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         # 1-entry no-op rewriting slot (0,0,0) to its CURRENT value
         # (known from the patched host arrays)
         seg_ws = [s.w for b in patched.bands for s in b.segments]
+        up = (
+            self.plan.replicate if self.plan is not None
+            else jnp.asarray
+        )
         upd_g, upd_s, upd_r, upd_w = [], [], [], []
         for si, w_host in enumerate(seg_ws):
             ups = updates.get(si)
@@ -1794,10 +1949,10 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
                 eb *= 2
             ups = ups + [ups[0]] * (eb - len(ups))
             arr = np.asarray(ups, dtype=np.int32)
-            upd_g.append(jnp.asarray(arr[:, 0]))
-            upd_s.append(jnp.asarray(arr[:, 1]))
-            upd_r.append(jnp.asarray(arr[:, 2]))
-            upd_w.append(jnp.asarray(arr[:, 3]))
+            upd_g.append(up(arr[:, 0]))
+            upd_s.append(up(arr[:, 1]))
+            upd_r.append(up(arr[:, 2]))
+            upd_w.append(up(arr[:, 3]))
         return {
             "patched": patched,
             "upd": (tuple(upd_g), tuple(upd_s), tuple(upd_r),
@@ -1814,6 +1969,8 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         upd_g, upd_s, upd_r, upd_w = ctx["upd"]
         if self.mesh is None:
             (new_w, dr, digests, packed_res,
+             # openr-lint: disable=sharding-spec -- single-chip churn
+             # dispatch (mesh is None): no mesh axis to spec
              packed_dev) = _grouped_churn_step(
                 self.sweeper.v_t, self.sweeper.w_t,
                 upd_g, upd_s, upd_r, upd_w,
@@ -1830,10 +1987,9 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             ctx["patched_segs"] = new_w
             segments = [packed_dev]
         else:
+            self._ensure_residents()
             if ctx["patched_segs"] is None:
-                ctx["patched_segs"] = _patch_segments(
-                    self.sweeper.w_t, upd_g, upd_s, upd_r, upd_w
-                )
+                ctx["patched_segs"] = self._dispatch_patch(ctx)
             new_w = ctx["patched_segs"]
             (dr, digests, packed_res,
              packed_dev) = _sharded_grouped_churn_step(
@@ -1866,13 +2022,18 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         (segment SHAPES never change under grouped_patch, so the
         full-width dispatch re-runs without recompiling)."""
         if ctx["patched_segs"] is None:
-            upd_g, upd_s, upd_r, upd_w = ctx["upd"]
-            ctx["patched_segs"] = _patch_segments(
-                self.sweeper.w_t, upd_g, upd_s, upd_r, upd_w
-            )
+            ctx["patched_segs"] = self._dispatch_patch(ctx)
         self.sweeper.w_t = ctx["patched_segs"]
         self.sweeper.overloaded = ov_new
         self.graph = self.sweeper.graph = ctx["patched"]
+
+    def _dispatch_patch(self, ctx):
+        upd_g, upd_s, upd_r, upd_w = ctx["upd"]
+        fn = (
+            replicated_jit(_patch_segments_fn, self.mesh)
+            if self.mesh is not None else _patch_segments
+        )
+        return fn(self.sweeper.w_t, upd_g, upd_s, upd_r, upd_w)
 
     @solve_window
     def _dispatch_frontier_probe(self, ctx, e_dev, limit):

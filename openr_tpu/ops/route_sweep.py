@@ -514,26 +514,35 @@ class RouteSweeper:
     readback. Mirrors spf_sparse.EllState's residency discipline (on
     relay-backed platforms a per-block re-upload costs a round trip)."""
 
-    def __init__(self, graph: EllGraph, sample_names: Sequence[str]):
+    def __init__(self, graph: EllGraph, sample_names: Sequence[str],
+                 plan=None):
         assert graph.direction == "out", "route sweep needs out-edge ELL"
+        # every resident the sharded dispatches read is committed
+        # replicated at build time (parallel.mesh.ShardingPlan) — under
+        # a mesh an unplaced band tensor makes XLA insert a replication
+        # copy on every churn dispatch
+        up = plan.replicate if plan is not None else jnp.asarray
         self.graph = graph
-        self.v_t = tuple(jnp.asarray(s) for s in graph.src)
-        self.w_t = tuple(jnp.asarray(w) for w in graph.w)
-        self.overloaded = jnp.asarray(graph.overloaded)
+        self.plan = plan
+        self.v_t = tuple(up(s) for s in graph.src)
+        self.w_t = tuple(up(w) for w in graph.w)
+        self.overloaded = up(graph.overloaded)
         self.sample_names = tuple(sample_names)
         self.sample_ids = np.asarray(
             [graph.node_index[nm] for nm in self.sample_names],
             dtype=np.int32,
         )
         self.samp_v, self.samp_w = _sample_bands(graph, self.sample_ids)
-        self._samp_ids_dev = jnp.asarray(self.sample_ids)
-        self._samp_v_dev = jnp.asarray(self.samp_v)
-        self._samp_w_dev = jnp.asarray(self.samp_w)
-        self._pos_w_dev = jnp.asarray(canonical_pos_weights(graph))
+        self._samp_ids_dev = up(self.sample_ids)
+        self._samp_v_dev = up(self.samp_v)
+        self._samp_w_dev = up(self.samp_w)
+        self._pos_w_dev = up(canonical_pos_weights(graph))
 
     def solve_block(self, t_ids) -> jnp.ndarray:
         """One destination block -> packed [B, W] int32 (still on
         device; the caller reads it back or chains on it)."""
+        # openr-lint: disable=sharding-spec -- single-chip block solve
+        # (mesh engines dispatch _sharded_full_resident instead)
         return _route_block(
             self.v_t, self.w_t, self.overloaded,
             _as_device_ids(t_ids),

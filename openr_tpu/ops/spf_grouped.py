@@ -566,14 +566,21 @@ class GroupedRouteSweeper:
     producing the identical RouteSweepResult (canonical digests are
     bit-comparable across the two backends)."""
 
-    def __init__(self, graph: GroupedGraph, sample_names: Sequence[str]):
+    def __init__(self, graph: GroupedGraph, sample_names: Sequence[str],
+                 plan=None):
         from openr_tpu.ops import route_sweep as rs
 
         assert graph.direction == "out", "route sweep needs out-edges"
+        # replicated build-time placement under a mesh, mirroring
+        # RouteSweeper (see parallel.mesh.ShardingPlan)
+        up = plan.replicate if plan is not None else jnp.asarray
         self.graph = graph
+        self.plan = plan
         self.meta = band_meta(graph)
-        self.v_t, self.w_t = device_tensors(graph)
-        self.overloaded = jnp.asarray(graph.overloaded)
+        self.v_t, self.w_t = (
+            tuple(up(seg) for seg in t) for t in device_tensors(graph)
+        )
+        self.overloaded = up(graph.overloaded)
         self.sample_names = tuple(sample_names)
         self.sample_ids = np.asarray(
             [graph.node_index[nm] for nm in self.sample_names],
@@ -583,12 +590,14 @@ class GroupedRouteSweeper:
         self.samp_v, self.samp_w = rs.pack_sample_rows(
             rows, self.sample_ids
         )
-        self._samp_ids_dev = jnp.asarray(self.sample_ids)
-        self._samp_v_dev = jnp.asarray(self.samp_v)
-        self._samp_w_dev = jnp.asarray(self.samp_w)
-        self._pos_w_dev = jnp.asarray(rs.canonical_pos_weights(graph))
+        self._samp_ids_dev = up(self.sample_ids)
+        self._samp_v_dev = up(self.samp_v)
+        self._samp_w_dev = up(self.samp_w)
+        self._pos_w_dev = up(rs.canonical_pos_weights(graph))
 
     def solve_block(self, t_ids):
+        # openr-lint: disable=sharding-spec -- single-chip block solve
+        # (mesh engines dispatch their sharded full-resident twin)
         return _grouped_route_block(
             self.v_t, self.w_t, self.overloaded,
             _as_device_ids(t_ids),

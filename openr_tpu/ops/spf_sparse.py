@@ -1418,6 +1418,9 @@ class EllState:
         # path CONSUMES the previous resident distances (d_prev is dead
         # after this dispatch) and self._d_dev is rebound to the fresh
         # output below; no retry ladder re-reads the donated buffer
+        # openr-lint: disable=sharding-spec -- single-chip resident
+        # reconvergence (mesh callers go through the sharded_ell_*
+        # shard_map wrappers): no mesh axis to spec
         self.src, self.w, packed, d = _ell_reconverge(
             in_src, in_w, patch_ids, patch_src, patch_w,
             jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w),
@@ -1780,37 +1783,62 @@ def sharded_ell_all_sources(graph: EllGraph, mesh: Mesh):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
-def _sharded_ell_all_view_rows(
-    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+def _sharded_warm_all_pairs(
+    srcs_t, ws_t, overloaded, d_prev, inc_tail, inc_head, inc_w,
     bands, n, mesh,
 ):
-    """Mesh-sharded twin of _ell_all_view_rows: the all-pairs fixed
-    point runs with source rows sharded over the mesh (1-bit psum
-    vote), and the view/endpoint row gathers run as global-view ops on
-    the sharded matrix (XLA inserts the row collectives). d_all comes
-    back SHARDED — the resident footprint per device is n^2/ndev,
-    which is what lifts the KSP2 engine past the single-chip bound."""
+    """Warm-seeded all-pairs fixed point with source rows sharded over
+    the mesh. The warm seed (_warm_seed) is row-local — its tight test
+    reads whole COLUMNS of d_prev at the increase tails/heads, which
+    every shard's [rows, n] block carries — so d_prev shards along the
+    same axis as the solve and never moves. d_prev is NOT donated on
+    this path (the sharded buffer may still back a caller-held ref;
+    the single-chip dispatch keeps its donation win)."""
     nb = len(srcs_t)
 
-    def shard_fn(ids_blk, *rest):
+    def shard_fn(ids_blk, d_prev_blk, it, ih, iw, *rest):
         srcs_r = rest[:nb]
         ws_r = rest[nb : 2 * nb]
         ov_r = rest[-1]
         return _ell_fixed_point(
             srcs_r, ws_r, ov_r, ids_blk, bands, n,
             vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+            warm=(d_prev_blk, it, ih, iw),
         )
 
-    d_all = shard_map(
+    return shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=tuple(
-            [P(SOURCES_AXIS)] + [P(None, None)] * (2 * nb) + [P(None)]
+            [P(SOURCES_AXIS), P(SOURCES_AXIS, None)]
+            + [P(None)] * 3
+            + [P(None, None)] * (2 * nb)
+            + [P(None)]
         ),
         out_specs=P(SOURCES_AXIS, None),
-    )(jnp.arange(n, dtype=jnp.int32), *srcs_t, *ws_t, overloaded)
+    )(
+        jnp.arange(n, dtype=jnp.int32), d_prev,
+        inc_tail, inc_head, inc_w,
+        *srcs_t, *ws_t, overloaded,
+    )
 
+
+@functools.partial(jax.jit, static_argnames=("bands", "n", "mesh"))
+def _sharded_ell_all_view_rows(
+    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+    inc_tail, inc_head, inc_w, bands, n, mesh,
+):
+    """Mesh-sharded twin of _ell_all_view_rows: the all-pairs fixed
+    point runs with source rows sharded over the mesh (1-bit psum
+    vote), WARM-SEEDED from the row-sharded previous distances, and
+    the view/endpoint row gathers run as global-view ops on the
+    sharded matrix (XLA inserts the row collectives). d_all comes
+    back SHARDED — the resident footprint per device is n^2/ndev,
+    which is what lifts the KSP2 engine past the single-chip bound."""
+    d_all = _sharded_warm_all_pairs(
+        srcs_t, ws_t, overloaded, d_prev, inc_tail, inc_head, inc_w,
+        bands, n, mesh,
+    )
     d = d_all[view_srcs]
     fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
     packed = jnp.concatenate(
@@ -1826,15 +1854,19 @@ def _sharded_ell_all_view_rows(
 
 
 def sharded_ell_all_view_rows(
-    state: "EllState", view_srcs, w_sv, ep_ids, d_prev, mesh: Mesh
+    state: "EllState", view_srcs, w_sv, ep_ids, d_prev, mesh: Mesh,
+    inc=None,
 ):
     """Run the sharded all-sources + view + invalidation-rows dispatch
     on the resident bands. Returns (d_all_dev SHARDED, packed_host).
-    n_pad must divide by the mesh size (the engine gates on this and
-    falls back to the single-chip dispatch otherwise)."""
+    ``inc`` is the increase-edge delta for warm seeding (None forces
+    the cold seed — same contract as ell_all_view_rows); d_prev is NOT
+    donated. n_pad must divide by the mesh size (the engine gates on
+    this and falls back to the single-chip dispatch otherwise)."""
     assert state.graph.n_pad % mesh.devices.size == 0, (
         state.graph.n_pad, mesh.devices.size,
     )
+    inc_t, inc_h, inc_w = _inc_args(inc)
     d_all, packed = _sharded_ell_all_view_rows(
         state.src, state.w, state.overloaded,
         _as_device_ids(view_srcs),
@@ -1842,10 +1874,122 @@ def sharded_ell_all_view_rows(
             np.asarray(w_sv, dtype=np.int32)
         ),
         _as_device_ids(ep_ids),
-        d_prev,
+        d_prev, inc_t, inc_h, inc_w,
         state.graph.bands, state.graph.n_pad, mesh,
     )
-    return d_all, np.asarray(packed)
+    return d_all, jax.device_get(packed)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bands", "n", "k_budget", "mesh")
+)
+def _sharded_ell_all_view_rows_masked(
+    srcs_t, ws_t, overloaded, view_srcs, w_sv, ep_ids, d_prev,
+    inc_tail, inc_head, inc_w, masks_t, dm_old, d_real, src_id,
+    bands, n, k_budget, mesh,
+):
+    """Mesh-sharded twin of _ell_all_view_rows_masked — the 1-RTT
+    speculative KSP2 dispatch on-mesh. Three pieces:
+
+      - the warm-seeded all-pairs fixed point, source rows sharded
+        (see _sharded_warm_all_pairs);
+      - the speculative masked second-path solve, DESTINATION batch
+        sharded (each device owns D_pad/ndev masked solves over the
+        replicated bands — the _sharded_ell_masked layout);
+      - the row diff / budget meta / changed-row gather assembled as
+        global-view ops on the sharded dm_new.
+
+    The destination batch is padded to a mesh multiple by the caller;
+    pad rows are unmasked solves whose rows move every event, so the
+    diff is masked to the first ``d_real`` real rows (a device scalar:
+    the pad width is a compile-time shape, the real count is not).
+    Nothing is donated — matching the plain sharded dispatch (see
+    _sharded_warm_all_pairs on why)."""
+    nb = len(srcs_t)
+    d_all = _sharded_warm_all_pairs(
+        srcs_t, ws_t, overloaded, d_prev, inc_tail, inc_head, inc_w,
+        bands, n, mesh,
+    )
+    d = d_all[view_srcs]
+    fh = _first_hops_from_rows(d, view_srcs, w_sv, overloaded, n)
+
+    def masked_fn(*args):
+        masks_blk = args[:nb]
+        srcs_r = args[nb : 2 * nb]
+        ws_r = args[2 * nb : 3 * nb]
+        ov_r = args[-1]
+        return _ell_masked_fixed_point(
+            srcs_r, ws_r, masks_blk, ov_r, src_id, bands, n,
+            vote=lambda bit: jax.lax.psum(bit, SOURCES_AXIS),
+        )
+
+    b = masks_t[0].shape[0]
+    dm_new = shard_map(
+        masked_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS, None, None)] * nb  # masks: batch-sharded
+            + [P(None, None)] * (2 * nb)  # bands replicated
+            + [P(None)]
+        ),
+        out_specs=P(SOURCES_AXIS, None),
+    )(*masks_t, *srcs_t, *ws_t, overloaded)
+
+    valid = jnp.arange(b, dtype=jnp.int32) < d_real
+    row_changed = valid & jnp.any(dm_new != dm_old, axis=1)  # [D_pad]
+    changed_ids = jnp.nonzero(
+        row_changed, size=k_budget, fill_value=-1
+    )[0].astype(jnp.int32)
+    count = jnp.sum(row_changed.astype(jnp.int32))
+    meta = jnp.full((n,), -1, dtype=jnp.int32)
+    meta = meta.at[:k_budget].set(changed_ids)
+    meta = meta.at[k_budget].set(count)
+    changed_rows = dm_new[jnp.clip(changed_ids, 0, b - 1)]  # [K, n]
+
+    packed = jnp.concatenate(
+        [
+            d,
+            fh.astype(jnp.int32),
+            d_all[ep_ids],
+            d_prev[ep_ids],
+            meta[None, :],
+            changed_rows,
+        ],
+        axis=0,
+    )
+    return d_all, dm_new, packed
+
+
+def sharded_ell_all_view_rows_masked(
+    state: "EllState", view_srcs, w_sv, ep_ids, d_prev,
+    masks_t, dm_old, src_id: int, k_budget: int, d_real: int,
+    mesh: Mesh, inc=None,
+):
+    """Run the fused speculative dispatch on-mesh. Returns
+    (d_all_dev SHARDED, dm_new_dev SHARDED, packed_host).
+    ``d_real`` is the count of REAL destination rows in the padded
+    masks batch (pad rows are excluded from the changed-row diff);
+    ``inc`` as in ell_all_view_rows_masked. Unlike the single-chip
+    twin nothing is donated."""
+    assert state.graph.n_pad % mesh.devices.size == 0, (
+        state.graph.n_pad, mesh.devices.size,
+    )
+    assert masks_t[0].shape[0] % mesh.devices.size == 0, (
+        masks_t[0].shape[0], mesh.devices.size,
+    )
+    inc_t, inc_h, inc_w = _inc_args(inc)
+    d_all, dm_new, packed = _sharded_ell_all_view_rows_masked(
+        state.src, state.w, state.overloaded,
+        _as_device_ids(view_srcs),
+        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+            np.asarray(w_sv, dtype=np.int32)
+        ),
+        _as_device_ids(ep_ids),
+        d_prev, inc_t, inc_h, inc_w, masks_t, dm_old,
+        jnp.int32(d_real), src_id,
+        state.graph.bands, state.graph.n_pad, k_budget, mesh,
+    )
+    return d_all, dm_new, jax.device_get(packed)
 
 
 def sharded_ell_masked_distances_resident(

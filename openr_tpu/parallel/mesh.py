@@ -46,6 +46,82 @@ def pad_for_mesh(n: int, mesh: Mesh, align: int = 128) -> int:
     return max(block, ((n + block - 1) // block) * block)
 
 
+class ShardingPlan:
+    """Build-time placement contract for the mesh-sharded engines.
+
+    Every resident buffer the sharded dispatches touch gets an explicit
+    ``NamedSharding`` at creation so the steady-state churn path never
+    pays an XLA-inserted reshard or replication copy: row-striped
+    residents (`[n_pad, ...]` products, digests) live on the source
+    axis, the band/segment topology tensors and small edge uploads are
+    replicated to every device, and destination-batched KSP2 masks are
+    striped over the same axis by batch row.
+
+    ``ensure`` is the churn-path tripwire: it verifies an operand is
+    already committed to its planned placement, and when it is not it
+    bumps ``ops.reshard_events`` and corrects the placement with an
+    explicit ``device_put`` — so the acceptance gate
+    (``ops.reshard_events == 0`` across a churn run) measures real
+    placement discipline rather than hoping ``jax.transfer_guard``
+    notices (device-to-device resharding is invisible to the guard).
+    """
+
+    __slots__ = ("mesh", "axis", "rows", "vec", "batch3", "replicated")
+
+    def __init__(self, mesh: Mesh, axis: str = SOURCES_AXIS) -> None:
+        self.mesh = mesh
+        self.axis = axis
+        # [n_pad, W]-shaped residents, striped by source row
+        self.rows = NamedSharding(mesh, P(axis, None))
+        # [n_pad] per-row vectors (digests)
+        self.vec = NamedSharding(mesh, P(axis))
+        # [B, slots, k] destination-batched mask stacks, striped by batch
+        self.batch3 = NamedSharding(mesh, P(axis, None, None))
+        # topology bands / edge uploads / overload vector: every device
+        # reads all of it, so commit a replica per device up front
+        self.replicated = NamedSharding(mesh, P())
+
+    def place(self, x, sharding: NamedSharding) -> jnp.ndarray:
+        """Explicit build-time placement (host->device; transfer-guard
+        exempt because device_put is an explicit transfer)."""
+        return jax.device_put(jnp.asarray(x), sharding)
+
+    def shard_rows(self, x) -> jnp.ndarray:
+        return self.place(x, self.rows if np.ndim(x) > 1 else self.vec)
+
+    def replicate(self, x) -> jnp.ndarray:
+        return self.place(x, self.replicated)
+
+    def ensure(self, x: jnp.ndarray, sharding: NamedSharding,
+               name: str = "") -> jnp.ndarray:
+        """Churn-path placement check: already-committed-as-planned is a
+        no-op; anything else is a reshard event (counted, then fixed)."""
+        cur = getattr(x, "sharding", None)
+        if cur is not None and cur.is_equivalent_to(sharding, x.ndim):
+            return x
+        from openr_tpu.telemetry import get_registry
+
+        get_registry().counter_bump("ops.reshard_events")
+        return jax.device_put(x, sharding)
+
+
+@functools.lru_cache(maxsize=None)
+def replicated_jit(fn, mesh: Mesh):
+    """A jitted dispatch of ``fn`` whose every input and output is
+    committed replicated across ``mesh``.
+
+    Used for the small patch dispatches (`_patch_bands` /
+    `_patch_segments`): their outputs feed the shard_map churn
+    dispatches as replicated operands, so committing them replicated at
+    the producer keeps XLA from inserting a broadcast copy at the
+    consumer (SNIPPETS.md [2]: out specs of one dispatch must match the
+    in specs of the next). A single NamedSharding broadcasts as a
+    pytree prefix over every argument/result.
+    """
+    rep = NamedSharding(mesh, P())
+    return jax.jit(fn, in_shardings=rep, out_shardings=rep)
+
+
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def sharded_all_sources(
     w: jnp.ndarray, overloaded: jnp.ndarray, mesh: Mesh

@@ -956,5 +956,120 @@ def test_cli_list_rules(capsys):
         "lock-order",
         "span-discipline",
         "retrace-risk",
+        "sharding-spec",
     ):
         assert rid in out
+
+
+# ---------------------------------------------------------------------
+# sharding-spec
+# ---------------------------------------------------------------------
+
+SHARDING_PREAMBLE = """\
+    import functools
+    import jax
+    from openr_tpu.analysis.annotations import resident_buffers
+"""
+
+
+def lint_ops(tmp_path, source, relpath="openr_tpu/ops/snippet.py"):
+    """Fixture module written INSIDE the checked surface (the rule
+    only fires under openr_tpu/ops/ and openr_tpu/decision/)."""
+    target = tmp_path / relpath
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return run_analysis(str(tmp_path), targets=(relpath,))
+
+
+def test_sharding_bare_jit_taking_resident_trips(tmp_path):
+    report = lint_ops(tmp_path, SHARDING_PREAMBLE + """
+    @jax.jit
+    def step(dr, x):
+        return dr + x
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, x):
+            return step(self._dr, x)
+    """)
+    hits = rule_hits(report, "sharding-spec")
+    assert len(hits) == 1
+    assert "_dr" in hits[0].message
+
+
+def test_sharding_declared_jit_is_clean(tmp_path):
+    report = lint_ops(tmp_path, SHARDING_PREAMBLE + """
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def plain(dr, n):
+        return dr * n
+
+    @functools.partial(
+        jax.jit, in_shardings=None, out_shardings=None
+    )
+    def specced(dr, x):
+        return dr + x
+
+    def _impl(dr, x):
+        return dr + x
+
+    bound = jax.jit(_impl, out_shardings=None)
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, x):
+            return specced(self._dr, x) + bound(self._dr, x)
+    """)
+    assert rule_hits(report, "sharding-spec") == []
+
+
+def test_sharding_shard_map_body_counts_as_declared(tmp_path):
+    report = lint_ops(tmp_path, SHARDING_PREAMBLE + """
+    from openr_tpu.utils.jax_compat import shard_map
+
+    @functools.partial(jax.jit, static_argnames=("mesh",))
+    def sharded_step(dr, mesh):
+        return shard_map(lambda b: b, mesh=mesh)(dr)
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, mesh):
+            return sharded_step(self._dr, mesh)
+    """)
+    assert rule_hits(report, "sharding-spec") == []
+
+
+def test_sharding_outside_checked_dirs_is_clean(tmp_path):
+    report = lint_ops(
+        tmp_path,
+        SHARDING_PREAMBLE + """
+    @jax.jit
+    def step(dr, x):
+        return dr + x
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, x):
+            return step(self._dr, x)
+    """,
+        relpath="openr_tpu/telemetry/snippet.py",
+    )
+    assert rule_hits(report, "sharding-spec") == []
+
+
+def test_sharding_suppressed_with_reason(tmp_path):
+    report = lint_ops(tmp_path, SHARDING_PREAMBLE + """
+    @jax.jit
+    def step(dr, x):
+        return dr + x
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, x):
+            # openr-lint: disable=sharding-spec -- single-chip engine
+            return step(self._dr, x)
+    """)
+    assert rule_hits(report, "sharding-spec") == []
+    assert any(
+        f.rule == "sharding-spec" and f.suppressed
+        for f in report.findings
+    )

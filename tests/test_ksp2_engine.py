@@ -852,8 +852,10 @@ class TestMeshShardedEngine:
     """The engine's all-pairs residency sharded over the device mesh
     (set_engine_mesh): per-device footprint n^2/ndev, activation bound
     scaled by sqrt(ndev) — the path past the single-chip 12k ceiling.
-    Sharded mode runs the plain incremental dispatch (the speculative
-    resident-masks fast path stays single-chip)."""
+    The speculative resident-masks fast path runs mesh-wide too: the
+    destination batch pads to a device multiple and the mask stack /
+    dm residents stripe over the batch axis; when it cannot engage the
+    drop is typed (decision.ksp2.spec_mesh_fallbacks), never silent."""
 
     @pytest.fixture()
     def engine_mesh(self):
@@ -910,6 +912,82 @@ class TestMeshShardedEngine:
             SPF_COUNTERS["decision.ksp2_host_fallbacks"]
             == before["decision.ksp2_host_fallbacks"]
         )
+
+    def test_mesh_fast_path_engages(self, engine_mesh):
+        """The speculative resident-masks fast path must run ON the
+        mesh: mask/dm residents padded to a device multiple and
+        batch-striped, warm dispatches counted, zero typed fallbacks —
+        and routes stay host-exact through churn (no silent drop to
+        the plain dispatch, let alone single-chip)."""
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        _t2, area_h, ps_h = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        rsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("rsw"))
+        dev = SpfSolver(rsw, backend="device")
+        host = SpfSolver(rsw, backend="host")
+        before = dict(SPF_COUNTERS)
+        d = dev.build_route_db(rsw, area_d, ps)
+        h = host.build_route_db(rsw, area_h, ps_h)
+        assert d.to_route_db(rsw) == h.to_route_db(rsw), "cold"
+        engine = next(iter(dev._ksp2_engines.values()))
+        assert engine._mesh is not None
+        assert engine.masks_t is not None, (
+            "speculative fast path must engage on-mesh"
+        )
+        ndev = engine_mesh.devices.size
+        assert engine.masks_t[0].shape[0] % ndev == 0, "padded batch"
+        assert engine.dm_dev.shape[0] == engine.masks_t[0].shape[0]
+        for step in range(4):
+            _mutate_metric(ls_d, fsw, 0, 2 + step % 3)
+            _mutate_metric(ls_h, fsw, 0, 2 + step % 3)
+            d = dev.build_route_db(rsw, area_d, ps)
+            h = host.build_route_db(rsw, area_h, ps_h)
+            assert d.to_route_db(rsw) == h.to_route_db(rsw), step
+        assert (
+            SPF_COUNTERS["decision.ksp2_warm_dispatches"]
+            > before["decision.ksp2_warm_dispatches"]
+        ), "sharded metric churn must count warm speculative dispatches"
+        assert (
+            SPF_COUNTERS["decision.ksp2.spec_mesh_fallbacks"]
+            == before["decision.ksp2.spec_mesh_fallbacks"]
+        ), "the fast path engaged: no fallback may be recorded"
+
+    def test_mesh_fallback_is_typed(self, engine_mesh, monkeypatch):
+        """When the padded mask stack exceeds the device budget the
+        mesh fast path refuses LOUDLY — typed counter bumped — while
+        the plain sharded dispatch keeps routes host-exact."""
+        from openr_tpu.decision import spf_solver as ss
+
+        monkeypatch.setattr(ss, "KSP2_DEVICE_MASK_BUDGET", 1)
+        topo, area_d, ps = _ksp2_network("fabric", 120)
+        _t2, area_h, ps_h = _ksp2_network("fabric", 120)
+        (ls_d,) = area_d.values()
+        (ls_h,) = area_h.values()
+        fsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("fsw"))
+        rsw = next(k for k in sorted(topo.adj_dbs)
+                   if k.startswith("rsw"))
+        dev = SpfSolver(rsw, backend="device")
+        host = SpfSolver(rsw, backend="host")
+        before = dict(SPF_COUNTERS)
+        d = dev.build_route_db(rsw, area_d, ps)
+        h = host.build_route_db(rsw, area_h, ps_h)
+        assert d.to_route_db(rsw) == h.to_route_db(rsw), "cold"
+        engine = next(iter(dev._ksp2_engines.values()))
+        assert engine.masks_t is None
+        assert (
+            SPF_COUNTERS["decision.ksp2.spec_mesh_fallbacks"]
+            > before["decision.ksp2.spec_mesh_fallbacks"]
+        ), "budget refusal must be typed, not silent"
+        _mutate_metric(ls_d, fsw, 0, 7)
+        _mutate_metric(ls_h, fsw, 0, 7)
+        d = dev.build_route_db(rsw, area_d, ps)
+        h = host.build_route_db(rsw, area_h, ps_h)
+        assert d.to_route_db(rsw) == h.to_route_db(rsw), "churn"
 
     def test_activates_past_single_chip_bound(self, engine_mesh,
                                               monkeypatch):
