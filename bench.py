@@ -473,6 +473,30 @@ def _run() -> dict:
             except Exception as e:
                 bench_shchurn = {"error": f"{type(e).__name__}: {e}"}
 
+    # ninth leg: sustained-load service-plane run — the seeded
+    # open-loop generator driving the REAL KvStore -> Decision -> Fib
+    # pipeline at a fixed rate with admission control + pipelined emit,
+    # plus a max-sustainable-rate estimate and the shed-by-coalescing
+    # oracle-parity verdict (tools/load_report.py is the CI gate; this
+    # leg folds the same numbers into the official bench artifact)
+    bench_load = None
+    if os.environ.get("OPENR_BENCH_LOAD") == "1":
+        if leg_elapsed() > 540:
+            bench_load = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import sustained_load_bench
+
+                bench_load = sustained_load_bench(
+                    int(os.environ.get("OPENR_BENCH_LOAD_NODES", "1000")),
+                    rate=240,
+                    duration_s=4.0,
+                )
+            except Exception as e:
+                bench_load = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -548,6 +572,7 @@ def _run() -> dict:
         "bench_sp_solver_churn": bench_spsolver,
         "bench_sharded_churn": bench_shchurn,
         "bench_convergence_trace": bench_traces,
+        "bench_sustained_load": bench_load,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -618,11 +643,13 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_KSP2"] = "1"
         env["OPENR_BENCH_ROUTES"] = "1"
         env["OPENR_BENCH_TRACES"] = "1"
+        env["OPENR_BENCH_LOAD"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
         env.pop("OPENR_BENCH_ROUTES", None)
         env.pop("OPENR_BENCH_TRACES", None)
+        env.pop("OPENR_BENCH_LOAD", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -1202,6 +1202,57 @@ def sharded_churn_bench(
     return out
 
 
+def sustained_load_bench(
+    nodes: int = 1000, rate: int = 240, duration_s: float = 4.0,
+    p99_slo_ms: float = 5000.0, seed: int = 20260805,
+) -> dict:
+    """Sustained-load leg through the REAL KvStore→Decision→Fib
+    pipeline (openr_tpu.load): a seeded open-loop publication stream at
+    a fixed target rate with admission control (shed-by-coalescing +
+    rate-adaptive debounce) and the pipelined Decision emit stage on,
+    followed by a short binary-search max-sustainable-rate estimate
+    against the p99 convergence SLO. Reports the e2e latency
+    distribution, shed/coalesce counters, queue high-watermark, and the
+    oracle-parity verdict (shedded live RouteDatabase vs unshedded
+    replay)."""
+    from openr_tpu.load import AdmissionConfig
+    from openr_tpu.load.harness import SustainedLoadHarness
+
+    harness = SustainedLoadHarness(
+        nodes=nodes,
+        seed=seed,
+        solver_backend="host",
+        debounce_max_s=0.05,
+        admission=AdmissionConfig(shed_depth=4, cap_s=0.5),
+        pipelined_emit=True,
+    )
+    t0 = time.perf_counter()
+    harness.start(initial_timeout_s=600.0)
+    start_s = time.perf_counter() - t0
+    try:
+        rep = harness.run_fixed_rate(
+            rate, duration_s, p99_slo_ms=p99_slo_ms
+        )
+        search = harness.find_max_sustainable_rate(
+            p99_slo_ms=p99_slo_ms,
+            lo=max(25, rate // 2),
+            hi=rate * 2,
+            duration_s=max(1.5, duration_s / 2),
+            max_probes=3,
+        )
+        parity = harness.check_parity()
+    finally:
+        harness.stop()
+    out = rep.to_dict()
+    out["bench"] = f"scale.sustained_load_{nodes}_e2e_ms"
+    out["start_s"] = round(start_s, 3)
+    out["median_ms"] = out["e2e_ms"]["p50"]
+    out["p99_ms"] = out["e2e_ms"]["p99"]
+    out["max_sustainable"] = search
+    out["oracle_parity"] = bool(parity)
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)

@@ -19,6 +19,12 @@ mystery counter hours later. This rule pushes the check to lint time:
   of that ``try``: the supervisor's contract is that failures re-raise
   through the handler after stamping the span, so close-in-except is a
   protected exit path there by construction, not via suppression;
+- a span that transferred ownership into an *attribute* (the debounce
+  span held across a window) must not be cleared (``self.x = None``)
+  by a method that neither closes it nor reads it out first — that is
+  exactly the overload-path leak where ``reset()`` drops an open
+  ``decision.debounce`` span while a rebuild is in flight.
+  ``__init__`` is exempt (declaring the slot is not a clear);
 - literal metric and span names (``counter_bump`` / ``counter_set`` /
   ``observe`` / ``histogram`` / ``begin_span`` / ``span_active``) must
   match the fb303 dotted convention ``component.sub.metric`` —
@@ -95,6 +101,10 @@ class SpanDisciplineRule(Rule):
         findings.extend(self._check_names(sf))
         for fn, _cls in sf.functions():
             findings.extend(self._check_spans(sf, fn))
+        assert sf.tree is not None
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_attr_clears(sf, node))
         return findings
 
     # -- metric / span naming ----------------------------------------
@@ -218,6 +228,91 @@ class SpanDisciplineRule(Rule):
                     )
                     break
         return findings
+
+    # -- span-attribute clears ----------------------------------------
+
+    def _check_attr_clears(
+        self, sf: SourceFile, cls: ast.ClassDef
+    ) -> Iterable[Finding]:
+        """A ``self.<attr> = None`` that drops a span-holding attribute
+        without first closing it (end_span*) or reading it out (into a
+        local / call / return) leaks the open span. This is the
+        overload-reset leak: a method that wipes state while a span is
+        still riding the attribute."""
+        methods = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        # pass 1: which attributes ever hold a span? Either assigned a
+        # span-opening call directly, or assigned a local that was bound
+        # to one in the same method.
+        span_attrs: Set[str] = set()
+        for fn in methods:
+            opens: Set[str] = set()
+            for node in _own_nodes(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if self._opener_in(node.value):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            opens.add(tgt.id)
+                        elif self._is_self_attr(tgt):
+                            span_attrs.add(tgt.attr)
+                elif isinstance(node.value, ast.Name) and node.value.id in opens:
+                    for tgt in node.targets:
+                        if self._is_self_attr(tgt):
+                            span_attrs.add(tgt.attr)
+        if not span_attrs:
+            return []
+        # pass 2: find clears that neither close nor read out first
+        findings: List[Finding] = []
+        for fn in methods:
+            if fn.name == "__init__":
+                continue  # declaring the slot is not a clear
+            clears: List[Tuple[str, int, int]] = []
+            reads: Dict[str, List[int]] = {}
+            for node in _own_nodes(fn):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if (
+                            self._is_self_attr(tgt)
+                            and tgt.attr in span_attrs
+                            and isinstance(node.value, ast.Constant)
+                            and node.value.value is None
+                        ):
+                            clears.append(
+                                (tgt.attr, node.lineno, node.col_offset)
+                            )
+                if isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    if (
+                        self._is_self_attr(node)
+                        and node.attr in span_attrs
+                    ):
+                        reads.setdefault(node.attr, []).append(node.lineno)
+            for attr, line, col in clears:
+                if any(r <= line for r in reads.get(attr, [])):
+                    continue  # read out (or closed via a read) first
+                findings.append(
+                    Finding(
+                        self.id, sf.path, line, col,
+                        f"clearing span attribute 'self.{attr}' without "
+                        "closing it or reading it out first leaks the "
+                        "open span on this path (end_span it, or bind "
+                        "it to a local before the clear)",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _is_self_attr(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        )
 
     def _opener_in(self, expr: ast.expr) -> Optional[str]:
         for sub in ast.walk(expr):

@@ -23,6 +23,7 @@ The solver behind it runs the TPU kernels (see spf_solver.py).
 from __future__ import annotations
 
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Set, Tuple
 
 from openr_tpu.decision.prefix_state import PrefixState
@@ -40,6 +41,7 @@ from openr_tpu.types import (
 )
 from openr_tpu.analysis.annotations import fault_boundary, solve_window
 from openr_tpu.faults.supervisor import DegradationSupervisor
+from openr_tpu.load.admission import AdmissionControl
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
@@ -145,13 +147,24 @@ class DecisionPendingUpdates:
             )
         return trace
 
+    def release_trace(self) -> None:
+        """Reclaim an adopted trace that will never reach a rebuild
+        (overload resets, teardown): the ``decision.debounce`` span MUST
+        close on this path too, or sustained load leaks one open span
+        per reset and the smoke gate's well-formedness check trips."""
+        trace, span = self.trace, self._debounce_span
+        self.trace = None
+        self._debounce_span = None
+        if trace is not None and span is not None:
+            trace.end_span(span, aborted=True)
+            get_registry().counter_bump("decision.debounce_spans_reclaimed")
+
     def reset(self) -> None:
         self.count = 0
         self.perf_events = None
         self._needs_full_rebuild = False
         self.updated_prefixes = set()
-        self.trace = None
-        self._debounce_span = None
+        self.release_trace()
 
 
 class Decision:
@@ -171,6 +184,9 @@ class Decision:
         enable_best_route_selection: bool = True,
         solver_backend: str = "device",
         enable_rib_policy: bool = True,
+        admission: Optional[AdmissionControl] = None,
+        pipelined_emit: bool = False,
+        kvstore_reader_maxlen: Optional[int] = None,
     ):
         self._enable_rib_policy = enable_rib_policy
         self.my_node_name = my_node_name
@@ -221,16 +237,38 @@ class Decision:
         self._rebuild_debounced = AsyncDebounce(
             self.evb, debounce_min_s, debounce_max_s, self._on_debounce_fire
         )
+        # admission/backpressure path (service plane): the controller
+        # adapts the debounce ceiling to the reader backlog, and the
+        # consume path sheds-by-coalescing once the backlog is deep
+        self._admission = admission
+        if self._admission is not None:
+            self._admission.bind_debounce(
+                self._rebuild_debounced, debounce_max_s
+            )
+        # pipelined emit: the diff/apply/publish tail of a rebuild runs
+        # on a single-worker FIFO executor so event N+1's solve can
+        # dispatch while event N's routes are still being derived and
+        # programmed (PendingDelta double-buffering, one layer up). The
+        # worker is the sole owner of route_db once enabled.
+        self._pipelined_emit = pipelined_emit
+        self._emit_executor: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"decision-emit:{my_node_name}"
+            )
+            if pipelined_emit
+            else None
+        )
+        self._emit_future: Optional[Future] = None
         self._cold_start_until = (
             time.monotonic() + cold_start_s if cold_start_s > 0 else 0.0
         )
         if cold_start_s > 0:
             self.evb.schedule_timeout(cold_start_s, self._on_cold_start_done)
 
-        self.evb.add_queue_reader(
-            kvstore_updates_queue.get_reader(f"decision:{my_node_name}"),
-            self._on_publication,
+        self._kv_reader = kvstore_updates_queue.get_reader(
+            f"decision:{my_node_name}", maxlen=kvstore_reader_maxlen
         )
+        self.evb.add_queue_reader(self._kv_reader, self._on_publication)
         if static_routes_queue is not None:
             self.evb.add_queue_reader(
                 static_routes_queue.get_reader(f"decision:{my_node_name}"),
@@ -246,18 +284,44 @@ class Decision:
     def stop(self) -> None:
         self.evb.stop()
         self.evb.join()
+        if self._emit_executor is not None:
+            if self._emit_future is not None:
+                try:
+                    self._emit_future.result(timeout=10.0)
+                except Exception:  # noqa: BLE001 - drained best-effort
+                    pass
+                self._emit_future = None
+            self._emit_executor.shutdown(wait=True)
 
     # -- queue handlers (run on the module thread) ------------------------
 
     def _on_publication(self, pub: Publication) -> None:
-        self.counters["decision.publications"] += 1
-        self.process_publication(pub)
+        if self._admission is not None:
+            # admission path: observe backlog depth (adapting the
+            # debounce ceiling) and, under a deep backlog, drain +
+            # coalesce it into net-effect publications — superseded
+            # per-key versions are shed, net state is untouched
+            batch = self._admission.admit(pub, self._kv_reader)
+            pubs, traces = batch.publications, batch.traces
+            self.counters["decision.publications"] += batch.pubs_in
+        else:
+            pubs, traces = [pub], [pub.trace]
+            self.counters["decision.publications"] += 1
+        for p in pubs:
+            self.process_publication(p)
         if self.pending.needs_route_update():
-            self.pending.adopt_trace(pub.trace)
-        elif pub.trace is not None:
-            # publication with no route impact (e.g. fibtime keys):
-            # the trace dies here, visibly
-            get_registry().counter_bump("telemetry.traces_no_route_impact")
+            # arrival order: the first (oldest) trace wins the window,
+            # later ones are counted merged — same rule as perf_events
+            for trace in traces:
+                self.pending.adopt_trace(trace)
+        else:
+            for trace in traces:
+                if trace is not None:
+                    # publication with no route impact (e.g. fibtime
+                    # keys): the trace dies here, visibly
+                    get_registry().counter_bump(
+                        "telemetry.traces_no_route_impact"
+                    )
         if self.pending.needs_route_update():
             # overlap the device-side delta application with the
             # debounce window: the band scatter for this publication's
@@ -266,7 +330,10 @@ class Decision:
             # resident bands are already patched (and the previous
             # event's RouteDatabase delta emission ran concurrently
             # with the scatter instead of ahead of it)
-            self.spf_solver.prewarm(self.area_link_states)
+            if self._admission is None or self._admission.allow_prewarm(
+                self._kv_reader.size()
+            ):
+                self.spf_solver.prewarm(self.area_link_states)
             self._rebuild_debounced()
 
     def _on_static_routes(self, delta) -> None:
@@ -499,9 +566,9 @@ class Decision:
         # propagates to the event loop after the finally closes the
         # trace span; pending is NOT reset on that path, so the next
         # publication retriggers the rebuild.
-        update = None
+        payload = None
         try:
-            update = self.supervisor.run(
+            payload = self.supervisor.run(
                 (
                     (
                         "warm",
@@ -536,39 +603,80 @@ class Decision:
             )
             if trace is not None:
                 tracer.deactivate()
-                trace.end_span(
-                    rebuild_span,
-                    routes_updated=(
-                        len(update.unicast_routes_to_update)
-                        if update is not None
-                        else -1
-                    ),
-                    routes_deleted=(
-                        len(update.unicast_routes_to_delete)
-                        if update is not None
-                        else -1
-                    ),
-                )
+                if payload is None:
+                    # ladder exhausted: no emit stage will run for this
+                    # rebuild, so the span closes here
+                    trace.end_span(
+                        rebuild_span, routes_updated=-1, routes_deleted=-1
+                    )
 
-        self.route_db.update(update)
         self.pending.add_event("ROUTE_UPDATE")
-        update.perf_events = self.pending.move_out_events()
-        update.trace = trace
+        perf_events = self.pending.move_out_events()
         self.pending.reset()
+        if self._emit_executor is not None:
+            # double-buffered handoff: at most one emit in flight. The
+            # wait lands AFTER this event's solve, so emit N overlapped
+            # solve N+1; the single worker keeps route_db mutation and
+            # queue pushes strictly FIFO.
+            self._drain_emit()
+            self._emit_future = self._emit_executor.submit(
+                self._emit_update, payload, trace, rebuild_span, perf_events
+            )
+        else:
+            self._emit_update(payload, trace, rebuild_span, perf_events)
+
+    def _drain_emit(self) -> None:
+        if self._emit_future is not None:
+            try:
+                self._emit_future.result()
+            except Exception:  # noqa: BLE001 - counted, never kills evb
+                get_registry().counter_bump("decision.emit_errors")
+            self._emit_future = None
+
+    def _emit_update(
+        self, payload, trace, rebuild_span, perf_events
+    ) -> None:
+        """Emit stage of a rebuild: diff the solved db against the
+        installed one, apply, and publish. In pipelined mode this runs
+        on the single-worker emit executor (which then exclusively owns
+        route_db); in eager mode it runs inline on the module thread."""
+        kind, value = payload
+        if kind == "db":
+            # the diff runs HERE, not in the solve rung: route_db is
+            # mutated by this stage, so reading it from the (possibly
+            # concurrent) solve would race in pipelined mode
+            update = self.route_db.calculate_update(value)
+        else:
+            update = value
+        if trace is not None:
+            trace.end_span(
+                rebuild_span,
+                routes_updated=len(update.unicast_routes_to_update),
+                routes_deleted=len(update.unicast_routes_to_delete),
+            )
+        self.route_db.update(update)
+        update.perf_events = perf_events
+        update.trace = trace
         self.route_updates_queue.push(update)
 
     @fault_boundary
     def _solve_update(
         self, full: bool, reset: bool, backend: str
-    ) -> DecisionRouteUpdate:
-        """One ladder rung: compute the DecisionRouteUpdate for this
-        rebuild. ``reset`` drops every device-derived cache first (so a
-        torn dispatch can't leak into the result); a backend flip does
-        the same implicitly. A reset or flip forces the full-rebuild
-        branch even for a per-prefix batch — the full route db is a
-        superset of the per-prefix entries and ``calculate_update``
+    ) -> Tuple[str, object]:
+        """One ladder rung: compute this rebuild's routes. ``reset``
+        drops every device-derived cache first (so a torn dispatch
+        can't leak into the result); a backend flip does the same
+        implicitly. A reset or flip forces the full-rebuild branch even
+        for a per-prefix batch — the full route db is a superset of the
+        per-prefix entries and the emit stage's ``calculate_update``
         diffs against the installed db, so the emitted delta is
-        identical."""
+        identical.
+
+        Returns an emit payload — ``("db", DecisionRouteDb)`` for a
+        full build (the emit stage diffs it against the installed db)
+        or ``("delta", DecisionRouteUpdate)`` for the per-prefix
+        incremental pass — so the rung itself never touches route_db
+        and can overlap the previous event's emit."""
         flipped = self.spf_solver.backend != backend
         if reset:
             self.spf_solver.reset_device_state()
@@ -584,7 +692,7 @@ class Decision:
             )
             if self.rib_policy is not None and self.rib_policy.is_active():
                 self.rib_policy.apply_policy(new_db.unicast_routes)
-            update = self.route_db.calculate_update(new_db)
+            return ("db", new_db)
         else:
             for prefix in self.pending.updated_prefixes:
                 entry = self.spf_solver.create_route_for_prefix(
@@ -602,7 +710,7 @@ class Decision:
                     update.unicast_routes_to_update
                 )
                 update.unicast_routes_to_delete.extend(change.deleted_routes)
-        return update
+        return ("delta", update)
 
     # -- public (thread-safe) APIs ---------------------------------------
 

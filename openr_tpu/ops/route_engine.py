@@ -790,6 +790,14 @@ class RouteSweepEngine:
         self._align = align
         self._k_hint = _ROW_BUCKETS[0]
         self._pending: Optional[PendingDelta] = None
+        # service-plane visibility into the dispatch-level double
+        # buffer: 1 while a delta-compacted readback is in flight
+        # (consumed inside the next churn's dispatch window) — the same
+        # overlap the Decision emit stage applies one layer up
+        get_registry().gauge(
+            "ops.pending_delta_inflight",
+            lambda: float(self._pending is not None),
+        )
         self.last_delta_rows = 0
         self.last_readback_bytes = 0
         self.last_overlap_ms = 0.0

@@ -157,6 +157,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=ring)
         self._tls = threading.local()
+        # finish listeners: the sustained-load harness samples e2e per
+        # retired trace through these instead of polling the ring (the
+        # 256-deep ring overflows in ~1s at 200+ events/s)
+        self._finish_listeners: List[Any] = []
 
     # -- lifecycle --------------------------------------------------
     def start(self, origin: str = "kvstore.publish", **attrs: Any) -> Trace:
@@ -182,6 +186,23 @@ class Tracer:
             reg.observe("convergence.e2e_ms", e2e)
         with self._lock:
             self._ring.append(trace)
+            listeners = list(self._finish_listeners)
+        for fn in listeners:
+            try:
+                fn(trace, ok)
+            except Exception:  # noqa: BLE001 - observers never poison Fib
+                reg.counter_bump("telemetry.finish_listener_errors")
+
+    def add_finish_listener(self, fn) -> None:
+        """Register ``fn(trace, ok)`` called after every finish(). Runs
+        on the finishing thread (Fib's event base) — keep it cheap."""
+        with self._lock:
+            self._finish_listeners.append(fn)
+
+    def remove_finish_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._finish_listeners:
+                self._finish_listeners.remove(fn)
 
     # -- thread-local activation ------------------------------------
     def activate(self, trace: Optional[Trace]) -> None:

@@ -280,6 +280,19 @@ class ExponentialBackoff:
     def at_max_backoff(self) -> bool:
         return self._current >= self._max
 
+    @property
+    def max_backoff(self) -> float:
+        return self._max
+
+    def set_max(self, max_s: float) -> None:
+        """Retarget the ceiling (rate-adaptive debounce). Raising the max
+        lets the next report_error extend further; lowering it clamps any
+        in-flight backoff so the change takes effect immediately."""
+        assert max_s >= self._initial
+        self._max = max_s
+        if self._current > max_s:
+            self._current = max_s
+
     def get_current_backoff(self) -> float:
         return self._current
 
@@ -357,3 +370,15 @@ class AsyncDebounce:
 
     def is_scheduled(self) -> bool:
         return self._handle is not None and not self._handle.cancelled
+
+    @property
+    def max_backoff_s(self) -> float:
+        return self._backoff.max_backoff
+
+    def set_max_backoff(self, max_s: float) -> None:
+        """Adjust the extension ceiling in place (the admission path's
+        rate-adaptive debounce). A pending fire keeps its deadline; only
+        future extensions see the new ceiling — except that lowering the
+        ceiling clamps the backoff immediately, so a saturated debounce
+        under a narrowed ceiling fires sooner on the next invocation."""
+        self._backoff.set_max(max_s)

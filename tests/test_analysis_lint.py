@@ -603,6 +603,73 @@ def test_span_fb303_name_convention(tmp_path):
     assert any("Ops.Step" in m for m in msgs)
 
 
+def test_span_attr_clear_without_close_trips(tmp_path):
+    # the overload-path debounce leak: reset() wipes the span attribute
+    # while a rebuild is in flight, with no close and no read-out
+    report = lint(tmp_path, SPAN_PREAMBLE + """
+    class Pending:
+        def adopt(self, trace):
+            self._debounce_span = trace.begin_span("decision.debounce")
+
+        def reset(self):
+            self.count = 0
+            self._debounce_span = None
+    """)
+    hits = rule_hits(report, "span-discipline")
+    assert len(hits) == 1
+    assert "clearing span attribute" in hits[0].message
+    assert "_debounce_span" in hits[0].message
+
+
+def test_span_attr_clear_after_read_out_is_clean(tmp_path):
+    # the fixed shape: read the span into a local (so it can be closed)
+    # before clearing the attribute — decision.py's release_trace
+    report = lint(tmp_path, SPAN_PREAMBLE + """
+    class Pending:
+        def adopt(self, trace):
+            self._debounce_span = trace.begin_span("decision.debounce")
+
+        def reset(self, trace):
+            span = self._debounce_span
+            self._debounce_span = None
+            if span is not None:
+                trace.end_span(span, aborted=True)
+    """)
+    assert rule_hits(report, "span-discipline") == []
+
+
+def test_span_attr_clear_init_exempt(tmp_path):
+    # declaring the slot in __init__ is not a clear
+    report = lint(tmp_path, SPAN_PREAMBLE + """
+    class Pending:
+        def __init__(self):
+            self._debounce_span = None
+
+        def adopt(self, trace):
+            self._debounce_span = trace.begin_span("decision.debounce")
+
+        def move_out(self, trace):
+            span = self._debounce_span
+            self._debounce_span = None
+            trace.end_span(span)
+            return span
+    """)
+    assert rule_hits(report, "span-discipline") == []
+
+
+def test_span_attr_clear_non_span_attr_ignored(tmp_path):
+    # only attributes that ever hold spans are tracked
+    report = lint(tmp_path, SPAN_PREAMBLE + """
+    class State:
+        def set(self, value):
+            self._value = value
+
+        def reset(self):
+            self._value = None
+    """)
+    assert rule_hits(report, "span-discipline") == []
+
+
 # ---------------------------------------------------------------------
 # retrace-risk
 # ---------------------------------------------------------------------

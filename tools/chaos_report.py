@@ -3,9 +3,10 @@
 
 Drives a seeded, replayable fault storm across the pipeline's
 injection seams — device dispatch, delta consume, cold rebuild,
-Decision SPF solve, the Fib thrift transport, netlink programming —
-through the REAL supervised paths, then fails loudly if the
-graceful-degradation contract regressed:
+Decision SPF solve, the Fib thrift transport, netlink programming,
+and the ``load.generator`` publisher seam (a chaos storm *under*
+sustained load) — through the REAL supervised paths, then fails
+loudly if the graceful-degradation contract regressed:
 
 - any supervisor did not self-heal back to HEALTHY after the faults
   stopped,
@@ -13,8 +14,11 @@ graceful-degradation contract regressed:
   cold twin (or the Decision RouteDatabase to a native-backend
   oracle),
 - a ladder walk was unbounded (more walks than churn events),
-- the coverage floor was missed (too few faults fired, or fewer than
-  five distinct seams crossed).
+- the coverage floor was missed (too few faults fired, fewer than
+  six distinct seams crossed, or the lossy-publisher seam never
+  fired),
+- the lossy-load route product diverged from a survivor-replay
+  oracle (dropped events must be pure no-ops).
 
 Writes a JSON artifact (``--out``, default
 ``/tmp/openr_tpu_chaos_report.json``) with the per-site fault counts,
@@ -389,6 +393,77 @@ def _platform_leg(seed, events, failures):
     return calls
 
 
+def _load_leg(seed, events, failures):
+    """Chaos under sustained load: arm the ninth seam
+    (``load.generator``) so the seeded publisher goes lossy mid-storm,
+    then check the dropped events were pure no-ops — the coalesced
+    replay of the *surviving* stream must land bit-identical to the
+    survivor-by-survivor oracle replay."""
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.faults import FaultSchedule, get_injector
+    from openr_tpu.load import LoadGenerator, coalesce_publications
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.models import topologies
+    from openr_tpu.types import Publication, Value
+    from openr_tpu.utils import wire
+
+    topo = topologies.fat_tree_nodes(24)
+    node = next(n for n in sorted(topo.adj_dbs) if n.startswith("rsw"))
+    gen = LoadGenerator(topo, seed=seed + 10)
+    initial = gen.initial_key_vals()
+    get_injector().arm(
+        "load.generator",
+        FaultSchedule.fail_with_probability(0.3, seed=seed + 11),
+    )
+    evs = gen.events(events)
+    get_injector().disarm("load.generator")
+    if gen.dropped == 0:
+        failures.append("load.generator seam never fired")
+    pubs = [
+        Publication(
+            key_vals={
+                e.key: Value(
+                    version=e.version,
+                    originator_id=e.node,
+                    value=e.payload,
+                )
+            },
+            area=topo.area,
+        )
+        for e in evs
+        if not e.dropped
+    ]
+
+    def make():
+        d = Decision(
+            node,
+            kvstore_updates_queue=ReplicateQueue(name="kv"),
+            route_updates_queue=ReplicateQueue(name="routes"),
+            solver_backend="host",
+        )
+        d.process_publication(
+            Publication(key_vals=dict(initial), area=topo.area)
+        )
+        d.rebuild_routes("CHAOS")
+        return d
+
+    live = make()
+    for pub in coalesce_publications(pubs).publications:
+        live.process_publication(pub)
+    live.rebuild_routes("CHAOS")
+    oracle = make()
+    for pub in pubs:
+        oracle.process_publication(pub)
+    oracle.rebuild_routes("ORACLE")
+    if wire.dumps(live.route_db.to_route_db(node)) != wire.dumps(
+        oracle.route_db.to_route_db(node)
+    ):
+        failures.append(
+            "lossy-load route db diverged from survivor-replay oracle"
+        )
+    return len(evs)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=20260805)
@@ -414,9 +489,11 @@ def main(argv=None) -> int:
     base = _injected(reg)
 
     budgets = (
-        {"engine": 60, "decision": 20, "platform": 20, "floor": 50}
+        {"engine": 60, "decision": 20, "platform": 20, "load": 40,
+         "floor": 50}
         if args.smoke
-        else {"engine": 160, "decision": 40, "platform": 40, "floor": 200}
+        else {"engine": 160, "decision": 40, "platform": 40, "load": 80,
+              "floor": 200}
     )
 
     failures: list = []
@@ -425,6 +502,7 @@ def main(argv=None) -> int:
     events += _engine_leg(args.seed, budgets["engine"], failures)
     events += _decision_leg(args.seed, budgets["decision"], failures)
     events += _platform_leg(args.seed, budgets["platform"], failures)
+    events += _load_leg(args.seed, budgets["load"], failures)
     elapsed = time.perf_counter() - t0
 
     injected = {
@@ -437,7 +515,7 @@ def main(argv=None) -> int:
             f"coverage floor missed: {sum(injected.values())} faults "
             f"< {budgets['floor']}"
         )
-    if len(injected) < 5:
+    if len(injected) < 6:
         failures.append(
             f"only {len(injected)} seams crossed: {sorted(injected)}"
         )

@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Sustained-load gate (``make load-smoke``) and report artifact.
+
+Drives the REAL KvStore→Decision→Fib pipeline with the seeded open-loop
+generator (``openr_tpu.load``) at a fixed target rate, with admission
+control (shed-by-coalescing + rate-adaptive debounce) and the pipelined
+Decision emit stage enabled, then fails loudly if the service-plane
+contract regressed:
+
+- the publisher could not hold the floor rate (>= 200 events/s at 1k
+  nodes on CPU in smoke mode),
+- the pipeline failed to drain after the window (unbounded queue
+  growth), or the reader high-watermark blew past the admission band,
+- any finished trace was malformed, or no end-to-end convergence
+  samples were collected,
+- the shedded live RouteDatabase is not bit-identical to the unshedded
+  oracle replay of the full journaled event stream.
+
+Also probes a max-sustainable-rate estimate (binary search against a
+p99 convergence SLO) and reports the per-rate ladder with p50/p95/p99
+e2e latency, shed/coalesce counters, and the WARM/cold solve mix.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_load_report.json``). ``--smoke`` shrinks the window
+and search budget for the tier-1 gate; exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates service-plane machinery,
+not kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/load_report.py) in addition
+# to module mode (python -m tools.load_report)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=20260805)
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="short window + small search budget for the tier-1 gate",
+    )
+    parser.add_argument("--nodes", type=int, default=1000)
+    parser.add_argument(
+        "--rates",
+        default="",
+        help="comma-separated fixed-rate ladder (events/s); "
+        "default 240 smoke / 120,240,360 full",
+    )
+    parser.add_argument(
+        "--duration", type=float, default=0.0,
+        help="seconds per fixed-rate window (default 3 smoke / 5 full)",
+    )
+    parser.add_argument(
+        "--slo-ms", type=float, default=5000.0,
+        help="p99 e2e convergence SLO for the max-rate search",
+    )
+    parser.add_argument(
+        "--min-rate", type=float, default=200.0,
+        help="achieved-rate floor the gate enforces on the first rung",
+    )
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_load_report.json"
+    )
+    args = parser.parse_args(argv)
+
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.load import AdmissionConfig
+    from openr_tpu.load.harness import SustainedLoadHarness
+
+    rates = (
+        [int(r) for r in args.rates.split(",") if r]
+        if args.rates
+        else ([240] if args.smoke else [120, 240, 360])
+    )
+    duration = args.duration or (3.0 if args.smoke else 5.0)
+    admission = AdmissionConfig(shed_depth=4, cap_s=0.5)
+
+    failures: list = []
+    t0 = time.perf_counter()
+    harness = SustainedLoadHarness(
+        nodes=args.nodes,
+        seed=args.seed,
+        solver_backend="host",
+        debounce_max_s=0.05,
+        admission=admission,
+        pipelined_emit=True,
+    )
+    harness.start(initial_timeout_s=600.0)
+    start_s = time.perf_counter() - t0
+
+    ladder = []
+    try:
+        for rate in rates:
+            rep = harness.run_fixed_rate(
+                rate, duration, p99_slo_ms=args.slo_ms
+            )
+            ladder.append(rep.to_dict())
+        first = ladder[0]
+
+        if first["achieved_rate"] < args.min_rate:
+            failures.append(
+                f"publisher held {first['achieved_rate']:.1f} ev/s < "
+                f"floor {args.min_rate:.0f} at {args.nodes} nodes"
+            )
+        for rep in ladder:
+            if not rep["drained"]:
+                failures.append(
+                    f"rate {rep['rate']}: pipeline failed to drain "
+                    "(unbounded queue growth)"
+                )
+            if rep["depth_hwm"] > 16 * admission.shed_depth:
+                failures.append(
+                    f"rate {rep['rate']}: reader high-watermark "
+                    f"{rep['depth_hwm']} blew past the admission band"
+                )
+            if rep["traces_malformed"]:
+                failures.append(
+                    f"rate {rep['rate']}: {rep['traces_malformed']} "
+                    "malformed traces"
+                )
+        if first["e2e_samples"] == 0:
+            failures.append("no end-to-end convergence samples collected")
+
+        # binary-search max sustainable rate against the p99 SLO
+        # (informational: the estimate lands in the artifact; the gate
+        # rests on the fixed-rate rungs + parity above/below)
+        search = harness.find_max_sustainable_rate(
+            p99_slo_ms=args.slo_ms,
+            lo=max(25, rates[0] // 2),
+            hi=rates[-1] * 2,
+            duration_s=max(1.5, duration / 2),
+            max_probes=3 if args.smoke else 6,
+        )
+
+        # parity last: the oracle replays the FULL journal (every
+        # published event across all rungs and probes), unshedded
+        if not harness.check_parity():
+            failures.append(
+                "shedded live RouteDatabase != unshedded oracle replay"
+            )
+    finally:
+        harness.stop()
+    elapsed = time.perf_counter() - t0
+
+    report = {
+        "seed": args.seed,
+        "smoke": args.smoke,
+        "nodes": args.nodes,
+        "start_s": round(start_s, 3),
+        "elapsed_s": round(elapsed, 3),
+        "slo_p99_ms": args.slo_ms,
+        "ladder": ladder,
+        "max_sustainable": search,
+        "failures": failures,
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if failures:
+        print(f"LOAD GATE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print(f"LOAD GATE: PASS (report: {args.out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
