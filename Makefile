@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke multichip-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke multichip-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -79,6 +79,14 @@ chaos-smoke: native
 # /tmp/openr_tpu_load_smoke.json (tools/load_report.py)
 load-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.load_report --smoke --out /tmp/openr_tpu_load_smoke.json
+
+# tenant-plane gate (ops.world_batch): B=8 mixed-size tenants across
+# shape buckets — batched-vs-sequential bit parity under churn, a
+# zero-compile ceiling after bucket warmup, and the evict->rehydrate
+# round trip (warm, not cold). See docs/RUNBOOK.md "Tenant residency
+# triage" when it fails.
+tenancy-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.tenancy_smoke --out /tmp/openr_tpu_tenancy_smoke.json
 
 # sharded-dispatch gate on the virtual 8-device CPU mesh (conftest
 # pins the device count): pipelined==eager bit-identity across a

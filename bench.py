@@ -497,6 +497,29 @@ def _run() -> dict:
             except Exception as e:
                 bench_load = {"error": f"{type(e).__name__}: {e}"}
 
+    # tenth leg: multi-tenant batched worlds — B mixed-size tenant
+    # graphs under per-round churn, solved as one bucket dispatch vs
+    # one warm EllState reconverge per tenant; reports the
+    # batched/sequential per-tenant cost ratio (the tenancy acceptance
+    # gate is <= 0.5x at B=8), bucket compile counts, and the
+    # tenancy.* counter deltas (make tenancy-smoke is the hard CI
+    # gate; this leg folds the throughput numbers into the artifact)
+    bench_tenancy = None
+    if os.environ.get("OPENR_BENCH_TENANCY") == "1":
+        if leg_elapsed() > 540:
+            bench_tenancy = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import multi_tenant_bench
+
+                bench_tenancy = multi_tenant_bench(
+                    int(os.environ.get("OPENR_BENCH_TENANTS", "8"))
+                )
+            except Exception as e:
+                bench_tenancy = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -573,6 +596,7 @@ def _run() -> dict:
         "bench_sharded_churn": bench_shchurn,
         "bench_convergence_trace": bench_traces,
         "bench_sustained_load": bench_load,
+        "bench_multi_tenant": bench_tenancy,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -644,12 +668,14 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_ROUTES"] = "1"
         env["OPENR_BENCH_TRACES"] = "1"
         env["OPENR_BENCH_LOAD"] = "1"
+        env["OPENR_BENCH_TENANCY"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
         env.pop("OPENR_BENCH_ROUTES", None)
         env.pop("OPENR_BENCH_TRACES", None)
         env.pop("OPENR_BENCH_LOAD", None)
+        env.pop("OPENR_BENCH_TENANCY", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -1253,6 +1253,164 @@ def sustained_load_bench(
     return out
 
 
+def multi_tenant_bench(
+    tenants: int = 8, rounds: int = 20, seed: int = 20260805,
+) -> dict:
+    """Multi-tenant batched-worlds leg (ops.world_batch): B mixed-size
+    tenant graphs under per-round metric churn, solved two ways —
+
+    - SEQUENTIAL: one warm ``EllState.reconverge`` fused dispatch per
+      tenant per round (the pre-tenancy status quo: N engine calls),
+    - BATCHED: one ``WorldManager.solve_views`` round (one dispatch
+      per shape bucket + delta-compacted readback).
+
+    Reports per-tenant dispatch cost both ways, the batched/sequential
+    ratio (the ISSUE 9 acceptance gate is <= 0.5x at B=8), bucket
+    compile counts, and the tenancy counter deltas. Parity is asserted
+    on the final round — a fast bench must still be a correct one.
+
+    The fleet is mixed-size (grids + meshes, 9..126 nodes, varying
+    degree) but sized to COALESCE under the arbiter's shape rounding:
+    a dispatch amortizes per-call overhead across exactly the tenants
+    that share a bucket, so the bench measures the design's target
+    regime — many similar-scale worlds, one executable. A fleet
+    spanning many buckets degrades toward the sequential cost by
+    construction (each extra bucket is one more dispatch per round);
+    the parity gates in tests/tools cover that shape, the throughput
+    gate lives here."""
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.spf_sparse import (
+        EllState,
+        compile_ell,
+        ell_patch,
+        ell_source_batch,
+    )
+    from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
+
+    def mk_topos():
+        base = [
+            topologies.grid(3),
+            topologies.grid(5),
+            topologies.grid(7),
+            topologies.random_mesh(24, 3, seed=seed % 1000 + 7),
+            topologies.random_mesh(48, 4, seed=seed % 1000 + 11),
+            topologies.random_mesh(80, 4, seed=seed % 1000 + 13),
+            topologies.random_mesh(104, 3, seed=seed % 1000 + 17),
+            topologies.random_mesh(126, 3, seed=seed % 1000 + 19),
+        ]
+        while len(base) < tenants:
+            base.append(
+                topologies.random_mesh(
+                    40, 3, seed=seed % 1000 + 23 + len(base)
+                )
+            )
+        return base[:tenants]
+
+    def mk_ls(topo):
+        ls = LinkState(area=topo.area)
+        for _name, adj_db in sorted(topo.adj_dbs.items()):
+            ls.update_adjacency_database(adj_db)
+        return ls
+
+    def wiggle(ls, root, metric):
+        from dataclasses import replace
+
+        adj_db = ls.get_adjacency_databases()[root]
+        adjs = list(adj_db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        ls.update_adjacency_database(
+            replace(adj_db, adjacencies=tuple(adjs))
+        )
+
+    # -- sequential: one warm EllState per tenant --------------------------
+    seq_ls = [mk_ls(t) for t in mk_topos()]
+    seq_roots = [
+        sorted(ls.get_adjacency_databases())[0] for ls in seq_ls
+    ]
+    states = [EllState(compile_ell(ls)) for ls in seq_ls]
+    versions = [ls.topology_version for ls in seq_ls]
+    for i, (ls, st) in enumerate(zip(seq_ls, states)):
+        np.asarray(
+            st.reconverge(
+                st.graph, ell_source_batch(st.graph, ls, seq_roots[i])
+            )
+        )
+    seq_round_ms = []
+    for r in range(rounds):
+        for i, ls in enumerate(seq_ls):
+            wiggle(ls, seq_roots[i], 40 + r)
+        t0 = time.perf_counter()
+        for i, (ls, st) in enumerate(zip(seq_ls, states)):
+            affected = ls.affected_since(versions[i])
+            versions[i] = ls.topology_version
+            patched = ell_patch(
+                st.graph, ls, sorted(affected), widen=True
+            )
+            np.asarray(
+                st.reconverge(
+                    patched, ell_source_batch(patched, ls, seq_roots[i])
+                )
+            )
+        seq_round_ms.append(1000.0 * (time.perf_counter() - t0))
+
+    # -- batched: one WorldManager over the same churn ---------------------
+    bat_ls = [mk_ls(t) for t in mk_topos()]
+    bat_roots = [
+        sorted(ls.get_adjacency_databases())[0] for ls in bat_ls
+    ]
+    items = [
+        (f"bt{i}", ls, root)
+        for i, (ls, root) in enumerate(zip(bat_ls, bat_roots))
+    ]
+    compiles0 = TENANCY_COUNTERS["bucket_compiles"]
+    counters0 = {k: TENANCY_COUNTERS[k] for k in TENANCY_COUNTERS}
+    mgr = WorldManager(slots_per_bucket=max(8, tenants))
+    mgr.solve_views(items)  # warmup (bucket compiles land here)
+    bat_round_ms = []
+    views = None
+    for r in range(rounds):
+        for i, ls in enumerate(bat_ls):
+            wiggle(ls, bat_roots[i], 40 + r)
+        t0 = time.perf_counter()
+        views = mgr.solve_views(items)
+        bat_round_ms.append(1000.0 * (time.perf_counter() - t0))
+
+    # final-round parity: the batched rows must match a cold oracle
+    from openr_tpu.ops.spf_sparse import ell_view_batch_packed
+
+    parity = True
+    for (tid, ls, root), (_g, srcs, packed) in zip(items, views):
+        graph = compile_ell(ls)
+        ref = np.asarray(
+            ell_view_batch_packed(
+                graph, ell_source_batch(graph, ls, root)
+            )
+        )
+        parity = parity and np.array_equal(packed, ref)
+
+    seq_med = sorted(seq_round_ms)[len(seq_round_ms) // 2]
+    bat_med = sorted(bat_round_ms)[len(bat_round_ms) // 2]
+    return {
+        "bench": f"scale.multi_tenant_{tenants}_dispatch_ms",
+        "tenants": tenants,
+        "rounds": rounds,
+        "sequential_round_ms": round(seq_med, 3),
+        "batched_round_ms": round(bat_med, 3),
+        "sequential_per_tenant_ms": round(seq_med / tenants, 4),
+        "batched_per_tenant_ms": round(bat_med / tenants, 4),
+        "batched_vs_sequential_ratio": round(bat_med / seq_med, 4),
+        "bucket_compiles": TENANCY_COUNTERS["bucket_compiles"]
+        - compiles0,
+        "buckets": mgr.bucket_count(),
+        "parity": bool(parity),
+        "tenancy_counters": {
+            k: TENANCY_COUNTERS[k] - counters0[k]
+            for k in counters0
+        },
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)
@@ -1311,7 +1469,22 @@ def main(argv=None):
                    default="ell",
                    help="route-sweep relaxation backend: per-edge ELL "
                         "gather, or block-bipartite grouped (dense)")
+    p.add_argument("--multi-tenant", action="store_true",
+                   help="batched-worlds leg: B mixed-size tenant "
+                        "graphs under churn, one batched dispatch vs "
+                        "N sequential warm engine calls")
+    p.add_argument("--tenants", type=int, default=8)
     args = p.parse_args(argv)
+    if args.multi_tenant:
+        print(
+            json.dumps(
+                multi_tenant_bench(
+                    args.tenants, rounds=max(20, args.churn_events)
+                )
+            ),
+            flush=True,
+        )
+        return
     if args.churn:
         run_churn(args)
         return

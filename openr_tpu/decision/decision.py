@@ -187,6 +187,8 @@ class Decision:
         admission: Optional[AdmissionControl] = None,
         pipelined_emit: bool = False,
         kvstore_reader_maxlen: Optional[int] = None,
+        world_batch: Optional[bool] = None,
+        view_cache_cap: Optional[int] = None,
     ):
         self._enable_rib_policy = enable_rib_policy
         self.my_node_name = my_node_name
@@ -200,6 +202,8 @@ class Decision:
             bgp_dry_run=bgp_dry_run,
             enable_best_route_selection=enable_best_route_selection,
             backend=solver_backend,
+            view_cache_cap=view_cache_cap,
+            world_batch=world_batch,
         )
         # degradation ladder for the rebuild path: warm device solve →
         # device-state reset + cold rebuild → non-device backend. The

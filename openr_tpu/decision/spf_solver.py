@@ -19,6 +19,7 @@ predecessor links (paths are short; the SPF runs behind them are memoized).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -189,7 +190,22 @@ SPF_COUNTERS = _get_registry().counter_dict(
         "decision.ell_prewarms",
         "decision.device_state_resets",
         "decision.backend_switches",
+        # multi-area batched dispatch (ops.world_batch): builds whose
+        # area views were solved as one tenant-plane dispatch, and
+        # preload attempts that fell back to sequential solves
+        "decision.world_preloads",
+        "decision.world_preload_failures",
+        # SpfSolver._views LRU demotions — the miniature of
+        # tenancy.evictions; a hot loop here means the view cache cap
+        # (OPENR_VIEW_CACHE_CAP) is below the live area count
+        "route_engine.view_evictions",
     ]
+)
+
+# SpfSolver._views LRU capacity (graphs, not views). Overridable per
+# solver via the view_cache_cap constructor arg.
+VIEW_CACHE_CAP_DEFAULT = int(
+    os.environ.get("OPENR_VIEW_CACHE_CAP", "4") or 4
 )
 
 # the Decision degradation ladder's injection seam (a fresh device
@@ -313,6 +329,10 @@ class SpfView:
         if backend == "device":
             if (
                 len(ls.get_adjacency_databases()) > SPARSE_NODE_THRESHOLD
+                # a batched tenant-plane dispatch (or a KSP2 engine)
+                # already solved this exact view: consume it instead
+                # of building the dense snapshot
+                or _ELL_RESIDENT.has_preloaded(ls, root)
             ):
                 self._init_device_sparse()
             else:
@@ -564,6 +584,18 @@ class _EllResidentCache:
         cap = max(8, len(self._cache))
         del self._preloaded[:-cap]
 
+    def has_preloaded(self, ls, root: str) -> bool:
+        """True when view_packed would be satisfied by a preloaded
+        entry (no device round trip). SpfView's device branch uses
+        this to route moderate-N areas through the sparse consumption
+        path when the tenant plane already solved them batched."""
+        return any(
+            e[0]() is ls
+            and e[1] == ls.topology_version
+            and e[2] == root
+            for e in self._preloaded
+        )
+
     def _sync(self, ls: LinkState):
         """Resolve the resident state for ``ls``: returns
         ``(state, pending)`` where ``pending`` is a journaled patched
@@ -644,6 +676,14 @@ def reset_device_caches() -> None:
     _ELL_RESIDENT._cache = _weakref.WeakKeyDictionary()
     _ELL_RESIDENT._preloaded = []
     _SNAPSHOTS.invalidate()
+    try:
+        # lazy: the tenant plane is optional and must not make the
+        # cold rung's recovery path depend on its import
+        from openr_tpu.ops import world_batch as _world_batch
+
+        _world_batch.reset_world_manager()
+    except Exception:
+        pass
 
 
 class SpfSolver:
@@ -658,6 +698,8 @@ class SpfSolver:
         bgp_dry_run: bool = False,
         enable_best_route_selection: bool = True,
         backend: str = "device",
+        view_cache_cap: Optional[int] = None,
+        world_batch: Optional[bool] = None,
     ):
         self.my_node_name = my_node_name
         self.enable_v4 = enable_v4
@@ -666,6 +708,21 @@ class SpfSolver:
         self.bgp_dry_run = bgp_dry_run
         self.enable_best_route_selection = enable_best_route_selection
         self.backend = backend
+        # _views LRU capacity (per-graph slots); None -> env/default
+        self.view_cache_cap = max(
+            1,
+            view_cache_cap
+            if view_cache_cap is not None
+            else VIEW_CACHE_CAP_DEFAULT,
+        )
+        # multi-area tenant-plane dispatch (ops.world_batch): None ->
+        # env opt-in. Off by default — single-area deployments gain
+        # nothing and the sequential path is the proven one.
+        self.world_batch = (
+            world_batch
+            if world_batch is not None
+            else os.environ.get("OPENR_WORLD_BATCH") == "1"
+        )
         self.static_mpls_routes: Dict[int, List[NextHop]] = {}
         self.best_routes_cache: Dict[IpPrefix, BestRouteSelectionResult] = {}
         # root -> (d, fh_matrix, node_names, links_sig,
@@ -813,6 +870,47 @@ class SpfSolver:
             except Exception:
                 continue
 
+    def _world_preload(
+        self,
+        my_node_name: str,
+        area_link_states: AreaLinkStates,
+    ) -> None:
+        """Solve every eligible area's {root}+neighbors view as ONE
+        batched tenant-plane dispatch (ops.world_batch) and preload the
+        results into the resident-view consumption path, so the
+        per-area SpfView constructions below become host-side slices
+        instead of N sequential device round trips. Strictly an
+        optimization: any failure (or an area already holding a cached
+        view) falls back to the per-area sequential solve."""
+        if self.backend != "device" or not self.world_batch:
+            return
+        items = []
+        for area in sorted(area_link_states):
+            ls = area_link_states[area]
+            if not ls.has_node(my_node_name):
+                continue
+            per_ls = self._views.get(ls)
+            if per_ls is not None and (
+                (ls.topology_version, my_node_name) in per_ls
+            ):
+                continue  # cached view: a preload would go unconsumed
+            items.append((f"{area}/{my_node_name}", ls, my_node_name))
+        if len(items) < 2:
+            return  # nothing to batch
+        try:
+            from openr_tpu.ops import world_batch as _world_batch
+
+            views = _world_batch.get_world_manager().solve_views(
+                [(tid, ls, root) for tid, ls, root in items]
+            )
+            for (_tid, ls, _root), (graph, srcs, packed) in zip(
+                items, views
+            ):
+                _ELL_RESIDENT.preload_view(ls, graph, srcs, packed)
+            SPF_COUNTERS["decision.world_preloads"] += 1
+        except Exception:
+            SPF_COUNTERS["decision.world_preload_failures"] += 1
+
     def _view(self, area: str, ls: LinkState, root: str) -> SpfView:
         del area  # identity of the LinkState object is the key
         per_ls = self._views.get(ls)
@@ -824,8 +922,9 @@ class SpfSolver:
             # which silently disables the SP dirty test
             del self._views[ls]
         self._views[ls] = per_ls
-        while len(self._views) > 4:
+        while len(self._views) > self.view_cache_cap:
             self._views.pop(next(iter(self._views)))
+            SPF_COUNTERS["route_engine.view_evictions"] += 1
         key = (ls.topology_version, root)
         view = per_ls.get(key)
         if view is None:
@@ -1067,6 +1166,7 @@ class SpfSolver:
         self._build_seq += 1
         route_db = DecisionRouteDb()
         self.best_routes_cache.clear()
+        self._world_preload(my_node_name, area_link_states)
         affected = self._prefetch_ksp2_paths(
             my_node_name, area_link_states, prefix_state
         )

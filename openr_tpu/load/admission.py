@@ -61,6 +61,18 @@ class DebounceController:
     The ceiling is pushed into the AsyncDebounce via ``set_max_backoff``;
     counters ``decision.debounce_widenings`` / ``_narrowings`` and the
     ``decision.debounce_max_ms`` gauge make the FSM observable.
+
+    The widen/narrow band also SELF-ADJUSTS from the admission
+    counters it used to be hand-picked against: every ``tune_period``
+    observations the controller samples ``{prefix}.admission.sheds``
+    and ``{prefix}.admission.pubs_coalesced`` — sheds while inside the
+    band mean widening engaged too late (``widen_depth`` steps down
+    toward the backlog the shed path actually saw), a fully quiet
+    period relaxes it back up toward the configured value. Adjustments
+    are one step per period with the band floor pinned at
+    ``narrow_depth + 1`` (the FSM's hysteresis invariant), counted in
+    ``{prefix}.debounce_band_adjustments``. ``self_tune=False``
+    restores the fixed hand-picked band.
     """
 
     WIDEN = "widen"
@@ -75,23 +87,71 @@ class DebounceController:
         narrow_depth: int = 2,
         debounce=None,
         metric_prefix: str = "decision",
+        self_tune: bool = True,
+        tune_period: int = 64,
     ):
         assert cap_s >= base_max_s > 0
         assert widen_depth > narrow_depth >= 0
         self._base = base_max_s
         self._cap = cap_s
         self._widen_depth = widen_depth
+        self._widen_depth_base = widen_depth
         self._narrow_depth = narrow_depth
         self._debounce = debounce
         self._prefix = metric_prefix
+        self._self_tune = self_tune
+        self._tune_period = max(1, tune_period)
+        self._observations = 0
+        # (sheds, pubs_coalesced) at the last retune; None until the
+        # first period completes so a fresh controller never adjusts
+        # off counter history it did not witness
+        self._tune_sample = None
         self.current_max_s = base_max_s
         get_registry().gauge(
             f"{metric_prefix}.debounce_max_ms",
             lambda: self.current_max_s * 1000.0,
         )
 
+    @property
+    def widen_depth(self) -> int:
+        return self._widen_depth
+
+    def _retune(self) -> None:
+        reg = get_registry()
+        sample = (
+            reg.counter_get(f"{self._prefix}.admission.sheds"),
+            reg.counter_get(f"{self._prefix}.admission.pubs_coalesced"),
+        )
+        prev, self._tune_sample = self._tune_sample, sample
+        if prev is None:
+            return
+        sheds = sample[0] - prev[0]
+        coalesced = sample[1] - prev[1]
+        floor = self._narrow_depth + 1
+        if sheds > 0 and self._widen_depth > floor:
+            # backlogs reached the shed path while the ceiling was
+            # still narrow: engage widening earlier
+            self._widen_depth -= 1
+        elif (
+            sheds == 0
+            and coalesced == 0
+            and self._widen_depth < self._widen_depth_base
+        ):
+            # a full period with no pressure at all: relax back toward
+            # the configured band
+            self._widen_depth += 1
+        else:
+            return
+        get_registry().counter_bump(
+            f"{self._prefix}.debounce_band_adjustments"
+        )
+
     def observe(self, depth: int) -> str:
         """Feed one backlog-depth sample; returns the action taken."""
+        if self._self_tune:
+            self._observations += 1
+            if self._observations % self._tune_period == 0:
+                self._retune()
         if depth >= self._widen_depth and self.current_max_s < self._cap:
             self.current_max_s = min(self.current_max_s * 2.0, self._cap)
             self._apply()

@@ -83,6 +83,7 @@ from openr_tpu.ops import host_sweep
 from openr_tpu.ops import route_sweep as rs
 from openr_tpu.ops.spf_sparse import (
     _out_edges,
+    _tenant_view_solve,
     compile_ell,
     ell_patch,
     pad_patch_rows,
@@ -296,6 +297,65 @@ def _compact_changed(new_packed, prev_packed, n):
     out = jnp.zeros((npad, body.shape[1]), dtype=jnp.int32)
     out = out.at[dest].set(body, mode="drop")
     return ch_count, out
+
+
+def _compact_rows_with_ids(new_packed, prev_packed, cap):
+    """Traced body of compact_rows_with_ids — shared with the fused
+    world_dispatch below so the delta epilogue rides the same
+    executable as the solve it diffs."""
+    bsz, rows, n = new_packed.shape
+    changed = jnp.any(new_packed != prev_packed, axis=2).reshape(-1)
+    ch_count = jnp.sum(changed.astype(jnp.int32))
+    flat = new_packed.reshape(bsz * rows, n)
+    ids = jnp.arange(bsz * rows, dtype=jnp.int32)
+    body = jnp.concatenate(
+        [(ids // rows)[:, None], (ids % rows)[:, None], flat], axis=1
+    )
+    pos = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    dest = jnp.where(changed, pos, cap)
+    out = jnp.zeros((cap + 1, 2 + n), dtype=jnp.int32)
+    out = out.at[dest].set(body, mode="drop")
+    return ch_count, out
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def compact_rows_with_ids(new_packed, prev_packed, cap):
+    """Tenant-batched delta epilogue (consumed by ops.world_batch):
+    diff a [B, R, N] packed block bit-for-bit against the resident
+    previous one and prefix-sum-compact the changed rows to the front,
+    each prefixed by a [tenant, row] id column pair — the batched
+    generalization of _compact_changed's single-graph delta readback,
+    with the tenant id riding the compacted rows so one readback fans
+    back out to B per-tenant host mirrors. Returns
+    (changed_count, out [cap+1, 2+N]): the host reads the scalar, then
+    slices out[:changed_count]; when the delta overflows ``cap`` the
+    caller falls back to a full-block readback (counted, never silent).
+    Unchanged rows scatter into the dropped slot at ``cap``; overflow
+    positions land out of bounds and mode="drop" discards them, so the
+    resident previous block is never torn by a too-small cap."""
+    return _compact_rows_with_ids(new_packed, prev_packed, cap)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def world_dispatch(
+    src, w, ov, srcs, p_rows, p_src, p_w,
+    inc_t, inc_h, inc_w, d_prev, packed_prev, cap,
+):
+    """The fused per-bucket tenant dispatch: patch scatter + batched
+    view solve (spf_sparse._tenant_view_solve under vmap) + tenant-id
+    delta compaction against the resident previous block — ONE device
+    round trip per shape bucket per churn round, the tenant-plane twin
+    of _churn_step. Returns (packed, d, src, w, changed_count, out):
+    the first four rebind as the bucket's new resident block (inputs
+    are NOT donated — the overflow fallback and rehydration re-read
+    them, the double-buffer hazard rule), the last two drive the
+    compacted readback exactly as compact_rows_with_ids documents."""
+    packed, d, src, w = jax.vmap(_tenant_view_solve)(
+        src, w, ov, srcs, p_rows, p_src, p_w,
+        inc_t, inc_h, inc_w, d_prev,
+    )
+    ch_count, out = _compact_rows_with_ids(packed, packed_prev, cap)
+    return packed, d, src, w, ch_count, out
 
 
 @functools.partial(jax.jit, static_argnames=("bands", "n", "k"))

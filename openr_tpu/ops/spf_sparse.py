@@ -2012,3 +2012,146 @@ def sharded_ell_masked_distances_resident(
             state.graph.bands, state.graph.n_pad, mesh,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Batched multi-tenant worlds: uniform-ELL packing + leading-axis kernels
+# ---------------------------------------------------------------------------
+#
+# The sliced-ELL layout above specializes its executables on the band
+# structure (``bands`` is a static jit argument) — optimal for ONE
+# resident graph, hostile to batching: two topologies almost never share
+# a band tuple, so a [B, ...] dispatch over banded tensors would retrace
+# per tenant set. The tenant plane (ops.world_batch) therefore packs
+# each tenant into a UNIFORM [n_slot, k_slot] ELL block — every row
+# padded to one shared slot width, the node axis padded to one shared
+# count — so a whole shape bucket of tenants runs one
+# [B, n_slot, k_slot] executable regardless of which tenants occupy it.
+# The padding is inert by construction (self-loop src ids with w = INF,
+# the same trick the banded layout uses inside a slot class), so the
+# per-tenant result is bit-identical to the banded single-graph solve:
+# the int32 min-relaxation has a unique fixed point and the uniform
+# relax computes the same monotone map, just with more (INF) slots.
+
+
+def ell_pack_uniform(
+    graph: EllGraph, n_slot: int, k_slot: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Flatten a sliced-ELL graph into one uniform [n_slot, k_slot]
+    block: (src, w, overloaded). Rows keep their banded ids (node
+    numbering is unchanged); slots past a row's band k and rows past
+    n_pad are self-loop/INF padding, inert in every relax."""
+    assert n_slot >= graph.n_pad, (n_slot, graph.n_pad)
+    assert k_slot >= max(b.k for b in graph.bands), k_slot
+    src = np.tile(
+        np.arange(n_slot, dtype=np.int32)[:, None], (1, k_slot)
+    )
+    w = np.full((n_slot, k_slot), INF, dtype=np.int32)
+    for band, s_b, w_b in zip(graph.bands, graph.src, graph.w):
+        src[band.start : band.start + band.rows, : band.k] = s_b
+        w[band.start : band.start + band.rows, : band.k] = w_b
+    overloaded = np.zeros(n_slot, dtype=bool)
+    overloaded[: len(graph.overloaded)] = graph.overloaded
+    return src, w, overloaded
+
+
+def ell_uniform_rows(
+    graph: EllGraph, ids, k_slot: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Uniform-layout (src, w) rows for a set of global node ids — the
+    O(rows x k) host prep for scattering a patch into a resident
+    uniform block (ops.world_batch's analogue of band_patch_inputs)."""
+    ids = np.asarray(ids, dtype=np.int32)
+    src = np.tile(ids[:, None], (1, k_slot))
+    w = np.full((len(ids), k_slot), INF, dtype=np.int32)
+    for x, j in enumerate(ids):
+        bi, band = _band_of(graph, int(j))
+        r = int(j) - band.start
+        src[x, : band.k] = graph.src[bi][r]
+        w[x, : band.k] = graph.w[bi][r]
+    return src, w
+
+
+def _uniform_relax(d, src, w, overloaded):
+    """One masked relaxation over a uniform ELL block: [S, N] -> [S, N]
+    as one gather + K-reduce (the single-band special case of
+    _ell_relax — identical algebra, so fixed points agree bit-for-bit).
+    Edges originating at overloaded nodes never extend paths."""
+    w_eff = jnp.where(overloaded[src], INF, w)  # [n, k]
+    gathered = d[:, src]  # [S, n, k]
+    relaxed = jnp.min(
+        jnp.minimum(gathered + w_eff[None, :, :], INF), axis=2
+    )
+    return jnp.minimum(d, relaxed.astype(jnp.int32))
+
+
+def _uniform_direct(src, w, srcs):
+    """On-device direct min-metric srcs[0] -> each batch node over a
+    uniform block (INF when not adjacent, and for the source itself) —
+    the uniform twin of _device_direct_metrics, so the batched dispatch
+    needs no host band reads."""
+    src_id = srcs[0]
+    direct = jnp.min(jnp.where(src == src_id, w, INF), axis=1)  # [n]
+    w_sv = direct[srcs]
+    return jnp.where(srcs == src_id, INF, w_sv).astype(jnp.int32)
+
+
+def _tenant_view_solve(src, w, overloaded, srcs, p_rows, p_src, p_w,
+                       inc_t, inc_h, inc_w, d_prev):
+    """One tenant's fused view solve over its uniform block: scatter
+    the pending patch rows into the resident block (p_rows carries
+    global row ids padded with the out-of-bounds id ``n`` — mode="drop"
+    makes padding and idle tenants zero-cost no-ops, so patch
+    application costs no extra dispatch and no extra executable),
+    derive the direct metrics on device, warm-seed the fixed point
+    from d_prev (reset only the increase cone — cold tenants pass the
+    _FORCE_RESET_EDGE sentinel, so warm and cold share ONE executable,
+    exactly like _ell_reconverge), iterate to the fixed point, pack
+    distances + first hops. Shapes only — no static arguments — so
+    jax.vmap lifts it to the [B, ...] tenant axis without retracing.
+    Returns the post-patch (src, w) too: the caller rebinds them as
+    the new resident block, keeping device and host graphs coherent
+    with ONE device round trip per bucket."""
+    n = src.shape[0]
+    s = srcs.shape[0]
+    src = src.at[p_rows].set(p_src, mode="drop")
+    w = w.at[p_rows].set(p_w, mode="drop")
+    w_sv = _uniform_direct(src, w, srcs)
+    unit = jnp.full((s, n), INF, dtype=jnp.int32)
+    unit = unit.at[jnp.arange(s), srcs].set(0)
+    # init rows: one UNMASKED relax (overloaded sources still originate)
+    no_overload = jnp.zeros_like(overloaded)
+    d0 = _uniform_relax(unit, src, w, no_overload)
+    seed = _warm_seed(d_prev, inc_t, inc_h, inc_w, d0)
+
+    def cond(state):
+        _, changed, it = state
+        return jnp.logical_and(changed, it < n)
+
+    def body(state):
+        d, _, it = state
+        nxt = _uniform_relax(d, src, w, overloaded)
+        return nxt, jnp.any(nxt < d), it + 1
+
+    d, _, _ = jax.lax.while_loop(cond, body, (seed, jnp.bool_(True), 0))
+    fh = _first_hops_from_rows(d, srcs, w_sv, overloaded, n)
+    packed = jnp.concatenate([d, fh.astype(jnp.int32)], axis=0)
+    return packed, d, src, w
+
+
+# The batch-lifted solve: every argument carries a leading tenant axis
+# ([B, n, k] blocks, [B, R] patch rows (+[B, R, k] values), [B, S]
+# source batches, [B, E] increase deltas, [B, S, n] previous
+# distances). Under vmap the while_loop iterates until EVERY tenant's
+# lanes converge; extra iterations past a tenant's own fixed point are
+# identity (min-relax is idempotent there), so per-tenant results never
+# depend on batch composition — the padding-masking contract
+# tests/test_world_batch.py enforces. Inactive slots ride along as
+# all-INF blocks that converge in zero iterations. Resident inputs are
+# NOT donated: the delta-readback retry (overflow -> full fallback) and
+# the arbiter's rehydration path both re-read them (the same
+# double-buffer hazard rule _churn_step follows). The production entry
+# is route_engine.world_dispatch, which fuses this with the tenant-id
+# delta compaction into one executable per shape bucket; this unfused
+# alias exists for kernel-level tests.
+world_view_solve = jax.jit(jax.vmap(_tenant_view_solve))

@@ -1,0 +1,744 @@
+"""Multi-tenant batched worlds: one device, many graphs, one dispatch.
+
+A route server or controller serving real traffic runs MANY topologies
+at once — areas, VRFs, what-if scenarios — while the ELL engines above
+are single-graph residents. This module is the tenant plane over them:
+
+- ``WorldManager`` — the arbiter. Tenants (independent LinkState
+  worlds) are admitted into **shape buckets**: per-tenant ``n``/``k``/
+  source-batch sizes rounded up to shared power-of-two slots, so every
+  tenant in a bucket runs the SAME compiled executable
+  (``route_engine.world_dispatch``, the ``vmap``-lifted fused view
+  solve + patch scatter + delta compaction, with no shape-varying
+  static arguments). Tenants joining a warm bucket cost zero retraces
+  — the tenancy smoke gate asserts the compile count stays flat.
+
+- ``WorldBucket`` — one ``[B, n_slot, k_slot]`` resident block of B
+  tenant slots (uniform-ELL packing, ``spf_sparse.ell_pack_uniform``).
+  A dispatch solves every slot in one device round trip
+  (``route_engine.world_dispatch``, which fuses the pending patch
+  scatter, the batched solve AND the delta compaction into one
+  executable); inactive and idle slots are inert by construction
+  (all-INF padding converges in zero iterations, and an idle slot
+  re-derives its own fixed point — the min-relax is idempotent there —
+  so its packed rows never change and never read back). Readback is
+  per-tenant delta-compacted: the packed [B, 2S, N] block diffs
+  against the resident previous block and only changed rows cross,
+  prefixed by a tenant-id column (the
+  ``route_engine.compact_rows_with_ids`` epilogue), fanning back out
+  to B per-tenant host mirrors.
+
+- **HBM residency** — buckets hold a fixed number of slots; when a
+  bucket is full (or the global ``max_resident`` cap is exceeded) the
+  least-recently-used tenant is EVICTED to its host snapshot: the host
+  keeps the tenant's ``EllGraph``, its packed view mirror (which
+  includes the last-solve distance rows) and its un-solved patch
+  journal. Re-admission REHYDRATES warm: the uniform block re-packs
+  from the graph, the previous distances upload as the warm seed, and
+  the journal replays as an increase-edge delta — the first solve
+  after rehydration is a warm solve, not a cold one (the
+  evict→rehydrate parity test enforces both the bits and the
+  warmness). This generalizes the ``SpfSolver._views`` LRU from PR 1
+  from host-side view objects to device-resident engine state.
+
+Churn stays warm exactly the way ``EllState`` keeps it warm: patches
+journal (tail, head) -> (weight snapshot, current weight) with
+first-touch-wins snapshots, overload flips journal the flipped node's
+out-edges at raw weights, and solve time emits the effective-weight
+increase delta against the snapshots the resident distances were
+solved under (see ``EllState._note_patch`` / ``_emit_increases`` for
+the soundness argument — the logic here is the same journal over the
+host-side tenant record instead of a device-resident band set).
+
+Observability: ``tenancy.*`` counters (active/resident/evictions/
+rehydrations/bucket_compiles/... ) and an ``ops.tenant_dispatch`` span
+per bucket dispatch carrying batch occupancy.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import weakref
+from dataclasses import replace as _replace
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from openr_tpu.ops.route_engine import world_dispatch
+from openr_tpu.ops.spf import INF
+from openr_tpu.ops.spf_sparse import (
+    _FORCE_RESET_EDGE,
+    EllGraph,
+    band_row_edge_changes,
+    compile_ell,
+    ell_pack_uniform,
+    ell_patch,
+    ell_source_batch,
+    ell_uniform_rows,
+)
+from openr_tpu.telemetry import get_registry as _get_registry
+from openr_tpu.telemetry import get_tracer as _get_tracer
+
+# per-dispatch increase-delta slots per tenant: ONE fixed shape (no
+# pow2 ladder like pad_increase_edges — a ladder would retrace per
+# bucket size and break the flat-compile contract). A tenant whose
+# journal emits more increases than this takes a forced reset instead:
+# still bit-identical, just cold for that one solve.
+_INC_SLOTS = 64
+
+# compacted-delta readback rows per dispatch (capped; a bigger delta
+# falls back to a full-block readback, counted in delta_overflows)
+_DELTA_CAP_MAX = 1024
+
+# pending patch rows carried INTO the fused dispatch per tenant: one
+# fixed [B, _PATCH_SLOTS] shape (padded with the out-of-bounds row id,
+# dropped by the scatter) so patch application costs no separate
+# device dispatch and no extra executable. A tenant accumulating more
+# dirty rows than this between solves re-uploads its whole slot
+# instead (counted in patch_overflows, never silent).
+_PATCH_SLOTS = 32
+
+TENANCY_COUNTERS = _get_registry().counter_dict(
+    [
+        "active",        # tenants known to the manager (gauge-like)
+        "resident",      # tenants currently holding a device slot
+        "admissions",    # cold admits (fresh compile_ell worlds)
+        "evictions",     # resident -> host-snapshot demotions
+        "rehydrations",  # host-snapshot -> warm resident promotions
+        "bucket_compiles",    # distinct shape buckets materialized
+        "bucket_migrations",  # tenant moved between shape buckets
+        "warm_solves",   # tenant solves seeded from previous distances
+        "cold_solves",   # tenant solves from the forced-reset sentinel
+        "dispatches",    # batched device dispatches (one per bucket)
+        "delta_rows",        # compacted rows read back
+        "delta_overflows",   # full-block readback fallbacks
+        "patch_overflows",   # full-slot re-uploads (patch > row budget)
+    ],
+    prefix="tenancy.",
+)
+
+
+def _pow2_at_least(x: int, lo: int) -> int:
+    p = lo
+    while p < x:
+        p *= 2
+    return p
+
+
+# Eager per-slot writer, jitted so the slot index is a RUNTIME operand:
+# an inline ``buf.at[3].set(...)`` would bake the slot into the program
+# and compile once per slot, breaking the flat-compile contract the
+# bucket exists for. One executable per (buffer shape, value shape).
+@jax.jit
+def _slot_set(buf, slot, val):
+    return buf.at[slot].set(val)
+
+
+class TenantWorld:
+    """Host-side record for one tenant: its compiled graph, source
+    batch, packed-view mirror (rows [0, 2*s_slot) in bucket layout),
+    and the un-solved patch journal. This IS the eviction snapshot —
+    nothing device-side is needed to rehydrate warm."""
+
+    __slots__ = (
+        "tenant_id", "ls_ref", "root", "graph", "version", "srcs",
+        "packed_host", "pending_edges", "pending_rows", "ov_solved",
+        "pending_structural", "force_reset", "needs_solve", "solved",
+        "slot", "bucket", "last_used", "srcs_dirty",
+    )
+
+    def __init__(self, tenant_id: str, ls, root: str,
+                 graph: EllGraph, srcs: List[int]):
+        self.tenant_id = tenant_id
+        self.ls_ref = weakref.ref(ls)
+        self.root = root
+        self.graph = graph
+        self.version = ls.topology_version
+        self.srcs = list(srcs)
+        self.packed_host: Optional[np.ndarray] = None
+        self.pending_edges: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        # global row ids whose device copy is stale (applied in-kernel
+        # by the next fused dispatch, or subsumed by a full re-pack)
+        self.pending_rows: set = set()
+        self.ov_solved = np.array(graph.overloaded, copy=True)
+        self.pending_structural = False
+        self.force_reset = True
+        self.needs_solve = True
+        self.solved = False
+        self.slot: Optional[int] = None
+        self.bucket: Optional["WorldBucket"] = None
+        self.last_used = 0
+        self.srcs_dirty = True
+
+    @property
+    def dims(self) -> Tuple[int, int, int]:
+        """(s_slot, n_slot, k_slot) shape-bucket key this tenant
+        rounds up into. The k floor is deliberately coarse (16): real
+        mixed fleets mostly differ in degree, and every extra bucket
+        is an extra dispatch per churn round plus an extra executable
+        — a few INF slots per row are far cheaper than either."""
+        return (
+            _pow2_at_least(len(self.srcs), 8),
+            _pow2_at_least(self.graph.n_pad, 128),
+            _pow2_at_least(max(b.k for b in self.graph.bands), 16),
+        )
+
+    def view(self) -> Tuple[EllGraph, List[int], np.ndarray]:
+        """(graph, srcs, packed [2b, n_pad]) in exactly the layout
+        ``ell_view_batch_packed`` / ``EllState.reconverge`` return —
+        sliced out of the bucket-shaped mirror, copied (the mirror
+        mutates under later dispatches)."""
+        assert self.packed_host is not None and self.solved
+        b = len(self.srcs)
+        s = self.packed_host.shape[0] // 2
+        n_pad = self.graph.n_pad
+        return self.graph, list(self.srcs), np.concatenate(
+            [
+                self.packed_host[:b, :n_pad],
+                self.packed_host[s : s + b, :n_pad],
+            ],
+            axis=0,
+        )
+
+
+class WorldBucket:
+    """One shape bucket's resident device block: B tenant slots of
+    uniform [n_slot, k_slot] ELL plus the per-slot source batches,
+    previous distances (the warm seed) and previous packed views (the
+    delta-readback baseline). Invariant: ``packed_dev[slot]`` equals
+    ``jnp.asarray(tenant.packed_host)`` for every occupied slot between
+    dispatches — placement uploads the mirror, dispatch replaces both
+    sides coherently — so the compacted diff is exact per tenant."""
+
+    def __init__(self, slots: int, s: int, n: int, k: int):
+        self.key = (s, n, k)
+        self.slots, self.s, self.n, self.k = slots, s, n, k
+        base_src = np.tile(
+            np.arange(n, dtype=np.int32)[None, :, None], (slots, 1, k)
+        )
+        self.src_dev = jnp.asarray(base_src)
+        self.w_dev = jnp.asarray(
+            np.full((slots, n, k), INF, dtype=np.int32)
+        )
+        self.ov_dev = jnp.asarray(np.zeros((slots, n), dtype=bool))
+        self.srcs_dev = jnp.asarray(
+            np.zeros((slots, s), dtype=np.int32)
+        )
+        self.d_dev = jnp.asarray(
+            np.zeros((slots, s, n), dtype=np.int32)
+        )
+        self.packed_dev = jnp.asarray(
+            np.zeros((slots, 2 * s, n), dtype=np.int32)
+        )
+        self.tenants: List[Optional[TenantWorld]] = [None] * slots
+        self.delta_cap = min(slots * 2 * s, _DELTA_CAP_MAX)
+
+    def free_slot(self) -> Optional[int]:
+        for i, t in enumerate(self.tenants):
+            if t is None:
+                return i
+        return None
+
+    def occupancy(self) -> int:
+        return sum(1 for t in self.tenants if t is not None)
+
+
+class WorldManager:
+    """The residency arbiter + dispatch front end (see module
+    docstring). One per process by default (``get_world_manager``) —
+    the device blocks it owns are process-global state, like the
+    ``_ELL_RESIDENT`` cache in decision.spf_solver."""
+
+    def __init__(self, slots_per_bucket: Optional[int] = None,
+                 max_resident: Optional[int] = None):
+        if slots_per_bucket is None:
+            slots_per_bucket = int(
+                os.environ.get("OPENR_WORLD_SLOTS", "8") or 8
+            )
+        if max_resident is None:
+            max_resident = int(
+                os.environ.get("OPENR_WORLD_RESIDENT", "64") or 64
+            )
+        self.slots_per_bucket = _pow2_at_least(
+            max(1, slots_per_bucket), 1
+        )
+        self.max_resident = max(1, max_resident)
+        self._buckets: Dict[Tuple[int, int, int], WorldBucket] = {}
+        self._tenants: Dict[str, TenantWorld] = {}
+        self._clock = 0
+
+    # -- public API --------------------------------------------------------
+
+    def solve_views(self, items) -> List[Tuple]:
+        """Sync + batch-solve a set of tenants in as few dispatches as
+        buckets allow. ``items``: [(tenant_id, ls, root)]; returns the
+        aligned [(graph, srcs, packed [2b, n_pad])] views. More
+        requested tenants than a bucket has slots are solved in waves
+        (each wave fills the bucket, solves, and yields its slots to
+        the next — eviction/rehydration do the bookkeeping)."""
+        tenants = [
+            self._sync(tid, ls, root) for tid, ls, root in items
+        ]
+        pending = [t for t in tenants if t.needs_solve]
+        waves = 0
+        while pending:
+            waves += 1
+            assert waves <= 2 * len(tenants) + 2, "tenancy livelock"
+            for t in pending:
+                self._ensure_resident(t)
+            # launch every bucket's fused solve before blocking on the
+            # first readback: dispatches are async, so bucket B's
+            # compute overlaps bucket A's delta fan-out
+            ctxs = [
+                self._dispatch_launch(bucket)
+                for bucket in {t.bucket for t in pending if t.bucket}
+            ]
+            for ctx in ctxs:
+                if ctx is not None:
+                    self._dispatch_finish(ctx)
+            pending = [t for t in pending if t.needs_solve]
+        self._enforce_residency()
+        self._update_gauges()
+        return [t.view() for t in tenants]
+
+    def solve_view(self, tenant_id: str, ls, root: str):
+        return self.solve_views([(tenant_id, ls, root)])[0]
+
+    def drop(self, tenant_id: str) -> None:
+        t = self._tenants.pop(tenant_id, None)
+        if t is not None and t.slot is not None:
+            self._detach(t)
+        self._update_gauges()
+
+    def reset(self) -> None:
+        """Release every device block and tenant record (the
+        degradation ladder's cold rung — nothing cached across a torn
+        dispatch may leak into the recovered state)."""
+        self._buckets = {}
+        self._tenants = {}
+        self._update_gauges()
+
+    def resident_count(self) -> int:
+        return sum(
+            1 for t in self._tenants.values() if t.slot is not None
+        )
+
+    def bucket_count(self) -> int:
+        return len(self._buckets)
+
+    # -- sync / journal ----------------------------------------------------
+
+    def _sync(self, tenant_id: str, ls, root: str) -> TenantWorld:
+        self._clock += 1
+        t = self._tenants.get(tenant_id)
+        if t is not None and (t.ls_ref() is not ls or t.root != root):
+            # a new world under an old name: identity goes through the
+            # live object, never id()/name reuse
+            self.drop(tenant_id)
+            t = None
+        if t is None:
+            graph = compile_ell(ls)
+            t = TenantWorld(
+                tenant_id, ls, root, graph,
+                ell_source_batch(graph, ls, root),
+            )
+            self._tenants[tenant_id] = t
+            TENANCY_COUNTERS["admissions"] += 1
+        elif t.version != ls.topology_version:
+            affected = ls.affected_since(t.version)
+            patched = (
+                ell_patch(t.graph, ls, sorted(affected), widen=True)
+                if affected is not None
+                else None
+            )
+            if patched is None:
+                # journal gap or node-set change: recompile from the
+                # LinkState; numbering may move, so the old mirror and
+                # journal are unusable — cold solve
+                graph = compile_ell(ls)
+                self._reset_world(
+                    t, graph, ell_source_batch(graph, ls, root)
+                )
+            else:
+                self._apply_patch(t, patched)
+                srcs = ell_source_batch(t.graph, ls, root)
+                if srcs != t.srcs:
+                    # the source batch moved (neighbor set churn):
+                    # same contract as EllState._warm_key — previous
+                    # distance rows describe other sources, force the
+                    # cold seed
+                    t.srcs = list(srcs)
+                    t.srcs_dirty = True
+                    t.force_reset = True
+            t.version = ls.topology_version
+            t.needs_solve = True
+        t.last_used = self._clock
+        return t
+
+    def _reset_world(self, t: TenantWorld, graph: EllGraph,
+                     srcs: List[int]) -> None:
+        old_dims = t.dims
+        t.graph = graph
+        t.srcs = list(srcs)
+        t.packed_host = None
+        t.pending_edges = {}
+        t.pending_rows = set()
+        t.ov_solved = np.array(graph.overloaded, copy=True)
+        t.pending_structural = False
+        t.force_reset = True
+        t.solved = False
+        t.srcs_dirty = True
+        if t.slot is not None and t.dims != old_dims:
+            self._detach(t)
+
+    def _apply_patch(self, t: TenantWorld, patched: EllGraph) -> None:
+        ov_changed = not np.array_equal(
+            t.graph.overloaded, patched.overloaded
+        )
+        self._journal_patch(t, patched, ov_changed)
+        rows = sorted(
+            int(patched.bands[bi].start) + int(r)
+            for bi, rs in (patched.changed or {}).items()
+            for r in np.asarray(rs)
+        )
+        old_dims = t.dims
+        t.graph = _replace(patched, changed=None)
+        # changed rows go STALE on device and ride the next fused
+        # dispatch as in-kernel scatter operands (placement's full
+        # re-pack subsumes them for non-residents and migrants)
+        t.pending_rows.update(rows)
+        if t.slot is None:
+            return  # non-resident: placement re-packs from the graph
+        if t.dims != old_dims:
+            # a widened row outgrew the bucket's k: migrate (the warm
+            # mirror + journal move with the tenant — placement decides
+            # whether the shapes still permit a warm seed)
+            self._detach(t)
+            TENANCY_COUNTERS["bucket_migrations"] += 1
+            return
+        bucket = t.bucket
+        if ov_changed:
+            ov = np.zeros(bucket.n, dtype=bool)
+            ov[: len(t.graph.overloaded)] = t.graph.overloaded
+            bucket.ov_dev = _slot_set(
+                bucket.ov_dev, np.int32(t.slot), ov
+            )
+
+    def _journal_patch(self, t: TenantWorld, patched: EllGraph,
+                       ov_changed: bool) -> None:
+        """EllState._note_patch over the host tenant record: merge the
+        patch's edge delta into the warm-start journal (first-touch
+        snapshots), journal flipped nodes' out-edges across an
+        overload change. Skipped before the first solve — there is
+        nothing warm to protect yet."""
+        if not t.solved:
+            return
+        if ov_changed:
+            t.pending_structural = True
+            flipped = np.nonzero(
+                np.asarray(t.graph.overloaded)
+                != np.asarray(patched.overloaded)
+            )[0]
+            collapsed: Dict[Tuple[int, int], int] = {}
+            pos = 0
+            for src_b, w_b in zip(t.graph.src, t.graph.w):
+                hit = np.isin(src_b, flipped) & (w_b < INF)
+                for r, sl in zip(*np.nonzero(hit)):
+                    key = (int(src_b[r, sl]), pos + int(r))
+                    wv = int(w_b[r, sl])
+                    if wv < collapsed.get(key, INF):
+                        collapsed[key] = wv
+                pos += src_b.shape[0]
+            for key, wv in collapsed.items():
+                t.pending_edges.setdefault(key, (wv, wv))
+        if not patched.changed:
+            return
+        structural = False
+        for s, h, wo, wn in band_row_edge_changes(t.graph, patched):
+            snap, _cur = t.pending_edges.get((s, h), (wo, wo))
+            t.pending_edges[(s, h)] = (snap, wn)
+            structural = structural or wo >= INF or wn >= INF
+        if structural:
+            t.pending_structural = True
+
+    def _emit_increases(self, t: TenantWorld, ov_now: np.ndarray):
+        """EllState._emit_increases over the tenant journal (same
+        effective-weight soundness argument)."""
+        inc = []
+        for (s, h), (snap, cur) in t.pending_edges.items():
+            if snap >= INF:
+                continue
+            snap_eff = INF if t.ov_solved[s] else snap
+            cur_eff = INF if ov_now[s] else cur
+            if cur > snap or cur_eff > snap_eff:
+                inc.append((s, h, snap))
+        return inc
+
+    # -- placement / residency ---------------------------------------------
+
+    def _bucket_for(self, dims: Tuple[int, int, int]) -> WorldBucket:
+        bucket = self._buckets.get(dims)
+        if bucket is None:
+            bucket = WorldBucket(self.slots_per_bucket, *dims)
+            self._buckets[dims] = bucket
+            TENANCY_COUNTERS["bucket_compiles"] += 1
+        return bucket
+
+    def _ensure_resident(self, t: TenantWorld) -> None:
+        dims = t.dims
+        if (
+            t.slot is not None
+            and t.bucket is not None
+            and t.bucket.key == dims
+        ):
+            return
+        if t.slot is not None:
+            self._detach(t)
+            TENANCY_COUNTERS["bucket_migrations"] += 1
+        bucket = self._bucket_for(dims)
+        slot = bucket.free_slot()
+        if slot is None:
+            slot = self._evict_lru(bucket)
+        self._place(t, bucket, slot)
+
+    def _place(self, t: TenantWorld, bucket: WorldBucket,
+               slot: int) -> None:
+        s_slot, n_slot, k_slot = bucket.key
+        mirror_shape = (2 * s_slot, n_slot)
+        if t.packed_host is None or t.packed_host.shape != mirror_shape:
+            # no (shape-compatible) previous view: the warm seed has
+            # nothing sound to start from
+            t.packed_host = np.zeros(mirror_shape, dtype=np.int32)
+            t.force_reset = True
+            t.solved = False
+        elif t.solved:
+            TENANCY_COUNTERS["rehydrations"] += 1
+        src, w, ov = ell_pack_uniform(t.graph, n_slot, k_slot)
+        srcs_row = np.full(s_slot, t.srcs[0], dtype=np.int32)
+        srcs_row[: len(t.srcs)] = t.srcs
+        sl = np.int32(slot)
+        bucket.src_dev = _slot_set(bucket.src_dev, sl, src)
+        bucket.w_dev = _slot_set(bucket.w_dev, sl, w)
+        bucket.ov_dev = _slot_set(bucket.ov_dev, sl, ov)
+        bucket.srcs_dev = _slot_set(bucket.srcs_dev, sl, srcs_row)
+        bucket.d_dev = _slot_set(
+            bucket.d_dev, sl, t.packed_host[:s_slot]
+        )
+        bucket.packed_dev = _slot_set(
+            bucket.packed_dev, sl, t.packed_host
+        )
+        bucket.tenants[slot] = t
+        t.bucket = bucket
+        t.slot = slot
+        t.srcs_dirty = False
+        t.pending_rows = set()  # the full pack above subsumed them
+
+    def _detach(self, t: TenantWorld) -> None:
+        """Demote to the host snapshot. The vacated slot's device rows
+        stay in place — an unoccupied slot re-solves its stale fixed
+        point idempotently (no packed change, no readback) until the
+        next occupant's placement overwrites it."""
+        if t.bucket is not None and t.slot is not None:
+            t.bucket.tenants[t.slot] = None
+        t.bucket = None
+        t.slot = None
+
+    def _evict_lru(self, bucket: WorldBucket) -> int:
+        victims = [
+            (t.last_used, slot)
+            for slot, t in enumerate(bucket.tenants)
+            if t is not None
+        ]
+        _, slot = min(victims)
+        self._detach(bucket.tenants[slot])
+        TENANCY_COUNTERS["evictions"] += 1
+        return slot
+
+    def _enforce_residency(self) -> None:
+        while self.resident_count() > self.max_resident:
+            t = min(
+                (
+                    t
+                    for t in self._tenants.values()
+                    if t.slot is not None
+                ),
+                key=lambda t: t.last_used,
+            )
+            self._detach(t)
+            TENANCY_COUNTERS["evictions"] += 1
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, bucket: WorldBucket) -> None:
+        ctx = self._dispatch_launch(bucket)
+        if ctx is not None:
+            self._dispatch_finish(ctx)
+
+    def _dispatch_launch(self, bucket: WorldBucket):
+        """Phase 1 of a bucket dispatch: journal emission, patch-operand
+        prep, and the (async) fused device call. Returns the in-flight
+        context for _dispatch_finish, which owns the blocking readback
+        — solve_views launches EVERY bucket before finishing the first,
+        so bucket B's solve overlaps bucket A's readback and host
+        fan-out instead of serializing on it."""
+        solving = [
+            (slot, t)
+            for slot, t in enumerate(bucket.tenants)
+            if t is not None and t.needs_solve
+        ]
+        if not solving:
+            return None
+        _tracer = _get_tracer()
+        _span = _tracer.span_active("ops.tenant_dispatch")
+        _t0 = time.perf_counter()
+        bsz, s, n, k = bucket.slots, bucket.s, bucket.n, bucket.k
+        inc_t = np.zeros((bsz, _INC_SLOTS), dtype=np.int32)
+        inc_h = np.zeros((bsz, _INC_SLOTS), dtype=np.int32)
+        inc_w = np.full((bsz, _INC_SLOTS), INF, dtype=np.int32)
+        # in-kernel patch operands; the out-of-bounds row id ``n``
+        # marks padding (and untouched slots) — the fused scatter
+        # drops it, so idle lanes cost nothing
+        p_rows = np.full((bsz, _PATCH_SLOTS), n, dtype=np.int32)
+        p_src = np.zeros((bsz, _PATCH_SLOTS, k), dtype=np.int32)
+        p_w = np.zeros((bsz, _PATCH_SLOTS, k), dtype=np.int32)
+        warm_ct = cold_ct = 0
+        for slot, t in solving:
+            if t.srcs_dirty:
+                srcs_row = np.full(s, t.srcs[0], dtype=np.int32)
+                srcs_row[: len(t.srcs)] = t.srcs
+                bucket.srcs_dev = _slot_set(
+                    bucket.srcs_dev, np.int32(slot), srcs_row
+                )
+                t.srcs_dirty = False
+            if t.pending_rows:
+                rows = sorted(t.pending_rows)
+                t.pending_rows = set()
+                if len(rows) > _PATCH_SLOTS:
+                    # patch wider than the in-kernel row budget:
+                    # re-upload the whole slot (one warm executable)
+                    # instead of growing a scatter-shape ladder
+                    TENANCY_COUNTERS["patch_overflows"] += 1
+                    src_u, w_u, _ov = ell_pack_uniform(t.graph, n, k)
+                    sl = np.int32(slot)
+                    bucket.src_dev = _slot_set(
+                        bucket.src_dev, sl, src_u
+                    )
+                    bucket.w_dev = _slot_set(bucket.w_dev, sl, w_u)
+                else:
+                    ids = np.asarray(rows, dtype=np.int32)
+                    src_rows, w_rows = ell_uniform_rows(t.graph, ids, k)
+                    p_rows[slot, : len(rows)] = ids
+                    p_src[slot, : len(rows)] = src_rows
+                    p_w[slot, : len(rows)] = w_rows
+            ov_now = np.asarray(t.graph.overloaded)
+            edges = None
+            if t.solved and not t.force_reset:
+                edges = self._emit_increases(t, ov_now)
+                if len(edges) > _INC_SLOTS:
+                    edges = None  # journal wider than the slot budget
+            if edges is None:
+                edges = [_FORCE_RESET_EDGE]
+                cold_ct += 1
+            else:
+                warm_ct += 1
+            for x, (tt, hh, ww) in enumerate(edges):
+                inc_t[slot, x] = tt
+                inc_h[slot, x] = hh
+                inc_w[slot, x] = ww
+        cap = bucket.delta_cap
+        packed, d, src_new, w_new, ch_count, out = world_dispatch(
+            bucket.src_dev, bucket.w_dev, bucket.ov_dev,
+            bucket.srcs_dev, p_rows, p_src, p_w,
+            inc_t, inc_h, inc_w, bucket.d_dev, bucket.packed_dev,
+            cap,
+        )
+        bucket.src_dev = src_new
+        bucket.w_dev = w_new
+        bucket.d_dev = d
+        bucket.packed_dev = packed
+        return (
+            bucket, solving, warm_ct, cold_ct,
+            packed, ch_count, out, _span, _t0,
+        )
+
+    def _dispatch_finish(self, ctx) -> None:
+        """Phase 2: block on the in-flight solve, fan the compacted
+        delta back out to the per-tenant host mirrors, and settle the
+        journals + counters + span."""
+        (
+            bucket, solving, warm_ct, cold_ct,
+            packed, ch_count, out, _span, _t0,
+        ) = ctx
+        cap = bucket.delta_cap
+        # one transfer round trip for count + compacted rows (the
+        # count alone would sync on the whole dispatch anyway)
+        cnt_host, out_host = jax.device_get((ch_count, out))
+        cnt = int(cnt_host)
+        if cnt > cap:
+            TENANCY_COUNTERS["delta_overflows"] += 1
+            full = np.asarray(packed)
+            for slot, t in enumerate(bucket.tenants):
+                if t is not None:
+                    t.packed_host = np.array(full[slot])
+        elif cnt:
+            rows = out_host[:cnt]
+            slots = rows[:, 0]
+            for slot in np.unique(slots):
+                t = bucket.tenants[int(slot)]
+                if t is None:
+                    continue  # vacated slot: stale rows, drop
+                m = slots == slot
+                t.packed_host[rows[m, 1]] = rows[m, 2:]
+        TENANCY_COUNTERS["delta_rows"] += cnt
+        TENANCY_COUNTERS["dispatches"] += 1
+        TENANCY_COUNTERS["warm_solves"] += warm_ct
+        TENANCY_COUNTERS["cold_solves"] += cold_ct
+        for _slot, t in solving:
+            t.pending_edges = {}
+            t.pending_structural = False
+            t.ov_solved = np.array(t.graph.overloaded, copy=True)
+            t.force_reset = False
+            t.needs_solve = False
+            t.solved = True
+        _get_registry().observe(
+            "tenancy.dispatch_ms",
+            (time.perf_counter() - _t0) * 1000.0,
+        )
+        _get_tracer().end_span_active(
+            _span,
+            slots=bucket.slots,
+            resident=bucket.occupancy(),
+            solving=len(solving),
+            warm=warm_ct,
+            cold=cold_ct,
+            delta_rows=cnt,
+        )
+
+    def _update_gauges(self) -> None:
+        TENANCY_COUNTERS["active"] = len(self._tenants)
+        TENANCY_COUNTERS["resident"] = self.resident_count()
+
+
+_WORLDS: Optional[WorldManager] = None
+
+
+def get_world_manager() -> WorldManager:
+    """Process-wide arbiter (the device blocks are process-global
+    state, like spf_solver's resident ELL cache)."""
+    global _WORLDS
+    if _WORLDS is None:
+        _WORLDS = WorldManager()
+    return _WORLDS
+
+
+def reset_world_manager() -> None:
+    """Drop the process-wide arbiter and every device block it owns
+    (wired into decision.spf_solver.reset_device_caches: the cold rung
+    must not leak half-synced tenant state)."""
+    global _WORLDS
+    if _WORLDS is not None:
+        _WORLDS.reset()
+    _WORLDS = None
