@@ -520,6 +520,30 @@ def _run() -> dict:
             except Exception as e:
                 bench_tenancy = {"error": f"{type(e).__name__}: {e}"}
 
+    # eleventh leg: crash-recovery boot race — the state plane's cold
+    # boot (replay every publication) vs warm boot (recover the
+    # journaled checkpoint + rehydrate the resident engine from its
+    # snapshot), parity-gated; the warm/cold ratio is the recovery
+    # design's payoff number (make recovery-smoke is the hard CI gate;
+    # this leg folds the timing into the official bench artifact)
+    bench_recovery = None
+    if os.environ.get("OPENR_BENCH_RECOVERY") == "1":
+        if leg_elapsed() > 540:
+            bench_recovery = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import recovery_bench
+
+                bench_recovery = recovery_bench(
+                    int(os.environ.get(
+                        "OPENR_BENCH_RECOVERY_NODES", "200"
+                    ))
+                )
+            except Exception as e:
+                bench_recovery = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -597,6 +621,7 @@ def _run() -> dict:
         "bench_convergence_trace": bench_traces,
         "bench_sustained_load": bench_load,
         "bench_multi_tenant": bench_tenancy,
+        "bench_recovery": bench_recovery,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -669,6 +694,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_TRACES"] = "1"
         env["OPENR_BENCH_LOAD"] = "1"
         env["OPENR_BENCH_TENANCY"] = "1"
+        env["OPENR_BENCH_RECOVERY"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
@@ -676,6 +702,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env.pop("OPENR_BENCH_TRACES", None)
         env.pop("OPENR_BENCH_LOAD", None)
         env.pop("OPENR_BENCH_TENANCY", None)
+        env.pop("OPENR_BENCH_RECOVERY", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

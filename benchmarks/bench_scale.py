@@ -1411,6 +1411,172 @@ def multi_tenant_bench(
     }
 
 
+def recovery_bench(
+    nodes: int = 200, boots: int = 3, seed: int = 20260805,
+) -> dict:
+    """Crash-recovery leg (openr_tpu.state): cold boot vs warm boot.
+
+    A Decision journals a fat-tree LSDB plus a short churn tail through
+    ``StatePlane`` (checkpoint + WAL + engine snapshot), then the
+    process "crashes" (device caches dropped). Two boot paths race from
+    the same crash point, ``boots`` times each:
+
+    - COLD: a fresh Decision replays every publication from scratch and
+      pays the cold ELL build + first solve,
+    - WARM: open the backing store, ``recover()`` (journal over
+      checkpoint), ``warm_boot()`` — the resident ELL state is seeded
+      from the persisted snapshot and the rebuild reconverges warm.
+
+    Reports both boot medians, the warm/cold ratio (the recovery
+    design's payoff: warm << cold), the journal/checkpoint shape the
+    recovery replayed, and route parity between the two boots — a fast
+    warm boot that diverges is a failed one."""
+    import os
+    import shutil
+    import tempfile
+    from dataclasses import replace
+
+    from openr_tpu.config_store.persistent_store import PersistentStore
+    from openr_tpu.decision import spf_solver
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.decision.spf_solver import reset_device_caches
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.state import StatePlane
+    from openr_tpu.telemetry import get_registry
+    from openr_tpu.types import Publication, Value
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    reg = get_registry()
+    # route the bench area through the resident sliced-ELL path (the
+    # one the state plane snapshots)
+    spf_solver.SPARSE_NODE_THRESHOLD = 4
+    topo = topologies.fat_tree_nodes(nodes)
+    n = len(topo.adj_dbs)
+    node = next(m for m in sorted(topo.adj_dbs) if m.startswith("rsw"))
+    area = topo.area
+    workdir = tempfile.mkdtemp(prefix="openr_tpu_bench_recovery_")
+    path = os.path.join(workdir, "state.bin")
+    versions: dict = {}
+    published: list = []
+
+    def make_decision(name, plane=None):
+        return Decision(
+            node,
+            kvstore_updates_queue=ReplicateQueue(name=f"bkv-{name}"),
+            route_updates_queue=ReplicateQueue(name=f"brt-{name}"),
+            state_plane=plane,
+        )
+
+    def kv_value(key, originator, payload):
+        versions[key] = versions.get(key, 0) + 1
+        return Value(
+            version=versions[key],
+            originator_id=originator,
+            value=payload,
+        )
+
+    try:
+        store = PersistentStore(path)
+        plane = StatePlane(store, checkpoint_every=4)
+        live = make_decision("live", plane)
+        initial = {}
+        for adj_db in topo.adj_dbs.values():
+            initial[keyutil.adj_key(adj_db.this_node_name)] = kv_value(
+                keyutil.adj_key(adj_db.this_node_name),
+                adj_db.this_node_name,
+                wire.dumps(adj_db),
+            )
+        for pdb in topo.prefix_dbs.values():
+            initial[keyutil.prefix_db_key(pdb.this_node_name)] = kv_value(
+                keyutil.prefix_db_key(pdb.this_node_name),
+                pdb.this_node_name,
+                wire.dumps(pdb),
+            )
+        published.append(initial)
+        plane.on_kvstore_merge(area, initial)
+        live.process_publication(
+            Publication(key_vals=dict(initial), area=area)
+        )
+        live.rebuild_routes("BENCH")
+        live.checkpoint_state()
+        # short churn tail so recovery replays a real WAL, not just the
+        # checkpoint
+        mutated = dict(topo.adj_dbs)
+        for i, name in enumerate(sorted(mutated)[:4]):
+            adj_db = mutated[name]
+            adjs = list(adj_db.adjacencies)
+            adjs[0] = replace(adjs[0], metric=10 + i)
+            mutated[name] = replace(adj_db, adjacencies=tuple(adjs))
+            kv = {
+                keyutil.adj_key(name): kv_value(
+                    keyutil.adj_key(name), name,
+                    wire.dumps(mutated[name]),
+                )
+            }
+            published.append(kv)
+            plane.on_kvstore_merge(area, kv)
+            live.process_publication(
+                Publication(key_vals=dict(kv), area=area)
+            )
+            live.rebuild_routes("BENCH")
+        live.checkpoint_state()
+        routes_live = wire.dumps(live.route_db.to_route_db(node))
+        store.stop()
+
+        warm_ms, cold_ms = [], []
+        warm_seeds0 = reg.counter_get("state.warm_seeds")
+        rec = None
+        routes_warm = routes_cold = None
+        for _ in range(boots):
+            # warm: store open + recover + warm_boot, from a crashed
+            # process (resident device state gone)
+            reset_device_caches()
+            t0 = time.perf_counter()
+            store2 = PersistentStore(path)
+            plane2 = StatePlane(store2)
+            rec = plane2.recover()
+            warm = make_decision("warm", plane2)
+            warm.warm_boot(rec)
+            warm_ms.append(1000.0 * (time.perf_counter() - t0))
+            routes_warm = wire.dumps(warm.route_db.to_route_db(node))
+            store2.stop()
+
+            # cold: replay every publication from scratch
+            reset_device_caches()
+            t0 = time.perf_counter()
+            cold = make_decision("cold")
+            for kv in published:
+                cold.process_publication(
+                    Publication(key_vals=dict(kv), area=area)
+                )
+            cold.rebuild_routes("BENCH")
+            cold_ms.append(1000.0 * (time.perf_counter() - t0))
+            routes_cold = wire.dumps(cold.route_db.to_route_db(node))
+
+        warm_med = sorted(warm_ms)[len(warm_ms) // 2]
+        cold_med = sorted(cold_ms)[len(cold_ms) // 2]
+        return {
+            "bench": f"scale.recovery_{n}_warm_boot_ms",
+            "nodes": n,
+            "boots": boots,
+            "warm_boot_ms": round(warm_med, 3),
+            "cold_boot_ms": round(cold_med, 3),
+            "warm_vs_cold_ratio": round(
+                warm_med / max(cold_med, 1e-9), 4
+            ),
+            "journal_replayed": rec.journal_replayed,
+            "had_checkpoint": rec.had_checkpoint,
+            "warm_seeds": reg.counter_get("state.warm_seeds")
+            - warm_seeds0,
+            "parity": bool(
+                routes_warm == routes_cold == routes_live
+            ),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)

@@ -9,12 +9,16 @@ Used for drain/overload state, allocated prefixes and node labels
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from typing import Any, Dict, Optional
 
+from openr_tpu.telemetry import get_registry
 from openr_tpu.utils import wire
 from openr_tpu.utils.eventbase import AsyncThrottle, OpenrEventBase
+
+log = logging.getLogger(__name__)
 
 
 class PersistentStore:
@@ -74,9 +78,30 @@ class PersistentStore:
         try:
             with open(self._path, "rb") as f:
                 raw = f.read()
-            self._data = dict(wire.loads(raw, Dict[str, bytes]))
-        except (FileNotFoundError, ValueError, TypeError):
+        except FileNotFoundError:
             self._data = {}
+            return
+        try:
+            self._data = dict(wire.loads(raw, Dict[str, bytes]))
+        except (ValueError, TypeError, IndexError, EOFError) as exc:
+            # Corrupt/truncated store: start empty, but never silently.
+            # The bad bytes are parked at the .tmp sibling for forensics
+            # (the next atomic save overwrites .tmp last, so the evidence
+            # survives until a healthy save lands).
+            self._data = {}
+            get_registry().counter_bump("config_store.load_errors")
+            tmp = f"{self._path}.tmp"
+            try:
+                if not os.path.exists(tmp):
+                    with open(tmp, "wb") as f:
+                        f.write(raw)
+            except OSError:
+                pass
+            log.error(
+                "config-store %s unreadable (%d bytes): %s; starting "
+                "empty, corrupt bytes kept at %s",
+                self._path, len(raw), exc, tmp,
+            )
 
     def _save_to_disk(self) -> None:
         with self._lock:

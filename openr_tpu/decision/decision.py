@@ -189,7 +189,12 @@ class Decision:
         kvstore_reader_maxlen: Optional[int] = None,
         world_batch: Optional[bool] = None,
         view_cache_cap: Optional[int] = None,
+        state_plane=None,
     ):
+        # crash-safe state plane (openr_tpu.state.StatePlane): engine
+        # warm material is snapshotted after each debounced rebuild and
+        # warm_boot() rehydrates from its recover() result
+        self._state_plane = state_plane
         self._enable_rib_policy = enable_rib_policy
         self.my_node_name = my_node_name
         self.evb = OpenrEventBase(name=f"decision:{my_node_name}")
@@ -527,6 +532,69 @@ class Decision:
 
     def _on_debounce_fire(self) -> None:
         self.rebuild_routes("DECISION_DEBOUNCE")
+        # snapshot AFTER the solve window closes: the capture reads the
+        # resident distance rows back to host
+        if self._state_plane is not None:
+            self.checkpoint_state()
+
+    def checkpoint_state(self) -> None:
+        """Persist the engines' warm-start material to the state plane.
+
+        Runs outside any solve window (one small device->host readback
+        per area); failures are counted, never fatal — a crashed
+        capture just means the next boot seeds cold for that area.
+        """
+        if self._state_plane is None:
+            return
+        from openr_tpu.state import capture_engine_snapshot
+
+        for area, ls in self.area_link_states.items():
+            try:
+                snap = capture_engine_snapshot(area, ls)
+                if snap is not None:
+                    self._state_plane.record_engine_snapshot(snap)
+            except Exception:  # noqa: BLE001 - capture is best-effort
+                get_registry().counter_bump("state.capture_errors")
+        # cadence-gated: the journal IS the crash record between cuts;
+        # collapsing it on every converge would turn the WAL into a
+        # full-LSDB write per event
+        self._state_plane.maybe_checkpoint(only_if_due=True)
+
+    def warm_boot(self, recovered) -> int:
+        """Rehydrate from a ``StatePlane.recover()`` result.
+
+        Rebuilds the per-area LinkStates from the journal-recovered
+        LSDB, seeds the resident ELL engines from the persisted
+        snapshots (digest-gated — a journal that advanced past a
+        snapshot seeds cold, never wrong), and runs one rebuild so
+        ``route_db`` is serveable and the first route update reaches
+        Fib (ending its graceful-restart hold). Call BEFORE start().
+        Returns the number of areas seeded warm.
+        """
+        from openr_tpu.state import rehydrate_engine
+
+        tracer = get_tracer()
+        trace = tracer.start("recovery.warm_boot", node=self.my_node_name)
+        span = trace.begin_span("recovery.replay_lsdb")
+        for area, key_vals in sorted(recovered.key_vals_by_area.items()):
+            self.process_publication(
+                Publication(key_vals=dict(key_vals), area=area)
+            )
+        trace.end_span(span, areas=len(recovered.key_vals_by_area))
+        span = trace.begin_span("recovery.rehydrate_engines")
+        warm = 0
+        for area, ls in sorted(self.area_link_states.items()):
+            if rehydrate_engine(ls, recovered.engine_snapshots.get(area)):
+                warm += 1
+        trace.end_span(
+            span, warm=warm, areas=len(self.area_link_states)
+        )
+        span = trace.begin_span("recovery.rebuild")
+        self.rebuild_routes("WARM_BOOT")
+        trace.end_span(span)
+        tracer.finish(trace, ok=True)
+        get_registry().counter_bump("state.warm_boots")
+        return warm
 
     @solve_window
     def rebuild_routes(self, event: str) -> None:

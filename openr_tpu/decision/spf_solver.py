@@ -666,6 +666,27 @@ class _EllResidentCache:
 _ELL_RESIDENT = _EllResidentCache()
 
 
+def export_resident_state(ls: LinkState):
+    """The version-matched, solved resident ``EllState`` for ``ls`` —
+    or None when nothing warm exists. The crash-safe state plane
+    (``openr_tpu.state.snapshot``) serializes its warm material from
+    this; the EllState itself never leaves the process."""
+    entry = _ELL_RESIDENT._cache.get(ls)
+    if entry is None:
+        return None
+    version, state = entry
+    if version != ls.topology_version or state._d_dev is None:
+        return None
+    return state
+
+
+def seed_resident_state(ls: LinkState, state) -> None:
+    """Install a rehydrated ``EllState`` as the resident entry for
+    ``ls`` at its current topology version (warm-boot path: the state
+    plane rebuilt it from a persisted snapshot, digest-gated)."""
+    _ELL_RESIDENT._cache[ls] = (ls.topology_version, state)
+
+
 def reset_device_caches() -> None:
     """Drop every module-level device-derived cache (resident ELL
     bands, preloaded views, compiled graph snapshots). The degradation

@@ -7,11 +7,13 @@ seams fail for real.
 """
 
 from openr_tpu.faults.injector import (
+    DeviceLostError,
     FaultInjected,
     FaultInjector,
     FaultSchedule,
     fault_point,
     get_injector,
+    is_device_loss,
     register_fault_site,
 )
 from openr_tpu.faults.supervisor import (
@@ -22,6 +24,7 @@ from openr_tpu.faults.supervisor import (
 
 __all__ = [
     "DegradationSupervisor",
+    "DeviceLostError",
     "FaultInjected",
     "FaultInjector",
     "FaultSchedule",
@@ -29,5 +32,6 @@ __all__ = [
     "LadderExhausted",
     "fault_point",
     "get_injector",
+    "is_device_loss",
     "register_fault_site",
 ]

@@ -154,6 +154,49 @@ class FaultInjector:
         raise FaultInjected(site)
 
 
+class DeviceLostError(RuntimeError):
+    """An accelerator died under resident state.
+
+    Raised by the ``device.lost`` seam (and recognized when the runtime
+    raises its own device-loss flavored ``XlaRuntimeError``); the
+    dispatch/consume fault boundaries poison the residents and the
+    ladder's recover rung rebuilds them from the host mirrors.
+    """
+
+    def __init__(self, site: str = "device.lost") -> None:
+        super().__init__(f"device lost at {site}")
+        self.site = site
+
+
+# Substrings the XLA runtime uses for a lost/failed device; matched
+# case-insensitively against the exception text.
+_DEVICE_LOSS_MARKERS = (
+    "device lost",
+    "device is lost",
+    "device failure",
+    "deadline exceeded waiting for device",
+    "hbm is corrupted",
+    "data loss:",
+)
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """True when ``exc`` means the accelerator (not the program) died.
+
+    Covers the typed ``DeviceLostError``, the ``device.lost`` injection
+    seam, and real ``XlaRuntimeError`` texts carrying a device-loss
+    marker.
+    """
+    if isinstance(exc, DeviceLostError):
+        return True
+    if isinstance(exc, FaultInjected) and exc.site == "device.lost":
+        return True
+    if type(exc).__name__ == "XlaRuntimeError":
+        msg = str(exc).lower()
+        return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+    return False
+
+
 _INJECTOR = FaultInjector()
 
 

@@ -54,6 +54,7 @@ class Fib:
         retry_min_s: float = 0.05,
         retry_max_s: float = 2.0,
         log_sample_queue: Optional[ReplicateQueue] = None,
+        graceful_restart_hold_s: float = 0.0,
     ):
         self.my_node_name = my_node_name
         self.agent = agent
@@ -73,6 +74,14 @@ class Fib:
         self._backoff = ExponentialBackoff(retry_min_s, retry_max_s)
         self._retry_timer = None
         self._agent_alive_since: Optional[int] = None
+        # graceful restart: a warm-booted process serves the
+        # journal-recovered RouteDatabase and HOLDS the previously
+        # programmed routes (no deletes, no churn) until Decision
+        # re-converges or the hold timer fires — either way ONE full
+        # sync_fib reconciles the agent table; routes never flap.
+        self.graceful_restart_hold_s = graceful_restart_hold_s
+        self._gr_active = False
+        self._gr_timer = None
         self.counters = {
             "fib.route_programming_failures": 0,
             "fib.sync_fib_calls": 0,
@@ -80,6 +89,9 @@ class Fib:
             "fib.routes_deleted": 0,
             "fib.agent_restarts": 0,
             "fib.unacked_reprogrammed": 0,
+            "fib.graceful_restarts": 0,
+            "fib.gr_reconciles": 0,
+            "fib.gr_hold_expirations": 0,
         }
         # prefixes/labels a failed delta left in unknown agent state
         # (the program call may have partially landed before the
@@ -111,11 +123,68 @@ class Fib:
         except Exception:
             self._agent_alive_since = None
         self.evb.run_in_thread()
+        if self._gr_active and self.graceful_restart_hold_s > 0:
+            self._gr_timer = self.evb.schedule_timeout(
+                self.graceful_restart_hold_s, self._on_gr_hold_expired
+            )
 
     def stop(self) -> None:
         self._keepalive.cancel()
+        if self._gr_timer is not None:
+            self._gr_timer.cancel()
+            self._gr_timer = None
         self.evb.stop()
         self.evb.join()
+
+    # -- graceful restart -------------------------------------------------
+
+    def start_graceful_restart(
+        self, route_db: RouteDatabase, hold_s: Optional[float] = None
+    ) -> None:
+        """Seed the desired state from a recovered RouteDatabase and
+        enter the graceful-restart hold: the previous life's routes are
+        presumed still programmed in the agent, so nothing is deleted
+        or re-programmed until Decision re-converges (first route
+        update) or the hold timer expires — then a single ``sync_fib``
+        reconciles the table. Call BEFORE ``start()``."""
+        if hold_s is not None:
+            self.graceful_restart_hold_s = hold_s
+        for r in route_db.unicast_routes:
+            self.unicast_routes[r.dest] = r
+        for r in route_db.mpls_routes:
+            self.mpls_routes[r.label] = r
+        self._gr_active = True
+        # the agent table already holds these routes from the previous
+        # life — do NOT treat the boot as never-synced (that would
+        # force an immediate full sync and defeat the hold)
+        self._synced_once = True
+        self._dirty = False
+        self.counters["fib.graceful_restarts"] += 1
+        get_registry().counter_bump("fib.graceful_restarts")
+
+    def _cancel_graceful_restart(self) -> None:
+        self._gr_active = False
+        if self._gr_timer is not None:
+            self._gr_timer.cancel()
+            self._gr_timer = None
+
+    def _end_graceful_restart(self) -> bool:
+        """Reconcile: one full sync replaces the held table with the
+        current desired state. Unchanged routes are re-asserted, never
+        withdrawn — the no-flap contract."""
+        self._cancel_graceful_restart()
+        self.counters["fib.gr_reconciles"] += 1
+        return self._sync_route_db()
+
+    def _on_gr_hold_expired(self) -> None:
+        self._gr_timer = None
+        if not self._gr_active:
+            return
+        # Decision never re-converged within the hold: stop waiting and
+        # reconcile with what the journal recovered
+        self.counters["fib.gr_hold_expirations"] += 1
+        if not self._end_graceful_restart():
+            self._mark_dirty()
 
     # -- route updates ----------------------------------------------------
 
@@ -139,7 +208,12 @@ class Fib:
         for entry in update.mpls_routes_to_update:
             self.mpls_routes[entry.label] = entry.to_mpls_route()
 
-        if not self._synced_once or self._dirty:
+        if self._gr_active:
+            # first update after a warm boot: Decision re-converged, so
+            # end the hold with the one reconciling sync (the delta is
+            # subsumed by the full desired state)
+            ok = self._end_graceful_restart()
+        elif not self._synced_once or self._dirty:
             ok = self._sync_route_db()
         else:
             ok = self._program_delta(update)
@@ -287,6 +361,10 @@ class Fib:
         if alive != self._agent_alive_since:
             self._agent_alive_since = alive
             self.counters["fib.agent_restarts"] += 1
+            # an agent restart voids graceful restart's premise (the
+            # held routes are gone from its table) — reconcile now via
+            # the restart resync instead of waiting out the hold
+            self._cancel_graceful_restart()
             # the restarted agent lost its table: every desired route
             # is effectively unacknowledged until the sync lands
             self._unacked_prefixes.update(self.unicast_routes)

@@ -26,6 +26,7 @@ executor so store event loops never block on each other.
 from __future__ import annotations
 
 import heapq
+import logging
 import random
 import threading
 import time
@@ -52,6 +53,8 @@ from openr_tpu.utils.eventbase import ExponentialBackoff, OpenrEventBase
 # ttl decrement applied when re-flooding, so a key eventually dies even in
 # a flood loop (reference: Constants.h kTtlDecrement)
 TTL_DECREMENT_MS = 1
+
+_LOG = logging.getLogger(__name__)
 
 # injection seams for the store's two peer-I/O paths: the 3-way full
 # sync request and the flood fan-out. Both fire on the executor thread
@@ -253,6 +256,7 @@ class KvStoreDb:
         is_flood_root: bool = False,
         flood_rate: Optional[Tuple[float, int]] = None,
         log_sample_queue: Optional[ReplicateQueue] = None,
+        merge_hook=None,
     ):
         self.area = area
         self.node_id = node_id
@@ -261,6 +265,9 @@ class KvStoreDb:
         self._executor = executor
         self._filters = filters
         self._log_sample_queue = log_sample_queue
+        # crash-safe state plane: called with (area, accepted updates)
+        # after every merge, on this evb (StatePlane.on_kvstore_merge)
+        self._merge_hook = merge_hook
         self.key_vals: Dict[str, Value] = {}
         self.peers: Dict[str, _Peer] = {}
         # flood rate limiting: token bucket + coalescing buffer
@@ -296,6 +303,7 @@ class KvStoreDb:
             "kvstore.rate_limit_suppress": 0,
             "kvstore.full_sync_failures": 0,
             "kvstore.flood_errors": 0,
+            "kvstore.journal_errors": 0,
         }
 
     def _log_sample(self, **fields) -> None:
@@ -318,6 +326,18 @@ class KvStoreDb:
         if not updates:
             return
         self._track_ttls(updates)
+        if self._merge_hook is not None:
+            # write-ahead: the journal lands before the publication so a
+            # crash mid-publish replays at least what Decision consumed
+            try:
+                self._merge_hook(self.area, updates)
+            except Exception as exc:  # noqa: BLE001 - journal must not kill the merge path
+                self.counters["kvstore.journal_errors"] += 1
+                get_registry().counter_bump("state.journal_errors")
+                _LOG.error(
+                    "kvstore[%s] state-plane journal append failed: %s",
+                    self.area, exc,
+                )
         # telemetry: every accepted merge births one trace; Decision
         # adopts the oldest trace in a debounce window, Fib retires it
         trace = get_tracer().start(
@@ -872,6 +892,7 @@ class KvStore:
         is_flood_root: bool = False,
         flood_rate: Optional[Tuple[float, int]] = None,
         log_sample_queue: Optional[ReplicateQueue] = None,
+        state_plane=None,
     ):
         self.node_id = node_id
         self.evb = OpenrEventBase(name=f"kvstore:{node_id}")
@@ -894,6 +915,11 @@ class KvStore:
                 is_flood_root=is_flood_root,
                 flood_rate=flood_rate,
                 log_sample_queue=log_sample_queue,
+                merge_hook=(
+                    state_plane.on_kvstore_merge
+                    if state_plane is not None
+                    else None
+                ),
             )
         self._sync_interval = sync_interval_s
         self._sync_timer = None

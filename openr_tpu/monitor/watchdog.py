@@ -14,6 +14,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from openr_tpu.monitor.monitor import SystemMetrics
+from openr_tpu.telemetry import get_registry
 from openr_tpu.utils.eventbase import OpenrEventBase
 
 
@@ -33,6 +34,10 @@ class Watchdog:
         self._monitored: List[Tuple[str, OpenrEventBase]] = []
         self._timer = None
         self.violations: List[str] = []
+        # how many monitored event bases the LAST check found stalled —
+        # a gauge a dashboard can alert on before fire_crash aborts
+        self._stalled = 0
+        get_registry().gauge("watchdog.stalled", lambda: self._stalled)
 
     # -- registration -----------------------------------------------------
 
@@ -58,14 +63,18 @@ class Watchdog:
 
     def _check(self) -> None:
         now = time.monotonic()
+        stalled = 0
         for name, evb in self._monitored:
             if not evb.is_running:
                 continue
             stalled_for = now - evb.last_loop_ts
             if stalled_for > self._thread_timeout:
+                stalled += 1
+                get_registry().counter_bump(f"watchdog.stalls.{name}")
                 self._fire_crash(
                     f"event base {name!r} stalled for {stalled_for:.1f}s"
                 )
+        self._stalled = stalled
         if self.memory_limit_exceeded():
             self._fire_crash(
                 f"memory limit exceeded: rss={SystemMetrics.rss_bytes()}"

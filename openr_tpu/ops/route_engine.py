@@ -94,7 +94,11 @@ from openr_tpu.analysis.annotations import (
     resident_buffers,
     solve_window,
 )
-from openr_tpu.faults.injector import fault_point, register_fault_site
+from openr_tpu.faults.injector import (
+    fault_point,
+    is_device_loss,
+    register_fault_site,
+)
 from openr_tpu.faults.supervisor import DegradationSupervisor
 from openr_tpu.telemetry import get_registry, get_tracer
 
@@ -104,6 +108,11 @@ FAULT_DISPATCH = register_fault_site("route_engine.dispatch")
 FAULT_CONSUME = register_fault_site("route_engine.consume")
 FAULT_COLD_BUILD = register_fault_site("route_engine.cold_build")
 FAULT_FRONTIER = register_fault_site("route_engine.frontier_resolve")
+# the accelerator itself dying under the residents (vs. a failed
+# dispatch on a healthy device): fires at the same dispatch/consume
+# crossings, recognized by faults.is_device_loss, recovered by the
+# ladder's dedicated rung (_device_recover)
+FAULT_DEVICE_LOST = register_fault_site("device.lost")
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
@@ -844,6 +853,9 @@ class RouteSweepEngine:
         # resident gets an explicit NamedSharding (rows striped,
         # bands/edges replicated) so churn dispatches never reshard
         self.plan = ShardingPlan(mesh) if mesh is not None else None
+        # pre-mesh alignment, kept so a device-loss mesh shrink can
+        # re-derive the per-shard row block for the surviving devices
+        self._base_align = align
         if mesh is not None:
             # every shard must own an equal block of destination rows
             align = align * mesh.devices.size
@@ -871,7 +883,13 @@ class RouteSweepEngine:
         # False between a failed/bypassed device path and the next
         # successful cold build: gates the warm rung off stale residents
         self._device_valid = False
+        # True between an observed device loss (is_device_loss at a
+        # rung boundary) and the recover rung re-landing the residents;
+        # gates the recover rung so it is a no-op on ordinary faults
+        self._device_lost = False
         self.host_fallbacks = 0
+        self.device_rebuilds = 0
+        self.mesh_shrinks = 0
         self.supervisor = DegradationSupervisor("route_engine")
         self._build(ls)
 
@@ -1053,6 +1071,7 @@ class RouteSweepEngine:
         and the changed rows only at consume time."""
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         fault_point(FAULT_DISPATCH)
+        fault_point(FAULT_DEVICE_LOST)
         graph = ctx["patched"]
         if self.mesh is None:
             (new_v, new_w_t, dr, digests, packed_res,
@@ -1418,6 +1437,7 @@ class RouteSweepEngine:
         # ladder rung reassembles the whole result, so the staleness
         # cannot outlive the walk
         fault_point(FAULT_CONSUME)
+        fault_point(FAULT_DEVICE_LOST)
         tracer = get_tracer()
         span = tracer.span_active("ops.route_engine.delta_consume")
         reg = get_registry()
@@ -1486,28 +1506,135 @@ class RouteSweepEngine:
     def churn(self, ls, affected_nodes: Set[str],
               defer_consume: bool = False):
         """Apply one churn event, SUPERVISED: the degradation ladder
-        walks warm incremental re-solve → drain + cold device rebuild
-        → host NumPy fallback, each rung producing a bit-identical
-        route product, until one succeeds (LadderExhausted if none
-        does). Returns the warm path's affected destination NAMES /
-        PendingDelta (``defer_consume=True``), or None from the deeper
-        rungs — the pre-existing cold-rebuild contract."""
+        walks warm incremental re-solve → device-loss recovery → drain
+        + cold device rebuild → host NumPy fallback, each rung
+        producing a bit-identical route product, until one succeeds
+        (LadderExhausted if none does). Returns the warm path's
+        affected destination NAMES / PendingDelta
+        (``defer_consume=True``), or None from the deeper rungs — the
+        pre-existing cold-rebuild contract. The recover rung is inert
+        (fails straight through) unless a rung failure was recognized
+        as a device loss."""
         return self.supervisor.run((
-            ("warm", lambda: self._churn_device(
-                ls, affected_nodes, defer_consume
+            ("warm", lambda: self._rung_guard(
+                self._churn_device, ls, affected_nodes, defer_consume
             )),
-            ("cold", lambda: self._cold_recover(ls)),
+            ("recover", lambda: self._rung_guard(
+                self._device_recover, ls, affected_nodes, defer_consume
+            )),
+            ("cold", lambda: self._rung_guard(self._cold_recover, ls)),
             ("host", lambda: self._host_fallback(ls)),
         ))
 
+    def _rung_guard(self, fn, *args):
+        """Run one ladder rung, marking the engine device-lost when the
+        failure is the accelerator dying (typed DeviceLostError, the
+        ``device.lost`` seam, or a device-loss flavored
+        XlaRuntimeError) — the marker arms the recover rung. The
+        exception still propagates so the supervisor walks the
+        ladder."""
+        try:
+            return fn(*args)
+        except Exception as exc:  # noqa: BLE001 - re-raised below
+            if is_device_loss(exc):
+                self._device_valid = False
+                self._device_lost = True
+                get_registry().counter_bump("recovery.device_lost")
+            raise
+
     @fault_boundary
     def _cold_recover(self, ls) -> None:
-        """Ladder rung 1: drain + cold device rebuild. Layout, host
+        """Ladder rung 2: drain + cold device rebuild. Layout, host
         mirrors, and residents are all rederived from the LinkState —
         the cold-twin contract of the parity suite makes the result
         bit-identical to the warm path's."""
         self._build(ls)
         return None
+
+    def _make_sweeper(self, graph):
+        """Backend hook: a fresh sweeper (device band/sample uploads)
+        over an ALREADY-COMPILED host graph — the device-loss recovery
+        path, which must not pay the host layout recompile."""
+        return rs.RouteSweeper(graph, self.sample_names, plan=self.plan)
+
+    def _probe_device(self, dev) -> bool:
+        """Liveness probe for one mesh device (monkeypatchable: tests
+        and the chaos harness simulate partial mesh loss here)."""
+        try:
+            jax.device_put(
+                np.zeros((), np.int32), dev
+            ).block_until_ready()
+            return True
+        except Exception:  # noqa: BLE001 - any failure means dead
+            return False
+
+    def _surviving_devices(self):
+        return [d for d in self.mesh.devices.flat if self._probe_device(d)]
+
+    @fault_boundary
+    @requires_drain("_discard_pending")
+    def _device_recover(self, ls, affected_nodes: Set[str],
+                        defer_consume: bool = False):
+        """Ladder rung 1: rebuild the residents on a live device from
+        the host mirrors after a device loss. Single-chip (and a mesh
+        whose devices all answer the liveness probe): re-land the
+        resident sweeper + full product from ``self.graph`` — host
+        layout intact, no ``compile_ell``, the dispatch shapes are
+        already jitted. A mesh that lost devices SHRINKS to the
+        survivors (typed ``recovery.mesh_shrinks`` counter — never
+        silent) and cold-builds on the smaller mesh. Either way the
+        rung finishes by re-running the warm churn body for the event
+        that observed the loss, so the caller sees the ordinary warm
+        contract."""
+        if not self._device_lost:
+            raise _DeviceStateInvalid(
+                "no device loss observed (recover rung idle)"
+            )
+        self._discard_pending()
+        reg = get_registry()
+        tracer = get_tracer()
+        span = tracer.span_active("recovery.device_rebuild")
+        self._device_lost = False
+        shrunk = False
+        if self.mesh is not None:
+            survivors = self._surviving_devices()
+            if not survivors:
+                tracer.end_span_active(span, ok=False)
+                raise _DeviceStateInvalid(
+                    "device recovery: no surviving devices in mesh"
+                )
+            if len(survivors) < self.mesh.devices.size:
+                shrunk = True
+                self.mesh_shrinks += 1
+                reg.counter_bump("recovery.mesh_shrinks")
+                self.mesh = Mesh(
+                    np.asarray(survivors), self.mesh.axis_names
+                )
+                self.plan = ShardingPlan(self.mesh)
+                self._align = self._base_align * self.mesh.devices.size
+                reg.counter_set(
+                    "recovery.mesh_size", self.mesh.devices.size
+                )
+        if shrunk:
+            # per-shard row blocks changed: the layout must re-align,
+            # so this is a true cold build on the surviving mesh
+            self._build(ls)
+        else:
+            self.sweeper = self._make_sweeper(self.graph)
+            dr, digests, packed = self._full_resident(self.graph)
+            self._dr = dr
+            self._digests_dev = digests
+            self._packed_dev = packed
+            self.result = rs.assemble_result(
+                self.sweeper, jax.device_get(packed)
+            )
+            self._device_valid = True
+        self.device_rebuilds += 1
+        reg.counter_bump("recovery.device_rebuilds")
+        tracer.end_span_active(span, shrunk=shrunk)
+        # the residents now mirror the last COMMITTED event; the event
+        # that observed the loss has not landed — run it warm
+        return self._churn_device(ls, affected_nodes, defer_consume)
 
     def _discard_pending(self) -> None:
         """Drop the in-flight delta WITHOUT the host-side apply: the
@@ -1950,6 +2077,14 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             graph, self.sample_names, plan=self.plan
         )
 
+    def _make_sweeper(self, graph):
+        # device-loss recovery: re-land the segment tensors from the
+        # current host graph; the slot table keys on layout, which a
+        # patch never changes, so self._slots stays valid
+        return sg.GroupedRouteSweeper(
+            graph, self.sample_names, plan=self.plan
+        )
+
     def _full_resident(self, graph):
         impl = sg.get_grouped_impl()
         if self.mesh is None:
@@ -2032,6 +2167,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         fault_point(FAULT_DISPATCH)
+        fault_point(FAULT_DEVICE_LOST)
         graph = ctx["patched"]
         impl = sg.get_grouped_impl()
         upd_g, upd_s, upd_r, upd_w = ctx["upd"]

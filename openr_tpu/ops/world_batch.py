@@ -67,7 +67,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from openr_tpu.ops.route_engine import world_dispatch
+from openr_tpu.faults import fault_point, is_device_loss
+from openr_tpu.ops.route_engine import FAULT_DEVICE_LOST, world_dispatch
 from openr_tpu.ops.spf import INF
 from openr_tpu.ops.spf_sparse import (
     _FORCE_RESET_EDGE,
@@ -116,6 +117,7 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "delta_rows",        # compacted rows read back
         "delta_overflows",   # full-block readback fallbacks
         "patch_overflows",   # full-slot re-uploads (patch > row budget)
+        "device_loss_recoveries",  # torn dispatches rebuilt from host
     ],
     prefix="tenancy.",
 )
@@ -284,21 +286,30 @@ class WorldManager:
         ]
         pending = [t for t in tenants if t.needs_solve]
         waves = 0
+        recoveries = 0
         while pending:
             waves += 1
-            assert waves <= 2 * len(tenants) + 2, "tenancy livelock"
+            assert (
+                waves <= 2 * len(tenants) + 2 + 2 * recoveries
+            ), "tenancy livelock"
             for t in pending:
                 self._ensure_resident(t)
             # launch every bucket's fused solve before blocking on the
             # first readback: dispatches are async, so bucket B's
             # compute overlaps bucket A's delta fan-out
-            ctxs = [
-                self._dispatch_launch(bucket)
-                for bucket in {t.bucket for t in pending if t.bucket}
-            ]
-            for ctx in ctxs:
-                if ctx is not None:
-                    self._dispatch_finish(ctx)
+            try:
+                ctxs = [
+                    self._dispatch_launch(bucket)
+                    for bucket in {t.bucket for t in pending if t.bucket}
+                ]
+                for ctx in ctxs:
+                    if ctx is not None:
+                        self._dispatch_finish(ctx)
+            except Exception as exc:  # noqa: BLE001 - loss triage below
+                if not is_device_loss(exc) or recoveries >= 2:
+                    raise
+                recoveries += 1
+                self._recover_device_loss()
             pending = [t for t in pending if t.needs_solve]
         self._enforce_residency()
         self._update_gauges()
@@ -320,6 +331,22 @@ class WorldManager:
         self._buckets = {}
         self._tenants = {}
         self._update_gauges()
+
+    def _recover_device_loss(self) -> None:
+        """Device-loss fault boundary: every resident block is suspect,
+        so demote every tenant to its host snapshot and drop the device
+        buckets. The mirrors and journals are pre-dispatch state —
+        ``_dispatch_finish`` settles them only on success, so a torn
+        dispatch leaves nothing half-committed on the host — and the
+        next wave re-places each pending tenant from ``packed_host``
+        (a warm rehydration, not a cold solve). Never silent: counted
+        in ``tenancy.device_loss_recoveries`` + ``recovery.device_lost``."""
+        for t in self._tenants.values():
+            if t.slot is not None:
+                self._detach(t)
+        self._buckets = {}
+        TENANCY_COUNTERS["device_loss_recoveries"] += 1
+        _get_registry().counter_bump("recovery.device_lost")
 
     def resident_count(self) -> int:
         return sum(
@@ -649,6 +676,7 @@ class WorldManager:
                 inc_h[slot, x] = hh
                 inc_w[slot, x] = ww
         cap = bucket.delta_cap
+        fault_point(FAULT_DEVICE_LOST)
         packed, d, src_new, w_new, ch_count, out = world_dispatch(
             bucket.src_dev, bucket.w_dev, bucket.ov_dev,
             bucket.srcs_dev, p_rows, p_src, p_w,

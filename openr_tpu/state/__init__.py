@@ -1,0 +1,39 @@
+"""Crash-safe state plane: WAL + checkpoint of the LSDB and the route
+engine's warm-start material, persisted through ``PersistentStore``'s
+atomic-commit path.
+
+``StatePlane`` journals every accepted KvStore merge, collapses the
+journal into a periodic checkpoint, and snapshots Decision's resident
+ELL warm material (distance rows + patch journal + overload mask — the
+per-tenant evict-to-host format from ``ops.world_batch`` generalized to
+the primary engine). On boot, ``StatePlane.recover()`` replays
+journal-over-checkpoint and ``Decision.warm_boot`` rehydrates the route
+engine WARM: bit-identical RouteDatabase vs a cold oracle build, zero
+jit compiles beyond persistent-cache hits.
+"""
+
+from openr_tpu.state.plane import (
+    FAULT_CHECKPOINT_WRITE,
+    JournalRecord,
+    LsdbCheckpoint,
+    RecoveredState,
+    StatePlane,
+)
+from openr_tpu.state.snapshot import (
+    EngineSnapshot,
+    capture_engine_snapshot,
+    graph_digest,
+    rehydrate_engine,
+)
+
+__all__ = [
+    "EngineSnapshot",
+    "FAULT_CHECKPOINT_WRITE",
+    "JournalRecord",
+    "LsdbCheckpoint",
+    "RecoveredState",
+    "StatePlane",
+    "capture_engine_snapshot",
+    "graph_digest",
+    "rehydrate_engine",
+]

@@ -1,0 +1,234 @@
+"""Write-ahead journal + periodic checkpoint of the KvStore LSDB.
+
+Record layout inside the ``PersistentStore`` (one wire-encoded object
+per key, committed tmp+rename+fsync by the store):
+
+- ``state:lsdb:ckpt``              — ``LsdbCheckpoint``: the full
+  ``{area: {key: Value}}`` map as of journal seq ``seq`` (exclusive).
+- ``state:lsdb:journal:<seq>``     — ``JournalRecord``: one accepted
+  KvStore merge (the post-CRDT-merge winners only), zero-padded seq so
+  the store's sorted key order IS replay order.
+- ``state:engine:<area>``          — ``EngineSnapshot``: the resident
+  ELL warm material for that area's primary engine (see
+  ``state.snapshot``).
+
+Recovery ordering: load checkpoint, replay journal records with
+``seq >= ckpt.seq`` in seq order (accepted updates are strictly newer
+under the CRDT merge order, so a plain per-key overwrite replays the
+merge), then rehydrate engines against the recovered LSDB — an engine
+snapshot whose graph digest no longer matches (journal advanced past
+it) seeds cold, never wrong.
+
+The ``state.checkpoint_write`` fault seam fires before the checkpoint
+commit: a failed checkpoint leaves the journal intact, so chaos storms
+prove checkpoint loss is recoverable.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from openr_tpu.config_store.persistent_store import PersistentStore
+from openr_tpu.faults import FaultInjected, fault_point, register_fault_site
+from openr_tpu.state.snapshot import EngineSnapshot
+from openr_tpu.telemetry import get_registry
+from openr_tpu.types.kvstore import Value
+
+FAULT_CHECKPOINT_WRITE = register_fault_site("state.checkpoint_write")
+
+_CKPT_KEY = "state:lsdb:ckpt"
+_JOURNAL_PREFIX = "state:lsdb:journal:"
+_ENGINE_PREFIX = "state:engine:"
+
+
+@dataclass
+class JournalRecord:
+    """One accepted KvStore merge (post-merge winners only)."""
+
+    seq: int = 0
+    area: str = ""
+    key_vals: Dict[str, Value] = field(default_factory=dict)
+
+
+@dataclass
+class LsdbCheckpoint:
+    """Full LSDB as of journal ``seq`` (exclusive)."""
+
+    seq: int = 0
+    key_vals_by_area: Dict[str, Dict[str, Value]] = field(
+        default_factory=dict
+    )
+
+
+@dataclass
+class RecoveredState:
+    """What ``StatePlane.recover()`` hands the warm-booting process."""
+
+    key_vals_by_area: Dict[str, Dict[str, Value]] = field(
+        default_factory=dict
+    )
+    engine_snapshots: Dict[str, EngineSnapshot] = field(
+        default_factory=dict
+    )
+    journal_replayed: int = 0
+    had_checkpoint: bool = False
+
+
+def _journal_key(seq: int) -> str:
+    return f"{_JOURNAL_PREFIX}{seq:012d}"
+
+
+class StatePlane:
+    """The WAL/checkpoint writer and the boot-time replayer.
+
+    Journal appends arrive on the KvStore evb (via the merge hook);
+    checkpoints may be cut from any thread. The in-memory LSDB mirror
+    under ``_lock`` is the checkpoint source — it is exactly
+    checkpoint + journal, so a checkpoint never needs to re-read disk.
+    """
+
+    def __init__(
+        self, store: PersistentStore, checkpoint_every: int = 64
+    ) -> None:
+        self._store = store
+        self._lock = threading.Lock()
+        self._lsdb: Dict[str, Dict[str, Value]] = {}
+        self._next_seq = 0
+        self._ckpt_seq = 0
+        self._checkpoint_every = max(1, int(checkpoint_every))
+        self._replaying = False
+
+    # -- journal ------------------------------------------------------
+
+    def on_kvstore_merge(
+        self, area: str, updates: Dict[str, Value]
+    ) -> None:
+        """KvStore merge hook: journal one accepted update batch.
+
+        Called with the post-merge winners only (strictly newer under
+        the CRDT order), so the mirror update is a plain overwrite.
+        """
+        if not updates or self._replaying:
+            return
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._lsdb.setdefault(area, {}).update(updates)
+            journal_len = self._next_seq - self._ckpt_seq
+        self._store.store(
+            _journal_key(seq),
+            JournalRecord(seq=seq, area=area, key_vals=dict(updates)),
+        )
+        get_registry().counter_bump("state.journal_appends")
+        if journal_len >= self._checkpoint_every:
+            self.maybe_checkpoint()
+
+    # -- checkpoint ---------------------------------------------------
+
+    def checkpoint(self) -> None:
+        """Collapse the journal into a fresh full-LSDB checkpoint.
+
+        Raises if the commit fails (including the injected
+        ``state.checkpoint_write`` seam); the journal is untouched on
+        failure, so recovery replays through the old checkpoint.
+        """
+        with self._lock:
+            upto = self._next_seq
+            snap = {a: dict(kv) for a, kv in self._lsdb.items()}
+        fault_point(FAULT_CHECKPOINT_WRITE)
+        self._store.store(
+            _CKPT_KEY, LsdbCheckpoint(seq=upto, key_vals_by_area=snap)
+        )
+        for key in self._store.keys():
+            if key.startswith(_JOURNAL_PREFIX):
+                if int(key[len(_JOURNAL_PREFIX):]) < upto:
+                    self._store.erase(key)
+        with self._lock:
+            self._ckpt_seq = max(self._ckpt_seq, upto)
+        reg = get_registry()
+        reg.counter_bump("state.checkpoint_writes")
+        reg.counter_set("state.checkpoint_seq", upto)
+
+    def checkpoint_due(self) -> bool:
+        """True when the journal has grown past the checkpoint cadence."""
+        return self.journal_length() >= self._checkpoint_every
+
+    def maybe_checkpoint(self, only_if_due: bool = False) -> bool:
+        """Checkpoint, absorbing failures (counted, journal intact).
+
+        With ``only_if_due`` the cut is cadence-gated: callers on hot
+        paths (Decision's post-converge hook) skip the full-LSDB write
+        until the journal has actually grown past ``checkpoint_every``.
+        """
+        if only_if_due and not self.checkpoint_due():
+            return False
+        try:
+            self.checkpoint()
+            return True
+        except (FaultInjected, OSError, ValueError, TypeError):
+            get_registry().counter_bump("state.checkpoint_failures")
+            return False
+
+    # -- engine snapshots ---------------------------------------------
+
+    def record_engine_snapshot(self, snap: EngineSnapshot) -> None:
+        self._store.store(f"{_ENGINE_PREFIX}{snap.area}", snap)
+        get_registry().counter_bump("state.engine_snapshots")
+
+    # -- recovery -----------------------------------------------------
+
+    def recover(self) -> RecoveredState:
+        """Replay journal-over-checkpoint from the backing store.
+
+        Also primes this plane's in-memory mirror and seq counters so
+        the recovered process keeps journaling from where the crashed
+        one stopped.
+        """
+        reg = get_registry()
+        ckpt = self._store.load(_CKPT_KEY, LsdbCheckpoint)
+        lsdb: Dict[str, Dict[str, Value]] = {}
+        base_seq = 0
+        if ckpt is not None:
+            lsdb = {a: dict(kv) for a, kv in ckpt.key_vals_by_area.items()}
+            base_seq = ckpt.seq
+        replayed = 0
+        max_seq = base_seq
+        for key in self._store.keys():  # sorted => seq order
+            if not key.startswith(_JOURNAL_PREFIX):
+                continue
+            rec = self._store.load(key, JournalRecord)
+            if rec is None or rec.seq < base_seq:
+                continue
+            lsdb.setdefault(rec.area, {}).update(rec.key_vals)
+            replayed += 1
+            max_seq = max(max_seq, rec.seq + 1)
+        engines: Dict[str, EngineSnapshot] = {}
+        for key in self._store.keys():
+            if key.startswith(_ENGINE_PREFIX):
+                snap = self._store.load(key, EngineSnapshot)
+                if snap is not None:
+                    engines[snap.area] = snap
+        with self._lock:
+            self._lsdb = {a: dict(kv) for a, kv in lsdb.items()}
+            self._next_seq = max_seq
+            self._ckpt_seq = base_seq
+        reg.counter_bump("state.recoveries")
+        reg.counter_bump("state.journal_replayed", replayed)
+        return RecoveredState(
+            key_vals_by_area=lsdb,
+            engine_snapshots=engines,
+            journal_replayed=replayed,
+            had_checkpoint=ckpt is not None,
+        )
+
+    # -- introspection ------------------------------------------------
+
+    def journal_length(self) -> int:
+        with self._lock:
+            return self._next_seq - self._ckpt_seq
+
+    def lsdb_mirror(self) -> Dict[str, Dict[str, Value]]:
+        with self._lock:
+            return {a: dict(kv) for a, kv in self._lsdb.items()}

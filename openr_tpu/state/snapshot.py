@@ -1,0 +1,153 @@
+"""Engine warm-start snapshots: the resident ELL material as host bytes.
+
+Generalizes ``ops.world_batch``'s per-tenant evict-to-host record
+(packed host mirror + pending-edge journal + solved overload mask) to
+the primary engine: everything ``EllState`` needs to warm-start its
+next ``reconverge`` — the solved distance rows, the source batch they
+belong to, the mergeable ``(tail, head) -> (w_snapshot, w_current)``
+patch journal, the overload mask at the last solve, and the structural
+flag — captured as a wire-encodable dataclass keyed by a digest of the
+band graph it was solved under.
+
+Rehydration is digest-gated: ``compile_ell`` over the recovered
+LinkState must reproduce a bit-identical band graph (same node set,
+band layout, weights, mask) for the distance rows to be valid warm
+seeds. A journal that advanced past the snapshot changes the digest
+and the engine seeds cold — slower, never wrong. Either way the warm
+check in ``EllState.reconverge`` (``_warm_key`` vs the solve's source
+batch) is the final gate, so a stale snapshot can only cost work.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from openr_tpu.telemetry import get_registry
+from openr_tpu.utils import wire
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def _fnv1a(payload: bytes, h: int = _FNV_OFFSET) -> int:
+    for b in payload:
+        h ^= b
+        h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def graph_digest(graph) -> int:
+    """Content digest of a compiled ELL band graph.
+
+    Covers everything a warm seed's validity depends on: node set and
+    order, pad width, per-band source/weight arrays, and the overload
+    mask. Two LinkStates with identical adjacency content compile to
+    digest-equal graphs regardless of the per-process journal history.
+    """
+    head = wire.dumps(
+        [int(graph.n), int(graph.n_pad), list(graph.node_names)]
+    )
+    h = _fnv1a(head)
+    for arr in (*graph.src, *graph.w):
+        h = _fnv1a(np.ascontiguousarray(np.asarray(arr)).tobytes(), h)
+    ov = np.ascontiguousarray(np.asarray(graph.overloaded, dtype=np.uint8))
+    h = _fnv1a(ov.tobytes(), h)
+    return h
+
+
+@dataclass
+class EngineSnapshot:
+    """Wire-encodable resident warm material for one area's engine."""
+
+    area: str = ""
+    graph_digest: int = 0
+    warm_key: Tuple[int, ...] = ()
+    batch: int = 0
+    n_pad: int = 0
+    d_rows: bytes = b""  # int32 [batch, n_pad], row-major
+    pending_edges: Dict[Tuple[int, int], Tuple[int, int]] = field(
+        default_factory=dict
+    )
+    ov_solved: bytes = b""  # uint8 [n_pad]
+    pending_structural: bool = False
+
+
+def capture_engine_snapshot(area: str, ls) -> Optional["EngineSnapshot"]:
+    """Snapshot the resident warm material for ``ls``, if any.
+
+    Returns None when the resident cache has no version-matched solved
+    state for this LinkState (nothing warm to persist). Reads the
+    device distance rows back to host — call outside a solve window,
+    after a rebuild settles.
+    """
+    from openr_tpu.decision import spf_solver
+
+    state = spf_solver.export_resident_state(ls)
+    if state is None:
+        return None
+    d_host = np.asarray(state._d_dev, dtype=np.int32)
+    ov = np.asarray(state._ov_solved, dtype=np.uint8)
+    return EngineSnapshot(
+        area=area,
+        graph_digest=graph_digest(state.graph),
+        warm_key=tuple(int(s) for s in state._warm_key),
+        batch=int(d_host.shape[0]),
+        n_pad=int(d_host.shape[1]),
+        d_rows=d_host.tobytes(),
+        pending_edges={
+            (int(s), int(h)): (int(a), int(b))
+            for (s, h), (a, b) in state._pending_edges.items()
+        },
+        ov_solved=ov.tobytes(),
+        pending_structural=bool(state._pending_structural),
+    )
+
+
+def rehydrate_engine(ls, snap: Optional["EngineSnapshot"]) -> bool:
+    """Seed the resident ELL cache for ``ls`` from a snapshot.
+
+    Compiles the band layout from the LinkState (host work, no jit)
+    and, when the compiled graph digest matches the snapshot, restores
+    the solved distance rows + journal so the next ``reconverge`` runs
+    WARM. Digest mismatch (or no snapshot) seeds a cold resident state
+    — still saving the resident cache's own full compile at first use.
+    Returns True on a warm seed.
+    """
+    import jax.numpy as jnp
+
+    from openr_tpu.decision import spf_solver
+    from openr_tpu.ops import spf_sparse
+
+    reg = get_registry()
+    graph = spf_sparse.compile_ell(ls)
+    state = spf_sparse.EllState(graph)
+    warm = (
+        snap is not None
+        and snap.batch > 0
+        and snap.n_pad == int(graph.n_pad)
+        and graph_digest(graph) == snap.graph_digest
+    )
+    if warm:
+        d = np.frombuffer(snap.d_rows, dtype=np.int32).reshape(
+            snap.batch, snap.n_pad
+        )
+        state._d_dev = jnp.asarray(d)
+        state._warm_key = tuple(int(s) for s in snap.warm_key)
+        state._pending_edges = {
+            (int(s), int(h)): (int(a), int(b))
+            for (s, h), (a, b) in snap.pending_edges.items()
+        }
+        state._ov_solved = (
+            np.frombuffer(snap.ov_solved, dtype=np.uint8)
+            .astype(bool)
+            .copy()
+        )
+        state._pending_structural = bool(snap.pending_structural)
+        reg.counter_bump("state.warm_seeds")
+    else:
+        reg.counter_bump("state.cold_seeds")
+    spf_solver.seed_resident_state(ls, state)
+    return warm

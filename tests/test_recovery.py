@@ -1,0 +1,497 @@
+"""Crash-safe recovery: device-loss ladder rung (single-chip, grouped,
+sharded-mesh shrink), the tenant plane's torn-dispatch rebuild,
+Decision's checkpointed warm boot (bit-identical to the cold oracle),
+and Fib graceful restart (hold -> one reconciling sync, routes never
+flap)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from openr_tpu.config_store.persistent_store import PersistentStore
+from openr_tpu.decision.decision import Decision
+from openr_tpu.decision.spf_solver import reset_device_caches
+from openr_tpu.faults import (
+    DegradationSupervisor,
+    FaultSchedule,
+    HealthState,
+    get_injector,
+)
+from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+from openr_tpu.messaging.queue import ReplicateQueue
+from openr_tpu.models import topologies
+from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
+from openr_tpu.platform.fib_service import MockFibAgent
+from openr_tpu.state import StatePlane
+from openr_tpu.telemetry import get_registry
+from openr_tpu.types import Publication, Value
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+from tests.test_fib import push_update, rib_entry, wait_until
+from tests.test_route_engine_delta import (
+    assert_bit_identical,
+    engine_digests,
+    full_digests,
+    load,
+    make_engine,
+    mutate_metric,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    get_injector().reset()
+    yield
+    get_injector().reset()
+
+
+def _fat_tree_ls():
+    return load(
+        topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+    )
+
+
+def _engine_setup(kind):
+    ls = _fat_tree_ls()
+    engine = make_engine(kind, ls)
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    return ls, engine
+
+
+class TestEngineDeviceLoss:
+    @pytest.mark.parametrize("kind", ["ell", "grouped"])
+    def test_device_loss_recovers_within_ladder(self, kind):
+        reg = get_registry()
+        ls, engine = _engine_setup(kind)
+        rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+        lost0 = reg.counter_get("recovery.device_lost")
+        rebuilds0 = reg.counter_get("recovery.device_rebuilds")
+
+        get_injector().arm("device.lost", FaultSchedule.fail_once())
+        affected = mutate_metric(ls, rsw, 0, 41)
+        engine.churn(ls, affected)
+
+        # recover is a middle rung: the walk lands DEGRADED, never host
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert engine.device_rebuilds == 1
+        assert reg.counter_get("recovery.device_lost") == lost0 + 1
+        assert reg.counter_get("recovery.device_rebuilds") == rebuilds0 + 1
+        # the event that observed the loss still landed, bit-identical
+        assert_bit_identical(engine, ls, kind)
+
+        # next churn goes straight through warm: self-heal to HEALTHY
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 42))
+        assert engine.supervisor.state is HealthState.HEALTHY
+        assert engine.device_rebuilds == 1
+        assert_bit_identical(engine, ls, kind)
+
+    def test_non_loss_failure_skips_recover_rung(self):
+        reg = get_registry()
+        ls, engine = _engine_setup("ell")
+        rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+        idle0 = reg.counter_get("route_engine.rung_failures.recover")
+        get_injector().arm(
+            "route_engine.dispatch", FaultSchedule.fail_once()
+        )
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 17))
+        # a plain dispatch fault is NOT a device loss: the recover rung
+        # stays inert and the walk lands on the cold rung as before
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert engine.device_rebuilds == 0
+        assert (
+            reg.counter_get("route_engine.rung_failures.recover")
+            == idle0 + 1
+        )
+        assert_bit_identical(engine, ls, "ell")
+
+    def test_sharded_mesh_shrinks_to_survivors(self):
+        reg = get_registry()
+        ls, engine = _engine_setup("ell_sharded")
+        assert engine.mesh is not None
+        size0 = int(engine.mesh.devices.size)
+        assert size0 >= 2
+        dead = engine.mesh.devices.flat[0]
+        engine._probe_device = lambda dev: dev.id != dead.id
+
+        rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+        shrinks0 = reg.counter_get("recovery.mesh_shrinks")
+        get_injector().arm("device.lost", FaultSchedule.fail_once())
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 23))
+
+        # never silent: the shrink is typed and the gauge moves
+        assert engine.supervisor.state is HealthState.DEGRADED
+        assert engine.mesh_shrinks == 1
+        assert reg.counter_get("recovery.mesh_shrinks") == shrinks0 + 1
+        assert int(engine.mesh.devices.size) == size0 - 1
+        assert reg.snapshot().get("recovery.mesh_size") == size0 - 1
+        # route product on the survivor mesh matches the host oracle
+        assert engine_digests(engine) == full_digests(ls)
+
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 24))
+        assert engine.supervisor.state is HealthState.HEALTHY
+        assert engine_digests(engine) == full_digests(ls)
+
+    def test_all_devices_lost_falls_to_host(self):
+        ls, engine = _engine_setup("ell_sharded")
+        engine._probe_device = lambda dev: False
+        rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+        get_injector().arm("device.lost", FaultSchedule.fail_once())
+        # cold rebuild on a dead mesh also observes the loss; keep the
+        # seam armed so every device rung fails and host serves
+        get_injector().arm(
+            "route_engine.cold_build", FaultSchedule.fail_n(4)
+        )
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 29))
+        assert engine.supervisor.state is HealthState.FALLBACK
+        assert engine.host_fallbacks >= 1
+        assert engine_digests(engine) == full_digests(ls)
+
+
+class TestWorldBatchDeviceLoss:
+    def test_torn_dispatch_rebuilds_from_host(self):
+        reg = get_registry()
+        ls1 = load(topologies.grid(4))
+        ls2 = load(topologies.grid(4))
+        wm = WorldManager(slots_per_bucket=4, max_resident=8)
+        root = sorted(ls1.get_adjacency_databases())[0]
+        wm.solve_views([("a", ls1, root), ("b", ls2, root)])
+
+        mutate_metric(ls1, root, 0, 55)
+        mutate_metric(ls2, root, 1, 77)
+        recov0 = TENANCY_COUNTERS["device_loss_recoveries"]
+        rehyd0 = TENANCY_COUNTERS["rehydrations"]
+        lost0 = reg.counter_get("recovery.device_lost")
+        get_injector().arm("device.lost", FaultSchedule.fail_once())
+        views = wm.solve_views([("a", ls1, root), ("b", ls2, root)])
+
+        assert TENANCY_COUNTERS["device_loss_recoveries"] == recov0 + 1
+        assert reg.counter_get("recovery.device_lost") == lost0 + 1
+        # the re-placement after the loss is a WARM rehydration from
+        # the host snapshots, not a cold re-admit
+        assert TENANCY_COUNTERS["rehydrations"] >= rehyd0 + 2
+
+        oracle = WorldManager(slots_per_bucket=4, max_resident=8)
+        ovs = oracle.solve_views([("a", ls1, root), ("b", ls2, root)])
+        for got, want in zip(views, ovs):
+            np.testing.assert_array_equal(
+                np.asarray(got[2]), np.asarray(want[2])
+            )
+
+    def test_repeated_loss_raises(self):
+        ls = load(topologies.grid(3))
+        wm = WorldManager(slots_per_bucket=2, max_resident=4)
+        root = sorted(ls.get_adjacency_databases())[0]
+        get_injector().arm("device.lost", FaultSchedule.fail_n(10))
+        with pytest.raises(Exception):
+            # more consecutive losses than the recovery bound: loud
+            wm.solve_views([("t", ls, root)])
+
+
+def _publish_topo(decision, topo, versions):
+    kv = {}
+    for db in topo.adj_dbs.values():
+        k = keyutil.adj_key(db.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=db.this_node_name,
+            value=wire.dumps(db),
+        )
+    for pdb in topo.prefix_dbs.values():
+        k = keyutil.prefix_db_key(pdb.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=pdb.this_node_name,
+            value=wire.dumps(pdb),
+        )
+    pub = Publication(key_vals=kv, area=topo.area)
+    decision.process_publication(pub)
+    return kv
+
+
+class TestDecisionWarmBoot:
+    def test_warm_boot_bit_identical_and_warm(self, tmp_path, monkeypatch):
+        from openr_tpu.decision import spf_solver
+        from openr_tpu.ops.spf_sparse import ELL_COUNTERS
+
+        # route the small test area through the resident sliced-ELL
+        # path (the one the state plane snapshots)
+        monkeypatch.setattr(spf_solver, "SPARSE_NODE_THRESHOLD", 2)
+        reg = get_registry()
+        topo = topologies.build_topology(
+            "grid",
+            [("a", "b", 1), ("b", "c", 2), ("a", "c", 5), ("c", "d", 1)],
+        )
+        store = PersistentStore(str(tmp_path / "state.bin"))
+        plane = StatePlane(store)
+        d1 = Decision(
+            "a",
+            kvstore_updates_queue=ReplicateQueue(name="kv1"),
+            route_updates_queue=ReplicateQueue(name="routes1"),
+            state_plane=plane,
+        )
+        versions = {}
+        kv = _publish_topo(d1, topo, versions)
+        # mirror what the KvStore merge hook would have journaled
+        plane.on_kvstore_merge(topo.area, kv)
+        d1.rebuild_routes("TEST")
+        d1.checkpoint_state()
+        routes_before = dict(d1.route_db.unicast_routes)
+        assert reg.counter_get("state.engine_snapshots") >= 1
+        store.stop()
+
+        # crash: resident device state and process memory are gone
+        reset_device_caches()
+
+        store2 = PersistentStore(str(tmp_path / "state.bin"))
+        plane2 = StatePlane(store2)
+        rec = plane2.recover()
+        assert rec.key_vals_by_area[topo.area]
+        assert topo.area in rec.engine_snapshots
+        d2 = Decision(
+            "a",
+            kvstore_updates_queue=ReplicateQueue(name="kv2"),
+            route_updates_queue=ReplicateQueue(name="routes2"),
+            state_plane=plane2,
+        )
+        warm0 = reg.counter_get("state.warm_seeds")
+        cold_solves0 = ELL_COUNTERS["ell_cold_solves"]
+        warm = d2.warm_boot(rec)
+        assert warm == 1
+        assert reg.counter_get("state.warm_seeds") == warm0 + 1
+        # the warm-boot rebuild reconverges WARM: zero cold ELL solves
+        assert ELL_COUNTERS["ell_cold_solves"] == cold_solves0
+        assert dict(d2.route_db.unicast_routes) == routes_before
+        store2.stop()
+
+    def test_warm_boot_digest_mismatch_seeds_cold(self, tmp_path, monkeypatch):
+        from dataclasses import replace
+
+        from openr_tpu.decision import spf_solver
+
+        monkeypatch.setattr(spf_solver, "SPARSE_NODE_THRESHOLD", 2)
+        reg = get_registry()
+        topo = topologies.build_topology(
+            "grid", [("a", "b", 1), ("b", "c", 2), ("a", "c", 5)]
+        )
+        store = PersistentStore(str(tmp_path / "state.bin"))
+        plane = StatePlane(store)
+        d1 = Decision(
+            "a",
+            kvstore_updates_queue=ReplicateQueue(name="kv1"),
+            route_updates_queue=ReplicateQueue(name="routes1"),
+            state_plane=plane,
+        )
+        versions = {}
+        kv = _publish_topo(d1, topo, versions)
+        plane.on_kvstore_merge(topo.area, kv)
+        d1.rebuild_routes("TEST")
+        d1.checkpoint_state()
+        # the journal advances past the snapshot: a metric changes
+        db = dict(topo.adj_dbs)["b"]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=adjs[0].metric + 7)
+        newer = replace(db, adjacencies=tuple(adjs))
+        k = keyutil.adj_key("b")
+        versions[k] += 1
+        newer_kv = {
+            k: Value(
+                version=versions[k],
+                originator_id="b",
+                value=wire.dumps(newer),
+            )
+        }
+        plane.on_kvstore_merge(topo.area, newer_kv)
+        d1.process_publication(
+            Publication(key_vals=newer_kv, area=topo.area)
+        )
+        d1.rebuild_routes("TEST")
+        routes_after = dict(d1.route_db.unicast_routes)
+        store.stop()
+
+        reset_device_caches()
+        store2 = PersistentStore(str(tmp_path / "state.bin"))
+        rec = StatePlane(store2).recover()
+        d2 = Decision(
+            "a",
+            kvstore_updates_queue=ReplicateQueue(name="kv2"),
+            route_updates_queue=ReplicateQueue(name="routes2"),
+        )
+        cold0 = reg.counter_get("state.cold_seeds")
+        warm = d2.warm_boot(rec)
+        # stale snapshot: digest-gated rehydration seeds cold — slower,
+        # never wrong
+        assert warm == 0
+        assert reg.counter_get("state.cold_seeds") == cold0 + 1
+        assert dict(d2.route_db.unicast_routes) == routes_after
+        store2.stop()
+
+
+class _RestartDuringSyncAgent(MockFibAgent):
+    """Agent that restarts itself as the first sync_fib completes —
+    the restart lands between Fib.start() and the first keepalive, so
+    the just-synced table is wiped before the keepalive can observe a
+    steady baseline."""
+
+    def __init__(self):
+        super().__init__()
+        self.restart_after_syncs = 0
+
+    def sync_fib(self, client_id, routes):
+        super().sync_fib(client_id, routes)
+        if self.restart_after_syncs:
+            self.restart_after_syncs -= 1
+            self.restart()
+
+
+class TestFibGracefulRestart:
+    def _previous_life(self, agent, entries):
+        """Run one Fib life to program routes and capture its
+        RouteDatabase — the material a warm boot would recover."""
+        q = ReplicateQueue(name="gr-prev")
+        fib = Fib("node-a", agent, q, keepalive_interval_s=5.0)
+        fib.start()
+        push_update(q, entries=entries)
+        assert wait_until(
+            lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID))
+            == len(entries)
+        )
+        rdb = fib.get_route_db()
+        fib.stop()
+        return rdb
+
+    def test_hold_then_reconcile_no_flap(self):
+        agent = MockFibAgent()
+        entries = [rib_entry("fd00:1::/64"), rib_entry("fd00:2::/64")]
+        rdb = self._previous_life(agent, entries)
+        syncs0 = agent.counters["sync_fib"]
+        deletes0 = agent.counters["delete_unicast"]
+
+        q = ReplicateQueue(name="gr-routes")
+        fib = Fib(
+            "node-a", agent, q,
+            keepalive_interval_s=5.0,
+            graceful_restart_hold_s=30.0,
+        )
+        fib.start_graceful_restart(rdb)
+        fib.start()
+        try:
+            assert fib.counters["fib.graceful_restarts"] == 1
+            # the hold: recovered routes served, agent untouched
+            assert fib.longest_prefix_match("fd00:1::1") is not None
+            time.sleep(0.1)
+            assert agent.counters["sync_fib"] == syncs0
+            assert agent.counters["delete_unicast"] == deletes0
+
+            # Decision re-converges: same routes plus one new — ONE
+            # reconciling sync, zero deletes, nothing flaps
+            push_update(
+                q, entries=entries + [rib_entry("fd00:3::/64")]
+            )
+            assert wait_until(
+                lambda: fib.counters["fib.gr_reconciles"] == 1
+            )
+            assert agent.counters["sync_fib"] == syncs0 + 1
+            assert agent.counters["delete_unicast"] == deletes0
+            table = agent.get_route_table_by_client(OPENR_CLIENT_ID)
+            assert len(table) == 3
+            # GR is over: the next update programs as a plain delta
+            push_update(q, entries=[rib_entry("fd00:4::/64")])
+            assert wait_until(
+                lambda: len(
+                    agent.get_route_table_by_client(OPENR_CLIENT_ID)
+                ) == 4
+            )
+            assert agent.counters["sync_fib"] == syncs0 + 1
+        finally:
+            fib.stop()
+
+    def test_hold_expiry_reconciles(self):
+        agent = MockFibAgent()
+        entries = [rib_entry("fd00:a::/64")]
+        rdb = self._previous_life(agent, entries)
+        syncs0 = agent.counters["sync_fib"]
+
+        q = ReplicateQueue(name="gr-exp")
+        fib = Fib(
+            "node-a", agent, q,
+            keepalive_interval_s=5.0,
+            graceful_restart_hold_s=0.1,
+        )
+        fib.start_graceful_restart(rdb)
+        fib.start()
+        try:
+            # Decision never re-converges: the hold timer fires and the
+            # journal-recovered state reconciles on its own
+            assert wait_until(
+                lambda: fib.counters["fib.gr_hold_expirations"] == 1
+            )
+            assert wait_until(
+                lambda: agent.counters["sync_fib"] == syncs0 + 1
+            )
+            assert fib.counters["fib.gr_reconciles"] == 1
+            assert len(
+                agent.get_route_table_by_client(OPENR_CLIENT_ID)
+            ) == 1
+        finally:
+            fib.stop()
+
+    def test_agent_restart_during_hold_ends_gr(self):
+        agent = MockFibAgent()
+        rdb = self._previous_life(agent, [rib_entry("fd00:b::/64")])
+
+        q = ReplicateQueue(name="gr-agent")
+        fib = Fib(
+            "node-a", agent, q,
+            keepalive_interval_s=0.05,
+            graceful_restart_hold_s=30.0,
+        )
+        fib.start_graceful_restart(rdb)
+        fib.start()
+        try:
+            agent.restart()  # wipes the held table: GR's premise gone
+            assert wait_until(
+                lambda: fib.counters["fib.agent_restarts"] == 1
+            )
+            # the restart resync re-programs the recovered routes now
+            # instead of waiting out the 30s hold
+            assert wait_until(
+                lambda: len(
+                    agent.get_route_table_by_client(OPENR_CLIENT_ID)
+                ) == 1
+            )
+            assert fib.counters["fib.gr_hold_expirations"] == 0
+        finally:
+            fib.stop()
+
+    def test_agent_restart_during_inflight_sync(self):
+        # satellite: the agent restarts while the first sync_fib is in
+        # flight — between start() and the first keepalive. start()'s
+        # aliveSince baseline predates the restart, so the keepalive
+        # detects it and re-programs the routes the restart wiped.
+        agent = _RestartDuringSyncAgent()
+        agent.restart_after_syncs = 1
+        q = ReplicateQueue(name="gr-inflight")
+        fib = Fib("node-a", agent, q, keepalive_interval_s=0.05)
+        fib.start()
+        try:
+            push_update(q, entries=[rib_entry("fd00:c::/64")])
+            # first sync landed, then the agent dumped it; the resync
+            # triggered by the keepalive restores the route
+            assert wait_until(
+                lambda: fib.counters["fib.agent_restarts"] == 1
+            )
+            assert wait_until(
+                lambda: len(
+                    agent.get_route_table_by_client(OPENR_CLIENT_ID)
+                ) == 1
+            )
+            assert agent.counters["sync_fib"] >= 2
+        finally:
+            fib.stop()

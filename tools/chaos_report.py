@@ -15,10 +15,14 @@ loudly if the graceful-degradation contract regressed:
   oracle),
 - a ladder walk was unbounded (more walks than churn events),
 - the coverage floor was missed (too few faults fired, fewer than
-  six distinct seams crossed, or the lossy-publisher seam never
+  eight distinct seams crossed — including ``device.lost`` and
+  ``state.checkpoint_write`` — or the lossy-publisher seam never
   fired),
 - the lossy-load route product diverged from a survivor-replay
-  oracle (dropped events must be pure no-ops).
+  oracle (dropped events must be pure no-ops),
+- the kill-restart leg (checkpoint mid-storm with one injected
+  checkpoint-write failure, drop process state, warm-boot from the
+  backing store, replay survivors) did not land bit-identical.
 
 Writes a JSON artifact (``--out``, default
 ``/tmp/openr_tpu_chaos_report.json``) with the per-site fault counts,
@@ -108,6 +112,10 @@ def _engine_leg(seed, events, failures):
         "route_engine.frontier_resolve",
         FaultSchedule.fail_with_probability(0.5, seed=seed + 7),
     )
+    # deterministic double device loss: the first fires mid-storm and
+    # walks the recover rung; the second fires inside the recover
+    # rung's own re-run, proving the ladder bounds repeated loss
+    inj.arm("device.lost", FaultSchedule.fail_n(2))
 
     def mutate(node, metric):
         db = ls.get_adjacency_databases()[node]
@@ -171,6 +179,7 @@ def _engine_leg(seed, events, failures):
         "route_engine.consume",
         "route_engine.cold_build",
         "route_engine.frontier_resolve",
+        "device.lost",
     ):
         inj.disarm(site)
     for _ in range(12):
@@ -464,6 +473,142 @@ def _load_leg(seed, events, failures):
     return len(evs)
 
 
+def _kill_restart_leg(seed, events, failures):
+    """Kill-restart mid-storm: a Decision journaling through the state
+    plane takes a churn storm with the ``state.checkpoint_write`` seam
+    armed (one checkpoint cut FAILS — the journal must carry it), then
+    the process "dies" (device caches and in-memory LSDB dropped), a
+    fresh plane replays journal-over-checkpoint from the backing store
+    alone, and the warm-booted RouteDatabase must be bit-identical to
+    the crashed instance's last product AND to a survivor-replay
+    oracle."""
+    import shutil
+    import tempfile
+
+    from openr_tpu.config_store.persistent_store import PersistentStore
+    from openr_tpu.decision import spf_solver as ss
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.decision.spf_solver import reset_device_caches
+    from openr_tpu.faults import FaultSchedule, get_injector
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.models import topologies
+    from openr_tpu.state import StatePlane
+    from openr_tpu.telemetry import get_registry
+    from openr_tpu.types import Publication, Value
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    reg = get_registry()
+    ss.SPARSE_NODE_THRESHOLD = 4  # resident-ELL path for small areas
+    topo = topologies.fat_tree_nodes(24)
+    node = next(n for n in sorted(topo.adj_dbs) if n.startswith("rsw"))
+    workdir = tempfile.mkdtemp(prefix="openr_tpu_chaos_state_")
+    path = os.path.join(workdir, "state.bin")
+    versions: dict = {}
+    published: list = []
+
+    def make_decision(name, plane=None):
+        return Decision(
+            node,
+            kvstore_updates_queue=ReplicateQueue(name=f"ckv-{name}"),
+            route_updates_queue=ReplicateQueue(name=f"crt-{name}"),
+            state_plane=plane,
+        )
+
+    def publish(d, plane, kv):
+        published.append(kv)
+        if plane is not None:
+            plane.on_kvstore_merge(topo.area, kv)
+        d.process_publication(
+            Publication(key_vals=dict(kv), area=topo.area)
+        )
+
+    def adj_kv(db):
+        k = keyutil.adj_key(db.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        return {
+            k: Value(
+                version=versions[k],
+                originator_id=db.this_node_name,
+                value=wire.dumps(db),
+            )
+        }
+
+    try:
+        store = PersistentStore(path)
+        plane = StatePlane(store, checkpoint_every=6)
+        d = make_decision("live", plane)
+        initial = {}
+        for db in topo.adj_dbs.values():
+            initial.update(adj_kv(db))
+        for pdb in topo.prefix_dbs.values():
+            k = keyutil.prefix_db_key(pdb.this_node_name)
+            versions[k] = versions.get(k, 0) + 1
+            initial[k] = Value(
+                version=versions[k],
+                originator_id=pdb.this_node_name,
+                value=wire.dumps(pdb),
+            )
+        publish(d, plane, initial)
+        d.rebuild_routes("CHAOS")
+
+        # one checkpoint cut mid-storm MUST fail and be survivable
+        get_injector().arm(
+            "state.checkpoint_write", FaultSchedule.fail_once()
+        )
+        ckpt_fail0 = reg.counter_get("state.checkpoint_failures")
+        rng = random.Random(seed + 13)
+        mutated = dict(topo.adj_dbs)
+        names = sorted(mutated)
+        for _ in range(events):
+            name = rng.choice(names)
+            db = mutated[name]
+            adjs = list(db.adjacencies)
+            adjs[0] = replace(adjs[0], metric=rng.randrange(1, 50))
+            mutated[name] = replace(db, adjacencies=tuple(adjs))
+            publish(d, plane, adj_kv(mutated[name]))
+            d.rebuild_routes("CHAOS")
+            d.checkpoint_state()
+        get_injector().disarm("state.checkpoint_write")
+        if reg.counter_get("state.checkpoint_failures") - ckpt_fail0 < 1:
+            failures.append(
+                "state.checkpoint_write seam never fired mid-storm"
+            )
+        d.checkpoint_state()
+        routes_live = wire.dumps(d.route_db.to_route_db(node))
+        store.stop()
+
+        # the kill: everything in-process is gone
+        reset_device_caches()
+
+        store2 = PersistentStore(path)
+        plane2 = StatePlane(store2)
+        rec = plane2.recover()
+        d2 = make_decision("warm", plane2)
+        d2.warm_boot(rec)
+        routes_warm = wire.dumps(d2.route_db.to_route_db(node))
+        if routes_warm != routes_live:
+            failures.append(
+                "kill-restart warm boot diverged from the crashed "
+                "instance's last RouteDatabase"
+            )
+        oracle = make_decision("oracle")
+        for kv in published:
+            oracle.process_publication(
+                Publication(key_vals=dict(kv), area=topo.area)
+            )
+        oracle.rebuild_routes("ORACLE")
+        if routes_warm != wire.dumps(oracle.route_db.to_route_db(node)):
+            failures.append(
+                "kill-restart warm boot diverged from survivor-replay "
+                "oracle"
+            )
+        store2.stop()
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return events
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=20260805)
@@ -490,10 +635,10 @@ def main(argv=None) -> int:
 
     budgets = (
         {"engine": 60, "decision": 20, "platform": 20, "load": 40,
-         "floor": 50}
+         "restart": 12, "floor": 50}
         if args.smoke
         else {"engine": 160, "decision": 40, "platform": 40, "load": 80,
-              "floor": 200}
+              "restart": 24, "floor": 200}
     )
 
     failures: list = []
@@ -503,6 +648,7 @@ def main(argv=None) -> int:
     events += _decision_leg(args.seed, budgets["decision"], failures)
     events += _platform_leg(args.seed, budgets["platform"], failures)
     events += _load_leg(args.seed, budgets["load"], failures)
+    events += _kill_restart_leg(args.seed, budgets["restart"], failures)
     elapsed = time.perf_counter() - t0
 
     injected = {
@@ -515,7 +661,9 @@ def main(argv=None) -> int:
             f"coverage floor missed: {sum(injected.values())} faults "
             f"< {budgets['floor']}"
         )
-    if len(injected) < 6:
+    # the floor covers the crash seams too: ``device.lost`` (engine
+    # leg) and ``state.checkpoint_write`` (kill-restart leg) must fire
+    if len(injected) < 8:
         failures.append(
             f"only {len(injected)} seams crossed: {sorted(injected)}"
         )

@@ -1,0 +1,424 @@
+#!/usr/bin/env python
+"""Crash-recovery gate (``make recovery-smoke``) and report artifact.
+
+Exercises the crash-safe state plane end to end and fails loudly if
+the recovery contract regressed:
+
+- WARM-BOOT PARITY: a Decision journaling through ``StatePlane`` is
+  "crashed" (device caches dropped, process state rebuilt from the
+  backing ``PersistentStore`` alone); the warm-booted RouteDatabase
+  must be BIT-IDENTICAL to the crashed instance's last product and to
+  a cold oracle replaying the same publications,
+- WARM REHYDRATION: the warm boot must seed the resident ELL state
+  from the persisted snapshot (``state.warm_seeds`` >= 1) and its
+  rebuild must reconverge WARM — zero cold ELL solves and ZERO jit
+  compiles beyond persistent-cache hits (``jax.compile_count`` delta
+  == 0: every dispatch shape was warmed before the crash),
+- DEVICE-LOSS LADDER: an injected ``device.lost`` at the dispatch
+  seam must recover within the ladder (DEGRADED via the recover rung,
+  one typed rebuild, bit parity vs the host oracle, self-heal to
+  HEALTHY on the next churn),
+- FIB GRACEFUL RESTART: a warm-booted Fib holding recovered routes
+  must reconcile with exactly ONE ``sync_fib`` and ZERO deletes when
+  Decision re-converges — and on hold-timer expiry when it never does
+  (routes never flap either way).
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_recovery_smoke.json``); exit 0 on pass, 1 with a
+reason list on fail. Runs CPU-pinned — this gates recovery machinery,
+not kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from dataclasses import replace
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/recovery_smoke.py) in addition
+# to module mode (python -m tools.recovery_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _publish(decision, plane, area, kv):
+    from openr_tpu.types import Publication
+
+    if plane is not None:
+        plane.on_kvstore_merge(area, kv)
+    decision.process_publication(Publication(key_vals=dict(kv), area=area))
+
+
+def _topo_key_vals(topo, versions):
+    from openr_tpu.types import Value
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    kv = {}
+    for db in topo.adj_dbs.values():
+        k = keyutil.adj_key(db.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=db.this_node_name,
+            value=wire.dumps(db),
+        )
+    for pdb in topo.prefix_dbs.values():
+        k = keyutil.prefix_db_key(pdb.this_node_name)
+        versions[k] = versions.get(k, 0) + 1
+        kv[k] = Value(
+            version=versions[k],
+            originator_id=pdb.this_node_name,
+            value=wire.dumps(pdb),
+        )
+    return kv
+
+
+def _adj_key_val(db, versions):
+    from openr_tpu.types import Value
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    k = keyutil.adj_key(db.this_node_name)
+    versions[k] = versions.get(k, 0) + 1
+    return {
+        k: Value(
+            version=versions[k],
+            originator_id=db.this_node_name,
+            value=wire.dumps(db),
+        )
+    }
+
+
+def _warm_boot_leg(workdir, hooks_live, report, failures):
+    from openr_tpu.config_store.persistent_store import PersistentStore
+    from openr_tpu.decision import spf_solver
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.decision.spf_solver import reset_device_caches
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.spf_sparse import ELL_COUNTERS
+    from openr_tpu.state import StatePlane
+    from openr_tpu.telemetry import get_registry
+    from openr_tpu.utils import wire
+
+    reg = get_registry()
+    # route the test areas through the resident sliced-ELL path (the
+    # one the state plane snapshots)
+    spf_solver.SPARSE_NODE_THRESHOLD = 4
+    topo = topologies.fat_tree_nodes(24)
+    node = next(n for n in sorted(topo.adj_dbs) if n.startswith("rsw"))
+    path = os.path.join(workdir, "state.bin")
+
+    def make_decision(name, plane=None):
+        return Decision(
+            node,
+            kvstore_updates_queue=ReplicateQueue(name=f"kv-{name}"),
+            route_updates_queue=ReplicateQueue(name=f"routes-{name}"),
+            state_plane=plane,
+        )
+
+    store = PersistentStore(path)
+    # cadence of 4 so the churn run crosses a real checkpoint cut AND
+    # leaves a journal tail — recovery exercises both layers
+    plane = StatePlane(store, checkpoint_every=4)
+    d1 = make_decision("live", plane)
+    versions = {}
+    initial = _topo_key_vals(topo, versions)
+    _publish(d1, plane, topo.area, initial)
+    d1.rebuild_routes("RECOVERY_SMOKE")
+    d1.checkpoint_state()
+
+    # churn a few metrics so the snapshot carries a real journal tail
+    mutated = dict(topo.adj_dbs)
+    churned = []
+    for i, name in enumerate(sorted(mutated)[:4]):
+        db = mutated[name]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=10 + i)
+        mutated[name] = replace(db, adjacencies=tuple(adjs))
+        kv = _adj_key_val(mutated[name], versions)
+        churned.append(kv)
+        _publish(d1, plane, topo.area, kv)
+        d1.rebuild_routes("RECOVERY_SMOKE")
+    d1.checkpoint_state()
+    routes_live = wire.dumps(d1.route_db.to_route_db(node))
+    report["journal_len_at_crash"] = plane.journal_length()
+    store.stop()
+
+    # crash: resident device state and in-process LSDB are gone; only
+    # the backing store survives
+    reset_device_caches()
+
+    store2 = PersistentStore(path)
+    plane2 = StatePlane(store2)
+    rec = plane2.recover()
+    report["recovered_areas"] = len(rec.key_vals_by_area)
+    report["journal_replayed"] = rec.journal_replayed
+    report["had_checkpoint"] = rec.had_checkpoint
+    if not rec.had_checkpoint:
+        failures.append("recovery never saw a checkpoint cut")
+    if rec.journal_replayed < 1:
+        failures.append(
+            "recovery replayed no journal records (WAL tail missing)"
+        )
+    warm0 = reg.counter_get("state.warm_seeds")
+    cold_solves0 = ELL_COUNTERS["ell_cold_solves"]
+    compiles0 = reg.counter_get("jax.compile_count") if hooks_live else None
+    d2 = make_decision("warm", plane2)
+    warm = d2.warm_boot(rec)
+    routes_warm = wire.dumps(d2.route_db.to_route_db(node))
+    report["warm_engines"] = warm
+    report["warm_seeds"] = reg.counter_get("state.warm_seeds") - warm0
+
+    if routes_warm != routes_live:
+        failures.append(
+            "warm-boot RouteDatabase diverged from the crashed instance"
+        )
+    if warm < 1 or reg.counter_get("state.warm_seeds") - warm0 < 1:
+        failures.append("warm boot did not seed a warm engine")
+    cold_delta = ELL_COUNTERS["ell_cold_solves"] - cold_solves0
+    if cold_delta:
+        failures.append(
+            f"warm-boot rebuild paid {cold_delta} cold ELL solves"
+        )
+    if hooks_live:
+        compile_delta = reg.counter_get("jax.compile_count") - compiles0
+        report["rehydrate_compile_delta"] = compile_delta
+        if compile_delta > 0:
+            failures.append(
+                f"warm boot jit-compiled {compile_delta}x (every "
+                "dispatch shape was warmed before the crash)"
+            )
+    else:
+        report["rehydrate_compile_delta"] = None
+
+    # cold oracle: replay every publication from scratch, no plane
+    d3 = make_decision("oracle")
+    _publish(d3, None, topo.area, initial)
+    for kv in churned:
+        _publish(d3, None, topo.area, kv)
+    d3.rebuild_routes("ORACLE")
+    if routes_warm != wire.dumps(d3.route_db.to_route_db(node)):
+        failures.append("warm-boot RouteDatabase diverged from cold oracle")
+    store2.stop()
+    report["warm_boot_parity"] = not any("warm-boot" in f for f in failures)
+
+
+def _device_loss_leg(report, failures):
+    from openr_tpu.faults import (
+        DegradationSupervisor,
+        FaultSchedule,
+        HealthState,
+        get_injector,
+    )
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    names = sorted(ls.get_adjacency_databases())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+
+    def mutate(metric):
+        db = ls.get_adjacency_databases()[names[0]]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        return {names[0], adjs[0].other_node_name}
+
+    rebuilds0 = reg.counter_get("recovery.device_rebuilds")
+    get_injector().arm("device.lost", FaultSchedule.fail_once())
+    engine.churn(ls, mutate(31))
+    get_injector().disarm("device.lost")
+    degraded = engine.supervisor.state is HealthState.DEGRADED
+    rebuilt = reg.counter_get("recovery.device_rebuilds") - rebuilds0
+    report["device_loss_rebuilds"] = rebuilt
+    if not degraded:
+        failures.append(
+            "device.lost did not land on the recover rung "
+            f"(state {engine.supervisor.state.name})"
+        )
+    if rebuilt != 1:
+        failures.append(f"expected 1 device rebuild, saw {rebuilt}")
+    host = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+    if route_sweep.digests_by_name(engine.result) != host:
+        failures.append("post-recovery route product diverged from oracle")
+    engine.churn(ls, mutate(32))
+    if engine.supervisor.state is not HealthState.HEALTHY:
+        failures.append(
+            "engine did not self-heal after device-loss recovery"
+        )
+    report["device_loss_recovered"] = not any(
+        "device" in f or "recover" in f for f in failures
+    )
+
+
+def _fib_gr_leg(report, failures):
+    from openr_tpu.decision.rib import DecisionRouteUpdate, RibUnicastEntry
+    from openr_tpu.fib.fib import OPENR_CLIENT_ID, Fib
+    from openr_tpu.messaging.queue import ReplicateQueue
+    from openr_tpu.platform.fib_service import MockFibAgent
+    from openr_tpu.types import BinaryAddress, IpPrefix, NextHop
+
+    def entry(prefix):
+        return RibUnicastEntry(
+            prefix=IpPrefix.from_str(prefix),
+            nexthops={
+                NextHop(
+                    address=BinaryAddress.from_str(
+                        "fe80::1", if_name="if0"
+                    ),
+                    metric=1,
+                )
+            },
+        )
+
+    def push(q, entries):
+        update = DecisionRouteUpdate()
+        for e in entries:
+            update.unicast_routes_to_update[e.prefix] = e
+        q.push(update)
+
+    def wait_until(pred, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return pred()
+
+    prefixes = ["fd00:1::/64", "fd00:2::/64", "fd00:3::/64"]
+    agent = MockFibAgent()
+    # previous life: program the routes, capture its RouteDatabase
+    q0 = ReplicateQueue(name="rs-prev")
+    prev = Fib("node-a", agent, q0, keepalive_interval_s=30.0)
+    prev.start()
+    push(q0, [entry(p) for p in prefixes])
+    if not wait_until(
+        lambda: len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) == 3
+    ):
+        failures.append("fib previous life failed to program routes")
+    rdb = prev.get_route_db()
+    prev.stop()
+
+    # warm boot with graceful restart: Decision re-converges in time
+    syncs0 = agent.counters["sync_fib"]
+    deletes0 = agent.counters["delete_unicast"]
+    q1 = ReplicateQueue(name="rs-gr")
+    fib = Fib(
+        "node-a", agent, q1,
+        keepalive_interval_s=30.0,
+        graceful_restart_hold_s=30.0,
+    )
+    fib.start_graceful_restart(rdb)
+    fib.start()
+    push(q1, [entry(p) for p in prefixes] + [entry("fd00:4::/64")])
+    ok = wait_until(lambda: fib.counters["fib.gr_reconciles"] == 1)
+    fib.stop()
+    sync_delta = agent.counters["sync_fib"] - syncs0
+    delete_delta = agent.counters["delete_unicast"] - deletes0
+    report["gr_reconcile_syncs"] = sync_delta
+    report["gr_reconcile_deletes"] = delete_delta
+    if not ok or sync_delta != 1:
+        failures.append(
+            f"graceful restart reconciled with {sync_delta} syncs "
+            "(want exactly 1)"
+        )
+    if delete_delta:
+        failures.append(
+            f"graceful restart deleted {delete_delta} routes (flap!)"
+        )
+    if len(agent.get_route_table_by_client(OPENR_CLIENT_ID)) != 4:
+        failures.append("post-reconcile agent table wrong")
+
+    # hold-timer expiry: Decision never re-converges
+    syncs1 = agent.counters["sync_fib"]
+    q2 = ReplicateQueue(name="rs-exp")
+    fib2 = Fib(
+        "node-a", agent, q2,
+        keepalive_interval_s=30.0,
+        graceful_restart_hold_s=0.05,
+    )
+    fib2.start_graceful_restart(rdb)
+    fib2.start()
+    expired = wait_until(
+        lambda: fib2.counters["fib.gr_hold_expirations"] == 1
+    )
+    wait_until(lambda: agent.counters["sync_fib"] == syncs1 + 1)
+    fib2.stop()
+    report["gr_hold_expirations"] = fib2.counters[
+        "fib.gr_hold_expirations"
+    ]
+    if not expired or agent.counters["sync_fib"] - syncs1 != 1:
+        failures.append(
+            "hold-timer expiry did not reconcile with exactly one sync"
+        )
+    report["fib_gr_no_flap"] = not any("flap" in f or "sync" in f
+                                       for f in failures)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_recovery_smoke.json"
+    )
+    args = parser.parse_args(argv)
+
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.faults import get_injector
+    from openr_tpu.telemetry import jax_hooks
+
+    hooks_live = jax_hooks.install()
+    get_injector().reset()
+    failures: list = []
+    report: dict = {}
+    workdir = tempfile.mkdtemp(prefix="openr_tpu_recovery_")
+    t0 = time.perf_counter()
+    try:
+        _warm_boot_leg(workdir, hooks_live, report, failures)
+        _device_loss_leg(report, failures)
+        _fib_gr_leg(report, failures)
+    finally:
+        get_injector().reset()
+        shutil.rmtree(workdir, ignore_errors=True)
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    report["failures"] = failures
+    report["passed"] = not failures
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if failures:
+        print(f"RECOVERY GATE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print(f"RECOVERY GATE: PASS (report: {args.out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
