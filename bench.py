@@ -544,6 +544,30 @@ def _run() -> dict:
             except Exception as e:
                 bench_recovery = {"error": f"{type(e).__name__}: {e}"}
 
+    # twelfth leg: integrity-audit overhead — the same warm churn
+    # loop with the audit plane armed every event (rate limit off,
+    # the worst case) vs disarmed; the acceptance gate is an armed
+    # e2e median within 5% of disarmed with zero violations on
+    # healthy state (make integrity-smoke is the hard CI gate; this
+    # leg folds the overhead number into the official artifact)
+    bench_integrity = None
+    if os.environ.get("OPENR_BENCH_INTEGRITY") == "1":
+        if leg_elapsed() > 540:
+            bench_integrity = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import integrity_audit_bench
+
+                bench_integrity = integrity_audit_bench(
+                    int(os.environ.get(
+                        "OPENR_BENCH_INTEGRITY_NODES", "1000"
+                    ))
+                )
+            except Exception as e:
+                bench_integrity = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -622,6 +646,7 @@ def _run() -> dict:
         "bench_sustained_load": bench_load,
         "bench_multi_tenant": bench_tenancy,
         "bench_recovery": bench_recovery,
+        "bench_integrity_audit": bench_integrity,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -695,6 +720,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_LOAD"] = "1"
         env["OPENR_BENCH_TENANCY"] = "1"
         env["OPENR_BENCH_RECOVERY"] = "1"
+        env["OPENR_BENCH_INTEGRITY"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
@@ -703,6 +729,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env.pop("OPENR_BENCH_LOAD", None)
         env.pop("OPENR_BENCH_TENANCY", None)
         env.pop("OPENR_BENCH_RECOVERY", None)
+        env.pop("OPENR_BENCH_INTEGRITY", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

@@ -27,6 +27,7 @@ RESIDENT_ATTR = "__openr_resident_buffers__"
 REQUIRES_DRAIN_ATTR = "__openr_requires_drain__"
 DONATES_ATTR = "__openr_donates__"
 FAULT_BOUNDARY_ATTR = "__openr_fault_boundary__"
+MIRROR_ATTR = "__openr_host_mirrors__"
 
 
 def solve_window(fn: F) -> F:
@@ -54,6 +55,24 @@ def resident_buffers(*attr_names: str) -> Callable[[C], C]:
     def deco(cls: C) -> C:
         merged = tuple(getattr(cls, RESIDENT_ATTR, ())) + attr_names
         setattr(cls, RESIDENT_ATTR, merged)
+        return cls
+
+    return deco
+
+
+def mirrored_by(**mirrors: str) -> Callable[[C], C]:
+    """Class decorator declaring, per ``@resident_buffers`` name, the
+    settle-on-success host mirror (an attribute name) or the rebuild
+    recipe (a prose description) that makes the buffer healable after
+    silent corruption or device loss. The ``mirror-coverage`` rule
+    requires every registered resident buffer to appear here or carry
+    an in-source audited suppression — a resident with neither is
+    unhealable state waiting to strand a quarantined engine."""
+
+    def deco(cls: C) -> C:
+        merged = dict(getattr(cls, MIRROR_ATTR, {}))
+        merged.update(mirrors)
+        setattr(cls, MIRROR_ATTR, merged)
         return cls
 
     return deco

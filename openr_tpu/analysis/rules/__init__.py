@@ -1,10 +1,11 @@
-"""The six invariant checkers. Each module exports one Rule class;
+"""The seven invariant checkers. Each module exports one Rule class;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
 from openr_tpu.analysis.rules.donation import DonationHazardRule
 from openr_tpu.analysis.rules.hostsync import HostSyncInWindowRule
 from openr_tpu.analysis.rules.lockorder import LockOrderRule
+from openr_tpu.analysis.rules.mirror_coverage import MirrorCoverageRule
 from openr_tpu.analysis.rules.retrace import RetraceRiskRule
 from openr_tpu.analysis.rules.sharding import ShardingSpecRule
 from openr_tpu.analysis.rules.spans import SpanDisciplineRule
@@ -16,6 +17,7 @@ ALL_RULES = (
     SpanDisciplineRule,
     RetraceRiskRule,
     ShardingSpecRule,
+    MirrorCoverageRule,
 )
 
 __all__ = [
@@ -23,6 +25,7 @@ __all__ = [
     "DonationHazardRule",
     "HostSyncInWindowRule",
     "LockOrderRule",
+    "MirrorCoverageRule",
     "SpanDisciplineRule",
     "RetraceRiskRule",
     "ShardingSpecRule",

@@ -1,0 +1,84 @@
+"""``mirror-coverage``: every resident device buffer declares how it
+heals.
+
+The integrity plane (and the device-loss recover rung before it) can
+only quarantine-and-heal an engine whose resident buffers are all
+re-derivable: either a settle-on-success host mirror exists
+(``_packed_dev`` ↔ ``_packed_host``) or a cold rebuild recipe does
+(``_dr`` ← the band tensors / the LinkState). A resident buffer with
+neither is unhealable state — the first silent flip or torn dispatch
+strands the engine in quarantine with nothing sound to rebuild from,
+and nobody notices until that day.
+
+This rule makes the declaration mandatory at review time: every
+literal name registered via ``@resident_buffers(...)`` must appear as
+a keyword of a ``@mirrored_by(...)`` on the same class, or carry an
+audited in-source suppression (``# openr-lint:
+disable=mirror-coverage -- reason``) explaining why the buffer is
+legitimately unhealable (e.g. a derived scratch block a cold build
+always regenerates wholesale).
+
+Unlike ``sharding-spec`` this rule is TREE-WIDE — unhealable resident
+state is a hazard wherever it lives, not just on the churn path — and
+purely class-local, so it needs no cross-file collect pass.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    decorator_info,
+    literal_or_none,
+)
+
+RULE_ID = "mirror-coverage"
+
+
+class MirrorCoverageRule(Rule):
+    id = RULE_ID
+    description = (
+        "every @resident_buffers name must appear in a @mirrored_by "
+        "declaration on the same class (or carry an audited "
+        "suppression) — a resident with no mirror and no rebuild "
+        "recipe is unhealable after corruption or device loss"
+    )
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for cls in sf.classes():
+            residents = []  # (name, anchor node)
+            mirrored = set()
+            for dec in cls.decorator_list:
+                name, call = decorator_info(dec)
+                if name is None or call is None:
+                    continue
+                leaf = name.split(".")[-1]
+                if leaf == "resident_buffers":
+                    for arg in call.args:
+                        val = literal_or_none(arg)
+                        if isinstance(val, str):
+                            residents.append((val, arg))
+                elif leaf == "mirrored_by":
+                    mirrored.update(
+                        kw.arg for kw in call.keywords if kw.arg
+                    )
+            for buf, node in residents:
+                if buf in mirrored:
+                    continue
+                findings.append(
+                    Finding(
+                        self.id, sf.path, node.lineno, node.col_offset,
+                        f"resident buffer {buf!r} on {cls.name} has no "
+                        "@mirrored_by entry: declare its host mirror "
+                        "or rebuild recipe, or suppress with an "
+                        "audited reason — otherwise the integrity "
+                        "plane can quarantine this engine but never "
+                        "heal it",
+                    )
+                )
+        return findings

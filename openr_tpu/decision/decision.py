@@ -40,7 +40,8 @@ from openr_tpu.types import (
     PrefixEntry,
 )
 from openr_tpu.analysis.annotations import fault_boundary, solve_window
-from openr_tpu.faults.supervisor import DegradationSupervisor
+from openr_tpu.faults.supervisor import DegradationSupervisor, HealthState
+from openr_tpu.integrity import get_auditor, quarantine_active
 from openr_tpu.load.admission import AdmissionControl
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
@@ -221,6 +222,13 @@ class Decision:
             "native" if solver_backend == "device" else solver_backend
         )
         self.supervisor = DegradationSupervisor("decision")
+        # monotonic stamp of the last route db installed while the
+        # ladder was fully warm and no engine sat in integrity
+        # quarantine — the staleness gauge ages from it while degraded
+        self._last_good_route_ts: Optional[float] = None
+        get_registry().gauge(
+            "decision.route_staleness_ms", self._route_staleness_ms
+        )
         self.area_link_states: Dict[str, LinkState] = {}
         self.prefix_state = PrefixState()
         self.route_db = DecisionRouteDb()
@@ -536,6 +544,26 @@ class Decision:
         # resident distance rows back to host
         if self._state_plane is not None:
             self.checkpoint_state()
+        # the audit plane rides the same post-converge hook — NEVER
+        # inside rebuild_routes, where a probe dispatch would serialize
+        # the solve window it is auditing. Audit errors are contained
+        # inside the auditor (counted, never raised): the event loop
+        # must not die for a probe.
+        get_auditor().on_converge()
+
+    def _route_staleness_ms(self) -> float:
+        """How long the installed routes have been serving without a
+        verified-good refresh: 0 while the ladder is warm and no engine
+        is quarantined (or before the first install), else the age of
+        the last route db installed in that state. Self-heal zeroes it."""
+        if self._last_good_route_ts is None:
+            return 0.0
+        if (
+            self.supervisor.state is HealthState.HEALTHY
+            and not quarantine_active()
+        ):
+            return 0.0
+        return (time.monotonic() - self._last_good_route_ts) * 1000.0
 
     def checkpoint_state(self) -> None:
         """Persist the engines' warm-start material to the state plane.
@@ -727,6 +755,11 @@ class Decision:
                 routes_deleted=len(update.unicast_routes_to_delete),
             )
         self.route_db.update(update)
+        if (
+            self.supervisor.state is HealthState.HEALTHY
+            and not quarantine_active()
+        ):
+            self._last_good_route_ts = time.monotonic()
         update.perf_events = perf_events
         update.trace = trace
         self.route_updates_queue.push(update)

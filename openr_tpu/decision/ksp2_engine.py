@@ -53,7 +53,7 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 import numpy as np
 
-from openr_tpu.analysis.annotations import resident_buffers
+from openr_tpu.analysis.annotations import mirrored_by, resident_buffers
 from openr_tpu.graph.linkstate import Link, LinkState
 from openr_tpu.ops.spf import INF
 
@@ -358,6 +358,13 @@ def _pad_ids(ids: List[int], bucket_min: int = 8) -> np.ndarray:
     )
 
 
+@mirrored_by(
+    d_prev_dev="rebuilt by _cold_build from the resident EllState "
+               "distance cache (engine invalidates to valid=False and "
+               "re-seeds on the next sync)",
+    dm_dev="rebuilt by _cold_build from the traced host-side dm rows",
+    masks_t="re-derived by _cold_build from the band tensor shapes",
+)
 @resident_buffers("d_prev_dev", "dm_dev", "masks_t")
 class Ksp2Engine:
     """Per-(LinkState, root) incremental KSP2 state. Invalid until the

@@ -11,6 +11,7 @@ from openr_tpu.faults.injector import (
     FaultInjected,
     FaultInjector,
     FaultSchedule,
+    consume_fault,
     fault_point,
     get_injector,
     is_device_loss,
@@ -29,6 +30,7 @@ __all__ = [
     "FaultInjector",
     "FaultSchedule",
     "HealthState",
+    "consume_fault",
     "LadderExhausted",
     "fault_point",
     "get_injector",

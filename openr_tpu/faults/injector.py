@@ -153,6 +153,21 @@ class FaultInjector:
         get_registry().counter_bump(f"faults.injected.{site}")
         raise FaultInjected(site)
 
+    def consume(self, site: str) -> bool:
+        """Non-raising crossing for seams that CORRUPT rather than
+        fail (e.g. ``device.corrupt_resident``): the caller mutates its
+        own state when this returns True. Fired crossings still bump
+        ``faults.injected.<site>`` so chaos coverage floors see them;
+        ``delay`` schedules make no sense here and are treated as
+        fires."""
+        with self._lock:
+            schedule = self._armed.get(site)
+            fire = schedule is not None and schedule.should_fire()
+        if not fire:
+            return False
+        get_registry().counter_bump(f"faults.injected.{site}")
+        return True
+
 
 class DeviceLostError(RuntimeError):
     """An accelerator died under resident state.
@@ -216,3 +231,11 @@ def fault_point(site: str) -> None:
     if not _INJECTOR.any_armed:
         return
     _INJECTOR.check(site)
+
+
+def consume_fault(site: str) -> bool:
+    """Non-raising sibling of ``fault_point`` for corrupting seams.
+    Same disarmed cost: one attribute read and a falsy branch."""
+    if not _INJECTOR.any_armed:
+        return False
+    return _INJECTOR.consume(site)

@@ -32,8 +32,9 @@ module error (state stays FALLBACK, breaker open).
 from __future__ import annotations
 
 import threading
+import zlib
 from enum import IntEnum
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils.eventbase import ExponentialBackoff
@@ -68,10 +69,23 @@ class DegradationSupervisor:
         name: str,
         backoff_min_s: float = 0.05,
         backoff_max_s: float = 2.0,
+        backoff_jitter: bool = True,
+        backoff_seed: Optional[int] = None,
     ) -> None:
         self.name = name
         self.state = HealthState.HEALTHY
-        self.breaker = ExponentialBackoff(backoff_min_s, backoff_max_s)
+        # decorrelated jitter ON by default: supervisors that all
+        # degraded on one event must not re-probe in lockstep. The seed
+        # defaults to a name hash so each supervisor gets a distinct
+        # but replayable stream.
+        seed = (
+            backoff_seed if backoff_seed is not None
+            else zlib.crc32(name.encode("utf-8"))
+        )
+        self.breaker = ExponentialBackoff(
+            backoff_min_s, backoff_max_s,
+            jitter=backoff_jitter, seed=seed,
+        )
         self.walks = 0
         self._held_rung = 0
         self._lock = threading.RLock()

@@ -1,0 +1,38 @@
+"""Integrity plane: silent-corruption detection, quarantine, and warm
+healing for all resident device state.
+
+Import surface is deliberately LIGHT — engines import the contract at
+module load and Decision reads ``quarantine_active`` on its gauge path;
+the jax-heavy audit kernels load lazily behind ``get_auditor()`` use.
+"""
+
+from openr_tpu.integrity.contract import ResidentEngineContract
+
+__all__ = [
+    "ResidentEngineContract",
+    "get_auditor",
+    "reset_auditor",
+    "quarantine_active",
+]
+
+
+def get_auditor():
+    from openr_tpu.integrity.auditor import get_auditor as _get
+
+    return _get()
+
+
+def reset_auditor() -> None:
+    from openr_tpu.integrity.auditor import reset_auditor as _reset
+
+    _reset()
+
+
+def quarantine_active() -> bool:
+    """True while any engine failed its last audit and has not yet
+    re-audited clean. Touches no jax state and instantiates nothing —
+    safe on gauge-sample paths."""
+    from openr_tpu.integrity import auditor as _auditor
+
+    a = _auditor._AUDITOR
+    return a is not None and a.quarantine_active()

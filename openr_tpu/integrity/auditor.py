@@ -1,0 +1,211 @@
+"""The integrity auditor: cadence-gated audits over every registered
+resident engine, with quarantine-and-heal orchestration.
+
+Engines self-register on construction (a ``weakref.WeakSet`` — the
+auditor never keeps an engine alive). Decision's post-converge hook
+calls ``on_converge()``; tiers 1+2 run per audited call (one fused
+dispatch + one uint32 readback each), tier 3 every ``oracle_every``-th
+call, the whole hook rate-limited to one audit pass per
+``min_interval_s`` of wall clock so converge storms stay cheap.
+Audits ride idle post-converge windows ONLY — never inside a solve
+window (the residual dispatch would interleave with an in-flight delta
+readback and alarm on healthy state).
+
+Detection path per engine: bump ``integrity.violations.<tier>`` +
+``integrity.quarantines``, poison the warm rung via
+``engine.quarantine()`` (so the degradation ladder cold-rebuilds even
+if nothing else happens), then try the cheap warm heal
+(``engine.integrity_heal()``) and RE-AUDIT with the oracle forced. The
+heal deliberately does NOT refresh the host mirror first: the re-audit
+digest compares the healed device product against the PRE-corruption
+settle-on-success mirror, so a heal that fails to reproduce the exact
+bits counts as ``integrity.heal_failures`` and the engine stays
+quarantined for the ladder's cold rebuild. Either way routes never
+flap — the healed product is bit-identical, Fib sees zero deletes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.integrity.contract import ResidentEngineContract
+from openr_tpu.telemetry import get_registry, get_tracer
+
+
+class IntegrityAuditor:
+    """Process-global audit scheduler over the registered engines."""
+
+    def __init__(self, oracle_every: int = 8, sample_rows: int = 4,
+                 seed: int = 0, min_interval_s: float = 1.0) -> None:
+        assert oracle_every >= 1 and sample_rows >= 1
+        self.oracle_every = oracle_every
+        self.sample_rows = sample_rows
+        self.min_interval_s = min_interval_s
+        self._seed = seed
+        self._last_audit_t: Optional[float] = None
+        self._lock = threading.Lock()
+        self._engines: "weakref.WeakSet[ResidentEngineContract]" = (
+            weakref.WeakSet()
+        )
+        self._quarantined: "weakref.WeakSet[ResidentEngineContract]" = (
+            weakref.WeakSet()
+        )
+        self._converges = 0
+
+    # -- registry ------------------------------------------------------
+
+    def register(self, engine: ResidentEngineContract) -> None:
+        with self._lock:
+            self._engines.add(engine)
+
+    def unregister(self, engine: ResidentEngineContract) -> None:
+        with self._lock:
+            self._engines.discard(engine)
+            self._quarantined.discard(engine)
+
+    def quarantine_active(self) -> bool:
+        """True while any registered engine failed its last audit and
+        has not yet re-audited clean (drives
+        ``decision.route_staleness_ms``)."""
+        return len(self._quarantined) > 0
+
+    # -- cadence -------------------------------------------------------
+
+    def on_converge(self) -> None:
+        """Post-converge hook: audit every registered engine. Tiers
+        1+2 each call; tier 3 every ``oracle_every``-th. Cheap early
+        out when nothing is registered, and WALL-CLOCK rate-limited
+        (``min_interval_s``) so a converge storm — thousands of
+        debounce fires per second under sustained load — pays at most
+        a few audit dispatches per second, not one per converge.
+        Audit errors are contained — a broken audit must never take
+        down the Decision loop."""
+        with self._lock:
+            engines = list(self._engines)
+        if not engines:
+            return
+        now = time.monotonic()
+        if (
+            self._last_audit_t is not None
+            and now - self._last_audit_t < self.min_interval_s
+        ):
+            return
+        self._last_audit_t = now
+        self._converges += 1
+        force_oracle = (self._converges % self.oracle_every) == 0
+        for engine in engines:
+            try:
+                self.audit_engine(engine, force_oracle=force_oracle)
+            except Exception:
+                get_registry().counter_bump("integrity.audit_errors")
+
+    def audit_now(self) -> List[Dict[str, Any]]:
+        """Forced full audit (oracle included) of every engine —
+        tools/tests surface; raises nothing, reports per engine."""
+        with self._lock:
+            engines = list(self._engines)
+        self._converges += 1
+        out = []
+        for engine in engines:
+            try:
+                out.append(self.audit_engine(engine, force_oracle=True))
+            except Exception as exc:
+                get_registry().counter_bump("integrity.audit_errors")
+                out.append({
+                    "kind": getattr(engine, "audit_kind", "?"),
+                    "verdict": "error", "error": repr(exc),
+                })
+        return out
+
+    # -- one engine ----------------------------------------------------
+
+    def audit_engine(self, engine: ResidentEngineContract,
+                     force_oracle: bool = False) -> Dict[str, Any]:
+        reg = get_registry()
+        if not engine.audit_ready():
+            reg.counter_bump("integrity.skipped")
+            return {"kind": engine.audit_kind, "verdict": "skipped"}
+        tracer = get_tracer()
+        span = tracer.span_active("integrity.audit")
+        reg.counter_bump("integrity.audits")
+        tier = ""
+        verdict = "error"
+        try:
+            tier = self._detect(engine, force_oracle) or ""
+            if not tier:
+                self._quarantined.discard(engine)
+                verdict = "clean"
+            else:
+                reg.counter_bump(f"integrity.violations.{tier}")
+                reg.counter_bump("integrity.quarantines")
+                self._quarantined.add(engine)
+                engine.quarantine(f"integrity audit: {tier} violation")
+                healed = False
+                try:
+                    healed = bool(engine.integrity_heal())
+                except Exception:
+                    reg.counter_bump("integrity.heal_errors")
+                if (
+                    healed
+                    and engine.audit_ready()
+                    and self._detect(engine, force_oracle=True) is None
+                ):
+                    reg.counter_bump("integrity.heals")
+                    self._quarantined.discard(engine)
+                    verdict = "healed"
+                else:
+                    reg.counter_bump("integrity.heal_failures")
+                    verdict = "quarantined"
+        finally:
+            tracer.end_span_active(
+                span, kind=engine.audit_kind, verdict=verdict, tier=tier
+            )
+        return {
+            "kind": engine.audit_kind, "verdict": verdict, "tier": tier,
+        }
+
+    def _detect(self, engine: ResidentEngineContract,
+                force_oracle: bool) -> Optional[str]:
+        """Run the tiers cheapest-first; return the first violated
+        tier's name, or None when the residents audit clean."""
+        if int(engine.audit_residual()):
+            return "residual"
+        dev, host = engine.audit_digest_pair()
+        if int(dev) != int(host):
+            return "digest"
+        if force_oracle:
+            count = int(engine.audit_row_count())
+            if count > 0:
+                rng = random.Random(
+                    self._seed * 1_000_003 + self._converges
+                )
+                k = min(self.sample_rows, count)
+                rows = sorted(rng.sample(range(count), k))
+                if int(engine.audit_sample_rows(rows)):
+                    return "oracle"
+        return None
+
+
+_AUDITOR: Optional[IntegrityAuditor] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_auditor() -> IntegrityAuditor:
+    global _AUDITOR
+    if _AUDITOR is None:
+        with _GLOBAL_LOCK:
+            if _AUDITOR is None:
+                _AUDITOR = IntegrityAuditor()
+    return _AUDITOR
+
+
+def reset_auditor() -> None:
+    """Test/tool isolation: drop the global auditor (engines
+    re-register on construction; existing engines are forgotten)."""
+    global _AUDITOR
+    with _GLOBAL_LOCK:
+        _AUDITOR = None

@@ -1,0 +1,123 @@
+"""The ONE resident-engine contract (ROADMAP: "One resident-engine
+interface").
+
+Every warm compute plane in this repo is the same shape: device-RESIDENT
+buffers a fused dispatch re-reads (``@resident_buffers``), a host-side
+patch JOURNAL that keeps warm solves sound across churn, a liveness
+PROBE, a DELTA READBACK that settles host mirrors on success only,
+SNAPSHOT/REHYDRATE warm-start material, and — new in the integrity
+plane — a budget-bounded AUDIT surface over the residents. Features
+kept landing per-backend (frontier: ELL-only, recovery: ELL-first,
+tenancy: its own hooks); this module names the contract once so the
+ELL, grouped, sharded and world-batch engines implement it and
+capabilities written against it hold everywhere.
+
+Dependency-light ON PURPOSE: no jax, no numpy — the annotated engines
+import this at module load, and ``make lint-analysis`` (which never
+touches an accelerator runtime) walks the same classes.
+
+The audit surface (all implementations budget-bounded; called from
+Decision's post-converge hook, NEVER inside a solve window):
+
+- ``audit_residual`` — tier 1: one extra min-plus relax pass over the
+  resident distances must be the identity (the fixed point is unique);
+  returns the scalar violation count from one fused dispatch.
+- ``audit_digest_pair`` — tier 2: FNV-1a digest of the resident packed
+  product on device vs the settle-on-success host mirror's digest
+  (scalar readback, no row transfer).
+- ``audit_sample_rows`` — tier 3: a seeded row subset re-solved COLD on
+  device and bit-compared against the resident rows.
+
+Detection flows quarantine -> heal: ``quarantine`` poisons the warm
+rung (the engine's next event walks the degradation ladder to a cold
+rebuild), ``integrity_heal`` is the cheaper warm path the auditor
+tries first — re-land the residents from uncorrupted material (band
+tensors, host mirrors) with no layout recompile, then re-audit.
+Either way routes never flap: the healed product is bit-identical, so
+Fib sees at most one delta and zero deletes.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional, Sequence, Tuple
+
+
+class ResidentEngineContract(ABC):
+    """Abstract resident-engine protocol: residents + journal + probe +
+    delta readback + snapshot/rehydrate + audit.
+
+    Implementors: ``ops.route_engine.RouteSweepEngine`` (ELL, and via
+    ``mesh=`` the sharded variant), ``GroupedRouteSweepEngine``, and
+    ``ops.world_batch.WorldManager`` (the tenant plane audits all its
+    bucket blocks as one engine).
+    """
+
+    #: short engine-class tag stamped into spans/artifacts
+    audit_kind: str = "resident"
+
+    # -- audit plane (tiers 1..3) -------------------------------------
+
+    @abstractmethod
+    def audit_ready(self) -> bool:
+        """True when the residents are settled and mirrored — no
+        pending delta in flight, no host-fallback staleness, no
+        unsolved tenant. Audits are skipped (counted) otherwise."""
+
+    @abstractmethod
+    def audit_residual(self) -> int:
+        """Tier 1: violation count of one extra relax pass (0 == the
+        resident distances are a fixed point)."""
+
+    @abstractmethod
+    def audit_digest_pair(self) -> Tuple[int, int]:
+        """Tier 2: (device digest, host-mirror digest) of the resident
+        packed product. Equal unless the device copy silently drifted
+        from the settle-on-success mirror."""
+
+    @abstractmethod
+    def audit_row_count(self) -> int:
+        """Population the tier-3 sampler draws from (rows/lanes)."""
+
+    @abstractmethod
+    def audit_sample_rows(self, rows: Sequence[int]) -> int:
+        """Tier 3: re-solve the given rows cold on device; return how
+        many mismatch the resident rows bit-for-bit."""
+
+    # -- quarantine / heal --------------------------------------------
+
+    @abstractmethod
+    def quarantine(self, reason: str) -> None:
+        """Poison the warm plane: no later warm dispatch may read the
+        (possibly corrupt) residents. The engine's own degradation
+        ladder then cold-rebuilds on the next event even if
+        ``integrity_heal`` is never called."""
+
+    @abstractmethod
+    def integrity_heal(self) -> bool:
+        """Warm heal: re-land every resident from uncorrupted material
+        (band tensors / host mirrors) WITHOUT a host layout recompile.
+        Returns True when the engine believes it is healed; the
+        auditor re-audits before counting the heal."""
+
+    # -- fault seam ----------------------------------------------------
+
+    @abstractmethod
+    def corrupt_resident(self, seed: int) -> None:
+        """Deterministic ``device.corrupt_resident`` seam: flip seeded
+        bits in the live residents so tests and chaos storms can prove
+        detection-within-one-cadence and bit-identical healing."""
+
+    # -- snapshot / rehydrate (state plane) ---------------------------
+
+    def snapshot_resident_state(self) -> Optional[Any]:
+        """Warm-start material sufficient to re-land the residents
+        bit-identically (versions + host copies). None when the engine
+        has nothing sound to snapshot (mid-fallback, unsolved)."""
+        return None
+
+    def rehydrate_resident_state(self, snap: Any) -> bool:
+        """Re-land residents from ``snapshot_resident_state`` output.
+        Version/identity-gated: a stale or foreign snapshot returns
+        False and the engine stays on its cold path (never wrong)."""
+        return False

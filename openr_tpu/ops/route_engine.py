@@ -71,8 +71,9 @@ on delta) at the network-wide scale.
 from __future__ import annotations
 
 import functools
+import random
 import time
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -90,16 +91,20 @@ from openr_tpu.ops.spf_sparse import (
 )
 from openr_tpu.analysis.annotations import (
     fault_boundary,
+    mirrored_by,
     requires_drain,
     resident_buffers,
     solve_window,
 )
 from openr_tpu.faults.injector import (
+    consume_fault,
     fault_point,
     is_device_loss,
     register_fault_site,
 )
 from openr_tpu.faults.supervisor import DegradationSupervisor
+from openr_tpu.integrity import ResidentEngineContract, get_auditor
+from openr_tpu.integrity import kernels as integrity_kernels
 from openr_tpu.telemetry import get_registry, get_tracer
 
 # degradation-ladder injection sites (armable by name; see
@@ -113,6 +118,11 @@ FAULT_FRONTIER = register_fault_site("route_engine.frontier_resolve")
 # crossings, recognized by faults.is_device_loss, recovered by the
 # ladder's dedicated rung (_device_recover)
 FAULT_DEVICE_LOST = register_fault_site("device.lost")
+# silent corruption: a CONSUMED (non-raising) seam at the churn /
+# solve_views entries that flips seeded bits in the live residents —
+# the integrity plane's audit tiers must then detect within one
+# cadence and heal bit-identically (tools/integrity_smoke.py)
+FAULT_CORRUPT = register_fault_site("device.corrupt_resident")
 
 ENGINE_MAX_NODES = 12288  # same residency envelope as ksp2_engine
 # affected-row solve buckets: the dispatch runs at the hint bucket and
@@ -822,8 +832,14 @@ class PendingDelta:
         return self.names
 
 
+@mirrored_by(
+    _dr="re-derived from the resident band tensors (integrity_heal) "
+        "or the LinkState (_build)",
+    _digests_dev="result.digests (delta-applied on every consume)",
+    _packed_dev="_packed_host (settle-on-success row scatter)",
+)
 @resident_buffers("_dr", "_digests_dev", "_packed_dev")
-class RouteSweepEngine:
+class RouteSweepEngine(ResidentEngineContract):
     """Resident incremental network-wide route product.
 
     cold_build(ls) -> RouteSweepResult (full product)
@@ -890,8 +906,14 @@ class RouteSweepEngine:
         self.host_fallbacks = 0
         self.device_rebuilds = 0
         self.mesh_shrinks = 0
+        # settle-on-success host mirror of the resident packed product
+        # (rows < n scatter-updated on every delta consume): tier-2
+        # digest reference and the warm-heal bit-identity witness
+        self._packed_host: Optional[np.ndarray] = None
+        self._corrupt_events = 0
         self.supervisor = DegradationSupervisor("route_engine")
         self._build(ls)
+        get_auditor().register(self)
 
     def _max_nodes(self) -> int:
         """Residency bound: the resident DR is [n_pad, n_pad] int32 —
@@ -978,9 +1000,10 @@ class RouteSweepEngine:
         self._packed_dev = packed
         # explicit gather (device_get): under a mesh np.asarray would
         # be an implicit cross-device transfer the guard rejects
-        self.result = rs.assemble_result(
-            self.sweeper, jax.device_get(packed)
-        )
+        packed_host = jax.device_get(packed)
+        self.result = rs.assemble_result(self.sweeper, packed_host)
+        # private copy: assemble_result may keep views of its input
+        self._packed_host = np.array(packed_host)
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
         self._device_valid = True
@@ -1418,6 +1441,11 @@ class RouteSweepEngine:
         rows = rows[rows[:, 0] < self.graph.n]
         if not len(rows):
             return []
+        # settle the packed mirror on success, same rows: after every
+        # consume the mirror matches the resident product bit-for-bit
+        # on real rows (the tier-2 digest invariant)
+        if self._packed_host is not None:
+            self._packed_host[rows[:, 0]] = rows[:, 1:]
         rs.assemble_result(self.sweeper, rows, into=self.result)
         names = self.graph.node_names
         return [names[int(t)] for t in rows[:, 0]]
@@ -1515,6 +1543,11 @@ class RouteSweepEngine:
         pre-existing cold-rebuild contract. The recover rung is inert
         (fails straight through) unless a rung failure was recognized
         as a device loss."""
+        # corruption seam (non-raising): disarmed cost is one attribute
+        # read inside consume_fault — the sanctioned churn-path budget
+        if consume_fault(FAULT_CORRUPT):
+            self._corrupt_events += 1
+            self.corrupt_resident(self._corrupt_events)
         return self.supervisor.run((
             ("warm", lambda: self._rung_guard(
                 self._churn_device, ls, affected_nodes, defer_consume
@@ -1625,9 +1658,9 @@ class RouteSweepEngine:
             self._dr = dr
             self._digests_dev = digests
             self._packed_dev = packed
-            self.result = rs.assemble_result(
-                self.sweeper, jax.device_get(packed)
-            )
+            packed_host = jax.device_get(packed)
+            self.result = rs.assemble_result(self.sweeper, packed_host)
+            self._packed_host = np.array(packed_host)
             self._device_valid = True
         self.device_rebuilds += 1
         reg.counter_bump("recovery.device_rebuilds")
@@ -1646,6 +1679,168 @@ class RouteSweepEngine:
             p.consumed = True
             get_registry().counter_bump("route_engine.deltas_discarded")
 
+    # -- integrity plane (ResidentEngineContract) ---------------------
+
+    audit_kind = "ell"
+
+    def audit_ready(self) -> bool:
+        return (
+            self._device_valid
+            and self._pending is None
+            and self._packed_host is not None
+        )
+
+    def audit_residual(self) -> int:
+        # openr-lint: disable=sharding-spec -- read-only audit probe off the churn path; bare jit stays placement-agnostic across single-chip and mesh engines (see integrity.kernels)
+        return int(jax.device_get(integrity_kernels.ell_residual(
+            self._dr, self.sweeper.v_t, self.sweeper.w_t,
+            self.sweeper.overloaded, self.graph.bands,
+        )))
+
+    def audit_digest_pair(self) -> Tuple[int, int]:
+        # real rows only: padding destination rows are never
+        # delta-read-back, so they stay outside the mirror invariant
+        n = self.graph.n
+        # openr-lint: disable=sharding-spec -- read-only audit probe off the churn path; bare jit stays placement-agnostic across single-chip and mesh engines (see integrity.kernels)
+        probe = integrity_kernels.fnv_device(self._packed_dev[:n])
+        dev = int(jax.device_get(probe))
+        host = integrity_kernels.fnv_host(self._packed_host[:n])
+        return dev, host
+
+    def audit_row_count(self) -> int:
+        return self.graph.n
+
+    def audit_sample_rows(self, rows: Sequence[int]) -> int:
+        # pad the sample to a fixed pow2 bucket (>= 8) with repeats of
+        # the first row — one compiled oracle shape, duplicates just
+        # re-check the same row
+        ids = list(int(r) for r in rows)
+        b = 8
+        while b < len(ids):
+            b *= 2
+        ids = ids + [ids[0]] * (b - len(ids))
+        ids_t = jnp.asarray(np.asarray(ids, dtype=np.int32))
+        if self.plan is not None:
+            ids_t = self.plan.replicate(ids_t)
+        return int(jax.device_get(self._sample_oracle(ids_t)))
+
+    def _sample_oracle(self, ids_t):
+        """Backend hook: tier-3 cold re-solve of the given rows."""
+        # openr-lint: disable=sharding-spec -- read-only audit probe off the churn path; bare jit stays placement-agnostic across single-chip and mesh engines (see integrity.kernels)
+        return integrity_kernels.ell_sample_oracle(
+            self._dr, ids_t, self.sweeper.v_t, self.sweeper.w_t,
+            self.sweeper.overloaded, self.graph.bands,
+            self.graph.n_pad,
+        )
+
+    def quarantine(self, reason: str) -> None:
+        """Poison the warm rung: the next churn's warm walk raises
+        ``_DeviceStateInvalid`` and the ladder cold-rebuilds, even if
+        ``integrity_heal`` never runs."""
+        self._device_valid = False
+        get_registry().counter_bump("route_engine.quarantines")
+
+    @fault_boundary
+    @requires_drain("_discard_pending")
+    def integrity_heal(self) -> bool:
+        """Warm heal: re-derive every resident from the resident band
+        tensors — the ``_device_recover`` non-shrink body without the
+        loss gate: no host layout recompile, no LinkState needed. The
+        packed MIRROR is deliberately left untouched: the auditor's
+        re-audit digest compares the healed device product against the
+        PRE-corruption settle-on-success mirror, so a heal that fails
+        to reproduce the exact bits is caught (and the engine stays
+        quarantined for the ladder's true cold rebuild). Band-tensor
+        corruption is therefore outside this heal's reach by design —
+        the re-audit fails and the cold rung re-derives the bands from
+        the LinkState."""
+        self._discard_pending()
+        dr, digests, packed = self._full_resident(self.graph)
+        self._dr = dr
+        self._digests_dev = digests
+        self._packed_dev = packed
+        self.result = rs.assemble_result(
+            self.sweeper, jax.device_get(packed)
+        )
+        self._device_valid = True
+        get_registry().counter_bump("route_engine.integrity_heals")
+        return True
+
+    def corrupt_resident(self, seed: int) -> None:
+        """Deterministic ``device.corrupt_resident`` seam: flip one
+        seeded bit in the resident packed product (tier-2 detects
+        unconditionally — the mirror still holds the true bits) and OR
+        one seeded bit into a resident DR cell (a RAISE, which tier 1
+        usually catches: an uncorrupted neighbor re-derives the shorter
+        true value; see kernels.py for the blind-spot analysis)."""
+        rng = random.Random(seed)
+        n = self.graph.n
+        r = rng.randrange(n)
+        c = rng.randrange(int(self._packed_dev.shape[1]))
+        bit = jnp.int32(1 << rng.randrange(31))
+        self._packed_dev = self._packed_dev.at[r, c].set(
+            self._packed_dev[r, c] ^ bit
+        )
+        r2 = rng.randrange(n)
+        c2 = rng.randrange(n)
+        bit2 = jnp.int32(1 << rng.randrange(20))
+        self._dr = self._dr.at[r2, c2].set(self._dr[r2, c2] | bit2)
+        if self.plan is not None:
+            # .at[].set may drop the explicit placement: re-pin so the
+            # next churn dispatch sees the planned sharding
+            self._packed_dev = self.plan.place(
+                self._packed_dev, self.plan.rows
+            )
+            self._dr = self.plan.place(self._dr, self.plan.rows)
+        get_registry().counter_bump("integrity.corruptions")
+
+    def snapshot_resident_state(self) -> Optional[Dict[str, Any]]:
+        """Warm-start material (versions + host copies of every
+        resident) — sufficient for ``rehydrate_resident_state`` to
+        re-land the residents bit-identically with zero solves."""
+        if not self.audit_ready():
+            return None
+        return {
+            "kind": self.audit_kind,
+            "version": self.version,
+            "aversion": self.aversion,
+            "node_names": tuple(self.graph.node_names),
+            "dr": np.array(jax.device_get(self._dr)),
+            "digests": np.array(jax.device_get(self._digests_dev)),
+            "packed": np.array(self._packed_host),
+        }
+
+    @requires_drain("flush")
+    def rehydrate_resident_state(self, snap: Any) -> bool:
+        """Re-land the residents from a snapshot taken by the SAME
+        engine class at the SAME (topology, attributes, name-order)
+        state; anything else returns False and the caller stays on its
+        cold path."""
+        if (
+            not isinstance(snap, dict)
+            or snap.get("kind") != self.audit_kind
+            or snap.get("version") != self.version
+            or snap.get("aversion") != self.aversion
+            or tuple(snap.get("node_names", ()))
+            != tuple(self.graph.node_names)
+        ):
+            return False
+        self.flush()
+        up = (
+            self.plan.shard_rows if self.plan is not None
+            else jnp.asarray
+        )
+        self._dr = up(snap["dr"])
+        self._digests_dev = up(snap["digests"])
+        self._packed_dev = up(snap["packed"])
+        self.result = rs.assemble_result(
+            self.sweeper, np.array(snap["packed"])
+        )
+        self._packed_host = np.array(snap["packed"])
+        self._device_valid = True
+        get_registry().counter_bump("route_engine.rehydrates")
+        return True
+
     @fault_boundary
     @requires_drain("_discard_pending")
     def _host_fallback(self, ls) -> None:
@@ -1660,6 +1855,9 @@ class RouteSweepEngine:
         )
         self.result = rs.assemble_result(shim, packed)
         self._device_valid = False
+        # the device residents are stale relative to this host product:
+        # drop the mirror so audit_ready gates the audit plane off too
+        self._packed_host = None
         self.version = ls.topology_version
         self.aversion = ls.attributes_version
         self.host_fallbacks += 1
@@ -2069,6 +2267,24 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
     share source signatures, so structure growth is a layout event
     (the ELL engine covers growth-heavy churn; digests are
     bit-comparable across the two engines)."""
+
+    audit_kind = "grouped"
+
+    def audit_residual(self) -> int:
+        # openr-lint: disable=sharding-spec -- read-only audit probe off the churn path; bare jit stays placement-agnostic across single-chip and mesh engines (see integrity.kernels)
+        return int(jax.device_get(integrity_kernels.grouped_residual(
+            self._dr, self.sweeper.v_t, self.sweeper.w_t,
+            self.sweeper.overloaded, self.sweeper.meta,
+            sg.get_grouped_impl(),
+        )))
+
+    def _sample_oracle(self, ids_t):
+        # openr-lint: disable=sharding-spec -- read-only audit probe off the churn path; bare jit stays placement-agnostic across single-chip and mesh engines (see integrity.kernels)
+        return integrity_kernels.grouped_sample_oracle(
+            self._dr, ids_t, self.sweeper.v_t, self.sweeper.w_t,
+            self.sweeper.overloaded, self.sweeper.meta,
+            self.graph.n_pad, sg.get_grouped_impl(),
+        )
 
     def _compile_backend(self, ls):
         graph = sg.compile_out_grouped(ls, align=self._align)

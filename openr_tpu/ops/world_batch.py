@@ -58,17 +58,24 @@ per bucket dispatch carrying batch occupancy.
 from __future__ import annotations
 
 import os
+import random
 import time
 import weakref
 from dataclasses import replace as _replace
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from openr_tpu.faults import fault_point, is_device_loss
-from openr_tpu.ops.route_engine import FAULT_DEVICE_LOST, world_dispatch
+from openr_tpu.faults import consume_fault, fault_point, is_device_loss
+from openr_tpu.integrity import ResidentEngineContract, get_auditor
+from openr_tpu.integrity import kernels as integrity_kernels
+from openr_tpu.ops.route_engine import (
+    FAULT_CORRUPT,
+    FAULT_DEVICE_LOST,
+    world_dispatch,
+)
 from openr_tpu.ops.spf import INF
 from openr_tpu.ops.spf_sparse import (
     _FORCE_RESET_EDGE,
@@ -118,6 +125,8 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "delta_overflows",   # full-block readback fallbacks
         "patch_overflows",   # full-slot re-uploads (patch > row budget)
         "device_loss_recoveries",  # torn dispatches rebuilt from host
+        "quarantines",       # integrity audits that poisoned the blocks
+        "integrity_heals",   # warm re-placements after a quarantine
     ],
     prefix="tenancy.",
 )
@@ -248,11 +257,13 @@ class WorldBucket:
         return sum(1 for t in self.tenants if t is not None)
 
 
-class WorldManager:
+class WorldManager(ResidentEngineContract):
     """The residency arbiter + dispatch front end (see module
     docstring). One per process by default (``get_world_manager``) —
     the device blocks it owns are process-global state, like the
     ``_ELL_RESIDENT`` cache in decision.spf_solver."""
+
+    audit_kind = "world_batch"
 
     def __init__(self, slots_per_bucket: Optional[int] = None,
                  max_resident: Optional[int] = None):
@@ -271,6 +282,8 @@ class WorldManager:
         self._buckets: Dict[Tuple[int, int, int], WorldBucket] = {}
         self._tenants: Dict[str, TenantWorld] = {}
         self._clock = 0
+        self._corrupt_events = 0
+        get_auditor().register(self)
 
     # -- public API --------------------------------------------------------
 
@@ -313,6 +326,13 @@ class WorldManager:
             pending = [t for t in pending if t.needs_solve]
         self._enforce_residency()
         self._update_gauges()
+        # the corruption seam sits AFTER the dispatches settle: a bit
+        # flipped pre-dispatch would be washed by world_dispatch's
+        # wholesale packed/d replacement and never model the silent
+        # between-solves decay the audit plane exists to catch
+        if consume_fault(FAULT_CORRUPT):
+            self._corrupt_events += 1
+            self.corrupt_resident(self._corrupt_events)
         return [t.view() for t in tenants]
 
     def solve_view(self, tenant_id: str, ls, root: str):
@@ -744,6 +764,184 @@ class WorldManager:
             cold=cold_ct,
             delta_rows=cnt,
         )
+
+    # -- integrity plane ---------------------------------------------------
+    # The tenant plane's audit surface (``ResidentEngineContract``).
+    # Note WorldBucket deliberately carries no ``@resident_buffers``
+    # marker: its blocks flow through the bare-jit ``world_dispatch``,
+    # which the donation/sharding rules would misread as a single-graph
+    # engine dispatch. Healability is declared here instead — every
+    # block re-derives from the per-tenant ``packed_host`` mirrors plus
+    # each tenant's compiled graph, which is exactly what
+    # ``integrity_heal`` (and ``_recover_device_loss``) replay.
+
+    def audit_ready(self) -> bool:
+        """Auditable between solve waves only: every occupied slot
+        settled (solved, no pending patch rows, mirror present) and at
+        least one slot occupied. A mid-churn audit would alarm on
+        in-flight state, not corruption."""
+        if not self._buckets:
+            return False
+        occupied = 0
+        for bucket in self._buckets.values():
+            for t in bucket.tenants:
+                if t is None:
+                    continue
+                occupied += 1
+                if (
+                    not t.solved
+                    or t.needs_solve
+                    or t.pending_rows
+                    or t.packed_host is None
+                ):
+                    return False
+        return occupied > 0
+
+    def audit_residual(self) -> int:
+        total = 0
+        for key in sorted(self._buckets):
+            bucket = self._buckets[key]
+            total += int(jax.device_get(integrity_kernels.world_residual(
+                bucket.src_dev, bucket.w_dev,
+                bucket.ov_dev, bucket.d_dev,
+            )))
+        return total
+
+    def audit_digest_pair(self) -> Tuple[int, int]:
+        """Wraparound sum of per-slot packed digests over OCCUPIED
+        slots, device vs the per-tenant host mirrors. Vacated slots are
+        excluded on both sides (their device rows are stale by design),
+        and the order-independent fold makes bucket/slot iteration
+        order immaterial."""
+        dev_sum = 0
+        host_sum = 0
+        for key in sorted(self._buckets):
+            bucket = self._buckets[key]
+            slot_digests = np.asarray(jax.device_get(
+                integrity_kernels.fnv_slots(bucket.packed_dev)
+            ))
+            for slot, t in enumerate(bucket.tenants):
+                if t is None or t.packed_host is None:
+                    continue
+                dev_sum = (dev_sum + int(slot_digests[slot])) & 0xFFFFFFFF
+                host_sum = (
+                    host_sum + integrity_kernels.fnv_host(t.packed_host)
+                ) & 0xFFFFFFFF
+        return dev_sum, host_sum
+
+    def _occupied_lanes(self) -> List[Tuple[WorldBucket, int, int]]:
+        """Stable enumeration of (bucket, slot, source lane) triples
+        the row oracle samples from — real lanes only (padding lanes
+        duplicate ``srcs[0]`` and add no coverage)."""
+        lanes: List[Tuple[WorldBucket, int, int]] = []
+        for key in sorted(self._buckets):
+            bucket = self._buckets[key]
+            for slot, t in enumerate(bucket.tenants):
+                if t is None or not t.solved:
+                    continue
+                for lane in range(len(t.srcs)):
+                    lanes.append((bucket, slot, lane))
+        return lanes
+
+    def audit_row_count(self) -> int:
+        return len(self._occupied_lanes())
+
+    def audit_sample_rows(self, rows: Sequence[int]) -> int:
+        """Tier-3 oracle: group the sampled lane indices by slot, cold
+        re-solve each touched slot once (``world_cold_slot`` replicates
+        the tenant solve's cold path), bit-compare the sampled lanes
+        against the resident distance block."""
+        lanes = self._occupied_lanes()
+        if not lanes:
+            return 0
+        picked: Dict[
+            Tuple[Tuple[int, int, int], int],
+            Tuple[WorldBucket, int, List[int]],
+        ] = {}
+        for i in rows:
+            bucket, slot, lane = lanes[i % len(lanes)]
+            picked.setdefault(
+                (bucket.key, slot), (bucket, slot, [])
+            )[2].append(lane)
+        mismatches = 0
+        for bucket, slot, lns in picked.values():
+            cold = np.asarray(jax.device_get(
+                integrity_kernels.world_cold_slot(
+                    bucket.src_dev[slot], bucket.w_dev[slot],
+                    bucket.ov_dev[slot], bucket.srcs_dev[slot],
+                )
+            ))
+            resident = np.asarray(jax.device_get(bucket.d_dev[slot]))
+            for lane in sorted(set(lns)):
+                if not np.array_equal(cold[lane], resident[lane]):
+                    mismatches += 1
+        return mismatches
+
+    def quarantine(self, reason: str) -> None:
+        """Poison every device block: demote each resident tenant to
+        its host snapshot (mirrors + journals are the last verified
+        product — they were never device state, so they are not
+        suspect) and drop the buckets. Views keep serving from the
+        mirrors, so downstream route products never flap."""
+        for t in self._tenants.values():
+            if t.slot is not None:
+                self._detach(t)
+        self._buckets = {}
+        TENANCY_COUNTERS["quarantines"] += 1
+        self._update_gauges()
+
+    def integrity_heal(self) -> bool:
+        """Warm heal: re-place every settled tenant from its mirror —
+        the same upload path ``_recover_device_loss`` relies on, so the
+        re-audit's digest cross-check against the untouched mirrors is
+        the bit-identity witness."""
+        healed = False
+        for tid in sorted(self._tenants):
+            t = self._tenants[tid]
+            if (
+                t.slot is None
+                and t.solved
+                and not t.needs_solve
+                and t.packed_host is not None
+            ):
+                self._ensure_resident(t)
+                healed = True
+        if healed:
+            self._enforce_residency()
+            TENANCY_COUNTERS["integrity_heals"] += 1
+        self._update_gauges()
+        return healed
+
+    def corrupt_resident(self, seed: int) -> None:
+        """Deterministic silent-corruption seam: pick an occupied slot
+        from the seeded stream, XOR one bit of its packed view block
+        (tier 2 catches this unconditionally) and OR one bit into its
+        distance block (tier 1/3 territory). Device state only — the
+        host mirrors stay good, which is what makes the heal warm."""
+        rng = random.Random(seed)
+        occupied = [
+            (key, slot)
+            for key in sorted(self._buckets)
+            for slot, t in enumerate(self._buckets[key].tenants)
+            if t is not None and t.solved
+        ]
+        if not occupied:
+            return
+        key, slot = occupied[rng.randrange(len(occupied))]
+        bucket = self._buckets[key]
+        r = rng.randrange(2 * bucket.s)
+        c = rng.randrange(bucket.n)
+        bit = jnp.int32(1 << rng.randrange(31))
+        bucket.packed_dev = bucket.packed_dev.at[slot, r, c].set(
+            bucket.packed_dev[slot, r, c] ^ bit
+        )
+        lane = rng.randrange(bucket.s)
+        c2 = rng.randrange(bucket.n)
+        bit2 = jnp.int32(1 << rng.randrange(20))
+        bucket.d_dev = bucket.d_dev.at[slot, lane, c2].set(
+            bucket.d_dev[slot, lane, c2] | bit2
+        )
+        _get_registry().counter_bump("integrity.corruptions")
 
     def _update_gauges(self) -> None:
         TENANCY_COUNTERS["active"] = len(self._tenants)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import queue as _queue
+import random
 import threading
 import time
 from typing import Callable, List, Optional, Tuple
@@ -255,14 +256,26 @@ class PeriodicHandle:
 
 
 class ExponentialBackoff:
-    """reference: common/ExponentialBackoff.h — per-key retry pacing."""
+    """reference: common/ExponentialBackoff.h — per-key retry pacing.
 
-    def __init__(self, initial_s: float, max_s: float):
+    ``jitter=True`` opts into DECORRELATED jitter (the AWS
+    exponential-backoff-and-jitter scheme): each error re-draws the
+    delay uniformly from ``[initial, 3 * previous]`` (clamped to
+    ``max``) from a private seeded stream, so N breakers that opened on
+    the same event spread their re-probes instead of re-hammering the
+    device in lockstep. Default OFF: the deterministic doubling path is
+    byte-identical to the reference and some callers pin its exact
+    sequence."""
+
+    def __init__(self, initial_s: float, max_s: float,
+                 jitter: bool = False, seed: Optional[int] = None):
         assert initial_s > 0 and max_s >= initial_s
         self._initial = initial_s
         self._max = max_s
         self._current = 0.0
         self._last_error_ts = 0.0
+        self._jitter = bool(jitter)
+        self._rng = random.Random(seed) if jitter else None
 
     def can_try_now(self) -> bool:
         return self.get_time_remaining_until_retry() <= 0
@@ -272,7 +285,15 @@ class ExponentialBackoff:
 
     def report_error(self) -> None:
         self._last_error_ts = time.monotonic()
-        if self._current == 0.0:
+        if self._jitter:
+            prev = self._current if self._current > 0.0 else self._initial
+            self._current = min(
+                self._max,
+                self._rng.uniform(
+                    self._initial, max(self._initial, prev * 3.0)
+                ),
+            )
+        elif self._current == 0.0:
             self._current = self._initial
         else:
             self._current = min(self._current * 2, self._max)

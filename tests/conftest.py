@@ -11,6 +11,8 @@ openr_tpu.testing so bench.py and the driver entries share one copy.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from openr_tpu.testing import pin_host_cpu  # noqa: E402
@@ -18,3 +20,20 @@ from openr_tpu.utils.compile_cache import enable as _enable_compile_cache  # noq
 
 pin_host_cpu(8)
 _enable_compile_cache()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_integrity_auditor():
+    """Resident engines self-register with the process-global
+    IntegrityAuditor on construction, and Decision's post-converge
+    hook audits EVERY registered engine. Without a per-test reset, one
+    test's converge would audit engines still alive from another —
+    bumping integrity/tenancy counters and jit-compiling audit kernels
+    inside tests that assert exact counter or compile deltas. A
+    production process wants the global registry; tests want
+    hermeticity."""
+    from openr_tpu.integrity import reset_auditor
+
+    reset_auditor()
+    yield
+    reset_auditor()

@@ -373,9 +373,12 @@ class TestEngineLadder:
     def test_breaker_holds_then_probe_self_heals(self):
         ls, engine, rsw = _engine_setup()
         # a wider breaker window than the default so the hold assertion
-        # is not racing the walk's own wall-clock cost
+        # is not racing the walk's own wall-clock cost; jitter off — this
+        # test choreographs the exact doubling sequence (0.3 -> 0.6) and
+        # a decorrelated draw can exceed the 0.7 s probe sleep
         engine.supervisor = DegradationSupervisor(
-            "route_engine", backoff_min_s=0.3, backoff_max_s=1.0
+            "route_engine", backoff_min_s=0.3, backoff_max_s=1.0,
+            backoff_jitter=False,
         )
         get_injector().arm(
             "route_engine.dispatch", FaultSchedule.fail_once()

@@ -1,0 +1,600 @@
+"""Integrity plane: silent-corruption detection, quarantine, and warm
+healing over every resident engine class.
+
+The contract under test: a seeded bit flip in any resident device
+state (ELL, grouped, sharded, world-batch) is detected within ONE
+audit pass, healed bit-identical to a from-scratch cold build, and the
+emitted route product never flaps — the host mirrors hold the last
+verified-good bits throughout, so Fib-facing digests are unchanged
+before, during, and after the quarantine. Plus the satellites: the
+decorrelated backoff jitter, the disarmed-seam overhead bound, the
+``decision.route_staleness_ms`` gauge, grouped snapshot/rehydrate
+parity under the shared contract, and the ``mirror-coverage`` lint.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from openr_tpu.faults import (
+    DegradationSupervisor,
+    FaultSchedule,
+    consume_fault,
+    fault_point,
+    get_injector,
+)
+from openr_tpu.faults import injector as injector_mod
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.integrity import (
+    ResidentEngineContract,
+    get_auditor,
+    quarantine_active,
+    reset_auditor,
+)
+from openr_tpu.integrity import kernels as ik
+from openr_tpu.integrity.auditor import IntegrityAuditor
+from openr_tpu.models import topologies
+from openr_tpu.ops import route_engine, route_sweep
+from openr_tpu.ops import world_batch as wb
+from openr_tpu.telemetry import get_registry
+from openr_tpu.utils.eventbase import ExponentialBackoff
+
+from tests.test_route_engine_delta import (
+    KINDS,
+    assert_bit_identical,
+    engine_digests,
+    load,
+    make_engine,
+    mutate_metric,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    get_injector().reset()
+    reset_auditor()
+    yield
+    get_injector().reset()
+    reset_auditor()
+
+
+def _topo():
+    return topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+
+
+def _fast_supervisor():
+    return DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+
+
+# ---------------------------------------------------------------------
+# digest kernels
+# ---------------------------------------------------------------------
+
+
+class TestDigestKernels:
+    def test_device_host_parity(self):
+        rng = np.random.default_rng(0)
+        for shape in ((1, 1), (7, 3), (64, 33)):
+            arr = rng.integers(
+                -(2**31), 2**31, size=shape, dtype=np.int64
+            ).astype(np.int32)
+            assert int(ik.fnv_device(arr)) == ik.fnv_host(arr)
+
+    def test_slots_parity(self):
+        rng = np.random.default_rng(1)
+        block = rng.integers(
+            -(2**31), 2**31, size=(5, 8, 11), dtype=np.int64
+        ).astype(np.int32)
+        per_slot = np.asarray(ik.fnv_slots(block))
+        for s in range(block.shape[0]):
+            assert int(per_slot[s]) == ik.fnv_host(block[s])
+
+    def test_row_order_independent(self):
+        rng = np.random.default_rng(2)
+        arr = rng.integers(
+            -(2**31), 2**31, size=(16, 9), dtype=np.int64
+        ).astype(np.int32)
+        shuffled = arr[rng.permutation(16)]
+        assert ik.fnv_host(arr) == ik.fnv_host(shuffled)
+
+    def test_single_bit_sensitivity(self):
+        arr = np.zeros((8, 8), dtype=np.int32)
+        flipped = arr.copy()
+        flipped[3, 5] ^= 1 << 17
+        assert ik.fnv_host(arr) != ik.fnv_host(flipped)
+
+
+# ---------------------------------------------------------------------
+# detection + warm heal, all four engine classes
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_detect_quarantine_heal_bit_identical(kind):
+    ls = load(_topo())
+    engine = make_engine(kind, ls)
+    engine.supervisor = _fast_supervisor()
+    aud = get_auditor()
+    assert engine.audit_ready()
+    assert aud.audit_now()[-1]["verdict"] == "clean"
+
+    before = engine_digests(engine)
+    reg = get_registry()
+    q0 = reg.counter_get("integrity.quarantines")
+    engine.corrupt_resident(seed=7)
+    report = aud.audit_now()[-1]
+    # detected within ONE audit pass, healed within the same pass
+    assert report["verdict"] == "healed"
+    assert report["tier"] in ("residual", "digest", "oracle")
+    assert reg.counter_get("integrity.quarantines") == q0 + 1
+    assert not quarantine_active()
+
+    # zero route flaps: the served product never changed at all, and
+    # the healed residents are bit-identical to a cold build
+    assert engine_digests(engine) == before
+    assert engine.audit_ready()
+    assert_bit_identical(engine, ls, kind)
+
+    # the healed engine still churns warm
+    rsw = next(
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    )
+    moved = engine.churn(ls, mutate_metric(ls, rsw, 0, 9))
+    assert moved
+    assert_bit_identical(engine, ls, kind)
+
+
+def test_quarantine_poisons_warm_rung_without_heal():
+    """Even if integrity_heal never runs, a quarantined engine must not
+    serve another warm solve from the suspect residents: the next churn
+    walks the ladder past the warm rung and rebuilds."""
+    ls = load(_topo())
+    engine = make_engine("ell", ls)
+    engine.supervisor = _fast_supervisor()
+    reg = get_registry()
+    walks0 = reg.counter_get("route_engine.rung_failures.warm")
+    engine.corrupt_resident(seed=3)
+    engine.quarantine("test: manual quarantine")
+    assert not engine.audit_ready()
+    rsw = next(
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    )
+    moved = engine.churn(ls, mutate_metric(ls, rsw, 0, 13))
+    # deeper rungs return None by the cold-rebuild contract — the point
+    # is the warm rung REFUSED to serve from the poisoned residents
+    assert moved is None
+    assert reg.counter_get("route_engine.rung_failures.warm") == walks0 + 1
+    assert engine._device_valid  # the rebuild un-poisoned it
+    assert_bit_identical(engine, ls, "ell")
+
+
+def test_oracle_tier_catches_residual_blind_spot(monkeypatch):
+    """Tier 3 is the backstop for corruption tiers 1+2 can miss: blind
+    them explicitly, raise one resident DR cell, and the sampled cold
+    oracle (sampling every row here) must still convict."""
+    ls = load(_topo())
+    engine = make_engine("ell", ls)
+    engine.supervisor = _fast_supervisor()
+    monkeypatch.setattr(engine, "audit_residual", lambda: 0)
+    monkeypatch.setattr(engine, "audit_digest_pair", lambda: (0, 0))
+    aud = IntegrityAuditor(oracle_every=1, sample_rows=engine.graph.n)
+    aud.register(engine)
+    assert aud.audit_now()[-1]["verdict"] == "clean"
+    engine._dr = engine._dr.at[1, 2].set(engine._dr[1, 2] + 1)
+    report = aud.audit_now()[-1]
+    assert report["tier"] == "oracle"
+    # the heal rebuilt real state; the blinded tiers stay patched, so
+    # the oracle itself re-audited the healed rows clean
+    assert report["verdict"] == "healed"
+
+
+# ---------------------------------------------------------------------
+# world-batch plane
+# ---------------------------------------------------------------------
+
+
+def _world_items(n_tenants=2):
+    items = []
+    for i in range(n_tenants):
+        topo = _topo()
+        ls = LinkState(area=topo.area)
+        for _name, db in sorted(topo.adj_dbs.items()):
+            ls.update_adjacency_database(db)
+        names = sorted(ls.get_adjacency_databases())
+        items.append((f"tenant{i}", ls, names[i % len(names)]))
+    return items
+
+
+def test_world_batch_detect_quarantine_heal():
+    m = wb.WorldManager(slots_per_bucket=4, max_resident=8)
+    items = _world_items()
+    views = m.solve_views(items)
+    aud = get_auditor()
+    assert m.audit_ready()
+    assert aud.audit_now()[-1]["verdict"] == "clean"
+
+    before = [np.array(v[2], copy=True) for v in views]
+    reg = get_registry()
+    q0 = reg.counter_get("tenancy.quarantines")
+    h0 = reg.counter_get("tenancy.integrity_heals")
+    m.corrupt_resident(seed=5)
+    report = aud.audit_now()[-1]
+    assert report["verdict"] == "healed"
+    assert reg.counter_get("tenancy.quarantines") == q0 + 1
+    assert reg.counter_get("tenancy.integrity_heals") == h0 + 1
+
+    # the healed tenants serve bit-identical views with no re-solve
+    warm0 = reg.counter_get("tenancy.warm_solves")
+    cold0 = reg.counter_get("tenancy.cold_solves")
+    views2 = m.solve_views(items)
+    assert all(
+        np.array_equal(a, v2[2]) for a, v2 in zip(before, views2)
+    )
+    assert reg.counter_get("tenancy.warm_solves") == warm0
+    assert reg.counter_get("tenancy.cold_solves") == cold0
+
+
+def test_world_batch_corruption_seam_on_solve_views():
+    m = wb.WorldManager(slots_per_bucket=4, max_resident=8)
+    items = _world_items()
+    m.solve_views(items)
+    reg = get_registry()
+    c0 = reg.counter_get("faults.injected.device.corrupt_resident")
+    get_injector().arm(
+        route_engine.FAULT_CORRUPT, FaultSchedule.fail_once()
+    )
+    m.solve_views(items)
+    assert (
+        reg.counter_get("faults.injected.device.corrupt_resident")
+        == c0 + 1
+    )
+    # the flip landed after the dispatches settled: the audit sees it
+    assert get_auditor().audit_now()[-1]["verdict"] == "healed"
+
+
+# ---------------------------------------------------------------------
+# the seam + its disarmed cost
+# ---------------------------------------------------------------------
+
+
+def test_corrupt_seam_fires_on_engine_churn():
+    ls = load(_topo())
+    engine = make_engine("ell", ls)
+    engine.supervisor = _fast_supervisor()
+    before = engine_digests(engine)
+    reg = get_registry()
+    c0 = reg.counter_get("faults.injected.device.corrupt_resident")
+    get_injector().arm(
+        route_engine.FAULT_CORRUPT, FaultSchedule.fail_once()
+    )
+    rsw = next(
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    )
+    engine.churn(ls, mutate_metric(ls, rsw, 0, 21))
+    assert (
+        reg.counter_get("faults.injected.device.corrupt_resident")
+        == c0 + 1
+    )
+    # detection within one cadence, heal bit-identical, zero flaps on
+    # the UNTOUCHED routes (the churn itself legitimately moved some)
+    report = get_auditor().audit_now()[-1]
+    assert report["verdict"] == "healed"
+    assert_bit_identical(engine, ls, "ell")
+    after = engine_digests(engine)
+    moved_names = {
+        n for n in before if before[n] != after.get(n, before[n])
+    }
+    assert moved_names  # the metric change really moved routes
+    assert set(after) == set(before)  # ...but deleted none
+
+
+def test_disarmed_seam_never_reaches_injector(monkeypatch):
+    """The churn-path overhead contract: a disarmed process pays ONE
+    attribute read per seam crossing — the injector's locked paths must
+    not even be entered."""
+    inj = get_injector()
+    inj.reset()
+
+    def _boom(*a, **k):  # pragma: no cover - the assert is the test
+        raise AssertionError("disarmed crossing entered the injector")
+
+    monkeypatch.setattr(injector_mod.FaultInjector, "check", _boom)
+    monkeypatch.setattr(injector_mod.FaultInjector, "consume", _boom)
+    fault_point(route_engine.FAULT_CORRUPT)
+    assert consume_fault(route_engine.FAULT_CORRUPT) is False
+
+
+# ---------------------------------------------------------------------
+# decorrelated backoff jitter
+# ---------------------------------------------------------------------
+
+
+class TestBackoffJitter:
+    def test_spread_under_fixed_seeds(self):
+        firsts = []
+        for seed in range(8):
+            b = ExponentialBackoff(0.05, 2.0, jitter=True, seed=seed)
+            b.report_error()
+            d = b.get_current_backoff()
+            assert 0.05 <= d <= 2.0
+            firsts.append(round(d, 9))
+        # eight breakers opening on one event must NOT re-probe in
+        # lockstep: the seeded streams spread
+        assert len(set(firsts)) >= 6
+
+    def test_bounds_and_determinism(self):
+        a = ExponentialBackoff(0.05, 2.0, jitter=True, seed=42)
+        b = ExponentialBackoff(0.05, 2.0, jitter=True, seed=42)
+        seq_a, seq_b = [], []
+        for _ in range(32):
+            a.report_error()
+            b.report_error()
+            seq_a.append(a.get_current_backoff())
+            seq_b.append(b.get_current_backoff())
+        assert seq_a == seq_b  # replayable from the seed
+        assert all(0.05 <= d <= 2.0 for d in seq_a)
+
+    def test_default_off_keeps_reference_sequence(self):
+        b = ExponentialBackoff(0.1, 0.4)
+        got = []
+        for _ in range(3):
+            b.report_error()
+            got.append(b.get_current_backoff())
+        assert got == pytest.approx([0.1, 0.2, 0.4])
+
+    def test_supervisor_defaults_jitter_on_with_name_seed(self):
+        s1 = DegradationSupervisor("jitter_a", backoff_min_s=0.05,
+                                   backoff_max_s=2.0)
+        s2 = DegradationSupervisor("jitter_a", backoff_min_s=0.05,
+                                   backoff_max_s=2.0)
+        s3 = DegradationSupervisor("jitter_b", backoff_min_s=0.05,
+                                   backoff_max_s=2.0)
+        for s in (s1, s2, s3):
+            s.breaker.report_error()
+        # same name -> same replayable stream; distinct names diverge
+        assert (
+            s1.breaker.get_current_backoff()
+            == s2.breaker.get_current_backoff()
+        )
+        assert (
+            s1.breaker.get_current_backoff()
+            != s3.breaker.get_current_backoff()
+        )
+
+
+# ---------------------------------------------------------------------
+# auditor cadence + containment
+# ---------------------------------------------------------------------
+
+
+class _FakeEngine(ResidentEngineContract):
+    audit_kind = "fake"
+
+    def __init__(self):
+        self.sample_calls = 0
+        self.residual_calls = 0
+
+    def audit_ready(self):
+        return True
+
+    def audit_residual(self):
+        self.residual_calls += 1
+        return 0
+
+    def audit_digest_pair(self):
+        return (0, 0)
+
+    def audit_row_count(self):
+        return 16
+
+    def audit_sample_rows(self, rows):
+        self.sample_calls += 1
+        assert list(rows) == sorted(set(rows))
+        assert all(0 <= r < 16 for r in rows)
+        return 0
+
+    def quarantine(self, reason):
+        pass
+
+    def integrity_heal(self):
+        return True
+
+    def corrupt_resident(self, seed):
+        pass
+
+
+def test_oracle_cadence_gating():
+    aud = IntegrityAuditor(oracle_every=3, sample_rows=4,
+                           min_interval_s=0.0)
+    eng = _FakeEngine()
+    aud.register(eng)
+    for _ in range(6):
+        aud.on_converge()
+    assert eng.residual_calls == 6  # tiers 1+2 every converge
+    assert eng.sample_calls == 2    # tier 3 on the 3rd and 6th only
+
+
+def test_audit_errors_are_contained():
+    aud = IntegrityAuditor()
+    eng = _FakeEngine()
+    eng.audit_residual = lambda: (_ for _ in ()).throw(RuntimeError("x"))
+    aud.register(eng)
+    reg = get_registry()
+    e0 = reg.counter_get("integrity.audit_errors")
+    aud.on_converge()  # must not raise: Decision's loop rides this
+    assert reg.counter_get("integrity.audit_errors") == e0 + 1
+
+
+# ---------------------------------------------------------------------
+# snapshot / rehydrate under the shared contract (grouped backend)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ("grouped", "ell"))
+def test_snapshot_rehydrate_parity(kind):
+    ls = load(_topo())
+    engine = make_engine(kind, ls)
+    engine.supervisor = _fast_supervisor()
+    rsw = next(
+        n for n in engine.graph.node_names if n.startswith("rsw")
+    )
+    engine.churn(ls, mutate_metric(ls, rsw, 0, 17))
+    snap = engine.snapshot_resident_state()
+    assert snap is not None and snap["kind"] == engine.audit_kind
+
+    twin = make_engine(kind, ls)
+    assert twin.rehydrate_resident_state(snap) is True
+    np.testing.assert_array_equal(
+        twin.result.digests, engine.result.digests
+    )
+    np.testing.assert_array_equal(
+        np.asarray(twin._dr), np.asarray(engine._dr)
+    )
+    # the rehydrated residents audit clean and churn warm
+    aud = IntegrityAuditor(oracle_every=1, sample_rows=4)
+    aud.register(twin)
+    assert aud.audit_now()[-1]["verdict"] == "clean"
+    twin.supervisor = _fast_supervisor()
+    # metric 1 yanks shortest paths ONTO the link: routes must move
+    moved = twin.churn(ls, mutate_metric(ls, rsw, 0, 1))
+    assert moved
+    assert_bit_identical(twin, ls, kind)
+
+
+def test_rehydrate_rejects_cross_class_and_stale():
+    ls = load(_topo())
+    ell = make_engine("ell", ls)
+    grouped = make_engine("grouped", ls)
+    snap = ell.snapshot_resident_state()
+    assert snap is not None
+    # cross-class: layouts differ, the gate must refuse
+    assert grouped.rehydrate_resident_state(snap) is False
+    # stale topology: mutate, re-sync the donor, old snap must refuse
+    rsw = next(n for n in ell.graph.node_names if n.startswith("rsw"))
+    ell.supervisor = _fast_supervisor()
+    ell.churn(ls, mutate_metric(ls, rsw, 0, 29))
+    fresh = make_engine("ell", ls)
+    assert fresh.rehydrate_resident_state(snap) is False
+    assert fresh.rehydrate_resident_state({"kind": "ell"}) is False
+    assert fresh.rehydrate_resident_state(None) is False
+
+
+# ---------------------------------------------------------------------
+# decision.route_staleness_ms
+# ---------------------------------------------------------------------
+
+
+def test_route_staleness_gauge():
+    from openr_tpu.decision.decision import Decision
+    from openr_tpu.faults.supervisor import HealthState
+    from openr_tpu.messaging.queue import ReplicateQueue
+
+    d = Decision(
+        "node1",
+        kvstore_updates_queue=ReplicateQueue(name="kv"),
+        route_updates_queue=ReplicateQueue(name="routes"),
+        solver_backend="native",
+    )
+    gauge = d._route_staleness_ms
+    assert gauge() == 0.0  # nothing installed yet
+    import time as _time
+
+    d._last_good_route_ts = _time.monotonic() - 0.25
+    assert gauge() == 0.0  # healthy + no quarantine: not stale
+    d.supervisor.state = HealthState.DEGRADED
+    assert gauge() >= 250.0  # ages from the last verified-good install
+    d.supervisor.state = HealthState.HEALTHY
+    assert gauge() == 0.0  # self-heal zeroes it
+
+    # an integrity quarantine makes the served routes stale too, even
+    # with the ladder fully healthy
+    aud = get_auditor()
+    eng = _FakeEngine()
+    aud.register(eng)
+    aud._quarantined.add(eng)
+    assert quarantine_active()
+    assert gauge() >= 250.0
+    aud._quarantined.discard(eng)
+    assert gauge() == 0.0
+
+
+# ---------------------------------------------------------------------
+# mirror-coverage lint
+# ---------------------------------------------------------------------
+
+from tests.test_analysis_lint import lint, rule_hits  # noqa: E402
+
+MIRROR_PREAMBLE = """\
+    from openr_tpu.analysis.annotations import (
+        mirrored_by, resident_buffers,
+    )
+"""
+
+
+def test_mirror_coverage_flags_unmirrored_resident(tmp_path):
+    report = lint(tmp_path, MIRROR_PREAMBLE + """
+    @resident_buffers("_d_dev", "_packed_dev")
+    class Engine:
+        pass
+    """)
+    hits = rule_hits(report, "mirror-coverage")
+    assert len(hits) == 2
+    assert "_d_dev" in hits[0].message
+
+
+def test_mirror_coverage_satisfied_by_mirrored_by(tmp_path):
+    report = lint(tmp_path, MIRROR_PREAMBLE + """
+    @mirrored_by(_d_dev="settled into _d_host on consume",
+                 _packed_dev="rebuilt from the LinkState")
+    @resident_buffers("_d_dev", "_packed_dev")
+    class Engine:
+        pass
+    """)
+    assert rule_hits(report, "mirror-coverage") == []
+
+
+def test_mirror_coverage_partial_coverage_flags_the_gap(tmp_path):
+    report = lint(tmp_path, MIRROR_PREAMBLE + """
+    @mirrored_by(_d_dev="settled into _d_host on consume")
+    @resident_buffers("_d_dev", "_packed_dev")
+    class Engine:
+        pass
+    """)
+    hits = rule_hits(report, "mirror-coverage")
+    assert len(hits) == 1
+    assert "_packed_dev" in hits[0].message
+
+
+def test_mirror_coverage_suppressed_with_reason(tmp_path):
+    report = lint(tmp_path, MIRROR_PREAMBLE + """
+    # openr-lint: disable=mirror-coverage -- scratch block, cold build regenerates it wholesale
+    @resident_buffers("_scratch_dev")
+    class Engine:
+        pass
+    """)
+    assert rule_hits(report, "mirror-coverage") == []
+
+
+# ---------------------------------------------------------------------
+# the contract itself
+# ---------------------------------------------------------------------
+
+
+def test_engines_implement_the_contract():
+    ls = load(_topo())
+    engine = make_engine("ell", ls)
+    manager = wb.WorldManager(slots_per_bucket=2, max_resident=4)
+    assert isinstance(engine, ResidentEngineContract)
+    assert isinstance(manager, ResidentEngineContract)
+    kinds = {engine.audit_kind, manager.audit_kind}
+    assert kinds == {"ell", "world_batch"}
+    # the defaulted half of the contract: worlds opt out of
+    # snapshot/rehydrate (placement from the mirrors IS their warm
+    # path), engines implement it
+    assert manager.snapshot_resident_state() is None
+    assert manager.rehydrate_resident_state({"kind": "world_batch"}) is False

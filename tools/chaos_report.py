@@ -15,14 +15,18 @@ loudly if the graceful-degradation contract regressed:
   oracle),
 - a ladder walk was unbounded (more walks than churn events),
 - the coverage floor was missed (too few faults fired, fewer than
-  eight distinct seams crossed — including ``device.lost`` and
-  ``state.checkpoint_write`` — or the lossy-publisher seam never
-  fired),
+  nine distinct seams crossed — including ``device.lost``,
+  ``state.checkpoint_write``, and ``device.corrupt_resident`` — or
+  the lossy-publisher seam never fired),
 - the lossy-load route product diverged from a survivor-replay
   oracle (dropped events must be pure no-ops),
 - the kill-restart leg (checkpoint mid-storm with one injected
   checkpoint-write failure, drop process state, warm-boot from the
-  backing store, replay survivors) did not land bit-identical.
+  backing store, replay survivors) did not land bit-identical,
+- the corruption-storm leg (probabilistic ``device.corrupt_resident``
+  flips across a churn run, audited each event) missed a conviction,
+  failed a heal, or finished with a product that diverged from the
+  fault-free oracle.
 
 Writes a JSON artifact (``--out``, default
 ``/tmp/openr_tpu_chaos_report.json``) with the per-site fault counts,
@@ -210,6 +214,93 @@ def _engine_leg(seed, events, failures):
     )
     if route_sweep.digests_by_name(engine.result) != host:
         failures.append("route digests diverged from host sweep oracle")
+    return churns
+
+
+def _corruption_storm_leg(seed, events, failures):
+    from openr_tpu.faults import (
+        DegradationSupervisor,
+        FaultSchedule,
+        get_injector,
+    )
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.integrity.auditor import IntegrityAuditor
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = LinkState(area=topo.area)
+    for _, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    rsws = [n for n in engine.graph.node_names if n.startswith("rsw")][:4]
+
+    def mutate(node, metric):
+        db = ls.get_adjacency_databases()[node]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+        return {node, adjs[0].other_node_name}
+
+    # a PRIVATE auditor (not the process global) so the storm audits
+    # exactly this engine, on the real post-converge cadence
+    # (rate limit off: the storm converges far faster than wall time)
+    aud = IntegrityAuditor(oracle_every=4, seed=seed, min_interval_s=0.0)
+    aud.register(engine)
+    inj = get_injector()
+    inj.arm(
+        "device.corrupt_resident",
+        FaultSchedule.fail_with_probability(0.5, seed=seed + 9),
+    )
+    v0 = sum(
+        c for k, c in reg.snapshot().items()
+        if k.startswith("integrity.violations.")
+    )
+    hf0 = reg.counter_get("integrity.heal_failures")
+    rng = random.Random(seed + 10)
+    churns = 0
+    try:
+        for _ in range(events):
+            engine.churn(ls, mutate(rng.choice(rsws), rng.randrange(1, 60)))
+            churns += 1
+            # the Decision post-converge hook's cadence: tiers 1+2
+            # every event, the sampled oracle every 4th
+            aud.on_converge()
+    finally:
+        inj.disarm("device.corrupt_resident")
+    final = aud.audit_now()[-1]
+
+    convictions = sum(
+        c for k, c in reg.snapshot().items()
+        if k.startswith("integrity.violations.")
+    ) - v0
+    if convictions < 1:
+        failures.append(
+            "corruption storm produced zero convictions (seam dead "
+            "or every flip washed)"
+        )
+    if reg.counter_get("integrity.heal_failures") - hf0:
+        failures.append("corruption storm left failed heals behind")
+    if final["verdict"] != "clean":
+        failures.append(
+            f"post-storm audit verdict {final['verdict']!r} (want clean)"
+        )
+    host = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+    if route_sweep.digests_by_name(engine.result) != host:
+        failures.append(
+            "post-corruption-storm digests diverged from host oracle"
+        )
+    aud.unregister(engine)
     return churns
 
 
@@ -635,16 +726,17 @@ def main(argv=None) -> int:
 
     budgets = (
         {"engine": 60, "decision": 20, "platform": 20, "load": 40,
-         "restart": 12, "floor": 50}
+         "restart": 12, "corrupt": 20, "floor": 50}
         if args.smoke
         else {"engine": 160, "decision": 40, "platform": 40, "load": 80,
-              "restart": 24, "floor": 200}
+              "restart": 24, "corrupt": 48, "floor": 200}
     )
 
     failures: list = []
     t0 = time.perf_counter()
     events = 0
     events += _engine_leg(args.seed, budgets["engine"], failures)
+    events += _corruption_storm_leg(args.seed, budgets["corrupt"], failures)
     events += _decision_leg(args.seed, budgets["decision"], failures)
     events += _platform_leg(args.seed, budgets["platform"], failures)
     events += _load_leg(args.seed, budgets["load"], failures)
@@ -661,9 +753,11 @@ def main(argv=None) -> int:
             f"coverage floor missed: {sum(injected.values())} faults "
             f"< {budgets['floor']}"
         )
-    # the floor covers the crash seams too: ``device.lost`` (engine
-    # leg) and ``state.checkpoint_write`` (kill-restart leg) must fire
-    if len(injected) < 8:
+    # the floor covers the crash and corruption seams too:
+    # ``device.lost`` (engine leg), ``state.checkpoint_write``
+    # (kill-restart leg), and ``device.corrupt_resident``
+    # (corruption-storm leg) must all fire
+    if len(injected) < 9:
         failures.append(
             f"only {len(injected)} seams crossed: {sorted(injected)}"
         )

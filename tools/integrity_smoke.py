@@ -1,0 +1,313 @@
+#!/usr/bin/env python
+"""Integrity gate (``make integrity-smoke``) and report artifact.
+
+Exercises the silent-corruption audit plane end to end and fails
+loudly if the detect/quarantine/heal contract regressed:
+
+- ENGINE CORRUPTION (ELL + grouped): the ``device.corrupt_resident``
+  seam flips resident bits during a live churn; the very next forced
+  audit must convict (one of the three tiers), quarantine, and heal
+  WARM — the healed route product bit-identical to a from-scratch
+  host oracle, the served digests unchanged for every untouched
+  route, and ZERO route deletes (routes never flap),
+- WORLD-BATCH CORRUPTION: the same seam fired inside
+  ``solve_views`` lands after the dispatches settle; the audit heals
+  by re-placing from the settle-on-success mirrors and the next
+  ``solve_views`` serves bit-identical views with zero warm or cold
+  re-solves,
+- LADDER POISONING: a quarantined engine must refuse to serve another
+  warm solve — the next churn walks past the warm rung
+  (``route_engine.rung_failures.warm`` bumps) and rebuilds clean,
+- AUDIT ACCOUNTING: every conviction is visible as
+  ``integrity.violations.<tier>`` + ``integrity.quarantines`` +
+  ``integrity.heals`` with no ``integrity.heal_failures`` and no
+  contained ``integrity.audit_errors``.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_integrity_smoke.json``); exit 0 on pass, 1 with a
+reason list on fail. Runs CPU-pinned — this gates audit machinery,
+not kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import replace
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/integrity_smoke.py) in addition
+# to module mode (python -m tools.integrity_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _linkstate():
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _make_engine(kind, ls):
+    from openr_tpu.faults import DegradationSupervisor
+    from openr_tpu.ops import route_engine
+
+    names = sorted(ls.get_adjacency_databases())
+    cls = (
+        route_engine.RouteSweepEngine
+        if kind == "ell"
+        else route_engine.GroupedRouteSweepEngine
+    )
+    engine = cls(ls, [names[0]])
+    engine.supervisor = DegradationSupervisor(
+        "route_engine", backoff_min_s=0.001, backoff_max_s=0.002
+    )
+    return engine, names
+
+
+def _mutate(ls, name, metric):
+    db = ls.get_adjacency_databases()[name]
+    adjs = list(db.adjacencies)
+    adjs[0] = replace(adjs[0], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {name, adjs[0].other_node_name}
+
+
+def _host_digests(ls, names):
+    from openr_tpu.ops import route_sweep
+
+    return route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+
+
+def _engine_corruption_leg(kind, report, failures):
+    from openr_tpu.faults import FaultSchedule, get_injector
+    from openr_tpu.integrity import get_auditor, quarantine_active
+    from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    ls = _linkstate()
+    engine, names = _make_engine(kind, ls)
+    aud = get_auditor()
+    if aud.audit_now()[-1]["verdict"] != "clean":
+        failures.append(f"{kind}: pristine engine failed its first audit")
+
+    before = route_sweep.digests_by_name(engine.result)
+    moved = engine.churn(ls, _mutate(ls, names[0], 7))
+    report[f"{kind}_routes_moved"] = len(moved or ())
+    if not moved:
+        failures.append(f"{kind}: metric churn moved no routes")
+    settled = route_sweep.digests_by_name(engine.result)
+    if set(settled) != set(before):
+        failures.append(f"{kind}: route deletes on a metric churn")
+
+    # corrupt the settled residents, then audit: detection + warm heal
+    # within ONE forced pass, the served digests untouched throughout
+    q0 = reg.counter_get("integrity.quarantines")
+    h0 = reg.counter_get("integrity.heals")
+    hf0 = reg.counter_get("integrity.heal_failures")
+    engine.corrupt_resident(seed=7)
+    verdict = aud.audit_now()[-1]
+    report[f"{kind}_verdict"] = verdict
+    if verdict["verdict"] != "healed":
+        failures.append(
+            f"{kind}: audit verdict {verdict['verdict']!r} "
+            f"(tier {verdict.get('tier')!r}), want healed in one pass"
+        )
+    if reg.counter_get("integrity.quarantines") - q0 != 1:
+        failures.append(f"{kind}: conviction did not count a quarantine")
+    if reg.counter_get("integrity.heals") - h0 != 1:
+        failures.append(f"{kind}: heal did not count")
+    if reg.counter_get("integrity.heal_failures") - hf0:
+        failures.append(f"{kind}: heal failures counted")
+    if quarantine_active():
+        failures.append(f"{kind}: quarantine still active after heal")
+    if route_sweep.digests_by_name(engine.result) != settled:
+        failures.append(
+            f"{kind}: served digests changed across quarantine + heal"
+        )
+    if settled != _host_digests(ls, names):
+        failures.append(
+            f"{kind}: healed route product diverged from host oracle"
+        )
+
+    # the seam itself: fired mid-churn the flip lands BEFORE the warm
+    # body, so it is either convicted by the next audit or legitimately
+    # overwritten by the re-solve — bit parity is the invariant either
+    # way, and the injection must count exactly once
+    fired0 = reg.counter_get("faults.injected.device.corrupt_resident")
+    get_injector().arm(
+        route_engine.FAULT_CORRUPT, FaultSchedule.fail_once()
+    )
+    engine.churn(ls, _mutate(ls, names[0], 1))
+    get_injector().disarm(route_engine.FAULT_CORRUPT)
+    fired = reg.counter_get(
+        "faults.injected.device.corrupt_resident"
+    ) - fired0
+    if fired != 1:
+        failures.append(
+            f"{kind}: corruption seam fired {fired}x on churn (want 1)"
+        )
+    seam_verdict = aud.audit_now()[-1]
+    report[f"{kind}_seam_verdict"] = seam_verdict
+    if seam_verdict["verdict"] not in ("healed", "clean"):
+        failures.append(
+            f"{kind}: seam corruption left verdict "
+            f"{seam_verdict['verdict']!r}"
+        )
+    if route_sweep.digests_by_name(engine.result) != _host_digests(
+        ls, names
+    ):
+        failures.append(f"{kind}: post-seam product diverged from oracle")
+    aud.unregister(engine)
+
+
+def _ladder_poison_leg(report, failures):
+    from openr_tpu.integrity import get_auditor
+    from openr_tpu.ops import route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    ls = _linkstate()
+    engine, names = _make_engine("ell", ls)
+    engine.corrupt_resident(seed=11)
+    engine.quarantine("integrity smoke: manual quarantine")
+    walks0 = reg.counter_get("route_engine.rung_failures.warm")
+    engine.churn(ls, _mutate(ls, names[0], 13))
+    walks = reg.counter_get("route_engine.rung_failures.warm") - walks0
+    report["poisoned_warm_rung_walks"] = walks
+    if walks != 1:
+        failures.append(
+            f"quarantined engine served the warm rung ({walks} walks)"
+        )
+    if route_sweep.digests_by_name(engine.result) != _host_digests(
+        ls, names
+    ):
+        failures.append("ladder rebuild of a poisoned engine diverged")
+    get_auditor().unregister(engine)
+
+
+def _world_corruption_leg(report, failures):
+    import numpy as np
+
+    from openr_tpu.faults import FaultSchedule, get_injector
+    from openr_tpu.integrity import get_auditor
+    from openr_tpu.ops import route_engine
+    from openr_tpu.ops import world_batch as wb
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    manager = wb.WorldManager(slots_per_bucket=4, max_resident=8)
+    items = []
+    for i in range(2):
+        ls = _linkstate()
+        names = sorted(ls.get_adjacency_databases())
+        items.append((f"tenant{i}", ls, names[i]))
+    views = manager.solve_views(items)
+    before = [np.array(v[2], copy=True) for v in views]
+    aud = get_auditor()
+    if aud.audit_now()[-1]["verdict"] != "clean":
+        failures.append("world: pristine manager failed its first audit")
+
+    q0 = reg.counter_get("tenancy.quarantines")
+    h0 = reg.counter_get("tenancy.integrity_heals")
+    get_injector().arm(
+        route_engine.FAULT_CORRUPT, FaultSchedule.fail_once()
+    )
+    manager.solve_views(items)
+    get_injector().disarm(route_engine.FAULT_CORRUPT)
+    verdict = aud.audit_now()[-1]
+    report["world_verdict"] = verdict
+    if verdict["verdict"] != "healed":
+        failures.append(
+            f"world: audit verdict {verdict['verdict']!r} "
+            f"(tier {verdict.get('tier')!r}), want healed"
+        )
+    if reg.counter_get("tenancy.quarantines") - q0 != 1:
+        failures.append("world: conviction did not count a quarantine")
+    if reg.counter_get("tenancy.integrity_heals") - h0 != 1:
+        failures.append("world: mirror re-placement heal did not count")
+
+    # the heal is pure re-placement: the next solve serves the exact
+    # pre-corruption bits without a single warm or cold re-solve
+    warm0 = reg.counter_get("tenancy.warm_solves")
+    cold0 = reg.counter_get("tenancy.cold_solves")
+    views2 = manager.solve_views(items)
+    warm = reg.counter_get("tenancy.warm_solves") - warm0
+    cold = reg.counter_get("tenancy.cold_solves") - cold0
+    report["world_post_heal_resolves"] = warm + cold
+    if warm or cold:
+        failures.append(
+            f"world: heal paid {warm} warm + {cold} cold re-solves"
+        )
+    if not all(
+        np.array_equal(a, v2[2]) for a, v2 in zip(before, views2)
+    ):
+        failures.append("world: post-heal views diverged (route flap)")
+    aud.unregister(manager)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_integrity_smoke.json"
+    )
+    args = parser.parse_args(argv)
+
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.faults import get_injector
+    from openr_tpu.integrity import reset_auditor
+    from openr_tpu.telemetry import get_registry, jax_hooks
+
+    jax_hooks.install()
+    get_injector().reset()
+    reset_auditor()
+    reg = get_registry()
+    errors0 = reg.counter_get("integrity.audit_errors")
+    failures: list = []
+    report: dict = {}
+    t0 = time.perf_counter()
+    try:
+        _engine_corruption_leg("ell", report, failures)
+        _engine_corruption_leg("grouped", report, failures)
+        _ladder_poison_leg(report, failures)
+        _world_corruption_leg(report, failures)
+    finally:
+        get_injector().reset()
+        reset_auditor()
+    errors = reg.counter_get("integrity.audit_errors") - errors0
+    report["audit_errors"] = errors
+    if errors:
+        failures.append(f"{errors} audit errors were contained (want 0)")
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    report["failures"] = failures
+    report["passed"] = not failures
+
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if failures:
+        print(f"INTEGRITY GATE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print(f"INTEGRITY GATE: PASS (report: {args.out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
