@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke multichip-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke multichip-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -105,6 +105,15 @@ recovery-smoke: native
 # it fails.
 integrity-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.integrity_smoke --out /tmp/openr_tpu_integrity_smoke.json
+
+# digital-twin gate (openr_tpu.twin): a 16-vantage fleet must solve
+# as ONE batched dispatch wave bit-identical to 16 independently-run
+# Decision pipelines, join/warm-churn retrace-free, and the fleet
+# analyzer must catch an injected micro-loop and transient blackhole
+# (and report clean after the heal wave). See docs/RUNBOOK.md "Fleet
+# what-if triage" when it fails.
+twin-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.twin_smoke --out /tmp/openr_tpu_twin_smoke.json
 
 # sharded-dispatch gate on the virtual 8-device CPU mesh (conftest
 # pins the device count): pipelined==eager bit-identity across a

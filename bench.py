@@ -568,6 +568,28 @@ def _run() -> dict:
             except Exception as e:
                 bench_integrity = {"error": f"{type(e).__name__}: {e}"}
 
+    # thirteenth leg: digital-twin fleet reconvergence — N vantages
+    # re-solved per topology event as ONE batched wave (the twin) vs
+    # N sequential single-tenant dispatches (the pre-twin status quo),
+    # parity-asserted on the final event; reports the per-event cost
+    # ratio and dispatches/event (make twin-smoke is the hard CI
+    # gate; this leg folds the fleet numbers into the artifact)
+    bench_twin = None
+    if os.environ.get("OPENR_BENCH_TWIN") == "1":
+        if leg_elapsed() > 540:
+            bench_twin = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import fleet_twin_bench
+
+                bench_twin = fleet_twin_bench(
+                    int(os.environ.get("OPENR_BENCH_TWIN_NODES", "16"))
+                )
+            except Exception as e:
+                bench_twin = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -647,6 +669,7 @@ def _run() -> dict:
         "bench_multi_tenant": bench_tenancy,
         "bench_recovery": bench_recovery,
         "bench_integrity_audit": bench_integrity,
+        "bench_fleet_twin": bench_twin,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -721,6 +744,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_TENANCY"] = "1"
         env["OPENR_BENCH_RECOVERY"] = "1"
         env["OPENR_BENCH_INTEGRITY"] = "1"
+        env["OPENR_BENCH_TWIN"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
@@ -730,6 +754,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env.pop("OPENR_BENCH_TENANCY", None)
         env.pop("OPENR_BENCH_RECOVERY", None)
         env.pop("OPENR_BENCH_INTEGRITY", None)
+        env.pop("OPENR_BENCH_TWIN", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

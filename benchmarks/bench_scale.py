@@ -1690,6 +1690,91 @@ def integrity_audit_bench(
     }
 
 
+def fleet_twin_bench(
+    nodes: int = 16, events: int = 10, seed: int = 20260805,
+) -> dict:
+    """Digital-twin leg (openr_tpu.twin): per-event fleet
+    reconvergence solved two ways over the SAME LSDB stream —
+
+    - BATCHED: the twin's one ``solve_views`` wave (all N vantages in
+      one dispatch, vantage-view packing sharing one compiled graph),
+    - SEQUENTIAL: N single-tenant ``solve_view`` calls per event (the
+      pre-twin status quo: each vantage its own dispatch).
+
+    Both sides measure device-view production only (route-db builds
+    are identical host work either way); the final event's packed
+    views are compared bit for bit — a fast bench must still be a
+    correct one. ``make twin-smoke`` is the hard CI gate; this leg
+    folds the fleet-throughput numbers into the official artifact."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.load.generator import LoadGenerator
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
+    from openr_tpu.twin import FabricTwin
+    from openr_tpu.types import AdjacencyDatabase
+    from openr_tpu.utils import keys as keyutil
+    from openr_tpu.utils import wire
+
+    topo = topologies.ring(nodes)
+    roots = sorted(topo.adj_dbs)
+    twin = FabricTwin(topo)
+    twin.converge()  # warm the fleet bucket
+    items = [(twin._tid(n), twin.ls, n) for n in roots]
+
+    seq_mgr = WorldManager(slots_per_bucket=1, max_resident=nodes)
+    ls_seq = LinkState(topo.area)
+    for n in roots:
+        ls_seq.update_adjacency_database(topo.adj_dbs[n])
+    for r in roots:
+        seq_mgr.solve_view(f"seq/{r}", ls_seq, r)  # warm each world
+
+    gen = LoadGenerator(topo, seed=seed % 1000)
+    gen.initial_key_vals()
+    batched_s = seq_s = 0.0
+    applied = 0
+    twin_dispatches = 0
+    while applied < events:
+        ev = gen.next_event()
+        if not keyutil.is_adj_key(ev.key):
+            continue  # prefix events cost no SPF wave on either side
+        applied += 1
+        db = wire.loads(ev.payload, AdjacencyDatabase)
+        twin.ls.update_adjacency_database(db)
+        d0 = TENANCY_COUNTERS["dispatches"]
+        t0 = _time.perf_counter()
+        views_b = twin.manager.solve_views(items)
+        batched_s += _time.perf_counter() - t0
+        twin_dispatches += TENANCY_COUNTERS["dispatches"] - d0
+        ls_seq.update_adjacency_database(db)
+        t0 = _time.perf_counter()
+        views_s = [
+            seq_mgr.solve_view(f"seq/{r}", ls_seq, r) for r in roots
+        ]
+        seq_s += _time.perf_counter() - t0
+    parity = all(
+        sb == ss
+        and np.array_equal(np.asarray(pb), np.asarray(ps))
+        for (_gb, sb, pb), (_gs, ss, ps) in zip(views_b, views_s)
+    )
+    assert parity, "fleet twin bench diverged from sequential oracle"
+    twin.close()
+    return {
+        "vantages": nodes,
+        "events": applied,
+        "batched_ms_per_event": round(1000.0 * batched_s / applied, 3),
+        "sequential_ms_per_event": round(1000.0 * seq_s / applied, 3),
+        "ratio": round(batched_s / seq_s, 4) if seq_s else None,
+        "dispatches_per_event": twin_dispatches / float(applied),
+        "parity": parity,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)

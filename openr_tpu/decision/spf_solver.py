@@ -565,23 +565,32 @@ class _EllResidentCache:
         self._preloaded: List[tuple] = []
 
     def preload_view(self, ls, graph, srcs, packed) -> None:
-        root = graph.node_names[srcs[0]]
+        self.preload_views(ls, [(graph, srcs, packed)])
+
+    def preload_views(self, ls, views) -> None:
+        """Batch preload — the fleet twin's fan-in: every vantage's
+        solved view from one batched tenant dispatch lands here so the
+        per-vantage ``build_route_db`` calls each consume theirs with
+        zero device work. ``views``: [(graph, srcs, packed)]."""
         # dead-graph entries can never match; drop them so MB-scale
         # packed rows don't stay pinned behind a dead LinkState
         self._preloaded = [
             e for e in self._preloaded if e[0]() is not None
         ]
-        self._preloaded.append(
-            (
-                _weakref.ref(ls), ls.topology_version, root,
-                graph, srcs, packed,
+        for graph, srcs, packed in views:
+            root = graph.node_names[srcs[0]]
+            self._preloaded.append(
+                (
+                    _weakref.ref(ls), ls.topology_version, root,
+                    graph, srcs, packed,
+                )
             )
-        )
         # bound growth on unconsumed entries — but never below the
-        # area count: every area engine preloads BEFORE any view is
+        # area count (every area engine preloads BEFORE any view is
         # consumed, so a fixed cap would evict the earliest areas'
-        # views each build and silently re-pay the round trip
-        cap = max(8, len(self._cache))
+        # views each build) nor below THIS batch's size (an N-vantage
+        # fleet preload must never evict its own earlier entries)
+        cap = max(8, len(self._cache), len(views))
         del self._preloaded[:-cap]
 
     def has_preloaded(self, ls, root: str) -> bool:
@@ -678,6 +687,15 @@ def export_resident_state(ls: LinkState):
     if version != ls.topology_version or state._d_dev is None:
         return None
     return state
+
+
+def fleet_preload_views(ls: LinkState, views) -> None:
+    """Install one batched wave's per-vantage solved views (the
+    digital twin's fan-in): each ``(graph, srcs, packed)`` triple is
+    consumed once by the matching root's next SpfView, so N vantage
+    route rebuilds follow one ``world_dispatch`` with zero further
+    device work."""
+    _ELL_RESIDENT.preload_views(ls, views)
 
 
 def seed_resident_state(ls: LinkState, state) -> None:

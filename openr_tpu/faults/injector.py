@@ -49,7 +49,7 @@ class FaultSchedule:
     """
 
     __slots__ = ("mode", "remaining", "probability", "delay_s", "_rng",
-                 "fires")
+                 "fires", "period", "_crossings")
 
     def __init__(
         self,
@@ -58,6 +58,7 @@ class FaultSchedule:
         probability: float = 0.0,
         delay_s: float = 0.0,
         seed: int = 0,
+        period: int = 0,
     ) -> None:
         self.mode = mode
         self.remaining = remaining  # None = unlimited
@@ -65,6 +66,8 @@ class FaultSchedule:
         self.delay_s = delay_s
         self._rng = random.Random(seed)
         self.fires = 0
+        self.period = int(period)  # fire every k-th crossing (0 = off)
+        self._crossings = 0
 
     # -- constructors ------------------------------------------------
     @classmethod
@@ -80,6 +83,14 @@ class FaultSchedule:
         return cls("fail", probability=float(p), seed=seed)
 
     @classmethod
+    def fail_every(cls, k: int) -> "FaultSchedule":
+        """Fire on every k-th crossing: deterministic periodic loss
+        (the twin's lossy-flood scenarios want a fixed drop cadence
+        that replays identically, which probability schedules only
+        give per-seed)."""
+        return cls("fail", period=int(k))
+
+    @classmethod
     def delay(
         cls, seconds: float, n: Optional[int] = None
     ) -> "FaultSchedule":
@@ -87,6 +98,12 @@ class FaultSchedule:
 
     # -- evaluation --------------------------------------------------
     def should_fire(self) -> bool:
+        if self.period:
+            self._crossings += 1
+            if self._crossings % self.period:
+                return False
+            self.fires += 1
+            return True
         if self.remaining is not None:
             if self.remaining <= 0:
                 return False

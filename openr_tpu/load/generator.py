@@ -29,8 +29,10 @@ from openr_tpu.models.topologies import Topology
 from openr_tpu.types import (
     TTL_INFINITY,
     Adjacency,
+    AdjacencyDatabase,
     BinaryAddress,
     IpPrefix,
+    PrefixDatabase,
     PrefixEntry,
     Value,
 )
@@ -42,21 +44,31 @@ FAULT_LOAD_GENERATOR = register_fault_site("load.generator")
 KIND_METRIC = "metric_churn"
 KIND_FLAP = "link_flap"
 KIND_PREFIX = "prefix_update"
+KIND_DRAIN = "drain_flip"
 
 
 @dataclass(frozen=True)
 class EventMix:
-    """Relative weights of the three event kinds (normalized at use)."""
+    """Relative weights of the event kinds (normalized at use).
+
+    ``drain_flip`` (overload toggle — the twin's drain-sequencing
+    scenario) defaults to 0.0 so every pre-existing (topology, seed,
+    mix) schedule stays byte-identical: the kind pick still consumes
+    exactly one RNG draw and the cumulative thresholds are unchanged
+    when the new weight is zero."""
 
     metric_churn: float = 0.70
     link_flap: float = 0.15
     prefix_update: float = 0.15
+    drain_flip: float = 0.0
 
-    def cumulative(self) -> Tuple[float, float]:
-        total = self.metric_churn + self.link_flap + self.prefix_update
+    def cumulative(self) -> Tuple[float, float, float]:
+        total = (self.metric_churn + self.link_flap
+                 + self.prefix_update + self.drain_flip)
         assert total > 0
         c1 = self.metric_churn / total
-        return (c1, c1 + self.link_flap / total)
+        c2 = c1 + self.link_flap / total
+        return (c1, c2, c2 + self.prefix_update / total)
 
 
 @dataclass
@@ -141,9 +153,14 @@ class LoadGenerator:
     def next_event(self) -> LoadEvent:
         seq = self._seq
         self._seq += 1
-        c1, c2 = self._mix.cumulative()
+        c1, c2, c3 = self._mix.cumulative()
         r = self._rng.random()
-        kind = KIND_METRIC if r < c1 else KIND_FLAP if r < c2 else KIND_PREFIX
+        kind = (
+            KIND_METRIC if r < c1
+            else KIND_FLAP if r < c2
+            else KIND_PREFIX if r < c3
+            else KIND_DRAIN
+        )
         # the seam fires BEFORE any state mutation: a dropped event is a
         # pure no-op for the oracle (lossy publisher, not torn state)
         try:
@@ -155,10 +172,53 @@ class LoadGenerator:
             return self._metric_churn(seq)
         if kind == KIND_FLAP:
             return self._link_flap(seq)
+        if kind == KIND_DRAIN:
+            return self._drain_flip(seq)
         return self._prefix_update(seq)
 
     def events(self, n: int) -> List[LoadEvent]:
         return [self.next_event() for _ in range(n)]
+
+    # -- scripted seams (the twin's scenario driver) ----------------------
+
+    def emit_adjacency(
+        self,
+        node: str,
+        db: Optional[AdjacencyDatabase] = None,
+        kind: str = "scripted",
+    ) -> LoadEvent:
+        """Scripted-event seam: replace ``node``'s adjacency database
+        (when given) and emit the publication event. Consumes NO RNG
+        draws, so scripted steps interleave with the seeded stream
+        without perturbing its schedule."""
+        if db is not None:
+            self.adj_dbs[node] = db
+        seq = self._seq
+        self._seq += 1
+        return self._emit_adj(seq, kind, node)
+
+    def emit_prefix(
+        self,
+        node: str,
+        db: Optional[PrefixDatabase] = None,
+        kind: str = KIND_PREFIX,
+    ) -> LoadEvent:
+        """Scripted prefix-advertisement seam (same no-RNG contract as
+        ``emit_adjacency``)."""
+        if db is not None:
+            self.prefix_dbs[node] = db
+        seq = self._seq
+        self._seq += 1
+        key = keyutil.prefix_db_key(node)
+        v = self.versions[key] = self.versions.get(key, 0) + 1
+        return LoadEvent(
+            seq=seq,
+            kind=kind,
+            node=node,
+            key=key,
+            payload=wire.dumps(self.prefix_dbs[node]),
+            version=v,
+        )
 
     # -- kinds ------------------------------------------------------------
 
@@ -209,6 +269,39 @@ class LoadGenerator:
         self.adj_dbs[node] = replace(db, adjacencies=tuple(adjs))
         self._down.append((node, adj))
         return self._emit_adj(seq, KIND_FLAP, node)
+
+    def _drain_flip(self, seq: int) -> LoadEvent:
+        """Drain/undrain: toggle ``is_overloaded`` on one node's
+        adjacency database. An undrain is preferred when any node is
+        drained and the coin lands that way (mirror of the flap
+        restore discipline), and a node is never drained if that would
+        leave zero undrained nodes — an all-overloaded fabric has no
+        transit path at all, which would make parity timing-dependent
+        the same way an isolated originator would."""
+        drained = sorted(
+            n for n, db in self.adj_dbs.items() if db.is_overloaded
+        )
+        undrain = bool(drained) and self._rng.random() < 0.5
+        if undrain:
+            node = drained[
+                int(self._rng.random() * len(drained)) % len(drained)
+            ]
+            self.adj_dbs[node] = replace(
+                self.adj_dbs[node], is_overloaded=False
+            )
+            return self._emit_adj(seq, KIND_DRAIN, node)
+        candidates = sorted(
+            n for n, db in self.adj_dbs.items() if not db.is_overloaded
+        )
+        if len(candidates) <= 1:
+            return self._metric_churn(seq)
+        node = candidates[
+            int(self._rng.random() * len(candidates)) % len(candidates)
+        ]
+        self.adj_dbs[node] = replace(
+            self.adj_dbs[node], is_overloaded=True
+        )
+        return self._emit_adj(seq, KIND_DRAIN, node)
 
     def _prefix_update(self, seq: int) -> LoadEvent:
         nodes = sorted(self.prefix_dbs)

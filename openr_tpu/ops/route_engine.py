@@ -2252,6 +2252,157 @@ def _sharded_grouped_churn_step(
     )
 
 
+@functools.partial(
+    jax.jit, static_argnames=("meta", "n", "max_jumps")
+)
+def _grouped_frontier_probe(
+    v_t, w_t, dr, e_u, e_v, e_w_old, e_w_new, cell_limit, meta, n,
+    max_jumps,
+):
+    """Grouped frontier probe: the affected-cone expansion over the
+    full resident DR and the PRE-patch segment slabs
+    (sg._grouped_cone_expand) — the grouped twin of _frontier_probe,
+    returning the same resident cone + 4-float meta
+    [rows, cells, jumps, converged] policy row."""
+    cone, rows, cells, jumps, ok = sg._grouped_cone_expand(
+        dr, meta, v_t, w_t, e_u, e_v, e_w_old, e_w_new, max_jumps,
+        cell_limit=cell_limit[0],
+    )
+    meta_row = jnp.stack(
+        [rows.astype(jnp.float32), cells,
+         jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+    )
+    return cone, meta_row
+
+
+@functools.partial(
+    jax.jit, static_argnames=("meta", "n", "impl")
+)
+def _grouped_frontier_step(
+    v_t, w_t, cone, dr, overloaded, samp_ids, samp_v, samp_w, pos_w,
+    meta, n, impl,
+):
+    """Grouped frontier re-solve: full-width WARM fixed point through
+    the gather-free grouped relaxation over the PATCHED segments, cone
+    cells seeded at INF, every other cell keeping its resident
+    distance — the grouped twin of _frontier_step, with the identical
+    extraction/packing so the product stays bit-identical to the cold
+    grouped build. Residents are NOT donated (retry-ladder hazard
+    rule)."""
+    t_ids = jnp.arange(n, dtype=jnp.int32)
+    warm0 = jnp.where(cone, INF, dr)
+    dr2 = sg._grouped_fixed_point(
+        meta, v_t, w_t, overloaded, t_ids, n, reverse=True, impl=impl,
+        init=warm0,
+    )
+    nh_count = sg._grouped_nh_counts(
+        dr2, meta, v_t, w_t, overloaded, t_ids
+    )
+    d_s, packed_mask = rs._sample_stats(
+        dr2, samp_ids, samp_v, samp_w, overloaded, t_ids
+    )
+    digests, packed = _pack_product(
+        dr2, nh_count, d_s, packed_mask, pos_w
+    )
+    return dr2, digests, packed
+
+
+@functools.partial(
+    jax.jit, static_argnames=("meta", "n", "max_jumps", "mesh")
+)
+def _sharded_grouped_frontier_probe(
+    v_t, w_t, dr, e_u, e_v, e_w_old, e_w_new, cell_limit, meta, n,
+    max_jumps, mesh,
+):
+    """Sharded grouped frontier probe: each shard expands the cone
+    over its own resident DR rows with the counters and growth bit
+    psum-voted (device-invariant meta, replicated), the cone staying
+    row-sharded for _sharded_grouped_frontier_step — same contract as
+    _sharded_frontier_probe."""
+    nseg = len(v_t)
+
+    def shard_fn(dr_s, *rest):
+        v_r = rest[:nseg]
+        w_r = rest[nseg : 2 * nseg]
+        e_u_r, e_v_r, e_wo_r, e_wn_r, lim_r = rest[2 * nseg :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        cone, rows, cells, jumps, ok = sg._grouped_cone_expand(
+            dr_s, meta, v_r, w_r, e_u_r, e_v_r, e_wo_r, e_wn_r,
+            max_jumps, vote=vote, cell_limit=lim_r[0],
+        )
+        meta_row = jnp.stack(
+            [rows.astype(jnp.float32), cells,
+             jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+        )
+        return cone, meta_row
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS, None)]
+            + [P(None, None)] * nseg
+            + [P(None, None, None)] * nseg
+            + [P(None)] * 5
+        ),
+        out_specs=(P(SOURCES_AXIS, None), P(None)),
+    )(dr, *v_t, *w_t, e_u, e_v, e_w_old, e_w_new, cell_limit)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("meta", "n", "mesh", "impl")
+)
+def _sharded_grouped_frontier_step(
+    v_t, w_t, cone, dr, overloaded, samp_ids, samp_v, samp_w, pos_w,
+    meta, n, mesh, impl,
+):
+    """Sharded grouped frontier re-solve over the PATCHED (replicated)
+    segment tensors, each shard warm-seeding its own DR rows outside
+    its cone shard; the convergence vote is the only collective."""
+    nseg = len(v_t)
+
+    def shard_fn(t_blk, cone_s, dr_s, *rest):
+        v_r = rest[:nseg]
+        w_r = rest[nseg : 2 * nseg]
+        ov_r, sid_r, sv_r, sw_r, pw_r = rest[2 * nseg :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        warm0 = jnp.where(cone_s, INF, dr_s)
+        dr2 = sg._grouped_fixed_point(
+            meta, v_r, w_r, ov_r, t_blk, n, reverse=True, vote=vote,
+            impl=impl, init=warm0,
+        )
+        nh_count = sg._grouped_nh_counts(
+            dr2, meta, v_r, w_r, ov_r, t_blk
+        )
+        d_s, packed_mask = rs._sample_stats(
+            dr2, sid_r, sv_r, sw_r, ov_r, t_blk
+        )
+        digests, packed = _pack_product(
+            dr2, nh_count, d_s, packed_mask, pw_r
+        )
+        return dr2, digests, packed
+
+    return shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS), P(SOURCES_AXIS, None),
+             P(SOURCES_AXIS, None)]
+            + [P(None, None)] * nseg
+            + [P(None, None, None)] * nseg
+            + [P(None), P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+        ),
+    )(
+        jnp.arange(n, dtype=jnp.int32), cone, dr, *v_t, *w_t,
+        overloaded, samp_ids, samp_v, samp_w, pos_w,
+    )
+
+
 class GroupedRouteSweepEngine(RouteSweepEngine):
     """The incremental engine over the GROUPED (block-bipartite)
     relaxation backend — the gather-free flagship compute path
@@ -2457,9 +2608,51 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
 
     @solve_window
     def _dispatch_frontier_probe(self, ctx, e_dev, limit):
-        """No frontier kernel for the grouped backend yet: the cone
-        expansion walks per-band ELL slots, while this backend stores
-        block-bipartite segments. Returning None makes every grouped
-        overflow ride the full-width refresh (counted in
-        ops.frontier_fallbacks) — correctness is unaffected."""
-        return None
+        """Grouped frontier probe: the dense cone expansion over the
+        [G, S, R] segment slabs (sg._grouped_cone_expand) against the
+        PRE-patch resident tensors — same ordering contract as the ELL
+        hook (nothing commits before _apply_patch_resident, so the
+        resident w_t/_dr this reads are the pre-event ones)."""
+        e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        lim = jnp.asarray([limit], dtype=jnp.float32)
+        if self.plan is not None:
+            lim = self.plan.replicate(lim)
+        if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip frontier
+            # probe (mesh is None): no mesh axis to spec
+            return _grouped_frontier_probe(
+                self.sweeper.v_t, self.sweeper.w_t, self._dr,
+                e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+                self.sweeper.meta, self.graph.n_pad,
+                _FRONTIER_MAX_JUMPS,
+            )
+        return _sharded_grouped_frontier_probe(
+            self.sweeper.v_t, self.sweeper.w_t, self._dr,
+            e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+            self.sweeper.meta, self.graph.n_pad,
+            _FRONTIER_MAX_JUMPS, self.mesh,
+        )
+
+    @solve_window
+    def _frontier_resident(self, cone):
+        """Grouped masked full-width dispatch: warm fixed point with
+        only cone cells reset, over the ALREADY-PATCHED resident
+        segment tensors (_apply_patch_resident ran)."""
+        impl = sg.get_grouped_impl()
+        if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip frontier
+            # re-solve (mesh is None): no mesh axis to spec
+            return _grouped_frontier_step(
+                self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+                self.sweeper.overloaded,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                self.sweeper.meta, self.graph.n_pad, impl,
+            )
+        return _sharded_grouped_frontier_step(
+            self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+            self.sweeper.overloaded,
+            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+            self.sweeper.meta, self.graph.n_pad, self.mesh, impl,
+        )

@@ -403,19 +403,24 @@ def _grouped_relax(d, meta, srcs_t, ws_t, overloaded, t_ids,
 
 def _grouped_fixed_point(
     meta, srcs_t, ws_t, overloaded, ids, n, reverse, vote=None,
-    impl="jnp",
+    impl="jnp", init=None,
 ):
     """Distance fixed point from unit init. ``reverse=False``: rows are
     SOURCES (forward all-sources; init = one unmasked relax so an
     overloaded source still originates). ``reverse=True``: rows are
     DESTINATIONS (route-sweep orientation; the per-row mask needs no
-    init special case)."""
+    init special case). ``init`` (reverse only) warm-seeds rows with a
+    pointwise upper bound on the new fixed point — the unit anchor is
+    min-ed in, and the int32 min-relaxation's unique fixed point keeps
+    the result bit-identical to the cold solve (the same contract as
+    route_sweep._rev_fixed_point)."""
     b = ids.shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(b), ids].set(0)
     if reverse:
-        d0 = unit
+        d0 = unit if init is None else jnp.minimum(init, unit)
     else:
+        assert init is None, "warm seed is a reverse-sweep contract"
         no_overload = jnp.zeros_like(overloaded)
         d0 = _grouped_relax(
             unit, meta, srcs_t, ws_t, no_overload, None, impl=impl
@@ -512,6 +517,112 @@ def _grouped_nh_counts(dr, meta, srcs_t, ws_t, overloaded, t_ids):
         pos += rows
     parts.append(jnp.zeros_like(dr[:, pos:]))
     return jnp.concatenate(parts, axis=1)
+
+
+def _grouped_cone_expand(sel_dr, meta, srcs_t, ws_t, e_u, e_v, e_w_old,
+                         e_w_new, max_jumps, vote=None, cell_limit=None):
+    """Affected-cone mask for a weight-increase delta over the GROUPED
+    segment slabs — the dense mirror of route_sweep._cone_expand (same
+    seed, same growth semantics, same counters), walking each band's
+    ``[G, S, R]`` segments instead of per-row ELL slots. Seed: cells u
+    where an increased edge (u -> v, w_old) was tight (edge-list based,
+    layout-independent). Grow: cell j joins when any RAW-tight segment
+    slot of j (old weights, resident distances) reaches a cone cell —
+    the per-segment tight test is the same [B, G, S, R] algebra as
+    _grouped_nh_counts, joined against ``cone[:, src]`` and landed back
+    on the band grid (axis-2 segments transpose in, exactly like
+    _grouped_relax). Tightness on RAW weights over-approximates — extra
+    resets stay bit-identical by the unique-fixed-point squeeze. INF
+    cells can never rise and are excluded.
+
+    Returns ``(cone [B, N] bool, rows, cells, jumps, converged)`` with
+    the identical contract as the ELL kernel: ``converged`` False on a
+    ``max_jumps`` cutoff or ``cell_limit`` overflow (the cone is then
+    an under-approximation and the caller must fall back), and
+    ``vote`` psum-lifts the counters/growth bit for sharded callers."""
+    b = sel_dr.shape[0]
+    live = sel_dr < INF
+    inc_e = (e_w_new > e_w_old) & (e_w_old < INF)
+    seed_tight = (
+        (sel_dr[:, e_u]
+         == jnp.minimum(e_w_old[None, :] + sel_dr[:, e_v], INF))
+        & inc_e[None, :]
+        & live[:, e_u]
+    )  # [B, E]
+    cone0 = (
+        jnp.zeros(sel_dr.shape, dtype=jnp.int32)
+        .at[:, e_u].max(seed_tight.astype(jnp.int32))
+    ) > 0
+
+    def count(cone):
+        rows = jnp.sum(jnp.any(cone, axis=1), dtype=jnp.int32)
+        cells = jnp.sum(cone, dtype=jnp.float32)
+        if vote is None:
+            return rows, cells
+        return vote(rows), vote(cells)
+
+    def grow(cone):
+        parts = []
+        pos = 0
+        si = 0
+        for band in meta:
+            rows = band.g1 * band.g2
+            joined = jnp.zeros((b, rows), dtype=bool)
+            d_grid = sel_dr[:, pos : pos + rows].reshape(
+                b, band.g1, band.g2
+            )
+            for axis in band.seg_axes:
+                src = srcs_t[si]
+                w = ws_t[si]
+                si += 1
+                d_g = d_grid if axis == 1 else jnp.transpose(
+                    d_grid, (0, 2, 1)
+                )  # [B, G, R]
+                gath = sel_dr[:, src]  # [B, G, S]
+                total = jnp.minimum(
+                    gath[:, :, :, None] + w[None], INF
+                )  # [B, G, S, R]
+                tight = (
+                    (total == d_g[:, :, None, :])
+                    & (d_g < INF)[:, :, None, :]
+                    & (w < INF)[None]
+                )
+                j = jnp.any(
+                    tight & cone[:, src][:, :, :, None], axis=2
+                )  # [B, G, R]
+                if axis == 2:
+                    j = jnp.transpose(j, (0, 2, 1))
+                joined = joined | j.reshape(b, rows)
+            parts.append(joined)
+            pos += rows
+        parts.append(jnp.zeros_like(cone[:, pos:]))
+        return cone | jnp.concatenate(parts, axis=1)
+
+    def cond(state):
+        _, _, cells, it, grew = state
+        keep = jnp.logical_and(grew > 0, it < max_jumps)
+        if cell_limit is not None:
+            keep = jnp.logical_and(keep, cells <= cell_limit)
+        return keep
+
+    def body(state):
+        cone, _, _, it, _ = state
+        nxt = grow(cone)
+        grew_local = jnp.any(nxt & ~cone).astype(jnp.int32)
+        grew = grew_local if vote is None else vote(grew_local)
+        rows, cells = count(nxt)
+        return nxt, rows, cells, it + 1, grew
+
+    rows0, cells0 = count(cone0)
+    cone, rows, cells, jumps, grew = jax.lax.while_loop(
+        cond, body,
+        (cone0, rows0, cells0, jnp.int32(0),
+         (cells0 > 0).astype(jnp.int32)),
+    )
+    converged = grew == 0
+    if cell_limit is not None:
+        converged = jnp.logical_and(converged, cells <= cell_limit)
+    return cone, rows, cells, jumps, converged
 
 
 def _grouped_route_block_body(

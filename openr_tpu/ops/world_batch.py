@@ -118,6 +118,8 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "rehydrations",  # host-snapshot -> warm resident promotions
         "bucket_compiles",    # distinct shape buckets materialized
         "bucket_migrations",  # tenant moved between shape buckets
+        "graph_shares",       # vantage-view packing: shared-graph reuses
+        "override_solves",    # per-vantage override syncs (forced cold)
         "warm_solves",   # tenant solves seeded from previous distances
         "cold_solves",   # tenant solves from the forced-reset sentinel
         "dispatches",    # batched device dispatches (one per bucket)
@@ -158,7 +160,7 @@ class TenantWorld:
         "tenant_id", "ls_ref", "root", "graph", "version", "srcs",
         "packed_host", "pending_edges", "pending_rows", "ov_solved",
         "pending_structural", "force_reset", "needs_solve", "solved",
-        "slot", "bucket", "last_used", "srcs_dirty",
+        "slot", "bucket", "last_used", "srcs_dirty", "override",
     )
 
     def __init__(self, tenant_id: str, ls, root: str,
@@ -183,6 +185,9 @@ class TenantWorld:
         self.bucket: Optional["WorldBucket"] = None
         self.last_used = 0
         self.srcs_dirty = True
+        # vantage-local overload view ({node: overloaded}); empty =
+        # the tenant sees the shared LSDB truth
+        self.override: Dict[str, bool] = {}
 
     @property
     def dims(self) -> Tuple[int, int, int]:
@@ -281,6 +286,17 @@ class WorldManager(ResidentEngineContract):
         self.max_resident = max(1, max_resident)
         self._buckets: Dict[Tuple[int, int, int], WorldBucket] = {}
         self._tenants: Dict[str, TenantWorld] = {}
+        # vantage-view packing: tenants viewing the SAME LinkState share
+        # one compiled EllGraph (and one journaled patch per version
+        # transition) instead of paying compile_ell/ell_patch N times —
+        # the fleet-twin admission path. Weakly keyed so a dead
+        # LinkState never pins its graphs.
+        self._graph_share: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
+        self._patch_share: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary()
+        )
         self._clock = 0
         self._corrupt_events = 0
         get_auditor().register(self)
@@ -289,14 +305,19 @@ class WorldManager(ResidentEngineContract):
 
     def solve_views(self, items) -> List[Tuple]:
         """Sync + batch-solve a set of tenants in as few dispatches as
-        buckets allow. ``items``: [(tenant_id, ls, root)]; returns the
+        buckets allow. ``items``: [(tenant_id, ls, root)] — or
+        4-tuples [(tenant_id, ls, root, override)] where ``override``
+        is a vantage-local {node: overloaded} view layered over the
+        shared LSDB (the twin's per-node what-if seam). Returns the
         aligned [(graph, srcs, packed [2b, n_pad])] views. More
         requested tenants than a bucket has slots are solved in waves
         (each wave fills the bucket, solves, and yields its slots to
         the next — eviction/rehydration do the bookkeeping)."""
-        tenants = [
-            self._sync(tid, ls, root) for tid, ls, root in items
-        ]
+        tenants = []
+        for item in items:
+            tid, ls, root = item[0], item[1], item[2]
+            override = item[3] if len(item) > 3 else None
+            tenants.append(self._sync(tid, ls, root, override))
         pending = [t for t in tenants if t.needs_solve]
         waves = 0
         recoveries = 0
@@ -335,8 +356,9 @@ class WorldManager(ResidentEngineContract):
             self.corrupt_resident(self._corrupt_events)
         return [t.view() for t in tenants]
 
-    def solve_view(self, tenant_id: str, ls, root: str):
-        return self.solve_views([(tenant_id, ls, root)])[0]
+    def solve_view(self, tenant_id: str, ls, root: str,
+                   override: Optional[Dict[str, bool]] = None):
+        return self.solve_views([(tenant_id, ls, root, override)])[0]
 
     def drop(self, tenant_id: str) -> None:
         t = self._tenants.pop(tenant_id, None)
@@ -350,6 +372,8 @@ class WorldManager(ResidentEngineContract):
         dispatch may leak into the recovered state)."""
         self._buckets = {}
         self._tenants = {}
+        self._graph_share = weakref.WeakKeyDictionary()
+        self._patch_share = weakref.WeakKeyDictionary()
         self._update_gauges()
 
     def _recover_device_loss(self) -> None:
@@ -378,7 +402,8 @@ class WorldManager(ResidentEngineContract):
 
     # -- sync / journal ----------------------------------------------------
 
-    def _sync(self, tenant_id: str, ls, root: str) -> TenantWorld:
+    def _sync(self, tenant_id: str, ls, root: str,
+              override: Optional[Dict[str, bool]] = None) -> TenantWorld:
         self._clock += 1
         t = self._tenants.get(tenant_id)
         if t is not None and (t.ls_ref() is not ls or t.root != root):
@@ -387,7 +412,7 @@ class WorldManager(ResidentEngineContract):
             self.drop(tenant_id)
             t = None
         if t is None:
-            graph = compile_ell(ls)
+            graph = self._shared_graph(ls)
             t = TenantWorld(
                 tenant_id, ls, root, graph,
                 ell_source_batch(graph, ls, root),
@@ -395,22 +420,18 @@ class WorldManager(ResidentEngineContract):
             self._tenants[tenant_id] = t
             TENANCY_COUNTERS["admissions"] += 1
         elif t.version != ls.topology_version:
-            affected = ls.affected_since(t.version)
-            patched = (
-                ell_patch(t.graph, ls, sorted(affected), widen=True)
-                if affected is not None
-                else None
-            )
-            if patched is None:
+            shared = self._shared_patched(t, ls)
+            if shared is None:
                 # journal gap or node-set change: recompile from the
                 # LinkState; numbering may move, so the old mirror and
                 # journal are unusable — cold solve
-                graph = compile_ell(ls)
+                graph = self._shared_graph(ls)
                 self._reset_world(
                     t, graph, ell_source_batch(graph, ls, root)
                 )
             else:
-                self._apply_patch(t, patched)
+                patched, stripped = shared
+                self._apply_patch(t, patched, stripped)
                 srcs = ell_source_batch(t.graph, ls, root)
                 if srcs != t.srcs:
                     # the source batch moved (neighbor set churn):
@@ -422,8 +443,117 @@ class WorldManager(ResidentEngineContract):
                     t.force_reset = True
             t.version = ls.topology_version
             t.needs_solve = True
+        self._apply_override(t, ls, override)
         t.last_used = self._clock
         return t
+
+    # -- vantage-view packing ----------------------------------------------
+
+    def _shared_graph(self, ls) -> EllGraph:
+        """Version-current compiled EllGraph for ``ls``, shared across
+        every tenant viewing the same world: a fleet twin admitting N
+        vantages pays ONE ``compile_ell``, and the shared object
+        identity is what lets ``_shared_patched`` share the per-version
+        patch across those tenants afterwards."""
+        entry = self._graph_share.get(ls)
+        if entry is not None and entry[0] == ls.topology_version:
+            TENANCY_COUNTERS["graph_shares"] += 1
+            return entry[1]
+        graph = compile_ell(ls)
+        self._graph_share[ls] = (ls.topology_version, graph)
+        return graph
+
+    def _shared_patched(self, t: TenantWorld, ls):
+        """One journaled ``ell_patch`` per (ls, version transition,
+        base graph), shared by every tenant whose graph IS that base —
+        the common fleet case where all vantages sync in lockstep.
+        Returns ``(patched, stripped)`` (with/without the ``changed``
+        row map) or None when the journal has a gap or the node set
+        moved (caller recompiles via ``_shared_graph``). The stripped
+        twin is cached alongside so sharing tenants land on the SAME
+        object identity and keep hitting this cache next transition."""
+        entries = self._patch_share.get(ls)
+        if entries:
+            for fv, tv, base, patched, stripped in entries:
+                if (
+                    fv == t.version
+                    and tv == ls.topology_version
+                    and base is t.graph
+                ):
+                    TENANCY_COUNTERS["graph_shares"] += 1
+                    return patched, stripped
+        affected = ls.affected_since(t.version)
+        patched = (
+            ell_patch(t.graph, ls, sorted(affected), widen=True)
+            if affected is not None
+            else None
+        )
+        if patched is None:
+            return None
+        stripped = _replace(patched, changed=None)
+        # bounded FIFO per ls: staggered fleets (vantages at mixed
+        # versions) keep a few transitions live without thrash
+        entries = list(entries or [])[-3:]
+        entries.append(
+            (t.version, ls.topology_version, t.graph, patched, stripped)
+        )
+        self._patch_share[ls] = entries
+        return patched, stripped
+
+    def _base_overloaded(self, t: TenantWorld, ls) -> np.ndarray:
+        """The ls-truth overload vector in ``t.graph``'s numbering —
+        the baseline per-vantage overrides fold into (and restore
+        from)."""
+        entry = self._graph_share.get(ls)
+        if (
+            entry is not None
+            and entry[0] == ls.topology_version
+            and len(entry[1].overloaded) == len(t.graph.overloaded)
+        ):
+            return np.array(entry[1].overloaded, copy=True)
+        adj = ls.get_adjacency_databases()
+        base = np.array(t.graph.overloaded, copy=True)
+        for node, i in t.graph.node_index.items():
+            db = adj.get(node)
+            if db is not None and i < len(base):
+                base[i] = bool(db.is_overloaded)
+        return base
+
+    def _apply_override(self, t: TenantWorld, ls,
+                        override: Optional[Dict[str, bool]]) -> None:
+        """Per-node override: a vantage-local overload view layered
+        over the shared LSDB (the twin's what-if drain seam). A tenant
+        with an active override always solves via the forced-reset
+        sentinel — same executable, same dispatch wave, never a
+        retrace — because the warm-start journal argues soundness
+        against the SHARED overload state, which an override
+        deliberately diverges from."""
+        ov_map = {str(k): bool(v) for k, v in (override or {}).items()}
+        changed = ov_map != t.override
+        if changed:
+            t.override = ov_map
+            t.needs_solve = True
+        if not ov_map and not changed:
+            return
+        ov = self._base_overloaded(t, ls)
+        idx = t.graph.node_index
+        for node, flag in ov_map.items():
+            i = idx.get(node)
+            if i is not None and i < len(ov):
+                ov[i] = flag
+        if not np.array_equal(ov, np.asarray(t.graph.overloaded)):
+            t.graph = _replace(t.graph, overloaded=ov)
+            if t.slot is not None and t.bucket is not None:
+                full = np.zeros(t.bucket.n, dtype=bool)
+                full[: len(ov)] = ov
+                t.bucket.ov_dev = _slot_set(
+                    t.bucket.ov_dev, np.int32(t.slot), full
+                )
+        # overridden OR just-restored state: the journal cannot vouch
+        # for either transition, so the next solve is cold
+        t.force_reset = True
+        if t.needs_solve:
+            TENANCY_COUNTERS["override_solves"] += 1
 
     def _reset_world(self, t: TenantWorld, graph: EllGraph,
                      srcs: List[int]) -> None:
@@ -441,7 +571,8 @@ class WorldManager(ResidentEngineContract):
         if t.slot is not None and t.dims != old_dims:
             self._detach(t)
 
-    def _apply_patch(self, t: TenantWorld, patched: EllGraph) -> None:
+    def _apply_patch(self, t: TenantWorld, patched: EllGraph,
+                     stripped: Optional[EllGraph] = None) -> None:
         ov_changed = not np.array_equal(
             t.graph.overloaded, patched.overloaded
         )
@@ -452,7 +583,12 @@ class WorldManager(ResidentEngineContract):
             for r in np.asarray(rs)
         )
         old_dims = t.dims
-        t.graph = _replace(patched, changed=None)
+        # the caller-provided stripped twin keeps same-ls tenants on
+        # ONE graph object (vantage-view packing's identity contract)
+        t.graph = (
+            stripped if stripped is not None
+            else _replace(patched, changed=None)
+        )
         # changed rows go STALE on device and ride the next fused
         # dispatch as in-kernel scatter operands (placement's full
         # re-pack subsumes them for non-residents and migrants)

@@ -20,6 +20,8 @@ lock + dict add; a histogram observation is a lock + ring append.
 from __future__ import annotations
 
 import threading
+import time
+from contextlib import contextmanager
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Union
 
 from collections.abc import MutableMapping
@@ -142,6 +144,18 @@ class Registry:
 
     def observe(self, name: str, value: float) -> None:
         self.histogram(name).observe(value)
+
+    @contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        """Context manager observing the block's wall-clock into the
+        ``name`` histogram in milliseconds — the one-liner the fleet
+        twin's converge waves (and any future timed section) use
+        instead of hand-rolled perf_counter bookkeeping."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, (time.perf_counter() - t0) * 1000.0)
 
     def histograms(self) -> Dict[str, Histogram]:
         with self._lock:

@@ -1,0 +1,32 @@
+"""Whole-network digital twin: every node's control plane, one device.
+
+``FabricTwin`` models an N-node fabric as one batched tenant world
+per vantage over one shared LSDB (one compiled graph, one journaled
+patch, one dispatch wave per event); ``ScenarioDriver`` scripts the
+operational sequences (flaps, drains, partitions, rolling restarts)
+on top of seeded background load; ``analyze_fleet`` walks next hops
+across vantages for micro-loops and transient blackholes.
+"""
+
+from openr_tpu.twin.analyzer import (
+    KIND_BLACKHOLE,
+    KIND_MICRO_LOOP,
+    Finding,
+    FleetReport,
+    analyze_fleet,
+)
+from openr_tpu.twin.fabric import FabricTwin
+from openr_tpu.twin.metrics import TWIN_COUNTERS
+from openr_tpu.twin.scenario import FAULT_TWIN_INJECT, ScenarioDriver
+
+__all__ = [
+    "FabricTwin",
+    "ScenarioDriver",
+    "FleetReport",
+    "Finding",
+    "analyze_fleet",
+    "FAULT_TWIN_INJECT",
+    "TWIN_COUNTERS",
+    "KIND_MICRO_LOOP",
+    "KIND_BLACKHOLE",
+]

@@ -1,0 +1,229 @@
+"""Fleet analyzer: walk next-hops across vantages to find the two
+reconvergence pathologies no single-daemon view can see.
+
+Given every vantage's computed RIB over ONE shared LSDB snapshot, each
+destination prefix induces a directed forwarding graph: vantage ``v``
+points at the neighbor nodes named by its ECMP next hops for that
+prefix. Two defect classes fall out of walking it:
+
+- **micro-loop** — a cycle in the per-prefix forwarding graph. On a
+  fully converged fleet this cannot happen (every next hop strictly
+  decreases the shared SPF distance), so a cycle is the signature of
+  *mixed-epoch* tables: some vantages re-solved after an event while
+  others still forward on the pre-event snapshot.
+- **transient blackhole** — a vantage that should be able to deliver
+  but drops instead: it has no route for a prefix that is reachable
+  from it in the current topology (stale table missing a fresh
+  advertisement), or its next hop names a neighbor the current
+  topology no longer connects it to (fresh withdrawal, stale route —
+  the packet dies on the dead link).
+
+Reachability is judged on the CURRENT LinkState: bidirectional up
+links only, and overloaded (drained) nodes do not transit — matching
+the SPF semantics the route tables themselves were built under. A
+prefix that is genuinely unreachable from a vantage is NOT a
+blackhole; the analyzer only flags deliverable traffic that a
+mixed-epoch fleet would drop or spin.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from openr_tpu.twin.metrics import TWIN_COUNTERS
+
+KIND_MICRO_LOOP = "micro_loop"
+KIND_BLACKHOLE = "blackhole"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect: the prefix it affects and the walk that exhibits it
+    (a cycle for micro-loops; ``(vantage,)`` for a missing route or
+    ``(vantage, dead_next_hop)`` for a stale next hop)."""
+
+    kind: str
+    prefix: str
+    path: Tuple[str, ...]
+
+
+@dataclass
+class FleetReport:
+    """One analyzer pass over the fleet's route tables."""
+
+    findings: List[Finding] = field(default_factory=list)
+    prefixes: int = 0
+    vantages: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def loops(self) -> List[Finding]:
+        return [f for f in self.findings if f.kind == KIND_MICRO_LOOP]
+
+    def blackholes(self) -> List[Finding]:
+        return [f for f in self.findings if f.kind == KIND_BLACKHOLE]
+
+    def to_dict(self) -> dict:
+        return {
+            "clean": self.clean,
+            "prefixes": self.prefixes,
+            "vantages": self.vantages,
+            "micro_loops": len(self.loops()),
+            "blackholes": len(self.blackholes()),
+            "findings": [
+                {"kind": f.kind, "prefix": f.prefix, "path": list(f.path)}
+                for f in self.findings
+            ],
+        }
+
+
+def _up_neighbors(ls) -> Dict[str, Set[str]]:
+    """Current bidirectional up-link neighbor sets per node."""
+    return {
+        n: {
+            link.other_node(n)
+            for link in ls.links_from_node(n)
+            if link.is_up()
+        }
+        for n in ls.nodes()
+    }
+
+
+def _reachable_to(
+    dsts: Set[str],
+    neighbors: Dict[str, Set[str]],
+    overloaded: Dict[str, bool],
+) -> Set[str]:
+    """Nodes with SOME deliverable path to any node in ``dsts`` over
+    the current topology: links are symmetric, and an overloaded node
+    may source or sink traffic but never transit (the SPF overload
+    contract)."""
+    seen = {d for d in dsts if d in neighbors}
+    queue = deque(seen)
+    while queue:
+        u = queue.popleft()
+        if u not in dsts and overloaded.get(u):
+            continue  # drained: no transit through it
+        for v in neighbors.get(u, ()):
+            if v not in seen:
+                seen.add(v)
+                queue.append(v)
+    return seen
+
+
+def analyze_fleet(
+    route_dbs: Dict[str, object],
+    ls,
+    prefix_state,
+    vantages: Optional[Sequence[str]] = None,
+) -> FleetReport:
+    """Walk every (prefix, vantage) forwarding graph and report
+    micro-loops and transient blackholes. ``route_dbs`` maps vantage
+    name -> DecisionRouteDb (mixed epochs allowed — that is the
+    point); ``ls``/``prefix_state`` are the CURRENT shared truth the
+    walks are judged against."""
+    names = sorted(route_dbs) if vantages is None else sorted(vantages)
+    neighbors = _up_neighbors(ls)
+    overloaded = {n: ls.is_node_overloaded(n) for n in ls.nodes()}
+    findings: List[Finding] = []
+    prefix_map = prefix_state.prefixes()
+    for prefix in sorted(prefix_map, key=lambda p: p.to_str()):
+        pstr = prefix.to_str()
+        advertisers = {na[0] for na in prefix_map[prefix]}
+        deliverable = _reachable_to(advertisers, neighbors, overloaded)
+        succ: Dict[str, Set[str]] = {}
+        for v in names:
+            if v in advertisers:
+                continue  # delivers locally
+            db = route_dbs.get(v)
+            entry = (
+                db.unicast_routes.get(prefix) if db is not None else None
+            )
+            hops = (
+                {
+                    nh.neighbor_node_name
+                    for nh in entry.nexthops
+                    if nh.neighbor_node_name
+                }
+                if entry is not None
+                else set()
+            )
+            if not hops:
+                if v in deliverable:
+                    # stale table missing a deliverable prefix
+                    findings.append(
+                        Finding(KIND_BLACKHOLE, pstr, (v,))
+                    )
+                continue
+            for u in sorted(hops):
+                if u not in neighbors.get(v, ()):
+                    # stale next hop over a now-dead link
+                    findings.append(
+                        Finding(KIND_BLACKHOLE, pstr, (v, u))
+                    )
+            succ[v] = {u for u in hops if u in neighbors.get(v, ())}
+        findings.extend(
+            Finding(KIND_MICRO_LOOP, pstr, cycle)
+            for cycle in _cycles(names, succ, advertisers)
+        )
+    TWIN_COUNTERS["analyses"] += 1
+    TWIN_COUNTERS["loops_found"] += sum(
+        1 for f in findings if f.kind == KIND_MICRO_LOOP
+    )
+    TWIN_COUNTERS["blackholes_found"] += sum(
+        1 for f in findings if f.kind == KIND_BLACKHOLE
+    )
+    return FleetReport(
+        findings=findings,
+        prefixes=len(prefix_map),
+        vantages=len(names),
+    )
+
+
+def _cycles(
+    names: Sequence[str],
+    succ: Dict[str, Set[str]],
+    advertisers: Set[str],
+) -> List[Tuple[str, ...]]:
+    """Cycles in one prefix's forwarding graph (iterative colored DFS;
+    a walk reaching an advertiser has delivered and stops). Each
+    distinct cycle node-set reports once."""
+    color: Dict[str, int] = {}  # 1 = on stack, 2 = done
+    out: List[Tuple[str, ...]] = []
+    seen_cycles: Set[frozenset] = set()
+    for start in names:
+        if color.get(start) or start in advertisers:
+            continue
+        color[start] = 1
+        path = [start]
+        stack = [(start, iter(sorted(succ.get(start, ()))))]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt in advertisers:
+                    continue  # delivered
+                c = color.get(nxt, 0)
+                if c == 1:
+                    cycle = tuple(path[path.index(nxt):]) + (nxt,)
+                    key = frozenset(cycle)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cycle)
+                elif c == 0:
+                    color[nxt] = 1
+                    path.append(nxt)
+                    stack.append(
+                        (nxt, iter(sorted(succ.get(nxt, ()))))
+                    )
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = 2
+                stack.pop()
+                path.pop()
+    return out

@@ -1,0 +1,254 @@
+"""Whole-network digital twin: every node's control plane, one device.
+
+A real Open/R deployment is N daemons each running Decision over
+nearly the same flooded LSDB from their own vantage. ``FabricTwin``
+models that fleet as ONE batched world per node on the tenant plane
+(``ops.world_batch``):
+
+- all vantages share the flooded structure — one ``LinkState`` +
+  ``PrefixState``, one compiled ``EllGraph`` (the manager's
+  vantage-view packing shares compile and patch across same-ls
+  tenants), one journaled patch per injected event;
+- vantages differ only in their source batch ({self} + neighbors) and
+  optional vantage-local overload overrides (what-if drains);
+- each injected event re-solves the whole fleet as one
+  ``world_dispatch`` wave (zero retraces after fleet warmup — every
+  vantage rides the same bucket executable), and the per-vantage
+  views fan into ``decision.spf_solver.fleet_preload_views`` so the N
+  ``build_route_db`` calls consume them with zero further device work.
+
+On top of the solved per-node tables, ``twin.analyzer`` walks
+next-hops across vantages for micro-loops and transient blackholes,
+and ``twin.scenario`` scripts the event sequences (flaps, churn,
+drain sequencing, partitions, rolling restarts) no single-daemon test
+can express.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import replace as _dc_replace
+from typing import Dict, List, Optional, Sequence
+
+from openr_tpu.decision.prefix_state import PrefixState
+from openr_tpu.decision.rib import DecisionRouteDb
+from openr_tpu.decision.spf_solver import SpfSolver, fleet_preload_views
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.load.generator import LoadEvent
+from openr_tpu.models.topologies import Topology
+from openr_tpu.ops.world_batch import WorldManager
+from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.twin.analyzer import FleetReport, analyze_fleet
+from openr_tpu.twin.metrics import TWIN_COUNTERS
+from openr_tpu.types import AdjacencyDatabase, PrefixDatabase
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+# tenant ids must stay unique per twin even when a manager is shared
+# across twins (id() reuse after gc must never alias tenants)
+_TWIN_SEQ = itertools.count(1)
+
+
+def _pow2_at_least(x: int) -> int:
+    p = 1
+    while p < x:
+        p *= 2
+    return p
+
+
+class FabricTwin:
+    """N vantages over one shared LSDB, solved as one batched wave.
+
+    The twin owns a dedicated ``WorldManager`` sized so the WHOLE
+    fleet fits one bucket wave (``slots_per_bucket >= N``) — the
+    one-dispatch-per-event contract would silently become two waves
+    under the process-global manager's default 8 slots. Pass
+    ``manager=`` to share one (e.g. several small twins in one test).
+    """
+
+    def __init__(
+        self,
+        topo: Topology,
+        *,
+        area: Optional[str] = None,
+        solver_backend: str = "device",
+        manager: Optional[WorldManager] = None,
+    ):
+        self.topo = topo
+        self.area = area if area is not None else (topo.area or "0")
+        self.nodes: List[str] = sorted(topo.adj_dbs)
+        self._seq = next(_TWIN_SEQ)
+        self.ls = LinkState(self.area)
+        self.prefix_state = PrefixState()
+        for name in self.nodes:
+            db = topo.adj_dbs[name]
+            if db.area != self.area:
+                db = _dc_replace(db, area=self.area)
+            self.ls.update_adjacency_database(db)
+        for name in sorted(topo.prefix_dbs):
+            pdb = topo.prefix_dbs[name]
+            if pdb.area != self.area:
+                pdb = _dc_replace(pdb, area=self.area)
+            self.prefix_state.update_prefix_database(pdb)
+        if manager is None:
+            manager = WorldManager(
+                slots_per_bucket=_pow2_at_least(len(self.nodes)),
+                max_resident=max(1, len(self.nodes)),
+            )
+        self.manager = manager
+        self._backend = solver_backend
+        self.solvers: Dict[str, SpfSolver] = {
+            n: SpfSolver(n, backend=solver_backend) for n in self.nodes
+        }
+        self.route_dbs: Dict[str, DecisionRouteDb] = {}
+        # vantage -> {node: overloaded} what-if views (cold-solved in
+        # the same wave; see WorldManager._apply_override)
+        self.overrides: Dict[str, Dict[str, bool]] = {}
+        self.stale: set = set(self.nodes)
+        self.events_applied = 0
+        TWIN_COUNTERS["vantages"] += len(self.nodes)
+
+    # -- event plane -------------------------------------------------------
+
+    def apply_event(self, ev: LoadEvent) -> bool:
+        """Apply one generated/scripted publication to the shared
+        LSDB exactly the way ``Decision.process_publication`` would;
+        every vantage goes stale until the next converge wave. Returns
+        False for dropped/unknown events (a pure no-op)."""
+        if ev.dropped or ev.payload is None:
+            return False
+        if keyutil.is_adj_key(ev.key):
+            db = wire.loads(ev.payload, AdjacencyDatabase)
+            if db.area != self.area:
+                db = _dc_replace(db, area=self.area)
+            self.ls.update_adjacency_database(db)
+        elif keyutil.is_prefix_key(ev.key):
+            pdb = wire.loads(ev.payload, PrefixDatabase)
+            if pdb.area != self.area:
+                pdb = _dc_replace(pdb, area=self.area)
+            self.prefix_state.update_prefix_database(pdb)
+        else:
+            return False
+        self.events_applied += 1
+        TWIN_COUNTERS["events"] += 1
+        self.stale.update(self.nodes)
+        TWIN_COUNTERS["stale_vantages"] = len(self.stale)
+        return True
+
+    # -- converge plane ----------------------------------------------------
+
+    def _tid(self, node: str) -> str:
+        return f"twin/{self._seq}/{node}"
+
+    def converge(
+        self, vantages: Optional[Sequence[str]] = None
+    ) -> Dict[str, DecisionRouteDb]:
+        """One fleet reconvergence wave: solve the given vantages (all
+        stale ones by default) as ONE batched tenant dispatch, preload
+        the views, and rebuild each vantage's RIB. Converging a strict
+        subset deliberately leaves the rest serving mixed-epoch tables
+        — that is how scenarios model in-flight reconvergence for the
+        analyzer."""
+        nodes = (
+            [n for n in self.nodes if n in self.stale]
+            if vantages is None
+            else [n for n in self.nodes if n in set(vantages)]
+        )
+        if not nodes:
+            return {}
+        tracer = get_tracer()
+        trace = tracer.start(origin="twin.converge")
+        tracer.activate(trace)
+        span = tracer.span_active("twin.fleet_converge")
+        out: Dict[str, DecisionRouteDb] = {}
+        try:
+            with get_registry().timed("twin.converge_ms"):
+                views = self.manager.solve_views(
+                    [
+                        (
+                            self._tid(n),
+                            self.ls,
+                            n,
+                            self.overrides.get(n),
+                        )
+                        for n in nodes
+                    ]
+                )
+                fleet_preload_views(self.ls, views)
+                area_ls = {self.area: self.ls}
+                for n in nodes:
+                    db = self.solvers[n].build_route_db(
+                        n, area_ls, self.prefix_state
+                    )
+                    if db is None:
+                        self.route_dbs.pop(n, None)
+                    else:
+                        self.route_dbs[n] = db
+                        out[n] = db
+                    self.stale.discard(n)
+            TWIN_COUNTERS["waves"] += 1
+            TWIN_COUNTERS["vantage_solves"] += len(nodes)
+            TWIN_COUNTERS["stale_vantages"] = len(self.stale)
+        finally:
+            tracer.end_span_active(
+                span, vantages=len(nodes), stale=len(self.stale)
+            )
+            tracer.deactivate()
+            tracer.finish(trace)
+        return out
+
+    def step(self, ev: LoadEvent) -> Dict[str, DecisionRouteDb]:
+        """Apply one event and reconverge the whole fleet (one wave)."""
+        self.apply_event(ev)
+        return self.converge()
+
+    # -- what-if / restart seams -------------------------------------------
+
+    def set_override(
+        self, vantage: str, overloads: Optional[Dict[str, bool]]
+    ) -> None:
+        """Give ``vantage`` a local overload view layered over the
+        shared LSDB (None/empty clears it). The vantage goes stale; it
+        cold-solves inside the next wave — same executable, no
+        retrace. The vantage also gets a fresh solver: its view cache
+        keys on (topology_version, root), and an override moves the
+        solve without moving the LSDB version, so a kept solver would
+        serve the pre-override view and strand the preloaded one."""
+        if overloads:
+            self.overrides[vantage] = dict(overloads)
+        else:
+            self.overrides.pop(vantage, None)
+        self.solvers[vantage] = SpfSolver(vantage, backend=self._backend)
+        self.stale.add(vantage)
+        TWIN_COUNTERS["stale_vantages"] = len(self.stale)
+
+    def restart_node(self, node: str) -> Optional[DecisionRouteDb]:
+        """Rolling-restart one vantage with graceful-restart
+        semantics: the held RIB keeps serving (it is never cleared)
+        while the vantage's solver state and tenant world are dropped
+        and warm-booted from the shared LSDB. Returns the held table;
+        on an unchanged LSDB the rebuilt RIB must be bit-identical to
+        it — the PR 10 graceful-restart contract, checkable
+        fleet-wide."""
+        held = self.route_dbs.get(node)
+        self.manager.drop(self._tid(node))
+        self.solvers[node] = SpfSolver(node, backend=self._backend)
+        self.stale.add(node)
+        self.converge([node])
+        TWIN_COUNTERS["restarts"] += 1
+        return held
+
+    # -- analysis ----------------------------------------------------------
+
+    def analyze(self) -> FleetReport:
+        """Run the fleet analyzer over the CURRENT per-vantage tables
+        (mixed epochs included — that is the point)."""
+        return analyze_fleet(
+            self.route_dbs, self.ls, self.prefix_state
+        )
+
+    def close(self) -> None:
+        """Release the fleet's tenant worlds (device slots)."""
+        for n in self.nodes:
+            self.manager.drop(self._tid(n))
+        TWIN_COUNTERS["vantages"] -= len(self.nodes)

@@ -1,0 +1,25 @@
+"""``twin.*`` observability surface.
+
+Counters live here (not in ``fabric``) so the analyzer and scenario
+driver can bump them without importing the FabricTwin module — the
+fabric imports the analyzer, never the other way around.
+"""
+
+from openr_tpu.telemetry import get_registry
+
+TWIN_COUNTERS = get_registry().counter_dict(
+    [
+        "vantages",          # gauge: nodes modeled by live twins
+        "events",            # publications applied to the shared LSDB
+        "waves",             # fleet converge waves (one dispatch each)
+        "vantage_solves",    # per-vantage route rebuilds
+        "stale_vantages",    # gauge: vantages behind the shared LSDB
+        "restarts",          # rolling-restart (graceful) cycles
+        "partitions",        # area-partition cuts applied
+        "injected_drops",    # events dropped by the twin.inject seam
+        "analyses",          # fleet analyzer passes
+        "loops_found",       # micro-loop findings
+        "blackholes_found",  # transient-blackhole findings
+    ],
+    prefix="twin.",
+)

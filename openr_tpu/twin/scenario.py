@@ -1,0 +1,358 @@
+"""Scenario driver: scripted fleet-scale event sequences for the twin.
+
+The seeded ``LoadGenerator`` supplies realistic background churn; this
+driver layers the *operational* sequences on top of it — link flaps,
+metric changes, drain sequencing, area partitions, rolling restarts —
+using the generator's scripted seams (``emit_adjacency`` /
+``emit_prefix``), which consume no RNG draws: scripted steps
+interleave freely with seeded load without perturbing its schedule,
+so the oracle replay of the event log stays deterministic.
+
+Tenth fault seam: ``twin.inject``. Arming it makes injected events
+drop BEFORE reaching the twin's LSDB — a lossy flood toward the whole
+fleet. Dropped events are excluded from both the twin and the replay
+log (the generator's full-database publication semantics mean the
+next surviving event for the same key self-heals the divergence), so
+twin-vs-oracle parity holds under chaos, the same contract the load
+harness established.
+
+Two injectors exist specifically to seed the analyzer's defect
+classes: ``inject_micro_loop`` flaps a link and reconverges only its
+endpoints (stale interior vantages still forward into the flap —
+cycle), ``inject_blackhole`` advertises a fresh prefix and converges
+only its originator (stale vantages lack a route to deliverable
+traffic). Both heal with one full ``twin.converge()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as _dc_replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from openr_tpu.faults.injector import (
+    FaultInjected,
+    fault_point,
+    register_fault_site,
+)
+from openr_tpu.load.generator import (
+    KIND_DRAIN,
+    KIND_FLAP,
+    KIND_METRIC,
+    EventMix,
+    LoadEvent,
+    LoadGenerator,
+    _extra_prefix,
+)
+from openr_tpu.twin.fabric import FabricTwin
+from openr_tpu.twin.metrics import TWIN_COUNTERS
+from openr_tpu.types import (
+    TTL_INFINITY,
+    Adjacency,
+    PrefixEntry,
+    Publication,
+    Value,
+)
+from openr_tpu.utils import wire
+
+FAULT_TWIN_INJECT = register_fault_site("twin.inject")
+
+
+class ScenarioDriver:
+    """Owns one twin + one seeded generator and a replay log.
+
+    ``self.log`` holds exactly the events that reached the twin's
+    LSDB (seeded and scripted alike, drops excluded) — replaying it
+    through N independent Decision pipelines is the parity oracle.
+    """
+
+    def __init__(
+        self,
+        twin: FabricTwin,
+        seed: int = 0,
+        mix: Optional[EventMix] = None,
+    ):
+        self.twin = twin
+        self.gen = LoadGenerator(twin.topo, seed=seed, mix=mix)
+        # priming the version-1 bulk load keeps event versions aligned
+        # with the harness convention AND gives the oracle its initial
+        # publication (content-identical to the twin's topo databases)
+        self.initial = self.gen.initial_key_vals()
+        self.log: List[LoadEvent] = []
+        # flapped/partitioned adjacencies awaiting restore:
+        # (node, Adjacency) in withdrawal order
+        self._withdrawn: Dict[Tuple[str, str], List[Tuple[str, Adjacency]]] = {}
+        self._partition_cut: List[Tuple[str, Adjacency]] = []
+
+    # -- event plumbing ----------------------------------------------------
+
+    def apply(self, ev: LoadEvent) -> bool:
+        """Push one event at the twin through the lossy-flood seam.
+        Returns True iff the event mutated the shared LSDB."""
+        if ev.dropped:
+            return False
+        try:
+            fault_point(FAULT_TWIN_INJECT)
+        except FaultInjected:
+            TWIN_COUNTERS["injected_drops"] += 1
+            return False
+        if self.twin.apply_event(ev):
+            self.log.append(ev)
+            return True
+        return False
+
+    def run_load(self, n: int, converge_each: bool = True) -> List[LoadEvent]:
+        """Drive ``n`` seeded background events (each one twin wave
+        when ``converge_each``)."""
+        out = []
+        for _ in range(n):
+            ev = self.gen.next_event()
+            out.append(ev)
+            if self.apply(ev) and converge_each:
+                self.twin.converge()
+        if not converge_each:
+            self.twin.converge()
+        return out
+
+    # -- per-vantage oracle ------------------------------------------------
+
+    def oracle_route_db(self, node: str):
+        """The twin-vs-real parity oracle for one vantage: replay the
+        surviving event log — initial bulk load plus every event that
+        reached the twin — into a fresh, independently-run Decision on
+        the deterministic host backend, and return its final
+        DecisionRouteDb. N of these ARE the real fleet; the twin's N
+        tables must match them bit for bit."""
+        from openr_tpu.decision.decision import Decision
+        from openr_tpu.messaging.queue import ReplicateQueue
+
+        area = self.twin.area
+        kv_q = ReplicateQueue(name=f"twin-oracle:{node}:kvstore")
+        oracle = Decision(
+            node,
+            kvstore_updates_queue=kv_q,
+            route_updates_queue=ReplicateQueue(
+                name=f"twin-oracle:{node}:routes"
+            ),
+            solver_backend="host",
+        )
+        try:
+            oracle.process_publication(
+                Publication(key_vals=dict(self.initial), area=area)
+            )
+            for ev in self.log:
+                oracle.process_publication(
+                    Publication(
+                        key_vals={
+                            ev.key: Value(
+                                version=ev.version,
+                                originator_id=ev.node,
+                                value=ev.payload,
+                                ttl=TTL_INFINITY,
+                                hash=wire.generate_hash(
+                                    ev.version, ev.node, ev.payload
+                                ),
+                            )
+                        },
+                        area=area,
+                    )
+                )
+            oracle.pending.set_needs_full_rebuild()
+            oracle.rebuild_routes("TWIN_ORACLE")
+            return oracle.route_db
+        finally:
+            kv_q.close()
+
+    def check_parity(self, nodes: Optional[Sequence[str]] = None
+                     ) -> List[str]:
+        """Bit-compare every (or the given) vantage's twin table
+        against its independent-pipeline oracle. Returns the diverged
+        vantages — [] is the passing result. Converges any stale
+        vantages first (the oracle models a fully-converged daemon)."""
+        if self.twin.stale:
+            self.twin.converge()
+        diverged = []
+        for node in nodes if nodes is not None else list(self.twin.nodes):
+            mine = self.twin.route_dbs.get(node)
+            ref = self.oracle_route_db(node)
+            if mine is None or ref is None:
+                if (mine is None) != (ref is None):
+                    diverged.append(node)
+                continue
+            if wire.dumps(mine.to_route_db(node)) != wire.dumps(
+                ref.to_route_db(node)
+            ):
+                diverged.append(node)
+        return diverged
+
+    # -- scripted adjacency surgery ----------------------------------------
+
+    def _adj_db(self, node: str):
+        return self.gen.adj_dbs[node]
+
+    def _withdraw(self, node: str, toward: str, sink: List) -> bool:
+        """Remove every ``node``→``toward`` adjacency from the
+        generator's evolving database, remembering it in ``sink`` for
+        restore. Returns True when something was withdrawn."""
+        db = self._adj_db(node)
+        kept, pulled = [], []
+        for adj in db.adjacencies:
+            (pulled if adj.other_node_name == toward else kept).append(adj)
+        if not pulled:
+            return False
+        self.gen.adj_dbs[node] = _dc_replace(db, adjacencies=tuple(kept))
+        sink.extend((node, adj) for adj in pulled)
+        return True
+
+    def flap_link(self, a: str, b: str, converge: bool = True) -> None:
+        """Withdraw BOTH directions of the a—b link (a real link flap
+        floods two adjacency databases)."""
+        sink = self._withdrawn.setdefault(self._link_key(a, b), [])
+        for node, toward in ((a, b), (b, a)):
+            if self._withdraw(node, toward, sink):
+                self.apply(self.gen.emit_adjacency(node, kind=KIND_FLAP))
+        if converge:
+            self.twin.converge()
+
+    def restore_link(self, a: str, b: str, converge: bool = True) -> None:
+        sink = self._withdrawn.pop(self._link_key(a, b), [])
+        for node, adj in sink:
+            db = self._adj_db(node)
+            self.gen.adj_dbs[node] = _dc_replace(
+                db, adjacencies=db.adjacencies + (adj,)
+            )
+        for node in sorted({node for node, _ in sink}):
+            self.apply(self.gen.emit_adjacency(node, kind=KIND_FLAP))
+        if converge:
+            self.twin.converge()
+
+    @staticmethod
+    def _link_key(a: str, b: str) -> Tuple[str, str]:
+        return (a, b) if a <= b else (b, a)
+
+    def set_metric(self, a: str, b: str, metric: int,
+                   converge: bool = True) -> None:
+        """Symmetric metric change on the a—b link."""
+        for node, toward in ((a, b), (b, a)):
+            db = self._adj_db(node)
+            adjs = tuple(
+                _dc_replace(adj, metric=metric)
+                if adj.other_node_name == toward
+                else adj
+                for adj in db.adjacencies
+            )
+            if adjs != db.adjacencies:
+                self.gen.adj_dbs[node] = _dc_replace(db, adjacencies=adjs)
+                self.apply(self.gen.emit_adjacency(node, kind=KIND_METRIC))
+        if converge:
+            self.twin.converge()
+
+    # -- drains ------------------------------------------------------------
+
+    def drain(self, node: str, drained: bool = True,
+              converge: bool = True) -> None:
+        """Set/clear ``is_overloaded`` on one node (operational drain)."""
+        db = self._adj_db(node)
+        if db.is_overloaded != drained:
+            self.gen.adj_dbs[node] = _dc_replace(db, is_overloaded=drained)
+            self.apply(self.gen.emit_adjacency(node, kind=KIND_DRAIN))
+        if converge:
+            self.twin.converge()
+
+    def drain_sequence(self, nodes: Sequence[str]) -> None:
+        """Drain each node in order, fleet-converging between steps —
+        the maintenance sequencing pattern (each wave must stay clean:
+        drained nodes stop transiting but traffic keeps delivering)."""
+        for node in nodes:
+            self.drain(node, True)
+
+    def undrain_sequence(self, nodes: Sequence[str]) -> None:
+        for node in nodes:
+            self.drain(node, False)
+
+    # -- partitions --------------------------------------------------------
+
+    def partition(self, group: Sequence[str], converge: bool = True) -> None:
+        """Cut every link between ``group`` and the rest of the fabric
+        (an area partition). ``heal_partition`` restores the cut."""
+        inside = set(group)
+        touched = set()
+        for node in sorted(self.gen.adj_dbs):
+            others = {
+                adj.other_node_name
+                for adj in self._adj_db(node).adjacencies
+            }
+            for other in sorted(others):
+                if (node in inside) != (other in inside):
+                    if self._withdraw(node, other, self._partition_cut):
+                        touched.add(node)
+        for node in sorted(touched):
+            self.apply(self.gen.emit_adjacency(node, kind=KIND_FLAP))
+        TWIN_COUNTERS["partitions"] += 1
+        if converge:
+            self.twin.converge()
+
+    def heal_partition(self, converge: bool = True) -> None:
+        cut, self._partition_cut = self._partition_cut, []
+        for node, adj in cut:
+            db = self._adj_db(node)
+            self.gen.adj_dbs[node] = _dc_replace(
+                db, adjacencies=db.adjacencies + (adj,)
+            )
+        for node in sorted({node for node, _ in cut}):
+            self.apply(self.gen.emit_adjacency(node, kind=KIND_FLAP))
+        if converge:
+            self.twin.converge()
+
+    # -- rolling restarts --------------------------------------------------
+
+    def rolling_restart(self, nodes: Optional[Sequence[str]] = None
+                        ) -> List[str]:
+        """Restart each vantage in turn with graceful-restart
+        semantics and bit-compare its held table against the rebuilt
+        one (the LSDB is unchanged across a restart, so they must
+        match). Returns the nodes whose tables diverged — [] is the
+        passing result."""
+        diverged = []
+        for node in nodes if nodes is not None else list(self.twin.nodes):
+            held = self.twin.restart_node(node)
+            rebuilt = self.twin.route_dbs.get(node)
+            if held is None or rebuilt is None:
+                if held is not rebuilt:
+                    diverged.append(node)
+                continue
+            if wire.dumps(held.to_route_db(node)) != wire.dumps(
+                rebuilt.to_route_db(node)
+            ):
+                diverged.append(node)
+        return diverged
+
+    # -- defect injectors --------------------------------------------------
+
+    def inject_micro_loop(self, a: str, b: str) -> None:
+        """Seed a micro-loop: flap the a—b link but reconverge ONLY
+        its endpoints. They re-route the long way around while every
+        stale vantage still forwards into the flap — a cycle in the
+        per-prefix forwarding graph that ``twin.analyze()`` must
+        report. One full ``converge()`` heals it."""
+        self.flap_link(a, b, converge=False)
+        self.twin.converge([a, b])
+
+    def inject_blackhole(self, node: str) -> None:
+        """Seed a transient blackhole: ``node`` advertises a fresh
+        prefix, but only ``node`` reconverges — every other vantage is
+        missing a route to deliverable traffic until the next full
+        wave."""
+        db = self.gen.prefix_dbs[node]
+        extra = _extra_prefix(self.gen._node_idx[node])
+        if all(e.prefix != extra for e in db.prefix_entries):
+            base = db.prefix_entries[0] if db.prefix_entries else None
+            entry = (
+                _dc_replace(base, prefix=extra)
+                if base is not None
+                else PrefixEntry(prefix=extra)
+            )
+            self.gen.prefix_dbs[node] = _dc_replace(
+                db, prefix_entries=db.prefix_entries + (entry,)
+            )
+        self.apply(self.gen.emit_prefix(node))
+        self.twin.converge([node])

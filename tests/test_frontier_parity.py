@@ -250,12 +250,36 @@ class TestFrontierPolicy:
         assert engine.full_refreshes == 0
         assert engine_digests(engine) == full_digests(ls)
 
-    def test_grouped_backend_falls_back(self):
-        """No frontier kernel over block-bipartite segments yet: the
-        grouped engine's probe hook returns None and every overflow
-        rides the full-width refresh, counted as a fallback."""
+    def test_grouped_backend_takes_frontier(self):
+        """The grouped backend resolves structural churn through its
+        OWN cone probe (dense expansion over the [G, S, R] segment
+        slabs): a localized link down rides the frontier — no
+        unconditional full-width fallback — and stays bit-identical
+        to the cold oracle."""
         ls = self._fat_tree()
         engine = fresh_engine(ls, kind="grouped")
+        rsw, peer = leaf_link(ls)
+        pulled = drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 1
+        assert engine.full_refreshes == 0
+        assert engine.frontier_fallbacks == 0
+        assert engine.last_frontier_cells > 0
+        assert engine_digests(engine) == full_digests(ls), "down"
+        # link up heals warm through the same path
+        restore_link(ls, pulled)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == 1
+
+    def test_grouped_threshold_zero_falls_back_full_width(self):
+        """The grouped probe honors the same overflow policy: a zero
+        cell budget rejects the cone and rides the full-width
+        refresh, counted as a fallback."""
+        ls = self._fat_tree()
+        engine = fresh_engine(
+            ls, kind="grouped", frontier_threshold=0.0
+        )
         rsw, peer = leaf_link(ls)
         drop_link(ls, rsw, peer)
         assert engine.churn(ls, {rsw, peer}) is not None
@@ -263,6 +287,24 @@ class TestFrontierPolicy:
         assert engine.full_refreshes == 1
         assert engine.frontier_fallbacks == 1
         assert engine_digests(engine) == full_digests(ls)
+
+    def test_grouped_drain_flip_takes_frontier(self):
+        """An overload flip on the grouped backend classifies as
+        structural and heals warm through the grouped cone probe."""
+        from tests.test_route_engine import set_overload
+
+        ls = self._fat_tree()
+        engine = fresh_engine(ls, kind="grouped")
+        fsw = next(
+            n for n in engine.graph.node_names if n.startswith("fsw")
+        )
+        assert engine.churn(ls, set_overload(ls, fsw, True)) is not None
+        assert engine.structural_events == 1
+        assert engine_digests(engine) == full_digests(ls), "drain"
+        assert engine.churn(ls, set_overload(ls, fsw, False)) is not None
+        assert engine_digests(engine) == full_digests(ls), "undrain"
+        assert engine.cold_builds == 1
+        assert engine.frontier_resolves + engine.full_refreshes == 2
 
     def test_drain_flip_takes_frontier(self):
         """An overload flip is structural churn too (effective-weight
@@ -336,6 +378,23 @@ class TestFrontierSharded:
         assert engine_digests(engine) == full_digests(ls), "up"
         assert engine.cold_builds == 1
         assert_bit_identical(engine, ls, "ell_sharded")
+
+    def test_sharded_grouped_link_churn_digest_parity(self):
+        """Mesh-sharded GROUPED engine: the psum-voted grouped probe
+        meta is device-invariant and the row-sharded cone seeds the
+        sharded grouped masked re-solve."""
+        ls = load(TOPOS["fat_tree"]())
+        engine = fresh_engine(ls, kind="grouped_sharded")
+        rsw, peer = leaf_link(ls)
+        pulled = drop_link(ls, rsw, peer)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine.frontier_resolves == 1
+        assert engine_digests(engine) == full_digests(ls), "down"
+        restore_link(ls, pulled)
+        assert engine.churn(ls, {rsw, peer}) is not None
+        assert engine_digests(engine) == full_digests(ls), "up"
+        assert engine.cold_builds == 1
+        assert_bit_identical(engine, ls, "grouped_sharded")
 
 
 class TestFrontierFaults:

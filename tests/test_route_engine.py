@@ -735,7 +735,7 @@ class TestFullRefresh:
         ls = load(topo)
         names = sorted(ls.get_adjacency_databases().keys())
         engine = route_engine.GroupedRouteSweepEngine(
-            ls, [names[0]]
+            ls, [names[0]], frontier_threshold=0.0
         )
         engine._k_hint = 8
         affected = self._overflow_event(ls, engine)

@@ -1,0 +1,348 @@
+"""Digital twin (openr_tpu.twin): N-vantage fleet bit-parity against
+N independently-run Decision pipelines across every scenario class,
+one-dispatch-per-event with zero retraces after fleet warmup, and the
+fleet analyzer's micro-loop / transient-blackhole detection (findings
+on seeded mixed-epoch fleets, none on clean reconvergence)."""
+
+import pytest
+
+from openr_tpu.decision.spf_solver import reset_device_caches
+from openr_tpu.faults.injector import FaultSchedule, get_injector
+from openr_tpu.load.generator import EventMix, LoadGenerator
+from openr_tpu.models import topologies
+from openr_tpu.ops.world_batch import TENANCY_COUNTERS
+from openr_tpu.telemetry import get_registry, jax_hooks
+from openr_tpu.twin import (
+    KIND_BLACKHOLE,
+    KIND_MICRO_LOOP,
+    FabricTwin,
+    ScenarioDriver,
+    analyze_fleet,
+)
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    get_injector().reset()
+    reset_device_caches()
+    yield
+    get_injector().reset()
+    reset_device_caches()
+
+
+def _fleet(n=16, seed=0, mix=None):
+    twin = FabricTwin(topologies.ring(n))
+    drv = ScenarioDriver(twin, seed=seed, mix=mix)
+    return twin, drv
+
+
+class TestTwinParity:
+    """The acceptance bar: the one-dispatch twin is bit-identical to
+    N independently-run KvStore->Decision pipelines."""
+
+    def test_cold_build_16_vantages_one_wave(self):
+        twin, drv = _fleet(16)
+        before = TENANCY_COUNTERS["dispatches"]
+        twin.converge()
+        assert TENANCY_COUNTERS["dispatches"] - before == 1
+        assert len(twin.route_dbs) == 16
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_metric_churn_parity(self):
+        twin, drv = _fleet(16, seed=11)
+        twin.converge()
+        drv.run_load(12)
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_link_flap_parity(self):
+        twin, drv = _fleet(16, seed=5)
+        twin.converge()
+        drv.flap_link("node-3", "node-4")
+        assert drv.check_parity() == []
+        drv.restore_link("node-3", "node-4")
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_drain_parity(self):
+        twin, drv = _fleet(16, seed=5)
+        twin.converge()
+        drv.drain_sequence(["node-2", "node-9"])
+        assert drv.check_parity() == []
+        drv.undrain_sequence(["node-2", "node-9"])
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_mixed_scenario_parity_with_drain_load(self):
+        # seeded background load that includes drain_flip events
+        twin, drv = _fleet(
+            16, seed=23,
+            mix=EventMix(metric_churn=0.5, link_flap=0.2,
+                         prefix_update=0.2, drain_flip=0.1),
+        )
+        twin.converge()
+        drv.run_load(20)
+        drv.set_metric("node-7", "node-8", 5)
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_partition_and_heal_parity(self):
+        twin, drv = _fleet(12, seed=2)
+        twin.converge()
+        drv.partition(["node-0", "node-1", "node-2"])
+        assert TENANCY_COUNTERS is not None
+        assert drv.check_parity() == []
+        # a clean partition blackholes nothing: unreachable is not a
+        # defect, and both islands converged
+        assert twin.analyze().clean
+        drv.heal_partition()
+        assert drv.check_parity() == []
+        assert twin.analyze().clean
+        twin.close()
+
+    def test_lossy_flood_parity(self):
+        # the twin.inject seam drops events BEFORE the LSDB; the
+        # replay log excludes them, so parity still holds
+        twin, drv = _fleet(8, seed=9)
+        twin.converge()
+        get_injector().arm("twin.inject", FaultSchedule.fail_every(3))
+        drv.run_load(9)
+        get_injector().reset()
+        from openr_tpu.twin import TWIN_COUNTERS
+        assert TWIN_COUNTERS["injected_drops"] >= 1
+        assert drv.check_parity() == []
+        twin.close()
+
+
+class TestTwinDispatchEconomy:
+    def test_zero_retraces_after_fleet_warmup(self):
+        jax_hooks.install()
+        reg = get_registry()
+        twin, drv = _fleet(16, seed=4)
+        twin.converge()  # warmup wave (may compile the bucket exec)
+        compiles = reg.counter_get("jax.compile_count")
+        before = TENANCY_COUNTERS["dispatches"]
+        adj_events = 0
+        for _ in range(6):
+            ev = drv.gen.next_event()
+            if drv.apply(ev):
+                # prefix-only events change no topology: no SPF wave
+                adj_events += keyutil.is_adj_key(ev.key)
+                twin.converge()
+        assert twin.events_applied >= adj_events >= 1
+        assert TENANCY_COUNTERS["dispatches"] - before == adj_events
+        assert reg.counter_get("jax.compile_count") == compiles
+        twin.close()
+
+    def test_fleet_join_zero_retraces(self):
+        # a second same-shape fleet joins entirely on warm executables
+        jax_hooks.install()
+        reg = get_registry()
+        first = FabricTwin(topologies.ring(16))
+        first.converge()
+        compiles = reg.counter_get("jax.compile_count")
+        second = FabricTwin(topologies.ring(16))
+        second.converge()
+        assert reg.counter_get("jax.compile_count") == compiles
+        assert len(second.route_dbs) == 16
+        first.close()
+        second.close()
+
+    def test_vantage_view_packing_shares_graphs(self):
+        before = TENANCY_COUNTERS["graph_shares"]
+        twin, drv = _fleet(16, seed=1)
+        twin.converge()
+        # 16 vantages over one LSDB: one compile_ell, 15+ shared reuses
+        assert TENANCY_COUNTERS["graph_shares"] - before >= 15
+        drv.run_load(2)
+        assert drv.check_parity() == []
+        twin.close()
+
+
+class TestFleetAnalyzer:
+    def test_clean_on_converged_fleet(self):
+        twin, drv = _fleet(10, seed=6)
+        twin.converge()
+        rep = twin.analyze()
+        assert rep.clean
+        assert rep.vantages == 10
+        assert rep.prefixes == 10
+        twin.close()
+
+    def test_injected_micro_loop_detected_and_heals(self):
+        twin, drv = _fleet(10, seed=6)
+        twin.converge()
+        drv.inject_micro_loop("node-0", "node-1")
+        rep = twin.analyze()
+        loops = rep.loops()
+        assert loops, "seeded micro-loop must be reported"
+        assert all(f.kind == KIND_MICRO_LOOP for f in loops)
+        # every reported cycle is a real cycle: closed walk
+        for f in loops:
+            assert f.path[0] == f.path[-1] and len(f.path) >= 3
+        twin.converge()  # full wave heals the mixed epochs
+        assert twin.analyze().clean
+        drv.restore_link("node-0", "node-1")
+        assert twin.analyze().clean
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_injected_blackhole_detected_and_heals(self):
+        twin, drv = _fleet(10, seed=6)
+        twin.converge()
+        drv.inject_blackhole("node-4")
+        rep = twin.analyze()
+        holes = rep.blackholes()
+        assert holes, "stale vantages must blackhole the new prefix"
+        assert all(f.kind == KIND_BLACKHOLE for f in holes)
+        # the advertiser itself converged; it is never a finding
+        assert all(f.path[0] != "node-4" for f in holes)
+        twin.converge()
+        assert twin.analyze().clean
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_stale_next_hop_over_dead_link_is_blackhole(self):
+        # flap a link but converge NOBODY: both endpoints still point
+        # at each other over the dead link
+        twin, drv = _fleet(8, seed=6)
+        twin.converge()
+        drv.flap_link("node-2", "node-3", converge=False)
+        rep = twin.analyze()
+        assert any(
+            f.path in (("node-2", "node-3"), ("node-3", "node-2"))
+            for f in rep.blackholes()
+        )
+        twin.converge()
+        assert twin.analyze().clean
+        twin.close()
+
+    def test_drained_nodes_do_not_transit_in_deliverability(self):
+        # drain a node: traffic keeps delivering around it, so a
+        # clean converged fleet reports nothing
+        twin, drv = _fleet(8, seed=6)
+        twin.converge()
+        drv.drain("node-5")
+        assert twin.analyze().clean
+        twin.close()
+
+    def test_analyze_fleet_direct_empty(self):
+        twin, _ = _fleet(4)
+        rep = analyze_fleet({}, twin.ls, twin.prefix_state, vantages=[])
+        assert rep.clean and rep.vantages == 0
+        twin.close()
+
+
+class TestTwinWhatIf:
+    def test_override_matches_actually_drained_fabric(self):
+        ta = FabricTwin(topologies.ring(8))
+        ta.converge()
+        ta.set_override("node-5", {"node-2": True})
+        ta.converge()
+        a = wire.dumps(ta.route_dbs["node-5"].to_route_db("node-5"))
+
+        tb = FabricTwin(topologies.ring(8))
+        db = ScenarioDriver(tb, seed=0)
+        tb.converge()
+        db.drain("node-2")
+        b = wire.dumps(tb.route_dbs["node-5"].to_route_db("node-5"))
+        assert a == b
+        ta.close()
+        tb.close()
+
+    def test_override_clear_restores_base_table(self):
+        base = FabricTwin(topologies.ring(8))
+        base.converge()
+        ref = wire.dumps(base.route_dbs["node-5"].to_route_db("node-5"))
+        twin = FabricTwin(topologies.ring(8))
+        twin.converge()
+        twin.set_override("node-5", {"node-2": True})
+        twin.converge()
+        twin.set_override("node-5", None)
+        twin.converge()
+        got = wire.dumps(twin.route_dbs["node-5"].to_route_db("node-5"))
+        assert got == ref
+        base.close()
+        twin.close()
+
+    def test_override_does_not_leak_to_other_vantages(self):
+        base = FabricTwin(topologies.ring(8))
+        base.converge()
+        twin = FabricTwin(topologies.ring(8))
+        twin.converge()
+        twin.set_override("node-5", {"node-2": True})
+        twin.converge()
+        for n in twin.nodes:
+            if n == "node-5":
+                continue
+            assert wire.dumps(
+                twin.route_dbs[n].to_route_db(n)
+            ) == wire.dumps(base.route_dbs[n].to_route_db(n)), n
+        base.close()
+        twin.close()
+
+
+class TestRollingRestart:
+    def test_rolling_restart_graceful_bit_identity(self):
+        twin, drv = _fleet(12, seed=8)
+        twin.converge()
+        drv.run_load(4)
+        assert drv.rolling_restart() == []
+        assert drv.check_parity() == []
+        twin.close()
+
+    def test_restart_under_override(self):
+        twin, drv = _fleet(8, seed=8)
+        twin.converge()
+        twin.set_override("node-3", {"node-6": True})
+        twin.converge()
+        held = twin.restart_node("node-3")
+        rebuilt = twin.route_dbs["node-3"]
+        # the override survives the restart: rebuilt == held
+        assert wire.dumps(held.to_route_db("node-3")) == wire.dumps(
+            rebuilt.to_route_db("node-3")
+        )
+        twin.close()
+
+
+class TestDrainGenerator:
+    """Satellite: seeded drain/undrain events in the load generator."""
+
+    def test_same_seed_same_stream_with_drains(self):
+        mix = EventMix(metric_churn=0.4, link_flap=0.2,
+                       prefix_update=0.2, drain_flip=0.2)
+        topo = topologies.ring(8)
+        a = LoadGenerator(topo, seed=77, mix=mix).events(40)
+        b = LoadGenerator(topo, seed=77, mix=mix).events(40)
+        assert [(e.kind, e.node, e.key, e.payload, e.version)
+                for e in a] == [
+            (e.kind, e.node, e.key, e.payload, e.version) for e in b
+        ]
+        assert any(e.kind == "drain_flip" for e in a)
+
+    def test_zero_drain_weight_is_byte_identical_to_default(self):
+        topo = topologies.ring(8)
+        a = LoadGenerator(topo, seed=3).events(30)
+        b = LoadGenerator(
+            topo, seed=3,
+            mix=EventMix(metric_churn=0.70, link_flap=0.15,
+                         prefix_update=0.15, drain_flip=0.0),
+        ).events(30)
+        assert [(e.kind, e.key, e.payload) for e in a] == [
+            (e.kind, e.key, e.payload) for e in b
+        ]
+
+    def test_never_drains_last_undrained_node(self):
+        mix = EventMix(metric_churn=0.0, link_flap=0.0,
+                       prefix_update=0.0, drain_flip=1.0)
+        gen = LoadGenerator(topologies.ring(4), seed=1, mix=mix)
+        for _ in range(200):
+            gen.next_event()
+            undrained = [
+                n for n, db in gen.adj_dbs.items()
+                if not db.is_overloaded
+            ]
+            assert undrained, "generator drained the whole fabric"

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Digital-twin gate (``make twin-smoke``) and report artifact.
+
+Exercises the whole-network twin (``openr_tpu.twin``) end to end on a
+16-node ring and fails loudly if the fleet contract regressed:
+
+- PARITY VS PER-NODE ORACLES: every vantage's twin route table (cold
+  build, seeded churn, scripted link flap, drain) must be
+  bit-identical to an independently-run KvStore->Decision pipeline
+  replaying the same surviving event log on the host backend,
+- ONE WAVE / ZERO RETRACES: the cold 16-vantage fleet solves as ONE
+  batched dispatch; a second same-shape fleet joins with ZERO jit
+  compiles; each post-warmup topology event costs exactly one
+  dispatch and zero compiles,
+- DEFECT DETECTION: an injected link flap with only its endpoints
+  reconverged must surface a micro-loop, an injected fresh prefix
+  with only its originator reconverged must surface transient
+  blackholes, and one full converge wave must return the fleet to a
+  clean analyzer report,
+- VANTAGE-VIEW PACKING: 16 vantages over one LSDB must reuse one
+  compiled graph (``tenancy.graph_shares`` >= 15 on the cold wave).
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_twin_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates the twin's bookkeeping and
+fleet semantics, not device throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/twin_smoke.py) in addition to
+# module mode (python -m tools.twin_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="/tmp/openr_tpu_twin_smoke.json")
+    parser.add_argument("--nodes", type=int, default=16)
+    parser.add_argument("--load-events", type=int, default=10)
+    args = parser.parse_args(argv)
+
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.world_batch import TENANCY_COUNTERS
+    from openr_tpu.telemetry import get_registry, jax_hooks
+    from openr_tpu.twin import TWIN_COUNTERS, FabricTwin, ScenarioDriver
+
+    hooks_live = jax_hooks.install()
+    reg = get_registry()
+    failures: list = []
+    report: dict = {"gates": {}, "nodes": args.nodes}
+
+    twin = FabricTwin(topologies.ring(args.nodes))
+    drv = ScenarioDriver(twin, seed=20)
+
+    # -- gate 1: cold fleet = one dispatch wave, bit parity ---------------
+    d0 = TENANCY_COUNTERS["dispatches"]
+    shares0 = TENANCY_COUNTERS["graph_shares"]
+    twin.converge()
+    cold_waves = TENANCY_COUNTERS["dispatches"] - d0
+    report["gates"]["cold_waves"] = cold_waves
+    if cold_waves != 1:
+        failures.append(
+            f"cold {args.nodes}-vantage fleet took {cold_waves} "
+            "dispatch waves (must be exactly 1)"
+        )
+    shares = TENANCY_COUNTERS["graph_shares"] - shares0
+    report["gates"]["graph_shares_cold"] = shares
+    if shares < args.nodes - 1:
+        failures.append(
+            f"vantage-view packing reused the compiled graph {shares}x "
+            f"(expected >= {args.nodes - 1}: one compile, rest shared)"
+        )
+    diverged = drv.check_parity()
+    report["gates"]["cold_parity_diverged"] = diverged
+    if diverged:
+        failures.append(f"cold-build parity diverged: {diverged}")
+
+    # -- gate 2: fleet join + post-warmup events retrace-free -------------
+    if hooks_live:
+        c0 = reg.counter_get("jax.compile_count")
+        join = FabricTwin(topologies.ring(args.nodes))
+        join.converge()
+        join_compiles = reg.counter_get("jax.compile_count") - c0
+        join.close()
+        report["gates"]["fleet_join_compiles"] = join_compiles
+        if join_compiles:
+            failures.append(
+                f"second fleet join retraced {join_compiles}x "
+                "(same-shape fleets must ride warm executables)"
+            )
+        c0 = reg.counter_get("jax.compile_count")
+        drv.run_load(args.load_events)
+        load_compiles = reg.counter_get("jax.compile_count") - c0
+        report["gates"]["load_compiles"] = load_compiles
+        if load_compiles:
+            failures.append(
+                f"post-warmup load retraced {load_compiles}x"
+            )
+    else:
+        report["gates"]["fleet_join_compiles"] = None
+        drv.run_load(args.load_events)
+
+    # -- gate 3: scripted scenario parity ---------------------------------
+    drv.flap_link("node-2", "node-3")
+    drv.drain("node-7")
+    diverged = drv.check_parity()
+    report["gates"]["scenario_parity_diverged"] = diverged
+    if diverged:
+        failures.append(f"flap+drain parity diverged: {diverged}")
+    drv.restore_link("node-2", "node-3")
+    drv.drain("node-7", False)
+
+    # -- gate 4: analyzer catches the seeded defects, then heals ----------
+    if not twin.analyze().clean:
+        failures.append("converged fleet reported findings (must be clean)")
+    drv.inject_micro_loop("node-0", "node-1")
+    loops = len(twin.analyze().loops())
+    report["gates"]["injected_micro_loops_found"] = loops
+    if not loops:
+        failures.append(
+            "endpoint-only reconvergence after a flap surfaced no "
+            "micro-loop"
+        )
+    twin.converge()
+    drv.restore_link("node-0", "node-1")
+    if not twin.analyze().clean:
+        failures.append("fleet not clean after micro-loop heal wave")
+    drv.inject_blackhole("node-5")
+    holes = len(twin.analyze().blackholes())
+    report["gates"]["injected_blackholes_found"] = holes
+    if not holes:
+        failures.append(
+            "originator-only reconvergence after a fresh prefix "
+            "surfaced no transient blackhole"
+        )
+    twin.converge()
+    if not twin.analyze().clean:
+        failures.append("fleet not clean after blackhole heal wave")
+    diverged = drv.check_parity()
+    report["gates"]["final_parity_diverged"] = diverged
+    if diverged:
+        failures.append(f"post-defect parity diverged: {diverged}")
+
+    twin.close()
+    report["counters"] = {
+        f"twin.{k}": TWIN_COUNTERS[k] for k in TWIN_COUNTERS
+    }
+    report["events_in_log"] = len(drv.log)
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("TWIN SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"twin smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
