@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke multichip-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke multichip-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -114,6 +114,16 @@ integrity-smoke: native
 # what-if triage" when it fails.
 twin-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.twin_smoke --out /tmp/openr_tpu_twin_smoke.json
+
+# committed-dispatch gate (openr_tpu.ops.route_engine): a warm event
+# window must cost at most 2 host touches (one submit run, one reap
+# run) with zero blocking syncs, an identical second pass must cost
+# zero AOT/jit compiles, and both the incremental result and the
+# debounced churn_window batch must be bit-identical to the
+# from-scratch oracle. See docs/RUNBOOK.md "Host-overhead triage"
+# when it fails.
+dispatch-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.dispatch_smoke --out /tmp/openr_tpu_dispatch_smoke.json
 
 # sharded-dispatch gate on the virtual 8-device CPU mesh (conftest
 # pins the device count): pipelined==eager bit-identity across a

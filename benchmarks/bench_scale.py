@@ -907,7 +907,9 @@ def route_engine_churn_bench(
 
     import jax
 
+    from openr_tpu.ops import dispatch_accounting as da
     from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
 
     topo = topologies.fat_tree_nodes(nodes)
     ls = LinkState(area=topo.area)
@@ -995,12 +997,22 @@ def route_engine_churn_bench(
     # per-event frontier probe stats (only events that hit the
     # overflow policy contribute; engine.last_* is per-probe state)
     frontier_rows, frontier_cells, frontier_jumps = [], [], []
+    # committed-dispatch accounting: per-event host touches (submit
+    # phases + reap phases, 2 = the contract) and the window's
+    # blocking-sync total (0 on the warm path — every readback was
+    # kicked at submit time)
+    touches = []
+    _reg = get_registry()
+    sync0 = _reg.counter_get("ops.blocking_syncs")
+    disp0 = _reg.counter_get("ops.host_dispatches")
     for step in range(churn_events):
         affected = churn(step)
         probe0 = engine.frontier_resolves + engine.frontier_fallbacks
         t0 = time.perf_counter()
-        out = engine.churn(ls, affected, defer_consume=True)
+        with da.event_window("bench_churn") as win:
+            out = engine.churn(ls, affected, defer_consume=True)
         samples.append((time.perf_counter() - t0) * 1000)
+        touches.append(win.touches)
         if (
             engine.frontier_resolves + engine.frontier_fallbacks
             > probe0
@@ -1023,6 +1035,21 @@ def route_engine_churn_bench(
     t0 = time.perf_counter()
     engine.flush()  # drain the tail event's delta
     drain_ms = (time.perf_counter() - t0) * 1000
+    blocking_syncs = _reg.counter_get("ops.blocking_syncs") - sync0
+    host_dispatches = _reg.counter_get("ops.host_dispatches") - disp0
+
+    # device-only per-event cost with the fixed transport cancelled:
+    # K data-dependent deferred churn dispatches against ONE drain,
+    # (T_K - T_1)/(K - 1) — the denominator of host_overhead_ratio
+    _extra = [churn_events]
+
+    def _chain_step(_prev):
+        _extra[0] += 1
+        return engine.churn(ls, churn(_extra[0]), defer_consume=True)
+
+    device_only_ms = _chained_device_only_ms(
+        _chain_step, lambda _out: engine.flush(), k=4, reps=3
+    )
 
     affected_counts = []
     rb_bytes, delta_rows, overlap_ms = [], [], []
@@ -1102,6 +1129,24 @@ def route_engine_churn_bench(
         "delta_rows_max": max(delta_rows),
         "overlap_ms_median": round(statistics.median(overlap_ms), 3),
         "pipeline_drain_ms": round(drain_ms, 3),
+        # committed-dispatch contract fields: 2 touches/event on the
+        # warm path (one submit run + one reap run), 0 blocking syncs
+        # (every readback kicked at submit), and the e2e-vs-device
+        # ratio the host-overhead runbook recipe triages from
+        "host_touches_per_event": round(
+            statistics.median(touches), 1
+        ),
+        "host_touches_max": max(touches),
+        "blocking_syncs_per_event": round(
+            blocking_syncs / max(1, churn_events), 3
+        ),
+        "host_dispatches_per_event": round(
+            host_dispatches / max(1, churn_events), 2
+        ),
+        "device_only_ms": device_only_ms,
+        "host_overhead_ratio": round(
+            statistics.median(samples) / max(device_only_ms, 1e-3), 2
+        ),
         "relay_rtt_ms": _relay_rtt_ms(),
         "platform": jax.devices()[0].platform,
         "oracle_spot_check": "passed",

@@ -23,6 +23,7 @@ C = TypeVar("C", bound=type)
 #: attribute names the markers are stored under (shared with the AST
 #: rules so both sides agree on one spelling)
 SOLVE_WINDOW_ATTR = "__openr_solve_window__"
+COMMITTED_DISPATCH_ATTR = "__openr_committed_dispatch__"
 RESIDENT_ATTR = "__openr_resident_buffers__"
 REQUIRES_DRAIN_ATTR = "__openr_requires_drain__"
 DONATES_ATTR = "__openr_donates__"
@@ -42,6 +43,23 @@ def solve_window(fn: F) -> F:
     except AttributeError:
         # jit-wrapped callables may reject attributes; the static
         # checker reads the decorator syntactically either way
+        pass
+    return fn
+
+
+def committed_dispatch(fn: F) -> F:
+    """Mark a function as committed-dispatch code: it lives on the
+    event path between SUBMIT (program launches) and REAP (async
+    readback drain), where the host may touch the device only through
+    the sanctioned ``ops.dispatch_accounting`` helpers
+    (``count_dispatch`` / ``kick_async`` / ``reap_read``). The
+    ``committed-dispatch`` rule flags raw ``jax.device_get`` /
+    ``.block_until_ready()`` / device-scalar coercion forms in the
+    function's direct body — each one is an unaccounted host round
+    trip that serializes the event window."""
+    try:
+        setattr(fn, COMMITTED_DISPATCH_ATTR, True)
+    except AttributeError:
         pass
     return fn
 

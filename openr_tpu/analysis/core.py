@@ -194,6 +194,30 @@ def decorator_info(dec: ast.AST) -> Tuple[Optional[str], Optional[ast.Call]]:
     return name, call
 
 
+def unwrap_aot_call(
+    node: ast.Call,
+) -> Optional[Tuple[str, List[ast.expr]]]:
+    """See through ``aot_call(tag, fn, (dyn...), {statics})`` (the
+    committed-dispatch executable cache, ops.aot_cache): returns the
+    wrapped dispatch's (dotted name, positional dyn-arg expressions) so
+    call-site rules — donation-hazard, sharding-spec — keep their
+    precision after a hot dispatch moves behind the AOT cache. The
+    statics mapping is intentionally dropped: statics are hashable
+    policy values (band tuples, n, k, mesh), never device buffers."""
+    callee = dotted_name(node.func)
+    if callee is None or callee.split(".")[-1] not in ("aot_call", "warm"):
+        return None
+    if len(node.args) < 3:
+        return None
+    inner = dotted_name(node.args[1])
+    if inner is None:
+        return None
+    dyn = node.args[2]
+    if not isinstance(dyn, (ast.Tuple, ast.List)):
+        return None
+    return inner, list(dyn.elts)
+
+
 def call_kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
     for kw in call.keywords:
         if kw.arg == name:

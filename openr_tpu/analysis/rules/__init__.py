@@ -1,9 +1,12 @@
-"""The seven invariant checkers. Each module exports one Rule class;
+"""The eight invariant checkers. Each module exports its Rule classes;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
 from openr_tpu.analysis.rules.donation import DonationHazardRule
-from openr_tpu.analysis.rules.hostsync import HostSyncInWindowRule
+from openr_tpu.analysis.rules.hostsync import (
+    CommittedDispatchRule,
+    HostSyncInWindowRule,
+)
 from openr_tpu.analysis.rules.lockorder import LockOrderRule
 from openr_tpu.analysis.rules.mirror_coverage import MirrorCoverageRule
 from openr_tpu.analysis.rules.retrace import RetraceRiskRule
@@ -13,6 +16,7 @@ from openr_tpu.analysis.rules.spans import SpanDisciplineRule
 ALL_RULES = (
     DonationHazardRule,
     HostSyncInWindowRule,
+    CommittedDispatchRule,
     LockOrderRule,
     SpanDisciplineRule,
     RetraceRiskRule,
@@ -22,6 +26,7 @@ ALL_RULES = (
 
 __all__ = [
     "ALL_RULES",
+    "CommittedDispatchRule",
     "DonationHazardRule",
     "HostSyncInWindowRule",
     "LockOrderRule",

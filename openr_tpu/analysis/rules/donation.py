@@ -43,6 +43,7 @@ from openr_tpu.analysis.core import (
     decorator_info,
     dotted_name,
     literal_or_none,
+    unwrap_aot_call,
 )
 
 RULE_ID = "donation-hazard"
@@ -245,12 +246,20 @@ class DonationHazardRule(Rule):
             callee = dotted_name(node.func)
             if callee is None:
                 continue
+            call_args = node.args
+            call_keywords = node.keywords
+            aot = unwrap_aot_call(node)
+            if aot is not None:
+                # dispatch behind the AOT executable cache: check the
+                # wrapped fn's signature against the dyn-arg tuple
+                callee, call_args = aot
+                call_keywords = []
             info = donators.get(callee.split(".")[-1])
             if info is None:
                 continue
             params: List[str] = info["params"]  # type: ignore[assignment]
             donated: Set[str] = info["donated"]  # type: ignore[assignment]
-            for i, arg in enumerate(node.args):
+            for i, arg in enumerate(call_args):
                 pname = params[i] if i < len(params) else None
                 if pname not in donated:
                     continue
@@ -261,7 +270,7 @@ class DonationHazardRule(Rule):
                         fault_boundary,
                     )
                 )
-            for kw in node.keywords:
+            for kw in call_keywords:
                 if kw.arg in donated:
                     findings.extend(
                         self._flag_donated_arg(

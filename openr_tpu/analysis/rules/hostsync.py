@@ -20,7 +20,19 @@ churn dispatch and its commit — the following forms are flagged:
 The rule is syntactic; only the annotated function's own body is
 scanned (nested defs get their own annotation if they need it), so a
 ``@solve_window`` marker is a precise, reviewable claim.
-"""
+
+PR 13 added the committed-dispatch contract on top: one SUBMIT and one
+REAP per event window, with every crossing routed through
+``ops.dispatch_accounting`` (``count_dispatch`` / ``kick_async`` /
+``reap_read``). ``CommittedDispatchRule`` (id ``committed-dispatch``,
+same module so the two window disciplines share one classifier) scans
+``@committed_dispatch`` bodies for the raw sync forms above — a raw
+``jax.device_get`` or ``.block_until_ready()`` between submit and reap
+is an unaccounted host round trip. One deliberate difference: the
+``np.asarray``-family calls are flagged only when their argument
+mentions a device-resident name — committed bodies legitimately do
+host-side numpy patch prep between reaps, and a host-list conversion
+breaks nothing."""
 
 from __future__ import annotations
 
@@ -65,12 +77,16 @@ def _mentions_device(expr: ast.expr) -> Optional[str]:
     return None
 
 
-def _is_solve_window(fn: ast.AST) -> bool:
+def _has_decorator(fn: ast.AST, marker: str) -> bool:
     for dec in fn.decorator_list:
         name, _call = decorator_info(dec)
-        if name is not None and name.split(".")[-1] == "solve_window":
+        if name is not None and name.split(".")[-1] == marker:
             return True
     return False
+
+
+def _is_solve_window(fn: ast.AST) -> bool:
+    return _has_decorator(fn, "solve_window")
 
 
 def _own_body_walk(fn: ast.AST) -> Iterable[ast.AST]:
@@ -138,3 +154,58 @@ class HostSyncInWindowRule(Rule):
                 dev = _mentions_device(node.func.value)
                 return f".{meth}() on device value '{dev}'"
         return None
+
+
+_ASARRAY_FAMILY = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+}
+
+
+class CommittedDispatchRule(HostSyncInWindowRule):
+    """``committed-dispatch``: inside ``@committed_dispatch`` bodies
+    the host may cross the device boundary only through the
+    ``ops.dispatch_accounting`` helpers — any raw sync form between
+    submit and reap serializes the committed event window."""
+
+    id = "committed-dispatch"
+    description = (
+        "no raw device->host sync between submit and reap in "
+        "@committed_dispatch event-path code (use "
+        "dispatch_accounting.reap_read / kick_async)"
+    )
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn, _cls in sf.functions():
+            if not _has_decorator(fn, "committed_dispatch"):
+                continue
+            for node in _own_body_walk(fn):
+                hit = self._classify(node)
+                if hit is not None:
+                    findings.append(
+                        Finding(
+                            self.id,
+                            sf.path,
+                            node.lineno,
+                            node.col_offset,
+                            f"{hit} inside @committed_dispatch "
+                            f"'{fn.name}' — a raw host round trip "
+                            "between submit and reap; route it "
+                            "through dispatch_accounting.reap_read "
+                            "(or kick_async + reap_read(kicked=True))",
+                        )
+                    )
+        return findings
+
+    def _classify(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee in _ASARRAY_FAMILY:
+                # host-list prep between reaps is legitimate on the
+                # event path; only a device operand forces a transfer
+                if not (
+                    node.args
+                    and _mentions_device(node.args[0]) is not None
+                ):
+                    return None
+        return super()._classify(node)

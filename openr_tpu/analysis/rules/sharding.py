@@ -43,6 +43,7 @@ from openr_tpu.analysis.core import (
     decorator_info,
     dotted_name,
     literal_or_none,
+    unwrap_aot_call,
 )
 from openr_tpu.analysis.rules.donation import _is_resident_name
 
@@ -163,14 +164,20 @@ class ShardingSpecRule(Rule):
                 callee = dotted_name(node.func)
                 if callee is None:
                     continue
+                call_args = list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]
+                aot = unwrap_aot_call(node)
+                if aot is not None:
+                    # dispatch behind the AOT executable cache: the
+                    # wrapped fn + its dyn-arg tuple are the real site
+                    callee, call_args = aot
                 leaf = callee.split(".")[-1]
                 declares = jitted.get(leaf)
                 if declares is not False:
                     # unknown callable or a declaring dispatch
                     continue
-                for arg in list(node.args) + [
-                    kw.value for kw in node.keywords
-                ]:
+                for arg in call_args:
                     hit = resident_in(arg)
                     if hit is not None:
                         findings.append(

@@ -43,6 +43,7 @@ from openr_tpu.analysis.annotations import fault_boundary, solve_window
 from openr_tpu.faults.supervisor import DegradationSupervisor, HealthState
 from openr_tpu.integrity import get_auditor, quarantine_active
 from openr_tpu.load.admission import AdmissionControl
+from openr_tpu.ops import dispatch_accounting as da
 from openr_tpu.telemetry import get_registry, get_tracer
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
@@ -667,9 +668,11 @@ class Decision:
         # trace span; pending is NOT reset on that path, so the next
         # publication retriggers the rebuild.
         payload = None
+        win = None
         try:
-            payload = self.supervisor.run(
-                (
+            with da.event_window("decision.rebuild") as win:
+                payload = self.supervisor.run(
+                    (
                     (
                         "warm",
                         lambda: self._solve_update(
@@ -701,6 +704,14 @@ class Decision:
                 "decision.rebuild_ms",
                 (time.perf_counter() - t_rebuild0) * 1000.0,
             )
+            if rebuild_span is not None and win is not None:
+                # the committed-dispatch discipline, visible per
+                # rebuild: 2 touches = one submit run + one reap run
+                rebuild_span.attrs.update(
+                    host_touches=win.touches,
+                    host_dispatches=win.dispatches,
+                    blocking_syncs=win.blocking_syncs,
+                )
             if trace is not None:
                 tracer.deactivate()
                 if payload is None:

@@ -294,7 +294,14 @@ def get_spf_counters() -> Dict[str, int]:
     # same snapshot so bench artifacts and the reshard-storm runbook
     # recipe read one merged view (0 when no mesh ever activated)
     _reg = _get_registry()
-    for _k in ("ops.reshard_events", "ops.shard_readback_bytes"):
+    for _k in (
+        "ops.reshard_events", "ops.shard_readback_bytes",
+        # committed-dispatch accounting: submit/reap discipline of the
+        # churn windows plus the AOT executable cache's hit economics
+        "ops.host_dispatches", "ops.blocking_syncs",
+        "ops.async_reaps", "ops.aot_compiles", "ops.aot_hits",
+        "ops.aot_fallbacks",
+    ):
         out[_k] = _reg.counter_get(_k)
     # fold in the ops-level resident-band counters under the same
     # namespace (one merged view for Decision.get_counters and the

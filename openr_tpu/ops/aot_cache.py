@@ -1,0 +1,172 @@
+"""AOT executable cache: the committed-dispatch hot path calls a
+compiled executable directly, with zero Python retrace / signature
+checks.
+
+``jax.jit``'s call path re-derives the (args -> executable) key on
+every invocation: pytree flatten, static-argument hashing, signature
+canonicalization — tens of microseconds of host work per dispatch that
+the churn path pays thousands of times per second. This cache hoists
+that work to the FIRST call per shape: ``jit(fn).lower(*dyn,
+**statics).compile()`` bakes the statics into a ``Compiled`` executable
+that is then invoked with the dynamic operands only (passing a static
+again at call time is a pytree mismatch — the statics no longer exist
+as parameters). Every later event with the same shape key goes
+``dict lookup -> executable`` and nothing else.
+
+Keying: ``(tag, statics, dynamic signature)`` where the dynamic
+signature is the pytree structure plus per-leaf (shape, dtype,
+sharding). Sharding is part of the key on purpose: an executable
+compiled for single-chip operands cannot consume mesh-sharded
+residents, and the single-chip and mesh engines of one test process
+share this process-global cache.
+
+Fallback ladder (never raises past the jitted semantics): a failed
+lower/compile poisons the key and the call rides the plain jitted
+function (``ops.aot_fallbacks``); a failed EXECUTABLE call (placement
+drift, donated-buffer reuse, transfer guards) falls back the same way
+per call. The executables themselves ride jax's persistent compilation
+cache when one is configured, so "compile on miss" is a disk load, not
+an XLA run, across processes.
+
+Counters: ``ops.aot_compiles`` / ``ops.aot_hits`` /
+``ops.aot_fallbacks``. Every call counts one committed dispatch via
+``dispatch_accounting.count_dispatch``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from openr_tpu.ops import dispatch_accounting
+from openr_tpu.telemetry import get_registry
+
+_UNCOMPILABLE = object()  # poison marker: lower/compile failed once
+
+
+def cache_dir() -> Optional[str]:
+    """Directory the persistent artifacts (autotune winners, jax's
+    compilation cache when the caller wires it) live in. None when
+    ``OPENR_CACHE_DIR`` is unset — in-memory only, no disk writes."""
+    d = os.environ.get("OPENR_CACHE_DIR")
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _leaf_sig(leaf: Any) -> Tuple:
+    if isinstance(leaf, jax.Array):
+        try:
+            sh = leaf.sharding
+        except Exception:  # noqa: BLE001 - deleted/traced arrays
+            sh = None
+        return (tuple(leaf.shape), str(leaf.dtype), sh)
+    if isinstance(leaf, np.ndarray):
+        return (tuple(leaf.shape), str(leaf.dtype), "host")
+    return (type(leaf).__name__, leaf if isinstance(
+        leaf, (bool, int, float, str, type(None))) else None)
+
+
+def signature(dyn_args: Tuple) -> Tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(dyn_args)
+    return (treedef, tuple(_leaf_sig(x) for x in leaves))
+
+
+class AotDispatchCache:
+    """Process-global (tag, statics, signature) -> Compiled map."""
+
+    def __init__(self) -> None:
+        self._exes: Dict[Tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def stats(self) -> Dict[str, int]:
+        reg = get_registry()
+        return {
+            "entries": len(self._exes),
+            "compiles": int(reg.counter_get("ops.aot_compiles")),
+            "hits": int(reg.counter_get("ops.aot_hits")),
+            "fallbacks": int(reg.counter_get("ops.aot_fallbacks")),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._exes.clear()
+
+    def _lookup(self, tag: str, fn, dyn_args: Tuple,
+                statics: Dict[str, Any]):
+        try:
+            key = (tag, tuple(sorted(statics.items())),
+                   signature(dyn_args))
+            hash(key)
+        except TypeError:
+            return None, None  # unhashable statics: jitted path
+        exe = self._exes.get(key)
+        return key, exe
+
+    def call(self, tag: str, fn, dyn_args: Tuple,
+             statics: Dict[str, Any]):
+        """Dispatch ``fn(*dyn_args, **statics)`` through the cached
+        executable for this shape key, compiling it on first miss."""
+        reg = get_registry()
+        dispatch_accounting.count_dispatch()
+        key, exe = self._lookup(tag, fn, dyn_args, statics)
+        if key is None or exe is _UNCOMPILABLE:
+            reg.counter_bump("ops.aot_fallbacks")
+            return fn(*dyn_args, **statics)
+        if exe is None:
+            try:
+                exe = fn.lower(*dyn_args, **statics).compile()
+            except Exception:  # noqa: BLE001 - poison + jitted path
+                with self._lock:
+                    self._exes[key] = _UNCOMPILABLE
+                reg.counter_bump("ops.aot_fallbacks")
+                return fn(*dyn_args, **statics)
+            with self._lock:
+                self._exes[key] = exe
+            reg.counter_bump("ops.aot_compiles")
+        else:
+            reg.counter_bump("ops.aot_hits")
+        try:
+            # dynamic operands ONLY: the statics were baked at lower
+            # time and no longer exist as parameters of the executable
+            return exe(*dyn_args)
+        except Exception:  # noqa: BLE001 - absorb into jitted path
+            reg.counter_bump("ops.aot_fallbacks")
+            return fn(*dyn_args, **statics)
+
+    def warm(self, tag: str, fn, dyn_args: Tuple,
+             statics: Dict[str, Any]) -> bool:
+        """Build (or load from jax's persistent cache) the executable
+        for this shape key without running it — the engine-construction
+        prewarm."""
+        key, exe = self._lookup(tag, fn, dyn_args, statics)
+        if key is None or exe is _UNCOMPILABLE:
+            return False
+        if exe is not None:
+            return True
+        try:
+            exe = fn.lower(*dyn_args, **statics).compile()
+        except Exception:  # noqa: BLE001 - poison, warm is best-effort
+            with self._lock:
+                self._exes[key] = _UNCOMPILABLE
+            return False
+        with self._lock:
+            self._exes[key] = exe
+        get_registry().counter_bump("ops.aot_compiles")
+        return True
+
+
+_CACHE = AotDispatchCache()
+
+
+def get_aot_cache() -> AotDispatchCache:
+    return _CACHE
+
+
+def aot_call(tag: str, fn, dyn_args: Tuple, statics: Dict[str, Any]):
+    return _CACHE.call(tag, fn, dyn_args, statics)

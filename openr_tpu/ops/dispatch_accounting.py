@@ -1,0 +1,142 @@
+"""Host-touch accounting for the committed-dispatch contract.
+
+The committed-dispatch invariant (ROADMAP "kill the host overhead"):
+one event window touches the device exactly twice — SUBMIT (every
+program launch rides one stream push, back to back) and REAP (every
+readback rides a ``copy_to_host_async`` staged at submit time, drained
+in one read run). A host round trip anywhere between those two phases
+serializes the device pipeline, which is precisely the 600x
+e2e-vs-device gap BENCH_r05 measured.
+
+This module is the ONE sanctioned crossing point. Event-path code
+never calls ``jax.device_get`` / ``.block_until_ready`` directly (the
+``committed-dispatch`` lint rule enforces that); it calls:
+
+- ``count_dispatch()``   — a device program was launched,
+- ``kick_async(arr)``    — stage a readback on the async lane (free:
+  rides the dispatch stream, not a host touch),
+- ``reap_read(arr, kicked=...)`` — materialize a readback on host.
+  ``kicked=True`` means the transfer was staged earlier and the reap
+  normally finds it landed (counted ``ops.async_reaps``);
+  ``kicked=False`` is a genuine blocking device->host sync (counted
+  ``ops.blocking_syncs``).
+
+``event_window(tag)`` brackets one event: consecutive dispatches
+collapse into one submit phase and consecutive reads into one read
+phase, so ``touches = submit_phases + read_phases`` is exactly the
+number of times the host turned the device around. Per-window touches
+feed the ``ops.host_touches`` histogram; the counters
+``ops.host_dispatches`` / ``ops.blocking_syncs`` / ``ops.async_reaps``
+accumulate globally (windowed or not). Re-entrant: an inner
+``event_window`` joins the active one, so a coalesced churn window
+spanning N folded events still reads as ONE submit + ONE reap.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Iterator, Optional
+
+import jax
+
+from openr_tpu.telemetry import get_registry
+
+_TLS = threading.local()
+
+
+class EventWindow:
+    """Phase accounting for one committed event window."""
+
+    __slots__ = (
+        "tag", "dispatches", "blocking_syncs", "async_reaps",
+        "submit_phases", "read_phases", "_last",
+    )
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.dispatches = 0
+        self.blocking_syncs = 0
+        self.async_reaps = 0
+        self.submit_phases = 0
+        self.read_phases = 0
+        self._last: Optional[str] = None
+
+    def _mark(self, phase: str) -> None:
+        if self._last != phase:
+            if phase == "submit":
+                self.submit_phases += 1
+            else:
+                self.read_phases += 1
+            self._last = phase
+
+    @property
+    def touches(self) -> int:
+        return self.submit_phases + self.read_phases
+
+
+def current_window() -> Optional[EventWindow]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def event_window(tag: str = "event") -> Iterator[EventWindow]:
+    """Bracket one committed event. Joins an already-active window
+    (same thread) instead of nesting, so the OUTERMOST caller owns the
+    per-event touch observation."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    if stack:
+        yield stack[-1]
+        return
+    w = EventWindow(tag)
+    stack.append(w)
+    try:
+        yield w
+    finally:
+        stack.pop()
+        reg = get_registry()
+        reg.observe("ops.host_touches", float(w.touches))
+        reg.observe(f"ops.host_touches.{w.tag}", float(w.touches))
+
+
+def count_dispatch(n: int = 1) -> None:
+    """Record n device program launches (one submit phase while
+    consecutive)."""
+    get_registry().counter_bump("ops.host_dispatches", n)
+    w = current_window()
+    if w is not None:
+        w.dispatches += n
+        w._mark("submit")
+
+
+def kick_async(arr) -> None:
+    """Stage a device->host transfer on the async readback lane.
+    Not a host touch: the copy rides the device stream and lands while
+    the host does other work. Host shim arrays pass through."""
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
+def reap_read(arr, kicked: bool = False):
+    """Materialize one readback on host (the sanctioned
+    ``jax.device_get`` crossing). ``kicked=True`` asserts the transfer
+    was staged via ``kick_async`` earlier — an async reap, not a
+    blocking sync."""
+    reg = get_registry()
+    w = current_window()
+    if kicked:
+        reg.counter_bump("ops.async_reaps")
+        if w is not None:
+            w.async_reaps += 1
+    else:
+        reg.counter_bump("ops.blocking_syncs")
+        if w is not None:
+            w.blocking_syncs += 1
+    if w is not None:
+        w._mark("read")
+    return jax.device_get(arr)

@@ -71,6 +71,7 @@ on delta) at the network-wide scale.
 from __future__ import annotations
 
 import functools
+import os
 import random
 import time
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
@@ -90,12 +91,15 @@ from openr_tpu.ops.spf_sparse import (
     pad_patch_rows,
 )
 from openr_tpu.analysis.annotations import (
+    committed_dispatch,
     fault_boundary,
     mirrored_by,
     requires_drain,
     resident_buffers,
     solve_window,
 )
+from openr_tpu.ops import dispatch_accounting as da
+from openr_tpu.ops.aot_cache import aot_call, get_aot_cache
 from openr_tpu.faults.injector import (
     consume_fault,
     fault_point,
@@ -796,16 +800,23 @@ class PendingDelta:
 
     __slots__ = (
         "_engine", "segs", "counts", "ch_counts", "k", "dslices",
-        "consumed", "names", "delta_rows", "readback_bytes",
-        "overlap_ms",
+        "fw_count", "consumed", "names", "delta_rows",
+        "readback_bytes", "overlap_ms",
     )
 
-    def __init__(self, engine, segs, counts, ch_counts, k):
+    def __init__(self, engine, segs, counts, ch_counts, k,
+                 fw_count=None):
         self._engine = engine
         self.segs = segs          # per-shard device [k+1, 1+W] arrays
         self.counts = counts      # per-shard affected counts
         self.ch_counts = ch_counts  # per-shard CHANGED counts
         self.k = k
+        # FULL-WIDTH mode (fw_count is a device scalar): the segment is
+        # a _compact_changed output [n_pad, 1+W] whose changed rows
+        # start at ROW 0 and whose count has not crossed to host yet —
+        # the count rides the async lane now and is reaped at consume
+        # time, so even the overflow rungs keep the two-touch window
+        self.fw_count = fw_count
         self.consumed = False
         self.names: List[str] = []
         self.delta_rows = 0
@@ -821,10 +832,12 @@ class PendingDelta:
             if m:
                 if isinstance(seg, jax.Array):
                     sl = _rows_slice(seg, 1, int(m))
-                    sl.copy_to_host_async()
+                    da.kick_async(sl)
                 else:  # host shim arrays
                     sl = seg[1 : 1 + m]
             self.dslices.append(sl)
+        if fw_count is not None:
+            da.kick_async(fw_count)
 
     def wait(self) -> List[str]:
         if not self.consumed:
@@ -942,19 +955,26 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip cold
             # build (mesh is None): one device, no axis to spec
-            return _full_resident_sweep(
+            return aot_call(
+                "ell_full_resident", _full_resident_sweep,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t,
+                    self.sweeper.overloaded,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(bands=graph.bands, n=graph.n_pad),
+            )
+        return aot_call(
+            "ell_full_resident_sharded", _sharded_full_resident,
+            (
                 self.sweeper.v_t, self.sweeper.w_t,
                 self.sweeper.overloaded,
                 self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                graph.bands, graph.n_pad,
-            )
-        return _sharded_full_resident(
-            self.sweeper.v_t, self.sweeper.w_t,
-            self.sweeper.overloaded,
-            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-            graph.bands, graph.n_pad, self.mesh,
+            ),
+            dict(bands=graph.bands, n=graph.n_pad, mesh=self.mesh),
         )
 
     @requires_drain("flush")
@@ -1086,12 +1106,16 @@ class RouteSweepEngine(ResidentEngineContract):
         }
 
     @solve_window
+    @committed_dispatch
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         """Backend hook: one detect+solve dispatch at bucket size k.
         Returns (segments, commit_state) where segments are per-shard
         IN-FLIGHT device arrays [k+1, 1+W] — nothing is copied to host
         here; the caller reads the tiny meta row for the retry ladder
-        and the changed rows only at consume time."""
+        and the changed rows only at consume time. Every launch goes
+        through the AOT executable cache (aot_call): after warmup the
+        event window runs a pre-compiled XLA program with zero Python
+        retrace/signature checks on the hot path."""
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         fault_point(FAULT_DISPATCH)
         fault_point(FAULT_DEVICE_LOST)
@@ -1101,16 +1125,19 @@ class RouteSweepEngine(ResidentEngineContract):
              # openr-lint: disable=sharding-spec -- single-chip churn
              # dispatch (mesh is None): no mesh axis to spec; the mesh
              # branch below rides _sharded_churn_step's shard_map specs
-             packed_dev) = _churn_step(
-                ctx["in_v"], ctx["in_w"],
-                ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
-                self._dr, self._digests_dev, self._packed_dev,
-                e_u_d, e_v_d, e_wo_d, e_wn_d,
-                ov_new,
-                self.sweeper._samp_ids_dev,
-                self.sweeper._samp_v_dev,
-                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                graph.bands, graph.n_pad, k,
+             packed_dev) = aot_call(
+                "ell_churn_step", _churn_step,
+                (
+                    ctx["in_v"], ctx["in_w"],
+                    ctx["patch_ids"], ctx["patch_v"], ctx["patch_w"],
+                    self._dr, self._digests_dev, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(bands=graph.bands, n=graph.n_pad, k=k),
             )
             # the fused step already patched the bands on device: cache
             # them so an overflow's _apply_patch_resident adopts these
@@ -1124,15 +1151,21 @@ class RouteSweepEngine(ResidentEngineContract):
             if ctx["patched_bands"] is None:
                 ctx["patched_bands"] = self._dispatch_patch(ctx)
             new_v, new_w_t = ctx["patched_bands"]
-            dr, digests, packed_res, packed_dev = _sharded_churn_step(
-                new_v, new_w_t,
-                self._dr, self._digests_dev, self._packed_dev,
-                e_u_d, e_v_d, e_wo_d, e_wn_d,
-                ov_new,
-                self.sweeper._samp_ids_dev,
-                self.sweeper._samp_v_dev,
-                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                graph.bands, graph.n_pad, k, self.mesh,
+            dr, digests, packed_res, packed_dev = aot_call(
+                "ell_churn_step_sharded", _sharded_churn_step,
+                (
+                    new_v, new_w_t,
+                    self._dr, self._digests_dev, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    bands=graph.bands, n=graph.n_pad, k=k,
+                    mesh=self.mesh,
+                ),
             )
             segments = self._split_segments(packed_dev, k)
         return segments, (new_v, new_w_t, dr, digests, packed_res)
@@ -1222,7 +1255,8 @@ class RouteSweepEngine(ResidentEngineContract):
         for nm in ov_flips:
             self._ov_host[nm] = ls.is_node_overloaded(nm)
 
-    def _full_refresh(self, ls, ctx, ov_new, new_out, ov_flips):
+    def _full_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
+                      defer=False):
         """Overflow path: the affected-row count exceeds every solve
         bucket (a fat-tree link up/down affects EVERY destination row
         through ECMP next-hop churn), so re-solving a subset saves
@@ -1246,20 +1280,27 @@ class RouteSweepEngine(ResidentEngineContract):
         self.full_refreshes += 1
         get_registry().counter_bump("route_engine.full_refreshes")
         return self._commit_full_width(
-            ls, dr, digests, packed, new_out, ov_flips
+            ls, dr, digests, packed, new_out, ov_flips, defer=defer
         )
 
+    @committed_dispatch
     def _commit_full_width(self, ls, dr, digests, packed, new_out,
-                           ov_flips):
+                           ov_flips, defer=False):
         """Shared commit tail of the full-width refresh and the
         frontier re-solve: both produce a complete (dr, digests,
         packed) product in one wide dispatch, compact the diff on
-        device, and apply only the changed rows on host."""
+        device, and apply only the changed rows on host. With
+        ``defer=True`` the changed count stays an in-flight device
+        scalar riding the async lane (PendingDelta full-width mode):
+        the overflow rungs then also submit-and-walk-away, keeping the
+        committed two-touch event window."""
         # openr-lint: disable=sharding-spec -- elementwise diff of
         # two committed operands: propagation keeps their (identical)
         # placements; overflow rung, not the steady-state churn path
-        ch_count, comp = _compact_changed(
-            packed, self._packed_dev, self.graph.n
+        ch_count, comp = aot_call(
+            "compact_changed", _compact_changed,
+            (packed, self._packed_dev),
+            dict(n=self.graph.n),
         )
         self._dr = dr
         self._digests_dev = digests
@@ -1271,11 +1312,19 @@ class RouteSweepEngine(ResidentEngineContract):
         # at the top bucket (one dispatch) instead of re-climbing the
         # ladder; small events decay the hint back down as usual
         self._k_hint = _ROW_BUCKETS[-1]
-        m = int(jax.device_get(ch_count))
+        if defer:
+            pending = PendingDelta(
+                self, [comp], [-1], [None], int(comp.shape[0]),
+                fw_count=ch_count,
+            )
+            self._pending = pending
+            return pending
+        da.kick_async(ch_count)
+        m = int(da.reap_read(ch_count, kicked=True))
         names: List[str] = []
         if m:
             names = self._apply_delta_rows(
-                jax.device_get(_rows_slice(comp, 0, m))
+                da.reap_read(_rows_slice(comp, 0, m))
             )
         bytes_read = m * comp.shape[1] * 4 + 4  # rows + the scalar
         self.last_delta_rows = m
@@ -1308,17 +1357,27 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # probe (mesh is None): no mesh axis to spec
-            return _frontier_probe(
+            return aot_call(
+                "ell_frontier_probe", _frontier_probe,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, self._dr,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+                ),
+                dict(
+                    bands=self.graph.bands, n=self.graph.n_pad,
+                    max_jumps=_FRONTIER_MAX_JUMPS,
+                ),
+            )
+        return aot_call(
+            "ell_frontier_probe_sharded", _sharded_frontier_probe,
+            (
                 self.sweeper.v_t, self.sweeper.w_t, self._dr,
                 e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
-                self.graph.bands, self.graph.n_pad,
-                _FRONTIER_MAX_JUMPS,
-            )
-        return _sharded_frontier_probe(
-            self.sweeper.v_t, self.sweeper.w_t, self._dr,
-            e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
-            self.graph.bands, self.graph.n_pad,
-            _FRONTIER_MAX_JUMPS, self.mesh,
+            ),
+            dict(
+                bands=self.graph.bands, n=self.graph.n_pad,
+                max_jumps=_FRONTIER_MAX_JUMPS, mesh=self.mesh,
+            ),
         )
 
     @solve_window
@@ -1333,23 +1392,34 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # re-solve (mesh is None): no mesh axis to spec
-            return _frontier_step(
+            return aot_call(
+                "ell_frontier_step", _frontier_step,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+                    self.sweeper.overloaded,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(bands=self.graph.bands, n=self.graph.n_pad),
+            )
+        return aot_call(
+            "ell_frontier_step_sharded", _sharded_frontier_step,
+            (
                 self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
                 self.sweeper.overloaded,
                 self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                self.graph.bands, self.graph.n_pad,
-            )
-        return _sharded_frontier_step(
-            self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
-            self.sweeper.overloaded,
-            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-            self.graph.bands, self.graph.n_pad, self.mesh,
+            ),
+            dict(
+                bands=self.graph.bands, n=self.graph.n_pad,
+                mesh=self.mesh,
+            ),
         )
 
+    @committed_dispatch
     def _overflow_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
-                          e_dev):
+                          e_dev, defer=False):
         """Overflow policy: the affected-row count exceeded every
         solve bucket. Probe the affected cone on device first; when
         the cone converged under the row budget
@@ -1385,7 +1455,11 @@ class RouteSweepEngine(ResidentEngineContract):
                 reg.counter_bump("route_engine.frontier_errors")
             if probe is not None:
                 cone, meta = probe
-                meta = jax.device_get(meta)  # 16-byte policy readback
+                # 16-byte policy readback: kicked onto the async lane
+                # so the decision read folds into the window's single
+                # read phase instead of a dedicated blocking sync
+                da.kick_async(meta)
+                meta = da.reap_read(meta, kicked=True)
                 rows, jumps = int(meta[0]), int(meta[2])
                 cells = float(meta[1])
                 converged = bool(meta[3])
@@ -1398,12 +1472,13 @@ class RouteSweepEngine(ResidentEngineContract):
                 if converged and cells <= limit:
                     path = "frontier"
                     return self._frontier_refresh(
-                        ls, ctx, ov_new, new_out, ov_flips, cone
+                        ls, ctx, ov_new, new_out, ov_flips, cone,
+                        defer=defer,
                     )
             self.frontier_fallbacks += 1
             reg.counter_bump("ops.frontier_fallbacks")
             return self._full_refresh(
-                ls, ctx, ov_new, new_out, ov_flips
+                ls, ctx, ov_new, new_out, ov_flips, defer=defer
             )
         finally:
             tracer.end_span_active(
@@ -1412,7 +1487,7 @@ class RouteSweepEngine(ResidentEngineContract):
             )
 
     def _frontier_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
-                          cone):
+                          cone, defer=False):
         """Frontier path: adopt the band patch resident, then one
         masked dispatch seeds cone cells at INF while every other cell
         keeps its resident distance. Bit-identical to the cold solve
@@ -1425,7 +1500,7 @@ class RouteSweepEngine(ResidentEngineContract):
         self.frontier_resolves += 1
         get_registry().counter_bump("route_engine.frontier_resolves")
         return self._commit_full_width(
-            ls, dr, digests, packed, new_out, ov_flips
+            ls, dr, digests, packed, new_out, ov_flips, defer=defer
         )
 
     def flush(self):
@@ -1450,13 +1525,16 @@ class RouteSweepEngine(ResidentEngineContract):
         names = self.graph.node_names
         return [names[int(t)] for t in rows[:, 0]]
 
+    @committed_dispatch
     def _consume_pending(self, overlap: bool):
         """Drain the pending delta: read each shard's changed rows
         (O(changed) transfer) and apply them in place. When ``overlap``
         is True this runs INSIDE the next event's dispatch window —
         the host-side apply and the device solve proceed concurrently
         (the double-buffer payoff, recorded as
-        ops.route_engine.overlap_ms)."""
+        ops.route_engine.overlap_ms). This is the window's REAP side:
+        every read rides a copy kicked async at PendingDelta creation,
+        so the host normally finds the bytes already landed."""
         p = self._pending
         if p is None:
             return None
@@ -1476,13 +1554,32 @@ class RouteSweepEngine(ResidentEngineContract):
         total_bytes = 0
         for seg, sl, m in zip(p.segs, p.dslices, p.ch_counts):
             t_sh = time.perf_counter()
+            if m is None:
+                # FULL-WIDTH pending: the changed count rode the async
+                # lane since the overflow commit; reap it, then pull
+                # exactly the changed rows (compacted from ROW 0 — a
+                # _compact_changed segment carries no meta row)
+                m = int(da.reap_read(p.fw_count, kicked=True))
+                shard_bytes = 4
+                if m:
+                    names.extend(self._apply_delta_rows(
+                        da.reap_read(_rows_slice(seg, 0, m))
+                    ))
+                    total_rows += m
+                    shard_bytes += m * seg.shape[1] * 4
+                total_bytes += shard_bytes
+                continue
             # meta row already crossed (retry ladder); count it
             shard_bytes = seg.shape[1] * 4
             if m:
                 # the per-shard copy was kicked async at PendingDelta
-                # creation: device_get here normally finds the host
-                # value already landed (explicit, guard-exempt)
-                names.extend(self._apply_delta_rows(jax.device_get(sl)))
+                # creation: the reap normally finds the host value
+                # already landed (explicit, guard-exempt)
+                rows = (
+                    da.reap_read(sl, kicked=True)
+                    if isinstance(sl, jax.Array) else np.asarray(sl)
+                )
+                names.extend(self._apply_delta_rows(rows))
                 total_rows += m
                 shard_bytes += m * seg.shape[1] * 4
             total_bytes += shard_bytes
@@ -1531,6 +1628,19 @@ class RouteSweepEngine(ResidentEngineContract):
             )
         return self.churn(ls, union, defer_consume=defer_consume)
 
+    def churn_window(self, ls, affected_sets, defer_consume=False):
+        """Committed-dispatch entry point for one debounce window: N
+        debounced events become ONE device program under ONE
+        accounting window (``ops.host_touches.churn_window``). The
+        batched result is bit-identical to N sequential ``churn()``
+        calls — same union-diff argument as ``churn_coalesced`` — but
+        the host only touches the device twice: once to submit the
+        fused dispatch chain, once to reap the compacted delta."""
+        with da.event_window("churn_window"):
+            return self.churn_coalesced(
+                ls, affected_sets, defer_consume=defer_consume
+            )
+
     def churn(self, ls, affected_nodes: Set[str],
               defer_consume: bool = False):
         """Apply one churn event, SUPERVISED: the degradation ladder
@@ -1548,6 +1658,12 @@ class RouteSweepEngine(ResidentEngineContract):
         if consume_fault(FAULT_CORRUPT):
             self._corrupt_events += 1
             self.corrupt_resident(self._corrupt_events)
+        with da.event_window("churn"):
+            return self._churn_supervised(ls, affected_nodes,
+                                          defer_consume)
+
+    def _churn_supervised(self, ls, affected_nodes: Set[str],
+                          defer_consume: bool = False):
         return self.supervisor.run((
             ("warm", lambda: self._rung_guard(
                 self._churn_device, ls, affected_nodes, defer_consume
@@ -1590,10 +1706,14 @@ class RouteSweepEngine(ResidentEngineContract):
         path, which must not pay the host layout recompile."""
         return rs.RouteSweeper(graph, self.sample_names, plan=self.plan)
 
+    @committed_dispatch
     def _probe_device(self, dev) -> bool:
         """Liveness probe for one mesh device (monkeypatchable: tests
         and the chaos harness simulate partial mesh loss here)."""
         try:
+            # openr-lint: disable=committed-dispatch -- liveness probe:
+            # the blocking sync IS the signal (recover rung, never on
+            # the warm submit/reap path)
             jax.device_put(
                 np.zeros((), np.int32), dev
             ).block_until_ready()
@@ -1865,6 +1985,7 @@ class RouteSweepEngine(ResidentEngineContract):
         return None
 
     @fault_boundary
+    @committed_dispatch
     def _churn_device(self, ls, affected_nodes: Set[str],
                       defer_consume: bool = False):
         """Ladder rung 0 (warm): one incremental device event. Returns
@@ -2004,25 +2125,33 @@ class RouteSweepEngine(ResidentEngineContract):
             segments, commit_state = self._run_bucket(
                 ctx, k, e_dev, ov_new
             )
-            if not overlapped:
-                # the overlap window: the PREVIOUS event's delta is
-                # consumed on host while this dispatch solves on device
-                self._consume_pending(overlap=True)
-                overlapped = True
-            # kick every shard's 8-byte meta copy before reading any:
-            # the transfers ride all devices' readback lanes
-            # concurrently instead of draining one shard at a time
+            # kick every shard's 8-byte meta copy while still in the
+            # SUBMIT phase: the transfers ride all devices' readback
+            # lanes concurrently instead of draining one shard at a
+            # time, and the window's host touches stay at two
+            # (submit everything, then reap everything)
             meta_rows = [
                 _seg_meta(seg) if isinstance(seg, jax.Array)
                 else seg[0, :2]
                 for seg in segments
             ]
+            n_meta = sum(
+                1 for seg in segments if isinstance(seg, jax.Array)
+            )
+            if n_meta:
+                da.count_dispatch(n_meta)
             for mrow in meta_rows:
-                try:
-                    mrow.copy_to_host_async()
-                except AttributeError:
-                    pass
-            metas = [jax.device_get(mrow) for mrow in meta_rows]
+                da.kick_async(mrow)
+            if not overlapped:
+                # the overlap window: the PREVIOUS event's delta is
+                # consumed on host while this dispatch solves on device
+                self._consume_pending(overlap=True)
+                overlapped = True
+            metas = [
+                da.reap_read(mrow, kicked=True)
+                if isinstance(mrow, jax.Array) else mrow
+                for mrow in meta_rows
+            ]
             counts = [int(m[0]) for m in metas]
             ch_counts = [int(m[1]) for m in metas]
             if max(counts) <= k:
@@ -2032,7 +2161,8 @@ class RouteSweepEngine(ResidentEngineContract):
             # overflow policy pick frontier re-solve vs full-width
             # refresh (no host recompile on either path)
             return self._overflow_refresh(
-                ls, ctx, ov_new, new_out, ov_flips, e_dev
+                ls, ctx, ov_new, new_out, ov_flips, e_dev,
+                defer=defer_consume,
             )
         # hint tracks the typical event size (decays toward small)
         self._k_hint = max(
@@ -2457,19 +2587,30 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip cold
             # build (mesh is None): one device, no axis to spec
-            return _grouped_full_resident(
+            return aot_call(
+                "grouped_full_resident", _grouped_full_resident,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t,
+                    self.sweeper.overloaded,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(meta=self.sweeper.meta, n=graph.n_pad, impl=impl),
+            )
+        return aot_call(
+            "grouped_full_resident_sharded",
+            _sharded_grouped_full_resident,
+            (
                 self.sweeper.v_t, self.sweeper.w_t,
                 self.sweeper.overloaded,
                 self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                self.sweeper.meta, graph.n_pad, impl,
-            )
-        return _sharded_grouped_full_resident(
-            self.sweeper.v_t, self.sweeper.w_t,
-            self.sweeper.overloaded,
-            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-            self.sweeper.meta, graph.n_pad, self.mesh, impl,
+            ),
+            dict(
+                meta=self.sweeper.meta, n=graph.n_pad,
+                mesh=self.mesh, impl=impl,
+            ),
         )
 
     def _refresh_sample_bands(self, patched, affected_nodes) -> bool:
@@ -2531,6 +2672,7 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         }
 
     @solve_window
+    @committed_dispatch
     def _run_bucket(self, ctx, k, e_dev, ov_new):
         e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
         fault_point(FAULT_DISPATCH)
@@ -2542,16 +2684,22 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             (new_w, dr, digests, packed_res,
              # openr-lint: disable=sharding-spec -- single-chip churn
              # dispatch (mesh is None): no mesh axis to spec
-             packed_dev) = _grouped_churn_step(
-                self.sweeper.v_t, self.sweeper.w_t,
-                upd_g, upd_s, upd_r, upd_w,
-                self._dr, self._digests_dev, self._packed_dev,
-                e_u_d, e_v_d, e_wo_d, e_wn_d,
-                ov_new,
-                self.sweeper._samp_ids_dev,
-                self.sweeper._samp_v_dev,
-                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                self.sweeper.meta, graph.n_pad, k, impl,
+             packed_dev) = aot_call(
+                "grouped_churn_step", _grouped_churn_step,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t,
+                    upd_g, upd_s, upd_r, upd_w,
+                    self._dr, self._digests_dev, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    meta=self.sweeper.meta, n=graph.n_pad, k=k,
+                    impl=impl,
+                ),
             )
             # cache the fused step's on-device segment patch for an
             # overflow's _apply_patch_resident (mirrors the ELL path)
@@ -2563,15 +2711,21 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
                 ctx["patched_segs"] = self._dispatch_patch(ctx)
             new_w = ctx["patched_segs"]
             (dr, digests, packed_res,
-             packed_dev) = _sharded_grouped_churn_step(
-                self.sweeper.v_t, new_w,
-                self._dr, self._digests_dev, self._packed_dev,
-                e_u_d, e_v_d, e_wo_d, e_wn_d,
-                ov_new,
-                self.sweeper._samp_ids_dev,
-                self.sweeper._samp_v_dev,
-                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                self.sweeper.meta, graph.n_pad, k, self.mesh, impl,
+             packed_dev) = aot_call(
+                "grouped_churn_step_sharded", _sharded_grouped_churn_step,
+                (
+                    self.sweeper.v_t, new_w,
+                    self._dr, self._digests_dev, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d,
+                    ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    meta=self.sweeper.meta, n=graph.n_pad, k=k,
+                    mesh=self.mesh, impl=impl,
+                ),
             )
             segments = self._split_segments(packed_dev, k)
         return segments, (new_w, dr, digests, packed_res)
@@ -2620,17 +2774,28 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # probe (mesh is None): no mesh axis to spec
-            return _grouped_frontier_probe(
+            return aot_call(
+                "grouped_frontier_probe", _grouped_frontier_probe,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, self._dr,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
+                ),
+                dict(
+                    meta=self.sweeper.meta, n=self.graph.n_pad,
+                    max_jumps=_FRONTIER_MAX_JUMPS,
+                ),
+            )
+        return aot_call(
+            "grouped_frontier_probe_sharded",
+            _sharded_grouped_frontier_probe,
+            (
                 self.sweeper.v_t, self.sweeper.w_t, self._dr,
                 e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
-                self.sweeper.meta, self.graph.n_pad,
-                _FRONTIER_MAX_JUMPS,
-            )
-        return _sharded_grouped_frontier_probe(
-            self.sweeper.v_t, self.sweeper.w_t, self._dr,
-            e_u_d, e_v_d, e_wo_d, e_wn_d, lim,
-            self.sweeper.meta, self.graph.n_pad,
-            _FRONTIER_MAX_JUMPS, self.mesh,
+            ),
+            dict(
+                meta=self.sweeper.meta, n=self.graph.n_pad,
+                max_jumps=_FRONTIER_MAX_JUMPS, mesh=self.mesh,
+            ),
         )
 
     @solve_window
@@ -2642,17 +2807,31 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # re-solve (mesh is None): no mesh axis to spec
-            return _grouped_frontier_step(
+            return aot_call(
+                "grouped_frontier_step", _grouped_frontier_step,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
+                    self.sweeper.overloaded,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    meta=self.sweeper.meta, n=self.graph.n_pad,
+                    impl=impl,
+                ),
+            )
+        return aot_call(
+            "grouped_frontier_step_sharded",
+            _sharded_grouped_frontier_step,
+            (
                 self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
                 self.sweeper.overloaded,
                 self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
                 self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-                self.sweeper.meta, self.graph.n_pad, impl,
-            )
-        return _sharded_grouped_frontier_step(
-            self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
-            self.sweeper.overloaded,
-            self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
-            self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
-            self.sweeper.meta, self.graph.n_pad, self.mesh, impl,
+            ),
+            dict(
+                meta=self.sweeper.meta, n=self.graph.n_pad,
+                mesh=self.mesh, impl=impl,
+            ),
         )

@@ -34,6 +34,7 @@ at INF = 2**30 - 1 (int32-safe: INF + INF == 2**31 - 2 < 2**31 - 1).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -55,20 +56,34 @@ def _mask_transit_rows(d: jnp.ndarray, overloaded: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(overloaded[:, None], ident_row, d)
 
 
-# min-plus implementation selector: "jnp" (XLA fused broadcast+reduce) or
-# "pallas" (explicit VMEM tiling, openr_tpu.ops.pallas_minplus). The bench
-# probes pallas on real TPU and falls back on any failure.
-_MINPLUS_IMPL = "jnp"
+# min-plus implementation selector: "jnp" (XLA fused broadcast+reduce),
+# "pallas" (explicit VMEM tiling, openr_tpu.ops.pallas_minplus), or
+# "auto" — a MEASURED per-shape winner picked by ops.autotune at the
+# first eager call for each operand shape (the jnp-vs-pallas winner
+# flips with shape and hardware; see ops/autotune.py). Resolution
+# happens in the public wrappers below, before jit entry, so traces
+# only ever see a concrete impl as their static argument.
+_MINPLUS_IMPL = os.environ.get("OPENR_MINPLUS", "jnp")
 
 
 def set_minplus_impl(impl: str) -> None:
     global _MINPLUS_IMPL
-    assert impl in ("jnp", "pallas"), impl
+    assert impl in ("jnp", "pallas", "auto"), impl
     _MINPLUS_IMPL = impl
 
 
 def get_minplus_impl() -> str:
     return _MINPLUS_IMPL
+
+
+def _impl_for(shape) -> str:
+    """Concrete impl for one dispatch: "auto" resolves to the measured
+    per-shape winner ([rows, n] against [n, n])."""
+    if _MINPLUS_IMPL != "auto":
+        return _MINPLUS_IMPL
+    from openr_tpu.ops import autotune
+
+    return autotune.resolve_minplus(tuple(shape))
 
 
 def _minplus(a: jnp.ndarray, b: jnp.ndarray, impl: str = "jnp") -> jnp.ndarray:
@@ -119,7 +134,7 @@ def all_pairs_distances(
     w: [N, N] one-hop metric matrix (INF = no edge). Diagonal is forced
     to 0. overloaded: [N] bool transit-exclusion mask.
     """
-    return _all_pairs_distances(w, overloaded, _MINPLUS_IMPL)
+    return _all_pairs_distances(w, overloaded, _impl_for(w.shape))
 
 
 @functools.partial(jax.jit, static_argnames=("impl",))
@@ -155,7 +170,10 @@ def distances_from_sources(
     Bellman-Ford over the transit-masked one-hop matrix. Initial rows are
     the sources' direct edges (so an overloaded source still originates).
     """
-    return _distances_from_sources(w, overloaded, src_ids, _MINPLUS_IMPL)
+    return _distances_from_sources(
+        w, overloaded, src_ids,
+        _impl_for((src_ids.shape[0], w.shape[-1])),
+    )
 
 
 @jax.jit
@@ -290,7 +308,8 @@ def spf_view_batch(
     toward j.
     """
     packed = _spf_view_batch(
-        metric, overloaded, srcs, use_link_metric, _MINPLUS_IMPL
+        metric, overloaded, srcs, use_link_metric,
+        _impl_for((srcs.shape[0], metric.shape[-1])),
     )
     b = srcs.shape[0]
     return packed[:b], packed[b:].astype(jnp.bool_)
@@ -306,7 +325,8 @@ def spf_view_batch_packed(
     (rows [0, B) distances, rows [B, 2B) first-hop 0/1) so the host pays
     exactly one device->host transfer."""
     return _spf_view_batch(
-        metric, overloaded, srcs, use_link_metric, _MINPLUS_IMPL
+        metric, overloaded, srcs, use_link_metric,
+        _impl_for((srcs.shape[0], metric.shape[-1])),
     )
 
 
@@ -345,7 +365,7 @@ def reconverge_step(
     """
     return _reconverge_step(
         metric, patch_ids, patch_vals, overloaded, srcs, use_link_metric,
-        _MINPLUS_IMPL,
+        _impl_for((srcs.shape[0], metric.shape[-1])),
     )
 
 
@@ -378,5 +398,6 @@ def spf_from_source_with_first_hops(
     Returns (d_src [N], d_all [N, N], first_hops [N, N] bool).
     """
     return _spf_from_source_with_first_hops(
-        metric, hop, overloaded, src_id, use_link_metric, _MINPLUS_IMPL
+        metric, hop, overloaded, src_id, use_link_metric,
+        _impl_for(metric.shape),
     )

@@ -53,6 +53,7 @@ renumbering.
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -71,20 +72,30 @@ from openr_tpu.ops.spf_sparse import (
 )
 
 # Relaxation contraction backend: "jnp" leaves the broadcast+min-reduce
-# to XLA's fuser; "pallas" runs ops.pallas_grouped.batched_minplus
-# (explicit VMEM tiling). Like the dense path (ops.spf minplus), the
-# bench probes both ON REAL HARDWARE and runs the winner.
-_GROUPED_IMPL = "jnp"
+# to XLA's fuser; "pallas"/"pallas_t" run ops.pallas_grouped (explicit
+# VMEM tiling); "auto" resolves to a MEASURED winner via ops.autotune
+# (coarse: one representative block shape per platform — the grouped
+# contraction's tiling is dominated by platform, not by the exact
+# segment dims). Like the dense path (ops.spf minplus), the bench also
+# probes all three ON REAL HARDWARE and can pin the winner explicitly.
+_GROUPED_IMPL = os.environ.get("OPENR_GROUPED_IMPL", "jnp")
+
+# representative [B, G, S, R] probe block for the "auto" measurement
+_AUTO_PROBE_SHAPE = (32, 8, 8, 16)
 
 
 def set_grouped_impl(impl: str) -> None:
     global _GROUPED_IMPL
-    assert impl in ("jnp", "pallas", "pallas_t"), impl
+    assert impl in ("jnp", "pallas", "pallas_t", "auto"), impl
     _GROUPED_IMPL = impl
 
 
 def get_grouped_impl() -> str:
-    return _GROUPED_IMPL
+    if _GROUPED_IMPL != "auto":
+        return _GROUPED_IMPL
+    from openr_tpu.ops import autotune
+
+    return autotune.resolve_grouped(_AUTO_PROBE_SHAPE)
 
 
 def _contract(gath, w, impl):

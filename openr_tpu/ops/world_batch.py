@@ -71,6 +71,9 @@ import numpy as np
 from openr_tpu.faults import consume_fault, fault_point, is_device_loss
 from openr_tpu.integrity import ResidentEngineContract, get_auditor
 from openr_tpu.integrity import kernels as integrity_kernels
+from openr_tpu.analysis.annotations import committed_dispatch
+from openr_tpu.ops import dispatch_accounting as da
+from openr_tpu.ops.aot_cache import aot_call
 from openr_tpu.ops.route_engine import (
     FAULT_CORRUPT,
     FAULT_DEVICE_LOST,
@@ -319,6 +322,23 @@ class WorldManager(ResidentEngineContract):
             override = item[3] if len(item) > 3 else None
             tenants.append(self._sync(tid, ls, root, override))
         pending = [t for t in tenants if t.needs_solve]
+        with da.event_window("world_window"):
+            self._solve_waves(tenants, pending)
+        self._enforce_residency()
+        self._update_gauges()
+        # the corruption seam sits AFTER the dispatches settle: a bit
+        # flipped pre-dispatch would be washed by world_dispatch's
+        # wholesale packed/d replacement and never model the silent
+        # between-solves decay the audit plane exists to catch
+        if consume_fault(FAULT_CORRUPT):
+            self._corrupt_events += 1
+            self.corrupt_resident(self._corrupt_events)
+        return [t.view() for t in tenants]
+
+    def _solve_waves(self, tenants, pending) -> None:
+        """The wave loop of ``solve_views``, factored out so the whole
+        multi-wave solve runs under ONE committed accounting window
+        (``ops.host_touches.world_window``)."""
         waves = 0
         recoveries = 0
         while pending:
@@ -345,16 +365,6 @@ class WorldManager(ResidentEngineContract):
                 recoveries += 1
                 self._recover_device_loss()
             pending = [t for t in pending if t.needs_solve]
-        self._enforce_residency()
-        self._update_gauges()
-        # the corruption seam sits AFTER the dispatches settle: a bit
-        # flipped pre-dispatch would be washed by world_dispatch's
-        # wholesale packed/d replacement and never model the silent
-        # between-solves decay the audit plane exists to catch
-        if consume_fault(FAULT_CORRUPT):
-            self._corrupt_events += 1
-            self.corrupt_resident(self._corrupt_events)
-        return [t.view() for t in tenants]
 
     def solve_view(self, tenant_id: str, ls, root: str,
                    override: Optional[Dict[str, bool]] = None):
@@ -760,6 +770,7 @@ class WorldManager(ResidentEngineContract):
         if ctx is not None:
             self._dispatch_finish(ctx)
 
+    @committed_dispatch
     def _dispatch_launch(self, bucket: WorldBucket):
         """Phase 1 of a bucket dispatch: journal emission, patch-operand
         prep, and the (async) fused device call. Returns the in-flight
@@ -833,21 +844,28 @@ class WorldManager(ResidentEngineContract):
                 inc_w[slot, x] = ww
         cap = bucket.delta_cap
         fault_point(FAULT_DEVICE_LOST)
-        packed, d, src_new, w_new, ch_count, out = world_dispatch(
-            bucket.src_dev, bucket.w_dev, bucket.ov_dev,
-            bucket.srcs_dev, p_rows, p_src, p_w,
-            inc_t, inc_h, inc_w, bucket.d_dev, bucket.packed_dev,
-            cap,
+        packed, d, src_new, w_new, ch_count, out = aot_call(
+            "world_dispatch", world_dispatch,
+            (
+                bucket.src_dev, bucket.w_dev, bucket.ov_dev,
+                bucket.srcs_dev, p_rows, p_src, p_w,
+                inc_t, inc_h, inc_w, bucket.d_dev, bucket.packed_dev,
+            ),
+            dict(cap=cap),
         )
         bucket.src_dev = src_new
         bucket.w_dev = w_new
         bucket.d_dev = d
         bucket.packed_dev = packed
+        # both readback lanes kicked at submit; _dispatch_finish reaps
+        da.kick_async(ch_count)
+        da.kick_async(out)
         return (
             bucket, solving, warm_ct, cold_ct,
             packed, ch_count, out, _span, _t0,
         )
 
+    @committed_dispatch
     def _dispatch_finish(self, ctx) -> None:
         """Phase 2: block on the in-flight solve, fan the compacted
         delta back out to the per-tenant host mirrors, and settle the
@@ -857,13 +875,14 @@ class WorldManager(ResidentEngineContract):
             packed, ch_count, out, _span, _t0,
         ) = ctx
         cap = bucket.delta_cap
-        # one transfer round trip for count + compacted rows (the
-        # count alone would sync on the whole dispatch anyway)
-        cnt_host, out_host = jax.device_get((ch_count, out))
-        cnt = int(cnt_host)
+        # count + compacted rows were both kicked at launch: reaping
+        # them here is the window's single read phase, overlapped with
+        # the other buckets' still-running solves
+        cnt = int(da.reap_read(ch_count, kicked=True))
+        out_host = da.reap_read(out, kicked=True)
         if cnt > cap:
             TENANCY_COUNTERS["delta_overflows"] += 1
-            full = np.asarray(packed)
+            full = da.reap_read(packed)
             for slot, t in enumerate(bucket.tenants):
                 if t is not None:
                     t.packed_host = np.array(full[slot])

@@ -269,6 +269,82 @@ def test_hostsync_suppressed(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# committed-dispatch
+# ---------------------------------------------------------------------
+
+COMMITTED_PREAMBLE = """\
+    import jax
+    import numpy as np
+    from openr_tpu.analysis.annotations import committed_dispatch
+    from openr_tpu.ops import dispatch_accounting as da
+"""
+
+
+def test_committed_flags_raw_syncs(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(rows_dev):
+        meta = jax.device_get(rows_dev)
+        rows_dev.block_until_ready()
+        return int(rows_dev[0])
+    """)
+    msgs = [f.message for f in rule_hits(report, "committed-dispatch")]
+    assert len(msgs) == 3
+    assert any("device_get" in m for m in msgs)
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("int()" in m for m in msgs)
+
+
+def test_committed_accounted_crossings_are_clean(tmp_path):
+    """The sanctioned dispatch_accounting crossings — plus host-list
+    numpy prep, which the rule deliberately does not flag inside
+    committed bodies (unlike @solve_window ones)."""
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(rows_dev, srcs):
+        ids = np.asarray(srcs)
+        da.count_dispatch()
+        da.kick_async(rows_dev)
+        return da.reap_read(rows_dev, kicked=True), ids
+    """)
+    assert rule_hits(report, "committed-dispatch") == []
+
+
+def test_committed_asarray_on_device_operand_trips(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(rows_dev):
+        return np.asarray(rows_dev)
+    """)
+    hits = rule_hits(report, "committed-dispatch")
+    assert len(hits) == 1
+    assert "np.asarray" in hits[0].message
+
+
+def test_committed_unannotated_function_is_clean(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    def plain(rows_dev):
+        return jax.device_get(rows_dev)
+    """)
+    assert rule_hits(report, "committed-dispatch") == []
+
+
+def test_committed_suppressed_with_reason(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def probe(dev):
+        # openr-lint: disable=committed-dispatch -- liveness probe:
+        # the blocking sync IS the signal
+        return dev.block_until_ready()
+    """)
+    assert rule_hits(report, "committed-dispatch") == []
+    assert any(
+        f.rule == "committed-dispatch" and f.suppressed
+        for f in report.findings
+    )
+
+
+# ---------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------
 
@@ -1121,6 +1197,27 @@ def test_sharding_outside_checked_dirs_is_clean(tmp_path):
         relpath="openr_tpu/telemetry/snippet.py",
     )
     assert rule_hits(report, "sharding-spec") == []
+
+
+def test_sharding_sees_through_aot_call(tmp_path):
+    """Wrapping the dispatch in the AOT executable cache must not hide
+    the resident flow — aot_call(tag, fn, (dyn...), {...}) is unwrapped
+    to the virtual call fn(*dyn)."""
+    report = lint_ops(tmp_path, SHARDING_PREAMBLE + """
+    from openr_tpu.ops.aot_cache import aot_call
+
+    @jax.jit
+    def step(dr, x):
+        return dr + x
+
+    @resident_buffers("_dr")
+    class Engine:
+        def churn(self, x):
+            return aot_call("tag", step, (self._dr, x), dict(n=4))
+    """)
+    hits = rule_hits(report, "sharding-spec")
+    assert len(hits) == 1
+    assert "_dr" in hits[0].message
 
 
 def test_sharding_suppressed_with_reason(tmp_path):

@@ -1,0 +1,347 @@
+"""Committed-dispatch contract (PR 13): one AOT-compiled executable
+per warm event window, two host touches (one submit run, one reap run),
+zero blocking syncs on the warm path — and the batched debounce window
+(``churn_window``) bit-identical to the same events solved one at a
+time, across the ELL, grouped, and mesh-sharded backends.
+
+Four claims, each with its own class:
+
+- AOT reuse: after warmup, a warm churn window compiles NOTHING — the
+  executable cache serves every dispatch (``ops.aot_compiles`` delta 0,
+  ``ops.aot_hits`` climbing, ``jax.compile_count`` delta 0).
+- Batched-window parity: N debounced events through ``churn_window``
+  leave the same digests as N sequential ``churn()`` calls — metric,
+  structural (link down/up), and mixed windows.
+- Pipelined parity: ``defer_consume=True`` chains (including the
+  deferred FULL-WIDTH overflow, whose changed count rides the async
+  lane) drain to the same bit-identical result.
+- Touch accounting: a warm event window records at most 2 host touches
+  and 0 blocking syncs; no event class exceeds 2 blocking syncs.
+"""
+
+import numpy as np
+import pytest
+from dataclasses import replace
+
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.ops import dispatch_accounting as da
+from openr_tpu.ops import route_engine, route_sweep
+from openr_tpu.telemetry import get_registry
+
+
+def load(topo):
+    ls = LinkState(area=topo.area)
+    for name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def make_topo():
+    return topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+
+
+def mutate_metric(ls, node, i, metric):
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+def drop_link(ls, u, v):
+    pulled = {}
+    for x, y in ((u, v), (v, u)):
+        db = ls.get_adjacency_databases()[x]
+        keep, gone = [], []
+        for a in db.adjacencies:
+            (gone if a.other_node_name == y else keep).append(a)
+        pulled[(x, y)] = tuple(gone)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(keep))
+        )
+    return pulled
+
+
+def make_engine(kind, ls):
+    names = sorted(ls.get_adjacency_databases().keys())
+    if kind in ("ell_sharded", "grouped_sharded"):
+        import jax
+
+        from openr_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(jax.devices())
+        cls = (
+            route_engine.RouteSweepEngine
+            if kind == "ell_sharded"
+            else route_engine.GroupedRouteSweepEngine
+        )
+        return cls(ls, [names[0]], align=16, mesh=mesh)
+    cls = (
+        route_engine.RouteSweepEngine
+        if kind == "ell"
+        else route_engine.GroupedRouteSweepEngine
+    )
+    return cls(ls, [names[0]])
+
+
+def digests(engine):
+    return route_sweep.digests_by_name(engine.result)
+
+
+KINDS = ("ell", "grouped", "ell_sharded", "grouped_sharded")
+
+
+class TestAotReuse:
+    def test_warm_window_compiles_nothing(self):
+        """After the first event compiled the chain, every further warm
+        event is served entirely from the AOT executable cache."""
+        ls = load(make_topo())
+        engine = make_engine("ell", ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        # warmup pass: AOT-compiles the fused chain once per
+        # (tag, bucket shape) the ladder visits
+        seq = (7, 3, 11, 5)
+        for metric in seq:
+            engine.churn(ls, mutate_metric(ls, rsw, 0, metric))
+        reg = get_registry()
+        compiles0 = reg.counter_get("ops.aot_compiles")
+        jax_compiles0 = reg.counter_get("jax.compile_count")
+        hits0 = reg.counter_get("ops.aot_hits")
+        # identical second pass: every shape warm, zero compiles
+        for metric in seq:
+            # an event may legitimately move no routes (the wiggled
+            # uplink off every shortest path at both metrics) — it
+            # still dispatches the full committed chain
+            moved = engine.churn(ls, mutate_metric(ls, rsw, 0, metric))
+            assert moved is not None
+        assert reg.counter_get("ops.aot_compiles") == compiles0, (
+            "warm churn windows must reuse the AOT executables"
+        )
+        assert reg.counter_get("jax.compile_count") == jax_compiles0, (
+            "warm churn windows must not trigger backend compiles"
+        )
+        assert reg.counter_get("ops.aot_hits") >= hits0 + len(seq)
+        assert reg.counter_get("ops.aot_fallbacks") == 0
+
+    def test_compile_count_ceiling_across_window(self):
+        """A whole multi-event warm window stays within a fixed compile
+        budget: everything after event one is cache hits."""
+        ls = load(make_topo())
+        engine = make_engine("ell", ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        reg = get_registry()
+        compiles0 = reg.counter_get("ops.aot_compiles")
+        for step, metric in enumerate((7, 3, 11, 5, 9)):
+            engine.churn(ls, mutate_metric(ls, rsw, 0, metric))
+        delta = reg.counter_get("ops.aot_compiles") - compiles0
+        # one executable per (tag, bucket-shape) key on this path —
+        # the cold build plus the k-buckets the retry ladder visits;
+        # never one compile per event
+        assert delta <= 6, f"AOT compiled {delta} times for 5 events"
+
+
+@pytest.mark.parametrize("kind", KINDS)
+class TestBatchedWindowParity:
+    def test_metric_window(self, kind):
+        """Three debounced metric events through ONE churn_window
+        dispatch == the same three applied one churn() at a time."""
+        ls_a = load(make_topo())
+        ls_b = load(make_topo())
+        seq = make_engine(kind, ls_a)
+        bat = make_engine(kind, ls_b)
+        rsw = next(n for n in seq.graph.node_names
+                   if n.startswith("rsw"))
+        fsw = next(n for n in seq.graph.node_names
+                   if n.startswith("fsw"))
+        events = [(rsw, 0, 7), (fsw, 0, 5), (rsw, 1, 9)]
+        for node, i, metric in events:
+            seq.churn(ls_a, mutate_metric(ls_a, node, i, metric))
+        sets = [
+            mutate_metric(ls_b, node, i, metric)
+            for node, i, metric in events
+        ]
+        out = bat.churn_window(ls_b, sets)
+        assert out is not None
+        assert digests(seq) == digests(bat)
+        assert bat.coalesced_events == 1
+
+    def test_structural_window(self, kind):
+        """A link-down folded with a metric wiggle in one window."""
+        ls_a = load(make_topo())
+        ls_b = load(make_topo())
+        seq = make_engine(kind, ls_a)
+        bat = make_engine(kind, ls_b)
+        rsw = next(n for n in seq.graph.node_names
+                   if n.startswith("rsw"))
+        fsw = next(n for n in seq.graph.node_names
+                   if n.startswith("fsw"))
+        peer = ls_a.get_adjacency_databases()[rsw].adjacencies[
+            0
+        ].other_node_name
+        drop_link(ls_a, rsw, peer)
+        seq.churn(ls_a, {rsw, peer})
+        seq.churn(ls_a, mutate_metric(ls_a, fsw, 0, 4))
+        drop_link(ls_b, rsw, peer)
+        s2 = mutate_metric(ls_b, fsw, 0, 4)
+        bat.churn_window(ls_b, [{rsw, peer}, s2])
+        assert digests(seq) == digests(bat)
+        # parity against a from-scratch oracle of the final state
+        names = sorted(ls_b.get_adjacency_databases().keys())
+        full = route_sweep.digests_by_name(
+            route_sweep.all_sources_route_sweep(
+                ls_b, [names[0]], block=64
+            )
+        )
+        assert digests(bat) == full
+
+    def test_coalesced_alias(self, kind):
+        """churn_window and churn_coalesced are the same program —
+        the window wrapper only adds the accounting bracket."""
+        ls_a = load(make_topo())
+        ls_b = load(make_topo())
+        a = make_engine(kind, ls_a)
+        b = make_engine(kind, ls_b)
+        rsw = next(n for n in a.graph.node_names
+                   if n.startswith("rsw"))
+        sets_a = [
+            mutate_metric(ls_a, rsw, 0, 7),
+            mutate_metric(ls_a, rsw, 1, 3),
+        ]
+        sets_b = [
+            mutate_metric(ls_b, rsw, 0, 7),
+            mutate_metric(ls_b, rsw, 1, 3),
+        ]
+        a.churn_coalesced(ls_a, sets_a)
+        b.churn_window(ls_b, sets_b)
+        assert digests(a) == digests(b)
+
+
+@pytest.mark.parametrize("kind", KINDS)
+class TestPipelinedParity:
+    def test_deferred_chain(self, kind):
+        """defer_consume chains (delta apply riding the NEXT event's
+        dispatch window) drain to the sequential result."""
+        ls_a = load(make_topo())
+        ls_b = load(make_topo())
+        seq = make_engine(kind, ls_a)
+        pipe = make_engine(kind, ls_b)
+        rsw = next(n for n in seq.graph.node_names
+                   if n.startswith("rsw"))
+        for metric in (7, 3, 11):
+            seq.churn(ls_a, mutate_metric(ls_a, rsw, 0, metric))
+            out = pipe.churn(
+                ls_b, mutate_metric(ls_b, rsw, 0, metric),
+                defer_consume=True,
+            )
+            assert isinstance(out, route_engine.PendingDelta)
+        pipe.flush()
+        assert digests(seq) == digests(pipe)
+
+    def test_deferred_full_width(self, kind, monkeypatch):
+        """The deferred FULL-WIDTH overflow: the changed count rides
+        the async lane inside the PendingDelta (fw_count) and the rows
+        cross only at consume time — same final bits."""
+        monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
+        ls = load(make_topo())
+        engine = make_engine(kind, ls)
+        engine._k_hint = 8
+        engine.frontier_threshold = 0.0  # force the full-width rung
+        ssw = next(n for n in engine.graph.node_names
+                   if n.startswith("ssw"))
+        pending = engine.churn(
+            ls, mutate_metric(ls, ssw, 0, 9), defer_consume=True
+        )
+        assert isinstance(pending, route_engine.PendingDelta)
+        assert pending.fw_count is not None
+        assert not pending.consumed
+        engine.flush()
+        assert pending.consumed
+        assert len(pending.names) > 8
+        assert engine.full_refreshes == 1
+        names = sorted(ls.get_adjacency_databases().keys())
+        full = route_sweep.digests_by_name(
+            route_sweep.all_sources_route_sweep(
+                ls, [names[0]], block=64
+            )
+        )
+        assert digests(engine) == full
+
+
+class TestTouchAccounting:
+    def test_warm_event_two_touches_zero_blocking(self):
+        """The committed-dispatch contract on the warm path: one
+        submit run + one reap run, nothing blocking in between."""
+        ls = load(make_topo())
+        engine = make_engine("ell", ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 7))  # warmup
+        for metric in (3, 11, 5):
+            with da.event_window("test") as win:
+                engine.churn(
+                    ls, mutate_metric(ls, rsw, 0, metric),
+                    defer_consume=True,
+                )
+            assert win.touches <= 2, (
+                f"warm event took {win.touches} host touches"
+            )
+            assert win.blocking_syncs == 0
+            assert win.dispatches >= 1
+        engine.flush()
+
+    def test_no_event_class_exceeds_two_blocking_syncs(self,
+                                                       monkeypatch):
+        """Regression guard across event classes: bucketed, frontier,
+        and full-width events all stay within 2 blocking syncs."""
+        monkeypatch.setattr(route_engine, "_ROW_BUCKETS", (8,))
+        ls = load(make_topo())
+        engine = make_engine("ell", ls)
+        engine._k_hint = 8
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        ssw = next(n for n in engine.graph.node_names
+                   if n.startswith("ssw"))
+        reg = get_registry()
+        events = [
+            (rsw, 0, 7),   # bucketed
+            (ssw, 0, 9),   # overflow (frontier or full-width)
+            (rsw, 0, 3),   # bucketed again
+        ]
+        for node, i, metric in events:
+            s0 = reg.counter_get("ops.blocking_syncs")
+            engine.churn(ls, mutate_metric(ls, node, i, metric))
+            took = reg.counter_get("ops.blocking_syncs") - s0
+            assert took <= 2, (
+                f"event on {node} took {took} blocking syncs"
+            )
+
+    def test_histogram_observed_per_window(self):
+        """churn() brackets itself: ops.host_touches and the churn tag
+        histogram record one observation per event window."""
+        ls = load(make_topo())
+        engine = make_engine("ell", ls)
+        rsw = next(n for n in engine.graph.node_names
+                   if n.startswith("rsw"))
+        reg = get_registry()
+        h = reg.histogram("ops.host_touches.churn")
+        c0 = h.count
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 7))
+        engine.churn(ls, mutate_metric(ls, rsw, 0, 3))
+        assert h.count == c0 + 2
+
+    def test_counters_in_spf_snapshot(self):
+        """The dispatch-accounting counters ride the merged SPF counter
+        snapshot (bench artifacts + runbook recipe read one view)."""
+        from openr_tpu.decision.spf_solver import get_spf_counters
+
+        out = get_spf_counters()
+        for key in (
+            "ops.host_dispatches", "ops.blocking_syncs",
+            "ops.async_reaps", "ops.aot_compiles", "ops.aot_hits",
+        ):
+            assert key in out

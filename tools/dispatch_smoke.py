@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Committed-dispatch gate (``make dispatch-smoke``) and report
+artifact.
+
+Exercises the fused event→patch→warm-solve→delta-compact chain
+(``openr_tpu.ops.route_engine``) end to end on a 3-pod fat-tree, then
+fails loudly if the committed-dispatch contract regressed:
+
+- HOST-TOUCH BUDGET: every warm event window costs at most 2 host
+  touches (one submit run, one reap run) and ZERO blocking syncs —
+  readbacks must ride the ``copy_to_host_async`` lane,
+- COMPILE FLATNESS: an identical second pass over the warmed metric
+  sequence must cost ZERO AOT compiles and ZERO backend jit compiles
+  (``ops.aot_compiles`` and ``jax.compile_count`` deltas both 0, with
+  ``ops.aot_hits`` climbing and ``ops.aot_fallbacks`` pinned at 0),
+- PARITY: the incrementally maintained routes after all events must be
+  bit-identical to a from-scratch ``all_sources_route_sweep`` oracle,
+  and a debounced ``churn_window`` batch must equal the same events
+  applied one ``churn()`` at a time.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_dispatch_smoke.json``); exit 0 on pass, 1 with a
+reason list on fail. Runs CPU-pinned — this gates the dispatch
+contract and executable reuse, not device throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/dispatch_smoke.py) in addition
+# to module mode (python -m tools.dispatch_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load(topo):
+    from openr_tpu.graph.linkstate import LinkState
+
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _mutate_metric(ls, node, i, metric):
+    from dataclasses import replace
+
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+SEQ = (7, 3, 11, 5)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--out", default="/tmp/openr_tpu_dispatch_smoke.json",
+        help="JSON artifact path",
+    )
+    args = ap.parse_args()
+
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import dispatch_accounting as da
+    from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    failures: list = []
+    report: dict = {"gates": {}}
+    reg = get_registry()
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = _load(topo)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+
+    # -- warmup pass: compiles the chain once per (tag, bucket) key -----
+    for metric in SEQ:
+        engine.churn(ls, _mutate_metric(ls, rsw, 0, metric))
+    report["warmup_aot_compiles"] = reg.counter_get("ops.aot_compiles")
+
+    # -- gate: compile flatness + host-touch budget on the warm pass ----
+    compiles0 = reg.counter_get("ops.aot_compiles")
+    jax0 = reg.counter_get("jax.compile_count")
+    hits0 = reg.counter_get("ops.aot_hits")
+    touches = []
+    for metric in SEQ:
+        with da.event_window("smoke") as win:
+            engine.churn(
+                ls, _mutate_metric(ls, rsw, 0, metric),
+                defer_consume=True,
+            )
+        touches.append(win.touches)
+        if win.touches > 2:
+            failures.append(
+                f"warm event (metric={metric}) took {win.touches} host "
+                "touches (budget is 2: one submit, one reap)"
+            )
+        if win.blocking_syncs:
+            failures.append(
+                f"warm event (metric={metric}) paid "
+                f"{win.blocking_syncs} blocking sync(s); readbacks must "
+                "ride the async lane"
+            )
+    engine.flush()
+    compile_delta = reg.counter_get("ops.aot_compiles") - compiles0
+    jax_delta = reg.counter_get("jax.compile_count") - jax0
+    if compile_delta:
+        failures.append(
+            f"warm pass AOT-compiled {compile_delta} time(s); the "
+            "executable cache must serve every warm dispatch"
+        )
+    if jax_delta:
+        failures.append(
+            f"warm pass triggered {jax_delta} backend jit compile(s)"
+        )
+    if reg.counter_get("ops.aot_hits") - hits0 < len(SEQ):
+        failures.append("warm pass did not register AOT cache hits")
+    if reg.counter_get("ops.aot_fallbacks"):
+        failures.append(
+            "AOT executable invocation fell back to plain jit "
+            "(ops.aot_fallbacks > 0)"
+        )
+    report["gates"]["host_touch_budget"] = not any(
+        "touches" in f or "blocking" in f for f in failures
+    )
+    report["gates"]["compile_flatness"] = (
+        compile_delta == 0 and jax_delta == 0
+    )
+    report["warm"] = {
+        "host_touches_per_event": touches,
+        "aot_compile_delta": compile_delta,
+        "jax_compile_delta": jax_delta,
+    }
+
+    # -- gate: parity vs a from-scratch oracle of the final state -------
+    got = route_sweep.digests_by_name(engine.result)
+    oracle = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+    if got != oracle:
+        bad = sorted(n for n in oracle if got.get(n) != oracle[n])
+        failures.append(
+            f"incremental result diverged from oracle at {len(bad)} "
+            f"node(s): {bad[:5]}"
+        )
+    report["gates"]["oracle_parity"] = got == oracle
+
+    # -- gate: batched window == sequential, bit for bit ----------------
+    ls_a, ls_b = _load(topo), _load(topo)
+    seq_eng = route_engine.RouteSweepEngine(ls_a, [names[0]])
+    bat_eng = route_engine.RouteSweepEngine(ls_b, [names[0]])
+    fsw = next(
+        n for n in seq_eng.graph.node_names if n.startswith("fsw")
+    )
+    events = [(rsw, 0, 7), (fsw, 0, 5), (rsw, 1, 9)]
+    for node, i, metric in events:
+        seq_eng.churn(ls_a, _mutate_metric(ls_a, node, i, metric))
+    sets = [
+        _mutate_metric(ls_b, node, i, metric)
+        for node, i, metric in events
+    ]
+    bat_eng.churn_window(ls_b, sets)
+    d_seq = route_sweep.digests_by_name(seq_eng.result)
+    d_bat = route_sweep.digests_by_name(bat_eng.result)
+    if d_seq != d_bat:
+        failures.append(
+            "churn_window batch diverged from the same events applied "
+            "sequentially"
+        )
+    report["gates"]["batched_window_parity"] = d_seq == d_bat
+
+    report["counters"] = {
+        k: reg.counter_get(k)
+        for k in (
+            "ops.host_dispatches",
+            "ops.blocking_syncs", "ops.async_reaps",
+            "ops.aot_compiles", "ops.aot_hits", "ops.aot_fallbacks",
+            "jax.compile_count",
+        )
+    }
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("DISPATCH SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"dispatch smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
