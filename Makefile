@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke multichip-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke multichip-smoke serve-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke serve-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -137,6 +137,16 @@ multichip-smoke: native
 	  tests/test_route_engine_delta.py::TestShardedNoReshard \
 	  tests/test_ksp2_engine.py::TestMeshShardedEngine \
 	  -q -m "not slow"
+
+# serving-plane gate (openr_tpu.serve): ONE device-owning solver
+# service process serving B>=64 tenants from 4 jax-free client OS
+# processes over the ctrl wire — bit parity vs the oracle replay,
+# ZERO jit compiles across the whole client storm after warmup,
+# per-class p99 under the 100ms CPU-scaled SLO, and premium p99 <=
+# standard p99 under a seeded mixed-class storm. See docs/RUNBOOK.md
+# "SLO breach triage" when it fails.
+serve-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.serve_smoke --out /tmp/openr_tpu_serve_smoke.json
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

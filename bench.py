@@ -611,6 +611,29 @@ def _run() -> dict:
             except Exception as e:
                 bench_twin = {"error": f"{type(e).__name__}: {e}"}
 
+    # fourteenth leg: solver-as-a-service — B mixed-class tenants
+    # driven through the live SolverService wave loop by concurrent
+    # submitters; reports per-class latency percentiles, solves/s,
+    # requests-per-wave, join/preemption deltas, and the scheduler
+    # overhead vs a direct batched solve_views floor (make serve-smoke
+    # is the hard CI gate; this leg folds the serving-throughput
+    # numbers into the official artifact)
+    bench_serve = None
+    if os.environ.get("OPENR_BENCH_SERVE") == "1":
+        if leg_elapsed() > 540:
+            bench_serve = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import solver_service_bench
+
+                bench_serve = solver_service_bench(
+                    int(os.environ.get("OPENR_BENCH_SERVE_TENANTS", "64"))
+                )
+            except Exception as e:
+                bench_serve = {"error": f"{type(e).__name__}: {e}"}
+
     # measured head-to-head: the committed same-host single-thread
     # solver runs (BASELINE_MEASURED.json — native C++ oracle + pure
     # Python host solver over the reference's DecisionBenchmark grid).
@@ -695,6 +718,7 @@ def _run() -> dict:
         "bench_recovery": bench_recovery,
         "bench_integrity_audit": bench_integrity,
         "bench_fleet_twin": bench_twin,
+        "bench_solver_service": bench_serve,
         # per-event convergence-latency distribution from the telemetry
         # registry (convergence.e2e_ms feeds from every finished trace;
         # the solver-leg histograms ride along) — the artifact's
@@ -770,6 +794,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_RECOVERY"] = "1"
         env["OPENR_BENCH_INTEGRITY"] = "1"
         env["OPENR_BENCH_TWIN"] = "1"
+        env["OPENR_BENCH_SERVE"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
@@ -780,6 +805,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env.pop("OPENR_BENCH_RECOVERY", None)
         env.pop("OPENR_BENCH_INTEGRITY", None)
         env.pop("OPENR_BENCH_TWIN", None)
+        env.pop("OPENR_BENCH_SERVE", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

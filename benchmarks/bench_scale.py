@@ -1820,6 +1820,188 @@ def fleet_twin_bench(
     }
 
 
+def solver_service_bench(
+    tenants: int = 64, rounds: int = 10, submitters: int = 8,
+    seed: int = 20260806,
+) -> dict:
+    """Solver-as-a-service leg (openr_tpu.serve): B tenants of mixed
+    SLO class driven through a live ``SolverService`` wave loop by
+    ``submitters`` concurrent threads (the in-process stand-in for
+    client daemons — the TCP wire is the smoke gate's job, the
+    scheduler is this leg's). Each round every submitter churns one
+    metric per tenant and solicits a solve; concurrent submission is
+    what makes requests pile into shared waves.
+
+    Reports per-class latency percentiles (enqueue -> delivery),
+    aggregate solves/s, waves and mean requests-per-wave, the wave
+    join / preemption counter deltas, and the service-overhead ratio:
+    served mean per-solve cost vs the same fleet solved as one direct
+    ``WorldManager.solve_views`` batch per round (the scheduler-free
+    floor). Parity is asserted on the final round — a fast server must
+    still be a correct one."""
+    import threading as _threading
+
+    import jax
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.spf_sparse import (
+        compile_ell,
+        ell_source_batch,
+        ell_view_batch_packed,
+    )
+    from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
+    from openr_tpu.serve.service import SolverService
+    from openr_tpu.serve.slo import SLO_TABLE
+
+    def mk_ls(i):
+        kind = i % 3
+        if kind == 0:
+            topo = topologies.grid(3 + i % 3)
+        elif kind == 1:
+            topo = topologies.ring(8 + 2 * (i % 4))
+        else:
+            topo = topologies.random_mesh(
+                20 + i % 16, 3, seed=seed % 1000 + i
+            )
+        ls = LinkState(area=topo.area)
+        for _name, adj_db in sorted(topo.adj_dbs.items()):
+            ls.update_adjacency_database(adj_db)
+        return ls
+
+    def wiggle(ls, root, metric):
+        from dataclasses import replace
+
+        adj_db = ls.get_adjacency_databases()[root]
+        adjs = list(adj_db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=metric)
+        ls.update_adjacency_database(
+            replace(adj_db, adjacencies=tuple(adjs))
+        )
+
+    classes = sorted(SLO_TABLE)
+    fleet = []
+    for i in range(tenants):
+        ls = mk_ls(i)
+        fleet.append((
+            f"b{i}", ls, sorted(ls.get_adjacency_databases())[0],
+            classes[i % len(classes)],
+        ))
+
+    svc = SolverService(
+        manager=WorldManager(
+            slots_per_bucket=max(64, tenants), max_resident=2 * tenants
+        )
+    ).start()
+    lat_ms: dict = {cls: [] for cls in classes}
+    lat_lock = _threading.Lock()
+    try:
+        for tid, _ls, _root, slo in fleet:
+            svc.register(tid, slo)
+        # warmup: cold placements + one churn round, so both the cold
+        # and the warm-incremental dispatch executables (and the delta
+        # readback) are compiled before the measured rounds
+        for tid, ls, root, _slo in fleet:
+            svc.solve(tid, ls, root)
+        for tid, ls, root, _slo in fleet:
+            wiggle(ls, root, 39)
+            svc.solve(tid, ls, root)
+        joins0 = TENANCY_COUNTERS["wave_joins"]
+        pre0 = TENANCY_COUNTERS["wave_preemptions"]
+        waves0 = svc.waves()
+
+        shard = max(1, -(-len(fleet) // submitters))
+        shards = [
+            fleet[i : i + shard] for i in range(0, len(fleet), shard)
+        ]
+
+        def drive(mine, r):
+            for tid, ls, root, slo in mine:
+                wiggle(ls, root, 40 + r)
+                t0 = time.perf_counter()
+                svc.solve(tid, ls, root)
+                ms = 1000.0 * (time.perf_counter() - t0)
+                with lat_lock:
+                    lat_ms[slo].append(ms)
+
+        t_serve0 = time.perf_counter()
+        for r in range(rounds):
+            threads = [
+                _threading.Thread(target=drive, args=(mine, r))
+                for mine in shards
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        serve_s = time.perf_counter() - t_serve0
+        waves = svc.waves() - waves0
+        joins = TENANCY_COUNTERS["wave_joins"] - joins0
+        preemptions = TENANCY_COUNTERS["wave_preemptions"] - pre0
+
+        # parity on the final round's state, tenant-by-tenant
+        parity = True
+        for tid, ls, root, _slo in fleet[:: max(1, tenants // 8)]:
+            graph = compile_ell(ls)
+            ref = np.asarray(ell_view_batch_packed(
+                graph, ell_source_batch(graph, ls, root)
+            ))
+            _g, _srcs, packed = svc.solve(tid, ls, root)
+            if not np.array_equal(packed, ref):
+                parity = False
+    finally:
+        svc.stop()
+
+    # scheduler-free floor: the same fleet, one direct batched
+    # solve_views per round on a private manager
+    mgr = WorldManager(
+        slots_per_bucket=max(64, tenants), max_resident=2 * tenants
+    )
+    direct_ls = [mk_ls(i) for i in range(tenants)]
+    direct = [
+        (f"d{i}", ls, sorted(ls.get_adjacency_databases())[0])
+        for i, ls in enumerate(direct_ls)
+    ]
+    mgr.solve_views(direct)  # warmup
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        for _tid, ls, root in direct:
+            wiggle(ls, root, 40 + r)
+        mgr.solve_views(direct)
+    direct_s = time.perf_counter() - t0
+
+    def pct(samples, q):
+        if not samples:
+            return None
+        w = sorted(samples)
+        return round(
+            w[min(len(w) - 1, max(0, int(round(q * (len(w) - 1)))))], 3
+        )
+
+    total = rounds * tenants
+    return {
+        "tenants": tenants,
+        "rounds": rounds,
+        "submitters": submitters,
+        "solves_per_s": round(total / serve_s, 1) if serve_s else None,
+        "latency_ms": {
+            cls: {"p50": pct(s, 0.5), "p99": pct(s, 0.99)}
+            for cls, s in sorted(lat_ms.items())
+        },
+        "waves": waves,
+        "requests_per_wave": round(total / waves, 2) if waves else None,
+        "wave_joins": joins,
+        "wave_preemptions": preemptions,
+        "served_ms_per_solve": round(1000.0 * serve_s / total, 3),
+        "direct_ms_per_solve": round(1000.0 * direct_s / total, 3),
+        "service_overhead_ratio": (
+            round(serve_s / direct_s, 3) if direct_s else None
+        ),
+        "parity": parity,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=10000)

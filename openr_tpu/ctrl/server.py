@@ -23,6 +23,21 @@ from openr_tpu.utils.jsonable import to_jsonable
 
 _STREAM_METHODS = {"subscribe_kvstore_filtered", "subscribe_fib"}
 
+# Each JSON connection is served by one dedicated thread, so the
+# connection identity rides a thread-local: handlers that care which
+# client is speaking (the solver service ties tenants to connections
+# for graceful detach) read ``current_connection()`` during a dispatch
+# and implement ``connection_closed(conn_id)`` for the teardown.
+_CONN = threading.local()
+_CONN_SEQ = [0]
+_CONN_LOCK = threading.Lock()
+
+
+def current_connection() -> Optional[int]:
+    """The serving connection's id inside a handler dispatch (None
+    outside one — e.g. a handler called in-process without a socket)."""
+    return getattr(_CONN, "conn_id", None)
+
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     payload = json.dumps(obj).encode("utf-8")
@@ -139,14 +154,30 @@ class CtrlServer:
         self._server.server_close()
 
     def _serve_json(self, sock) -> None:
-        while True:
-            try:
-                request = _recv_frame(sock)
-            except (ConnectionError, OSError):
-                return
-            if request is None:
-                return
-            self._dispatch(sock, request)
+        with _CONN_LOCK:
+            _CONN_SEQ[0] += 1
+            conn_id = _CONN_SEQ[0]
+        _CONN.conn_id = conn_id
+        try:
+            while True:
+                try:
+                    request = _recv_frame(sock)
+                except (ConnectionError, OSError):
+                    return
+                if request is None:
+                    return
+                self._dispatch(sock, request)
+        finally:
+            _CONN.conn_id = None
+            # duck-typed teardown: a handler that tracks per-connection
+            # state (solver service tenants) detaches it here — abrupt
+            # client death lands on the same path as a clean close
+            closed = getattr(self.handler, "connection_closed", None)
+            if closed is not None:
+                try:
+                    closed(conn_id)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
 
     def _serve_classified_tls(self, tls_sock) -> None:
         """Read the first frame head off the TLS stream, classify it,

@@ -1,0 +1,153 @@
+"""SolverCtrlHandler: the solver service's wire surface.
+
+Rides the existing ctrl transport (``CtrlServer`` — length-prefixed
+JSON frames, duck-typed method dispatch, the same dual-stacked port
+Decision's handler serves), so clients reach the solver with the stock
+``CtrlClient`` machinery: no new listener, no new framing, TLS for
+free. Every method is prefixed ``solver_`` to keep the namespace
+disjoint from the OpenrCtrl surface.
+
+Worlds cross the wire as base64 ``utils.wire`` AdjacencyDatabase
+blobs — the LSDB's own serialization — and the server builds each
+tenant's ``LinkState`` from them (clients stay jax-free and
+graph-free; see serve/client.py). Views return as base64 int32 packed
+blocks plus the node-name table, which is everything a client needs to
+reconstruct per-destination distances/first-hops and everything the
+parity gates digest.
+
+The ``serve.slow_client`` fault seam fires on the reply path of
+``solver_solve``: an armed delay schedule stalls only THIS client's
+connection thread — the wave loop and other clients never feel it.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from openr_tpu.ctrl.server import current_connection
+from openr_tpu.faults import fault_point
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.serve.service import FAULT_SLOW_CLIENT, SolverService
+from openr_tpu.serve.slo import SLO_TABLE
+from openr_tpu.types.lsdb import AdjacencyDatabase
+from openr_tpu.utils import wire
+
+
+def _decode_db(blob: str) -> AdjacencyDatabase:
+    return wire.loads(base64.b64decode(blob), AdjacencyDatabase)
+
+
+def _path_links(path) -> List[List]:
+    """Canonical wire form of one path: per link, the sorted
+    ((node, iface), (node, iface)) endpoint key — identical for the
+    served trace and a host-oracle trace of the same links."""
+    return [
+        [end for pair in sorted(
+            ((l.n1, l.if1), (l.n2, l.if2))
+        ) for end in pair]
+        for l in path
+    ]
+
+
+class SolverCtrlHandler:
+    """One per service process. Tenants registered over a connection
+    are tied to it (``ctrl.server.current_connection``); the server's
+    ``connection_closed`` teardown parks them warm through
+    ``SolverService.connection_closed``."""
+
+    def __init__(self, service: SolverService):
+        self._svc = service
+        self._lock = threading.RLock()
+        self._ls: Dict[str, LinkState] = {}
+        self._roots: Dict[str, str] = {}
+
+    # -- transport teardown hook (CtrlServer duck-types this) --------------
+
+    def connection_closed(self, conn: int) -> None:
+        self._svc.connection_closed(conn)
+
+    # -- methods (JSON-frame dispatched) -----------------------------------
+
+    def solver_hello(self) -> Dict:
+        return {
+            "classes": sorted(SLO_TABLE),
+            "slots_per_bucket": self._svc.manager.slots_per_bucket,
+        }
+
+    def solver_register(self, tenant_id: str, slo: str = "standard",
+                        area: str = "0") -> Dict:
+        self._svc.register(
+            tenant_id, slo, conn=current_connection()
+        )
+        with self._lock:
+            if tenant_id not in self._ls:
+                self._ls[tenant_id] = LinkState(area=area)
+        return {"tenant_id": tenant_id, "slo": slo}
+
+    def solver_update(self, tenant_id: str, adj_dbs: List[str],
+                      root: Optional[str] = None) -> Dict:
+        """Apply a world snapshot or churn delta: each entry is one
+        node's AdjacencyDatabase (b64 wire). The FIRST update must be
+        the full snapshot; later calls send only changed nodes."""
+        with self._lock:
+            ls = self._ls[tenant_id]
+            for blob in adj_dbs:
+                ls.update_adjacency_database(_decode_db(blob))
+            if root is not None:
+                self._roots[tenant_id] = root
+            return {
+                "topology_version": ls.topology_version,
+                "nodes": len(ls.get_adjacency_databases()),
+            }
+
+    def solver_solve(self, tenant_id: str,
+                     timeout: float = 60.0) -> Dict:
+        with self._lock:
+            ls = self._ls[tenant_id]
+            root = self._roots.get(tenant_id)
+            if root is None:
+                root = sorted(ls.get_adjacency_databases())[0]
+        graph, srcs, packed = self._svc.solve(
+            tenant_id, ls, root, timeout=timeout
+        )
+        # slow-client seam: a delay schedule armed here models a
+        # client draining its reply slowly — only this connection
+        # thread stalls, the wave loop has already moved on
+        fault_point(FAULT_SLOW_CLIENT)
+        packed = np.ascontiguousarray(packed.astype(np.int32))
+        names = [
+            name
+            for name, _i in sorted(
+                graph.node_index.items(), key=lambda kv: kv[1]
+            )
+        ]
+        return {
+            "root": root,
+            "srcs": [int(s) for s in srcs],
+            "n_pad": int(graph.n_pad),
+            "shape": list(packed.shape),
+            "packed_b64": base64.b64encode(packed.tobytes()).decode(),
+            "nodes": names,
+        }
+
+    def solver_ksp2(self, tenant_id: str, dsts: List[str]) -> Dict:
+        paths = self._svc.ksp2(tenant_id, dsts)
+        return {
+            dst: [_path_links(p) for p in path_list]
+            for dst, path_list in paths.items()
+        }
+
+    def solver_detach(self, tenant_id: str,
+                      warm: bool = True) -> Dict:
+        self._svc.detach(tenant_id, warm=warm)
+        return {"tenant_id": tenant_id, "warm": warm}
+
+    def solver_counters(self) -> Dict:
+        return self._svc.counters()
+
+    def solver_ping(self) -> Dict:
+        return {"ok": True, "waves": self._svc.waves()}

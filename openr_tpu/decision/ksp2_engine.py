@@ -55,6 +55,7 @@ import numpy as np
 
 from openr_tpu.analysis.annotations import mirrored_by, resident_buffers
 from openr_tpu.graph.linkstate import Link, LinkState
+from openr_tpu.ops import dispatch_accounting as _da
 from openr_tpu.ops.spf import INF
 
 # Engine activation bound: the event loop keeps TWO device-resident
@@ -389,7 +390,16 @@ class Ksp2Engine:
         kth-path cache for every destination, and return the set of
         destination names whose paths may have changed (for route
         reuse). Returns None when the engine had to cold-rebuild (no
-        reuse this build) or cannot run (caller falls back)."""
+        reuse this build) or cannot run (caller falls back).
+
+        The whole relay round trip runs inside one accounting window:
+        every device readback must ride the committed chain
+        (``aot_call`` + async kick, reaped via ``reap_read``), and the
+        ``ops.host_touches.ksp2_window`` observation is the gate."""
+        with _da.event_window("ksp2_window"):
+            return self._sync_window(ls, dsts)
+
+    def _sync_window(self, ls: LinkState, dsts: List[str]) -> Optional[Set[str]]:
         self.last_affected = None
         from openr_tpu.decision import spf_solver as _ss
 
@@ -520,13 +530,14 @@ class Ksp2Engine:
             d_all_dev, dm_new_dev, packed = spf_sparse.ell_all_view_rows_masked(
                 state, srcs_dev, w_sv, ep_ids, self.d_prev_dev,
                 self.masks_t, self.dm_dev, self.sid, ENGINE_ROW_BUDGET,
-                inc=inc,
+                inc=inc, defer=True,
             )
         else:
             # openr-lint: disable=donation-hazard -- intentional: same
             # consume-and-rebind discipline as the fast path above
             d_all_dev, packed = spf_sparse.ell_all_view_rows(
-                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev, inc=inc
+                state, srcs_dev, w_sv, ep_ids, self.d_prev_dev, inc=inc,
+                defer=True,
             )
         # the single-chip dispatches DONATE d_prev_dev (and dm_dev on
         # the fast path): adopt the outputs NOW, before any fallback
@@ -536,6 +547,12 @@ class Ksp2Engine:
         self.d_prev_dev = d_all_dev
         if dm_new_dev is not None:
             self.dm_dev = dm_new_dev
+        if not isinstance(packed, np.ndarray):
+            # single-chip deferred dispatch: the packed readback was
+            # kicked copy_to_host_async inside the wrapper — reap it
+            # AFTER the residents adopted the donated outputs so a
+            # reap failure can never hand dead buffers to _cold_build
+            packed = _da.reap_read(packed, kicked=True)
         b = len(view_srcs)
         p = len(ep_ids)
         view_packed = packed[: 2 * b]
@@ -619,10 +636,8 @@ class Ksp2Engine:
                 # budget overflow: one extra readback of the full
                 # matrix (rare — means a large fraction of rows moved);
                 # under the mesh the batch carries pad rows — drop them
-                import jax
-
                 dm_full = np.asarray(
-                    jax.device_get(dm_new_dev)
+                    _da.reap_read(dm_new_dev)
                 )[: len(self.dsts)]
                 moved = np.flatnonzero((dm_full != self.dm).any(axis=1))
                 row_map = {self.dsts[int(i)]: dm_full[int(i)] for i in moved}
@@ -781,8 +796,9 @@ class Ksp2Engine:
             d_all_dev, packed = spf_sparse.ell_all_view_rows(
                 state, srcs_dev, w_sv,
                 np.asarray([self.sid], np.int32),
-                placeholder,
+                placeholder, defer=True,
             )
+            packed = _da.reap_read(packed, kicked=True)
         b = len(view_srcs)
         self._preload_view(ls, graph, view_srcs, packed[: 2 * b])
         self.d_base = packed[0].astype(np.int32)
@@ -1221,14 +1237,19 @@ class Ksp2Engine:
             masks, ok = spf_sparse.build_edge_masks(
                 graph, excl_sets + [set()] * pad
             )
+            drows_dev = None
             if self._mesh is not None:
                 drows = spf_sparse.sharded_ell_masked_distances_resident(
                     state, self.sid, masks, self._mesh
                 )
             else:
-                drows = spf_sparse.ell_masked_distances_resident(
-                    state, self.sid, masks
+                # committed chain: the masked rows are kicked
+                # copy_to_host_async; the resident scatter below chains
+                # off the DEVICE rows, and the host copy is reaped once
+                drows_dev = spf_sparse.ell_masked_distances_resident(
+                    state, self.sid, masks, defer=True
                 )
+                drows = None
             _counters()["decision.ksp2_device_batches"] += 1
             if getattr(self, "masks_t", None) is not None:
                 # fast path: keep the RESIDENT masks and masked-row
@@ -1245,9 +1266,14 @@ class Ksp2Engine:
                     m_res.at[ids].set(jnp.asarray(m_new[: len(batch)]))
                     for m_res, m_new in zip(self.masks_t, masks)
                 )
-                self.dm_dev = self.dm_dev.at[ids].set(
-                    jnp.asarray(drows[: len(batch)])
+                rows_src = (
+                    drows_dev[: len(batch)]
+                    if drows_dev is not None
+                    else jnp.asarray(drows[: len(batch)])
                 )
+                self.dm_dev = self.dm_dev.at[ids].set(rows_src)
+            if drows is None:
+                drows = _da.reap_read(drows_dev, kicked=True)
             traceable: List[int] = []
             for i, dst in enumerate(batch):
                 if not ok[i]:

@@ -1,0 +1,260 @@
+"""Multi-process client driver for the solver service.
+
+The PR 8 load harness drives one in-process pipeline; this module
+drives a ``SolverService`` the way production would be driven — N
+OS-process client daemons, each owning a disjoint set of tenants,
+registering worlds, churning metrics, and soliciting views over the
+ctrl wire. Child processes are JAX-FREE (serve/client.py + the
+topology generators only), so spawn startup is milliseconds and the
+one device owner stays the service process.
+
+Everything is deterministic from the spec: the world a tenant
+registers and the metric it churns on round ``i`` derive only from
+``(spec, i)``, so a parent (test or smoke gate) replays the same
+schedule host-side to produce oracle digests without any channel back
+from the children beyond the result files.
+
+``run_client`` is module-level and takes only picklable arguments —
+required by the ``spawn`` start method (the only safe method with a
+jax parent).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, replace
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's deterministic world + churn schedule."""
+
+    tenant_id: str
+    kind: str          # "grid" | "ring" | "mesh"
+    size: int
+    seed: int = 0
+    slo: str = "standard"
+
+    def build_dbs(self) -> Dict[str, "object"]:
+        from openr_tpu.models import topologies
+
+        if self.kind == "grid":
+            topo = topologies.grid(self.size)
+        elif self.kind == "ring":
+            topo = topologies.ring(self.size)
+        elif self.kind == "mesh":
+            topo = topologies.random_mesh(
+                self.size, 3, seed=self.seed or 7
+            )
+        else:
+            raise ValueError(f"unknown topology kind {self.kind!r}")
+        return dict(topo.adj_dbs)
+
+    def root_of(self, dbs: Dict) -> str:
+        return sorted(dbs)[0]
+
+    def mutation(self, dbs: Dict, round_i: int) -> Tuple[str, object]:
+        """The round's churn: ONE adjacency metric bump on a
+        deterministically chosen node. Returns (node, new_db); pure —
+        parent oracles replay it bit-identically."""
+        names = sorted(dbs)
+        node = names[(round_i * 3 + self.seed) % len(names)]
+        db = dbs[node]
+        adjs = list(db.adjacencies)
+        if not adjs:
+            node = names[0]
+            db = dbs[node]
+            adjs = list(db.adjacencies)
+        ai = (round_i + self.seed) % len(adjs)
+        metric = 1 + ((round_i * 7 + self.seed * 5 + ai) % 13)
+        adjs[ai] = replace(adjs[ai], metric=metric)
+        return node, replace(db, adjacencies=tuple(adjs))
+
+
+def apply_mutation(dbs: Dict, spec: TenantSpec, round_i: int) -> str:
+    """Mutate ``dbs`` in place per the schedule; returns the node."""
+    node, db = spec.mutation(dbs, round_i)
+    dbs[node] = db
+    return node
+
+
+def run_client(
+    host: str,
+    port: int,
+    client_id: str,
+    specs: List[Dict],
+    rounds: int,
+    out_path: str,
+    ksp2_every: int = 0,
+    hold_open_s: float = 0.0,
+) -> None:
+    """Child-process entry: drive ``specs``' tenants for ``rounds``
+    churn rounds and write a JSON result file — per-request latencies
+    (by SLO class), the per-tenant view digest after every round, and
+    any errors. ``ksp2_every > 0`` also solicits the second-path view
+    every that-many rounds (digested as the JSON text of the reply).
+    ``hold_open_s`` keeps the connection (and its tenants) alive after
+    the last round — the disconnect tests use it."""
+    from openr_tpu.serve.client import SolverClient
+
+    result = {
+        "client_id": client_id,
+        "latencies_ms": {},
+        "digests": {},
+        "ksp2": {},
+        "errors": [],
+        "rounds": 0,
+    }
+    try:
+        client = SolverClient(host, port)
+        worlds = {}
+        for sd in specs:
+            spec = TenantSpec(**sd)
+            dbs = spec.build_dbs()
+            worlds[spec.tenant_id] = (spec, dbs)
+            client.register(spec.tenant_id, slo=spec.slo)
+            client.update_world(
+                spec.tenant_id, [dbs[k] for k in sorted(dbs)],
+                root=spec.root_of(dbs),
+            )
+            result["digests"][spec.tenant_id] = []
+            result["ksp2"][spec.tenant_id] = []
+        for i in range(rounds):
+            for tid, (spec, dbs) in worlds.items():
+                if i > 0:
+                    node = apply_mutation(dbs, spec, i)
+                    client.update_world(tid, [dbs[node]])
+                t0 = time.perf_counter()
+                view = client.solve(tid)
+                ms = (time.perf_counter() - t0) * 1000.0
+                result["latencies_ms"].setdefault(
+                    spec.slo, []
+                ).append(ms)
+                result["digests"][tid].append(view.digest())
+                if ksp2_every and (i + 1) % ksp2_every == 0:
+                    paths = client.ksp2(
+                        tid, sorted(view.nodes[:8])
+                    )
+                    result["ksp2"][tid].append(
+                        _digest_text(json.dumps(paths, sort_keys=True))
+                    )
+            result["rounds"] = i + 1
+        if hold_open_s > 0:
+            time.sleep(hold_open_s)
+        client.close()
+    except Exception as exc:  # noqa: BLE001 - reported in the artifact
+        result["errors"].append(repr(exc))
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+def _digest_text(text: str) -> int:
+    h = 0x811C9DC5
+    for b in text.encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def spawn_clients(
+    host: str,
+    port: int,
+    client_specs: Dict[str, List[TenantSpec]],
+    rounds: int,
+    out_dir: str,
+    ksp2_every: int = 0,
+    hold_open_s: float = 0.0,
+):
+    """Launch one spawn-context process per client; returns
+    ``[(proc, out_path)]`` for the parent to join and harvest."""
+    import multiprocessing as mp
+    import os
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for client_id, specs in client_specs.items():
+        out_path = os.path.join(
+            out_dir, f"solver_client_{client_id}.json"
+        )
+        p = ctx.Process(
+            target=run_client,
+            args=(
+                host, port, client_id,
+                [asdict(s) for s in specs], rounds, out_path,
+            ),
+            kwargs=dict(
+                ksp2_every=ksp2_every, hold_open_s=hold_open_s
+            ),
+            daemon=True,
+        )
+        p.start()
+        procs.append((p, out_path))
+    return procs
+
+
+def oracle_digests(
+    specs: List[TenantSpec], rounds: int
+) -> Dict[str, List[int]]:
+    """Sequential single-graph oracle for the exact schedule
+    ``run_client`` drives: per tenant, per round, the FNV digest of
+    ``ell_view_batch_packed`` over the replayed world. Imports jax —
+    parent/gate side only."""
+    import numpy as np
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.ops.spf_sparse import (
+        compile_ell,
+        ell_source_batch,
+        ell_view_batch_packed,
+    )
+
+    out: Dict[str, List[int]] = {}
+    for spec in specs:
+        dbs = spec.build_dbs()
+        ls = LinkState(area="0")
+        for name in sorted(dbs):
+            ls.update_adjacency_database(dbs[name])
+        root = spec.root_of(dbs)
+        digests = []
+        for i in range(rounds):
+            if i > 0:
+                node = apply_mutation(dbs, spec, i)
+                ls.update_adjacency_database(dbs[node])
+            graph = compile_ell(ls)
+            srcs = ell_source_batch(graph, ls, root)
+            packed = np.asarray(
+                ell_view_batch_packed(graph, srcs)
+            ).astype(np.int32)
+            h = 0x811C9DC5
+            for b in packed.tobytes():
+                h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+            digests.append(h)
+        out[spec.tenant_id] = digests
+    return out
+
+
+def harvest(procs) -> List[Dict]:
+    """Join spawned clients and load their result files; a child that
+    died without writing is reported as an error record."""
+    import json as _json
+    import os
+
+    results = []
+    for p, out_path in procs:
+        p.join(timeout=300)
+        if p.is_alive():
+            p.terminate()
+            results.append(
+                {"client_id": out_path, "errors": ["timeout"]}
+            )
+            continue
+        if not os.path.exists(out_path):
+            results.append({
+                "client_id": out_path,
+                "errors": [f"no result file (exit {p.exitcode})"],
+            })
+            continue
+        with open(out_path) as f:
+            results.append(_json.load(f))
+    return results

@@ -64,6 +64,8 @@ _NODE_PAD = 128
 # the exported "decision." names, so the registry snapshot and
 # get_spf_counters() agree by construction.
 from openr_tpu.analysis.annotations import donates, solve_window
+from openr_tpu.ops import dispatch_accounting as _da
+from openr_tpu.ops.aot_cache import aot_call as _aot_call
 from openr_tpu.telemetry import get_registry as _get_registry
 from openr_tpu.telemetry import get_tracer as _get_tracer
 
@@ -1133,36 +1135,50 @@ def build_edge_masks(graph: EllGraph, exclusion_sets, parallel_pairs=None):
 
 
 def ell_masked_distances(graph: EllGraph, src_id: int, masks):
-    """Run the batched masked solve; returns host [B, n_pad] int32."""
-    return np.asarray(
-        _ell_masked_source_batch(
+    """Run the batched masked solve; returns host [B, n_pad] int32.
+    Rides the committed AOT executable cache — the host-graph twin of
+    ``ell_masked_distances_resident`` (the serve plane's per-tenant
+    KSP2 view dispatches here, so its warm waves must not retrace)."""
+    d = _aot_call(
+        "ksp2_masked_host", _ell_masked_source_batch,
+        (
             tuple(jnp.asarray(s) for s in graph.src),
             tuple(jnp.asarray(w) for w in graph.w),
             tuple(jnp.asarray(m) for m in masks),
             jnp.asarray(graph.overloaded),
             src_id,
-            graph.bands,
-            graph.n_pad,
-        )
+        ),
+        dict(bands=graph.bands, n=graph.n_pad),
     )
+    return np.asarray(d)
 
 
 def ell_masked_distances_resident(
-    state: "EllState", src_id: int, masks
+    state: "EllState", src_id: int, masks, defer: bool = False
 ):
     """Masked solve over an EllState's device-RESIDENT bands — only the
-    masks cross host->device per dispatch."""
-    return np.asarray(
-        _ell_masked_source_batch(
+    masks cross host->device per dispatch. Dispatches through the AOT
+    executable cache (``ksp2_masked_resident``) so a warm churn event
+    costs a dict lookup, not a jit signature re-derivation. With
+    ``defer=True`` the [B, n_pad] product stays ON DEVICE with its
+    readback kicked on the async lane — the caller reaps it via
+    ``dispatch_accounting.reap_read(rows, kicked=True)`` inside its
+    event window (the KSP2 committed-dispatch chain)."""
+    d = _aot_call(
+        "ksp2_masked_resident", _ell_masked_source_batch,
+        (
             state.src,
             state.w,
             tuple(jnp.asarray(m) for m in masks),
             state.overloaded,
             src_id,
-            state.graph.bands,
-            state.graph.n_pad,
-        )
+        ),
+        dict(bands=state.graph.bands, n=state.graph.n_pad),
     )
+    if defer:
+        _da.kick_async(d)
+        return d
+    return np.asarray(d)
 
 
 def band_patch_inputs(resident_src, resident_w, patched: EllGraph):
@@ -1592,43 +1608,66 @@ def _inc_args(inc):
 def ell_all_view_rows_masked(
     state: EllState, view_srcs, w_sv, ep_ids, d_prev,
     masks_t, dm_old, src_id: int, k_budget: int, inc=None,
+    defer: bool = False,
 ):
     """Run the fused 1-RTT dispatch on the resident bands. Returns
     (d_all_dev, dm_new_dev, packed_host). ``inc`` is the increase-edge
     delta [(tail, head, old_w)] for warm seeding — None forces the
     cold seed; d_prev and dm_old are DONATED (invalid after the
-    call)."""
+    call). Rides the committed AOT executable cache
+    (``ksp2_view_rows_masked``); ``defer=True`` keeps ``packed`` on
+    device with its readback kicked async — the caller reaps via
+    ``dispatch_accounting.reap_read(packed, kicked=True)`` inside its
+    event window, folding the relay round trip into the chain."""
     inc_t, inc_h, inc_w = _inc_args(inc)
-    d_all, dm_new, packed = _ell_all_view_rows_masked(
-        state.src, state.w, state.overloaded,
-        _as_device_ids(view_srcs),
-        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
-            np.asarray(w_sv, dtype=np.int32)
+    d_all, dm_new, packed = _aot_call(
+        "ksp2_view_rows_masked", _ell_all_view_rows_masked,
+        (
+            state.src, state.w, state.overloaded,
+            _as_device_ids(view_srcs),
+            w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+                np.asarray(w_sv, dtype=np.int32)
+            ),
+            _as_device_ids(ep_ids),
+            d_prev, inc_t, inc_h, inc_w, masks_t, dm_old, src_id,
         ),
-        _as_device_ids(ep_ids),
-        d_prev, inc_t, inc_h, inc_w, masks_t, dm_old, src_id,
-        state.graph.bands, state.graph.n_pad, k_budget,
+        dict(
+            bands=state.graph.bands, n=state.graph.n_pad,
+            k_budget=k_budget,
+        ),
     )
+    if defer:
+        _da.kick_async(packed)
+        return d_all, dm_new, packed
     return d_all, dm_new, np.asarray(packed)
 
 
 @donates("d_prev")
 def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev,
-                      inc=None):
+                      inc=None, defer: bool = False):
     """Run the fused all-sources + view + invalidation-rows dispatch on
     the resident bands. Returns (d_all_dev, packed_host). ``inc`` as in
-    ell_all_view_rows_masked; d_prev is DONATED."""
+    ell_all_view_rows_masked; d_prev is DONATED. Rides the committed
+    AOT executable cache (``ksp2_view_rows``); ``defer=True`` as in
+    ell_all_view_rows_masked (device ``packed``, readback kicked,
+    caller reaps)."""
     inc_t, inc_h, inc_w = _inc_args(inc)
-    d_all, packed = _ell_all_view_rows(
-        state.src, state.w, state.overloaded,
-        _as_device_ids(view_srcs),
-        w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
-            np.asarray(w_sv, dtype=np.int32)
+    d_all, packed = _aot_call(
+        "ksp2_view_rows", _ell_all_view_rows,
+        (
+            state.src, state.w, state.overloaded,
+            _as_device_ids(view_srcs),
+            w_sv if isinstance(w_sv, jax.Array) else jnp.asarray(
+                np.asarray(w_sv, dtype=np.int32)
+            ),
+            _as_device_ids(ep_ids),
+            d_prev, inc_t, inc_h, inc_w,
         ),
-        _as_device_ids(ep_ids),
-        d_prev, inc_t, inc_h, inc_w,
-        state.graph.bands, state.graph.n_pad,
+        dict(bands=state.graph.bands, n=state.graph.n_pad),
     )
+    if defer:
+        _da.kick_async(packed)
+        return d_all, packed
     return d_all, np.asarray(packed)
 
 

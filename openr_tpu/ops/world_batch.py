@@ -119,6 +119,7 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "admissions",    # cold admits (fresh compile_ell worlds)
         "evictions",     # resident -> host-snapshot demotions
         "rehydrations",  # host-snapshot -> warm resident promotions
+        "placements",    # slot uploads of any kind (join/rehydrate/resize)
         "bucket_compiles",    # distinct shape buckets materialized
         "bucket_migrations",  # tenant moved between shape buckets
         "graph_shares",       # vantage-view packing: shared-graph reuses
@@ -132,9 +133,19 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "device_loss_recoveries",  # torn dispatches rebuilt from host
         "quarantines",       # integrity audits that poisoned the blocks
         "integrity_heals",   # warm re-placements after a quarantine
+        "wave_occupancy",    # gauge-like: last wave's solving/slots pct
+        "wave_joins",        # requests that joined an in-flight wave
+        "wave_preemptions",  # higher-SLO requests admitted over earlier ones
+        "bucket_compactions",  # vacancy-driven bucket shrinks
+        "ksp2_views",        # per-tenant second-path view solves
     ],
     prefix="tenancy.",
 )
+
+# SLO classes the serve plane stamps on tenants (serve/slo.py owns the
+# class table; the tenant plane only carries the label so dispatch
+# spans and counters can slice by class without importing serve)
+SLO_CLASSES = ("premium", "standard", "bulk")
 
 
 def _pow2_at_least(x: int, lo: int) -> int:
@@ -163,7 +174,7 @@ class TenantWorld:
         "tenant_id", "ls_ref", "root", "graph", "version", "srcs",
         "packed_host", "pending_edges", "pending_rows", "ov_solved",
         "pending_structural", "force_reset", "needs_solve", "solved",
-        "slot", "bucket", "last_used", "srcs_dirty", "override",
+        "slot", "bucket", "last_used", "srcs_dirty", "override", "slo",
     )
 
     def __init__(self, tenant_id: str, ls, root: str,
@@ -191,6 +202,8 @@ class TenantWorld:
         # vantage-local overload view ({node: overloaded}); empty =
         # the tenant sees the shared LSDB truth
         self.override: Dict[str, bool] = {}
+        # SLO class label (serve plane admission ordering + span attrs)
+        self.slo = "standard"
 
     @property
     def dims(self) -> Tuple[int, int, int]:
@@ -302,6 +315,9 @@ class WorldManager(ResidentEngineContract):
         )
         self._clock = 0
         self._corrupt_events = 0
+        # SLO class labels survive drop/re-admit (a client's class is
+        # a property of the tenant NAME, assigned at registration)
+        self._slo_classes: Dict[str, str] = {}
         get_auditor().register(self)
 
     # -- public API --------------------------------------------------------
@@ -370,11 +386,115 @@ class WorldManager(ResidentEngineContract):
                    override: Optional[Dict[str, bool]] = None):
         return self.solve_views([(tenant_id, ls, root, override)])[0]
 
+    def ksp2_view(self, tenant_id: str, dsts: Sequence[str]):
+        """Second-path (KSP2) view for a SOLVED tenant: first paths
+        traced from the resident SP view's root distance row, per-dst
+        edge masks over the first paths' links, ONE batched masked
+        device solve per pow2 chunk (``ell_masked_distances`` — rides
+        the committed ``ksp2_masked_host`` AOT executable, so warm
+        waves never retrace), second paths traced from the masked rows.
+        Returns ``{dst: [first_paths..., second_paths...]}`` in exactly
+        ``ls.get_kth_paths(root, dst, 1) + (…, 2)`` layout (byte-equal
+        traces: same canonical predecessor order). Destinations whose
+        exclusion set is unrepresentable in the packed layout fall back
+        to the host oracle — deterministic, never silent (counted in
+        ``tenancy.ksp2_host_fallbacks``)."""
+        from openr_tpu.decision.ksp2_engine import (
+            make_cands_of,
+            trace_paths_from_row,
+        )
+        from openr_tpu.ops import spf_sparse
+
+        t = self._tenants[tenant_id]
+        ls = t.ls_ref()
+        if ls is None or not t.solved or t.needs_solve:
+            raise RuntimeError(
+                f"ksp2_view({tenant_id!r}) requires a settled solve"
+            )
+        graph, srcs, packed = t.view()
+        root = t.root
+        sid = srcs[0]
+        d_base = packed[0].astype(np.int64)
+        cands_of = make_cands_of(ls, graph.node_index)
+        transit_blocked = {
+            name
+            for name in graph.node_names
+            if ls.is_node_overloaded(name) and name != root
+        }
+        out: Dict[str, List] = {}
+        excl: Dict[str, set] = {}
+        preds_cache: Dict[str, list] = {}
+        for dst in dsts:
+            firsts = trace_paths_from_row(
+                root, dst, graph.node_index, d_base, set(),
+                cands_of, transit_blocked, preds_cache,
+            )
+            out[dst] = list(firsts)
+            excl[dst] = {l for p in firsts for l in p}
+        TENANCY_COUNTERS["ksp2_views"] += 1
+        par = (
+            ls.parallel_pairs() if graph.slot_of is None else None
+        )
+        host_fallbacks = 0
+        order = list(dsts)
+        for start in range(0, len(order), 64):
+            batch = order[start : start + 64]
+            bucket = 8
+            while bucket < len(batch):
+                bucket *= 2
+            pad = bucket - len(batch)
+            masks, ok = spf_sparse.build_edge_masks(
+                graph, [excl[d] for d in batch] + [set()] * pad, par
+            )
+            drows = spf_sparse.ell_masked_distances(graph, sid, masks)
+            for i, dst in enumerate(batch):
+                if not ok[i]:
+                    host_fallbacks += 1
+                    out[dst] = ls.get_kth_paths(
+                        root, dst, 1
+                    ) + ls.get_kth_paths(root, dst, 2)
+                    continue
+                out[dst] = out[dst] + trace_paths_from_row(
+                    root, dst, graph.node_index,
+                    drows[i].astype(np.int64), excl[dst],
+                    cands_of, transit_blocked,
+                )
+        if host_fallbacks:
+            _get_registry().counter_bump(
+                "tenancy.ksp2_host_fallbacks", host_fallbacks
+            )
+        return out
+
     def drop(self, tenant_id: str) -> None:
         t = self._tenants.pop(tenant_id, None)
         if t is not None and t.slot is not None:
             self._detach(t)
         self._update_gauges()
+
+    def park(self, tenant_id: str) -> None:
+        """Warm detach: free the tenant's device slot but KEEP its host
+        record (mirror + journal), so a later solve rehydrates warm.
+        The serve plane's client-disconnect path — a vanished client
+        must not poison the bucket its tenants shared, and must not
+        cold-solve if it reconnects."""
+        t = self._tenants.get(tenant_id)
+        if t is not None and t.slot is not None:
+            self._detach(t)
+        self._update_gauges()
+
+    def set_slo_class(self, tenant_id: str, slo: str) -> None:
+        """Stamp a tenant's SLO class (serve plane admission input).
+        Sticky across drop/re-admit; unknown class names are rejected
+        here so a typo never silently lands in ``standard``."""
+        if slo not in SLO_CLASSES:
+            raise ValueError(f"unknown SLO class: {slo!r}")
+        self._slo_classes[tenant_id] = slo
+        t = self._tenants.get(tenant_id)
+        if t is not None:
+            t.slo = slo
+
+    def slo_class(self, tenant_id: str) -> str:
+        return self._slo_classes.get(tenant_id, "standard")
 
     def reset(self) -> None:
         """Release every device block and tenant record (the
@@ -428,6 +548,7 @@ class WorldManager(ResidentEngineContract):
                 ell_source_batch(graph, ls, root),
             )
             self._tenants[tenant_id] = t
+            t.slo = self._slo_classes.get(tenant_id, "standard")
             TENANCY_COUNTERS["admissions"] += 1
         elif t.version != ls.topology_version:
             shared = self._shared_patched(t, ls)
@@ -693,9 +814,59 @@ class WorldManager(ResidentEngineContract):
             TENANCY_COUNTERS["bucket_migrations"] += 1
         bucket = self._bucket_for(dims)
         slot = bucket.free_slot()
+        if slot is None and bucket.slots < self.slots_per_bucket:
+            # a previously compacted bucket refilled: grow it back
+            # toward the configured width before evicting anyone
+            bucket = self._resize_bucket(bucket, bucket.slots * 2)
+            slot = bucket.free_slot()
         if slot is None:
             slot = self._evict_lru(bucket)
         self._place(t, bucket, slot)
+
+    def _resize_bucket(self, bucket: WorldBucket,
+                       slots: int) -> WorldBucket:
+        """Replace a bucket with a ``slots``-wide twin and warm
+        re-place its occupants (mirror + journal ride along — same
+        upload path as rehydration, so bits are preserved). A resized
+        block is a NEW dispatch shape: the executable for the new B
+        compiles once (counted in ``bucket_compiles``), which is why
+        compaction only fires past a real vacancy threshold."""
+        fresh = WorldBucket(slots, *bucket.key)
+        self._buckets[bucket.key] = fresh
+        TENANCY_COUNTERS["bucket_compiles"] += 1
+        occupants = [t for t in bucket.tenants if t is not None]
+        for t in occupants:
+            self._detach(t)
+        for t in occupants:
+            self._place(t, fresh, fresh.free_slot())
+        return fresh
+
+    def compact_buckets(self, vacancy: float = 0.5) -> int:
+        """Occupancy-sized dispatch: shrink every bucket whose vacancy
+        exceeds ``vacancy`` down to the power-of-two width that fits
+        its occupants (empty buckets are dropped outright), so a
+        half-empty fleet stops paying full-width solves. Returns the
+        number of buckets compacted. The serve plane calls this
+        between waves; callers that never compact keep the old
+        fixed-width behavior."""
+        compacted = 0
+        for key in sorted(self._buckets):
+            bucket = self._buckets[key]
+            occ = bucket.occupancy()
+            if occ == 0:
+                del self._buckets[key]
+                compacted += 1
+                TENANCY_COUNTERS["bucket_compactions"] += 1
+                continue
+            target = _pow2_at_least(occ, 1)
+            if target >= bucket.slots or occ > bucket.slots * (
+                1.0 - vacancy
+            ):
+                continue
+            self._resize_bucket(bucket, target)
+            compacted += 1
+            TENANCY_COUNTERS["bucket_compactions"] += 1
+        return compacted
 
     def _place(self, t: TenantWorld, bucket: WorldBucket,
                slot: int) -> None:
@@ -709,6 +880,7 @@ class WorldManager(ResidentEngineContract):
             t.solved = False
         elif t.solved:
             TENANCY_COUNTERS["rehydrations"] += 1
+        TENANCY_COUNTERS["placements"] += 1
         src, w, ov = ell_pack_uniform(t.graph, n_slot, k_slot)
         srcs_row = np.full(s_slot, t.srcs[0], dtype=np.int32)
         srcs_row[: len(t.srcs)] = t.srcs
@@ -860,9 +1032,12 @@ class WorldManager(ResidentEngineContract):
         # both readback lanes kicked at submit; _dispatch_finish reaps
         da.kick_async(ch_count)
         da.kick_async(out)
+        slo_counts = {cls: 0 for cls in SLO_CLASSES}
+        for _slot, t in solving:
+            slo_counts[t.slo] = slo_counts.get(t.slo, 0) + 1
         return (
             bucket, solving, warm_ct, cold_ct,
-            packed, ch_count, out, _span, _t0,
+            packed, ch_count, out, _span, _t0, slo_counts,
         )
 
     @committed_dispatch
@@ -872,7 +1047,7 @@ class WorldManager(ResidentEngineContract):
         journals + counters + span."""
         (
             bucket, solving, warm_ct, cold_ct,
-            packed, ch_count, out, _span, _t0,
+            packed, ch_count, out, _span, _t0, slo_counts,
         ) = ctx
         cap = bucket.delta_cap
         # count + compacted rows were both kicked at launch: reaping
@@ -910,6 +1085,9 @@ class WorldManager(ResidentEngineContract):
             "tenancy.dispatch_ms",
             (time.perf_counter() - _t0) * 1000.0,
         )
+        TENANCY_COUNTERS["wave_occupancy"] = int(
+            round(100 * bucket.occupancy() / bucket.slots)
+        )
         _get_tracer().end_span_active(
             _span,
             slots=bucket.slots,
@@ -918,6 +1096,9 @@ class WorldManager(ResidentEngineContract):
             warm=warm_ct,
             cold=cold_ct,
             delta_rows=cnt,
+            slo_premium=slo_counts.get("premium", 0),
+            slo_standard=slo_counts.get("standard", 0),
+            slo_bulk=slo_counts.get("bulk", 0),
         )
 
     # -- integrity plane ---------------------------------------------------

@@ -1,0 +1,155 @@
+"""SolverClient: the client daemon's side of solver-as-a-service.
+
+Deliberately *thin and jax-free*: a client process imports only the
+stdlib, numpy, and the wire codec — no jax, no graph compiler, no
+engines. That is the point of the ownership inversion: many cheap
+client daemons (Decision instances, twins, what-if tools) feed worlds
+to ONE device-owning service process and read views back.
+
+Speaks the ctrl transport's JSON frames (the same
+``{"method", "kwargs"}`` envelope ``CtrlServer`` dual-stacks), so a
+solver client and a breeze CLI can share a port. Worlds travel as
+base64 ``utils.wire`` AdjacencyDatabase blobs; views come back as
+base64 int32 packed blocks decoded into ``SolverView``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from openr_tpu.types.lsdb import AdjacencyDatabase
+from openr_tpu.utils import wire
+
+
+def _send_frame(sock: socket.socket, obj) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+    header = b""
+    while len(header) < 4:
+        chunk = sock.recv(4 - len(header))
+        if not chunk:
+            return None
+        header += chunk
+    (length,) = struct.unpack(">I", header)
+    payload = b""
+    while len(payload) < length:
+        chunk = sock.recv(length - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return json.loads(payload.decode("utf-8"))
+
+
+class SolverView:
+    """Decoded tenant view: ``packed`` is the [2b, n_pad] int32 block
+    (rows [0, b) distances per source, rows [b, 2b) first hops — the
+    ``ell_view_batch_packed`` layout), ``nodes`` maps column -> node
+    name, and row 0 is the root's distance row."""
+
+    def __init__(self, reply: Dict):
+        self.root: str = reply["root"]
+        self.srcs: List[int] = list(reply["srcs"])
+        self.n_pad: int = int(reply["n_pad"])
+        self.nodes: List[str] = list(reply["nodes"])
+        shape = tuple(reply["shape"])
+        self.packed = np.frombuffer(
+            base64.b64decode(reply["packed_b64"]), dtype=np.int32
+        ).reshape(shape)
+        self.index: Dict[str, int] = {
+            name: i for i, name in enumerate(self.nodes)
+        }
+
+    def distance(self, dst: str) -> int:
+        return int(self.packed[0, self.index[dst]])
+
+    def digest(self) -> int:
+        """FNV-1a over the packed bytes — what the parity gates
+        compare against a server/oracle digest."""
+        h = 0x811C9DC5
+        for b in self.packed.tobytes():
+            h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+        return h
+
+
+class SolverClient:
+    """One TCP connection to a ``SolverService``; every tenant
+    registered through it is tied to this connection server-side (a
+    disconnect parks them warm)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 2018,
+                 timeout_s: float = 120.0):
+        self._sock = socket.create_connection(
+            (host, port), timeout=timeout_s
+        )
+
+    def _call(self, method: str, **kwargs):
+        _send_frame(self._sock, {"method": method, "kwargs": kwargs})
+        reply = _recv_frame(self._sock)
+        if reply is None:
+            raise ConnectionError("solver service closed connection")
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error", "unknown error"))
+        return reply.get("result")
+
+    # -- surface -----------------------------------------------------------
+
+    def hello(self) -> Dict:
+        return self._call("solver_hello")
+
+    def ping(self) -> Dict:
+        return self._call("solver_ping")
+
+    def register(self, tenant_id: str, slo: str = "standard",
+                 area: str = "0") -> Dict:
+        return self._call(
+            "solver_register", tenant_id=tenant_id, slo=slo, area=area
+        )
+
+    def update_world(
+        self,
+        tenant_id: str,
+        adj_dbs: Iterable[AdjacencyDatabase],
+        root: Optional[str] = None,
+    ) -> Dict:
+        blobs = [
+            base64.b64encode(wire.dumps(db)).decode()
+            for db in adj_dbs
+        ]
+        return self._call(
+            "solver_update", tenant_id=tenant_id, adj_dbs=blobs,
+            root=root,
+        )
+
+    def solve(self, tenant_id: str,
+              timeout: float = 60.0) -> SolverView:
+        return SolverView(self._call(
+            "solver_solve", tenant_id=tenant_id, timeout=timeout
+        ))
+
+    def ksp2(self, tenant_id: str, dsts: List[str]) -> Dict:
+        return self._call(
+            "solver_ksp2", tenant_id=tenant_id, dsts=list(dsts)
+        )
+
+    def detach(self, tenant_id: str, warm: bool = True) -> Dict:
+        return self._call(
+            "solver_detach", tenant_id=tenant_id, warm=warm
+        )
+
+    def counters(self) -> Dict:
+        return self._call("solver_counters")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,367 @@
+"""SolverService: the device-owning solver process.
+
+Ownership inversion over the rest of the tree: everywhere else the
+Decision instance owns its engines and the device; here a standalone
+serving process owns ONE private ``WorldManager`` (and through it the
+device blocks) and many client daemons talk to it over the ctrl
+transport. The scheduler is continuous batching as practiced by
+inference servers, mapped onto the tenant plane:
+
+- **Wave loop.** One background thread drains the pending-request
+  table into bucket *waves*: each wave syncs + solves every admitted
+  tenant in as few fused ``world_dispatch`` calls as the shape buckets
+  allow (``WorldManager.solve_views``). Requests that arrive while a
+  wave is in flight join the NEXT wave (``tenancy.wave_joins``) — the
+  zero-retrace bucket-join contract makes that join free of compiles,
+  which is what makes mid-flight joining worth doing at all.
+
+- **SLO classes.** Every tenant carries a class (``premium`` /
+  ``standard`` / ``bulk``, serve/slo.py). Wave admission orders
+  pending requests by (class priority, arrival seq) and cuts at the
+  wave budget: a premium request arriving late still rides the next
+  wave ahead of earlier bulk arrivals (counted in
+  ``tenancy.wave_preemptions``), and bulk requests absorb whatever
+  budget the higher classes leave (they are never starved outright —
+  the cut is a budget, not a filter, so leftover bulk rides the
+  following wave).
+
+- **Occupancy-sized dispatch.** After waves settle, buckets whose
+  vacancy exceeds ~50% are compacted to the power-of-two width that
+  fits their occupants (``WorldManager.compact_buckets``) so a
+  half-empty fleet stops paying full-width solves.
+
+- **Fault seams.** ``serve.client_disconnect`` fires at result
+  delivery: a vanished client's tenants are parked WARM (slot freed,
+  mirror + journal kept — the bucket is never poisoned and a
+  reconnect rehydrates). ``serve.slow_client`` fires on the ctrl
+  reply path (ctrl/solver.py), stalling only that client's connection
+  thread, never the wave loop.
+
+Telemetry: ``serve.requests`` / ``serve.waves`` / ``serve.errors`` /
+``serve.disconnect_detaches`` counters, ``serve.latency_ms.<class>``
+per-class histograms (p99 drives the SLO gate), plus the tenancy
+counters the wave loop feeds (wave_joins / wave_preemptions /
+wave_occupancy / bucket_compactions).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from openr_tpu.faults import (
+    FaultInjected,
+    fault_point,
+    register_fault_site,
+)
+from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
+from openr_tpu.serve.slo import SLO_TABLE, order_requests
+from openr_tpu.telemetry import get_registry as _get_registry
+
+FAULT_CLIENT_DISCONNECT = register_fault_site("serve.client_disconnect")
+FAULT_SLOW_CLIENT = register_fault_site("serve.slow_client")
+
+
+class SolveRequest:
+    """One pending tenant solve: latest-wins per tenant (a newer
+    request for the same tenant supersedes the queued one — the solve
+    always runs against the tenant's CURRENT LinkState, so coalescing
+    is free), delivered through an event the caller blocks on."""
+
+    __slots__ = (
+        "tenant_id", "ls", "root", "slo", "seq", "enqueued",
+        "event", "view", "error", "superseded",
+    )
+
+    def __init__(self, tenant_id: str, ls, root: str, slo: str,
+                 seq: int):
+        self.tenant_id = tenant_id
+        self.ls = ls
+        self.root = root
+        self.slo = slo
+        self.seq = seq
+        self.enqueued = time.perf_counter()
+        self.event = threading.Event()
+        self.view = None
+        self.error: Optional[BaseException] = None
+        # waiters on requests this one coalesced over: they are served
+        # with THIS request's result (the wave solves the tenant's
+        # current world, which answers every superseded ask)
+        self.superseded: List["SolveRequest"] = []
+
+    def deliver(self, view=None,
+                error: Optional[BaseException] = None) -> None:
+        for r in [self] + self.superseded:
+            r.view = view
+            r.error = error
+            r.event.set()
+
+    def wait(self, timeout: float = 60.0):
+        if not self.event.wait(timeout):
+            raise TimeoutError(
+                f"solve({self.tenant_id!r}) not served in {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return self.view
+
+
+class SolverService:
+    """The serving process's core (transport-free; ctrl/solver.py puts
+    it behind the wire). Thread model: ctrl connection threads enqueue
+    requests and block on their events; ONE wave thread owns every
+    WorldManager mutation (the manager is not thread-safe), with
+    ``_mgr_lock`` serializing the few out-of-wave touches (register /
+    detach / ksp2 view)."""
+
+    def __init__(
+        self,
+        manager: Optional[WorldManager] = None,
+        wave_budget: Optional[int] = None,
+        compaction_vacancy: float = 0.5,
+        compact_every: int = 16,
+    ):
+        # PRIVATE manager by default: the service owns the device; it
+        # deliberately does not share get_world_manager()'s process
+        # singleton with an in-process Decision
+        self._mgr = manager if manager is not None else WorldManager()
+        self._wave_budget = (
+            wave_budget
+            if wave_budget is not None
+            else 4 * self._mgr.slots_per_bucket
+        )
+        self._compaction_vacancy = compaction_vacancy
+        # consecutive idle wait ticks (~50 ms each) with no pending
+        # work before an occupancy-compaction pass may run
+        self._compact_every = max(1, compact_every)
+        self._placements_at_check = TENANCY_COUNTERS["placements"]
+        self._cv = threading.Condition()
+        self._pending: Dict[str, SolveRequest] = {}
+        self._seq = 0
+        self._stop = False
+        self._wave_active = False
+        self._waves = 0
+        self._mgr_lock = threading.RLock()
+        self._conn_tenants: Dict[int, Set[str]] = {}
+        self._detached: Set[str] = set()
+        self._reg = _get_registry()
+        self._thread = threading.Thread(
+            target=self._wave_loop, name="solver-wave-loop", daemon=True
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SolverService":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+        # fail pending waiters rather than hanging their clients
+        with self._cv:
+            pending = list(self._pending.values())
+            self._pending = {}
+        for r in pending:
+            r.deliver(error=RuntimeError("solver service stopped"))
+
+    @property
+    def manager(self) -> WorldManager:
+        return self._mgr
+
+    def waves(self) -> int:
+        return self._waves
+
+    # -- client surface ----------------------------------------------------
+
+    def register(self, tenant_id: str, slo: str = "standard",
+                 conn: Optional[int] = None) -> None:
+        """Declare a tenant and its SLO class; ``conn`` ties it to a
+        ctrl connection so a disconnect detaches it warm."""
+        if slo not in SLO_TABLE:
+            raise ValueError(f"unknown SLO class: {slo!r}")
+        with self._mgr_lock:
+            self._mgr.set_slo_class(tenant_id, slo)
+        if conn is not None:
+            with self._cv:
+                self._conn_tenants.setdefault(conn, set()).add(
+                    tenant_id
+                )
+        self._detached.discard(tenant_id)
+
+    def request_solve(self, tenant_id: str, ls,
+                      root: str) -> SolveRequest:
+        """Enqueue (or supersede) the tenant's pending solve; returns
+        the request whose ``wait()`` yields the view. Arrivals during
+        an in-flight wave are the continuous-batching case — they ride
+        the next wave, counted as wave joins."""
+        with self._cv:
+            self._seq += 1
+            r = SolveRequest(
+                tenant_id, ls, root,
+                self._mgr.slo_class(tenant_id), self._seq,
+            )
+            old = self._pending.get(tenant_id)
+            if old is not None:
+                # latest-wins coalescing: the superseded waiters are
+                # served with this wave's view of the same tenant
+                r.superseded = old.superseded + [old]
+                old.superseded = []
+            if self._wave_active:
+                TENANCY_COUNTERS["wave_joins"] += 1
+                self._reg.counter_bump("serve.wave_joins")
+            self._pending[tenant_id] = r
+            self._reg.counter_bump("serve.requests")
+            self._cv.notify()
+        return r
+
+    def solve(self, tenant_id: str, ls, root: str,
+              timeout: float = 60.0):
+        """Blocking convenience wrapper: enqueue + wait for the wave."""
+        return self.request_solve(tenant_id, ls, root).wait(timeout)
+
+    def ksp2(self, tenant_id: str, dsts: Sequence[str]):
+        """Second-path view for a solved tenant (the tenant plane's
+        ``ksp2_view`` behind the service lock)."""
+        with self._mgr_lock:
+            return self._mgr.ksp2_view(tenant_id, dsts)
+
+    def detach(self, tenant_id: str, warm: bool = True) -> None:
+        """Release a tenant's device slot; ``warm`` keeps the host
+        record for a cheap rehydration on return."""
+        with self._cv:
+            r = self._pending.pop(tenant_id, None)
+        if r is not None:
+            r.deliver(
+                error=RuntimeError(f"tenant {tenant_id!r} detached")
+            )
+        with self._mgr_lock:
+            if warm:
+                self._mgr.park(tenant_id)
+            else:
+                self._mgr.drop(tenant_id)
+        self._detached.add(tenant_id)
+
+    def connection_closed(self, conn: int) -> None:
+        """Ctrl-transport teardown hook: every tenant the connection
+        registered is parked warm — the shared bucket keeps serving
+        its other tenants and a reconnecting client rehydrates."""
+        with self._cv:
+            tenants = self._conn_tenants.pop(conn, set())
+        for tid in tenants:
+            self.detach(tid, warm=True)
+            self._reg.counter_bump("serve.disconnect_detaches")
+
+    # -- wave loop ---------------------------------------------------------
+
+    def _admit_locked(self) -> List[SolveRequest]:
+        """Cut the next wave from the pending table under ``_cv``:
+        SLO-ordered, budget-capped. Leftovers stay pending and lead
+        the next wave (their seq keeps their place in class order)."""
+        by_tenant = dict(self._pending)
+        ordered = order_requests(
+            [(r.slo, r.seq) for r in by_tenant.values()]
+        )
+        seq_to_req = {r.seq: r for r in by_tenant.values()}
+        admitted = [
+            seq_to_req[seq]
+            for _cls, seq in ordered[: self._wave_budget]
+        ]
+        for r in admitted:
+            del self._pending[r.tenant_id]
+        return admitted
+
+    def _wave_loop(self) -> None:
+        idle_ticks = 0
+        while True:
+            compact = False
+            with self._cv:
+                while not self._pending and not self._stop:
+                    self._cv.wait(0.05)
+                    if not self._pending and not self._stop:
+                        idle_ticks += 1
+                        if idle_ticks >= self._compact_every:
+                            idle_ticks = 0
+                            compact = True
+                            break
+                if self._stop:
+                    return
+                if not compact:
+                    idle_ticks = 0
+                    batch = self._admit_locked()
+                    self._wave_active = True
+            if compact:
+                self._maybe_compact()
+                continue
+            try:
+                self._run_wave(batch)
+            finally:
+                with self._cv:
+                    self._wave_active = False
+
+    def _maybe_compact(self) -> None:
+        """Idle-time occupancy compaction. Runs ONLY when the service
+        has had no pending work for a stretch AND no placement landed
+        since the last check: a resize is a new dispatch width (a
+        retrace), so compacting while requests flow — or mid
+        admission-ramp, when occupancy lags the tenant count — would
+        shrink a bucket that immediately regrows and break the
+        zero-compile wave-join contract. Under load the loop never
+        enters this branch; the vacancy threshold inside
+        ``compact_buckets`` keeps a busy full fleet untouched even
+        when it does."""
+        placements = TENANCY_COUNTERS["placements"]
+        if placements == self._placements_at_check:
+            with self._mgr_lock:
+                self._mgr.compact_buckets(self._compaction_vacancy)
+        self._placements_at_check = placements
+
+    def _run_wave(self, batch: List[SolveRequest]) -> None:
+        self._waves += 1
+        self._reg.counter_bump("serve.waves")
+        items = [(r.tenant_id, r.ls, r.root) for r in batch]
+        views = errors = None
+        try:
+            with self._mgr_lock:
+                views = self._mgr.solve_views(items)
+        except Exception as exc:  # noqa: BLE001 - relayed per request
+            errors = exc
+            self._reg.counter_bump("serve.errors")
+        now = time.perf_counter()
+        for i, r in enumerate(batch):
+            if errors is not None:
+                r.deliver(error=errors)
+                continue
+            try:
+                # the disconnect seam sits AT delivery: the wave solved
+                # this tenant, but its client died before consuming —
+                # park it warm, never poison the bucket
+                fault_point(FAULT_CLIENT_DISCONNECT)
+            except FaultInjected:
+                self.detach(r.tenant_id, warm=True)
+                self._reg.counter_bump("serve.disconnect_detaches")
+                r.deliver(error=ConnectionError(
+                    f"client of {r.tenant_id!r} disconnected"
+                ))
+                continue
+            self._reg.observe(
+                f"serve.latency_ms.{r.slo}",
+                (now - r.enqueued) * 1000.0,
+            )
+            r.deliver(view=views[i])
+
+    # -- introspection -----------------------------------------------------
+
+    def class_p99(self, slo: str) -> float:
+        return self._reg.percentile(f"serve.latency_ms.{slo}", 0.99)
+
+    def counters(self) -> Dict[str, float]:
+        snap = self._reg.snapshot()
+        return {
+            k: v
+            for k, v in snap.items()
+            if k.startswith("serve.") or k.startswith("tenancy.")
+        }

@@ -92,6 +92,20 @@ class Histogram:
         out[self.name + ".avg"] = round(hsum / count, 4)
         return out
 
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the sliding window (same rule
+        as ``stats``), 0.0 when empty — the serve plane's live SLO
+        breach check reads this between waves instead of snapshotting
+        the whole registry."""
+        with self._lock:
+            ring = list(self._ring[: self._filled])
+        if not ring:
+            return 0.0
+        window = sorted(ring)
+        n = len(window)
+        idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+        return window[idx]
+
 
 class Registry:
     """Process-wide metric store. All methods are thread-safe."""
@@ -144,6 +158,9 @@ class Registry:
 
     def observe(self, name: str, value: float) -> None:
         self.histogram(name).observe(value)
+
+    def percentile(self, name: str, q: float) -> float:
+        return self.histogram(name).percentile(q)
 
     @contextmanager
     def timed(self, name: str) -> Iterator[None]:

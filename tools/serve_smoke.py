@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Solver-service gate (``make serve-smoke``) and report artifact.
+
+Exercises solver-as-a-service (``openr_tpu.serve``) the way production
+would run it: ONE device-owning service process (this one) serving
+B>=64 tenants from >=3 jax-free client OS processes over the ctrl
+wire, with continuous-batching waves and SLO-class admission. Fails
+loudly if the serving contract regressed:
+
+- WIRE PARITY: every view digest every client reads, every round, must
+  equal the jax-free oracle replay of the same deterministic world +
+  churn schedule (``load.multi_client.oracle_digests``) — bit
+  identity through register/update/solve/decode,
+- ZERO-COMPILE WAVE JOINS: after the service warms its bucket, the
+  whole multi-process client storm (cold tenant joins, churn
+  re-solves, mid-wave joins) must cost ZERO jit compiles
+  (``jax.compile_count`` delta == 0),
+- SLO: per-class p99 solve latency (client-observed, wire included)
+  must sit under the class target (default 100ms — the CPU-scaled
+  smoke gate), and requests must actually JOIN in-flight waves
+  (``tenancy.wave_joins`` > 0) rather than serialize,
+- CLASS ORDERING: under a seeded in-process mixed-class storm pushed
+  through a budget-capped wave loop, premium p99 must not exceed
+  standard p99 (admission preemption is what buys it — counted in
+  ``tenancy.wave_preemptions``).
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_serve_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates the serving plane's
+scheduling and wire contracts, not device throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/serve_smoke.py) in addition
+# to module mode (python -m tools.serve_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+KINDS = [("grid", 3), ("ring", 8), ("mesh", 20)]
+
+
+def _client_specs(clients: int, per_client: int):
+    from openr_tpu.load.multi_client import TenantSpec
+    from openr_tpu.serve.slo import SLO_TABLE
+
+    classes = sorted(SLO_TABLE)
+    specs = {}
+    for c in range(clients):
+        lst = []
+        for j in range(per_client):
+            kind, size = KINDS[(c + j) % len(KINDS)]
+            lst.append(TenantSpec(
+                tenant_id=f"c{c}t{j}",
+                kind=kind,
+                size=size,
+                seed=c * per_client + j,
+                slo=classes[(c * per_client + j) % len(classes)],
+            ))
+        specs[f"c{c}"] = lst
+    return specs
+
+
+def _p99(samples):
+    if not samples:
+        return 0.0
+    window = sorted(samples)
+    n = len(window)
+    return window[min(n - 1, max(0, int(round(0.99 * (n - 1)))))]
+
+
+def _warmup(svc):
+    """Compile the bucket executables the client storm will ride:
+    cold place + solve, a warm churn re-solve, and a late join into
+    the already-warm bucket — after this, client traffic must be
+    retrace-free."""
+    from dataclasses import replace
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+
+    def _load(kind, size, seed):
+        topo = {
+            "grid": lambda: topologies.grid(size),
+            "ring": lambda: topologies.ring(size),
+            "mesh": lambda: topologies.random_mesh(
+                size, 3, seed=seed or 7
+            ),
+        }[kind]()
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        return ls
+
+    worlds = []
+    for i, (kind, size) in enumerate(KINDS):
+        ls = _load(kind, size, 1000 + i)
+        worlds.append((f"warm{i}", ls,
+                       sorted(ls.get_adjacency_databases())[0]))
+    for tid, ls, root in worlds:
+        svc.register(tid)
+        svc.solve(tid, ls, root)
+    for tid, ls, root in worlds:
+        node = sorted(ls.get_adjacency_databases())[0]
+        db = ls.get_adjacency_databases()[node]
+        adjs = list(db.adjacencies)
+        adjs[0] = replace(adjs[0], metric=17)
+        ls.update_adjacency_database(
+            replace(db, adjacencies=tuple(adjs))
+        )
+        svc.solve(tid, ls, root)
+    # late join: a NEW tenant entering the warm bucket
+    ls = _load("grid", 3, 2000)
+    svc.register("warm-join")
+    svc.solve(
+        "warm-join", ls, sorted(ls.get_adjacency_databases())[0]
+    )
+    for tid, _ls, _root in worlds + [("warm-join", None, None)]:
+        svc.detach(tid, warm=False)
+
+
+def _storm_gate(report, failures, storm_tenants, wave_budget):
+    """Seeded mixed-class storm through a budget-capped wave loop:
+    every request enqueued BEFORE the loop starts, so admission order
+    (class priority, seq) alone decides which wave each rides."""
+    import random
+
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.models import topologies
+    from openr_tpu.ops.world_batch import (
+        TENANCY_COUNTERS,
+        WorldManager,
+    )
+    from openr_tpu.serve.service import SolverService
+    from openr_tpu.serve.slo import SLO_TABLE
+
+    classes = sorted(SLO_TABLE)
+    rng = random.Random(20260806)
+    svc = SolverService(
+        manager=WorldManager(slots_per_bucket=64, max_resident=128),
+        wave_budget=wave_budget,
+    )
+    order = [classes[i % len(classes)] for i in range(storm_tenants)]
+    rng.shuffle(order)
+    pre0 = TENANCY_COUNTERS["wave_preemptions"]
+    done = {}
+    waiters = []
+    t_start = time.perf_counter()
+    for i, slo in enumerate(order):
+        topo = topologies.grid(3)
+        ls = LinkState(area=topo.area)
+        for name in sorted(topo.adj_dbs):
+            ls.update_adjacency_database(topo.adj_dbs[name])
+        tid = f"s{i}"
+        svc.register(tid, slo)
+        req = svc.request_solve(
+            tid, ls, sorted(ls.get_adjacency_databases())[0]
+        )
+
+        def _wait(req=req, slo=slo):
+            req.wait(120)
+            done.setdefault(slo, []).append(
+                (time.perf_counter() - t_start) * 1000.0
+            )
+
+        th = threading.Thread(target=_wait)
+        th.start()
+        waiters.append(th)
+    svc.start()
+    try:
+        for th in waiters:
+            th.join(120)
+    finally:
+        svc.stop()
+    p99 = {cls: _p99(done.get(cls, [])) for cls in classes}
+    preemptions = TENANCY_COUNTERS["wave_preemptions"] - pre0
+    report["storm"] = {
+        "tenants": storm_tenants,
+        "wave_budget": wave_budget,
+        "p99_ms": p99,
+        "preemptions": preemptions,
+    }
+    if p99["premium"] > p99["standard"]:
+        failures.append(
+            "premium p99 {:.2f}ms exceeds standard p99 {:.2f}ms "
+            "under the mixed-class storm".format(
+                p99["premium"], p99["standard"]
+            )
+        )
+    if preemptions < 1:
+        failures.append(
+            "the shuffled storm produced no counted wave preemptions"
+        )
+    report["gates"]["premium_p99_le_standard"] = (
+        p99["premium"] <= p99["standard"]
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_serve_smoke.json"
+    )
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--tenants-per-client", type=int, default=16)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument(
+        "--slo-ms",
+        type=float,
+        default=float(os.environ.get("OPENR_SERVE_SLO_MS", "100")),
+    )
+    parser.add_argument("--storm-tenants", type=int, default=60)
+    args = parser.parse_args(argv)
+
+    import tempfile
+
+    from openr_tpu.ctrl.server import CtrlServer
+    from openr_tpu.ctrl.solver import SolverCtrlHandler
+    from openr_tpu.load import multi_client
+    from openr_tpu.ops.world_batch import (
+        TENANCY_COUNTERS,
+        WorldManager,
+    )
+    from openr_tpu.serve.service import SolverService
+    from openr_tpu.telemetry import get_registry, jax_hooks
+
+    hooks_live = jax_hooks.install()
+    reg = get_registry()
+    failures: list = []
+    report: dict = {
+        "gates": {},
+        "clients": args.clients,
+        "tenants": args.clients * args.tenants_per_client,
+        "rounds": args.rounds,
+        "slo_ms": args.slo_ms,
+    }
+
+    svc = SolverService(
+        manager=WorldManager(slots_per_bucket=64, max_resident=128)
+    ).start()
+    srv = CtrlServer(SolverCtrlHandler(svc))
+    srv.start()
+    try:
+        _warmup(svc)
+        compiles0 = (
+            reg.counter_get("jax.compile_count") if hooks_live else 0
+        )
+        joins0 = TENANCY_COUNTERS["wave_joins"]
+
+        specs = _client_specs(args.clients, args.tenants_per_client)
+        t0 = time.perf_counter()
+        with tempfile.TemporaryDirectory() as out_dir:
+            procs = multi_client.spawn_clients(
+                "127.0.0.1", srv.port, specs, args.rounds, out_dir
+            )
+            results = multi_client.harvest(procs)
+        report["storm_wall_s"] = round(time.perf_counter() - t0, 3)
+
+        compile_delta = (
+            reg.counter_get("jax.compile_count") - compiles0
+            if hooks_live
+            else None
+        )
+        wave_joins = TENANCY_COUNTERS["wave_joins"] - joins0
+
+        # -- gate 1: every client finished every round cleanly ------------
+        errors = [e for r in results for e in r.get("errors", [])]
+        short = [
+            r["client_id"]
+            for r in results
+            if r.get("rounds", 0) != args.rounds
+        ]
+        if errors:
+            failures.append(f"client errors: {errors}")
+        if short:
+            failures.append(f"clients short of {args.rounds} rounds: {short}")
+        report["gates"]["clients_clean"] = not errors and not short
+
+        # -- gate 2: wire parity vs the oracle replay ---------------------
+        all_specs = [s for lst in specs.values() for s in lst]
+        oracle = multi_client.oracle_digests(all_specs, args.rounds)
+        diverged = []
+        for r in results:
+            for tid, digs in r.get("digests", {}).items():
+                if digs != oracle[tid]:
+                    diverged.append(tid)
+        if diverged:
+            failures.append(
+                f"{len(diverged)} tenants diverged from the oracle "
+                f"replay: {diverged[:8]}"
+            )
+        report["gates"]["wire_parity"] = not diverged
+
+        # -- gate 3: B>=64 tenants actually served ------------------------
+        served = sum(len(r.get("digests", {})) for r in results)
+        report["tenants_served"] = served
+        if served < 64:
+            failures.append(
+                f"only {served} tenants served (gate needs >= 64)"
+            )
+        report["gates"]["b64_tenants"] = served >= 64
+
+        # -- gate 4: zero-compile wave joins ------------------------------
+        report["gates"]["compile_delta_after_warmup"] = compile_delta
+        if compile_delta is not None and compile_delta > 0:
+            failures.append(
+                f"jit retraced {compile_delta}x during the client "
+                "storm (wave joins must be retrace-free after warmup)"
+            )
+        report["wave_joins"] = wave_joins
+        if wave_joins < 1:
+            failures.append(
+                "no request joined an in-flight wave (continuous "
+                "batching is not batching)"
+            )
+        report["gates"]["wave_joins"] = wave_joins >= 1
+
+        # -- gate 5: per-class p99 under the SLO --------------------------
+        lat = {}
+        for r in results:
+            for cls, samples in r.get("latencies_ms", {}).items():
+                lat.setdefault(cls, []).extend(samples)
+        p99 = {cls: round(_p99(s), 3) for cls, s in sorted(lat.items())}
+        report["client_p99_ms"] = p99
+        report["server_p99_ms"] = {
+            cls: round(svc.class_p99(cls), 3) for cls in sorted(lat)
+        }
+        for cls, v in p99.items():
+            if v > args.slo_ms:
+                failures.append(
+                    f"{cls} client p99 {v:.2f}ms breaches the "
+                    f"{args.slo_ms:.0f}ms smoke SLO"
+                )
+        report["gates"]["slo_p99"] = all(
+            v <= args.slo_ms for v in p99.values()
+        )
+    finally:
+        srv.stop()
+        svc.stop()
+
+    # -- gate 6: premium beats standard under a seeded storm --------------
+    _storm_gate(report, failures, args.storm_tenants, wave_budget=8)
+
+    report["counters"] = {
+        f"tenancy.{k}": TENANCY_COUNTERS[k] for k in TENANCY_COUNTERS
+    }
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("SERVE SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"serve smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
