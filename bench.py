@@ -323,6 +323,12 @@ def _run() -> dict:
             # e2e-vs-device ratio (the committed-dispatch target is
             # this trending to ~1 as host turnarounds leave the path)
             leg["host_overhead_ratio"] = round(v / max(dev, 1e-3), 2)
+        measured = _measured_overhead_ratio()
+        if measured is not None:
+            # the profiler's own wall-vs-device account over recent
+            # dispatch windows — this is the headline; the derived
+            # ratio above stays for comparison against old artifacts
+            leg["host_overhead_ratio_measured"] = measured
         return leg
 
     # second leg: 10k-node resident-ELL churn (the north-star scale
@@ -701,6 +707,12 @@ def _run() -> dict:
             round(value / max(device_only, 1e-3), 2)
             if device_only else None
         ),
+        # headline measured ratio from the always-on profiling plane
+        # (paired host/device timing per dispatch window) plus per-tag
+        # host-touch distributions — the per-stage account that the
+        # derived e2e/device ratio above can only approximate
+        "host_overhead_ratio_measured": _measured_overhead_ratio(),
+        "host_touches_by_tag": _host_touches_by_tag(),
         "n_nodes": snap0.n,
         "platform": platform,
         "minplus_impl": spf_ops.get_minplus_impl(),
@@ -730,6 +742,40 @@ def _run() -> dict:
         "spf_counters": _spf_counter_snapshot(),
         "error": None,
     }
+
+
+def _measured_overhead_ratio() -> "float | None":
+    """Live ``ops.host_overhead_ratio`` from the profiling plane:
+    sum(window wall) / sum(attributed device time) over the recent
+    dispatch windows, or None before any sampled window landed."""
+    try:
+        from openr_tpu.telemetry import get_profiler
+
+        ratio = get_profiler().host_overhead_ratio()
+        return round(ratio, 3) if ratio is not None else None
+    except Exception:
+        return None
+
+
+def _host_touches_by_tag() -> dict:
+    """Per-tag ``ops.host_touches.<tag>`` snapshots (p50 + count) —
+    which dispatch stages pay host turnarounds, and how often."""
+    try:
+        from openr_tpu.telemetry import get_registry
+
+        reg = get_registry()
+        out = {}
+        for name, h in sorted(reg.histograms().items()):
+            if not name.startswith("ops.host_touches.") or not h.count:
+                continue
+            tag = name[len("ops.host_touches."):]
+            out[tag] = {
+                "p50": round(h.percentile(0.50), 3),
+                "count": h.count,
+            }
+        return out
+    except Exception:
+        return {}
 
 
 def _histogram_snapshot() -> dict:

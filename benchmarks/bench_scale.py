@@ -54,6 +54,28 @@ def _relay_rtt_ms() -> float:
     return round(statistics.median(ts), 2)
 
 
+def _host_touches_by_tag() -> dict:
+    """Per-tag ``ops.host_touches.<tag>`` p50s from the live registry:
+    which event-window tags ran, and how many host turnarounds each
+    cost per window (2 == the warm committed-dispatch contract)."""
+    from openr_tpu.telemetry import get_registry
+
+    out = {}
+    for name, h in get_registry().histograms().items():
+        if name.startswith("ops.host_touches.") and h.count:
+            out[name[len("ops.host_touches."):]] = {
+                "p50": round(h.percentile(0.50), 1),
+                "count": h.count,
+            }
+    return out
+
+
+def _get_profiler():
+    from openr_tpu.telemetry import get_profiler
+
+    return get_profiler()
+
+
 def _chained_device_only_ms(step, readback, k: int = 4,
                             reps: int = 5) -> float:
     """Per-dispatch device time via K data-dependent chained dispatches
@@ -1147,6 +1169,13 @@ def route_engine_churn_bench(
         "host_overhead_ratio": round(
             statistics.median(samples) / max(device_only_ms, 1e-3), 2
         ),
+        # MEASURED ratio (telemetry.profiler): window wall over sampled
+        # block-for-ready device time — the headline number; the
+        # derived chained-dispatch ratio above stays for comparison
+        "host_overhead_ratio_measured": (
+            _get_profiler().host_overhead_ratio() or None
+        ),
+        "host_touches_by_tag": _host_touches_by_tag(),
         "relay_rtt_ms": _relay_rtt_ms(),
         "platform": jax.devices()[0].platform,
         "oracle_spot_check": "passed",

@@ -29,6 +29,7 @@ REQUIRES_DRAIN_ATTR = "__openr_requires_drain__"
 DONATES_ATTR = "__openr_donates__"
 FAULT_BOUNDARY_ATTR = "__openr_fault_boundary__"
 MIRROR_ATTR = "__openr_host_mirrors__"
+FLIGHT_CALLBACK_ATTR = "__openr_flight_callback__"
 
 
 def solve_window(fn: F) -> F:
@@ -123,6 +124,23 @@ def fault_boundary(fn: F) -> F:
     close-in-except + re-raise shape as a protected exit path."""
     try:
         setattr(fn, FAULT_BOUNDARY_ATTR, True)
+    except AttributeError:
+        pass
+    return fn
+
+
+def flight_callback(fn: F) -> F:
+    """Mark a function as an anomaly-trigger / flight-recorder callback
+    that runs on the wave loop or another dispatch-adjacent thread. A
+    post-mortem dump is file I/O plus a full counter snapshot, so a
+    callback body must never synchronize with the device — the
+    ``span-discipline`` rule flags raw host-sync forms
+    (``jax.device_get``, ``.block_until_ready()``, device-scalar
+    coercion) in its direct body. Dump deferral lives in
+    ``telemetry.flight._fire``; this marker keeps callback authors
+    honest about everything else."""
+    try:
+        setattr(fn, FLIGHT_CALLBACK_ATTR, True)
     except AttributeError:
         pass
     return fn

@@ -30,7 +30,13 @@ mystery counter hours later. This rule pushes the check to lint time:
   match the fb303 dotted convention ``component.sub.metric`` —
   lowercase, digits, underscores, at least one dot. Dynamically built
   names (``"jax.events." + suffix``) are skipped; they are covered by
-  the runtime registry, not lint.
+  the runtime registry, not lint;
+- a ``@flight_callback`` function (an anomaly-trigger / flight-recorder
+  callback registered on the wave loop) must not synchronize with the
+  device in its direct body — a dump must never block a solve window,
+  so raw ``jax.device_get`` / ``.block_until_ready()`` / device-scalar
+  coercion forms are findings (same classifier as
+  ``committed-dispatch``; host-side numpy prep stays legal).
 """
 
 from __future__ import annotations
@@ -45,6 +51,11 @@ from openr_tpu.analysis.core import (
     Rule,
     SourceFile,
     decorator_info,
+)
+from openr_tpu.analysis.rules.hostsync import (
+    CommittedDispatchRule,
+    _has_decorator,
+    _own_body_walk,
 )
 
 
@@ -101,10 +112,39 @@ class SpanDisciplineRule(Rule):
         findings.extend(self._check_names(sf))
         for fn, _cls in sf.functions():
             findings.extend(self._check_spans(sf, fn))
+            findings.extend(self._check_flight_callback(sf, fn))
         assert sf.tree is not None
         for node in ast.walk(sf.tree):
             if isinstance(node, ast.ClassDef):
                 findings.extend(self._check_attr_clears(sf, node))
+        return findings
+
+    # -- flight-callback host-sync ban --------------------------------
+
+    def _check_flight_callback(
+        self, sf: SourceFile, fn: ast.AST
+    ) -> Iterable[Finding]:
+        """An anomaly-trigger callback runs on the wave loop between
+        solves; any device sync in it stalls every tenant in the wave.
+        Same classifier as ``committed-dispatch`` (raw device_get /
+        block_until_ready / device-scalar coercion; host numpy ok)."""
+        if not _has_decorator(fn, "flight_callback"):
+            return []
+        classifier = CommittedDispatchRule()
+        findings: List[Finding] = []
+        for node in _own_body_walk(fn):
+            hit = classifier._classify(node)
+            if hit is not None:
+                findings.append(
+                    Finding(
+                        self.id, sf.path, node.lineno, node.col_offset,
+                        f"{hit} inside @flight_callback '{fn.name}' — "
+                        "an anomaly-trigger callback must never block "
+                        "a solve window (note() the evidence; the "
+                        "flight recorder defers the dump to window "
+                        "retirement)",
+                    )
+                )
         return findings
 
     # -- metric / span naming ----------------------------------------

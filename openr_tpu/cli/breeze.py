@@ -386,6 +386,51 @@ class Breeze:
             render_table(["Trace", "State", "e2e_ms", "Spans"], rows)
         )
 
+    def monitor_flight(self, limit: int = 30, dump: bool = False,
+                       fmt: str = "table") -> None:
+        """The flight recorder's recent-activity ring + live per-stage
+        device-time attribution; ``--dump`` forces a post-mortem
+        bundle to disk on the server and prints its path."""
+        if dump:
+            out = self.client.call(
+                "dump_postmortem", trigger="manual",
+                reason="breeze monitor flight --dump",
+            )
+            self._print(f"post-mortem bundle: {out.get('path')}")
+            return
+        rec = self.client.call("get_flight_record", limit=limit)
+        if fmt == "json":
+            self._print(json.dumps(rec, indent=2))
+            return
+        rows = []
+        for r in rec["records"]:
+            extra = {
+                k: v for k, v in r.items() if k not in ("ts", "kind")
+            }
+            rows.append((r["ts"], r["kind"], json.dumps(extra)))
+        self._print(render_table(["ts", "kind", "detail"], rows))
+        attr_rows = [
+            (
+                tag,
+                row.get("device_ms_p50"),
+                row.get("host_ms_p50"),
+                row.get("calls"),
+                row.get("device_samples"),
+            )
+            for tag, row in sorted(rec["attribution"].items())
+        ]
+        self._print(
+            render_table(
+                ["Stage", "device_ms_p50", "host_ms_p50", "calls",
+                 "samples"],
+                attr_rows,
+            )
+        )
+        self._print(
+            f"host_overhead_ratio={rec['host_overhead_ratio']} "
+            f"triggers={','.join(rec['triggers']) or '(none)'}"
+        )
+
     # -- openr ------------------------------------------------------------
 
     def openr_version(self) -> None:
@@ -646,6 +691,15 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("table", "jsonl", "chrome"),
         default="table",
     )
+    flight = m.add_parser("flight")
+    flight.add_argument("--limit", type=int, default=30)
+    flight.add_argument("--dump", action="store_true")
+    flight.add_argument(
+        "--format",
+        dest="fmt",
+        choices=("table", "json"),
+        default="table",
+    )
 
     o = group("openr")
     o.add_parser("version")
@@ -748,6 +802,9 @@ def run(argv: List[str], client=None, out=None) -> int:
         "monitor.logs": lambda: breeze.monitor_logs(args.limit),
         "monitor.traces": lambda: breeze.monitor_traces(
             args.limit, args.fmt
+        ),
+        "monitor.flight": lambda: breeze.monitor_flight(
+            args.limit, args.dump, args.fmt
         ),
         "openr.version": breeze.openr_version,
         "openr.config": breeze.openr_config,

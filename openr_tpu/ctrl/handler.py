@@ -421,6 +421,32 @@ class OpenrCtrlHandler:
             return tracer.jsonl(limit)
         return [t.to_dict() for t in tracer.traces(limit)]
 
+    def get_flight_record(self, limit: int = 0) -> Dict[str, Any]:
+        """The flight recorder's recent-activity ring (newest last)
+        plus the live device-time attribution — the first stop of the
+        post-mortem triage recipe (docs/RUNBOOK.md)."""
+        from openr_tpu.telemetry import get_flight_recorder, get_profiler
+
+        fr = get_flight_recorder()
+        prof = get_profiler()
+        return {
+            "records": fr.records(limit),
+            "triggers": fr.trigger_names(),
+            "attribution": prof.attribution(),
+            "host_overhead_ratio": prof.host_overhead_ratio(),
+        }
+
+    def dump_postmortem(self, trigger: str = "manual",
+                        reason: str = "") -> Dict[str, Any]:
+        """Force a post-mortem bundle to disk right now (counted
+        ``flight.dumps.manual`` unless a trigger name is given)."""
+        from openr_tpu.telemetry import get_flight_recorder
+
+        path = get_flight_recorder().dump_postmortem(
+            trigger=trigger, reason=reason or "operator request"
+        )
+        return {"path": path}
+
     # -- LinkMonitor ------------------------------------------------------
 
     def get_interfaces(self):

@@ -151,3 +151,32 @@ class SolverCtrlHandler:
 
     def solver_ping(self) -> Dict:
         return {"ok": True, "waves": self._svc.waves()}
+
+    def solver_stage_attribution(self) -> Dict:
+        """Per-SLO-class p99 beside the measured per-stage device/host
+        costs (``SolverService.stage_attribution``)."""
+        return self._svc.stage_attribution()
+
+    def get_flight_record(self, limit: int = 0) -> Dict:
+        """Same surface as the OpenrCtrl handler: the flight ring +
+        live attribution, so ``breeze monitor flight`` works against a
+        solver process too."""
+        from openr_tpu.telemetry import get_flight_recorder, get_profiler
+
+        fr = get_flight_recorder()
+        prof = get_profiler()
+        return {
+            "records": fr.records(limit),
+            "triggers": fr.trigger_names(),
+            "attribution": prof.attribution(),
+            "host_overhead_ratio": prof.host_overhead_ratio(),
+        }
+
+    def dump_postmortem(self, trigger: str = "manual",
+                        reason: str = "") -> Dict:
+        from openr_tpu.telemetry import get_flight_recorder
+
+        path = get_flight_recorder().dump_postmortem(
+            trigger=trigger, reason=reason or "operator request"
+        )
+        return {"path": path}

@@ -44,7 +44,11 @@ from openr_tpu.faults.supervisor import DegradationSupervisor, HealthState
 from openr_tpu.integrity import get_auditor, quarantine_active
 from openr_tpu.load.admission import AdmissionControl
 from openr_tpu.ops import dispatch_accounting as da
-from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.telemetry import (
+    get_registry,
+    get_tracer,
+    install_default_triggers,
+)
 from openr_tpu.utils import keys as keyutil
 from openr_tpu.utils import wire
 from openr_tpu.utils.eventbase import AsyncDebounce, OpenrEventBase
@@ -223,6 +227,10 @@ class Decision:
             "native" if solver_backend == "device" else solver_backend
         )
         self.supervisor = DegradationSupervisor("decision")
+        # standing anomaly set (p99 breach vs rolling baseline,
+        # compile-after-warmup, reshard delta): always-on from the
+        # moment a pipeline exists, idempotent across instances
+        install_default_triggers()
         # monotonic stamp of the last route db installed while the
         # ladder was fully warm and no engine sat in integrity
         # quarantine — the staleness gauge ages from it while degraded

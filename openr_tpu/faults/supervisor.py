@@ -36,7 +36,7 @@ import zlib
 from enum import IntEnum
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
-from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.telemetry import get_flight_recorder, get_registry, get_tracer
 from openr_tpu.utils.eventbase import ExponentialBackoff
 
 Rung = Tuple[str, Callable[[], Any]]
@@ -127,6 +127,12 @@ class DegradationSupervisor:
             self.state = HealthState.FALLBACK
             self.breaker.report_error()
             self._held_rung = len(rungs) - 1
+            get_flight_recorder().anomaly(
+                "ladder_exhausted",
+                reason=f"{self.name}: all {len(rungs)} rungs failed",
+                ladder=self.name,
+                rungs=[r for r, _ in failures],
+            )
             raise LadderExhausted(self.name, failures)
 
     # ------------------------------------------------------------------
@@ -161,6 +167,13 @@ class DegradationSupervisor:
             span = tracer.span_active(f"{self.name}.ladder")
             tracer.end_span_active(
                 span,
+                rung=rung_name,
+                health=new.name,
+                rungs_tried=index - start + 1,
+            )
+            get_flight_recorder().note(
+                "ladder",
+                name=self.name,
                 rung=rung_name,
                 health=new.name,
                 rungs_tried=index - start + 1,

@@ -33,7 +33,7 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from openr_tpu.integrity.contract import ResidentEngineContract
-from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.telemetry import get_flight_recorder, get_registry, get_tracer
 
 
 class IntegrityAuditor:
@@ -144,6 +144,12 @@ class IntegrityAuditor:
                 reg.counter_bump("integrity.quarantines")
                 self._quarantined.add(engine)
                 engine.quarantine(f"integrity audit: {tier} violation")
+                get_flight_recorder().anomaly(
+                    "quarantine",
+                    reason=f"{engine.audit_kind}: {tier} violation",
+                    audit_kind=engine.audit_kind,
+                    tier=tier,
+                )
                 healed = False
                 try:
                     healed = bool(engine.integrity_heal())
@@ -163,6 +169,10 @@ class IntegrityAuditor:
         finally:
             tracer.end_span_active(
                 span, kind=engine.audit_kind, verdict=verdict, tier=tier
+            )
+            get_flight_recorder().note(
+                "audit", audit_kind=engine.audit_kind, verdict=verdict,
+                tier=tier,
             )
         return {
             "kind": engine.audit_kind, "verdict": verdict, "tier": tier,

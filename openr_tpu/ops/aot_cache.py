@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -44,8 +45,26 @@ import numpy as np
 
 from openr_tpu.ops import dispatch_accounting
 from openr_tpu.telemetry import get_registry
+from openr_tpu.telemetry.profiler import get_profiler
 
 _UNCOMPILABLE = object()  # poison marker: lower/compile failed once
+
+
+def _profiled(tag: str, thunk):
+    """Run one dispatch under device-time attribution: host wall time
+    always, sampled block-for-ready device time per the profiler's
+    cadence, both folded into the active event window's stage table.
+    Disabled profiler == the bare call (one attribute read)."""
+    prof = get_profiler()
+    if not prof.enabled:
+        return thunk()
+    with prof.annotate(tag):
+        t0 = time.perf_counter()
+        out = thunk()
+        host_ms = (time.perf_counter() - t0) * 1000.0
+    device_ms = prof.on_dispatch(tag, out, host_ms)
+    dispatch_accounting.attribute_stage(tag, host_ms, device_ms)
+    return out
 
 
 def cache_dir() -> Optional[str]:
@@ -117,7 +136,7 @@ class AotDispatchCache:
         key, exe = self._lookup(tag, fn, dyn_args, statics)
         if key is None or exe is _UNCOMPILABLE:
             reg.counter_bump("ops.aot_fallbacks")
-            return fn(*dyn_args, **statics)
+            return _profiled(tag, lambda: fn(*dyn_args, **statics))
         if exe is None:
             try:
                 exe = fn.lower(*dyn_args, **statics).compile()
@@ -125,7 +144,7 @@ class AotDispatchCache:
                 with self._lock:
                     self._exes[key] = _UNCOMPILABLE
                 reg.counter_bump("ops.aot_fallbacks")
-                return fn(*dyn_args, **statics)
+                return _profiled(tag, lambda: fn(*dyn_args, **statics))
             with self._lock:
                 self._exes[key] = exe
             reg.counter_bump("ops.aot_compiles")
@@ -134,10 +153,10 @@ class AotDispatchCache:
         try:
             # dynamic operands ONLY: the statics were baked at lower
             # time and no longer exist as parameters of the executable
-            return exe(*dyn_args)
+            return _profiled(tag, lambda: exe(*dyn_args))
         except Exception:  # noqa: BLE001 - absorb into jitted path
             reg.counter_bump("ops.aot_fallbacks")
-            return fn(*dyn_args, **statics)
+            return _profiled(tag, lambda: fn(*dyn_args, **statics))
 
     def warm(self, tag: str, fn, dyn_args: Tuple,
              statics: Dict[str, Any]) -> bool:

@@ -35,12 +35,15 @@ spanning N folded events still reads as ONE submit + ONE reap.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
-from typing import Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import jax
 
 from openr_tpu.telemetry import get_registry
+from openr_tpu.telemetry.flight import get_flight_recorder
+from openr_tpu.telemetry.profiler import get_profiler
 
 _TLS = threading.local()
 
@@ -51,6 +54,7 @@ class EventWindow:
     __slots__ = (
         "tag", "dispatches", "blocking_syncs", "async_reaps",
         "submit_phases", "read_phases", "_last",
+        "t0", "device_ms", "stages",
     )
 
     def __init__(self, tag: str):
@@ -61,6 +65,11 @@ class EventWindow:
         self.submit_phases = 0
         self.read_phases = 0
         self._last: Optional[str] = None
+        self.t0 = time.perf_counter()
+        # device-time attribution (fed by attribute_stage): total
+        # device ms inside this window + per-tag [calls, host, device]
+        self.device_ms = 0.0
+        self.stages: Dict[str, List[float]] = {}
 
     def _mark(self, phase: str) -> None:
         if self._last != phase:
@@ -100,6 +109,29 @@ def event_window(tag: str = "event") -> Iterator[EventWindow]:
         reg = get_registry()
         reg.observe("ops.host_touches", float(w.touches))
         reg.observe(f"ops.host_touches.{w.tag}", float(w.touches))
+        # window retired (stack popped): safe point for the profiling
+        # plane — ratio bookkeeping, flight record, trigger checks,
+        # and any deferred post-mortem dump all run OUTSIDE the window
+        wall_ms = (time.perf_counter() - w.t0) * 1000.0
+        get_profiler().on_window(w.tag, wall_ms, w.device_ms)
+        get_flight_recorder().on_window(w.tag, wall_ms, w)
+
+
+def attribute_stage(tag: str, host_ms: float, device_ms: float) -> None:
+    """Fold one profiled dispatch into the active window's device-time
+    attribution (no-op outside a window). Called by the aot_cache for
+    every timed call; keeps ``touches``-style accounting untouched."""
+    w = current_window()
+    if w is None:
+        return
+    w.device_ms += device_ms
+    s = w.stages.get(tag)
+    if s is None:
+        w.stages[tag] = [1, host_ms, device_ms]
+    else:
+        s[0] += 1
+        s[1] += host_ms
+        s[2] += device_ms
 
 
 def count_dispatch(n: int = 1) -> None:

@@ -109,7 +109,7 @@ from openr_tpu.faults.injector import (
 from openr_tpu.faults.supervisor import DegradationSupervisor
 from openr_tpu.integrity import ResidentEngineContract, get_auditor
 from openr_tpu.integrity import kernels as integrity_kernels
-from openr_tpu.telemetry import get_registry, get_tracer
+from openr_tpu.telemetry import get_flight_recorder, get_registry, get_tracer
 
 # degradation-ladder injection sites (armable by name; see
 # openr_tpu.faults.injector)
@@ -1039,6 +1039,9 @@ class RouteSweepEngine(ResidentEngineContract):
             self, "frontier_fallbacks", 0
         )
         get_registry().counter_bump("route_engine.cold_builds")
+        get_flight_recorder().note(
+            "engine", path="cold_build", n=int(graph.n_pad)
+        )
 
     def _refresh_sample_bands(self, patched, affected_nodes) -> bool:
         """A churn event that touched a SAMPLE node's own adjacencies
@@ -1279,6 +1282,7 @@ class RouteSweepEngine(ResidentEngineContract):
         # artifacts
         self.full_refreshes += 1
         get_registry().counter_bump("route_engine.full_refreshes")
+        get_flight_recorder().note("engine", path="full_refresh")
         return self._commit_full_width(
             ls, dr, digests, packed, new_out, ov_flips, defer=defer
         )
@@ -1477,6 +1481,9 @@ class RouteSweepEngine(ResidentEngineContract):
                     )
             self.frontier_fallbacks += 1
             reg.counter_bump("ops.frontier_fallbacks")
+            get_flight_recorder().note(
+                "engine", path="frontier_fallback", rows=rows, jumps=jumps
+            )
             return self._full_refresh(
                 ls, ctx, ov_new, new_out, ov_flips, defer=defer
             )
@@ -1499,6 +1506,7 @@ class RouteSweepEngine(ResidentEngineContract):
         dr, digests, packed = self._frontier_resident(cone)
         self.frontier_resolves += 1
         get_registry().counter_bump("route_engine.frontier_resolves")
+        get_flight_recorder().note("engine", path="frontier_resolve")
         return self._commit_full_width(
             ls, dr, digests, packed, new_out, ov_flips, defer=defer
         )

@@ -90,6 +90,7 @@ from openr_tpu.ops.spf_sparse import (
     ell_source_batch,
     ell_uniform_rows,
 )
+from openr_tpu.telemetry import get_profiler as _get_profiler
 from openr_tpu.telemetry import get_registry as _get_registry
 from openr_tpu.telemetry import get_tracer as _get_tracer
 
@@ -1016,15 +1017,27 @@ class WorldManager(ResidentEngineContract):
                 inc_w[slot, x] = ww
         cap = bucket.delta_cap
         fault_point(FAULT_DEVICE_LOST)
-        packed, d, src_new, w_new, ch_count, out = aot_call(
-            "world_dispatch", world_dispatch,
-            (
-                bucket.src_dev, bucket.w_dev, bucket.ov_dev,
-                bucket.srcs_dev, p_rows, p_src, p_w,
-                inc_t, inc_h, inc_w, bucket.d_dev, bucket.packed_dev,
-            ),
-            dict(cap=cap),
-        )
+        slo_counts = {cls: 0 for cls in SLO_CLASSES}
+        for _slot, t in solving:
+            slo_counts[t.slo] = slo_counts.get(t.slo, 0) + 1
+        # label the sampled device timing with this bucket's shape key
+        # and its dominant SLO class, so ops.device_ms.by_bucket.* /
+        # by_slo.* attribute the wave per tenant bucket and SLO
+        dominant = max(slo_counts, key=slo_counts.get) if solving \
+            else "idle"
+        with _get_profiler().labels(
+            bucket=f"{bucket.s}x{bucket.n}x{bucket.k}", slo=dominant,
+        ):
+            packed, d, src_new, w_new, ch_count, out = aot_call(
+                "world_dispatch", world_dispatch,
+                (
+                    bucket.src_dev, bucket.w_dev, bucket.ov_dev,
+                    bucket.srcs_dev, p_rows, p_src, p_w,
+                    inc_t, inc_h, inc_w, bucket.d_dev,
+                    bucket.packed_dev,
+                ),
+                dict(cap=cap),
+            )
         bucket.src_dev = src_new
         bucket.w_dev = w_new
         bucket.d_dev = d
@@ -1032,9 +1045,6 @@ class WorldManager(ResidentEngineContract):
         # both readback lanes kicked at submit; _dispatch_finish reaps
         da.kick_async(ch_count)
         da.kick_async(out)
-        slo_counts = {cls: 0 for cls in SLO_CLASSES}
-        for _slot, t in solving:
-            slo_counts[t.slo] = slo_counts.get(t.slo, 0) + 1
         return (
             bucket, solving, warm_ct, cold_ct,
             packed, ch_count, out, _span, _t0, slo_counts,

@@ -50,6 +50,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set
 
+from openr_tpu.analysis.annotations import flight_callback
 from openr_tpu.faults import (
     FaultInjected,
     fault_point,
@@ -57,7 +58,13 @@ from openr_tpu.faults import (
 )
 from openr_tpu.ops.world_batch import TENANCY_COUNTERS, WorldManager
 from openr_tpu.serve.slo import SLO_TABLE, order_requests
-from openr_tpu.telemetry import get_registry as _get_registry
+from openr_tpu.telemetry import (
+    P99BreachTrigger,
+    get_flight_recorder,
+    get_profiler,
+    get_registry as _get_registry,
+    install_default_triggers,
+)
 
 FAULT_CLIENT_DISCONNECT = register_fault_site("serve.client_disconnect")
 FAULT_SLOW_CLIENT = register_fault_site("serve.slow_client")
@@ -146,6 +153,18 @@ class SolverService:
         self._conn_tenants: Dict[int, Set[str]] = {}
         self._detached: Set[str] = set()
         self._reg = _get_registry()
+        # standing anomaly set + one p99-breach trigger per SLO class,
+        # so every breach freezes the flight ring with the admission /
+        # window records that explain it (idempotent across services
+        # sharing the process recorder)
+        fr = install_default_triggers()
+        armed = set(fr.trigger_names())
+        for cls in SLO_TABLE:
+            name = f"p99_breach_{cls}"
+            if name not in armed:
+                fr.add_trigger(
+                    P99BreachTrigger(name, f"serve.latency_ms.{cls}")
+                )
         self._thread = threading.Thread(
             target=self._wave_loop, name="solver-wave-loop", daemon=True
         )
@@ -262,6 +281,7 @@ class SolverService:
         SLO-ordered, budget-capped. Leftovers stay pending and lead
         the next wave (their seq keeps their place in class order)."""
         by_tenant = dict(self._pending)
+        preempt0 = TENANCY_COUNTERS["wave_preemptions"]
         ordered = order_requests(
             [(r.slo, r.seq) for r in by_tenant.values()]
         )
@@ -272,6 +292,16 @@ class SolverService:
         ]
         for r in admitted:
             del self._pending[r.tenant_id]
+        mix: Dict[str, int] = {}
+        for r in admitted:
+            mix[r.slo] = mix.get(r.slo, 0) + 1
+        get_flight_recorder().note(
+            "admission",
+            admitted=len(admitted),
+            deferred=len(by_tenant) - len(admitted),
+            mix=mix,
+            preemptions=TENANCY_COUNTERS["wave_preemptions"] - preempt0,
+        )
         return admitted
 
     def _wave_loop(self) -> None:
@@ -352,11 +382,33 @@ class SolverService:
                 (now - r.enqueued) * 1000.0,
             )
             r.deliver(view=views[i])
+        self._check_slo_triggers()
+
+    @flight_callback
+    def _check_slo_triggers(self) -> None:
+        """Post-delivery anomaly sweep on the wave loop: per-class p99
+        breach + the standing trigger set. Runs after every wave, after
+        results are delivered and outside any event window, so a
+        trigger firing here dumps immediately instead of deferring."""
+        get_flight_recorder().check_triggers()
 
     # -- introspection -----------------------------------------------------
 
     def class_p99(self, slo: str) -> float:
         return self._reg.percentile(f"serve.latency_ms.{slo}", 0.99)
+
+    def stage_attribution(self) -> Dict[str, object]:
+        """Every SLO-class p99 next to the measured per-stage device /
+        host costs that produced it — the serve plane's answer to
+        'which stage is eating my latency budget'."""
+        prof = get_profiler()
+        return {
+            "class_p99_ms": {
+                cls: round(self.class_p99(cls), 3) for cls in SLO_TABLE
+            },
+            "stages": prof.attribution(),
+            "host_overhead_ratio": prof.host_overhead_ratio(),
+        }
 
     def counters(self) -> Dict[str, float]:
         snap = self._reg.snapshot()

@@ -15,6 +15,12 @@ Three pieces, one export surface:
 - ``jax_hooks.py``: jax.monitoring listeners mapping jit compiles to
   ``jax.compile_count`` / ``jax.compile_ms`` so compile-cache
   regressions show up as counters, not silent latency cliffs.
+- ``profiler.py``: always-on device-time attribution — measured
+  ``ops.device_ms.<tag>`` / ``ops.host_ms.<tag>`` per dispatch tag and
+  a live ``ops.host_overhead_ratio`` gauge.
+- ``flight.py``: the flight recorder — a lock-cheap activity ring that
+  survives trace-ring overflow, with anomaly triggers that freeze it
+  and dump post-mortem bundles.
 """
 
 from openr_tpu.telemetry.registry import (  # noqa: F401
@@ -29,14 +35,38 @@ from openr_tpu.telemetry.trace import (  # noqa: F401
     Tracer,
     get_tracer,
 )
+from openr_tpu.telemetry.profiler import (  # noqa: F401
+    Profiler,
+    get_profiler,
+    reset_profiler,
+)
+from openr_tpu.telemetry.flight import (  # noqa: F401
+    CompileAfterWarmupTrigger,
+    CounterDeltaTrigger,
+    FlightRecorder,
+    P99BreachTrigger,
+    get_flight_recorder,
+    install_default_triggers,
+    reset_flight_recorder,
+)
 
 __all__ = [
+    "CompileAfterWarmupTrigger",
+    "CounterDeltaTrigger",
     "CounterDict",
+    "FlightRecorder",
     "Histogram",
+    "P99BreachTrigger",
+    "Profiler",
     "Registry",
     "Span",
     "Trace",
     "Tracer",
+    "get_flight_recorder",
+    "get_profiler",
     "get_registry",
     "get_tracer",
+    "install_default_triggers",
+    "reset_flight_recorder",
+    "reset_profiler",
 ]

@@ -1,0 +1,414 @@
+"""Flight recorder: a lock-cheap ring of recent system activity that
+survives trace-ring overflow, plus anomaly triggers that freeze it and
+dump a post-mortem bundle to disk.
+
+Why a second ring: the span tracer keeps ~256 *full* traces — at 200+
+events/s that is ~1 s of history, gone before anyone asks "what
+happened right before the p99 breach / the quarantine / the compile
+storm". A flight record is a flat dict (one event window's touch
+counts, one ladder rung, one audit verdict, one wave admission), so a
+2048-deep ring holds tens of seconds of causally-ordered activity for
+the cost of a lock + deque append per record.
+
+Record kinds (see docs/ARCHITECTURE.md "Flight recorder"):
+
+- ``window``   — one retired event window: tag, wall_ms, touches,
+  dispatches, blocking_syncs, async_reaps, attributed device_ms, and
+  per-stage {calls, host_ms, device_ms} (from
+  ``ops/dispatch_accounting.py``);
+- ``trace``    — compact summary of every retired trace (origin,
+  e2e_ms, span names) noted by ``Tracer.finish`` — survives the trace
+  ring's own overflow;
+- ``engine``   — route-engine decision points (cold build, full
+  refresh, frontier resolve/fallback);
+- ``ladder``   — degradation-ladder walks that left the warm rung;
+- ``audit``    — integrity audit verdicts;
+- ``admission``— wave-scheduler admission: admitted count, class mix,
+  preemption delta;
+- ``anomaly``  — a trigger firing.
+
+Triggers: each ``check()`` is a couple of registry reads per retired
+event window (and per serve wave). On fire the ring FREEZES (new notes
+are dropped and counted, so the pre-anomaly evidence survives), a
+bundle is written (``flight.dumps.<trigger>``), and the ring thaws.
+
+THE HAZARD (lint-enforced via ``@flight_callback``): a dump is file
+I/O plus a full counter snapshot — it must NEVER run inside a solve
+window. ``_fire`` defers the dump while ``dispatch_accounting`` has an
+active window and flushes it at the next window retirement, which
+runs strictly after the window pops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.telemetry.registry import get_registry
+
+_DEF_RING = 2048
+_DEF_DIR = "/tmp/openr_tpu_flight"
+
+
+class Trigger:
+    """One anomaly detector. ``check(reg)`` returns a human-readable
+    reason string to fire, or None. Checks run per retired event window
+    — keep them to a few registry reads."""
+
+    name = "trigger"
+
+    def check(self, reg) -> Optional[str]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class CounterDeltaTrigger(Trigger):
+    """Fires when a counter moves by >= min_delta since the last check.
+    The baseline updates on every check, so one burst fires once."""
+
+    def __init__(self, name: str, counter: str, min_delta: int = 1) -> None:
+        self.name = name
+        self.counter = counter
+        self.min_delta = min_delta
+        self._last: Optional[float] = None
+
+    def check(self, reg) -> Optional[str]:
+        cur = float(reg.counter_get(self.counter))
+        last, self._last = self._last, cur
+        if last is None:
+            return None
+        delta = cur - last
+        if delta >= self.min_delta:
+            return f"{self.counter} +{delta:g} (was {last:g})"
+        return None
+
+
+class P99BreachTrigger(Trigger):
+    """Fires when a latency histogram's p99 breaches ``factor`` x its
+    own rolling EWMA baseline (and an absolute floor, so microsecond
+    noise on a quiet histogram can't trip it). Re-baselines on fire so
+    a sustained regression fires once, not every window."""
+
+    def __init__(self, name: str, hist: str, factor: float = 3.0,
+                 min_samples: int = 32, floor_ms: float = 5.0,
+                 alpha: float = 0.1) -> None:
+        self.name = name
+        self.hist = hist
+        self.factor = factor
+        self.min_samples = min_samples
+        self.floor_ms = floor_ms
+        self.alpha = alpha
+        self._baseline: Optional[float] = None
+        self._last_count = -1
+
+    def check(self, reg) -> Optional[str]:
+        h = reg.histogram_if_exists(self.hist)
+        if h is None:
+            return None
+        count = h.count
+        if count < self.min_samples or count == self._last_count:
+            return None
+        self._last_count = count
+        p99 = h.percentile(0.99)
+        if self._baseline is None:
+            self._baseline = p99
+            return None
+        threshold = max(self.floor_ms, self.factor * self._baseline)
+        baseline = self._baseline
+        self._baseline = (1.0 - self.alpha) * self._baseline + \
+            self.alpha * p99
+        if p99 > threshold:
+            self._baseline = p99  # re-baseline: fire once per regression
+            return (f"{self.hist} p99 {p99:.2f}ms > {self.factor:g}x "
+                    f"baseline {baseline:.2f}ms")
+        return None
+
+
+class CompileAfterWarmupTrigger(Trigger):
+    """Any jit or AOT compile after the profiler's warmup marker is a
+    retrace — the exact regression the zero-retrace contract forbids."""
+
+    name = "compile_after_warmup"
+
+    def __init__(self) -> None:
+        self._last: Optional[float] = None
+
+    def check(self, reg) -> Optional[str]:
+        cur = float(reg.counter_get("ops.aot_compiles")) + \
+            float(reg.counter_get("jax.compile_count"))
+        from openr_tpu.telemetry.profiler import get_profiler
+
+        if not get_profiler().warm:
+            self._last = cur
+            return None
+        last, self._last = self._last, cur
+        if last is not None and cur > last:
+            return f"compile after warmup (+{cur - last:g} compiles)"
+        return None
+
+
+class FlightRecorder:
+    """Process-wide activity ring + trigger host + post-mortem dumper."""
+
+    def __init__(
+        self,
+        ring: Optional[int] = None,
+        enabled: Optional[bool] = None,
+        dump_dir: Optional[str] = None,
+        min_dump_interval_s: float = 2.0,
+        max_dumps: int = 16,
+    ) -> None:
+        if ring is None:
+            ring = int(os.environ.get("OPENR_FLIGHT_RING", str(_DEF_RING)))
+        if enabled is None:
+            enabled = os.environ.get("OPENR_FLIGHT", "1") != "0"
+        if dump_dir is None:
+            dump_dir = os.environ.get("OPENR_FLIGHT_DIR", _DEF_DIR)
+        self.enabled = bool(enabled)
+        self.dump_dir = dump_dir
+        self.min_dump_interval_s = min_dump_interval_s
+        self.max_dumps = max_dumps
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(16, ring))
+        self._frozen = False
+        self._seq = 0
+        self._dumps = 0
+        self._last_dump_t = 0.0
+        self._triggers: List[Trigger] = []
+        self._pending: Optional[tuple] = None
+        budget = os.environ.get("OPENR_TOUCH_BUDGET", "")
+        self._touch_budget: Optional[int] = int(budget) if budget else None
+
+    # -- recording ---------------------------------------------------
+    def note(self, kind: str, /, **data: Any) -> None:
+        """Append one activity record. Lock + deque append; drops (and
+        counts) while frozen so pre-anomaly evidence survives.
+        ``kind`` is positional-only: a data key named ``kind`` rides in
+        the record instead of colliding (the record's own kind wins)."""
+        if not self.enabled:
+            return
+        rec = dict(data)
+        rec["ts"] = round(time.time(), 4)
+        rec["kind"] = kind
+        with self._lock:
+            if self._frozen:
+                dropped = True
+            else:
+                dropped = False
+                if len(self._ring) == self._ring.maxlen:
+                    get_registry().counter_bump("flight.ring_overflows")
+                self._ring.append(rec)
+        if dropped:
+            get_registry().counter_bump("flight.dropped_while_frozen")
+
+    def records(self, limit: int = 0) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._ring)
+        return out[-limit:] if limit else out
+
+    def freeze(self) -> None:
+        with self._lock:
+            self._frozen = True
+
+    def unfreeze(self) -> None:
+        with self._lock:
+            self._frozen = False
+
+    # -- budgets -----------------------------------------------------
+    def set_touch_budget(self, budget: Optional[int]) -> None:
+        """Arm (or disarm with None) the per-window host-touch budget.
+        Disarmed by default: cold builds legitimately exceed the warm
+        two-touch contract."""
+        self._touch_budget = budget
+
+    # -- triggers ----------------------------------------------------
+    def add_trigger(self, trigger: Trigger) -> None:
+        with self._lock:
+            self._triggers.append(trigger)
+
+    def trigger_names(self) -> List[str]:
+        with self._lock:
+            return [t.name for t in self._triggers]
+
+    def check_triggers(self) -> None:
+        """Run every registered trigger. Called per retired event
+        window and per serve wave — a few registry reads per trigger."""
+        if not self.enabled:
+            return
+        reg = get_registry()
+        with self._lock:
+            triggers = list(self._triggers)
+        for t in triggers:
+            try:
+                reason = t.check(reg)
+            except Exception:  # noqa: BLE001 - a bad trigger never
+                reg.counter_bump("flight.trigger_errors")  # poisons solve
+                continue
+            if reason:
+                self._fire(t.name, reason)
+
+    def anomaly(self, name: str, /, reason: str = "", **data: Any) -> None:
+        """Direct anomaly entry point for call sites that already know
+        (quarantine conviction, ladder exhaustion) — no polling
+        trigger needed."""
+        if not self.enabled:
+            return
+        self.note("anomaly", trigger=name, reason=reason, **data)
+        self._fire(name, reason)
+
+    def _fire(self, name: str, reason: str) -> None:
+        reg = get_registry()
+        reg.counter_bump(f"flight.triggers.{name}")
+        now = time.monotonic()
+        with self._lock:
+            if self._dumps >= self.max_dumps or \
+                    (now - self._last_dump_t) < self.min_dump_interval_s:
+                reg.counter_bump("flight.dumps_suppressed")
+                return
+            self._last_dump_t = now
+            self._frozen = True
+        # NEVER dump inside a solve window: the bundle write is file
+        # I/O + a full snapshot. Defer; the next window retirement
+        # (which runs after the window pops) flushes it.
+        from openr_tpu.ops import dispatch_accounting as da
+
+        if da.current_window() is not None:
+            with self._lock:
+                self._pending = (name, reason)
+            return
+        self.dump_postmortem(trigger=name, reason=reason)
+
+    def _flush_pending(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is not None:
+            self.dump_postmortem(trigger=pending[0], reason=pending[1])
+
+    # -- window hook -------------------------------------------------
+    def on_window(self, tag: str, wall_ms: float, window: Any) -> None:
+        """One committed event window retired (called by
+        ``dispatch_accounting.event_window`` AFTER the window pops, so
+        everything here — including a deferred dump — runs outside the
+        solve window)."""
+        if not self.enabled:
+            return
+        stages = {
+            t: {"calls": s[0], "host_ms": round(s[1], 4),
+                "device_ms": round(s[2], 4)}
+            for t, s in window.stages.items()
+        }
+        self.note(
+            "window",
+            tag=tag,
+            wall_ms=round(wall_ms, 4),
+            touches=window.touches,
+            dispatches=window.dispatches,
+            blocking_syncs=window.blocking_syncs,
+            async_reaps=window.async_reaps,
+            device_ms=round(window.device_ms, 4),
+            stages=stages,
+        )
+        budget = self._touch_budget
+        if budget is not None and window.touches > budget:
+            self.anomaly(
+                "touch_budget",
+                reason=f"{tag}: {window.touches} touches > budget {budget}",
+                tag=tag,
+                touches=window.touches,
+                budget=budget,
+            )
+        self._flush_pending()
+        self.check_triggers()
+
+    # -- post-mortem bundles -----------------------------------------
+    def dump_postmortem(self, trigger: str = "manual",
+                        reason: str = "") -> Optional[str]:
+        """Write the bundle (JSON + sibling Chrome trace), thaw the
+        ring, return the bundle path (None when disabled or the write
+        fails — a dump failure never propagates into the pipeline)."""
+        if not self.enabled:
+            return None
+        reg = get_registry()
+        from openr_tpu.telemetry.profiler import get_profiler
+        from openr_tpu.telemetry.trace import get_tracer
+
+        prof = get_profiler()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            records = list(self._ring)
+        bundle = {
+            "trigger": trigger,
+            "reason": reason,
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "seq": seq,
+            "records": records,
+            "counters": reg.snapshot(),
+            "attribution": prof.attribution(),
+            "host_overhead_ratio": prof.host_overhead_ratio(),
+        }
+        stamp = int(bundle["ts"] * 1000.0)
+        base = f"postmortem-{trigger}-{stamp}-{os.getpid()}-{seq}"
+        path = os.path.join(self.dump_dir, base + ".json")
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(bundle, f, indent=1)
+            with open(os.path.join(self.dump_dir,
+                                   base + "-trace.json"), "w") as f:
+                json.dump(get_tracer().chrome_trace(), f)
+        except OSError:
+            reg.counter_bump("flight.dump_errors")
+            path = None
+        with self._lock:
+            if path is not None:
+                self._dumps += 1
+            self._frozen = False
+        if path is not None:
+            reg.counter_bump(f"flight.dumps.{trigger}")
+        return path
+
+
+_RECORDER: Optional[FlightRecorder] = None
+_RECORDER_LOCK = threading.Lock()
+_DEFAULTS_INSTALLED = False
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _RECORDER
+    if _RECORDER is None:
+        with _RECORDER_LOCK:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder()
+    return _RECORDER
+
+
+def reset_flight_recorder(**kwargs: Any) -> FlightRecorder:
+    """Tests / smoke gates: replace the singleton (re-reads env unless
+    overridden by kwargs). Default triggers must be re-installed."""
+    global _RECORDER, _DEFAULTS_INSTALLED
+    with _RECORDER_LOCK:
+        _RECORDER = FlightRecorder(**kwargs)
+        _DEFAULTS_INSTALLED = False
+    return _RECORDER
+
+
+def install_default_triggers() -> FlightRecorder:
+    """Idempotent: arm the standing anomaly set — convergence p99
+    breach, compile-after-warmup, reshard delta. Touch budget stays
+    disarmed until a caller sets it; quarantine and ladder exhaustion
+    fire directly from their call sites via ``anomaly()``."""
+    global _DEFAULTS_INSTALLED
+    fr = get_flight_recorder()
+    with _RECORDER_LOCK:
+        if _DEFAULTS_INSTALLED:
+            return fr
+        _DEFAULTS_INSTALLED = True
+    fr.add_trigger(P99BreachTrigger("p99_breach", "convergence.e2e_ms"))
+    fr.add_trigger(CompileAfterWarmupTrigger())
+    fr.add_trigger(CounterDeltaTrigger("reshard", "ops.reshard_events"))
+    return fr

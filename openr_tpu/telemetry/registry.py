@@ -156,6 +156,14 @@ class Registry:
                 h = self._histograms[name] = Histogram(name, window)
             return h
 
+    def histogram_if_exists(self, name: str) -> Optional[Histogram]:
+        """The histogram, or None if nothing has observed it yet —
+        anomaly triggers poll through this so they never materialize
+        empty histograms (the telemetry smoke fails on any registered
+        histogram with count 0)."""
+        with self._lock:
+            return self._histograms.get(name)
+
     def observe(self, name: str, value: float) -> None:
         self.histogram(name).observe(value)
 

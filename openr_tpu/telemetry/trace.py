@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -151,11 +152,19 @@ class Trace:
 
 class Tracer:
     """Process-wide sink for completed traces + per-thread active-trace
-    stack for deep call sites."""
+    stack for deep call sites.
 
-    def __init__(self, ring: int = 256) -> None:
+    The ring depth defaults from ``OPENR_TRACE_RING`` (256): at 200+
+    events/s the default overflows in ~1 s, which is why every retired
+    trace's overflow is counted (``telemetry.trace_ring_overflows``)
+    and a compact summary also lands in the flight recorder's much
+    cheaper ring."""
+
+    def __init__(self, ring: Optional[int] = None) -> None:
+        if ring is None:
+            ring = int(os.environ.get("OPENR_TRACE_RING", "256"))
         self._lock = threading.Lock()
-        self._ring: deque = deque(maxlen=ring)
+        self._ring: deque = deque(maxlen=max(1, ring))
         self._tls = threading.local()
         # finish listeners: the sustained-load harness samples e2e per
         # retired trace through these instead of polling the ring (the
@@ -185,8 +194,25 @@ class Tracer:
         if trace.complete and e2e is not None:
             reg.observe("convergence.e2e_ms", e2e)
         with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                reg.counter_bump("telemetry.trace_ring_overflows")
             self._ring.append(trace)
             listeners = list(self._finish_listeners)
+        # compact summary into the flight recorder's deeper ring — the
+        # evidence that survives this ring's ~1 s overflow horizon.
+        # Lazy import: flight imports this module for chrome export.
+        from openr_tpu.telemetry.flight import get_flight_recorder
+
+        fr = get_flight_recorder()
+        if fr.enabled:
+            fr.note(
+                "trace",
+                origin=trace.origin,
+                trace_id=trace.trace_id,
+                e2e_ms=round(e2e, 4) if e2e is not None else None,
+                complete=trace.complete,
+                spans=[s.name for s in trace.spans],
+            )
         for fn in listeners:
             try:
                 fn(trace, ok)

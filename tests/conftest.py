@@ -37,3 +37,19 @@ def _fresh_integrity_auditor():
     reset_auditor()
     yield
     reset_auditor()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_flight_recorder(tmp_path):
+    """The flight recorder is a process singleton fed from every event
+    window; without a per-test reset one test's anomaly (a Decision
+    pipeline installs the default triggers) would freeze the ring or
+    write a post-mortem bundle into /tmp mid-way through another
+    test's exact-counter assertions. Dumps land under the test's own
+    tmp_path; tests that exercise the recorder re-reset with their own
+    config."""
+    from openr_tpu.telemetry import reset_flight_recorder
+
+    reset_flight_recorder(dump_dir=str(tmp_path / "flight"))
+    yield
+    reset_flight_recorder()

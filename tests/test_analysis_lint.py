@@ -1237,3 +1237,88 @@ def test_sharding_suppressed_with_reason(tmp_path):
         f.rule == "sharding-spec" and f.suppressed
         for f in report.findings
     )
+
+
+# ---------------------------------------------------------------------
+# span-discipline: @flight_callback host-sync ban
+# ---------------------------------------------------------------------
+
+FLIGHT_PREAMBLE = """\
+    import jax
+    import numpy as np
+    from openr_tpu.analysis.annotations import flight_callback
+    from openr_tpu.telemetry import get_flight_recorder
+"""
+
+
+def test_flight_callback_device_get_flagged(tmp_path):
+    report = lint(tmp_path, FLIGHT_PREAMBLE + """
+    @flight_callback
+    def on_anomaly(arr):
+        evidence = jax.device_get(arr)
+        get_flight_recorder().note("anomaly", rows=len(evidence))
+    """)
+    hits = rule_hits(report, "span-discipline")
+    assert len(hits) == 1
+    assert "flight_callback" in hits[0].message
+    assert "never block" in hits[0].message
+
+
+def test_flight_callback_block_until_ready_flagged(tmp_path):
+    report = lint(tmp_path, FLIGHT_PREAMBLE + """
+    @flight_callback
+    def on_anomaly(arr):
+        arr.block_until_ready()
+        get_flight_recorder().note("anomaly", ok=True)
+    """)
+    hits = rule_hits(report, "span-discipline")
+    assert len(hits) == 1
+    assert "block_until_ready" in hits[0].message
+
+
+def test_flight_callback_scalar_coercion_flagged(tmp_path):
+    report = lint(tmp_path, FLIGHT_PREAMBLE + """
+    @flight_callback
+    def on_anomaly(count_dev):
+        get_flight_recorder().note("anomaly", n=int(count_dev))
+    """)
+    hits = rule_hits(report, "span-discipline")
+    assert len(hits) == 1
+    assert "coercion" in hits[0].message
+
+
+def test_flight_callback_host_work_is_clean(tmp_path):
+    report = lint(tmp_path, FLIGHT_PREAMBLE + """
+    @flight_callback
+    def on_anomaly(rows):
+        counts = np.asarray([len(r) for r in rows])
+        get_flight_recorder().note(
+            "anomaly", total=int(counts.sum())
+        )
+        get_flight_recorder().check_triggers()
+    """)
+    assert rule_hits(report, "span-discipline") == []
+
+
+def test_undecorated_callback_not_policed(tmp_path):
+    # the ban rides the decorator: plain helpers keep the normal
+    # (window-scoped) host-sync rules only
+    report = lint(tmp_path, FLIGHT_PREAMBLE + """
+    def not_a_callback(arr):
+        return jax.device_get(arr)
+    """)
+    assert rule_hits(report, "span-discipline") == []
+
+
+def test_flight_callback_decorator_is_runtime_inert(tmp_path):
+    from openr_tpu.analysis.annotations import (
+        FLIGHT_CALLBACK_ATTR,
+        flight_callback,
+    )
+
+    @flight_callback
+    def cb(x):
+        return x + 1
+
+    assert cb(2) == 3
+    assert getattr(cb, FLIGHT_CALLBACK_ATTR)

@@ -1,0 +1,339 @@
+"""Flight recorder unit tests: ring mechanics, anomaly triggers,
+post-mortem bundles, dump deferral, and concurrency.
+
+Every test resets the process singleton with its own dump dir (the
+conftest autouse fixture restores defaults after) because the
+recorder is fed from ``dispatch_accounting.event_window`` retirement —
+the same seam production uses."""
+
+import json
+import os
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from openr_tpu.telemetry import (
+    CompileAfterWarmupTrigger,
+    CounterDeltaTrigger,
+    P99BreachTrigger,
+    get_registry,
+    reset_flight_recorder,
+    reset_profiler,
+)
+
+
+def _recorder(tmp_path, **kw):
+    kw.setdefault("dump_dir", str(tmp_path / "flight"))
+    kw.setdefault("min_dump_interval_s", 0.0)
+    kw.setdefault("max_dumps", 64)
+    return reset_flight_recorder(**kw)
+
+
+def _window(touches=2, device_ms=1.0, stages=None):
+    return SimpleNamespace(
+        touches=touches, dispatches=1, blocking_syncs=0, async_reaps=1,
+        device_ms=device_ms, stages=stages or {},
+    )
+
+
+def _bundles(fr, trigger="*"):
+    d = fr.dump_dir
+    if not os.path.isdir(d):
+        return []
+    return sorted(
+        f for f in os.listdir(d)
+        if f.startswith("postmortem-") and not f.endswith("-trace.json")
+        and (trigger == "*" or f.startswith(f"postmortem-{trigger}-"))
+    )
+
+
+class TestRing:
+    def test_note_and_records_limit(self, tmp_path):
+        fr = _recorder(tmp_path, ring=16)
+        for i in range(5):
+            fr.note("engine", i=i)
+        recs = fr.records()
+        assert [r["i"] for r in recs] == [0, 1, 2, 3, 4]
+        assert all(r["kind"] == "engine" and "ts" in r for r in recs)
+        assert [r["i"] for r in fr.records(limit=2)] == [3, 4]
+
+    def test_overflow_evicts_oldest_and_counts(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path, ring=16)
+        o0 = reg.counter_get("flight.ring_overflows")
+        for i in range(40):
+            fr.note("engine", i=i)
+        recs = fr.records()
+        assert len(recs) == 16
+        assert recs[0]["i"] == 24 and recs[-1]["i"] == 39
+        assert reg.counter_get("flight.ring_overflows") - o0 == 24
+
+    def test_frozen_ring_drops_and_counts(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        fr.note("engine", i=0)
+        fr.freeze()
+        d0 = reg.counter_get("flight.dropped_while_frozen")
+        fr.note("engine", i=1)
+        assert len(fr.records()) == 1
+        assert reg.counter_get("flight.dropped_while_frozen") - d0 == 1
+        fr.unfreeze()
+        fr.note("engine", i=2)
+        assert [r["i"] for r in fr.records()] == [0, 2]
+
+    def test_disabled_recorder_is_inert(self, tmp_path):
+        fr = _recorder(tmp_path, enabled=False)
+        fr.note("engine", i=0)
+        fr.anomaly("quarantine", reason="x")
+        assert fr.records() == []
+        assert _bundles(fr) == []
+
+    def test_data_key_named_kind_cannot_shadow_record_kind(self, tmp_path):
+        fr = _recorder(tmp_path)
+        fr.note("audit", kind="ell", verdict="clean")
+        (rec,) = fr.records()
+        assert rec["kind"] == "audit"
+
+
+class TestTriggers:
+    def test_counter_delta_baselines_then_fires_once(self, tmp_path):
+        reg = get_registry()
+        t = CounterDeltaTrigger("reshard", "t.reshard_x")
+        assert t.check(reg) is None  # first check only baselines
+        reg.counter_bump("t.reshard_x")
+        assert "t.reshard_x" in t.check(reg)
+        assert t.check(reg) is None  # one burst fires once
+
+    def test_p99_breach_baseline_spike_rebaseline(self, tmp_path):
+        reg = get_registry()
+        t = P99BreachTrigger(
+            "p99", "t.lat_x", factor=3.0, min_samples=8, floor_ms=0.1
+        )
+        for _ in range(8):
+            reg.observe("t.lat_x", 1.0)
+        assert t.check(reg) is None  # baseline set
+        for _ in range(4):
+            reg.observe("t.lat_x", 500.0)
+        reason = t.check(reg)
+        assert reason is not None and "t.lat_x" in reason
+        reg.observe("t.lat_x", 500.0)
+        # re-baselined on fire: the sustained regression fires once
+        assert t.check(reg) is None
+
+    def test_p99_breach_never_materializes_histogram(self, tmp_path):
+        reg = get_registry()
+        t = P99BreachTrigger("p99", "t.never_observed")
+        assert t.check(reg) is None
+        assert reg.histogram_if_exists("t.never_observed") is None
+
+    def test_p99_breach_respects_min_samples(self, tmp_path):
+        reg = get_registry()
+        t = P99BreachTrigger("p99", "t.thin_x", min_samples=32)
+        for _ in range(8):
+            reg.observe("t.thin_x", 1.0)
+        assert t.check(reg) is None
+        reg.observe("t.thin_x", 9999.0)
+        assert t.check(reg) is None  # still under min_samples
+
+    def test_compile_after_warmup_gated_on_warm_marker(self, tmp_path):
+        reg = get_registry()
+        prof = reset_profiler()
+        try:
+            t = CompileAfterWarmupTrigger()
+            reg.counter_bump("ops.aot_compiles")
+            assert t.check(reg) is None  # cold: compiles are expected
+            reg.counter_bump("ops.aot_compiles")
+            assert t.check(reg) is None
+            prof.mark_warm()
+            assert t.check(reg) is None  # no delta since last check
+            reg.counter_bump("ops.aot_compiles")
+            assert "compile after warmup" in t.check(reg)
+        finally:
+            reset_profiler()
+
+    def test_broken_trigger_counted_never_raises(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+
+        class Boom(CounterDeltaTrigger):
+            def check(self, reg):
+                raise RuntimeError("bad trigger")
+
+        fr.add_trigger(Boom("boom", "t.none"))
+        e0 = reg.counter_get("flight.trigger_errors")
+        fr.check_triggers()
+        assert reg.counter_get("flight.trigger_errors") - e0 == 1
+
+
+class TestAnomaliesAndDumps:
+    def test_anomaly_fires_counts_and_dumps_bundle(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        fr.note("engine", path="cold_build")
+        t0 = reg.counter_get("flight.triggers.quarantine")
+        d0 = reg.counter_get("flight.dumps.quarantine")
+        fr.anomaly("quarantine", reason="tier2 violation", tier="tier2")
+        assert reg.counter_get("flight.triggers.quarantine") - t0 == 1
+        assert reg.counter_get("flight.dumps.quarantine") - d0 == 1
+        (name,) = _bundles(fr, "quarantine")
+        with open(os.path.join(fr.dump_dir, name)) as fh:
+            bundle = json.load(fh)
+        for key in ("trigger", "reason", "ts", "pid", "seq", "records",
+                    "counters", "attribution", "host_overhead_ratio"):
+            assert key in bundle
+        assert bundle["trigger"] == "quarantine"
+        assert bundle["reason"] == "tier2 violation"
+        kinds = [r["kind"] for r in bundle["records"]]
+        assert "engine" in kinds and "anomaly" in kinds
+        # sibling Chrome trace rides along
+        trace = os.path.join(fr.dump_dir, name[:-5] + "-trace.json")
+        with open(trace) as fh:
+            json.load(fh)
+        # ring thawed after the dump
+        fr.note("engine", path="after")
+        assert fr.records()[-1]["path"] == "after"
+
+    def test_touch_budget_disarmed_by_default(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        t0 = reg.counter_get("flight.triggers.touch_budget")
+        fr.on_window("w", 1.0, _window(touches=50))
+        assert reg.counter_get("flight.triggers.touch_budget") - t0 == 0
+
+    def test_touch_budget_armed_fires_on_breach(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        fr.set_touch_budget(2)
+        t0 = reg.counter_get("flight.triggers.touch_budget")
+        fr.on_window("w", 1.0, _window(touches=2))
+        assert reg.counter_get("flight.triggers.touch_budget") - t0 == 0
+        fr.on_window("w", 1.0, _window(touches=3))
+        assert reg.counter_get("flight.triggers.touch_budget") - t0 == 1
+        assert _bundles(fr, "touch_budget")
+
+    def test_on_window_records_stage_attribution(self, tmp_path):
+        fr = _recorder(tmp_path)
+        fr.on_window(
+            "churn", 5.0,
+            _window(device_ms=3.0, stages={"solve": [4, 1.25, 3.0]}),
+        )
+        rec = fr.records()[-1]
+        assert rec["kind"] == "window" and rec["tag"] == "churn"
+        assert rec["stages"]["solve"] == {
+            "calls": 4, "host_ms": 1.25, "device_ms": 3.0,
+        }
+
+    def test_dump_rate_limited_and_capped(self, tmp_path):
+        reg = get_registry()
+        fr = _recorder(tmp_path, min_dump_interval_s=3600.0)
+        s0 = reg.counter_get("flight.dumps_suppressed")
+        fr.anomaly("reshard", reason="one")
+        fr.anomaly("reshard", reason="two")  # inside the interval
+        assert len(_bundles(fr, "reshard")) == 1
+        assert reg.counter_get("flight.dumps_suppressed") - s0 == 1
+        # a suppressed dump must not leave the ring frozen
+        fr.note("engine", path="alive")
+        assert fr.records()[-1]["path"] == "alive"
+
+    def test_dump_deferred_inside_solve_window(self, tmp_path):
+        from openr_tpu.ops import dispatch_accounting as da
+
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        d0 = reg.counter_get("flight.dumps.ladder_exhausted")
+        with da.event_window("deferral"):
+            fr.anomaly("ladder_exhausted", reason="all rungs failed")
+            # fired, but the bundle write must wait for retirement
+            assert reg.counter_get(
+                "flight.dumps.ladder_exhausted"
+            ) - d0 == 0
+            assert _bundles(fr, "ladder_exhausted") == []
+        # window retired: on_window flushed the pending dump
+        assert reg.counter_get("flight.dumps.ladder_exhausted") - d0 == 1
+        assert len(_bundles(fr, "ladder_exhausted")) == 1
+
+    def test_dump_write_failure_counted_not_raised(self, tmp_path):
+        reg = get_registry()
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("file where the dump dir should go")
+        fr = reset_flight_recorder(
+            dump_dir=str(blocker), min_dump_interval_s=0.0
+        )
+        e0 = reg.counter_get("flight.dump_errors")
+        assert fr.dump_postmortem(trigger="manual") is None
+        assert reg.counter_get("flight.dump_errors") - e0 == 1
+        fr.note("engine", path="alive")  # thawed despite the failure
+        assert fr.records()[-1]["path"] == "alive"
+
+
+class TestDefaultTriggers:
+    def test_install_is_idempotent(self, tmp_path):
+        from openr_tpu.telemetry import install_default_triggers
+
+        _recorder(tmp_path)
+        fr = install_default_triggers()
+        once = list(fr.trigger_names())
+        assert {"p99_breach", "compile_after_warmup", "reshard"} <= set(once)
+        install_default_triggers()
+        assert fr.trigger_names() == once
+
+
+class TestConcurrency:
+    def test_concurrent_notes_readers_freeze(self, tmp_path):
+        fr = _recorder(tmp_path, ring=64)
+        stop = threading.Event()
+        errors = []
+
+        def writer(k):
+            i = 0
+            while not stop.is_set():
+                fr.note("engine", w=k, i=i)
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                for rec in fr.records(limit=16):
+                    if "kind" not in rec or "ts" not in rec:
+                        errors.append(rec)
+
+        def freezer():
+            while not stop.is_set():
+                fr.freeze()
+                fr.unfreeze()
+
+        threads = [
+            threading.Thread(target=writer, args=(k,)) for k in range(4)
+        ] + [threading.Thread(target=reader) for _ in range(2)] + [
+            threading.Thread(target=freezer)
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        fr.unfreeze()
+        assert len(fr.records()) <= 64
+
+    def test_concurrent_trigger_checks_fire_exactly_once_per_delta(
+        self, tmp_path
+    ):
+        reg = get_registry()
+        fr = _recorder(tmp_path)
+        fr.add_trigger(CounterDeltaTrigger("reshard", "t.conc_reshard"))
+        fr.check_triggers()  # baseline
+        t0 = reg.counter_get("flight.triggers.reshard")
+        reg.counter_bump("t.conc_reshard")
+        threads = [
+            threading.Thread(target=fr.check_triggers) for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # no lost fire, and every fire paired with a counted trigger
+        assert reg.counter_get("flight.triggers.reshard") - t0 >= 1

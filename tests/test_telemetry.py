@@ -324,6 +324,56 @@ class TestExportSurfaceParity:
         doc = json.loads(capsys.readouterr().out)
         assert doc["traceEvents"]
 
+    def test_breeze_monitor_flight_renders_ring_and_attribution(
+        self, capsys, tmp_path
+    ):
+        from openr_tpu.cli.breeze import Breeze, _InProcessClient
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.telemetry import (
+            get_flight_recorder,
+            reset_flight_recorder,
+            reset_profiler,
+        )
+
+        reset_flight_recorder(
+            dump_dir=str(tmp_path / "flight"), min_dump_interval_s=0.0
+        )
+        prof = reset_profiler(sample_every=1)
+        try:
+            prof.on_dispatch("t_breeze_stage", None, 1.5)
+            get_flight_recorder().note("engine", path="cold_build")
+            handler = OpenrCtrlHandler("n1")
+            breeze = Breeze(_InProcessClient(handler))
+            breeze.monitor_flight(limit=5)
+            out = capsys.readouterr().out
+            assert "cold_build" in out
+            assert "t_breeze_stage" in out
+            breeze.monitor_flight(limit=5, fmt="json")
+            doc = json.loads(capsys.readouterr().out)
+            assert doc["records"] and "t_breeze_stage" in doc["attribution"]
+            breeze.monitor_flight(dump=True)
+            out = capsys.readouterr().out
+            assert "postmortem-manual-" in out
+        finally:
+            reset_profiler()
+
+    def test_solver_handler_flight_surface_matches_ctrl(self, tmp_path):
+        # the solver process serves the same flight surface so breeze
+        # monitor flight works against it too; neither method touches
+        # self, so exercise them without a full SolverService
+        from openr_tpu.ctrl.handler import OpenrCtrlHandler
+        from openr_tpu.ctrl.solver import SolverCtrlHandler
+        from openr_tpu.telemetry import reset_flight_recorder
+
+        reset_flight_recorder(
+            dump_dir=str(tmp_path / "flight"), min_dump_interval_s=0.0
+        )
+        a = OpenrCtrlHandler("n1").get_flight_record()
+        b = SolverCtrlHandler.get_flight_record(None)
+        assert set(a) == set(b) == {
+            "records", "triggers", "attribution", "host_overhead_ratio",
+        }
+
 
 class TestJaxHooks:
     def test_install_idempotent(self):
@@ -345,3 +395,167 @@ class TestJaxHooks:
 
         f(jnp.arange(7)).block_until_ready()
         assert get_registry().counter_get("jax.compile_count") > before
+
+
+class TestConcurrentPercentiles:
+    """The serve plane reads ``Registry.percentile`` between waves and
+    the flight triggers read ``histogram_if_exists(...).percentile``
+    per retired window — both race live ``observe`` streams from
+    dispatch threads. The sliding-window ring must stay readable (no
+    exceptions, values inside the observed range) under that churn."""
+
+    def test_histogram_observe_vs_percentile_race(self):
+        h = Histogram("race_ms", window=128)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            v = 0
+            while not stop.is_set():
+                h.observe(float(v % 1000))
+                v += 1
+
+        def reader():
+            while not stop.is_set():
+                for q in (0.5, 0.95, 0.99):
+                    p = h.percentile(q)
+                    if not (0.0 <= p <= 999.0):
+                        errors.append((q, p))
+                s = h.stats()
+                if s["race_ms.count"] and not (
+                    0.0 <= s["race_ms.p50"] <= 999.0
+                ):
+                    errors.append(("stats", s["race_ms.p50"]))
+
+        threads = [threading.Thread(target=writer) for _ in range(4)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert h.count >= 128
+
+    def test_registry_percentile_vs_observe_and_snapshot_race(self):
+        r = Registry()
+        stop = threading.Event()
+        errors = []
+
+        def writer(k):
+            v = 0
+            while not stop.is_set():
+                r.observe(f"lat.{k}", float(v % 100))
+                v += 1
+
+        def reader():
+            while not stop.is_set():
+                p = r.percentile("lat.0", 0.99)
+                if not (0.0 <= p <= 99.0):
+                    errors.append(p)
+                r.snapshot()
+                if r.histogram_if_exists("lat.never") is not None:
+                    errors.append("materialized lat.never")
+
+        threads = [
+            threading.Thread(target=writer, args=(k,)) for k in range(3)
+        ] + [threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        # readers never created histograms the writers did not observe
+        assert set(r.histograms()) == {"lat.0", "lat.1", "lat.2"}
+
+    def test_histogram_if_exists_returns_live_histogram(self):
+        r = Registry()
+        assert r.histogram_if_exists("x.ms") is None
+        r.observe("x.ms", 3.0)
+        h = r.histogram_if_exists("x.ms")
+        assert h is not None and h.percentile(0.5) == 3.0
+
+
+class TestProfiler:
+    """Device-time attribution plane (telemetry/profiler.py)."""
+
+    def _fresh(self, **kw):
+        from openr_tpu.telemetry import reset_profiler
+
+        return reset_profiler(**kw)
+
+    def teardown_method(self):
+        from openr_tpu.telemetry import reset_profiler
+
+        reset_profiler()
+
+    def test_sampling_cadence_and_histograms(self):
+        reg = get_registry()
+        prof = self._fresh(sample_every=4)
+        h0 = reg.histogram_if_exists("ops.host_ms.t_stage")
+        host0 = h0.count if h0 else 0
+        d0 = reg.histogram_if_exists("ops.device_ms.t_stage")
+        dev0 = d0.count if d0 else 0
+        for _ in range(8):
+            prof.on_dispatch("t_stage", None, 0.5)
+        h = reg.histogram_if_exists("ops.host_ms.t_stage")
+        d = reg.histogram_if_exists("ops.device_ms.t_stage")
+        assert h.count - host0 == 8  # every call carries host time
+        assert d.count - dev0 == 2  # calls 1 and 5 sampled
+
+    def test_labels_land_sampled_device_time_per_dimension(self):
+        reg = get_registry()
+        prof = self._fresh(sample_every=1)
+        with prof.labels(bucket="8x128x4", slo="Premium!"):
+            prof.on_dispatch("t_lbl", None, 1.0)
+        assert reg.histogram_if_exists(
+            "ops.device_ms.by_bucket.8x128x4"
+        ) is not None
+        # label values sanitized to fb303-safe strings
+        assert reg.histogram_if_exists(
+            "ops.device_ms.by_slo.premium"
+        ) is not None
+
+    def test_attribution_excludes_label_histograms(self):
+        prof = self._fresh(sample_every=1)
+        with prof.labels(bucket="b1"):
+            prof.on_dispatch("t_attr", None, 2.0)
+        attr = prof.attribution()
+        assert "t_attr" in attr
+        row = attr["t_attr"]
+        assert row["calls"] >= 1 and row["device_samples"] >= 1
+        assert not any(tag.startswith("by_") for tag in attr)
+
+    def test_host_overhead_ratio_from_window_pairs(self):
+        prof = self._fresh()
+        prof.on_window("w", 10.0, 5.0)
+        prof.on_window("w", 30.0, 15.0)
+        assert prof.host_overhead_ratio() == 2.0
+
+    def test_disabled_profiler_observes_nothing(self):
+        reg = get_registry()
+        prof = self._fresh(enabled=False)
+        prof.on_dispatch("t_off", None, 1.0)
+        prof.on_window("t_off", 10.0, 5.0)
+        assert reg.histogram_if_exists("ops.host_ms.t_off") is None
+        assert prof.host_overhead_ratio() == 0.0
+
+    def test_profiled_aot_call_feeds_window_stage_table(self):
+        import jax
+        import jax.numpy as jnp
+
+        from openr_tpu.ops import dispatch_accounting as da
+        from openr_tpu.ops.aot_cache import aot_call
+
+        self._fresh(sample_every=1)
+        fn = jax.jit(lambda x: x + 1)
+        with da.event_window("t_prof_win") as win:
+            aot_call("t_prof_stage", fn, (jnp.arange(4),), {})
+        assert "t_prof_stage" in win.stages
+        calls, host_ms, device_ms = win.stages["t_prof_stage"]
+        assert calls == 1 and host_ms > 0.0 and device_ms > 0.0
+        assert win.device_ms >= device_ms

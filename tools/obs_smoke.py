@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Observability-plane gate (``make obs-smoke``) and report artifact.
+
+Exercises the always-on profiling plane and the flight recorder
+(``openr_tpu/telemetry/profiler.py`` + ``flight.py``) end to end on
+the real churn pipeline and fails loudly if the contract regressed:
+
+- OVERHEAD BUDGET: a ~1k-event warm churn leg timed with the plane
+  ARMED (profiler sampling + flight ring + window records) vs DISARMED
+  must cost < 5% extra wall clock (best-of-3 paired rounds, so one
+  scheduler hiccup can't fail the gate),
+- TRIGGER COVERAGE: every anomaly trigger class — touch_budget,
+  p99_breach, reshard, quarantine, ladder_exhausted,
+  compile_after_warmup — is forced once through its real entry point
+  (a churn window over budget, a latency spike, a reshard delta, a
+  corrupted resident + forced audit, an all-failing degradation
+  ladder, a post-warmup cold build) and each must fire
+  (``flight.triggers.<name>``) and dump (``flight.dumps.<name>``) a
+  WELL-FORMED bundle: JSON loads, ring records non-empty, device-time
+  attribution non-empty, sibling Chrome trace present,
+- ATTRIBUTION CONSISTENCY: the per-tag attributed call counts must be
+  positive and no larger than ``ops.host_dispatches`` (every profiled
+  call IS a counted dispatch), with real sampled device time
+  (``ops.profile_samples`` > 0) and a live window wall/device ratio.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_obs_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates the observability plane,
+not kernels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the gate measures the plane itself: pin it on regardless of ambient
+# env so a developer's OPENR_PROFILE=0 can't vacuously pass the gate
+os.environ["OPENR_PROFILE"] = "1"
+os.environ["OPENR_FLIGHT"] = "1"
+os.environ.pop("OPENR_TOUCH_BUDGET", None)
+
+# allow direct invocation (python tools/obs_smoke.py) in addition
+# to module mode (python -m tools.obs_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEQ = (7, 3, 11, 5)
+
+_BUNDLE_KEYS = (
+    "trigger", "reason", "ts", "records", "counters",
+    "attribution", "host_overhead_ratio",
+)
+
+
+def _load(topo):
+    from openr_tpu.graph.linkstate import LinkState
+
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _mutate_metric(ls, node, i, metric):
+    from dataclasses import replace
+
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+def _churn_round(engine, ls, node, n_events, tag) -> float:
+    """One timed warm churn leg: n_events metric flips, each inside a
+    committed event window; returns wall seconds."""
+    from openr_tpu.ops import dispatch_accounting as da
+
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        with da.event_window(tag):
+            engine.churn(
+                ls, _mutate_metric(ls, node, 0, SEQ[i % len(SEQ)]),
+                defer_consume=True,
+            )
+    engine.flush()
+    return time.perf_counter() - t0
+
+
+def _assert_bundle(trigger, dump_dir, failures) -> None:
+    """A trigger's newest bundle must be a loadable post-mortem with
+    evidence in it: ring records, device-time attribution, and the
+    sibling Chrome trace."""
+    paths = [
+        p for p in sorted(glob.glob(
+            os.path.join(dump_dir, f"postmortem-{trigger}-*.json")
+        ))
+        if not p.endswith("-trace.json")
+    ]
+    if not paths:
+        failures.append(f"{trigger}: dump counted but no bundle on disk")
+        return
+    path = paths[-1]
+    try:
+        with open(path) as fh:
+            bundle = json.load(fh)
+    except (OSError, ValueError) as exc:
+        failures.append(f"{trigger}: bundle unreadable ({exc})")
+        return
+    for key in _BUNDLE_KEYS:
+        if key not in bundle:
+            failures.append(f"{trigger}: bundle missing {key!r}")
+    if not bundle.get("records"):
+        failures.append(f"{trigger}: bundle flight ring is empty")
+    attr = bundle.get("attribution") or {}
+    if not attr:
+        failures.append(f"{trigger}: bundle attribution is empty")
+    elif not any(
+        row.get("device_samples") for row in attr.values()
+    ):
+        failures.append(
+            f"{trigger}: bundle attribution has no sampled device time"
+        )
+    trace_path = path[:-len(".json")] + "-trace.json"
+    try:
+        with open(trace_path) as fh:
+            json.load(fh)
+    except (OSError, ValueError):
+        failures.append(f"{trigger}: sibling Chrome trace missing/bad")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--out", default="/tmp/openr_tpu_obs_smoke.json",
+        help="JSON artifact path",
+    )
+    ap.add_argument(
+        "--events", type=int,
+        default=int(os.environ.get("OPENR_OBS_EVENTS", "168")),
+        help="churn events per timed round (3 paired rounds x 2 "
+             "configs -> ~1k events at the default)",
+    )
+    args = ap.parse_args()
+
+    from openr_tpu.faults import DegradationSupervisor, LadderExhausted
+    from openr_tpu.integrity import get_auditor
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import dispatch_accounting as da
+    from openr_tpu.ops import route_engine
+    from openr_tpu.telemetry import (
+        get_flight_recorder,
+        get_profiler,
+        get_registry,
+        install_default_triggers,
+        reset_flight_recorder,
+        reset_profiler,
+    )
+
+    failures: list = []
+    report: dict = {"gates": {}}
+    reg = get_registry()
+    dump_dir = tempfile.mkdtemp(prefix="openr_tpu_obs_flight_")
+    report["dump_dir"] = dump_dir
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = _load(topo)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+
+    # -- warmup: compile the chain + exercise the armed plane once so
+    # lazy init (annotation class import, tag state) is out of the
+    # timed rounds
+    reset_profiler(sample_every=4)
+    reset_flight_recorder(
+        dump_dir=dump_dir, min_dump_interval_s=0.0, max_dumps=64
+    )
+    for metric in SEQ + SEQ:
+        with da.event_window("obs_warmup"):
+            engine.churn(
+                ls, _mutate_metric(ls, rsw, 0, metric), defer_consume=True
+            )
+    engine.flush()
+
+    # -- gate: armed-vs-disarmed overhead on the warm churn leg -------
+    # paired rounds back to back so drift hits both configs; best-of-3
+    # ratio gates (one scheduler hiccup in an armed round must not fail
+    # a plane that is actually cheap)
+    pairs = 3
+    armed_ms, disarmed_ms, ratios = [], [], []
+    for _ in range(pairs):
+        reset_profiler(enabled=False)
+        reset_flight_recorder(enabled=False, dump_dir=dump_dir)
+        off = _churn_round(engine, ls, rsw, args.events, "obs_churn")
+        # production config: default sampling cadence, live ring, no
+        # triggers armed (trigger cost is covered by the trigger legs)
+        reset_profiler()
+        reset_flight_recorder(
+            dump_dir=dump_dir, min_dump_interval_s=0.0, max_dumps=64
+        )
+        on = _churn_round(engine, ls, rsw, args.events, "obs_churn")
+        disarmed_ms.append(round(off * 1000.0, 2))
+        armed_ms.append(round(on * 1000.0, 2))
+        ratios.append(round(on / max(off, 1e-9), 4))
+    overhead = min(ratios)
+    report["overhead"] = {
+        "events_per_round": args.events,
+        "events_total": pairs * 2 * args.events,
+        "disarmed_ms": disarmed_ms,
+        "armed_ms": armed_ms,
+        "ratios": ratios,
+        "best_ratio": overhead,
+        "budget": 1.05,
+    }
+    if overhead >= 1.05:
+        failures.append(
+            f"armed profiling overhead {overhead:.3f}x disarmed "
+            f"(ratios {ratios}); budget is <1.05x"
+        )
+    report["gates"]["overhead_budget"] = overhead < 1.05
+
+    # -- trigger coverage: arm the standing set + force each class ----
+    reset_profiler(sample_every=2)
+    fr = reset_flight_recorder(
+        dump_dir=dump_dir, min_dump_interval_s=0.0, max_dumps=64
+    )
+    fr = install_default_triggers()
+    prof = get_profiler()
+
+    def force(name, fn):
+        fired0 = reg.counter_get(f"flight.triggers.{name}")
+        dumps0 = reg.counter_get(f"flight.dumps.{name}")
+        fn()
+        # a no-op window retirement flushes any dump the trigger
+        # deferred because it fired inside a solve window
+        with da.event_window("obs_flush"):
+            pass
+        fired = reg.counter_get(f"flight.triggers.{name}") - fired0
+        dumped = reg.counter_get(f"flight.dumps.{name}") - dumps0
+        if fired < 1:
+            failures.append(f"{name}: trigger did not fire")
+        if dumped < 1:
+            failures.append(f"{name}: no post-mortem bundle counted")
+        else:
+            _assert_bundle(name, dump_dir, failures)
+        report["gates"][f"trigger_{name}"] = fired >= 1 and dumped >= 1
+
+    # touch_budget: budget 0 means ANY host touch in a window is over
+    def force_touch_budget():
+        fr.set_touch_budget(0)
+        try:
+            with da.event_window("obs_budget"):
+                engine.churn(
+                    ls, _mutate_metric(ls, rsw, 0, 13), defer_consume=True
+                )
+            engine.flush()
+        finally:
+            fr.set_touch_budget(None)
+
+    force("touch_budget", force_touch_budget)
+
+    # p99_breach: baseline the default convergence trigger, then land
+    # a latency spike far above any real sample this process produced
+    def force_p99():
+        for _ in range(48):
+            reg.observe("convergence.e2e_ms", 1.0)
+        fr.check_triggers()  # >= min_samples: baseline set
+        for _ in range(8):
+            reg.observe("convergence.e2e_ms", 60000.0)
+        fr.check_triggers()  # p99 >> factor x baseline: fires
+
+    force("p99_breach", force_p99)
+
+    # reshard: the counter-delta trigger baselined during the legs
+    # above; one reshard event is one anomaly
+    def force_reshard():
+        fr.check_triggers()
+        reg.counter_bump("ops.reshard_events")
+        fr.check_triggers()
+
+    force("reshard", force_reshard)
+
+    # quarantine: flip resident bits on the live engine; the forced
+    # audit convicts, quarantines, heals — and fires the anomaly
+    def force_quarantine():
+        engine.corrupt_resident(seed=7)
+        get_auditor().audit_now()
+
+    force("quarantine", force_quarantine)
+
+    # ladder_exhausted: every rung fails in one walk
+    def force_ladder():
+        sup = DegradationSupervisor(
+            "obs_ladder", backoff_min_s=0.001, backoff_max_s=0.002
+        )
+
+        def boom():
+            raise RuntimeError("forced for obs smoke")
+
+        try:
+            sup.run([("warm", boom), ("cold", boom)])
+        except LadderExhausted:
+            pass
+        else:
+            failures.append("ladder_exhausted: exhaustion did not raise")
+
+    force("ladder_exhausted", force_ladder)
+
+    # compile_after_warmup: declare warmup done, then cold-build an
+    # engine for a topology this process never compiled — the AOT
+    # compile after the marker is the anomaly (LAST: the legs above
+    # must run un-warm so their own cold paths can't fire this)
+    def force_compile():
+        prof.mark_warm()
+        topo2 = topologies.fat_tree(
+            pods=5, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls2 = _load(topo2)
+        names2 = sorted(ls2.get_adjacency_databases().keys())
+        route_engine.RouteSweepEngine(ls2, [names2[0]])
+        fr.check_triggers()
+
+    force("compile_after_warmup", force_compile)
+
+    # -- gate: attribution consistent with dispatch accounting --------
+    attribution = prof.attribution()
+    report["attribution"] = attribution
+    calls = sum(
+        int(row.get("calls", 0)) for row in attribution.values()
+    )
+    samples = sum(
+        int(row.get("device_samples", 0)) for row in attribution.values()
+    )
+    dispatches = reg.counter_get("ops.host_dispatches")
+    ratio = prof.host_overhead_ratio()
+    report["attributed_calls"] = calls
+    report["device_samples"] = samples
+    report["host_dispatches"] = dispatches
+    report["host_overhead_ratio"] = ratio
+    if calls <= 0:
+        failures.append("no dispatches carried host-time attribution")
+    if samples <= 0:
+        failures.append("no dispatch was sampled for device time")
+    if calls > dispatches:
+        failures.append(
+            f"attributed {calls} calls but only {dispatches} host "
+            "dispatches counted — attribution is double-counting"
+        )
+    if not reg.counter_get("ops.profile_samples"):
+        failures.append("ops.profile_samples never counted")
+    if not ratio or ratio <= 0.0:
+        failures.append(
+            "ops.host_overhead_ratio gauge is dead (no window pairs)"
+        )
+    report["gates"]["attribution_consistency"] = (
+        0 < calls <= dispatches and samples > 0 and bool(ratio)
+    )
+
+    report["counters"] = {
+        k: reg.counter_get(k)
+        for k in (
+            "ops.host_dispatches", "ops.profile_samples",
+            "flight.ring_overflows", "flight.dropped_while_frozen",
+            "flight.trigger_errors", "flight.dump_errors",
+            "flight.dumps_suppressed",
+        )
+    }
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("OBS SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"obs smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -250,6 +250,11 @@ def main(argv=None) -> int:
     srv.start()
     try:
         _warmup(svc)
+        # warmup done: from here any compile is an anomaly the flight
+        # recorder's compile-after-warmup trigger would convict
+        from openr_tpu.telemetry import get_profiler
+
+        get_profiler().mark_warm()
         compiles0 = (
             reg.counter_get("jax.compile_count") if hooks_live else 0
         )
@@ -341,6 +346,22 @@ def main(argv=None) -> int:
                 )
         report["gates"]["slo_p99"] = all(
             v <= args.slo_ms for v in p99.values()
+        )
+
+        # -- per-stage attribution: every class p99 above must be
+        # explainable by a measured stage cost, not a bench-side model
+        attribution = svc.stage_attribution()
+        report["stage_attribution"] = attribution
+        report["host_overhead_ratio_measured"] = attribution[
+            "host_overhead_ratio"
+        ]
+        if not attribution["stages"]:
+            failures.append(
+                "stage attribution is empty — the serve p99s are not "
+                "attributable to any measured dispatch stage"
+            )
+        report["gates"]["stage_attribution"] = bool(
+            attribution["stages"]
         )
     finally:
         srv.stop()
