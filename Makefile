@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke multichip-smoke serve-smoke obs-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke serve-smoke obs-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke serve-smoke obs-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -124,6 +124,16 @@ twin-smoke: native
 # when it fails.
 dispatch-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.dispatch_smoke --out /tmp/openr_tpu_dispatch_smoke.json
+
+# pipelined event-window gate (PR 16): a warm multi-event burst must
+# cost at most 2 host touches per pipeline DRAIN (not per window) with
+# ops.pipelined_dispatches witnessing depth >= 2, speculation must
+# adopt on match and cancel (counted) on mismatch with both paths
+# bit-identical to the sequential oracle, and warm bursts at depths
+# 1..3 must cost zero AOT/jit compiles. See docs/RUNBOOK.md
+# "Speculation-miss storm" when the cancel counters climb.
+pipeline-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.pipeline_smoke --out /tmp/openr_tpu_pipeline_smoke.json
 
 # sharded-dispatch gate on the virtual 8-device CPU mesh (conftest
 # pins the device count): pipelined==eager bit-identity across a

@@ -1,10 +1,11 @@
-"""The eight invariant checkers. Each module exports its Rule classes;
+"""The nine invariant checkers. Each module exports its Rule classes;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
 from openr_tpu.analysis.rules.donation import DonationHazardRule
 from openr_tpu.analysis.rules.hostsync import (
     CommittedDispatchRule,
+    HostBranchInChainRule,
     HostSyncInWindowRule,
 )
 from openr_tpu.analysis.rules.lockorder import LockOrderRule
@@ -17,6 +18,7 @@ ALL_RULES = (
     DonationHazardRule,
     HostSyncInWindowRule,
     CommittedDispatchRule,
+    HostBranchInChainRule,
     LockOrderRule,
     SpanDisciplineRule,
     RetraceRiskRule,
@@ -28,6 +30,7 @@ __all__ = [
     "ALL_RULES",
     "CommittedDispatchRule",
     "DonationHazardRule",
+    "HostBranchInChainRule",
     "HostSyncInWindowRule",
     "LockOrderRule",
     "MirrorCoverageRule",

@@ -209,3 +209,118 @@ class CommittedDispatchRule(HostSyncInWindowRule):
                 ):
                     return None
         return super()._classify(node)
+
+
+def _assigned_names(target: ast.expr) -> Iterable[str]:
+    """Plain names bound by an assignment target. Attribute and
+    subscript stores are skipped — ``self.meta = reap_read(...)``
+    binds the attribute, and tainting the whole object would flag
+    every later ``if self...``."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _assigned_names(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _assigned_names(target.value)
+
+
+def _mentions_any(expr: ast.expr, names: set) -> Optional[str]:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return sub.id
+    return None
+
+
+class HostBranchInChainRule(Rule):
+    """``host-branch-in-chain``: control flow inside the committed
+    chain must not fork on a meta readback. PR 16 moved the
+    frontier-vs-full-width and overflow decisions into on-device
+    seed-select branches precisely so a pipelined burst never breaks
+    the fused chain on a 16-byte readback; an ``if``/``while`` whose
+    test derives from a ``reap_read`` value reintroduces the stall —
+    the host must materialize the meta row before it can even decide
+    what to submit next. Sites that are deliberately host-side (the
+    widened-layout split path, the bucket-ladder overflow check)
+    carry audited suppressions."""
+
+    id = "host-branch-in-chain"
+    description = (
+        "no if/while on meta-readback values inside "
+        "@committed_dispatch/@solve_window bodies (move the decision "
+        "on device or suppress with a reason)"
+    )
+
+    def check(self, sf: SourceFile, ctx: AnalysisContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn, _cls in sf.functions():
+            if not (
+                _has_decorator(fn, "committed_dispatch")
+                or _has_decorator(fn, "solve_window")
+            ):
+                continue
+            tainted = self._tainted_names(fn)
+            if not tainted:
+                continue
+            for node in _own_body_walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                name = _mentions_any(node.test, tainted)
+                if name is None:
+                    continue
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(
+                    Finding(
+                        self.id,
+                        sf.path,
+                        node.lineno,
+                        node.col_offset,
+                        f"{kind} on meta-readback value '{name}' "
+                        f"inside '{fn.name}' — a host branch in the "
+                        "committed chain serializes the pipeline; "
+                        "fold the decision into the fused executable "
+                        "(seed-select / lax.cond) or suppress with "
+                        "an audited reason",
+                    )
+                )
+        return findings
+
+    @staticmethod
+    def _tainted_names(fn: ast.AST) -> set:
+        """Names bound (directly or one hop transitively) from a
+        ``reap_read(...)`` call in the function's own body. The
+        fixpoint over assignments catches ``cnt = int(reap_read(m))``
+        as well as ``rows = meta[0]`` after ``meta = reap_read(...)``."""
+        tainted: set = set()
+        assigns: List[Tuple[List[str], ast.expr]] = []
+        for node in _own_body_walk(fn):
+            if isinstance(node, ast.Assign):
+                targets = [
+                    n for t in node.targets for n in _assigned_names(t)
+                ]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = list(_assigned_names(node.target))
+                value = node.value
+            else:
+                continue
+            assigns.append((targets, value))
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call):
+                    callee = dotted_name(sub.func)
+                    if callee is not None and (
+                        callee.split(".")[-1] == "reap_read"
+                    ):
+                        tainted.update(targets)
+                        break
+        changed = True
+        while changed:
+            changed = False
+            for targets, value in assigns:
+                if _mentions_any(value, tainted) is None:
+                    continue
+                fresh = [t for t in targets if t not in tainted]
+                if fresh:
+                    tainted.update(fresh)
+                    changed = True
+        return tainted

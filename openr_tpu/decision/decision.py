@@ -263,6 +263,10 @@ class Decision:
         self._rebuild_debounced = AsyncDebounce(
             self.evb, debounce_min_s, debounce_max_s, self._on_debounce_fire
         )
+        # debounce-terminal speculation latch: at most ONE speculative
+        # view solve per debounce window (armed when the window
+        # saturates, reset when the rebuild fires)
+        self._spec_fired_this_window = False
         # admission/backpressure path (service plane): the controller
         # adapts the debounce ceiling to the reader backlog, and the
         # consume path sheds-by-coalescing once the backlog is deep
@@ -361,6 +365,22 @@ class Decision:
             ):
                 self.spf_solver.prewarm(self.area_link_states)
             self._rebuild_debounced()
+            # debounce-terminal speculation: once the window's backoff
+            # saturates, further publications can only JOIN the window,
+            # never extend it — the fire time is final, and under
+            # latest-wins the current coalesced backlog is the most
+            # likely rebuild composition. Stage its view solve now
+            # (once per window) so the rebuild lands on a warm cache
+            # hit; a later join supersedes the stage, counted
+            # ops.spec_cancels, and the rebuild re-solves bit-identical.
+            if (
+                not self._spec_fired_this_window
+                and self._rebuild_debounced.at_max_backoff()
+            ):
+                self._spec_fired_this_window = True
+                self.spf_solver.speculate_views(
+                    self.my_node_name, self.area_link_states
+                )
 
     def _on_static_routes(self, delta) -> None:
         """Static MPLS routes pushed by the platform/plugin layer
@@ -548,6 +568,7 @@ class Decision:
             self.rebuild_routes("COLD_START_UPDATE")
 
     def _on_debounce_fire(self) -> None:
+        self._spec_fired_this_window = False
         self.rebuild_routes("DECISION_DEBOUNCE")
         # snapshot AFTER the solve window closes: the capture reads the
         # resident distance rows back to host

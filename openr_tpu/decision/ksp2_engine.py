@@ -1218,8 +1218,13 @@ class Ksp2Engine:
 
         graph = state.graph
         chunk = _ss._ksp2_chunk(graph)
-        for start in range(0, len(dsts), chunk):
-            batch = dsts[start : start + chunk]
+
+        def _submit(batch):
+            """Stage 1 of the relay pipeline: mask build + (async)
+            masked solve + resident masks/dm scatter, all chained on
+            the device stream. Returns the in-flight context
+            ``(batch, ok, drows_dev, drows)`` — exactly one of the
+            last two is set, depending on the mesh path."""
             # pad to a power-of-two bucket (capped at the chunk) so the
             # masked kernel compiles a handful of shapes, not one per
             # distinct affected-set size
@@ -1272,6 +1277,12 @@ class Ksp2Engine:
                     else jnp.asarray(drows[: len(batch)])
                 )
                 self.dm_dev = self.dm_dev.at[ids].set(rows_src)
+            return batch, ok, drows_dev, drows
+
+        def _settle(batch, ok, drows_dev, drows):
+            """Stage 2: reap the masked rows, settle dm + fallback
+            accounting, trace second paths — host work the NEXT
+            chunk's already-submitted solve overlaps."""
             if drows is None:
                 drows = _da.reap_read(drows_dev, kicked=True)
             traceable: List[int] = []
@@ -1297,6 +1308,26 @@ class Ksp2Engine:
             )
             for i, paths in zip(traceable, traced):
                 self.second_paths[batch[i]] = paths
+
+        # ONE-DEEP relay pipeline: chunk i+1's masked solve is
+        # submitted before chunk i's rows are reaped, so the relay
+        # round trip amortizes across in-flight chunks. Safe because
+        # ``self.excl`` is fixed for the whole call (every chunk's
+        # masks derive from the same exclusion table) and the settle
+        # stage touches only host mirrors. The mesh path degrades to
+        # eager per-chunk order — the sharded solve already returns
+        # host rows, so there is nothing in flight to overlap.
+        inflight = None
+        for start in range(0, len(dsts), chunk):
+            staged = _submit(dsts[start : start + chunk])
+            if inflight is not None:
+                if staged[2] is not None:
+                    _da.note_pipelined_dispatch(2)
+                    _da.note_overlapped_reap()
+                _settle(*inflight)
+            inflight = staged
+        if inflight is not None:
+            _settle(*inflight)
         for dst in dsts:
             if dst in self.host_dsts:
                 continue

@@ -785,6 +785,11 @@ class SpfSolver:
         # area graph must release its engine (resident [n, n] device
         # matrix + path caches) instead of pinning it until eviction
         self._ksp2_engines = _weakref.WeakKeyDictionary()
+        # debounce-terminal speculation ledger: ls -> (version, root)
+        # staged by speculate_views and not yet consumed by a rebuild.
+        # Weakly keyed like _ksp2_engines; the staged view itself lives
+        # in _views (it IS the rebuild's cache entry on a hit)
+        self._spec_staged = _weakref.WeakKeyDictionary()
         # per-prefix route reuse across churn (driven by the engine's
         # affected set): prefix -> (RibUnicastEntry | None, best result)
         self._route_cache: Dict[IpPrefix, tuple] = {}
@@ -858,6 +863,7 @@ class SpfSolver:
         leak into the recovered route database."""
         self._views = {}
         self._ksp2_engines = _weakref.WeakKeyDictionary()
+        self._spec_staged = _weakref.WeakKeyDictionary()
         self._labels_cache = _weakref.WeakKeyDictionary()
         self._route_cache = {}
         self._route_cache_meta = None
@@ -916,6 +922,63 @@ class SpfSolver:
             except Exception:
                 continue
 
+    def speculate_views(
+        self,
+        my_node_name: str,
+        area_link_states: AreaLinkStates,
+    ) -> int:
+        """Debounce-terminal speculation hook (the decision module
+        calls this once per saturated debounce window, while the timer
+        runs out): under latest-wins, the most likely composition of
+        the pending rebuild is the CURRENT coalesced backlog, so solve
+        the root's view for it NOW and let the rebuild's ``_view``
+        land on a cache hit instead of paying the solve inside the
+        route-build critical path. Counted, never silent:
+        ``ops.spec_dispatches`` on stage, ``ops.spec_hits`` when the
+        rebuild consumes the staged view, ``ops.spec_cancels`` when a
+        later publication supersedes it (the committed rebuild then
+        re-solves — bit-identical, the view is pure in
+        (version, root)). Stands down (``ops.spec_skips``) off-device
+        or while any chaos fault is armed: every fault seam belongs to
+        the committed path's degradation ladder, and a speculative
+        solve consuming a charge would let a fault escape the rung
+        that owns it."""
+        from openr_tpu.faults.injector import get_injector
+
+        reg = _get_registry()
+        if self.backend != "device":
+            return 0
+        if get_injector().any_armed:
+            reg.counter_bump("ops.spec_skips")
+            return 0
+        staged = 0
+        for area in sorted(area_link_states):
+            ls = area_link_states[area]
+            if not ls.has_node(my_node_name):
+                continue
+            key = (ls.topology_version, my_node_name)
+            prev = self._spec_staged.pop(ls, None)
+            if prev == key:
+                self._spec_staged[ls] = prev
+                continue
+            if prev is not None:
+                # an earlier stage for this graph died unconsumed
+                reg.counter_bump("ops.spec_cancels")
+            per_ls = self._views.get(ls)
+            if per_ls is not None and key in per_ls:
+                continue  # already current: nothing to speculate
+            try:
+                self._view(area, ls, my_node_name)
+            except Exception:
+                # abandoned speculation, never an escalation: the
+                # committed rebuild owns the retry ladder
+                reg.counter_bump("ops.spec_cancels")
+                continue
+            self._spec_staged[ls] = key
+            reg.counter_bump("ops.spec_dispatches")
+            staged += 1
+        return staged
+
     def _world_preload(
         self,
         my_node_name: str,
@@ -973,6 +1036,20 @@ class SpfSolver:
             SPF_COUNTERS["route_engine.view_evictions"] += 1
         key = (ls.topology_version, root)
         view = per_ls.get(key)
+        spec = self._spec_staged.get(ls)
+        if spec is not None:
+            if view is not None and spec == key:
+                # the debounced rebuild consumed the staged view —
+                # the speculative solve paid off
+                del self._spec_staged[ls]
+                _get_registry().counter_bump("ops.spec_hits")
+            elif spec[0] != key[0]:
+                # the graph moved past the staged version: the
+                # speculative solve died unconsumed
+                del self._spec_staged[ls]
+                _get_registry().counter_bump("ops.spec_cancels")
+            # same version, different root (a ctrl query): the staged
+            # view stays armed for the rebuild
         if view is None:
             # drop stale versions of this graph
             for k in [k for k in per_ls if k[0] != key[0]]:

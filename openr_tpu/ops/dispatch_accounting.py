@@ -30,6 +30,19 @@ feed the ``ops.host_touches`` histogram; the counters
 accumulate globally (windowed or not). Re-entrant: an inner
 ``event_window`` joins the active one, so a coalesced churn window
 spanning N folded events still reads as ONE submit + ONE reap.
+
+``pipeline_drain(tag)`` brackets one pipelined BURST of event windows:
+window N+1's submit overlaps window N's reap, so the unit of host cost
+is the drain, not the window. Every ``event_window`` opened inside a
+drain joins it (same re-entrancy), which is what makes the per-drain
+touch histogram honest: the reap that window N+1 drains on window N's
+behalf lands in ONE shared read phase instead of being double-counted
+against both windows. Per-drain touches feed ``ops.touches_per_drain``
+(+ the folded window count in ``ops.windows_per_drain``); the
+pipelining itself is witnessed by ``note_pipelined_dispatch`` — called
+at each submit that happens while a prior window's reap is still in
+flight — and ``note_overlapped_reap`` at each reap drained inside a
+successor's window.
 """
 
 from __future__ import annotations
@@ -54,10 +67,10 @@ class EventWindow:
     __slots__ = (
         "tag", "dispatches", "blocking_syncs", "async_reaps",
         "submit_phases", "read_phases", "_last",
-        "t0", "device_ms", "stages",
+        "t0", "device_ms", "stages", "windows", "drain",
     )
 
-    def __init__(self, tag: str):
+    def __init__(self, tag: str, drain: bool = False):
         self.tag = tag
         self.dispatches = 0
         self.blocking_syncs = 0
@@ -70,6 +83,11 @@ class EventWindow:
         # device ms inside this window + per-tag [calls, host, device]
         self.device_ms = 0.0
         self.stages: Dict[str, List[float]] = {}
+        # logical event windows folded into this one (joins bump it);
+        # drain=True marks a pipeline_drain bracket, whose retirement
+        # feeds the per-drain histograms instead of only per-window
+        self.windows = 1
+        self.drain = drain
 
     def _mark(self, phase: str) -> None:
         if self._last != phase:
@@ -89,6 +107,23 @@ def current_window() -> Optional[EventWindow]:
     return stack[-1] if stack else None
 
 
+def _retire(w: EventWindow) -> None:
+    """Observe a popped window and hand it to the profiling plane.
+    Runs OUTSIDE the window (stack already popped): ratio bookkeeping,
+    flight record, trigger checks, and any deferred post-mortem dump
+    are all safe here."""
+    reg = get_registry()
+    reg.observe("ops.host_touches", float(w.touches))
+    reg.observe(f"ops.host_touches.{w.tag}", float(w.touches))
+    if w.drain:
+        reg.counter_bump("ops.pipeline_drains")
+        reg.observe("ops.touches_per_drain", float(w.touches))
+        reg.observe("ops.windows_per_drain", float(w.windows))
+    wall_ms = (time.perf_counter() - w.t0) * 1000.0
+    get_profiler().on_window(w.tag, wall_ms, w.device_ms)
+    get_flight_recorder().on_window(w.tag, wall_ms, w)
+
+
 @contextmanager
 def event_window(tag: str = "event") -> Iterator[EventWindow]:
     """Bracket one committed event. Joins an already-active window
@@ -98,6 +133,7 @@ def event_window(tag: str = "event") -> Iterator[EventWindow]:
     if stack is None:
         stack = _TLS.stack = []
     if stack:
+        stack[-1].windows += 1
         yield stack[-1]
         return
     w = EventWindow(tag)
@@ -106,15 +142,59 @@ def event_window(tag: str = "event") -> Iterator[EventWindow]:
         yield w
     finally:
         stack.pop()
-        reg = get_registry()
-        reg.observe("ops.host_touches", float(w.touches))
-        reg.observe(f"ops.host_touches.{w.tag}", float(w.touches))
-        # window retired (stack popped): safe point for the profiling
-        # plane — ratio bookkeeping, flight record, trigger checks,
-        # and any deferred post-mortem dump all run OUTSIDE the window
-        wall_ms = (time.perf_counter() - w.t0) * 1000.0
-        get_profiler().on_window(w.tag, wall_ms, w.device_ms)
-        get_flight_recorder().on_window(w.tag, wall_ms, w)
+        _retire(w)
+
+
+@contextmanager
+def pipeline_drain(tag: str = "drain") -> Iterator[EventWindow]:
+    """Bracket one pipelined burst of event windows. The drain opens a
+    drain-flagged window on the same stack, so every ``event_window``
+    inside it joins (the burst's overlapped submits and reaps merge
+    into shared phases — no double-counting the reap window N+1 drains
+    for window N). Retirement feeds ``ops.touches_per_drain`` and
+    ``ops.windows_per_drain`` on top of the per-window histograms.
+    Joining an already-active window degrades to that window (the
+    outermost bracket owns the observation)."""
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    if stack:
+        yield stack[-1]
+        return
+    w = EventWindow(tag, drain=True)
+    w.windows = 0  # only joined event windows count toward the burst
+    stack.append(w)
+    try:
+        yield w
+    finally:
+        stack.pop()
+        _retire(w)
+
+
+def note_window(n: int = 1) -> None:
+    """Count ``n`` logical event windows folded into the active window
+    or drain WITHOUT opening a join — for burst bodies that stage their
+    windows inline (one submit run, one settle run) rather than through
+    nested ``event_window`` brackets. No-op outside a window."""
+    w = current_window()
+    if w is not None:
+        w.windows += n
+
+
+def note_pipelined_dispatch(depth: int = 2) -> None:
+    """Witness that a window's committed dispatch was submitted while
+    a prior window's reap was still in flight (the acceptance-criterion
+    signal for pipeline depth >= 2). ``depth`` is the number of windows
+    concurrently in flight after this submit."""
+    reg = get_registry()
+    reg.counter_bump("ops.pipelined_dispatches")
+    reg.observe("ops.pipeline_depth", float(depth))
+
+
+def note_overlapped_reap() -> None:
+    """Witness that a prior window's staged reap was drained inside a
+    successor window's submit/solve span (the double-buffer overlap)."""
+    get_registry().counter_bump("ops.overlapped_reaps")
 
 
 def attribute_stage(tag: str, host_ms: float, device_ms: float) -> None:

@@ -300,8 +300,7 @@ def _resolve_and_pack(
     return dr, digests, packed_res, out
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _compact_changed(new_packed, prev_packed, n):
+def _compact_changed_body(new_packed, prev_packed, n):
     """Full-width delta epilogue: diff the fresh [n_pad, W] packed
     product bit-for-bit against the resident previous one and
     prefix-sum-compact the changed rows to the front, each prefixed by
@@ -309,7 +308,10 @@ def _compact_changed(new_packed, prev_packed, n):
     the host reads the scalar, then slices out[:changed_count]: the
     full-width refresh pays an O(changed) readback like the bucketed
     path instead of hauling every row home. Padding destinations
-    (t >= n) re-solve identically every time and are masked out."""
+    (t >= n) re-solve identically every time and are masked out.
+    Traced body — shared by the standalone jit below and the fused
+    overflow chains, so the compaction rides the same executable as
+    the solve it diffs."""
     npad = new_packed.shape[0]
     ids = jnp.arange(npad, dtype=jnp.int32)
     changed = (ids < n) & jnp.any(new_packed != prev_packed, axis=1)
@@ -320,6 +322,11 @@ def _compact_changed(new_packed, prev_packed, n):
     out = jnp.zeros((npad, body.shape[1]), dtype=jnp.int32)
     out = out.at[dest].set(body, mode="drop")
     return ch_count, out
+
+
+_compact_changed = functools.partial(
+    jax.jit, static_argnames=("n",)
+)(_compact_changed_body)
 
 
 def _compact_rows_with_ids(new_packed, prev_packed, cap):
@@ -501,6 +508,59 @@ def _frontier_step(
         dr2, nh_count, d_s, packed_mask, pos_w
     )
     return dr2, digests, packed
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bands", "n", "n_real", "max_jumps")
+)
+def _overflow_chain(
+    v_old_t, w_old_t, v_new_t, w_new_t, dr, packed_res,
+    e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w, bands, n, n_real, max_jumps,
+):
+    """The fused overflow decision chain: probe + frontier-vs-full
+    branch + re-solve + extraction + delta compaction in ONE
+    executable, with the policy decision made ON DEVICE instead of a
+    16-byte meta readback and a host ``if``.
+
+    The branch reduces to a seed select: the full-width refresh is
+    exactly the frontier re-solve with an all-True cone (an all-INF
+    warm seed collapses to the cold unit init inside
+    ``rs._rev_fixed_point``), so ``use_frontier`` only widens the
+    reset mask — no ``lax.cond`` over differently-shaped programs, and
+    the answer is bit-identical to whichever split-path dispatch the
+    host branch would have picked. The probe runs over the PRE-patch
+    tensors, the solve over the PATCHED ones (both passed in: patch
+    scatter is its own tiny dispatch in the same submit phase). The
+    meta row rides home on the async lane for post-hoc policy
+    telemetry only — a warm multi-window burst never breaks the
+    dispatch chain on it."""
+    cone, rows, cells, jumps, ok = rs._cone_expand(
+        dr, bands, v_old_t, w_old_t, e_u, e_v, e_w_old, e_w_new,
+        max_jumps, cell_limit=cell_limit[0],
+    )
+    meta = jnp.stack(
+        [rows.astype(jnp.float32), cells,
+         jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+    )
+    use_frontier = jnp.logical_and(ok, cells <= cell_limit[0])
+    eff_cone = jnp.logical_or(cone, jnp.logical_not(use_frontier))
+    t_ids = jnp.arange(n, dtype=jnp.int32)
+    warm0 = jnp.where(eff_cone, INF, dr)
+    dr2 = rs._rev_fixed_point(
+        bands, v_new_t, w_new_t, overloaded_new, t_ids, n, init=warm0
+    )
+    nh_count = rs._nh_counts(
+        dr2, bands, v_new_t, w_new_t, overloaded_new, t_ids
+    )
+    d_s, packed_mask = rs._sample_stats(
+        dr2, samp_ids, samp_v, samp_w, overloaded_new, t_ids
+    )
+    digests, packed = _pack_product(
+        dr2, nh_count, d_s, packed_mask, pos_w
+    )
+    ch_count, comp = _compact_changed_body(packed, packed_res, n_real)
+    return dr2, digests, packed, ch_count, comp, meta
 
 
 # -- mesh-sharded dispatches ----------------------------------------------
@@ -780,6 +840,84 @@ def _sharded_frontier_step(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("bands", "n", "n_real", "max_jumps", "mesh"),
+)
+def _sharded_overflow_chain(
+    v_old_t, w_old_t, v_new_t, w_new_t, dr, packed_res,
+    e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w, bands, n, n_real, max_jumps,
+    mesh,
+):
+    """Sharded fused overflow chain: per-shard cone expansion with the
+    counters/growth bit psum-voted (the policy inputs are
+    device-invariant by construction, so every shard takes the SAME
+    seed-select branch), warm re-solve over the patched replicated
+    bands, per-shard extraction — one shard_map, no replicated policy
+    readback in the middle. The delta compaction runs on the
+    row-sharded packed product after the shard_map, inside the same
+    executable; meta comes back replicated for post-hoc telemetry."""
+    nb = len(v_old_t)
+
+    def shard_fn(t_blk, dr_s, *rest):
+        v_o = rest[:nb]
+        w_o = rest[nb : 2 * nb]
+        v_n = rest[2 * nb : 3 * nb]
+        w_n = rest[3 * nb : 4 * nb]
+        (e_u_r, e_v_r, e_wo_r, e_wn_r, lim_r, ov_r,
+         sid_r, sv_r, sw_r, pw_r) = rest[4 * nb :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        cone, rows, cells, jumps, ok = rs._cone_expand(
+            dr_s, bands, v_o, w_o, e_u_r, e_v_r, e_wo_r, e_wn_r,
+            max_jumps, vote=vote, cell_limit=lim_r[0],
+        )
+        meta = jnp.stack(
+            [rows.astype(jnp.float32), cells,
+             jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+        )
+        use_frontier = jnp.logical_and(ok, cells <= lim_r[0])
+        eff_cone = jnp.logical_or(
+            cone, jnp.logical_not(use_frontier)
+        )
+        warm0 = jnp.where(eff_cone, INF, dr_s)
+        dr2 = rs._rev_fixed_point(
+            bands, v_n, w_n, ov_r, t_blk, n, vote=vote, init=warm0
+        )
+        nh_count = rs._nh_counts(dr2, bands, v_n, w_n, ov_r, t_blk)
+        d_s, packed_mask = rs._sample_stats(
+            dr2, sid_r, sv_r, sw_r, ov_r, t_blk
+        )
+        digests, packed = _pack_product(
+            dr2, nh_count, d_s, packed_mask, pw_r
+        )
+        return dr2, digests, packed, meta
+
+    dr2, digests, packed, meta = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS), P(SOURCES_AXIS, None)]
+            + [P(None, None)] * (4 * nb)
+            + [P(None)] * 6
+            + [P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+            P(None),
+        ),
+    )(
+        jnp.arange(n, dtype=jnp.int32), dr,
+        *v_old_t, *w_old_t, *v_new_t, *w_new_t,
+        e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+        samp_ids, samp_v, samp_w, pos_w,
+    )
+    ch_count, comp = _compact_changed_body(packed, packed_res, n_real)
+    return dr2, digests, packed, ch_count, comp, meta
+
+
 class _DeviceStateInvalid(RuntimeError):
     """The resident device state is stale (a host fallback bypassed
     it): the warm rung refuses to run and the ladder walks to the cold
@@ -801,11 +939,11 @@ class PendingDelta:
     __slots__ = (
         "_engine", "segs", "counts", "ch_counts", "k", "dslices",
         "fw_count", "consumed", "names", "delta_rows",
-        "readback_bytes", "overlap_ms",
+        "readback_bytes", "overlap_ms", "meta_dev", "meta_limit",
     )
 
     def __init__(self, engine, segs, counts, ch_counts, k,
-                 fw_count=None):
+                 fw_count=None, meta_dev=None, meta_limit=0.0):
         self._engine = engine
         self.segs = segs          # per-shard device [k+1, 1+W] arrays
         self.counts = counts      # per-shard affected counts
@@ -838,11 +976,37 @@ class PendingDelta:
             self.dslices.append(sl)
         if fw_count is not None:
             da.kick_async(fw_count)
+        # fused-overflow-chain mode: the probe meta rode the dispatch
+        # and its policy classification (frontier vs full-width
+        # counters) is settled at consume time, off the event window
+        self.meta_dev = meta_dev
+        self.meta_limit = meta_limit
+        if meta_dev is not None:
+            da.kick_async(meta_dev)
 
     def wait(self) -> List[str]:
         if not self.consumed:
             self._engine.flush()
         return self.names
+
+
+class _Speculation:
+    """One staged speculative churn dispatch (latest-wins guess at the
+    debounce window's final composition). Everything here is
+    FUNCTIONAL output of _run_bucket — the resident tensors are never
+    donated (retry-ladder hazard rule), so cancelling a speculation is
+    dropping this object: no device state to unwind, no readback to
+    drain (the kicked meta copies land and are garbage-collected).
+    ``dr_ref`` pins the exact resident DR the dispatch read; every
+    commit path replaces the engine's ``_dr`` binding, so an
+    identity mismatch at adoption time means another event committed
+    underneath the speculation and it MUST cancel."""
+
+    __slots__ = (
+        "union", "version", "aversion", "dr_ref", "ctx", "segments",
+        "counts", "ch_counts", "commit_state", "ov_new", "k",
+        "new_out", "ov_flips", "structural",
+    )
 
 
 @mirrored_by(
@@ -891,6 +1055,8 @@ class RouteSweepEngine(ResidentEngineContract):
         self._align = align
         self._k_hint = _ROW_BUCKETS[0]
         self._pending: Optional[PendingDelta] = None
+        # at most one staged speculative dispatch (see speculate_churn)
+        self._speculation: Optional[_Speculation] = None
         # service-plane visibility into the dispatch-level double
         # buffer: 1 while a delta-compacted readback is in flight
         # (consumed inside the next churn's dispatch window) — the same
@@ -986,6 +1152,8 @@ class RouteSweepEngine(ResidentEngineContract):
         # the engine torn (mirrors vs residents), and the gate forces
         # every later event through another cold build or the host rung
         self._device_valid = False
+        # a staged speculation read the pre-build residents: dead now
+        self._speculation = None
         graph, sweeper = self._compile_backend(ls)
         if graph.n_pad > self._max_nodes():
             raise ValueError(
@@ -1070,6 +1238,15 @@ class RouteSweepEngine(ResidentEngineContract):
         return True
 
     # -- events ------------------------------------------------------------
+
+    def _layout_changed(self, ctx) -> bool:
+        """Backend hook: did this event change the static band layout
+        (shapes under the resident tensors)? Speculation and bursts
+        refuse such events — the committed path owns the recompile.
+        ELL bands are plain (start, rows, k) records, comparable by
+        value; the grouped backend overrides (its patch helper returns
+        None on any layout break, so a ctx implies stability)."""
+        return ctx["patched"].bands != self.graph.bands
 
     def _prepare_patch(self, ls, affected_sorted):
         """Backend hook: derive the patched graph + device patch
@@ -1326,6 +1503,7 @@ class RouteSweepEngine(ResidentEngineContract):
         da.kick_async(ch_count)
         m = int(da.reap_read(ch_count, kicked=True))
         names: List[str] = []
+        # openr-lint: disable=host-branch-in-chain -- post-reap delta apply: the window already closed; the count only sizes the host mirror copy (audited)
         if m:
             names = self._apply_delta_rows(
                 da.reap_read(_rows_slice(comp, 0, m))
@@ -1421,20 +1599,157 @@ class RouteSweepEngine(ResidentEngineContract):
             ),
         )
 
+    @solve_window
+    def _dispatch_overflow_chain(self, ctx, e_dev, ov_new, limit):
+        """Backend hook: the FUSED overflow decision chain — probe,
+        on-device frontier-vs-full-width seed select, warm re-solve,
+        extraction and delta compaction in one dispatch
+        (_overflow_chain). Returns the chain product tuple
+        ``(dr, digests, packed, ch_count, comp, meta)`` with meta an
+        in-flight device row, or None when the event WIDENED the band
+        layout (static shapes changed under the resident tensors —
+        the split probe/branch path owns that recompile)."""
+        if ctx["patched"].bands != self.graph.bands:
+            return None
+        if ctx["patched_bands"] is None:
+            ctx["patched_bands"] = self._dispatch_patch(ctx)
+        new_v, new_w = ctx["patched_bands"]
+        e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        lim = jnp.asarray([limit], dtype=jnp.float32)
+        if self.plan is not None:
+            lim = self.plan.replicate(lim)
+        if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip fused
+            # overflow chain (mesh is None): no mesh axis to spec
+            return aot_call(
+                "ell_overflow_chain", _overflow_chain,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, new_v, new_w,
+                    self._dr, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d, lim, ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    bands=self.graph.bands, n=self.graph.n_pad,
+                    n_real=self.graph.n, max_jumps=_FRONTIER_MAX_JUMPS,
+                ),
+            )
+        return aot_call(
+            "ell_overflow_chain_sharded", _sharded_overflow_chain,
+            (
+                self.sweeper.v_t, self.sweeper.w_t, new_v, new_w,
+                self._dr, self._packed_dev,
+                e_u_d, e_v_d, e_wo_d, e_wn_d, lim, ov_new,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+            ),
+            dict(
+                bands=self.graph.bands, n=self.graph.n_pad,
+                n_real=self.graph.n, max_jumps=_FRONTIER_MAX_JUMPS,
+                mesh=self.mesh,
+            ),
+        )
+
+    def _note_overflow_meta(self, meta, limit) -> str:
+        """Post-hoc policy classification of a fused overflow chain's
+        reaped probe meta: the SAME float32 compare the device seed
+        select made, so the frontier/full-width counters match the
+        branch the chain actually took. Mirrors the split path's
+        counter/flight bookkeeping exactly (both counters bump on a
+        fallback: it IS a full refresh)."""
+        reg = get_registry()
+        rows, jumps = int(meta[0]), int(meta[2])
+        cells = float(meta[1])
+        converged = bool(meta[3])
+        self.last_frontier_rows = rows
+        self.last_frontier_jumps = jumps
+        self.last_frontier_cells = cells
+        reg.observe("ops.frontier_rows", float(rows))
+        reg.observe("ops.frontier_cells", cells)
+        reg.observe("ops.frontier_jumps", float(jumps))
+        if converged and np.float32(cells) <= np.float32(limit):
+            self.frontier_resolves += 1
+            reg.counter_bump("route_engine.frontier_resolves")
+            get_flight_recorder().note(
+                "engine", path="frontier_resolve"
+            )
+            return "frontier"
+        self.frontier_fallbacks += 1
+        reg.counter_bump("ops.frontier_fallbacks")
+        get_flight_recorder().note(
+            "engine", path="frontier_fallback", rows=rows, jumps=jumps
+        )
+        self.full_refreshes += 1
+        reg.counter_bump("route_engine.full_refreshes")
+        get_flight_recorder().note("engine", path="full_refresh")
+        return "full_width"
+
+    def _commit_overflow_chain(self, ls, chain, ctx, ov_new, new_out,
+                               ov_flips, limit, defer=False):
+        """Commit tail of the fused overflow chain: adopt the patch +
+        chain product, then reap (or defer) the compacted delta AND
+        the policy meta in one read phase — the counters classify
+        post-hoc from the same meta the device branched on."""
+        dr, digests, packed, ch_count, comp, meta_dev = chain
+        # the chain read the pre-patch residents; adopt the patched
+        # tensors now (patched_bands already dispatched, no extra
+        # program launch)
+        self._apply_patch_resident(ctx, ov_new)
+        self._dr = dr
+        self._digests_dev = digests
+        self._packed_dev = packed
+        self._commit_host_mirrors(ls, new_out, ov_flips)
+        self.version = ls.topology_version
+        self.aversion = ls.attributes_version
+        self._k_hint = _ROW_BUCKETS[-1]
+        if defer:
+            pending = PendingDelta(
+                self, [comp], [-1], [None], int(comp.shape[0]),
+                fw_count=ch_count, meta_dev=meta_dev,
+                meta_limit=limit,
+            )
+            self._pending = pending
+            return pending
+        da.kick_async(ch_count)
+        da.kick_async(meta_dev)
+        self._note_overflow_meta(
+            da.reap_read(meta_dev, kicked=True), limit
+        )
+        m = int(da.reap_read(ch_count, kicked=True))
+        names: List[str] = []
+        if m:
+            names = self._apply_delta_rows(
+                da.reap_read(_rows_slice(comp, 0, m))
+            )
+        bytes_read = m * comp.shape[1] * 4 + 4
+        self.last_delta_rows = m
+        self.last_readback_bytes = bytes_read
+        self.last_overlap_ms = 0.0
+        reg = get_registry()
+        reg.observe("ops.delta_rows", float(m))
+        reg.observe("ops.readback_bytes", float(bytes_read))
+        return sorted(names)
+
     @committed_dispatch
     def _overflow_refresh(self, ls, ctx, ov_new, new_out, ov_flips,
                           e_dev, defer=False):
         """Overflow policy: the affected-row count exceeded every
-        solve bucket. Probe the affected cone on device first; when
-        the cone converged under the row budget
-        (frontier_threshold * n), re-solve ONLY cone cells in one
-        masked full-width dispatch (_frontier_refresh) — otherwise
-        ride the existing _full_refresh. Either way the readback stays
-        delta-compacted (O(changed)).
+        solve bucket. The warm path is the FUSED chain
+        (_dispatch_overflow_chain): probe + frontier-vs-full-width
+        decision + re-solve + compaction in one dispatch, the branch
+        taken ON DEVICE — no 16-byte meta readback between the probe
+        and the re-solve, so a pipelined burst's dispatch chain never
+        breaks here. When the event widened the band layout the split
+        probe/branch path runs instead (the widening recompile
+        dominates; one policy readback is noise there). Either way the
+        readback stays delta-compacted (O(changed)).
 
-        A probe failure degrades WITHIN the warm rung: the full-width
-        refresh is this path's own fallback, so the supervisor ladder
-        (warm -> cold -> host) never sees a frontier error."""
+        A chain/probe failure degrades WITHIN the warm rung: the
+        full-width refresh is this path's own fallback, so the
+        supervisor ladder (warm -> cold -> host) never sees a frontier
+        error."""
         reg = get_registry()
         tracer = get_tracer()
         span = tracer.span_active("ops.frontier_resolve")
@@ -1446,39 +1761,62 @@ class RouteSweepEngine(ResidentEngineContract):
             # destination row, so a row count saturates at n while the
             # actual cone stays a sliver of the [n, n] product
             limit = self.frontier_threshold * float(self.graph.n) ** 2
-            probe = None
+            chain = None
+            widened = False
             try:
                 fault_point(FAULT_FRONTIER)
-                probe = self._dispatch_frontier_probe(
-                    ctx, e_dev, limit
+                chain = self._dispatch_overflow_chain(
+                    ctx, e_dev, ov_new, limit
                 )
+                widened = chain is None
             except Exception:
                 # degrade, don't propagate: full-width gives the same
                 # bit-identical answer, just slower (counted so a
                 # frontier-fallback storm is visible in telemetry)
                 reg.counter_bump("route_engine.frontier_errors")
-            if probe is not None:
-                cone, meta = probe
-                # 16-byte policy readback: kicked onto the async lane
-                # so the decision read folds into the window's single
-                # read phase instead of a dedicated blocking sync
-                da.kick_async(meta)
-                meta = da.reap_read(meta, kicked=True)
-                rows, jumps = int(meta[0]), int(meta[2])
-                cells = float(meta[1])
-                converged = bool(meta[3])
-                self.last_frontier_rows = rows
-                self.last_frontier_jumps = jumps
-                self.last_frontier_cells = cells
-                reg.observe("ops.frontier_rows", float(rows))
-                reg.observe("ops.frontier_cells", cells)
-                reg.observe("ops.frontier_jumps", float(jumps))
-                if converged and cells <= limit:
-                    path = "frontier"
-                    return self._frontier_refresh(
-                        ls, ctx, ov_new, new_out, ov_flips, cone,
-                        defer=defer,
+            if chain is not None:
+                path = "fused_chain"
+                got = self._commit_overflow_chain(
+                    ls, chain, ctx, ov_new, new_out, ov_flips, limit,
+                    defer=defer,
+                )
+                rows = self.last_frontier_rows
+                jumps = self.last_frontier_jumps
+                return got
+            if widened:
+                # split path (band widening recompiles anyway): probe,
+                # then one async-lane policy readback + host branch
+                probe = None
+                try:
+                    probe = self._dispatch_frontier_probe(
+                        ctx, e_dev, limit
                     )
+                except Exception:
+                    reg.counter_bump("route_engine.frontier_errors")
+                if probe is not None:
+                    cone, meta = probe
+                    # 16-byte policy readback: kicked onto the async
+                    # lane so the decision read folds into the
+                    # window's single read phase instead of a
+                    # dedicated blocking sync
+                    da.kick_async(meta)
+                    meta = da.reap_read(meta, kicked=True)
+                    rows, jumps = int(meta[0]), int(meta[2])
+                    cells = float(meta[1])
+                    converged = bool(meta[3])
+                    self.last_frontier_rows = rows
+                    self.last_frontier_jumps = jumps
+                    self.last_frontier_cells = cells
+                    reg.observe("ops.frontier_rows", float(rows))
+                    reg.observe("ops.frontier_cells", cells)
+                    reg.observe("ops.frontier_jumps", float(jumps))
+                    # openr-lint: disable=host-branch-in-chain -- widened-layout split path: the band reshape recompiles the chain anyway, so the one policy branch stays host-side (audited)
+                    if converged and cells <= limit:
+                        path = "frontier"
+                        return self._frontier_refresh(
+                            ls, ctx, ov_new, new_out, ov_flips, cone,
+                            defer=defer,
+                        )
             self.frontier_fallbacks += 1
             reg.counter_bump("ops.frontier_fallbacks")
             get_flight_recorder().note(
@@ -1552,6 +1890,19 @@ class RouteSweepEngine(ResidentEngineContract):
         # cannot outlive the walk
         fault_point(FAULT_CONSUME)
         fault_point(FAULT_DEVICE_LOST)
+        if overlap:
+            # window N's staged reap drains inside window N+1's span:
+            # the double-buffer overlap, witnessed for the per-drain
+            # accounting
+            da.note_overlapped_reap()
+        if p.meta_dev is not None:
+            # fused-overflow-chain pending: settle the policy
+            # classification (frontier vs full-width counters) from
+            # the meta row that rode the async lane since commit
+            self._note_overflow_meta(
+                da.reap_read(p.meta_dev, kicked=True), p.meta_limit
+            )
+            p.meta_dev = None
         tracer = get_tracer()
         span = tracer.span_active("ops.route_engine.delta_consume")
         reg = get_registry()
@@ -1562,6 +1913,7 @@ class RouteSweepEngine(ResidentEngineContract):
         total_bytes = 0
         for seg, sl, m in zip(p.segs, p.dslices, p.ch_counts):
             t_sh = time.perf_counter()
+            # openr-lint: disable=host-branch-in-chain -- pending-delta consume IS the drain point: every branch here runs after the overlapped reap lands (audited)
             if m is None:
                 # FULL-WIDTH pending: the changed count rode the async
                 # lane since the overflow commit; reap it, then pull
@@ -1569,6 +1921,7 @@ class RouteSweepEngine(ResidentEngineContract):
                 # _compact_changed segment carries no meta row)
                 m = int(da.reap_read(p.fw_count, kicked=True))
                 shard_bytes = 4
+                # openr-lint: disable=host-branch-in-chain -- post-reap apply: the count only sizes the row pull (audited)
                 if m:
                     names.extend(self._apply_delta_rows(
                         da.reap_read(_rows_slice(seg, 0, m))
@@ -1579,6 +1932,7 @@ class RouteSweepEngine(ResidentEngineContract):
                 continue
             # meta row already crossed (retry ladder); count it
             shard_bytes = seg.shape[1] * 4
+            # openr-lint: disable=host-branch-in-chain -- post-reap apply: the count only sizes the row pull (audited)
             if m:
                 # the per-shard copy was kicked async at PendingDelta
                 # creation: the reap normally finds the host value
@@ -1643,11 +1997,406 @@ class RouteSweepEngine(ResidentEngineContract):
         batched result is bit-identical to N sequential ``churn()``
         calls — same union-diff argument as ``churn_coalesced`` — but
         the host only touches the device twice: once to submit the
-        fused dispatch chain, once to reap the compacted delta."""
+        fused dispatch chain, once to reap the compacted delta.
+
+        When a staged speculation (speculate_churn) matches this
+        window's final composition — same union, same LinkState
+        versions, residents untouched since staging — the window
+        ADOPTS the already-dispatched solve (ops.spec_hits) and only
+        pays the commit + reap; any mismatch cancels the speculation
+        (ops.spec_cancels, never silent) and the committed path below
+        re-dispatches from the unchanged residents, so the result is
+        bit-identical to the sequential oracle either way."""
+        union: Set[str] = set()
+        for s in affected_sets:
+            union |= set(s)
+        spec = self._speculation
+        self._speculation = None
+        if spec is not None:
+            if (
+                spec.union == frozenset(union)
+                and spec.version == ls.topology_version
+                and spec.aversion == ls.attributes_version
+                and spec.dr_ref is self._dr
+                and self._device_valid
+            ):
+                return self._adopt_speculation(
+                    ls, spec, affected_sets, defer_consume
+                )
+            get_registry().counter_bump("ops.spec_cancels")
+            get_flight_recorder().note("engine", path="spec_cancel")
         with da.event_window("churn_window"):
             return self.churn_coalesced(
                 ls, affected_sets, defer_consume=defer_consume
             )
+
+    def speculate_churn(self, ls, affected_sets) -> bool:
+        """Stage a SPECULATIVE dispatch of the debounce backlog's
+        most-likely final composition (latest-wins: the coalesced
+        union as of now) before the window closes — the device solves
+        while the host is otherwise idling out the debounce timer. The
+        dispatch is purely functional (residents never donated), so a
+        wrong guess costs nothing but the wasted device cycles:
+        churn_window cancels it and re-dispatches committed.
+
+        Counted, never silent: ops.spec_dispatches on staging,
+        ops.spec_skips when a composition refuses speculation (sample
+        -band mutation, layout widening, bucket overflow — the paths
+        whose side effects are not cancellable or whose committed
+        replay differs), ops.spec_cancels on an abandoned or
+        mismatched attempt. Returns True when a speculation is
+        staged."""
+        reg = get_registry()
+        union: Set[str] = set()
+        for s in affected_sets:
+            union |= set(s)
+        self._speculation = None
+        if not union or not self._device_valid:
+            reg.counter_bump("ops.spec_skips")
+            return False
+        if union & set(self.sample_names):
+            # _refresh_sample_bands mutates the sweeper slot tables
+            # EARLY (before dispatch) — not cancellable, so a window
+            # touching a sample node's adjacencies never speculates
+            reg.counter_bump("ops.spec_skips")
+            return False
+        try:
+            ctx = self._prepare_patch(ls, sorted(union))
+            if ctx is None or self._layout_changed(ctx):
+                # layout break: the committed path cold-rebuilds (or
+                # recompiles the widened shapes) — nothing to adopt
+                reg.counter_bump("ops.spec_skips")
+                return False
+            _raw, new_out, ov_flips, changed = self._event_diff(
+                ls, union, self.graph
+            )
+            if not changed:
+                # attribute-only backlog: nothing route-affecting
+                reg.counter_bump("ops.spec_skips")
+                return False
+            structural = any(
+                wo >= INF or wn >= INF
+                for (wo, wn) in changed.values()
+            )
+            ov_new, e_dev = self._upload_event(
+                ctx["patched"], changed
+            )
+            k = next(b for b in _ROW_BUCKETS if b >= self._k_hint)
+            if self._pending is not None:
+                # the staged dispatch submits while the previous
+                # window's reap is still in flight: depth-2 pipelining
+                da.note_pipelined_dispatch(2)
+            segments, commit_state = self._run_bucket(
+                ctx, k, e_dev, ov_new
+            )
+            meta_rows = [
+                _seg_meta(seg) if isinstance(seg, jax.Array)
+                else seg[0, :2]
+                for seg in segments
+            ]
+            n_meta = sum(
+                1 for seg in segments if isinstance(seg, jax.Array)
+            )
+            if n_meta:
+                da.count_dispatch(n_meta)
+            for mrow in meta_rows:
+                da.kick_async(mrow)
+            metas = [
+                da.reap_read(mrow, kicked=True)
+                if isinstance(mrow, jax.Array) else mrow
+                for mrow in meta_rows
+            ]
+            counts = [int(m[0]) for m in metas]
+            ch_counts = [int(m[1]) for m in metas]
+            if max(counts) > k:
+                # overflow composition: the committed path walks the
+                # bucket ladder / overflow policy — adopting a partial
+                # bucket is never profitable
+                reg.counter_bump("ops.spec_skips")
+                return False
+        except Exception:
+            # speculation runs OUTSIDE the supervisor ladder: any
+            # failure (chaos seam included) abandons the attempt and
+            # the committed path re-dispatches from the unchanged
+            # residents — a fault mid-speculation degrades within the
+            # ladder at commit time, never up it
+            reg.counter_bump("ops.spec_cancels")
+            get_flight_recorder().note("engine", path="spec_abandon")
+            return False
+        spec = _Speculation()
+        spec.union = frozenset(union)
+        spec.version = ls.topology_version
+        spec.aversion = ls.attributes_version
+        spec.dr_ref = self._dr
+        spec.ctx = ctx
+        spec.segments = segments
+        spec.counts = counts
+        spec.ch_counts = ch_counts
+        spec.commit_state = commit_state
+        spec.ov_new = ov_new
+        spec.k = k
+        spec.new_out = new_out
+        spec.ov_flips = ov_flips
+        spec.structural = structural
+        self._speculation = spec
+        reg.counter_bump("ops.spec_dispatches")
+        return True
+
+    def _adopt_speculation(self, ls, spec, affected_sets,
+                           defer_consume):
+        """Commit a matched speculation as the window's result: the
+        solve already ran, so the window is commit + reap only. The
+        counter bookkeeping mirrors _churn_device exactly — an adopted
+        window is indistinguishable from a committed one in the
+        artifacts except for ops.spec_hits."""
+        reg = get_registry()
+        reg.counter_bump("ops.spec_hits")
+        get_flight_recorder().note("engine", path="spec_hit")
+        with da.event_window("churn_window"):
+            if len(affected_sets) > 1:
+                self.coalesced_events += 1
+                reg.counter_bump("route_engine.coalesced_events")
+            if spec.structural:
+                self.structural_events += 1
+                reg.counter_bump("route_engine.structural_events")
+            # the previous window's delta (if any) drains here, inside
+            # the adopted window — same overlap as _churn_device
+            self._consume_pending(overlap=True)
+            self._commit_device(spec.ctx, spec.commit_state,
+                                spec.ov_new)
+            self._commit_host_mirrors(ls, spec.new_out, spec.ov_flips)
+            self.version = ls.topology_version
+            self.aversion = ls.attributes_version
+            self.incremental_events += 1
+            reg.counter_bump("route_engine.incremental_events")
+            self._k_hint = max(
+                _ROW_BUCKETS[0], min(1024, 2 * max(spec.counts))
+            )
+            pending = PendingDelta(
+                self, spec.segments, spec.counts, spec.ch_counts,
+                spec.k,
+            )
+            self._pending = pending
+            if defer_consume:
+                return pending
+            self._consume_pending(overlap=False)
+            return pending.names
+
+    def churn_burst(self, ls, apply_events, defer_consume=False):
+        """Pipelined multi-event burst: every window's committed
+        dispatch submits back to back — window N+1's solve is on the
+        stream before window N's reap lands — then ALL reaps settle in
+        one read run, so the whole burst costs ~2 host touches
+        (ops.touches_per_drain) instead of 2 per window.
+
+        ``apply_events`` is a list of callables; each mutates the
+        LinkState and returns its affected-node set (the latest-wins
+        delivery shape the debounce terminal hands the engine).
+        Bit-identical to applying the events sequentially: each
+        window's dispatch reads the previous window's COMMITTED device
+        state (functional dispatches, residents never donated), and
+        any hazard — bucket overflow, layout widening, sample-band
+        mutation, a chaos-seam fault — cancels the burst back to a
+        pre-burst snapshot and replays the whole thing as ONE
+        coalesced committed window (ops.burst_cancels; the union-diff
+        argument makes the replay equal the sequential chain).
+        Returns the sorted union of moved destination names, or the
+        LAST window's PendingDelta under ``defer_consume=True``."""
+        if not apply_events:
+            return []
+        if not self._device_valid:
+            # degraded: no residents to pipeline against — fold the
+            # burst into one supervised window
+            sets = [set(ev()) for ev in apply_events]
+            return self.churn_window(
+                ls, sets, defer_consume=defer_consume
+            )
+        with da.pipeline_drain("churn_burst"):
+            return self._churn_burst_drain(
+                ls, apply_events, defer_consume
+            )
+
+    def _burst_snapshot(self):
+        """Pre-burst restore point: device refs (functional dispatches
+        never donate them) + deep copies of the host mirrors the
+        optimistic per-window commits mutate."""
+        return dict(
+            dr=self._dr, dig=self._digests_dev,
+            packed=self._packed_dev,
+            v_t=self.sweeper.v_t, w_t=self.sweeper.w_t,
+            ov=self.sweeper.overloaded, graph=self.graph,
+            w_out={u: dict(d) for u, d in self._w_out.items()},
+            w_in={u: dict(d) for u, d in self._w_in.items()},
+            ov_host=dict(self._ov_host),
+            version=self.version, aversion=self.aversion,
+            k_hint=self._k_hint,
+        )
+
+    def _burst_rollback(self, snap) -> None:
+        self._dr = snap["dr"]
+        self._digests_dev = snap["dig"]
+        self._packed_dev = snap["packed"]
+        self.sweeper.v_t = snap["v_t"]
+        self.sweeper.w_t = snap["w_t"]
+        self.sweeper.overloaded = snap["ov"]
+        self.graph = self.sweeper.graph = snap["graph"]
+        self._w_out = snap["w_out"]
+        self._w_in = snap["w_in"]
+        self._ov_host = snap["ov_host"]
+        self.version = snap["version"]
+        self.aversion = snap["aversion"]
+        self._k_hint = snap["k_hint"]
+
+    def _churn_burst_drain(self, ls, apply_events, defer_consume):
+        """The drain body: submit phase pipelines every window's
+        dispatch at ONE fixed bucket (climbing the ladder mid-burst
+        would interleave a meta reap between submits and break the
+        S...S,R...R phase shape), optimistically committing device
+        state + host mirrors per window; the settle phase reaps every
+        meta and every delta in one read run. Any overflow or
+        pre-dispatch hazard rolls back to the snapshot and replays the
+        burst as one coalesced supervised window."""
+        reg = get_registry()
+        self._speculation = None
+        snap = self._burst_snapshot()
+        union: Set[str] = set()
+        # fixed bucket for the whole burst: first ladder rung >= hint
+        k = next(b for b in _ROW_BUCKETS if b >= self._k_hint)
+        staged: List[dict] = []
+        cancel = False
+        idx = 0
+        try:
+            while idx < len(apply_events):
+                ev = apply_events[idx]
+                idx += 1
+                aff = set(ev())
+                union |= aff
+                if not aff:
+                    continue
+                if aff & set(self.sample_names):
+                    cancel = True
+                    break
+                ctx = self._prepare_patch(ls, sorted(aff))
+                if ctx is None or self._layout_changed(ctx):
+                    cancel = True
+                    break
+                _raw, new_out, ov_flips, changed = self._event_diff(
+                    ls, aff, self.graph
+                )
+                if not changed:
+                    self.version = ls.topology_version
+                    self.aversion = ls.attributes_version
+                    continue
+                structural = any(
+                    wo >= INF or wn >= INF
+                    for (wo, wn) in changed.values()
+                )
+                ov_new, e_dev = self._upload_event(
+                    ctx["patched"], changed
+                )
+                if staged or self._pending is not None:
+                    da.note_pipelined_dispatch(len(staged) + 1)
+                segments, commit_state = self._run_bucket(
+                    ctx, k, e_dev, ov_new
+                )
+                meta_rows = [
+                    _seg_meta(seg) if isinstance(seg, jax.Array)
+                    else seg[0, :2]
+                    for seg in segments
+                ]
+                n_meta = sum(
+                    1 for seg in segments
+                    if isinstance(seg, jax.Array)
+                )
+                if n_meta:
+                    da.count_dispatch(n_meta)
+                for mrow in meta_rows:
+                    da.kick_async(mrow)
+                if not staged:
+                    # first window drains any pre-burst delta while
+                    # the burst solves (the double-buffer overlap)
+                    self._consume_pending(overlap=True)
+                # optimistic adoption: window N+1's dispatch must read
+                # window N's committed state to equal the sequential
+                # chain; the snapshot guards the whole prefix
+                self._commit_device(ctx, commit_state, ov_new)
+                self._commit_host_mirrors(ls, new_out, ov_flips)
+                self.version = ls.topology_version
+                self.aversion = ls.attributes_version
+                staged.append(dict(
+                    segments=segments, meta_rows=meta_rows,
+                    structural=structural,
+                ))
+                da.note_window()
+        except Exception:
+            # chaos seam / dispatch failure mid-burst: degrade WITHIN
+            # the ladder — roll back and let the supervised replay
+            # walk warm -> cold -> host as usual, never up it
+            cancel = True
+        if not cancel and staged:
+            # settle: one read run over every window's meta
+            all_counts: List[List[int]] = []
+            all_ch: List[List[int]] = []
+            for st in staged:
+                metas = [
+                    da.reap_read(mrow, kicked=True)
+                    if isinstance(mrow, jax.Array) else mrow
+                    for mrow in st["meta_rows"]
+                ]
+                all_counts.append([int(m[0]) for m in metas])
+                all_ch.append([int(m[1]) for m in metas])
+            if max(max(c) for c in all_counts) > k:
+                cancel = True
+        if cancel:
+            # one cancel path for every hazard: finish delivering the
+            # remaining LinkState mutations, restore the pre-burst
+            # state, and replay the net effect as ONE supervised
+            # coalesced window (union-diff => bit-identical)
+            while idx < len(apply_events):
+                union |= set(apply_events[idx]())
+                idx += 1
+            self._burst_rollback(snap)
+            reg.counter_bump("ops.burst_cancels")
+            get_flight_recorder().note(
+                "engine", path="burst_cancel",
+                windows=len(apply_events),
+            )
+            if len(apply_events) > 1:
+                self.coalesced_events += 1
+                reg.counter_bump("route_engine.coalesced_events")
+            return self.churn(
+                ls, union, defer_consume=defer_consume
+            )
+        if not staged:
+            # attribute-only burst
+            if not defer_consume:
+                self.flush()
+            return []
+        self._k_hint = max(
+            _ROW_BUCKETS[0],
+            min(1024, 2 * max(max(c) for c in all_counts)),
+        )
+        names: List[str] = []
+        last = len(staged) - 1
+        result = None
+        for i, st in enumerate(staged):
+            self.incremental_events += 1
+            reg.counter_bump("route_engine.incremental_events")
+            if st["structural"]:
+                self.structural_events += 1
+                reg.counter_bump("route_engine.structural_events")
+            pending = PendingDelta(
+                self, st["segments"], all_counts[i], all_ch[i], k
+            )
+            self._pending = pending
+            if defer_consume and i == last:
+                result = pending
+                break
+            self._consume_pending(overlap=False)
+            names.extend(pending.names)
+        if result is not None:
+            return result
+        return sorted(set(names))
 
     def churn(self, ls, affected_nodes: Set[str],
               defer_consume: bool = False):
@@ -1803,6 +2552,8 @@ class RouteSweepEngine(ResidentEngineContract):
         are subsumed. A caller-held PendingDelta resolves (empty)."""
         p = self._pending
         self._pending = None
+        # a staged speculation read residents this fallback bypasses
+        self._speculation = None
         if p is not None:
             p.consumed = True
             get_registry().counter_bump("route_engine.deltas_discarded")
@@ -1992,32 +2743,13 @@ class RouteSweepEngine(ResidentEngineContract):
         get_registry().counter_bump("route_engine.host_fallbacks")
         return None
 
-    @fault_boundary
-    @committed_dispatch
-    def _churn_device(self, ls, affected_nodes: Set[str],
-                      defer_consume: bool = False):
-        """Ladder rung 0 (warm): one incremental device event. Returns
-        the list of affected destination NAMES (their digests/sample
-        rows in self.result are refreshed in place); falls back to a
-        cold rebuild (and returns None) when incrementality does not
-        apply. With ``defer_consume=True`` the device state commits but
-        the host apply is left in flight: the return value is a
-        PendingDelta (consumed by the next churn inside its dispatch
-        window, or by flush()/wait()) — self.result is stale until
-        then."""
-        if not self._device_valid:
-            raise _DeviceStateInvalid(
-                "device residents stale (host fallback active)"
-            )
-        graph = self.graph
-        ctx = self._prepare_patch(ls, sorted(affected_nodes))
-        if ctx is None or not self._refresh_sample_bands(
-            ctx["patched"], affected_nodes
-        ):
-            self._build(ls)
-            return None
-        patched = ctx["patched"]
-
+    def _event_diff(self, ls, affected_nodes: Set[str], graph):
+        """Pure host-side event diff against the resident raw-weight
+        mirrors: O(degree) per affected node, no device crossing.
+        Returns ``(raw_changed, new_out, ov_flips, changed)`` — shared
+        by the committed churn path and the speculative staging path
+        (which must observe the SAME diff the committed dispatch
+        would)."""
         # RAW weight diff of the affected nodes' out-edges (O(degree)
         # via the origin index + spf_sparse._out_edges, the same
         # collapse logic the compile uses)
@@ -2065,6 +2797,73 @@ class RouteSweepEngine(ResidentEngineContract):
                     changed[(u, x)] = (wo, INF)  # may break paths
                 else:
                     changed[(u, x)] = (INF, wn)  # may create paths
+        return raw_changed, new_out, ov_flips, changed
+
+    def _upload_event(self, patched, changed):
+        """Upload one event's edge-transition list (padded to a pow2
+        bucket: one compiled shape per bucket, not per distinct churn
+        size) and the patched overload mask. Padding edges are
+        self-loops with INF on both sides -> never usable. Returns
+        ``(ov_new, e_dev)`` committed replicated under a mesh (the
+        sharded steps read them with P(None) in_specs; an unplaced
+        upload would make XLA insert the broadcast on every
+        dispatch)."""
+        e_u = np.asarray([u for (u, _v) in changed], dtype=np.int32)
+        e_v = np.asarray([v for (_u, v) in changed], dtype=np.int32)
+        e_wo = np.asarray(
+            [wo for (wo, _wn) in changed.values()], dtype=np.int32
+        )
+        e_wn = np.asarray(
+            [wn for (_wo, wn) in changed.values()], dtype=np.int32
+        )
+        eb = 8
+        while eb < len(e_u):
+            eb *= 2
+        pad = eb - len(e_u)
+        if pad:
+            e_u = np.concatenate([e_u, np.zeros(pad, np.int32)])
+            e_v = np.concatenate([e_v, np.zeros(pad, np.int32)])
+            e_wo = np.concatenate(
+                [e_wo, np.full(pad, INF, np.int32)]
+            )
+            e_wn = np.concatenate(
+                [e_wn, np.full(pad, INF, np.int32)]
+            )
+        up = self.plan.replicate if self.plan is not None \
+            else jnp.asarray
+        ov_new = up(patched.overloaded)
+        e_dev = (up(e_u), up(e_v), up(e_wo), up(e_wn))
+        return ov_new, e_dev
+
+    @fault_boundary
+    @committed_dispatch
+    def _churn_device(self, ls, affected_nodes: Set[str],
+                      defer_consume: bool = False):
+        """Ladder rung 0 (warm): one incremental device event. Returns
+        the list of affected destination NAMES (their digests/sample
+        rows in self.result are refreshed in place); falls back to a
+        cold rebuild (and returns None) when incrementality does not
+        apply. With ``defer_consume=True`` the device state commits but
+        the host apply is left in flight: the return value is a
+        PendingDelta (consumed by the next churn inside its dispatch
+        window, or by flush()/wait()) — self.result is stale until
+        then."""
+        if not self._device_valid:
+            raise _DeviceStateInvalid(
+                "device residents stale (host fallback active)"
+            )
+        graph = self.graph
+        ctx = self._prepare_patch(ls, sorted(affected_nodes))
+        if ctx is None or not self._refresh_sample_bands(
+            ctx["patched"], affected_nodes
+        ):
+            self._build(ls)
+            return None
+        patched = ctx["patched"]
+
+        raw_changed, new_out, ov_flips, changed = self._event_diff(
+            ls, affected_nodes, graph
+        )
         if not changed:
             # attribute-only event: nothing route-affecting
             self.version = ls.topology_version
@@ -2086,39 +2885,12 @@ class RouteSweepEngine(ResidentEngineContract):
                 "route_engine.structural_events"
             )
 
-        e_u = np.asarray([u for (u, _v) in changed], dtype=np.int32)
-        e_v = np.asarray([v for (_u, v) in changed], dtype=np.int32)
-        e_wo = np.asarray(
-            [wo for (wo, _wn) in changed.values()], dtype=np.int32
-        )
-        e_wn = np.asarray(
-            [wn for (_wo, wn) in changed.values()], dtype=np.int32
-        )
-        # pad the edge list to a pow2 bucket (one compiled shape per
-        # bucket, not per distinct churn size); padding edges are
-        # self-loops with INF on both sides -> never usable
-        eb = 8
-        while eb < len(e_u):
-            eb *= 2
-        pad = eb - len(e_u)
-        if pad:
-            e_u = np.concatenate([e_u, np.zeros(pad, np.int32)])
-            e_v = np.concatenate([e_v, np.zeros(pad, np.int32)])
-            e_wo = np.concatenate(
-                [e_wo, np.full(pad, INF, np.int32)]
-            )
-            e_wn = np.concatenate(
-                [e_wn, np.full(pad, INF, np.int32)]
-            )
-
-        # edge/overload uploads committed REPLICATED under a mesh (the
-        # sharded steps read them with P(None) in_specs; an unplaced
-        # upload would make XLA insert the broadcast on every dispatch)
-        up = self.plan.replicate if self.plan is not None \
-            else jnp.asarray
-        ov_new = up(patched.overloaded)
-        e_dev = (up(e_u), up(e_v), up(e_wo), up(e_wn))
+        ov_new, e_dev = self._upload_event(patched, changed)
         buckets = [b for b in _ROW_BUCKETS if b >= self._k_hint]
+        # pipelining witness: a pending delta means the PREVIOUS
+        # window's reap is still in flight while this window's
+        # dispatch submits — depth-2 double buffering
+        was_pending = self._pending is not None
         # segments: per-shard IN-FLIGHT [k+1, 1+W] device arrays (ONE
         # for the single-chip engine), each leading with its own meta
         # row [affected, changed] — the bucket k bounds the PER-SHARD
@@ -2151,6 +2923,8 @@ class RouteSweepEngine(ResidentEngineContract):
             for mrow in meta_rows:
                 da.kick_async(mrow)
             if not overlapped:
+                if was_pending:
+                    da.note_pipelined_dispatch(2)
                 # the overlap window: the PREVIOUS event's delta is
                 # consumed on host while this dispatch solves on device
                 self._consume_pending(overlap=True)
@@ -2162,8 +2936,10 @@ class RouteSweepEngine(ResidentEngineContract):
             ]
             counts = [int(m[0]) for m in metas]
             ch_counts = [int(m[1]) for m in metas]
+            # openr-lint: disable=host-branch-in-chain -- bucket-ladder retry: climbing a rung recompiles anyway, so the overflow check stays host-side (audited)
             if max(counts) <= k:
                 break
+        # openr-lint: disable=host-branch-in-chain -- bucket-ladder retry: climbing a rung recompiles anyway, so the overflow check stays host-side (audited)
         if max(counts) > k:
             # beyond every bucket: keep the patched layout and let the
             # overflow policy pick frontier re-solve vs full-width
@@ -2446,6 +3222,52 @@ def _grouped_frontier_step(
 
 
 @functools.partial(
+    jax.jit,
+    static_argnames=("meta", "n", "n_real", "max_jumps", "impl"),
+)
+def _grouped_overflow_chain(
+    v_t, w_old_t, w_new_t, dr, packed_res,
+    e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w, meta, n, n_real, max_jumps,
+    impl,
+):
+    """Grouped fused overflow chain: cone probe over the PRE-patch
+    segment slabs, on-device frontier-vs-full seed select (the same
+    collapse as _overflow_chain: full-width == frontier with an
+    all-True cone), warm grouped re-solve over the PATCHED segments,
+    extraction + delta compaction — one executable, meta riding the
+    async lane for telemetry only. Segment shapes never change under
+    grouped_patch, so this chain covers every grouped overflow."""
+    cone, rows, cells, jumps, ok = sg._grouped_cone_expand(
+        dr, meta, v_t, w_old_t, e_u, e_v, e_w_old, e_w_new, max_jumps,
+        cell_limit=cell_limit[0],
+    )
+    meta_row = jnp.stack(
+        [rows.astype(jnp.float32), cells,
+         jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+    )
+    use_frontier = jnp.logical_and(ok, cells <= cell_limit[0])
+    eff_cone = jnp.logical_or(cone, jnp.logical_not(use_frontier))
+    t_ids = jnp.arange(n, dtype=jnp.int32)
+    warm0 = jnp.where(eff_cone, INF, dr)
+    dr2 = sg._grouped_fixed_point(
+        meta, v_t, w_new_t, overloaded_new, t_ids, n, reverse=True,
+        impl=impl, init=warm0,
+    )
+    nh_count = sg._grouped_nh_counts(
+        dr2, meta, v_t, w_new_t, overloaded_new, t_ids
+    )
+    d_s, packed_mask = rs._sample_stats(
+        dr2, samp_ids, samp_v, samp_w, overloaded_new, t_ids
+    )
+    digests, packed = _pack_product(
+        dr2, nh_count, d_s, packed_mask, pos_w
+    )
+    ch_count, comp = _compact_changed_body(packed, packed_res, n_real)
+    return dr2, digests, packed, ch_count, comp, meta_row
+
+
+@functools.partial(
     jax.jit, static_argnames=("meta", "n", "max_jumps", "mesh")
 )
 def _sharded_grouped_frontier_probe(
@@ -2539,6 +3361,86 @@ def _sharded_grouped_frontier_step(
         jnp.arange(n, dtype=jnp.int32), cone, dr, *v_t, *w_t,
         overloaded, samp_ids, samp_v, samp_w, pos_w,
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("meta", "n", "n_real", "max_jumps", "mesh",
+                     "impl"),
+)
+def _sharded_grouped_overflow_chain(
+    v_t, w_old_t, w_new_t, dr, packed_res,
+    e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+    samp_ids, samp_v, samp_w, pos_w, meta, n, n_real, max_jumps,
+    mesh, impl,
+):
+    """Sharded grouped fused overflow chain — the grouped twin of
+    _sharded_overflow_chain: psum-voted per-shard probe (policy inputs
+    device-invariant, every shard takes the same seed select), warm
+    grouped re-solve over the patched replicated segments, per-shard
+    extraction, delta compaction after the shard_map in the same
+    executable."""
+    nseg = len(v_t)
+
+    def shard_fn(t_blk, dr_s, *rest):
+        v_r = rest[:nseg]
+        w_o = rest[nseg : 2 * nseg]
+        w_n = rest[2 * nseg : 3 * nseg]
+        (e_u_r, e_v_r, e_wo_r, e_wn_r, lim_r, ov_r,
+         sid_r, sv_r, sw_r, pw_r) = rest[3 * nseg :]
+        vote = lambda bit: jax.lax.psum(bit, SOURCES_AXIS)  # noqa: E731
+        cone, rows, cells, jumps, ok = sg._grouped_cone_expand(
+            dr_s, meta, v_r, w_o, e_u_r, e_v_r, e_wo_r, e_wn_r,
+            max_jumps, vote=vote, cell_limit=lim_r[0],
+        )
+        meta_row = jnp.stack(
+            [rows.astype(jnp.float32), cells,
+             jumps.astype(jnp.float32), ok.astype(jnp.float32)]
+        )
+        use_frontier = jnp.logical_and(ok, cells <= lim_r[0])
+        eff_cone = jnp.logical_or(
+            cone, jnp.logical_not(use_frontier)
+        )
+        warm0 = jnp.where(eff_cone, INF, dr_s)
+        dr2 = sg._grouped_fixed_point(
+            meta, v_r, w_n, ov_r, t_blk, n, reverse=True, vote=vote,
+            impl=impl, init=warm0,
+        )
+        nh_count = sg._grouped_nh_counts(
+            dr2, meta, v_r, w_n, ov_r, t_blk
+        )
+        d_s, packed_mask = rs._sample_stats(
+            dr2, sid_r, sv_r, sw_r, ov_r, t_blk
+        )
+        digests, packed = _pack_product(
+            dr2, nh_count, d_s, packed_mask, pw_r
+        )
+        return dr2, digests, packed, meta_row
+
+    dr2, digests, packed, meta_row = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=tuple(
+            [P(SOURCES_AXIS), P(SOURCES_AXIS, None)]
+            + [P(None, None)] * nseg
+            + [P(None, None, None)] * (2 * nseg)
+            + [P(None)] * 6
+            + [P(None), P(None, None), P(None, None), P(None)]
+        ),
+        out_specs=(
+            P(SOURCES_AXIS, None),
+            P(SOURCES_AXIS),
+            P(SOURCES_AXIS, None),
+            P(None),
+        ),
+    )(
+        jnp.arange(n, dtype=jnp.int32), dr,
+        *v_t, *w_old_t, *w_new_t,
+        e_u, e_v, e_w_old, e_w_new, cell_limit, overloaded_new,
+        samp_ids, samp_v, samp_w, pos_w,
+    )
+    ch_count, comp = _compact_changed_body(packed, packed_res, n_real)
+    return dr2, digests, packed, ch_count, comp, meta_row
 
 
 class GroupedRouteSweepEngine(RouteSweepEngine):
@@ -2640,6 +3542,13 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
         sweeper._samp_v_dev = up(samp_v)
         sweeper._samp_w_dev = up(samp_w)
         return True
+
+    def _layout_changed(self, ctx) -> bool:
+        # segment shapes never change under grouped_patch (it returns
+        # None on any layout break), so a ctx implies a stable layout;
+        # GridBand holds ndarrays, so the ELL value-compare would
+        # raise on it anyway
+        return False
 
     def _prepare_patch(self, ls, affected_sorted):
         got = sg.grouped_patch(
@@ -2840,6 +3749,56 @@ class GroupedRouteSweepEngine(RouteSweepEngine):
             ),
             dict(
                 meta=self.sweeper.meta, n=self.graph.n_pad,
+                mesh=self.mesh, impl=impl,
+            ),
+        )
+
+    @solve_window
+    def _dispatch_overflow_chain(self, ctx, e_dev, ov_new, limit):
+        """Grouped fused overflow chain: segment SHAPES never change
+        under grouped_patch, so every grouped overflow fuses — probe on
+        the pre-patch slabs, on-device seed select, warm re-solve on
+        the patched slabs, extraction + compaction in one dispatch."""
+        if ctx["patched_segs"] is None:
+            ctx["patched_segs"] = self._dispatch_patch(ctx)
+        new_w = ctx["patched_segs"]
+        e_u_d, e_v_d, e_wo_d, e_wn_d = e_dev
+        lim = jnp.asarray([limit], dtype=jnp.float32)
+        if self.plan is not None:
+            lim = self.plan.replicate(lim)
+        impl = sg.get_grouped_impl()
+        if self.mesh is None:
+            # openr-lint: disable=sharding-spec -- single-chip fused
+            # overflow chain (mesh is None): no mesh axis to spec
+            return aot_call(
+                "grouped_overflow_chain", _grouped_overflow_chain,
+                (
+                    self.sweeper.v_t, self.sweeper.w_t, new_w,
+                    self._dr, self._packed_dev,
+                    e_u_d, e_v_d, e_wo_d, e_wn_d, lim, ov_new,
+                    self.sweeper._samp_ids_dev,
+                    self.sweeper._samp_v_dev,
+                    self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+                ),
+                dict(
+                    meta=self.sweeper.meta, n=self.graph.n_pad,
+                    n_real=self.graph.n, max_jumps=_FRONTIER_MAX_JUMPS,
+                    impl=impl,
+                ),
+            )
+        return aot_call(
+            "grouped_overflow_chain_sharded",
+            _sharded_grouped_overflow_chain,
+            (
+                self.sweeper.v_t, self.sweeper.w_t, new_w,
+                self._dr, self._packed_dev,
+                e_u_d, e_v_d, e_wo_d, e_wn_d, lim, ov_new,
+                self.sweeper._samp_ids_dev, self.sweeper._samp_v_dev,
+                self.sweeper._samp_w_dev, self.sweeper._pos_w_dev,
+            ),
+            dict(
+                meta=self.sweeper.meta, n=self.graph.n_pad,
+                n_real=self.graph.n, max_jumps=_FRONTIER_MAX_JUMPS,
                 mesh=self.mesh, impl=impl,
             ),
         )

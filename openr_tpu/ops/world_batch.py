@@ -373,9 +373,13 @@ class WorldManager(ResidentEngineContract):
                     self._dispatch_launch(bucket)
                     for bucket in {t.bucket for t in pending if t.bucket}
                 ]
-                for ctx in ctxs:
-                    if ctx is not None:
-                        self._dispatch_finish(ctx)
+                ctxs = [ctx for ctx in ctxs if ctx is not None]
+                if len(ctxs) > 1:
+                    da.note_pipelined_dispatch(len(ctxs))
+                for i, ctx in enumerate(ctxs):
+                    if i + 1 < len(ctxs):
+                        da.note_overlapped_reap()
+                    self._dispatch_finish(ctx)
             except Exception as exc:  # noqa: BLE001 - loss triage below
                 if not is_device_loss(exc) or recoveries >= 2:
                     raise
@@ -386,6 +390,106 @@ class WorldManager(ResidentEngineContract):
     def solve_view(self, tenant_id: str, ls, root: str,
                    override: Optional[Dict[str, bool]] = None):
         return self.solve_views([(tenant_id, ls, root, override)])[0]
+
+    def solve_views_pipelined(self, batches) -> List[List[Tuple]]:
+        """Pipelined multi-batch front end: batch i+1's bucket
+        dispatches are SUBMITTED before batch i's readbacks are
+        reaped, so the whole burst of solve waves costs one drain of
+        host turnarounds instead of one per batch. ``batches`` is a
+        sequence of ``solve_views`` item lists; returns the aligned
+        per-batch view lists, bit-identical to calling ``solve_views``
+        per batch in order.
+
+        Hazard rule (the slot-reuse seam): a batch whose placement or
+        re-dispatch would touch a bucket with an in-flight readback
+        drains the pipeline first — an eviction or journal re-emission
+        under an unreaped dispatch would misattribute the compacted
+        delta fan-out. Same-ls batches therefore pipeline only when
+        their tenants land in disjoint shape buckets; the degenerate
+        sequential order is always correct, never silent (the drain
+        just shortens)."""
+        batches = [list(b) for b in batches]
+        results: List[Optional[List[Tuple]]] = [None] * len(batches)
+        if not batches:
+            return []
+        with da.pipeline_drain("world_drain"):
+            # in-flight entries: (batch index, synced tenants, launch
+            # contexts whose readbacks have not been reaped yet)
+            inflight: List[Tuple[int, list, list]] = []
+            try:
+                for bi, items in enumerate(batches):
+                    tenants = []
+                    for item in items:
+                        tid, ls, root = item[0], item[1], item[2]
+                        override = item[3] if len(item) > 3 else None
+                        tenants.append(
+                            self._sync(tid, ls, root, override)
+                        )
+                    pending = [t for t in tenants if t.needs_solve]
+                    busy = {
+                        id(ctx[0])
+                        for _pbi, _tn, ctxs in inflight
+                        for ctx in ctxs
+                    }
+                    if busy and any(
+                        id(self._buckets.get(t.dims)) in busy
+                        or (t.bucket is not None and id(t.bucket) in busy)
+                        for t in pending
+                    ):
+                        self._drain_inflight(inflight, results)
+                    for t in pending:
+                        self._ensure_resident(t)
+                    if any(t.slot is None for t in pending):
+                        # a batch wider than its bucket needs the
+                        # multi-wave loop; that loop reuses slots, so
+                        # it owns the whole device alone
+                        self._drain_inflight(inflight, results)
+                        self._solve_waves(tenants, pending)
+                        results[bi] = [t.view() for t in tenants]
+                        da.note_window()
+                        continue
+                    ctxs = [
+                        ctx
+                        for ctx in (
+                            self._dispatch_launch(bucket)
+                            for bucket in {
+                                t.bucket for t in pending if t.bucket
+                            }
+                        )
+                        if ctx is not None
+                    ]
+                    if ctxs and inflight:
+                        da.note_pipelined_dispatch(len(inflight) + 1)
+                    inflight.append((bi, tenants, ctxs))
+                    da.note_window()
+                self._drain_inflight(inflight, results)
+            except Exception as exc:  # noqa: BLE001 - loss triage below
+                if not is_device_loss(exc):
+                    raise
+                # the in-flight contexts died with the device; recovery
+                # demotes everyone to host snapshots and the stragglers
+                # re-solve sequentially below (warm rehydration)
+                inflight.clear()
+                self._recover_device_loss()
+        for bi, items in enumerate(batches):
+            if results[bi] is None:
+                results[bi] = self.solve_views(items)
+        self._enforce_residency()
+        self._update_gauges()
+        return results
+
+    def _drain_inflight(self, inflight, results) -> None:
+        """Reap every in-flight launch in submission order and settle
+        its batch's views. Reaps drained while later batches' launches
+        are still in flight are the double-buffer overlap the
+        accounting witnesses."""
+        while inflight:
+            bi, tenants, ctxs = inflight.pop(0)
+            for ctx in ctxs:
+                if inflight:
+                    da.note_overlapped_reap()
+                self._dispatch_finish(ctx)
+            results[bi] = [t.view() for t in tenants]
 
     def ksp2_view(self, tenant_id: str, dsts: Sequence[str]):
         """Second-path (KSP2) view for a SOLVED tenant: first paths
@@ -1065,12 +1169,14 @@ class WorldManager(ResidentEngineContract):
         # the other buckets' still-running solves
         cnt = int(da.reap_read(ch_count, kicked=True))
         out_host = da.reap_read(out, kicked=True)
+        # openr-lint: disable=host-branch-in-chain -- post-reap settle: overflow-vs-delta here picks which already-reaped buffer to copy, not what to submit (audited)
         if cnt > cap:
             TENANCY_COUNTERS["delta_overflows"] += 1
             full = da.reap_read(packed)
             for slot, t in enumerate(bucket.tenants):
                 if t is not None:
                     t.packed_host = np.array(full[slot])
+        # openr-lint: disable=host-branch-in-chain -- post-reap settle: the count only sizes the host mirror patch (audited)
         elif cnt:
             rows = out_host[:cnt]
             slots = rows[:, 0]

@@ -321,13 +321,22 @@ class SolverService:
                     return
                 if not compact:
                     idle_ticks = 0
-                    batch = self._admit_locked()
+                    batches = [self._admit_locked()]
+                    if self._pending:
+                        # back-pressure burst: more requests than one
+                        # wave's budget. Cut the follow-on wave NOW and
+                        # pipeline it behind this one — wave N+1's
+                        # bucket dispatches submit before wave N's
+                        # readbacks reap (solve_views_pipelined), so
+                        # the burst drains at pipeline depth 2 instead
+                        # of paying a full host turnaround per wave.
+                        batches.append(self._admit_locked())
                     self._wave_active = True
             if compact:
                 self._maybe_compact()
                 continue
             try:
-                self._run_wave(batch)
+                self._run_waves(batches)
             finally:
                 with self._cv:
                     self._wave_active = False
@@ -350,38 +359,62 @@ class SolverService:
         self._placements_at_check = placements
 
     def _run_wave(self, batch: List[SolveRequest]) -> None:
-        self._waves += 1
-        self._reg.counter_bump("serve.waves")
-        items = [(r.tenant_id, r.ls, r.root) for r in batch]
-        views = errors = None
+        self._run_waves([batch])
+
+    def _run_waves(self, batches: List[List[SolveRequest]]) -> None:
+        """Solve one or more admitted waves — two or more ride the
+        tenant plane's pipelined front end, where wave N+1's dispatches
+        are submitted before wave N's readbacks land — then deliver
+        every request. Failures are relayed per request, never thrown
+        at the wave loop."""
+        views_list: Optional[List[List]] = None
+        errors = None
         try:
             with self._mgr_lock:
-                views = self._mgr.solve_views(items)
+                if len(batches) == 1:
+                    views_list = [
+                        self._mgr.solve_views(
+                            [(r.tenant_id, r.ls, r.root)
+                             for r in batches[0]]
+                        )
+                    ]
+                else:
+                    views_list = self._mgr.solve_views_pipelined(
+                        [
+                            [(r.tenant_id, r.ls, r.root) for r in b]
+                            for b in batches
+                        ]
+                    )
+                    self._reg.counter_bump("serve.pipelined_waves")
         except Exception as exc:  # noqa: BLE001 - relayed per request
             errors = exc
             self._reg.counter_bump("serve.errors")
         now = time.perf_counter()
-        for i, r in enumerate(batch):
-            if errors is not None:
-                r.deliver(error=errors)
-                continue
-            try:
-                # the disconnect seam sits AT delivery: the wave solved
-                # this tenant, but its client died before consuming —
-                # park it warm, never poison the bucket
-                fault_point(FAULT_CLIENT_DISCONNECT)
-            except FaultInjected:
-                self.detach(r.tenant_id, warm=True)
-                self._reg.counter_bump("serve.disconnect_detaches")
-                r.deliver(error=ConnectionError(
-                    f"client of {r.tenant_id!r} disconnected"
-                ))
-                continue
-            self._reg.observe(
-                f"serve.latency_ms.{r.slo}",
-                (now - r.enqueued) * 1000.0,
-            )
-            r.deliver(view=views[i])
+        for bi, batch in enumerate(batches):
+            self._waves += 1
+            self._reg.counter_bump("serve.waves")
+            views = views_list[bi] if views_list is not None else None
+            for i, r in enumerate(batch):
+                if errors is not None:
+                    r.deliver(error=errors)
+                    continue
+                try:
+                    # the disconnect seam sits AT delivery: the wave
+                    # solved this tenant, but its client died before
+                    # consuming — park it warm, never poison the bucket
+                    fault_point(FAULT_CLIENT_DISCONNECT)
+                except FaultInjected:
+                    self.detach(r.tenant_id, warm=True)
+                    self._reg.counter_bump("serve.disconnect_detaches")
+                    r.deliver(error=ConnectionError(
+                        f"client of {r.tenant_id!r} disconnected"
+                    ))
+                    continue
+                self._reg.observe(
+                    f"serve.latency_ms.{r.slo}",
+                    (now - r.enqueued) * 1000.0,
+                )
+                r.deliver(view=views[i])
         self._check_slo_triggers()
 
     @flight_callback

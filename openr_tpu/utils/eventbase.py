@@ -392,6 +392,15 @@ class AsyncDebounce:
     def is_scheduled(self) -> bool:
         return self._handle is not None and not self._handle.cancelled
 
+    def at_max_backoff(self) -> bool:
+        """True once the extension ceiling is saturated: further
+        invocations no longer push the deadline out, so a pending fire
+        time is FINAL. This is the debounce *terminal* — the window
+        where speculating on the current coalesced backlog is sound
+        under latest-wins (nothing can reopen the window, only join
+        it)."""
+        return self._backoff.at_max_backoff()
+
     @property
     def max_backoff_s(self) -> float:
         return self._backoff.max_backoff

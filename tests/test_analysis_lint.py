@@ -345,6 +345,77 @@ def test_committed_suppressed_with_reason(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# host-branch-in-chain
+# ---------------------------------------------------------------------
+
+
+def test_branch_on_reap_read_value_trips(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(meta_dev, rows_dev):
+        da.kick_async(meta_dev)
+        m = int(da.reap_read(meta_dev, kicked=True))
+        if m > 0:
+            da.count_dispatch()
+        return m
+    """)
+    hits = rule_hits(report, "host-branch-in-chain")
+    assert len(hits) == 1
+    assert "'m'" in hits[0].message
+
+
+def test_branch_taint_flows_through_assignments(tmp_path):
+    """``rows = meta[0]`` after ``meta = reap_read(...)`` carries the
+    taint; a while on the derived name is the same stall."""
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(meta_dev):
+        meta = da.reap_read(meta_dev, kicked=True)
+        rows = meta[0]
+        while rows > 4:
+            rows = rows // 2
+        return rows
+    """)
+    hits = rule_hits(report, "host-branch-in-chain")
+    assert len(hits) == 1
+    assert "while" in hits[0].message
+
+
+def test_branch_on_untainted_value_is_clean(tmp_path):
+    """Branching on host-side inputs (backlog sizes, flags) is fine —
+    only readback-derived tests break the chain. Attribute stores of
+    a reap must not taint the whole object either."""
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(self, events, meta_dev):
+        self.meta = da.reap_read(meta_dev, kicked=True)
+        if len(events) > 8:
+            da.count_dispatch()
+        if self.ready:
+            da.count_dispatch()
+        return events
+    """)
+    assert rule_hits(report, "host-branch-in-chain") == []
+
+
+def test_branch_suppressed_with_reason(tmp_path):
+    report = lint(tmp_path, COMMITTED_PREAMBLE + """
+    @committed_dispatch
+    def window(meta_dev):
+        m = int(da.reap_read(meta_dev, kicked=True))
+        # openr-lint: disable=host-branch-in-chain -- post-reap apply (audited)
+        if m:
+            return m
+        return 0
+    """)
+    assert rule_hits(report, "host-branch-in-chain") == []
+    assert any(
+        f.rule == "host-branch-in-chain" and f.suppressed
+        for f in report.findings
+    )
+
+
+# ---------------------------------------------------------------------
 # lock-order
 # ---------------------------------------------------------------------
 

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Pipelined event-window gate (``make pipeline-smoke``) and report
+artifact.
+
+Exercises the PR 16 pipelining plane end to end on a 3-pod fat-tree:
+multi-event bursts whose committed dispatches submit back to back
+under one ``pipeline_drain`` (window N+1 on the stream before window
+N's reap lands), plus the speculative dispatch path (stage the
+debounce backlog's most-likely composition, adopt on match, cancel on
+mismatch). Fails loudly if the pipeline contract regressed:
+
+- TOUCH-PER-DRAIN BUDGET: a warm multi-event burst costs at most 2
+  host touches for the WHOLE drain (one submit run, one settle run),
+  zero blocking syncs, with ``ops.pipelined_dispatches`` witnessing
+  that depth >= 2 actually happened and ``ops.windows_per_drain``
+  matching the burst size,
+- SPEC-CANCEL PARITY: a speculation staged for one composition and
+  then invalidated by a different final backlog must be CANCELLED
+  (``ops.spec_cancels`` climbs, never silent) and the committed
+  replay must be bit-identical to the sequential oracle; a matching
+  composition must ADOPT (``ops.spec_hits``) with the same parity,
+- COMPILE FLATNESS: warm bursts at pipeline depths 1, 2 and 3 must
+  cost ZERO AOT compiles and ZERO backend jit compiles — pipelining
+  reuses the same per-(tag, bucket) executables as the eager path.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_pipeline_smoke.json``); exit 0 on pass, 1 with a
+reason list on fail. Runs CPU-pinned — this gates the dispatch
+pipeline contract, not device throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/pipeline_smoke.py) in addition
+# to module mode (python -m tools.pipeline_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load(topo):
+    from openr_tpu.graph.linkstate import LinkState
+
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _mutate_metric(ls, node, i, metric):
+    from dataclasses import replace
+
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+SEQ = (7, 3, 11, 5)
+
+
+def _safe_edges(ls, sample_names, count):
+    """(node, slot) pairs whose BOTH endpoints avoid the engine's
+    sample nodes: a window touching a sample node's adjacencies
+    deliberately refuses speculation/bursting (the sample-band refresh
+    mutates sweeper state early), so the smoke must churn elsewhere to
+    exercise the pipelined path."""
+    out = []
+    sample = set(sample_names)
+    for node in sorted(ls.get_adjacency_databases().keys()):
+        if node in sample:
+            continue
+        adjs = ls.get_adjacency_databases()[node].adjacencies
+        for i, a in enumerate(adjs):
+            if a.other_node_name in sample:
+                continue
+            out.append((node, i))
+            break  # one slot per node keeps the sets disjoint
+        if len(out) == count:
+            return out
+    raise RuntimeError("topology too small for sample-free churn set")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--out", default="/tmp/openr_tpu_pipeline_smoke.json",
+        help="JSON artifact path",
+    )
+    args = ap.parse_args()
+
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import dispatch_accounting as da
+    from openr_tpu.ops import route_engine, route_sweep
+    from openr_tpu.telemetry import get_registry
+
+    failures: list = []
+    report: dict = {"gates": {}}
+    reg = get_registry()
+
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = _load(topo)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+    # three churn edges clear of the sample band: a window touching a
+    # sample node's adjacencies refuses to speculate/burst by design
+    (e0, e1, e2) = _safe_edges(ls, engine.sample_names, 3)
+
+    # -- warmup: compile the chain (eager) and the burst path once -----
+    for metric in SEQ:
+        engine.churn(ls, _mutate_metric(ls, rsw, 0, metric))
+    engine.churn_burst(ls, [
+        lambda: _mutate_metric(ls, e0[0], e0[1], 4),
+        lambda: _mutate_metric(ls, e1[0], e1[1], 6),
+    ])
+    report["warmup_aot_compiles"] = reg.counter_get("ops.aot_compiles")
+
+    # -- gate: touch-per-drain budget on a warm depth-3 burst ----------
+    compiles0 = reg.counter_get("ops.aot_compiles")
+    jax0 = reg.counter_get("jax.compile_count")
+    piped0 = reg.counter_get("ops.pipelined_dispatches")
+    drains = []
+    for depth, metrics in ((1, (8,)), (2, (9, 12)), (3, (13, 5, 7))):
+        events = []
+        for k, metric in enumerate(metrics):
+            node, slot = (e0, e1, e2)[k]
+            events.append(
+                lambda n=node, s=slot, m=metric:
+                _mutate_metric(ls, n, s, m)
+            )
+        with da.pipeline_drain("smoke_drain") as w:
+            engine.churn_burst(ls, events)
+        drains.append({
+            "burst_size": depth,
+            "touches": w.touches,
+            "windows": w.windows,
+            "blocking_syncs": w.blocking_syncs,
+        })
+        if w.touches > 2:
+            failures.append(
+                f"warm burst of {depth} window(s) took {w.touches} "
+                "host touches (budget is 2 per DRAIN: one submit run, "
+                "one settle run)"
+            )
+        if w.blocking_syncs:
+            failures.append(
+                f"warm burst of {depth} window(s) paid "
+                f"{w.blocking_syncs} blocking sync(s)"
+            )
+        if w.windows != depth:
+            failures.append(
+                f"drain folded {w.windows} window(s), expected {depth} "
+                "(ops.windows_per_drain accounting drifted)"
+            )
+    pipelined_delta = reg.counter_get("ops.pipelined_dispatches") - piped0
+    if pipelined_delta < 3:  # depth-2 burst: 1 witness; depth-3: 2
+        failures.append(
+            "multi-window bursts did not witness pipelined dispatches "
+            f"(ops.pipelined_dispatches +{pipelined_delta}, expected "
+            ">= 3): window N+1 must submit before window N's reap"
+        )
+    report["gates"]["touch_per_drain_budget"] = not any(
+        "touches" in f or "blocking" in f or "drain folded" in f
+        for f in failures
+    )
+    report["gates"]["pipelined_dispatch_witness"] = pipelined_delta >= 3
+    report["drains"] = drains
+
+    # -- gate: compile flatness across pipeline depths -----------------
+    compile_delta = reg.counter_get("ops.aot_compiles") - compiles0
+    jax_delta = reg.counter_get("jax.compile_count") - jax0
+    if compile_delta:
+        failures.append(
+            f"warm bursts AOT-compiled {compile_delta} time(s); "
+            "pipelining must reuse the eager path's executables"
+        )
+    if jax_delta:
+        failures.append(
+            f"warm bursts triggered {jax_delta} backend jit compile(s)"
+        )
+    report["gates"]["compile_flatness"] = (
+        compile_delta == 0 and jax_delta == 0
+    )
+    report["warm"] = {
+        "aot_compile_delta": compile_delta,
+        "jax_compile_delta": jax_delta,
+        "pipelined_dispatches": pipelined_delta,
+    }
+
+    # -- gate: pipelined == eager-sequential oracle, bit for bit -------
+    got = route_sweep.digests_by_name(engine.result)
+    oracle = route_sweep.digests_by_name(
+        route_sweep.all_sources_route_sweep(ls, [names[0]], block=64)
+    )
+    if got != oracle:
+        bad = sorted(n for n in oracle if got.get(n) != oracle[n])
+        failures.append(
+            f"pipelined result diverged from oracle at {len(bad)} "
+            f"node(s): {bad[:5]}"
+        )
+    report["gates"]["oracle_parity"] = got == oracle
+
+    # -- gate: speculation hit AND cancel, both bit-identical ----------
+    ls_a, ls_b = _load(topo), _load(topo)
+    seq_eng = route_engine.RouteSweepEngine(ls_a, [names[0]])
+    spec_eng = route_engine.RouteSweepEngine(ls_b, [names[0]])
+    for metric in SEQ:  # warm both
+        seq_eng.churn(ls_a, _mutate_metric(ls_a, rsw, 0, metric))
+        spec_eng.churn(ls_b, _mutate_metric(ls_b, rsw, 0, metric))
+    hits0 = reg.counter_get("ops.spec_hits")
+    cancels0 = reg.counter_get("ops.spec_cancels")
+
+    # HIT: speculate the exact final composition, then deliver it
+    aff_a = _mutate_metric(ls_a, e0[0], e0[1], 9)
+    aff_b = _mutate_metric(ls_b, e0[0], e0[1], 9)
+    spec_eng.speculate_churn(ls_b, [aff_b])
+    spec_eng.churn_window(ls_b, [aff_b])
+    seq_eng.churn(ls_a, aff_a)
+    hit_delta = reg.counter_get("ops.spec_hits") - hits0
+    hit_parity = (
+        route_sweep.digests_by_name(spec_eng.result)
+        == route_sweep.digests_by_name(seq_eng.result)
+    )
+    if hit_delta < 1:
+        failures.append(
+            "matching speculation was not adopted (ops.spec_hits flat)"
+        )
+    if not hit_parity:
+        failures.append(
+            "adopted speculation diverged from the sequential oracle"
+        )
+
+    # CANCEL: speculate one composition, then grow the backlog — the
+    # mismatch must cancel (counted) and the committed replay must
+    # still equal the sequential chain
+    aff_b1 = _mutate_metric(ls_b, e0[0], e0[1], 11)
+    spec_eng.speculate_churn(ls_b, [aff_b1])
+    aff_b2 = _mutate_metric(ls_b, e1[0], e1[1], 4)
+    spec_eng.churn_window(ls_b, [aff_b1, aff_b2])
+    aff_a1 = _mutate_metric(ls_a, e0[0], e0[1], 11)
+    aff_a2 = _mutate_metric(ls_a, e1[0], e1[1], 4)
+    seq_eng.churn_window(ls_a, [aff_a1, aff_a2])
+    cancel_delta = reg.counter_get("ops.spec_cancels") - cancels0
+    cancel_parity = (
+        route_sweep.digests_by_name(spec_eng.result)
+        == route_sweep.digests_by_name(seq_eng.result)
+    )
+    if cancel_delta < 1:
+        failures.append(
+            "mismatched speculation was not cancelled "
+            "(ops.spec_cancels flat): misses must never be silent"
+        )
+    if not cancel_parity:
+        failures.append(
+            "cancelled speculation's committed replay diverged from "
+            "the sequential oracle"
+        )
+    report["gates"]["spec_hit_parity"] = hit_delta >= 1 and hit_parity
+    report["gates"]["spec_cancel_parity"] = (
+        cancel_delta >= 1 and cancel_parity
+    )
+    report["speculation"] = {
+        "spec_hits_delta": hit_delta,
+        "spec_cancels_delta": cancel_delta,
+    }
+
+    report["counters"] = {
+        k: reg.counter_get(k)
+        for k in (
+            "ops.host_dispatches", "ops.blocking_syncs",
+            "ops.async_reaps", "ops.pipeline_drains",
+            "ops.pipelined_dispatches", "ops.overlapped_reaps",
+            "ops.spec_dispatches", "ops.spec_hits",
+            "ops.spec_cancels", "ops.spec_skips",
+            "ops.burst_cancels",
+            "ops.aot_compiles", "ops.aot_hits", "jax.compile_count",
+        )
+    }
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("PIPELINE SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"pipeline smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
