@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke replay-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke serve-smoke obs-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke serve-smoke obs-smoke replay-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -166,6 +166,16 @@ serve-smoke: native
 # dispatch accounting. See docs/RUNBOOK.md "Post-mortem triage".
 obs-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.obs_smoke --out /tmp/openr_tpu_obs_smoke.json
+
+# incident-replay gate (openr_tpu.twin.replay): a seeded flap-free
+# churn storm + forced micro-loop must dump a self-contained bundle
+# (journal slice + verifying LSDB anchor) that a FRESH OS process
+# replays to the same anomaly class with bit-identical per-vantage
+# route digests twice in a row and parity vs the live twin at dump
+# time. --nodes 1008 is the acceptance-scale run on real hardware.
+# See docs/RUNBOOK.md "Replay an incident".
+replay-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.replay_smoke --out /tmp/openr_tpu_replay_smoke.json
 
 # the official reconvergence benchmark (one JSON line; probes the real
 # accelerator with retries, degrades to CPU with evidence)

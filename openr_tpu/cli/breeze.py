@@ -396,7 +396,14 @@ class Breeze:
                 "dump_postmortem", trigger="manual",
                 reason="breeze monitor flight --dump",
             )
-            self._print(f"post-mortem bundle: {out.get('path')}")
+            path = out.get("path")
+            if path:
+                self._print(f"post-mortem bundle: {path}")
+            else:
+                self._print(
+                    "post-mortem dump produced no bundle (rate-limited,"
+                    " disabled, or write failed server-side)"
+                )
             return
         rec = self.client.call("get_flight_record", limit=limit)
         if fmt == "json":
@@ -430,6 +437,51 @@ class Breeze:
             f"host_overhead_ratio={rec['host_overhead_ratio']} "
             f"triggers={','.join(rec['triggers']) or '(none)'}"
         )
+
+    def monitor_replay(self, bundle: str, as_json: bool = False,
+                       backend: str = "device",
+                       twice: bool = False) -> None:
+        """LOCAL command (no daemon dial): deterministically re-run a
+        post-mortem bundle's captured churn through a fresh FabricTwin
+        and print the verdict — the bundle is self-contained, so this
+        works on any box with the repo, not just the one that dumped
+        it. ``--twice`` replays twice and checks the per-vantage route
+        digests are bit-identical across runs."""
+        from openr_tpu.twin.replay import ScenarioReplayer, replay_digest
+
+        verdict = ScenarioReplayer.from_path(
+            bundle, solver_backend=backend
+        ).replay()
+        deterministic = None
+        if twice:
+            second = ScenarioReplayer.from_path(
+                bundle, solver_backend=backend
+            ).replay()
+            deterministic = (
+                replay_digest(verdict) == replay_digest(second)
+            )
+        if as_json:
+            out = verdict.to_dict()
+            out["deterministic"] = deterministic
+            self._print(json.dumps(out, indent=2, sort_keys=True))
+            return
+        self._print(
+            f"reproduced={verdict.reproduced} "
+            f"recorded={sorted(verdict.recorded_classes)} "
+            f"replayed={sorted(verdict.replayed_classes)}"
+        )
+        self._print(
+            f"windows={verdict.windows} pubs={verdict.pubs_applied} "
+            f"trailing_pubs={verdict.trailing_pubs} "
+            f"anchor_moved={verdict.anchor_moved} "
+            f"digests_match_recorded={verdict.digests_match_recorded}"
+        )
+        if deterministic is not None:
+            self._print(f"deterministic={deterministic}")
+        for d in verdict.divergence[:10]:
+            self._print(f"  divergence: {json.dumps(d, sort_keys=True)}")
+        for e in verdict.errors:
+            self._print(f"  error: {e}")
 
     # -- openr ------------------------------------------------------------
 
@@ -700,6 +752,11 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("table", "json"),
         default="table",
     )
+    replay = m.add_parser("replay")
+    replay.add_argument("bundle")
+    replay.add_argument("--json", dest="as_json", action="store_true")
+    replay.add_argument("--backend", default="device")
+    replay.add_argument("--twice", action="store_true")
 
     o = group("openr")
     o.add_parser("version")
@@ -728,15 +785,16 @@ def build_parser() -> argparse.ArgumentParser:
 
 def run(argv: List[str], client=None, out=None) -> int:
     args = build_parser().parse_args(argv)
-    if client is None:
-        from openr_tpu.ctrl.server import CtrlClient
-
-        client = CtrlClient(args.host, args.port)
-    breeze = Breeze(client, out=out)
     group = args.group.replace("-", "_")
     command = getattr(args, "command", "").replace("-", "_") if hasattr(
         args, "command"
     ) else ""
+    local = group == "monitor" and command == "replay"
+    if client is None and not local:
+        from openr_tpu.ctrl.server import CtrlClient
+
+        client = CtrlClient(args.host, args.port)
+    breeze = Breeze(client, out=out)
 
     dispatch: Dict[str, Callable[[], None]] = {
         "config.show": breeze.config_show,
@@ -805,6 +863,9 @@ def run(argv: List[str], client=None, out=None) -> int:
         ),
         "monitor.flight": lambda: breeze.monitor_flight(
             args.limit, args.dump, args.fmt
+        ),
+        "monitor.replay": lambda: breeze.monitor_replay(
+            args.bundle, args.as_json, args.backend, args.twice
         ),
         "openr.version": breeze.openr_version,
         "openr.config": breeze.openr_config,

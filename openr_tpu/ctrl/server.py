@@ -39,6 +39,16 @@ def current_connection() -> Optional[int]:
     return getattr(_CONN, "conn_id", None)
 
 
+def current_trace_context() -> Optional[Dict[str, Any]]:
+    """The caller-stamped trace context of the frame being dispatched
+    (None when the client sent none, or outside a dispatch). A client
+    that wants end-to-end attribution adds a top-level ``"trace"``
+    object — ``{"trace_id", "span_id", "origin"}`` — to its request
+    frame; handlers adopt it into their own spans so a client-observed
+    latency breach can be chased through the service's wave records."""
+    return getattr(_CONN, "trace_ctx", None)
+
+
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     payload = json.dumps(obj).encode("utf-8")
     sock.sendall(struct.pack(">I", len(payload)) + payload)
@@ -197,6 +207,11 @@ class CtrlServer:
     def _dispatch(self, sock: socket.socket, request: Dict) -> None:
         method_name = request.get("method", "")
         kwargs = request.get("kwargs", {})
+        # cross-wire trace propagation: an extra top-level "trace" key
+        # rides the frame (ignored by older servers) and is visible to
+        # the handler for the duration of this dispatch
+        trace_ctx = request.get("trace")
+        _CONN.trace_ctx = trace_ctx if isinstance(trace_ctx, dict) else None
         method = getattr(self.handler, method_name, None)
         if method is None or method_name.startswith("_"):
             _send_frame(sock, {"ok": False, "error": f"no method {method_name}"})
@@ -209,6 +224,8 @@ class CtrlServer:
             _send_frame(sock, {"ok": True, "result": to_jsonable(result)})
         except Exception as e:  # noqa: BLE001 - relayed to client
             _send_frame(sock, {"ok": False, "error": repr(e)})
+        finally:
+            _CONN.trace_ctx = None
 
     def _stream(self, sock: socket.socket, method, kwargs: Dict) -> None:
         try:

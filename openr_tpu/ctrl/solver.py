@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from openr_tpu.ctrl.server import current_connection
+from openr_tpu.ctrl.server import current_connection, current_trace_context
 from openr_tpu.faults import fault_point
 from openr_tpu.graph.linkstate import LinkState
 from openr_tpu.serve.service import FAULT_SLOW_CLIENT, SolverService
@@ -112,7 +112,8 @@ class SolverCtrlHandler:
             if root is None:
                 root = sorted(ls.get_adjacency_databases())[0]
         graph, srcs, packed = self._svc.solve(
-            tenant_id, ls, root, timeout=timeout
+            tenant_id, ls, root, timeout=timeout,
+            trace_ctx=current_trace_context(),
         )
         # slow-client seam: a delay schedule armed here models a
         # client draining its reply slowly — only this connection
@@ -176,7 +177,13 @@ class SolverCtrlHandler:
                         reason: str = "") -> Dict:
         from openr_tpu.telemetry import get_flight_recorder
 
+        reason = reason or "operator request"
+        ctx = current_trace_context()
+        if ctx and ctx.get("span_id"):
+            # stamp the requesting client's span so the bundle pairs
+            # with the client-side observation that asked for it
+            reason = f"{reason} [client span {ctx['span_id']}]"
         path = get_flight_recorder().dump_postmortem(
-            trigger=trigger, reason=reason or "operator request"
+            trigger=trigger, reason=reason
         )
         return {"path": path}

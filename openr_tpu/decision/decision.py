@@ -22,6 +22,7 @@ The solver behind it runs the TPU kernels (see spf_solver.py).
 
 from __future__ import annotations
 
+import base64
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Set, Tuple
@@ -201,6 +202,18 @@ class Decision:
         # warm material is snapshotted after each debounced rebuild and
         # warm_boot() rehydrates from its recover() result
         self._state_plane = state_plane
+        # incident replay plane: a Decision that owns a state plane IS
+        # the durable production pipeline, so its adopted post-CRDT
+        # publications feed the flight recorder's event journal and its
+        # WAL position anchors every post-mortem bundle. Memory-only
+        # Decisions (tests, oracles) stay out of the shared journal.
+        self._flight_journal = state_plane is not None
+        if self._flight_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().set_anchor_provider(
+                state_plane.flight_anchor
+            )
         self._enable_rib_policy = enable_rib_policy
         self.my_node_name = my_node_name
         self.evb = OpenrEventBase(name=f"decision:{my_node_name}")
@@ -429,6 +442,8 @@ class Decision:
                         ),
                         adj_db.perf_events,
                     )
+                    if self._flight_journal:
+                        self._journal_adopted(area, key, value, pub)
                     if (
                         self._enable_ordered_fib
                         and link_state.has_holds()
@@ -448,6 +463,8 @@ class Decision:
                         self.prefix_state.update_prefix_database(node_db),
                         prefix_db.perf_events,
                     )
+                    if self._flight_journal:
+                        self._journal_adopted(area, key, value, pub)
                 elif keyutil.is_fib_time_key(key):
                     try:
                         self.fib_times[node_name] = float(
@@ -475,6 +492,27 @@ class Decision:
                 self.pending.apply_prefix_state_change(
                     self.prefix_state.update_prefix_database(node_db)
                 )
+
+    def _journal_adopted(
+        self, area: str, key: str, value, pub: Publication
+    ) -> None:
+        """Feed one adopted post-CRDT key into the flight recorder's
+        event journal (the incident replay plane). The serialized value
+        is the post-merge winner — replaying the journal over the
+        bundle's anchor is exactly the state plane's recovery fold."""
+        from openr_tpu.telemetry.flight import get_flight_recorder
+
+        fr = get_flight_recorder()
+        if not fr.enabled or value.value is None:
+            return
+        fr.journal_note(
+            area,
+            key,
+            value_b64=base64.b64encode(value.value).decode("ascii"),
+            version=value.version,
+            originator=value.originator_id,
+            trace_id=getattr(pub.trace, "trace_id", None),
+        )
 
     def _update_node_prefix_db(
         self, key: str, prefix_db: PrefixDatabase, area: str
@@ -570,6 +608,16 @@ class Decision:
     def _on_debounce_fire(self) -> None:
         self._spec_fired_this_window = False
         self.rebuild_routes("DECISION_DEBOUNCE")
+        # debounce terminal: close the journal's replay window — every
+        # pub adopted since the previous mark rode THIS rebuild
+        if self._flight_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().journal_mark(
+                "wave",
+                window="DECISION_DEBOUNCE",
+                vantages=[self.my_node_name],
+            )
         # snapshot AFTER the solve window closes: the capture reads the
         # resident distance rows back to host
         if self._state_plane is not None:

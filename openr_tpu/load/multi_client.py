@@ -106,9 +106,15 @@ def run_client(
         "ksp2": {},
         "errors": [],
         "rounds": 0,
+        "trace_id": None,
+        "span_ids": [],
     }
     try:
         client = SolverClient(host, port)
+        # reported back so the parent gate can check cross-wire trace
+        # continuity: these ids must surface in the SERVICE's wave
+        # flight records
+        result["trace_id"] = client.trace_id
         worlds = {}
         for sd in specs:
             spec = TenantSpec(**sd)
@@ -141,6 +147,7 @@ def run_client(
                         _digest_text(json.dumps(paths, sort_keys=True))
                     )
             result["rounds"] = i + 1
+        result["span_ids"] = list(client.span_ids)
         if hold_open_s > 0:
             time.sleep(hold_open_s)
         client.close()

@@ -16,15 +16,23 @@ base64 int32 packed blocks decoded into ``SolverView``.
 from __future__ import annotations
 
 import base64
+import itertools
 import json
+import os
 import socket
 import struct
+import time
+from collections import deque
 from typing import Dict, Iterable, List, Optional
 
 import numpy as np
 
 from openr_tpu.types.lsdb import AdjacencyDatabase
 from openr_tpu.utils import wire
+
+# distinct trace ids across many clients in one process (the load
+# driver spawns several per worker)
+_CLIENT_SEQ = itertools.count(1)
 
 
 def _send_frame(sock: socket.socket, obj) -> None:
@@ -83,22 +91,96 @@ class SolverView:
 class SolverClient:
     """One TCP connection to a ``SolverService``; every tenant
     registered through it is tied to this connection server-side (a
-    disconnect parks them warm)."""
+    disconnect parks them warm).
+
+    Cross-wire tracing: every request frame carries a top-level
+    ``"trace"`` object (trace id stable per client, span id fresh per
+    call) that the service adopts into its wave spans and flight
+    records — a client-observed latency anomaly is chaseable to the
+    exact service wave that served it. The client also keeps a rolling
+    solve-latency window; a p99 breach against its own EWMA baseline
+    (``breach_factor`` x, absolute ``breach_floor_ms``) fires a
+    service-side ``dump_postmortem`` over the same wire, stamped with
+    the breaching span id. Pass ``breach_factor=None`` to disarm."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 2018,
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0,
+                 breach_factor: Optional[float] = 4.0,
+                 breach_min_samples: int = 64,
+                 breach_floor_ms: float = 50.0):
         self._sock = socket.create_connection(
             (host, port), timeout=timeout_s
         )
+        self._trace_id = f"sc-{os.getpid():x}-{next(_CLIENT_SEQ):x}"
+        self._span_seq = itertools.count(1)
+        self.last_span_id: Optional[str] = None
+        self.span_ids: deque = deque(maxlen=1024)
+        self._breach_factor = breach_factor
+        self._breach_min_samples = max(8, int(breach_min_samples))
+        self._breach_floor_ms = breach_floor_ms
+        self._lat_ring: deque = deque(maxlen=256)
+        self._breach_baseline: Optional[float] = None
+        self.breaches = 0
+
+    @property
+    def trace_id(self) -> str:
+        return self._trace_id
+
+    def _next_trace(self, method: str) -> Dict:
+        span_id = f"{self._trace_id}.{next(self._span_seq):x}"
+        self.last_span_id = span_id
+        self.span_ids.append(span_id)
+        return {
+            "trace_id": self._trace_id,
+            "span_id": span_id,
+            "origin": "solver_client",
+            "method": method,
+        }
 
     def _call(self, method: str, **kwargs):
-        _send_frame(self._sock, {"method": method, "kwargs": kwargs})
+        _send_frame(self._sock, {
+            "method": method,
+            "kwargs": kwargs,
+            "trace": self._next_trace(method),
+        })
         reply = _recv_frame(self._sock)
         if reply is None:
             raise ConnectionError("solver service closed connection")
         if not reply.get("ok"):
             raise RuntimeError(reply.get("error", "unknown error"))
         return reply.get("result")
+
+    # -- client-observed p99 breach watch ------------------------------
+
+    def _observe_solve_latency(self, ms: float) -> None:
+        if self._breach_factor is None:
+            return
+        self._lat_ring.append(ms)
+        if len(self._lat_ring) < self._breach_min_samples:
+            return
+        ordered = sorted(self._lat_ring)
+        p99 = ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+        if self._breach_baseline is None:
+            self._breach_baseline = p99
+            return
+        baseline = self._breach_baseline
+        threshold = max(self._breach_floor_ms,
+                        self._breach_factor * baseline)
+        self._breach_baseline = 0.9 * baseline + 0.1 * p99
+        if p99 > threshold:
+            # re-baseline: one sustained regression fires once, and the
+            # service-side rate limiter bounds a fleet of clients
+            self._breach_baseline = p99
+            self.breaches += 1
+            try:
+                self.dump_postmortem(
+                    trigger="client_p99_breach",
+                    reason=(f"client-observed p99 {p99:.2f}ms > "
+                            f"{self._breach_factor:g}x baseline "
+                            f"{baseline:.2f}ms; trace {self.last_span_id}"),
+                )
+            except (RuntimeError, ConnectionError, OSError):
+                pass  # a breach report must never break the solve path
 
     # -- surface -----------------------------------------------------------
 
@@ -131,9 +213,12 @@ class SolverClient:
 
     def solve(self, tenant_id: str,
               timeout: float = 60.0) -> SolverView:
-        return SolverView(self._call(
+        t0 = time.perf_counter()
+        view = SolverView(self._call(
             "solver_solve", tenant_id=tenant_id, timeout=timeout
         ))
+        self._observe_solve_latency((time.perf_counter() - t0) * 1000.0)
+        return view
 
     def ksp2(self, tenant_id: str, dsts: List[str]) -> Dict:
         return self._call(
@@ -147,6 +232,15 @@ class SolverClient:
 
     def counters(self) -> Dict:
         return self._call("solver_counters")
+
+    def dump_postmortem(self, trigger: str = "manual",
+                        reason: str = "") -> Dict:
+        """Ask the SERVICE to cut a post-mortem bundle (the breach
+        watch calls this with the breaching trace stamped into the
+        reason, so the bundle pairs with the client's observation)."""
+        return self._call(
+            "dump_postmortem", trigger=trigger, reason=reason
+        )
 
     def close(self) -> None:
         try:

@@ -63,6 +63,7 @@ from openr_tpu.telemetry import (
     get_flight_recorder,
     get_profiler,
     get_registry as _get_registry,
+    get_tracer,
     install_default_triggers,
 )
 
@@ -78,16 +79,20 @@ class SolveRequest:
 
     __slots__ = (
         "tenant_id", "ls", "root", "slo", "seq", "enqueued",
-        "event", "view", "error", "superseded",
+        "event", "view", "error", "superseded", "trace_ctx",
     )
 
     def __init__(self, tenant_id: str, ls, root: str, slo: str,
-                 seq: int):
+                 seq: int, trace_ctx: Optional[Dict] = None):
         self.tenant_id = tenant_id
         self.ls = ls
         self.root = root
         self.slo = slo
         self.seq = seq
+        # client-stamped trace context off the wire ({"trace_id",
+        # "span_id", ...}): adopted into the wave span + flight record
+        # that serve this request, closing the cross-wire trace
+        self.trace_ctx = trace_ctx
         self.enqueued = time.perf_counter()
         self.event = threading.Event()
         self.view = None
@@ -211,8 +216,8 @@ class SolverService:
                 )
         self._detached.discard(tenant_id)
 
-    def request_solve(self, tenant_id: str, ls,
-                      root: str) -> SolveRequest:
+    def request_solve(self, tenant_id: str, ls, root: str,
+                      trace_ctx: Optional[Dict] = None) -> SolveRequest:
         """Enqueue (or supersede) the tenant's pending solve; returns
         the request whose ``wait()`` yields the view. Arrivals during
         an in-flight wave are the continuous-batching case — they ride
@@ -222,6 +227,7 @@ class SolverService:
             r = SolveRequest(
                 tenant_id, ls, root,
                 self._mgr.slo_class(tenant_id), self._seq,
+                trace_ctx=trace_ctx,
             )
             old = self._pending.get(tenant_id)
             if old is not None:
@@ -238,9 +244,12 @@ class SolverService:
         return r
 
     def solve(self, tenant_id: str, ls, root: str,
-              timeout: float = 60.0):
+              timeout: float = 60.0,
+              trace_ctx: Optional[Dict] = None):
         """Blocking convenience wrapper: enqueue + wait for the wave."""
-        return self.request_solve(tenant_id, ls, root).wait(timeout)
+        return self.request_solve(
+            tenant_id, ls, root, trace_ctx=trace_ctx
+        ).wait(timeout)
 
     def ksp2(self, tenant_id: str, dsts: Sequence[str]):
         """Second-path view for a solved tenant (the tenant plane's
@@ -366,9 +375,24 @@ class SolverService:
         tenant plane's pipelined front end, where wave N+1's dispatches
         are submitted before wave N's readbacks land — then deliver
         every request. Failures are relayed per request, never thrown
-        at the wave loop."""
+        at the wave loop.
+
+        Cross-wire tracing: requests carrying a client-stamped trace
+        context get their span ids adopted into this wave's service
+        span and flight record, so a client-side p99 breach bundle and
+        the service wave that served it share ids."""
+        client_spans = [
+            r.trace_ctx["span_id"]
+            for b in batches
+            for r in b
+            if isinstance(r.trace_ctx, dict) and r.trace_ctx.get("span_id")
+        ]
         views_list: Optional[List[List]] = None
         errors = None
+        tracer = get_tracer()
+        trace = tracer.start(origin="serve.wave")
+        tracer.activate(trace)
+        span = tracer.span_active("serve.wave_solve")
         try:
             with self._mgr_lock:
                 if len(batches) == 1:
@@ -389,6 +413,22 @@ class SolverService:
         except Exception as exc:  # noqa: BLE001 - relayed per request
             errors = exc
             self._reg.counter_bump("serve.errors")
+        finally:
+            tracer.end_span_active(
+                span,
+                waves=len(batches),
+                requests=sum(len(b) for b in batches),
+                client_spans=client_spans[:64],
+            )
+            tracer.deactivate()
+            tracer.finish(trace)
+        get_flight_recorder().note(
+            "wave",
+            batches=len(batches),
+            requests=sum(len(b) for b in batches),
+            failed=errors is not None,
+            client_spans=client_spans[:64],
+        )
         now = time.perf_counter()
         for bi, batch in enumerate(batches):
             self._waves += 1

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 from openr_tpu.config_store.persistent_store import PersistentStore
 from openr_tpu.faults import FaultInjected, fault_point, register_fault_site
@@ -78,6 +78,31 @@ class RecoveredState:
 
 def _journal_key(seq: int) -> str:
     return f"{_JOURNAL_PREFIX}{seq:012d}"
+
+
+def replay_journal(
+    ckpt: Optional[LsdbCheckpoint],
+    records: Iterable[JournalRecord],
+) -> Dict[str, Dict[str, Value]]:
+    """The checkpoint+journal recovery fold, as a pure function: start
+    from the checkpoint LSDB (empty when None), apply every record with
+    ``seq >= ckpt.seq`` in the given order as a plain per-key overwrite
+    (post-CRDT winners are strictly newer, so overwrite IS the merge).
+
+    ``recover()`` uses it against the backing store; the incident
+    replayer (``twin/replay.py``) uses it against a post-mortem
+    bundle's anchor + journal slice — one recovery semantics, two
+    sources."""
+    lsdb: Dict[str, Dict[str, Value]] = {}
+    base_seq = 0
+    if ckpt is not None:
+        lsdb = {a: dict(kv) for a, kv in ckpt.key_vals_by_area.items()}
+        base_seq = ckpt.seq
+    for rec in records:
+        if rec is None or rec.seq < base_seq:
+            continue
+        lsdb.setdefault(rec.area, {}).update(rec.key_vals)
+    return lsdb
 
 
 class StatePlane:
@@ -188,22 +213,18 @@ class StatePlane:
         """
         reg = get_registry()
         ckpt = self._store.load(_CKPT_KEY, LsdbCheckpoint)
-        lsdb: Dict[str, Dict[str, Value]] = {}
-        base_seq = 0
-        if ckpt is not None:
-            lsdb = {a: dict(kv) for a, kv in ckpt.key_vals_by_area.items()}
-            base_seq = ckpt.seq
-        replayed = 0
-        max_seq = base_seq
+        base_seq = ckpt.seq if ckpt is not None else 0
+        journal: list = []
         for key in self._store.keys():  # sorted => seq order
             if not key.startswith(_JOURNAL_PREFIX):
                 continue
             rec = self._store.load(key, JournalRecord)
             if rec is None or rec.seq < base_seq:
                 continue
-            lsdb.setdefault(rec.area, {}).update(rec.key_vals)
-            replayed += 1
-            max_seq = max(max_seq, rec.seq + 1)
+            journal.append(rec)
+        lsdb = replay_journal(ckpt, journal)
+        replayed = len(journal)
+        max_seq = max([base_seq] + [rec.seq + 1 for rec in journal])
         engines: Dict[str, EngineSnapshot] = {}
         for key in self._store.keys():
             if key.startswith(_ENGINE_PREFIX):
@@ -228,6 +249,18 @@ class StatePlane:
     def journal_length(self) -> int:
         with self._lock:
             return self._next_seq - self._ckpt_seq
+
+    def flight_anchor(self) -> Dict[str, int]:
+        """Anchor extras for the flight recorder's post-mortem bundles
+        (installed via ``set_anchor_provider`` by a Decision that owns
+        this plane): where the durable WAL stood when the bundle was
+        cut, so an offline triager can pair the bundle with the
+        matching on-disk checkpoint."""
+        with self._lock:
+            return {
+                "state_checkpoint_seq": self._ckpt_seq,
+                "state_journal_seq": self._next_seq,
+            }
 
     def lsdb_mirror(self) -> Dict[str, Dict[str, Value]]:
         with self._lock:

@@ -41,16 +41,20 @@ from openr_tpu.telemetry.profiler import (  # noqa: F401
     reset_profiler,
 )
 from openr_tpu.telemetry.flight import (  # noqa: F401
+    BUNDLE_SCHEMA,
     CompileAfterWarmupTrigger,
     CounterDeltaTrigger,
     FlightRecorder,
     P99BreachTrigger,
+    fnv1a,
     get_flight_recorder,
     install_default_triggers,
+    load_bundle,
     reset_flight_recorder,
 )
 
 __all__ = [
+    "BUNDLE_SCHEMA",
     "CompileAfterWarmupTrigger",
     "CounterDeltaTrigger",
     "CounterDict",
@@ -62,11 +66,13 @@ __all__ = [
     "Span",
     "Trace",
     "Tracer",
+    "fnv1a",
     "get_flight_recorder",
     "get_profiler",
     "get_registry",
     "get_tracer",
     "install_default_triggers",
+    "load_bundle",
     "reset_flight_recorder",
     "reset_profiler",
 ]

@@ -27,6 +27,20 @@ Record kinds (see docs/ARCHITECTURE.md "Flight recorder"):
   preemption delta;
 - ``anomaly``  — a trigger firing.
 
+Besides the activity ring there is a second, independent bounded ring:
+the **event journal** (``journal_note`` / ``journal_mark``). Where an
+activity record is a human-facing breadcrumb, a journal record is a
+*replayable* fact: one adopted post-CRDT publication (area, key,
+serialized value, version, trace id) or one dispatch-wave boundary
+mark. The journal self-compacts: a pub record evicted from the ring
+folds into a rolling per-(area, key) base LSDB, so ``base + ring
+slice`` is always the complete adopted history — every post-mortem
+bundle embeds both plus an anchor (checkpoint seq + FNV-1a graph
+digest) and is therefore self-contained and deterministically
+replayable by ``twin/replay.py``. The journal does NOT drop while the
+activity ring is frozen: dropping a pub would break the
+base-plus-slice completeness of every later bundle.
+
 Triggers: each ``check()`` is a couple of registry reads per retired
 event window (and per serve wave). On fire the ring FREEZES (new notes
 are dropped and counted, so the pre-anomaly evidence survives), a
@@ -41,17 +55,55 @@ runs strictly after the window pops.
 
 from __future__ import annotations
 
+import gzip
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from openr_tpu.telemetry.registry import get_registry
 
 _DEF_RING = 2048
+_DEF_JOURNAL = 4096
+_DEF_MAX_DUMP_BYTES = 8 << 20
 _DEF_DIR = "/tmp/openr_tpu_flight"
+
+BUNDLE_SCHEMA = 2
+
+
+def fnv1a(data: bytes, h: int = 0x811C9DC5) -> int:
+    """FNV-1a over ``data`` (same digest family as ``SolverView.digest``
+    and the multi-client wire parity check)."""
+    for b in data:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def _lsdb_digest(lsdb: Dict[str, Dict[str, Dict[str, Any]]]) -> int:
+    """FNV-1a over a serialized base LSDB in sorted (area, key) order —
+    the bundle's graph anchor digest. ``twin/replay.py`` recomputes it
+    to detect a corrupt or hand-edited bundle."""
+    h = 0x811C9DC5
+    for area in sorted(lsdb):
+        kv = lsdb[area]
+        for key in sorted(kv):
+            rec = kv[key]
+            blob = "|".join((area, key, str(rec.get("version", 0)),
+                             rec.get("value_b64") or "", ";"))
+            h = fnv1a(blob.encode(), h)
+    return h
+
+
+def load_bundle(path: str) -> Dict[str, Any]:
+    """Load a post-mortem bundle written by ``dump_postmortem`` —
+    transparently handles the gzip form (``.json.gz``)."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
 
 
 class Trigger:
@@ -160,6 +212,9 @@ class FlightRecorder:
         dump_dir: Optional[str] = None,
         min_dump_interval_s: float = 2.0,
         max_dumps: int = 16,
+        journal: Optional[int] = None,
+        max_dump_bytes: Optional[int] = None,
+        gzip_dumps: Optional[bool] = None,
     ) -> None:
         if ring is None:
             ring = int(os.environ.get("OPENR_FLIGHT_RING", str(_DEF_RING)))
@@ -167,10 +222,20 @@ class FlightRecorder:
             enabled = os.environ.get("OPENR_FLIGHT", "1") != "0"
         if dump_dir is None:
             dump_dir = os.environ.get("OPENR_FLIGHT_DIR", _DEF_DIR)
+        if journal is None:
+            journal = int(os.environ.get(
+                "OPENR_FLIGHT_JOURNAL", str(_DEF_JOURNAL)))
+        if max_dump_bytes is None:
+            max_dump_bytes = int(os.environ.get(
+                "OPENR_FLIGHT_MAX_DUMP_BYTES", str(_DEF_MAX_DUMP_BYTES)))
+        if gzip_dumps is None:
+            gzip_dumps = os.environ.get("OPENR_FLIGHT_GZIP", "0") == "1"
         self.enabled = bool(enabled)
         self.dump_dir = dump_dir
         self.min_dump_interval_s = min_dump_interval_s
         self.max_dumps = max_dumps
+        self.max_dump_bytes = max(4096, int(max_dump_bytes))
+        self.gzip_dumps = bool(gzip_dumps)
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(16, ring))
         self._frozen = False
@@ -179,6 +244,13 @@ class FlightRecorder:
         self._last_dump_t = 0.0
         self._triggers: List[Trigger] = []
         self._pending: Optional[tuple] = None
+        # -- event journal: pub/mark ring + rolling base LSDB ---------
+        self._journal: deque = deque(maxlen=max(64, journal))
+        self._journal_seq = 0
+        self._journal_base: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._journal_base_seq = 0
+        self._anchor_provider: Optional[Callable[[], Dict[str, Any]]] = None
+        self._counter_baseline: Dict[str, float] = {}
         budget = os.environ.get("OPENR_TOUCH_BUDGET", "")
         self._touch_budget: Optional[int] = int(budget) if budget else None
 
@@ -216,6 +288,103 @@ class FlightRecorder:
     def unfreeze(self) -> None:
         with self._lock:
             self._frozen = False
+
+    # -- event journal -----------------------------------------------
+    def journal_anchor(self, area: str,
+                       key_vals: Dict[str, Dict[str, Any]]) -> None:
+        """Seed (or extend) the rolling base LSDB wholesale — used by a
+        source whose starting state never flowed through ``journal_note``
+        (e.g. a twin built directly from a topology). ``key_vals`` maps
+        key -> {value_b64, version, originator}."""
+        if not self.enabled:
+            return
+        with self._lock:
+            base = self._journal_base.setdefault(area, {})
+            for key, rec in key_vals.items():
+                base[key] = dict(rec)
+
+    def journal_note(self, area: str, key: str, *, value_b64: str,
+                     version: int, originator: str = "",
+                     trace_id: Optional[int] = None) -> None:
+        """Record one adopted post-CRDT publication. Keeps appending
+        while the activity ring is frozen: the journal is bounded and
+        self-compacting, and a dropped pub would break the
+        base-plus-slice completeness of every later bundle."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {
+            "area": area,
+            "key": key,
+            "value_b64": value_b64,
+            "version": int(version),
+            "originator": originator,
+        }
+        if trace_id is not None:
+            rec["trace_id"] = trace_id
+        with self._lock:
+            self._journal_seq += 1
+            rec["seq"] = self._journal_seq
+            self._journal_append_locked(rec)
+
+    def journal_mark(self, kind: str, /, **data: Any) -> None:
+        """Record one dispatch-wave / debounce-window boundary (kind
+        ``wave``) or an analyzer verdict (kind ``analysis``). Marks
+        delimit the replay windows: the replayer applies the pubs since
+        the previous mark, then converges exactly the mark's vantages."""
+        if not self.enabled:
+            return
+        rec: Dict[str, Any] = {"mark": kind}
+        rec.update(data)
+        with self._lock:
+            self._journal_seq += 1
+            rec["seq"] = self._journal_seq
+            self._journal_append_locked(rec)
+
+    def _journal_append_locked(self, rec: Dict[str, Any]) -> None:
+        ring = self._journal
+        if len(ring) == ring.maxlen:
+            evicted = ring[0]
+            if "mark" not in evicted:
+                self._journal_base.setdefault(evicted["area"], {})[
+                    evicted["key"]] = {
+                    "value_b64": evicted["value_b64"],
+                    "version": evicted["version"],
+                    "originator": evicted.get("originator", ""),
+                }
+            self._journal_base_seq = evicted["seq"]
+            get_registry().counter_bump("flight.journal_evictions")
+        ring.append(rec)
+
+    def journal_records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._journal]
+
+    def journal_len(self) -> int:
+        with self._lock:
+            return len(self._journal)
+
+    def journal_base(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        with self._lock:
+            return {a: {k: dict(v) for k, v in kv.items()}
+                    for a, kv in self._journal_base.items()}
+
+    def set_anchor_provider(
+            self, fn: Optional[Callable[[], Dict[str, Any]]]) -> None:
+        """Install a callable returning extra anchor fields for the next
+        bundle (the state plane installs one that reports its checkpoint
+        seq). Errors are swallowed and counted — same contract as the
+        dump itself."""
+        self._anchor_provider = fn
+
+    def _anchor_digest_locked(self) -> int:
+        return _lsdb_digest(self._journal_base)
+
+    def journal_anchor_digest(self) -> int:
+        """FNV-1a digest over the rolling base LSDB (sorted area/key
+        order) — the bundle's graph anchor, recomputed by the replayer
+        to detect a corrupt or mis-paired bundle."""
+        with self._lock:
+            return self._anchor_digest_locked()
 
     # -- budgets -----------------------------------------------------
     def set_touch_budget(self, budget: Optional[int]) -> None:
@@ -324,11 +493,50 @@ class FlightRecorder:
         self.check_triggers()
 
     # -- post-mortem bundles -----------------------------------------
+    def _encode_bundle(self, bundle: Dict[str, Any]) -> bytes:
+        """Serialize compactly; if over the size ceiling, shed the bulk
+        in evidence order — activity records first, then the oldest
+        journal pubs (folded into the bundle's own anchor LSDB so the
+        bundle stays replayable, just from a later anchor)."""
+        payload = json.dumps(bundle, separators=(",", ":")).encode()
+        truncated = False
+        while len(payload) > self.max_dump_bytes:
+            recs = bundle["records"]
+            jrn = bundle["journal"]
+            if recs:
+                del recs[:max(1, len(recs) // 2)]
+            elif len(jrn["records"]) > 1:
+                drop = jrn["records"][:max(1, len(jrn["records"]) // 2)]
+                del jrn["records"][:len(drop)]
+                lsdb = jrn["anchor"]["lsdb"]
+                for rec in drop:
+                    if "mark" in rec:
+                        continue
+                    lsdb.setdefault(rec["area"], {})[rec["key"]] = {
+                        "value_b64": rec["value_b64"],
+                        "version": rec["version"],
+                        "originator": rec.get("originator", ""),
+                    }
+                    jrn["base_seq"] = rec["seq"]
+                # the anchor moved: its digest no longer matches the
+                # recorded one, so recompute over the folded LSDB
+                jrn["anchor"]["graph_digest"] = _lsdb_digest(lsdb)
+            else:
+                break
+            truncated = True
+            bundle["truncated"] = True
+            payload = json.dumps(bundle, separators=(",", ":")).encode()
+        if truncated:
+            get_registry().counter_bump("flight.dump_truncations")
+        return payload
+
     def dump_postmortem(self, trigger: str = "manual",
                         reason: str = "") -> Optional[str]:
-        """Write the bundle (JSON + sibling Chrome trace), thaw the
-        ring, return the bundle path (None when disabled or the write
-        fails — a dump failure never propagates into the pipeline)."""
+        """Write the bundle (JSON or gzip + sibling Chrome trace), thaw
+        the ring, return the bundle path (None when disabled or the
+        write fails — a dump failure never propagates into the
+        pipeline). The bundle embeds the journal slice plus the LSDB
+        anchor, so it is self-contained for ``twin/replay.py``."""
         if not self.enabled:
             return None
         reg = get_registry()
@@ -340,33 +548,72 @@ class FlightRecorder:
             self._seq += 1
             seq = self._seq
             records = list(self._ring)
+            journal_records = [dict(r) for r in self._journal]
+            journal_base = {a: {k: dict(v) for k, v in kv.items()}
+                            for a, kv in self._journal_base.items()}
+            base_seq = self._journal_base_seq
+            graph_digest = self._anchor_digest_locked()
+        anchor: Dict[str, Any] = {
+            "checkpoint_seq": base_seq,
+            "graph_digest": graph_digest,
+            "lsdb": journal_base,
+        }
+        provider = self._anchor_provider
+        if provider is not None:
+            try:
+                anchor.update(provider() or {})
+            except Exception:  # noqa: BLE001 - anchor extras are
+                reg.counter_bump("flight.anchor_errors")  # best-effort
+        counters = reg.snapshot()
+        baseline = self._counter_baseline
+        delta = {k: round(v - baseline.get(k, 0.0), 6)
+                 for k, v in counters.items()
+                 if v != baseline.get(k, 0.0)}
         bundle = {
+            "schema": BUNDLE_SCHEMA,
             "trigger": trigger,
             "reason": reason,
             "ts": round(time.time(), 3),
             "pid": os.getpid(),
             "seq": seq,
             "records": records,
-            "counters": reg.snapshot(),
+            "counters": counters,
+            "counters_delta": delta,
+            "journal": {
+                "base_seq": base_seq,
+                "records": journal_records,
+                "anchor": anchor,
+            },
             "attribution": prof.attribution(),
             "host_overhead_ratio": prof.host_overhead_ratio(),
         }
         stamp = int(bundle["ts"] * 1000.0)
         base = f"postmortem-{trigger}-{stamp}-{os.getpid()}-{seq}"
-        path = os.path.join(self.dump_dir, base + ".json")
+        path = os.path.join(self.dump_dir,
+                            base + (".json.gz" if self.gzip_dumps
+                                    else ".json"))
         try:
+            payload = self._encode_bundle(bundle)
             os.makedirs(self.dump_dir, exist_ok=True)
-            with open(path, "w") as f:
-                json.dump(bundle, f, indent=1)
+            if self.gzip_dumps:
+                with gzip.open(path, "wb") as f:
+                    f.write(payload)
+            else:
+                with open(path, "wb") as f:
+                    f.write(payload)
+            reg.observe("ops.flight.dump_bytes",
+                        float(os.path.getsize(path)))
             with open(os.path.join(self.dump_dir,
                                    base + "-trace.json"), "w") as f:
-                json.dump(get_tracer().chrome_trace(), f)
-        except OSError:
+                json.dump(get_tracer().chrome_trace(), f,
+                          separators=(",", ":"))
+        except (OSError, TypeError, ValueError):
             reg.counter_bump("flight.dump_errors")
             path = None
         with self._lock:
             if path is not None:
                 self._dumps += 1
+                self._counter_baseline = dict(counters)
             self._frozen = False
         if path is not None:
             reg.counter_bump(f"flight.dumps.{trigger}")

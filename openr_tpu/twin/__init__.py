@@ -17,11 +17,14 @@ from openr_tpu.twin.analyzer import (
 )
 from openr_tpu.twin.fabric import FabricTwin
 from openr_tpu.twin.metrics import TWIN_COUNTERS
+from openr_tpu.twin.replay import ReplayVerdict, ScenarioReplayer
 from openr_tpu.twin.scenario import FAULT_TWIN_INJECT, ScenarioDriver
 
 __all__ = [
     "FabricTwin",
     "ScenarioDriver",
+    "ScenarioReplayer",
+    "ReplayVerdict",
     "FleetReport",
     "Finding",
     "analyze_fleet",

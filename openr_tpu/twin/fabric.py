@@ -26,6 +26,7 @@ can express.
 
 from __future__ import annotations
 
+import base64
 import itertools
 from dataclasses import replace as _dc_replace
 from typing import Dict, List, Optional, Sequence
@@ -73,11 +74,19 @@ class FabricTwin:
         area: Optional[str] = None,
         solver_backend: str = "device",
         manager: Optional[WorldManager] = None,
+        record_journal: bool = False,
     ):
         self.topo = topo
         self.area = area if area is not None else (topo.area or "0")
         self.nodes: List[str] = sorted(topo.adj_dbs)
         self._seq = next(_TWIN_SEQ)
+        # opt-in (several twins share one process-wide flight journal,
+        # so only the scenario under capture records): every applied
+        # event journals a pub, every converge a wave mark, and the
+        # starting databases seed the bundle's LSDB anchor — together
+        # a post-mortem bundle replays this twin exactly
+        self.record_journal = bool(record_journal)
+        anchor: Dict[str, Dict[str, object]] = {}
         self.ls = LinkState(self.area)
         self.prefix_state = PrefixState()
         for name in self.nodes:
@@ -85,11 +94,29 @@ class FabricTwin:
             if db.area != self.area:
                 db = _dc_replace(db, area=self.area)
             self.ls.update_adjacency_database(db)
+            if self.record_journal:
+                anchor[keyutil.adj_key(name)] = {
+                    "value_b64": base64.b64encode(
+                        wire.dumps(db)).decode("ascii"),
+                    "version": 1,
+                    "originator": name,
+                }
         for name in sorted(topo.prefix_dbs):
             pdb = topo.prefix_dbs[name]
             if pdb.area != self.area:
                 pdb = _dc_replace(pdb, area=self.area)
             self.prefix_state.update_prefix_database(pdb)
+            if self.record_journal:
+                anchor[keyutil.prefix_db_key(name)] = {
+                    "value_b64": base64.b64encode(
+                        wire.dumps(pdb)).decode("ascii"),
+                    "version": 1,
+                    "originator": name,
+                }
+        if self.record_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().journal_anchor(self.area, anchor)
         if manager is None:
             manager = WorldManager(
                 slots_per_bucket=_pow2_at_least(len(self.nodes)),
@@ -133,6 +160,16 @@ class FabricTwin:
         TWIN_COUNTERS["events"] += 1
         self.stale.update(self.nodes)
         TWIN_COUNTERS["stale_vantages"] = len(self.stale)
+        if self.record_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().journal_note(
+                self.area,
+                ev.key,
+                value_b64=base64.b64encode(ev.payload).decode("ascii"),
+                version=ev.version,
+                originator=ev.node,
+            )
         return True
 
     # -- converge plane ----------------------------------------------------
@@ -195,6 +232,15 @@ class FabricTwin:
             )
             tracer.deactivate()
             tracer.finish(trace)
+        if self.record_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().journal_mark(
+                "wave",
+                window="twin.converge",
+                vantages=list(nodes),
+                stale=len(self.stale),
+            )
         return out
 
     def step(self, ev: LoadEvent) -> Dict[str, DecisionRouteDb]:
@@ -243,9 +289,31 @@ class FabricTwin:
     def analyze(self) -> FleetReport:
         """Run the fleet analyzer over the CURRENT per-vantage tables
         (mixed epochs included — that is the point)."""
-        return analyze_fleet(
+        report = analyze_fleet(
             self.route_dbs, self.ls, self.prefix_state
         )
+        if self.record_journal:
+            from openr_tpu.telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().journal_mark(
+                "analysis",
+                micro_loops=len(report.loops()),
+                blackholes=len(report.blackholes()),
+                clean=report.clean,
+                route_digests=self.route_digests(),
+            )
+        return report
+
+    def route_digests(self) -> Dict[str, int]:
+        """FNV-1a digest of every vantage's serialized RouteDatabase —
+        the bundle-embedded ground truth for the replayer's
+        bit-identical determinism check."""
+        from openr_tpu.telemetry.flight import fnv1a
+
+        return {
+            n: fnv1a(wire.dumps(db.to_route_db(n)))
+            for n, db in sorted(self.route_dbs.items())
+        }
 
     def close(self) -> None:
         """Release the fleet's tenant worlds (device slots)."""

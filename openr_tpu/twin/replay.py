@@ -1,0 +1,370 @@
+"""Incident replay: turn a post-mortem bundle into a deterministic
+twin run and a reproduced/not-reproduced verdict.
+
+A bundle written by ``FlightRecorder.dump_postmortem`` is
+self-contained (DeltaPath's insight, via the state plane's WAL
+semantics): the ``journal`` section carries an LSDB **anchor** (the
+rolling base — every pub evicted from the journal ring folded down,
+digest-stamped with FNV-1a) plus the **ring slice** of adopted
+post-CRDT publications and wave marks recorded up to the freeze.
+``base + slice`` is therefore the complete adopted history of the
+frozen window, and replaying it is exactly the state plane's
+checkpoint+journal recovery fold (``state.plane.replay_journal``).
+
+``ScenarioReplayer`` runs that fold in a fresh process:
+
+1. decode the anchor into an ``LsdbCheckpoint``, verify its FNV graph
+   digest (a corrupt or hand-edited bundle fails closed), and rebuild
+   the starting topology via ``replay_journal`` — one recovery
+   semantics shared with warm boot;
+2. feed the slice through a ``FabricTwin``: pubs apply to the shared
+   LSDB, each recorded ``wave`` mark converges EXACTLY the vantages
+   the original wave solved — one dispatch wave per recorded debounce
+   window, so mixed-epoch states (the interesting ones: micro-loops
+   live between a partial converge and the heal wave) reproduce
+   bit-for-bit;
+3. re-run the micro-loop/blackhole analyzer at every recorded
+   ``analysis`` mark and at the end, and emit a ``ReplayVerdict`` —
+   anomaly class reproduced or not, per-window divergence diff
+   against the recorded counters/digests, and the final per-vantage
+   RouteDatabase digests (two replays of one bundle must agree
+   bit-for-bit; so must replay-vs-original when the bundle carries
+   recorded digests).
+
+Ordering hazard (see ARCHITECTURE "Incident replay plane"): pubs
+recorded after the last wave mark were still pending in the debounce
+window at freeze time — they are applied but deliberately left
+unconverged, mirroring the frozen process. A bundle whose ring
+evicted *wave marks* (``base_seq > 0`` with fewer marks than waves)
+has lost window boundaries; the replay still converges to the same
+final LSDB but intermediate mixed-epoch states may differ —
+``anchor_moved`` flags it in the verdict.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from openr_tpu.load.generator import LoadEvent
+from openr_tpu.models.topologies import Topology
+from openr_tpu.state.plane import JournalRecord, LsdbCheckpoint, replay_journal
+from openr_tpu.telemetry import get_registry
+from openr_tpu.telemetry.flight import _lsdb_digest, fnv1a, load_bundle
+from openr_tpu.twin.analyzer import KIND_BLACKHOLE, KIND_MICRO_LOOP
+from openr_tpu.twin.fabric import FabricTwin
+from openr_tpu.types import AdjacencyDatabase, PrefixDatabase, Value
+from openr_tpu.types.kvstore import TTL_INFINITY
+from openr_tpu.utils import keys as keyutil
+from openr_tpu.utils import wire
+
+
+@dataclass
+class ReplayVerdict:
+    """What a replay concluded. ``reproduced`` is the headline: every
+    anomaly class the original run recorded showed up again."""
+
+    reproduced: bool = False
+    recorded_classes: List[str] = field(default_factory=list)
+    replayed_classes: List[str] = field(default_factory=list)
+    windows: int = 0
+    pubs_applied: int = 0
+    trailing_pubs: int = 0
+    anchor_moved: bool = False
+    divergence: List[Dict[str, Any]] = field(default_factory=list)
+    route_digests: Dict[str, int] = field(default_factory=dict)
+    digests_match_recorded: Optional[bool] = None
+    errors: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "reproduced": self.reproduced,
+            "recorded_classes": list(self.recorded_classes),
+            "replayed_classes": list(self.replayed_classes),
+            "windows": self.windows,
+            "pubs_applied": self.pubs_applied,
+            "trailing_pubs": self.trailing_pubs,
+            "anchor_moved": self.anchor_moved,
+            "divergence": list(self.divergence),
+            "route_digests": dict(self.route_digests),
+            "digests_match_recorded": self.digests_match_recorded,
+            "errors": list(self.errors),
+        }
+
+
+def _decode_value(rec: Dict[str, Any]) -> Value:
+    payload = base64.b64decode(rec["value_b64"])
+    version = int(rec.get("version", 1))
+    originator = rec.get("originator", "")
+    return Value(
+        version=version,
+        originator_id=originator,
+        value=payload,
+        ttl=TTL_INFINITY,
+        hash=wire.generate_hash(version, originator, payload),
+    )
+
+
+class ScenarioReplayer:
+    """Ingest one bundle, drive one twin, emit one verdict."""
+
+    def __init__(self, bundle: Dict[str, Any],
+                 solver_backend: str = "device"):
+        self.bundle = bundle
+        self._backend = solver_backend
+
+    @classmethod
+    def from_path(cls, path: str,
+                  solver_backend: str = "device") -> "ScenarioReplayer":
+        return cls(load_bundle(path), solver_backend=solver_backend)
+
+    # -- anchor reconstruction ---------------------------------------
+
+    def _anchor_lsdb(self, verdict: ReplayVerdict) -> Dict[str, Dict[str, Value]]:
+        journal = self.bundle.get("journal") or {}
+        anchor = journal.get("anchor") or {}
+        raw_lsdb = anchor.get("lsdb") or {}
+        recorded_digest = anchor.get("graph_digest")
+        if recorded_digest is not None:
+            actual = _lsdb_digest(raw_lsdb)
+            if actual != recorded_digest:
+                raise ValueError(
+                    f"anchor digest mismatch: bundle says "
+                    f"{recorded_digest}, LSDB hashes to {actual} — "
+                    f"corrupt or hand-edited bundle"
+                )
+        verdict.anchor_moved = int(journal.get("base_seq", 0) or 0) > 0
+        # one synthetic checkpoint + zero journal records: the anchor
+        # base is already fully folded, so the recovery fold reduces to
+        # decoding it — but going through replay_journal keeps replay
+        # on the state plane's recovery semantics
+        ckpt = LsdbCheckpoint(
+            seq=0,
+            key_vals_by_area={
+                area: {k: _decode_value(rec) for k, rec in kv.items()}
+                for area, kv in raw_lsdb.items()
+            },
+        )
+        return replay_journal(ckpt, [])
+
+    def _build_twin(self, lsdb: Dict[str, Dict[str, Value]]) -> FabricTwin:
+        if not lsdb:
+            raise ValueError("bundle has no journal anchor — nothing to replay")
+        # one twin per area is the twin's model; bundles from a
+        # single-fabric pipeline carry one area
+        area = sorted(lsdb)[0]
+        adj_dbs: Dict[str, AdjacencyDatabase] = {}
+        prefix_dbs: Dict[str, PrefixDatabase] = {}
+        for key, value in lsdb[area].items():
+            if value.value is None:
+                continue
+            if keyutil.is_adj_key(key):
+                db = wire.loads(value.value, AdjacencyDatabase)
+                adj_dbs[db.this_node_name] = db
+            elif keyutil.is_prefix_key(key):
+                pdb = wire.loads(value.value, PrefixDatabase)
+                prefix_dbs[pdb.this_node_name] = pdb
+        topo = Topology(
+            name="replay",
+            area=area,
+            adj_dbs=adj_dbs,
+            prefix_dbs=prefix_dbs,
+        )
+        return FabricTwin(
+            topo, area=area, solver_backend=self._backend
+        )
+
+    # -- replay --------------------------------------------------------
+
+    def replay(self) -> ReplayVerdict:
+        verdict = ReplayVerdict()
+        verdict.recorded_classes = self._recorded_classes()
+        lsdb = self._anchor_lsdb(verdict)
+        twin = self._build_twin(lsdb)
+        records = (self.bundle.get("journal") or {}).get("records") or []
+        pending = 0
+        try:
+            for rec in records:
+                if "mark" in rec:
+                    self._replay_mark(twin, rec, verdict, pending)
+                    if rec["mark"] == "wave":
+                        verdict.windows += 1
+                        pending = 0
+                    continue
+                ev = LoadEvent(
+                    seq=int(rec.get("seq", 0)),
+                    kind="replay",
+                    node=rec.get("originator", ""),
+                    key=rec["key"],
+                    payload=base64.b64decode(rec["value_b64"]),
+                    version=int(rec.get("version", 1)),
+                )
+                if twin.apply_event(ev):
+                    verdict.pubs_applied += 1
+                    pending += 1
+            verdict.trailing_pubs = pending
+            report = twin.analyze()
+            verdict.replayed_classes = sorted(
+                {f.kind for f in report.findings}
+            )
+            verdict.route_digests = twin.route_digests()
+            recorded_digests = self._last_recorded_digests()
+            if recorded_digests is not None:
+                verdict.digests_match_recorded = recorded_digests == {
+                    str(k): v for k, v in verdict.route_digests.items()
+                }
+            verdict.reproduced = bool(verdict.recorded_classes) and set(
+                verdict.recorded_classes
+            ) <= set(verdict.replayed_classes)
+            get_registry().counter_bump("twin.replays")
+            if verdict.reproduced:
+                get_registry().counter_bump("twin.replays_reproduced")
+        finally:
+            twin.close()
+        return verdict
+
+    def _replay_mark(self, twin: FabricTwin, rec: Dict[str, Any],
+                     verdict: ReplayVerdict, pending: int) -> None:
+        kind = rec["mark"]
+        if kind == "wave":
+            vantages = rec.get("vantages") or None
+            twin.converge(vantages)
+            stale = rec.get("stale")
+            if stale is not None and stale != len(twin.stale):
+                verdict.divergence.append({
+                    "window": verdict.windows,
+                    "field": "stale_vantages",
+                    "recorded": stale,
+                    "replayed": len(twin.stale),
+                })
+        elif kind == "analysis":
+            report = twin.analyze()
+            for name, recorded in (
+                ("micro_loops", rec.get("micro_loops")),
+                ("blackholes", rec.get("blackholes")),
+            ):
+                if recorded is None:
+                    continue
+                replayed = len(
+                    report.loops() if name == "micro_loops"
+                    else report.blackholes()
+                )
+                if replayed != recorded:
+                    verdict.divergence.append({
+                        "window": verdict.windows,
+                        "field": name,
+                        "recorded": recorded,
+                        "replayed": replayed,
+                    })
+            recorded_digests = rec.get("route_digests")
+            if recorded_digests:
+                mine = {str(k): v for k, v in twin.route_digests().items()}
+                theirs = {str(k): v for k, v in recorded_digests.items()}
+                if mine != theirs:
+                    verdict.divergence.append({
+                        "window": verdict.windows,
+                        "field": "route_digests",
+                        "recorded": len(theirs),
+                        "replayed": sum(
+                            1 for k in mine if mine[k] == theirs.get(k)
+                        ),
+                    })
+
+    # -- recorded ground truth -----------------------------------------
+
+    def _marks(self, kind: str) -> List[Dict[str, Any]]:
+        records = (self.bundle.get("journal") or {}).get("records") or []
+        return [r for r in records if r.get("mark") == kind]
+
+    def _recorded_classes(self) -> List[str]:
+        """The anomaly classes the original run recorded: analyzer
+        counts from ``analysis`` marks, plus the trigger name itself
+        when it names a class."""
+        classes = set()
+        for rec in self._marks("analysis"):
+            if rec.get("micro_loops"):
+                classes.add(KIND_MICRO_LOOP)
+            if rec.get("blackholes"):
+                classes.add(KIND_BLACKHOLE)
+        trigger = self.bundle.get("trigger", "")
+        if trigger in (KIND_MICRO_LOOP, KIND_BLACKHOLE):
+            classes.add(trigger)
+        return sorted(classes)
+
+    def _last_recorded_digests(self) -> Optional[Dict[str, int]]:
+        marks = self._marks("analysis")
+        for rec in reversed(marks):
+            digests = rec.get("route_digests")
+            if digests:
+                return {str(k): v for k, v in digests.items()}
+        return None
+
+
+def replay_digest(verdict: ReplayVerdict) -> int:
+    """One FNV-1a number over the verdict's per-vantage digests — what
+    'bit-identical twice in a row' compares."""
+    blob = json.dumps(
+        sorted(verdict.route_digests.items()), separators=(",", ":")
+    )
+    return fnv1a(blob.encode())
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m openr_tpu.twin.replay <bundle> [--json] [--backend B]``
+    — the fresh-process entry `tools/replay_smoke.py` and `breeze
+    monitor replay` both drive. Exit 0 when the recorded anomaly class
+    reproduced (or the bundle recorded a clean run and replay stayed
+    clean), 1 otherwise."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="openr_tpu.twin.replay")
+    ap.add_argument("bundle")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--backend", default="device")
+    ap.add_argument("--twice", action="store_true",
+                    help="replay twice and require bit-identical "
+                         "per-vantage digests")
+    args = ap.parse_args(argv)
+    replayer = ScenarioReplayer.from_path(args.bundle,
+                                          solver_backend=args.backend)
+    verdict = replayer.replay()
+    deterministic = None
+    if args.twice:
+        second = ScenarioReplayer.from_path(
+            args.bundle, solver_backend=args.backend
+        ).replay()
+        deterministic = replay_digest(verdict) == replay_digest(second)
+    out = verdict.to_dict()
+    if deterministic is not None:
+        out["deterministic"] = deterministic
+    ok = (
+        verdict.reproduced
+        or (not verdict.recorded_classes and not verdict.replayed_classes)
+    ) and not verdict.errors and deterministic is not False
+    out["ok"] = ok
+    if args.as_json:
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print(f"bundle:     {args.bundle}")
+        print(f"trigger:    {replayer.bundle.get('trigger')} "
+              f"({replayer.bundle.get('reason', '')})")
+        print(f"windows:    {verdict.windows} "
+              f"(+{verdict.trailing_pubs} trailing pubs)")
+        print(f"recorded:   {', '.join(verdict.recorded_classes) or 'clean'}")
+        print(f"replayed:   {', '.join(verdict.replayed_classes) or 'clean'}")
+        print(f"reproduced: {verdict.reproduced}")
+        if verdict.digests_match_recorded is not None:
+            print(f"digests match recorded: "
+                  f"{verdict.digests_match_recorded}")
+        if deterministic is not None:
+            print(f"deterministic: {deterministic}")
+        for d in verdict.divergence:
+            print(f"  divergence w{d['window']} {d['field']}: "
+                  f"recorded {d['recorded']} vs replayed {d['replayed']}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI shim
+    raise SystemExit(main())

@@ -79,9 +79,18 @@ def _mutate_metric(ls, node, i, metric):
 
 def _churn_round(engine, ls, node, n_events, tag) -> float:
     """One timed warm churn leg: n_events metric flips, each inside a
-    committed event window; returns wall seconds."""
-    from openr_tpu.ops import dispatch_accounting as da
+    committed event window; returns wall seconds. When the flight
+    recorder is armed, each event also pays the Decision adoption
+    site's event-journal append (serialize + b64 the adopted value,
+    one pub note + one wave mark) so the armed-vs-disarmed A/B gates
+    the journal ring's overhead too, not just the activity ring's."""
+    import base64
 
+    from openr_tpu.ops import dispatch_accounting as da
+    from openr_tpu.telemetry import get_flight_recorder
+    from openr_tpu.utils import wire
+
+    fr = get_flight_recorder()
     t0 = time.perf_counter()
     for i in range(n_events):
         with da.event_window(tag):
@@ -89,6 +98,14 @@ def _churn_round(engine, ls, node, n_events, tag) -> float:
                 ls, _mutate_metric(ls, node, 0, SEQ[i % len(SEQ)]),
                 defer_consume=True,
             )
+        if fr.enabled:
+            db = ls.get_adjacency_databases()[node]
+            fr.journal_note(
+                "0", f"adj:{node}",
+                value_b64=base64.b64encode(wire.dumps(db)).decode(),
+                version=i + 1, originator=node,
+            )
+            fr.journal_mark("wave", window=tag)
     engine.flush()
     return time.perf_counter() - t0
 
@@ -371,7 +388,8 @@ def main() -> int:
             "ops.host_dispatches", "ops.profile_samples",
             "flight.ring_overflows", "flight.dropped_while_frozen",
             "flight.trigger_errors", "flight.dump_errors",
-            "flight.dumps_suppressed",
+            "flight.dumps_suppressed", "flight.journal_evictions",
+            "flight.dump_truncations",
         )
     }
     report["failures"] = failures

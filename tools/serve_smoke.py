@@ -363,6 +363,45 @@ def main(argv=None) -> int:
         report["gates"]["stage_attribution"] = bool(
             attribution["stages"]
         )
+
+        # -- gate: cross-wire trace continuity ----------------------------
+        # every client process stamped a trace context into its RPCs;
+        # each client's trace id must surface in at least one of the
+        # service's wave flight records (client span ids adopted at
+        # _run_waves), proving a client-observed breach is chaseable to
+        # the exact service wave that served it
+        from openr_tpu.telemetry import get_flight_recorder
+
+        wave_spans = [
+            s
+            for rec in get_flight_recorder().records()
+            if rec.get("kind") == "wave"
+            for s in rec.get("client_spans", [])
+        ]
+        client_traces = [
+            r["trace_id"] for r in results if r.get("trace_id")
+        ]
+        missing = [
+            t for t in client_traces
+            if not any(s.startswith(t + ".") for s in wave_spans)
+        ]
+        report["trace_continuity"] = {
+            "client_traces": len(client_traces),
+            "wave_spans_recorded": len(wave_spans),
+            "missing": missing,
+        }
+        if not client_traces:
+            failures.append(
+                "no client reported a trace id (trace stamping is dead)"
+            )
+        if missing:
+            failures.append(
+                f"{len(missing)} client trace ids never surfaced in "
+                f"service wave records: {missing[:4]}"
+            )
+        report["gates"]["trace_continuity"] = (
+            bool(client_traces) and not missing
+        )
     finally:
         srv.stop()
         svc.stop()
