@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke replay-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke replay-smoke bench clean install
 
 all: native
 
@@ -43,7 +43,7 @@ lint-analysis:
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke pipeline-smoke serve-smoke obs-smoke replay-smoke
+tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke serve-smoke obs-smoke replay-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -124,6 +124,17 @@ twin-smoke: native
 # when it fails.
 dispatch-smoke: native
 	env JAX_PLATFORMS=cpu python -m tools.dispatch_smoke --out /tmp/openr_tpu_dispatch_smoke.json
+
+# sliced-ELL kernel gate (openr_tpu.ops.pallas_ell, interpret mode):
+# all-pairs distances must be bit-identical between the jnp and pallas
+# relax impls on a fat-tree and a random mesh, an ell_relax autotuner
+# winner must round-trip through the v2 family-keyed persistence
+# (measure -> persist -> reload, no re-measure), and a warmed churn
+# pass with the kernel armed via impl="auto" must cost zero AOT/jit
+# compiles. See docs/RUNBOOK.md "Kernel regression triage" when it
+# fails.
+kernel-smoke: native
+	env JAX_PLATFORMS=cpu python -m tools.kernel_smoke --out /tmp/openr_tpu_kernel_smoke.json
 
 # pipelined event-window gate (PR 16): a warm multi-event burst must
 # cost at most 2 host touches per pipeline DRAIN (not per window) with

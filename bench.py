@@ -512,6 +512,26 @@ def _run() -> dict:
             except Exception as e:
                 bench_shchurn = {"error": f"{type(e).__name__}: {e}"}
 
+    # sliced-ELL kernel leg: paired jnp-vs-pallas relax timing on the
+    # resident band structure with the bit-identity oracle gate; the
+    # measured winner lands in the autotuner's family-keyed ell_relax
+    # persistence (off-CPU), so impl="auto" sparse dispatches in later
+    # processes inherit the oracle-gated number — the sparse twin of
+    # the min-plus probe above
+    bench_ellkern = None
+    if os.environ.get("OPENR_BENCH_ELLKERN") == "1":
+        if leg_elapsed() > 500:
+            bench_ellkern = {
+                "skipped": f"child budget ({leg_elapsed():.0f}s elapsed)"
+            }
+        else:
+            try:
+                from benchmarks.bench_scale import ell_kernel_bench
+
+                bench_ellkern = ell_kernel_bench(1000, 256)
+            except Exception as e:
+                bench_ellkern = {"error": f"{type(e).__name__}: {e}"}
+
     # ninth leg: sustained-load service-plane run — the seeded
     # open-loop generator driving the REAL KvStore -> Decision -> Fib
     # pipeline at a fixed rate with admission control + pipelined emit,
@@ -741,6 +761,7 @@ def _run() -> dict:
         "bench_route_engine_churn": bench_rchurn,
         "bench_sp_solver_churn": bench_spsolver,
         "bench_sharded_churn": bench_shchurn,
+        "bench_ell_kernel": bench_ellkern,
         "bench_convergence_trace": bench_traces,
         "bench_sustained_load": bench_load,
         "bench_multi_tenant": bench_tenancy,
@@ -873,6 +894,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env["OPENR_BENCH_INTEGRITY"] = "1"
         env["OPENR_BENCH_TWIN"] = "1"
         env["OPENR_BENCH_SERVE"] = "1"
+        env["OPENR_BENCH_ELLKERN"] = "1"
     else:
         env.pop("OPENR_BENCH_10K", None)
         env.pop("OPENR_BENCH_KSP2", None)
@@ -884,6 +906,7 @@ def _spawn(mode: str, timeout_s: int, with_10k: bool = False):
         env.pop("OPENR_BENCH_INTEGRITY", None)
         env.pop("OPENR_BENCH_TWIN", None)
         env.pop("OPENR_BENCH_SERVE", None)
+        env.pop("OPENR_BENCH_ELLKERN", None)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],

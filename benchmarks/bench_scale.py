@@ -1397,6 +1397,107 @@ def sharded_churn_bench(
     return out
 
 
+def ell_kernel_bench(nodes: int = 1000, sources: int = 256) -> dict:
+    """Paired jnp-vs-pallas sliced-ELL relax kernel leg (issue 18):
+    the SAME all-sources solve on one fat-tree, once per impl — a
+    bit-identity oracle gate between the two (the relax algebra has a
+    unique int32 fixed point, so any mismatch is a kernel bug, not
+    noise), per-relax device time via the shared chained methodology,
+    and the measured winner fed into the autotuner's family-keyed
+    ``ell_relax`` persistence so later ``impl="auto"`` processes
+    inherit this measurement instead of re-timing a synthetic probe.
+    On CPU the pallas leg runs in interpret mode — its number is a
+    correctness witness there, not a speed claim; the winner is only
+    recorded off-CPU for the same reason the min-plus probe is."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from openr_tpu.ops import autotune
+    from openr_tpu.ops.pallas_ell import vmem_bytes
+
+    topo = topologies.fat_tree_nodes(nodes)
+    ls = LinkState(area=topo.area)
+    for name in sorted(topo.adj_dbs):
+        ls.update_adjacency_database(topo.adj_dbs[name])
+    graph = spf_sparse.compile_ell(ls)
+    k_max = max(b.k for b in graph.bands)
+    s = min(sources, graph.n)
+    src_ids = np.arange(s, dtype=np.int32)
+
+    srcs_t = tuple(jnp.asarray(x) for x in graph.src)
+    ws_t = tuple(jnp.asarray(x) for x in graph.w)
+    ov = jnp.asarray(graph.overloaded)
+    d_init = jnp.full((s, graph.n_pad), spf_sparse.INF, jnp.int32)
+    d_init = d_init.at[np.arange(s), src_ids].set(0)
+
+    @functools.partial(jax.jit, static_argnames=("bands", "impl"))
+    def relax_step(d, st, wt, o, bands, impl):
+        return spf_sparse._ell_relax(d, bands, st, wt, o, impl=impl)
+
+    prev = spf_sparse.get_ell_relax_impl()
+    device_ms: dict = {}
+    solved: dict = {}
+    try:
+        for impl in ("jnp", "pallas"):
+            try:
+                spf_sparse.set_ell_relax_impl(impl)
+                solved[impl] = np.asarray(
+                    spf_sparse.ell_distances_from_sources(
+                        graph, src_ids
+                    )
+                )
+
+                def step(prev_d, impl=impl):
+                    return relax_step(
+                        d_init if prev_d is None else prev_d,
+                        srcs_t, ws_t, ov, graph.bands, impl,
+                    )
+
+                device_ms[impl] = _chained_device_only_ms(
+                    step, np.asarray, k=8
+                )
+            except Exception as e:  # noqa: BLE001 - loser, not fatal
+                device_ms[f"{impl}_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        spf_sparse.set_ell_relax_impl(prev)
+
+    parity = (
+        "jnp" in solved and "pallas" in solved
+        and bool(np.array_equal(solved["jnp"], solved["pallas"]))
+    )
+    timed = {
+        k: v for k, v in device_ms.items()
+        if isinstance(v, (int, float))
+    }
+    winner = min(timed, key=timed.get) if timed else "jnp"
+    platform = jax.devices()[0].platform
+    recorded = False
+    if parity and timed and platform != "cpu":
+        try:
+            autotune.get_autotuner().record(
+                "ell_relax", f"{graph.n_pad}x{k_max}", winner, timed
+            )
+            recorded = True
+        except Exception:  # noqa: BLE001 - persistence is best-effort
+            pass
+    return {
+        "bench": "ell_kernel",
+        "nodes": graph.n,
+        "n_pad": graph.n_pad,
+        "k_max": k_max,
+        "bands": len(graph.bands),
+        "sources": s,
+        "platform": platform,
+        "device_ms": device_ms,
+        "oracle_parity": parity,
+        "winner": winner,
+        "winner_recorded": recorded,
+        "vmem_bytes": vmem_bytes(graph.n_pad, k_max),
+    }
+
+
 def sustained_load_bench(
     nodes: int = 1000, rate: int = 240, duration_s: float = 4.0,
     p99_slo_ms: float = 5000.0, seed: int = 20260805,

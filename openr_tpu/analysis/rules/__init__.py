@@ -1,4 +1,4 @@
-"""The nine invariant checkers. Each module exports its Rule classes;
+"""The ten invariant checkers. Each module exports its Rule classes;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
@@ -13,6 +13,7 @@ from openr_tpu.analysis.rules.mirror_coverage import MirrorCoverageRule
 from openr_tpu.analysis.rules.retrace import RetraceRiskRule
 from openr_tpu.analysis.rules.sharding import ShardingSpecRule
 from openr_tpu.analysis.rules.spans import SpanDisciplineRule
+from openr_tpu.analysis.rules.vmem import VmemBudgetRule
 
 ALL_RULES = (
     DonationHazardRule,
@@ -24,6 +25,7 @@ ALL_RULES = (
     RetraceRiskRule,
     ShardingSpecRule,
     MirrorCoverageRule,
+    VmemBudgetRule,
 )
 
 __all__ = [
@@ -37,4 +39,5 @@ __all__ = [
     "SpanDisciplineRule",
     "RetraceRiskRule",
     "ShardingSpecRule",
+    "VmemBudgetRule",
 ]

@@ -1,0 +1,162 @@
+"""``vmem-budget``: every pallas kernel module declares its VMEM
+footprint, and the declaration tracks the module's tile constants.
+
+A Mosaic kernel that silently outgrows scoped VMEM fails on hardware
+only — CPU interpret mode (what tier-1 runs) has no 16 MB ceiling, so
+the first signal is a compile error on a TPU pod at deploy time. The
+repo convention is that each module calling ``pallas_call`` exposes a
+module-level ``vmem_bytes(...)`` function computing the per-grid-step
+resident footprint from the SAME tile constants / tile planners the
+``BlockSpec``s use, so benches and smoke tools can assert the budget
+without lowering. This rule pins the convention statically:
+
+- a module that calls ``pallas_call`` but defines no module-level
+  ``vmem_bytes`` is a finding (undeclared budget);
+- ``vmem_bytes`` (including any module-level helpers it calls,
+  transitively) must reference every module-level ``TILE_*`` constant
+  — a tile dim the budget does not account for means the declared
+  bound and the actual kernel footprint have diverged;
+- if the module declares no ``TILE_*`` constants (tile sizes come from
+  a planner), the ``vmem_bytes`` closure must still reference at least
+  one module-level ALL_CAPS constant (the budget cap the planner
+  enforces, e.g. ``_TEMP_BUDGET``) — otherwise the declaration is
+  detached from anything the kernel actually obeys.
+
+Fixture-only modules and non-pallas code never trigger: the rule keys
+strictly on the presence of a ``pallas_call`` callsite.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted_name,
+)
+
+RULE_ID = "vmem-budget"
+
+_CONST_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+_TILE_RE = re.compile(r"^TILE_[A-Z0-9_]+$")
+
+
+def _module_constants(tree: ast.Module) -> Set[str]:
+    """Top-level ALL_CAPS assignment targets (leading underscore ok)."""
+    out: Set[str] = set()
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and _CONST_RE.match(t.id):
+                out.add(t.id)
+    return out
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, ast.AST]:
+    return {
+        node.name: node
+        for node in tree.body
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _referenced_names(fn: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(fn) if isinstance(n, ast.Name)
+    }
+
+
+def _closure_names(
+    fn: ast.AST, functions: Dict[str, ast.AST]
+) -> Set[str]:
+    """Names referenced by ``fn`` plus, transitively, by every
+    module-level function it references (the tile-planner hop:
+    ``vmem_bytes`` -> ``_pick_tiles`` -> ``_TEMP_BUDGET``)."""
+    seen_fns: Set[str] = set()
+    names: Set[str] = set()
+    work = [fn]
+    while work:
+        cur = work.pop()
+        for name in _referenced_names(cur):
+            names.add(name)
+            if name in functions and name not in seen_fns:
+                seen_fns.add(name)
+                work.append(functions[name])
+    return names
+
+
+def _first_pallas_call(tree: ast.Module) -> Optional[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            if callee and callee.split(".")[-1] == "pallas_call":
+                return node
+    return None
+
+
+class VmemBudgetRule(Rule):
+    id = RULE_ID
+    description = (
+        "pallas kernel modules declare vmem_bytes and the declaration "
+        "references the tile constants that bound the VMEM temporary"
+    )
+
+    def check(
+        self, sf: SourceFile, ctx: AnalysisContext
+    ) -> Iterable[Finding]:
+        if sf.tree is None:
+            return
+        site = _first_pallas_call(sf.tree)
+        if site is None:
+            return
+        functions = _module_functions(sf.tree)
+        budget = functions.get("vmem_bytes")
+        if budget is None:
+            yield Finding(
+                rule=self.id,
+                path=sf.path,
+                line=site.lineno,
+                col=site.col_offset,
+                message=(
+                    "module calls pallas_call but declares no module-"
+                    "level vmem_bytes budget function"
+                ),
+            )
+            return
+        consts = _module_constants(sf.tree)
+        tiles = sorted(c for c in consts if _TILE_RE.match(c))
+        closure = _closure_names(budget, functions)
+        missing = [t for t in tiles if t not in closure]
+        for tile in missing:
+            yield Finding(
+                rule=self.id,
+                path=sf.path,
+                line=budget.lineno,
+                col=budget.col_offset,
+                message=(
+                    f"vmem_bytes does not account for tile constant "
+                    f"{tile}: the declared budget no longer bounds "
+                    f"the kernel's VMEM temporary"
+                ),
+            )
+        if not tiles and not (closure & consts):
+            yield Finding(
+                rule=self.id,
+                path=sf.path,
+                line=budget.lineno,
+                col=budget.col_offset,
+                message=(
+                    "vmem_bytes references no module-level tile or "
+                    "budget constant; the declaration is detached "
+                    "from what the kernel obeys"
+                ),
+            )

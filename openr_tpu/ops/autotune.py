@@ -38,6 +38,66 @@ from openr_tpu.telemetry import get_registry
 
 _PERSIST_FILE = "autotune.json"
 
+# kernel family -> legal winner names. Persistence is keyed on the
+# family and every loaded entry is validated against it, so a winner
+# measured for one family can never be replayed onto a dispatch of
+# another that shares the same (platform, shape) — e.g. a dense
+# "pallas_t" minplus winner silently arming the sparse ell_relax
+# dispatch, which has no such implementation. Unknown families and
+# out-of-family winners are dropped on load (re-measured), never fatal.
+_FAMILY_CANDIDATES = {
+    "minplus": ("jnp", "pallas"),
+    "grouped_minplus": ("jnp", "pallas", "pallas_t"),
+    "ell_relax": ("jnp", "pallas"),
+}
+
+_SCHEMA_VERSION = 2
+
+
+def _valid_entry(key: str, entry) -> Optional[Tuple[str, str]]:
+    """(family, winner) when the persisted entry is adoptable, else
+    None. Keys are ``platform:family:shape``; v2 entries also carry an
+    explicit ``family`` field that must agree with the key (a mismatch
+    means the file was hand-edited or corrupted — re-measure)."""
+    if not isinstance(entry, dict):
+        return None
+    winner = entry.get("winner")
+    parts = key.split(":")
+    if len(parts) != 3 or not isinstance(winner, str):
+        return None
+    family = parts[1]
+    if family not in _FAMILY_CANDIDATES:
+        return None
+    if winner not in _FAMILY_CANDIDATES[family]:
+        return None
+    tagged = entry.get("family")
+    if tagged is not None and tagged != family:
+        return None
+    return family, winner
+
+
+def _parse_persisted(data) -> Dict[str, Dict]:
+    """Lenient reader for both schemas: v2 ``{"version": 2, "winners":
+    {...}}`` and the legacy flat ``{key: {"winner": ...}}`` dict.
+    Invalid/unknown entries are dropped (those keys re-measure)."""
+    if not isinstance(data, dict):
+        return {}
+    winners = data.get("winners", data)
+    if not isinstance(winners, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    for key, entry in winners.items():
+        ok = _valid_entry(key, entry)
+        if ok is None:
+            continue
+        family, winner = ok
+        out[key] = {
+            "family": family,
+            "winner": winner,
+            "ms": entry.get("ms", {}),
+        }
+    return out
+
 
 def _default_measure(thunk: Callable[[], None], reps: int = 3) -> float:
     """Best-of-reps wall time in ms; one untimed warmup run eats the
@@ -73,8 +133,8 @@ class Autotuner:
                 with open(path) as f:
                     data = json.load(f)
                 self._winners.update({
-                    k: v["winner"] for k, v in data.items()
-                    if isinstance(v, dict) and "winner" in v
+                    k: v["winner"]
+                    for k, v in _parse_persisted(data).items()
                 })
             except Exception:  # noqa: BLE001 - cache is best-effort
                 pass
@@ -85,13 +145,21 @@ class Autotuner:
         if not path:
             return
         try:
-            data = {}
+            winners = {}
             if os.path.exists(path):
                 with open(path) as f:
-                    data = json.load(f)
-            data[key] = {"winner": winner, "ms": timings}
+                    # legacy flat files migrate here: valid entries are
+                    # rewritten under the v2 schema, invalid ones drop
+                    winners = _parse_persisted(json.load(f))
+            family = key.split(":")[1]
+            winners[key] = {
+                "family": family, "winner": winner, "ms": timings,
+            }
             with open(path, "w") as f:
-                json.dump(data, f, indent=1, sort_keys=True)
+                json.dump(
+                    {"version": _SCHEMA_VERSION, "winners": winners},
+                    f, indent=1, sort_keys=True,
+                )
         except Exception:  # noqa: BLE001 - cache is best-effort
             pass
 
@@ -102,6 +170,8 @@ class Autotuner:
         synthetic contraction) — memoized and persisted exactly like a
         ``pick`` result, so later processes inherit the bench's
         measurement."""
+        assert kernel in _FAMILY_CANDIDATES, kernel
+        assert winner in _FAMILY_CANDIDATES[kernel], (kernel, winner)
         self._load()
         platform = jax.devices()[0].platform
         key = f"{platform}:{kernel}:{shape_key}"
@@ -205,4 +275,42 @@ def resolve_grouped(shape: Tuple[int, int, int, int]) -> str:
         "grouped_minplus", f"{b}x{g}x{s}x{r}",
         {"jnp": thunk("jnp"), "pallas": thunk("pallas"),
          "pallas_t": thunk("pallas_t")},
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("impl",))
+def _ell_relax_probe(d, src, w, overloaded, impl):
+    from openr_tpu.ops.spf_sparse import _uniform_relax
+
+    return _uniform_relax(d, src, w, overloaded, impl=impl)
+
+
+def resolve_ell_relax(shape: Tuple[int, int]) -> str:
+    """Measured jnp-vs-pallas winner for the sliced-ELL relaxation at
+    this (n_pad, k_slot) band shape. The probe runs the single-band
+    uniform relax (identical algebra to the banded kernel — the slot
+    class the shape key describes) on synthetic operands: a
+    [TILE_S, n] distance panel against [n, k] slot tensors. The S
+    extent is excluded from the key on purpose: it varies per dispatch
+    (view batches, all-sources blocks, sweep batches) while the band
+    geometry — which decides gather locality, the thing being measured
+    — does not."""
+    from openr_tpu.ops.spf import INF
+
+    n, k = (int(x) for x in shape)
+
+    def thunk(impl):
+        d = jnp.full((8, n), INF // 2, jnp.int32)
+        src = jnp.zeros((n, k), jnp.int32)
+        w = jnp.full((n, k), INF // 2, jnp.int32)
+        ov = jnp.zeros((n,), jnp.bool_)
+
+        def run():
+            _ell_relax_probe(d, src, w, ov, impl).block_until_ready()
+
+        return run
+
+    return _TUNER.pick(
+        "ell_relax", f"{n}x{k}",
+        {"jnp": thunk("jnp"), "pallas": thunk("pallas")},
     )

@@ -1,0 +1,270 @@
+"""Pallas TPU kernel for the sliced-ELL relaxation — the sparse hot
+path (ops.spf_sparse._ell_relax) as an explicit VMEM-tiled kernel.
+
+Per band the relaxation computes, for every source row s and band row j:
+
+    out[s, j] = min(d[s, j], min_slot(d[s, src[j, slot]] + w[j, slot]))
+
+with the overload mask folded in (edges originating at overloaded nodes
+never extend paths: w_eff = INF where overloaded[src]). The jnp
+formulation leaves the [S, rows, k] gather+broadcast to XLA — the
+single hottest dispatch in the system at scale (every warm churn solve,
+frontier re-solve and batched-world dispatch iterates it to the fixed
+point). This kernel tiles it so the work stays in VMEM:
+
+  - the source-rows distance panel is the RESIDENT block: one
+    (TILE_S, n_pad) panel per sublane step, reused across the whole
+    band-row sweep (at 100k nodes: 8 x 100096 x 4 B ~= 3.2 MB —
+    comfortably inside the ~16 MB VMEM budget, see vmem_bytes);
+  - the (src, w) slot panels stream through as (TILE_N, k) blocks
+    (k is the full slot extent — legal at any size per Mosaic's
+    full-extent rule; TILE_N = 128 rides the lane axis);
+  - the gather temporary is (TILE_S, TILE_N, k) int32 — the largest
+    per-step allocation, bounded by the declared tile dims.
+
+Padding discipline (provably inert): band rows pad to a TILE_N multiple
+with src = 0 (a valid gather index) and w = INF — min(d + INF -> INF)
+never wins, and the padded output columns are sliced off; source rows
+pad to a TILE_S multiple with d = INF — garbage rows, also sliced off.
+INF = 2^30 - 1 keeps d + w < 2^31 (no int32 overflow), exactly the jnp
+kernel's saturation contract, so the result is BIT-identical (int32
+exact) to the jnp formulation — the unique-fixed-point property of the
+int32 min-relaxation then makes every downstream fixed point identical
+too, which is what the parity suites assert.
+
+Three variants mirror the three jnp relax flavors:
+
+  - ``ell_band_relax``: the plain banded relax (spf_sparse._ell_relax)
+  - ``ell_band_relax_masked``: + a per-batch edge exclusion mask
+    (spf_sparse._ell_relax_masked, the KSP2 second-path graphs)
+  - ``rev_band_relax``: the reversed-graph sweep relax with the
+    row-dependent transit mask (route_sweep._rev_relax): edge (s -> v)
+    extends a v ~> t path unless v is overloaded and v != t.
+
+Like the dense and grouped kernels, selection is BY MEASUREMENT
+(ops.autotune, family key "ell_relax"); ``interpret=None`` resolves to
+interpret mode off-TPU so tier-1 gates bit parity on CPU without
+hardware. On-hardware risk to note: the in-kernel gather ``d[:, src]``
+relies on Mosaic's dynamic-gather lowering — the scale bench's
+``ell_kernel_bench`` leg is the on-chip acceptance run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+INF = np.int32((1 << 30) - 1)
+
+TILE_S = 8  # source rows per grid step (sublane axis of the d panel)
+TILE_N = 128  # band rows per grid step (lane axis)
+
+
+def _pad_to(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def vmem_bytes(n_pad: int, k: int, masked: bool = False) -> int:
+    """Per-grid-step VMEM bound in bytes, from the declared tile dims:
+    the resident (TILE_S, n_pad) distance panel, the streaming
+    (TILE_N, k) src/w panels, the (1, n_pad) overload row, the
+    (TILE_S, TILE_N) current/output blocks, and the (TILE_S, TILE_N, k)
+    gather temporary (doubled when a per-batch mask block rides along).
+    The autotuner never needs this — it measures — but the kernel-smoke
+    gate and the vmem-budget lint both check the declared tiles bound
+    the temporary."""
+    elems = (
+        TILE_S * n_pad  # resident distance panel
+        + 2 * TILE_N * k  # src + w slot panels
+        + n_pad  # overload row
+        + 2 * TILE_S * TILE_N  # d_cur block + output block
+        + TILE_S * TILE_N * k  # gather temporary
+    )
+    if masked:
+        elems += 2 * TILE_S * TILE_N * k  # mask block + masked weights
+    return elems * 4
+
+
+def _relax_kernel(d_ref, src_ref, w_ref, ov_ref, dcur_ref, o_ref):
+    src = src_ref[...]  # (TILE_N, k)
+    ov = ov_ref[0, :]  # (n_pad,) int32 (0/1)
+    w_eff = jnp.where(ov[src] != 0, INF, w_ref[...])  # (TILE_N, k)
+    g = d_ref[...][:, src]  # (TILE_S, TILE_N, k) gather
+    relaxed = jnp.min(
+        jnp.minimum(g + w_eff[None, :, :], INF), axis=2
+    ).astype(jnp.int32)
+    o_ref[...] = jnp.minimum(dcur_ref[...], relaxed)
+
+
+def _masked_relax_kernel(d_ref, src_ref, w_ref, m_ref, ov_ref,
+                         dcur_ref, o_ref):
+    src = src_ref[...]  # (TILE_N, k)
+    ov = ov_ref[0, :]
+    w_eff = jnp.where(ov[src] != 0, INF, w_ref[...])  # (TILE_N, k)
+    m = m_ref[...]  # (TILE_S, TILE_N, k) int32 (0/1)
+    w_b = jnp.where(m != 0, INF, w_eff[None, :, :])
+    g = d_ref[...][:, src]
+    relaxed = jnp.min(jnp.minimum(g + w_b, INF), axis=2).astype(
+        jnp.int32
+    )
+    o_ref[...] = jnp.minimum(dcur_ref[...], relaxed)
+
+
+def _rev_relax_kernel(d_ref, v_ref, w_ref, t_ref, ov_ref, dcur_ref,
+                      o_ref):
+    v = v_ref[...]  # (TILE_N, k)
+    ov_g = ov_ref[0, :][v] != 0  # (TILE_N, k)
+    t = t_ref[...]  # (TILE_S, 1)
+    # edge (s -> v) extends a v ~> t path unless v is overloaded
+    # transit (v != t): row-dependent, never source-dependent
+    blocked = ov_g[None, :, :] & (v[None, :, :] != t[:, :, None])
+    w_eff = jnp.where(blocked, INF, w_ref[...][None, :, :])
+    g = d_ref[...][:, v]
+    relaxed = jnp.min(jnp.minimum(g + w_eff, INF), axis=2).astype(
+        jnp.int32
+    )
+    o_ref[...] = jnp.minimum(dcur_ref[...], relaxed)
+
+
+def _interpret(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.devices()[0].platform != "tpu"
+
+
+def _pad_band(d, src, w, pos, rows):
+    """Shared inert-padding prep: returns (d_padded [s_pad, n_pad],
+    src/w [rows_pad, k], d_cur [s_pad, rows_pad], s_pad, rows_pad,
+    real (s, rows)). pos/rows are static band coordinates."""
+    s, _n_pad = d.shape
+    s_pad = _pad_to(max(s, TILE_S), TILE_S)
+    rows_pad = _pad_to(max(rows, TILE_N), TILE_N)
+    d_cur = d[:, pos : pos + rows]
+    if s_pad != s:
+        d = jnp.pad(d, ((0, s_pad - s), (0, 0)), constant_values=INF)
+    d_cur = jnp.pad(
+        d_cur,
+        ((0, s_pad - s), (0, rows_pad - rows)),
+        constant_values=INF,
+    )
+    src_p = jnp.pad(src, ((0, rows_pad - rows), (0, 0)))
+    w_p = jnp.pad(
+        w, ((0, rows_pad - rows), (0, 0)), constant_values=INF
+    )
+    return d, src_p, w_p, d_cur, s_pad, rows_pad, (s, rows)
+
+
+def _ov_row(overloaded):
+    """[n_pad] bool -> (1, n_pad) int32: Mosaic handles int32 blocks
+    uniformly; the kernels test `!= 0`."""
+    return overloaded.astype(jnp.int32)[None, :]
+
+
+def _run(kernel, operands, in_specs, s_pad, rows_pad, real, interpret):
+    s, rows = real
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((s_pad, rows_pad), jnp.int32),
+        grid=(s_pad // TILE_S, rows_pad // TILE_N),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((TILE_S, TILE_N), lambda i, j: (i, j)),
+        interpret=_interpret(interpret),
+    )(*operands)
+    return out[:s, :rows]
+
+
+# the shared block plan: d panel resident across the j sweep, slot
+# panels streaming, overload row broadcast, current/output tiled
+def _d_spec(n_pad):
+    return pl.BlockSpec((TILE_S, n_pad), lambda i, j: (i, 0))
+
+
+def _slot_spec(k):
+    return pl.BlockSpec((TILE_N, k), lambda i, j: (j, 0))
+
+
+def _ov_spec(n_pad):
+    return pl.BlockSpec((1, n_pad), lambda i, j: (0, 0))
+
+
+def _tile_spec():
+    return pl.BlockSpec((TILE_S, TILE_N), lambda i, j: (i, j))
+
+
+def ell_band_relax(d, src, w, overloaded, pos, interpret=None):
+    """One band of the plain sliced-ELL relax: d [S, n_pad], band
+    tensors src/w [rows, k], overloaded [n_pad] bool; returns the
+    band's output block [S, rows] = min(d[:, pos:pos+rows],
+    min_slot(d[:, src] + w_eff)). Bit-identical to the jnp band body
+    in spf_sparse._ell_relax."""
+    rows = src.shape[0]
+    k = src.shape[1]
+    n_pad = d.shape[1]
+    d_p, src_p, w_p, d_cur, s_pad, rows_pad, real = _pad_band(
+        d, src, w, pos, rows
+    )
+    return _run(
+        _relax_kernel,
+        [d_p, src_p, w_p, _ov_row(overloaded), d_cur],
+        [
+            _d_spec(n_pad), _slot_spec(k), _slot_spec(k),
+            _ov_spec(n_pad), _tile_spec(),
+        ],
+        s_pad, rows_pad, real, interpret,
+    )
+
+
+def ell_band_relax_masked(d, src, w, mask, overloaded, pos,
+                          interpret=None):
+    """One band of the per-batch-masked relax (KSP2 second-path
+    graphs): mask [S, rows, k] bool, True == edge excluded for that
+    batch element. Bit-identical to spf_sparse._ell_relax_masked's
+    band body."""
+    rows = src.shape[0]
+    k = src.shape[1]
+    n_pad = d.shape[1]
+    d_p, src_p, w_p, d_cur, s_pad, rows_pad, real = _pad_band(
+        d, src, w, pos, rows
+    )
+    s = d.shape[0]
+    m = jnp.pad(
+        mask.astype(jnp.int32),
+        ((0, s_pad - s), (0, rows_pad - rows), (0, 0)),
+    )
+    return _run(
+        _masked_relax_kernel,
+        [d_p, src_p, w_p, m, _ov_row(overloaded), d_cur],
+        [
+            _d_spec(n_pad), _slot_spec(k), _slot_spec(k),
+            pl.BlockSpec((TILE_S, TILE_N, k), lambda i, j: (i, j, 0)),
+            _ov_spec(n_pad), _tile_spec(),
+        ],
+        s_pad, rows_pad, real, interpret,
+    )
+
+
+def rev_band_relax(d, v, w, t_ids, overloaded, pos, interpret=None):
+    """One band of the reversed-graph sweep relax (route_sweep
+    ._rev_relax): t_ids [S] destination ids; the transit mask blocks
+    edge (s -> v) when v is overloaded and v != t. Bit-identical to
+    the jnp band body."""
+    rows = v.shape[0]
+    k = v.shape[1]
+    n_pad = d.shape[1]
+    d_p, v_p, w_p, d_cur, s_pad, rows_pad, real = _pad_band(
+        d, v, w, pos, rows
+    )
+    s = d.shape[0]
+    t = jnp.pad(t_ids.astype(jnp.int32), (0, s_pad - s))[:, None]
+    return _run(
+        _rev_relax_kernel,
+        [d_p, v_p, w_p, t, _ov_row(overloaded), d_cur],
+        [
+            _d_spec(n_pad), _slot_spec(k), _slot_spec(k),
+            pl.BlockSpec((TILE_S, 1), lambda i, j: (i, 0)),
+            _ov_spec(n_pad), _tile_spec(),
+        ],
+        s_pad, rows_pad, real, interpret,
+    )

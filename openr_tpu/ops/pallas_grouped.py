@@ -71,6 +71,50 @@ def _group_plan(g: int, per_group: int):
     return tg, _pad_to(g, tg)
 
 
+def _per_group(tb: int, ts: int, tr: int) -> int:
+    """int32 elements of per-group VMEM at one grid step (standard
+    layout): the gath (TB,TS) and weight (TS,TR) input blocks, the
+    output (TB,TR), and the (TB,8,TR) broadcast temporary. Count
+    TILED sizes: VMEM lays the last-two dims out in (8, 128) tiles,
+    so a tiny trailing dim still occupies full lanes — raw element
+    counts under-estimated a TR=4 segment 32x and blew the 16 MB
+    scoped-vmem limit on-chip (measured on v5e at 1008)."""
+    lanes_s = _pad_to(ts, 128)
+    lanes_r = _pad_to(tr, 128)
+    return (
+        tb * lanes_s  # gath block (tb, ts)
+        + _pad_to(ts, 8) * lanes_r  # weight block (ts, tr)
+        + tb * lanes_r  # output block (tb, tr)
+        + tb * 8 * lanes_r  # broadcast temp (tb, 8, tr)
+    )
+
+
+def _per_group_t(tb: int, ts: int, tr: int) -> int:
+    """Per-group VMEM elements for the TRANSPOSED layout (lanes =
+    batch): b rides the lane axis, r rides sublanes."""
+    lanes_b = _pad_to(tb, 128)
+    return (
+        _pad_to(ts, 8) * lanes_b  # gath block (ts, tb)
+        + _pad_to(ts, 8) * _pad_to(tr, 128)  # weight block (ts, tr)
+        + _pad_to(tr, 8) * lanes_b  # output block (tr, tb)
+        + 8 * _pad_to(tr, 8) * lanes_b  # broadcast temp (8, tr, tb)
+    )
+
+
+def vmem_bytes(g: int, b_pad: int, s: int, r: int,
+               transposed: bool = False) -> int:
+    """Planned per-grid-step VMEM residency in bytes for the [B,G,S] x
+    [G,S,R] contraction at this shape — TG groups times the per-group
+    blocks+temporary the planner budgeted under ``_TEMP_BUDGET``. The
+    planner guarantees TG * per_group <= _TEMP_BUDGET elements (4 MB)
+    unless a single group alone exceeds the budget (TG floors at 1)."""
+    if transposed:
+        tg, _, tb, _, _, ts, _, tr = _pick_tiles_t(g, b_pad, s, r)
+        return tg * _per_group_t(tb, ts, tr) * 4
+    tg, _, tb, _, _, ts, _, tr = _pick_tiles(g, b_pad, s, r)
+    return tg * _per_group(tb, ts, tr) * 4
+
+
 def _accumulate(o_ref, acc, s_idx):
     """INF-clamp + s-grid revisit discipline shared by both kernels:
     the output tile is INF-initialized on the first s step and
@@ -97,22 +141,9 @@ def _pick_tiles(g: int, b_pad: int, s: int, r: int):
         r_pad, tr = _pad_to(r, 128), 128
     # s is chunked by 8 inside the kernel -> 8-mult; block cap _S_CAP
     s_pad, ts = _s_plan(s)
-    # groups per step: bound TOTAL per-step VMEM, counting the gath
-    # (TG,TB,TS) and weight (TG,TS,TR) input blocks and the output
-    # (TG,TB,TR) alongside the (TG,TB,8,TR) broadcast temporary.
-    # Count TILED sizes: VMEM lays the last-two dims out in (8, 128)
-    # tiles, so a tiny trailing dim still occupies full lanes — raw
-    # element counts under-estimated a TR=4 segment 32x and blew the
-    # 16 MB scoped-vmem limit on-chip (measured on v5e at 1008).
-    lanes_s = _pad_to(ts, 128)
-    lanes_r = _pad_to(tr, 128)
-    per_group = (
-        tb * lanes_s  # gath block (tb, ts)
-        + _pad_to(ts, 8) * lanes_r  # weight block (ts, tr)
-        + tb * lanes_r  # output block (tb, tr)
-        + tb * 8 * lanes_r  # broadcast temp (tb, 8, tr)
-    )
-    tg, g_pad = _group_plan(g, per_group)
+    # groups per step: bound TOTAL per-step VMEM (blocks + broadcast
+    # temporary; tiled sizes — see _per_group)
+    tg, g_pad = _group_plan(g, _per_group(tb, ts, tr))
     return tg, g_pad, tb, b_ok, s_pad, ts, r_pad, tr
 
 
@@ -147,14 +178,7 @@ def _pick_tiles_t(g: int, b_pad: int, s: int, r: int):
     # r rides SUBLANES here: 8-aligned, same cap/revisit shape as s
     r_pad, tr = _s_plan(r)
     s_pad, ts = _s_plan(s)
-    lanes_b = _pad_to(tb, 128)
-    per_group = (
-        _pad_to(ts, 8) * lanes_b  # gath block (ts, tb)
-        + _pad_to(ts, 8) * _pad_to(tr, 128)  # weight block (ts, tr)
-        + _pad_to(tr, 8) * lanes_b  # output block (tr, tb)
-        + 8 * _pad_to(tr, 8) * lanes_b  # broadcast temp (8, tr, tb)
-    )
-    tg, g_pad = _group_plan(g, per_group)
+    tg, g_pad = _group_plan(g, _per_group_t(tb, ts, tr))
     return tg, g_pad, tb, b_ok, s_pad, ts, r_pad, tr
 
 

@@ -37,6 +37,20 @@ TILE_N = 128
 TILE_K = 128
 
 
+def vmem_bytes() -> int:
+    """Per-grid-step VMEM residency in bytes: the a/b input blocks,
+    the revisited output tile, and the (TS, TK, TN) broadcast
+    temporary — all int32. The tile sizes are static, so the budget
+    is a constant (~0.6 MB), independent of N."""
+    elems = (
+        TILE_S * TILE_K  # a block
+        + TILE_K * TILE_N  # b block
+        + TILE_S * TILE_N  # output tile
+        + TILE_S * TILE_K * TILE_N  # broadcast temporary
+    )
+    return elems * 4
+
+
 def _minplus_kernel(a_ref, b_ref, o_ref):
     k = pl.program_id(2)
     a = a_ref[...]  # (TILE_S, TILE_K)

@@ -87,6 +87,7 @@ from openr_tpu.ops.spf_sparse import (
     _out_edges,
     _tenant_view_solve,
     compile_ell,
+    ell_dispatch,
     ell_patch,
     pad_patch_rows,
 )
@@ -1121,7 +1122,7 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip cold
             # build (mesh is None): one device, no axis to spec
-            return aot_call(
+            return ell_dispatch(
                 "ell_full_resident", _full_resident_sweep,
                 (
                     self.sweeper.v_t, self.sweeper.w_t,
@@ -1132,7 +1133,7 @@ class RouteSweepEngine(ResidentEngineContract):
                 ),
                 dict(bands=graph.bands, n=graph.n_pad),
             )
-        return aot_call(
+        return ell_dispatch(
             "ell_full_resident_sharded", _sharded_full_resident,
             (
                 self.sweeper.v_t, self.sweeper.w_t,
@@ -1305,7 +1306,7 @@ class RouteSweepEngine(ResidentEngineContract):
              # openr-lint: disable=sharding-spec -- single-chip churn
              # dispatch (mesh is None): no mesh axis to spec; the mesh
              # branch below rides _sharded_churn_step's shard_map specs
-             packed_dev) = aot_call(
+             packed_dev) = ell_dispatch(
                 "ell_churn_step", _churn_step,
                 (
                     ctx["in_v"], ctx["in_w"],
@@ -1331,7 +1332,7 @@ class RouteSweepEngine(ResidentEngineContract):
             if ctx["patched_bands"] is None:
                 ctx["patched_bands"] = self._dispatch_patch(ctx)
             new_v, new_w_t = ctx["patched_bands"]
-            dr, digests, packed_res, packed_dev = aot_call(
+            dr, digests, packed_res, packed_dev = ell_dispatch(
                 "ell_churn_step_sharded", _sharded_churn_step,
                 (
                     new_v, new_w_t,
@@ -1539,7 +1540,7 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # probe (mesh is None): no mesh axis to spec
-            return aot_call(
+            return ell_dispatch(
                 "ell_frontier_probe", _frontier_probe,
                 (
                     self.sweeper.v_t, self.sweeper.w_t, self._dr,
@@ -1550,7 +1551,7 @@ class RouteSweepEngine(ResidentEngineContract):
                     max_jumps=_FRONTIER_MAX_JUMPS,
                 ),
             )
-        return aot_call(
+        return ell_dispatch(
             "ell_frontier_probe_sharded", _sharded_frontier_probe,
             (
                 self.sweeper.v_t, self.sweeper.w_t, self._dr,
@@ -1574,7 +1575,7 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip frontier
             # re-solve (mesh is None): no mesh axis to spec
-            return aot_call(
+            return ell_dispatch(
                 "ell_frontier_step", _frontier_step,
                 (
                     self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
@@ -1585,7 +1586,7 @@ class RouteSweepEngine(ResidentEngineContract):
                 ),
                 dict(bands=self.graph.bands, n=self.graph.n_pad),
             )
-        return aot_call(
+        return ell_dispatch(
             "ell_frontier_step_sharded", _sharded_frontier_step,
             (
                 self.sweeper.v_t, self.sweeper.w_t, cone, self._dr,
@@ -1621,7 +1622,7 @@ class RouteSweepEngine(ResidentEngineContract):
         if self.mesh is None:
             # openr-lint: disable=sharding-spec -- single-chip fused
             # overflow chain (mesh is None): no mesh axis to spec
-            return aot_call(
+            return ell_dispatch(
                 "ell_overflow_chain", _overflow_chain,
                 (
                     self.sweeper.v_t, self.sweeper.w_t, new_v, new_w,
@@ -1636,7 +1637,7 @@ class RouteSweepEngine(ResidentEngineContract):
                     n_real=self.graph.n, max_jumps=_FRONTIER_MAX_JUMPS,
                 ),
             )
-        return aot_call(
+        return ell_dispatch(
             "ell_overflow_chain_sharded", _sharded_overflow_chain,
             (
                 self.sweeper.v_t, self.sweeper.w_t, new_v, new_w,

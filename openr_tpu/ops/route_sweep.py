@@ -64,6 +64,7 @@ from openr_tpu.ops.spf import INF
 from openr_tpu.ops.spf_sparse import (
     EllGraph,
     _as_device_ids,
+    _ell_impl_for,
     compile_ell,
 )
 
@@ -86,12 +87,29 @@ def compile_out_ell(ls, align: int = 128) -> EllGraph:
     return compile_ell(ls, align=align, direction="out")
 
 
-def _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids):
+def _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids, impl=None):
     """One reversed-graph relaxation [B, N] -> [B, N] with the
     row-dependent transit mask: edge (s -> v) may extend a v ~> t path
-    unless v is overloaded and v != t."""
+    unless v is overloaded and v != t. ``impl`` follows the shared
+    sliced-ELL selector (spf_sparse._ell_impl_for): "pallas" runs the
+    VMEM-tiled band kernel (ops.pallas_ell.rev_band_relax), and the
+    destination-digest equivalence check in this module's contract
+    gates that it is bit-identical."""
+    if impl is None:
+        impl = _ell_impl_for(dr.shape[1], max(b.k for b in bands))
     parts = []
     pos = 0
+    if impl == "pallas":
+        from openr_tpu.ops.pallas_ell import rev_band_relax
+
+        for band, v_b, w_b in zip(bands, v_t, w_t):
+            assert band.start == pos, (band, pos)
+            parts.append(
+                rev_band_relax(dr, v_b, w_b, t_ids, overloaded, pos)
+            )
+            pos += band.rows
+        parts.append(dr[:, pos:])  # padding columns: unchanged
+        return jnp.concatenate(parts, axis=1)
     for band, v_b, w_b in zip(bands, v_t, w_t):
         assert band.start == pos, (band, pos)
         blocked = overloaded[v_b][None, :, :] & (
@@ -109,7 +127,7 @@ def _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids):
 
 
 def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None,
-                     init=None):
+                     init=None, impl=None):
     """DR rows [B, N] for destination batch ``t_ids`` from unit init.
     ``vote`` lifts the local convergence bit to a global one (psum) for
     the sharded variant, mirroring spf_sparse._ell_fixed_point.
@@ -117,7 +135,11 @@ def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None,
     the new fixed point (e.g. the pre-patch resident rows outside the
     increase-affected cone); the unit anchor is min-ed in, and the
     int32 min-relaxation's unique fixed point keeps the result
-    bit-identical to the cold solve."""
+    bit-identical to the cold solve. ``impl`` as in _rev_relax —
+    resolved ONCE here so every loop iteration bakes the same
+    kernel."""
+    if impl is None:
+        impl = _ell_impl_for(n, max(b.k for b in bands))
     b = t_ids.shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(b), t_ids].set(0)
@@ -129,7 +151,8 @@ def _rev_fixed_point(bands, v_t, w_t, overloaded, t_ids, n, vote=None,
 
     def body(state):
         dr, _, it = state
-        nxt = _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids)
+        nxt = _rev_relax(dr, bands, v_t, w_t, overloaded, t_ids,
+                         impl=impl)
         local = jnp.any(nxt < dr).astype(jnp.int32)
         return nxt, local if vote is None else vote(local), it + 1
 

@@ -37,6 +37,7 @@ plus the O(E) edge list — well inside HBM.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from dataclasses import dataclass, replace as _replace
 from typing import Dict, List, Optional, Tuple
@@ -80,6 +81,70 @@ ELL_COUNTERS = _get_registry().counter_dict(
     ],
     prefix="decision.",
 )
+
+
+# Sliced-ELL relax implementation selector — the sparse twin of
+# ops.spf's min-plus selector: "jnp" (XLA gather+broadcast), "pallas"
+# (explicit VMEM tiling, openr_tpu.ops.pallas_ell), or "auto" — a
+# MEASURED per-(n_pad, k_slot) winner from ops.autotune (family
+# "ell_relax"). Resolution happens at TRACE time inside the relax
+# primitives below (the autotune probe runs eagerly on concrete
+# synthetic operands, so an enclosing trace never sees "auto" — only
+# the resolved impl is baked into the executable). The committed AOT
+# dispatch tags re-key through ``ell_dispatch`` when a non-default
+# kernel is armed, so flipping the impl can never replay a stale
+# executable; the one plain-jit hot path (_ell_reconverge) carries the
+# resolved impl as an ordinary static argument for the same reason.
+_ELL_IMPL = os.environ.get("OPENR_ELL_RELAX", "jnp")
+
+
+def set_ell_relax_impl(impl: str) -> None:
+    global _ELL_IMPL
+    assert impl in ("jnp", "pallas", "auto"), impl
+    _ELL_IMPL = impl
+
+
+def get_ell_relax_impl() -> str:
+    return _ELL_IMPL
+
+
+def _ell_impl_for(n: int, k: int) -> str:
+    """Concrete relax impl for one (n_pad, k_slot) band geometry:
+    "auto" resolves to the measured winner (memoized per shape by the
+    autotuner, so the probe pays its compile once per process). A
+    probe failure is never fatal — the jnp formulation is always
+    sound."""
+    if _ELL_IMPL != "auto":
+        return _ELL_IMPL
+    from openr_tpu.ops import autotune
+
+    try:
+        return autotune.resolve_ell_relax((int(n), int(k)))
+    except Exception:  # noqa: BLE001 - measurement is best-effort
+        return "jnp"
+
+
+def ell_dispatch(tag, fn, dyn_args, statics, shape=None):
+    """Committed-dispatch wrapper for executables whose TRACE bakes in
+    the sliced-ELL relax impl (everything that iterates _ell_relax /
+    _ell_relax_masked / _uniform_relax to a fixed point). A cached AOT
+    executable keyed only on (tag, statics, signature) would survive an
+    impl flip and silently keep running the old kernel; this wrapper
+    resolves the concrete impl for the dispatch's band geometry — from
+    ``statics`` (bands + n), or an explicit ``shape=(n, k)`` for
+    uniform-block dispatches — and suffixes the tag (``tag@pallas``)
+    whenever a non-default kernel is armed. The suffix re-keys the AOT
+    cache AND shows up verbatim in ``ops.device_ms.<tag>`` attribution,
+    so the flight recorder sees which kernel actually ran. Inner
+    functions resolve the SAME memoized per-shape winner at trace time,
+    which is what keeps the tag and the traced kernel consistent."""
+    if shape is None:
+        bands = statics["bands"]
+        shape = (statics["n"], max(b.k for b in bands))
+    impl = _ell_impl_for(int(shape[0]), int(shape[1]))
+    if impl != "jnp":
+        tag = f"{tag}@{impl}"
+    return _aot_call(tag, fn, dyn_args, statics)
 
 
 def _pad_up(n: int, align: int) -> int:
@@ -714,12 +779,27 @@ def direct_metrics(graph: EllGraph, src_id: int, node_ids) -> np.ndarray:
     return out
 
 
-def _ell_relax(d, bands, srcs_t, ws_t, overloaded):
+def _ell_relax(d, bands, srcs_t, ws_t, overloaded, impl=None):
     """One masked relaxation over the class bands: [S, N] -> [S, N] as
     pure gather + reduce per band, writing contiguous output slices.
-    Edges originating at overloaded nodes never extend paths."""
+    Edges originating at overloaded nodes never extend paths.
+    ``impl=None`` resolves the selector at trace time (see
+    _ell_impl_for); "pallas" runs the VMEM-tiled band kernel
+    (ops.pallas_ell) — bit-identical by the padding/saturation
+    contract, so every fixed point downstream is too."""
+    if impl is None:
+        impl = _ell_impl_for(d.shape[1], max(b.k for b in bands))
     parts = []
     pos = 0
+    if impl == "pallas":
+        from openr_tpu.ops.pallas_ell import ell_band_relax
+
+        for band, s_b, w_b in zip(bands, srcs_t, ws_t):
+            assert band.start == pos, (band, pos)
+            parts.append(ell_band_relax(d, s_b, w_b, overloaded, pos))
+            pos += band.rows
+        parts.append(d[:, pos:])  # padding columns: unchanged
+        return jnp.concatenate(parts, axis=1)
     for band, s_b, w_b in zip(bands, srcs_t, ws_t):
         assert band.start == pos, (band, pos)
         w_eff = jnp.where(overloaded[s_b], INF, w_b)  # [rows, k]
@@ -774,17 +854,21 @@ def _device_direct_metrics(srcs_t, ws_t, srcs, bands):
     return jnp.where(srcs == src_id, INF, w_sv).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n"))
-def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n):
+@functools.partial(jax.jit, static_argnames=("bands", "n", "ell_impl"))
+def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n,
+                    ell_impl="jnp"):
     """Batched {src} + neighbors distances + packed first hops over the
     sliced-ELL graph — the sparse mirror of ops.spf._spf_view_batch.
-    w_sv: [B] host-computed direct metric source -> batch node."""
+    w_sv: [B] host-computed direct metric source -> batch node.
+    ``ell_impl`` is the resolved relax impl (plain-jit dispatch — the
+    static re-keys on flips, same reasoning as _ell_reconverge)."""
     b = srcs.shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(b), srcs].set(0)
     # init rows: one UNMASKED relax (overloaded sources still originate)
     no_overload = jnp.zeros_like(overloaded)
-    d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload)
+    d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload,
+                    impl=ell_impl)
 
     def cond(state):
         _, changed, it = state
@@ -792,7 +876,8 @@ def _ell_view_batch(srcs_t, ws_t, overloaded, srcs, w_sv, bands, n):
 
     def body(state):
         d, _, it = state
-        nxt = _ell_relax(d, bands, srcs_t, ws_t, overloaded)
+        nxt = _ell_relax(d, bands, srcs_t, ws_t, overloaded,
+                         impl=ell_impl)
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (d0, jnp.bool_(True), 0))
@@ -825,7 +910,7 @@ def _first_hops_from_rows(d, srcs, w_sv, overloaded, n):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bands", "n"),
+    static_argnames=("bands", "n", "ell_impl"),
     # the previous bands and distance rows are dead after the call —
     # donating them lets XLA scatter/relax in place instead of copying
     # multi-hundred-MB band+distance blocks every churn event
@@ -833,12 +918,15 @@ def _first_hops_from_rows(d, srcs, w_sv, overloaded, n):
 )
 def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
                     inc_tail, inc_head, inc_w, overloaded, d_prev,
-                    srcs, bands, n):
+                    srcs, bands, n, ell_impl="jnp"):
     """Fused churn executable: scatter the patched rows, derive the
     direct metrics on device, warm-seed the fixed point from d_prev
     (reset only the increase cone), pack distances + first hops.
     Only the O(rows x K) patch + O(|delta|) increase edges cross
-    host->device; only the packed [2B, N] view crosses back."""
+    host->device; only the packed [2B, N] view crosses back.
+    ``ell_impl`` is the RESOLVED relax impl as an ordinary static
+    argument — this is a plain-jit dispatch (no AOT tag to re-key), so
+    an impl flip must re-key the jit cache instead."""
     new_src = tuple(
         s.at[ids, :].set(ps)
         for s, ids, ps in zip(srcs_t, patch_ids_t, patch_src_t)
@@ -852,7 +940,8 @@ def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(b), srcs].set(0)
     no_overload = jnp.zeros_like(overloaded)
-    d0 = _ell_relax(unit, bands, new_src, new_w, no_overload)
+    d0 = _ell_relax(unit, bands, new_src, new_w, no_overload,
+                    impl=ell_impl)
     seed = _warm_seed(d_prev, inc_tail, inc_head, inc_w, d0)
 
     def cond(state):
@@ -861,7 +950,8 @@ def _ell_reconverge(srcs_t, ws_t, patch_ids_t, patch_src_t, patch_w_t,
 
     def body(state):
         d, _, it = state
-        nxt = _ell_relax(d, bands, new_src, new_w, overloaded)
+        nxt = _ell_relax(d, bands, new_src, new_w, overloaded,
+                         impl=ell_impl)
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (seed, jnp.bool_(True), 0))
@@ -887,6 +977,9 @@ def ell_view_batch_packed(graph: EllGraph, srcs):
         tuple(jnp.asarray(w) for w in graph.w),
         jnp.asarray(graph.overloaded),
         srcs_dev, w_sv, graph.bands, graph.n_pad,
+        ell_impl=_ell_impl_for(
+            graph.n_pad, max(b.k for b in graph.bands)
+        ),
     )
 
 
@@ -913,7 +1006,7 @@ def ell_source_batch(graph: EllGraph, ls, src_name: str):
 
 
 def _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n,
-                     vote=None, warm=None):
+                     vote=None, warm=None, impl=None):
     """Shared ELL relaxation fixed-point: distances [S, N] from unit
     init. ``vote`` turns the local convergence bit into the global
     stop condition (identity when None; a psum over the mesh axis for
@@ -923,12 +1016,16 @@ def _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n,
     originate (reference: LinkState.cpp:831-838). ``warm`` is an
     optional (d_prev, inc_tail, inc_head, inc_w) tuple: seed from the
     previous distances via _warm_seed (bit-identical fixed point,
-    fewer iterations under churn)."""
+    fewer iterations under churn). ``impl`` as in _ell_relax —
+    resolved ONCE here so both the init relax and the loop body bake
+    the same kernel."""
+    if impl is None:
+        impl = _ell_impl_for(n, max(b.k for b in bands))
     s = src_ids.shape[0]
     unit = jnp.full((s, n), INF, dtype=jnp.int32)
     unit = unit.at[jnp.arange(s), src_ids].set(0)
     no_overload = jnp.zeros_like(overloaded)
-    d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload)
+    d0 = _ell_relax(unit, bands, srcs_t, ws_t, no_overload, impl=impl)
     if warm is not None:
         d_prev, inc_tail, inc_head, inc_w = warm
         d0 = _warm_seed(d_prev, inc_tail, inc_head, inc_w, d0)
@@ -939,7 +1036,7 @@ def _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n,
 
     def body(state):
         d, _, it = state
-        nxt = _ell_relax(d, bands, srcs_t, ws_t, overloaded)
+        nxt = _ell_relax(d, bands, srcs_t, ws_t, overloaded, impl=impl)
         local = jnp.any(nxt < d).astype(jnp.int32)
         return nxt, local if vote is None else vote(local), it + 1
 
@@ -947,15 +1044,19 @@ def _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n,
     return d
 
 
-@functools.partial(jax.jit, static_argnames=("bands", "n"))
-def _ell_from_sources(srcs_t, ws_t, overloaded, src_ids, bands, n):
+@functools.partial(jax.jit, static_argnames=("bands", "n", "ell_impl"))
+def _ell_from_sources(srcs_t, ws_t, overloaded, src_ids, bands, n,
+                      ell_impl="jnp"):
     """Distances [S, N] from a batch of sources over the sliced-ELL
     bands — pure gather + K-reduce per band, NO segment-min scatter
     anywhere. This is the all-sources workhorse: the flat-edge-list
     formulation (_sparse_from_sources) spends its time in
     ``jax.ops.segment_min``, which lowers to serialized scatters on
-    TPU; this one vectorizes."""
-    return _ell_fixed_point(srcs_t, ws_t, overloaded, src_ids, bands, n)
+    TPU; this one vectorizes. ``ell_impl`` re-keys the plain-jit cache
+    on kernel flips (see _ell_reconverge)."""
+    return _ell_fixed_point(
+        srcs_t, ws_t, overloaded, src_ids, bands, n, impl=ell_impl
+    )
 
 
 def ell_distances_from_sources(graph: EllGraph, src_ids,
@@ -979,6 +1080,9 @@ def ell_distances_from_sources(graph: EllGraph, src_ids,
         srcs_t, ws_t, ov,
         _as_device_ids(src_ids),
         graph.bands, graph.n_pad,
+        ell_impl=_ell_impl_for(
+            graph.n_pad, max(b.k for b in graph.bands)
+        ),
     )
 
 
@@ -1017,12 +1121,27 @@ def ell_all_sources(graph: EllGraph, block: int = 2048) -> np.ndarray:
     return out
 
 
-def _ell_relax_masked(d, bands, srcs_t, ws_t, masks_t, overloaded):
+def _ell_relax_masked(d, bands, srcs_t, ws_t, masks_t, overloaded,
+                      impl=None):
     """One relaxation with a PER-BATCH edge mask: [B, N] -> [B, N].
     masks_t[bi] is [B, rows, k] bool — True == edge excluded for that
-    batch element (the KSP2 edge-disjoint second-path graphs)."""
+    batch element (the KSP2 edge-disjoint second-path graphs).
+    ``impl`` as in _ell_relax."""
+    if impl is None:
+        impl = _ell_impl_for(d.shape[1], max(b.k for b in bands))
     parts = []
     pos = 0
+    if impl == "pallas":
+        from openr_tpu.ops.pallas_ell import ell_band_relax_masked
+
+        for band, s_b, w_b, m_b in zip(bands, srcs_t, ws_t, masks_t):
+            assert band.start == pos, (band, pos)
+            parts.append(
+                ell_band_relax_masked(d, s_b, w_b, m_b, overloaded, pos)
+            )
+            pos += band.rows
+        parts.append(d[:, pos:])
+        return jnp.concatenate(parts, axis=1)
     for band, s_b, w_b, m_b in zip(bands, srcs_t, ws_t, masks_t):
         assert band.start == pos, (band, pos)
         w_eff = jnp.where(overloaded[s_b], INF, w_b)  # [rows, k]
@@ -1040,7 +1159,7 @@ def _ell_relax_masked(d, bands, srcs_t, ws_t, masks_t, overloaded):
 
 
 def _ell_masked_fixed_point(srcs_t, ws_t, masks_t, overloaded, src_id,
-                            bands, n, vote=None):
+                            bands, n, vote=None, impl=None):
     """Single-source distances over B differently-masked graphs:
     [B, N] — the device half of batched KSP2 second-path computation
     (reference semantics: LinkState.cpp:763 getKthPaths' runSpf with
@@ -1049,12 +1168,17 @@ def _ell_masked_fixed_point(srcs_t, ws_t, masks_t, overloaded, src_id,
     _ell_view_batch). ``vote`` turns the local convergence bit into the
     global stop condition (identity when None; a psum for the sharded
     variant) — the SAME parameterization as _ell_fixed_point, and the
-    ONE home of this loop (three call sites share it)."""
+    ONE home of this loop (three call sites share it). ``impl`` as in
+    _ell_fixed_point — resolved once, shared by init and body."""
+    if impl is None:
+        impl = _ell_impl_for(n, max(b.k for b in bands))
     b = masks_t[0].shape[0]
     unit = jnp.full((b, n), INF, dtype=jnp.int32)
     unit = unit.at[:, src_id].set(0)
     no_overload = jnp.zeros_like(overloaded)
-    d0 = _ell_relax_masked(unit, bands, srcs_t, ws_t, masks_t, no_overload)
+    d0 = _ell_relax_masked(
+        unit, bands, srcs_t, ws_t, masks_t, no_overload, impl=impl
+    )
 
     def cond(state):
         _, changed, it = state
@@ -1063,7 +1187,7 @@ def _ell_masked_fixed_point(srcs_t, ws_t, masks_t, overloaded, src_id,
     def body(state):
         d, _, it = state
         nxt = _ell_relax_masked(
-            d, bands, srcs_t, ws_t, masks_t, overloaded
+            d, bands, srcs_t, ws_t, masks_t, overloaded, impl=impl
         )
         local = jnp.any(nxt < d).astype(jnp.int32)
         return nxt, local if vote is None else vote(local), it + 1
@@ -1139,7 +1263,7 @@ def ell_masked_distances(graph: EllGraph, src_id: int, masks):
     Rides the committed AOT executable cache — the host-graph twin of
     ``ell_masked_distances_resident`` (the serve plane's per-tenant
     KSP2 view dispatches here, so its warm waves must not retrace)."""
-    d = _aot_call(
+    d = ell_dispatch(
         "ksp2_masked_host", _ell_masked_source_batch,
         (
             tuple(jnp.asarray(s) for s in graph.src),
@@ -1164,7 +1288,7 @@ def ell_masked_distances_resident(
     readback kicked on the async lane — the caller reaps it via
     ``dispatch_accounting.reap_read(rows, kicked=True)`` inside its
     event window (the KSP2 committed-dispatch chain)."""
-    d = _aot_call(
+    d = ell_dispatch(
         "ksp2_masked_resident", _ell_masked_source_batch,
         (
             state.src,
@@ -1442,6 +1566,9 @@ class EllState:
             jnp.asarray(inc_t), jnp.asarray(inc_h), jnp.asarray(inc_w),
             self.overloaded, d_prev, srcs_dev,
             patched.bands, patched.n_pad,
+            ell_impl=_ell_impl_for(
+                patched.n_pad, max(b.k for b in patched.bands)
+            ),
         )
         _t_end = time.perf_counter()
         self._d_dev = d
@@ -1620,7 +1747,7 @@ def ell_all_view_rows_masked(
     ``dispatch_accounting.reap_read(packed, kicked=True)`` inside its
     event window, folding the relay round trip into the chain."""
     inc_t, inc_h, inc_w = _inc_args(inc)
-    d_all, dm_new, packed = _aot_call(
+    d_all, dm_new, packed = ell_dispatch(
         "ksp2_view_rows_masked", _ell_all_view_rows_masked,
         (
             state.src, state.w, state.overloaded,
@@ -1652,7 +1779,7 @@ def ell_all_view_rows(state: EllState, view_srcs, w_sv, ep_ids, d_prev,
     ell_all_view_rows_masked (device ``packed``, readback kicked,
     caller reaps)."""
     inc_t, inc_h, inc_w = _inc_args(inc)
-    d_all, packed = _aot_call(
+    d_all, packed = ell_dispatch(
         "ksp2_view_rows", _ell_all_view_rows,
         (
             state.src, state.w, state.overloaded,
@@ -2111,11 +2238,21 @@ def ell_uniform_rows(
     return src, w
 
 
-def _uniform_relax(d, src, w, overloaded):
+def _uniform_relax(d, src, w, overloaded, impl=None):
     """One masked relaxation over a uniform ELL block: [S, N] -> [S, N]
     as one gather + K-reduce (the single-band special case of
     _ell_relax — identical algebra, so fixed points agree bit-for-bit).
-    Edges originating at overloaded nodes never extend paths."""
+    Edges originating at overloaded nodes never extend paths. ``impl``
+    as in _ell_relax; under vmap (the world-batch tenant axis) the
+    pallas band kernel batches through pallas_call's vmap rule."""
+    if impl is None:
+        impl = _ell_impl_for(src.shape[0], src.shape[1])
+    if impl == "pallas":
+        from openr_tpu.ops.pallas_ell import ell_band_relax
+
+        # one uniform band covering every row: the kernel's output IS
+        # the full [S, n] next state
+        return ell_band_relax(d, src, w, overloaded, 0)
     w_eff = jnp.where(overloaded[src], INF, w)  # [n, k]
     gathered = d[:, src]  # [S, n, k]
     relaxed = jnp.min(
@@ -2153,6 +2290,10 @@ def _tenant_view_solve(src, w, overloaded, srcs, p_rows, p_src, p_w,
     with ONE device round trip per bucket."""
     n = src.shape[0]
     s = srcs.shape[0]
+    # relax impl resolved ONCE at trace time from the uniform block's
+    # (n_slot, k_slot) geometry — under vmap the shapes are the
+    # per-tenant ones, so every tenant in a bucket shares one winner
+    impl = _ell_impl_for(src.shape[0], src.shape[1])
     src = src.at[p_rows].set(p_src, mode="drop")
     w = w.at[p_rows].set(p_w, mode="drop")
     w_sv = _uniform_direct(src, w, srcs)
@@ -2160,7 +2301,7 @@ def _tenant_view_solve(src, w, overloaded, srcs, p_rows, p_src, p_w,
     unit = unit.at[jnp.arange(s), srcs].set(0)
     # init rows: one UNMASKED relax (overloaded sources still originate)
     no_overload = jnp.zeros_like(overloaded)
-    d0 = _uniform_relax(unit, src, w, no_overload)
+    d0 = _uniform_relax(unit, src, w, no_overload, impl=impl)
     seed = _warm_seed(d_prev, inc_t, inc_h, inc_w, d0)
 
     def cond(state):
@@ -2169,7 +2310,7 @@ def _tenant_view_solve(src, w, overloaded, srcs, p_rows, p_src, p_w,
 
     def body(state):
         d, _, it = state
-        nxt = _uniform_relax(d, src, w, overloaded)
+        nxt = _uniform_relax(d, src, w, overloaded, impl=impl)
         return nxt, jnp.any(nxt < d), it + 1
 
     d, _, _ = jax.lax.while_loop(cond, body, (seed, jnp.bool_(True), 0))

@@ -1,0 +1,519 @@
+"""Pallas sliced-ELL relax kernel (ops.pallas_ell): bit-exact parity
+with the jnp formulation, the autotuner's family-keyed persistence,
+and the zero-retrace / no-transfer contracts with the kernel armed.
+
+The kernel runs in interpret mode on CPU (``_interpret`` defaults to
+non-TPU platforms), so every parity assertion here is exact int32
+equality — the relaxation is a monotone min-plus contraction with a
+unique fixed point, and the padding/overload-masking contract promises
+the tiled kernel computes the SAME lattice values, not approximately
+close ones. Oracles are independent numpy re-derivations of the band
+algebra, not calls back into the jnp impl under test.
+"""
+
+import json
+import os
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from openr_tpu.graph.linkstate import LinkState
+from openr_tpu.models import topologies
+from openr_tpu.ops import autotune, route_engine, route_sweep, spf_sparse
+from openr_tpu.ops.pallas_ell import (
+    INF,
+    TILE_N,
+    TILE_S,
+    ell_band_relax,
+    ell_band_relax_masked,
+    rev_band_relax,
+    vmem_bytes,
+)
+from openr_tpu.types import AdjacencyDatabase
+
+
+def load(topo, overloaded_nodes=()):
+    ls = LinkState(area=topo.area)
+    for name, db in sorted(topo.adj_dbs.items()):
+        if name in overloaded_nodes:
+            db = AdjacencyDatabase(
+                this_node_name=db.this_node_name,
+                is_overloaded=True,
+                adjacencies=db.adjacencies,
+                node_label=db.node_label,
+                area=db.area,
+            )
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def mutate_metric(ls, node, i, metric):
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+class ForcedTuner(autotune.Autotuner):
+    """Deterministic winner for every key — no timing, no disk."""
+
+    def __init__(self, winner: str):
+        super().__init__(persist=False)
+        self.forced = winner
+
+    def pick(self, kernel, shape_key, candidates):
+        return self.forced if self.forced in candidates else next(
+            iter(candidates)
+        )
+
+
+@pytest.fixture(autouse=True)
+def _restore_impl_and_tuner():
+    prev = spf_sparse.get_ell_relax_impl()
+    prev_tuner = autotune.get_autotuner()
+    yield
+    spf_sparse.set_ell_relax_impl(prev)
+    autotune.set_autotuner(prev_tuner)
+
+
+# ---------------------------------------------------------------------
+# numpy oracles: independent re-derivation of the band algebra
+# ---------------------------------------------------------------------
+
+
+def np_band_relax(d, src, w, overloaded, pos):
+    d = np.asarray(d).astype(np.int64)
+    src = np.asarray(src)
+    w_eff = np.where(np.asarray(overloaded)[src], int(INF),
+                     np.asarray(w)).astype(np.int64)
+    relaxed = np.minimum(
+        d[:, src] + w_eff[None, :, :], int(INF)
+    ).min(axis=2)
+    rows = src.shape[0]
+    return np.minimum(d[:, pos:pos + rows], relaxed).astype(np.int32)
+
+
+def np_band_relax_masked(d, src, w, mask, overloaded, pos):
+    d = np.asarray(d).astype(np.int64)
+    src = np.asarray(src)
+    w_eff = np.where(np.asarray(overloaded)[src], int(INF),
+                     np.asarray(w))
+    w_b = np.where(np.asarray(mask), int(INF),
+                   w_eff[None, :, :]).astype(np.int64)
+    relaxed = np.minimum(d[:, src] + w_b, int(INF)).min(axis=2)
+    rows = src.shape[0]
+    return np.minimum(d[:, pos:pos + rows], relaxed).astype(np.int32)
+
+
+def np_rev_relax(d, v, w, t_ids, overloaded, pos):
+    d = np.asarray(d).astype(np.int64)
+    v = np.asarray(v)
+    ov = np.asarray(overloaded)
+    blocked = ov[v][None, :, :] & (
+        v[None, :, :] != np.asarray(t_ids)[:, None, None]
+    )
+    w_eff = np.where(blocked, int(INF),
+                     np.asarray(w)[None, :, :]).astype(np.int64)
+    relaxed = np.minimum(d[:, v] + w_eff, int(INF)).min(axis=2)
+    rows = v.shape[0]
+    return np.minimum(d[:, pos:pos + rows], relaxed).astype(np.int32)
+
+
+def synth_band(rng, s, n_pad, rows, k, pos, inf_frac=0.2,
+               ov_frac=0.2, inf_w_frac=0.15):
+    """Random operands with the hazards the padding contract must keep
+    inert: INF distance cells, whole all-INF rows, INF weights, and
+    overloaded sources."""
+    d = rng.integers(0, INF // 4, size=(s, n_pad), dtype=np.int32)
+    d[rng.random((s, n_pad)) < inf_frac] = INF
+    d[0, :] = INF  # an all-INF source row stays all-INF-or-relaxed
+    src = rng.integers(0, n_pad, size=(rows, k), dtype=np.int32)
+    w = rng.integers(1, 1000, size=(rows, k), dtype=np.int32)
+    w[rng.random((rows, k)) < inf_w_frac] = INF
+    ov = rng.random(n_pad) < ov_frac
+    return d, src, w, ov
+
+
+BAND_SHAPES = [
+    # (s, n_pad, rows, k, pos): tile-exact, off-tile, and edge extents
+    (8, 256, 128, 4, 0),  # exact (TILE_S, TILE_N) multiples
+    (8, 256, 128, 4, 64),  # band offset inside the padded axis
+    (5, 256, 100, 3, 64),  # s and rows both off-tile
+    (1, 384, 1, 1, 200),  # degenerate 1-row band, k = 1
+    (9, 256, 127, 2, 0),  # rows one short of a lane tile
+    (16, 512, 129, 6, 128),  # rows one past a lane tile
+    (3, 128, 128, 9, 0),  # k past the slot-class nominal sizes
+]
+
+
+class TestBandKernelParity:
+    @pytest.mark.parametrize("s,n_pad,rows,k,pos", BAND_SHAPES)
+    def test_plain_band_matches_oracle(self, s, n_pad, rows, k, pos):
+        rng = np.random.default_rng(seed=s * 1000 + rows + k)
+        d, src, w, ov = synth_band(rng, s, n_pad, rows, k, pos)
+        got = np.asarray(ell_band_relax(
+            jnp.asarray(d), jnp.asarray(src), jnp.asarray(w),
+            jnp.asarray(ov), pos,
+        ))
+        want = np_band_relax(d, src, w, ov, pos)
+        assert got.dtype == np.int32
+        assert np.array_equal(got, want)
+
+    @pytest.mark.parametrize("s,n_pad,rows,k,pos", BAND_SHAPES)
+    def test_masked_band_matches_oracle(self, s, n_pad, rows, k, pos):
+        rng = np.random.default_rng(seed=s * 77 + rows * 3 + k)
+        d, src, w, ov = synth_band(rng, s, n_pad, rows, k, pos)
+        mask = rng.random((s, rows, k)) < 0.3
+        got = np.asarray(ell_band_relax_masked(
+            jnp.asarray(d), jnp.asarray(src), jnp.asarray(w),
+            jnp.asarray(mask), jnp.asarray(ov), pos,
+        ))
+        want = np_band_relax_masked(d, src, w, mask, ov, pos)
+        assert np.array_equal(got, want)
+
+    @pytest.mark.parametrize("s,n_pad,rows,k,pos", BAND_SHAPES)
+    def test_rev_band_matches_oracle(self, s, n_pad, rows, k, pos):
+        rng = np.random.default_rng(seed=s * 13 + rows * 7 + k)
+        d, v, w, ov = synth_band(rng, s, n_pad, rows, k, pos)
+        t_ids = rng.integers(0, n_pad, size=(s,), dtype=np.int32)
+        got = np.asarray(rev_band_relax(
+            jnp.asarray(d), jnp.asarray(v), jnp.asarray(w),
+            jnp.asarray(t_ids), jnp.asarray(ov), pos,
+        ))
+        want = np_rev_relax(d, v, w, t_ids, ov, pos)
+        assert np.array_equal(got, want)
+
+    def test_all_overloaded_only_direct_mins_survive(self):
+        """Every source overloaded => the relax degenerates to the
+        identity on d's band slice (no edge may extend a path)."""
+        rng = np.random.default_rng(seed=42)
+        d, src, w, _ = synth_band(rng, 6, 256, 120, 3, 32, ov_frac=0.0)
+        ov = np.ones(256, bool)
+        got = np.asarray(ell_band_relax(
+            jnp.asarray(d), jnp.asarray(src), jnp.asarray(w),
+            jnp.asarray(ov), 32,
+        ))
+        assert np.array_equal(got, d[:, 32:152])
+
+    def test_vmap_over_batch_axis(self):
+        """pallas_call's batching rule must carry the kernel under
+        vmap — the world-model solves are jit(vmap(...)) chains."""
+        rng = np.random.default_rng(seed=3)
+        batch_d = []
+        want = []
+        src = rng.integers(0, 128, size=(64, 3), dtype=np.int32)
+        w = rng.integers(1, 50, size=(64, 3), dtype=np.int32)
+        ov = rng.random(128) < 0.2
+        for _ in range(4):
+            d, _, _, _ = synth_band(rng, 8, 128, 64, 3, 0)
+            batch_d.append(d)
+            want.append(np_band_relax(d, src, w, ov, 0))
+        got = np.asarray(jax.vmap(
+            lambda dd: ell_band_relax(
+                dd, jnp.asarray(src), jnp.asarray(w), jnp.asarray(ov), 0
+            )
+        )(jnp.asarray(np.stack(batch_d))))
+        assert np.array_equal(got, np.stack(want))
+
+    def test_vmem_budget_is_positive_and_tile_scaled(self):
+        base = vmem_bytes(256, 4)
+        assert base > 0
+        assert vmem_bytes(512, 4) > base  # d panel scales with n_pad
+        assert vmem_bytes(256, 8) > base  # slot panels scale with k
+        assert vmem_bytes(256, 4, masked=True) > base
+        # the budget is tile-bounded: independent of S entirely, and
+        # the d panel term is TILE_S rows regardless of source count
+        assert TILE_S * 256 * 4 <= base
+
+
+# ---------------------------------------------------------------------
+# whole-solve parity on real topologies
+# ---------------------------------------------------------------------
+
+
+def topo_cases():
+    return [
+        ("ring", topologies.ring(17), ()),
+        ("fat_tree", topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        ), ()),
+        ("random", topologies.random_mesh(
+            40, degree=5, seed=3, max_metric=30
+        ), ()),
+        ("random_overloaded", topologies.random_mesh(
+            30, degree=4, seed=9, max_metric=20
+        ), ("node-12", "node-3")),
+    ]
+
+
+class TestTopologyParity:
+    @pytest.mark.parametrize(
+        "name,topo,ov", topo_cases(), ids=lambda c: str(c)[:14]
+    )
+    def test_all_pairs_bit_identical(self, name, topo, ov):
+        ls = load(topo, overloaded_nodes=ov)
+        graph = spf_sparse.compile_ell(ls)
+        srcs = np.arange(graph.n, dtype=np.int32)
+        spf_sparse.set_ell_relax_impl("jnp")
+        d_jnp = np.asarray(
+            spf_sparse.ell_distances_from_sources(graph, srcs)
+        )
+        spf_sparse.set_ell_relax_impl("pallas")
+        d_pl = np.asarray(
+            spf_sparse.ell_distances_from_sources(graph, srcs)
+        )
+        assert np.array_equal(d_jnp, d_pl)
+
+    def test_masked_relax_bit_identical_on_real_bands(self):
+        """The KSP2 per-batch edge-exclusion variant, on the real band
+        structure of a fat-tree (multiple slot classes)."""
+        ls = load(topo_cases()[1][1])
+        graph = spf_sparse.compile_ell(ls)
+        rng = np.random.default_rng(seed=11)
+        b = 4
+        d = rng.integers(
+            0, INF // 4, size=(b, graph.n_pad), dtype=np.int32
+        )
+        d[rng.random(d.shape) < 0.25] = INF
+        masks = tuple(
+            jnp.asarray(rng.random((b,) + s.shape) < 0.3)
+            for s in graph.src
+        )
+        args = (
+            jnp.asarray(d), graph.bands,
+            tuple(jnp.asarray(s) for s in graph.src),
+            tuple(jnp.asarray(w) for w in graph.w),
+            masks, jnp.asarray(graph.overloaded),
+        )
+        got_j = np.asarray(spf_sparse._ell_relax_masked(*args, impl="jnp"))
+        got_p = np.asarray(
+            spf_sparse._ell_relax_masked(*args, impl="pallas")
+        )
+        assert np.array_equal(got_j, got_p)
+
+    def test_route_sweep_digests_bit_identical(self):
+        """Destination-major sweep (the rev kernel) end to end."""
+        topo = topo_cases()[1][1]
+        ls_a, ls_b = load(topo), load(topo)
+        names = sorted(ls_a.get_adjacency_databases().keys())
+        spf_sparse.set_ell_relax_impl("jnp")
+        eng_j = route_engine.RouteSweepEngine(ls_a, [names[0]])
+        spf_sparse.set_ell_relax_impl("pallas")
+        eng_p = route_engine.RouteSweepEngine(ls_b, [names[0]])
+        assert route_sweep.digests_by_name(eng_j.result) == \
+            route_sweep.digests_by_name(eng_p.result)
+
+
+# ---------------------------------------------------------------------
+# autotuner: family-keyed persistence
+# ---------------------------------------------------------------------
+
+
+class TestAutotunePersistence:
+    def _cache(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("OPENR_CACHE_DIR", str(tmp_path))
+        return os.path.join(str(tmp_path), "autotune.json")
+
+    def test_round_trip_same_winner_without_remeasure(
+        self, tmp_path, monkeypatch
+    ):
+        path = self._cache(tmp_path, monkeypatch)
+        calls = []
+
+        def measure(thunk, reps=3):
+            calls.append(1)
+            thunk()
+            return float(len(calls))  # first candidate measured wins
+
+        t1 = autotune.Autotuner(measure=measure)
+        w1 = t1.pick("ell_relax", "256x4", {
+            "jnp": lambda: None, "pallas": lambda: None,
+        })
+        assert w1 == "jnp" and len(calls) == 2
+        data = json.load(open(path))
+        assert data["version"] == 2
+        key = f"{jax.devices()[0].platform}:ell_relax:256x4"
+        assert data["winners"][key]["winner"] == "jnp"
+        assert data["winners"][key]["family"] == "ell_relax"
+        # a fresh process (new tuner) adopts the persisted winner and
+        # never measures
+        t2 = autotune.Autotuner(measure=measure)
+        calls.clear()
+        w2 = t2.pick("ell_relax", "256x4", {
+            "jnp": lambda: None, "pallas": lambda: None,
+        })
+        assert w2 == "jnp" and calls == []
+
+    def test_legacy_flat_schema_migrates(self, tmp_path, monkeypatch):
+        path = self._cache(tmp_path, monkeypatch)
+        platform = jax.devices()[0].platform
+        legacy = {
+            f"{platform}:minplus:8x256": {"winner": "pallas"},
+            # out-of-family winner: a dense pallas_t must never arm
+            # the sparse relax dispatch
+            f"{platform}:ell_relax:256x4": {"winner": "pallas_t"},
+            f"{platform}:nonsense": {"winner": "jnp"},  # malformed key
+            f"{platform}:unknown_family:1x1": {"winner": "jnp"},
+        }
+        with open(path, "w") as f:
+            json.dump(legacy, f)
+        t = autotune.Autotuner(measure=lambda th, reps=3: 1.0)
+        assert t.pick("minplus", "8x256", {
+            "jnp": lambda: None, "pallas": lambda: None,
+        }) == "pallas"  # valid legacy entry adopted
+        # the invalid ell_relax entry was dropped -> re-measured
+        assert t.pick("ell_relax", "256x4", {
+            "jnp": lambda: None, "pallas": lambda: None,
+        }) in ("jnp", "pallas")
+        # any save rewrites the surviving entries under the v2 schema
+        data = json.load(open(path))
+        assert data["version"] == 2
+        keys = set(data["winners"])
+        assert f"{platform}:minplus:8x256" in keys
+        assert f"{platform}:nonsense" not in keys
+        assert f"{platform}:unknown_family:1x1" not in keys
+        for entry in data["winners"].values():
+            assert entry["winner"] in \
+                autotune._FAMILY_CANDIDATES[entry["family"]]
+
+    def test_record_rejects_out_of_family_winner(self):
+        t = autotune.Autotuner(persist=False)
+        with pytest.raises(AssertionError):
+            t.record("ell_relax", "256x4", "pallas_t")
+        with pytest.raises(AssertionError):
+            t.record("not_a_family", "256x4", "jnp")
+
+    def test_resolve_ell_relax_adopts_recorded_winner(self):
+        t = autotune.Autotuner(persist=False)
+        autotune.set_autotuner(t)
+        t.record("ell_relax", "256x3", "pallas")
+        assert autotune.resolve_ell_relax((256, 3)) == "pallas"
+
+
+# ---------------------------------------------------------------------
+# compile-flatness, burst parity, sharded transfer guard — kernel armed
+# ---------------------------------------------------------------------
+
+
+def _warm_engine_auto():
+    """Fat-tree engine built with impl='auto' resolving to pallas for
+    every shape (forced tuner), warmed through one churn event."""
+    autotune.set_autotuner(ForcedTuner("pallas"))
+    spf_sparse.set_ell_relax_impl("auto")
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = load(topo)
+    names = sorted(ls.get_adjacency_databases().keys())
+    eng = route_engine.RouteSweepEngine(ls, [names[0]])
+    rsw = next(n for n in eng.graph.node_names if n.startswith("rsw"))
+    assert eng.churn(ls, mutate_metric(ls, rsw, 0, 3))
+    return eng, ls, rsw
+
+
+class TestArmedContracts:
+    def test_zero_retrace_across_churn_under_auto(self):
+        """Warm metric churn with the kernel armed through the
+        autotuner costs zero new compiles: the @pallas-suffixed AOT
+        tags and the ell_impl statics were all built during warm-up,
+        and nothing about a metric flip re-keys them."""
+        from openr_tpu.telemetry import get_registry
+
+        eng, ls, rsw = _warm_engine_auto()
+        # first cycle warms every row bucket these events land in
+        for metric in (5, 9, 2, 12):
+            eng.churn(ls, mutate_metric(ls, rsw, 0, metric))
+        reg = get_registry()
+        aot0 = reg.counter_get("ops.aot_compiles")
+        jax0 = reg.counter_get("jax.compile_count")
+        for metric in (5, 9, 2, 12):
+            eng.churn(ls, mutate_metric(ls, rsw, 0, metric))
+        assert reg.counter_get("ops.aot_compiles") == aot0
+        assert reg.counter_get("jax.compile_count") == jax0
+
+    def test_warm_churn_two_touch_contract_holds_armed(self):
+        """Arming the kernel must not change the dispatch cadence: a
+        warm event window still costs <= 2 host touches and zero
+        blocking syncs."""
+        from openr_tpu.ops import dispatch_accounting as da
+
+        eng, ls, rsw = _warm_engine_auto()
+        with da.event_window("test_armed") as w:
+            assert eng.churn(ls, mutate_metric(ls, rsw, 0, 8))
+        assert w.touches <= 2, f"armed churn cost {w.touches} touches"
+        assert w.blocking_syncs == 0
+
+    def test_pipelined_burst_digest_parity_armed(self):
+        """A 3-event pipelined burst with the kernel armed leaves
+        digests bit-identical to the jnp engine fed the same events."""
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls_j, ls_p = load(topo), load(topo)
+        names = sorted(ls_j.get_adjacency_databases().keys())
+        spf_sparse.set_ell_relax_impl("jnp")
+        eng_j = route_engine.RouteSweepEngine(ls_j, [names[0]])
+        autotune.set_autotuner(ForcedTuner("pallas"))
+        spf_sparse.set_ell_relax_impl("auto")
+        eng_p = route_engine.RouteSweepEngine(ls_p, [names[0]])
+        edges = []
+        sample = set(eng_j.sample_names)
+        for node in names:
+            if node in sample:
+                continue
+            adjs = ls_j.get_adjacency_databases()[node].adjacencies
+            for i, a in enumerate(adjs):
+                if a.other_node_name not in sample:
+                    edges.append((node, i))
+                    break
+            if len(edges) == 3:
+                break
+        # warm both engines through one sequential round
+        for (node, slot), metric in zip(edges, (7, 5, 9)):
+            eng_j.churn(ls_j, mutate_metric(ls_j, node, slot, metric))
+            eng_p.churn(ls_p, mutate_metric(ls_p, node, slot, metric))
+        # second round: sequential on the jnp engine, one pipelined
+        # burst on the armed engine
+        final = list(zip(edges, (11, 4, 13)))
+        for (node, slot), metric in final:
+            eng_j.churn(ls_j, mutate_metric(ls_j, node, slot, metric))
+        eng_p.churn_burst(ls_p, [
+            (lambda n=node, s=slot, m=metric:
+             mutate_metric(ls_p, n, s, m))
+            for (node, slot), metric in final
+        ])
+        assert route_sweep.digests_by_name(eng_j.result) == \
+            route_sweep.digests_by_name(eng_p.result)
+
+    def test_sharded_churn_no_implicit_transfers_armed(self):
+        """The sharded twin runs the kernel per shard: warm churn with
+        pallas armed completes under jax.transfer_guard('disallow')
+        with zero placement corrections — shard_map hands the kernel
+        its local rows, nothing reshards."""
+        from openr_tpu.parallel.mesh import make_mesh
+        from openr_tpu.telemetry import get_registry
+
+        autotune.set_autotuner(ForcedTuner("pallas"))
+        spf_sparse.set_ell_relax_impl("auto")
+        topo = topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )
+        ls = load(topo)
+        names = sorted(ls.get_adjacency_databases().keys())
+        mesh = make_mesh(jax.devices())
+        eng = route_engine.RouteSweepEngine(
+            ls, [names[0]], align=16, mesh=mesh
+        )
+        rsw = next(n for n in eng.graph.node_names
+                   if n.startswith("rsw"))
+        assert eng.churn(ls, mutate_metric(ls, rsw, 0, 3))
+        reg = get_registry()
+        before = reg.counter_get("ops.reshard_events")
+        with jax.transfer_guard("disallow"):
+            for metric in (5, 9, 2):
+                eng.churn(ls, mutate_metric(ls, rsw, 0, metric))
+        assert reg.counter_get("ops.reshard_events") == before

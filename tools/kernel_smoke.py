@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Sliced-ELL kernel gate (``make kernel-smoke``) and report artifact.
+
+Exercises the Pallas sliced-ELL relax kernel (``openr_tpu.ops.
+pallas_ell``, interpret mode on CPU) against the jnp formulation and
+the autotuner plumbing that arms it, then fails loudly if the kernel
+contract regressed:
+
+- INTERPRET PARITY: all-pairs distances on a 3-pod fat-tree and a
+  random mesh must be BIT-IDENTICAL (int32 exact) between
+  ``impl="jnp"`` and ``impl="pallas"`` — the padding/overload-masking
+  contract admits no tolerance,
+- AUTOTUNER ROUND-TRIP: an ``ell_relax`` winner measured into a fresh
+  cache dir must persist under the v2 family-keyed schema and be
+  adopted by a brand-new tuner (same winner, zero re-measures),
+- COMPILE FLATNESS: with the kernel armed through ``impl="auto"``, a
+  second pass over a warmed metric-churn sequence must cost ZERO AOT
+  compiles and ZERO backend jit compiles — arming the kernel re-keys
+  tags once at warm-up, never per event.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_kernel_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates the kernel's algebra and
+dispatch plumbing, not device throughput (bench owns that leg, see
+``OPENR_BENCH_ELLKERN``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/kernel_smoke.py) in addition
+# to module mode (python -m tools.kernel_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _load(topo):
+    from openr_tpu.graph.linkstate import LinkState
+
+    ls = LinkState(area=topo.area)
+    for _name, db in sorted(topo.adj_dbs.items()):
+        ls.update_adjacency_database(db)
+    return ls
+
+
+def _mutate_metric(ls, node, i, metric):
+    from dataclasses import replace
+
+    db = ls.get_adjacency_databases()[node]
+    adjs = list(db.adjacencies)
+    adjs[i] = replace(adjs[i], metric=metric)
+    ls.update_adjacency_database(replace(db, adjacencies=tuple(adjs)))
+    return {node, adjs[i].other_node_name}
+
+
+SEQ = (7, 3, 11, 5)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--out", default="/tmp/openr_tpu_kernel_smoke.json",
+        help="JSON artifact path",
+    )
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from openr_tpu.models import topologies
+    from openr_tpu.ops import autotune, route_engine, spf_sparse
+    from openr_tpu.ops.pallas_ell import vmem_bytes
+    from openr_tpu.telemetry import get_registry
+
+    failures: list = []
+    report: dict = {"gates": {}}
+    reg = get_registry()
+    prev_impl = spf_sparse.get_ell_relax_impl()
+    prev_tuner = autotune.get_autotuner()
+
+    # -- gate: interpret-mode bit parity on real topologies -------------
+    parity_ok = True
+    for name, topo in (
+        ("fat_tree", topologies.fat_tree(
+            pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+        )),
+        ("random_mesh", topologies.random_mesh(
+            40, degree=5, seed=3, max_metric=30
+        )),
+    ):
+        ls = _load(topo)
+        graph = spf_sparse.compile_ell(ls)
+        srcs = np.arange(graph.n, dtype=np.int32)
+        spf_sparse.set_ell_relax_impl("jnp")
+        d_jnp = np.asarray(
+            spf_sparse.ell_distances_from_sources(graph, srcs)
+        )
+        spf_sparse.set_ell_relax_impl("pallas")
+        d_pl = np.asarray(
+            spf_sparse.ell_distances_from_sources(graph, srcs)
+        )
+        same = bool(np.array_equal(d_jnp, d_pl))
+        parity_ok = parity_ok and same
+        k_max = max(b.k for b in graph.bands)
+        report.setdefault("parity", {})[name] = {
+            "bit_identical": same,
+            "n_pad": graph.n_pad,
+            "k_max": k_max,
+            "vmem_bytes": vmem_bytes(graph.n_pad, k_max),
+        }
+        if not same:
+            bad = int((d_jnp != d_pl).sum())
+            failures.append(
+                f"pallas kernel diverged from jnp on {name}: {bad} "
+                "cell(s) differ — the bit-identity contract is broken"
+            )
+    report["gates"]["interpret_parity"] = parity_ok
+
+    # -- gate: autotuner measure -> persist -> reload round-trip --------
+    with tempfile.TemporaryDirectory() as cache:
+        prev_env = os.environ.get("OPENR_CACHE_DIR")
+        os.environ["OPENR_CACHE_DIR"] = cache
+        try:
+            t1 = autotune.Autotuner()
+            autotune.set_autotuner(t1)
+            winner = autotune.resolve_ell_relax((256, 4))
+            path = os.path.join(cache, "autotune.json")
+            persisted = {}
+            if os.path.exists(path):
+                with open(path) as fh:
+                    persisted = json.load(fh)
+            schema_ok = persisted.get("version") == 2
+            key = f"{jax.devices()[0].platform}:ell_relax:256x4"
+            entry = persisted.get("winners", {}).get(key, {})
+            entry_ok = (
+                entry.get("winner") == winner
+                and entry.get("family") == "ell_relax"
+            )
+            # a fresh tuner must adopt without re-measuring
+            measured = []
+            t2 = autotune.Autotuner(
+                measure=lambda th, reps=3: measured.append(1) or 1.0
+            )
+            autotune.set_autotuner(t2)
+            winner2 = autotune.resolve_ell_relax((256, 4))
+            adopt_ok = winner2 == winner and not measured
+            report["autotune"] = {
+                "winner": winner,
+                "schema_version_2": schema_ok,
+                "entry_family_keyed": entry_ok,
+                "adopted_without_remeasure": adopt_ok,
+            }
+            if not schema_ok:
+                failures.append(
+                    "autotune persistence is not the v2 family-keyed "
+                    "schema"
+                )
+            if not entry_ok:
+                failures.append(
+                    f"persisted ell_relax entry malformed: {entry}"
+                )
+            if not adopt_ok:
+                failures.append(
+                    "fresh tuner re-measured or flipped the persisted "
+                    f"ell_relax winner ({winner} -> {winner2}, "
+                    f"{len(measured)} re-measure(s))"
+                )
+            report["gates"]["autotune_round_trip"] = (
+                schema_ok and entry_ok and adopt_ok
+            )
+        finally:
+            if prev_env is None:
+                os.environ.pop("OPENR_CACHE_DIR", None)
+            else:
+                os.environ["OPENR_CACHE_DIR"] = prev_env
+
+    # -- gate: compile flatness with the kernel armed via auto ----------
+    class _Forced(autotune.Autotuner):
+        def pick(self, kernel, shape_key, candidates):
+            return "pallas" if "pallas" in candidates else next(
+                iter(candidates)
+            )
+
+    autotune.set_autotuner(_Forced(persist=False))
+    spf_sparse.set_ell_relax_impl("auto")
+    topo = topologies.fat_tree(
+        pods=3, ssw_per_plane=2, fsw_per_pod=2, rsw_per_pod=4
+    )
+    ls = _load(topo)
+    names = sorted(ls.get_adjacency_databases().keys())
+    engine = route_engine.RouteSweepEngine(ls, [names[0]])
+    rsw = next(n for n in engine.graph.node_names if n.startswith("rsw"))
+    for metric in SEQ:  # warm every (tag@pallas, bucket) key
+        engine.churn(ls, _mutate_metric(ls, rsw, 0, metric))
+    compiles0 = reg.counter_get("ops.aot_compiles")
+    jax0 = reg.counter_get("jax.compile_count")
+    for metric in SEQ:
+        engine.churn(ls, _mutate_metric(ls, rsw, 0, metric))
+    compile_delta = reg.counter_get("ops.aot_compiles") - compiles0
+    jax_delta = reg.counter_get("jax.compile_count") - jax0
+    if compile_delta:
+        failures.append(
+            f"armed warm pass AOT-compiled {compile_delta} time(s); "
+            "@pallas tags must be fully keyed at warm-up"
+        )
+    if jax_delta:
+        failures.append(
+            f"armed warm pass triggered {jax_delta} backend jit "
+            "compile(s)"
+        )
+    report["gates"]["armed_compile_flatness"] = (
+        compile_delta == 0 and jax_delta == 0
+    )
+    report["armed_warm"] = {
+        "aot_compile_delta": compile_delta,
+        "jax_compile_delta": jax_delta,
+    }
+
+    spf_sparse.set_ell_relax_impl(prev_impl)
+    autotune.set_autotuner(prev_tuner)
+
+    report["failures"] = failures
+    report["passed"] = not failures
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print("KERNEL SMOKE FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print(f"kernel smoke passed; report at {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
