@@ -12,7 +12,7 @@ CXX      ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread
 NATIVE    = native/libspfcore.so
 
-.PHONY: all native test test-fast tier1 lint-analysis churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke replay-smoke bench clean install
+.PHONY: all native test test-fast tier1 lint-analysis race-smoke churn-smoke telemetry-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke multichip-smoke serve-smoke obs-smoke replay-smoke bench clean install
 
 all: native
 
@@ -31,19 +31,19 @@ test: native
 test-fast: native
 	python -m pytest tests/ -q -x -m "not slow"
 
-# invariant linters (openr_tpu/analysis): donation-hazard,
-# host-sync-in-window, lock-order, span-discipline, retrace-risk.
-# Pure-ast pass, no jax import, a few seconds on the whole tree.
-# Exit 1 on any unsuppressed finding; suppressions need a reason
-# (see docs/RUNBOOK.md "Invariant lint triage").
+# invariant linters (openr_tpu/analysis; --list-rules for the full
+# registry). Pure-ast pass, no jax import, a few seconds on the whole
+# tree. Exit 1 on any unsuppressed finding OR any stale suppression (a
+# directive shielding nothing); suppressions need a reason (see
+# docs/RUNBOOK.md "Invariant lint triage").
 lint-analysis:
-	python -m openr_tpu.analysis
+	python -m openr_tpu.analysis --audit-suppressions
 
 # the ROADMAP tier-1 gate, verbatim (CPU-pinned, bounded, dot-counted);
 # the invariant linters and the chaos gate run first — a finding or a
 # degradation-contract regression fails the gate before the test suite
 # spends its budget
-tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke serve-smoke obs-smoke replay-smoke
+tier1: native lint-analysis race-smoke chaos-smoke load-smoke tenancy-smoke recovery-smoke integrity-smoke twin-smoke dispatch-smoke kernel-smoke pipeline-smoke serve-smoke obs-smoke replay-smoke
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # fast guard for the incremental churn path: fails if the device
@@ -55,6 +55,17 @@ tier1: native lint-analysis chaos-smoke load-smoke tenancy-smoke recovery-smoke 
 # full-width path while its frontier is below threshold fails here
 churn-smoke: native
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_churn_smoke.py tests/test_incremental_parity.py tests/test_route_engine_delta.py tests/test_frontier_parity.py -q -m "not slow"
+
+# thread-provenance race gate (openr_tpu.analysis races/racedep): the
+# whole-tree shared-state rule must report zero unsuppressed findings
+# with every suppression reasoned and zero stale, the racedep sanitizer
+# must convict a seeded two-thread unlocked overlap (and stay silent on
+# its lock-guarded twin) under deterministic barrier scheduling, and
+# lockdep inversions must carry static role attribution. JSON artifact
+# at /tmp/openr_tpu_race_smoke.json. See docs/RUNBOOK.md "Race triage"
+# when it fails.
+race-smoke:
+	env JAX_PLATFORMS=cpu python -m tools.race_smoke --out /tmp/openr_tpu_race_smoke.json
 
 # observability gate: small churn scenario through the real pipeline;
 # fails if any registered histogram is empty, any trace span is left

@@ -21,7 +21,12 @@ that runs on every PR (``make lint-analysis`` / tier-1's meta-test):
   ``span-discipline``, ``retrace-risk``.
 - :mod:`openr_tpu.analysis.lockdep` — the runtime lock-order tracker
   (lockdep-style) that tests activate to catch dynamic inversions the
-  static graph over-approximates.
+  static graph over-approximates; also home of the thread-role
+  registry runtime findings attribute back to.
+- :mod:`openr_tpu.analysis.racedep` — the runtime shared-state
+  sanitizer pairing with the static ``shared-state`` rule: records
+  (attr, thread, role, locks-held) access witnesses and convicts the
+  first unlocked cross-thread write overlap without the race striking.
 
 This package deliberately imports neither jax nor numpy: the static
 pass must stay a sub-second pure-``ast`` walk.

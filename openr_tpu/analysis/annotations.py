@@ -30,6 +30,10 @@ DONATES_ATTR = "__openr_donates__"
 FAULT_BOUNDARY_ATTR = "__openr_fault_boundary__"
 MIRROR_ATTR = "__openr_host_mirrors__"
 FLIGHT_CALLBACK_ATTR = "__openr_flight_callback__"
+THREAD_CONFINED_ATTR = "__openr_thread_confined__"
+GUARDED_BY_ATTR = "__openr_guarded_by__"
+HANDOFF_ATTR = "__openr_handoff__"
+RUNS_ON_ATTR = "__openr_runs_on__"
 
 
 def solve_window(fn: F) -> F:
@@ -144,6 +148,95 @@ def flight_callback(fn: F) -> F:
     except AttributeError:
         pass
     return fn
+
+
+def thread_confined(role: str, *attr_names: str):
+    """Declare thread confinement for the ``shared-state`` rule.
+
+    Two forms:
+
+    - **class decorator** ``@thread_confined("evb:Decision", "_attr",
+      ...)`` — the named instance attributes are only ever touched
+      while the object is driven by the given role (the role names
+      come from ``python -m openr_tpu.analysis --roles``). The rule
+      exempts those attributes from cross-role conviction; the runtime
+      sanitizer (:mod:`openr_tpu.analysis.racedep`) can still convict
+      the claim if it is a lie.
+    - **method decorator** ``@thread_confined("wave-loop")`` (no attr
+      names) — pins the method's may-run-on role set to exactly this
+      role, overriding inference. For callbacks reached through
+      registries the static pass cannot see.
+    """
+
+    def deco(obj):
+        if isinstance(obj, type) or attr_names:
+            merged = dict(getattr(obj, THREAD_CONFINED_ATTR, {}))
+            for a in attr_names:
+                merged[a] = role
+            try:
+                setattr(obj, THREAD_CONFINED_ATTR, merged)
+            except AttributeError:
+                pass
+        else:
+            try:
+                setattr(obj, THREAD_CONFINED_ATTR, {"__method__": role})
+            except AttributeError:
+                pass
+        return obj
+
+    return deco
+
+
+def guarded_by(lock_id: str, *attr_names: str) -> Callable[[C], C]:
+    """Class decorator declaring that the named instance attributes are
+    always accessed under the given lock class (``"Class._lock"`` —
+    identity shared with the ``lock-order`` rule). The ``shared-state``
+    rule exempts the attributes AND trusts the declaration enough to
+    skip held-lock reconstruction at sites its with-stack tracking
+    cannot see (callbacks invoked under a caller's lock). Audited by
+    the runtime sanitizer, which observes the locks actually held."""
+
+    def deco(cls: C) -> C:
+        merged = dict(getattr(cls, GUARDED_BY_ATTR, {}))
+        for a in attr_names:
+            merged[a] = lock_id
+        setattr(cls, GUARDED_BY_ATTR, merged)
+        return cls
+
+    return deco
+
+
+def handoff(*attr_names: str) -> Callable[[C], C]:
+    """Class decorator declaring publish-once-then-immutable handoff
+    attributes: written by one role (usually ``__init__`` or a single
+    setup method) before any other role can observe the object, never
+    mutated after publication. The classic safe patterns — config
+    snapshots, frozen route products swapped in whole — are handoffs,
+    not races; this names them so the ``shared-state`` rule does not
+    cry wolf."""
+
+    def deco(cls: C) -> C:
+        merged = tuple(getattr(cls, HANDOFF_ATTR, ())) + attr_names
+        setattr(cls, HANDOFF_ATTR, merged)
+        return cls
+
+    return deco
+
+
+def runs_on(role: str) -> Callable[[C], C]:
+    """Class decorator pinning EVERY method of the class to one thread
+    role. For handler classes reached through dynamic dispatch the
+    static pass cannot resolve (the ctrl server's ``getattr`` method
+    lookup runs each handler on a per-connection socketserver thread).
+    Methods of a ``@runs_on("ctrl")`` class seed the role fixpoint with
+    that role, so attribute accesses they make — and calls they fan out
+    into the rest of the tree — carry ctrl-thread provenance."""
+
+    def deco(cls: C) -> C:
+        setattr(cls, RUNS_ON_ATTR, role)
+        return cls
+
+    return deco
 
 
 def donates(*param_names: str) -> Callable[[F], F]:

@@ -14,8 +14,8 @@ import os
 import sys
 from typing import List, Optional, Sequence
 
-from openr_tpu.analysis.core import run_analysis
-from openr_tpu.analysis.rules import ALL_RULES
+from openr_tpu.analysis.core import STALE_RULE, run_analysis
+from openr_tpu.analysis.rules import ALL_RULES, SharedStateRule
 
 
 def _default_root() -> str:
@@ -29,8 +29,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m openr_tpu.analysis",
         description="openr-tpu invariant linters "
-        "(donation-hazard, host-sync-in-window, lock-order, "
-        "span-discipline, retrace-risk)",
+        "(--list-rules for the full registry)",
     )
     ap.add_argument(
         "targets",
@@ -64,6 +63,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="list rule ids and exit"
     )
+    ap.add_argument(
+        "--audit-suppressions",
+        action="store_true",
+        help="also report stale suppressions (directives shielding no "
+        "finding of a rule that ran) as unsuppressable findings",
+    )
+    ap.add_argument(
+        "--roles",
+        action="store_true",
+        help="dump the shared-state rule's inferred thread-role map "
+        "(Class.method -> may-run-on roles) and exit",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -84,7 +95,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 2
         rules = [known[r]() for r in args.rules]
 
-    report = run_analysis(args.root, targets=args.targets, rules=rules)
+    if args.roles:
+        role_rule = SharedStateRule()
+        run_analysis(args.root, targets=args.targets, rules=[role_rule])
+        for key in sorted(role_rule.role_map):
+            print(f"{key}: {', '.join(role_rule.role_map[key])}")
+        print(
+            f"--roles: {len(role_rule.role_map)} role-carrying "
+            "methods",
+            file=sys.stderr,
+        )
+        return 0
+
+    report = run_analysis(
+        args.root,
+        targets=args.targets,
+        rules=rules,
+        audit_suppressions=args.audit_suppressions,
+    )
 
     shown: List[str] = []
     for f in report.findings:
@@ -94,10 +122,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     for line in shown:
         print(line)
     n_sup = len(report.findings) - len(report.unsuppressed)
+    stale = ""
+    if args.audit_suppressions:
+        n_stale = sum(1 for f in report.findings if f.rule == STALE_RULE)
+        stale = f", {n_stale} stale suppression(s)"
     print(
         f"lint-analysis: {report.files_scanned} files, "
         f"{len(report.unsuppressed)} finding(s), "
-        f"{n_sup} suppressed, {report.duration_s * 1000:.0f} ms",
+        f"{n_sup} suppressed{stale}, {report.duration_s * 1000:.0f} ms",
         file=sys.stderr,
     )
 

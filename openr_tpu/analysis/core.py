@@ -29,9 +29,11 @@ on the whole tree (tier-1 runs it as a meta-test).
 from __future__ import annotations
 
 import ast
+import io
 import os
 import re
 import time
+import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -45,6 +47,8 @@ _SUPPRESS_RE = re.compile(
 HYGIENE_RULE = "suppression-hygiene"
 #: rule id for files the parser rejects
 PARSE_RULE = "parse-error"
+#: rule id for suppressions that no longer shield any finding
+STALE_RULE = "suppression-stale"
 
 
 @dataclass
@@ -73,16 +77,41 @@ class Finding:
         return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
 
 
+def _comment_lines(lines: Sequence[str]) -> Optional[frozenset]:
+    """Line numbers (1-based) holding a real COMMENT token, so
+    directive-shaped text inside string literals never registers. None
+    when tokenization fails (unparseable file) — the caller falls back
+    to the plain line scan."""
+    try:
+        return frozenset(
+            tok.start[0]
+            for tok in tokenize.generate_tokens(
+                io.StringIO("\n".join(lines) + "\n").readline
+            )
+            if tok.type == tokenize.COMMENT
+        )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+
+
 class Suppressions:
     """Per-file ``# openr-lint:`` directive table."""
 
     def __init__(self, lines: Sequence[str]) -> None:
-        # line (1-based) -> {rule -> reason}
-        self.by_line: Dict[int, Dict[str, str]] = {}
-        self.file_level: Dict[str, str] = {}
+        # line (1-based) -> {rule -> (reason, directive line)}
+        self.by_line: Dict[int, Dict[str, Tuple[str, int]]] = {}
+        self.file_level: Dict[str, Tuple[str, int]] = {}
         # directive sites with no reason (line, rules) for hygiene
         self.missing_reason: List[Tuple[int, str]] = []
+        # every directive site: (line, rule ids) — audited for
+        # staleness (a directive shielding nothing is rot)
+        self.sites: List[Tuple[int, Tuple[str, ...]]] = []
+        comments = _comment_lines(lines)
         for i, raw in enumerate(lines, start=1):
+            if comments is not None and i not in comments:
+                # directive text inside a string literal (docstring
+                # syntax examples) is not a directive
+                continue
             m = _SUPPRESS_RE.search(raw)
             if m is None:
                 continue
@@ -101,7 +130,8 @@ class Suppressions:
                 shield = j
             if not reason:
                 self.missing_reason.append((i, ",".join(rules)))
-            table = {r: reason for r in rules}
+            self.sites.append((i, tuple(rules)))
+            table = {r: (reason, i) for r in rules}
             if m.group(1) == "disable-file":
                 self.file_level.update(table)
                 continue
@@ -111,11 +141,22 @@ class Suppressions:
 
     def lookup(self, rule: str, line: int) -> Optional[str]:
         """Reason string (possibly empty) if suppressed, else None."""
+        hit = self.lookup_site(rule, line)
+        return hit[0] if hit is not None else None
+
+    def lookup_site(
+        self, rule: str, line: int
+    ) -> Optional[Tuple[str, int, str]]:
+        """(reason, directive line, matched rule id — ``rule`` or
+        ``"all"``) if suppressed, else None. The directive line is what
+        the staleness audit keys on."""
         for table in (self.by_line.get(line, {}), self.file_level):
             if rule in table:
-                return table[rule]
+                reason, dline = table[rule]
+                return reason, dline, rule
             if "all" in table:
-                return table["all"]
+                reason, dline = table["all"]
+                return reason, dline, "all"
         return None
 
 
@@ -330,9 +371,18 @@ def run_analysis(
     root: str,
     targets: Sequence[str] = ("openr_tpu",),
     rules: Optional[Sequence[Rule]] = None,
+    audit_suppressions: bool = False,
 ) -> Report:
     """Run every rule over the tree; returns the full report (findings
-    carry their suppression state — nothing is dropped silently)."""
+    carry their suppression state — nothing is dropped silently).
+
+    With ``audit_suppressions``, every directive that shielded no
+    finding of a rule that RAN this pass is itself reported (rule
+    ``suppression-stale``, unsuppressed — the audit's findings cannot
+    be suppressed away): the code it excused has moved or been fixed,
+    and a directive shielding nothing is how dead exceptions hide live
+    regressions. Only meaningful on full-rule runs — a rule-subset run
+    skips directives for rules that did not run."""
     if rules is None:
         from openr_tpu.analysis.rules import ALL_RULES
 
@@ -361,14 +411,37 @@ def run_analysis(
     # suppression application + hygiene (a directive with no reason is
     # itself a finding so undocumented exceptions cannot accumulate)
     resolved: List[Finding] = []
+    used_sites: set = set()  # (path, directive line, matched rule id)
     for f in findings:
         sf = ctx.file_for(f.path)
         if sf is not None:
-            reason = sf.suppressions.lookup(f.rule, f.line)
-            if reason is not None:
+            hit = sf.suppressions.lookup_site(f.rule, f.line)
+            if hit is not None:
+                reason, dline, matched = hit
                 f.suppressed = True
                 f.reason = reason
-        resolved.append(f)
+                used_sites.add((f.path, dline, matched))
+    resolved.extend(findings)
+    if audit_suppressions:
+        ran = {r.id for r in rules}
+        for sf in ctx.files:
+            for dline, dir_rules in sf.suppressions.sites:
+                for r in dir_rules:
+                    if r != "all" and r not in ran:
+                        continue  # rule did not run: cannot judge
+                    if (sf.path, dline, r) in used_sites:
+                        continue
+                    resolved.append(
+                        Finding(
+                            STALE_RULE,
+                            sf.path,
+                            dline,
+                            0,
+                            f"suppression of '{r}' shields no finding "
+                            "— the excused code moved or was fixed; "
+                            "delete the directive",
+                        )
+                    )
     for sf in ctx.files:
         for line, rules_str in sf.suppressions.missing_reason:
             resolved.append(

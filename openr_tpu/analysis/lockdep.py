@@ -47,6 +47,41 @@ class _Witness:
     holder: str
     acquired: str
     thread: str
+    role: str = ""
+
+
+# -- thread-role registry ---------------------------------------------
+#
+# Maps live threads to the static ``shared-state`` rule's role names
+# ("evb", "solver-wave-loop", "ctrl", ...) so runtime findings — lock
+# inversions here, write overlaps in ``racedep`` — attribute back to
+# the same vocabulary the static report and ``--roles`` dump use.
+
+_roles_mu = threading.Lock()
+_thread_roles: Dict[int, str] = {}
+
+
+def set_thread_role(role: str,
+                    thread: Optional[threading.Thread] = None) -> None:
+    """Register the static role name the given thread (default: the
+    calling thread) runs as. Harnesses call this at thread entry."""
+    ident = thread.ident if thread is not None else threading.get_ident()
+    if ident is None:
+        return
+    with _roles_mu:
+        _thread_roles[ident] = role
+
+
+def clear_thread_roles() -> None:
+    with _roles_mu:
+        _thread_roles.clear()
+
+
+def current_role() -> str:
+    """The calling thread's registered role, else its thread name."""
+    with _roles_mu:
+        role = _thread_roles.get(threading.get_ident())
+    return role if role else threading.current_thread().name
 
 
 @dataclass
@@ -59,8 +94,11 @@ class LockOrderViolation:
 
     def __str__(self) -> str:
         chain = " -> ".join(self.cycle + (self.cycle[0],))
+        who = self.witness.thread
+        if self.witness.role and self.witness.role != who:
+            who = f"{who} (role {self.witness.role})"
         return (
-            f"lock-order inversion {chain}: thread {self.witness.thread} "
+            f"lock-order inversion {chain}: thread {who} "
             f"acquired {self.witness.acquired} while holding "
             f"{self.witness.holder}, but the reverse order was "
             "previously observed"
@@ -94,6 +132,8 @@ class LockDepTracker:
     def on_acquire(self, name: str, reentrant: bool) -> None:
         stack = self._stack()
         violation: Optional[LockOrderViolation] = None
+        tname = threading.current_thread().name
+        role = current_role()
         with self._mu:
             for held, held_reentrant in stack:
                 if held == name:
@@ -101,9 +141,7 @@ class LockDepTracker:
                         continue  # RLock recursion is the design
                     violation = LockOrderViolation(
                         cycle=(name,),
-                        witness=_Witness(
-                            held, name, threading.current_thread().name
-                        ),
+                        witness=_Witness(held, name, tname, role),
                     )
                     break
                 path = self._path(name, held)
@@ -111,9 +149,7 @@ class LockDepTracker:
                     cycle = (held,) + tuple(path)
                     violation = LockOrderViolation(
                         cycle=cycle,
-                        witness=_Witness(
-                            held, name, threading.current_thread().name
-                        ),
+                        witness=_Witness(held, name, tname, role),
                         prior=[
                             self._edges[(a, b)]
                             for a, b in zip(path, path[1:])
@@ -123,13 +159,18 @@ class LockDepTracker:
                     break
                 self._edges.setdefault(
                     (held, name),
-                    _Witness(held, name, threading.current_thread().name),
+                    _Witness(held, name, tname, role),
                 )
             if violation is not None:
                 self.violations.append(violation)
         stack.append((name, reentrant))
         if violation is not None and self.raise_on_violation:
             raise LockOrderError(str(violation))
+
+    def held(self) -> Tuple[str, ...]:
+        """Lock classes the calling thread currently holds, outermost
+        first. ``racedep`` reads this to stamp accesses."""
+        return tuple(n for n, _ in self._stack())
 
     def on_release(self, name: str) -> None:
         stack = self._stack()

@@ -1,0 +1,227 @@
+"""Runtime shared-state sanitizer (race-dep), the dynamic companion to
+the static ``shared-state`` rule — exactly as :mod:`lockdep` is to the
+static ``lock-order`` rule.
+
+The static rule over-approximates (it merges instances by class and
+cannot see hand-offs through queues); annotations silence what it gets
+wrong. This shim audits the annotations from the other side: it records
+every instrumented access as an ``(attr, thread, role, locks-held)``
+tuple and flags the FIRST unlocked cross-thread write overlap — two
+threads touching the same attribute, at least one writing, with no lock
+class in common — without the race having to strike. A
+``@thread_confined`` claim that is a lie convicts here the first time
+two threads actually touch the attribute.
+
+Usage (tests; production code never imports this on the hot path)::
+
+    dep = LockDepTracker()
+    race = RaceTracker(lockdep=dep)
+    s = SharedState("SolverService", tracker=race)
+    mu = TrackedLock("SolverService._cv", tracker=dep)
+
+    set_thread_role("solver-wave-loop")   # at thread entry
+    with mu:
+        s.waves = 1                       # locked write: fine
+    s.waves                               # unlocked read from another
+                                          # role -> RaceViolation
+
+Violations carry the same role vocabulary the static report and
+``python -m openr_tpu.analysis --roles`` use (via
+:func:`lockdep.set_thread_role`), so a runtime conviction reads like a
+static finding: "written under role solver-wave-loop and read under
+role ctrl with no common lock class". Locks held are observed through
+the paired :class:`lockdep.LockDepTracker`'s per-thread stack, so the
+two sanitizers share one notion of "held" and one lock-class identity
+(``ClassName._attr``).
+
+Detection is first-overlap, lockdep-style: witnesses accumulate per
+attribute and each new access is checked against remembered accesses
+from other threads; one violation is recorded per attribute (the first
+convicting pair), then the attribute goes quiet. The tracker never
+blocks or perturbs scheduling — recording is a dict update under a
+short internal mutex.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from openr_tpu.analysis.lockdep import (
+    LockDepTracker,
+    current_role,
+    get_tracker,
+)
+
+#: cap on remembered witnesses per attribute — enough for any test
+#: harness while bounding memory if an access loop runs hot
+_MAX_WITNESSES = 64
+
+
+@dataclass(frozen=True)
+class _AccessWitness:
+    """One remembered access to one shared attribute."""
+
+    attr: str
+    thread: str
+    thread_id: int
+    role: str
+    write: bool
+    locks: Tuple[str, ...]
+
+    def _describe(self) -> str:
+        kind = "written" if self.write else "read"
+        held = (
+            "holding {" + ", ".join(self.locks) + "}"
+            if self.locks else "holding no lock"
+        )
+        return f"{kind} under role {self.role} ({held})"
+
+
+@dataclass
+class RaceViolation:
+    """An unlocked cross-thread write overlap on one attribute."""
+
+    attr: str
+    first: _AccessWitness
+    second: _AccessWitness
+
+    @property
+    def roles(self) -> Tuple[str, str]:
+        return (self.first.role, self.second.role)
+
+    def __str__(self) -> str:
+        return (
+            f"shared-state race on {self.attr}: "
+            f"{self.first._describe()} and {self.second._describe()} "
+            "with no common lock class"
+        )
+
+
+class RaceError(RuntimeError):
+    """Raised on overlap when the tracker is in raising mode."""
+
+
+class RaceTracker:
+    """Learns per-attribute access witnesses and convicts the first
+    unlocked cross-thread write overlap."""
+
+    def __init__(
+        self,
+        raise_on_violation: bool = False,
+        lockdep: Optional[LockDepTracker] = None,
+    ) -> None:
+        self._mu = threading.Lock()
+        self._lockdep = lockdep if lockdep is not None else get_tracker()
+        self._witnesses: Dict[str, List[_AccessWitness]] = {}
+        self._convicted: Dict[str, RaceViolation] = {}
+        self.raise_on_violation = raise_on_violation
+        self.violations: List[RaceViolation] = []
+
+    # -- recording ----------------------------------------------------
+
+    def record(self, attr: str, write: bool) -> None:
+        """Record one access to ``attr`` (``"Class.attr"`` identity) by
+        the calling thread, stamping its role and the lock classes it
+        holds right now."""
+        t = threading.current_thread()
+        witness = _AccessWitness(
+            attr=attr,
+            thread=t.name,
+            thread_id=threading.get_ident(),
+            role=current_role(),
+            write=write,
+            locks=self._lockdep.held(),
+        )
+        violation: Optional[RaceViolation] = None
+        with self._mu:
+            if attr not in self._convicted:
+                held = set(witness.locks)
+                for prior in self._witnesses.get(attr, ()):
+                    if prior.thread_id == witness.thread_id:
+                        continue
+                    if not (prior.write or witness.write):
+                        continue  # read/read never races
+                    if held & set(prior.locks):
+                        continue  # a common lock class serializes them
+                    violation = RaceViolation(attr, prior, witness)
+                    self._convicted[attr] = violation
+                    self.violations.append(violation)
+                    break
+            bucket = self._witnesses.setdefault(attr, [])
+            if len(bucket) < _MAX_WITNESSES and witness not in bucket:
+                bucket.append(witness)
+        if violation is not None and self.raise_on_violation:
+            raise RaceError(str(violation))
+
+    def reset(self) -> None:
+        with self._mu:
+            self._witnesses.clear()
+            self._convicted.clear()
+            self.violations.clear()
+
+
+class SharedState:
+    """An instrumented attribute bag — the :class:`TrackedLock` analog
+    for shared state. Every attribute read/write on an instance records
+    into the tracker under ``"ClassName.attr"`` identity, so a test can
+    swap one in for a real object's state and let two genuinely
+    scheduled threads convict (or clear) an annotation claim.
+
+    Container mutations count as what they are at the attribute level:
+    read the attribute out (a recorded read), mutate the container —
+    to model the static rule's mutator-call writes, use
+    :meth:`mutate`, which records a write and returns the container.
+    """
+
+    def __init__(self, class_name: str,
+                 tracker: Optional[RaceTracker] = None) -> None:
+        object.__setattr__(self, "_cls", class_name)
+        object.__setattr__(
+            self, "_tracker",
+            tracker if tracker is not None else get_race_tracker(),
+        )
+        object.__setattr__(self, "_values", {})
+
+    def __setattr__(self, name: str, value: object) -> None:
+        self._tracker.record(f"{self._cls}.{name}", write=True)
+        self._values[name] = value
+
+    def __getattr__(self, name: str) -> object:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        values = object.__getattribute__(self, "_values")
+        if name not in values:
+            raise AttributeError(name)
+        self._tracker.record(f"{self._cls}.{name}", write=False)
+        return values[name]
+
+    def mutate(self, name: str) -> object:
+        """Fetch ``name`` for in-place mutation — records a WRITE, the
+        runtime twin of the static rule's ``.append``/``.add``/...
+        mutator-call accounting."""
+        self._tracker.record(f"{self._cls}.{name}", write=True)
+        return object.__getattribute__(self, "_values")[name]
+
+
+_global_tracker: Optional[RaceTracker] = None
+_global_mu = threading.Lock()
+
+
+def get_race_tracker() -> RaceTracker:
+    global _global_tracker
+    with _global_mu:
+        if _global_tracker is None:
+            _global_tracker = RaceTracker()
+        return _global_tracker
+
+
+def reset_race_tracker(
+    lockdep: Optional[LockDepTracker] = None,
+) -> RaceTracker:
+    """Fresh module-level tracker (test fixtures call this)."""
+    global _global_tracker
+    with _global_mu:
+        _global_tracker = RaceTracker(lockdep=lockdep)
+        return _global_tracker

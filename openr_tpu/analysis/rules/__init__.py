@@ -1,4 +1,4 @@
-"""The ten invariant checkers. Each module exports its Rule classes;
+"""The eleven invariant checkers. Each module exports its Rule classes;
 ``ALL_RULES`` is the canonical registry consumed by
 ``core.run_analysis`` and the CLI."""
 
@@ -10,6 +10,7 @@ from openr_tpu.analysis.rules.hostsync import (
 )
 from openr_tpu.analysis.rules.lockorder import LockOrderRule
 from openr_tpu.analysis.rules.mirror_coverage import MirrorCoverageRule
+from openr_tpu.analysis.rules.races import SharedStateRule
 from openr_tpu.analysis.rules.retrace import RetraceRiskRule
 from openr_tpu.analysis.rules.sharding import ShardingSpecRule
 from openr_tpu.analysis.rules.spans import SpanDisciplineRule
@@ -21,6 +22,7 @@ ALL_RULES = (
     CommittedDispatchRule,
     HostBranchInChainRule,
     LockOrderRule,
+    SharedStateRule,
     SpanDisciplineRule,
     RetraceRiskRule,
     ShardingSpecRule,
@@ -36,6 +38,7 @@ __all__ = [
     "HostSyncInWindowRule",
     "LockOrderRule",
     "MirrorCoverageRule",
+    "SharedStateRule",
     "SpanDisciplineRule",
     "RetraceRiskRule",
     "ShardingSpecRule",

@@ -1,0 +1,864 @@
+"""``shared-state``: cross-thread instance-attribute races, statically.
+
+Open/R's design is many single-threaded modules, but the reproduction
+has real cross-thread seams: the Decision emit executor, the
+SolverService wave loop, KvStore's flood executor, netlink/UDP io
+threads, ctrl server connection threads, tracer finish listeners,
+registry gauge callbacks. The classic production killer on those seams
+is a ``self._attr`` written on one thread and read on another with no
+common lock. This rule convicts exactly that, whole-tree:
+
+**Phase A — thread roles.** Every *entry point* that puts code on a
+thread seeds a role:
+
+- ``threading.Thread(target=X, name="...")`` — role is the literal
+  thread name (or ``thread:Class.method`` when the name is dynamic);
+  a target resolving to ``OpenrEventBase.run`` (or a subclass) is the
+  event loop itself, role ``evb``.
+- ``<executor>.submit(X)`` where the receiver was constructed as a
+  ``ThreadPoolExecutor`` — role ``ex:Class._attr``.
+- event-base marshalling and timers (``run_in_event_base``,
+  ``call_and_wait``, ``schedule_timeout``, ``schedule_periodic``,
+  ``add_queue_reader``) plus the constructor-registered callbacks
+  (``AsyncDebounce``, ``AsyncThrottle``, ``PeriodicHandle``) — the
+  callback runs on the loop thread, role ``evb``. All event bases
+  share one role: cross-evb traffic goes through queues by design, and
+  splitting the role per instance would convict same-thread pairs.
+- registered listeners: ``add_finish_listener`` (role
+  ``tracer.finish``), ``Registry.gauge(name, fn)`` (role
+  ``registry.gauge`` — gauges are sampled from whatever thread
+  snapshots the registry).
+- ``@runs_on("ctrl")`` classes (the ctrl server dispatches handler
+  methods by ``getattr`` on per-connection threads — invisible to the
+  AST, so the handler classes declare it) and ``@thread_confined``
+  -pinned methods.
+
+Roles close over the call graph (caller -> callee fixpoint, receivers
+resolved with the same typing machinery as ``lock-order``). A lambda
+or function reference *passed into* a marshalling/registration call is
+attributed to the TARGET role, not the enclosing method's role — the
+``evb.call_and_wait(lambda: self._x)`` idiom reads ``_x`` on the loop
+thread, not the caller's.
+
+**Phase B — conviction.** For each instance attribute: a write outside
+``__init__`` under role A and any access under role B != A, where the
+two sites share no lock class (identity ``Class._attr``, shared with
+``lock-order``; ``Condition(self._lock)`` aliases; a helper only ever
+called with a lock held inherits that lock context), is a finding —
+one per attribute, witnessed at the write.
+
+Declared-safe escapes (``analysis.annotations``):
+
+- ``@thread_confined(role, *attrs)`` — attrs only touched under one
+  role (the runtime sanitizer can convict the claim if it lies);
+- ``@guarded_by("Class._lock", *attrs)`` — always accessed under that
+  lock, including paths the with-stack tracking cannot see;
+- ``@handoff(*attrs)`` — publish-once-then-immutable;
+- an audited ``# openr-lint: disable=shared-state -- why`` at the
+  write site.
+
+Known over-approximations (kept deliberately): methods no role
+reaches never convict (unstarted code is silent, not noisy);
+attributes holding locks, queues, executors and other internally
+locked types are exempt; container mutator calls (``.add``,
+``.append``, ``.update``...) on a self attribute count as writes;
+dynamic dispatch beyond ``@runs_on`` is invisible. The runtime
+companion (:mod:`openr_tpu.analysis.racedep`) watches the gap.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from openr_tpu.analysis.core import (
+    AnalysisContext,
+    Finding,
+    Rule,
+    SourceFile,
+    decorator_info,
+    dotted_name,
+)
+from openr_tpu.analysis.rules.lockorder import (
+    LockOrderRule,
+    _ann_name,
+    _MethodWalk,
+    _Model,
+)
+
+RULE_ID = "shared-state"
+
+#: event-base marshalling / timer APIs: the callable argument runs on
+#: the loop thread (distinctive names — matched receiver-type-free so
+#: untyped ``self._evb`` attributes still resolve)
+_EVB_MARSHAL = {
+    "run_in_event_base",
+    "run_immediately_or_in_event_base",
+    "call_and_wait",
+    "schedule_timeout",
+    "schedule_periodic",
+    "add_queue_reader",
+}
+
+#: constructors that register their callback argument on an event base
+_EVB_CTORS = {"AsyncDebounce", "AsyncThrottle", "PeriodicHandle"}
+
+#: method-name -> role for listener registries
+_LISTENER_ROLES = {
+    "add_finish_listener": "tracer.finish",
+    "gauge": "registry.gauge",
+}
+
+#: container/object mutator method names: a call on a self attribute
+#: mutates the shared object behind it — counts as a write
+_MUTATORS = {
+    "add",
+    "append",
+    "appendleft",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popitem",
+    "popleft",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+#: constructor type leafs that are internally synchronized (or are
+#: synchronization primitives themselves) — their attrs never convict
+_THREAD_SAFE_TYPES = {
+    "Barrier",
+    "BoundedSemaphore",
+    "Event",
+    "LifoQueue",
+    "PriorityQueue",
+    "Queue",
+    "RQueue",
+    "ReplicateQueue",
+    "Semaphore",
+    "SimpleQueue",
+    "ThreadPoolExecutor",
+    "TrackedLock",
+    "local",
+}
+
+#: the decorators this rule reads (leaf names; analysis.annotations)
+_ANN_THREAD_CONFINED = "thread_confined"
+_ANN_GUARDED_BY = "guarded_by"
+_ANN_HANDOFF = "handoff"
+_ANN_RUNS_ON = "runs_on"
+
+_EVB_ROLE = "evb"
+_EVB_BASE = "OpenrEventBase"
+
+_Key = Tuple[Optional[str], str]
+
+
+@dataclass
+class _Access:
+    """One attribute touch, resolved to roles + effective lock set."""
+
+    write: bool
+    line: int
+    path: str
+    held: FrozenSet[str]
+    roles: FrozenSet[str]
+    in_init: bool
+
+
+@dataclass
+class _Extra:
+    """Race-specific whole-tree facts (beyond lock-order's _Model)."""
+
+    # class -> direct base names
+    bases: Dict[str, List[str]] = field(default_factory=dict)
+    # class -> {attr -> role} from @thread_confined(role, *attrs)
+    confined: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # class -> {attr -> lock id} from @guarded_by(lock, *attrs)
+    guarded: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    # class -> set of @handoff attrs
+    handoff: Dict[str, Set[str]] = field(default_factory=dict)
+    # class -> role from @runs_on(role)
+    runs_on: Dict[str, str] = field(default_factory=dict)
+    # (class, method) -> pinned role from method-level @thread_confined
+    pins: Dict[_Key, str] = field(default_factory=dict)
+    # (class, attr) -> annotated-parameter type ("self._evb = evb"
+    # where "evb: OpenrEventBase"); pruned against class_names later
+    attr_param_type: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # populated at finalize, read by the walkers
+    executor_attrs: Set[Tuple[str, str]] = field(default_factory=set)
+    evb_types: Set[str] = field(default_factory=set)
+
+
+def _literal_str(node: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class SharedStateRule(Rule):
+    id = RULE_ID
+    description = (
+        "an instance attribute written on one thread role and "
+        "accessed on another must share a lock class (or be declared "
+        "@thread_confined / @guarded_by / @handoff)"
+    )
+
+    def __init__(self) -> None:
+        # reuse lock-order's collector verbatim, but store its _Model
+        # under OUR scratch key so the two rules stay independent
+        # (--rule shared-state must work standalone, and our typing
+        # extensions must not leak into lock-order's findings)
+        self._lock_collector = LockOrderRule()
+        self._lock_collector.id = self.id
+        #: method "Class.name" -> sorted role list; kept on the rule
+        #: instance so the CLI --roles dump can read it post-run
+        self.role_map: Dict[str, List[str]] = {}
+
+    # -- collect -----------------------------------------------------
+
+    def collect(self, sf: SourceFile, ctx: AnalysisContext) -> None:
+        self._lock_collector.collect(sf, ctx)
+        x: _Extra = ctx.scratch(self.id).setdefault("x", _Extra())
+        for cls in sf.classes():
+            x.bases.setdefault(
+                cls.name,
+                [b for b in (_ann_name(base) for base in cls.bases) if b],
+            )
+            for dec in cls.decorator_list:
+                name, call = decorator_info(dec)
+                leaf = name.split(".")[-1] if name else None
+                if call is None or leaf is None:
+                    continue
+                args = [_literal_str(a) for a in call.args]
+                if leaf == _ANN_RUNS_ON and args and args[0]:
+                    x.runs_on[cls.name] = args[0]
+                elif leaf == _ANN_THREAD_CONFINED and args and args[0]:
+                    table = x.confined.setdefault(cls.name, {})
+                    for a in args[1:]:
+                        if a:
+                            table[a] = args[0]
+                elif leaf == _ANN_GUARDED_BY and args and args[0]:
+                    table = x.guarded.setdefault(cls.name, {})
+                    for a in args[1:]:
+                        if a:
+                            table[a] = args[0]
+                elif leaf == _ANN_HANDOFF:
+                    x.handoff.setdefault(cls.name, set()).update(
+                        a for a in args if a
+                    )
+        for fn, cls in sf.functions():
+            for dec in fn.decorator_list:
+                name, call = decorator_info(dec)
+                leaf = name.split(".")[-1] if name else None
+                if (
+                    leaf == _ANN_THREAD_CONFINED
+                    and call is not None
+                    and len(call.args) == 1
+                ):
+                    role = _literal_str(call.args[0])
+                    if role:
+                        x.pins[(cls, fn.name)] = role
+            if cls is None:
+                continue
+            # "self._x = param" where the param carries a class
+            # annotation: receiver typing the lock-order collector
+            # (constructor calls only) cannot see
+            ann: Dict[str, str] = {}
+            fargs = fn.args
+            for a in fargs.posonlyargs + fargs.args + fargs.kwonlyargs:
+                t = _ann_name(a.annotation)
+                if t is not None:
+                    ann[a.arg] = t
+            if not ann:
+                continue
+            for node in ast.walk(fn):
+                target: Optional[ast.expr] = None
+                value: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                if (
+                    target is None
+                    or value is None
+                    or not isinstance(target, ast.Attribute)
+                    or not isinstance(target.value, ast.Name)
+                    or target.value.id != "self"
+                ):
+                    continue
+                if isinstance(value, ast.Name) and value.id in ann:
+                    x.attr_param_type.setdefault(
+                        (cls, target.attr), ann[value.id]
+                    )
+                    continue
+                # conditional construction: "self._x = Ctor(...) if
+                # flag else None" (and the AnnAssign spelling) — the
+                # lock-order collector only types plain Call assigns
+                cands = [value]
+                if isinstance(value, ast.IfExp):
+                    cands = [value.body, value.orelse]
+                for cand in cands:
+                    if isinstance(cand, ast.Call):
+                        callee = dotted_name(cand.func)
+                        if callee is not None:
+                            x.attr_param_type.setdefault(
+                                (cls, target.attr), callee.split(".")[-1]
+                            )
+                            break
+
+    # -- finalize: roles fixpoint, lock contexts, conviction ---------
+
+    def finalize(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        scratch = ctx.scratch(self.id)
+        model: Optional[_Model] = scratch.get("model")
+        x: Optional[_Extra] = scratch.get("x")
+        if model is None or x is None:
+            return ()
+
+        # merge param-derived attr types (constructor typing wins),
+        # then extract executor/thread-safe attrs BEFORE the known-
+        # class prune discards stdlib type names
+        for k, v in x.attr_param_type.items():
+            model.attr_type.setdefault(k, v)
+        x.executor_attrs = {
+            k
+            for k, v in model.attr_type.items()
+            if v == "ThreadPoolExecutor"
+        }
+        threadsafe_attrs = {
+            k for k, v in model.attr_type.items() if v in _THREAD_SAFE_TYPES
+        }
+        model.attr_type = {
+            k: v for k, v in model.attr_type.items() if v in model.class_names
+        }
+        model.returns = {
+            k: v for k, v in model.returns.items() if v in model.class_names
+        }
+        x.evb_types = _subclass_closure(x.bases, _EVB_BASE)
+
+        walkers: Dict[_Key, "_RaceWalk"] = {}
+        for key, (fn, sf) in model.methods.items():
+            w = _RaceWalk(model, key[0], fn, sf, x)
+            w.run()
+            walkers[key] = w
+
+        roles = self._role_fixpoint(model, x, walkers)
+        entry_held = self._held_fixpoint(model, x, roles, walkers)
+
+        self.role_map = {
+            f"{k[0] or '<module>'}.{k[1]}": sorted(v)
+            for k, v in roles.items()
+            if v
+        }
+        scratch["roles"] = self.role_map
+
+        # -- attribute access table ---------------------------------
+        table: Dict[Tuple[str, str], List[_Access]] = {}
+        for key, w in walkers.items():
+            cls = key[0]
+            if cls is None:
+                continue
+            my_roles = frozenset(roles.get(key, ()))
+            base_held = entry_held.get(key) or frozenset()
+            in_init = key[1] == "__init__"
+            for attr, write, line, held in w.accesses:
+                table.setdefault((cls, attr), []).append(
+                    _Access(
+                        write=write,
+                        line=line,
+                        path=w.sf.path,
+                        held=frozenset(held) | base_held,
+                        roles=my_roles,
+                        in_init=in_init,
+                    )
+                )
+            for attr, write, line, role in w.pseudo:
+                table.setdefault((cls, attr), []).append(
+                    _Access(
+                        write=write,
+                        line=line,
+                        path=w.sf.path,
+                        held=frozenset(),
+                        roles=frozenset((role,)),
+                        in_init=False,
+                    )
+                )
+
+        findings: List[Finding] = []
+        for (cls, attr), accs in sorted(table.items()):
+            if (cls, attr) in model.attr_lock:
+                continue
+            if (cls, attr) in threadsafe_attrs:
+                continue
+            if self._declared_safe(x, cls, attr):
+                continue
+            f = self._convict(cls, attr, accs)
+            if f is not None:
+                findings.append(f)
+        return findings
+
+    # -- role machinery ----------------------------------------------
+
+    def _role_fixpoint(
+        self,
+        model: _Model,
+        x: _Extra,
+        walkers: Dict[_Key, "_RaceWalk"],
+    ) -> Dict[_Key, Set[str]]:
+        roles: Dict[_Key, Set[str]] = {k: set() for k in model.methods}
+        frozen: Set[_Key] = set()
+        for key, role in x.pins.items():
+            if key in roles:
+                roles[key] = {role}
+                frozen.add(key)
+        for cls, role in x.runs_on.items():
+            for key in roles:
+                if key[0] == cls and key not in frozen:
+                    roles[key].add(role)
+        for w in walkers.values():
+            for key, role in w.entries:
+                if key in roles and key not in frozen:
+                    roles[key].add(role)
+        calls: Dict[_Key, Set[_Key]] = {
+            k: {c for c in w.called if c in model.methods}
+            for k, w in walkers.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key, callees in calls.items():
+                src = roles[key]
+                if not src:
+                    continue
+                for callee in callees:
+                    if callee in frozen:
+                        continue
+                    dst = roles[callee]
+                    before = len(dst)
+                    dst |= src
+                    if len(dst) != before:
+                        changed = True
+        return roles
+
+    def _held_fixpoint(
+        self,
+        model: _Model,
+        x: _Extra,
+        roles: Dict[_Key, Set[str]],
+        walkers: Dict[_Key, "_RaceWalk"],
+    ) -> Dict[_Key, Optional[FrozenSet[str]]]:
+        """Entry lock context: the intersection, over every call site
+        on a role-carrying path, of locks held at the call (plus the
+        caller's own entry context). A ``_locked_helper`` only ever
+        invoked under ``self._mu`` inherits {Class._mu}; a thread /
+        callback entry point starts with nothing held. None = not yet
+        reached (top)."""
+        held: Dict[_Key, Optional[FrozenSet[str]]] = {
+            k: None for k in model.methods
+        }
+        entry_keys = {k for k, v in roles.items() if v}
+        # seed: every role entry (spawn/registration target, @runs_on
+        # handler method, pinned method) starts with nothing held
+        seeded: Set[_Key] = set()
+        for w in walkers.values():
+            for key, _role in w.entries:
+                if key in held:
+                    seeded.add(key)
+        for key in held:
+            if key[0] in x.runs_on or key in x.pins:
+                seeded.add(key)
+        for key in seeded:
+            held[key] = frozenset()
+        changed = True
+        while changed:
+            changed = False
+            for key, w in walkers.items():
+                if key not in entry_keys:
+                    continue
+                base = held[key]
+                for callee, site_held in w.call_sites:
+                    if callee not in held or callee in seeded:
+                        continue
+                    contrib: Optional[FrozenSet[str]]
+                    if base is None:
+                        contrib = None
+                    else:
+                        contrib = frozenset(site_held) | base
+                    if contrib is None:
+                        continue
+                    cur = held[callee]
+                    nxt = contrib if cur is None else (cur & contrib)
+                    if nxt != cur:
+                        held[callee] = nxt
+                        changed = True
+        return held
+
+    # -- conviction ---------------------------------------------------
+
+    def _declared_safe(self, x: _Extra, cls: str, attr: str) -> bool:
+        for c in _mro_chain(x.bases, cls):
+            if attr in x.confined.get(c, {}):
+                return True
+            if attr in x.guarded.get(c, {}):
+                return True
+            if attr in x.handoff.get(c, ()):
+                return True
+        return False
+
+    def _convict(
+        self, cls: str, attr: str, accs: List[_Access]
+    ) -> Optional[Finding]:
+        writes = sorted(
+            (a for a in accs if a.write and not a.in_init and a.roles),
+            key=lambda a: (a.path, a.line),
+        )
+        if not writes:
+            return None
+        ordered = sorted(
+            (a for a in accs if a.roles),
+            key=lambda a: (not a.write, a.path, a.line),
+        )
+        for w in writes:
+            for a in ordered:
+                if a.in_init:
+                    continue
+                if w.held & a.held:
+                    continue  # common lock class serializes the pair
+                pair = _role_pair(w.roles, a.roles)
+                if pair is None:
+                    continue
+                r1, r2 = pair
+                kind = "written" if a.write else "read"
+                same = a.line == w.line and a.path == w.path
+                site = "" if same else f" ({a.path}:{a.line})"
+                return Finding(
+                    self.id,
+                    w.path,
+                    w.line,
+                    0,
+                    f"{cls}.{attr} written under role {r1} and {kind} "
+                    f"under role {r2}{site} with no common lock class "
+                    "— cross-thread race; lock both sites or declare "
+                    "@thread_confined/@guarded_by/@handoff",
+                )
+        return None
+
+
+def _role_pair(
+    w_roles: FrozenSet[str], a_roles: FrozenSet[str]
+) -> Optional[Tuple[str, str]]:
+    """Distinct (writer role, accessor role), or None. A single-role
+    pair only convicts when the roles differ; a multi-role method can
+    race against itself (two threads, same code path)."""
+    for r1 in sorted(w_roles):
+        for r2 in sorted(a_roles):
+            if r1 != r2:
+                return r1, r2
+    return None
+
+
+def _subclass_closure(bases: Dict[str, List[str]], root: str) -> Set[str]:
+    out = {root}
+    changed = True
+    while changed:
+        changed = False
+        for cls, parents in bases.items():
+            if cls not in out and any(p in out for p in parents):
+                out.add(cls)
+                changed = True
+    return out
+
+
+def _mro_chain(bases: Dict[str, List[str]], cls: str) -> List[str]:
+    """cls plus transitive in-tree bases (declaration-ordered DFS)."""
+    seen: List[str] = []
+    stack = [cls]
+    while stack:
+        c = stack.pop(0)
+        if c in seen:
+            continue
+        seen.append(c)
+        stack.extend(bases.get(c, ()))
+    return seen
+
+
+class _RaceWalk(_MethodWalk):
+    """Method traversal that additionally records attribute accesses
+    with their held-lock sets, every call site, and the thread-role
+    entry points created by spawning / submitting / registering."""
+
+    def __init__(
+        self,
+        model: _Model,
+        cls: Optional[str],
+        fn: ast.AST,
+        sf: SourceFile,
+        x: _Extra,
+    ) -> None:
+        super().__init__(model, cls, fn, sf)
+        self.x = x
+        # (attr, is_write, line, held tuple) for self.<attr> touches
+        self.accesses: List[Tuple[str, bool, int, FrozenSet[str]]] = []
+        # (callee key, held) for every resolvable call site
+        self.call_sites: List[Tuple[_Key, FrozenSet[str]]] = []
+        # (method key, role) registrations discovered here
+        self.entries: List[Tuple[_Key, str]] = []
+        # (attr, is_write, line, role) accesses inside lambdas handed
+        # to a marshalling/registration call: they run under the
+        # TARGET role, with nothing held
+        self.pseudo: List[Tuple[str, bool, int, str]] = []
+
+    # -- write-aware statement handling ------------------------------
+
+    def _walk_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            for t in targets:
+                self._record_target(t, held)
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                self._record_target(t, held)
+            return
+        super()._walk_stmt(stmt, held)
+
+    def _self_attr(self, node: ast.expr) -> Optional[str]:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _record_target(self, t: ast.expr, held: List[str]) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._record_target(e, held)
+            return
+        if isinstance(t, ast.Starred):
+            self._record_target(t.value, held)
+            return
+        if isinstance(t, ast.Attribute):
+            attr = self._self_attr(t)
+            if attr is not None:
+                self.accesses.append(
+                    (attr, True, t.lineno, frozenset(held))
+                )
+                return
+            # obj.field = v mutates the object behind obj; if obj is a
+            # self attribute, that is a write through the shared ref
+            inner = self._self_attr(t.value)
+            if inner is not None:
+                self.accesses.append(
+                    (inner, True, t.lineno, frozenset(held))
+                )
+                return
+            self._scan_expr(t.value, held)
+            return
+        if isinstance(t, ast.Subscript):
+            attr = self._self_attr(t.value)
+            if attr is not None:
+                self.accesses.append(
+                    (attr, True, t.lineno, frozenset(held))
+                )
+            else:
+                self._scan_expr(t.value, held)
+            self._scan_expr(t.slice, held)
+            return
+        # plain Name targets are locals
+
+    # -- expression scanning with role-aware call handling -----------
+
+    def _scan_expr(self, expr: ast.expr, held: List[str]) -> None:
+        self._scan_node(expr, held)
+
+    def _scan_node(self, node: ast.AST, held: List[str]) -> None:
+        attr = self._self_attr(node) if isinstance(node, ast.expr) else None
+        if attr is not None:
+            self.accesses.append((attr, False, node.lineno, frozenset(held)))
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, held)
+
+    def _scan_call(self, node: ast.Call, held: List[str]) -> None:
+        func = node.func
+        # explicit .acquire() — mirror the parent's bookkeeping
+        if isinstance(func, ast.Attribute) and func.attr == "acquire":
+            lock = self._lock_id(func.value)
+            if lock is not None:
+                self.acquired.add(lock)
+                for h in held:
+                    self.nested.append(
+                        (h, lock, node.lineno, f"{lock}.acquire()")
+                    )
+                for arg in node.args:
+                    self._scan_node(arg, held)
+                return
+        # container mutators through a self attribute are writes
+        if isinstance(func, ast.Attribute):
+            recv_attr = self._self_attr(func.value)
+            if recv_attr is not None and func.attr in _MUTATORS:
+                self.accesses.append(
+                    (recv_attr, True, node.lineno, frozenset(held))
+                )
+        if self._handle_registration(node, held):
+            return
+        key = self._callee_key(node)
+        if key is not None:
+            self.called.add(key)
+            self.call_sites.append((key, frozenset(held)))
+            for h in held:
+                self.calls_while_held.append((h, key, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            self._scan_node(child, held)
+
+    # -- registration / spawn interception ---------------------------
+
+    def _handle_registration(self, node: ast.Call, held: List[str]) -> bool:
+        """If ``node`` hands a callable to another thread role, record
+        the entry (or pseudo accesses for lambdas) and scan the
+        remaining arguments normally. Returns True when handled."""
+        func = node.func
+        callee = dotted_name(func)
+        leaf = callee.split(".")[-1] if callee else None
+
+        role: Optional[str] = None
+        cb_args: List[ast.expr] = []
+
+        if leaf == "Thread":
+            target = None
+            name_lit = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                elif kw.arg == "name":
+                    name_lit = _literal_str(kw.value)
+            if target is None:
+                return False
+            key = self._method_ref(target)
+            if key is not None:
+                role = self._thread_role(key, name_lit)
+                self.entries.append((key, role))
+            elif isinstance(target, ast.Lambda):
+                role = name_lit or "thread:<lambda>"
+                self._pseudo_scan(target.body, role)
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    self._scan_node(kw.value, held)
+            for arg in node.args:
+                self._scan_node(arg, held)
+            return True
+
+        if leaf in _EVB_CTORS:
+            role = _EVB_ROLE
+            cb_args = list(node.args) + [kw.value for kw in node.keywords]
+        elif isinstance(func, ast.Attribute):
+            if func.attr in _EVB_MARSHAL:
+                role = _EVB_ROLE
+                cb_args = list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]
+                # the receiver expression itself is evaluated here
+                self._scan_node(func.value, held)
+            elif func.attr in _LISTENER_ROLES and len(node.args) >= 1:
+                role = _LISTENER_ROLES[func.attr]
+                cb_args = list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]
+                self._scan_node(func.value, held)
+            elif func.attr == "submit":
+                recv_attr = self._self_attr(func.value)
+                if (
+                    recv_attr is not None
+                    and self.cls is not None
+                    and (self.cls, recv_attr) in self.x.executor_attrs
+                ):
+                    role = f"ex:{self.cls}.{recv_attr}"
+                    cb_args = list(node.args) + [
+                        kw.value for kw in node.keywords
+                    ]
+                    self._scan_node(func.value, held)
+        if role is None:
+            return False
+        for arg in cb_args:
+            self._reg_target(arg, role, held)
+        return True
+
+    def _thread_role(self, key: _Key, name_lit: Optional[str]) -> str:
+        if key[1] == "run" and key[0] in self.x.evb_types:
+            return _EVB_ROLE
+        if name_lit:
+            return name_lit
+        return f"thread:{key[0] or '<module>'}.{key[1]}"
+
+    def _method_ref(self, expr: ast.expr) -> Optional[_Key]:
+        """Resolve a callable *reference* (not a call) to a method key."""
+        if isinstance(expr, ast.Attribute):
+            owner = self._receiver_type(expr.value)
+            if owner is not None and (owner, expr.attr) in self.model.methods:
+                return (owner, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            for key in ((self.cls, expr.id), (None, expr.id)):
+                if key in self.model.methods:
+                    return key
+        return None
+
+    def _reg_target(
+        self, expr: ast.expr, role: str, held: List[str]
+    ) -> None:
+        # functools.partial(fn, ...) — register fn, scan the rest here
+        if isinstance(expr, ast.Call):
+            callee = dotted_name(expr.func)
+            leaf = callee.split(".")[-1] if callee else None
+            if leaf == "partial" and expr.args:
+                self._reg_target(expr.args[0], role, held)
+                for arg in expr.args[1:]:
+                    self._scan_node(arg, held)
+                for kw in expr.keywords:
+                    self._scan_node(kw.value, held)
+                return
+            self._scan_node(expr, held)
+            return
+        if isinstance(expr, ast.Lambda):
+            self._pseudo_scan(expr.body, role)
+            return
+        key = self._method_ref(expr)
+        if key is not None:
+            if key[1] == "run" and key[0] in self.x.evb_types:
+                role = _EVB_ROLE
+            self.entries.append((key, role))
+            return
+        self._scan_node(expr, held)
+
+    def _pseudo_scan(self, node: ast.AST, role: str) -> None:
+        """Attribute accesses / calls inside a lambda body handed to
+        another role: they execute there, with nothing held."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Attribute):
+                    recv = self._self_attr(n.func.value)
+                    if recv is not None and n.func.attr in _MUTATORS:
+                        self.pseudo.append((recv, True, n.lineno, role))
+                key = self._callee_key(n)
+                if key is not None:
+                    self.entries.append((key, role))
+            elif isinstance(n, ast.expr):
+                attr = self._self_attr(n)
+                if attr is not None:
+                    self.pseudo.append((attr, False, n.lineno, role))

@@ -17,6 +17,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from openr_tpu.analysis.annotations import runs_on
 from openr_tpu.messaging.queue import RQueue
 from openr_tpu.types import (
     TTL_INFINITY,
@@ -76,6 +77,7 @@ class _FilteredPublicationReader:
             close()
 
 
+@runs_on("ctrl")
 class OpenrCtrlHandler:
     def __init__(
         self,

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from openr_tpu.analysis.annotations import runs_on
 from openr_tpu.ctrl.server import current_connection, current_trace_context
 from openr_tpu.faults import fault_point
 from openr_tpu.graph.linkstate import LinkState
@@ -53,11 +54,15 @@ def _path_links(path) -> List[List]:
     ]
 
 
+@runs_on("ctrl")
 class SolverCtrlHandler:
     """One per service process. Tenants registered over a connection
     are tied to it (``ctrl.server.current_connection``); the server's
     ``connection_closed`` teardown parks them warm through
-    ``SolverService.connection_closed``."""
+    ``SolverService.connection_closed``. Every method runs on a
+    per-connection ctrl server thread (``@runs_on`` seeds the
+    shared-state rule's role inference across the duck-typed
+    dispatch)."""
 
     def __init__(self, service: SolverService):
         self._svc = service

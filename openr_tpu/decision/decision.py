@@ -23,6 +23,7 @@ The solver behind it runs the TPU kernels (see spf_solver.py).
 from __future__ import annotations
 
 import base64
+import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, Optional, Set, Tuple
@@ -40,7 +41,11 @@ from openr_tpu.types import (
     PrefixDatabase,
     PrefixEntry,
 )
-from openr_tpu.analysis.annotations import fault_boundary, solve_window
+from openr_tpu.analysis.annotations import (
+    fault_boundary,
+    solve_window,
+    thread_confined,
+)
 from openr_tpu.faults.supervisor import DegradationSupervisor, HealthState
 from openr_tpu.integrity import get_auditor, quarantine_active
 from openr_tpu.load.admission import AdmissionControl
@@ -174,6 +179,10 @@ class DecisionPendingUpdates:
         self.release_trace()
 
 
+# route_db is single-owner by mode, not by lock: eager mode mutates it
+# on the event base; pipelined mode hands ownership to the emit worker,
+# and every rebuild joins the worker (_drain_emit) before touching it.
+@thread_confined("owner", "route_db")
 class Decision:
     def __init__(
         self,
@@ -248,6 +257,11 @@ class Decision:
         # ladder was fully warm and no engine sat in integrity
         # quarantine — the staleness gauge ages from it while degraded
         self._last_good_route_ts: Optional[float] = None
+        # the stamp is written by whichever role emits (event base or
+        # the emit worker) and read by the registry's gauge thread —
+        # a dedicated lock keeps the pair race-free without dragging
+        # the gauge into the emit path's wider critical sections
+        self._emit_mu = threading.Lock()
         get_registry().gauge(
             "decision.route_staleness_ms", self._route_staleness_ms
         )
@@ -634,14 +648,16 @@ class Decision:
         verified-good refresh: 0 while the ladder is warm and no engine
         is quarantined (or before the first install), else the age of
         the last route db installed in that state. Self-heal zeroes it."""
-        if self._last_good_route_ts is None:
+        with self._emit_mu:
+            ts = self._last_good_route_ts
+        if ts is None:
             return 0.0
         if (
             self.supervisor.state is HealthState.HEALTHY
             and not quarantine_active()
         ):
             return 0.0
-        return (time.monotonic() - self._last_good_route_ts) * 1000.0
+        return (time.monotonic() - ts) * 1000.0
 
     def checkpoint_state(self) -> None:
         """Persist the engines' warm-start material to the state plane.
@@ -847,7 +863,8 @@ class Decision:
             self.supervisor.state is HealthState.HEALTHY
             and not quarantine_active()
         ):
-            self._last_good_route_ts = time.monotonic()
+            with self._emit_mu:
+                self._last_good_route_ts = time.monotonic()
         update.perf_events = perf_events
         update.trace = trace
         self.route_updates_queue.push(update)

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, List, Optional, Set
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.types import (
     IpPrefix,
     MplsRoute,
@@ -126,6 +127,11 @@ class DecisionRouteUpdate:
         )
 
 
+# a passive container with a single owner at any moment: Decision
+# mutates it on whichever role currently drives emission (see
+# Decision.route_db's owner confinement) — it carries no lock of its
+# own by design
+@thread_confined("owner", "unicast_routes", "mpls_routes")
 @dataclass
 class DecisionRouteDb:
     """The full computed RIB. reference: openr/decision/Decision.h:95."""

@@ -124,6 +124,7 @@ class DegradationSupervisor:
             # nothing worked: stay broken, keep the breaker open so the
             # next walk still skips ahead, and surface the causes
             reg.counter_bump(f"{self.name}.ladder_exhausted")
+            # openr-lint: disable=shared-state -- health gauge reads this single enum reference unlocked; a GIL-atomic stale read only ages one scrape
             self.state = HealthState.FALLBACK
             self.breaker.report_error()
             self._held_rung = len(rungs) - 1

@@ -74,6 +74,7 @@ class Watchdog:
                 self._fire_crash(
                     f"event base {name!r} stalled for {stalled_for:.1f}s"
                 )
+        # openr-lint: disable=shared-state -- stall gauge reads this single int unlocked; a GIL-atomic stale read only ages one scrape
         self._stalled = stalled
         if self.memory_limit_exceeded():
             self._fire_crash(

@@ -1476,9 +1476,6 @@ class RouteSweepEngine(ResidentEngineContract):
         scalar riding the async lane (PendingDelta full-width mode):
         the overflow rungs then also submit-and-walk-away, keeping the
         committed two-touch event window."""
-        # openr-lint: disable=sharding-spec -- elementwise diff of
-        # two committed operands: propagation keeps their (identical)
-        # placements; overflow rung, not the steady-state churn path
         ch_count, comp = aot_call(
             "compact_changed", _compact_changed,
             (packed, self._packed_dev),

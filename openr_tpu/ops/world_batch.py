@@ -71,7 +71,7 @@ import numpy as np
 from openr_tpu.faults import consume_fault, fault_point, is_device_loss
 from openr_tpu.integrity import ResidentEngineContract, get_auditor
 from openr_tpu.integrity import kernels as integrity_kernels
-from openr_tpu.analysis.annotations import committed_dispatch
+from openr_tpu.analysis.annotations import committed_dispatch, thread_confined
 from openr_tpu.ops import dispatch_accounting as da
 from openr_tpu.ops.route_engine import (
     FAULT_CORRUPT,
@@ -279,6 +279,21 @@ class WorldBucket:
         return sum(1 for t in self.tenants if t is not None)
 
 
+# externally serialized, never internally locked: the serve plane
+# drives its manager only under SolverService._mgr_lock, and every
+# other instance (tenancy tests, twin replay) lives on one thread.
+# The rule merges all instances by class, so cross-role access to one
+# instance is impossible by construction — hence "owner" confinement.
+@thread_confined(
+    "owner",
+    "_buckets",
+    "_clock",
+    "_corrupt_events",
+    "_graph_share",
+    "_patch_share",
+    "_slo_classes",
+    "_tenants",
+)
 class WorldManager(ResidentEngineContract):
     """The residency arbiter + dispatch front end (see module
     docstring). One per process by default (``get_world_manager``) —

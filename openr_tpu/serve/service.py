@@ -157,6 +157,11 @@ class SolverService:
         self._mgr_lock = threading.RLock()
         self._conn_tenants: Dict[int, Set[str]] = {}
         self._detached: Set[str] = set()
+        # SLO classes mirrored under _cv so the request arrival path
+        # never touches _mgr_lock — the wave loop holds that for the
+        # whole solve, and an arrival blocking on it would serialize
+        # behind the wave instead of joining the next one
+        self._slo: Dict[str, str] = {}
         self._reg = _get_registry()
         # standing anomaly set + one p99-breach trigger per SLO class,
         # so every breach freezes the flight ring with the admission /
@@ -197,7 +202,8 @@ class SolverService:
         return self._mgr
 
     def waves(self) -> int:
-        return self._waves
+        with self._cv:
+            return self._waves
 
     # -- client surface ----------------------------------------------------
 
@@ -214,7 +220,9 @@ class SolverService:
                 self._conn_tenants.setdefault(conn, set()).add(
                     tenant_id
                 )
-        self._detached.discard(tenant_id)
+        with self._cv:
+            self._slo[tenant_id] = slo
+            self._detached.discard(tenant_id)
 
     def request_solve(self, tenant_id: str, ls, root: str,
                       trace_ctx: Optional[Dict] = None) -> SolveRequest:
@@ -223,10 +231,11 @@ class SolverService:
         an in-flight wave are the continuous-batching case — they ride
         the next wave, counted as wave joins."""
         with self._cv:
+            slo = self._slo.get(tenant_id, "standard")
             self._seq += 1
             r = SolveRequest(
                 tenant_id, ls, root,
-                self._mgr.slo_class(tenant_id), self._seq,
+                slo, self._seq,
                 trace_ctx=trace_ctx,
             )
             old = self._pending.get(tenant_id)
@@ -271,7 +280,8 @@ class SolverService:
                 self._mgr.park(tenant_id)
             else:
                 self._mgr.drop(tenant_id)
-        self._detached.add(tenant_id)
+        with self._cv:
+            self._detached.add(tenant_id)
 
     def connection_closed(self, conn: int) -> None:
         """Ctrl-transport teardown hook: every tenant the connection
@@ -430,8 +440,9 @@ class SolverService:
             client_spans=client_spans[:64],
         )
         now = time.perf_counter()
+        with self._cv:
+            self._waves += len(batches)
         for bi, batch in enumerate(batches):
-            self._waves += 1
             self._reg.counter_bump("serve.waves")
             views = views_list[bi] if views_list is not None else None
             for i, r in enumerate(batch):

@@ -565,7 +565,11 @@ class FlightRecorder:
             except Exception:  # noqa: BLE001 - anchor extras are
                 reg.counter_bump("flight.anchor_errors")  # best-effort
         counters = reg.snapshot()
-        baseline = self._counter_baseline
+        # the baseline dict is swapped wholesale under _lock on reset;
+        # grab the reference under the same lock so a dump racing a
+        # reset reads one coherent snapshot, never a torn swap
+        with self._lock:
+            baseline = self._counter_baseline
         delta = {k: round(v - baseline.get(k, 0.0), 6)
                  for k, v in counters.items()
                  if v != baseline.get(k, 0.0)}

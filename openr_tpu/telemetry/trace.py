@@ -35,6 +35,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.telemetry.registry import get_registry
 
 _trace_ids = itertools.count(1)
@@ -74,10 +75,13 @@ class Span:
         }
 
 
+@thread_confined("owner", "spans", "_stack", "complete")
 class Trace:
     """An ordered list of spans sharing one trace id. Not thread-safe
     by itself — a trace is owned by exactly one module thread at a
-    time (it travels through the queues with the payload)."""
+    time (it travels through the queues with the payload); the
+    ``"owner"`` confinement above states exactly that hand-off
+    discipline for the shared-state rule."""
 
     __slots__ = ("trace_id", "origin", "ts_ms", "spans", "_stack", "complete")
 

@@ -1,10 +1,11 @@
 """Machine-readable invariant-lint report for CI artifacts.
 
 ``make lint-analysis`` gates on the exit code; this wrapper is the
-artifact side: it runs the same five checkers and writes the full JSON
-payload (every finding, including suppressed ones with their reasons)
-so a CI run keeps an auditable record of which invariant exceptions
-existed at that commit.
+artifact side: it runs the same checkers (with the suppression
+staleness audit on) and writes the full JSON payload (every finding,
+including suppressed ones with their reasons, plus the stale-directive
+count) so a CI run keeps an auditable record of which invariant
+exceptions existed at that commit.
 
 Run:  python -m tools.lint_report [--out artifacts/lint_report.json]
 
@@ -20,7 +21,7 @@ import os
 import sys
 from typing import Optional, Sequence
 
-from openr_tpu.analysis.core import run_analysis
+from openr_tpu.analysis.core import STALE_RULE, run_analysis
 
 
 def _repo_root() -> str:
@@ -45,8 +46,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     args = ap.parse_args(argv)
 
-    report = run_analysis(args.root, targets=args.targets)
-    payload = json.dumps(report.to_dict(), indent=2, sort_keys=True)
+    report = run_analysis(
+        args.root, targets=args.targets, audit_suppressions=True
+    )
+    payload = report.to_dict()
+    payload["stale_suppressions"] = sum(
+        1 for f in report.findings if f.rule == STALE_RULE
+    )
+    payload = json.dumps(payload, indent=2, sort_keys=True)
     if args.out == "-":
         print(payload)
     else:
@@ -59,9 +66,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(out)
 
     n_sup = len(report.findings) - len(report.unsuppressed)
+    n_stale = sum(1 for f in report.findings if f.rule == STALE_RULE)
     print(
         f"lint-report: {report.files_scanned} files, "
-        f"{len(report.unsuppressed)} finding(s), {n_sup} suppressed",
+        f"{len(report.unsuppressed)} finding(s), {n_sup} suppressed, "
+        f"{n_stale} stale suppression(s)",
         file=sys.stderr,
     )
     for f in report.unsuppressed:

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Thread-provenance race gate (``make race-smoke``) and report
+artifact.
+
+Exercises both halves of the race detector
+(``openr_tpu.analysis.rules.races`` static, ``analysis.racedep``
+runtime) and fails loudly if either regressed:
+
+- STATIC CLEAN: the whole-tree ``shared-state`` rule must report ZERO
+  unsuppressed findings, every suppression must carry a reason, and
+  the suppression-staleness audit must report ZERO stale directives
+  (a directive shielding nothing is rot that hides regressions),
+- ROLE MAP ALIVE: role inference must still see the load-bearing
+  roles — the event-base role, the solver wave loop, the ctrl
+  connection threads and at least one executor role — over a sane
+  number of role-carrying methods (an empty map means the fixpoint
+  silently died and the rule passes vacuously),
+- RUNTIME CONVICTION: the racedep sanitizer must convict a seeded
+  two-thread unlocked write/read overlap under DETERMINISTIC barrier
+  scheduling (no sleeps, no real race required to strike) with both
+  static role names attributed, and must stay SILENT on the
+  lock-guarded twin of the same schedule,
+- LOCKDEP ATTRIBUTION: a seeded lock-order inversion must carry the
+  acquiring thread's registered role name in its violation.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_race_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Pure host-side — no jax import, sub-10s on the whole
+tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+# allow direct invocation (python tools/race_smoke.py) in addition
+# to module mode (python -m tools.race_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: roles that must survive in the inferred map — each one anchors a
+#: cross-thread seam the rule exists to watch
+_LOAD_BEARING_ROLES = ("evb", "solver-wave-loop", "ctrl")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _static_leg(report_out: dict, reasons: list) -> None:
+    from openr_tpu.analysis.core import STALE_RULE, run_analysis
+    from openr_tpu.analysis.rules.races import SharedStateRule
+
+    rule = SharedStateRule()
+    report = run_analysis(
+        _repo_root(), rules=[rule], audit_suppressions=True
+    )
+    unsup = [f for f in report.unsuppressed if f.rule == rule.id]
+    stale = [f for f in report.findings if f.rule == STALE_RULE]
+    reasonless = [
+        f for f in report.findings
+        if f.suppressed and f.rule == rule.id and not f.reason
+    ]
+    roles_seen = set()
+    for roles in rule.role_map.values():
+        roles_seen.update(roles)
+    missing = [r for r in _LOAD_BEARING_ROLES if r not in roles_seen]
+    has_executor = any(r.startswith("ex:") for r in roles_seen)
+
+    report_out["static"] = {
+        "files_scanned": report.files_scanned,
+        "unsuppressed": [f.to_dict() for f in unsup],
+        "suppressed": sum(
+            1 for f in report.findings
+            if f.suppressed and f.rule == rule.id
+        ),
+        "stale_suppressions": len(stale),
+        "role_carrying_methods": len(rule.role_map),
+        "roles_seen": sorted(roles_seen),
+        "duration_s": round(report.duration_s, 3),
+    }
+    if unsup:
+        reasons.append(
+            f"shared-state: {len(unsup)} unsuppressed finding(s)"
+        )
+    if reasonless:
+        reasons.append(
+            f"shared-state: {len(reasonless)} suppression(s) "
+            "without a reason"
+        )
+    if stale:
+        reasons.append(
+            f"suppression audit: {len(stale)} stale directive(s)"
+        )
+    if missing:
+        reasons.append(
+            f"role map lost load-bearing role(s): {missing}"
+        )
+    if not has_executor:
+        reasons.append("role map lost every executor (ex:*) role")
+    if len(rule.role_map) < 50:
+        reasons.append(
+            "role fixpoint collapsed: only "
+            f"{len(rule.role_map)} role-carrying methods"
+        )
+
+
+def _runtime_leg(report_out: dict, reasons: list) -> None:
+    """Deterministic barrier-scheduled conviction: the overlap is
+    forced by schedule, not by timing — thread W writes unlocked,
+    thread R reads unlocked strictly after (barrier order), and the
+    tracker must convict WITHOUT the race ever striking."""
+    from openr_tpu.analysis.lockdep import (
+        LockDepTracker,
+        TrackedLock,
+        set_thread_role,
+    )
+    from openr_tpu.analysis.racedep import RaceTracker, SharedState
+
+    def schedule(locked: bool):
+        dep = LockDepTracker()
+        race = RaceTracker(lockdep=dep)
+        state = SharedState("SolverService", tracker=race)
+        mu = TrackedLock("SolverService._cv", tracker=dep)
+        gate = threading.Barrier(2)
+        errs = []
+
+        def writer():
+            try:
+                set_thread_role("solver-wave-loop")
+                if locked:
+                    with mu:
+                        state.waves = 1
+                else:
+                    state.waves = 1
+                gate.wait()  # publish strictly before the read
+            except Exception as exc:  # pragma: no cover - harness bug
+                errs.append(repr(exc))
+
+        def reader():
+            try:
+                set_thread_role("ctrl")
+                gate.wait()  # read strictly after the write
+                if locked:
+                    with mu:
+                        _ = state.waves
+                else:
+                    _ = state.waves
+            except Exception as exc:  # pragma: no cover - harness bug
+                errs.append(repr(exc))
+
+        tw = threading.Thread(target=writer, name="race-smoke-wave")
+        tr = threading.Thread(target=reader, name="race-smoke-ctrl")
+        tw.start(); tr.start(); tw.join(); tr.join()
+        if errs:
+            reasons.append(f"runtime harness error: {errs}")
+        return race
+
+    unlocked = schedule(locked=False)
+    locked = schedule(locked=True)
+
+    report_out["runtime"] = {
+        "unlocked_violations": [str(v) for v in unlocked.violations],
+        "unlocked_roles": [
+            list(v.roles) for v in unlocked.violations
+        ],
+        "locked_violations": [str(v) for v in locked.violations],
+    }
+    if len(unlocked.violations) != 1:
+        reasons.append(
+            "racedep failed to convict the seeded unlocked overlap "
+            f"({len(unlocked.violations)} violations)"
+        )
+    else:
+        got = set(unlocked.violations[0].roles)
+        if got != {"solver-wave-loop", "ctrl"}:
+            reasons.append(
+                f"racedep conviction lost role attribution: {got}"
+            )
+    if locked.violations:
+        reasons.append(
+            "racedep convicted the lock-guarded twin "
+            f"({len(locked.violations)} violations) — false positive"
+        )
+
+
+def _lockdep_leg(report_out: dict, reasons: list) -> None:
+    from openr_tpu.analysis.lockdep import (
+        LockDepTracker,
+        TrackedLock,
+        set_thread_role,
+    )
+
+    dep = LockDepTracker()
+    a = TrackedLock("KvStoreDb._lock", tracker=dep)
+    b = TrackedLock("Registry._lock", tracker=dep)
+
+    def fwd():
+        set_thread_role("evb")
+        with a:
+            with b:
+                pass
+
+    def rev():
+        set_thread_role("solver-wave-loop")
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=fwd, name="race-smoke-fwd")
+    t1.start(); t1.join()
+    t2 = threading.Thread(target=rev, name="race-smoke-rev")
+    t2.start(); t2.join()
+
+    report_out["lockdep"] = {
+        "violations": [str(v) for v in dep.violations],
+        "roles": [v.witness.role for v in dep.violations],
+    }
+    if len(dep.violations) != 1:
+        reasons.append(
+            "lockdep failed to flag the seeded inversion "
+            f"({len(dep.violations)} violations)"
+        )
+    elif dep.violations[0].witness.role != "solver-wave-loop":
+        reasons.append(
+            "lockdep violation lost role attribution: "
+            f"{dep.violations[0].witness.role!r}"
+        )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_race_smoke.json"
+    )
+    args = parser.parse_args(argv)
+
+    report: dict = {}
+    reasons: list = []
+    _static_leg(report, reasons)
+    _runtime_leg(report, reasons)
+    _lockdep_leg(report, reasons)
+
+    report["pass"] = not reasons
+    report["reasons"] = reasons
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(
+        "race-smoke: "
+        f"{report['static']['files_scanned']} files, "
+        f"{report['static']['role_carrying_methods']} role-carrying "
+        "methods, "
+        f"{report['static']['stale_suppressions']} stale, "
+        f"{len(report['runtime']['unlocked_violations'])} runtime "
+        "conviction(s)"
+    )
+    if reasons:
+        for r in reasons:
+            print(f"race-smoke FAIL: {r}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
