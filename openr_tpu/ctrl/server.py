@@ -49,6 +49,30 @@ def current_trace_context() -> Optional[Dict[str, Any]]:
     return getattr(_CONN, "trace_ctx", None)
 
 
+class CtrlRedirect(Exception):
+    """Raised inside a handler dispatch to answer with a redirect: the
+    reply frame carries ``moved_to: {host, port}`` next to the error
+    text, and a fleet-aware client re-dials the target it names (the
+    solver client counts the hop and follows it; a plain ``CtrlClient``
+    surfaces it as the usual RuntimeError)."""
+
+    def __init__(self, message: str, host: str, port: int):
+        super().__init__(message)
+        self.host = host
+        self.port = port
+
+
+class CtrlRetry(Exception):
+    """Raised inside a handler dispatch to answer retry-later: the
+    target exists but is transiently unroutable (a tenant frozen
+    mid-migration). The reply frame carries ``retry: true`` and a
+    ``retry_after_ms`` hint the client's backoff respects."""
+
+    def __init__(self, message: str, retry_after_ms: float = 50.0):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
+
+
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     payload = json.dumps(obj).encode("utf-8")
     sock.sendall(struct.pack(">I", len(payload)) + payload)
@@ -113,10 +137,26 @@ class CtrlServer:
         self._thrift_backend = ThriftCtrlServer(
             handler, listen=False
         )
+        # live accepted sockets, severed on stop(): a stopped server
+        # must look DEAD to connected clients (the fleet failover
+        # detector and the client reconnect path both depend on open
+        # connections dying with the service, as they do when a real
+        # process/device is lost)
+        self._live_lock = threading.Lock()
+        self._live: set = set()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                with outer._live_lock:
+                    outer._live.add(self.request)
+                try:
+                    self._handle_classified()
+                finally:
+                    with outer._live_lock:
+                        outer._live.discard(self.request)
+
+            def _handle_classified(self) -> None:
                 from openr_tpu.utils.rpc import (
                     peek_first_bytes,
                     wrap_server_connection,
@@ -162,6 +202,17 @@ class CtrlServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        with self._live_lock:
+            live = list(self._live)
+        for sock in live:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def _serve_json(self, sock) -> None:
         with _CONN_LOCK:
@@ -222,6 +273,19 @@ class CtrlServer:
         try:
             result = method(**kwargs)
             _send_frame(sock, {"ok": True, "result": to_jsonable(result)})
+        except CtrlRedirect as e:
+            _send_frame(sock, {
+                "ok": False,
+                "error": str(e),
+                "moved_to": {"host": e.host, "port": e.port},
+            })
+        except CtrlRetry as e:
+            _send_frame(sock, {
+                "ok": False,
+                "error": str(e),
+                "retry": True,
+                "retry_after_ms": e.retry_after_ms,
+            })
         except Exception as e:  # noqa: BLE001 - relayed to client
             _send_frame(sock, {"ok": False, "error": repr(e)})
         finally:

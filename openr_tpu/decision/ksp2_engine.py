@@ -53,7 +53,11 @@ from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
 import numpy as np
 
-from openr_tpu.analysis.annotations import mirrored_by, resident_buffers
+from openr_tpu.analysis.annotations import (
+    mirrored_by,
+    resident_buffers,
+    thread_confined,
+)
 from openr_tpu.graph.linkstate import Link, LinkState
 from openr_tpu.ops import dispatch_accounting as _da
 from openr_tpu.ops.spf import INF
@@ -367,6 +371,44 @@ def _pad_ids(ids: List[int], bucket_min: int = 8) -> np.ndarray:
     masks_t="re-derived by _cold_build from the band tensor shapes",
 )
 @resident_buffers("d_prev_dev", "dm_dev", "masks_t")
+# externally serialized, never internally locked: every engine is
+# created and driven by exactly one plane — Decision's under evb, a
+# ctrl handler's under SolverCtrlHandler._lock, the twin's on its one
+# thread. The shared-state rule merges all instances by class, so
+# cross-role access to one instance is impossible by construction —
+# hence "owner" confinement (same contract as WorldManager).
+@thread_confined(
+    "owner",
+    "_mesh",
+    "_mesh_knob",
+    "_slot_maps",
+    "_tarrays",
+    "attr_sig",
+    "aversion",
+    "band_shapes",
+    "d_base",
+    "d_prev_dev",
+    "dm",
+    "dm_dev",
+    "dst_pos",
+    "dsts",
+    "ecc_hops",
+    "eff_w",
+    "excl",
+    "first_paths",
+    "host_dsts",
+    "last_affected",
+    "masks_t",
+    "node_label",
+    "node_users",
+    "ov",
+    "pairs_by_node",
+    "second_paths",
+    "sid",
+    "state",
+    "valid",
+    "version",
+)
 class Ksp2Engine:
     """Per-(LinkState, root) incremental KSP2 state. Invalid until the
     first successful cold build."""

@@ -9,12 +9,19 @@ from __future__ import annotations
 
 from typing import Dict, Set, Tuple
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.types import IpPrefix, PrefixDatabase, PrefixEntry
 
 NodeAndArea = Tuple[str, str]
 PrefixEntries = Dict[NodeAndArea, PrefixEntry]
 
 
+# externally serialized, never internally locked: every PrefixState is
+# owned by one plane (Decision under evb; a ctrl handler's tenant
+# views under SolverCtrlHandler._lock). The shared-state rule merges
+# instances by class, so cross-role access to one instance is
+# impossible by construction — hence "owner" confinement.
+@thread_confined("owner", "_node_to_prefixes", "_prefixes", "version")
 class PrefixState:
     def __init__(self) -> None:
         self._prefixes: Dict[IpPrefix, PrefixEntries] = {}

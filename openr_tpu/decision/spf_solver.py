@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.decision.prefix_state import NodeAndArea, PrefixEntries, PrefixState
 from openr_tpu.decision.rib import DecisionRouteDb, RibMplsEntry, RibUnicastEntry
 from openr_tpu.faults.injector import fault_point, register_fault_site
@@ -732,6 +733,36 @@ def reset_device_caches() -> None:
         pass
 
 
+# externally serialized, never internally locked: every solver is
+# created and driven by exactly one plane — Decision's under evb, a
+# ctrl handler's (fleet FIB builds, replica absorb) under
+# SolverCtrlHandler._lock, the twin's on its one thread. The
+# shared-state rule merges all instances by class, so cross-role
+# access to one instance is impossible by construction — hence
+# "owner" confinement (same contract as WorldManager).
+@thread_confined(
+    "owner",
+    "_advertisers_cache",
+    "_build_seq",
+    "_ksp2_dsts_cache",
+    "_ksp2_engines",
+    "_ksp2_tracked",
+    "_label_cache",
+    "_label_state",
+    "_labels_cache",
+    "_route_best_cache",
+    "_route_cache",
+    "_route_cache_meta",
+    "_route_entries_cache",
+    "_sp_prev_seq",
+    "_sp_reuse",
+    "_spec_staged",
+    "_static_routes_version",
+    "_views",
+    "backend",
+    "best_routes_cache",
+    "static_mpls_routes",
+)
 class SpfSolver:
     """reference: openr/decision/Decision.h:202 SpfSolver (pImpl)."""
 

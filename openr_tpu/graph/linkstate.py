@@ -27,6 +27,7 @@ import heapq
 from dataclasses import dataclass, field
 from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.types import Adjacency, AdjacencyDatabase, BinaryAddress
 
 Metric = int
@@ -353,6 +354,26 @@ SpfResult = Dict[str, NodeSpfResult]
 Path = List[Link]
 
 
+# externally serialized, never internally locked: every LinkState is
+# created and driven by exactly one plane — Decision's under evb, a
+# ctrl handler's (tenant mirrors, replica absorb, warm import) under
+# SolverCtrlHandler._lock, the twin's on its one thread. The
+# shared-state rule merges all instances by class, so cross-role
+# access to one instance is impossible by construction — hence
+# "owner" confinement (same contract as WorldManager).
+@thread_confined(
+    "owner",
+    "_adj_dbs",
+    "_kth_path_cache",
+    "_link_map",
+    "_node_overloads",
+    "_ordered_links_memo",
+    "_spf_cache",
+    "attr_journal",
+    "attributes_version",
+    "change_journal",
+    "topology_version",
+)
 class LinkState:
     """Area-scoped link-state graph with incremental updates and memoized
     shortest-path queries."""

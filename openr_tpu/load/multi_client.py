@@ -37,20 +37,27 @@ class TenantSpec:
     seed: int = 0
     slo: str = "standard"
 
-    def build_dbs(self) -> Dict[str, "object"]:
+    def build_topology(self):
         from openr_tpu.models import topologies
 
         if self.kind == "grid":
-            topo = topologies.grid(self.size)
-        elif self.kind == "ring":
-            topo = topologies.ring(self.size)
-        elif self.kind == "mesh":
-            topo = topologies.random_mesh(
+            return topologies.grid(self.size)
+        if self.kind == "ring":
+            return topologies.ring(self.size)
+        if self.kind == "mesh":
+            return topologies.random_mesh(
                 self.size, 3, seed=self.seed or 7
             )
-        else:
-            raise ValueError(f"unknown topology kind {self.kind!r}")
-        return dict(topo.adj_dbs)
+        raise ValueError(f"unknown topology kind {self.kind!r}")
+
+    def build_dbs(self) -> Dict[str, "object"]:
+        return dict(self.build_topology().adj_dbs)
+
+    def build_prefix_dbs(self) -> Dict[str, "object"]:
+        """Per-node loopback PrefixDatabases — what the FIB-level view
+        routes toward (static across the churn schedule: mutations
+        touch adjacency metrics only)."""
+        return dict(self.build_topology().prefix_dbs)
 
     def root_of(self, dbs: Dict) -> str:
         return sorted(dbs)[0]
@@ -89,6 +96,9 @@ def run_client(
     out_path: str,
     ksp2_every: int = 0,
     hold_open_s: float = 0.0,
+    endpoints: Dict[str, List] = None,
+    controller: List = None,
+    fib_every: int = 0,
 ) -> None:
     """Child-process entry: drive ``specs``' tenants for ``rounds``
     churn rounds and write a JSON result file — per-request latencies
@@ -96,7 +106,16 @@ def run_client(
     any errors. ``ksp2_every > 0`` also solicits the second-path view
     every that-many rounds (digested as the JSON text of the reply).
     ``hold_open_s`` keeps the connection (and its tenants) alive after
-    the last round — the disconnect tests use it."""
+    the last round — the disconnect tests use it.
+
+    Fleet mode: ``endpoints`` maps tenant_id -> [host, port] (the
+    controller's admission decisions; tenants without an entry use the
+    default endpoint), ``controller`` is the fleet controller's
+    [host, port] for lookup fallback after an endpoint dies, and
+    ``fib_every > 0`` also consumes the FIB-level view (full
+    RouteDatabase digest) every that-many rounds. The client rides
+    migrations and promotions transparently — redirect/reconnect
+    totals land in the result for the parity gates."""
     from openr_tpu.serve.client import SolverClient
 
     result = {
@@ -104,31 +123,60 @@ def run_client(
         "latencies_ms": {},
         "digests": {},
         "ksp2": {},
+        "fib": {},
         "errors": [],
         "rounds": 0,
         "trace_id": None,
         "span_ids": [],
+        "redirects": 0,
+        "reconnects": 0,
     }
+    clients: Dict[tuple, SolverClient] = {}
+    ctrl_ep = tuple(controller) if controller else None
+
+    def client_for(tid: str) -> SolverClient:
+        ep = (host, port)
+        if endpoints and tid in endpoints:
+            e = endpoints[tid]
+            ep = (str(e[0]), int(e[1]))
+        c = clients.get(ep)
+        if c is None:
+            c = clients[ep] = SolverClient(
+                ep[0], ep[1], controller=ctrl_ep
+            )
+        return c
+
     try:
-        client = SolverClient(host, port)
-        # reported back so the parent gate can check cross-wire trace
-        # continuity: these ids must surface in the SERVICE's wave
-        # flight records
-        result["trace_id"] = client.trace_id
         worlds = {}
         for sd in specs:
             spec = TenantSpec(**sd)
             dbs = spec.build_dbs()
             worlds[spec.tenant_id] = (spec, dbs)
+            client = client_for(spec.tenant_id)
+            # reported back so the parent gate can check cross-wire
+            # trace continuity: these ids must surface in the
+            # SERVICE's wave flight records
+            if result["trace_id"] is None:
+                result["trace_id"] = client.trace_id
             client.register(spec.tenant_id, slo=spec.slo)
             client.update_world(
                 spec.tenant_id, [dbs[k] for k in sorted(dbs)],
                 root=spec.root_of(dbs),
+                prefix_dbs=(
+                    [
+                        db for _k, db in sorted(
+                            spec.build_prefix_dbs().items()
+                        )
+                    ]
+                    if fib_every else None
+                ),
             )
             result["digests"][spec.tenant_id] = []
             result["ksp2"][spec.tenant_id] = []
+            result["fib"][spec.tenant_id] = []
         for i in range(rounds):
             for tid, (spec, dbs) in worlds.items():
+                client = client_for(tid)
                 if i > 0:
                     node = apply_mutation(dbs, spec, i)
                     client.update_world(tid, [dbs[node]])
@@ -146,11 +194,19 @@ def run_client(
                     result["ksp2"][tid].append(
                         _digest_text(json.dumps(paths, sort_keys=True))
                     )
+                if fib_every and (i + 1) % fib_every == 0:
+                    result["fib"][tid].append(
+                        client.fib(tid).digest
+                    )
             result["rounds"] = i + 1
-        result["span_ids"] = list(client.span_ids)
+        for c in clients.values():
+            result["span_ids"].extend(list(c.span_ids))
+            result["redirects"] += c.redirects
+            result["reconnects"] += c.reconnects
         if hold_open_s > 0:
             time.sleep(hold_open_s)
-        client.close()
+        for c in clients.values():
+            c.close()
     except Exception as exc:  # noqa: BLE001 - reported in the artifact
         result["errors"].append(repr(exc))
     with open(out_path, "w") as f:
@@ -172,9 +228,14 @@ def spawn_clients(
     out_dir: str,
     ksp2_every: int = 0,
     hold_open_s: float = 0.0,
+    endpoints: Dict[str, List] = None,
+    controller: List = None,
+    fib_every: int = 0,
 ):
     """Launch one spawn-context process per client; returns
-    ``[(proc, out_path)]`` for the parent to join and harvest."""
+    ``[(proc, out_path)]`` for the parent to join and harvest.
+    ``endpoints``/``controller``/``fib_every`` pass through to
+    ``run_client`` for the fleet mode."""
     import multiprocessing as mp
     import os
 
@@ -191,7 +252,9 @@ def spawn_clients(
                 [asdict(s) for s in specs], rounds, out_path,
             ),
             kwargs=dict(
-                ksp2_every=ksp2_every, hold_open_s=hold_open_s
+                ksp2_every=ksp2_every, hold_open_s=hold_open_s,
+                endpoints=endpoints, controller=controller,
+                fib_every=fib_every,
             ),
             daemon=True,
         )
@@ -241,6 +304,64 @@ def oracle_digests(
     return out
 
 
+def oracle_fib_digests(
+    specs: List[TenantSpec], rounds: int, every: int
+) -> Dict[str, List[int]]:
+    """Never-migrated FIB oracle: replay each tenant's schedule on a
+    local ``SpfSolver`` through the SAME recipe the ctrl handler uses
+    (``fleet_preload_views`` over the packed ELL view, then
+    ``build_route_db`` -> canonical ``RouteDatabase``), digesting the
+    wire form on the rounds ``run_client(fib_every=every)`` samples.
+    Imports jax — parent/gate side only."""
+    import numpy as np
+
+    from openr_tpu.decision.prefix_state import PrefixState
+    from openr_tpu.decision.spf_solver import (
+        SpfSolver,
+        fleet_preload_views,
+    )
+    from openr_tpu.graph.linkstate import LinkState
+    from openr_tpu.ops.spf_sparse import (
+        compile_ell,
+        ell_source_batch,
+        ell_view_batch_packed,
+    )
+    from openr_tpu.utils import wire
+
+    out: Dict[str, List[int]] = {}
+    for spec in specs:
+        dbs = spec.build_dbs()
+        ls = LinkState(area="0")
+        for name in sorted(dbs):
+            ls.update_adjacency_database(dbs[name])
+        root = spec.root_of(dbs)
+        pfx = PrefixState()
+        for _name, pdb in sorted(spec.build_prefix_dbs().items()):
+            pfx.update_prefix_database(pdb)
+        solver = SpfSolver(root, backend="device")
+        digests: List[int] = []
+        for i in range(rounds):
+            if i > 0:
+                node = apply_mutation(dbs, spec, i)
+                ls.update_adjacency_database(dbs[node])
+            if not every or (i + 1) % every != 0:
+                continue
+            graph = compile_ell(ls)
+            srcs = ell_source_batch(graph, ls, root)
+            packed = np.asarray(
+                ell_view_batch_packed(graph, srcs)
+            ).astype(np.int32)
+            fleet_preload_views(ls, [(graph, srcs, packed)])
+            ddb = solver.build_route_db(root, {"0": ls}, pfx)
+            blob = wire.dumps(ddb.to_route_db(root))
+            h = 0x811C9DC5
+            for b in blob:
+                h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+            digests.append(h)
+        out[spec.tenant_id] = digests
+    return out
+
+
 def harvest(procs) -> List[Dict]:
     """Join spawned clients and load their result files; a child that
     died without writing is reported as an error record."""
@@ -265,3 +386,110 @@ def harvest(procs) -> List[Dict]:
         with open(out_path) as f:
             results.append(_json.load(f))
     return results
+
+
+_KINDS = ("grid", "ring", "mesh")
+_SLOS = ("premium", "standard", "bulk")
+
+
+def fleet_specs(
+    clients: int, tenants_per_client: int, size: int = 4
+) -> Dict[str, List[TenantSpec]]:
+    """Deterministic client->tenants layout for the fleet mode:
+    topology kinds and SLO classes rotate so every class exercises
+    placement."""
+    out: Dict[str, List[TenantSpec]] = {}
+    n = 0
+    for c in range(clients):
+        specs = []
+        for t in range(tenants_per_client):
+            specs.append(TenantSpec(
+                tenant_id=f"c{c}_t{t}",
+                kind=_KINDS[n % len(_KINDS)],
+                size=size,
+                seed=n + 1,
+                slo=_SLOS[n % len(_SLOS)],
+            ))
+            n += 1
+        out[f"c{c}"] = specs
+    return out
+
+
+def main(argv: List[str] = None) -> int:
+    """``python -m openr_tpu.load.multi_client --services N`` — bring
+    up a ``FleetController`` fleet of N services (each with a hot
+    standby unless ``--no-standby``), admit the tenant population by
+    SLO class, drive it from spawned jax-free client processes, and
+    gate every per-round view digest against the sequential oracle.
+    Exit 0 only on full parity with zero client errors."""
+    import argparse
+    import os
+    import tempfile
+
+    ap = argparse.ArgumentParser(prog="multi_client")
+    ap.add_argument("--services", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--tenants-per-client", type=int, default=2)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument("--size", type=int, default=4)
+    ap.add_argument("--no-standby", action="store_true")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args(argv)
+
+    from openr_tpu.fleet import FleetController
+
+    fc = FleetController(
+        services=args.services,
+        with_standby=not args.no_standby,
+    )
+    fc.start()
+    report = {"ok": False, "services": args.services}
+    try:
+        ctrl_port = fc.serve_ctrl("127.0.0.1")
+        client_specs = fleet_specs(
+            args.clients, args.tenants_per_client, args.size
+        )
+        endpoints = {}
+        for specs in client_specs.values():
+            for s in specs:
+                host, port = fc.admit(s.tenant_id, s.slo)
+                endpoints[s.tenant_id] = [host, port]
+        default_ep = next(iter(endpoints.values()))
+        with tempfile.TemporaryDirectory() as td:
+            procs = spawn_clients(
+                default_ep[0], default_ep[1], client_specs,
+                args.rounds, td,
+                endpoints=endpoints,
+                controller=["127.0.0.1", ctrl_port],
+            )
+            results = harvest(procs)
+        all_specs = [
+            s for specs in client_specs.values() for s in specs
+        ]
+        oracle = oracle_digests(all_specs, args.rounds)
+        errors = [e for r in results for e in r.get("errors", [])]
+        mismatches = []
+        for r in results:
+            for tid, digs in r.get("digests", {}).items():
+                if digs != oracle.get(tid):
+                    mismatches.append(tid)
+        report.update({
+            "ok": not errors and not mismatches,
+            "tenants": len(endpoints),
+            "errors": errors,
+            "digest_mismatches": mismatches,
+            "placement": fc.placement(),
+            "counters": fc.counters(),
+        })
+    finally:
+        fc.stop()
+    text = json.dumps(report, indent=2, sort_keys=True, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

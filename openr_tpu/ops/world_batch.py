@@ -57,6 +57,7 @@ per bucket dispatch carrying batch occupancy.
 
 from __future__ import annotations
 
+import base64
 import os
 import random
 import time
@@ -139,6 +140,13 @@ TENANCY_COUNTERS = _get_registry().counter_dict(
         "wave_preemptions",  # higher-SLO requests admitted over earlier ones
         "bucket_compactions",  # vacancy-driven bucket shrinks
         "ksp2_views",        # per-tenant second-path view solves
+        "park_midflight_carries",  # parked between submit and reap,
+                                   # delta still applied to the mirror
+        "park_midflight_resets",   # same window, but the record moved
+                                   # under the dispatch: forced cold
+        "tenant_exports",    # host records serialized for migration
+        "tenant_imports",    # migrated records rehydrated here
+        "tenant_import_colds",  # imports that could not seed warm
     ],
     prefix="tenancy.",
 )
@@ -601,6 +609,125 @@ class WorldManager(ResidentEngineContract):
         if t is not None and t.slot is not None:
             self._detach(t)
         self._update_gauges()
+
+    # -- live migration (fleet plane) --------------------------------------
+
+    def export_tenant(self, tenant_id: str) -> Dict[str, object]:
+        """Serialize a tenant's host record for live migration: the
+        packed mirror, the un-replayed journal tail, and the solve
+        flags — everything ``import_tenant`` needs to rehydrate WARM
+        on another manager. The record is valid on the far side
+        because ``compile_ell`` is deterministic: a LinkState rebuilt
+        from the same adjacency content reproduces the numbering the
+        mirror and journal are expressed in. The tenant is parked
+        first (slot freed) so the record cannot race a resident
+        dispatch; the CALLER owns draining any in-flight wave before
+        exporting (the serve plane's quiesce)."""
+        t = self._tenants.get(tenant_id)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        if t.slot is not None:
+            self._detach(t)
+            self._update_gauges()
+        rec: Dict[str, object] = {
+            "tenant_id": t.tenant_id,
+            "root": t.root,
+            "srcs": [int(s) for s in t.srcs],
+            "slo": self._slo_classes.get(tenant_id, t.slo),
+            "solved": bool(t.solved),
+            "needs_solve": bool(t.needs_solve),
+            "force_reset": bool(t.force_reset),
+            "pending_structural": bool(t.pending_structural),
+            "override": dict(t.override),
+            "pending_rows": sorted(int(r) for r in t.pending_rows),
+            "pending_edges": [
+                [int(s), int(h), int(snap), int(cur)]
+                for (s, h), (snap, cur) in sorted(
+                    t.pending_edges.items()
+                )
+            ],
+            "ov_solved_b64": base64.b64encode(
+                np.ascontiguousarray(
+                    t.ov_solved, dtype=bool
+                ).tobytes()
+            ).decode("ascii"),
+            "packed_host": None,
+        }
+        if t.packed_host is not None:
+            ph = np.ascontiguousarray(t.packed_host, dtype=np.int32)
+            rec["packed_host"] = {
+                "shape": list(ph.shape),
+                "b64": base64.b64encode(ph.tobytes()).decode("ascii"),
+            }
+        TENANCY_COUNTERS["tenant_exports"] += 1
+        return rec
+
+    def import_tenant(self, ls, record: Dict[str, object]) -> TenantWorld:
+        """Rehydrate an exported record against ``ls`` (a LinkState
+        rebuilt from the same adjacency content the exporter held).
+        The shipped mirror seeds the next placement warm — the first
+        post-migration solve is a warm solve with zero compiles, the
+        live-migration no-cold-solve contract. A record whose source
+        batch no longer matches (content drift between export and
+        import) degrades to a cold admission: bits stay correct, the
+        miss is counted (``tenancy.tenant_import_colds``), never
+        silent."""
+        tid = str(record["tenant_id"])
+        self.drop(tid)
+        root = str(record["root"])
+        graph = self._shared_graph(ls)
+        srcs = ell_source_batch(graph, ls, root)
+        t = TenantWorld(tid, ls, root, graph, srcs)
+        self._tenants[tid] = t
+        slo = str(record.get("slo", "standard"))
+        self._slo_classes[tid] = slo
+        t.slo = slo
+        t.version = ls.topology_version
+        TENANCY_COUNTERS["admissions"] += 1
+        TENANCY_COUNTERS["tenant_imports"] += 1
+        ph = record.get("packed_host")
+        warm = (
+            bool(record.get("solved"))
+            and isinstance(ph, dict)
+            and [int(s) for s in record.get("srcs", [])]
+            == [int(s) for s in srcs]
+        )
+        if not warm:
+            TENANCY_COUNTERS["tenant_import_colds"] += 1
+            self._update_gauges()
+            return t
+        shape = tuple(int(x) for x in ph["shape"])
+        t.packed_host = (
+            np.frombuffer(base64.b64decode(ph["b64"]), dtype=np.int32)
+            .reshape(shape)
+            .copy()
+        )
+        t.ov_solved = np.frombuffer(
+            base64.b64decode(record["ov_solved_b64"]), dtype=bool
+        ).copy()
+        t.pending_edges = {
+            (int(s), int(h)): (int(snap), int(cur))
+            for s, h, snap, cur in record.get("pending_edges", [])
+        }
+        t.pending_rows = {
+            int(r) for r in record.get("pending_rows", [])
+        }
+        t.pending_structural = bool(record.get("pending_structural"))
+        t.force_reset = bool(record.get("force_reset"))
+        t.needs_solve = bool(record.get("needs_solve"))
+        t.solved = True
+        t.override = {
+            str(k): bool(v)
+            for k, v in (record.get("override") or {}).items()
+        }
+        if t.override:
+            # a vantage-local override diverges from the shared LSDB
+            # truth; the shipped journal cannot vouch for it here —
+            # same forced-cold rule as _apply_override
+            t.force_reset = True
+            t.needs_solve = True
+        self._update_gauges()
+        return t
 
     def set_slo_class(self, tenant_id: str, slo: str) -> None:
         """Stamp a tenant's SLO class (serve plane admission input).
@@ -1168,9 +1295,14 @@ class WorldManager(ResidentEngineContract):
         # both readback lanes kicked at submit; _dispatch_finish reaps
         da.kick_async(ch_count)
         da.kick_async(out)
+        # launch-epoch versions: _dispatch_finish may reap AFTER a
+        # tenant was parked (fleet migration drains make that window
+        # routine) or even re-synced; the finish-side settle must know
+        # which world this dispatch actually solved
+        launch_ver = {slot: t.version for slot, t in solving}
         return (
             bucket, solving, warm_ct, cold_ct,
-            packed, ch_count, out, _span, _t0, slo_counts,
+            packed, ch_count, out, _span, _t0, slo_counts, launch_ver,
         )
 
     @committed_dispatch
@@ -1180,9 +1312,36 @@ class WorldManager(ResidentEngineContract):
         journals + counters + span."""
         (
             bucket, solving, warm_ct, cold_ct,
-            packed, ch_count, out, _span, _t0, slo_counts,
+            packed, ch_count, out, _span, _t0, slo_counts, launch_ver,
         ) = ctx
         cap = bucket.delta_cap
+        mirror_shape = (2 * bucket.s, bucket.n)
+
+        # A tenant parked between submit and reap vacated its
+        # bucket.tenants slot, but it is still OWED this dispatch's
+        # delta — its journal was emitted into this solve. Dropping
+        # the rows while the settle loop below clears the journal
+        # would leave a stale mirror marked solved (the un-reaped-
+        # delta bug; the fleet migration drain makes the window
+        # routine). Attribute vacated slots back to the launch-time
+        # occupant, as long as its record still describes the world
+        # this dispatch solved (same version, shape-intact mirror).
+        launched = dict(solving)
+
+        def _sink_of(slot_i: int) -> Optional[TenantWorld]:
+            t = bucket.tenants[slot_i]
+            if t is not None:
+                return t
+            lt = launched.get(slot_i)
+            if (
+                lt is not None
+                and lt.version == launch_ver[slot_i]
+                and lt.packed_host is not None
+                and lt.packed_host.shape == mirror_shape
+            ):
+                return lt
+            return None  # record moved under the dispatch: drop
+
         # count + compacted rows were both kicked at launch: reaping
         # them here is the window's single read phase, overlapped with
         # the other buckets' still-running solves
@@ -1192,7 +1351,8 @@ class WorldManager(ResidentEngineContract):
         if cnt > cap:
             TENANCY_COUNTERS["delta_overflows"] += 1
             full = da.reap_read(packed)
-            for slot, t in enumerate(bucket.tenants):
+            for slot in range(bucket.slots):
+                t = _sink_of(slot)
                 if t is not None:
                     t.packed_host = np.array(full[slot])
         # openr-lint: disable=host-branch-in-chain -- post-reap settle: the count only sizes the host mirror patch (audited)
@@ -1200,7 +1360,7 @@ class WorldManager(ResidentEngineContract):
             rows = out_host[:cnt]
             slots = rows[:, 0]
             for slot in np.unique(slots):
-                t = bucket.tenants[int(slot)]
+                t = _sink_of(int(slot))
                 if t is None:
                     continue  # vacated slot: stale rows, drop
                 m = slots == slot
@@ -1210,6 +1370,20 @@ class WorldManager(ResidentEngineContract):
         TENANCY_COUNTERS["warm_solves"] += warm_ct
         TENANCY_COUNTERS["cold_solves"] += cold_ct
         for _slot, t in solving:
+            if bucket.tenants[_slot] is not t:
+                # parked (or dropped) between submit and reap
+                if _sink_of(_slot) is not t:
+                    # the record moved under the dispatch (re-synced
+                    # or reset): the delta was dropped above, so the
+                    # journal must survive and the next admission
+                    # must not trust the mirror — cold, never silent
+                    TENANCY_COUNTERS["park_midflight_resets"] += 1
+                    t.force_reset = True
+                    t.solved = False
+                    continue
+                # mirror received the delta: the host record is
+                # current and re-admission rehydrates warm with bits
+                TENANCY_COUNTERS["park_midflight_carries"] += 1
             t.pending_edges = {}
             t.pending_structural = False
             t.ov_solved = np.array(t.graph.overloaded, copy=True)

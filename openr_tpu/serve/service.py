@@ -283,6 +283,47 @@ class SolverService:
         with self._cv:
             self._detached.add(tenant_id)
 
+    # -- fleet plane: drain / export / import ------------------------------
+
+    def quiesce(self, tenant_id: str, timeout_s: float = 30.0) -> None:
+        """Migration drain barrier: block until the tenant has no
+        pending request AND no wave is in flight. After this returns
+        (and until the caller re-admits work for the tenant) its host
+        record is stable — safe to export. New requests arriving after
+        the barrier are the ctrl layer's problem: it freezes the
+        tenant (retry-later replies) before draining."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while tenant_id in self._pending or self._wave_active:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"quiesce({tenant_id!r}) not drained in "
+                        f"{timeout_s}s"
+                    )
+                self._cv.wait(0.02)
+
+    def export_tenant(self, tenant_id: str) -> Dict[str, object]:
+        """Serialize the tenant's host record for live migration
+        (``WorldManager.export_tenant`` behind the service lock). The
+        caller drains first (``quiesce``)."""
+        with self._mgr_lock:
+            return self._mgr.export_tenant(tenant_id)
+
+    def import_tenant(self, ls, record: Dict[str, object]):
+        """Rehydrate a migrated tenant's record against ``ls``
+        (``WorldManager.import_tenant`` behind the service lock);
+        returns the placed ``TenantWorld``. The first post-import
+        solve is warm — zero compiles, zero cold solves — unless the
+        record degraded to a counted cold admission."""
+        with self._mgr_lock:
+            t = self._mgr.import_tenant(ls, record)
+        slo = record.get("slo")
+        if isinstance(slo, str):
+            with self._cv:
+                self._slo[str(record["tenant_id"])] = slo
+                self._detached.discard(str(record["tenant_id"]))
+        return t
+
     def connection_closed(self, conn: int) -> None:
         """Ctrl-transport teardown hook: every tenant the connection
         registered is parked warm — the shared bucket keeps serving

@@ -80,6 +80,20 @@ def _journal_key(seq: int) -> str:
     return f"{_JOURNAL_PREFIX}{seq:012d}"
 
 
+def journal_suffix(records, applied_seq: int) -> list:
+    """The un-applied tail of an ordered journal: every record whose
+    ``seq`` is strictly past ``applied_seq``. ONE definition shared by
+    recovery (replay past the checkpoint anchor, whose ``seq`` is
+    exclusive) and the fleet plane's replica stream, where the
+    never-promote-past-an-un-shipped-suffix hazard rule is exactly
+    "this list must be empty — or its loss consciously counted —
+    before a standby may take over" (fleet/journal.py)."""
+    return [
+        rec for rec in records
+        if rec is not None and rec.seq > applied_seq
+    ]
+
+
 def replay_journal(
     ckpt: Optional[LsdbCheckpoint],
     records: Iterable[JournalRecord],
@@ -98,9 +112,7 @@ def replay_journal(
     if ckpt is not None:
         lsdb = {a: dict(kv) for a, kv in ckpt.key_vals_by_area.items()}
         base_seq = ckpt.seq
-    for rec in records:
-        if rec is None or rec.seq < base_seq:
-            continue
+    for rec in journal_suffix(records, base_seq - 1):
         lsdb.setdefault(rec.area, {}).update(rec.key_vals)
     return lsdb
 

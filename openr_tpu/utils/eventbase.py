@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
+from openr_tpu.analysis.annotations import thread_confined
 from openr_tpu.messaging.queue import QueueClosedError, RQueue
 
 # upper bound on the event loop's idle wait so last_loop_ts stays fresh
@@ -255,6 +256,12 @@ class PeriodicHandle:
         self._handle.cancel()
 
 
+# per-instance pacing state owned by whichever single loop created the
+# backoff (an evb retry loop, the journal streamer thread, a client's
+# reconnect path) — never shared across threads. The shared-state rule
+# merges instances by class, so cross-role access to one instance is
+# impossible by construction — hence "owner" confinement.
+@thread_confined("owner", "_current", "_last_error_ts")
 class ExponentialBackoff:
     """reference: common/ExponentialBackoff.h — per-key retry pacing.
 

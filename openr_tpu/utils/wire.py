@@ -31,6 +31,11 @@ import typing
 from typing import Any, Dict, Tuple, get_args, get_origin, get_type_hints
 
 
+#: per-class field-order memo for the encode path (field order is
+#: static; ``dataclasses.fields`` rebuilds the tuple on every call)
+_FIELDS_MEMO: Dict[type, tuple] = {}
+
+
 def _encode_varint(n: int, out: bytearray) -> None:
     while True:
         b = n & 0x7F
@@ -107,7 +112,10 @@ def _encode(obj: Any, out: bytearray) -> None:
         name = type(obj).__name__.encode("utf-8")
         _encode_varint(len(name), out)
         out.extend(name)
-        flds = dataclasses.fields(obj)
+        flds = _FIELDS_MEMO.get(type(obj))
+        if flds is None:
+            flds = dataclasses.fields(obj)
+            _FIELDS_MEMO[type(obj)] = flds
         _encode_varint(len(flds), out)
         for f in flds:
             _encode(getattr(obj, f.name), out)
@@ -147,6 +155,21 @@ class _Reader:
         chunk = self.data[self.pos : self.pos + n]
         self.pos += n
         return chunk
+
+
+#: per-class (type hints, fields) memo. ``get_type_hints`` re-evaluates
+#: every stringified annotation (PEP 563) on each call — decoding one
+#: 1000-adjacency AdjacencyDatabase would pay that eval per nested
+#: Adjacency. Hints and field order are static per class; cache them.
+_CLASS_MEMO: Dict[type, Tuple[Dict[str, Any], tuple]] = {}
+
+
+def _class_memo(tp: type) -> Tuple[Dict[str, Any], tuple]:
+    memo = _CLASS_MEMO.get(tp)
+    if memo is None:
+        memo = (get_type_hints(tp), dataclasses.fields(tp))
+        _CLASS_MEMO[tp] = memo
+    return memo
 
 
 def _is_optional(tp) -> Tuple[bool, Any]:
@@ -205,8 +228,7 @@ def _decode(r: _Reader, tp: Any) -> Any:
             raise TypeError(f"wire: object {name!r} but target type is {tp!r}")
         if tp.__name__ != name:
             raise TypeError(f"wire: expected {tp.__name__!r}, found {name!r}")
-        hints = get_type_hints(tp)
-        flds = dataclasses.fields(tp)
+        hints, flds = _class_memo(tp)
         values: Dict[str, Any] = {}
         for i in range(nfields):
             if i < len(flds):

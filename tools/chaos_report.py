@@ -15,9 +15,10 @@ loudly if the graceful-degradation contract regressed:
   oracle),
 - a ladder walk was unbounded (more walks than churn events),
 - the coverage floor was missed (too few faults fired, fewer than
-  nine distinct seams crossed — including ``device.lost``,
-  ``state.checkpoint_write``, and ``device.corrupt_resident`` — or
-  the lossy-publisher seam never fired),
+  eleven distinct seams crossed — including ``device.lost``,
+  ``state.checkpoint_write``, ``device.corrupt_resident``, and the
+  fleet pair ``fleet.journal_stream``/``fleet.promote`` — or the
+  lossy-publisher seam never fired),
 - the lossy-load route product diverged from a survivor-replay
   oracle (dropped events must be pure no-ops),
 - the kill-restart leg (checkpoint mid-storm with one injected
@@ -26,7 +27,12 @@ loudly if the graceful-degradation contract regressed:
 - the corruption-storm leg (probabilistic ``device.corrupt_resident``
   flips across a churn run, audited each event) missed a conviction,
   failed a heal, or finished with a product that diverged from the
-  fault-free oracle.
+  fault-free oracle,
+- the fleet leg (two hot-standby services under churn with the
+  replica stream flapping, a live migration and a faulted-ladder
+  standby promotion mid-storm) flapped a route, diverged from the
+  never-migrated oracle, or left the surviving replica stream
+  undrained.
 
 Writes a JSON artifact (``--out``, default
 ``/tmp/openr_tpu_chaos_report.json``) with the per-site fault counts,
@@ -700,6 +706,174 @@ def _kill_restart_leg(seed, events, failures):
     return events
 
 
+def _fleet_leg(seed, events, failures):
+    """Fleet-plane chaos: a two-service hot-standby fleet under a
+    seeded churn storm with the ``fleet.journal_stream`` seam
+    flapping, a forced live migration mid-storm, and a primary kill
+    whose standby promotion walks the ladder with its first rung
+    faulted (``fleet.promote``). Gates: the survivor replay — every
+    view and FIB digest across the whole storm must equal the
+    never-migrated, never-promoted oracle — exactly one promotion
+    with ZERO route deletes, the stream seam recovered (errors
+    counted, lag drained on the surviving pair), and the client rode
+    both transitions."""
+    from openr_tpu.faults import FaultSchedule, get_injector
+    from openr_tpu.fleet import FleetController
+    from openr_tpu.fleet.controller import FAULT_PROMOTE
+    from openr_tpu.fleet.journal import FAULT_JOURNAL_STREAM
+    from openr_tpu.load import multi_client
+    from openr_tpu.serve.client import SolverClient
+    from openr_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    specs = [
+        multi_client.TenantSpec(
+            tenant_id="fl_a", kind="grid", size=4,
+            seed=seed % 97, slo="premium",
+        ),
+        multi_client.TenantSpec(
+            tenant_id="fl_b", kind="mesh", size=5,
+            seed=(seed + 1) % 97, slo="standard",
+        ),
+    ]
+    rounds = max(4, events // len(specs))
+    migrate_at = rounds // 3
+    kill_at = (2 * rounds) // 3
+
+    base = {
+        "promotions": reg.counter_get("fleet.promotions"),
+        "deletes": reg.counter_get("fleet.promotion_deletes"),
+        "stream_errors": reg.counter_get(
+            "fleet.journal_stream_errors"
+        ),
+    }
+    fc = FleetController(services=2, with_standby=True)
+    fc.start()
+    sp = {s.tenant_id: [] for s in specs}
+    fib = {s.tenant_id: [] for s in specs}
+    try:
+        ctrl_port = fc.serve_ctrl("127.0.0.1")
+        worlds = {}
+        clients = {}
+        for s in specs:
+            dbs = s.build_dbs()
+            worlds[s.tenant_id] = (s, dbs)
+            host, port = fc.admit(s.tenant_id, s.slo)
+            cli = SolverClient(
+                host, port, controller=("127.0.0.1", ctrl_port)
+            )
+            cli.register(s.tenant_id, slo=s.slo)
+            cli.update_world(
+                s.tenant_id, [dbs[k] for k in sorted(dbs)],
+                root=s.root_of(dbs),
+                prefix_dbs=[
+                    db for _k, db in sorted(
+                        s.build_prefix_dbs().items()
+                    )
+                ],
+            )
+            clients[s.tenant_id] = cli
+        # the replica stream flaps from the start: the streamer must
+        # recover through its backoff, never silently stall
+        get_injector().arm(
+            FAULT_JOURNAL_STREAM, FaultSchedule.fail_n(3)
+        )
+        victim = specs[0].tenant_id
+        for i in range(rounds):
+            if i == migrate_at:
+                fc.migrate(victim)
+            if i == kill_at:
+                # the promote ladder's preferred rung is faulted: the
+                # walk must degrade to the surrendered-suffix rung and
+                # still take over — counted, never silent
+                get_injector().arm(
+                    FAULT_PROMOTE, FaultSchedule.fail_once()
+                )
+                owner = fc.owner_of(victim)
+                ms = fc.services()[owner]
+                ms.streamer.flush(15.0)
+                ms.kill_primary()
+                promoted = fc.maybe_failover()
+                if promoted != [owner]:
+                    failures.append(
+                        f"fleet: expected promotion of {owner}, "
+                        f"got {promoted}"
+                    )
+            for tid, (s, dbs) in worlds.items():
+                cli = clients[tid]
+                if i > 0:
+                    node = multi_client.apply_mutation(dbs, s, i)
+                    cli.update_world(tid, [dbs[node]])
+                sp[tid].append(cli.solve(tid).digest())
+                fib[tid].append(cli.fib(tid).digest)
+        # survivor replay: the storm's full digest history vs the
+        # fault-free oracle
+        oracle_sp = multi_client.oracle_digests(specs, rounds)
+        oracle_fib = multi_client.oracle_fib_digests(
+            specs, rounds, every=1
+        )
+        for s in specs:
+            if sp[s.tenant_id] != oracle_sp[s.tenant_id]:
+                failures.append(
+                    f"fleet: SP digest diverged for {s.tenant_id} "
+                    "across migration/promotion"
+                )
+            if fib[s.tenant_id] != oracle_fib[s.tenant_id]:
+                failures.append(
+                    f"fleet: FIB digest diverged for {s.tenant_id} "
+                    "across migration/promotion"
+                )
+        promotions = (
+            reg.counter_get("fleet.promotions") - base["promotions"]
+        )
+        if promotions != 1:
+            failures.append(
+                f"fleet: {promotions} promotions (expected 1)"
+            )
+        deletes = (
+            reg.counter_get("fleet.promotion_deletes")
+            - base["deletes"]
+        )
+        if deletes != 0:
+            failures.append(
+                f"fleet: promotion deleted {deletes} routes "
+                "(graceful restart demands 0)"
+            )
+        if (
+            reg.counter_get("fleet.journal_stream_errors")
+            <= base["stream_errors"]
+        ):
+            failures.append(
+                "fleet: journal_stream seam never fired"
+            )
+        # the surviving (non-promoted) pair must drain its stream
+        for name, ms in fc.services().items():
+            if ms.streamer is not None:
+                if not ms.streamer.flush(15.0):
+                    failures.append(
+                        f"fleet: {name} replica stream failed to "
+                        "drain after the storm"
+                    )
+                elif ms.streamer.lag() != 0:
+                    failures.append(
+                        f"fleet: {name} replica lag "
+                        f"{ms.streamer.lag()} after drain"
+                    )
+        if not any(
+            cli.redirects >= 1 for cli in clients.values()
+        ):
+            failures.append(
+                "fleet: no client followed the migration redirect"
+            )
+        for cli in clients.values():
+            cli.close()
+    finally:
+        get_injector().disarm(FAULT_JOURNAL_STREAM)
+        get_injector().disarm(FAULT_PROMOTE)
+        fc.stop()
+    return rounds * len(specs)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--seed", type=int, default=20260805)
@@ -726,10 +900,10 @@ def main(argv=None) -> int:
 
     budgets = (
         {"engine": 60, "decision": 20, "platform": 20, "load": 40,
-         "restart": 12, "corrupt": 20, "floor": 50}
+         "restart": 12, "corrupt": 20, "fleet": 12, "floor": 50}
         if args.smoke
         else {"engine": 160, "decision": 40, "platform": 40, "load": 80,
-              "restart": 24, "corrupt": 48, "floor": 200}
+              "restart": 24, "corrupt": 48, "fleet": 24, "floor": 200}
     )
 
     failures: list = []
@@ -741,6 +915,7 @@ def main(argv=None) -> int:
     events += _platform_leg(args.seed, budgets["platform"], failures)
     events += _load_leg(args.seed, budgets["load"], failures)
     events += _kill_restart_leg(args.seed, budgets["restart"], failures)
+    events += _fleet_leg(args.seed, budgets["fleet"], failures)
     elapsed = time.perf_counter() - t0
 
     injected = {
@@ -753,11 +928,13 @@ def main(argv=None) -> int:
             f"coverage floor missed: {sum(injected.values())} faults "
             f"< {budgets['floor']}"
         )
-    # the floor covers the crash and corruption seams too:
+    # the floor covers the crash, corruption, and fleet seams too:
     # ``device.lost`` (engine leg), ``state.checkpoint_write``
-    # (kill-restart leg), and ``device.corrupt_resident``
-    # (corruption-storm leg) must all fire
-    if len(injected) < 9:
+    # (kill-restart leg), ``device.corrupt_resident``
+    # (corruption-storm leg), and the fleet pair
+    # ``fleet.journal_stream`` + ``fleet.promote`` (fleet leg) must
+    # all fire
+    if len(injected) < 11:
         failures.append(
             f"only {len(injected)} seams crossed: {sorted(injected)}"
         )

@@ -1,0 +1,357 @@
+#!/usr/bin/env python
+"""Fleet-plane gate (``make fleet-smoke``) and report artifact.
+
+Brings up a two-service fleet (each with a hot standby), storms it
+from jax-free multi-process clients through the controller's
+placement, then runs the two transitions the fleet plane exists for —
+and fails loudly if either contract regressed:
+
+- STORM PARITY: every view digest every client reads through the
+  fleet placement must equal the jax-free oracle replay
+  (``load.multi_client.oracle_digests``) — the ``--services N`` mode
+  of the load driver, admission by SLO class included.
+- WARM MIGRATION: a tenant live-migrated between services mid-churn
+  must keep serving bit-identical SP views and FIB-level
+  ``RouteDatabase`` products vs the never-migrated oracle, with ZERO
+  cold solves (``tenancy.cold_solves`` delta 0 AND
+  ``tenancy.tenant_import_colds`` delta 0) and ZERO jit compiles
+  (``jax.compile_count`` delta 0) on the destination — the snapshot +
+  journal rehydration must land warm or the migration story is a lie.
+- PROMOTION NO-FLAP: killing the owning primary mid-schedule and
+  promoting its hot standby must take exactly one promotion
+  (``fleet.promotions`` delta 1) with ZERO route deletes
+  (``fleet.promotion_deletes`` delta 0 — graceful-restart semantics:
+  one reconcile, no flap), and every post-promotion digest must stay
+  bit-identical to the oracle continuation.
+
+Writes a JSON artifact (``--out``, default
+``/tmp/openr_tpu_fleet_smoke.json``); exit 0 on pass, 1 with a reason
+list on fail. Runs CPU-pinned — this gates fleet-plane transitions,
+not device throughput.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# allow direct invocation (python tools/fleet_smoke.py) in addition
+# to module mode (python -m tools.fleet_smoke)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out", default="/tmp/openr_tpu_fleet_smoke.json"
+    )
+    parser.add_argument("--services", type=int, default=2)
+    parser.add_argument("--clients", type=int, default=2)
+    parser.add_argument("--tenants-per-client", type=int, default=3)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--drill-rounds", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    from openr_tpu import testing
+
+    testing.pin_host_cpu()
+
+    from openr_tpu.fleet import FleetController
+    from openr_tpu.load import multi_client
+    from openr_tpu.ops.world_batch import TENANCY_COUNTERS
+    from openr_tpu.serve.client import SolverClient
+    from openr_tpu.telemetry import get_registry, jax_hooks
+
+    hooks_live = jax_hooks.install()
+    reg = get_registry()
+    failures: list = []
+    report: dict = {
+        "gates": {},
+        "services": args.services,
+        "rounds": args.rounds,
+    }
+
+    fc = FleetController(services=args.services, with_standby=True)
+    fc.start()
+    t0 = time.perf_counter()
+    try:
+        ctrl_port = fc.serve_ctrl("127.0.0.1")
+
+        # -- leg 1: multi-process storm through the placement -------
+        client_specs = multi_client.fleet_specs(
+            args.clients, args.tenants_per_client, size=4
+        )
+        endpoints = {}
+        for specs in client_specs.values():
+            for s in specs:
+                host, port = fc.admit(s.tenant_id, s.slo)
+                endpoints[s.tenant_id] = [host, port]
+        owners = {
+            tid: fc.owner_of(tid) for tid in endpoints
+        }
+        report["placement_spread"] = len(set(owners.values()))
+        report["gates"]["placement_spread"] = (
+            len(set(owners.values())) == min(
+                args.services, len(endpoints)
+            )
+        )
+        if not report["gates"]["placement_spread"]:
+            failures.append(
+                "placement left a service empty under a mixed-class "
+                f"population: {owners}"
+            )
+        default_ep = next(iter(endpoints.values()))
+        with tempfile.TemporaryDirectory() as out_dir:
+            procs = multi_client.spawn_clients(
+                default_ep[0], default_ep[1], client_specs,
+                args.rounds, out_dir,
+                endpoints=endpoints,
+                controller=["127.0.0.1", ctrl_port],
+            )
+            results = multi_client.harvest(procs)
+        errors = [
+            e for r in results for e in r.get("errors", [])
+        ]
+        all_specs = [
+            s for specs in client_specs.values() for s in specs
+        ]
+        oracle = multi_client.oracle_digests(all_specs, args.rounds)
+        diverged = [
+            tid
+            for r in results
+            for tid, digs in r.get("digests", {}).items()
+            if digs != oracle.get(tid)
+        ]
+        report["gates"]["storm_clients_clean"] = not errors
+        report["gates"]["storm_wire_parity"] = not diverged
+        if errors:
+            failures.append(f"storm client errors: {errors[:4]}")
+        if diverged:
+            failures.append(f"storm parity diverged: {diverged}")
+
+        # -- leg 2: warm migration drill ----------------------------
+        # A standby-free fleet so the cold/compile accounting is
+        # exact: hot standbys legitimately cold-solve their FIRST
+        # absorb of a replicated tenant, and TENANCY_COUNTERS is
+        # process-global — the migration gate must see only the
+        # migration itself.
+        fm = FleetController(
+            services=2, with_standby=False
+        )
+        fm.start()
+        try:
+            drill = multi_client.TenantSpec(
+                tenant_id="drill", kind="grid", size=4, seed=17,
+                slo="premium",
+            )
+            dbs = drill.build_dbs()
+            host, port = fm.admit(drill.tenant_id, drill.slo)
+            cli = SolverClient(host, port)
+            cli.register(drill.tenant_id, slo=drill.slo)
+            cli.update_world(
+                drill.tenant_id, [dbs[k] for k in sorted(dbs)],
+                root=drill.root_of(dbs),
+                prefix_dbs=[
+                    db for _k, db in sorted(
+                        drill.build_prefix_dbs().items()
+                    )
+                ],
+            )
+            rounds = args.drill_rounds
+            migrate_at = rounds // 2
+            sp_digests, fib_digests = [], []
+            src = fm.owner_of(drill.tenant_id)
+            snap = {}
+            for i in range(rounds):
+                if i == migrate_at:
+                    # everything below this point must be warm: the
+                    # destination already compiled these shapes, so
+                    # the migration may not compile, may not
+                    # cold-solve
+                    snap["compiles"] = (
+                        reg.counter_get("jax.compile_count")
+                        if hooks_live else 0
+                    )
+                    snap["colds"] = int(
+                        TENANCY_COUNTERS["cold_solves"]
+                    )
+                    snap["import_colds"] = int(
+                        TENANCY_COUNTERS["tenant_import_colds"]
+                    )
+                    snap["migrations"] = fm.counters().get(
+                        "fleet.migrations", 0
+                    )
+                    fm.migrate(drill.tenant_id)
+                if i > 0:
+                    node = multi_client.apply_mutation(
+                        dbs, drill, i
+                    )
+                    cli.update_world(drill.tenant_id, [dbs[node]])
+                sp_digests.append(
+                    cli.solve(drill.tenant_id).digest()
+                )
+                fib_digests.append(cli.fib(drill.tenant_id).digest)
+            moved = fm.owner_of(drill.tenant_id) != src
+            mig_counters = fm.counters()
+
+            oracle_sp = multi_client.oracle_digests(
+                [drill], rounds
+            )[drill.tenant_id]
+            oracle_fib = multi_client.oracle_fib_digests(
+                [drill], rounds, every=1
+            )[drill.tenant_id]
+
+            compile_delta = (
+                reg.counter_get("jax.compile_count")
+                - snap["compiles"]
+            ) if hooks_live else 0
+            cold_delta = int(
+                TENANCY_COUNTERS["cold_solves"]
+            ) - snap["colds"]
+            import_cold_delta = int(
+                TENANCY_COUNTERS["tenant_import_colds"]
+            ) - snap["import_colds"]
+
+            report["migration"] = {
+                "moved": moved,
+                "compile_delta": compile_delta,
+                "cold_delta": cold_delta,
+                "import_cold_delta": import_cold_delta,
+                "migration_ms_p50": reg.percentile(
+                    "fleet.migration_ms", 50.0
+                ),
+            }
+            report["gates"]["migration_moved"] = moved and (
+                mig_counters.get("fleet.migrations", 0)
+                - snap["migrations"] == 1
+            )
+            report["gates"]["migration_warm"] = (
+                cold_delta == 0 and import_cold_delta == 0
+            )
+            report["gates"]["migration_zero_compiles"] = (
+                compile_delta == 0
+            )
+            report["gates"]["migration_sp_parity"] = (
+                sp_digests == oracle_sp
+            )
+            report["gates"]["migration_fib_parity"] = (
+                fib_digests == oracle_fib
+            )
+            report["gates"]["client_followed_redirect"] = (
+                cli.redirects >= 1
+            )
+            cli.close()
+        finally:
+            fm.stop()
+
+        # -- leg 3: promotion drill (hot-standby fleet) -------------
+        pro = multi_client.TenantSpec(
+            tenant_id="pro", kind="mesh", size=5, seed=23,
+            slo="standard",
+        )
+        pdbs = pro.build_dbs()
+        host, port = fc.admit(pro.tenant_id, pro.slo)
+        cli = SolverClient(
+            host, port, controller=("127.0.0.1", ctrl_port)
+        )
+        cli.register(pro.tenant_id, slo=pro.slo)
+        cli.update_world(
+            pro.tenant_id, [pdbs[k] for k in sorted(pdbs)],
+            root=pro.root_of(pdbs),
+            prefix_dbs=[
+                db for _k, db in sorted(
+                    pro.build_prefix_dbs().items()
+                )
+            ],
+        )
+        rounds = args.drill_rounds
+        kill_at = rounds // 2
+        sp_digests, fib_digests = [], []
+        snap = {
+            "promotions": fc.counters().get("fleet.promotions", 0),
+            "promotion_deletes": fc.counters().get(
+                "fleet.promotion_deletes", 0
+            ),
+            "failovers": fc.counters().get(
+                "fleet.failovers_detected", 0
+            ),
+        }
+        for i in range(rounds):
+            if i == kill_at:
+                # the owner dies mid-schedule; the hot standby takes
+                # over under graceful-restart semantics
+                owner = fc.owner_of(pro.tenant_id)
+                ms = fc.services()[owner]
+                ms.streamer.flush(10.0)
+                ms.kill_primary()
+                report["promoted"] = fc.maybe_failover()
+            if i > 0:
+                node = multi_client.apply_mutation(pdbs, pro, i)
+                cli.update_world(pro.tenant_id, [pdbs[node]])
+            sp_digests.append(cli.solve(pro.tenant_id).digest())
+            fib_digests.append(cli.fib(pro.tenant_id).digest)
+        counters = fc.counters()
+        oracle_sp = multi_client.oracle_digests(
+            [pro], rounds
+        )[pro.tenant_id]
+        oracle_fib = multi_client.oracle_fib_digests(
+            [pro], rounds, every=1
+        )[pro.tenant_id]
+        report["promotion"] = {
+            "promotions_delta": counters.get("fleet.promotions", 0)
+            - snap["promotions"],
+            "deletes_delta": counters.get(
+                "fleet.promotion_deletes", 0
+            ) - snap["promotion_deletes"],
+            "failovers_delta": counters.get(
+                "fleet.failovers_detected", 0
+            ) - snap["failovers"],
+            "replica_lag": reg.counter_get("fleet.replica_lag"),
+        }
+        report["gates"]["promotion_took_over"] = (
+            report["promotion"]["promotions_delta"] == 1
+            and report["promotion"]["failovers_delta"] == 1
+        )
+        report["gates"]["promotion_zero_deletes"] = (
+            report["promotion"]["deletes_delta"] == 0
+        )
+        report["gates"]["promotion_sp_parity"] = (
+            sp_digests == oracle_sp
+        )
+        report["gates"]["promotion_fib_parity"] = (
+            fib_digests == oracle_fib
+        )
+        report["gates"]["client_rode_failover"] = (
+            cli.reconnects >= 1
+        )
+        for gate, ok in report["gates"].items():
+            if not ok and not any(gate in f for f in failures):
+                failures.append(f"gate failed: {gate}")
+        cli.close()
+    finally:
+        fc.stop()
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    report["counters"] = {
+        k: v for k, v in sorted(get_registry().snapshot().items())
+        if k.startswith("fleet.")
+    }
+    report["failures"] = failures
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=True, default=str)
+        f.write("\n")
+    print(json.dumps(report["gates"], indent=2, sort_keys=True))
+    if failures:
+        print(f"FLEET GATE: FAIL ({len(failures)})", file=sys.stderr)
+        return 1
+    print(f"FLEET GATE: PASS (report: {args.out})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
